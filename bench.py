@@ -1,0 +1,107 @@
+"""Benchmark: p99 device latency of the FFD solve at north-star scale.
+
+Workload = BASELINE.json config #2-flavored: 50k heterogeneous pods (64
+distinct shapes, mixed constraints) x the full ~700-type catalog. The
+reference's greedy runs this loop on CPU inside the provisioner; the target
+is p99 < 200 ms on one TPU chip (BASELINE.md north star).
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": ..., "unit": "ms", "vs_baseline": ...}
+``vs_baseline`` is target_ms / measured_p99 (>1.0 means beating the 200 ms
+target).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+TARGET_MS = 200.0
+
+
+def build_problem(num_pods: int):
+    from karpenter_provider_aws_tpu.catalog import CatalogProvider
+    from karpenter_provider_aws_tpu.models import NodePool, Operator, Requirement
+    from karpenter_provider_aws_tpu.models import labels as lbl
+    from karpenter_provider_aws_tpu.models.pod import make_pods
+    from karpenter_provider_aws_tpu.ops.encode import encode_problem, pad_problem
+
+    catalog = CatalogProvider()
+    # Reference default-NodePool shape: instance-category pinned to c/m/r.
+    pool = NodePool(
+        name="default",
+        requirements=[Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m", "r"))],
+    )
+    rng = np.random.RandomState(0)
+    pods = []
+    n_shapes = 64
+    per_shape = num_pods // n_shapes
+    for i in range(n_shapes):
+        cpu_m = int(rng.choice([100, 250, 500, 1000, 2000, 4000, 8000]))
+        mem_mi = cpu_m * int(rng.choice([1, 2, 4, 8]))
+        kwargs = {}
+        r = rng.rand()
+        if r < 0.15:
+            kwargs["node_selector"] = {lbl.ARCH: "arm64"}
+        elif r < 0.25:
+            kwargs["node_selector"] = {lbl.TOPOLOGY_ZONE: str(rng.choice(["zone-a", "zone-b"]))}
+        pods += make_pods(per_shape, f"shape{i}", {"cpu": f"{cpu_m}m", "memory": f"{mem_mi}Mi"}, **kwargs)
+    problem = encode_problem(pods, catalog, pool)
+    return pad_problem(problem)
+
+
+def main() -> None:
+    num_pods = int(os.environ.get("BENCH_PODS", 50_000))
+    iters = int(os.environ.get("BENCH_ITERS", 30))
+    max_nodes = int(os.environ.get("BENCH_MAX_NODES", 4096))
+
+    import jax
+    import jax.numpy as jnp
+
+    from karpenter_provider_aws_tpu.ops.ffd import ffd_solve
+
+    problem = build_problem(num_pods)
+    args = (
+        jnp.asarray(problem.requests),
+        jnp.asarray(problem.counts),
+        jnp.asarray(problem.compat),
+        jnp.asarray(problem.capacity),
+        jnp.asarray(problem.price),
+        jnp.asarray(problem.group_window),
+        jnp.asarray(problem.type_window),
+    )
+
+    def run():
+        res = ffd_solve(*args, max_nodes=max_nodes)
+        jax.block_until_ready(res.node_type)
+        return res
+
+    res = run()  # compile + warmup
+    unplaced = int(np.asarray(res.unplaced).sum())
+    if unplaced:
+        print(f"warning: {unplaced} pods unplaced at bench scale", file=sys.stderr)
+
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        run()
+        times.append((time.perf_counter() - t0) * 1000.0)
+    p99 = float(np.percentile(times, 99))
+    print(
+        json.dumps(
+            {
+                "metric": f"p99_ffd_solve_latency_{num_pods}pods_x_{problem.capacity.shape[0]}types",
+                "value": round(p99, 3),
+                "unit": "ms",
+                "vs_baseline": round(TARGET_MS / p99, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+"""karpenter_provider_aws_tpu — a TPU-native node-provisioning framework.
+
+A brand-new framework with the capabilities of Karpenter's AWS provider
+(reference: gjreasoner/karpenter-provider-aws): a node-autoscaling control
+plane that watches pending pods, bin-packs them onto the cheapest feasible
+cloud capacity, launches/reaps instances, handles spot interruption and
+insufficient-capacity feedback, and continuously consolidates the cluster.
+
+The architecture is TPU-first, not a port:
+
+- ``models/``     — the data model: label-requirement engine, Pod, NodePool,
+                    NodeClass, NodeClaim (reference: ``pkg/apis/v1beta1``).
+- ``catalog/``    — the instance-type "device catalog": capacities,
+                    allocatable math, zonal spot/on-demand offerings, ICE
+                    masking (reference: ``pkg/providers/instancetype``,
+                    ``pkg/providers/pricing``).
+- ``ops/``        — the TPU compute path: tensor encoding of the scheduling
+                    problem and jitted solvers (FFD bin-packing scan,
+                    consolidation simulator) built on jax.numpy/lax.
+- ``scheduling/`` — the ``Solver`` plugin boundary + host-side oracle
+                    (reference: the core scheduler's ``Solve()``,
+                    ``designs/bin-packing.md``).
+- ``parallel/``   — jax.sharding Mesh / shard_map distribution of the solve
+                    across chips (pods axis data-parallel over ICI).
+- ``cloudprovider/`` — the cloud plugin: NodeClaim -> instance lifecycle
+                    (reference: ``pkg/cloudprovider``).
+- ``controllers/``— reconcile loops: provisioning, disruption, interruption,
+                    garbage collection, node-class status, tagging
+                    (reference: ``pkg/controllers``).
+- ``fake/``       — hermetic in-memory cloud + queue backends for tests
+                    (reference: ``pkg/fake``).
+- ``utils/``      — TTL caches, seqnum'd unavailable-offerings cache,
+                    batcher, error taxonomy (reference: ``pkg/cache``,
+                    ``pkg/batcher``, ``pkg/errors``).
+"""
+
+__version__ = "0.1.0"

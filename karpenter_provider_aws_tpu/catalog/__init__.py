@@ -1,0 +1,18 @@
+"""The instance-type catalog: capacities, allocatable math, prices, offerings.
+
+Reference parity: ``pkg/providers/instancetype`` (capacity/overhead math,
+offerings x zone x capacity-type, composite seqnum cache key),
+``pkg/providers/pricing`` (static seed prices + refresh), and the generated
+``zz_generated.*`` data tables (here replaced by a deterministic programmatic
+generator — the reference proves the catalog can be data, not API calls).
+"""
+
+from .instancetypes import (  # noqa: F401
+    InstanceType,
+    Offering,
+    generate_catalog,
+    DEFAULT_ZONES,
+    DEFAULT_REGION,
+)
+from .pricing import PricingProvider  # noqa: F401
+from .provider import CatalogProvider, OverheadOptions  # noqa: F401

@@ -1,0 +1,345 @@
+"""Instance types: the capacity catalog and its generator.
+
+The reference ships ~700 EC2 types discovered via DescribeInstanceTypes and
+two 12k-line generated tables (``zz_generated.vpclimits.go``,
+``zz_generated.bandwidth.go``). Here the catalog is produced by a
+deterministic generator spanning the same axes — categories x generations x
+sizes x cpu-architectures, plus GPU/accelerator/storage families — so tests
+and benches run hermetically at reference scale without any cloud API.
+
+Capacity/overhead math parity: ``pkg/providers/instancetype/types.go``
+ - ENI-limited pod count        types.go:326-340
+ - VM-overhead-adjusted memory  types.go:205-215
+ - kube-reserved CPU curve      types.go:364-383
+ - kube-reserved memory + eviction thresholds  types.go:389-416
+"""
+
+from __future__ import annotations
+
+import hashlib
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..models import labels as lbl
+from ..models.requirements import Requirements
+from ..models.resources import ResourceVector
+
+DEFAULT_REGION = "region-1"
+DEFAULT_ZONES = ("zone-a", "zone-b", "zone-c", "zone-d")
+
+
+@dataclass(frozen=True)
+class Offering:
+    """One purchasable (zone, capacity-type) slice of an instance type
+    (parity: cloudprovider.Offerings built at instancetype.go:252-293)."""
+
+    zone: str
+    capacity_type: str
+    price: float
+    available: bool
+
+
+@dataclass
+class InstanceType:
+    name: str
+    category: str           # c | m | r | t | x | i | g | p | inf | trn
+    family: str             # e.g. "c7g"
+    generation: int
+    size: str               # "xlarge" ...
+    arch: str               # amd64 | arm64
+    os: str = "linux"
+    vcpus: int = 2
+    memory_mib: int = 4096
+    network_bandwidth_mbps: int = 1000
+    ebs_bandwidth_mbps: int = 1000
+    max_enis: int = 3
+    ips_per_eni: int = 10
+    local_nvme_gib: int = 0
+    gpu_manufacturer: str = ""
+    gpu_name: str = ""
+    gpu_count: int = 0
+    gpu_memory_mib: int = 0
+    accelerator_manufacturer: str = ""
+    accelerator_name: str = ""
+    accelerator_count: int = 0
+    efa_count: int = 0
+    bare_metal: bool = False
+    hypervisor: str = "nitro"
+    encryption_in_transit: bool = True
+    region: str = DEFAULT_REGION
+    offerings: list[Offering] = field(default_factory=list)
+
+    # -- derived -----------------------------------------------------------
+    def eni_limited_pods(self) -> int:
+        """parity: types.go:326-340 — enis * (ips-per-eni - 1) + 2."""
+        return self.max_enis * (self.ips_per_eni - 1) + 2
+
+    def capacity(self, max_pods: Optional[int] = None, ephemeral_gib: int = 20) -> ResourceVector:
+        pods = max_pods if max_pods is not None else self.eni_limited_pods()
+        return ResourceVector.from_map(
+            {
+                "cpu": self.vcpus,
+                "memory": f"{self.memory_mib}Mi",
+                "pods": pods,
+                "ephemeral-storage": f"{max(self.local_nvme_gib, ephemeral_gib)}Gi",
+                "nvidia.com/gpu": self.gpu_count if self.gpu_manufacturer == "nvidia" else 0,
+                "amd.com/gpu": self.gpu_count if self.gpu_manufacturer == "amd" else 0,
+                "aws.amazon.com/neuron": self.accelerator_count if self.accelerator_manufacturer == "aws" else 0,
+                "vpc.amazonaws.com/efa": self.efa_count,
+            }
+        )
+
+    def labels(self) -> dict[str, str]:
+        """The node labels this type advertises (parity: types.go:75-161
+        computeRequirements — 20+ requirement labels incl. GPU/accelerator)."""
+        out = {
+            lbl.INSTANCE_TYPE_LABEL: self.name,
+            lbl.ARCH: self.arch,
+            lbl.OS: self.os,
+            lbl.TOPOLOGY_REGION: self.region,
+            lbl.INSTANCE_CATEGORY: self.category,
+            lbl.INSTANCE_FAMILY: self.family,
+            lbl.INSTANCE_GENERATION: str(self.generation),
+            lbl.INSTANCE_SIZE: self.size,
+            lbl.INSTANCE_CPU: str(self.vcpus),
+            lbl.INSTANCE_CPU_MANUFACTURER: "arm-designer" if self.arch == "arm64" else "x86-vendor",
+            lbl.INSTANCE_MEMORY: str(self.memory_mib),
+            lbl.INSTANCE_HYPERVISOR: "" if self.bare_metal else self.hypervisor,
+            lbl.INSTANCE_ENCRYPTION_IN_TRANSIT: str(self.encryption_in_transit).lower(),
+            lbl.INSTANCE_NETWORK_BANDWIDTH: str(self.network_bandwidth_mbps),
+            lbl.INSTANCE_EBS_BANDWIDTH: str(self.ebs_bandwidth_mbps),
+            lbl.INSTANCE_LOCAL_NVME: str(self.local_nvme_gib),
+        }
+        if self.gpu_count:
+            out[lbl.INSTANCE_GPU_MANUFACTURER] = self.gpu_manufacturer
+            out[lbl.INSTANCE_GPU_NAME] = self.gpu_name
+            out[lbl.INSTANCE_GPU_COUNT] = str(self.gpu_count)
+            out[lbl.INSTANCE_GPU_MEMORY] = str(self.gpu_memory_mib)
+        if self.accelerator_count:
+            out[lbl.INSTANCE_ACCELERATOR_MANUFACTURER] = self.accelerator_manufacturer
+            out[lbl.INSTANCE_ACCELERATOR_NAME] = self.accelerator_name
+            out[lbl.INSTANCE_ACCELERATOR_COUNT] = str(self.accelerator_count)
+        return out
+
+    def requirements(self) -> Requirements:
+        reqs = Requirements.from_labels(self.labels())
+        zones = sorted({o.zone for o in self.offerings if o.available})
+        captypes = sorted({o.capacity_type for o in self.offerings if o.available})
+        if zones:
+            from ..models.requirements import Operator, Requirement
+            reqs.add(Requirement(lbl.TOPOLOGY_ZONE, Operator.IN, tuple(zones)))
+            reqs.add(Requirement(lbl.CAPACITY_TYPE, Operator.IN, tuple(captypes)))
+        return reqs
+
+    def cheapest_price(self, capacity_types=lbl.CAPACITY_TYPES, zones=None) -> float:
+        prices = [
+            o.price
+            for o in self.offerings
+            if o.available and o.capacity_type in capacity_types and (zones is None or o.zone in zones)
+        ]
+        return min(prices) if prices else math.inf
+
+
+# ---------------------------------------------------------------------------
+# Deterministic catalog generator (replaces the reference's generated tables).
+# ---------------------------------------------------------------------------
+
+_SIZES = (
+    # (size, vcpus multiplier over .large=2)
+    ("large", 1), ("xlarge", 2), ("2xlarge", 4), ("3xlarge", 6), ("4xlarge", 8),
+    ("6xlarge", 12), ("8xlarge", 16), ("12xlarge", 24), ("16xlarge", 32),
+    ("24xlarge", 48),
+)
+_MEM_PER_VCPU_GIB = {"c": 2, "m": 4, "r": 8, "x": 16, "i": 8, "t": 4, "d": 6}
+
+
+def _h(name: str) -> int:
+    """Stable small hash for deterministic jitter."""
+    return int.from_bytes(hashlib.sha256(name.encode()).digest()[:4], "big")
+
+
+def _eni_limits(vcpus: int) -> tuple[int, int]:
+    if vcpus <= 2:
+        return 3, 10
+    if vcpus <= 8:
+        return 4, 15
+    if vcpus <= 16:
+        return 4, 30
+    if vcpus <= 48:
+        return 8, 30
+    return 15, 50
+
+
+def _network_mbps(vcpus: int, variant: str) -> int:
+    base = min(25_000, 750 * vcpus)
+    return base * (4 if variant == "n" else 1)
+
+
+def generate_catalog(zones=DEFAULT_ZONES) -> list[InstanceType]:
+    """~700 instance types spanning the reference catalog's axes."""
+    out: list[InstanceType] = []
+
+    # General-purpose / compute / memory families x generations x variants.
+    for cat in ("c", "m", "r", "x"):
+        for gen in (5, 6, 7):
+            arch_variants = [("", "amd64")]
+            if gen >= 6:
+                arch_variants.append(("g", "arm64"))  # graviton-style arm line
+            for arch_suffix, arch in arch_variants:
+                variants = ["", "d"]  # base, local-nvme
+                if cat in ("c", "m", "r"):
+                    if arch == "amd64":
+                        variants.append("a")  # alt-cpu-vendor line
+                        variants.append("n")  # network-optimized
+                    elif gen >= 7:
+                        variants.append("n")  # arm network line (c7gn-style)
+                for variant in variants:
+                    family = f"{cat}{gen}{arch_suffix}{variant}"
+                    for size, mult in _SIZES:
+                        vcpus = 2 * mult
+                        mem = int(vcpus * _MEM_PER_VCPU_GIB[cat] * 1024)
+                        enis, ips = _eni_limits(vcpus)
+                        out.append(
+                            InstanceType(
+                                name=f"{family}.{size}", category=cat, family=family,
+                                generation=gen, size=size, arch=arch, vcpus=vcpus,
+                                memory_mib=mem,
+                                network_bandwidth_mbps=_network_mbps(vcpus, variant),
+                                ebs_bandwidth_mbps=min(19_000, 600 * vcpus),
+                                max_enis=enis, ips_per_eni=ips,
+                                local_nvme_gib=(vcpus * 75 if variant == "d" else 0),
+                                efa_count=(1 if variant == "n" and vcpus >= 32 else 0),
+                            )
+                        )
+                    # bare-metal top end per family (base variant only)
+                    if variant == "":
+                        vcpus = 96
+                        out.append(
+                            InstanceType(
+                                name=f"{family}.metal", category=cat, family=family,
+                                generation=gen, size="metal", arch=arch, vcpus=vcpus,
+                                memory_mib=int(vcpus * _MEM_PER_VCPU_GIB[cat] * 1024),
+                                network_bandwidth_mbps=25_000, ebs_bandwidth_mbps=19_000,
+                                max_enis=15, ips_per_eni=50, bare_metal=True, hypervisor="",
+                            )
+                        )
+
+    # Burstable families (small sizes).
+    for fam, arch in (("t3", "amd64"), ("t3a", "amd64"), ("t4g", "arm64")):
+        for size, vcpus, mem_gib in (("micro", 2, 1), ("small", 2, 2), ("medium", 2, 4), ("large", 2, 8), ("xlarge", 4, 16)):
+            out.append(
+                InstanceType(
+                    name=f"{fam}.{size}", category="t", family=fam,
+                    generation=int(fam[1]), size=size,
+                    arch=arch, vcpus=vcpus, memory_mib=mem_gib * 1024,
+                    network_bandwidth_mbps=5_000, ebs_bandwidth_mbps=2_000,
+                    max_enis=3, ips_per_eni=6 if vcpus <= 2 else 12,
+                )
+            )
+
+    # Storage-optimized.
+    for gen, sizes in (("i3", _SIZES[:8]), ("i4i", _SIZES[:8]), ("d3", _SIZES[:5])):
+        for size, mult in sizes:
+            vcpus = 2 * mult
+            out.append(
+                InstanceType(
+                    name=f"{gen}.{size}", category="i", family=gen,
+                    generation=int(gen[1]), size=size, arch="amd64", vcpus=vcpus,
+                    memory_mib=int(vcpus * 8 * 1024),
+                    network_bandwidth_mbps=_network_mbps(vcpus, ""),
+                    ebs_bandwidth_mbps=min(19_000, 600 * vcpus),
+                    max_enis=_eni_limits(vcpus)[0], ips_per_eni=_eni_limits(vcpus)[1],
+                    local_nvme_gib=vcpus * 475,
+                )
+            )
+
+    # HPC families (EFA-heavy, on-demand-only in practice; modeled as normal).
+    for fam, arch, vcpus in (("hpc6a", "amd64", 96), ("hpc7g", "arm64", 64)):
+        out.append(
+            InstanceType(
+                name=f"{fam}.{vcpus}xlarge", category="hpc", family=fam,
+                generation=int(fam[3]), size=f"{vcpus}xlarge", arch=arch,
+                vcpus=vcpus, memory_mib=vcpus * 4 * 1024,
+                network_bandwidth_mbps=100_000, ebs_bandwidth_mbps=2_000,
+                max_enis=15, ips_per_eni=50, efa_count=1,
+            )
+        )
+
+    # GPU families (nvidia).
+    for family, gpu_name, gpu_mem, per_gpu_vcpu, sizes in (
+        ("g4dn", "t4", 16_384, 2, ((1, "xlarge"), (1, "2xlarge"), (1, "4xlarge"), (4, "12xlarge"), (8, "metal"))),
+        ("g5", "a10g", 24_576, 4, ((1, "xlarge"), (1, "2xlarge"), (1, "4xlarge"), (4, "12xlarge"), (8, "48xlarge"))),
+        ("g6", "l4", 24_576, 4, ((1, "xlarge"), (1, "2xlarge"), (1, "4xlarge"), (4, "12xlarge"), (8, "48xlarge"))),
+        ("p4d", "a100", 40_960, 12, ((8, "24xlarge"),)),
+        ("p5", "h100", 81_920, 24, ((8, "48xlarge"),)),
+    ):
+        for gpus, size in sizes:
+            vcpus = max(4, gpus * per_gpu_vcpu * 2)
+            out.append(
+                InstanceType(
+                    name=f"{family}.{size}", category="g" if family.startswith("g") else "p",
+                    family=family, generation=int("".join(c for c in family if c.isdigit())),
+                    size=size, arch="amd64", vcpus=vcpus,
+                    memory_mib=vcpus * 4 * 1024,
+                    network_bandwidth_mbps=100_000 if family.startswith("p") else 25_000,
+                    ebs_bandwidth_mbps=19_000,
+                    max_enis=8, ips_per_eni=30,
+                    gpu_manufacturer="nvidia", gpu_name=gpu_name, gpu_count=gpus,
+                    gpu_memory_mib=gpu_mem,
+                    efa_count=(4 if family == "p5" else (1 if family == "p4d" else 0)),
+                    bare_metal=(size == "metal"),
+                )
+            )
+
+    # Arm GPU line.
+    for gpus, size in ((1, "xlarge"), (1, "2xlarge"), (1, "4xlarge"), (1, "8xlarge"), (2, "16xlarge")):
+        vcpus = {"xlarge": 4, "2xlarge": 8, "4xlarge": 16, "8xlarge": 32, "16xlarge": 64}[size]
+        out.append(
+            InstanceType(
+                name=f"g5g.{size}", category="g", family="g5g", generation=5,
+                size=size, arch="arm64", vcpus=vcpus, memory_mib=vcpus * 2 * 1024,
+                network_bandwidth_mbps=25_000, ebs_bandwidth_mbps=9_500,
+                max_enis=8, ips_per_eni=30,
+                gpu_manufacturer="nvidia", gpu_name="t4g", gpu_count=gpus,
+                gpu_memory_mib=16_384,
+            )
+        )
+
+    # Neuron accelerator families.
+    for family, accel, sizes in (
+        ("inf1", "inferentia", ((1, "xlarge"), (1, "2xlarge"), (4, "6xlarge"), (16, "24xlarge"))),
+        ("inf2", "inferentia2", ((1, "xlarge"), (1, "8xlarge"), (6, "24xlarge"), (12, "48xlarge"))),
+        ("trn1", "trainium", ((1, "2xlarge"), (16, "32xlarge"))),
+    ):
+        for count, size in sizes:
+            vcpus = {"xlarge": 4, "2xlarge": 8, "6xlarge": 24, "8xlarge": 32, "24xlarge": 96, "32xlarge": 128, "48xlarge": 192}[size]
+            out.append(
+                InstanceType(
+                    name=f"{family}.{size}", category=family[:3], family=family,
+                    generation=int(family[-1]), size=size, arch="amd64", vcpus=vcpus,
+                    memory_mib=vcpus * 4 * 1024,
+                    network_bandwidth_mbps=100_000 if family == "trn1" else 25_000,
+                    ebs_bandwidth_mbps=19_000, max_enis=8, ips_per_eni=30,
+                    accelerator_manufacturer="aws", accelerator_name=accel,
+                    accelerator_count=count,
+                    efa_count=(8 if family == "trn1" and size == "32xlarge" else 0),
+                )
+            )
+
+    # Attach offerings (prices via the pricing model, deterministic
+    # availability holes so tests exercise the offering mask).
+    from .pricing import PricingProvider
+
+    pricing = PricingProvider()
+    for it in out:
+        offerings = []
+        for zi, zone in enumerate(zones):
+            # Newest-gen arm and exotic families are missing from some zones.
+            present = not (_h(f"{it.family}:{zone}") % 17 == 0 and zi >= 2)
+            od = pricing.on_demand_price(it)
+            spot = pricing.spot_price(it, zone)
+            offerings.append(Offering(zone, lbl.CAPACITY_TYPE_ON_DEMAND, od, present))
+            offerings.append(Offering(zone, lbl.CAPACITY_TYPE_SPOT, spot, present))
+        it.offerings = offerings
+    return out

@@ -1,0 +1,110 @@
+"""Pricing: $/hr catalog with static seed prices + live-refresh interface.
+
+Reference parity: ``pkg/providers/pricing/pricing.go`` — compiled-in seed
+prices (pricing.go:43), on-demand refresh via a pricing API, per-zone spot
+prices with an on-demand-derived default (pricing.go:75-90,141-156), and an
+isolated-VPC mode that skips live refresh (pricing.go:164-170).
+
+The price *model* is deterministic (a function of the type's shape), standing
+in for the reference's generated ``zz_generated.pricing_*.go`` tables. A
+``PriceUpdate`` hook lets a live backend override any entry, mirroring
+UpdateOnDemandPricing / UpdateSpotPricing.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import TYPE_CHECKING, Mapping, Optional
+
+from ..models import labels as lbl
+
+if TYPE_CHECKING:
+    from .instancetypes import InstanceType
+
+# Seed $/vcpu-hr by category; generation discount compounds 8%/gen newer than 5.
+_BASE_VCPU_RATE = {
+    "c": 0.0425, "m": 0.0480, "r": 0.0630, "x": 0.0835,
+    "i": 0.0780, "t": 0.0209, "d": 0.0690,
+    "g": 0.2500, "p": 0.7500, "inf": 0.1800, "trn": 0.3300,
+}
+_ARM_DISCOUNT = 0.80       # arm lines price ~20% under x86 peers
+_METAL_PREMIUM = 1.10
+_NVME_PREMIUM = 1.12
+_NET_PREMIUM = 1.08
+_GEN_DISCOUNT = 0.92
+
+
+def _jitter(seed: str, lo: float, hi: float) -> float:
+    h = int.from_bytes(hashlib.sha256(seed.encode()).digest()[:4], "big")
+    return lo + (hi - lo) * (h / 0xFFFFFFFF)
+
+
+class PricingProvider:
+    """Thread-safe price source; static model + overridable live updates."""
+
+    def __init__(self, isolated_vpc: bool = False):
+        self._od_overrides: dict[str, float] = {}
+        self._spot_overrides: dict[tuple[str, str], float] = {}
+        self._lock = threading.RLock()
+        self._seq = 0
+        self.isolated_vpc = isolated_vpc
+
+    # -- static model ------------------------------------------------------
+    def _model_od(self, it: "InstanceType") -> float:
+        rate = _BASE_VCPU_RATE.get(it.category, 0.05)
+        price = rate * it.vcpus
+        price *= _GEN_DISCOUNT ** max(0, it.generation - 5)
+        if it.arch == "arm64":
+            price *= _ARM_DISCOUNT
+        if it.bare_metal:
+            price *= _METAL_PREMIUM
+        if it.local_nvme_gib:
+            price *= _NVME_PREMIUM
+        if it.family.endswith("n"):
+            price *= _NET_PREMIUM
+        if it.gpu_count:
+            price += it.gpu_count * {"a10g": 0.60, "a100": 2.45, "h100": 6.90}.get(it.gpu_name, 1.0)
+        if it.accelerator_count:
+            price += it.accelerator_count * (0.95 if it.accelerator_name == "trainium" else 0.23)
+        return round(price, 5)
+
+    # -- queries (parity: OnDemandPrice / SpotPrice) -----------------------
+    def on_demand_price(self, it: "InstanceType") -> float:
+        with self._lock:
+            return self._od_overrides.get(it.name, self._model_od(it))
+
+    def spot_price(self, it: "InstanceType", zone: str) -> float:
+        """Zonal spot; default derived from on-demand when no live data
+        (parity: pricing.go:141-156 spotPrice fallback)."""
+        with self._lock:
+            override = self._spot_overrides.get((it.name, zone))
+            if override is not None:
+                return override
+            od = self.on_demand_price(it)
+            return round(od * _jitter(f"{it.name}:{zone}", 0.24, 0.44), 5)
+
+    # -- live refresh (parity: UpdateOnDemandPricing / UpdateSpotPricing) --
+    def update_on_demand(self, prices: Mapping[str, float]) -> None:
+        if self.isolated_vpc:
+            return
+        with self._lock:
+            self._od_overrides.update(prices)
+            self._seq += 1
+
+    def update_spot(self, prices: Mapping[tuple[str, str], float]) -> None:
+        if self.isolated_vpc:
+            return
+        with self._lock:
+            self._spot_overrides.update(prices)
+            self._seq += 1
+
+    def reset(self) -> None:
+        with self._lock:
+            self._od_overrides.clear()
+            self._spot_overrides.clear()
+            self._seq += 1
+
+    def seq_num(self) -> int:
+        with self._lock:
+            return self._seq

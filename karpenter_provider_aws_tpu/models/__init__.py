@@ -1,0 +1,19 @@
+"""Data model: the framework's own NodePool/NodeClass/NodeClaim/Pod types.
+
+Reference parity: ``pkg/apis/v1beta1`` (EC2NodeClass CRD, labels.go) and the
+core library's NodePool/NodeClaim APIs + scheduling requirements engine
+(SURVEY.md section 2.2).
+"""
+
+from .requirements import (  # noqa: F401
+    Operator,
+    Requirement,
+    Requirements,
+    ValueSet,
+)
+from .resources import ResourceVector, RESOURCE_AXES  # noqa: F401
+from .pod import Pod, Toleration, TopologySpreadConstraint  # noqa: F401
+from .nodepool import NodePool, Taint, Disruption, Limits  # noqa: F401
+from .nodeclass import NodeClass, SelectorTerm, BlockDevice, MetadataOptions  # noqa: F401
+from .nodeclaim import NodeClaim, NodeClaimStatus, Condition  # noqa: F401
+from . import labels  # noqa: F401

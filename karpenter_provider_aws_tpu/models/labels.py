@@ -1,0 +1,69 @@
+"""Well-known labels (reference parity: pkg/apis/v1beta1/labels.go:22-110).
+
+The framework's label namespace is ``karpenter.tpu`` (the reference uses
+``karpenter.k8s.aws``); core-library labels keep their upstream names so
+existing pod specs work unchanged.
+"""
+
+GROUP = "karpenter.tpu"
+
+# Core (upstream karpenter.sh / kubernetes.io) labels.
+NODEPOOL = "karpenter.sh/nodepool"
+CAPACITY_TYPE = "karpenter.sh/capacity-type"
+ARCH = "kubernetes.io/arch"
+OS = "kubernetes.io/os"
+INSTANCE_TYPE_LABEL = "node.kubernetes.io/instance-type"
+TOPOLOGY_ZONE = "topology.kubernetes.io/zone"
+TOPOLOGY_REGION = "topology.kubernetes.io/region"
+HOSTNAME = "kubernetes.io/hostname"
+
+CAPACITY_TYPE_SPOT = "spot"
+CAPACITY_TYPE_ON_DEMAND = "on-demand"
+CAPACITY_TYPES = (CAPACITY_TYPE_ON_DEMAND, CAPACITY_TYPE_SPOT)
+
+# Instance-property labels (reference: labels.go:87-98 — 19 instance labels).
+INSTANCE_HYPERVISOR = f"{GROUP}/instance-hypervisor"
+INSTANCE_ENCRYPTION_IN_TRANSIT = f"{GROUP}/instance-encryption-in-transit-supported"
+INSTANCE_CATEGORY = f"{GROUP}/instance-category"
+INSTANCE_FAMILY = f"{GROUP}/instance-family"
+INSTANCE_GENERATION = f"{GROUP}/instance-generation"
+INSTANCE_LOCAL_NVME = f"{GROUP}/instance-local-nvme"
+INSTANCE_SIZE = f"{GROUP}/instance-size"
+INSTANCE_CPU = f"{GROUP}/instance-cpu"
+INSTANCE_CPU_MANUFACTURER = f"{GROUP}/instance-cpu-manufacturer"
+INSTANCE_MEMORY = f"{GROUP}/instance-memory"
+INSTANCE_EBS_BANDWIDTH = f"{GROUP}/instance-ebs-bandwidth"
+INSTANCE_NETWORK_BANDWIDTH = f"{GROUP}/instance-network-bandwidth"
+INSTANCE_GPU_NAME = f"{GROUP}/instance-gpu-name"
+INSTANCE_GPU_MANUFACTURER = f"{GROUP}/instance-gpu-manufacturer"
+INSTANCE_GPU_COUNT = f"{GROUP}/instance-gpu-count"
+INSTANCE_GPU_MEMORY = f"{GROUP}/instance-gpu-memory"
+INSTANCE_ACCELERATOR_NAME = f"{GROUP}/instance-accelerator-name"
+INSTANCE_ACCELERATOR_MANUFACTURER = f"{GROUP}/instance-accelerator-manufacturer"
+INSTANCE_ACCELERATOR_COUNT = f"{GROUP}/instance-accelerator-count"
+
+# Annotations.
+ANNOTATION_NODECLASS_HASH = f"{GROUP}/nodeclass-hash"
+ANNOTATION_NODECLASS_HASH_VERSION = f"{GROUP}/nodeclass-hash-version"
+ANNOTATION_INSTANCE_TAGGED = f"{GROUP}/tagged"
+ANNOTATION_DO_NOT_DISRUPT = "karpenter.sh/do-not-disrupt"
+
+NODECLASS_HASH_VERSION = "v1"
+
+# Labels whose values are numeric and thus support Gt/Lt requirements.
+NUMERIC_LABELS = frozenset(
+    {
+        INSTANCE_CPU,
+        INSTANCE_MEMORY,
+        INSTANCE_GENERATION,
+        INSTANCE_GPU_COUNT,
+        INSTANCE_GPU_MEMORY,
+        INSTANCE_ACCELERATOR_COUNT,
+        INSTANCE_EBS_BANDWIDTH,
+        INSTANCE_NETWORK_BANDWIDTH,
+    }
+)
+
+# Restricted: users may not set these directly on NodePools (parity with
+# labels.go RestrictedLabels).
+RESTRICTED_LABELS = frozenset({HOSTNAME, f"{GROUP}/nodeclass"})

@@ -1,0 +1,137 @@
+"""NodeClass: cloud-specific node configuration (EC2NodeClass analogue).
+
+Reference parity: ``pkg/apis/v1beta1/ec2nodeclass.go:29-120`` (spec: selector
+terms, AMI family, role/instanceProfile, userData, block devices, metadata
+options, tags) and ``ec2nodeclass_status.go:56-92`` (status: resolved
+subnets/security-groups/images/instance-profile + conditions), plus the
+static drift hash (``ec2nodeclass.go:340``, ``hash/controller.go:47-70``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass, field, asdict
+from typing import Optional
+
+from . import labels as lbl
+
+
+@dataclass(frozen=True)
+class SelectorTerm:
+    """Discovery selector for subnets / security groups / images
+    (parity: SubnetSelectorTerm / SecurityGroupSelectorTerm / AMISelectorTerm)."""
+
+    tags: tuple[tuple[str, str], ...] = ()
+    id: str = ""
+    name: str = ""
+
+    @staticmethod
+    def of(id: str = "", name: str = "", **tags) -> "SelectorTerm":
+        return SelectorTerm(tags=tuple(sorted(tags.items())), id=id, name=name)
+
+    def matches(self, resource) -> bool:
+        if self.id:
+            return resource.id == self.id
+        if self.name and getattr(resource, "name", "") != self.name:
+            return False
+        rtags = getattr(resource, "tags", {})
+        for k, v in self.tags:
+            if v == "*":
+                if k not in rtags:
+                    return False
+            elif rtags.get(k) != v:
+                return False
+        return bool(self.tags) or bool(self.name)
+
+
+@dataclass(frozen=True)
+class BlockDevice:
+    device_name: str = "/dev/xvda"
+    volume_size_gib: int = 20
+    volume_type: str = "gp3"
+    iops: Optional[int] = None
+    throughput: Optional[int] = None
+    encrypted: bool = True
+    delete_on_termination: bool = True
+
+
+@dataclass(frozen=True)
+class MetadataOptions:
+    """IMDS options (parity: ec2nodeclass.go MetadataOptions defaults)."""
+
+    http_endpoint: str = "enabled"
+    http_protocol_ipv6: str = "disabled"
+    http_put_response_hop_limit: int = 2
+    http_tokens: str = "required"
+
+
+@dataclass
+class Condition:
+    type: str
+    status: bool
+    reason: str = ""
+    message: str = ""
+    transition_seq: int = 0
+
+
+@dataclass
+class NodeClassStatus:
+    subnets: list = field(default_factory=list)           # resolved Subnet objects
+    security_groups: list = field(default_factory=list)   # resolved SecurityGroup objects
+    images: list = field(default_factory=list)            # resolved Image objects
+    instance_profile: str = ""
+    conditions: dict[str, Condition] = field(default_factory=dict)
+
+    def set_condition(self, ctype: str, status: bool, reason: str = "", message: str = "") -> None:
+        self.conditions[ctype] = Condition(ctype, status, reason, message)
+
+    def is_ready(self) -> bool:
+        c = self.conditions.get("Ready")
+        return c is not None and c.status
+
+
+@dataclass
+class NodeClass:
+    name: str
+    image_family: str = "standard"  # parity with AMIFamily: standard|minimal|gpu|custom
+    image_selector: list[SelectorTerm] = field(default_factory=list)
+    subnet_selector: list[SelectorTerm] = field(default_factory=list)
+    security_group_selector: list[SelectorTerm] = field(default_factory=list)
+    role: str = ""
+    instance_profile: str = ""  # mutually exclusive with role
+    user_data: str = ""
+    block_devices: list[BlockDevice] = field(default_factory=lambda: [BlockDevice()])
+    metadata_options: MetadataOptions = field(default_factory=MetadataOptions)
+    tags: dict[str, str] = field(default_factory=dict)
+    vm_memory_overhead_percent: float = 0.075  # options.go VMMemoryOverheadPercent default
+    detailed_monitoring: bool = False
+    status: NodeClassStatus = field(default_factory=NodeClassStatus)
+    finalizers: set[str] = field(default_factory=set)
+    deleted: bool = False
+
+    # Fields excluded from the static drift hash because they are resolved
+    # dynamically (parity: hash tags on ec2nodeclass.go spec fields).
+    _HASH_EXCLUDE = ("status", "finalizers", "deleted", "image_selector",
+                     "subnet_selector", "security_group_selector")
+
+    def hash(self) -> str:
+        """Static drift hash over immutable spec fields
+        (parity: ec2nodeclass.go:340 Hash via hashstructure)."""
+        spec = {}
+        for k, v in self.__dict__.items():
+            if k in self._HASH_EXCLUDE or k.startswith("_"):
+                continue
+            if hasattr(v, "__dataclass_fields__"):
+                v = asdict(v)
+            elif isinstance(v, list):
+                v = [asdict(x) if hasattr(x, "__dataclass_fields__") else x for x in v]
+            spec[k] = v
+        blob = json.dumps(spec, sort_keys=True, default=str).encode()
+        return hashlib.sha256(blob).hexdigest()[:16]
+
+    def hash_annotations(self) -> dict[str, str]:
+        return {
+            lbl.ANNOTATION_NODECLASS_HASH: self.hash(),
+            lbl.ANNOTATION_NODECLASS_HASH_VERSION: lbl.NODECLASS_HASH_VERSION,
+        }

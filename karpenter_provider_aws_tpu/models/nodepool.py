@@ -1,0 +1,84 @@
+"""NodePool: the provisioning template + disruption policy + limits.
+
+Owns what the reference consumes from the core library's NodePool API
+(SURVEY.md section 2.2): template requirements/taints pointing at a
+NodeClass, resource limits, weight, and the disruption block
+(consolidationPolicy / consolidateAfter / expireAfter).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .requirements import Requirement, Requirements
+from .resources import ResourceVector
+from . import labels as lbl
+
+
+@dataclass(frozen=True)
+class Taint:
+    key: str
+    value: str = ""
+    effect: str = "NoSchedule"  # NoSchedule | PreferNoSchedule | NoExecute
+
+
+@dataclass
+class Limits:
+    """Aggregate resource caps across a NodePool's nodes (core NodePool.spec.limits)."""
+
+    resources: ResourceVector = field(default_factory=lambda: ResourceVector.from_map({}))
+    unlimited: bool = True
+
+    @staticmethod
+    def of(**resources) -> "Limits":
+        return Limits(resources=ResourceVector.from_map({k.replace("_", "-"): v for k, v in resources.items()}), unlimited=False)
+
+    def exceeded_by(self, in_use: ResourceVector) -> bool:
+        if self.unlimited:
+            return False
+        import numpy as np
+        mask = self.resources.v > 0
+        return bool((in_use.v[mask] > self.resources.v[mask]).any())
+
+
+@dataclass
+class Disruption:
+    """NodePool.spec.disruption (core): consolidation + expiration policy."""
+
+    consolidation_policy: str = "WhenUnderutilized"  # or WhenEmpty
+    consolidate_after_s: Optional[float] = 0.0  # None = Never
+    expire_after_s: Optional[float] = None  # None = Never
+    # disruption budgets: max share of nodes disruptable at once ("20%" or "5")
+    budgets: list[str] = field(default_factory=lambda: ["10%"])
+
+    def max_disruptions(self, total_nodes: int) -> int:
+        allowed = total_nodes
+        for b in self.budgets:
+            if b.endswith("%"):
+                v = int(total_nodes * float(b[:-1]) / 100.0)
+            else:
+                v = int(b)
+            allowed = min(allowed, v)
+        return max(allowed, 0)
+
+
+@dataclass
+class NodePool:
+    name: str
+    nodeclass_name: str = "default"
+    requirements: list[Requirement] = field(default_factory=list)
+    taints: list[Taint] = field(default_factory=list)
+    startup_taints: list[Taint] = field(default_factory=list)
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    limits: Limits = field(default_factory=Limits)
+    disruption: Disruption = field(default_factory=Disruption)
+    weight: int = 0  # higher = preferred, like core NodePool.spec.weight
+
+    def scheduling_requirements(self) -> Requirements:
+        """Template requirements + identity labels as a requirement set."""
+        reqs = Requirements(self.requirements)
+        reqs = reqs.union(Requirements.from_labels(self.labels))
+        reqs = reqs.union(Requirements.from_labels({lbl.NODEPOOL: self.name}))
+        return reqs

@@ -1,0 +1,145 @@
+"""Pod model: the unit of scheduling demand.
+
+Carries exactly what the scheduler needs: resource requests, scheduling
+constraints (nodeSelector, required node affinity, tolerations, topology
+spread, pod anti-affinity), and disruption-cost inputs (priority,
+deletion cost, do-not-disrupt). Reference parity: the core scheduler's pod
+view plus ``designs/consolidation.md:24-36`` cost inputs.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Mapping, Optional, Sequence
+
+from .requirements import Operator, Requirement, Requirements
+from .resources import ResourceVector
+from . import labels as lbl
+
+_uid_counter = itertools.count()
+
+
+@dataclass(frozen=True)
+class Toleration:
+    key: str = ""
+    operator: str = "Equal"  # Equal | Exists
+    value: str = ""
+    effect: str = ""  # "" tolerates all effects
+
+    def tolerates(self, taint: "Taint") -> bool:  # noqa: F821 (forward ref)
+        if self.effect and self.effect != taint.effect:
+            return False
+        if self.operator == "Exists":
+            return self.key == "" or self.key == taint.key
+        return self.key == taint.key and self.value == taint.value
+
+
+@dataclass(frozen=True)
+class TopologySpreadConstraint:
+    topology_key: str  # e.g. topology.kubernetes.io/zone or kubernetes.io/hostname
+    max_skew: int = 1
+    when_unsatisfiable: str = "DoNotSchedule"  # or ScheduleAnyway
+    label_selector: Mapping[str, str] = field(default_factory=dict)
+
+    def __hash__(self):
+        return hash((self.topology_key, self.max_skew, self.when_unsatisfiable,
+                     tuple(sorted(self.label_selector.items()))))
+
+
+@dataclass(frozen=True)
+class PodAffinityTerm:
+    """Required pod (anti-)affinity term (label selector + topology key)."""
+
+    topology_key: str
+    label_selector: Mapping[str, str] = field(default_factory=dict)
+
+    def __hash__(self):
+        return hash((self.topology_key, tuple(sorted(self.label_selector.items()))))
+
+    def matches(self, pod: "Pod") -> bool:
+        return all(pod.labels.get(k) == v for k, v in self.label_selector.items())
+
+
+@dataclass
+class Pod:
+    name: str
+    namespace: str = "default"
+    uid: str = ""
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    requests: ResourceVector = field(default_factory=ResourceVector)
+    node_selector: dict[str, str] = field(default_factory=dict)
+    # Required-during-scheduling node affinity, flattened to requirement terms
+    # (OR across terms is not yet supported; terms are ANDed like nodeSelector).
+    node_affinity: list[Requirement] = field(default_factory=list)
+    tolerations: list[Toleration] = field(default_factory=list)
+    topology_spread: list[TopologySpreadConstraint] = field(default_factory=list)
+    anti_affinity: list[PodAffinityTerm] = field(default_factory=list)
+    affinity: list[PodAffinityTerm] = field(default_factory=list)
+    priority: int = 0
+    node_name: str = ""  # bound node, empty = pending
+    phase: str = "Pending"
+    owner_key: str = ""  # ReplicaSet/Deployment identity for grouping
+
+    def __post_init__(self):
+        if not self.uid:
+            self.uid = f"pod-{next(_uid_counter)}"
+        # One pod slot is always consumed.
+        if self.requests.get("pods") == 0:
+            self.requests.set("pods", 1)
+
+    # -- scheduling views --------------------------------------------------
+    def requirements(self) -> Requirements:
+        """nodeSelector + required node affinity as one requirement set."""
+        reqs = Requirements.from_node_selector(self.node_selector)
+        for r in self.node_affinity:
+            reqs.add(r)
+        return reqs
+
+    def tolerates(self, taint) -> bool:
+        return any(t.tolerates(taint) for t in self.tolerations)
+
+    def tolerates_all(self, taints) -> bool:
+        return all(self.tolerates(t) for t in taints if t.effect in ("NoSchedule", "NoExecute"))
+
+    def do_not_disrupt(self) -> bool:
+        return self.annotations.get(lbl.ANNOTATION_DO_NOT_DISRUPT) == "true"
+
+    def deletion_cost(self) -> float:
+        try:
+            return float(self.annotations.get("controller.kubernetes.io/pod-deletion-cost", "0"))
+        except ValueError:
+            return 0.0
+
+    def is_pending(self) -> bool:
+        return self.phase == "Pending" and not self.node_name
+
+    # -- grouping (dedup) key ----------------------------------------------
+    def scheduling_key(self) -> tuple:
+        """Pods with equal keys are interchangeable to the solver; the
+        encoder collapses them into one group with a count (the TPU-native
+        replacement for the reference's per-pod loop — SURVEY.md section 7)."""
+        return (
+            self.requests.v.tobytes(),
+            tuple(sorted(self.node_selector.items())),
+            tuple(sorted((r.key, r.operator.value, r.values, r.min_values) for r in self.node_affinity)),
+            tuple(sorted((t.key, t.operator, t.value, t.effect) for t in self.tolerations)),
+            tuple(sorted(self.topology_spread, key=lambda c: c.topology_key)),
+            tuple(sorted(self.anti_affinity, key=lambda a: a.topology_key)),
+            tuple(sorted(self.affinity, key=lambda a: a.topology_key)),
+        )
+
+
+def make_pods(
+    count: int,
+    name_prefix: str,
+    requests: Mapping[str, object],
+    **kwargs,
+) -> list[Pod]:
+    """Convenience constructor for test/bench workloads."""
+    rv = ResourceVector.from_map(requests)
+    return [
+        Pod(name=f"{name_prefix}-{i}", requests=rv.copy(), **{k: (v.copy() if hasattr(v, "copy") else v) for k, v in kwargs.items()})
+        for i in range(count)
+    ]

@@ -1,0 +1,280 @@
+"""Label-requirement engine: operators, intersection, compatibility.
+
+This owns the semantics the reference consumes from the core library's
+scheduling requirements engine (used at
+``pkg/cloudprovider/cloudprovider.go:258-263`` and
+``pkg/providers/instancetype/types.go:76-161``): requirement sets keyed by
+label, with operators In / NotIn / Exists / DoesNotExist / Gt / Lt, pairwise
+intersection, ``Compatible()`` checks, and minValues support.
+
+Design note (TPU-first): requirements are *host-side* objects. They are
+evaluated once per (pod-group x instance-type) pair to produce the boolean
+compatibility mask that ships to the device (see ``ops/encode.py``); nothing
+in this module runs under jit.
+"""
+
+from __future__ import annotations
+
+import enum
+import math
+from dataclasses import dataclass, field
+from typing import Iterable, Mapping, Optional, Sequence
+
+
+class Operator(str, enum.Enum):
+    IN = "In"
+    NOT_IN = "NotIn"
+    EXISTS = "Exists"
+    DOES_NOT_EXIST = "DoesNotExist"
+    GT = "Gt"
+    LT = "Lt"
+
+
+@dataclass(frozen=True)
+class Requirement:
+    """A single label requirement, as on pods/NodePools (k8s NodeSelectorRequirement)."""
+
+    key: str
+    operator: Operator
+    values: tuple[str, ...] = ()
+    # Karpenter extension: at least this many distinct values must remain
+    # after all intersections (spec.template.spec.requirements[].minValues).
+    min_values: Optional[int] = None
+
+    def __post_init__(self):
+        object.__setattr__(self, "values", tuple(self.values))
+        if self.operator in (Operator.GT, Operator.LT):
+            if len(self.values) != 1:
+                raise ValueError(f"{self.operator.value} requires exactly one value")
+            float(self.values[0])  # must be numeric
+        if self.operator in (Operator.EXISTS, Operator.DOES_NOT_EXIST) and self.values:
+            raise ValueError(f"{self.operator.value} takes no values")
+
+
+class ValueSet:
+    """The set of label values a key may take, closed under intersection.
+
+    One of four shapes:
+      - complement=False: a finite allowed set (possibly empty -> unsatisfiable)
+      - complement=True:  everything except ``values`` (NotIn / Exists)
+    plus an optional numeric interval (gt, lt) intersected on top, and an
+    ``allow_undefined`` bit: whether the *absence* of the label satisfies the
+    requirement (DoesNotExist, or no constraint at all).
+    """
+
+    __slots__ = ("values", "complement", "gt", "lt", "allow_undefined", "allow_defined")
+
+    def __init__(
+        self,
+        values: frozenset[str] = frozenset(),
+        complement: bool = True,
+        gt: float = -math.inf,
+        lt: float = math.inf,
+        allow_undefined: bool = False,
+        allow_defined: bool = True,
+    ):
+        self.values = values
+        self.complement = complement
+        self.gt = gt
+        self.lt = lt
+        self.allow_undefined = allow_undefined
+        self.allow_defined = allow_defined
+
+    # -- constructors ------------------------------------------------------
+    @staticmethod
+    def any() -> "ValueSet":
+        """No constraint: any value, or absence, is fine."""
+        return ValueSet(allow_undefined=True)
+
+    @staticmethod
+    def from_requirement(req: Requirement) -> "ValueSet":
+        op = req.operator
+        if op == Operator.IN:
+            return ValueSet(values=frozenset(req.values), complement=False)
+        if op == Operator.NOT_IN:
+            # k8s semantics: NotIn is satisfied when the label is absent
+            # (nodeaffinity NotIn matches nodes without the key).
+            return ValueSet(values=frozenset(req.values), complement=True, allow_undefined=True)
+        if op == Operator.EXISTS:
+            return ValueSet()
+        if op == Operator.DOES_NOT_EXIST:
+            return ValueSet(allow_undefined=True, allow_defined=False)
+        if op == Operator.GT:
+            return ValueSet(gt=float(req.values[0]))
+        if op == Operator.LT:
+            return ValueSet(lt=float(req.values[0]))
+        raise ValueError(op)
+
+    # -- algebra -----------------------------------------------------------
+    def intersect(self, other: "ValueSet") -> "ValueSet":
+        if not self.complement and not other.complement:
+            vals = self.values & other.values
+            comp = False
+        elif not self.complement:
+            vals, comp = self.values - other.values, False
+        elif not other.complement:
+            vals, comp = other.values - self.values, False
+        else:
+            vals, comp = self.values | other.values, True
+        return ValueSet(
+            values=vals,
+            complement=comp,
+            gt=max(self.gt, other.gt),
+            lt=min(self.lt, other.lt),
+            allow_undefined=self.allow_undefined and other.allow_undefined,
+            allow_defined=self.allow_defined and other.allow_defined,
+        )
+
+    def _numeric_ok(self, value: str) -> bool:
+        if self.gt == -math.inf and self.lt == math.inf:
+            return True
+        try:
+            f = float(value)
+        except ValueError:
+            return False
+        return self.gt < f < self.lt
+
+    def contains(self, value: Optional[str]) -> bool:
+        """Does a concrete label value (None = label absent) satisfy this set?"""
+        if value is None:
+            return self.allow_undefined
+        if not self.allow_defined:
+            return False
+        if not self._numeric_ok(value):
+            return False
+        if self.complement:
+            return value not in self.values
+        return value in self.values
+
+    def is_satisfiable(self) -> bool:
+        if self.allow_undefined:
+            return True
+        if not self.allow_defined:
+            return False
+        if self.gt >= self.lt:
+            return False
+        if not self.complement:
+            return any(self._numeric_ok(v) for v in self.values)
+        return True  # complement of a finite set is infinite
+
+    def finite_values(self) -> Optional[frozenset[str]]:
+        """The allowed finite set, or None if unbounded."""
+        if self.complement:
+            return None
+        return frozenset(v for v in self.values if self._numeric_ok(v))
+
+    def __repr__(self):
+        parts = []
+        if not self.complement:
+            parts.append(f"in={sorted(self.values)}")
+        elif self.values:
+            parts.append(f"notin={sorted(self.values)}")
+        if self.gt != -math.inf:
+            parts.append(f"gt={self.gt}")
+        if self.lt != math.inf:
+            parts.append(f"lt={self.lt}")
+        if self.allow_undefined:
+            parts.append("undef-ok")
+        if not self.allow_defined:
+            parts.append("must-be-undef")
+        return f"ValueSet({', '.join(parts) or 'any-defined'})"
+
+
+class Requirements:
+    """A conjunction of per-key ValueSets, the unit of compatibility checks.
+
+    Mirrors the core library's ``scheduling.Requirements`` (NewRequirements /
+    Add / Compatible / Intersects) as consumed by the reference.
+    """
+
+    def __init__(self, reqs: Iterable[Requirement] = ()):
+        self._sets: dict[str, ValueSet] = {}
+        self._min_values: dict[str, int] = {}
+        for r in reqs:
+            self.add(r)
+
+    # -- construction ------------------------------------------------------
+    def add(self, req: Requirement) -> None:
+        vs = ValueSet.from_requirement(req)
+        cur = self._sets.get(req.key)
+        self._sets[req.key] = vs if cur is None else cur.intersect(vs)
+        if req.min_values is not None:
+            self._min_values[req.key] = max(
+                self._min_values.get(req.key, 0), req.min_values
+            )
+
+    @staticmethod
+    def from_labels(labels: Mapping[str, str]) -> "Requirements":
+        """Requirements equivalent to a concrete label set (one In per key)."""
+        return Requirements(
+            Requirement(k, Operator.IN, (v,)) for k, v in labels.items()
+        )
+
+    @staticmethod
+    def from_node_selector(selector: Mapping[str, str]) -> "Requirements":
+        return Requirements.from_labels(selector)
+
+    def union(self, other: "Requirements") -> "Requirements":
+        """Conjunction of both requirement sets (intersecting shared keys)."""
+        out = Requirements()
+        out._sets = dict(self._sets)
+        out._min_values = dict(self._min_values)
+        for k, vs in other._sets.items():
+            cur = out._sets.get(k)
+            out._sets[k] = vs if cur is None else cur.intersect(vs)
+        for k, mv in other._min_values.items():
+            out._min_values[k] = max(out._min_values.get(k, 0), mv)
+        return out
+
+    # -- queries -----------------------------------------------------------
+    def keys(self) -> Sequence[str]:
+        return list(self._sets.keys())
+
+    def get(self, key: str) -> ValueSet:
+        return self._sets.get(key, ValueSet.any())
+
+    def min_values(self, key: str) -> int:
+        return self._min_values.get(key, 0)
+
+    def is_satisfiable(self) -> bool:
+        return all(vs.is_satisfiable() for vs in self._sets.values())
+
+    def compatible(self, other: "Requirements") -> bool:
+        """Can some label assignment satisfy both requirement sets?
+
+        Semantics of the core engine: for every key constrained by either
+        side, the intersection of the two ValueSets must be satisfiable.
+        A key unconstrained on one side is treated as unbounded there.
+        """
+        for k in set(self._sets) | set(other._sets):
+            if not self.get(k).intersect(other.get(k)).is_satisfiable():
+                return False
+        return True
+
+    def satisfied_by_labels(self, lbl: Mapping[str, str]) -> bool:
+        """Do concrete labels (a launched node) satisfy every requirement?"""
+        return all(vs.contains(lbl.get(k)) for k, vs in self._sets.items())
+
+    def min_values_satisfied(self, other: "Requirements") -> bool:
+        """After intersecting with ``other`` (an instance-type set's labels),
+        does every minValues-bearing key retain enough distinct values?
+
+        The caller intersects against the union of candidate types; see
+        ``scheduling/solver.py``. Keys whose intersection is unbounded
+        trivially satisfy minValues.
+        """
+        for k, need in self._min_values.items():
+            inter = self.get(k).intersect(other.get(k))
+            finite = inter.finite_values()
+            if finite is not None and len(finite) < need:
+                return False
+        return True
+
+    def __iter__(self):
+        return iter(self._sets.items())
+
+    def __len__(self):
+        return len(self._sets)
+
+    def __repr__(self):
+        return f"Requirements({self._sets!r})"

@@ -1,0 +1,143 @@
+"""Canonical resource axes and the fixed-width resource vector.
+
+TPU-first design decision: every resource quantity in the system lives on a
+fixed, ordered axis of length ``NUM_RESOURCES`` so that pods, capacities, and
+overheads are plain float32 vectors and the whole scheduling problem is a set
+of dense matrices (SURVEY.md section 7.1). This replaces the reference's
+``corev1.ResourceList`` maps (used throughout
+``pkg/providers/instancetype/types.go:182-416``).
+
+Units: cpu in millicores, memory/ephemeral-storage in MiB, everything else in
+counts. Parsing accepts k8s quantity strings ("100m", "2", "4Gi", "512Mi").
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Mapping, Union
+
+import numpy as np
+
+# The fixed resource axis. Order matters: it is the last dim of every tensor.
+RESOURCE_AXES: tuple[str, ...] = (
+    "cpu",                      # millicores
+    "memory",                   # MiB
+    "pods",                     # count (per-node pod slots, ENI-limited)
+    "ephemeral-storage",        # MiB
+    "nvidia.com/gpu",           # count
+    "amd.com/gpu",              # count
+    "aws.amazon.com/neuron",    # count
+    "vpc.amazonaws.com/efa",    # count
+)
+NUM_RESOURCES = len(RESOURCE_AXES)
+_AXIS_INDEX = {name: i for i, name in enumerate(RESOURCE_AXES)}
+
+CPU, MEMORY, PODS, EPHEMERAL = 0, 1, 2, 3
+NVIDIA_GPU, AMD_GPU, NEURON, EFA = 4, 5, 6, 7
+
+# Extended-resource label parity: pkg/apis/v1beta1/labels.go:87-98 resources.
+EXTENDED_RESOURCES = RESOURCE_AXES[4:]
+
+_QUANTITY_RE = re.compile(r"^([0-9.]+)([a-zA-Z]*)$")
+_SUFFIX = {
+    "": 1.0,
+    "m": 1e-3,
+    "k": 1e3, "M": 1e6, "G": 1e9, "T": 1e12,
+    "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40,
+}
+
+
+def parse_quantity(q: Union[str, int, float]) -> float:
+    """Parse a k8s quantity string to a raw float (bytes for byte-suffixed)."""
+    if isinstance(q, (int, float)):
+        return float(q)
+    m = _QUANTITY_RE.match(q.strip())
+    if not m:
+        raise ValueError(f"bad quantity: {q!r}")
+    num, suf = m.groups()
+    if suf not in _SUFFIX:
+        raise ValueError(f"bad quantity suffix: {q!r}")
+    return float(num) * _SUFFIX[suf]
+
+
+def _to_axis_units(name: str, raw: float, q: Union[str, int, float]) -> float:
+    if name == "cpu":
+        # raw is cores (possibly fractional via "m"); axis unit is millicores.
+        return raw * 1000.0
+    if name in ("memory", "ephemeral-storage"):
+        # Bare numbers are bytes per k8s semantics; axis unit is MiB.
+        return raw / 2**20
+    return raw
+
+
+class ResourceVector:
+    """A point on the resource axis; wraps a float32 numpy vector."""
+
+    __slots__ = ("v",)
+
+    def __init__(self, v: np.ndarray | None = None):
+        self.v = np.zeros(NUM_RESOURCES, dtype=np.float32) if v is None else np.asarray(v, dtype=np.float32)
+
+    @staticmethod
+    def from_map(m: Mapping[str, Union[str, int, float]]) -> "ResourceVector":
+        out = ResourceVector()
+        for k, q in m.items():
+            if k not in _AXIS_INDEX:
+                raise KeyError(f"unknown resource {k!r}; axes are {RESOURCE_AXES}")
+            out.v[_AXIS_INDEX[k]] = _to_axis_units(k, parse_quantity(q), q)
+        return out
+
+    def to_map(self) -> dict[str, float]:
+        return {name: float(self.v[i]) for i, name in enumerate(RESOURCE_AXES) if self.v[i] != 0}
+
+    def get(self, name: str) -> float:
+        return float(self.v[_AXIS_INDEX[name]])
+
+    def set(self, name: str, value: float) -> "ResourceVector":
+        self.v[_AXIS_INDEX[name]] = value
+        return self
+
+    # -- arithmetic (all elementwise on the fixed axis) --------------------
+    def __add__(self, other: "ResourceVector") -> "ResourceVector":
+        return ResourceVector(self.v + other.v)
+
+    def __sub__(self, other: "ResourceVector") -> "ResourceVector":
+        return ResourceVector(self.v - other.v)
+
+    def __mul__(self, s: float) -> "ResourceVector":
+        return ResourceVector(self.v * s)
+
+    def clip_min_zero(self) -> "ResourceVector":
+        return ResourceVector(np.maximum(self.v, 0))
+
+    def fits_in(self, capacity: "ResourceVector") -> bool:
+        return bool(np.all(self.v <= capacity.v + 1e-6))
+
+    def is_zero(self) -> bool:
+        return bool(np.all(self.v == 0))
+
+    def dominant_share(self, capacity: "ResourceVector") -> float:
+        """Max over axes of request/capacity — the FFD sort key
+        (designs/bin-packing.md:29-31 sorts pods by decreasing size)."""
+        with np.errstate(divide="ignore", invalid="ignore"):
+            shares = np.where(capacity.v > 0, self.v / capacity.v, 0.0)
+        return float(np.max(shares))
+
+    def copy(self) -> "ResourceVector":
+        return ResourceVector(self.v.copy())
+
+    def __eq__(self, other):
+        return isinstance(other, ResourceVector) and bool(np.all(self.v == other.v))
+
+    def __hash__(self):
+        return hash(self.v.tobytes())
+
+    def __repr__(self):
+        return f"ResourceVector({self.to_map()})"
+
+
+def stack(vectors: list[ResourceVector]) -> np.ndarray:
+    """[len(vectors), NUM_RESOURCES] float32 matrix."""
+    if not vectors:
+        return np.zeros((0, NUM_RESOURCES), dtype=np.float32)
+    return np.stack([rv.v for rv in vectors]).astype(np.float32)

@@ -1,0 +1,19 @@
+"""The TPU compute path: tensor encodings + jitted solvers.
+
+This package replaces the reference's CPU-bound hot loops — the core
+scheduler's per-pod FFD ``Solve()`` (designs/bin-packing.md:29-43) and the
+consolidation simulator (designs/consolidation.md) — with batched,
+fixed-shape JAX programs (SURVEY.md sections 3.2, 7).
+
+Key design moves (TPU-first, not a port):
+ - Pods are deduplicated into (shape, count) *groups* host-side; the device
+   scans groups, not pods, and places whole multiplicities per step.
+ - All shapes are static: groups/nodes/types are bucketed+padded, so one
+   compiled program serves a workload family without recompiles.
+ - Constraint checks (requirements/taints/zones) are evaluated host-side once
+   per group x type into a boolean compatibility mask; the device only ever
+   sees dense float/bool tensors.
+"""
+
+from .encode import EncodedProblem, encode_problem, bucket  # noqa: F401
+from .ffd import ffd_solve, FFDResult  # noqa: F401

@@ -1,0 +1,289 @@
+"""Tensorization: pods + nodepool + catalog -> dense solve tensors.
+
+The canonical encoding from SURVEY.md section 7.1:
+ - ``requests[G, R]``  — deduped pod-group resource requests
+ - ``counts[G]``       — multiplicity per group
+ - ``compat[G, T]``    — requirements x taints x offering compatibility
+ - ``capacity[T, R]``  — allocatable per type (catalog tensors)
+ - ``price[G, T]``     — cheapest offering price usable by the group (inf if
+                         none); group-dependent because capacity-type/zone
+                         constraints differ per group
+ - group order is FFD (decreasing dominant resource share), matching
+   designs/bin-packing.md:29-31.
+
+Everything here is host-side numpy; jax only sees the finished arrays.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..catalog.provider import CatalogProvider, CatalogTensors
+from ..models import labels as lbl
+from ..models.nodepool import NodePool
+from ..models.pod import Pod
+from ..models.requirements import Operator, Requirement, Requirements
+from ..models.resources import NUM_RESOURCES
+
+
+def bucket(n: int, minimum: int = 8) -> int:
+    """Next power of two >= n (>= minimum): the static-shape padding rule."""
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+@dataclass
+class EncodedProblem:
+    # Device-facing tensors (numpy; solver converts to jnp).
+    requests: np.ndarray        # [G, R] float32
+    counts: np.ndarray          # [G] int32
+    compat: np.ndarray          # [G, T] bool
+    capacity: np.ndarray        # [T, R] float32
+    price: np.ndarray           # [G, T] float32, inf where unusable
+    # Host-side decode metadata.
+    group_pods: list[list[Pod]] = field(default_factory=list)   # per real group
+    type_names: tuple[str, ...] = ()
+    zones: tuple[str, ...] = ()
+    nodepool: Optional[NodePool] = None
+    # Joint per-group offering window (zone x capacity-type allowances) and
+    # per-type live-offering window (ICE already masked). Joint — not two
+    # marginal masks — so a (zone, captype) combination with no live offering
+    # can never be advertised on a node.
+    group_window: np.ndarray = None           # [G, Z, 2] bool
+    type_window: np.ndarray = None            # [T, Z, 2] bool
+    # Marginal views kept for inspection/tests:
+    group_zone_allowed: np.ndarray = None     # [G, Z] bool
+    group_captype_allowed: np.ndarray = None  # [G, 2] bool
+    unencodable: list[tuple[Pod, str]] = field(default_factory=list)
+
+    @property
+    def num_groups(self) -> int:
+        return len(self.group_pods)
+
+    @property
+    def num_pods(self) -> int:
+        return int(self.counts.sum())
+
+
+def _group_requirements(pod: Pod, nodepool: Optional[NodePool]) -> Requirements:
+    reqs = pod.requirements()
+    if nodepool is not None:
+        reqs = reqs.union(nodepool.scheduling_requirements())
+    return reqs
+
+
+_SKIP_KEYS = (lbl.TOPOLOGY_ZONE, lbl.CAPACITY_TYPE, lbl.HOSTNAME, lbl.NODEPOOL)
+
+# Per-catalog-snapshot label matrices, keyed by the snapshot's name tuple
+# (the tuple itself, not id() — ids are reused after GC).
+_label_array_cache: dict[tuple, dict] = {}
+
+
+def _label_arrays(types, names_key) -> dict:
+    """key -> (object array of label values, float array for numerics) over T.
+
+    Vectorizes requirement evaluation: one numpy pass per requirement key per
+    group instead of a Python loop over all T types (the encode-side hot path).
+    """
+    cached = _label_array_cache.get(names_key)
+    if cached is not None:
+        return cached
+    per_key: dict[str, tuple[np.ndarray, np.ndarray]] = {}
+    all_labels = [t.labels() for t in types]
+    keys = set()
+    for d in all_labels:
+        keys.update(d)
+    for key in keys:
+        vals = np.array([d.get(key) for d in all_labels], dtype=object)
+        fvals = np.full(len(all_labels), np.nan)
+        for i, v in enumerate(vals):
+            if v is not None:
+                try:
+                    fvals[i] = float(v)
+                except ValueError:
+                    pass
+        per_key[key] = (vals, fvals)
+    _label_array_cache.clear()  # one snapshot at a time is enough
+    _label_array_cache[names_key] = per_key
+    return per_key
+
+
+def _contains_vec(vs, vals: np.ndarray, fvals: np.ndarray) -> np.ndarray:
+    """Vectorized ValueSet.contains over a label-value array (None = absent)."""
+    defined = np.array([v is not None for v in vals])
+    ok = np.full(len(vals), vs.allow_defined)
+    if vs.gt != -np.inf or vs.lt != np.inf:
+        with np.errstate(invalid="ignore"):
+            ok &= (fvals > vs.gt) & (fvals < vs.lt)
+    if vs.complement:
+        if vs.values:
+            ok &= ~np.isin(vals, list(vs.values))
+    else:
+        ok &= np.isin(vals, list(vs.values))
+    return np.where(defined, ok, vs.allow_undefined)
+
+
+def encode_problem(
+    pods: Sequence[Pod],
+    catalog: CatalogProvider,
+    nodepool: Optional[NodePool] = None,
+    tensors: Optional[CatalogTensors] = None,
+) -> EncodedProblem:
+    """Build the dense solve tensors for one nodepool's candidate pods.
+
+    Pods that cannot run on this nodepool at all (taints not tolerated,
+    incompatible requirements) land in ``unencodable`` with a reason, the
+    analogue of the reference's per-pod filtering before Solve
+    (cloudprovider.go:253-264 resolveInstanceTypes).
+    """
+    tensors = tensors if tensors is not None else catalog.tensors()
+    types = catalog.list()
+    T = len(types)
+    Z = len(tensors.zones)
+
+    pool_reqs = nodepool.scheduling_requirements() if nodepool else Requirements()
+    # startupTaints are exempt from toleration checks: they are expected to
+    # be removed once the node is ready (karpenter startupTaints semantics).
+    taints = list(nodepool.taints) if nodepool else []
+
+    # -- group pods by scheduling key -------------------------------------
+    groups: dict[tuple, list[Pod]] = {}
+    unencodable: list[tuple[Pod, str]] = []
+    for pod in pods:
+        if taints and not pod.tolerates_all(taints):
+            unencodable.append((pod, "does not tolerate nodepool taints"))
+            continue
+        if not pod.requirements().compatible(pool_reqs):
+            unencodable.append((pod, "incompatible with nodepool requirements"))
+            continue
+        # A hostname pin names an *existing* node; provisioning a fresh node
+        # can never satisfy it (new nodes get new hostnames).
+        if pod.requirements().get(lbl.HOSTNAME).finite_values() is not None:
+            unencodable.append((pod, "pinned to an existing node via hostname"))
+            continue
+        groups.setdefault(pod.scheduling_key(), []).append(pod)
+
+    group_list = list(groups.values())
+    G = len(group_list)
+
+    requests = np.zeros((max(G, 1), NUM_RESOURCES), dtype=np.float32)
+    counts = np.zeros(max(G, 1), dtype=np.int32)
+    compat = np.zeros((max(G, 1), T), dtype=bool)
+    price = np.full((max(G, 1), T), np.inf, dtype=np.float32)
+    zone_allowed = np.zeros((max(G, 1), Z), dtype=bool)
+    captype_allowed = np.zeros((max(G, 1), 2), dtype=bool)
+    group_window = np.zeros((max(G, 1), Z, 2), dtype=bool)
+
+    # Cache key: catalog seqnum + names — a refresh() bumps the seq even when
+    # type names are unchanged, so stale label arrays can't be served.
+    catalog_seq = tensors.key[0] if tensors.key else 0
+    label_arrays = _label_arrays(types, (catalog.uid, catalog_seq, tensors.names))
+
+    for gi, plist in enumerate(group_list):
+        pod = plist[0]
+        requests[gi] = pod.requests.v
+        counts[gi] = len(plist)
+        reqs = _group_requirements(pod, nodepool)
+
+        # Offering-level allowances: which zones / capacity types may serve
+        # this group (parity: zone + capacity-type as ordinary requirements).
+        zvs = reqs.get(lbl.TOPOLOGY_ZONE)
+        cvs = reqs.get(lbl.CAPACITY_TYPE)
+        zone_allowed[gi] = [zvs.contains(z) for z in tensors.zones]
+        captype_allowed[gi] = [cvs.contains(ct) for ct in lbl.CAPACITY_TYPES]
+        group_window[gi] = zone_allowed[gi][:, None] & captype_allowed[gi][None, :]
+
+        # Static label compat, vectorized over T per requirement key.
+        static_ok = np.ones(T, dtype=bool)
+        for key, vs in reqs:
+            if key in _SKIP_KEYS:
+                continue
+            arrays = label_arrays.get(key)
+            if arrays is None:
+                # No type defines this label; satisfiable only if absence is OK.
+                if not vs.allow_undefined:
+                    static_ok[:] = False
+                    break
+                continue
+            static_ok &= _contains_vec(vs, *arrays)
+            if not static_ok.any():
+                break
+
+        # x offering availability x single-pod resource fit.
+        offer_ok = (
+            tensors.available
+            & zone_allowed[gi][None, :, None]
+            & captype_allowed[gi][None, None, :]
+        )  # [T, Z, 2]
+        fits = (pod.requests.v[None, :] <= tensors.capacity + 1e-6).all(axis=1)
+        row = static_ok & offer_ok.any(axis=(1, 2)) & fits
+        compat[gi] = row
+        row_price = np.where(offer_ok, tensors.price, np.inf).min(axis=(1, 2))
+        price[gi] = np.where(row, row_price, np.inf)
+
+    # -- FFD order: decreasing dominant share ------------------------------
+    if G > 0:
+        ref_cap = tensors.capacity.max(axis=0)
+        ref_cap[ref_cap == 0] = 1.0
+        dominant = (requests[:G] / ref_cap[None, :]).max(axis=1)
+        order = np.argsort(-dominant, kind="stable")
+        requests[:G] = requests[:G][order]
+        counts[:G] = counts[:G][order]
+        compat[:G] = compat[:G][order]
+        price[:G] = price[:G][order]
+        zone_allowed[:G] = zone_allowed[:G][order]
+        captype_allowed[:G] = captype_allowed[:G][order]
+        group_window[:G] = group_window[:G][order]
+        group_list = [group_list[i] for i in order]
+
+    return EncodedProblem(
+        requests=requests,
+        counts=counts,
+        compat=compat,
+        capacity=tensors.capacity.astype(np.float32),
+        price=price,
+        group_pods=group_list,
+        type_names=tensors.names,
+        zones=tensors.zones,
+        nodepool=nodepool,
+        group_window=group_window,
+        type_window=tensors.available.copy(),
+        group_zone_allowed=zone_allowed,
+        group_captype_allowed=captype_allowed,
+        unencodable=unencodable,
+    )
+
+
+def pad_problem(p: EncodedProblem, group_bucket: Optional[int] = None) -> EncodedProblem:
+    """Pad the group axis to a bucket size so jit compiles once per bucket."""
+    G = p.requests.shape[0]
+    GB = group_bucket or bucket(max(G, 1))
+    if GB == G:
+        return p
+    pad = GB - G
+
+    def padg(a, fill=0):
+        widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+        return np.pad(a, widths, constant_values=fill)
+
+    return EncodedProblem(
+        requests=padg(p.requests),
+        counts=padg(p.counts),          # count 0 => no-op groups
+        compat=padg(p.compat),
+        capacity=p.capacity,
+        price=padg(p.price, fill=np.inf),
+        group_pods=p.group_pods,
+        type_names=p.type_names,
+        zones=p.zones,
+        nodepool=p.nodepool,
+        group_window=padg(p.group_window),
+        type_window=p.type_window,
+        group_zone_allowed=padg(p.group_zone_allowed),
+        group_captype_allowed=padg(p.group_captype_allowed),
+        unencodable=p.unencodable,
+    )

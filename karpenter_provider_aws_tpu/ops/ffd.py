@@ -1,0 +1,201 @@
+"""The TPU bin-packing solver: first-fit-decreasing over pod groups.
+
+Replaces the core scheduler's ``Scheduler.Solve()`` per-pod FFD loop
+(designs/bin-packing.md:29-43) with a fixed-shape ``lax.scan`` over deduped
+pod *groups*. Each scan step places a whole multiplicity at once:
+
+ 1. Fill open nodes in index order (first-fit): per node, how many of this
+    group fit in the remaining capacity; a cumulative-sum prefix turns the
+    sequential "place then update" loop into one vector expression.
+ 2. For the remainder, open new nodes of the type minimizing
+    ``price / pods-per-node`` — cost-per-slot greedy, which reproduces the
+    reference's behavior of packing big cheap bins (the FFD chooses the type
+    maximizing packed pods; CreateFleet then picks the cheapest offering).
+
+Nodes carry a joint *(zone x capacity-type)* offering window (like the core
+scheduler's virtual nodes carrying narrowing requirements): a group may only
+land on a node whose remaining window intersects the group's allowance, and
+placement narrows the window. At open, the window starts as the group's
+allowance intersected with the committed type's live offerings — so a node
+can never advertise a (zone, captype) combination with no live offering.
+
+State lives on device across the whole scan; the only host<->device traffic
+is the encoded problem in and the node plan out (SURVEY.md section 7's
+"batcher analogue"). All shapes (G groups, N nodes, T types, R resources,
+Z zones) are static; recompiles only happen per (G, N, T) bucket.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-4
+
+
+class FFDResult(NamedTuple):
+    node_type: jnp.ndarray    # [N] int32, index into types; valid where < n_open
+    node_price: jnp.ndarray   # [N] float32 $/hr committed at open
+    used: jnp.ndarray         # [N, R] float32 resources packed onto each node
+    node_cap: jnp.ndarray     # [N, R] float32 allocatable of committed type
+    node_window: jnp.ndarray  # [N, Z, 2] bool remaining (zone, captype) window
+    n_open: jnp.ndarray       # [] int32 number of nodes opened
+    placed: jnp.ndarray       # [G, N] int32 pods of group g placed on node n
+    unplaced: jnp.ndarray     # [G] int32 pods that fit nowhere (or overflowed N)
+
+    def total_cost(self) -> jnp.ndarray:
+        n = self.node_type.shape[0]
+        live = jnp.arange(n) < self.n_open
+        return jnp.where(live, self.node_price, 0.0).sum()
+
+
+class _State(NamedTuple):
+    node_type: jnp.ndarray
+    node_price: jnp.ndarray
+    used: jnp.ndarray
+    node_cap: jnp.ndarray
+    node_window: jnp.ndarray
+    n_open: jnp.ndarray
+
+
+def _fit_counts(cap_rem: jnp.ndarray, req: jnp.ndarray) -> jnp.ndarray:
+    """[...,R] remaining capacity x [R] request -> [...] how many fit."""
+    with_req = req > 0
+    ratio = jnp.where(
+        with_req[None, :], jnp.floor((cap_rem + _EPS) / jnp.where(with_req, req, 1.0)[None, :]), jnp.inf
+    )
+    k = jnp.min(ratio, axis=-1)
+    return jnp.maximum(k, 0.0).astype(jnp.int32)
+
+
+def _step(capacity: jnp.ndarray, type_window: jnp.ndarray, state: _State, item):
+    req, cnt, compat_g, price_g, gw = item
+    N = state.used.shape[0]
+    idx = jnp.arange(N)
+    valid = idx < state.n_open
+
+    # -- 1. first-fit fill of open nodes ----------------------------------
+    window_ok = (state.node_window & gw[None, :, :]).any((-2, -1))
+    node_ok = valid & compat_g[state.node_type] & window_ok
+    k_fit = _fit_counts(state.node_cap - state.used, req)
+    k_fit = jnp.where(node_ok, k_fit, 0)
+    cum_before = jnp.cumsum(k_fit) - k_fit
+    place = jnp.clip(cnt - cum_before, 0, k_fit)
+    used = state.used + place[:, None] * req[None, :]
+    touched = place > 0
+    node_window = jnp.where(
+        touched[:, None, None], state.node_window & gw[None, :, :], state.node_window
+    )
+    rem = cnt - place.sum()
+
+    # -- 2. open new nodes for the remainder ------------------------------
+    # The greedy re-evaluates the cost-per-slot type choice as the remainder
+    # shrinks (a big bin stops paying off once fewer pods than its capacity
+    # remain). While the remainder >= the chosen type's capacity the choice
+    # is stable, so each while-iteration opens ALL full nodes of the current
+    # winner at once; the partial tail re-chooses. Iterations are bounded by
+    # the number of distinct winning types (~log of max pods-per-node).
+    k_type = _fit_counts(capacity, req)             # [T] pods-per-node by type
+    feasible = compat_g & (k_type >= 1) & jnp.isfinite(price_g)
+
+    def open_cond(carry):
+        return carry[6] > 0
+
+    def open_body(carry):
+        (node_type, node_price, used, node_cap, node_window, n_open,
+         rem, unplaced, opened_take) = carry
+        eff = jnp.minimum(k_type, jnp.maximum(rem, 1))
+        score = jnp.where(feasible, price_g / jnp.maximum(eff, 1), jnp.inf)
+        t_star = jnp.argmin(score)
+        ok = jnp.isfinite(score[t_star])
+        k_star = jnp.maximum(k_type[t_star], 1)
+        room = N - n_open
+
+        q_full = rem // k_star
+        q = jnp.where(q_full >= 1, q_full, 1)       # partial tail -> one node
+        q = jnp.minimum(q, room)
+        can_open = ok & (room > 0)
+        q = jnp.where(can_open, q, 0)
+
+        new_pos = idx - n_open
+        is_new = (new_pos >= 0) & (new_pos < q)
+        take = jnp.where(is_new, jnp.clip(rem - new_pos * k_star, 0, k_star), 0)
+        used = jnp.where(is_new[:, None], take[:, None] * req[None, :], used)
+        node_type = jnp.where(is_new, t_star, node_type)
+        node_price = jnp.where(is_new, price_g[t_star], node_price)
+        node_cap = jnp.where(is_new[:, None], capacity[t_star][None, :], node_cap)
+        node_window = jnp.where(
+            is_new[:, None, None], (gw & type_window[t_star])[None, :, :], node_window
+        )
+        opened_take = opened_take + take.astype(jnp.int32)
+
+        rem_next = jnp.where(can_open, rem - take.sum(), 0)
+        unplaced = unplaced + jnp.where(can_open, 0, rem)
+        return (node_type, node_price, used, node_cap, node_window,
+                n_open + q, rem_next, unplaced, opened_take)
+
+    carry0 = (
+        state.node_type, state.node_price, used, state.node_cap, node_window,
+        state.n_open, rem, jnp.asarray(0, dtype=rem.dtype), jnp.zeros(N, dtype=jnp.int32),
+    )
+    (node_type, node_price, used, node_cap, node_window, n_open, _,
+     unplaced, opened_take) = jax.lax.while_loop(open_cond, open_body, carry0)
+    placed_row = (place + opened_take).astype(jnp.int32)
+
+    new_state = _State(
+        node_type=node_type,
+        node_price=node_price,
+        used=used,
+        node_cap=node_cap,
+        node_window=node_window,
+        n_open=n_open,
+    )
+    return new_state, (placed_row, unplaced.astype(jnp.int32))
+
+
+@functools.partial(jax.jit, static_argnames=("max_nodes",))
+def ffd_solve(
+    requests: jnp.ndarray,     # [G, R] float32 (FFD-sorted by encode)
+    counts: jnp.ndarray,       # [G] int32
+    compat: jnp.ndarray,       # [G, T] bool
+    capacity: jnp.ndarray,     # [T, R] float32 allocatable
+    price: jnp.ndarray,        # [G, T] float32, inf where unusable
+    group_window: jnp.ndarray, # [G, Z, 2] bool (zone, captype) the group allows
+    type_window: jnp.ndarray,  # [T, Z, 2] bool live offerings per type
+    max_nodes: int = 1024,
+    init_state: _State | None = None,
+) -> FFDResult:
+    """One compiled program per (G, T, Z, max_nodes) bucket.
+
+    ``init_state`` lets the host chain chunked solves (group axis sliced into
+    multiple scans) while node state stays device-resident.
+    """
+    G, R = requests.shape
+    Z = group_window.shape[1]
+    if init_state is None:
+        init_state = _State(
+            node_type=jnp.zeros(max_nodes, dtype=jnp.int32),
+            node_price=jnp.zeros(max_nodes, dtype=jnp.float32),
+            used=jnp.zeros((max_nodes, R), dtype=jnp.float32),
+            node_cap=jnp.zeros((max_nodes, R), dtype=jnp.float32),
+            node_window=jnp.zeros((max_nodes, Z, 2), dtype=bool),
+            n_open=jnp.asarray(0, dtype=jnp.int32),
+        )
+
+    step = functools.partial(_step, capacity, type_window)
+    final, (placed, unplaced) = jax.lax.scan(
+        step, init_state, (requests, counts, compat, price, group_window)
+    )
+    return FFDResult(
+        node_type=final.node_type,
+        node_price=final.node_price,
+        used=final.used,
+        node_cap=final.node_cap,
+        node_window=final.node_window,
+        n_open=final.n_open,
+        placed=placed,
+        unplaced=unplaced,
+    )

@@ -1,0 +1,11 @@
+"""Multi-chip distribution of the solve via jax.sharding + shard_map.
+
+The reference's concurrency inventory (SURVEY.md section 2.3) maps here:
+reconcile-loop worker pools -> data-parallel group shards over the device
+mesh; the request batcher -> the single packed problem tensor; the
+kube/AWS API boundaries -> host<->device transfers. Collectives ride ICI
+(psum for global cost/counts), never DCN, per the sharding design in
+SURVEY.md section 5 ("distributed communication backend").
+"""
+
+from .mesh import make_mesh, solve_sharded, sharded_solve_fn  # noqa: F401

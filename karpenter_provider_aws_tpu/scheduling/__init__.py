@@ -1,0 +1,11 @@
+"""The scheduling.Solver plugin boundary + host-side reference solver.
+
+Parity: the core library's provisioning scheduler (``Scheduler.Solve``,
+designs/bin-packing.md) sits upstream of the reference repo; here the solver
+is a first-class plugin interface (SURVEY.md section 7.5) with two
+implementations — the jitted TPU solver and a pure-numpy host fallback that
+doubles as the behavioral oracle in tests.
+"""
+
+from .solver import Solver, TPUSolver, HostSolver, SolveResult, NodeSpec  # noqa: F401
+from .oracle import ffd_oracle, OracleNode  # noqa: F401

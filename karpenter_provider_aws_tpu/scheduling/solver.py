@@ -1,0 +1,343 @@
+"""The Solver plugin boundary: pods + nodepools + catalog -> node plan.
+
+Two implementations behind one interface (SURVEY.md section 7.5 — same
+plugin philosophy as ``cloudprovider.CloudProvider``):
+
+ - ``TPUSolver``  — encodes to tensors, runs the jitted FFD scan on device,
+   chunking the group axis with device-resident carry state.
+ - ``HostSolver`` — the pure-numpy per-pod FFD (default/fallback, the
+   analogue of keeping the in-process Go heuristic as default).
+
+Multi-nodepool handling mirrors the core scheduler: nodepools are tried in
+weight order; pods a nodepool cannot place fall through to the next.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Optional, Protocol, Sequence
+
+import numpy as np
+
+from ..catalog.provider import CatalogProvider
+from ..models import labels as lbl
+from ..models.nodepool import NodePool
+from ..models.pod import Pod
+from ..ops.encode import EncodedProblem, bucket, encode_problem, pad_problem
+from ..ops.ffd import ffd_solve
+
+# Launch-path truncation parity: instance.go:52-53 — at most 60 instance
+# types are carried into a single launch request.
+MAX_INSTANCE_TYPE_OPTIONS = 60
+
+
+@dataclass
+class NodeSpec:
+    """One node to create: ranked launch options + the pods it was packed for.
+
+    ``offering_options`` is the joint launchable set — every (zone,
+    capacity_type) pair listed has a live offering for at least the committed
+    type; ``zone_options``/``capacity_type_options`` are its marginals.
+    """
+
+    nodepool_name: str
+    instance_type_options: list[str]           # ranked cheapest-first
+    zone_options: list[str]
+    capacity_type_options: list[str]
+    offering_options: list[tuple[str, str]] = field(default_factory=list)
+    pods: list[Pod] = field(default_factory=list)
+    estimated_price: float = 0.0
+
+
+@dataclass
+class SolveResult:
+    node_specs: list[NodeSpec] = field(default_factory=list)
+    unschedulable: list[tuple[Pod, str]] = field(default_factory=list)
+    total_cost: float = 0.0                    # $/hr of committed choices
+    solve_seconds: float = 0.0
+    num_pods: int = 0
+
+    def pods_placed(self) -> int:
+        return sum(len(s.pods) for s in self.node_specs)
+
+
+class Solver(Protocol):
+    def solve(
+        self,
+        pods: Sequence[Pod],
+        nodepools: Sequence[NodePool],
+        catalog: CatalogProvider,
+    ) -> SolveResult: ...
+
+
+def _node_bucket(num_pods: int) -> int:
+    return min(max(bucket(max(num_pods, 1), minimum=64), 64), 8192)
+
+
+def _decode_nodes(
+    problem: EncodedProblem,
+    node_type: np.ndarray,
+    node_price: np.ndarray,
+    used: np.ndarray,
+    n_open: int,
+    placed: np.ndarray,
+    nodepool_name: str,
+    node_window: np.ndarray,
+) -> list[NodeSpec]:
+    """Turn device output into NodeSpecs with launch flexibility.
+
+    Flexibility recovery: the solver commits one type per node, but the
+    launch path wants ranked alternatives to survive ICE (parity: the
+    scheduler handing CloudProvider.Create many instanceType options).
+    A type qualifies if every group on the node accepts it (finite price)
+    and its allocatable covers the node's packed resources.
+    """
+    specs: list[NodeSpec] = []
+    G = len(problem.group_pods)
+    # per-group cursor into the concrete pod lists
+    cursors = [0] * G
+    cap = problem.capacity  # [T, R]
+    for n in range(n_open):
+        col = placed[:G, n]
+        group_idx = np.nonzero(col)[0]
+        pods: list[Pod] = []
+        for g in group_idx:
+            take = int(col[g])
+            plist = problem.group_pods[g]
+            pods.extend(plist[cursors[g]: cursors[g] + take])
+            cursors[g] += take
+        if not pods and not group_idx.size:
+            continue
+        # combined per-type price across the node's groups (inf if any group
+        # cannot use the type) -> ranked alternatives; an alternative must
+        # also offer the node's final zone/captype window
+        combined = problem.price[group_idx].max(axis=0)  # [T]
+        fits = (used[n][None, :] <= cap + 1e-4).all(axis=1)
+        window = (problem.type_window & node_window[n][None, :, :]).any(axis=(1, 2))
+        usable = np.isfinite(combined) & fits & window
+        order = np.argsort(np.where(usable, combined, np.inf), kind="stable")
+        n_usable = int(usable.sum())
+        ranked = order[: min(n_usable, MAX_INSTANCE_TYPE_OPTIONS)]
+        committed = int(node_type[n])
+        type_names = [problem.type_names[t] for t in ranked]
+        if problem.type_names[committed] not in type_names:
+            type_names = [problem.type_names[committed]] + type_names[:-1]
+
+        # The solver narrowed each node's joint (zone, captype) window as
+        # groups landed (intersected with the committed type's live
+        # offerings), so every pair in it is directly launchable.
+        win = node_window[n]  # [Z, 2]
+        offering_options = [
+            (z, ct)
+            for zi, z in enumerate(problem.zones)
+            for ci, ct in enumerate(lbl.CAPACITY_TYPES)
+            if win[zi, ci]
+        ]
+        specs.append(
+            NodeSpec(
+                nodepool_name=nodepool_name,
+                instance_type_options=type_names,
+                zone_options=[z for zi, z in enumerate(problem.zones) if win[zi].any()],
+                capacity_type_options=[
+                    ct for ci, ct in enumerate(lbl.CAPACITY_TYPES) if win[:, ci].any()
+                ],
+                offering_options=offering_options,
+                pods=pods,
+                estimated_price=float(node_price[n]),
+            )
+        )
+    return specs
+
+
+class TPUSolver:
+    """Device-backed solver. ``group_chunk`` bounds per-scan group axis; node
+    state carries across chunks on device."""
+
+    def __init__(self, group_chunk: int = 1024, max_nodes: Optional[int] = None):
+        self.group_chunk = group_chunk
+        self.max_nodes = max_nodes
+
+    def solve_encoded(self, problem: EncodedProblem) -> tuple[list[NodeSpec], dict[int, int]]:
+        import jax.numpy as jnp
+
+        G = len(problem.group_pods)
+        if G == 0:
+            return [], {}
+        num_pods = int(problem.counts[:G].sum())
+        N = self.max_nodes or _node_bucket(num_pods)
+        GB = bucket(G)
+        padded = pad_problem(problem, GB)
+
+        placed_chunks = []
+        unplaced_chunks = []
+        state = None
+        chunk = min(self.group_chunk, GB)
+        for start in range(0, GB, chunk):
+            sl = slice(start, start + chunk)
+            res = ffd_solve(
+                jnp.asarray(padded.requests[sl]),
+                jnp.asarray(padded.counts[sl]),
+                jnp.asarray(padded.compat[sl]),
+                jnp.asarray(padded.capacity),
+                jnp.asarray(padded.price[sl]),
+                jnp.asarray(padded.group_window[sl]),
+                jnp.asarray(padded.type_window),
+                max_nodes=N,
+                init_state=state,
+            )
+            from ..ops.ffd import _State
+
+            state = _State(
+                node_type=res.node_type,
+                node_price=res.node_price,
+                used=res.used,
+                node_cap=res.node_cap,
+                node_window=res.node_window,
+                n_open=res.n_open,
+            )
+            placed_chunks.append(np.asarray(res.placed))
+            unplaced_chunks.append(np.asarray(res.unplaced))
+
+        placed = np.concatenate(placed_chunks, axis=0)
+        unplaced_arr = np.concatenate(unplaced_chunks)[:G]
+        n_open = int(state.n_open)
+        specs = _decode_nodes(
+            problem,
+            np.asarray(state.node_type),
+            np.asarray(state.node_price),
+            np.asarray(state.used),
+            n_open,
+            placed,
+            problem.nodepool.name if problem.nodepool else "",
+            np.asarray(state.node_window),
+        )
+        unplaced = {g: int(c) for g, c in enumerate(unplaced_arr) if c > 0}
+        return specs, unplaced
+
+    def solve(self, pods, nodepools, catalog, in_use=None) -> SolveResult:
+        return _solve_multi_nodepool(self, pods, nodepools, catalog, in_use)
+
+
+class HostSolver:
+    """Numpy fallback solver (and the oracle in tests)."""
+
+    def solve_encoded(self, problem: EncodedProblem) -> tuple[list[NodeSpec], dict[int, int]]:
+        from .oracle import ffd_oracle
+
+        nodes, unplaced = ffd_oracle(problem)
+        G = len(problem.group_pods)
+        n_open = len(nodes)
+        N = max(n_open, 1)
+        Z = problem.group_window.shape[1]
+        placed = np.zeros((G, N), dtype=np.int32)
+        node_type = np.zeros(N, dtype=np.int32)
+        node_price = np.zeros(N, dtype=np.float32)
+        used = np.zeros((N, problem.capacity.shape[1]), dtype=np.float32)
+        node_window = np.zeros((N, Z, 2), dtype=bool)
+        for n, node in enumerate(nodes):
+            node_type[n] = node.type_index
+            node_price[n] = node.price
+            used[n] = node.used
+            node_window[n] = node.window
+            for g, c in node.group_counts.items():
+                placed[g, n] = c
+        specs = _decode_nodes(
+            problem, node_type, node_price, used, n_open, placed,
+            problem.nodepool.name if problem.nodepool else "",
+            node_window,
+        )
+        return specs, unplaced
+
+    def solve(self, pods, nodepools, catalog, in_use=None) -> SolveResult:
+        return _solve_multi_nodepool(self, pods, nodepools, catalog, in_use)
+
+
+def _enforce_pool_constraints(
+    specs: list[NodeSpec],
+    pool: NodePool,
+    catalog: CatalogProvider,
+    in_use,
+) -> tuple[list[NodeSpec], list[tuple[Pod, str]]]:
+    """Apply NodePool.spec.limits and requirement minValues to a node plan.
+
+    Limits parity (core NodePool.spec.limits): cumulative *capacity* of
+    launched nodes (plus capacity already in use) must not exceed the cap;
+    nodes beyond it are rejected and their pods fall through.
+
+    minValues parity: a launch whose instance-type flexibility has fewer
+    distinct values for a minValues-bearing key than required is rejected.
+    """
+    from ..models.resources import ResourceVector
+
+    min_values_keys = [
+        (r.key, r.min_values) for r in pool.requirements if r.min_values
+    ]
+    kept: list[NodeSpec] = []
+    rejected: list[tuple[Pod, str]] = []
+    in_use = in_use.copy() if in_use is not None else ResourceVector()
+    for spec in specs:
+        if min_values_keys:
+            ok = True
+            for key, need in min_values_keys:
+                distinct = {
+                    catalog.get(name).labels().get(key)
+                    for name in spec.instance_type_options
+                    if catalog.get(name) is not None
+                } - {None}
+                if len(distinct) < need:
+                    ok = False
+                    for pod in spec.pods:
+                        rejected.append(
+                            (pod, f"minValues for {key} not met ({len(distinct)} < {need})")
+                        )
+                    break
+            if not ok:
+                continue
+        if not pool.limits.unlimited:
+            it = catalog.get(spec.instance_type_options[0])
+            candidate = in_use + it.capacity()
+            if pool.limits.exceeded_by(candidate):
+                for pod in spec.pods:
+                    rejected.append((pod, "would exceed nodepool limits"))
+                continue
+            in_use = candidate
+        kept.append(spec)
+    return kept, rejected
+
+
+def _solve_multi_nodepool(impl, pods, nodepools, catalog, in_use=None) -> SolveResult:
+    t0 = time.perf_counter()
+    result = SolveResult(num_pods=len(pods))
+    remaining: list[Pod] = list(pods)
+    reasons: dict[str, str] = {}
+    in_use = in_use or {}
+    for pool in sorted(nodepools, key=lambda p: -p.weight):
+        if not remaining:
+            break
+        problem = encode_problem(remaining, catalog, nodepool=pool)
+        for pod, why in problem.unencodable:
+            reasons[pod.uid] = f"nodepool {pool.name}: {why}"
+        specs, unplaced = impl.solve_encoded(problem)
+        specs, rejected = _enforce_pool_constraints(
+            specs, pool, catalog, in_use.get(pool.name)
+        )
+        result.node_specs.extend(specs)
+        # pods that didn't land fall through to the next nodepool
+        leftover: list[Pod] = [p for p, _ in problem.unencodable]
+        for pod, why in rejected:
+            reasons[pod.uid] = f"nodepool {pool.name}: {why}"
+            leftover.append(pod)
+        for g, cnt in unplaced.items():
+            plist = problem.group_pods[g]
+            leftover.extend(plist[len(plist) - cnt:])
+            for pod in plist[len(plist) - cnt:]:
+                reasons[pod.uid] = f"nodepool {pool.name}: no instance type fits"
+        remaining = leftover
+    for pod in remaining:
+        result.unschedulable.append(
+            (pod, reasons.get(pod.uid, "no nodepool can schedule this pod"))
+        )
+    result.total_cost = float(sum(s.estimated_price for s in result.node_specs))
+    result.solve_seconds = time.perf_counter() - t0
+    return result

@@ -1,0 +1,20 @@
+"""Substrate: clocks, TTL caches, seqnum'd ICE cache, error taxonomy, batcher.
+
+Reference parity: ``pkg/cache`` (TTL constants + UnavailableOfferings),
+``pkg/errors`` (AWS error taxonomy), ``pkg/batcher`` (request coalescing).
+"""
+
+from .clock import Clock, RealClock, FakeClock  # noqa: F401
+from .cache import TTLCache, CacheTTL  # noqa: F401
+from .unavailable import UnavailableOfferings  # noqa: F401
+from .errors import (  # noqa: F401
+    CloudError,
+    NotFoundError,
+    AlreadyExistsError,
+    InsufficientCapacityError,
+    LaunchTemplateNotFoundError,
+    RateLimitedError,
+    is_not_found,
+    is_unfulfillable_capacity,
+)
+from .batcher import Batcher, BatcherOptions  # noqa: F401

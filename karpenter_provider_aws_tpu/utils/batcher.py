@@ -1,0 +1,128 @@
+"""Generic request-coalescing batcher.
+
+Reference parity: ``pkg/batcher/batcher.go:33-118`` — requests are bucketed
+by a hash of batchable options, a window triggers on idle timeout or max
+duration or max items, then one wire call serves the whole batch and results
+are scattered back to callers. The CreateFleet batcher turns N logical
+single-instance launches into one fleet call of capacity N and splits the
+results (``createfleet.go:32-110``).
+
+This is the host-side analogue of a collective: gather N logical ops into
+one physical op, scatter results. The device-side analogue is the problem
+tensor itself (all pods solved in one jit call).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Generic, Hashable, TypeVar
+
+T = TypeVar("T")  # request
+U = TypeVar("U")  # response
+
+
+@dataclass
+class BatcherOptions:
+    idle_timeout_s: float = 0.035   # createfleet.go:35 — 35ms
+    max_timeout_s: float = 1.0      # createfleet.go:36 — 1s
+    max_items: int = 1000           # createfleet.go:37
+    # max_request_workers in the reference; we execute inline per batch.
+
+
+class _Pending(Generic[T, U]):
+    def __init__(self, request: T):
+        self.request = request
+        self.event = threading.Event()
+        self.result: U | None = None
+        self.error: Exception | None = None
+
+
+class Batcher(Generic[T, U]):
+    """Coalesces requests with equal ``hasher(request)`` into one executor call.
+
+    ``executor(requests) -> list[results]`` must return one result (or raise)
+    per request, positionally.
+    """
+
+    def __init__(
+        self,
+        executor: Callable[[list[T]], list],
+        hasher: Callable[[T], Hashable] = lambda r: 0,
+        options: BatcherOptions | None = None,
+    ):
+        self._executor = executor
+        self._hasher = hasher
+        self._opts = options or BatcherOptions()
+        self._lock = threading.Lock()
+        self._buckets: dict[Hashable, list[_Pending]] = {}
+        self._timers: dict[Hashable, threading.Timer] = {}
+        self._first_seen: dict[Hashable, float] = {}
+        # metrics
+        self.batches_executed = 0
+        self.batch_sizes: list[int] = []
+
+    def add(self, request: T) -> U:
+        """Block until the batch containing this request executes; return its result."""
+        p: _Pending[T, U] = _Pending(request)
+        key = self._hasher(request)
+        flush_now = False
+        with self._lock:
+            bucket = self._buckets.setdefault(key, [])
+            bucket.append(p)
+            if len(bucket) >= self._opts.max_items:
+                flush_now = True
+            else:
+                self._arm_timer(key)
+        if flush_now:
+            self._flush(key)
+        if not p.event.wait(timeout=self._opts.max_timeout_s * 4 + 30):
+            raise TimeoutError("batch executor did not complete within the batch window")
+        if p.error is not None:
+            raise p.error
+        return p.result  # type: ignore[return-value]
+
+    def _arm_timer(self, key: Hashable) -> None:
+        # Called under lock. Idle window restarts per add; a max-duration
+        # timer bounds total latency (batcher.go idle/max windows).
+        import time
+        now = time.monotonic()
+        first = self._first_seen.setdefault(key, now)
+        remaining_max = self._opts.max_timeout_s - (now - first)
+        delay = max(0.0, min(self._opts.idle_timeout_s, remaining_max))
+        old = self._timers.pop(key, None)
+        if old is not None:
+            old.cancel()
+        t = threading.Timer(delay, self._flush, args=(key,))
+        t.daemon = True
+        self._timers[key] = t
+        t.start()
+
+    def _flush(self, key: Hashable) -> None:
+        with self._lock:
+            bucket = self._buckets.pop(key, [])
+            timer = self._timers.pop(key, None)
+            self._first_seen.pop(key, None)
+            if timer is not None:
+                timer.cancel()
+        if not bucket:
+            return
+        self.batches_executed += 1
+        self.batch_sizes.append(len(bucket))
+        try:
+            results = self._executor([p.request for p in bucket])
+            if len(results) != len(bucket):
+                raise RuntimeError(
+                    f"batch executor returned {len(results)} results for {len(bucket)} requests"
+                )
+            for p, r in zip(bucket, results):
+                if isinstance(r, Exception):
+                    p.error = r
+                else:
+                    p.result = r
+        except Exception as e:  # executor-wide failure fans out to all callers
+            for p in bucket:
+                p.error = e
+        finally:
+            for p in bucket:
+                p.event.set()

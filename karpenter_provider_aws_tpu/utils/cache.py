@@ -1,0 +1,79 @@
+"""TTL cache + the framework's TTL constants.
+
+Reference parity: ``pkg/cache/cache.go:20-47`` — DefaultTTL 1m, ICE 3m,
+instance-types/offerings 5m, instance-profile 15m; DefaultCleanupInterval.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Hashable, Optional
+
+from .clock import Clock, RealClock
+
+
+class CacheTTL:
+    DEFAULT = 60.0
+    UNAVAILABLE_OFFERINGS = 180.0
+    INSTANCE_TYPES = 300.0
+    INSTANCE_TYPE_AVAILABILITY = 300.0
+    INFLIGHT_IPS = 300.0
+    INSTANCE_PROFILE = 900.0
+    LAUNCH_TEMPLATE = 600.0
+    CATALOG_REFRESH_PERIOD = 12 * 3600.0
+    PRICING_REFRESH_PERIOD = 12 * 3600.0
+
+
+class TTLCache:
+    """Thread-safe expiring map on an injectable clock."""
+
+    def __init__(self, default_ttl: float = CacheTTL.DEFAULT, clock: Optional[Clock] = None):
+        self._data: dict[Hashable, tuple[Any, float]] = {}
+        self._ttl = default_ttl
+        self._clock = clock or RealClock()
+        self._lock = threading.RLock()
+
+    def set(self, key: Hashable, value: Any, ttl: Optional[float] = None) -> None:
+        with self._lock:
+            self._data[key] = (value, self._clock.now() + (self._ttl if ttl is None else ttl))
+
+    def get(self, key: Hashable, default: Any = None) -> Any:
+        with self._lock:
+            hit = self._data.get(key)
+            if hit is None:
+                return default
+            value, expiry = hit
+            if self._clock.now() >= expiry:
+                del self._data[key]
+                return default
+            return value
+
+    def get_or_load(self, key: Hashable, loader: Callable[[], Any], ttl: Optional[float] = None) -> Any:
+        with self._lock:
+            sentinel = object()
+            v = self.get(key, sentinel)
+            if v is not sentinel:
+                return v
+            v = loader()
+            self.set(key, v, ttl)
+            return v
+
+    def delete(self, key: Hashable) -> None:
+        with self._lock:
+            self._data.pop(key, None)
+
+    def flush(self) -> None:
+        with self._lock:
+            self._data.clear()
+
+    def keys(self) -> list:
+        with self._lock:
+            now = self._clock.now()
+            return [k for k, (_, exp) in self._data.items() if now < exp]
+
+    def __len__(self) -> int:
+        return len(self.keys())
+
+    def __contains__(self, key: Hashable) -> bool:
+        sentinel = object()
+        return self.get(key, sentinel) is not sentinel

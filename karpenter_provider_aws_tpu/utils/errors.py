@@ -1,0 +1,75 @@
+"""Cloud error taxonomy.
+
+Reference parity: ``pkg/errors/errors.go:31-52`` — not-found codes,
+already-exists, unfulfillable-capacity (ICE) codes, launch-template-not-found.
+The fake backend raises these; providers classify on them.
+"""
+
+from __future__ import annotations
+
+
+class CloudError(Exception):
+    code = "InternalError"
+
+    def __init__(self, message: str = "", code: str = ""):
+        super().__init__(message or self.__class__.code)
+        if code:
+            self.code = code
+
+
+class NotFoundError(CloudError):
+    code = "InvalidInstanceID.NotFound"
+
+
+class AlreadyExistsError(CloudError):
+    code = "ResourceAlreadyExists"
+
+
+class InsufficientCapacityError(CloudError):
+    """ICE — the capacity pool (instance type x zone x capacity type) is dry.
+
+    Parity: errors.go:44-52 unfulfillableCapacityErrorCodes
+    (InsufficientInstanceCapacity, MaxSpotInstanceCountExceeded, ...).
+    """
+
+    code = "InsufficientInstanceCapacity"
+
+    def __init__(self, instance_type: str = "", zone: str = "", capacity_type: str = "", message: str = ""):
+        super().__init__(message or f"ICE {capacity_type}:{instance_type}:{zone}")
+        self.instance_type = instance_type
+        self.zone = zone
+        self.capacity_type = capacity_type
+
+
+class LaunchTemplateNotFoundError(CloudError):
+    code = "InvalidLaunchTemplateName.NotFoundException"
+
+
+class RateLimitedError(CloudError):
+    code = "RequestLimitExceeded"
+
+
+_NOT_FOUND_CODES = {
+    "InvalidInstanceID.NotFound",
+    "InvalidLaunchTemplateName.NotFoundException",
+    "NoSuchEntity",
+    "QueueDoesNotExist",
+}
+
+_UNFULFILLABLE_CODES = {
+    "InsufficientFreeAddressesInSubnet",
+    "InsufficientInstanceCapacity",
+    "MaxSpotInstanceCountExceeded",
+    "SpotMaxPriceTooLow",
+    "UnfulfillableCapacity",
+    "Unsupported",
+    "InsufficientVolumeCapacity",
+}
+
+
+def is_not_found(err: Exception) -> bool:
+    return isinstance(err, CloudError) and err.code in _NOT_FOUND_CODES
+
+
+def is_unfulfillable_capacity(err: Exception) -> bool:
+    return isinstance(err, CloudError) and err.code in _UNFULFILLABLE_CODES

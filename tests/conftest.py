@@ -1,0 +1,42 @@
+"""Hermetic test environment.
+
+Forces JAX onto an 8-device virtual CPU platform *before* jax initializes, so
+multi-chip sharding tests run without TPU hardware (the driver separately
+dry-runs the multichip path). Mirrors the reference's tier-1 strategy:
+everything below e2e runs against fakes (SURVEY.md section 4).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The axon TPU-tunnel sitecustomize force-registers its platform via
+# jax.config, which beats the env var — override it back for hermetic tests.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+from karpenter_provider_aws_tpu.catalog import CatalogProvider, PricingProvider  # noqa: E402
+from karpenter_provider_aws_tpu.utils import FakeClock, UnavailableOfferings  # noqa: E402
+
+
+@pytest.fixture
+def clock():
+    return FakeClock()
+
+
+@pytest.fixture(scope="session")
+def session_catalog():
+    """One shared full-size catalog (building ~700 types is cheap but not free)."""
+    return CatalogProvider()
+
+
+@pytest.fixture
+def catalog(clock):
+    """Fresh catalog with injectable clock + empty ICE cache per test."""
+    return CatalogProvider(clock=clock)

@@ -1,0 +1,171 @@
+"""Catalog + allocatable-math behavior (reference: instancetype suite,
+pkg/providers/instancetype/suite_test.go capacity/overhead expectations)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from karpenter_provider_aws_tpu.catalog import (
+    CatalogProvider,
+    PricingProvider,
+    generate_catalog,
+)
+from karpenter_provider_aws_tpu.catalog.provider import (
+    OverheadOptions,
+    kube_reserved_cpu_milli,
+    kube_reserved_memory_mib,
+)
+from karpenter_provider_aws_tpu.models import labels as lbl
+from karpenter_provider_aws_tpu.models.resources import CPU, MEMORY, PODS
+from karpenter_provider_aws_tpu.utils import FakeClock
+
+
+class TestGenerator:
+    def test_reference_scale(self, session_catalog):
+        # The reference catalog is ~700 EC2 types (BASELINE.md catalog scale).
+        assert len(session_catalog) >= 700
+
+    def test_unique_names(self, session_catalog):
+        names = session_catalog.names()
+        assert len(names) == len(set(names))
+
+    def test_axes_covered(self, session_catalog):
+        types = session_catalog.list()
+        archs = {t.arch for t in types}
+        assert archs == {"amd64", "arm64"}
+        assert any(t.gpu_count for t in types)
+        assert any(t.accelerator_count for t in types)
+        assert any(t.bare_metal for t in types)
+        assert any(t.efa_count for t in types)
+        assert any(t.local_nvme_gib for t in types)
+
+    def test_deterministic(self):
+        a = generate_catalog()
+        b = generate_catalog()
+        assert [t.name for t in a] == [t.name for t in b]
+        assert all(
+            o1 == o2 for t1, t2 in zip(a, b) for o1, o2 in zip(t1.offerings, t2.offerings)
+        )
+
+    def test_labels_complete(self, session_catalog):
+        it = session_catalog.get("c7g.xlarge")
+        labels = it.labels()
+        assert labels[lbl.ARCH] == "arm64"
+        assert labels[lbl.INSTANCE_CATEGORY] == "c"
+        assert labels[lbl.INSTANCE_CPU] == "4"
+        assert labels[lbl.INSTANCE_GENERATION] == "7"
+        gpu = session_catalog.get("g5.12xlarge")
+        assert gpu.labels()[lbl.INSTANCE_GPU_MANUFACTURER] == "nvidia"
+        assert gpu.labels()[lbl.INSTANCE_GPU_COUNT] == "4"
+
+
+class TestAllocatable:
+    def test_kube_reserved_cpu_curve(self):
+        # 6% first core, 1% second, 0.5% cores 3-4, 0.25% rest (types.go:364-383)
+        assert kube_reserved_cpu_milli(1) == pytest.approx(60.0)
+        assert kube_reserved_cpu_milli(2) == pytest.approx(70.0)
+        assert kube_reserved_cpu_milli(4) == pytest.approx(80.0)
+        assert kube_reserved_cpu_milli(8) == pytest.approx(90.0)
+        assert kube_reserved_cpu_milli(48) == pytest.approx(190.0)
+
+    def test_kube_reserved_memory(self):
+        assert kube_reserved_memory_mib(29) == pytest.approx(255 + 11 * 29)
+
+    def test_allocatable_below_capacity(self, session_catalog):
+        it = session_catalog.get("m6.2xlarge") or session_catalog.get("m6d.2xlarge")
+        alloc = session_catalog.allocatable(it)
+        cap = it.capacity()
+        assert alloc.v[CPU] < cap.v[CPU]
+        assert alloc.v[MEMORY] < cap.v[MEMORY]
+        assert alloc.v[CPU] > 0 and alloc.v[MEMORY] > 0
+
+    def test_vm_overhead_percent(self):
+        base = CatalogProvider(overhead=OverheadOptions(vm_memory_overhead_percent=0.0))
+        heavy = CatalogProvider(overhead=OverheadOptions(vm_memory_overhead_percent=0.2))
+        it = base.get("c5.large")
+        assert heavy.allocatable(heavy.get("c5.large")).v[MEMORY] < base.allocatable(it).v[MEMORY]
+
+    def test_max_pods_override(self):
+        p = CatalogProvider(overhead=OverheadOptions(max_pods=10))
+        assert p.allocatable(p.get("c5.4xlarge")).v[PODS] == 10
+
+    def test_eni_limited_pods(self, session_catalog):
+        it = session_catalog.get("c5.large")  # 3 ENIs x 10 IPs -> 3*9+2 = 29
+        assert it.eni_limited_pods() == 29
+
+
+class TestOfferings:
+    def test_tensor_shapes(self, catalog):
+        t = catalog.tensors()
+        T, Z = len(catalog), len(catalog.zones)
+        assert t.capacity.shape == (T, 8)
+        assert t.price.shape == (T, Z, 2)
+        assert t.available.shape == (T, Z, 2)
+        assert t.available.any()
+
+    def test_spot_cheaper_than_od(self, catalog):
+        t = catalog.tensors()
+        both = t.available[:, :, 0] & t.available[:, :, 1]
+        assert (t.price[:, :, 1][both] < t.price[:, :, 0][both]).all()
+
+    def test_ice_masks_offering(self, catalog):
+        t0 = catalog.tensors()
+        name = catalog.names()[0]
+        zone = catalog.zones[0]
+        assert t0.available[0, 0, 1]
+        catalog.unavailable.mark_unavailable(name, zone, lbl.CAPACITY_TYPE_SPOT)
+        t1 = catalog.tensors()
+        assert not t1.available[0, 0, 1]
+        assert t1.available[0, 0, 0]  # on-demand untouched
+
+    def test_ice_ttl_expiry_restores(self, catalog, clock):
+        name = catalog.names()[0]
+        catalog.unavailable.mark_unavailable(name, catalog.zones[0], lbl.CAPACITY_TYPE_SPOT)
+        assert not catalog.tensors().available[0, 0, 1]
+        clock.advance(181)  # ICE TTL is 3m (cache.go:28-30)
+        # seqnum unchanged but TTL expired; entries() drops it
+        assert catalog.unavailable.entries() == []
+        assert not catalog.unavailable.is_unavailable(name, catalog.zones[0], lbl.CAPACITY_TYPE_SPOT)
+
+    def test_seqnum_invalidates_tensor_cache(self, catalog):
+        t0 = catalog.tensors()
+        catalog.unavailable.mark_unavailable(catalog.names()[3], catalog.zones[1], lbl.CAPACITY_TYPE_ON_DEMAND)
+        t1 = catalog.tensors()
+        assert t1.key != t0.key
+        assert not t1.available[3, 1, 0]
+
+    def test_tensor_cache_hit_on_same_key(self, catalog):
+        assert catalog.tensors() is catalog.tensors()
+
+    def test_min_price_masks_unavailable(self, catalog):
+        t = catalog.tensors()
+        mp = t.min_price()
+        live = t.any_available()
+        assert np.isfinite(mp[live]).all()
+        assert np.isinf(mp[~live]).all() if (~live).any() else True
+
+
+class TestPricing:
+    def test_live_update_overrides(self, catalog):
+        it = catalog.get("c5.large")
+        catalog.pricing.update_on_demand({"c5.large": 9.99})
+        assert catalog.pricing.on_demand_price(it) == 9.99
+        t = catalog.tensors()
+        i = catalog.names().index("c5.large")
+        assert np.allclose(t.price[i, :, 0], 9.99)
+
+    def test_isolated_vpc_skips_updates(self):
+        p = PricingProvider(isolated_vpc=True)
+        p.update_on_demand({"c5.large": 9.99})
+        assert p._od_overrides == {}
+
+    def test_arm_discount(self, catalog):
+        x86 = catalog.get("c6.2xlarge")
+        arm = catalog.get("c6g.2xlarge")
+        assert catalog.pricing.on_demand_price(arm) < catalog.pricing.on_demand_price(x86)
+
+    def test_refresh_bumps_seq(self, catalog):
+        k0 = catalog.cache_key()
+        catalog.pricing.update_spot({("c5.large", "zone-a"): 0.01})
+        assert catalog.cache_key() != k0

@@ -1,0 +1,145 @@
+"""Requirement-engine semantics (reference: core scheduling requirements,
+used at pkg/cloudprovider/cloudprovider.go:258-263)."""
+
+import pytest
+
+from karpenter_provider_aws_tpu.models import (
+    Operator,
+    Requirement,
+    Requirements,
+)
+from karpenter_provider_aws_tpu.models import labels as lbl
+
+
+def req(key, op, *values, min_values=None):
+    return Requirement(key, op, tuple(values), min_values=min_values)
+
+
+class TestOperators:
+    def test_in_contains(self):
+        r = Requirements([req("k", Operator.IN, "a", "b")])
+        assert r.satisfied_by_labels({"k": "a"})
+        assert r.satisfied_by_labels({"k": "b"})
+        assert not r.satisfied_by_labels({"k": "c"})
+        assert not r.satisfied_by_labels({})
+
+    def test_not_in(self):
+        r = Requirements([req("k", Operator.NOT_IN, "a")])
+        assert not r.satisfied_by_labels({"k": "a"})
+        assert r.satisfied_by_labels({"k": "b"})
+        # k8s semantics: NotIn is satisfied when the label is absent.
+        assert r.satisfied_by_labels({})
+
+    def test_not_in_then_exists(self):
+        r = Requirements([req("k", Operator.NOT_IN, "a"), req("k", Operator.EXISTS)])
+        assert r.satisfied_by_labels({"k": "b"})
+        assert not r.satisfied_by_labels({})
+
+    def test_exists_does_not_exist(self):
+        r = Requirements([req("k", Operator.EXISTS)])
+        assert r.satisfied_by_labels({"k": "anything"})
+        assert not r.satisfied_by_labels({})
+        r2 = Requirements([req("k", Operator.DOES_NOT_EXIST)])
+        assert r2.satisfied_by_labels({})
+        assert not r2.satisfied_by_labels({"k": "x"})
+
+    def test_gt_lt_numeric(self):
+        r = Requirements([req(lbl.INSTANCE_CPU, Operator.GT, "4"), req(lbl.INSTANCE_CPU, Operator.LT, "64")])
+        assert r.satisfied_by_labels({lbl.INSTANCE_CPU: "8"})
+        assert not r.satisfied_by_labels({lbl.INSTANCE_CPU: "4"})   # strict
+        assert not r.satisfied_by_labels({lbl.INSTANCE_CPU: "64"})
+        assert not r.satisfied_by_labels({lbl.INSTANCE_CPU: "128"})
+        assert not r.satisfied_by_labels({lbl.INSTANCE_CPU: "weird"})
+
+    def test_gt_requires_single_numeric_value(self):
+        with pytest.raises(ValueError):
+            req("k", Operator.GT, "1", "2")
+        with pytest.raises(ValueError):
+            req("k", Operator.GT, "abc")
+
+    def test_exists_rejects_values(self):
+        with pytest.raises(ValueError):
+            req("k", Operator.EXISTS, "v")
+
+
+class TestIntersection:
+    def test_in_in(self):
+        r = Requirements([req("k", Operator.IN, "a", "b"), req("k", Operator.IN, "b", "c")])
+        assert r.satisfied_by_labels({"k": "b"})
+        assert not r.satisfied_by_labels({"k": "a"})
+
+    def test_in_notin_unsat(self):
+        r = Requirements([req("k", Operator.IN, "a"), req("k", Operator.NOT_IN, "a")])
+        assert not r.is_satisfiable()
+
+    def test_in_gt(self):
+        r = Requirements([req("k", Operator.IN, "2", "8", "64"), req("k", Operator.GT, "4")])
+        assert r.satisfied_by_labels({"k": "8"})
+        assert not r.satisfied_by_labels({"k": "2"})
+
+    def test_exists_and_does_not_exist_unsat(self):
+        r = Requirements([req("k", Operator.EXISTS), req("k", Operator.DOES_NOT_EXIST)])
+        assert not r.is_satisfiable()
+
+
+class TestCompatible:
+    def test_disjoint_keys_compatible(self):
+        a = Requirements([req("x", Operator.IN, "1")])
+        b = Requirements([req("y", Operator.IN, "2")])
+        assert a.compatible(b)
+
+    def test_overlapping_values_compatible(self):
+        a = Requirements([req("k", Operator.IN, "a", "b")])
+        b = Requirements([req("k", Operator.IN, "b", "c")])
+        assert a.compatible(b) and b.compatible(a)
+
+    def test_disjoint_values_incompatible(self):
+        a = Requirements([req("k", Operator.IN, "a")])
+        b = Requirements([req("k", Operator.IN, "b")])
+        assert not a.compatible(b)
+
+    def test_notin_vs_in(self):
+        a = Requirements([req("k", Operator.NOT_IN, "a")])
+        b = Requirements([req("k", Operator.IN, "a")])
+        assert not a.compatible(b)
+        c = Requirements([req("k", Operator.IN, "a", "z")])
+        assert a.compatible(c)
+
+    def test_gt_vs_in_ranges(self):
+        a = Requirements([req("cpu", Operator.GT, "16")])
+        b = Requirements([req("cpu", Operator.IN, "4", "8")])
+        assert not a.compatible(b)
+        c = Requirements([req("cpu", Operator.IN, "4", "32")])
+        assert a.compatible(c)
+
+    def test_does_not_exist_vs_in(self):
+        a = Requirements([req("k", Operator.DOES_NOT_EXIST)])
+        b = Requirements([req("k", Operator.IN, "v")])
+        assert not a.compatible(b)
+
+
+class TestMinValues:
+    def test_min_values_satisfied(self):
+        pod = Requirements([req("fam", Operator.IN, "a", "b", "c", min_values=2)])
+        types = Requirements([req("fam", Operator.IN, "a", "b")])
+        assert pod.min_values_satisfied(types)
+
+    def test_min_values_violated(self):
+        pod = Requirements([req("fam", Operator.IN, "a", "b", "c", min_values=3)])
+        types = Requirements([req("fam", Operator.IN, "a")])
+        assert not pod.min_values_satisfied(types)
+
+
+class TestUnion:
+    def test_union_intersects_shared_keys(self):
+        a = Requirements([req("k", Operator.IN, "a", "b")])
+        b = Requirements([req("k", Operator.IN, "b", "c"), req("j", Operator.EXISTS)])
+        u = a.union(b)
+        assert u.satisfied_by_labels({"k": "b", "j": "x"})
+        assert not u.satisfied_by_labels({"k": "a", "j": "x"})
+        assert not u.satisfied_by_labels({"k": "b"})
+
+    def test_from_labels_roundtrip(self):
+        r = Requirements.from_labels({"a": "1", "b": "2"})
+        assert r.satisfied_by_labels({"a": "1", "b": "2", "extra": "ok"})
+        assert not r.satisfied_by_labels({"a": "1"})

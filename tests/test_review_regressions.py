@@ -1,0 +1,139 @@
+"""Regressions from code review: lock ordering, cache collisions, region
+labels, startup taints, joint offering windows, hostname pins."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from karpenter_provider_aws_tpu.catalog import CatalogProvider, generate_catalog
+from karpenter_provider_aws_tpu.catalog.instancetypes import InstanceType, Offering
+from karpenter_provider_aws_tpu.models import NodePool, Taint
+from karpenter_provider_aws_tpu.models import labels as lbl
+from karpenter_provider_aws_tpu.models.pod import make_pods
+from karpenter_provider_aws_tpu.ops.encode import encode_problem
+from karpenter_provider_aws_tpu.scheduling import HostSolver, TPUSolver
+
+
+@pytest.fixture(scope="module")
+def catalog():
+    return CatalogProvider()
+
+
+@pytest.fixture(scope="module")
+def pool():
+    return NodePool(name="default")
+
+
+class TestConcurrency:
+    def test_tensors_refresh_no_deadlock(self):
+        cat = CatalogProvider()
+        types = cat.list()
+        stop = threading.Event()
+        errors = []
+
+        def reader():
+            while not stop.is_set():
+                cat.tensors()
+
+        def refresher():
+            while not stop.is_set():
+                cat.refresh(types)
+
+        threads = [threading.Thread(target=reader) for _ in range(2)] + [
+            threading.Thread(target=refresher)
+        ]
+        for t in threads:
+            t.daemon = True
+            t.start()
+        import time
+
+        time.sleep(1.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+            assert not t.is_alive(), "deadlock: thread failed to exit"
+
+
+class TestLabelCacheIsolation:
+    def test_same_name_different_labels_across_providers(self):
+        a_type = InstanceType(name="t.x", category="c", family="t", generation=5,
+                              size="x", arch="amd64", vcpus=8, memory_mib=16384)
+        b_type = InstanceType(name="t.x", category="c", family="t", generation=5,
+                              size="x", arch="arm64", vcpus=8, memory_mib=16384)
+        for t in (a_type, b_type):
+            t.offerings = [Offering("zone-a", "on-demand", 1.0, True),
+                           Offering("zone-a", "spot", 0.3, True)]
+        prov_a = CatalogProvider(types=[a_type], zones=("zone-a",))
+        prov_b = CatalogProvider(types=[b_type], zones=("zone-a",))
+        pods = make_pods(1, "p", {"cpu": "1"}, node_selector={lbl.ARCH: "arm64"})
+        pa = encode_problem(pods, prov_a)
+        pb = encode_problem(pods, prov_b)
+        assert not pa.compat[0].any()   # amd64-only provider: incompatible
+        assert pb.compat[0].any()       # arm64 provider must not see stale cache
+
+
+class TestRegionLabel:
+    def test_region_selector_matches_all_types(self, catalog, pool):
+        pods = make_pods(2, "r", {"cpu": "1"},
+                         node_selector={lbl.TOPOLOGY_REGION: "region-1"})
+        res = TPUSolver().solve(pods, [pool], catalog)
+        assert res.pods_placed() == 2
+
+    def test_wrong_region_unschedulable(self, catalog, pool):
+        pods = make_pods(1, "r", {"cpu": "1"},
+                         node_selector={lbl.TOPOLOGY_REGION: "region-2"})
+        res = TPUSolver().solve(pods, [pool], catalog)
+        assert res.pods_placed() == 0
+
+
+class TestStartupTaints:
+    def test_startup_taints_do_not_require_toleration(self, catalog):
+        pool = NodePool(
+            name="cni",
+            startup_taints=[Taint(key="node.cni/agent-not-ready", effect="NoSchedule")],
+        )
+        pods = make_pods(3, "w", {"cpu": "1"})  # no tolerations
+        res = TPUSolver().solve(pods, [pool], catalog)
+        assert res.pods_placed() == 3
+
+    def test_regular_taints_still_enforced(self, catalog):
+        pool = NodePool(name="t", taints=[Taint(key="team", value="ml")])
+        pods = make_pods(3, "w", {"cpu": "1"})
+        res = TPUSolver().solve(pods, [pool], catalog)
+        assert res.pods_placed() == 0
+
+
+@pytest.mark.parametrize("solver_cls", [TPUSolver, HostSolver])
+class TestJointOfferingWindow:
+    def test_no_dead_offering_combinations(self, solver_cls):
+        # Type with on-demand live only in zone-a, spot live only in zone-b:
+        # the node must never advertise (zone-a, spot) or (zone-b, on-demand).
+        it = InstanceType(name="j.x", category="c", family="j", generation=5,
+                          size="x", arch="amd64", vcpus=8, memory_mib=16384)
+        it.offerings = [
+            Offering("zone-a", "on-demand", 1.0, True),
+            Offering("zone-a", "spot", 0.3, False),
+            Offering("zone-b", "on-demand", 1.0, False),
+            Offering("zone-b", "spot", 0.3, True),
+        ]
+        prov = CatalogProvider(types=[it], zones=("zone-a", "zone-b"))
+        pods = make_pods(2, "w", {"cpu": "1"})
+        res = solver_cls().solve(pods, [NodePool(name="p")], prov)
+        assert res.pods_placed() == 2
+        for spec in res.node_specs:
+            assert spec.offering_options
+            for zone, ct in spec.offering_options:
+                assert any(
+                    o.zone == zone and o.capacity_type == ct and o.available
+                    for o in it.offerings
+                ), f"dead offering advertised: {zone}/{ct}"
+
+
+class TestHostnamePin:
+    def test_hostname_pinned_pod_is_unencodable(self, catalog, pool):
+        pods = make_pods(1, "pinned", {"cpu": "1"},
+                         node_selector={lbl.HOSTNAME: "ip-10-0-0-5"})
+        res = TPUSolver().solve(pods, [pool], catalog)
+        assert res.pods_placed() == 0
+        assert "hostname" in res.unschedulable[0][1]

@@ -1,0 +1,240 @@
+"""Solver behavior: encode semantics, TPU-vs-oracle parity, packing quality.
+
+Mirrors the reference's scheduler behavior specs (designs/bin-packing.md and
+the instancetype/cloudprovider suites)."""
+
+import numpy as np
+import pytest
+
+from karpenter_provider_aws_tpu.catalog import CatalogProvider
+from karpenter_provider_aws_tpu.models import (
+    NodePool,
+    Operator,
+    Requirement,
+    Taint,
+    Toleration,
+)
+from karpenter_provider_aws_tpu.models import labels as lbl
+from karpenter_provider_aws_tpu.models.pod import make_pods
+from karpenter_provider_aws_tpu.ops.encode import encode_problem
+from karpenter_provider_aws_tpu.scheduling import HostSolver, TPUSolver
+from karpenter_provider_aws_tpu.scheduling.oracle import ffd_oracle, oracle_cost
+
+
+@pytest.fixture(scope="module")
+def catalog():
+    return CatalogProvider()
+
+
+@pytest.fixture(scope="module")
+def pool():
+    return NodePool(name="default")
+
+
+def solve_both(pods, pool, catalog):
+    tpu = TPUSolver().solve(pods, [pool], catalog)
+    host = HostSolver().solve(pods, [pool], catalog)
+    return tpu, host
+
+
+class TestEncode:
+    def test_grouping_dedup(self, catalog, pool):
+        pods = make_pods(50, "web", {"cpu": "500m", "memory": "1Gi"})
+        pods += make_pods(30, "db", {"cpu": "2", "memory": "8Gi"})
+        p = encode_problem(pods, catalog, pool)
+        assert p.num_groups == 2
+        assert sorted(p.counts[: p.num_groups].tolist()) == [30, 50]
+        assert p.num_pods == 80
+
+    def test_ffd_order(self, catalog, pool):
+        pods = make_pods(5, "small", {"cpu": "250m", "memory": "512Mi"})
+        pods += make_pods(5, "big", {"cpu": "8", "memory": "32Gi"})
+        p = encode_problem(pods, catalog, pool)
+        # big group must come first (decreasing dominant share)
+        assert p.requests[0, 0] > p.requests[1, 0]
+
+    def test_node_selector_restricts_compat(self, catalog, pool):
+        pods = make_pods(1, "arm", {"cpu": "1"}, node_selector={lbl.ARCH: "arm64"})
+        p = encode_problem(pods, catalog, pool)
+        names = np.array(p.type_names)
+        compat_names = set(names[p.compat[0]])
+        assert compat_names
+        for n in compat_names:
+            assert catalog.get(n).arch == "arm64"
+
+    def test_gpu_request_restricts_compat(self, catalog, pool):
+        pods = make_pods(1, "gpu", {"cpu": "4", "nvidia.com/gpu": 1})
+        p = encode_problem(pods, catalog, pool)
+        names = np.array(p.type_names)
+        for n in names[p.compat[0]]:
+            assert catalog.get(n).gpu_count >= 1
+
+    def test_taint_filtering(self, catalog):
+        tainted = NodePool(name="tainted", taints=[Taint(key="team", value="ml")])
+        pods = make_pods(1, "no-tol", {"cpu": "1"})
+        p = encode_problem(pods, catalog, tainted)
+        assert p.num_groups == 0
+        assert len(p.unencodable) == 1
+        tol = make_pods(1, "tol", {"cpu": "1"},
+                        tolerations=[Toleration(key="team", value="ml")])
+        p2 = encode_problem(tol, catalog, tainted)
+        assert p2.num_groups == 1
+
+    def test_capacity_type_requirement(self, catalog):
+        od_pool = NodePool(
+            name="od",
+            requirements=[Requirement(lbl.CAPACITY_TYPE, Operator.IN, (lbl.CAPACITY_TYPE_ON_DEMAND,))],
+        )
+        pods = make_pods(1, "p", {"cpu": "1"})
+        p = encode_problem(pods, catalog, od_pool)
+        assert p.group_captype_allowed[0].tolist() == [True, False]
+        # price must equal the on-demand price, not the cheaper spot price
+        t0 = int(np.nonzero(p.compat[0])[0][0])
+        it = catalog.get(p.type_names[t0])
+        assert p.price[0, t0] == pytest.approx(catalog.pricing.on_demand_price(it), rel=1e-5)
+
+    def test_zone_requirement(self, catalog, pool):
+        pods = make_pods(
+            1, "zonal", {"cpu": "1"},
+            node_selector={lbl.TOPOLOGY_ZONE: "zone-b"},
+        )
+        p = encode_problem(pods, catalog, pool)
+        assert p.group_zone_allowed[0].tolist() == [False, True, False, False]
+
+    def test_ice_shrinks_price_options(self, catalog, pool):
+        pods = make_pods(1, "p", {"cpu": "1"})
+        p1 = encode_problem(pods, catalog, pool)
+        g0_types = np.nonzero(p1.compat[0])[0]
+        victim = int(g0_types[0])
+        name = p1.type_names[victim]
+        for z in catalog.zones:
+            for ct in lbl.CAPACITY_TYPES:
+                catalog.unavailable.mark_unavailable(name, z, ct)
+        try:
+            p2 = encode_problem(pods, catalog, pool)
+            assert not p2.compat[0][victim]
+        finally:
+            catalog.unavailable.flush()
+
+
+class TestParity:
+    """TPU solver must match the host oracle exactly (same policy, same
+    tensors -> same nodes)."""
+
+    def check(self, pods, pool, catalog):
+        problem = encode_problem(pods, catalog, pool)
+        tpu_specs, tpu_un = TPUSolver().solve_encoded(problem)
+        # re-encode: decode mutates nothing but cursors are internal
+        problem2 = encode_problem(pods, catalog, pool)
+        nodes, oracle_un = ffd_oracle(problem2)
+        assert len(tpu_specs) == len(nodes), "node count mismatch"
+        tpu_types = sorted(s.instance_type_options[0] for s in tpu_specs)
+        oracle_types = sorted(problem2.type_names[n.type_index] for n in nodes)
+        assert tpu_types == oracle_types
+        assert sum(tpu_un.values()) == sum(oracle_un.values())
+        tpu_cost = sum(s.estimated_price for s in tpu_specs)
+        assert tpu_cost == pytest.approx(oracle_cost(nodes), rel=1e-4)
+
+    def test_homogeneous(self, catalog, pool):
+        self.check(make_pods(200, "w", {"cpu": "500m", "memory": "2Gi"}), pool, catalog)
+
+    def test_heterogeneous(self, catalog, pool):
+        pods = (
+            make_pods(40, "a", {"cpu": "250m", "memory": "512Mi"})
+            + make_pods(25, "b", {"cpu": "2", "memory": "4Gi"})
+            + make_pods(10, "c", {"cpu": "7", "memory": "20Gi"})
+            + make_pods(8, "d", {"cpu": "1", "memory": "30Gi"})
+            + make_pods(3, "e", {"cpu": "15", "memory": "10Gi"})
+        )
+        self.check(pods, pool, catalog)
+
+    def test_gpu_mix(self, catalog, pool):
+        pods = make_pods(6, "gpu", {"cpu": "4", "memory": "16Gi", "nvidia.com/gpu": 2})
+        pods += make_pods(50, "cpu", {"cpu": "1", "memory": "2Gi"})
+        self.check(pods, pool, catalog)
+
+    def test_constrained_mix(self, catalog, pool):
+        pods = make_pods(30, "arm", {"cpu": "1", "memory": "4Gi"},
+                         node_selector={lbl.ARCH: "arm64"})
+        pods += make_pods(20, "zonal", {"cpu": "2", "memory": "4Gi"},
+                          node_selector={lbl.TOPOLOGY_ZONE: "zone-a"})
+        self.check(pods, pool, catalog)
+
+    def test_chunked_state_carry(self, catalog, pool):
+        # Force multi-chunk: many distinct groups via distinct cpu requests.
+        pods = []
+        for i in range(40):
+            pods += make_pods(2, f"g{i}", {"cpu": f"{200 + 13 * i}m", "memory": "1Gi"})
+        problem = encode_problem(pods, catalog, pool)
+        chunked = TPUSolver(group_chunk=8)
+        whole = TPUSolver()
+        s1, u1 = chunked.solve_encoded(problem)
+        s2, u2 = whole.solve_encoded(encode_problem(pods, catalog, pool))
+        assert len(s1) == len(s2)
+        assert sorted(x.instance_type_options[0] for x in s1) == sorted(
+            x.instance_type_options[0] for x in s2
+        )
+        assert u1 == u2
+
+
+class TestPackingQuality:
+    def test_all_pods_placed(self, catalog, pool):
+        pods = make_pods(500, "w", {"cpu": "500m", "memory": "2Gi"})
+        tpu, _ = solve_both(pods, pool, catalog)
+        assert tpu.pods_placed() == 500
+        assert not tpu.unschedulable
+
+    def test_bin_utilization(self, catalog, pool):
+        # 500m x 200 pods = 100 vcpu of demand; with ~large bins the packed
+        # capacity should not exceed demand by more than the per-node overhead
+        # slack. Guard: chosen capacity <= 1.5x demand.
+        pods = make_pods(200, "w", {"cpu": "500m", "memory": "1Gi"})
+        tpu = TPUSolver().solve(pods, [pool], catalog)
+        total_vcpu = sum(
+            catalog.get(s.instance_type_options[0]).vcpus for s in tpu.node_specs
+        )
+        assert total_vcpu <= 1.5 * 100
+
+    def test_respects_do_not_fit(self, catalog, pool):
+        # A pod bigger than anything in the catalog is unschedulable.
+        pods = make_pods(1, "huge", {"cpu": "5000", "memory": "100000Gi"})
+        tpu, host = solve_both(pods, pool, catalog)
+        assert len(tpu.unschedulable) == 1
+        assert len(host.unschedulable) == 1
+
+    def test_multi_nodepool_fallthrough(self, catalog):
+        arm_only = NodePool(
+            name="arm", weight=10,
+            requirements=[Requirement(lbl.ARCH, Operator.IN, ("arm64",))],
+        )
+        general = NodePool(name="general", weight=1)
+        # x86-only pods cannot land on the arm pool
+        pods = make_pods(4, "x86", {"cpu": "1"}, node_selector={lbl.ARCH: "amd64"})
+        res = TPUSolver().solve(pods, [arm_only, general], catalog)
+        assert res.pods_placed() == 4
+        assert all(s.nodepool_name == "general" for s in res.node_specs)
+
+    def test_spot_preferred_when_allowed(self, catalog, pool):
+        pods = make_pods(10, "w", {"cpu": "1", "memory": "2Gi"})
+        res = TPUSolver().solve(pods, [pool], catalog)
+        for spec in res.node_specs:
+            assert "spot" in spec.capacity_type_options
+
+    def test_pod_assignment_complete_and_disjoint(self, catalog, pool):
+        pods = make_pods(120, "a", {"cpu": "500m", "memory": "1Gi"}) + make_pods(
+            60, "b", {"cpu": "2", "memory": "3Gi"}
+        )
+        res = TPUSolver().solve(pods, [pool], catalog)
+        seen = [p.uid for s in res.node_specs for p in s.pods]
+        assert len(seen) == len(set(seen)) == 180
+
+    def test_node_capacity_never_exceeded(self, catalog, pool):
+        pods = make_pods(300, "w", {"cpu": "700m", "memory": "3Gi"})
+        res = TPUSolver().solve(pods, [pool], catalog)
+        for spec in res.node_specs:
+            it = catalog.get(spec.instance_type_options[0])
+            alloc = catalog.allocatable(it)
+            total = np.sum([p.requests.v for p in spec.pods], axis=0)
+            assert (total <= alloc.v + 1e-3).all(), (
+                spec.instance_type_options[0], total, alloc.v
+            )

@@ -1,0 +1,146 @@
+"""Regression tests for solver constraint enforcement: zone/captype node
+windows, NodePool limits, minValues, NotIn-vs-undefined labels, ICE expiry
+freshness."""
+
+import numpy as np
+import pytest
+
+from karpenter_provider_aws_tpu.catalog import CatalogProvider
+from karpenter_provider_aws_tpu.models import (
+    Limits,
+    NodePool,
+    Operator,
+    Requirement,
+)
+from karpenter_provider_aws_tpu.models import labels as lbl
+from karpenter_provider_aws_tpu.models.pod import make_pods
+from karpenter_provider_aws_tpu.scheduling import HostSolver, TPUSolver
+
+
+@pytest.fixture(scope="module")
+def catalog():
+    return CatalogProvider()
+
+
+@pytest.fixture(scope="module")
+def pool():
+    return NodePool(name="default")
+
+
+@pytest.mark.parametrize("solver_cls", [TPUSolver, HostSolver])
+class TestNodeWindows:
+    def test_zone_disjoint_groups_never_share_a_node(self, catalog, pool, solver_cls):
+        # Same resources, disjoint zones: must land on separate nodes with
+        # non-empty zone windows (previously produced zone_options=[]).
+        pods = make_pods(5, "a", {"cpu": "500m", "memory": "1Gi"},
+                         node_selector={lbl.TOPOLOGY_ZONE: "zone-a"})
+        pods += make_pods(5, "b", {"cpu": "500m", "memory": "1Gi"},
+                          node_selector={lbl.TOPOLOGY_ZONE: "zone-b"})
+        res = solver_cls().solve(pods, [pool], catalog)
+        assert res.pods_placed() == 10
+        for spec in res.node_specs:
+            assert spec.zone_options, "unlaunchable: empty zone options"
+            zones = {p.node_selector[lbl.TOPOLOGY_ZONE] for p in spec.pods}
+            assert len(zones) == 1
+            assert spec.zone_options == sorted(zones)
+
+    def test_captype_disjoint_groups_never_share_a_node(self, catalog, solver_cls):
+        od = NodePool(name="p")
+        pods = make_pods(5, "spot", {"cpu": "500m"},
+                         node_selector={lbl.CAPACITY_TYPE: "spot"})
+        pods += make_pods(5, "od", {"cpu": "500m"},
+                          node_selector={lbl.CAPACITY_TYPE: "on-demand"})
+        res = solver_cls().solve(pods, [od], catalog)
+        assert res.pods_placed() == 10
+        for spec in res.node_specs:
+            assert spec.capacity_type_options
+            assert len(spec.capacity_type_options) == 1
+
+    def test_alternatives_respect_zone_window(self, catalog, pool, solver_cls):
+        pods = make_pods(3, "z", {"cpu": "1"},
+                         node_selector={lbl.TOPOLOGY_ZONE: "zone-d"})
+        res = solver_cls().solve(pods, [pool], catalog)
+        for spec in res.node_specs:
+            for name in spec.instance_type_options:
+                it = catalog.get(name)
+                assert any(o.zone == "zone-d" and o.available for o in it.offerings), name
+
+
+class TestLimits:
+    def test_limits_cap_node_plan(self, catalog):
+        pool = NodePool(name="capped", limits=Limits.of(cpu=64))
+        pods = make_pods(400, "w", {"cpu": "1", "memory": "1Gi"})
+        res = TPUSolver().solve(pods, [pool], catalog)
+        total_vcpu = sum(
+            catalog.get(s.instance_type_options[0]).vcpus for s in res.node_specs
+        )
+        assert total_vcpu <= 64
+        assert res.unschedulable
+        assert "limit" in res.unschedulable[0][1]
+
+    def test_unlimited_by_default(self, catalog, pool):
+        pods = make_pods(50, "w", {"cpu": "1", "memory": "1Gi"})
+        res = TPUSolver().solve(pods, [pool], catalog)
+        assert not res.unschedulable
+
+    def test_in_use_counts_against_limit(self, catalog):
+        from karpenter_provider_aws_tpu.models.resources import ResourceVector
+
+        pool = NodePool(name="capped", limits=Limits.of(cpu=64))
+        pods = make_pods(4, "w", {"cpu": "1", "memory": "1Gi"})
+        in_use = {"capped": ResourceVector.from_map({"cpu": 64})}
+        res = TPUSolver().solve(pods, [pool], catalog, in_use=in_use)
+        assert res.pods_placed() == 0
+        assert len(res.unschedulable) == 4
+
+
+class TestMinValues:
+    def test_min_values_rejects_narrow_flexibility(self, catalog):
+        # Require >= 200 distinct families among options: impossible once
+        # truncated to 60 options -> pods unschedulable with a clear reason.
+        pool = NodePool(
+            name="flex",
+            requirements=[
+                Requirement(lbl.INSTANCE_FAMILY, Operator.EXISTS, min_values=200)
+            ],
+        )
+        pods = make_pods(3, "w", {"cpu": "1"})
+        res = TPUSolver().solve(pods, [pool], catalog)
+        assert res.pods_placed() == 0
+        assert "minValues" in res.unschedulable[0][1]
+
+    def test_min_values_satisfiable(self, catalog):
+        pool = NodePool(
+            name="flex",
+            requirements=[
+                Requirement(lbl.INSTANCE_FAMILY, Operator.EXISTS, min_values=3)
+            ],
+        )
+        pods = make_pods(3, "w", {"cpu": "1"})
+        res = TPUSolver().solve(pods, [pool], catalog)
+        assert res.pods_placed() == 3
+
+
+class TestNotInLabels:
+    def test_not_in_matches_types_without_label(self, catalog, pool):
+        # NotIn gpu-name t4 must NOT exclude CPU-only types (absent label
+        # satisfies NotIn per k8s semantics).
+        pods = make_pods(
+            2, "w", {"cpu": "1"},
+            node_affinity=[Requirement(lbl.INSTANCE_GPU_NAME, Operator.NOT_IN, ("t4",))],
+        )
+        res = TPUSolver().solve(pods, [pool], catalog)
+        assert res.pods_placed() == 2
+        it = catalog.get(res.node_specs[0].instance_type_options[0])
+        assert it.gpu_name != "t4"
+
+
+class TestICEFreshness:
+    def test_expired_ice_unmasks_tensor_snapshot(self, clock):
+        cat = CatalogProvider(clock=clock)
+        name = cat.names()[0]
+        cat.unavailable.mark_unavailable(name, cat.zones[0], "spot")
+        assert not cat.tensors().available[0, 0, 1]
+        clock.advance(181)  # past the 3m ICE TTL
+        # seq_num reflects expiry, so a fresh snapshot unmasks the offering
+        assert cat.tensors().available[0, 0, 1]
