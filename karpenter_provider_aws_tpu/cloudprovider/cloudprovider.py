@@ -1,0 +1,237 @@
+"""CloudProvider: the plugin between the control plane and the cloud.
+
+Create() parity with ``pkg/cloudprovider/cloudprovider.go:81-141`` +
+``pkg/providers/instance/instance.go:94-258``:
+ - nodeclass readiness gate (cloudprovider.go:90-93)
+ - ranked instance-type/offering options filtered by the ICE cache
+ - image resolution grouping by arch/accelerator (resolver.go:123-162)
+ - zonal subnet choice with in-flight IP accounting (subnet.go:133-234)
+ - launch via the request-coalescing batcher (createfleet.go:52-110)
+ - ICE errors classified into the unavailable-offerings cache
+   (instance.go:362-368) and surfaced to the caller
+ - instance -> NodeClaim status with labels + capacity
+   (cloudprovider.go:294-337 instanceToNodeClaim)
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Optional
+
+from ..catalog.provider import CatalogProvider
+from ..fake.cloud import LaunchRequest
+from ..models import labels as lbl
+from ..models.nodeclaim import NodeClaim
+from ..models.nodeclass import NodeClass
+from ..providers.images import ImageProvider, resolve_image_for
+from ..providers.instanceprofiles import InstanceProfileProvider
+from ..providers.securitygroups import SecurityGroupProvider
+from ..providers.subnets import SubnetProvider
+from ..utils import errors
+from ..utils.batcher import Batcher, BatcherOptions
+from ..utils.clock import Clock, RealClock
+
+MANAGED_TAG = "karpenter.tpu/managed"
+NODEPOOL_TAG = "karpenter.tpu/nodepool"
+NODECLAIM_TAG = "karpenter.tpu/nodeclaim"
+
+
+class DriftReason(str, enum.Enum):
+    NONE = ""
+    STATIC = "NodeClassHashDrifted"          # hash/controller.go parity
+    IMAGE = "ImageDrifted"                   # drift.go AMI drift
+    SUBNET = "SubnetDrifted"
+    SECURITY_GROUP = "SecurityGroupDrifted"
+
+
+class CloudProvider:
+    def __init__(
+        self,
+        cloud,
+        catalog: CatalogProvider,
+        cluster,
+        clock: Optional[Clock] = None,
+        batcher_options: Optional[BatcherOptions] = None,
+    ):
+        self.cloud = cloud
+        self.catalog = catalog
+        self.cluster = cluster
+        self.clock = clock or RealClock()
+        self.subnets = SubnetProvider(cloud, clock=clock)
+        self.security_groups = SecurityGroupProvider(cloud, clock=clock)
+        self.images = ImageProvider(cloud, clock=clock)
+        self.instance_profiles = InstanceProfileProvider(cloud, clock=clock)
+        opts = batcher_options or BatcherOptions()
+        self._fleet_batcher: Batcher = Batcher(self.cloud.create_fleet, options=opts)
+        self._terminate_batcher: Batcher = Batcher(
+            self.cloud.terminate_instances,
+            options=BatcherOptions(idle_timeout_s=opts.idle_timeout_s * 3,
+                                   max_timeout_s=opts.max_timeout_s, max_items=500),
+        )
+
+    # -- Create ------------------------------------------------------------
+    def create(self, claim: NodeClaim) -> NodeClaim:
+        nodeclass = self.cluster.nodeclasses.get(claim.nodeclass_name)
+        if nodeclass is None:
+            raise errors.NotFoundError(f"nodeclass {claim.nodeclass_name} not found")
+        if not nodeclass.status.is_ready():
+            raise errors.CloudError(
+                f"nodeclass {nodeclass.name} is not ready", code="NodeClassNotReady"
+            )
+
+        type_options = [
+            self.catalog.get(n) for n in claim.instance_type_options if self.catalog.get(n)
+        ]
+        if not type_options:
+            raise errors.CloudError("no instance type options", code="NoInstanceTypes")
+
+        # Image grouping: resolve for the best-ranked type, then keep only
+        # types the same image serves (arch/gpu grouping parity).
+        images = self.images.list(nodeclass)
+        image = resolve_image_for(images, type_options[0])
+        if image is None:
+            raise errors.CloudError(
+                f"no image for {type_options[0].name}", code="NoCompatibleImage"
+            )
+        type_options = [
+            t for t in type_options if resolve_image_for(images, t) is image
+        ]
+
+        # ICE-masked offering options (parity: offerings filtered against the
+        # unavailable cache before launch).
+        offerings = list(self._live_offerings(claim, [t.name for t in type_options]))
+        if not offerings:
+            raise errors.InsufficientCapacityError(
+                message="all candidate offerings are ICE-cached"
+            )
+
+        zones = sorted({z for z, _ in offerings})
+        subnet_by_zone = self.subnets.zonal_subnets_for_launch(nodeclass, zones)
+        offerings = [o for o in offerings if o[0] in subnet_by_zone]
+        if not offerings:
+            raise errors.CloudError("no subnet available in candidate zones", code="NoSubnets")
+        sgs = tuple(g.id for g in self.security_groups.list(nodeclass))
+
+        request = LaunchRequest(
+            instance_type_options=[t.name for t in type_options],
+            offering_options=offerings,
+            image_id=image.id,
+            subnet_by_zone=subnet_by_zone,
+            security_group_ids=sgs,
+            tags={
+                MANAGED_TAG: "true",
+                NODEPOOL_TAG: claim.nodepool_name,
+                NODECLAIM_TAG: claim.name,
+                **nodeclass.tags,
+            },
+        )
+        try:
+            result = self._fleet_batcher.add(request)
+        except Exception as e:
+            # give back every pre-deducted IP, then classify ICE into the
+            # unavailable cache so the next solve masks the offering
+            self.subnets.release_unused(subnet_by_zone, used_zone="")
+            if errors.is_unfulfillable_capacity(e) and getattr(e, "instance_type", ""):
+                self.catalog.unavailable.mark_unavailable(
+                    e.instance_type, e.zone, e.capacity_type
+                )
+            raise
+        self.subnets.release_unused(subnet_by_zone, result.zone)
+        return self._instance_to_claim(claim, result, nodeclass)
+
+    def _live_offerings(self, claim: NodeClaim, type_names):
+        """(zone, captype) pairs from the claim not ICE-masked for at least
+        one candidate type, ranked spot-first-cheapest like CreateFleet."""
+        pairs = claim.capacity_type_options or [lbl.CAPACITY_TYPE_ON_DEMAND]
+        zones = claim.zone_options or list(self.catalog.zones)
+        joint = getattr(claim, "offering_options", None) or [
+            (z, ct) for z in zones for ct in pairs
+        ]
+        for zone, captype in sorted(joint, key=lambda o: 0 if o[1] == lbl.CAPACITY_TYPE_SPOT else 1):
+            if any(
+                not self.catalog.unavailable.is_unavailable(t, zone, captype)
+                for t in type_names
+            ):
+                yield (zone, captype)
+
+    def _instance_to_claim(self, claim: NodeClaim, inst, nodeclass: NodeClass) -> NodeClaim:
+        it = self.catalog.get(inst.instance_type)
+        claim.status.provider_id = inst.provider_id
+        claim.status.image_id = inst.image_id
+        claim.status.capacity = it.capacity()
+        claim.status.allocatable = self.catalog.allocatable(it)
+        claim.labels.update(it.labels())
+        claim.labels[lbl.TOPOLOGY_ZONE] = inst.zone
+        claim.labels[lbl.CAPACITY_TYPE] = inst.capacity_type
+        claim.labels[lbl.NODEPOOL] = claim.nodepool_name
+        claim.annotations.update(nodeclass.hash_annotations())
+        claim.created_at = self.clock.now()
+        claim.finalizers.add("karpenter.tpu/termination")
+        claim.status.set_condition("Launched", True)
+        return claim
+
+    # -- Delete / Get / List ----------------------------------------------
+    def delete(self, claim: NodeClaim) -> None:
+        instance_id = parse_provider_id(claim.status.provider_id)
+        if instance_id is None:
+            raise errors.NotFoundError(f"claim {claim.name} has no provider id")
+        self._terminate_batcher.add(instance_id)
+
+    def reset_caches(self) -> None:
+        """Test-environment hook: drop every provider-side cache."""
+        self.subnets.reset()
+        self.security_groups.reset()
+        self.images.reset()
+        self.instance_profiles.reset()
+
+    def get(self, provider_id: str):
+        instance_id = parse_provider_id(provider_id)
+        if instance_id is None:
+            raise errors.NotFoundError(f"bad provider id {provider_id}")
+        return self.cloud.get_instance(instance_id)
+
+    def list_instances(self):
+        """All managed, non-terminated instances (parity: instance.go List
+        by karpenter tag)."""
+        return self.cloud.list_instances({MANAGED_TAG: "true"})
+
+    # -- GetInstanceTypes --------------------------------------------------
+    def get_instance_types(self, nodepool) -> list:
+        """The scheduler's device catalog for one nodepool (parity:
+        cloudprovider.go:154-171); the heavy lifting is the catalog tensor
+        cache keyed by seqnums."""
+        return self.catalog.list()
+
+    # -- IsDrifted ---------------------------------------------------------
+    def is_drifted(self, claim: NodeClaim) -> DriftReason:
+        nodeclass = self.cluster.nodeclasses.get(claim.nodeclass_name)
+        if nodeclass is None:
+            return DriftReason.NONE
+        # static drift: stamped hash vs current spec hash (drift.go:41-60)
+        stamped = claim.annotations.get(lbl.ANNOTATION_NODECLASS_HASH)
+        if stamped is not None and stamped != nodeclass.hash():
+            return DriftReason.STATIC
+        try:
+            inst = self.get(claim.status.provider_id)
+        except Exception:
+            return DriftReason.NONE
+        # image drift: running image no longer among resolved images
+        images = {i.id for i in self.images.list(nodeclass)}
+        if images and inst.image_id not in images:
+            return DriftReason.IMAGE
+        # subnet drift / security-group drift vs current discovery
+        subnet_ids = {s.id for s in self.subnets.list(nodeclass)}
+        if inst.subnet_id and inst.subnet_id not in subnet_ids:
+            return DriftReason.SUBNET
+        sg_ids = {g.id for g in self.security_groups.list(nodeclass)}
+        if inst.security_group_ids and not set(inst.security_group_ids) <= sg_ids:
+            return DriftReason.SECURITY_GROUP
+        return DriftReason.NONE
+
+
+def parse_provider_id(provider_id: str) -> Optional[str]:
+    """cloud:///zone/i-... -> i-... (parity: utils.go:26-40 ParseInstanceID)."""
+    if not provider_id:
+        return None
+    parts = provider_id.rsplit("/", 1)
+    return parts[-1] if parts[-1].startswith("i-") else None

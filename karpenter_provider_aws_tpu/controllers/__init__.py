@@ -1,0 +1,16 @@
+"""Reconcile loops (reference L2: ``pkg/controllers`` + the core
+provisioner/disruption controllers this framework owns itself).
+
+All controllers are level-triggered ``reconcile()`` callables driven by the
+Manager (or called directly in tests, mirroring the reference's hermetic
+suites driving Reconcile by hand).
+"""
+
+from .base import Controller, Manager  # noqa: F401
+from .provisioning import ProvisioningController  # noqa: F401
+from .registration import RegistrationController  # noqa: F401
+from .garbagecollection import GarbageCollectionController  # noqa: F401
+from .tagging import TaggingController  # noqa: F401
+from .nodeclass_hash import NodeClassHashController  # noqa: F401
+from .nodeclass_status import NodeClassStatusController  # noqa: F401
+from .nodeclass_termination import NodeClassTerminationController  # noqa: F401

@@ -1,0 +1,55 @@
+"""Controller protocol + Manager runtime.
+
+The reference rides controller-runtime (reconcile loops with
+MaxConcurrentReconciles, singleton controllers with requeue intervals —
+SURVEY.md section 2.3). Here a controller is a named ``reconcile()``
+callable with an interval; the Manager runs each on its own thread.
+Tests call ``reconcile()`` directly for determinism (the reference's
+hermetic suites do exactly this with Reconcile()).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional, Protocol
+
+log = logging.getLogger("karpenter.tpu")
+
+
+class Controller(Protocol):
+    name: str
+    interval_s: float
+
+    def reconcile(self) -> None: ...
+
+
+class Manager:
+    def __init__(self, controllers: list[Controller]):
+        self.controllers = list(controllers)
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    def start(self) -> None:
+        for c in self.controllers:
+            t = threading.Thread(target=self._run, args=(c,), daemon=True, name=c.name)
+            self._threads.append(t)
+            t.start()
+
+    def _run(self, c: Controller) -> None:
+        while not self._stop.is_set():
+            try:
+                c.reconcile()
+            except Exception:
+                log.exception("controller %s reconcile failed", c.name)
+            self._stop.wait(c.interval_s)
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=timeout)
+
+    def reconcile_all_once(self) -> None:
+        """Deterministic single pass in registration order (test helper)."""
+        for c in self.controllers:
+            c.reconcile()

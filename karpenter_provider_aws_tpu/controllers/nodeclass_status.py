@@ -1,0 +1,50 @@
+"""NodeClass status controller: resolve spec selectors -> status + readiness.
+
+Parity: ``pkg/controllers/nodeclass/status/controller.go:70-106`` —
+sequential sub-reconcilers for subnets, security groups, images, instance
+profile, then the readiness condition; adds the termination finalizer.
+"""
+
+from __future__ import annotations
+
+from ..cloudprovider.cloudprovider import CloudProvider
+from ..state.cluster import Cluster
+
+FINALIZER = "karpenter.tpu/termination"
+
+
+class NodeClassStatusController:
+    name = "nodeclass-status"
+    interval_s = 10.0
+
+    def __init__(self, cluster: Cluster, cloudprovider: CloudProvider):
+        self.cluster = cluster
+        self.cloudprovider = cloudprovider
+
+    def reconcile(self) -> None:
+        for nc in list(self.cluster.nodeclasses.values()):
+            if nc.deleted:
+                continue
+            nc.finalizers.add(FINALIZER)
+            nc.status.subnets = self.cloudprovider.subnets.list(nc)
+            nc.status.security_groups = self.cloudprovider.security_groups.list(nc)
+            nc.status.images = self.cloudprovider.images.list(nc)
+            if nc.role or nc.instance_profile:
+                nc.status.instance_profile = self.cloudprovider.instance_profiles.create(nc)
+
+            missing = [
+                what
+                for what, got in (
+                    ("subnets", nc.status.subnets),
+                    ("security groups", nc.status.security_groups),
+                    ("images", nc.status.images),
+                )
+                if not got
+            ]
+            if missing:
+                nc.status.set_condition(
+                    "Ready", False, reason="ResolutionFailed",
+                    message=f"unresolved: {', '.join(missing)}",
+                )
+            else:
+                nc.status.set_condition("Ready", True)

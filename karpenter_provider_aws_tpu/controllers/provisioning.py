@@ -1,0 +1,117 @@
+"""Provisioning controller: pending pods -> solver -> NodeClaims -> launch.
+
+This owns what the reference consumes from the core provisioner
+(SURVEY.md section 3.2): batch pending pods, run the Solve, create
+NodeClaims, drive CloudProvider.Create, and handle ICE failures by deleting
+the claim so the next pass re-plans against the updated unavailable-
+offerings mask (the failure-plane feedback loop of SURVEY.md section 5).
+
+Launches run on a small worker pool so concurrent CloudProvider.Create
+calls land in one coalesced fleet batch (parity: createfleet.go windows —
+a serial loop would defeat the batcher entirely).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+from ..cloudprovider.cloudprovider import CloudProvider
+from ..models.nodeclaim import NodeClaim
+from ..scheduling.solver import NodeSpec, Solver
+from ..state.cluster import Cluster
+
+log = logging.getLogger("karpenter.tpu.provisioning")
+
+MAX_LAUNCH_WORKERS = 10  # parity: reconcile worker-pool width (SURVEY 2.3)
+
+
+class ProvisioningController:
+    name = "provisioning"
+    interval_s = 10.0
+
+    def __init__(self, cluster: Cluster, solver: Solver, cloudprovider: CloudProvider):
+        self.cluster = cluster
+        self.solver = solver
+        self.cloudprovider = cloudprovider
+        # pod uid -> claim name nominations (kube-scheduler binds for real;
+        # the registration controller honors these on node readiness)
+        self.nominations: dict[str, str] = {}
+        self._nominations_lock = threading.Lock()
+        self.last_unschedulable: list = []
+
+    def reconcile(self) -> None:
+        self._prune_stale_nominations()
+        with self._nominations_lock:
+            nominated = set(self.nominations)
+        pending = [p for p in self.cluster.pending_pods() if p.uid not in nominated]
+        if not pending:
+            return
+        nodepools = list(self.cluster.nodepools.values())
+        if not nodepools:
+            return
+        result = self.solver.solve(
+            pending,
+            nodepools,
+            self.cloudprovider.catalog,
+            in_use=self.cluster.in_use_by_nodepool(),
+        )
+        self.last_unschedulable = result.unschedulable
+        for pod, reason in result.unschedulable:
+            log.info("pod %s unschedulable: %s", pod.name, reason)
+        specs = result.node_specs
+        if not specs:
+            return
+        if len(specs) == 1:
+            self._launch(specs[0])
+        else:
+            with ThreadPoolExecutor(max_workers=min(MAX_LAUNCH_WORKERS, len(specs))) as pool:
+                list(pool.map(self._launch, specs))
+
+    def _prune_stale_nominations(self) -> None:
+        """Drop nominations whose claim died before binding, so their pods
+        re-enter the next solve instead of pending forever."""
+        claims = {c.name: c for c in self.cluster.snapshot_claims()}
+        with self._nominations_lock:
+            self.nominations = {
+                uid: cn
+                for uid, cn in self.nominations.items()
+                if cn in claims and not claims[cn].deleted
+            }
+
+    def _launch(self, spec: NodeSpec) -> None:
+        pool = self.cluster.nodepools.get(spec.nodepool_name)
+        if pool is None:
+            return
+        claim = NodeClaim.fresh(
+            nodepool_name=spec.nodepool_name,
+            nodeclass_name=pool.nodeclass_name,
+            instance_type_options=spec.instance_type_options,
+            zone_options=spec.zone_options,
+            capacity_type_options=spec.capacity_type_options,
+            offering_options=list(spec.offering_options),
+            taints=list(pool.taints),
+            startup_taints=list(pool.startup_taints),
+        )
+        self.cluster.apply(claim)
+        try:
+            self.cloudprovider.create(claim)
+        except Exception as e:
+            # ICE or launch failure: drop the claim; the unavailable cache
+            # now masks the offering, so the next solve re-plans around it
+            # (parity: instance.go:362-368 + provisioner retry).
+            log.warning("launch failed for %s: %s", claim.name, e)
+            self.cluster.finalize(claim)
+            self.cluster.delete(claim)
+            return
+        with self._nominations_lock:
+            for pod in spec.pods:
+                self.nominations[pod.uid] = claim.name
+
+    def forget_nominations_for(self, claim_name: str) -> None:
+        with self._nominations_lock:
+            self.nominations = {
+                uid: c for uid, c in self.nominations.items() if c != claim_name
+            }

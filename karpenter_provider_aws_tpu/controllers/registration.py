@@ -1,0 +1,80 @@
+"""Registration controller: launched NodeClaims -> ready Nodes -> bound pods.
+
+Stands in for the kubelet + core NodeClaim lifecycle controllers
+(SURVEY.md section 2.2 "NodePool/NodeClaim lifecycle"): a launched claim
+registers a Node carrying the claim's labels, flips Registered/Initialized,
+clears startup taints, and binds nominated pods (the fake analogue of
+kube-scheduler honoring the provisioner's nomination).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..models import labels as lbl
+from ..state.cluster import Cluster, Node
+from ..utils.clock import Clock, RealClock
+
+
+class RegistrationController:
+    name = "registration"
+    interval_s = 1.0
+
+    def __init__(self, cluster: Cluster, provisioning=None, clock: Optional[Clock] = None):
+        self.cluster = cluster
+        self.provisioning = provisioning
+        self.clock = clock or RealClock()
+
+    def reconcile(self) -> None:
+        for claim in list(self.cluster.nodeclaims.values()):
+            if claim.deleted or not claim.is_launched():
+                continue
+            if not claim.is_registered():
+                # registration: node joins carrying pool taints + startup
+                # taints (the reference injects startupTaints at launch)
+                node = Node(
+                    name=f"node-{claim.name}",
+                    provider_id=claim.status.provider_id,
+                    nodepool_name=claim.nodepool_name,
+                    nodeclaim_name=claim.name,
+                    labels=dict(claim.labels),
+                    annotations=dict(claim.annotations),
+                    taints=list(claim.taints) + list(claim.startup_taints),
+                    capacity=claim.status.capacity,
+                    allocatable=claim.status.allocatable,
+                    ready=True,
+                    created_at=self.clock.now(),
+                )
+                node.labels[lbl.HOSTNAME] = node.name
+                self.cluster.apply(node)
+                claim.status.node_name = node.name
+                claim.status.set_condition("Registered", True)
+            if not claim.is_initialized():
+                # initialization: startup taints are expected to be cleared
+                # by their owners (CNI etc.); the fake kubelet clears them
+                # here, leaving only the permanent pool taints.
+                node = self.cluster.nodes.get(claim.status.node_name)
+                if node is not None:
+                    startup = {(t.key, t.value, t.effect) for t in claim.startup_taints}
+                    node.taints = [
+                        t for t in node.taints if (t.key, t.value, t.effect) not in startup
+                    ]
+                claim.status.set_condition("Initialized", True)
+            self._bind_nominated(claim)
+
+    def _bind_nominated(self, claim) -> None:
+        if self.provisioning is None:
+            return
+        node_name = claim.status.node_name
+        with self.provisioning._nominations_lock:
+            mine = [
+                uid
+                for uid, claim_name in self.provisioning.nominations.items()
+                if claim_name == claim.name
+            ]
+            for uid in mine:
+                del self.provisioning.nominations[uid]
+        for uid in mine:
+            pod = self.cluster.pods.get(uid)
+            if pod is not None and pod.is_pending():
+                self.cluster.bind_pod(uid, node_name)

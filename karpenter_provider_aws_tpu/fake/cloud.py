@@ -1,0 +1,263 @@
+"""FakeCloud: the EC2-shaped in-memory backend.
+
+Parity map (pkg/fake/ec2api.go):
+ - ``EC2Behavior`` programmable outputs / recorded inputs -> ``calls`` +
+   ``next_errors``
+ - ``sync.Map`` instance store -> ``instances`` dict under a lock
+ - ``InsufficientCapacityPools`` -> ``ice_pools`` (set of
+   (capacity_type, instance_type, zone) triples) honored by create_fleet
+   (ec2api.go:112-160 CreateFleet simulation)
+ - capacity_pools: optional finite pool sizes, decremented per launch
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..utils.clock import Clock, RealClock
+from ..utils.errors import (
+    InsufficientCapacityError,
+    NotFoundError,
+)
+
+_ids = itertools.count(1000)
+
+
+@dataclass
+class Subnet:
+    id: str
+    zone: str
+    available_ips: int = 8192
+    tags: dict[str, str] = field(default_factory=dict)
+    public: bool = False
+
+
+@dataclass
+class SecurityGroup:
+    id: str
+    name: str = ""
+    tags: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Image:
+    id: str
+    name: str
+    family: str = "standard"        # image-family alias (AMI family analogue)
+    arch: str = "amd64"
+    gpu: bool = False
+    created_seq: int = 0            # newest-first ordering key
+    deprecated: bool = False
+    tags: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Instance:
+    id: str
+    instance_type: str
+    zone: str
+    capacity_type: str
+    image_id: str
+    subnet_id: str = ""
+    security_group_ids: tuple[str, ...] = ()
+    state: str = "running"          # pending | running | shutting-down | terminated
+    launch_time: float = 0.0
+    tags: dict[str, str] = field(default_factory=dict)
+
+    @property
+    def provider_id(self) -> str:
+        return f"cloud:///{self.zone}/{self.id}"
+
+
+@dataclass
+class LaunchRequest:
+    """One logical single-node launch; the batcher coalesces many of these
+    into one fleet call (parity: createfleet.go:52-110)."""
+
+    instance_type_options: list[str]          # ranked cheapest-first
+    offering_options: list[tuple[str, str]]   # launchable (zone, captype)
+    image_id: str
+    subnet_by_zone: dict[str, str] = field(default_factory=dict)
+    security_group_ids: tuple[str, ...] = ()
+    tags: dict[str, str] = field(default_factory=dict)
+
+
+class FakeCloud:
+    def __init__(self, clock: Optional[Clock] = None, zones=("zone-a", "zone-b", "zone-c", "zone-d")):
+        self.clock = clock or RealClock()
+        self._lock = threading.RLock()
+        self.zones = tuple(zones)
+        self.subnets: list[Subnet] = [
+            Subnet(id=f"subnet-{i}", zone=z, tags={"discovery": "cluster-1"})
+            for i, z in enumerate(zones)
+        ]
+        self.security_groups: list[SecurityGroup] = [
+            SecurityGroup(id="sg-1", name="default", tags={"discovery": "cluster-1"}),
+        ]
+        self.images: list[Image] = [
+            Image(id="img-std-2", name="standard-v2", family="standard", arch="amd64", created_seq=2),
+            Image(id="img-std-arm-2", name="standard-arm-v2", family="standard", arch="arm64", created_seq=2),
+            Image(id="img-std-1", name="standard-v1", family="standard", arch="amd64", created_seq=1),
+            Image(id="img-gpu-1", name="gpu-v1", family="gpu", arch="amd64", gpu=True, created_seq=1),
+            Image(id="img-min-1", name="minimal-v1", family="minimal", arch="amd64", created_seq=1),
+            Image(id="img-min-arm-1", name="minimal-arm-v1", family="minimal", arch="arm64", created_seq=1),
+        ]
+        self.instances: dict[str, Instance] = {}
+        self.instance_profiles: dict[str, dict] = {}
+        # Fault injection
+        self.ice_pools: set[tuple[str, str, str]] = set()   # (captype, type, zone)
+        self.capacity_pools: dict[tuple[str, str, str], int] = {}
+        self.next_errors: list[Exception] = []
+        # Recorded inputs per API name
+        self.calls: dict[str, list] = {}
+
+    # -- bookkeeping -------------------------------------------------------
+    def _record(self, api: str, payload) -> None:
+        self.calls.setdefault(api, []).append(payload)
+
+    def _maybe_fail(self):
+        if self.next_errors:
+            raise self.next_errors.pop(0)
+
+    def reset(self) -> None:
+        """Between-spec reset (parity: pkg/test/environment.go:168-197)."""
+        with self._lock:
+            self.instances.clear()
+            self.instance_profiles.clear()
+            self.ice_pools.clear()
+            self.capacity_pools.clear()
+            self.next_errors.clear()
+            self.calls.clear()
+
+    # -- fleet launch ------------------------------------------------------
+    def create_fleet(self, requests: list[LaunchRequest]) -> list:
+        """Launch one instance per request; per-request ICE errors are
+        returned positionally (the batcher scatters them back to callers)."""
+        with self._lock:
+            self._record("create_fleet", requests)
+            self._maybe_fail()
+            results = []
+            for req in requests:
+                results.append(self._launch_one(req))
+            return results
+
+    def _launch_one(self, req: LaunchRequest):
+        # Walk ranked (type, offering) choices; first non-ICE pool wins —
+        # mirrors CreateFleet's lowest-price allocation honoring ICE pools.
+        last_ice = None
+        for itype in req.instance_type_options:
+            for zone, captype in req.offering_options:
+                pool = (captype, itype, zone)
+                if pool in self.ice_pools:
+                    last_ice = pool
+                    continue
+                remaining = self.capacity_pools.get(pool)
+                if remaining is not None:
+                    if remaining <= 0:
+                        last_ice = pool
+                        continue
+                    self.capacity_pools[pool] = remaining - 1
+                inst = Instance(
+                    id=f"i-{next(_ids):08x}",
+                    instance_type=itype,
+                    zone=zone,
+                    capacity_type=captype,
+                    image_id=req.image_id,
+                    subnet_id=req.subnet_by_zone.get(zone, ""),
+                    security_group_ids=req.security_group_ids,
+                    launch_time=self.clock.now(),
+                    tags=dict(req.tags),
+                )
+                self.instances[inst.id] = inst
+                return inst
+        if last_ice is not None:
+            captype, itype, zone = last_ice
+            return InsufficientCapacityError(instance_type=itype, zone=zone, capacity_type=captype)
+        return InsufficientCapacityError(message="no launchable offering in request")
+
+    # -- instance APIs -----------------------------------------------------
+    def describe_instances(self, ids: list[str]) -> list[Instance]:
+        with self._lock:
+            self._record("describe_instances", list(ids))
+            self._maybe_fail()
+            return [self.instances[i] for i in ids if i in self.instances]
+
+    def list_instances(self, tag_filters: Optional[dict[str, str]] = None) -> list[Instance]:
+        with self._lock:
+            self._record("list_instances", tag_filters or {})
+            self._maybe_fail()
+            out = []
+            for inst in self.instances.values():
+                if inst.state == "terminated":
+                    continue
+                if tag_filters and not all(
+                    (v == "*" and k in inst.tags) or inst.tags.get(k) == v
+                    for k, v in tag_filters.items()
+                ):
+                    continue
+                out.append(inst)
+            return out
+
+    def terminate_instances(self, ids: list[str]) -> list:
+        with self._lock:
+            self._record("terminate_instances", list(ids))
+            self._maybe_fail()
+            results = []
+            for i in ids:
+                inst = self.instances.get(i)
+                if inst is None:
+                    results.append(NotFoundError(f"instance {i} not found"))
+                else:
+                    inst.state = "terminated"
+                    results.append(inst)
+            return results
+
+    def get_instance(self, instance_id: str) -> Instance:
+        with self._lock:
+            inst = self.instances.get(instance_id)
+            if inst is None or inst.state == "terminated":
+                raise NotFoundError(f"instance {instance_id} not found")
+            return inst
+
+    def tag_instance(self, instance_id: str, tags: dict[str, str]) -> None:
+        with self._lock:
+            self._record("tag_instance", (instance_id, dict(tags)))
+            self._maybe_fail()
+            self.get_instance(instance_id).tags.update(tags)
+
+    # -- discovery APIs ----------------------------------------------------
+    def describe_subnets(self) -> list[Subnet]:
+        with self._lock:
+            self._record("describe_subnets", None)
+            self._maybe_fail()
+            return list(self.subnets)
+
+    def describe_security_groups(self) -> list[SecurityGroup]:
+        with self._lock:
+            self._record("describe_security_groups", None)
+            self._maybe_fail()
+            return list(self.security_groups)
+
+    def describe_images(self) -> list[Image]:
+        with self._lock:
+            self._record("describe_images", None)
+            self._maybe_fail()
+            return [i for i in self.images if not i.deprecated]
+
+    # -- instance profiles (IAM analogue) ----------------------------------
+    def create_instance_profile(self, name: str, role: str, tags: dict[str, str]) -> None:
+        with self._lock:
+            self._record("create_instance_profile", (name, role))
+            self._maybe_fail()
+            self.instance_profiles.setdefault(name, {"role": role, "tags": dict(tags)})
+
+    def delete_instance_profile(self, name: str) -> None:
+        with self._lock:
+            self._record("delete_instance_profile", name)
+            self._maybe_fail()
+            if name not in self.instance_profiles:
+                raise NotFoundError(f"instance profile {name} not found", code="NoSuchEntity")
+            del self.instance_profiles[name]

@@ -47,6 +47,7 @@ class NodeClaim:
     labels: dict[str, str] = field(default_factory=dict)
     annotations: dict[str, str] = field(default_factory=dict)
     taints: list = field(default_factory=list)
+    startup_taints: list = field(default_factory=list)
     created_at: float = 0.0
     deleted: bool = False
     finalizers: set[str] = field(default_factory=set)
@@ -57,6 +58,7 @@ class NodeClaim:
     instance_type_options: list[str] = field(default_factory=list)
     capacity_type_options: list[str] = field(default_factory=list)
     zone_options: list[str] = field(default_factory=list)
+    offering_options: list[tuple] = field(default_factory=list)  # joint (zone, captype)
 
     @staticmethod
     def fresh(nodepool_name: str, nodeclass_name: str = "default", **kw) -> "NodeClaim":

@@ -1,0 +1,10 @@
+"""Cluster state: the in-memory model of nodepools, claims, nodes and pods.
+
+Owns what the reference consumes from the core library's ``state.NewCluster``
+(SURVEY.md section 2.2): a thread-safe view of the cluster that controllers
+reconcile against. Level-triggered like the reference — everything here is
+re-derivable from the stores, there is no event log to replay
+(checkpoint/resume parity: SURVEY.md section 5).
+"""
+
+from .cluster import Cluster, Node  # noqa: F401

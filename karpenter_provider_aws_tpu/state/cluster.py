@@ -1,0 +1,149 @@
+"""The Cluster store + Node model."""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..models import labels as lbl
+from ..models.nodeclaim import NodeClaim
+from ..models.nodeclass import NodeClass
+from ..models.nodepool import NodePool
+from ..models.pod import Pod
+from ..models.resources import ResourceVector
+
+
+@dataclass
+class Node:
+    name: str
+    provider_id: str = ""
+    nodepool_name: str = ""
+    nodeclaim_name: str = ""
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    taints: list = field(default_factory=list)
+    capacity: ResourceVector = field(default_factory=ResourceVector)
+    allocatable: ResourceVector = field(default_factory=ResourceVector)
+    ready: bool = False
+    cordoned: bool = False
+    created_at: float = 0.0
+
+    def zone(self) -> str:
+        return self.labels.get(lbl.TOPOLOGY_ZONE, "")
+
+    def capacity_type(self) -> str:
+        return self.labels.get(lbl.CAPACITY_TYPE, "")
+
+    def instance_type(self) -> str:
+        return self.labels.get(lbl.INSTANCE_TYPE_LABEL, "")
+
+
+class Cluster:
+    """Thread-safe object store with the handful of indexed views the
+    controllers need. All mutation goes through methods so tests can observe
+    ordering; watches are replaced by level-triggered re-listing."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.nodepools: dict[str, NodePool] = {}
+        self.nodeclasses: dict[str, NodeClass] = {}
+        self.nodeclaims: dict[str, NodeClaim] = {}
+        self.nodes: dict[str, Node] = {}
+        self.pods: dict[str, Pod] = {}
+
+    # -- apply/delete ------------------------------------------------------
+    def apply(self, obj) -> None:
+        with self._lock:
+            if isinstance(obj, NodePool):
+                self.nodepools[obj.name] = obj
+            elif isinstance(obj, NodeClass):
+                self.nodeclasses[obj.name] = obj
+            elif isinstance(obj, NodeClaim):
+                self.nodeclaims[obj.name] = obj
+            elif isinstance(obj, Node):
+                self.nodes[obj.name] = obj
+            elif isinstance(obj, Pod):
+                self.pods[obj.uid] = obj
+            else:
+                raise TypeError(f"unknown object {type(obj)}")
+
+    def delete(self, obj) -> None:
+        with self._lock:
+            if isinstance(obj, NodePool):
+                self.nodepools.pop(obj.name, None)
+            elif isinstance(obj, NodeClass):
+                if obj.finalizers:
+                    obj.deleted = True  # finalizer semantics: mark, don't drop
+                else:
+                    self.nodeclasses.pop(obj.name, None)
+            elif isinstance(obj, NodeClaim):
+                if obj.finalizers:
+                    obj.deleted = True
+                else:
+                    self.nodeclaims.pop(obj.name, None)
+            elif isinstance(obj, Node):
+                self.nodes.pop(obj.name, None)
+            elif isinstance(obj, Pod):
+                self.pods.pop(obj.uid, None)
+            else:
+                raise TypeError(f"unknown object {type(obj)}")
+
+    def finalize(self, obj) -> None:
+        """Remove finalizers and drop the (already deleted-marked) object."""
+        with self._lock:
+            obj.finalizers.clear()
+            if isinstance(obj, NodeClaim):
+                self.nodeclaims.pop(obj.name, None)
+            elif isinstance(obj, NodeClass):
+                self.nodeclasses.pop(obj.name, None)
+
+    # -- views -------------------------------------------------------------
+    def pending_pods(self) -> list[Pod]:
+        with self._lock:
+            return [p for p in self.pods.values() if p.is_pending()]
+
+    def bind_pod(self, pod_uid: str, node_name: str) -> None:
+        with self._lock:
+            pod = self.pods[pod_uid]
+            pod.node_name = node_name
+            pod.phase = "Running"
+
+    def pods_on_node(self, node_name: str) -> list[Pod]:
+        with self._lock:
+            return [p for p in self.pods.values() if p.node_name == node_name]
+
+    def node_by_provider_id(self, provider_id: str) -> Optional[Node]:
+        with self._lock:
+            for n in self.nodes.values():
+                if n.provider_id == provider_id:
+                    return n
+            return None
+
+    def claims_for_nodepool(self, nodepool_name: str) -> list[NodeClaim]:
+        with self._lock:
+            return [c for c in self.nodeclaims.values() if c.nodepool_name == nodepool_name]
+
+    def claims_for_nodeclass(self, nodeclass_name: str) -> list[NodeClaim]:
+        with self._lock:
+            return [c for c in self.nodeclaims.values() if c.nodeclass_name == nodeclass_name]
+
+    def in_use_by_nodepool(self) -> dict[str, ResourceVector]:
+        """Capacity accounted against each NodePool's limits — launched
+        claims count whether or not their node has registered yet."""
+        with self._lock:
+            out: dict[str, ResourceVector] = {}
+            for claim in self.nodeclaims.values():
+                if claim.deleted or not claim.is_launched():
+                    continue
+                acc = out.setdefault(claim.nodepool_name, ResourceVector())
+                out[claim.nodepool_name] = acc + claim.status.capacity
+            return out
+
+    def snapshot_nodes(self) -> list[Node]:
+        with self._lock:
+            return list(self.nodes.values())
+
+    def snapshot_claims(self) -> list[NodeClaim]:
+        with self._lock:
+            return list(self.nodeclaims.values())

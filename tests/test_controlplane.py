@@ -1,0 +1,260 @@
+"""Control-plane behavior: provisioning end-to-end against the fake cloud,
+ICE feedback, GC, tagging, drift, nodeclass lifecycle.
+
+Mirrors the reference's hermetic suites (pkg/cloudprovider/suite_test.go,
+pkg/controllers/* suites) driving Reconcile by hand against pkg/fake."""
+
+import pytest
+
+from karpenter_provider_aws_tpu.cloudprovider.cloudprovider import (
+    DriftReason,
+    MANAGED_TAG,
+)
+from karpenter_provider_aws_tpu.models import NodePool, Operator, Requirement
+from karpenter_provider_aws_tpu.models import labels as lbl
+from karpenter_provider_aws_tpu.models.nodeclass import NodeClass, SelectorTerm
+from karpenter_provider_aws_tpu.models.pod import make_pods
+from karpenter_provider_aws_tpu.testenv import new_environment
+
+
+@pytest.fixture(scope="module")
+def env():
+    return new_environment()
+
+
+@pytest.fixture(autouse=True)
+def _reset(env):
+    env.reset()
+    yield
+
+
+def cmr_pool():
+    return NodePool(
+        name="default",
+        requirements=[Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m", "r"))],
+    )
+
+
+class TestProvisioningE2E:
+    def test_pending_pods_become_running_nodes(self, env):
+        env.apply_defaults(cmr_pool())
+        for p in make_pods(40, "web", {"cpu": "500m", "memory": "1Gi"}):
+            env.cluster.apply(p)
+        env.step(2)
+        assert not env.cluster.pending_pods()
+        assert len(env.cluster.nodes) >= 1
+        for node in env.cluster.nodes.values():
+            assert node.ready
+            assert node.labels[lbl.NODEPOOL] == "default"
+            assert node.labels[lbl.INSTANCE_TYPE_LABEL]
+        # every claim launched a real cloud instance
+        for claim in env.cluster.nodeclaims.values():
+            inst = env.cloudprovider.get(claim.status.provider_id)
+            assert inst.state == "running"
+            assert inst.tags[MANAGED_TAG] == "true"
+
+    def test_pods_bound_to_their_nominated_nodes(self, env):
+        env.apply_defaults(cmr_pool())
+        pods = make_pods(10, "w", {"cpu": "1", "memory": "2Gi"})
+        for p in pods:
+            env.cluster.apply(p)
+        env.step(2)
+        for p in pods:
+            assert env.cluster.pods[p.uid].node_name != ""
+
+    def test_no_nodepool_no_nodes(self, env):
+        for p in make_pods(3, "w", {"cpu": "1"}):
+            env.cluster.apply(p)
+        env.step(2)
+        assert len(env.cluster.nodes) == 0
+
+    def test_not_ready_nodeclass_blocks_launch(self, env):
+        nodeclass = NodeClass(
+            name="default", role="r",
+            subnet_selector=[SelectorTerm.of(id="subnet-does-not-exist")],
+        )
+        env.cluster.apply(nodeclass)
+        env.cluster.apply(cmr_pool())
+        env.nodeclass_status.reconcile()
+        assert not env.cluster.nodeclasses["default"].status.is_ready()
+        for p in make_pods(2, "w", {"cpu": "1"}):
+            env.cluster.apply(p)
+        env.step(2)
+        assert len(env.cluster.nodes) == 0
+        assert env.cluster.pending_pods()
+
+    def test_pool_limits_respected_with_existing_capacity(self, env):
+        from karpenter_provider_aws_tpu.models import Limits
+
+        pool = cmr_pool()
+        pool.limits = Limits.of(cpu=200)
+        env.apply_defaults(pool)
+        for p in make_pods(50, "w", {"cpu": "2", "memory": "2Gi"}):
+            env.cluster.apply(p)
+        env.step(3)
+        total_vcpu = sum(
+            env.catalog.get(n.instance_type()).vcpus for n in env.cluster.nodes.values()
+        )
+        assert total_vcpu <= 200
+
+
+class TestICEFeedback:
+    def test_ice_launch_retries_alternative(self, env):
+        env.apply_defaults(cmr_pool())
+        pods = make_pods(5, "w", {"cpu": "2", "memory": "4Gi"})
+        for p in pods:
+            env.cluster.apply(p)
+        # First pass: find what the solver wants, then dry it up everywhere.
+        result = env.solver.solve(pods, [env.cluster.nodepools["default"]], env.catalog)
+        first_choice = result.node_specs[0].instance_type_options[0]
+        for z in env.catalog.zones:
+            for ct in lbl.CAPACITY_TYPES:
+                env.cloud.ice_pools.add((ct, first_choice, z))
+        env.step(3)
+        assert not env.cluster.pending_pods()
+        used_types = {n.instance_type() for n in env.cluster.nodes.values()}
+        assert first_choice not in used_types
+
+    def test_fleet_ice_populates_unavailable_cache(self, env):
+        env.apply_defaults(cmr_pool())
+        pods = make_pods(3, "w", {"cpu": "1", "memory": "2Gi"})
+        for p in pods:
+            env.cluster.apply(p)
+        result = env.solver.solve(pods, [env.cluster.nodepools["default"]], env.catalog)
+        spec = result.node_specs[0]
+        target = spec.instance_type_options[0]
+        # every offering of every candidate ICEs
+        for t in spec.instance_type_options:
+            for z in env.catalog.zones:
+                for ct in lbl.CAPACITY_TYPES:
+                    env.cloud.ice_pools.add((ct, t, z))
+        env.provisioning.reconcile()
+        assert env.catalog.unavailable.entries(), "ICE not recorded"
+        # claim must have been cleaned up after the failed launch
+        assert all(not c.deleted for c in env.cluster.nodeclaims.values())
+
+
+class TestGC:
+    def test_orphan_reaped_after_grace(self, env):
+        env.apply_defaults(cmr_pool())
+        from karpenter_provider_aws_tpu.fake import LaunchRequest
+
+        inst = env.cloud.create_fleet(
+            [LaunchRequest(
+                instance_type_options=["c5.large"],
+                offering_options=[("zone-a", "on-demand")],
+                image_id="img-std-2",
+                tags={MANAGED_TAG: "true"},
+            )]
+        )[0]
+        env.garbagecollection.reconcile()
+        assert env.cloud.instances[inst.id].state == "running"  # inside grace
+        env.clock.advance(31)
+        env.garbagecollection.reconcile()
+        assert env.cloud.instances[inst.id].state == "terminated"
+
+    def test_claimed_instance_not_reaped(self, env):
+        env.apply_defaults(cmr_pool())
+        for p in make_pods(5, "w", {"cpu": "1", "memory": "2Gi"}):
+            env.cluster.apply(p)
+        env.step(2)
+        env.clock.advance(3600)
+        env.garbagecollection.reconcile()
+        for claim in env.cluster.nodeclaims.values():
+            inst = env.cloudprovider.get(claim.status.provider_id)
+            assert inst.state == "running"
+
+
+class TestTagging:
+    def test_instances_tagged_once_registered(self, env):
+        env.apply_defaults(cmr_pool())
+        for p in make_pods(3, "w", {"cpu": "1", "memory": "2Gi"}):
+            env.cluster.apply(p)
+        env.step(2)
+        for claim in env.cluster.nodeclaims.values():
+            inst = env.cloudprovider.get(claim.status.provider_id)
+            assert inst.tags.get("Name") == claim.status.node_name
+            assert claim.annotations[lbl.ANNOTATION_INSTANCE_TAGGED] == "true"
+        calls_before = len(env.cloud.calls.get("tag_instance", []))
+        env.tagging.reconcile()  # second pass must be a no-op
+        assert len(env.cloud.calls.get("tag_instance", [])) == calls_before
+
+
+class TestDrift:
+    def _provision_one(self, env):
+        env.apply_defaults(cmr_pool())
+        for p in make_pods(2, "w", {"cpu": "1", "memory": "2Gi"}):
+            env.cluster.apply(p)
+        env.step(2)
+        return next(iter(env.cluster.nodeclaims.values()))
+
+    def test_no_drift_initially(self, env):
+        claim = self._provision_one(env)
+        assert env.cloudprovider.is_drifted(claim) == DriftReason.NONE
+
+    def test_static_hash_drift(self, env):
+        claim = self._provision_one(env)
+        env.cluster.nodeclasses["default"].user_data = "#!/bin/bash echo changed"
+        assert env.cloudprovider.is_drifted(claim) == DriftReason.STATIC
+
+    def test_image_drift(self, env):
+        claim = self._provision_one(env)
+        inst = env.cloudprovider.get(claim.status.provider_id)
+        inst.image_id = "img-removed"
+        assert env.cloudprovider.is_drifted(claim) == DriftReason.IMAGE
+
+    def test_security_group_drift(self, env):
+        claim = self._provision_one(env)
+        inst = env.cloudprovider.get(claim.status.provider_id)
+        inst.security_group_ids = ("sg-gone",)
+        assert env.cloudprovider.is_drifted(claim) == DriftReason.SECURITY_GROUP
+
+
+class TestNodeClassLifecycle:
+    def test_status_resolution(self, env):
+        env.apply_defaults()
+        nc = env.cluster.nodeclasses["default"]
+        assert nc.status.is_ready()
+        assert nc.status.subnets and nc.status.security_groups and nc.status.images
+        assert nc.status.instance_profile == "cluster-1-default"
+        assert env.cloud.instance_profiles.get("cluster-1-default")
+
+    def test_termination_blocked_by_claims_then_completes(self, env):
+        env.apply_defaults(cmr_pool())
+        for p in make_pods(2, "w", {"cpu": "1", "memory": "2Gi"}):
+            env.cluster.apply(p)
+        env.step(2)
+        nc = env.cluster.nodeclasses["default"]
+        env.cluster.delete(nc)
+        env.nodeclass_termination.reconcile()
+        assert "default" in env.cluster.nodeclasses  # blocked by claims
+        for claim in list(env.cluster.nodeclaims.values()):
+            env.cluster.finalize(claim)
+        env.nodeclass_termination.reconcile()
+        assert "default" not in env.cluster.nodeclasses
+        assert "cluster-1-default" not in env.cloud.instance_profiles
+
+    def test_image_selector_terms(self, env):
+        nodeclass = NodeClass(
+            name="custom", role="r",
+            image_selector=[SelectorTerm.of(name="gpu-v1")],
+        )
+        env.cluster.apply(nodeclass)
+        env.nodeclass_status.reconcile()
+        imgs = env.cluster.nodeclasses["custom"].status.images
+        assert [i.id for i in imgs] == ["img-gpu-1"]
+
+
+class TestSubnetAccounting:
+    def test_inflight_ip_give_back(self, env):
+        env.apply_defaults(cmr_pool())
+        nc = env.cluster.nodeclasses["default"]
+        chosen = env.cloudprovider.subnets.zonal_subnets_for_launch(
+            nc, ["zone-a", "zone-b"]
+        )
+        assert len(chosen) == 2
+        for sid in chosen.values():
+            assert env.cloudprovider.subnets.inflight(sid) == 1
+        env.cloudprovider.subnets.release_unused(chosen, used_zone="zone-a")
+        assert env.cloudprovider.subnets.inflight(chosen["zone-b"]) == 0
+        assert env.cloudprovider.subnets.inflight(chosen["zone-a"]) == 1
