@@ -141,13 +141,27 @@ class CloudProvider:
 
     def _live_offerings(self, claim: NodeClaim, type_names):
         """(zone, captype) pairs from the claim not ICE-masked for at least
-        one candidate type, ranked spot-first-cheapest like CreateFleet."""
+        one candidate type, ranked cheapest-first by the best-ranked type's
+        actual offering price — the fleet takes the first launchable pair, so
+        this ordering IS the lowest-price allocation strategy. A launch that
+        lands anywhere but the cheapest live offering would immediately look
+        consolidatable again (replace churn)."""
         pairs = claim.capacity_type_options or [lbl.CAPACITY_TYPE_ON_DEMAND]
         zones = claim.zone_options or list(self.catalog.zones)
         joint = getattr(claim, "offering_options", None) or [
             (z, ct) for z in zones for ct in pairs
         ]
-        for zone, captype in sorted(joint, key=lambda o: 0 if o[1] == lbl.CAPACITY_TYPE_SPOT else 1):
+        it = self.catalog.get(type_names[0]) if type_names else None
+
+        def price(offer):
+            zone, captype = offer
+            if it is None:
+                return 0.0
+            if captype == lbl.CAPACITY_TYPE_SPOT:
+                return self.catalog.pricing.spot_price(it, zone)
+            return self.catalog.pricing.on_demand_price(it)
+
+        for zone, captype in sorted(joint, key=price):
             if any(
                 not self.catalog.unavailable.is_unavailable(t, zone, captype)
                 for t in type_names

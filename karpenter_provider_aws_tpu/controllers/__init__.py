@@ -14,3 +14,7 @@ from .tagging import TaggingController  # noqa: F401
 from .nodeclass_hash import NodeClassHashController  # noqa: F401
 from .nodeclass_status import NodeClassStatusController  # noqa: F401
 from .nodeclass_termination import NodeClassTerminationController  # noqa: F401
+from .termination import TerminationController  # noqa: F401
+from .scheduling import SchedulingController  # noqa: F401
+from .disruption import DisruptionController  # noqa: F401
+from .interruption import InterruptionController  # noqa: F401

@@ -1,0 +1,235 @@
+"""Disruption controller: consolidation, emptiness, expiration, drift.
+
+Owns what the reference consumes from the core disruption controller
+(designs/consolidation.md; SURVEY.md section 3.4):
+
+ - emptiness: nodes with no pods (policy WhenEmpty or WhenUnderutilized)
+ - consolidation-delete: the TPU repack simulator proves a node's pods fit
+   on surviving capacity; candidates accepted greedily in disruption-cost
+   order with host-side revalidation against the updated free matrix
+   (multi-node consolidation)
+ - consolidation-replace: all of a node's pods fit one cheaper type; the
+   replacement is launched BEFORE the old claim is deleted
+ - expiration: claim older than the pool's expireAfter
+ - drift: CloudProvider.IsDrifted (static hash / image / subnet / SG)
+
+Per-pool disruption budgets (NodePool.spec.disruption.budgets) cap how many
+nodes may be disrupted in one pass, counting already-draining claims.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import numpy as np
+
+from ..cloudprovider.cloudprovider import CloudProvider, DriftReason
+from ..models import labels as lbl
+from ..ops.consolidate import (
+    ClusterTensors,
+    cheaper_replacement,
+    consolidatable,
+    encode_cluster,
+    repack_set_feasible,
+)
+from ..state.cluster import Cluster
+from ..utils.clock import Clock, RealClock
+
+log = logging.getLogger("karpenter.tpu.disruption")
+
+
+class DisruptionController:
+    name = "disruption"
+    interval_s = 10.0
+
+    def __init__(
+        self,
+        cluster: Cluster,
+        cloudprovider: CloudProvider,
+        clock: Optional[Clock] = None,
+        drift_enabled: bool = True,
+        provisioning=None,
+    ):
+        self.cluster = cluster
+        self.cloudprovider = cloudprovider
+        self.clock = clock or RealClock()
+        self.drift_enabled = drift_enabled
+        self.provisioning = provisioning
+        self.disrupted: list[tuple[str, str]] = []  # (claim name, reason) log
+
+    # -- budget accounting -------------------------------------------------
+    def _budget_left(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for pool in self.cluster.nodepools.values():
+            claims = self.cluster.claims_for_nodepool(pool.name)
+            total = len(claims)
+            draining = sum(1 for c in claims if c.deleted)
+            out[pool.name] = max(pool.disruption.max_disruptions(total) - draining, 0)
+        return out
+
+    def _disrupt(self, claim, reason: str, budget: dict[str, int]) -> bool:
+        if budget.get(claim.nodepool_name, 0) <= 0:
+            return False
+        budget[claim.nodepool_name] -= 1
+        self.disrupted.append((claim.name, reason))
+        log.info("disrupting %s: %s", claim.name, reason)
+        self.cluster.delete(claim)  # termination controller drains + reaps
+        return True
+
+    # -- reconcile ---------------------------------------------------------
+    def reconcile(self) -> None:
+        budget = self._budget_left()
+        self._reconcile_expiration(budget)
+        if self.drift_enabled:
+            self._reconcile_drift(budget)
+        self._reconcile_emptiness(budget)
+        self._reconcile_consolidation(budget)
+
+    def _claims_with_nodes(self):
+        for claim in self.cluster.snapshot_claims():
+            if claim.deleted or not claim.is_registered():
+                continue
+            node = self.cluster.nodes.get(claim.status.node_name)
+            if node is None or node.cordoned:
+                continue
+            yield claim, node
+
+    def _reconcile_expiration(self, budget) -> None:
+        now = self.clock.now()
+        for claim, node in self._claims_with_nodes():
+            pool = self.cluster.nodepools.get(claim.nodepool_name)
+            if pool is None or pool.disruption.expire_after_s is None:
+                continue
+            if now - claim.created_at >= pool.disruption.expire_after_s:
+                self._disrupt(claim, "expired", budget)
+
+    def _reconcile_drift(self, budget) -> None:
+        for claim, node in self._claims_with_nodes():
+            reason = self.cloudprovider.is_drifted(claim)
+            if reason != DriftReason.NONE:
+                self._disrupt(claim, f"drifted:{reason.value}", budget)
+
+    def _reconcile_emptiness(self, budget) -> None:
+        now = self.clock.now()
+        for claim, node in self._claims_with_nodes():
+            pool = self.cluster.nodepools.get(claim.nodepool_name)
+            if pool is None:
+                continue
+            after = pool.disruption.consolidate_after_s
+            if after is None:
+                continue
+            if self.cluster.pods_on_node(node.name):
+                continue
+            if now - node.created_at < after:
+                continue
+            self._disrupt(claim, "empty", budget)
+
+    def _reconcile_consolidation(self, budget) -> None:
+        pools = self.cluster.nodepools
+        # Skip the whole encode + device screen when no pool can consolidate.
+        if not any(
+            p.disruption.consolidation_policy == "WhenUnderutilized"
+            and p.disruption.consolidate_after_s is not None
+            for p in pools.values()
+        ):
+            return
+        ct = encode_cluster(self.cluster, self.cloudprovider.catalog)
+        if ct is None:
+            return
+        nodes = {n.name: n for n in self.cluster.snapshot_nodes()}
+        now = self.clock.now()
+        _eligible_cache: dict[int, object] = {}
+
+        def eligible(ni: int) -> Optional[object]:
+            if ni in _eligible_cache:
+                return _eligible_cache[ni]
+            result = None
+            node = nodes.get(ct.node_names[ni])
+            if node is not None:
+                pool = pools.get(node.nodepool_name)
+                claim = self.cluster.nodeclaims.get(node.nodeclaim_name)
+                after = pool.disruption.consolidate_after_s if pool else None
+                if (
+                    pool is not None
+                    and pool.disruption.consolidation_policy == "WhenUnderutilized"
+                    and after is not None
+                    # quiet window measured from the last pod add/remove on
+                    # the node, not node age (karpenter consolidateAfter)
+                    and now - max(node.created_at, node.last_pod_event) >= after
+                    and claim is not None
+                    and not claim.deleted
+                ):
+                    result = claim
+            _eligible_cache[ni] = result
+            return result
+
+        # 1. delete: TPU batch check screens candidates in parallel, then the
+        # multi-node set is chosen as the largest cost-ordered prefix whose
+        # pods ALL repack onto the survivors (candidates never serve as
+        # targets for each other — the set is removed at once, matching
+        # designs/consolidation.md's simulated scheduling).
+        can = consolidatable(ct)
+        order = np.argsort(ct.disruption_cost, kind="stable")
+        candidates = [
+            int(ni) for ni in order if can[ni] and eligible(int(ni)) is not None
+        ]
+        deleted_nodes: set[int] = set()
+        if candidates:
+            lo, hi = 0, len(candidates)
+            while lo < hi:  # largest feasible prefix via binary search
+                mid = (lo + hi + 1) // 2
+                if mid == 0 or repack_set_feasible(ct, candidates[:mid]):
+                    lo = mid
+                else:
+                    hi = mid - 1
+            for ni in candidates[:lo]:
+                claim = eligible(ni)
+                if claim is not None and self._disrupt(
+                    claim, "consolidatable:delete", budget
+                ):
+                    deleted_nodes.add(ni)
+
+        # 2. replace-with-cheaper for survivors.
+        for ni, type_name, new_price, offering_options in cheaper_replacement(
+            ct, self.cloudprovider.catalog, nodepools=dict(pools)
+        ):
+            if ni in deleted_nodes:
+                continue
+            claim = eligible(int(ni))
+            if claim is None:
+                continue
+            if budget.get(claim.nodepool_name, 0) <= 0:
+                continue
+            replacement = self._launch_replacement(claim, type_name, offering_options)
+            if replacement is None:
+                continue
+            # nominate the evicted pods onto the replacement so the
+            # provisioner doesn't double-provision while it registers
+            # (parity: core nomination protecting in-flight capacity)
+            if self.provisioning is not None:
+                node_name = claim.status.node_name
+                with self.provisioning._nominations_lock:
+                    for pod in self.cluster.pods_on_node(node_name):
+                        self.provisioning.nominations[pod.uid] = replacement.name
+            self._disrupt(claim, f"consolidatable:replace->{type_name}", budget)
+
+    def _launch_replacement(self, old_claim, type_name: str, offering_options):
+        """Launch the cheaper replacement BEFORE disrupting the old node
+        (consolidation.md: replacements come up first), through the shared
+        launch path so pool labels/taints/constraints are identical to a
+        provisioner launch. Returns the new claim, or None on failure."""
+        from ..scheduling.solver import NodeSpec
+        from .provisioning import launch_claim
+
+        pool = self.cluster.nodepools.get(old_claim.nodepool_name)
+        if pool is None:
+            return None
+        spec = NodeSpec(
+            nodepool_name=pool.name,
+            instance_type_options=[type_name],
+            zone_options=sorted({z for z, _ in offering_options}),
+            capacity_type_options=sorted({ct for _, ct in offering_options}),
+            offering_options=list(offering_options),
+        )
+        return launch_claim(self.cluster, self.cloudprovider, pool, spec)

@@ -1,0 +1,125 @@
+"""Interruption controller: queue events -> cordon & drain + ICE feedback.
+
+Parity: ``pkg/controllers/interruption`` — drain the queue of
+EventBridge-style messages; typed parsers keyed on (source, detail-type)
+(parser.go:52-91); actions (controller.go:180-226):
+ - spot interruption warning  -> mark spot offering unavailable + drain
+ - scheduled change / health  -> drain
+ - instance stopping/terminating state change -> drain
+ - rebalance recommendation   -> no action (default)
+Messages are deleted after handling, including unparseable ones; handling
+fans out over a small worker pool (controller.go:104 ParallelizeUntil(10)).
+"""
+
+from __future__ import annotations
+
+import logging
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from ..cloudprovider.cloudprovider import CloudProvider
+from ..models import labels as lbl
+from ..state.cluster import Cluster
+
+log = logging.getLogger("karpenter.tpu.interruption")
+
+PARALLELISM = 10
+
+
+@dataclass(frozen=True)
+class InterruptionEvent:
+    kind: str               # SpotInterruption | Rebalance | ScheduledChange | StateChange | Unknown
+    instance_ids: tuple[str, ...]
+    action_drain: bool
+
+
+def _parse_spot(detail) -> InterruptionEvent:
+    return InterruptionEvent("SpotInterruption", (detail.get("instance-id", ""),), True)
+
+
+def _parse_rebalance(detail) -> InterruptionEvent:
+    return InterruptionEvent("Rebalance", (detail.get("instance-id", ""),), False)
+
+
+def _parse_state_change(detail) -> InterruptionEvent:
+    state = detail.get("state", "")
+    drain = state in ("stopping", "stopped", "shutting-down", "terminated")
+    return InterruptionEvent("StateChange", (detail.get("instance-id", ""),), drain)
+
+
+def _parse_scheduled_change(detail) -> InterruptionEvent:
+    ids = tuple(
+        e.get("entityValue", "") for e in detail.get("affectedEntities", [])
+    ) or (detail.get("instance-id", ""),)
+    return InterruptionEvent("ScheduledChange", ids, True)
+
+
+# (source, detail-type) -> parser (parity: parser.go DefaultParsers)
+DEFAULT_PARSERS: dict[tuple[str, str], Callable[[dict], InterruptionEvent]] = {
+    ("aws.ec2", "EC2 Spot Instance Interruption Warning"): _parse_spot,
+    ("aws.ec2", "EC2 Instance Rebalance Recommendation"): _parse_rebalance,
+    ("aws.ec2", "EC2 Instance State-change Notification"): _parse_state_change,
+    ("aws.health", "AWS Health Event"): _parse_scheduled_change,
+}
+
+
+def parse_message(body: dict) -> InterruptionEvent:
+    parser = DEFAULT_PARSERS.get((body.get("source", ""), body.get("detail-type", "")))
+    if parser is None:
+        return InterruptionEvent("Unknown", (), False)
+    return parser(body.get("detail", {}))
+
+
+class InterruptionController:
+    """Enabled only when a queue is configured (parity:
+    controllers.go:67-71 gating on --interruption-queue)."""
+
+    name = "interruption"
+    interval_s = 2.0
+
+    def __init__(self, cluster: Cluster, cloudprovider: CloudProvider, queue):
+        self.cluster = cluster
+        self.cloudprovider = cloudprovider
+        self.queue = queue
+        self.handled: list[InterruptionEvent] = []
+
+    def reconcile(self) -> None:
+        messages = self.queue.receive()
+        if not messages:
+            return
+        # provider-id -> claim map built once per batch (controller.go:254-292)
+        claims_by_instance = {}
+        for claim in self.cluster.snapshot_claims():
+            iid = claim.status.provider_id.rsplit("/", 1)[-1]
+            if iid:
+                claims_by_instance[iid] = claim
+        if len(messages) == 1:
+            self._handle(messages[0], claims_by_instance)
+        else:
+            with ThreadPoolExecutor(max_workers=min(PARALLELISM, len(messages))) as pool:
+                list(pool.map(lambda m: self._handle(m, claims_by_instance), messages))
+
+    def _handle(self, message, claims_by_instance) -> None:
+        try:
+            event = parse_message(message.parsed())
+        except Exception:
+            event = InterruptionEvent("Unknown", (), False)
+        self.handled.append(event)
+        for iid in event.instance_ids:
+            claim = claims_by_instance.get(iid)
+            if claim is None:
+                continue
+            if event.kind == "SpotInterruption":
+                # the interrupted offering is effectively dry: mask it for
+                # the next solves (controller.go:197-203)
+                itype = claim.labels.get(lbl.INSTANCE_TYPE_LABEL, "")
+                zone = claim.labels.get(lbl.TOPOLOGY_ZONE, "")
+                if itype and zone:
+                    self.cloudprovider.catalog.unavailable.mark_unavailable(
+                        itype, zone, lbl.CAPACITY_TYPE_SPOT, reason="SpotInterruption"
+                    )
+            if event.action_drain and not claim.deleted:
+                log.info("interruption %s: draining %s", event.kind, claim.name)
+                self.cluster.delete(claim)  # cordon & drain via termination
+        self.queue.delete(message.receipt)

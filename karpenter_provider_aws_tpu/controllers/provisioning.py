@@ -85,26 +85,8 @@ class ProvisioningController:
         pool = self.cluster.nodepools.get(spec.nodepool_name)
         if pool is None:
             return
-        claim = NodeClaim.fresh(
-            nodepool_name=spec.nodepool_name,
-            nodeclass_name=pool.nodeclass_name,
-            instance_type_options=spec.instance_type_options,
-            zone_options=spec.zone_options,
-            capacity_type_options=spec.capacity_type_options,
-            offering_options=list(spec.offering_options),
-            taints=list(pool.taints),
-            startup_taints=list(pool.startup_taints),
-        )
-        self.cluster.apply(claim)
-        try:
-            self.cloudprovider.create(claim)
-        except Exception as e:
-            # ICE or launch failure: drop the claim; the unavailable cache
-            # now masks the offering, so the next solve re-plans around it
-            # (parity: instance.go:362-368 + provisioner retry).
-            log.warning("launch failed for %s: %s", claim.name, e)
-            self.cluster.finalize(claim)
-            self.cluster.delete(claim)
+        claim = launch_claim(self.cluster, self.cloudprovider, pool, spec)
+        if claim is None:
             return
         with self._nominations_lock:
             for pod in spec.pods:
@@ -115,3 +97,38 @@ class ProvisioningController:
             self.nominations = {
                 uid: c for uid, c in self.nominations.items() if c != claim_name
             }
+
+
+def launch_claim(cluster: Cluster, cloudprovider: CloudProvider, pool, spec: NodeSpec):
+    """Build a NodeClaim from a NodeSpec and drive CloudProvider.Create.
+
+    The single launch path for both the provisioner and the disruption
+    controller's replacements. Pool template labels/annotations are stamped
+    onto the claim (and thus the node), so pod selectors on them hold.
+    Returns the claim, or None on failure (the claim is cleaned up and the
+    ICE cache already updated by the provider).
+    """
+    claim = NodeClaim.fresh(
+        nodepool_name=pool.name,
+        nodeclass_name=pool.nodeclass_name,
+        instance_type_options=spec.instance_type_options,
+        zone_options=spec.zone_options,
+        capacity_type_options=spec.capacity_type_options,
+        offering_options=list(spec.offering_options),
+        labels=dict(pool.labels),
+        annotations=dict(pool.annotations),
+        taints=list(pool.taints),
+        startup_taints=list(pool.startup_taints),
+    )
+    cluster.apply(claim)
+    try:
+        cloudprovider.create(claim)
+        return claim
+    except Exception as e:
+        # ICE or launch failure: drop the claim; the unavailable cache now
+        # masks the offering, so the next solve re-plans around it
+        # (parity: instance.go:362-368 + provisioner retry).
+        log.warning("launch failed for %s: %s", claim.name, e)
+        cluster.finalize(claim)
+        cluster.delete(claim)
+        return None

@@ -77,4 +77,4 @@ class RegistrationController:
         for uid in mine:
             pod = self.cluster.pods.get(uid)
             if pod is not None and pod.is_pending():
-                self.cluster.bind_pod(uid, node_name)
+                self.cluster.bind_pod(uid, node_name, now=self.clock.now())

@@ -1,0 +1,64 @@
+"""Scheduling controller: the fake kube-scheduler for existing capacity.
+
+The reference relies on kube-scheduler to bind evicted/pending pods onto
+nodes that already have room; the provisioner only handles what cannot fit.
+This controller reproduces that: first-fit pending pods onto ready,
+uncordoned nodes whose labels satisfy the pod's requirements, whose taints
+are tolerated, and whose free allocatable covers the request. Runs BEFORE
+the provisioning controller so consolidation's evictions re-land on
+surviving capacity instead of spawning fresh nodes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..state.cluster import Cluster
+
+
+class SchedulingController:
+    name = "scheduling"
+    interval_s = 1.0
+
+    def __init__(self, cluster: Cluster, provisioning=None, clock=None):
+        from ..utils.clock import RealClock
+
+        self.cluster = cluster
+        self.provisioning = provisioning
+        self.clock = clock or RealClock()
+
+    def _free_map(self) -> dict[str, np.ndarray]:
+        free: dict[str, np.ndarray] = {}
+        for node in self.cluster.snapshot_nodes():
+            if not node.ready or node.cordoned:
+                continue
+            used = np.zeros_like(node.allocatable.v)
+            for pod in self.cluster.pods_on_node(node.name):
+                used = used + pod.requests.v
+            free[node.name] = node.allocatable.v - used
+        return free
+
+    def reconcile(self) -> None:
+        free = self._free_map()
+        if not free:
+            return
+        nominated = set()
+        if self.provisioning is not None:
+            with self.provisioning._nominations_lock:
+                nominated = set(self.provisioning.nominations)
+        nodes = {n.name: n for n in self.cluster.snapshot_nodes()}
+        for pod in self.cluster.pending_pods():
+            if pod.uid in nominated:
+                continue
+            reqs = pod.requirements()
+            for name, f in free.items():
+                node = nodes[name]
+                if (pod.requests.v > f + 1e-6).any():
+                    continue
+                if not reqs.satisfied_by_labels(node.labels):
+                    continue
+                if not pod.tolerates_all(node.taints):
+                    continue
+                self.cluster.bind_pod(pod.uid, name, now=self.clock.now())
+                free[name] = f - pod.requests.v
+                break

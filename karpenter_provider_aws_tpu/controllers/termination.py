@@ -1,0 +1,43 @@
+"""NodeClaim termination controller: finalizer-gated drain + terminate.
+
+Owns what the reference consumes from the core termination controller
+(SURVEY.md section 2.2 lifecycle): when a claim is deleted — by disruption,
+interruption, or the user — cordon its node, evict (unbind) its pods so
+they re-enter the scheduling pipeline, terminate the cloud instance, then
+remove the node and the finalizer.
+"""
+
+from __future__ import annotations
+
+from ..cloudprovider.cloudprovider import CloudProvider
+from ..state.cluster import Cluster
+from ..utils import errors
+
+
+class TerminationController:
+    name = "termination"
+    interval_s = 2.0
+
+    def __init__(self, cluster: Cluster, cloudprovider: CloudProvider):
+        self.cluster = cluster
+        self.cloudprovider = cloudprovider
+
+    def reconcile(self) -> None:
+        for claim in self.cluster.snapshot_claims():
+            if not claim.deleted:
+                continue
+            node = self.cluster.nodes.get(claim.status.node_name)
+            if node is not None:
+                node.cordoned = True
+                for pod in self.cluster.pods_on_node(node.name):
+                    pod.node_name = ""
+                    pod.phase = "Pending"
+            if claim.status.provider_id:
+                try:
+                    self.cloudprovider.delete(claim)
+                except Exception as e:
+                    if not errors.is_not_found(e):
+                        raise
+            if node is not None:
+                self.cluster.delete(node)
+            self.cluster.finalize(claim)

@@ -56,6 +56,13 @@ class FakeQueue:
             self._messages.pop(receipt, None)
             self.deleted_count += 1
 
+    def reset(self) -> None:
+        with self._lock:
+            self._messages.clear()
+            self.next_errors.clear()
+            self.received_count = 0
+            self.deleted_count = 0
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._messages)

@@ -53,10 +53,15 @@ class Disruption:
     budgets: list[str] = field(default_factory=lambda: ["10%"])
 
     def max_disruptions(self, total_nodes: int) -> int:
+        import math
+
         allowed = total_nodes
         for b in self.budgets:
             if b.endswith("%"):
-                v = int(total_nodes * float(b[:-1]) / 100.0)
+                # percentages round UP (k8s GetScaledValueFromIntOrPercent
+                # semantics as used by karpenter budgets): "10%" of 3 nodes
+                # allows 1 disruption, not 0
+                v = math.ceil(total_nodes * float(b[:-1]) / 100.0)
             else:
                 v = int(b)
             allowed = min(allowed, v)

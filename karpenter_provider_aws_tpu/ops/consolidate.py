@@ -1,0 +1,370 @@
+"""The consolidation simulator: batched "remove node i — do its pods fit
+elsewhere?" on device.
+
+Replaces the core disruption controller's per-candidate simulated
+scheduling (designs/consolidation.md:5-36) with one vmapped kernel: every
+candidate node's repack check runs as an independent lane over the shared
+free-capacity matrix (SURVEY.md sections 3.4 and 7.7). This is BASELINE
+config #4 (multi-node consolidation of 5k live nodes).
+
+Encoding: pods are deduped into groups cluster-wide; each node carries up to
+``GMAX`` (group id, count) slots. A candidate lane scans its slots, greedily
+first-fit-filling the *other* nodes' free capacity, exactly like the forward
+FFD fill step. Cost per lane O(GMAX x N x R); lanes are vmapped and the
+candidate axis can be chunked by the host for memory.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.resources import NUM_RESOURCES
+
+_EPS = 1e-4
+GMAX_DEFAULT = 32
+
+
+@dataclass
+class ClusterTensors:
+    """Device-facing snapshot of live nodes + their pods."""
+
+    node_names: list[str]
+    nodepool_names: list[str]
+    free: np.ndarray          # [N, R] allocatable - used
+    price: np.ndarray         # [N] $/hr of the running offering
+    requests: np.ndarray      # [G, R] deduped pod-group requests
+    group_ids: np.ndarray     # [N, GMAX] int32 (0-padded; count 0 = unused)
+    group_counts: np.ndarray  # [N, GMAX] int32
+    compat: np.ndarray        # [G, N] bool: group may run on node
+    disruption_cost: np.ndarray  # [N] float32 (consolidation.md:24-36 ranking)
+    blocked: np.ndarray       # [N] bool: do-not-disrupt pod or overflow
+    used_total: np.ndarray    # [N, R] resources of pods on the node
+    group_pods: list[list] = field(default_factory=list)  # per group: pods
+
+
+def encode_cluster(cluster, catalog, gmax: int = GMAX_DEFAULT) -> Optional[ClusterTensors]:
+    """Snapshot ready nodes with claims into consolidation tensors."""
+    from ..models import labels as lbl
+
+    # A node whose claim is already draining (deleted) is neither a
+    # candidate nor a repack target — its capacity is going away.
+    claims = {c.name: c for c in cluster.snapshot_claims()}
+    nodes = [
+        n
+        for n in cluster.snapshot_nodes()
+        if n.ready
+        and not n.cordoned
+        and n.nodeclaim_name in claims
+        and not claims[n.nodeclaim_name].deleted
+    ]
+    if not nodes:
+        return None
+    N = len(nodes)
+
+    groups: dict = {}
+    group_list: list[list] = []
+    node_groups: list[dict[int, int]] = []
+    blocked = np.zeros(N, dtype=bool)
+    disruption_cost = np.zeros(N, dtype=np.float32)
+    used_total = np.zeros((N, NUM_RESOURCES), dtype=np.float32)
+    for ni, node in enumerate(nodes):
+        per_node: dict[int, int] = {}
+        for pod in cluster.pods_on_node(node.name):
+            if pod.do_not_disrupt():
+                blocked[ni] = True
+            key = pod.scheduling_key()
+            gi = groups.get(key)
+            if gi is None:
+                gi = len(group_list)
+                groups[key] = gi
+                group_list.append([])
+            group_list[gi].append(pod)
+            per_node[gi] = per_node.get(gi, 0) + 1
+            disruption_cost[ni] += 1.0 + pod.deletion_cost() + pod.priority / 1000.0
+            used_total[ni] += pod.requests.v
+        if len(per_node) > gmax:
+            blocked[ni] = True  # too fragmented to encode; never silently skip
+        node_groups.append(per_node)
+
+    G = max(len(group_list), 1)
+    requests = np.zeros((G, NUM_RESOURCES), dtype=np.float32)
+    for gi, pods in enumerate(group_list):
+        requests[gi] = pods[0].requests.v
+
+    group_ids = np.zeros((N, gmax), dtype=np.int32)
+    group_counts = np.zeros((N, gmax), dtype=np.int32)
+    for ni, per_node in enumerate(node_groups):
+        for slot, (gi, cnt) in enumerate(list(per_node.items())[:gmax]):
+            group_ids[ni, slot] = gi
+            group_counts[ni, slot] = cnt
+
+    # group x node compatibility: labels + taints
+    compat = np.zeros((G, N), dtype=bool)
+    for gi, pods in enumerate(group_list):
+        pod = pods[0]
+        reqs = pod.requirements()
+        for ni, node in enumerate(nodes):
+            compat[gi, ni] = reqs.satisfied_by_labels(node.labels) and pod.tolerates_all(
+                node.taints
+            )
+
+    free = np.zeros((N, NUM_RESOURCES), dtype=np.float32)
+    price = np.zeros(N, dtype=np.float32)
+    for ni, node in enumerate(nodes):
+        free[ni] = node.allocatable.v - used_total[ni]
+        it = catalog.get(node.instance_type())
+        if it is None:
+            price[ni] = 0.0
+            blocked[ni] = True
+            continue
+        if node.capacity_type() == lbl.CAPACITY_TYPE_SPOT:
+            price[ni] = catalog.pricing.spot_price(it, node.zone())
+        else:
+            price[ni] = catalog.pricing.on_demand_price(it)
+
+    return ClusterTensors(
+        node_names=[n.name for n in nodes],
+        nodepool_names=[n.nodepool_name for n in nodes],
+        free=free,
+        price=price,
+        requests=requests,
+        group_ids=group_ids,
+        group_counts=group_counts,
+        compat=compat,
+        disruption_cost=disruption_cost,
+        blocked=blocked,
+        used_total=used_total,
+        group_pods=group_list,
+    )
+
+
+def _fit_counts(cap_rem: jnp.ndarray, req: jnp.ndarray) -> jnp.ndarray:
+    with_req = req > 0
+    ratio = jnp.where(
+        with_req[None, :],
+        jnp.floor((cap_rem + _EPS) / jnp.where(with_req, req, 1.0)[None, :]),
+        jnp.inf,
+    )
+    return jnp.maximum(jnp.min(ratio, axis=-1), 0.0).astype(jnp.int32)
+
+
+@jax.jit
+def repack_check(
+    free: jnp.ndarray,          # [N, R]
+    requests: jnp.ndarray,      # [G, R]
+    group_ids: jnp.ndarray,     # [N, GMAX]
+    group_counts: jnp.ndarray,  # [N, GMAX]
+    compat: jnp.ndarray,        # [G, N]
+    candidates: jnp.ndarray,    # [C] int32 node indices
+) -> jnp.ndarray:
+    """ok[C]: candidate's pods all fit on other nodes' free capacity."""
+    N = free.shape[0]
+    gmax = group_ids.shape[1]
+
+    def one(i):
+        other = jnp.arange(N) != i
+
+        def body(free_c, slot):
+            g = group_ids[i, slot]
+            cnt = group_counts[i, slot]
+            req = requests[g]
+            ok = compat[g] & other
+            k = jnp.where(ok, _fit_counts(free_c, req), 0)
+            cum_before = jnp.cumsum(k) - k
+            place = jnp.clip(cnt - cum_before, 0, k)
+            return free_c - place[:, None] * req[None, :], cnt - place.sum()
+
+        _, leftovers = jax.lax.scan(body, free, jnp.arange(gmax))
+        return leftovers.sum() == 0
+
+    return jax.vmap(one)(candidates)
+
+
+def consolidatable(ct: ClusterTensors, chunk: int = 512) -> np.ndarray:
+    """can_delete[N] via chunked device lanes."""
+    N = len(ct.node_names)
+    out = np.zeros(N, dtype=bool)
+    free = jnp.asarray(ct.free)
+    requests = jnp.asarray(ct.requests)
+    gids = jnp.asarray(ct.group_ids)
+    gcounts = jnp.asarray(ct.group_counts)
+    compat = jnp.asarray(ct.compat)
+    for start in range(0, N, chunk):
+        idx = np.arange(start, min(start + chunk, N), dtype=np.int32)
+        pad = np.zeros(chunk - len(idx), dtype=np.int32)
+        cand = jnp.asarray(np.concatenate([idx, pad]))
+        ok = np.asarray(repack_check(free, requests, gids, gcounts, compat, cand))
+        out[idx] = ok[: len(idx)]
+    out &= ~ct.blocked
+    # an empty node is trivially "repackable"; emptiness is handled separately
+    return out
+
+
+def repack_feasible_numpy(ct: ClusterTensors, free: np.ndarray, i: int) -> Optional[np.ndarray]:
+    """Host-side re-validation of a single candidate against a *current* free
+    matrix. Returns the updated free matrix on success, None on failure."""
+    ok = repack_set_feasible(ct, [i], free=free, return_free=True)
+    return ok
+
+
+def repack_set_feasible(
+    ct: ClusterTensors,
+    candidate_ids,
+    free: Optional[np.ndarray] = None,
+    return_free: bool = False,
+):
+    """Can ALL candidates' pods repack onto the *surviving* nodes (every
+    non-candidate)? This is the reference's multi-node consolidation
+    simulation (designs/consolidation.md:9-15): the whole set is removed at
+    once, so a candidate can never serve as a repack target for another.
+    """
+    free = (ct.free if free is None else free).copy()
+    N = free.shape[0]
+    survivors = np.ones(N, dtype=bool)
+    for c in candidate_ids:
+        survivors[c] = False
+    for i in candidate_ids:
+        for slot in range(ct.group_ids.shape[1]):
+            g = int(ct.group_ids[i, slot])
+            cnt = int(ct.group_counts[i, slot])
+            if cnt == 0:
+                continue
+            req = ct.requests[g]
+            ok = ct.compat[g] & survivors
+            with np.errstate(divide="ignore", invalid="ignore"):
+                ratio = np.where(
+                    req[None, :] > 0,
+                    np.floor((free + _EPS) / np.where(req > 0, req, 1.0)[None, :]),
+                    np.inf,
+                )
+            k = np.where(ok, np.maximum(ratio.min(axis=1), 0).astype(np.int64), 0)
+            cum_before = np.cumsum(k) - k
+            place = np.clip(cnt - cum_before, 0, k)
+            free -= place[:, None] * req[None, :]
+            if cnt - place.sum() > 0:
+                return None if return_free else False
+    return free if return_free else True
+
+
+def cheaper_replacement(
+    ct: ClusterTensors, catalog, nodepools: Optional[dict] = None, margin: float = 0.15
+) -> list:
+    """[(node_index, type_name, new_price)] single-node replace candidates:
+    all the node's pods fit one cheaper instance type (consolidation.md
+    'replace with a single cheaper node'). The replacement must satisfy the
+    node's NodePool requirements, not just the pods'.
+
+    ``margin`` demands a meaningful saving (default 15%) — with zero margin,
+    zonal spot-price jitter makes replace oscillate forever: every pass finds
+    an epsilon-cheaper offering for the node it just created."""
+    from ..models.requirements import Requirements
+    from ..ops.encode import _SKIP_KEYS, _contains_vec, _label_arrays
+
+    tensors = catalog.tensors()
+    types = catalog.list()
+    T = len(types)
+    catalog_seq = tensors.key[0] if tensors.key else 0
+    label_arrays = _label_arrays(types, (catalog.uid, catalog_seq, tensors.names))
+    min_price = tensors.min_price()  # [T]
+
+    def static_mask(reqs: Requirements) -> np.ndarray:
+        row = np.ones(T, dtype=bool)
+        for key, vs in reqs:
+            if key in _SKIP_KEYS:
+                continue
+            arrays = label_arrays.get(key)
+            if arrays is None:
+                if not vs.allow_undefined:
+                    row[:] = False
+                    break
+                continue
+            row &= _contains_vec(vs, *arrays)
+        return row
+
+    from ..models import labels as lbl
+
+    # spec requirements only — template *labels* are stamped onto nodes, not
+    # constraints the instance type must itself satisfy
+    pool_masks: dict[str, np.ndarray] = {}
+    pool_windows: dict[str, np.ndarray] = {}  # [Z, 2] zone x captype allowance
+    Z = len(tensors.zones)
+    for name, pool in (nodepools or {}).items():
+        reqs = Requirements(pool.requirements)
+        pool_masks[name] = static_mask(reqs)
+        zvs = reqs.get(lbl.TOPOLOGY_ZONE)
+        cvs = reqs.get(lbl.CAPACITY_TYPE)
+        zrow = np.array([zvs.contains(z) for z in tensors.zones])
+        crow = np.array([cvs.contains(ct_) for ct_ in lbl.CAPACITY_TYPES])
+        pool_windows[name] = zrow[:, None] & crow[None, :]
+
+    def group_window(gi: int) -> np.ndarray:
+        reqs = ct.group_pods[gi][0].requirements()
+        zvs = reqs.get(lbl.TOPOLOGY_ZONE)
+        cvs = reqs.get(lbl.CAPACITY_TYPE)
+        zrow = np.array([zvs.contains(z) for z in tensors.zones])
+        crow = np.array([cvs.contains(ct_) for ct_ in lbl.CAPACITY_TYPES])
+        return zrow[:, None] & crow[None, :]
+
+    # group x type compat via the same vectorized requirement path as encode
+    G = ct.requests.shape[0]
+    compat_t = np.ones((G, T), dtype=bool)
+    for gi, pods in enumerate(ct.group_pods):
+        reqs = pods[0].requirements()
+        row = np.ones(T, dtype=bool)
+        from ..models import labels as lbl
+        for key, vs in reqs:
+            if key in (lbl.TOPOLOGY_ZONE, lbl.CAPACITY_TYPE, lbl.HOSTNAME, lbl.NODEPOOL):
+                continue
+            arrays = label_arrays.get(key)
+            if arrays is None:
+                if not vs.allow_undefined:
+                    row[:] = False
+                    break
+                continue
+            row &= _contains_vec(vs, *arrays)
+        compat_t[gi] = row
+
+    out = []
+    N = len(ct.node_names)
+    present = ct.group_counts > 0  # [N, GMAX]
+    gw_cache: dict[int, np.ndarray] = {}
+    for i in range(N):
+        if ct.blocked[i] or not present[i].any():
+            continue
+        gids = ct.group_ids[i][present[i]]
+        node_compat = compat_t[gids].all(axis=0)  # [T]
+        pool_mask = pool_masks.get(ct.nodepool_names[i])
+        if pool_mask is not None:
+            node_compat = node_compat & pool_mask
+        # joint (zone, captype) window: pool allowance x every group on the
+        # node — the replacement must be launchable where its pods may run
+        window = pool_windows.get(ct.nodepool_names[i], np.ones((Z, 2), dtype=bool)).copy()
+        for g in gids:
+            g = int(g)
+            if g not in gw_cache:
+                gw_cache[g] = group_window(g)
+            window &= gw_cache[g]
+        if not window.any():
+            continue
+        # price per type restricted to the allowed, live offerings
+        allowed = tensors.available & window[None, :, :]
+        win_price = np.where(allowed, tensors.price, np.inf).min(axis=(1, 2))
+        fits = (ct.used_total[i][None, :] <= tensors.capacity + 1e-4).all(axis=1)
+        cheaper = win_price < ct.price[i] * (1.0 - margin) - 1e-9
+        usable = node_compat & fits & cheaper & np.isfinite(win_price)
+        if usable.any():
+            t = int(np.where(usable, win_price, np.inf).argmin())
+            offering_options = [
+                (tensors.zones[zi], lbl.CAPACITY_TYPES[ci])
+                for zi in range(Z)
+                for ci in range(2)
+                if allowed[t, zi, ci]
+            ]
+            out.append((i, tensors.names[t], float(win_price[t]), offering_options))
+    return out

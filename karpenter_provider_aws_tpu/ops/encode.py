@@ -184,6 +184,10 @@ def encode_problem(
     catalog_seq = tensors.key[0] if tensors.key else 0
     label_arrays = _label_arrays(types, (catalog.uid, catalog_seq, tensors.names))
 
+    # Keys the nodepool stamps onto its nodes as template labels: satisfied by
+    # construction on any launched node, never constraints on the type itself.
+    provided_keys = set(nodepool.labels) if nodepool else set()
+
     for gi, plist in enumerate(group_list):
         pod = plist[0]
         requests[gi] = pod.requests.v
@@ -201,7 +205,7 @@ def encode_problem(
         # Static label compat, vectorized over T per requirement key.
         static_ok = np.ones(T, dtype=bool)
         for key, vs in reqs:
-            if key in _SKIP_KEYS:
+            if key in _SKIP_KEYS or key in provided_keys:
                 continue
             arrays = label_arrays.get(key)
             if arrays is None:

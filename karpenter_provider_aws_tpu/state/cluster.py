@@ -28,6 +28,9 @@ class Node:
     ready: bool = False
     cordoned: bool = False
     created_at: float = 0.0
+    # monotonic timestamp of the last pod bind/unbind touching this node;
+    # consolidateAfter quiet windows are measured from here
+    last_pod_event: float = 0.0
 
     def zone(self) -> str:
         return self.labels.get(lbl.TOPOLOGY_ZONE, "")
@@ -44,13 +47,17 @@ class Cluster:
     controllers need. All mutation goes through methods so tests can observe
     ordering; watches are replaced by level-triggered re-listing."""
 
-    def __init__(self):
+    def __init__(self, clock=None):
+        self.clock = clock
         self._lock = threading.RLock()
         self.nodepools: dict[str, NodePool] = {}
         self.nodeclasses: dict[str, NodeClass] = {}
         self.nodeclaims: dict[str, NodeClaim] = {}
         self.nodes: dict[str, Node] = {}
         self.pods: dict[str, Pod] = {}
+
+    def _now(self) -> float:
+        return self.clock.now() if self.clock is not None else 0.0
 
     # -- apply/delete ------------------------------------------------------
     def apply(self, obj) -> None:
@@ -86,6 +93,9 @@ class Cluster:
                 self.nodes.pop(obj.name, None)
             elif isinstance(obj, Pod):
                 self.pods.pop(obj.uid, None)
+                node = self.nodes.get(obj.node_name)
+                if node is not None:
+                    node.last_pod_event = max(node.last_pod_event, self._now())
             else:
                 raise TypeError(f"unknown object {type(obj)}")
 
@@ -103,11 +113,14 @@ class Cluster:
         with self._lock:
             return [p for p in self.pods.values() if p.is_pending()]
 
-    def bind_pod(self, pod_uid: str, node_name: str) -> None:
+    def bind_pod(self, pod_uid: str, node_name: str, now: float = 0.0) -> None:
         with self._lock:
             pod = self.pods[pod_uid]
             pod.node_name = node_name
             pod.phase = "Running"
+            node = self.nodes.get(node_name)
+            if node is not None:
+                node.last_pod_event = max(node.last_pod_event, now)
 
     def pods_on_node(self, node_name: str) -> list[Pod]:
         with self._lock:

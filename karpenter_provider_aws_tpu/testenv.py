@@ -14,14 +14,18 @@ from typing import Optional
 from .catalog.provider import CatalogProvider, OverheadOptions
 from .cloudprovider.cloudprovider import CloudProvider
 from .controllers import (
+    DisruptionController,
     GarbageCollectionController,
+    InterruptionController,
     Manager,
     NodeClassHashController,
     NodeClassStatusController,
     NodeClassTerminationController,
     ProvisioningController,
     RegistrationController,
+    SchedulingController,
     TaggingController,
+    TerminationController,
 )
 from .fake import FakeCloud, FakeQueue
 from .models.nodeclass import NodeClass
@@ -42,7 +46,11 @@ class Environment:
     cloudprovider: CloudProvider
     solver: Solver
     provisioning: ProvisioningController
+    scheduling: SchedulingController
     registration: RegistrationController
+    termination: TerminationController
+    disruption: DisruptionController
+    interruption: InterruptionController
     garbagecollection: GarbageCollectionController
     tagging: TaggingController
     nodeclass_hash: NodeClassHashController
@@ -52,10 +60,15 @@ class Environment:
 
     def reset(self) -> None:
         self.cloud.reset()
-        self.cluster.__init__()
+        self.queue.reset()
+        self.cluster.__init__(clock=self.clock)
         self.catalog.unavailable.flush()
         self.cloudprovider.reset_caches()
         self.provisioning.nominations.clear()
+        self.provisioning.last_unschedulable.clear()
+        self.disruption.disrupted.clear()
+        self.interruption.handled.clear()
+        self.garbagecollection.reaped.clear()
 
     def step(self, n: int = 1) -> None:
         """n deterministic reconcile passes over every controller."""
@@ -78,7 +91,7 @@ def new_environment(solver: Optional[Solver] = None, use_tpu_solver: bool = True
     cloud = FakeCloud(clock=clock)
     queue = FakeQueue()
     catalog = CatalogProvider(clock=clock)
-    cluster = Cluster()
+    cluster = Cluster(clock=clock)
     cloudprovider = CloudProvider(
         cloud,
         catalog,
@@ -88,14 +101,30 @@ def new_environment(solver: Optional[Solver] = None, use_tpu_solver: bool = True
     )
     solver = solver or (TPUSolver() if use_tpu_solver else HostSolver())
     provisioning = ProvisioningController(cluster, solver, cloudprovider)
+    scheduling = SchedulingController(cluster, provisioning, clock=clock)
     registration = RegistrationController(cluster, provisioning, clock=clock)
+    termination = TerminationController(cluster, cloudprovider)
+    disruption = DisruptionController(cluster, cloudprovider, clock=clock, provisioning=provisioning)
+    interruption = InterruptionController(cluster, cloudprovider, queue)
     gc = GarbageCollectionController(cluster, cloudprovider, clock=clock)
     tagging = TaggingController(cluster, cloudprovider)
     nc_hash = NodeClassHashController(cluster)
     nc_status = NodeClassStatusController(cluster, cloudprovider)
     nc_term = NodeClassTerminationController(cluster, cloudprovider)
     manager = Manager(
-        [nc_status, nc_hash, provisioning, registration, tagging, gc, nc_term]
+        [
+            nc_status,
+            nc_hash,
+            interruption,
+            termination,
+            registration,
+            scheduling,
+            provisioning,
+            tagging,
+            disruption,
+            gc,
+            nc_term,
+        ]
     )
     return Environment(
         clock=clock,
@@ -106,7 +135,11 @@ def new_environment(solver: Optional[Solver] = None, use_tpu_solver: bool = True
         cloudprovider=cloudprovider,
         solver=solver,
         provisioning=provisioning,
+        scheduling=scheduling,
         registration=registration,
+        termination=termination,
+        disruption=disruption,
+        interruption=interruption,
         garbagecollection=gc,
         tagging=tagging,
         nodeclass_hash=nc_hash,

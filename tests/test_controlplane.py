@@ -29,9 +29,12 @@ def _reset(env):
 
 
 def cmr_pool():
+    from karpenter_provider_aws_tpu.models import Disruption
+
     return NodePool(
         name="default",
         requirements=[Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m", "r"))],
+        disruption=Disruption(consolidate_after_s=None),
     )
 
 

@@ -1,0 +1,306 @@
+"""Disruption + interruption behavior (reference: designs/consolidation.md,
+pkg/controllers/interruption suite, scale deprovisioning suites)."""
+
+import numpy as np
+import pytest
+
+from karpenter_provider_aws_tpu.models import Disruption, NodePool, Operator, Requirement
+from karpenter_provider_aws_tpu.models import labels as lbl
+from karpenter_provider_aws_tpu.models.pod import make_pods
+from karpenter_provider_aws_tpu.testenv import new_environment
+
+
+@pytest.fixture(scope="module")
+def env():
+    return new_environment()
+
+
+@pytest.fixture(autouse=True)
+def _reset(env):
+    env.reset()
+    yield
+
+
+def pool_with(**disruption_kwargs):
+    disruption_kwargs.setdefault("budgets", ["100%"])
+    disruption_kwargs.setdefault("consolidate_after_s", None)
+    return NodePool(
+        name="default",
+        requirements=[Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m", "r"))],
+        disruption=Disruption(**disruption_kwargs),
+    )
+
+
+def provision(env, pods):
+    for p in pods:
+        env.cluster.apply(p)
+    env.step(3)
+    assert not env.cluster.pending_pods()
+
+
+class TestTermination:
+    def test_claim_delete_drains_and_terminates(self, env):
+        env.apply_defaults(pool_with())
+        pods = make_pods(5, "w", {"cpu": "1", "memory": "2Gi"})
+        provision(env, pods)
+        claim = next(
+            c for c in env.cluster.nodeclaims.values()
+            if env.cluster.pods_on_node(c.status.node_name)
+        )
+        provider_id = claim.status.provider_id
+        drained = env.cluster.pods_on_node(claim.status.node_name)
+        assert drained
+        env.cluster.delete(claim)
+        env.termination.reconcile()
+        # pods evicted back to pending, instance gone, claim finalized
+        assert claim.name not in env.cluster.nodeclaims
+        with pytest.raises(Exception):
+            env.cloudprovider.get(provider_id)
+        assert all(p.is_pending() for p in drained)
+
+    def test_drained_pods_reprovisioned(self, env):
+        env.apply_defaults(pool_with())
+        pods = make_pods(5, "w", {"cpu": "1", "memory": "2Gi"})
+        provision(env, pods)
+        claim = next(iter(env.cluster.nodeclaims.values()))
+        env.cluster.delete(claim)
+        env.step(4)
+        assert not env.cluster.pending_pods()
+        assert len(env.cluster.nodes) >= 1
+
+
+class TestScheduler:
+    def test_pending_pod_lands_on_existing_free_node(self, env):
+        env.apply_defaults(pool_with())
+        # a 6cpu pod lands on an 8-vcpu-class node, leaving headroom
+        provision(env, make_pods(1, "big", {"cpu": "6", "memory": "6Gi"}))
+        n_nodes = len(env.cluster.nodes)
+        extra = make_pods(2, "extra", {"cpu": "500m", "memory": "1Gi"})
+        for p in extra:
+            env.cluster.apply(p)
+        env.scheduling.reconcile()
+        assert all(not p.is_pending() for p in extra)
+        assert len(env.cluster.nodes) == n_nodes  # no new nodes
+
+    def test_scheduler_respects_taints_and_labels(self, env):
+        from karpenter_provider_aws_tpu.models import Taint
+
+        env.apply_defaults(pool_with())
+        provision(env, make_pods(2, "w", {"cpu": "1", "memory": "2Gi"}))
+        for node in env.cluster.nodes.values():
+            node.taints = [Taint(key="quarantine", effect="NoSchedule")]
+        p = make_pods(1, "x", {"cpu": "100m"})[0]
+        env.cluster.apply(p)
+        env.scheduling.reconcile()
+        assert p.is_pending()  # not tolerated -> not bound
+
+
+class TestEmptiness:
+    def test_empty_node_deleted_after_consolidate_after(self, env):
+        env.apply_defaults(pool_with(consolidation_policy="WhenEmpty", consolidate_after_s=30))
+        pods = make_pods(3, "w", {"cpu": "1", "memory": "2Gi"})
+        provision(env, pods)
+        for p in pods:  # all pods finish
+            env.cluster.delete(p)
+        env.disruption.reconcile()
+        assert not any(c.deleted for c in env.cluster.nodeclaims.values())  # too soon
+        env.clock.advance(31)
+        env.disruption.reconcile()
+        assert all(c.deleted for c in env.cluster.nodeclaims.values())
+
+
+class TestExpiration:
+    def test_expired_claims_disrupted(self, env):
+        env.apply_defaults(pool_with(expire_after_s=3600, consolidate_after_s=None))
+        provision(env, make_pods(3, "w", {"cpu": "1", "memory": "2Gi"}))
+        env.disruption.reconcile()
+        assert not any(c.deleted for c in env.cluster.nodeclaims.values())
+        env.clock.advance(3601)
+        env.disruption.reconcile()
+        assert all(c.deleted for c in env.cluster.nodeclaims.values())
+
+
+class TestDriftDisruption:
+    def test_static_drift_triggers_disruption(self, env):
+        env.apply_defaults(pool_with(consolidate_after_s=None))
+        provision(env, make_pods(2, "w", {"cpu": "1", "memory": "2Gi"}))
+        env.cluster.nodeclasses["default"].user_data = "changed"
+        env.disruption.reconcile()
+        assert any("drifted" in r for _, r in env.disruption.disrupted)
+
+
+class TestBudgets:
+    def test_budget_caps_disruptions_per_pass(self, env):
+        pool = pool_with(expire_after_s=60, consolidate_after_s=None)
+        pool.disruption.budgets = ["1"]
+        env.apply_defaults(pool)
+        # several nodes: one pod each, big enough that each pod needs its own node
+        provision(env, make_pods(4, "w", {"cpu": "60", "memory": "120Gi"}))
+        assert len(env.cluster.nodeclaims) >= 3
+        env.clock.advance(61)
+        env.disruption.reconcile()
+        assert sum(1 for c in env.cluster.nodeclaims.values() if c.deleted) == 1
+
+
+class TestConsolidation:
+    def test_underutilized_nodes_consolidated(self, env):
+        # consolidate only after a quiet window, so provisioning settles first
+        env.apply_defaults(pool_with(consolidate_after_s=60))
+        pods = make_pods(30, "w", {"cpu": "1", "memory": "2Gi"})
+        provision(env, pods)
+        # most pods finish: the remaining few should repack onto fewer nodes
+        for p in pods[4:]:
+            env.cluster.delete(p)
+        n_before = len(env.cluster.nodes)
+        assert n_before >= 2
+        env.clock.advance(61)
+        env.disruption.reconcile()
+        env.step(4)  # drain, rebind onto survivors, settle
+        assert not env.cluster.pending_pods()
+        assert len(env.cluster.nodes) < n_before
+        # cost must not have increased: survivors hold all remaining pods
+        assert sum(len(env.cluster.pods_on_node(n)) for n in env.cluster.nodes) == 4
+
+    def test_replace_with_cheaper_single_node(self, env):
+        env.apply_defaults(pool_with(consolidate_after_s=60))
+        # 3cpu pods pack onto big nodes (best cost-per-slot); shrinking the
+        # demand to 2 pods leaves one nearly-empty big node whose pods fit a
+        # far cheaper type -> single-node replace
+        pods = make_pods(20, "w", {"cpu": "3", "memory": "6Gi"})
+        provision(env, pods)
+        keep = env.cluster.pods_on_node(
+            next(iter(env.cluster.nodes.values())).name
+        )[:2]
+        for p in pods:
+            if p.uid not in {k.uid for k in keep}:
+                env.cluster.delete(p)
+        price_before = sum(
+            env.catalog.pricing.on_demand_price(env.catalog.get(n.instance_type()))
+            for n in env.cluster.nodes.values()
+        )
+        env.clock.advance(61)
+        env.disruption.reconcile()
+        env.step(4)
+        assert not env.cluster.pending_pods()
+        price_after = sum(
+            env.catalog.pricing.on_demand_price(env.catalog.get(n.instance_type()))
+            for n in env.cluster.nodes.values()
+        )
+        assert price_after < price_before
+        assert any("replace" in r or "delete" in r for _, r in env.disruption.disrupted)
+
+    def test_do_not_disrupt_respected(self, env):
+        env.apply_defaults(pool_with(consolidate_after_s=60))
+        pods = make_pods(
+            2, "w", {"cpu": "1", "memory": "2Gi"},
+            annotations={lbl.ANNOTATION_DO_NOT_DISRUPT: "true"},
+        )
+        provision(env, pods)
+        env.clock.advance(61)
+        env.disruption.reconcile()
+        assert not any(c.deleted for c in env.cluster.nodeclaims.values())
+
+
+class TestInterruption:
+    def _spot_claim(self, env):
+        env.apply_defaults(pool_with(consolidate_after_s=None))
+        provision(env, make_pods(3, "w", {"cpu": "1", "memory": "2Gi"}))
+        for claim in env.cluster.nodeclaims.values():
+            if claim.labels.get(lbl.CAPACITY_TYPE) == "spot":
+                return claim
+        return next(iter(env.cluster.nodeclaims.values()))
+
+    def test_spot_interruption_drains_and_masks(self, env):
+        claim = self._spot_claim(env)
+        iid = claim.status.provider_id.rsplit("/", 1)[-1]
+        env.queue.send({
+            "source": "aws.ec2",
+            "detail-type": "EC2 Spot Instance Interruption Warning",
+            "detail": {"instance-id": iid},
+        })
+        env.interruption.reconcile()
+        assert claim.deleted
+        itype = claim.labels[lbl.INSTANCE_TYPE_LABEL]
+        zone = claim.labels[lbl.TOPOLOGY_ZONE]
+        assert env.catalog.unavailable.is_unavailable(itype, zone, "spot")
+        assert len(env.queue) == 0
+
+    def test_rebalance_is_no_action(self, env):
+        claim = self._spot_claim(env)
+        iid = claim.status.provider_id.rsplit("/", 1)[-1]
+        env.queue.send({
+            "source": "aws.ec2",
+            "detail-type": "EC2 Instance Rebalance Recommendation",
+            "detail": {"instance-id": iid},
+        })
+        env.interruption.reconcile()
+        assert not claim.deleted
+        assert len(env.queue) == 0
+
+    def test_state_change_terminated_drains(self, env):
+        claim = self._spot_claim(env)
+        iid = claim.status.provider_id.rsplit("/", 1)[-1]
+        env.queue.send({
+            "source": "aws.ec2",
+            "detail-type": "EC2 Instance State-change Notification",
+            "detail": {"instance-id": iid, "state": "shutting-down"},
+        })
+        env.interruption.reconcile()
+        assert claim.deleted
+
+    def test_health_event_drains(self, env):
+        claim = self._spot_claim(env)
+        iid = claim.status.provider_id.rsplit("/", 1)[-1]
+        env.queue.send({
+            "source": "aws.health",
+            "detail-type": "AWS Health Event",
+            "detail": {"affectedEntities": [{"entityValue": iid}]},
+        })
+        env.interruption.reconcile()
+        assert claim.deleted
+
+    def test_unparseable_message_deleted(self, env):
+        env.apply_defaults(pool_with())
+        env.queue.send({"source": "junk", "detail-type": "garbage"})
+        env.queue.send("not even json {{{")
+        env.interruption.reconcile()
+        assert len(env.queue) == 0
+
+    def test_end_to_end_interruption_replacement(self, env):
+        claim = self._spot_claim(env)
+        pods_on = env.cluster.pods_on_node(claim.status.node_name)
+        iid = claim.status.provider_id.rsplit("/", 1)[-1]
+        env.queue.send({
+            "source": "aws.ec2",
+            "detail-type": "EC2 Spot Instance Interruption Warning",
+            "detail": {"instance-id": iid},
+        })
+        env.step(5)
+        assert not env.cluster.pending_pods()
+        for p in pods_on:
+            assert p.node_name and p.node_name != f"node-{claim.name}"
+
+
+class TestConsolidationKernel:
+    def test_repack_check_matches_numpy(self, env):
+        from karpenter_provider_aws_tpu.ops.consolidate import (
+            consolidatable,
+            encode_cluster,
+            repack_feasible_numpy,
+        )
+
+        env.apply_defaults(pool_with(consolidate_after_s=3600))
+        pods = make_pods(20, "w", {"cpu": "1", "memory": "2Gi"}) + make_pods(
+            6, "big", {"cpu": "8", "memory": "24Gi"}
+        )
+        provision(env, pods)
+        for p in pods[10:20]:
+            env.cluster.delete(p)
+        ct = encode_cluster(env.cluster, env.catalog)
+        if ct is None:
+            pytest.skip("no nodes")
+        can_device = consolidatable(ct)
+        for i in range(len(ct.node_names)):
+            host = repack_feasible_numpy(ct, ct.free, i) is not None
+            if not ct.blocked[i]:
+                assert bool(can_device[i]) == host, f"node {i}"

@@ -144,3 +144,19 @@ class TestICEFreshness:
         clock.advance(181)  # past the 3m ICE TTL
         # seq_num reflects expiry, so a fresh snapshot unmasks the offering
         assert cat.tensors().available[0, 0, 1]
+
+
+class TestPoolTemplateLabels:
+    def test_pool_labels_satisfy_matching_node_selector(self, catalog):
+        # team=ml is stamped onto nodes by the pool template; no instance
+        # type defines it, yet pods selecting it must schedule.
+        pool = NodePool(name="ml", labels={"team": "ml"})
+        pods = make_pods(2, "w", {"cpu": "1"}, node_selector={"team": "ml"})
+        res = TPUSolver().solve(pods, [pool], catalog)
+        assert res.pods_placed() == 2
+
+    def test_mismatched_pool_label_filters_pod(self, catalog):
+        pool = NodePool(name="ml", labels={"team": "ml"})
+        pods = make_pods(1, "w", {"cpu": "1"}, node_selector={"team": "web"})
+        res = TPUSolver().solve(pods, [pool], catalog)
+        assert res.pods_placed() == 0
