@@ -73,6 +73,7 @@ def main() -> None:
         jnp.asarray(problem.price),
         jnp.asarray(problem.group_window),
         jnp.asarray(problem.type_window),
+        jnp.asarray(problem.max_per_node),
     )
 
     def run():

@@ -1,0 +1,52 @@
+"""CLI entry point: ``python -m karpenter_provider_aws_tpu``.
+
+Parity: ``cmd/controller/main.go`` — parse options, build the operator,
+serve metrics/health, run reconcile loops until interrupted. With
+``--role sidecar`` it instead runs the gRPC solver sidecar that owns the
+TPU (the process split from the BASELINE north star).
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import sys
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    if argv[:2] == ["--role", "sidecar"] or "--sidecar" in argv:
+        from .runtime.sidecar import serve
+
+        address = "127.0.0.1:50151"
+        for i, a in enumerate(argv):
+            if a == "--address" and i + 1 < len(argv):
+                address = argv[i + 1]
+        server = serve(address)
+        print(f"solver sidecar on {address}", flush=True)
+        server.wait()
+        return 0
+
+    from .operator import Options, new_operator
+
+    options = Options.from_env_and_args(argv)
+    op = new_operator(options)
+    op.start()
+    print(f"karpenter-tpu operator running (metrics port {op.metrics_port})", flush=True)
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    try:
+        while not stop:
+            signal.pause()
+    finally:
+        op.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

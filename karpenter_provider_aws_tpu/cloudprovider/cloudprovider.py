@@ -135,6 +135,9 @@ class CloudProvider:
                 self.catalog.unavailable.mark_unavailable(
                     e.instance_type, e.zone, e.capacity_type
                 )
+                from ..metrics import ICE_EVENTS
+
+                ICE_EVENTS.inc(capacity_type=e.capacity_type)
             raise
         self.subnets.release_unused(subnet_by_zone, result.zone)
         return self._instance_to_claim(claim, result, nodeclass)

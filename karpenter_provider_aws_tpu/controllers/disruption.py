@@ -72,6 +72,9 @@ class DisruptionController:
         if budget.get(claim.nodepool_name, 0) <= 0:
             return False
         budget[claim.nodepool_name] -= 1
+        from ..metrics import DISRUPTION_ACTIONS
+
+        DISRUPTION_ACTIONS.inc(reason=reason.split(":")[0])
         self.disrupted.append((claim.name, reason))
         log.info("disrupting %s: %s", claim.name, reason)
         self.cluster.delete(claim)  # termination controller drains + reaps
@@ -121,7 +124,9 @@ class DisruptionController:
                 continue
             if self.cluster.pods_on_node(node.name):
                 continue
-            if now - node.created_at < after:
+            # quiet window from the last pod removal, not node age — a node
+            # that just emptied gets the full consolidateAfter grace
+            if now - max(node.created_at, node.last_pod_event) < after:
                 continue
             self._disrupt(claim, "empty", budget)
 
@@ -190,7 +195,12 @@ class DisruptionController:
                 ):
                     deleted_nodes.add(ni)
 
-        # 2. replace-with-cheaper for survivors.
+        # 2. replace-with-cheaper for survivors. Skipped whenever the delete
+        # phase disrupted anything this pass: the snapshot is stale and a
+        # replace could drain a node the delete-feasibility proof used as a
+        # repack target; the next reconcile re-evaluates from fresh state.
+        if deleted_nodes:
+            return
         for ni, type_name, new_price, offering_options in cheaper_replacement(
             ct, self.cloudprovider.catalog, nodepools=dict(pools)
         ):

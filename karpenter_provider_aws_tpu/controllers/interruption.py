@@ -105,6 +105,9 @@ class InterruptionController:
             event = parse_message(message.parsed())
         except Exception:
             event = InterruptionEvent("Unknown", (), False)
+        from ..metrics import INTERRUPTION_MESSAGES
+
+        INTERRUPTION_MESSAGES.inc(kind=event.kind)
         self.handled.append(event)
         for iid in event.instance_ids:
             claim = claims_by_instance.get(iid)

@@ -58,6 +58,10 @@ class ProvisioningController:
             self.cloudprovider.catalog,
             in_use=self.cluster.in_use_by_nodepool(),
         )
+        from ..metrics import SOLVE_DURATION, SOLVE_PODS
+
+        SOLVE_DURATION.observe(result.solve_seconds)
+        SOLVE_PODS.inc(len(pending))
         self.last_unschedulable = result.unschedulable
         for pod, reason in result.unschedulable:
             log.info("pod %s unschedulable: %s", pod.name, reason)
@@ -123,6 +127,9 @@ def launch_claim(cluster: Cluster, cloudprovider: CloudProvider, pool, spec: Nod
     cluster.apply(claim)
     try:
         cloudprovider.create(claim)
+        from ..metrics import NODES_CREATED
+
+        NODES_CREATED.inc(nodepool=pool.name)
         return claim
     except Exception as e:
         # ICE or launch failure: drop the claim; the unavailable cache now

@@ -1,0 +1,57 @@
+"""Catalog + pricing refresh singletons.
+
+Parity: ``pkg/controllers/providers/instancetype/controller.go:41-63`` and
+``pkg/controllers/providers/pricing/controller.go:42-57`` — 12h requeue
+singletons that refresh the instance-type catalog and the spot/on-demand
+price books. The refresh sources are injectable so production backends can
+plug in a live API while tests/regenerators use the deterministic model.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..catalog.provider import CatalogProvider
+from ..utils.cache import CacheTTL
+
+
+class CatalogRefreshController:
+    name = "catalog-refresh"
+    interval_s = CacheTTL.CATALOG_REFRESH_PERIOD
+
+    def __init__(self, catalog: CatalogProvider, source: Optional[Callable] = None):
+        self.catalog = catalog
+        self.source = source  # () -> list[InstanceType]; None = regenerate
+        self.refreshes = 0
+
+    def reconcile(self) -> None:
+        from ..catalog.instancetypes import generate_catalog
+
+        types = self.source() if self.source else generate_catalog(self.catalog.zones)
+        self.catalog.refresh(types)
+        self.refreshes += 1
+
+
+class PricingRefreshController:
+    name = "pricing-refresh"
+    interval_s = CacheTTL.PRICING_REFRESH_PERIOD
+
+    def __init__(
+        self,
+        catalog: CatalogProvider,
+        od_source: Optional[Callable] = None,
+        spot_source: Optional[Callable] = None,
+    ):
+        self.catalog = catalog
+        self.od_source = od_source      # () -> {type_name: price}
+        self.spot_source = spot_source  # () -> {(type_name, zone): price}
+        self.refreshes = 0
+
+    def reconcile(self) -> None:
+        # isolated-VPC mode: updates are dropped by the provider
+        # (pricing.go:164-170 parity).
+        if self.od_source:
+            self.catalog.pricing.update_on_demand(self.od_source())
+        if self.spot_source:
+            self.catalog.pricing.update_spot(self.spot_source())
+        self.refreshes += 1

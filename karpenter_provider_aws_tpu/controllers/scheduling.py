@@ -38,6 +38,39 @@ class SchedulingController:
             free[node.name] = node.allocatable.v - used
         return free
 
+    def _topology_allows(self, pod, node, nodes) -> bool:
+        """Hostname/zone topology checks on rebind — the solver enforces
+        these at provisioning time; binds onto existing capacity must not
+        silently break them."""
+        cap = pod.hostname_cap()
+        if cap < (1 << 30):
+            selectors = [
+                t.label_selector
+                for t in list(pod.anti_affinity) + list(pod.topology_spread)
+                if getattr(t, "topology_key", "") in ("kubernetes.io/hostname",)
+            ]
+            matching = sum(
+                1
+                for q in self.cluster.pods_on_node(node.name)
+                if any(all(q.labels.get(k) == v for k, v in sel.items()) for sel in selectors)
+            )
+            if matching >= cap:
+                return False
+        ztop = pod.zone_topology()
+        if ztop is not None and ztop[0] == "anti":
+            zone = node.zone()
+            for other in nodes.values():
+                if other.zone() != zone:
+                    continue
+                for q in self.cluster.pods_on_node(other.name):
+                    if any(
+                        all(q.labels.get(k) == v for k, v in a.label_selector.items())
+                        for a in pod.anti_affinity
+                        if a.topology_key == "topology.kubernetes.io/zone"
+                    ):
+                        return False
+        return True
+
     def reconcile(self) -> None:
         free = self._free_map()
         if not free:
@@ -58,6 +91,8 @@ class SchedulingController:
                 if not reqs.satisfied_by_labels(node.labels):
                     continue
                 if not pod.tolerates_all(node.taints):
+                    continue
+                if not self._topology_allows(pod, node, nodes):
                     continue
                 self.cluster.bind_pod(pod.uid, name, now=self.clock.now())
                 free[name] = f - pod.requests.v

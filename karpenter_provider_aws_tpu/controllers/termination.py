@@ -41,3 +41,6 @@ class TerminationController:
             if node is not None:
                 self.cluster.delete(node)
             self.cluster.finalize(claim)
+            from ..metrics import NODES_TERMINATED
+
+            NODES_TERMINATED.inc(nodepool=claim.nodepool_name)

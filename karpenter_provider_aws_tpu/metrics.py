@@ -1,0 +1,189 @@
+"""Prometheus-compatible metrics registry (text exposition format).
+
+Parity surface: the reference's prometheus instrumentation — instance-type
+gauges (pkg/providers/instancetype/metrics.go), batcher histograms
+(pkg/batcher/metrics.go), interruption counters
+(pkg/controllers/interruption/metrics.go), and the CloudProvider method
+decorator (cmd/controller/main.go:44 metrics.Decorate).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+
+def _fmt_labels(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class Counter:
+    def __init__(self, name: str, help_: str = ""):
+        self.name, self.help = name, help_
+        self._values: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        return self._values.get(tuple(sorted(labels.items())), 0.0)
+
+    def expose(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        for key, v in sorted(self._values.items()):
+            out.append(f"{self.name}{_fmt_labels(dict(key))} {v}")
+        return out
+
+
+class Gauge(Counter):
+    def set(self, value: float, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = value
+
+    def expose(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        for key, v in sorted(self._values.items()):
+            out.append(f"{self.name}{_fmt_labels(dict(key))} {v}")
+        return out
+
+
+class Histogram:
+    DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0)
+
+    def __init__(self, name: str, help_: str = "", buckets=DEFAULT_BUCKETS):
+        self.name, self.help = name, help_
+        self.buckets = tuple(buckets)
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, value: float, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * (len(self.buckets) + 1))
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+                    break
+            counts[-1] += 1  # +Inf (total observations)
+
+    def time(self, **labels):
+        hist = self
+
+        class _Timer:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                hist.observe(time.perf_counter() - self.t0, **labels)
+
+        return _Timer()
+
+    def expose(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        for key, counts in sorted(self._counts.items()):
+            labels = dict(key)
+            for i, b in enumerate(self.buckets):
+                lab = dict(labels, le=str(b))
+                out.append(f"{self.name}_bucket{_fmt_labels(lab)} {sum(counts[: i + 1])}")
+            lab = dict(labels, le="+Inf")
+            out.append(f"{self.name}_bucket{_fmt_labels(lab)} {counts[-1]}")
+            out.append(f"{self.name}_sum{_fmt_labels(labels)} {self._sums[key]}")
+            out.append(f"{self.name}_count{_fmt_labels(labels)} {counts[-1]}")
+        return out
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: list = []
+        self._lock = threading.Lock()
+        self._http: Optional[ThreadingHTTPServer] = None
+
+    def register(self, metric):
+        with self._lock:
+            self._metrics.append(metric)
+        return metric
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self.register(Counter(name, help_))
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return self.register(Gauge(name, help_))
+
+    def histogram(self, name: str, help_: str = "", buckets=Histogram.DEFAULT_BUCKETS) -> Histogram:
+        return self.register(Histogram(name, help_, buckets))
+
+    def expose(self) -> str:
+        with self._lock:
+            lines: list[str] = []
+            for m in self._metrics:
+                lines.extend(m.expose())
+        return "\n".join(lines) + "\n"
+
+    # -- /metrics endpoint -------------------------------------------------
+    def serve(self, port: int) -> int:
+        registry = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                if self.path not in ("/metrics", "/healthz"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = (
+                    registry.expose() if self.path == "/metrics" else "ok\n"
+                ).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # quiet
+                pass
+
+        self._http = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        thread = threading.Thread(target=self._http.serve_forever, daemon=True)
+        thread.start()
+        return self._http.server_address[1]
+
+    def stop(self) -> None:
+        if self._http is not None:
+            self._http.shutdown()
+            self._http = None
+
+
+# The default process-wide registry + well-known metrics (created lazily by
+# components; names mirror the reference's metric families).
+REGISTRY = Registry()
+
+SOLVE_DURATION = REGISTRY.histogram(
+    "karpenter_solver_solve_duration_seconds", "End-to-end Solve() latency"
+)
+SOLVE_PODS = REGISTRY.counter("karpenter_solver_pods_total", "Pods passed through Solve()")
+NODES_CREATED = REGISTRY.counter("karpenter_nodes_created_total", "Nodes launched")
+NODES_TERMINATED = REGISTRY.counter("karpenter_nodes_terminated_total", "Nodes terminated")
+DISRUPTION_ACTIONS = REGISTRY.counter(
+    "karpenter_disruption_actions_total", "Disruption actions by reason"
+)
+INTERRUPTION_MESSAGES = REGISTRY.counter(
+    "karpenter_interruption_messages_total", "Interruption queue messages by kind"
+)
+BATCH_SIZE = REGISTRY.histogram(
+    "karpenter_batcher_batch_size", "Requests per coalesced batch",
+    buckets=(1, 2, 5, 10, 50, 100, 500, 1000),
+)
+ICE_EVENTS = REGISTRY.counter(
+    "karpenter_insufficient_capacity_errors_total", "ICE occurrences"
+)

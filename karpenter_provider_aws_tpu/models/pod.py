@@ -115,6 +115,34 @@ class Pod:
     def is_pending(self) -> bool:
         return self.phase == "Pending" and not self.node_name
 
+    # -- topology views ----------------------------------------------------
+    def hostname_cap(self) -> int:
+        """Max replicas of this pod's group per node: 1 under self-matching
+        hostname anti-affinity, max_skew under a DoNotSchedule hostname
+        topology spread, else unbounded."""
+        cap = 1 << 30
+        for c in self.topology_spread:
+            if c.topology_key == lbl.HOSTNAME and c.when_unsatisfiable == "DoNotSchedule":
+                cap = min(cap, max(c.max_skew, 1))
+        for a in self.anti_affinity:
+            if a.topology_key == lbl.HOSTNAME and a.matches(self):
+                cap = min(cap, 1)
+        return cap
+
+    def zone_topology(self) -> Optional[tuple[str, int]]:
+        """('spread', max_skew) | ('anti', 1) | ('affinity', 0) | None for the
+        zone axis."""
+        for a in self.anti_affinity:
+            if a.topology_key == lbl.TOPOLOGY_ZONE and a.matches(self):
+                return ("anti", 1)
+        for c in self.topology_spread:
+            if c.topology_key == lbl.TOPOLOGY_ZONE and c.when_unsatisfiable == "DoNotSchedule":
+                return ("spread", max(c.max_skew, 1))
+        for a in self.affinity:
+            if a.topology_key == lbl.TOPOLOGY_ZONE and a.matches(self):
+                return ("affinity", 0)
+        return None
+
     # -- grouping (dedup) key ----------------------------------------------
     def scheduling_key(self) -> tuple:
         """Pods with equal keys are interchangeable to the solver; the

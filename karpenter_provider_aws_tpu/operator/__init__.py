@@ -1,0 +1,11 @@
+"""Operator runtime: options, wiring, metrics, validation.
+
+Reference parity: ``cmd/controller/main.go`` + ``pkg/operator`` — compose
+the providers, cloud provider, and all controllers from configuration, and
+start the manager.
+"""
+
+from .options import Options  # noqa: F401
+from .operator import Operator, new_operator  # noqa: F401
+from ..metrics import Registry, Counter, Gauge, Histogram, REGISTRY  # noqa: F401
+from .webhooks import admit, AdmissionError  # noqa: F401

@@ -1,0 +1,157 @@
+"""Operator: compose every component from Options and start the manager.
+
+Parity: ``cmd/controller/main.go:32-73`` + ``pkg/operator/operator.go`` —
+build the cloud session (here: the cloud backend handle), construct the ten
+providers, wrap the cloud provider in the metrics decorator, register core
++ cloud-specific controllers (interruption only when a queue is configured),
+and start the reconcile loops.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Optional
+
+from ..catalog.provider import CatalogProvider, OverheadOptions
+from ..cloudprovider.cloudprovider import CloudProvider
+from ..controllers import (
+    DisruptionController,
+    GarbageCollectionController,
+    InterruptionController,
+    Manager,
+    NodeClassHashController,
+    NodeClassStatusController,
+    NodeClassTerminationController,
+    ProvisioningController,
+    RegistrationController,
+    SchedulingController,
+    TaggingController,
+    TerminationController,
+)
+from ..controllers.refresh import CatalogRefreshController, PricingRefreshController
+from ..catalog.pricing import PricingProvider
+from ..scheduling.solver import HostSolver, TPUSolver
+from ..state.cluster import Cluster
+from ..utils.batcher import BatcherOptions
+from ..utils.clock import Clock, RealClock
+from ..metrics import REGISTRY
+from .options import Options
+
+log = logging.getLogger("karpenter.tpu.operator")
+
+
+@dataclass
+class Operator:
+    options: Options
+    cluster: Cluster
+    catalog: CatalogProvider
+    cloudprovider: CloudProvider
+    manager: Manager
+    metrics_port: int = 0
+
+    def start(self) -> None:
+        if self.options.metrics_port:
+            self.metrics_port = REGISTRY.serve(self.options.metrics_port)
+            log.info("metrics on 127.0.0.1:%d/metrics", self.metrics_port)
+        self.manager.start()
+
+    def stop(self) -> None:
+        self.manager.stop()
+        REGISTRY.stop()
+
+    def apply(self, obj):
+        """Admission-checked apply (webhook chain parity)."""
+        from .webhooks import admit
+
+        return self.cluster.apply(admit(obj))
+
+
+def _build_solver(options: Options):
+    if options.solver_backend == "host":
+        return HostSolver()
+    if options.solver_backend == "native":
+        from ..scheduling.native import NativeSolver
+
+        return NativeSolver()
+    if options.solver_backend == "grpc":
+        from ..runtime.sidecar import RemoteSolver, SolverClient
+
+        return RemoteSolver(SolverClient(options.solver_sidecar_target))
+    return TPUSolver(max_nodes=options.max_nodes_per_solve or None)
+
+
+def new_operator(
+    options: Optional[Options] = None,
+    cloud=None,
+    queue=None,
+    clock: Optional[Clock] = None,
+) -> Operator:
+    """Build the full control plane. ``cloud`` is the cloud backend handle
+    (the fake for tests; a real adapter in production)."""
+    options = options or Options.from_env_and_args()
+    clock = clock or RealClock()
+    if cloud is None:
+        from ..fake import FakeCloud
+
+        cloud = FakeCloud(clock=clock)
+
+    pricing = PricingProvider(isolated_vpc=options.isolated_vpc)
+    catalog = CatalogProvider(
+        pricing=pricing,
+        overhead=OverheadOptions(
+            vm_memory_overhead_percent=options.vm_memory_overhead_percent,
+            reserved_enis=options.reserved_enis,
+        ),
+        clock=clock,
+    )
+    cluster = Cluster(clock=clock)
+    cloudprovider = CloudProvider(
+        cloud,
+        catalog,
+        cluster,
+        clock=clock,
+        batcher_options=BatcherOptions(
+            idle_timeout_s=options.batch_idle_seconds,
+            max_timeout_s=options.batch_max_seconds,
+        ),
+    )
+    solver = _build_solver(options)
+
+    provisioning = ProvisioningController(cluster, solver, cloudprovider)
+    scheduling = SchedulingController(cluster, provisioning, clock=clock)
+    registration = RegistrationController(cluster, provisioning, clock=clock)
+    termination = TerminationController(cluster, cloudprovider)
+    disruption = DisruptionController(
+        cluster,
+        cloudprovider,
+        clock=clock,
+        drift_enabled=options.drift_enabled and options.gate("Drift", True),
+        provisioning=provisioning,
+    )
+    controllers = [
+        NodeClassStatusController(cluster, cloudprovider),
+        NodeClassHashController(cluster),
+        termination,
+        registration,
+        scheduling,
+        provisioning,
+        TaggingController(cluster, cloudprovider),
+        disruption,
+        GarbageCollectionController(cluster, cloudprovider, clock=clock),
+        NodeClassTerminationController(cluster, cloudprovider),
+        CatalogRefreshController(catalog),
+        PricingRefreshController(catalog),
+    ]
+    # parity: interruption controller registered iff a queue is configured
+    # (pkg/controllers/controllers.go:67-71)
+    if options.interruption_queue and queue is not None:
+        controllers.insert(2, InterruptionController(cluster, cloudprovider, queue))
+
+    return Operator(
+        options=options,
+        cluster=cluster,
+        catalog=catalog,
+        cloudprovider=cloudprovider,
+        manager=Manager(controllers),
+    )

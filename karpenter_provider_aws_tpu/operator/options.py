@@ -1,0 +1,81 @@
+"""Options: the layered flag/env configuration system.
+
+Parity: ``pkg/operator/options/options.go:35-86`` — every knob has a flag
+form and an env fallback (FLAG --cluster-name <-> env CLUSTER_NAME), values
+validate on load, and the resolved Options object is injected into every
+component constructor (the context-injection analogue).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from dataclasses import dataclass, field, fields
+from typing import Optional
+
+
+def _env(name: str, default, cast):
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    if cast is bool:
+        return raw.lower() in ("1", "true", "yes")
+    return cast(raw)
+
+
+@dataclass
+class Options:
+    cluster_name: str = "cluster-1"
+    cluster_endpoint: str = ""
+    isolated_vpc: bool = False                   # skips live pricing refresh
+    vm_memory_overhead_percent: float = 0.075
+    interruption_queue: str = ""                 # empty = controller disabled
+    reserved_enis: int = 0
+    batch_idle_seconds: float = 0.035            # createfleet.go:35
+    batch_max_seconds: float = 1.0
+    solver_backend: str = "tpu"                  # tpu | host | native | grpc
+    solver_sidecar_target: str = ""              # for solver_backend=grpc
+    max_nodes_per_solve: int = 0                 # 0 = auto bucket
+    metrics_port: int = 8080                     # 0 = disabled
+    drift_enabled: bool = True
+    feature_gates: str = ""                      # "Drift=true,SpotToSpot=false"
+
+    @staticmethod
+    def from_env_and_args(argv: Optional[list[str]] = None) -> "Options":
+        defaults = Options()
+        parser = argparse.ArgumentParser(prog="karpenter-tpu")
+        for f in fields(Options):
+            flag = "--" + f.name.replace("_", "-")
+            env_name = f.name.upper()
+            cast = type(getattr(defaults, f.name))
+            env_default = _env(env_name, getattr(defaults, f.name), cast)
+            if cast is bool:
+                parser.add_argument(flag, type=lambda s: s.lower() in ("1", "true", "yes"),
+                                    default=env_default)
+            else:
+                parser.add_argument(flag, type=cast, default=env_default)
+        ns = parser.parse_args(argv if argv is not None else [])
+        opts = Options(**vars(ns))
+        opts.validate()
+        return opts
+
+    def validate(self) -> None:
+        """Parity: options_validation.go."""
+        if not self.cluster_name:
+            raise ValueError("cluster-name is required")
+        if not 0.0 <= self.vm_memory_overhead_percent < 1.0:
+            raise ValueError("vm-memory-overhead-percent must be in [0, 1)")
+        if self.solver_backend not in ("tpu", "host", "native", "grpc"):
+            raise ValueError(f"unknown solver backend {self.solver_backend!r}")
+        if self.solver_backend == "grpc" and not self.solver_sidecar_target:
+            raise ValueError("solver-sidecar-target required for the grpc backend")
+        if self.batch_idle_seconds <= 0 or self.batch_max_seconds < self.batch_idle_seconds:
+            raise ValueError("batch windows must satisfy 0 < idle <= max")
+
+    def gate(self, name: str, default: bool = True) -> bool:
+        for pair in self.feature_gates.split(","):
+            if "=" in pair:
+                k, v = pair.split("=", 1)
+                if k.strip() == name:
+                    return v.strip().lower() in ("1", "true", "yes")
+        return default

@@ -1,0 +1,111 @@
+"""Admission: defaulting + validation for NodeClass and NodePool.
+
+Parity: ``pkg/webhooks/webhooks.go`` (knative defaulting/validation
+admission) and the CEL rules embedded in the EC2NodeClass CRD markers
+(``pkg/apis/v1beta1/ec2nodeclass_validation.go``). Without an apiserver the
+admission chain runs at apply time: ``admit(obj)`` defaults then validates,
+raising ``AdmissionError`` with every violation.
+"""
+
+from __future__ import annotations
+
+from ..models import labels as lbl
+from ..models.nodeclass import NodeClass
+from ..models.nodepool import NodePool
+from ..models.requirements import Operator, Requirement
+
+
+class AdmissionError(ValueError):
+    def __init__(self, violations: list[str]):
+        super().__init__("; ".join(violations))
+        self.violations = violations
+
+
+# -- NodeClass ---------------------------------------------------------------
+
+def default_nodeclass(nc: NodeClass) -> NodeClass:
+    if not nc.image_family:
+        nc.image_family = "standard"
+    if not nc.block_devices:
+        from ..models.nodeclass import BlockDevice
+
+        nc.block_devices = [BlockDevice()]
+    return nc
+
+
+def validate_nodeclass(nc: NodeClass) -> None:
+    v: list[str] = []
+    if nc.role and nc.instance_profile:
+        v.append("role and instanceProfile are mutually exclusive")  # CEL rule parity
+    if not nc.role and not nc.instance_profile:
+        v.append("one of role or instanceProfile is required")
+    if nc.image_family not in ("standard", "minimal", "gpu", "custom"):
+        v.append(f"unknown imageFamily {nc.image_family!r}")
+    if nc.image_family == "custom" and not nc.image_selector:
+        v.append("imageFamily custom requires imageSelector terms")
+    for term in nc.subnet_selector + nc.security_group_selector + nc.image_selector:
+        if not term.id and not term.tags and not term.name:
+            v.append("selector terms must set id, name, or tags")
+    for bd in nc.block_devices:
+        if bd.volume_size_gib <= 0:
+            v.append("block device volume size must be positive")
+    mo = nc.metadata_options
+    if mo.http_tokens not in ("required", "optional"):
+        v.append("metadataOptions.httpTokens must be required|optional")
+    if not 1 <= mo.http_put_response_hop_limit <= 64:
+        v.append("metadataOptions hop limit must be in [1, 64]")
+    if any(k.startswith("karpenter.tpu/") for k in nc.tags):
+        v.append("tags may not use the karpenter.tpu/ namespace")
+    if v:
+        raise AdmissionError(v)
+
+
+# -- NodePool ----------------------------------------------------------------
+
+def default_nodepool(pool: NodePool) -> NodePool:
+    if not pool.requirements:
+        pool.requirements = [
+            Requirement(lbl.CAPACITY_TYPE, Operator.IN, tuple(lbl.CAPACITY_TYPES)),
+        ]
+    return pool
+
+
+def validate_nodepool(pool: NodePool) -> None:
+    v: list[str] = []
+    for r in pool.requirements:
+        if r.key in lbl.RESTRICTED_LABELS:
+            v.append(f"requirement on restricted label {r.key}")
+        if r.min_values is not None and r.min_values < 1:
+            v.append("minValues must be >= 1")
+    for key in pool.labels:
+        if key in lbl.RESTRICTED_LABELS or key == lbl.NODEPOOL:
+            v.append(f"template label {key} is restricted")
+    d = pool.disruption
+    if d.consolidation_policy not in ("WhenEmpty", "WhenUnderutilized"):
+        v.append(f"unknown consolidationPolicy {d.consolidation_policy!r}")
+    if d.consolidate_after_s is not None and d.consolidate_after_s < 0:
+        v.append("consolidateAfter must be >= 0")
+    if d.expire_after_s is not None and d.expire_after_s <= 0:
+        v.append("expireAfter must be positive")
+    for b in d.budgets:
+        try:
+            val = float(b[:-1]) if b.endswith("%") else int(b)
+            if val < 0:
+                v.append(f"budget {b!r} must be >= 0")
+        except ValueError:
+            v.append(f"malformed budget {b!r}")
+    if not pool.nodeclass_name:
+        v.append("nodeClassRef is required")
+    if v:
+        raise AdmissionError(v)
+
+
+def admit(obj):
+    """Default + validate (the webhook chain at apply time)."""
+    if isinstance(obj, NodeClass):
+        default_nodeclass(obj)
+        validate_nodeclass(obj)
+    elif isinstance(obj, NodePool):
+        default_nodepool(obj)
+        validate_nodepool(obj)
+    return obj

@@ -78,6 +78,12 @@ def encode_cluster(cluster, catalog, gmax: int = GMAX_DEFAULT) -> Optional[Clust
         for pod in cluster.pods_on_node(node.name):
             if pod.do_not_disrupt():
                 blocked[ni] = True
+            # Conservative: hostname/zone topology constraints are not
+            # representable in the repack feasibility check, so nodes
+            # carrying such pods are never consolidation candidates (the
+            # proof would be unsound otherwise).
+            if pod.hostname_cap() < (1 << 30) or pod.zone_topology() is not None:
+                blocked[ni] = True
             key = pod.scheduling_key()
             gi = groups.get(key)
             if gi is None:

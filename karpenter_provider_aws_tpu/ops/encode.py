@@ -59,6 +59,8 @@ class EncodedProblem:
     # Marginal views kept for inspection/tests:
     group_zone_allowed: np.ndarray = None     # [G, Z] bool
     group_captype_allowed: np.ndarray = None  # [G, 2] bool
+    # Hostname-topology cap: max replicas of the group on one node.
+    max_per_node: np.ndarray = None           # [G] int32
     unencodable: list[tuple[Pod, str]] = field(default_factory=list)
 
     @property
@@ -168,7 +170,55 @@ def encode_problem(
             continue
         groups.setdefault(pod.scheduling_key(), []).append(pod)
 
-    group_list = list(groups.values())
+    # -- topology expansion ------------------------------------------------
+    # Zone-level constraints are resolved HOST-side by splitting a group into
+    # zone-pinned subgroups (balanced shares for topology spread, one pod per
+    # zone for anti-affinity, a single zone for affinity); the device solver
+    # then only ever sees per-group zone windows. Hostname-level constraints
+    # become a per-group max-per-node cap enforced inside the scan
+    # (SURVEY.md section 7.4: "topology as iterative masked rounds").
+    zone_names = list(tensors.zones)
+    pool_zone_vs = pool_reqs.get(lbl.TOPOLOGY_ZONE)
+
+    expanded: list[tuple[list[Pod], Optional[int], int]] = []  # (pods, zone_idx, mpn)
+    for plist in groups.values():
+        pod = plist[0]
+        mpn = pod.hostname_cap()
+        ztop = pod.zone_topology()
+        allowed_z = [
+            zi for zi, z in enumerate(zone_names)
+            if pod.requirements().get(lbl.TOPOLOGY_ZONE).contains(z)
+            and pool_zone_vs.contains(z)
+        ]
+        if ztop is None or not allowed_z:
+            expanded.append((plist, None, mpn))
+            continue
+        mode, skew = ztop
+        if mode == "affinity":
+            # co-locate: restrict the whole group to one zone — prefer a
+            # zone that still has live offerings (ICE considered)
+            live_zones = tensors.available.any(axis=(0, 2))  # [Z]
+            pin = next((zi for zi in allowed_z if live_zones[zi]), allowed_z[0])
+            expanded.append((plist, pin, mpn))
+        elif mode == "anti":
+            for i, pod_i in enumerate(plist):
+                if i < len(allowed_z):
+                    expanded.append(([pod_i], allowed_z[i], mpn))
+                else:
+                    unencodable.append(
+                        (pod_i, "zone anti-affinity: more replicas than zones")
+                    )
+        else:  # spread: balanced shares, skew <= 1 <= max_skew
+            n, k = len(plist), len(allowed_z)
+            base, extra = divmod(n, k)
+            start = 0
+            for j, zi in enumerate(allowed_z):
+                take = base + (1 if j < extra else 0)
+                if take:
+                    expanded.append((plist[start : start + take], zi, mpn))
+                    start += take
+
+    group_list = [e[0] for e in expanded]
     G = len(group_list)
 
     requests = np.zeros((max(G, 1), NUM_RESOURCES), dtype=np.float32)
@@ -178,6 +228,7 @@ def encode_problem(
     zone_allowed = np.zeros((max(G, 1), Z), dtype=bool)
     captype_allowed = np.zeros((max(G, 1), 2), dtype=bool)
     group_window = np.zeros((max(G, 1), Z, 2), dtype=bool)
+    max_per_node = np.full(max(G, 1), 1 << 30, dtype=np.int32)
 
     # Cache key: catalog seqnum + names — a refresh() bumps the seq even when
     # type names are unchanged, so stale label arrays can't be served.
@@ -188,10 +239,11 @@ def encode_problem(
     # construction on any launched node, never constraints on the type itself.
     provided_keys = set(nodepool.labels) if nodepool else set()
 
-    for gi, plist in enumerate(group_list):
+    for gi, (plist, zone_pin, mpn) in enumerate(expanded):
         pod = plist[0]
         requests[gi] = pod.requests.v
         counts[gi] = len(plist)
+        max_per_node[gi] = mpn
         reqs = _group_requirements(pod, nodepool)
 
         # Offering-level allowances: which zones / capacity types may serve
@@ -199,6 +251,10 @@ def encode_problem(
         zvs = reqs.get(lbl.TOPOLOGY_ZONE)
         cvs = reqs.get(lbl.CAPACITY_TYPE)
         zone_allowed[gi] = [zvs.contains(z) for z in tensors.zones]
+        if zone_pin is not None:
+            pin = np.zeros(Z, dtype=bool)
+            pin[zone_pin] = True
+            zone_allowed[gi] &= pin
         captype_allowed[gi] = [cvs.contains(ct) for ct in lbl.CAPACITY_TYPES]
         group_window[gi] = zone_allowed[gi][:, None] & captype_allowed[gi][None, :]
 
@@ -243,6 +299,7 @@ def encode_problem(
         zone_allowed[:G] = zone_allowed[:G][order]
         captype_allowed[:G] = captype_allowed[:G][order]
         group_window[:G] = group_window[:G][order]
+        max_per_node[:G] = max_per_node[:G][order]
         group_list = [group_list[i] for i in order]
 
     return EncodedProblem(
@@ -259,6 +316,7 @@ def encode_problem(
         type_window=tensors.available.copy(),
         group_zone_allowed=zone_allowed,
         group_captype_allowed=captype_allowed,
+        max_per_node=max_per_node,
         unencodable=unencodable,
     )
 
@@ -289,5 +347,6 @@ def pad_problem(p: EncodedProblem, group_bucket: Optional[int] = None) -> Encode
         type_window=p.type_window,
         group_zone_allowed=padg(p.group_zone_allowed),
         group_captype_allowed=padg(p.group_captype_allowed),
+        max_per_node=padg(p.max_per_node, fill=1 << 30),
         unencodable=p.unencodable,
     )

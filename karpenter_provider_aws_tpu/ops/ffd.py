@@ -72,7 +72,7 @@ def _fit_counts(cap_rem: jnp.ndarray, req: jnp.ndarray) -> jnp.ndarray:
 
 
 def _step(capacity: jnp.ndarray, type_window: jnp.ndarray, state: _State, item):
-    req, cnt, compat_g, price_g, gw = item
+    req, cnt, compat_g, price_g, gw, mpn = item
     N = state.used.shape[0]
     idx = jnp.arange(N)
     valid = idx < state.n_open
@@ -81,6 +81,8 @@ def _step(capacity: jnp.ndarray, type_window: jnp.ndarray, state: _State, item):
     window_ok = (state.node_window & gw[None, :, :]).any((-2, -1))
     node_ok = valid & compat_g[state.node_type] & window_ok
     k_fit = _fit_counts(state.node_cap - state.used, req)
+    # hostname topology: at most mpn replicas of this group per node
+    k_fit = jnp.minimum(k_fit, mpn)
     k_fit = jnp.where(node_ok, k_fit, 0)
     cum_before = jnp.cumsum(k_fit) - k_fit
     place = jnp.clip(cnt - cum_before, 0, k_fit)
@@ -107,11 +109,11 @@ def _step(capacity: jnp.ndarray, type_window: jnp.ndarray, state: _State, item):
     def open_body(carry):
         (node_type, node_price, used, node_cap, node_window, n_open,
          rem, unplaced, opened_take) = carry
-        eff = jnp.minimum(k_type, jnp.maximum(rem, 1))
+        eff = jnp.minimum(jnp.minimum(k_type, mpn), jnp.maximum(rem, 1))
         score = jnp.where(feasible, price_g / jnp.maximum(eff, 1), jnp.inf)
         t_star = jnp.argmin(score)
         ok = jnp.isfinite(score[t_star])
-        k_star = jnp.maximum(k_type[t_star], 1)
+        k_star = jnp.maximum(jnp.minimum(k_type[t_star], mpn), 1)
         room = N - n_open
 
         q_full = rem // k_star
@@ -165,6 +167,7 @@ def ffd_solve(
     price: jnp.ndarray,        # [G, T] float32, inf where unusable
     group_window: jnp.ndarray, # [G, Z, 2] bool (zone, captype) the group allows
     type_window: jnp.ndarray,  # [T, Z, 2] bool live offerings per type
+    max_per_node: jnp.ndarray = None,  # [G] int32 hostname-topology cap
     max_nodes: int = 1024,
     init_state: _State | None = None,
 ) -> FFDResult:
@@ -175,6 +178,8 @@ def ffd_solve(
     """
     G, R = requests.shape
     Z = group_window.shape[1]
+    if max_per_node is None:
+        max_per_node = jnp.full(G, 1 << 30, dtype=jnp.int32)
     if init_state is None:
         init_state = _State(
             node_type=jnp.zeros(max_nodes, dtype=jnp.int32),
@@ -187,7 +192,7 @@ def ffd_solve(
 
     step = functools.partial(_step, capacity, type_window)
     final, (placed, unplaced) = jax.lax.scan(
-        step, init_state, (requests, counts, compat, price, group_window)
+        step, init_state, (requests, counts, compat, price, group_window, max_per_node)
     )
     return FFDResult(
         node_type=final.node_type,

@@ -42,14 +42,15 @@ def sharded_solve_fn(mesh: Mesh, max_nodes: int):
         jax.shard_map,
         mesh=mesh,
         in_specs=(P(POD_AXIS), P(POD_AXIS), P(POD_AXIS), P(), P(POD_AXIS),
-                  P(POD_AXIS), P()),
+                  P(POD_AXIS), P(), P(POD_AXIS)),
         out_specs=(P(POD_AXIS), P(POD_AXIS, None), P(POD_AXIS), P(POD_AXIS), P()),
         check_vma=False,
     )
     def _solve_shard(requests, counts, compat, capacity, price,
-                     group_window, type_window):
+                     group_window, type_window, max_per_node):
         res = ffd_solve(requests, counts, compat, capacity, price,
-                        group_window, type_window, max_nodes=max_nodes)
+                        group_window, type_window, max_per_node=max_per_node,
+                        max_nodes=max_nodes)
         live = jnp.arange(max_nodes) < res.n_open
         local_cost = jnp.where(live, res.node_price, 0.0).sum()
         total_cost = jax.lax.psum(local_cost, POD_AXIS)
@@ -91,6 +92,7 @@ def solve_sharded(problem, mesh: Mesh, max_nodes: int = 1024):
         jax.device_put(jnp.asarray(padded.price), shard),
         jax.device_put(jnp.asarray(padded.group_window), shard),
         jax.device_put(jnp.asarray(padded.type_window), rep),
+        jax.device_put(jnp.asarray(padded.max_per_node), shard),
     )
     node_type, used, n_open, unplaced, total_cost = fn(*args)
     return (

@@ -1,0 +1,10 @@
+"""The gRPC sidecar: the Go<->device process boundary.
+
+Reference-domain analogue (SURVEY.md sections 2.3, 5): where the reference's
+controllers call AWS over REST and coalesce via the batcher, this framework's
+control plane ships the packed problem tensors to the device-owning sidecar
+over gRPC; ICI/XLA collectives handle multi-chip inside, DCN/gRPC handles
+host boundaries outside.
+"""
+
+from .sidecar import SolverServer, SolverClient, serve  # noqa: F401

@@ -1,0 +1,196 @@
+"""Solver sidecar: gRPC server + client carrying npz tensor bundles.
+
+Service contract in ``solver.proto``. Methods are registered with grpc's
+generic handlers (no codegen dependency); payloads are npz archives of the
+same tensors the in-process solver consumes, so the sidecar is a thin
+process boundary around ``ops.ffd.ffd_solve`` / ``ops.consolidate``.
+"""
+
+from __future__ import annotations
+
+import io
+import logging
+from concurrent import futures
+from typing import Optional
+
+import grpc
+import numpy as np
+
+log = logging.getLogger("karpenter.tpu.sidecar")
+
+SERVICE = "karpenter.tpu.v1.Solver"
+
+
+def pack(**arrays) -> bytes:
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **arrays)
+    return buf.getvalue()
+
+
+def unpack(blob: bytes) -> dict[str, np.ndarray]:
+    with np.load(io.BytesIO(blob)) as z:
+        return {k: z[k] for k in z.files}
+
+
+class SolverServer:
+    """Owns the device; serves Solve / SimulateConsolidation / Health."""
+
+    def __init__(self, address: str = "127.0.0.1:0", max_workers: int = 4):
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+        handlers = {
+            "Solve": grpc.unary_unary_rpc_method_handler(
+                self._solve,
+                request_deserializer=bytes,
+                response_serializer=bytes,
+            ),
+            "SimulateConsolidation": grpc.unary_unary_rpc_method_handler(
+                self._simulate,
+                request_deserializer=bytes,
+                response_serializer=bytes,
+            ),
+            "Health": grpc.unary_unary_rpc_method_handler(
+                self._health,
+                request_deserializer=bytes,
+                response_serializer=bytes,
+            ),
+        }
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE, handlers),)
+        )
+        self.port = self._server.add_insecure_port(address)
+
+    # -- handlers ----------------------------------------------------------
+    def _solve(self, request: bytes, context) -> bytes:
+        import jax.numpy as jnp
+
+        from ..ops.ffd import ffd_solve
+
+        t = unpack(request)
+        max_nodes = int(t.get("max_nodes", np.int32(1024)))
+        res = ffd_solve(
+            jnp.asarray(t["requests"]),
+            jnp.asarray(t["counts"]),
+            jnp.asarray(t["compat"]),
+            jnp.asarray(t["capacity"]),
+            jnp.asarray(t["price"]),
+            jnp.asarray(t["group_window"]),
+            jnp.asarray(t["type_window"]),
+            max_per_node=jnp.asarray(t["max_per_node"]) if "max_per_node" in t else None,
+            max_nodes=max_nodes,
+        )
+        return pack(
+            node_type=np.asarray(res.node_type),
+            node_price=np.asarray(res.node_price),
+            used=np.asarray(res.used),
+            node_window=np.asarray(res.node_window),
+            n_open=np.asarray(res.n_open, dtype=np.int32),
+            placed=np.asarray(res.placed),
+            unplaced=np.asarray(res.unplaced),
+        )
+
+    def _simulate(self, request: bytes, context) -> bytes:
+        import jax.numpy as jnp
+
+        from ..ops.consolidate import repack_check
+
+        t = unpack(request)
+        ok = repack_check(
+            jnp.asarray(t["free"]),
+            jnp.asarray(t["requests"]),
+            jnp.asarray(t["group_ids"]),
+            jnp.asarray(t["group_counts"]),
+            jnp.asarray(t["compat"]),
+            jnp.asarray(t["candidates"]),
+        )
+        return pack(ok=np.asarray(ok))
+
+    def _health(self, request: bytes, context) -> bytes:
+        import jax
+
+        return pack(device_count=np.asarray(len(jax.devices()), dtype=np.int32))
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> int:
+        self._server.start()
+        log.info("solver sidecar listening on port %d", self.port)
+        return self.port
+
+    def stop(self, grace: float = 1.0) -> None:
+        self._server.stop(grace)
+
+    def wait(self) -> None:
+        self._server.wait_for_termination()
+
+
+class SolverClient:
+    """Tensor-bundle client; also usable as a TPUSolver drop-in through
+    ``RemoteSolver`` below."""
+
+    def __init__(self, target: str):
+        self._channel = grpc.insecure_channel(target)
+
+    def _call(self, method: str, payload: bytes) -> bytes:
+        fn = self._channel.unary_unary(
+            f"/{SERVICE}/{method}",
+            request_serializer=bytes,
+            response_deserializer=bytes,
+        )
+        return fn(payload)
+
+    def solve(self, **tensors) -> dict[str, np.ndarray]:
+        return unpack(self._call("Solve", pack(**tensors)))
+
+    def simulate_consolidation(self, **tensors) -> dict[str, np.ndarray]:
+        return unpack(self._call("SimulateConsolidation", pack(**tensors)))
+
+    def health(self) -> int:
+        return int(unpack(self._call("Health", pack()))["device_count"])
+
+    def close(self) -> None:
+        self._channel.close()
+
+
+class RemoteSolver:
+    """Solver-plugin implementation backed by a sidecar: encode host-side,
+    solve across the process boundary, decode host-side (the exact split the
+    BASELINE north star describes for the Go control plane)."""
+
+    def __init__(self, client: SolverClient, max_nodes: Optional[int] = None):
+        self.client = client
+        self.max_nodes = max_nodes
+
+    def solve_encoded(self, problem):
+        from ..ops.encode import bucket, pad_problem
+        from .solver_bridge import decode_remote
+
+        G = len(problem.group_pods)
+        if G == 0:
+            return [], {}
+        num_pods = int(problem.counts[:G].sum())
+        from ..scheduling.solver import _node_bucket
+
+        N = self.max_nodes or _node_bucket(num_pods)
+        padded = pad_problem(problem, bucket(G))
+        out = self.client.solve(
+            requests=padded.requests,
+            counts=padded.counts,
+            compat=padded.compat,
+            capacity=padded.capacity,
+            price=padded.price,
+            group_window=padded.group_window,
+            type_window=padded.type_window,
+            max_per_node=padded.max_per_node,
+            max_nodes=np.int32(N),
+        )
+        return decode_remote(problem, out)
+
+    def solve(self, pods, nodepools, catalog, in_use=None):
+        from ..scheduling.solver import _solve_multi_nodepool
+
+        return _solve_multi_nodepool(self, pods, nodepools, catalog, in_use)
+
+
+def serve(address: str = "127.0.0.1:50151") -> SolverServer:
+    server = SolverServer(address)
+    server.start()
+    return server

@@ -1,0 +1,24 @@
+"""Decode helpers shared by the remote solver path."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..scheduling.solver import _decode_nodes
+
+
+def decode_remote(problem, out: dict[str, np.ndarray]):
+    G = len(problem.group_pods)
+    n_open = int(out["n_open"])
+    specs = _decode_nodes(
+        problem,
+        out["node_type"],
+        out["node_price"],
+        out["used"],
+        n_open,
+        out["placed"],
+        problem.nodepool.name if problem.nodepool else "",
+        out["node_window"].astype(bool),
+    )
+    unplaced = {g: int(c) for g, c in enumerate(out["unplaced"][:G]) if c > 0}
+    return specs, unplaced

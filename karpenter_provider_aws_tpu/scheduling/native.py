@@ -1,0 +1,149 @@
+"""NativeSolver: the C++ FFD fallback, loaded via ctypes.
+
+Builds ``native/ffd.cpp`` into a shared library on first use (cached under
+``native/build/``) and exposes it behind the same ``solve_encoded`` contract
+as TPUSolver/HostSolver. This is the framework's native runtime component:
+the always-available in-process heuristic (reference analogue: the Go
+scheduler itself), independent of JAX/TPU.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+from ..ops.encode import EncodedProblem
+from .solver import NodeSpec, _decode_nodes, _solve_multi_nodepool
+
+_REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+_SRC = _REPO_ROOT / "native" / "ffd.cpp"
+_BUILD_DIR = _REPO_ROOT / "native" / "build"
+
+_lib_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def _build_library() -> Path:
+    src = _SRC.read_bytes()
+    digest = hashlib.sha256(src).hexdigest()[:16]
+    out = _BUILD_DIR / f"libffd-{digest}.so"
+    if out.exists():
+        return out
+    _BUILD_DIR.mkdir(parents=True, exist_ok=True)
+    tmp = out.with_suffix(".so.tmp")
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", str(_SRC), "-o", str(tmp)]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise NativeBuildError(f"native build failed: {proc.stderr}")
+    os.replace(tmp, out)
+    return out
+
+
+def load_library() -> ctypes.CDLL:
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        lib = ctypes.CDLL(str(_build_library()))
+        lib.ffd_solve_native.restype = ctypes.c_int
+        lib.ffd_solve_native.argtypes = [
+            ctypes.POINTER(ctypes.c_float),    # requests
+            ctypes.POINTER(ctypes.c_int32),    # counts
+            ctypes.POINTER(ctypes.c_uint8),    # compat
+            ctypes.POINTER(ctypes.c_float),    # capacity
+            ctypes.POINTER(ctypes.c_float),    # price
+            ctypes.POINTER(ctypes.c_uint8),    # group_window
+            ctypes.POINTER(ctypes.c_uint8),    # type_window
+            ctypes.POINTER(ctypes.c_int32),    # max_per_node
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int32),    # node_type
+            ctypes.POINTER(ctypes.c_float),    # node_price
+            ctypes.POINTER(ctypes.c_float),    # used
+            ctypes.POINTER(ctypes.c_uint8),    # node_window
+            ctypes.POINTER(ctypes.c_int32),    # placed
+            ctypes.POINTER(ctypes.c_int32),    # unplaced
+        ]
+        _lib = lib
+        return lib
+
+
+def native_available() -> bool:
+    try:
+        load_library()
+        return True
+    except Exception:
+        return False
+
+
+def _ptr(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+class NativeSolver:
+    """C++ host solver behind the standard Solver interface."""
+
+    def __init__(self, max_nodes: Optional[int] = None):
+        self.max_nodes = max_nodes
+        load_library()
+
+    def solve_encoded(self, problem: EncodedProblem) -> tuple[list[NodeSpec], dict[int, int]]:
+        G = len(problem.group_pods)
+        if G == 0:
+            return [], {}
+        T, R = problem.capacity.shape
+        Z = problem.group_window.shape[1]
+        W = Z * 2
+        num_pods = int(problem.counts[:G].sum())
+        N = self.max_nodes or max(num_pods, 1)
+
+        requests = np.ascontiguousarray(problem.requests[:G], dtype=np.float32)
+        counts = np.ascontiguousarray(problem.counts[:G], dtype=np.int32)
+        compat = np.ascontiguousarray(problem.compat[:G], dtype=np.uint8)
+        capacity = np.ascontiguousarray(problem.capacity, dtype=np.float32)
+        price = np.ascontiguousarray(problem.price[:G], dtype=np.float32)
+        gw = np.ascontiguousarray(
+            problem.group_window[:G].reshape(G, W), dtype=np.uint8
+        )
+        tw = np.ascontiguousarray(problem.type_window.reshape(T, W), dtype=np.uint8)
+        mpn = np.ascontiguousarray(problem.max_per_node[:G], dtype=np.int32)
+
+        node_type = np.zeros(N, dtype=np.int32)
+        node_price = np.zeros(N, dtype=np.float32)
+        used = np.zeros((N, R), dtype=np.float32)
+        node_window = np.zeros((N, W), dtype=np.uint8)
+        placed = np.zeros((G, N), dtype=np.int32)
+        unplaced = np.zeros(G, dtype=np.int32)
+
+        lib = load_library()
+        n_open = lib.ffd_solve_native(
+            _ptr(requests, ctypes.c_float), _ptr(counts, ctypes.c_int32),
+            _ptr(compat, ctypes.c_uint8), _ptr(capacity, ctypes.c_float),
+            _ptr(price, ctypes.c_float), _ptr(gw, ctypes.c_uint8),
+            _ptr(tw, ctypes.c_uint8), _ptr(mpn, ctypes.c_int32),
+            G, T, R, W, N,
+            _ptr(node_type, ctypes.c_int32), _ptr(node_price, ctypes.c_float),
+            _ptr(used, ctypes.c_float), _ptr(node_window, ctypes.c_uint8),
+            _ptr(placed, ctypes.c_int32), _ptr(unplaced, ctypes.c_int32),
+        )
+        if n_open < 0:
+            raise RuntimeError("native solver rejected inputs")
+        specs = _decode_nodes(
+            problem, node_type, node_price, used, n_open, placed,
+            problem.nodepool.name if problem.nodepool else "",
+            node_window.reshape(N, Z, 2).astype(bool),
+        )
+        return specs, {g: int(c) for g, c in enumerate(unplaced) if c > 0}
+
+    def solve(self, pods, nodepools, catalog, in_use=None):
+        return _solve_multi_nodepool(self, pods, nodepools, catalog, in_use)

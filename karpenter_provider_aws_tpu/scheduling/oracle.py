@@ -46,6 +46,7 @@ def ffd_oracle(problem: EncodedProblem, max_nodes: int = 100000) -> tuple[list[O
         compat = problem.compat[g]
         price = problem.price[g]
         gw = problem.group_window[g]
+        mpn = int(problem.max_per_node[g]) if problem.max_per_node is not None else 1 << 30
         # 1. first-fit across open nodes, one pod at a time (literal FFD).
         for node in nodes:
             if cnt == 0:
@@ -54,7 +55,7 @@ def ffd_oracle(problem: EncodedProblem, max_nodes: int = 100000) -> tuple[list[O
                 continue
             if not (node.window & gw).any():
                 continue
-            k = _fit_count(node.cap - node.used, req)
+            k = min(_fit_count(node.cap - node.used, req), mpn)
             take = min(k, cnt)
             if take > 0:
                 node.used = node.used + req * take
@@ -74,10 +75,10 @@ def ffd_oracle(problem: EncodedProblem, max_nodes: int = 100000) -> tuple[list[O
         while cnt > 0 and len(nodes) < max_nodes:
             if not feasible.any():
                 break
-            eff = np.minimum(k_type, max(cnt, 1)).astype(np.float32)
+            eff = np.minimum(np.minimum(k_type, mpn), max(cnt, 1)).astype(np.float32)
             score = np.where(feasible, price.astype(np.float32) / np.maximum(eff, 1), np.inf).astype(np.float32)
             t = int(score.argmin())
-            take = min(int(k_type[t]), cnt)
+            take = min(int(k_type[t]), cnt, mpn)
             nodes.append(
                 OracleNode(
                     type_index=t,

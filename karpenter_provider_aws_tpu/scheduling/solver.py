@@ -183,6 +183,7 @@ class TPUSolver:
                 jnp.asarray(padded.price[sl]),
                 jnp.asarray(padded.group_window[sl]),
                 jnp.asarray(padded.type_window),
+                max_per_node=jnp.asarray(padded.max_per_node[sl]),
                 max_nodes=N,
                 init_state=state,
             )

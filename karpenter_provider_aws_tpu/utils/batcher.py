@@ -110,6 +110,12 @@ class Batcher(Generic[T, U]):
         self.batches_executed += 1
         self.batch_sizes.append(len(bucket))
         try:
+            from ..metrics import BATCH_SIZE
+
+            BATCH_SIZE.observe(len(bucket))
+        except Exception:
+            pass
+        try:
             results = self._executor([p.request for p in bucket])
             if len(results) != len(bucket):
                 raise RuntimeError(

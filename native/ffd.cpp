@@ -1,0 +1,139 @@
+// Native host-side FFD bin-packing solver.
+//
+// The framework's compute hot path runs on TPU (ops/ffd.py); this C++
+// implementation is the in-process fallback — the analogue of the
+// reference's Go scheduler heuristic (designs/bin-packing.md:29-43) — used
+// when no accelerator is available and as an independent cross-check of the
+// device kernel. Exposed via a C ABI for ctypes.
+//
+// Semantics are bit-compatible with scheduling/oracle.py: float32 score
+// arithmetic (price / effective-slots), first-fit fill in node order, full
+// nodes of the winning type batched, partial tails re-scored, joint
+// (zone x capacity-type) offering windows, hostname max-per-node caps.
+
+#include <cstdint>
+#include <cstring>
+#include <cmath>
+#include <limits>
+#include <vector>
+
+namespace {
+
+constexpr float kEps = 1e-4f;
+
+inline int fit_count(const float* cap, const float* used, const float* req, int R) {
+    float k = std::numeric_limits<float>::infinity();
+    for (int r = 0; r < R; ++r) {
+        if (req[r] > 0.0f) {
+            float rem = cap[r] - (used ? used[r] : 0.0f);
+            float q = std::floor((rem + kEps) / req[r]);
+            if (q < k) k = q;
+        }
+    }
+    if (!std::isfinite(k)) return 0;
+    if (k < 0.0f) k = 0.0f;
+    if (k > 2.0e9f) k = 2.0e9f;
+    return static_cast<int>(k);
+}
+
+inline bool window_intersects(const uint8_t* a, const uint8_t* b, int n) {
+    for (int i = 0; i < n; ++i)
+        if (a[i] && b[i]) return true;
+    return false;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns the number of opened nodes, or -1 on bad input.
+// Shapes: requests[G*R] f32, counts[G] i32, compat[G*T] u8, capacity[T*R]
+// f32, price[G*T] f32, group_window[G*W] u8, type_window[T*W] u8 (W = Z*2),
+// max_per_node[G] i32. Outputs: node_type[N] i32, node_price[N] f32,
+// used[N*R] f32, node_window[N*W] u8, placed[G*N] i32, unplaced[G] i32.
+int ffd_solve_native(
+    const float* requests, const int32_t* counts, const uint8_t* compat,
+    const float* capacity, const float* price, const uint8_t* group_window,
+    const uint8_t* type_window, const int32_t* max_per_node,
+    int G, int T, int R, int W, int max_nodes,
+    int32_t* node_type, float* node_price, float* used, uint8_t* node_window,
+    int32_t* placed, int32_t* unplaced) {
+    if (G < 0 || T <= 0 || R <= 0 || W <= 0 || max_nodes <= 0) return -1;
+
+    int n_open = 0;
+    std::vector<int> k_type(T);
+
+    std::memset(placed, 0, sizeof(int32_t) * static_cast<size_t>(G) * max_nodes);
+    std::memset(unplaced, 0, sizeof(int32_t) * G);
+    std::memset(used, 0, sizeof(float) * static_cast<size_t>(max_nodes) * R);
+
+    for (int g = 0; g < G; ++g) {
+        const float* req = requests + static_cast<size_t>(g) * R;
+        int cnt = counts[g];
+        if (cnt <= 0) continue;
+        const uint8_t* gw = group_window + static_cast<size_t>(g) * W;
+        const int mpn = max_per_node ? max_per_node[g] : (1 << 30);
+
+        // 1. first-fit fill of open nodes in index order.
+        for (int n = 0; n < n_open && cnt > 0; ++n) {
+            int t = node_type[n];
+            if (!compat[static_cast<size_t>(g) * T + t]) continue;
+            if (!window_intersects(node_window + static_cast<size_t>(n) * W, gw, W)) continue;
+            int k = fit_count(capacity + static_cast<size_t>(t) * R,
+                              used + static_cast<size_t>(n) * R, req, R);
+            if (k > mpn) k = mpn;
+            int take = k < cnt ? k : cnt;
+            if (take <= 0) continue;
+            for (int r = 0; r < R; ++r)
+                used[static_cast<size_t>(n) * R + r] += take * req[r];
+            placed[static_cast<size_t>(g) * max_nodes + n] += take;
+            // narrow the node's offering window to the intersection
+            uint8_t* nw = node_window + static_cast<size_t>(n) * W;
+            for (int w = 0; w < W; ++w) nw[w] = nw[w] && gw[w];
+            cnt -= take;
+        }
+
+        // per-type pods-per-node for this group's request shape.
+        for (int t = 0; t < T; ++t)
+            k_type[t] = fit_count(capacity + static_cast<size_t>(t) * R, nullptr, req, R);
+
+        // 2. open new nodes: cost-per-slot greedy with partial-tail re-score.
+        while (cnt > 0 && n_open < max_nodes) {
+            int best = -1;
+            float best_score = std::numeric_limits<float>::infinity();
+            for (int t = 0; t < T; ++t) {
+                if (!compat[static_cast<size_t>(g) * T + t]) continue;
+                if (k_type[t] < 1) continue;
+                float p = price[static_cast<size_t>(g) * T + t];
+                if (!std::isfinite(p)) continue;
+                int eff = k_type[t];
+                if (eff > mpn) eff = mpn;
+                if (eff > cnt) eff = cnt;
+                if (eff < 1) eff = 1;
+                float score = p / static_cast<float>(eff);
+                if (score < best_score) {
+                    best_score = score;
+                    best = t;
+                }
+            }
+            if (best < 0) break;
+            int k_star = k_type[best] < mpn ? k_type[best] : mpn;
+            if (k_star < 1) k_star = 1;
+            int take = k_star < cnt ? k_star : cnt;
+            int n = n_open++;
+            node_type[n] = best;
+            node_price[n] = price[static_cast<size_t>(g) * T + best];
+            for (int r = 0; r < R; ++r)
+                used[static_cast<size_t>(n) * R + r] = take * req[r];
+            uint8_t* nw = node_window + static_cast<size_t>(n) * W;
+            const uint8_t* tw = type_window + static_cast<size_t>(best) * W;
+            for (int w = 0; w < W; ++w) nw[w] = gw[w] && tw[w];
+            placed[static_cast<size_t>(g) * max_nodes + n] = take;
+            cnt -= take;
+        }
+        if (cnt > 0) unplaced[g] = cnt;
+    }
+    return n_open;
+}
+
+}  // extern "C"

@@ -1,0 +1,120 @@
+"""Native C++ solver + gRPC sidecar: parity with the in-process solvers."""
+
+import numpy as np
+import pytest
+
+from karpenter_provider_aws_tpu.catalog import CatalogProvider
+from karpenter_provider_aws_tpu.models import NodePool
+from karpenter_provider_aws_tpu.models import labels as lbl
+from karpenter_provider_aws_tpu.models.pod import make_pods, PodAffinityTerm
+from karpenter_provider_aws_tpu.scheduling import HostSolver, TPUSolver
+from karpenter_provider_aws_tpu.scheduling.native import NativeSolver, native_available
+
+needs_native = pytest.mark.skipif(not native_available(), reason="no C++ toolchain")
+
+
+@pytest.fixture(scope="module")
+def catalog():
+    return CatalogProvider()
+
+
+@pytest.fixture(scope="module")
+def pool():
+    return NodePool(name="default")
+
+
+def workload():
+    pods = make_pods(80, "a", {"cpu": "500m", "memory": "1Gi"})
+    pods += make_pods(25, "b", {"cpu": "2", "memory": "8Gi"})
+    pods += make_pods(6, "gpu", {"cpu": "4", "nvidia.com/gpu": 1})
+    pods += make_pods(5, "aa", {"cpu": "1"}, labels={"app": "web"},
+                      anti_affinity=[PodAffinityTerm(topology_key=lbl.HOSTNAME,
+                                                    label_selector={"app": "web"})])
+    pods += make_pods(8, "zonal", {"cpu": "1"},
+                      node_selector={lbl.TOPOLOGY_ZONE: "zone-b"})
+    return pods
+
+
+@needs_native
+class TestNativeSolver:
+    def test_exact_parity_with_host(self, catalog, pool):
+        pods = workload()
+        rn = NativeSolver().solve(pods, [pool], catalog)
+        rh = HostSolver().solve(pods, [pool], catalog)
+        assert rn.pods_placed() == rh.pods_placed()
+        assert len(rn.node_specs) == len(rh.node_specs)
+        assert sorted(s.instance_type_options[0] for s in rn.node_specs) == sorted(
+            s.instance_type_options[0] for s in rh.node_specs
+        )
+        assert rn.total_cost == pytest.approx(rh.total_cost, rel=1e-5)
+
+    def test_parity_with_tpu(self, catalog, pool):
+        pods = workload()
+        rn = NativeSolver().solve(pods, [pool], catalog)
+        rt = TPUSolver().solve(pods, [pool], catalog)
+        assert len(rn.node_specs) == len(rt.node_specs)
+        assert rn.total_cost == pytest.approx(rt.total_cost, rel=1e-4)
+
+    def test_respects_anti_affinity(self, catalog, pool):
+        pods = make_pods(4, "w", {"cpu": "1"}, labels={"app": "web"},
+                         anti_affinity=[PodAffinityTerm(topology_key=lbl.HOSTNAME,
+                                                        label_selector={"app": "web"})])
+        res = NativeSolver().solve(pods, [pool], catalog)
+        assert res.pods_placed() == 4
+        assert all(len(s.pods) == 1 for s in res.node_specs)
+
+
+class TestSidecar:
+    @pytest.fixture(scope="class")
+    def server(self):
+        from karpenter_provider_aws_tpu.runtime import SolverServer
+
+        srv = SolverServer("127.0.0.1:0")
+        srv.start()
+        yield srv
+        srv.stop()
+
+    @pytest.fixture(scope="class")
+    def client(self, server):
+        from karpenter_provider_aws_tpu.runtime import SolverClient
+
+        c = SolverClient(f"127.0.0.1:{server.port}")
+        yield c
+        c.close()
+
+    def test_health(self, client):
+        assert client.health() >= 1
+
+    def test_remote_solve_matches_local(self, catalog, pool, client):
+        from karpenter_provider_aws_tpu.runtime.sidecar import RemoteSolver
+
+        pods = workload()
+        remote = RemoteSolver(client).solve(pods, [pool], catalog)
+        local = TPUSolver().solve(pods, [pool], catalog)
+        assert remote.pods_placed() == local.pods_placed()
+        assert len(remote.node_specs) == len(local.node_specs)
+        assert remote.total_cost == pytest.approx(local.total_cost, rel=1e-5)
+
+    def test_remote_consolidation_screening(self, client):
+        G, N, GMAX, R = 4, 16, 4, 8
+        rng = np.random.RandomState(1)
+        requests = np.zeros((G, R), dtype=np.float32)
+        requests[:, 0] = [500, 1000, 2000, 250]
+        requests[:, 2] = 1
+        free = np.zeros((N, R), dtype=np.float32)
+        free[:, 0] = 4000
+        free[:, 2] = 50
+        gids = rng.randint(0, G, (N, GMAX)).astype(np.int32)
+        gcounts = (rng.rand(N, GMAX) < 0.5).astype(np.int32)
+        out = client.simulate_consolidation(
+            free=free, requests=requests, group_ids=gids,
+            group_counts=gcounts, compat=np.ones((G, N), dtype=bool),
+            candidates=np.arange(N, dtype=np.int32),
+        )
+        assert out["ok"].shape == (N,)
+
+    def test_bad_payload_is_an_rpc_error(self, client):
+        import grpc
+
+        with pytest.raises(grpc.RpcError):
+            client._call("Solve", b"not an npz archive")

@@ -1,0 +1,171 @@
+"""Operator wiring, options, admission webhooks, metrics, refresh loops."""
+
+import urllib.request
+
+import pytest
+
+from karpenter_provider_aws_tpu.models import Disruption, NodePool, Requirement, Operator as ReqOp
+from karpenter_provider_aws_tpu.models import labels as lbl
+from karpenter_provider_aws_tpu.models.nodeclass import NodeClass, SelectorTerm
+from karpenter_provider_aws_tpu.models.pod import make_pods
+from karpenter_provider_aws_tpu.operator import (
+    AdmissionError,
+    Options,
+    admit,
+    new_operator,
+)
+from karpenter_provider_aws_tpu.operator.webhooks import (
+    validate_nodeclass,
+    validate_nodepool,
+)
+from karpenter_provider_aws_tpu.utils.clock import FakeClock
+
+
+class TestOptions:
+    def test_defaults_valid(self):
+        opts = Options.from_env_and_args([])
+        assert opts.cluster_name == "cluster-1"
+        assert opts.solver_backend == "tpu"
+
+    def test_flag_overrides(self):
+        opts = Options.from_env_and_args(["--cluster-name", "prod", "--solver-backend", "host"])
+        assert opts.cluster_name == "prod"
+        assert opts.solver_backend == "host"
+
+    def test_env_fallback(self, monkeypatch):
+        monkeypatch.setenv("VM_MEMORY_OVERHEAD_PERCENT", "0.2")
+        opts = Options.from_env_and_args([])
+        assert opts.vm_memory_overhead_percent == 0.2
+
+    def test_validation_rejects_bad(self):
+        with pytest.raises(ValueError):
+            Options(vm_memory_overhead_percent=1.5).validate()
+        with pytest.raises(ValueError):
+            Options(solver_backend="quantum").validate()
+        with pytest.raises(ValueError):
+            Options(solver_backend="grpc").validate()  # missing target
+
+    def test_feature_gates(self):
+        opts = Options(feature_gates="Drift=false,SpotToSpot=true")
+        assert not opts.gate("Drift")
+        assert opts.gate("SpotToSpot", default=False)
+        assert opts.gate("Unknown", default=True)
+
+
+class TestWebhooks:
+    def test_nodeclass_role_profile_exclusive(self):
+        with pytest.raises(AdmissionError, match="mutually exclusive"):
+            validate_nodeclass(NodeClass(name="x", role="r", instance_profile="p"))
+
+    def test_nodeclass_requires_identity(self):
+        with pytest.raises(AdmissionError, match="role or instanceProfile"):
+            validate_nodeclass(NodeClass(name="x"))
+
+    def test_nodeclass_custom_family_needs_selector(self):
+        with pytest.raises(AdmissionError, match="custom"):
+            validate_nodeclass(NodeClass(name="x", role="r", image_family="custom"))
+
+    def test_nodeclass_empty_selector_term(self):
+        with pytest.raises(AdmissionError, match="selector terms"):
+            validate_nodeclass(
+                NodeClass(name="x", role="r", subnet_selector=[SelectorTerm()])
+            )
+
+    def test_nodepool_restricted_label(self):
+        with pytest.raises(AdmissionError, match="restricted"):
+            validate_nodepool(
+                NodePool(name="p", requirements=[
+                    Requirement(lbl.HOSTNAME, ReqOp.IN, ("h",))
+                ])
+            )
+
+    def test_nodepool_bad_budget(self):
+        with pytest.raises(AdmissionError, match="budget"):
+            validate_nodepool(NodePool(name="p", disruption=Disruption(budgets=["lots"])))
+
+    def test_admit_defaults_nodepool_captype(self):
+        pool = admit(NodePool(name="p"))
+        keys = [r.key for r in pool.requirements]
+        assert lbl.CAPACITY_TYPE in keys
+
+    def test_admit_valid_nodeclass(self):
+        nc = admit(NodeClass(name="ok", role="r"))
+        assert nc.image_family == "standard"
+
+
+class TestOperatorWiring:
+    def test_full_stack_end_to_end(self):
+        clock = FakeClock()
+        options = Options(solver_backend="host", metrics_port=0,
+                          batch_idle_seconds=0.001, batch_max_seconds=0.05)
+        op = new_operator(options, clock=clock)
+        op.apply(NodeClass(name="default", role="r"))
+        op.apply(NodePool(name="default", disruption=Disruption(consolidate_after_s=None)))
+        for p in make_pods(10, "w", {"cpu": "1", "memory": "2Gi"}):
+            op.cluster.apply(p)
+        op.manager.reconcile_all_once()
+        op.manager.reconcile_all_once()
+        assert not op.cluster.pending_pods()
+        assert len(op.cluster.nodes) >= 1
+
+    def test_interruption_gated_on_queue_option(self):
+        from karpenter_provider_aws_tpu.fake import FakeQueue
+
+        base = Options(solver_backend="host")
+        names = [c.name for c in new_operator(base, queue=FakeQueue()).manager.controllers]
+        assert "interruption" not in names
+        opts = Options(solver_backend="host", interruption_queue="q")
+        names = [c.name for c in new_operator(opts, queue=FakeQueue()).manager.controllers]
+        assert "interruption" in names
+
+    def test_metrics_endpoint_serves(self):
+        options = Options(solver_backend="host", metrics_port=0)
+        op = new_operator(options)
+        from karpenter_provider_aws_tpu.metrics import REGISTRY
+
+        port = REGISTRY.serve(0)
+        try:
+            body = urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics").read().decode()
+            assert "karpenter_solver_solve_duration_seconds" in body
+            health = urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz").read().decode()
+            assert health == "ok\n"
+        finally:
+            REGISTRY.stop()
+
+
+class TestRefreshControllers:
+    def test_catalog_refresh_bumps_seq(self):
+        from karpenter_provider_aws_tpu.catalog import CatalogProvider
+        from karpenter_provider_aws_tpu.controllers.refresh import (
+            CatalogRefreshController,
+            PricingRefreshController,
+        )
+
+        cat = CatalogProvider()
+        key0 = cat.cache_key()
+        CatalogRefreshController(cat).reconcile()
+        assert cat.cache_key() != key0
+        assert len(cat) >= 700
+
+    def test_pricing_refresh_applies_sources(self):
+        from karpenter_provider_aws_tpu.catalog import CatalogProvider
+        from karpenter_provider_aws_tpu.controllers.refresh import PricingRefreshController
+
+        cat = CatalogProvider()
+        ctrl = PricingRefreshController(cat, od_source=lambda: {"c5.large": 42.0})
+        ctrl.reconcile()
+        assert cat.pricing.on_demand_price(cat.get("c5.large")) == 42.0
+
+
+class TestMetrics:
+    def test_counters_increment_through_flow(self):
+        from karpenter_provider_aws_tpu.metrics import NODES_CREATED, SOLVE_PODS
+        from karpenter_provider_aws_tpu.testenv import new_environment
+
+        env = new_environment(use_tpu_solver=False)
+        env.apply_defaults(NodePool(name="default", disruption=Disruption(consolidate_after_s=None)))
+        before = sum(NODES_CREATED._values.values())
+        for p in make_pods(4, "w", {"cpu": "1", "memory": "2Gi"}):
+            env.cluster.apply(p)
+        env.step(2)
+        assert sum(NODES_CREATED._values.values()) > before

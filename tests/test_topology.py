@@ -1,0 +1,172 @@
+"""Topology-spread / (anti-)affinity behavior (BASELINE config #3:
+zone+hostname topology-spread + pod anti-affinity)."""
+
+import numpy as np
+import pytest
+
+from karpenter_provider_aws_tpu.catalog import CatalogProvider
+from karpenter_provider_aws_tpu.models import NodePool
+from karpenter_provider_aws_tpu.models import labels as lbl
+from karpenter_provider_aws_tpu.models.pod import (
+    PodAffinityTerm,
+    TopologySpreadConstraint,
+    make_pods,
+)
+from karpenter_provider_aws_tpu.scheduling import HostSolver, TPUSolver
+
+
+@pytest.fixture(scope="module")
+def catalog():
+    return CatalogProvider()
+
+
+@pytest.fixture(scope="module")
+def pool():
+    return NodePool(name="default")
+
+
+def zone_spread(max_skew=1):
+    return TopologySpreadConstraint(
+        topology_key=lbl.TOPOLOGY_ZONE, max_skew=max_skew,
+        label_selector={"app": "web"},
+    )
+
+
+def host_spread(max_skew=1):
+    return TopologySpreadConstraint(
+        topology_key=lbl.HOSTNAME, max_skew=max_skew,
+        label_selector={"app": "web"},
+    )
+
+
+def self_anti_affinity(key=lbl.HOSTNAME):
+    return PodAffinityTerm(topology_key=key, label_selector={"app": "web"})
+
+
+@pytest.mark.parametrize("solver_cls", [TPUSolver, HostSolver])
+class TestZoneSpread:
+    def test_pods_balanced_across_zones(self, catalog, pool, solver_cls):
+        pods = make_pods(12, "w", {"cpu": "1", "memory": "2Gi"},
+                         labels={"app": "web"}, topology_spread=[zone_spread()])
+        res = solver_cls().solve(pods, [pool], catalog)
+        assert res.pods_placed() == 12
+        by_zone = {}
+        for spec in res.node_specs:
+            assert len(spec.zone_options) == 1
+            by_zone[spec.zone_options[0]] = by_zone.get(spec.zone_options[0], 0) + len(spec.pods)
+        counts = sorted(by_zone.values())
+        assert len(by_zone) == 4  # all four zones used
+        assert counts[-1] - counts[0] <= 1  # skew <= max_skew
+
+    def test_spread_within_allowed_zones_only(self, catalog, pool, solver_cls):
+        pods = make_pods(6, "w", {"cpu": "1"}, labels={"app": "web"},
+                         topology_spread=[zone_spread()],
+                         node_affinity=[])
+        for p in pods:
+            p.node_selector = {lbl.TOPOLOGY_ZONE: "zone-a"}
+        # zone-pinned + spread: everything lands in zone-a
+        res = solver_cls().solve(pods, [pool], catalog)
+        assert res.pods_placed() == 6
+        for spec in res.node_specs:
+            assert spec.zone_options == ["zone-a"]
+
+
+@pytest.mark.parametrize("solver_cls", [TPUSolver, HostSolver])
+class TestHostnameTopology:
+    def test_anti_affinity_one_pod_per_node(self, catalog, pool, solver_cls):
+        pods = make_pods(5, "w", {"cpu": "500m", "memory": "1Gi"},
+                         labels={"app": "web"},
+                         anti_affinity=[self_anti_affinity()])
+        res = solver_cls().solve(pods, [pool], catalog)
+        assert res.pods_placed() == 5
+        assert len(res.node_specs) == 5
+        for spec in res.node_specs:
+            assert len(spec.pods) == 1
+
+    def test_hostname_spread_caps_per_node(self, catalog, pool, solver_cls):
+        pods = make_pods(9, "w", {"cpu": "250m", "memory": "512Mi"},
+                         labels={"app": "web"},
+                         topology_spread=[host_spread(max_skew=3)])
+        res = solver_cls().solve(pods, [pool], catalog)
+        assert res.pods_placed() == 9
+        for spec in res.node_specs:
+            assert len(spec.pods) <= 3
+
+    def test_zone_anti_affinity_one_per_zone(self, catalog, pool, solver_cls):
+        pods = make_pods(6, "w", {"cpu": "1"}, labels={"app": "web"},
+                         anti_affinity=[self_anti_affinity(lbl.TOPOLOGY_ZONE)])
+        res = solver_cls().solve(pods, [pool], catalog)
+        # only 4 zones exist: 4 placed, 2 unschedulable with a clear reason
+        assert res.pods_placed() == 4
+        assert len(res.unschedulable) == 2
+        assert "zone anti-affinity" in res.unschedulable[0][1]
+        zones = [spec.zone_options[0] for spec in res.node_specs]
+        assert len(zones) == len(set(zones))
+
+    def test_zone_affinity_co_locates(self, catalog, pool, solver_cls):
+        pods = make_pods(4, "w", {"cpu": "1"}, labels={"app": "web"},
+                         affinity=[self_anti_affinity(lbl.TOPOLOGY_ZONE)])
+        res = solver_cls().solve(pods, [pool], catalog)
+        assert res.pods_placed() == 4
+        zones = {spec.zone_options[0] for spec in res.node_specs}
+        assert len(zones) == 1
+
+
+class TestCombined:
+    def test_config3_mix(self, catalog, pool):
+        """Zone spread + hostname anti-affinity together (BASELINE config 3)."""
+        pods = make_pods(
+            8, "w", {"cpu": "1", "memory": "2Gi"}, labels={"app": "web"},
+            topology_spread=[zone_spread()],
+            anti_affinity=[self_anti_affinity()],
+        )
+        pods += make_pods(30, "filler", {"cpu": "500m", "memory": "1Gi"})
+        res = TPUSolver().solve(pods, [pool], catalog)
+        assert res.pods_placed() == 38
+        web_nodes = [s for s in res.node_specs if any(p.labels.get("app") == "web" for p in s.pods)]
+        for spec in web_nodes:
+            assert sum(1 for p in spec.pods if p.labels.get("app") == "web") == 1
+        by_zone = {}
+        for spec in web_nodes:
+            z = spec.zone_options[0]
+            by_zone[z] = by_zone.get(z, 0) + 1
+        counts = sorted(by_zone.values())
+        assert counts[-1] - counts[0] <= 1
+
+
+class TestSchedulerTopology:
+    def test_rebind_respects_hostname_anti_affinity(self):
+        from karpenter_provider_aws_tpu.models import Disruption
+        from karpenter_provider_aws_tpu.testenv import new_environment
+
+        env = new_environment(use_tpu_solver=False)
+        env.apply_defaults(NodePool(name="default", disruption=Disruption(consolidate_after_s=None)))
+        pods = make_pods(3, "w", {"cpu": "500m", "memory": "1Gi"},
+                         labels={"app": "web"},
+                         anti_affinity=[self_anti_affinity()])
+        for p in pods:
+            env.cluster.apply(p)
+        env.step(2)
+        assert not env.cluster.pending_pods()
+        # evict one pod; the scheduler must not double it onto a sibling node
+        victim = pods[0]
+        old_node = victim.node_name
+        victim.node_name = ""
+        victim.phase = "Pending"
+        env.scheduling.reconcile()
+        if not victim.is_pending():
+            others = {p.node_name for p in pods[1:]}
+            assert victim.node_name not in others
+
+
+class TestHistogramExposition:
+    def test_buckets_cumulative_once(self):
+        from karpenter_provider_aws_tpu.metrics import Histogram
+
+        h = Histogram("t", buckets=(1.0, 5.0, 10.0))
+        h.observe(0.5)
+        text = "\n".join(h.expose())
+        assert 't_bucket{le="1.0"} 1' in text
+        assert 't_bucket{le="5.0"} 1' in text
+        assert 't_bucket{le="+Inf"} 1' in text
+        assert "t_count 1" in text
