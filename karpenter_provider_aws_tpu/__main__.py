@@ -11,6 +11,18 @@ from __future__ import annotations
 import logging
 import signal
 import sys
+import threading
+
+
+def _sidecar_requested(argv: list[str]) -> bool:
+    if "--sidecar" in argv:
+        return True
+    for i, a in enumerate(argv):
+        if a == "--role" and i + 1 < len(argv) and argv[i + 1] == "sidecar":
+            return True
+        if a == "--role=sidecar":
+            return True
+    return False
 
 
 def main(argv=None) -> int:
@@ -19,7 +31,7 @@ def main(argv=None) -> int:
         level=logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
-    if argv[:2] == ["--role", "sidecar"] or "--sidecar" in argv:
+    if _sidecar_requested(argv):
         from .runtime.sidecar import serve
 
         address = "127.0.0.1:50151"
@@ -37,12 +49,13 @@ def main(argv=None) -> int:
     op = new_operator(options)
     op.start()
     print(f"karpenter-tpu operator running (metrics port {op.metrics_port})", flush=True)
-    stop = []
-    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
-    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    # An Event closes the check-then-pause race a bare signal.pause() has:
+    # a signal landing between the loop check and pause() would be lost.
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
     try:
-        while not stop:
-            signal.pause()
+        stop.wait()
     finally:
         op.stop()
     return 0

@@ -52,11 +52,14 @@ class ProvisioningController:
         nodepools = list(self.cluster.nodepools.values())
         if not nodepools:
             return
+        from ..ops.encode import ZoneOccupancy
+
         result = self.solver.solve(
             pending,
             nodepools,
             self.cloudprovider.catalog,
             in_use=self.cluster.in_use_by_nodepool(),
+            occupancy=ZoneOccupancy.from_cluster(self.cluster),
         )
         from ..metrics import SOLVE_DURATION, SOLVE_PODS
 

@@ -11,6 +11,8 @@ surviving capacity instead of spawning fresh nodes.
 
 from __future__ import annotations
 
+from typing import Optional
+
 import numpy as np
 
 from ..state.cluster import Cluster
@@ -38,16 +40,38 @@ class SchedulingController:
             free[node.name] = node.allocatable.v - used
         return free
 
-    def _topology_allows(self, pod, node, nodes) -> bool:
+    def _zone_counts(self, selector, nodes, cache: dict) -> dict[str, int]:
+        """zone -> matching bound pods, memoized per reconcile pass (the
+        counts vary only by selector, not by candidate node)."""
+        key = tuple(sorted(selector.items()))
+        hit = cache.get(key)
+        if hit is not None:
+            return hit
+        counts: dict[str, int] = {}
+        for other in nodes.values():
+            z = other.zone()
+            if not z:
+                continue
+            counts.setdefault(z, 0)
+            for q in self.cluster.pods_on_node(other.name):
+                if all(q.labels.get(k) == v for k, v in selector.items()):
+                    counts[z] += 1
+        cache[key] = counts
+        return counts
+
+    def _topology_allows(self, pod, node, nodes, cache: Optional[dict] = None) -> bool:
         """Hostname/zone topology checks on rebind — the solver enforces
         these at provisioning time; binds onto existing capacity must not
         silently break them."""
+        from ..models import labels as lbl
+
+        cache = cache if cache is not None else {}
         cap = pod.hostname_cap()
         if cap < (1 << 30):
             selectors = [
                 t.label_selector
                 for t in list(pod.anti_affinity) + list(pod.topology_spread)
-                if getattr(t, "topology_key", "") in ("kubernetes.io/hostname",)
+                if getattr(t, "topology_key", "") in (lbl.HOSTNAME,)
             ]
             matching = sum(
                 1
@@ -56,20 +80,28 @@ class SchedulingController:
             )
             if matching >= cap:
                 return False
-        ztop = pod.zone_topology()
-        if ztop is not None and ztop[0] == "anti":
-            zone = node.zone()
-            for other in nodes.values():
-                if other.zone() != zone:
-                    continue
-                for q in self.cluster.pods_on_node(other.name):
-                    if any(
-                        all(q.labels.get(k) == v for k, v in a.label_selector.items())
-                        for a in pod.anti_affinity
-                        if a.topology_key == "topology.kubernetes.io/zone"
-                    ):
-                        return False
-        return True
+        zone = node.zone()
+        # EVERY zone anti-affinity term blocks zones holding matching pods —
+        # self-matching or not (a web pod may be required to avoid db zones).
+        for a in pod.anti_affinity:
+            if a.topology_key != lbl.TOPOLOGY_ZONE:
+                continue
+            if self._zone_counts(a.label_selector, nodes, cache).get(zone, 0) > 0:
+                return False
+        ztop = pod.zone_topology_term()
+        if ztop is None or ztop[0] == "anti":
+            return True  # anti already fully handled above
+        mode, skew, selector = ztop
+        counts = self._zone_counts(selector, nodes, cache)
+        if mode == "affinity":
+            # Required zone affinity: land where matching pods run; if none
+            # exist anywhere the pod may seed any zone.
+            if any(c > 0 for c in counts.values()):
+                return counts.get(zone, 0) > 0
+            return True
+        # spread: the incremental skew check over the zone domain.
+        floor = min(counts.values(), default=0)
+        return counts.get(zone, 0) + 1 - floor <= skew
 
     def reconcile(self) -> None:
         free = self._free_map()
@@ -80,6 +112,9 @@ class SchedulingController:
             with self.provisioning._nominations_lock:
                 nominated = set(self.provisioning.nominations)
         nodes = {n.name: n for n in self.cluster.snapshot_nodes()}
+        # Per-pass memo of zone->matching-pod counts; binds change the counts,
+        # so it is dropped after every successful bind.
+        zone_cache: dict = {}
         for pod in self.cluster.pending_pods():
             if pod.uid in nominated:
                 continue
@@ -92,8 +127,9 @@ class SchedulingController:
                     continue
                 if not pod.tolerates_all(node.taints):
                     continue
-                if not self._topology_allows(pod, node, nodes):
+                if not self._topology_allows(pod, node, nodes, zone_cache):
                     continue
                 self.cluster.bind_pod(pod.uid, name, now=self.clock.now())
                 free[name] = f - pod.requests.v
+                zone_cache.clear()
                 break

@@ -132,15 +132,23 @@ class Pod:
     def zone_topology(self) -> Optional[tuple[str, int]]:
         """('spread', max_skew) | ('anti', 1) | ('affinity', 0) | None for the
         zone axis."""
+        term = self.zone_topology_term()
+        return term[:2] if term is not None else None
+
+    def zone_topology_term(self) -> Optional[tuple[str, int, dict]]:
+        """(mode, max_skew, label_selector) for the zone axis, or None.
+
+        The selector is what existing cluster pods are counted against when
+        the encoder/rebinder account for zone occupancy."""
         for a in self.anti_affinity:
             if a.topology_key == lbl.TOPOLOGY_ZONE and a.matches(self):
-                return ("anti", 1)
+                return ("anti", 1, dict(a.label_selector))
         for c in self.topology_spread:
             if c.topology_key == lbl.TOPOLOGY_ZONE and c.when_unsatisfiable == "DoNotSchedule":
-                return ("spread", max(c.max_skew, 1))
+                return ("spread", max(c.max_skew, 1), dict(c.label_selector))
         for a in self.affinity:
             if a.topology_key == lbl.TOPOLOGY_ZONE and a.matches(self):
-                return ("affinity", 0)
+                return ("affinity", 0, dict(a.label_selector))
         return None
 
     # -- grouping (dedup) key ----------------------------------------------

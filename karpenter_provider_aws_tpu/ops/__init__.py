@@ -15,5 +15,5 @@ Key design moves (TPU-first, not a port):
    sees dense float/bool tensors.
 """
 
-from .encode import EncodedProblem, encode_problem, bucket  # noqa: F401
+from .encode import EncodedProblem, ZoneOccupancy, encode_problem, bucket  # noqa: F401
 from .ffd import ffd_solve, FFDResult  # noqa: F401

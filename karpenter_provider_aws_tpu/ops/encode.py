@@ -17,7 +17,7 @@ Everything here is host-side numpy; jax only sees the finished arrays.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Optional, Sequence
+from typing import Mapping, Optional, Sequence
 
 import numpy as np
 
@@ -35,6 +35,40 @@ def bucket(n: int, minimum: int = 8) -> int:
     while b < n:
         b *= 2
     return b
+
+
+class ZoneOccupancy:
+    """Per-zone counts of already-bound pods, for topology accounting.
+
+    Zone anti-affinity/spread/affinity must see replicas that are *already
+    running*, not just the pending ones — otherwise every scale-up restarts
+    the balance from zero and co-locates with existing replicas. Built from
+    (pod labels, zone) pairs; an empty selector matches every pod (the same
+    convention as ``PodAffinityTerm.matches``)."""
+
+    def __init__(self, entries: Optional[Sequence[tuple[Mapping[str, str], str]]] = None):
+        self._entries: list[tuple[Mapping[str, str], str]] = list(entries or [])
+
+    @classmethod
+    def from_cluster(cls, cluster) -> "ZoneOccupancy":
+        """Snapshot bound pods on nodes with a known zone (duck-typed so the
+        state package need not be imported here)."""
+        entries = []
+        for node in cluster.snapshot_nodes():
+            zone = node.zone()
+            if not zone:
+                continue
+            for pod in cluster.pods_on_node(node.name):
+                entries.append((dict(pod.labels), zone))
+        return cls(entries)
+
+    def counts(self, selector: Mapping[str, str]) -> dict[str, int]:
+        """zone -> number of bound pods matching the label selector."""
+        out: dict[str, int] = {}
+        for labels, zone in self._entries:
+            if all(labels.get(k) == v for k, v in selector.items()):
+                out[zone] = out.get(zone, 0) + 1
+        return out
 
 
 @dataclass
@@ -135,6 +169,7 @@ def encode_problem(
     catalog: CatalogProvider,
     nodepool: Optional[NodePool] = None,
     tensors: Optional[CatalogTensors] = None,
+    occupancy: Optional[ZoneOccupancy] = None,
 ) -> EncodedProblem:
     """Build the dense solve tensors for one nodepool's candidate pods.
 
@@ -180,43 +215,103 @@ def encode_problem(
     zone_names = list(tensors.zones)
     pool_zone_vs = pool_reqs.get(lbl.TOPOLOGY_ZONE)
 
-    expanded: list[tuple[list[Pod], Optional[int], int]] = []  # (pods, zone_idx, mpn)
+    live_zone_mask = tensors.available.any(axis=(0, 2))  # [Z] any live offering
+    zone_index = {z: zi for zi, z in enumerate(zone_names)}
+
+    # (pods, zone_pin, mpn, zone_mask) — zone_mask is an extra [Z] allowance
+    # from non-self anti-affinity terms, applied when the group is not pinned.
+    expanded: list[tuple[list[Pod], Optional[int], int, Optional[np.ndarray]]] = []
     for plist in groups.values():
         pod = plist[0]
         mpn = pod.hostname_cap()
-        ztop = pod.zone_topology()
+        ztop = pod.zone_topology_term()
         allowed_z = [
             zi for zi, z in enumerate(zone_names)
             if pod.requirements().get(lbl.TOPOLOGY_ZONE).contains(z)
             and pool_zone_vs.contains(z)
         ]
+        # Zones already holding pods matched by any NON-self zone
+        # anti-affinity term are off-limits regardless of the pod's own
+        # topology mode (e.g. a web pod that must avoid zones running db).
+        anti_mask: Optional[np.ndarray] = None
+        if occupancy is not None:
+            other_terms = [
+                a for a in pod.anti_affinity
+                if a.topology_key == lbl.TOPOLOGY_ZONE and not a.matches(pod)
+            ]
+            if other_terms:
+                anti_mask = np.ones(Z, dtype=bool)
+                for a in other_terms:
+                    for z, c in occupancy.counts(a.label_selector).items():
+                        if c > 0 and z in zone_index:
+                            anti_mask[zone_index[z]] = False
+                allowed_z = [zi for zi in allowed_z if anti_mask[zi]]
         if ztop is None or not allowed_z:
-            expanded.append((plist, None, mpn))
+            expanded.append((plist, None, mpn, anti_mask))
             continue
-        mode, skew = ztop
+        mode, skew, selector = ztop
+        # Existing bound replicas matching the term's selector, per zone —
+        # scale-ups must balance against them, not restart from zero.
+        existing = occupancy.counts(selector) if occupancy is not None else {}
+        e = {zi: existing.get(zone_names[zi], 0) for zi in allowed_z}
+        live = {zi for zi in allowed_z if live_zone_mask[zi]}
         if mode == "affinity":
-            # co-locate: restrict the whole group to one zone — prefer a
-            # zone that still has live offerings (ICE considered)
-            live_zones = tensors.available.any(axis=(0, 2))  # [Z]
-            pin = next((zi for zi in allowed_z if live_zones[zi]), allowed_z[0])
-            expanded.append((plist, pin, mpn))
+            # Co-locate: required zone affinity means landing where matching
+            # pods already run; with no existing matches the group seeds its
+            # own zone — prefer one with live offerings (ICE considered).
+            seeded = [zi for zi in allowed_z if e[zi] > 0]
+            if seeded:
+                pin = next((zi for zi in seeded if zi in live), seeded[0])
+            elif any(c > 0 for c in existing.values()):
+                # seeded empty means every allowed zone has zero matches, so
+                # any existing match necessarily runs in a disallowed zone.
+                for pod_i in plist:
+                    unencodable.append(
+                        (pod_i, "zone affinity: matching pods run only in disallowed zones")
+                    )
+                continue
+            else:
+                pin = next((zi for zi in allowed_z if zi in live), allowed_z[0])
+            expanded.append((plist, pin, mpn, None))
         elif mode == "anti":
+            # Each replica needs a zone with NO matching pod, existing or new.
+            empty = sorted(
+                (zi for zi in allowed_z if e[zi] == 0),
+                key=lambda zi: (zi not in live, zi),  # live zones first
+            )
             for i, pod_i in enumerate(plist):
-                if i < len(allowed_z):
-                    expanded.append(([pod_i], allowed_z[i], mpn))
+                if i < len(empty):
+                    expanded.append(([pod_i], empty[i], mpn, None))
                 else:
                     unencodable.append(
-                        (pod_i, "zone anti-affinity: more replicas than zones")
+                        (pod_i, "zone anti-affinity: no zone without a matching pod left")
                     )
-        else:  # spread: balanced shares, skew <= 1 <= max_skew
-            n, k = len(plist), len(allowed_z)
-            base, extra = divmod(n, k)
+        else:  # spread: greedy water-fill with the incremental skew check
+            # Place each pod in the lowest-count *live* zone that keeps
+            # max-min skew <= max_skew over the allowed domain (dead/ICE'd
+            # zones still count toward the domain minimum, so a fully-ICE'd
+            # zone caps how high the others may grow — DoNotSchedule
+            # semantics, kube-scheduler's per-pod check).
+            counts = dict(e)
+            assign = {zi: 0 for zi in allowed_z}
+            for _ in range(len(plist)):
+                floor = min(counts.values())
+                cands = [zi for zi in live if counts[zi] + 1 - floor <= skew]
+                if not cands:
+                    break
+                zi = min(cands, key=lambda z: (counts[z], z))
+                counts[zi] += 1
+                assign[zi] += 1
             start = 0
-            for j, zi in enumerate(allowed_z):
-                take = base + (1 if j < extra else 0)
+            for zi in allowed_z:
+                take = assign[zi]
                 if take:
-                    expanded.append((plist[start : start + take], zi, mpn))
+                    expanded.append((plist[start : start + take], zi, mpn, None))
                     start += take
+            for pod_i in plist[start:]:
+                unencodable.append(
+                    (pod_i, "zone topology spread unsatisfiable (max skew / zone availability)")
+                )
 
     group_list = [e[0] for e in expanded]
     G = len(group_list)
@@ -239,7 +334,7 @@ def encode_problem(
     # construction on any launched node, never constraints on the type itself.
     provided_keys = set(nodepool.labels) if nodepool else set()
 
-    for gi, (plist, zone_pin, mpn) in enumerate(expanded):
+    for gi, (plist, zone_pin, mpn, zone_mask) in enumerate(expanded):
         pod = plist[0]
         requests[gi] = pod.requests.v
         counts[gi] = len(plist)
@@ -251,6 +346,8 @@ def encode_problem(
         zvs = reqs.get(lbl.TOPOLOGY_ZONE)
         cvs = reqs.get(lbl.CAPACITY_TYPE)
         zone_allowed[gi] = [zvs.contains(z) for z in tensors.zones]
+        if zone_mask is not None:
+            zone_allowed[gi] &= zone_mask
         if zone_pin is not None:
             pin = np.zeros(Z, dtype=bool)
             pin[zone_pin] = True

@@ -68,6 +68,9 @@ def _fit_counts(cap_rem: jnp.ndarray, req: jnp.ndarray) -> jnp.ndarray:
         with_req[None, :], jnp.floor((cap_rem + _EPS) / jnp.where(with_req, req, 1.0)[None, :]), jnp.inf
     )
     k = jnp.min(ratio, axis=-1)
+    # An all-zero request fits "unboundedly": clamp to 1<<30 (the same
+    # sentinel the host/native solvers use) so the int cast is well-defined.
+    k = jnp.minimum(k, float(1 << 30))
     return jnp.maximum(k, 0.0).astype(jnp.int32)
 
 

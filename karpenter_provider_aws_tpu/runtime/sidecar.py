@@ -126,16 +126,22 @@ class SolverClient:
     """Tensor-bundle client; also usable as a TPUSolver drop-in through
     ``RemoteSolver`` below."""
 
-    def __init__(self, target: str):
-        self._channel = grpc.insecure_channel(target)
+    # A hung sidecar must not wedge the reconcile loop behind a deadline-less
+    # RPC: first jit of a new shape bucket can take ~40s, so the default
+    # leaves generous headroom over that, but is still finite.
+    DEFAULT_TIMEOUT_S = 120.0
 
-    def _call(self, method: str, payload: bytes) -> bytes:
+    def __init__(self, target: str, timeout_s: Optional[float] = None):
+        self._channel = grpc.insecure_channel(target)
+        self.timeout_s = timeout_s if timeout_s is not None else self.DEFAULT_TIMEOUT_S
+
+    def _call(self, method: str, payload: bytes, timeout_s: Optional[float] = None) -> bytes:
         fn = self._channel.unary_unary(
             f"/{SERVICE}/{method}",
             request_serializer=bytes,
             response_deserializer=bytes,
         )
-        return fn(payload)
+        return fn(payload, timeout=timeout_s or self.timeout_s)
 
     def solve(self, **tensors) -> dict[str, np.ndarray]:
         return unpack(self._call("Solve", pack(**tensors)))
@@ -144,7 +150,7 @@ class SolverClient:
         return unpack(self._call("SimulateConsolidation", pack(**tensors)))
 
     def health(self) -> int:
-        return int(unpack(self._call("Health", pack()))["device_count"])
+        return int(unpack(self._call("Health", pack(), timeout_s=10.0))["device_count"])
 
     def close(self) -> None:
         self._channel.close()
@@ -184,10 +190,10 @@ class RemoteSolver:
         )
         return decode_remote(problem, out)
 
-    def solve(self, pods, nodepools, catalog, in_use=None):
+    def solve(self, pods, nodepools, catalog, in_use=None, occupancy=None):
         from ..scheduling.solver import _solve_multi_nodepool
 
-        return _solve_multi_nodepool(self, pods, nodepools, catalog, in_use)
+        return _solve_multi_nodepool(self, pods, nodepools, catalog, in_use, occupancy)
 
 
 def serve(address: str = "127.0.0.1:50151") -> SolverServer:

@@ -145,5 +145,5 @@ class NativeSolver:
         )
         return specs, {g: int(c) for g, c in enumerate(unplaced) if c > 0}
 
-    def solve(self, pods, nodepools, catalog, in_use=None):
-        return _solve_multi_nodepool(self, pods, nodepools, catalog, in_use)
+    def solve(self, pods, nodepools, catalog, in_use=None, occupancy=None):
+        return _solve_multi_nodepool(self, pods, nodepools, catalog, in_use, occupancy)

@@ -28,10 +28,13 @@ class OracleNode:
     group_counts: dict[int, int] = field(default_factory=dict)
 
 
+_UNBOUNDED = 1 << 30  # all-zero request: same sentinel as ffd.py / ffd.cpp
+
+
 def _fit_count(cap_rem: np.ndarray, req: np.ndarray) -> int:
     with np.errstate(divide="ignore", invalid="ignore"):
         ratios = np.where(req > 0, np.floor((cap_rem + _EPS) / np.where(req > 0, req, 1.0)), np.inf)
-    return max(int(ratios.min()), 0)
+    return max(int(min(ratios.min(), _UNBOUNDED)), 0)
 
 
 def ffd_oracle(problem: EncodedProblem, max_nodes: int = 100000) -> tuple[list[OracleNode], dict[int, int]]:
@@ -70,7 +73,7 @@ def ffd_oracle(problem: EncodedProblem, max_nodes: int = 100000) -> tuple[list[O
                 np.floor((problem.capacity + _EPS) / np.where(req > 0, req, 1.0)[None, :]),
                 np.inf,
             )
-        k_type = np.maximum(ratios.min(axis=1), 0).astype(np.int32)
+        k_type = np.maximum(np.minimum(ratios.min(axis=1), _UNBOUNDED), 0).astype(np.int32)
         feasible = compat & (k_type >= 1) & np.isfinite(price)
         while cnt > 0 and len(nodes) < max_nodes:
             if not feasible.any():

@@ -24,7 +24,7 @@ from ..catalog.provider import CatalogProvider
 from ..models import labels as lbl
 from ..models.nodepool import NodePool
 from ..models.pod import Pod
-from ..ops.encode import EncodedProblem, bucket, encode_problem, pad_problem
+from ..ops.encode import EncodedProblem, ZoneOccupancy, bucket, encode_problem, pad_problem
 from ..ops.ffd import ffd_solve
 
 # Launch-path truncation parity: instance.go:52-53 — at most 60 instance
@@ -68,6 +68,8 @@ class Solver(Protocol):
         pods: Sequence[Pod],
         nodepools: Sequence[NodePool],
         catalog: CatalogProvider,
+        in_use=None,
+        occupancy: Optional[ZoneOccupancy] = None,
     ) -> SolveResult: ...
 
 
@@ -216,8 +218,8 @@ class TPUSolver:
         unplaced = {g: int(c) for g, c in enumerate(unplaced_arr) if c > 0}
         return specs, unplaced
 
-    def solve(self, pods, nodepools, catalog, in_use=None) -> SolveResult:
-        return _solve_multi_nodepool(self, pods, nodepools, catalog, in_use)
+    def solve(self, pods, nodepools, catalog, in_use=None, occupancy=None) -> SolveResult:
+        return _solve_multi_nodepool(self, pods, nodepools, catalog, in_use, occupancy)
 
 
 class HostSolver:
@@ -250,8 +252,8 @@ class HostSolver:
         )
         return specs, unplaced
 
-    def solve(self, pods, nodepools, catalog, in_use=None) -> SolveResult:
-        return _solve_multi_nodepool(self, pods, nodepools, catalog, in_use)
+    def solve(self, pods, nodepools, catalog, in_use=None, occupancy=None) -> SolveResult:
+        return _solve_multi_nodepool(self, pods, nodepools, catalog, in_use, occupancy)
 
 
 def _enforce_pool_constraints(
@@ -307,7 +309,9 @@ def _enforce_pool_constraints(
     return kept, rejected
 
 
-def _solve_multi_nodepool(impl, pods, nodepools, catalog, in_use=None) -> SolveResult:
+def _solve_multi_nodepool(
+    impl, pods, nodepools, catalog, in_use=None, occupancy=None
+) -> SolveResult:
     t0 = time.perf_counter()
     result = SolveResult(num_pods=len(pods))
     remaining: list[Pod] = list(pods)
@@ -316,7 +320,7 @@ def _solve_multi_nodepool(impl, pods, nodepools, catalog, in_use=None) -> SolveR
     for pool in sorted(nodepools, key=lambda p: -p.weight):
         if not remaining:
             break
-        problem = encode_problem(remaining, catalog, nodepool=pool)
+        problem = encode_problem(remaining, catalog, nodepool=pool, occupancy=occupancy)
         for pod, why in problem.unencodable:
             reasons[pod.uid] = f"nodepool {pool.name}: {why}"
         specs, unplaced = impl.solve_encoded(problem)

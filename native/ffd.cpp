@@ -30,9 +30,11 @@ inline int fit_count(const float* cap, const float* used, const float* req, int 
             if (q < k) k = q;
         }
     }
-    if (!std::isfinite(k)) return 0;
+    // All-zero request: fits "unboundedly" — clamp to the shared 1<<30
+    // sentinel (same as ops/ffd.py and scheduling/oracle.py).
+    constexpr float kUnbounded = 1073741824.0f;  // 1 << 30
+    if (!std::isfinite(k) || k > kUnbounded) k = kUnbounded;
     if (k < 0.0f) k = 0.0f;
-    if (k > 2.0e9f) k = 2.0e9f;
     return static_cast<int>(k);
 }
 

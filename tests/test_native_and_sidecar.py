@@ -118,3 +118,38 @@ class TestSidecar:
 
         with pytest.raises(grpc.RpcError):
             client._call("Solve", b"not an npz archive")
+
+
+class TestZeroRequestAlignment:
+    """An all-zero request row (only possible via raw tensors — Pod always
+    carries a pods=1 slot) must behave identically in all three solvers:
+    unbounded fit clamped to 1<<30, capped by max_per_node/count."""
+
+    def _problem(self, catalog, pool):
+        from karpenter_provider_aws_tpu.models.pod import make_pods
+        from karpenter_provider_aws_tpu.ops.encode import encode_problem
+
+        pods = make_pods(3, "z", {"cpu": "0"})
+        problem = encode_problem(pods, catalog, nodepool=pool)
+        problem.requests[:] = 0.0  # strip even the implicit pods slot
+        return problem
+
+    def test_oracle_places_zero_request(self, catalog, pool):
+        from karpenter_provider_aws_tpu.scheduling.oracle import ffd_oracle
+
+        nodes, unplaced = ffd_oracle(self._problem(catalog, pool))
+        assert not unplaced
+        assert len(nodes) == 1  # all replicas fit one node
+
+    @pytest.mark.skipif(not native_available(), reason="native build unavailable")
+    def test_native_matches_oracle(self, catalog, pool):
+        specs, unplaced = NativeSolver().solve_encoded(self._problem(catalog, pool))
+        assert not unplaced
+        assert len(specs) == 1
+        assert len(specs[0].pods) == 3
+
+    def test_tpu_matches_oracle(self, catalog, pool):
+        specs, unplaced = TPUSolver().solve_encoded(self._problem(catalog, pool))
+        assert not unplaced
+        assert len(specs) == 1
+        assert len(specs[0].pods) == 3

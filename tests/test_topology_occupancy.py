@@ -1,0 +1,235 @@
+"""Zone topology must account for already-bound replicas and ICE'd zones.
+
+Scale-ups of a zone-anti-affinity / spread / affinity workload see the
+replicas that are already running (via ``ZoneOccupancy``), and spread
+expansion only assigns shares to zones with live offerings. Rebinds onto
+existing capacity enforce the same modes (``_topology_allows``).
+"""
+
+import pytest
+
+from karpenter_provider_aws_tpu.catalog import CatalogProvider
+from karpenter_provider_aws_tpu.models import NodePool
+from karpenter_provider_aws_tpu.models import labels as lbl
+from karpenter_provider_aws_tpu.models.pod import (
+    PodAffinityTerm,
+    TopologySpreadConstraint,
+    make_pods,
+)
+from karpenter_provider_aws_tpu.ops.encode import ZoneOccupancy, encode_problem
+from karpenter_provider_aws_tpu.scheduling import HostSolver, TPUSolver
+
+
+@pytest.fixture(scope="module")
+def catalog():
+    return CatalogProvider()
+
+
+@pytest.fixture(scope="module")
+def pool():
+    return NodePool(name="default")
+
+
+def zone_spread(max_skew=1):
+    return TopologySpreadConstraint(
+        topology_key=lbl.TOPOLOGY_ZONE, max_skew=max_skew,
+        label_selector={"app": "web"},
+    )
+
+
+def zone_anti():
+    return PodAffinityTerm(topology_key=lbl.TOPOLOGY_ZONE, label_selector={"app": "web"})
+
+
+def occupancy_with(counts: dict[str, int]) -> ZoneOccupancy:
+    entries = []
+    for zone, n in counts.items():
+        entries += [({"app": "web"}, zone)] * n
+    return ZoneOccupancy(entries)
+
+
+@pytest.mark.parametrize("solver_cls", [TPUSolver, HostSolver])
+class TestScaleUpOccupancy:
+    def test_anti_affinity_avoids_occupied_zones(self, catalog, pool, solver_cls):
+        pods = make_pods(2, "w", {"cpu": "1"}, labels={"app": "web"},
+                         anti_affinity=[zone_anti()])
+        occ = occupancy_with({"zone-a": 1, "zone-b": 1})
+        res = solver_cls().solve(pods, [pool], catalog, occupancy=occ)
+        assert res.pods_placed() == 2
+        zones = sorted(spec.zone_options[0] for spec in res.node_specs)
+        assert zones == ["zone-c", "zone-d"]
+
+    def test_anti_affinity_unschedulable_when_all_zones_occupied(
+        self, catalog, pool, solver_cls
+    ):
+        pods = make_pods(2, "w", {"cpu": "1"}, labels={"app": "web"},
+                         anti_affinity=[zone_anti()])
+        occ = occupancy_with({"zone-a": 1, "zone-b": 1, "zone-c": 1, "zone-d": 1})
+        res = solver_cls().solve(pods, [pool], catalog, occupancy=occ)
+        assert res.pods_placed() == 0
+        assert len(res.unschedulable) == 2
+        assert "zone anti-affinity" in res.unschedulable[0][1]
+
+    def test_spread_balances_against_existing(self, catalog, pool, solver_cls):
+        # 3 replicas already in zone-a: the 3 new ones must land in b/c/d.
+        pods = make_pods(3, "w", {"cpu": "1"}, labels={"app": "web"},
+                         topology_spread=[zone_spread(max_skew=1)])
+        occ = occupancy_with({"zone-a": 3})
+        res = solver_cls().solve(pods, [pool], catalog, occupancy=occ)
+        assert res.pods_placed() == 3
+        zones = sorted(spec.zone_options[0] for spec in res.node_specs)
+        assert zones == ["zone-b", "zone-c", "zone-d"]
+
+    def test_affinity_co_locates_with_existing(self, catalog, pool, solver_cls):
+        pods = make_pods(3, "w", {"cpu": "1"}, labels={"app": "web"},
+                         affinity=[zone_anti()])
+        occ = occupancy_with({"zone-b": 2})
+        res = solver_cls().solve(pods, [pool], catalog, occupancy=occ)
+        assert res.pods_placed() == 3
+        assert {spec.zone_options[0] for spec in res.node_specs} == {"zone-b"}
+
+
+class TestSpreadICE:
+    def _ice_zone(self, catalog, zone):
+        for name in catalog.names():
+            for ct in lbl.CAPACITY_TYPES:
+                catalog.unavailable.mark_unavailable(name, zone, ct)
+
+    def test_spread_skips_dead_zone_when_skew_allows(self):
+        catalog = CatalogProvider()
+        self._ice_zone(catalog, "zone-d")
+        pool = NodePool(name="default")
+        pods = make_pods(12, "w", {"cpu": "1"}, labels={"app": "web"},
+                         topology_spread=[zone_spread(max_skew=5)])
+        res = HostSolver().solve(pods, [pool], catalog)
+        assert res.pods_placed() == 12
+        by_zone = {}
+        for spec in res.node_specs:
+            z = spec.zone_options[0]
+            by_zone[z] = by_zone.get(z, 0) + len(spec.pods)
+        assert "zone-d" not in by_zone
+        assert sorted(by_zone.values()) == [4, 4, 4]
+
+    def test_spread_respects_skew_against_dead_zone(self):
+        # max_skew=1 with an unfillable zone caps every live zone at 1.
+        catalog = CatalogProvider()
+        self._ice_zone(catalog, "zone-d")
+        pool = NodePool(name="default")
+        pods = make_pods(12, "w", {"cpu": "1"}, labels={"app": "web"},
+                         topology_spread=[zone_spread(max_skew=1)])
+        res = HostSolver().solve(pods, [pool], catalog)
+        assert res.pods_placed() == 3
+        assert len(res.unschedulable) == 9
+        assert "topology spread" in res.unschedulable[0][1]
+
+
+class TestEncodeOccupancy:
+    def test_encoder_reports_occupancy_splits(self, catalog, pool):
+        pods = make_pods(4, "w", {"cpu": "1"}, labels={"app": "web"},
+                         topology_spread=[zone_spread(max_skew=1)])
+        occ = occupancy_with({"zone-a": 2, "zone-b": 2})
+        problem = encode_problem(pods, catalog, nodepool=pool, occupancy=occ)
+        # water-fill: c and d catch up first (2 each)
+        zone_share = {}
+        for gi, plist in enumerate(problem.group_pods):
+            allowed = problem.group_zone_allowed[gi].nonzero()[0]
+            assert len(allowed) == 1
+            zone_share[int(allowed[0])] = len(plist)
+        assert sorted(zone_share.values()) == [2, 2]
+        assert set(zone_share) == {2, 3}  # zone-c, zone-d indices
+
+
+class _FakeNode:
+    def __init__(self, name, zone):
+        self.name = name
+        self.ready = True
+        self.cordoned = False
+        self._zone = zone
+
+    def zone(self):
+        return self._zone
+
+
+class _FakeCluster:
+    def __init__(self, nodes, pods_by_node):
+        self._nodes = nodes
+        self._pods = pods_by_node
+
+    def snapshot_nodes(self):
+        return self._nodes
+
+    def pods_on_node(self, name):
+        return self._pods.get(name, [])
+
+
+class TestRebindTopology:
+    def _controller(self, nodes, pods_by_node):
+        from karpenter_provider_aws_tpu.controllers.scheduling import SchedulingController
+
+        return SchedulingController(_FakeCluster(nodes, pods_by_node))
+
+    def test_rebind_blocks_spread_violation(self):
+        nodes = [_FakeNode("n-a", "zone-a"), _FakeNode("n-b", "zone-b")]
+        web = make_pods(2, "w", {"cpu": "1"}, labels={"app": "web"},
+                        topology_spread=[zone_spread(max_skew=1)])
+        ctrl = self._controller(nodes, {"n-a": [web[0]]})
+        pending = make_pods(1, "p", {"cpu": "1"}, labels={"app": "web"},
+                            topology_spread=[zone_spread(max_skew=1)])[0]
+        # zone-a already has 1, zone-b has 0: binding into zone-a gives
+        # skew 2 > 1, zone-b is fine.
+        nodemap = {n.name: n for n in nodes}
+        assert not ctrl._topology_allows(pending, nodemap["n-a"], nodemap)
+        assert ctrl._topology_allows(pending, nodemap["n-b"], nodemap)
+
+    def test_rebind_blocks_affinity_to_wrong_zone(self):
+        nodes = [_FakeNode("n-a", "zone-a"), _FakeNode("n-b", "zone-b")]
+        web = make_pods(1, "w", {"cpu": "1"}, labels={"app": "web"})[0]
+        ctrl = self._controller(nodes, {"n-b": [web]})
+        pending = make_pods(1, "p", {"cpu": "1"}, labels={"app": "web"},
+                            affinity=[zone_anti()])[0]
+        nodemap = {n.name: n for n in nodes}
+        assert not ctrl._topology_allows(pending, nodemap["n-a"], nodemap)
+        assert ctrl._topology_allows(pending, nodemap["n-b"], nodemap)
+
+    def test_rebind_allows_affinity_seed_when_no_matches(self):
+        nodes = [_FakeNode("n-a", "zone-a")]
+        ctrl = self._controller(nodes, {})
+        pending = make_pods(1, "p", {"cpu": "1"}, labels={"app": "web"},
+                            affinity=[zone_anti()])[0]
+        nodemap = {n.name: n for n in nodes}
+        assert ctrl._topology_allows(pending, nodemap["n-a"], nodemap)
+
+
+class TestCrossSelectorAntiAffinity:
+    """A non-self-matching zone anti-affinity term (web must avoid db zones)
+    blocks occupied zones at provisioning and rebind time."""
+
+    def test_encoder_blocks_zones_with_other_workload(self):
+        catalog = CatalogProvider()
+        pool = NodePool(name="default")
+        avoid_db = PodAffinityTerm(
+            topology_key=lbl.TOPOLOGY_ZONE, label_selector={"app": "db"}
+        )
+        pods = make_pods(2, "w", {"cpu": "1"}, labels={"app": "web"},
+                         anti_affinity=[avoid_db])
+        entries = [({"app": "db"}, "zone-a"), ({"app": "db"}, "zone-b")]
+        res = HostSolver().solve(pods, [pool], catalog,
+                                 occupancy=ZoneOccupancy(entries))
+        assert res.pods_placed() == 2
+        for spec in res.node_specs:
+            assert set(spec.zone_options) <= {"zone-c", "zone-d"}
+
+    def test_rebind_blocks_zone_with_other_workload(self):
+        from karpenter_provider_aws_tpu.controllers.scheduling import SchedulingController
+
+        nodes = [_FakeNode("n-a", "zone-a"), _FakeNode("n-b", "zone-b")]
+        db = make_pods(1, "db", {"cpu": "1"}, labels={"app": "db"})[0]
+        ctrl = SchedulingController(_FakeCluster(nodes, {"n-a": [db]}))
+        avoid_db = PodAffinityTerm(
+            topology_key=lbl.TOPOLOGY_ZONE, label_selector={"app": "db"}
+        )
+        pending = make_pods(1, "w", {"cpu": "1"}, labels={"app": "web"},
+                            anti_affinity=[avoid_db])[0]
+        nodemap = {n.name: n for n in nodes}
+        assert not ctrl._topology_allows(pending, nodemap["n-a"], nodemap)
+        assert ctrl._topology_allows(pending, nodemap["n-b"], nodemap)
