@@ -23,8 +23,10 @@ from ..fake.cloud import LaunchRequest
 from ..models import labels as lbl
 from ..models.nodeclaim import NodeClaim
 from ..models.nodeclass import NodeClass
+from ..providers.bootstrap import ClusterInfo
 from ..providers.images import ImageProvider, resolve_image_for
 from ..providers.instanceprofiles import InstanceProfileProvider
+from ..providers.launchtemplates import LaunchTemplateProvider
 from ..providers.securitygroups import SecurityGroupProvider
 from ..providers.subnets import SubnetProvider
 from ..utils import errors
@@ -52,15 +54,18 @@ class CloudProvider:
         cluster,
         clock: Optional[Clock] = None,
         batcher_options: Optional[BatcherOptions] = None,
+        cluster_info: Optional[ClusterInfo] = None,
     ):
         self.cloud = cloud
         self.catalog = catalog
         self.cluster = cluster
         self.clock = clock or RealClock()
+        self.cluster_info = cluster_info or ClusterInfo(name="cluster-1")
         self.subnets = SubnetProvider(cloud, clock=clock)
         self.security_groups = SecurityGroupProvider(cloud, clock=clock)
         self.images = ImageProvider(cloud, clock=clock)
         self.instance_profiles = InstanceProfileProvider(cloud, clock=clock)
+        self.launch_templates = LaunchTemplateProvider(cloud, self.cluster_info, clock=clock)
         opts = batcher_options or BatcherOptions()
         self._fleet_batcher: Batcher = Batcher(self.cloud.create_fleet, options=opts)
         self._terminate_batcher: Batcher = Batcher(
@@ -112,6 +117,19 @@ class CloudProvider:
             raise errors.CloudError("no subnet available in candidate zones", code="NoSubnets")
         sgs = tuple(g.id for g in self.security_groups.list(nodeclass))
 
+        # Ensure the launch template for this image group (parity:
+        # launchtemplate.EnsureAll at instance.go launch time).
+        def ensure_template() -> str:
+            pool = self.cluster.nodepools.get(claim.nodepool_name)
+            return self.launch_templates.ensure_all(
+                nodeclass,
+                [(image, type_options)],
+                labels=dict(claim.labels),
+                taints=list(claim.taints) + list(claim.startup_taints),
+                kubelet=getattr(pool, "kubelet", None) if pool else None,
+            )[image.id]
+
+        lt_name = ensure_template()
         request = LaunchRequest(
             instance_type_options=[t.name for t in type_options],
             offering_options=offerings,
@@ -124,9 +142,19 @@ class CloudProvider:
                 NODECLAIM_TAG: claim.name,
                 **nodeclass.tags,
             },
+            launch_template_name=lt_name,
         )
         try:
-            result = self._fleet_batcher.add(request)
+            try:
+                result = self._fleet_batcher.add(request)
+            except errors.CloudError as e:
+                if not errors.is_launch_template_not_found(e):
+                    raise
+                # Single retry after re-ensuring the template (parity:
+                # instance.go:106-110 LT-not-found retry).
+                self.launch_templates.invalidate(lt_name)
+                request.launch_template_name = ensure_template()
+                result = self._fleet_batcher.add(request)
         except Exception as e:
             # give back every pre-deducted IP, then classify ICE into the
             # unavailable cache so the next solve masks the offering
@@ -200,6 +228,7 @@ class CloudProvider:
         self.security_groups.reset()
         self.images.reset()
         self.instance_profiles.reset()
+        self.launch_templates.reset()
 
     def get(self, provider_id: str):
         instance_id = parse_provider_id(provider_id)

@@ -2,7 +2,8 @@
 
 Parity: ``pkg/controllers/nodeclass/termination/controller.go:68-129`` —
 block until no NodeClaims reference the class, then delete the managed
-instance profile and remove the finalizer.
+instance profile and every managed launch template, and remove the
+finalizer.
 """
 
 from __future__ import annotations
@@ -26,4 +27,5 @@ class NodeClassTerminationController:
             if self.cluster.claims_for_nodeclass(nc.name):
                 continue  # blocked until claims drain (controller.go:80-86)
             self.cloudprovider.instance_profiles.delete(nc)
+            self.cloudprovider.launch_templates.delete_all(nc)
             self.cluster.finalize(nc)

@@ -55,3 +55,17 @@ class PricingRefreshController:
         if self.spot_source:
             self.catalog.pricing.update_spot(self.spot_source())
         self.refreshes += 1
+
+
+class VersionRefreshController:
+    """Re-poll the control-plane version and re-check the support window
+    (parity: version.go's 15m poll through the cached provider)."""
+
+    name = "version-refresh"
+    interval_s = 15 * 60.0
+
+    def __init__(self, version_provider):
+        self.version_provider = version_provider
+
+    def reconcile(self) -> None:
+        self.version_provider.get()

@@ -73,6 +73,18 @@ class Instance:
 
 
 @dataclass
+class LaunchTemplateData:
+    name: str
+    image_id: str
+    user_data: str = ""
+    instance_profile: str = ""
+    security_group_ids: tuple[str, ...] = ()
+    block_devices: tuple = ()
+    metadata_options: Optional[object] = None
+    tags: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
 class LaunchRequest:
     """One logical single-node launch; the batcher coalesces many of these
     into one fleet call (parity: createfleet.go:52-110)."""
@@ -83,6 +95,7 @@ class LaunchRequest:
     subnet_by_zone: dict[str, str] = field(default_factory=dict)
     security_group_ids: tuple[str, ...] = ()
     tags: dict[str, str] = field(default_factory=dict)
+    launch_template_name: str = ""            # "" = launch without a template
 
 
 class FakeCloud:
@@ -104,9 +117,14 @@ class FakeCloud:
             Image(id="img-gpu-1", name="gpu-v1", family="gpu", arch="amd64", gpu=True, created_seq=1),
             Image(id="img-min-1", name="minimal-v1", family="minimal", arch="amd64", created_seq=1),
             Image(id="img-min-arm-1", name="minimal-arm-v1", family="minimal", arch="arm64", created_seq=1),
+            Image(id="img-br-1", name="bottlerocket-v1", family="bottlerocket", arch="amd64", created_seq=1),
+            Image(id="img-br-arm-1", name="bottlerocket-arm-v1", family="bottlerocket", arch="arm64", created_seq=1),
+            Image(id="img-nodeadm-1", name="nodeadm-v1", family="nodeadm", arch="amd64", created_seq=1),
+            Image(id="img-nodeadm-arm-1", name="nodeadm-arm-v1", family="nodeadm", arch="arm64", created_seq=1),
         ]
         self.instances: dict[str, Instance] = {}
         self.instance_profiles: dict[str, dict] = {}
+        self.launch_templates: dict[str, LaunchTemplateData] = {}
         # Fault injection
         self.ice_pools: set[tuple[str, str, str]] = set()   # (captype, type, zone)
         self.capacity_pools: dict[tuple[str, str, str], int] = {}
@@ -127,6 +145,7 @@ class FakeCloud:
         with self._lock:
             self.instances.clear()
             self.instance_profiles.clear()
+            self.launch_templates.clear()
             self.ice_pools.clear()
             self.capacity_pools.clear()
             self.next_errors.clear()
@@ -145,6 +164,13 @@ class FakeCloud:
             return results
 
     def _launch_one(self, req: LaunchRequest):
+        # Launch-template reference must resolve (parity: CreateFleet's
+        # InvalidLaunchTemplateName.NotFoundException, instance.go:106-110).
+        if req.launch_template_name and req.launch_template_name not in self.launch_templates:
+            return NotFoundError(
+                f"launch template {req.launch_template_name} not found",
+                code="InvalidLaunchTemplateName.NotFoundException",
+            )
         # Walk ranked (type, offering) choices; first non-ICE pool wins —
         # mirrors CreateFleet's lowest-price allocation honoring ICE pools.
         last_ice = None
@@ -246,6 +272,38 @@ class FakeCloud:
             self._record("describe_images", None)
             self._maybe_fail()
             return [i for i in self.images if not i.deprecated]
+
+    # -- launch templates --------------------------------------------------
+    def create_launch_template(self, name: str, image_id: str, user_data: str = "",
+                               instance_profile: str = "", security_group_ids=(),
+                               block_devices=(), metadata_options=None,
+                               tags: Optional[dict[str, str]] = None) -> LaunchTemplateData:
+        with self._lock:
+            self._record("create_launch_template", name)
+            self._maybe_fail()
+            lt = LaunchTemplateData(
+                name=name, image_id=image_id, user_data=user_data,
+                instance_profile=instance_profile,
+                security_group_ids=tuple(security_group_ids),
+                block_devices=tuple(block_devices),
+                metadata_options=metadata_options, tags=dict(tags or {}),
+            )
+            self.launch_templates[name] = lt
+            return lt
+
+    def describe_launch_templates(self) -> list[LaunchTemplateData]:
+        with self._lock:
+            self._record("describe_launch_templates", None)
+            self._maybe_fail()
+            return list(self.launch_templates.values())
+
+    def delete_launch_template(self, name: str) -> None:
+        with self._lock:
+            self._record("delete_launch_template", name)
+            self._maybe_fail()
+            if name not in self.launch_templates:
+                raise NotFoundError(f"launch template {name} not found")
+            del self.launch_templates[name]
 
     # -- instance profiles (IAM analogue) ----------------------------------
     def create_instance_profile(self, name: str, role: str, tags: dict[str, str]) -> None:

@@ -46,6 +46,48 @@ class SelectorTerm:
 
 
 @dataclass(frozen=True)
+class KubeletConfiguration:
+    """Kubelet knobs surfaced through node bootstrap
+    (parity: v1beta1 KubeletConfiguration consumed at bootstrap.go:36-64)."""
+
+    max_pods: Optional[int] = None
+    pods_per_core: Optional[int] = None
+    cluster_dns: tuple[str, ...] = ()
+    system_reserved: tuple[tuple[str, str], ...] = ()
+    kube_reserved: tuple[tuple[str, str], ...] = ()
+    eviction_hard: tuple[tuple[str, str], ...] = ()
+    eviction_soft: tuple[tuple[str, str], ...] = ()
+    image_gc_high_threshold_percent: Optional[int] = None
+    image_gc_low_threshold_percent: Optional[int] = None
+    cpu_cfs_quota: Optional[bool] = None
+
+    def extra_args(self) -> list[str]:
+        """--flag=value kubelet arguments (parity: kubeletExtraArgs)."""
+        args: list[str] = []
+        if self.max_pods is not None:
+            args.append(f"--max-pods={self.max_pods}")
+        if self.pods_per_core is not None:
+            args.append(f"--pods-per-core={self.pods_per_core}")
+        if self.cluster_dns:
+            args.append("--cluster-dns=" + ",".join(self.cluster_dns))
+        for flag, pairs in (
+            ("--system-reserved", self.system_reserved),
+            ("--kube-reserved", self.kube_reserved),
+            ("--eviction-hard", self.eviction_hard),
+            ("--eviction-soft", self.eviction_soft),
+        ):
+            if pairs:
+                args.append(flag + "=" + ",".join(f"{k}={v}" for k, v in pairs))
+        if self.image_gc_high_threshold_percent is not None:
+            args.append(f"--image-gc-high-threshold={self.image_gc_high_threshold_percent}")
+        if self.image_gc_low_threshold_percent is not None:
+            args.append(f"--image-gc-low-threshold={self.image_gc_low_threshold_percent}")
+        if self.cpu_cfs_quota is not None:
+            args.append(f"--cpu-cfs-quota={str(self.cpu_cfs_quota).lower()}")
+        return args
+
+
+@dataclass(frozen=True)
 class BlockDevice:
     device_name: str = "/dev/xvda"
     volume_size_gib: int = 20

@@ -14,6 +14,7 @@ from typing import Optional
 from .requirements import Requirement, Requirements
 from .resources import ResourceVector
 from . import labels as lbl
+from .nodeclass import KubeletConfiguration
 
 
 @dataclass(frozen=True)
@@ -80,6 +81,9 @@ class NodePool:
     limits: Limits = field(default_factory=Limits)
     disruption: Disruption = field(default_factory=Disruption)
     weight: int = 0  # higher = preferred, like core NodePool.spec.weight
+    # Kubelet knobs templated onto every node of this pool (parity: the
+    # v1beta1 NodePool.spec.template.spec.kubelet block).
+    kubelet: "Optional[KubeletConfiguration]" = None
 
     def scheduling_requirements(self) -> Requirements:
         """Template requirements + identity labels as a requirement set."""

@@ -29,7 +29,11 @@ from ..controllers import (
     TaggingController,
     TerminationController,
 )
-from ..controllers.refresh import CatalogRefreshController, PricingRefreshController
+from ..controllers.refresh import (
+    CatalogRefreshController,
+    PricingRefreshController,
+    VersionRefreshController,
+)
 from ..catalog.pricing import PricingProvider
 from ..scheduling.solver import HostSolver, TPUSolver
 from ..state.cluster import Cluster
@@ -49,6 +53,7 @@ class Operator:
     cloudprovider: CloudProvider
     manager: Manager
     metrics_port: int = 0
+    version_provider: object = None
 
     def start(self) -> None:
         if self.options.metrics_port:
@@ -106,6 +111,8 @@ def new_operator(
         clock=clock,
     )
     cluster = Cluster(clock=clock)
+    from ..providers.bootstrap import ClusterInfo
+
     cloudprovider = CloudProvider(
         cloud,
         catalog,
@@ -115,7 +122,18 @@ def new_operator(
             idle_timeout_s=options.batch_idle_seconds,
             max_timeout_s=options.batch_max_seconds,
         ),
+        cluster_info=ClusterInfo(
+            name=options.cluster_name, endpoint=options.cluster_endpoint
+        ),
     )
+    # Metrics decorator around the plugin boundary (parity: main.go:44).
+    from ..cloudprovider.decorator import decorate
+    from ..providers.version import VersionProvider
+
+    cloudprovider = decorate(cloudprovider)
+    version_provider = VersionProvider(cluster, clock=clock)
+    version_provider.get()  # support-window preflight
+
     solver = _build_solver(options)
 
     provisioning = ProvisioningController(cluster, solver, cloudprovider)
@@ -142,6 +160,7 @@ def new_operator(
         NodeClassTerminationController(cluster, cloudprovider),
         CatalogRefreshController(catalog),
         PricingRefreshController(catalog),
+        VersionRefreshController(version_provider),
     ]
     # parity: interruption controller registered iff a queue is configured
     # (pkg/controllers/controllers.go:67-71)
@@ -154,4 +173,5 @@ def new_operator(
         catalog=catalog,
         cloudprovider=cloudprovider,
         manager=Manager(controllers),
+        version_provider=version_provider,
     )

@@ -39,10 +39,14 @@ def validate_nodeclass(nc: NodeClass) -> None:
         v.append("role and instanceProfile are mutually exclusive")  # CEL rule parity
     if not nc.role and not nc.instance_profile:
         v.append("one of role or instanceProfile is required")
-    if nc.image_family not in ("standard", "minimal", "gpu", "custom"):
+    from ..providers.bootstrap import _FAMILIES
+
+    if nc.image_family not in _FAMILIES:
         v.append(f"unknown imageFamily {nc.image_family!r}")
     if nc.image_family == "custom" and not nc.image_selector:
         v.append("imageFamily custom requires imageSelector terms")
+    if nc.image_family == "custom" and not nc.user_data:
+        v.append("imageFamily custom requires userData")
     for term in nc.subnet_selector + nc.security_group_selector + nc.image_selector:
         if not term.id and not term.tags and not term.name:
             v.append("selector terms must set id, name, or tags")

@@ -1,0 +1,258 @@
+"""Bootstrap: per-image-family node userdata generation.
+
+Parity: ``pkg/providers/amifamily/bootstrap/`` — the ``Bootstrapper``
+strategy interface (bootstrap.go), kubelet args derived from a
+KubeletConfiguration (bootstrap.go:36-64 kubeletExtraArgs), MIME-multipart
+merge of custom userdata with the generated script (eksbootstrap.go),
+TOML settings for the bottlerocket-style family (bottlerocket.go,
+bottlerocketsettings.go), YAML node config for the nodeadm-style family
+(nodeadm.go), and verbatim passthrough for ``custom`` (custom.go).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Mapping, Optional, Sequence
+
+from ..models.nodeclass import KubeletConfiguration  # noqa: F401  (API-layer type)
+
+
+@dataclass(frozen=True)
+class ClusterInfo:
+    """What a node needs to join the cluster (parity: the cluster
+    name/endpoint/CA/DNS-IP resolved by operator.go:214-260)."""
+
+    name: str
+    endpoint: str = ""
+    ca_bundle: str = ""
+    dns_ip: str = ""
+    version: str = ""
+
+
+
+
+def _node_labels_arg(labels: Mapping[str, str]) -> str:
+    return ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+
+
+def _taints_arg(taints: Sequence) -> str:
+    return ",".join(
+        f"{t.key}={t.value}:{t.effect}" if t.value else f"{t.key}:{t.effect}"
+        for t in taints
+    )
+
+
+class ShellBootstrap:
+    """eksbootstrap.sh-style shell script (families: standard/minimal/gpu).
+
+    Custom userdata, when present, is merged ahead of the generated script in
+    a MIME multipart document (parity: eksbootstrap.go mime merge — cloud
+    init runs parts in order, user parts first)."""
+
+    def __init__(self, cluster: ClusterInfo, kubelet: KubeletConfiguration,
+                 labels: Mapping[str, str], taints: Sequence, custom: str = ""):
+        self.cluster = cluster
+        self.kubelet = kubelet
+        self.labels = labels
+        self.taints = taints
+        self.custom = custom
+
+    def script(self) -> str:
+        kubelet_args = list(self.kubelet.extra_args())
+        if self.labels:
+            kubelet_args.append(f"--node-labels={_node_labels_arg(self.labels)}")
+        if self.taints:
+            kubelet_args.append(f"--register-with-taints={_taints_arg(self.taints)}")
+        lines = [
+            "#!/bin/bash -xe",
+            f"/etc/node/bootstrap.sh '{self.cluster.name}' \\",
+            f"  --apiserver-endpoint '{self.cluster.endpoint}' \\",
+            f"  --b64-cluster-ca '{self.cluster.ca_bundle}' \\",
+        ]
+        if self.cluster.dns_ip:
+            lines.append(f"  --dns-cluster-ip '{self.cluster.dns_ip}' \\")
+        lines.append(f"  --kubelet-extra-args '{' '.join(kubelet_args)}'")
+        generated = "\n".join(lines) + "\n"
+        if not self.custom:
+            return generated
+        return mime_merge([self.custom, generated])
+
+
+class NodeadmBootstrap(ShellBootstrap):
+    """YAML NodeConfig (the AL2023/nodeadm-style family, nodeadm.go)."""
+
+    def script(self) -> str:
+        cfg = {
+            "apiVersion": "node.karpenter.tpu/v1alpha1",
+            "kind": "NodeConfig",
+            "spec": {
+                "cluster": {
+                    "name": self.cluster.name,
+                    "apiServerEndpoint": self.cluster.endpoint,
+                    "certificateAuthority": self.cluster.ca_bundle,
+                    "cidr": "",
+                },
+                "kubelet": {
+                    "flags": self.kubelet.extra_args()
+                    + ([f"--node-labels={_node_labels_arg(self.labels)}"] if self.labels else [])
+                    + ([f"--register-with-taints={_taints_arg(self.taints)}"] if self.taints else []),
+                },
+            },
+        }
+        generated = "# node.karpenter.tpu NodeConfig\n" + _yaml_dump(cfg)
+        if not self.custom:
+            return generated
+        return mime_merge([self.custom, generated])
+
+
+class TomlBootstrap(ShellBootstrap):
+    """TOML settings document (the bottlerocket-style family).
+
+    Custom userdata is parsed as TOML and deep-merged with the generated
+    settings, generated keys winning (parity: bottlerocket.go merge
+    semantics — karpenter-owned cluster settings are authoritative).
+    Invalid custom TOML raises, surfacing at launch time."""
+
+    def script(self) -> str:
+        settings: dict = {"settings": {"kubernetes": {}}}
+        k8s = settings["settings"]["kubernetes"]
+        k8s["cluster-name"] = self.cluster.name
+        k8s["api-server"] = self.cluster.endpoint
+        if self.cluster.ca_bundle:
+            k8s["cluster-certificate"] = self.cluster.ca_bundle
+        if self.cluster.dns_ip:
+            k8s["cluster-dns-ip"] = self.cluster.dns_ip
+        if self.kubelet.max_pods is not None:
+            k8s["max-pods"] = self.kubelet.max_pods
+        if self.labels:
+            k8s["node-labels"] = dict(sorted(self.labels.items()))
+        if self.taints:
+            k8s["node-taints"] = {t.key: f"{t.value}:{t.effect}" for t in self.taints}
+        if self.custom:
+            import tomllib
+
+            try:
+                base = tomllib.loads(self.custom)
+            except tomllib.TOMLDecodeError as e:
+                raise ValueError(f"custom userdata is not valid TOML: {e}") from e
+            settings = _deep_merge(base, settings)
+        return _toml_dump(settings)
+
+
+class CustomBootstrap(ShellBootstrap):
+    """Verbatim user data; the user owns the whole bootstrap (custom.go)."""
+
+    def script(self) -> str:
+        return self.custom
+
+
+_MIME_BOUNDARY = "//KARPENTER-TPU-BOUNDARY//"
+
+
+def mime_merge(parts: Sequence[str]) -> str:
+    """Join userdata parts into one multipart/mixed document
+    (parity: bootstrap/mime — parts execute in order)."""
+    out = [
+        "MIME-Version: 1.0",
+        f'Content-Type: multipart/mixed; boundary="{_MIME_BOUNDARY}"',
+        "",
+    ]
+    for part in parts:
+        ctype = (
+            "text/x-shellscript" if part.lstrip().startswith("#!") else "text/plain"
+        )
+        out += [
+            f"--{_MIME_BOUNDARY}",
+            f'Content-Type: {ctype}; charset="us-ascii"',
+            "",
+            part.rstrip("\n"),
+        ]
+    out.append(f"--{_MIME_BOUNDARY}--")
+    return "\n".join(out) + "\n"
+
+
+_FAMILIES = {
+    "standard": ShellBootstrap,
+    "minimal": ShellBootstrap,
+    "gpu": ShellBootstrap,
+    "nodeadm": NodeadmBootstrap,
+    "bottlerocket": TomlBootstrap,
+    "custom": CustomBootstrap,
+}
+
+
+def bootstrapper_for(
+    family: str,
+    cluster: ClusterInfo,
+    kubelet: Optional[KubeletConfiguration] = None,
+    labels: Optional[Mapping[str, str]] = None,
+    taints: Sequence = (),
+    custom: str = "",
+) -> ShellBootstrap:
+    """Family alias -> bootstrapper (parity: GetAMIFamily resolver.go:80-112).
+    Unknown families fall back to the shell family like the reference's
+    default-to-AL2 behavior."""
+    cls = _FAMILIES.get(family, ShellBootstrap)
+    return cls(cluster, kubelet or KubeletConfiguration(), labels or {}, taints, custom)
+
+
+def _deep_merge(base: dict, override: dict) -> dict:
+    """Recursive dict merge; override wins on scalar conflicts."""
+    out = dict(base)
+    for k, v in override.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def _toml_key(k: str) -> str:
+    return k if k.replace("-", "").replace("_", "").isalnum() else json.dumps(k)
+
+
+def _toml_val(v) -> str:
+    if isinstance(v, bool):
+        return str(v).lower()
+    if isinstance(v, str):
+        return json.dumps(v)
+    return str(v)
+
+
+def _toml_dump(obj: dict, path: tuple[str, ...] = ()) -> str:
+    """Deterministic TOML emitter for the nested settings dict. A table
+    header is emitted only for tables holding scalars (pure-container levels
+    like [settings] are implied by their children's dotted headers)."""
+    scalars = [(k, v) for k, v in obj.items() if not isinstance(v, dict)]
+    tables = [(k, v) for k, v in obj.items() if isinstance(v, dict)]
+    lines: list[str] = []
+    if scalars and path:
+        lines.append("[" + ".".join(_toml_key(p) for p in path) + "]")
+    for k, v in scalars:
+        lines.append(f"{_toml_key(k)} = {_toml_val(v)}")
+    for k, v in tables:
+        body = _toml_dump(v, path + (k,))
+        if body:
+            lines.append(body)
+    text = "\n".join(lines)
+    if text and not path:
+        text += "\n"
+    return text
+
+
+def _yaml_dump(obj, indent: int = 0) -> str:
+    """Tiny deterministic YAML emitter (avoids a yaml dependency)."""
+    pad = "  " * indent
+    if isinstance(obj, Mapping):
+        lines = []
+        for k, v in obj.items():
+            if isinstance(v, (Mapping, list)) and v:
+                lines.append(f"{pad}{k}:")
+                lines.append(_yaml_dump(v, indent + 1))
+            else:
+                lines.append(f"{pad}{k}: {json.dumps(v) if isinstance(v, str) else v}")
+        return "\n".join(lines)
+    if isinstance(obj, list):
+        return "\n".join(f"{pad}- {json.dumps(v) if isinstance(v, str) else v}" for v in obj)
+    return f"{pad}{obj}"

@@ -1,0 +1,168 @@
+"""Launch-template provider: ensure-or-create deduped launch templates.
+
+Parity: ``pkg/providers/launchtemplate/launchtemplate.go`` — template name
+is a hash of the resolved parameters (:149-151), a TTL cache dedupes
+ensure calls with hydration on startup (:100-109), templates carry block
+devices, IMDS metadata options and generated userdata (:235-312), and the
+nodeclass termination path deletes every managed template by tag
+(termination/controller.go:87-105).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+from dataclasses import asdict, dataclass, field
+from typing import Optional, Sequence
+
+from ..models.nodeclass import NodeClass
+from ..utils.cache import CacheTTL, TTLCache
+from ..utils.clock import Clock
+from .bootstrap import ClusterInfo, KubeletConfiguration, bootstrapper_for
+
+log = logging.getLogger("karpenter.tpu.launchtemplates")
+
+MANAGED_BY_TAG = "karpenter.tpu/managed-by"        # value: cluster name
+NODECLASS_LT_TAG = "karpenter.tpu/nodeclass"
+
+
+@dataclass(frozen=True)
+class ResolvedTemplate:
+    """The fully-resolved launch parameters for one image group (the
+    amifamily.Resolver output analogue, resolver.go:123-162)."""
+
+    image_id: str
+    user_data: str
+    instance_profile: str
+    security_group_ids: tuple[str, ...] = ()
+    block_devices: tuple = ()
+    metadata_options: Optional[object] = None
+    tags: tuple[tuple[str, str], ...] = ()
+
+    def content_hash(self) -> str:
+        blob = json.dumps(
+            {
+                "image": self.image_id,
+                "user_data": self.user_data,
+                "profile": self.instance_profile,
+                "sgs": list(self.security_group_ids),
+                "bdm": [asdict(b) for b in self.block_devices],
+                "md": asdict(self.metadata_options) if self.metadata_options else None,
+                "tags": list(self.tags),
+            },
+            sort_keys=True,
+        ).encode()
+        return hashlib.sha256(blob).hexdigest()[:16]
+
+
+class LaunchTemplateProvider:
+    def __init__(self, cloud, cluster_info: ClusterInfo, clock: Optional[Clock] = None):
+        self.cloud = cloud
+        self.cluster_info = cluster_info
+        self._cache = TTLCache(default_ttl=CacheTTL.DEFAULT, clock=clock)
+        self._hydrated = False
+
+    # -- the launch path ---------------------------------------------------
+    def ensure_all(
+        self,
+        nodeclass: NodeClass,
+        image_groups: Sequence[tuple],     # [(Image, [InstanceType, ...])]
+        labels: Optional[dict] = None,
+        taints: Sequence = (),
+        kubelet: Optional[KubeletConfiguration] = None,
+    ) -> dict[str, str]:
+        """image_id -> launch template name, creating what is missing.
+
+        One template per image group (parity: Resolver.Resolve grouping by
+        (amiID, maxPods, efa); our grouping key is the image, since maxPods
+        comes from the kubelet config and efa is N/A)."""
+        self._hydrate_once()
+        out: dict[str, str] = {}
+        for image, _types in image_groups:
+            # The NODECLASS family picks the bootstrapper — not the image's
+            # (parity: resolver.go:80-112, AMIFamily comes from the spec).
+            boot = bootstrapper_for(
+                nodeclass.image_family,
+                self.cluster_info,
+                kubelet=kubelet,
+                labels=labels,
+                taints=taints,
+                custom=nodeclass.user_data,
+            )
+            resolved = ResolvedTemplate(
+                image_id=image.id,
+                user_data=boot.script(),
+                instance_profile=nodeclass.status.instance_profile
+                or nodeclass.instance_profile,
+                security_group_ids=tuple(g.id for g in nodeclass.status.security_groups),
+                block_devices=tuple(nodeclass.block_devices),
+                metadata_options=nodeclass.metadata_options,
+                tags=tuple(sorted(nodeclass.tags.items())),
+            )
+            out[image.id] = self._ensure_one(nodeclass, resolved)
+        return out
+
+    def _name(self, resolved: ResolvedTemplate) -> str:
+        return f"karpenter.tpu/{self.cluster_info.name}/{resolved.content_hash()}"
+
+    def _ensure_one(self, nodeclass: NodeClass, resolved: ResolvedTemplate) -> str:
+        name = self._name(resolved)
+        if self._cache.get(("lt", name)) is not None:
+            return name
+        existing = {t.name for t in self.cloud.describe_launch_templates()}
+        if name not in existing:
+            self.cloud.create_launch_template(
+                name=name,
+                image_id=resolved.image_id,
+                user_data=resolved.user_data,
+                instance_profile=resolved.instance_profile,
+                security_group_ids=resolved.security_group_ids,
+                block_devices=resolved.block_devices,
+                metadata_options=resolved.metadata_options,
+                tags={
+                    # user tags first: the managed tags must win or hydration
+                    # and termination teardown lose track of the template
+                    **dict(resolved.tags),
+                    MANAGED_BY_TAG: self.cluster_info.name,
+                    NODECLASS_LT_TAG: nodeclass.name,
+                },
+            )
+            log.info("created launch template %s", name)
+        self._cache.set(("lt", name), True)
+        return name
+
+    # -- cache lifecycle ---------------------------------------------------
+    def _hydrate_once(self) -> None:
+        """Warm the dedupe cache from the cloud on first use (parity:
+        hydration goroutine on leader election, launchtemplate.go:100-109)."""
+        if self._hydrated:
+            return
+        self._hydrated = True
+        for t in self.cloud.describe_launch_templates():
+            if t.tags.get(MANAGED_BY_TAG) == self.cluster_info.name:
+                self._cache.set(("lt", t.name), True)
+
+    def invalidate(self, name: str) -> None:
+        """Drop one template from the dedupe cache (parity: InvalidateCache
+        after a launch failed with launch-template-not-found)."""
+        self._cache.delete(("lt", name))
+
+    def reset(self) -> None:
+        self._cache.flush()
+        self._hydrated = False
+
+    # -- teardown ----------------------------------------------------------
+    def delete_all(self, nodeclass: NodeClass) -> int:
+        """Delete every managed template for a nodeclass (parity:
+        nodeclass termination controller.go:87-105)."""
+        n = 0
+        for t in list(self.cloud.describe_launch_templates()):
+            if (
+                t.tags.get(MANAGED_BY_TAG) == self.cluster_info.name
+                and t.tags.get(NODECLASS_LT_TAG) == nodeclass.name
+            ):
+                self.cloud.delete_launch_template(t.name)
+                self._cache.delete(("lt", t.name))
+                n += 1
+        return n

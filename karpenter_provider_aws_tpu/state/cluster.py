@@ -55,6 +55,9 @@ class Cluster:
         self.nodeclaims: dict[str, NodeClaim] = {}
         self.nodes: dict[str, Node] = {}
         self.pods: dict[str, Pod] = {}
+        # Control-plane version surfaced to the version provider (parity:
+        # the discovery client behind version.go; fakes set this directly).
+        self.server_version: str = "1.29"
 
     def _now(self) -> float:
         return self.clock.now() if self.clock is not None else 0.0

@@ -73,3 +73,12 @@ def is_not_found(err: Exception) -> bool:
 
 def is_unfulfillable_capacity(err: Exception) -> bool:
     return isinstance(err, CloudError) and err.code in _UNFULFILLABLE_CODES
+
+
+def is_launch_template_not_found(err: Exception) -> bool:
+    """Parity: errors.go IsLaunchTemplateNotFound — triggers the single
+    re-ensure retry in the launch path (instance.go:106-110)."""
+    return (
+        isinstance(err, CloudError)
+        and err.code == LaunchTemplateNotFoundError.code
+    )
