@@ -55,6 +55,7 @@ class InstanceType:
     ebs_bandwidth_mbps: int = 1000
     max_enis: int = 3
     ips_per_eni: int = 10
+    branch_enis: int = 0    # pod-ENI branch interfaces (security-group-per-pod)
     local_nvme_gib: int = 0
     gpu_manufacturer: str = ""
     gpu_name: str = ""
@@ -87,6 +88,7 @@ class InstanceType:
                 "amd.com/gpu": self.gpu_count if self.gpu_manufacturer == "amd" else 0,
                 "aws.amazon.com/neuron": self.accelerator_count if self.accelerator_manufacturer == "aws" else 0,
                 "vpc.amazonaws.com/efa": self.efa_count,
+                "vpc.amazonaws.com/pod-eni": self.branch_enis,
             }
         )
 
@@ -176,7 +178,45 @@ def _network_mbps(vcpus: int, variant: str) -> int:
     return base * (4 if variant == "n" else 1)
 
 
-def generate_catalog(zones=DEFAULT_ZONES) -> list[InstanceType]:
+def _branch_enis(vcpus: int, hypervisor: str) -> int:
+    """Pod-ENI branch-interface model: nitro-only, scales with size
+    (parity: the trunk/branch columns of zz_generated.vpclimits.go,
+    consumed as vpc.amazonaws.com/pod-eni at types.go:255-262)."""
+    if hypervisor != "nitro":
+        return 0
+    return min(107, 6 * vcpus)
+
+
+def _apply_generated_tables(types: list["InstanceType"], apply_generated: bool = True) -> None:
+    """Overlay the committed static tables (the codegen layer's output,
+    mirroring how the reference consults its zz_generated.* maps at
+    types.go:122-124 and types.go:255-262). Falls back to the in-module
+    model when a table is absent or lacks an entry. ``apply_generated=False``
+    keeps pure model output — used by the codegen generators themselves so a
+    stale table is never snapshotted back into itself."""
+    LIMITS: dict = {}
+    INSTANCE_TYPE_BANDWIDTH_MBPS: dict = {}
+    if apply_generated:
+        try:
+            from .zz_generated_vpclimits import LIMITS  # type: ignore[no-redef]
+        except ImportError:
+            pass
+        try:
+            from .zz_generated_bandwidth import INSTANCE_TYPE_BANDWIDTH_MBPS  # type: ignore[no-redef]
+        except ImportError:
+            pass
+    for it in types:
+        lim = LIMITS.get(it.name)
+        if lim is not None:
+            it.max_enis, it.ips_per_eni, it.branch_enis = lim
+        else:
+            it.branch_enis = _branch_enis(it.vcpus, it.hypervisor)
+        bw = INSTANCE_TYPE_BANDWIDTH_MBPS.get(it.name)
+        if bw is not None:
+            it.network_bandwidth_mbps = bw
+
+
+def generate_catalog(zones=DEFAULT_ZONES, apply_generated: bool = True) -> list[InstanceType]:
     """~700 instance types spanning the reference catalog's axes."""
     out: list[InstanceType] = []
 
@@ -326,6 +366,8 @@ def generate_catalog(zones=DEFAULT_ZONES) -> list[InstanceType]:
                     efa_count=(8 if family == "trn1" and size == "32xlarge" else 0),
                 )
             )
+
+    _apply_generated_tables(out, apply_generated=apply_generated)
 
     # Attach offerings (prices via the pricing model, deterministic
     # availability holes so tests exercise the offering mask).

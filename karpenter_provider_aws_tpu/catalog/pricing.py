@@ -40,6 +40,17 @@ def _jitter(seed: str, lo: float, hi: float) -> float:
     return lo + (hi - lo) * (h / 0xFFFFFFFF)
 
 
+# Static seed-price tables (absent until codegen has run once).
+try:
+    from .zz_generated_pricing import (
+        INITIAL_ON_DEMAND_PRICES as _STATIC_OD,
+        INITIAL_SPOT_PRICES as _STATIC_SPOT,
+    )
+except ImportError:
+    _STATIC_OD: dict = {}
+    _STATIC_SPOT: dict = {}
+
+
 class PricingProvider:
     """Thread-safe price source; static model + overridable live updates."""
 
@@ -49,6 +60,14 @@ class PricingProvider:
         self._lock = threading.RLock()
         self._seq = 0
         self.isolated_vpc = isolated_vpc
+
+    # -- static seed tables (codegen output; parity: pricing.go:43 loading
+    # the compiled-in zz_generated.pricing_* maps; loaded once) ------------
+    def _static_od(self, name: str) -> Optional[float]:
+        return _STATIC_OD.get(name)
+
+    def _static_spot(self, name: str, zone: str) -> Optional[float]:
+        return _STATIC_SPOT.get(name, {}).get(zone)
 
     # -- static model ------------------------------------------------------
     def _model_od(self, it: "InstanceType") -> float:
@@ -72,7 +91,11 @@ class PricingProvider:
     # -- queries (parity: OnDemandPrice / SpotPrice) -----------------------
     def on_demand_price(self, it: "InstanceType") -> float:
         with self._lock:
-            return self._od_overrides.get(it.name, self._model_od(it))
+            override = self._od_overrides.get(it.name)
+            if override is not None:
+                return override
+            static = self._static_od(it.name)
+            return static if static is not None else self._model_od(it)
 
     def spot_price(self, it: "InstanceType", zone: str) -> float:
         """Zonal spot; default derived from on-demand when no live data
@@ -81,6 +104,9 @@ class PricingProvider:
             override = self._spot_overrides.get((it.name, zone))
             if override is not None:
                 return override
+            static = self._static_spot(it.name, zone)
+            if static is not None:
+                return static
             od = self.on_demand_price(it)
             return round(od * _jitter(f"{it.name}:{zone}", 0.24, 0.44), 5)
 

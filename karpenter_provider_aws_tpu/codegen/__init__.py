@@ -1,0 +1,31 @@
+"""Codegen: data-pipeline layer regenerating the static catalog tables.
+
+Reference parity: ``hack/codegen.sh:10-41`` drives four Go generators
+(``hack/code/{prices_gen,vpc_limits_gen,bandwidth_gen,instancetype_testdata_gen}``)
+that scrape public AWS data into committed ``zz_generated.*.go`` tables. Here
+the upstream "source of truth" is the deterministic catalog/pricing model
+(zero-egress environment), and each generator snapshots it into a committed
+``zz_generated_*.py`` table which the providers consult first at runtime —
+same data-not-API-calls philosophy, same refresh workflow
+(``python -m karpenter_provider_aws_tpu.codegen``).
+"""
+
+from .bandwidth_gen import generate_bandwidth
+from .instancetype_testdata_gen import generate_instancetype_testdata
+from .prices_gen import generate_prices
+from .vpc_limits_gen import generate_vpc_limits
+
+GENERATORS = {
+    "vpc-limits": generate_vpc_limits,
+    "bandwidth": generate_bandwidth,
+    "prices": generate_prices,
+    "instancetype-testdata": generate_instancetype_testdata,
+}
+
+__all__ = [
+    "GENERATORS",
+    "generate_bandwidth",
+    "generate_instancetype_testdata",
+    "generate_prices",
+    "generate_vpc_limits",
+]

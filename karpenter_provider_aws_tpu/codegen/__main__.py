@@ -1,0 +1,27 @@
+"""Codegen driver: regenerate all static data tables.
+
+Usage: ``python -m karpenter_provider_aws_tpu.codegen [name ...]``
+(no args = all). Parity: ``hack/codegen.sh:10-41``.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from . import GENERATORS
+
+
+def main(argv: list[str]) -> int:
+    names = argv or list(GENERATORS)
+    unknown = [n for n in names if n not in GENERATORS]
+    if unknown:
+        print(f"unknown generators {unknown}; available: {list(GENERATORS)}", file=sys.stderr)
+        return 2
+    for name in names:
+        path = GENERATORS[name]()
+        print(f"{name}: wrote {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

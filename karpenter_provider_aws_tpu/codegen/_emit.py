@@ -1,0 +1,22 @@
+"""Shared emission helpers for the codegen generators."""
+
+from __future__ import annotations
+
+import pathlib
+
+CATALOG_DIR = pathlib.Path(__file__).resolve().parent.parent / "catalog"
+FAKE_DIR = pathlib.Path(__file__).resolve().parent.parent / "fake"
+
+HEADER = (
+    '"""GENERATED FILE — DO NOT EDIT.\n'
+    "\n"
+    "Regenerate with: python -m karpenter_provider_aws_tpu.codegen\n"
+    "(parity: the reference's zz_generated.*.go tables produced by\n"
+    "hack/codegen.sh:10-41).\n"
+    '"""\n\n'
+)
+
+
+def write_module(path: pathlib.Path, body: str) -> pathlib.Path:
+    path.write_text(HEADER + body)
+    return path

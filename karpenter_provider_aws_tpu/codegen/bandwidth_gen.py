@@ -1,0 +1,27 @@
+"""Generates catalog/zz_generated_bandwidth.py.
+
+Reference parity: ``hack/code/bandwidth_gen`` producing
+``pkg/providers/instancetype/zz_generated.bandwidth.go`` — the
+``InstanceTypeBandwidthMegabits`` map consumed at types.go:122-124.
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+from ._emit import CATALOG_DIR, write_module
+
+
+def generate_bandwidth() -> pathlib.Path:
+    from ..catalog.instancetypes import generate_catalog
+
+    types = generate_catalog(apply_generated=False)
+    lines = ["INSTANCE_TYPE_BANDWIDTH_MBPS: dict[str, int] = {\n"]
+    for it in sorted(types, key=lambda t: t.name):
+        lines.append(f"    {it.name!r}: {it.network_bandwidth_mbps},\n")
+    lines.append("}\n")
+    return write_module(CATALOG_DIR / "zz_generated_bandwidth.py", "".join(lines))
+
+
+if __name__ == "__main__":
+    print(generate_bandwidth())

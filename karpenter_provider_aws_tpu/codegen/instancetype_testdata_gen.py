@@ -1,0 +1,68 @@
+"""Generates fake/zz_generated_describe_instance_types.py.
+
+Reference parity: ``hack/code/instancetype_testdata_gen`` producing the
+782-line ``pkg/fake/zz_generated.describe_instance_types.go`` fixture — a
+frozen, representative slice of the catalog that hermetic suites pin against
+so fixture drift is an explicit regeneration, not a silent model change.
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+from ._emit import FAKE_DIR, write_module
+
+# One representative per axis the reference fixture spans: generic x86/arm
+# across sizes, burstable, storage, metal, GPU, neuron, EFA-heavy.
+FIXTURE_NAMES = (
+    "c5.large", "c5.xlarge", "c5.2xlarge", "c5.metal",
+    "c6g.large", "c6g.xlarge", "c7g.16xlarge", "c7gn.8xlarge",
+    "m5.large", "m5.4xlarge", "m6a.xlarge", "m7g.2xlarge",
+    "r5.large", "r5.24xlarge", "r6gd.4xlarge", "x7.8xlarge",
+    "t3.micro", "t3.medium", "t4g.small", "t4g.xlarge",
+    "i3.2xlarge", "i4i.8xlarge", "d3.xlarge",
+    "g4dn.xlarge", "g5.12xlarge", "g5g.xlarge", "p4d.24xlarge", "p5.48xlarge",
+    "inf1.6xlarge", "inf2.24xlarge", "trn1.32xlarge",
+    "hpc6a.96xlarge",
+)
+
+_FIELDS = (
+    "name", "category", "family", "generation", "size", "arch", "os",
+    "vcpus", "memory_mib", "network_bandwidth_mbps", "ebs_bandwidth_mbps",
+    "max_enis", "ips_per_eni", "branch_enis", "local_nvme_gib",
+    "gpu_manufacturer", "gpu_name", "gpu_count", "gpu_memory_mib",
+    "accelerator_manufacturer", "accelerator_name", "accelerator_count",
+    "efa_count", "bare_metal", "hypervisor",
+)
+
+
+def generate_instancetype_testdata() -> pathlib.Path:
+    from ..catalog.instancetypes import generate_catalog
+
+    by_name = {it.name: it for it in generate_catalog(apply_generated=False)}
+    missing = [n for n in FIXTURE_NAMES if n not in by_name]
+    if missing:
+        raise SystemExit(f"fixture names not in catalog: {missing}")
+    lines = [
+        "# Frozen DescribeInstanceTypes-style fixtures for hermetic suites.\n",
+        "DESCRIBE_INSTANCE_TYPES: list[dict] = [\n",
+    ]
+    for name in FIXTURE_NAMES:
+        it = by_name[name]
+        kv = ", ".join(f"{f!r}: {getattr(it, f)!r}" for f in _FIELDS)
+        lines.append(f"    {{{kv}}},\n")
+    lines.append("]\n\n")
+    lines.append(
+        "def fixture_instance_types():\n"
+        '    """Materialize the fixtures as InstanceType objects (offerings\n'
+        "    attached by the caller / test env as needed).\"\"\"\n"
+        "    from ..catalog.instancetypes import InstanceType\n"
+        "    return [InstanceType(**d) for d in DESCRIBE_INSTANCE_TYPES]\n"
+    )
+    return write_module(
+        FAKE_DIR / "zz_generated_describe_instance_types.py", "".join(lines)
+    )
+
+
+if __name__ == "__main__":
+    print(generate_instancetype_testdata())

@@ -1,0 +1,40 @@
+"""Generates catalog/zz_generated_pricing.py.
+
+Reference parity: ``hack/code/prices_gen`` producing the
+``zz_generated.pricing_aws*.go`` static seed-price tables loaded at
+pricing.go:43 — the warm-start prices used until a live refresh lands.
+Spot seeds are per-zone, mirroring the zonal spot map (pricing.go:75-90).
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+from ._emit import CATALOG_DIR, write_module
+
+
+def generate_prices() -> pathlib.Path:
+    from ..catalog.instancetypes import DEFAULT_ZONES, generate_catalog
+    from ..catalog.pricing import PricingProvider, _jitter
+
+    types = generate_catalog(apply_generated=False)
+    pricing = PricingProvider()
+    od_lines = ["INITIAL_ON_DEMAND_PRICES: dict[str, float] = {\n"]
+    spot_lines = ["INITIAL_SPOT_PRICES: dict[str, dict[str, float]] = {\n"]
+    for it in sorted(types, key=lambda t: t.name):
+        od = pricing._model_od(it)
+        od_lines.append(f"    {it.name!r}: {od},\n")
+        per_zone = ", ".join(
+            f"{z!r}: {round(od * _jitter(f'{it.name}:{z}', 0.24, 0.44), 5)}"
+            for z in DEFAULT_ZONES
+        )
+        spot_lines.append(f"    {it.name!r}: {{{per_zone}}},\n")
+    od_lines.append("}\n\n")
+    spot_lines.append("}\n")
+    return write_module(
+        CATALOG_DIR / "zz_generated_pricing.py", "".join(od_lines + spot_lines)
+    )
+
+
+if __name__ == "__main__":
+    print(generate_prices())

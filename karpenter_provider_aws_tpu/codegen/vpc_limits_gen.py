@@ -1,0 +1,33 @@
+"""Generates catalog/zz_generated_vpclimits.py.
+
+Reference parity: ``hack/code/vpc_limits_gen`` producing
+``pkg/providers/instancetype/zz_generated.vpclimits.go`` — the per-type
+ENI / IPs-per-ENI / branch-interface (pod-ENI) limits map consumed by the
+capacity math (types.go:255-262, :326-340).
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+from ._emit import CATALOG_DIR, write_module
+
+
+def generate_vpc_limits() -> pathlib.Path:
+    from ..catalog.instancetypes import generate_catalog
+
+    types = generate_catalog(apply_generated=False)
+    lines = [
+        "# name: (max_enis, ips_per_eni, branch_enis)\n",
+        "LIMITS: dict[str, tuple[int, int, int]] = {\n",
+    ]
+    for it in sorted(types, key=lambda t: t.name):
+        lines.append(
+            f"    {it.name!r}: ({it.max_enis}, {it.ips_per_eni}, {it.branch_enis}),\n"
+        )
+    lines.append("}\n")
+    return write_module(CATALOG_DIR / "zz_generated_vpclimits.py", "".join(lines))
+
+
+if __name__ == "__main__":
+    print(generate_vpc_limits())

@@ -28,12 +28,13 @@ RESOURCE_AXES: tuple[str, ...] = (
     "amd.com/gpu",              # count
     "aws.amazon.com/neuron",    # count
     "vpc.amazonaws.com/efa",    # count
+    "vpc.amazonaws.com/pod-eni",  # count (branch interfaces, security-group-per-pod)
 )
 NUM_RESOURCES = len(RESOURCE_AXES)
 _AXIS_INDEX = {name: i for i, name in enumerate(RESOURCE_AXES)}
 
 CPU, MEMORY, PODS, EPHEMERAL = 0, 1, 2, 3
-NVIDIA_GPU, AMD_GPU, NEURON, EFA = 4, 5, 6, 7
+NVIDIA_GPU, AMD_GPU, NEURON, EFA, POD_ENI = 4, 5, 6, 7, 8
 
 # Extended-resource label parity: pkg/apis/v1beta1/labels.go:87-98 resources.
 EXTENDED_RESOURCES = RESOURCE_AXES[4:]

@@ -217,6 +217,8 @@ def _toml_val(v) -> str:
         return str(v).lower()
     if isinstance(v, str):
         return json.dumps(v)
+    if isinstance(v, (list, tuple)):
+        return "[" + ", ".join(_toml_val(x) for x in v) + "]"
     return str(v)
 
 

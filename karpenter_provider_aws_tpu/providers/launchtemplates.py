@@ -60,7 +60,7 @@ class LaunchTemplateProvider:
     def __init__(self, cloud, cluster_info: ClusterInfo, clock: Optional[Clock] = None):
         self.cloud = cloud
         self.cluster_info = cluster_info
-        self._cache = TTLCache(default_ttl=CacheTTL.DEFAULT, clock=clock)
+        self._cache = TTLCache(default_ttl=CacheTTL.LAUNCH_TEMPLATE, clock=clock)
         self._hydrated = False
 
     # -- the launch path ---------------------------------------------------
@@ -101,13 +101,17 @@ class LaunchTemplateProvider:
                 tags=tuple(sorted(nodeclass.tags.items())),
             )
             out[image.id] = self._ensure_one(nodeclass, resolved)
+        self._gc_stale(nodeclass, keep=set(out.values()))
         return out
 
-    def _name(self, resolved: ResolvedTemplate) -> str:
-        return f"karpenter.tpu/{self.cluster_info.name}/{resolved.content_hash()}"
+    def _name(self, nodeclass: NodeClass, resolved: ResolvedTemplate) -> str:
+        # The nodeclass name is part of the template name so two nodeclasses
+        # with identical resolved parameters never share one template (either
+        # one's termination teardown would destroy the other's).
+        return f"karpenter.tpu/{self.cluster_info.name}/{nodeclass.name}/{resolved.content_hash()}"
 
     def _ensure_one(self, nodeclass: NodeClass, resolved: ResolvedTemplate) -> str:
-        name = self._name(resolved)
+        name = self._name(nodeclass, resolved)
         if self._cache.get(("lt", name)) is not None:
             return name
         existing = {t.name for t in self.cloud.describe_launch_templates()}
@@ -131,6 +135,23 @@ class LaunchTemplateProvider:
             log.info("created launch template %s", name)
         self._cache.set(("lt", name), True)
         return name
+
+    def _gc_stale(self, nodeclass: NodeClass, keep: set[str]) -> None:
+        """Delete superseded templates for this nodeclass (image/userdata/tag
+        rotations mint a new hash name; the old one would otherwise live until
+        nodeclass termination). A template still vouched for by the dedupe
+        cache is kept — it may back an in-flight launch — so deletion happens
+        one cache-TTL after the template stopped being resolved (parity: the
+        reference deletes launch templates on cache eviction)."""
+        for t in list(self.cloud.describe_launch_templates()):
+            if (
+                t.tags.get(MANAGED_BY_TAG) == self.cluster_info.name
+                and t.tags.get(NODECLASS_LT_TAG) == nodeclass.name
+                and t.name not in keep
+                and self._cache.get(("lt", t.name)) is None
+            ):
+                self.cloud.delete_launch_template(t.name)
+                log.info("garbage-collected stale launch template %s", t.name)
 
     # -- cache lifecycle ---------------------------------------------------
     def _hydrate_once(self) -> None:

@@ -99,7 +99,9 @@ class TestOfferings:
     def test_tensor_shapes(self, catalog):
         t = catalog.tensors()
         T, Z = len(catalog), len(catalog.zones)
-        assert t.capacity.shape == (T, 8)
+        from karpenter_provider_aws_tpu.models.resources import NUM_RESOURCES
+
+        assert t.capacity.shape == (T, NUM_RESOURCES)
         assert t.price.shape == (T, Z, 2)
         assert t.available.shape == (T, Z, 2)
         assert t.available.any()

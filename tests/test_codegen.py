@@ -1,0 +1,84 @@
+"""Codegen layer (reference: hack/codegen.sh + hack/code/* generators and the
+zz_generated.* tables they produce)."""
+
+from __future__ import annotations
+
+import importlib
+
+from karpenter_provider_aws_tpu.catalog.instancetypes import generate_catalog
+from karpenter_provider_aws_tpu.codegen import GENERATORS
+
+
+def test_generators_are_idempotent(tmp_path):
+    """Re-running codegen against committed tables must be a no-op (the
+    generators snapshot the model, never the tables)."""
+    for name, gen in GENERATORS.items():
+        path = gen()
+        before = path.read_text()
+        path2 = gen()
+        assert path2 == path
+        assert path.read_text() == before, f"{name} not idempotent"
+
+
+def test_catalog_consumes_vpc_limits_table():
+    from karpenter_provider_aws_tpu.catalog.zz_generated_vpclimits import LIMITS
+
+    cat = generate_catalog()
+    assert len(LIMITS) == len(cat)
+    for it in cat[:50]:
+        assert (it.max_enis, it.ips_per_eni, it.branch_enis) == LIMITS[it.name]
+
+
+def test_catalog_consumes_bandwidth_table():
+    from karpenter_provider_aws_tpu.catalog.zz_generated_bandwidth import (
+        INSTANCE_TYPE_BANDWIDTH_MBPS,
+    )
+
+    cat = generate_catalog()
+    for it in cat[:50]:
+        assert it.network_bandwidth_mbps == INSTANCE_TYPE_BANDWIDTH_MBPS[it.name]
+
+
+def test_pricing_seeds_from_static_table():
+    """Static seed prices used until a live refresh overrides them
+    (parity: pricing.go:43 + UpdateOnDemandPricing)."""
+    from karpenter_provider_aws_tpu.catalog.pricing import PricingProvider
+    from karpenter_provider_aws_tpu.catalog.zz_generated_pricing import (
+        INITIAL_ON_DEMAND_PRICES,
+        INITIAL_SPOT_PRICES,
+    )
+
+    cat = generate_catalog()
+    p = PricingProvider()
+    it = cat[0]
+    assert p.on_demand_price(it) == INITIAL_ON_DEMAND_PRICES[it.name]
+    assert p.spot_price(it, "zone-a") == INITIAL_SPOT_PRICES[it.name]["zone-a"]
+    # spot strictly under on-demand in every seed entry
+    for name, per_zone in list(INITIAL_SPOT_PRICES.items())[:100]:
+        assert all(v < INITIAL_ON_DEMAND_PRICES[name] for v in per_zone.values())
+    # live refresh wins over the seed
+    p.update_on_demand({it.name: 123.0})
+    assert p.on_demand_price(it) == 123.0
+
+
+def test_pod_eni_capacity_from_limits():
+    """Branch interfaces surface as the vpc.amazonaws.com/pod-eni extended
+    resource (parity: labels.go:87-98 + types.go:255-262)."""
+    cat = generate_catalog()
+    nitro = next(it for it in cat if it.hypervisor == "nitro" and it.vcpus >= 8)
+    assert nitro.branch_enis > 0
+    assert nitro.capacity().get("vpc.amazonaws.com/pod-eni") == nitro.branch_enis
+    metal = next(it for it in cat if it.bare_metal)
+    assert metal.branch_enis == 0
+
+
+def test_testdata_fixtures_materialize():
+    mod = importlib.import_module(
+        "karpenter_provider_aws_tpu.fake.zz_generated_describe_instance_types"
+    )
+    fixtures = mod.fixture_instance_types()
+    assert len(fixtures) == len(mod.DESCRIBE_INSTANCE_TYPES) >= 30
+    by_name = {it.name: it for it in generate_catalog()}
+    for f in fixtures:
+        live = by_name[f.name]
+        assert f.vcpus == live.vcpus and f.memory_mib == live.memory_mib

@@ -137,3 +137,76 @@ class TestHostnamePin:
         res = TPUSolver().solve(pods, [pool], catalog)
         assert res.pods_placed() == 0
         assert "hostname" in res.unschedulable[0][1]
+
+
+class TestLaunchTemplateReview:
+    """Round-2 review findings: per-nodeclass template names, stale-template
+    GC, TOML array emission, static-price seeding."""
+
+    def _env(self):
+        from karpenter_provider_aws_tpu.testenv import new_environment
+
+        env = new_environment(use_tpu_solver=False)
+        env.apply_defaults()
+        return env
+
+    def test_identical_nodeclasses_get_distinct_templates(self):
+        """Two nodeclasses with identical resolved params must not share a
+        launch template: either one's teardown would destroy the other's."""
+        from karpenter_provider_aws_tpu.models.nodeclass import NodeClass
+
+        env = self._env()
+        twin = NodeClass(name="twin", role="node-role")
+        env.cluster.apply(twin)
+        pool_b = NodePool(name="pool-b", nodeclass_name="twin", labels={"tier": "b"})
+        env.cluster.apply(pool_b)
+        env.step(2)  # resolve twin's status
+        for p in make_pods(2, "a", {"cpu": "1", "memory": "2Gi"}):
+            env.cluster.apply(p)
+        for p in make_pods(2, "b", {"cpu": "1", "memory": "2Gi"}, node_selector={"tier": "b"}):
+            env.cluster.apply(p)
+        env.step(3)
+        names = {t.name for t in env.cloud.describe_launch_templates()}
+        assert any("/default/" in n for n in names)
+        assert any("/twin/" in n for n in names)
+        # teardown of twin leaves default's template alive
+        deleted = env.cloudprovider.launch_templates.delete_all(twin)
+        assert deleted >= 1
+        assert any("/default/" in t.name for t in env.cloud.describe_launch_templates())
+
+    def test_stale_template_gc_after_rotation(self):
+        """An image/userdata rotation mints a new template; the superseded one
+        is deleted one cache-TTL later, not at nodeclass termination."""
+        env = self._env()
+        for p in make_pods(1, "w", {"cpu": "1", "memory": "2Gi"}):
+            env.cluster.apply(p)
+        env.step(3)
+        before = {t.name for t in env.cloud.describe_launch_templates()}
+        assert len(before) == 1
+        # rotate userdata -> new resolved hash
+        nc = next(iter(env.cluster.nodeclasses.values()))
+        nc.user_data = "#!/bin/bash\necho rotated"
+        env.clock.advance(601)  # expire the old template's dedupe entry
+        for p in make_pods(1, "w2", {"cpu": "1", "memory": "2Gi"}):
+            env.cluster.apply(p)
+        env.step(3)
+        after = {t.name for t in env.cloud.describe_launch_templates()}
+        assert after and not (after & before), f"stale template survived: {after & before}"
+
+    def test_toml_array_values_round_trip(self):
+        import tomllib
+
+        from karpenter_provider_aws_tpu.providers.bootstrap import ClusterInfo, bootstrapper_for
+
+        info = ClusterInfo(name="c", endpoint="https://e", ca_bundle="Q0E=", dns_ip="10.0.0.10")
+        custom = (
+            "[settings.kernel]\n"
+            "sysctl-flags = [true, false]\n"
+            'lockdown = "integrity"\n'
+            "ports = [80, 443]\n"
+            'names = ["a\'b", "c"]\n'
+        )
+        script = bootstrapper_for("bottlerocket", info, custom=custom).script()
+        parsed = tomllib.loads(script)  # must be valid TOML
+        assert parsed["settings"]["kernel"]["sysctl-flags"] == [True, False]
+        assert parsed["settings"]["kernel"]["names"] == ["a'b", "c"]
