@@ -122,11 +122,15 @@ class CatalogProvider:
             self._tensor_cache.flush()
 
     # -- allocatable math --------------------------------------------------
-    def allocatable(self, it: InstanceType) -> ResourceVector:
+    def allocatable(self, it: InstanceType, max_pods: Optional[int] = None) -> ResourceVector:
         """capacity - VM overhead - kube/system reserved - eviction
-        (parity: types.go:182-215 Allocatable)."""
+        (parity: types.go:182-215 Allocatable). ``max_pods`` is the per-pool
+        kubelet override, which wins over the global overhead option
+        (parity: the kubelet maxPods input to types.go pods())."""
         o = self.overhead
-        if o.max_pods is not None:
+        if max_pods is not None:
+            pods = float(max_pods)
+        elif o.max_pods is not None:
             pods = float(o.max_pods)
         else:
             pods = float(max(1, (it.max_enis - o.reserved_enis) * (it.ips_per_eni - 1) + 2))

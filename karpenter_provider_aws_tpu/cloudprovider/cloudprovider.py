@@ -66,6 +66,9 @@ class CloudProvider:
         self.images = ImageProvider(cloud, clock=clock)
         self.instance_profiles = InstanceProfileProvider(cloud, clock=clock)
         self.launch_templates = LaunchTemplateProvider(cloud, self.cluster_info, clock=clock)
+        from ..utils.cache import CacheTTL, TTLCache
+
+        self._launchable_cache = TTLCache(default_ttl=CacheTTL.DEFAULT, clock=clock)
         opts = batcher_options or BatcherOptions()
         self._fleet_batcher: Batcher = Batcher(self.cloud.create_fleet, options=opts)
         self._terminate_batcher: Batcher = Batcher(
@@ -93,10 +96,18 @@ class CloudProvider:
         # Image grouping: resolve for the best-ranked type, then keep only
         # types the same image serves (arch/gpu grouping parity).
         images = self.images.list(nodeclass)
-        image = resolve_image_for(images, type_options[0])
+        # First ranked option with a resolvable image wins; options no image
+        # maps to are dropped rather than failing the launch (parity:
+        # resolver.go:123-162 — types with no AMI never reach CreateFleet).
+        image = None
+        for t in type_options:
+            image = resolve_image_for(images, t)
+            if image is not None:
+                break
         if image is None:
             raise errors.CloudError(
-                f"no image for {type_options[0].name}", code="NoCompatibleImage"
+                f"no image for any of {[t.name for t in type_options[:5]]}",
+                code="NoCompatibleImage",
             )
         type_options = [
             t for t in type_options if resolve_image_for(images, t) is image
@@ -170,6 +181,27 @@ class CloudProvider:
         self.subnets.release_unused(subnet_by_zone, result.zone)
         return self._instance_to_claim(claim, result, nodeclass)
 
+    def launchable_type_names(self, nodepool) -> "Optional[set[str]]":
+        """Types a nodepool's nodeclass can actually boot: at least one
+        resolved image is compatible (arch + accelerator). None = no
+        constraint known (nodeclass missing/unready — the readiness gate
+        rejects the launch anyway). Fed into the solve so the scheduler
+        never commits capacity it cannot image (parity: amifamily
+        MapToInstanceTypes, ami.go:79-90)."""
+        nodeclass = self.cluster.nodeclasses.get(nodepool.nodeclass_name)
+        if nodeclass is None or not nodeclass.status.is_ready():
+            return None
+        images = self.images.list(nodeclass)
+        key = ("launchable", nodeclass.name, tuple(i.id for i in images), self.catalog.cache_key())
+        hit = self._launchable_cache.get(key)
+        if hit is not None:
+            return hit
+        allowed = {
+            t.name for t in self.catalog.list() if resolve_image_for(images, t) is not None
+        }
+        self._launchable_cache.set(key, allowed)
+        return allowed
+
     def _live_offerings(self, claim: NodeClaim, type_names):
         """(zone, captype) pairs from the claim not ICE-masked for at least
         one candidate type, ranked cheapest-first by the best-ranked type's
@@ -203,8 +235,11 @@ class CloudProvider:
         it = self.catalog.get(inst.instance_type)
         claim.status.provider_id = inst.provider_id
         claim.status.image_id = inst.image_id
-        claim.status.capacity = it.capacity()
-        claim.status.allocatable = self.catalog.allocatable(it)
+        pool = self.cluster.nodepools.get(claim.nodepool_name)
+        kubelet = getattr(pool, "kubelet", None) if pool else None
+        max_pods = kubelet.max_pods if kubelet is not None else None
+        claim.status.capacity = it.capacity(max_pods=max_pods)
+        claim.status.allocatable = self.catalog.allocatable(it, max_pods=max_pods)
         claim.labels.update(it.labels())
         claim.labels[lbl.TOPOLOGY_ZONE] = inst.zone
         claim.labels[lbl.CAPACITY_TYPE] = inst.capacity_type
@@ -229,6 +264,7 @@ class CloudProvider:
         self.images.reset()
         self.instance_profiles.reset()
         self.launch_templates.reset()
+        self._launchable_cache.flush()
 
     def get(self, provider_id: str):
         instance_id = parse_provider_id(provider_id)

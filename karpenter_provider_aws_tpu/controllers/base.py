@@ -29,6 +29,9 @@ class Manager:
         self.controllers = list(controllers)
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
+        # last reconcile errors, newest last (bounded); controller-runtime
+        # parity: a failing reconcile is logged and requeued, never fatal.
+        self.errors: list[tuple[str, Exception]] = []
 
     def start(self) -> None:
         for c in self.controllers:
@@ -40,8 +43,9 @@ class Manager:
         while not self._stop.is_set():
             try:
                 c.reconcile()
-            except Exception:
+            except Exception as e:
                 log.exception("controller %s reconcile failed", c.name)
+                self._record_error(c, e)
             self._stop.wait(c.interval_s)
 
     def stop(self, timeout: float = 5.0) -> None:
@@ -49,7 +53,17 @@ class Manager:
         for t in self._threads:
             t.join(timeout=timeout)
 
+    def _record_error(self, c: Controller, e: Exception) -> None:
+        self.errors.append((c.name, e))
+        del self.errors[:-50]
+
     def reconcile_all_once(self) -> None:
-        """Deterministic single pass in registration order (test helper)."""
+        """Deterministic single pass in registration order (test helper).
+        Errors are isolated per controller, exactly like the threaded path —
+        one failing reconcile must not starve the others."""
         for c in self.controllers:
-            c.reconcile()
+            try:
+                c.reconcile()
+            except Exception as e:
+                log.exception("controller %s reconcile failed", c.name)
+                self._record_error(c, e)

@@ -60,6 +60,10 @@ class ProvisioningController:
             self.cloudprovider.catalog,
             in_use=self.cluster.in_use_by_nodepool(),
             occupancy=ZoneOccupancy.from_cluster(self.cluster),
+            type_allow={
+                pool.name: self.cloudprovider.launchable_type_names(pool)
+                for pool in nodepools
+            },
         )
         from ..metrics import SOLVE_DURATION, SOLVE_PODS
 

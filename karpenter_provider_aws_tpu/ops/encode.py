@@ -170,6 +170,7 @@ def encode_problem(
     nodepool: Optional[NodePool] = None,
     tensors: Optional[CatalogTensors] = None,
     occupancy: Optional[ZoneOccupancy] = None,
+    allowed_types: Optional[set] = None,
 ) -> EncodedProblem:
     """Build the dense solve tensors for one nodepool's candidate pods.
 
@@ -334,6 +335,15 @@ def encode_problem(
     # construction on any launched node, never constraints on the type itself.
     provided_keys = set(nodepool.labels) if nodepool else set()
 
+    # Launchability mask: types the caller knows cannot launch (e.g. no
+    # compatible image resolves for the nodeclass) are excluded from the
+    # solve entirely, instead of failing at CloudProvider.Create (parity:
+    # amifamily Resolver dropping types no AMI maps to, resolver.go:123-162).
+    if allowed_types is not None:
+        base_ok = np.array([n in allowed_types for n in tensors.names], dtype=bool)
+    else:
+        base_ok = np.ones(T, dtype=bool)
+
     for gi, (plist, zone_pin, mpn, zone_mask) in enumerate(expanded):
         pod = plist[0]
         requests[gi] = pod.requests.v
@@ -356,7 +366,7 @@ def encode_problem(
         group_window[gi] = zone_allowed[gi][:, None] & captype_allowed[gi][None, :]
 
         # Static label compat, vectorized over T per requirement key.
-        static_ok = np.ones(T, dtype=bool)
+        static_ok = base_ok.copy()
         for key, vs in reqs:
             if key in _SKIP_KEYS or key in provided_keys:
                 continue
@@ -399,11 +409,22 @@ def encode_problem(
         max_per_node[:G] = max_per_node[:G][order]
         group_list = [group_list[i] for i in order]
 
+    # Per-pool kubelet maxPods clamps the pods axis of every candidate type
+    # (parity: kubelet maxPods feeding types.go pods(); GetInstanceTypes is
+    # per-NodePool in the reference for exactly this reason).
+    capacity = tensors.capacity.astype(np.float32)
+    kubelet = getattr(nodepool, "kubelet", None) if nodepool else None
+    if kubelet is not None and kubelet.max_pods is not None:
+        from ..models.resources import PODS as _PODS
+
+        capacity = capacity.copy()
+        capacity[:, _PODS] = np.minimum(capacity[:, _PODS], float(kubelet.max_pods))
+
     return EncodedProblem(
         requests=requests,
         counts=counts,
         compat=compat,
-        capacity=tensors.capacity.astype(np.float32),
+        capacity=capacity,
         price=price,
         group_pods=group_list,
         type_names=tensors.names,

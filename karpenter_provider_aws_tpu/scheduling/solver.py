@@ -70,6 +70,7 @@ class Solver(Protocol):
         catalog: CatalogProvider,
         in_use=None,
         occupancy: Optional[ZoneOccupancy] = None,
+        type_allow=None,
     ) -> SolveResult: ...
 
 
@@ -218,8 +219,8 @@ class TPUSolver:
         unplaced = {g: int(c) for g, c in enumerate(unplaced_arr) if c > 0}
         return specs, unplaced
 
-    def solve(self, pods, nodepools, catalog, in_use=None, occupancy=None) -> SolveResult:
-        return _solve_multi_nodepool(self, pods, nodepools, catalog, in_use, occupancy)
+    def solve(self, pods, nodepools, catalog, in_use=None, occupancy=None, type_allow=None) -> SolveResult:
+        return _solve_multi_nodepool(self, pods, nodepools, catalog, in_use, occupancy, type_allow)
 
 
 class HostSolver:
@@ -252,8 +253,8 @@ class HostSolver:
         )
         return specs, unplaced
 
-    def solve(self, pods, nodepools, catalog, in_use=None, occupancy=None) -> SolveResult:
-        return _solve_multi_nodepool(self, pods, nodepools, catalog, in_use, occupancy)
+    def solve(self, pods, nodepools, catalog, in_use=None, occupancy=None, type_allow=None) -> SolveResult:
+        return _solve_multi_nodepool(self, pods, nodepools, catalog, in_use, occupancy, type_allow)
 
 
 def _enforce_pool_constraints(
@@ -310,7 +311,7 @@ def _enforce_pool_constraints(
 
 
 def _solve_multi_nodepool(
-    impl, pods, nodepools, catalog, in_use=None, occupancy=None
+    impl, pods, nodepools, catalog, in_use=None, occupancy=None, type_allow=None
 ) -> SolveResult:
     t0 = time.perf_counter()
     result = SolveResult(num_pods=len(pods))
@@ -320,7 +321,9 @@ def _solve_multi_nodepool(
     for pool in sorted(nodepools, key=lambda p: -p.weight):
         if not remaining:
             break
-        problem = encode_problem(remaining, catalog, nodepool=pool, occupancy=occupancy)
+        allowed = type_allow.get(pool.name) if type_allow else None
+        problem = encode_problem(remaining, catalog, nodepool=pool, occupancy=occupancy,
+                                 allowed_types=allowed)
         for pod, why in problem.unencodable:
             reasons[pod.uid] = f"nodepool {pool.name}: {why}"
         specs, unplaced = impl.solve_encoded(problem)

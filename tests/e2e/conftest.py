@@ -1,0 +1,43 @@
+"""e2e-tier fixtures: one full environment per module, reset per test
+(parity: the per-suite BeforeEach env reset in test/suites/*)."""
+
+import pytest
+
+from karpenter_provider_aws_tpu.testenv import new_environment
+
+from .environment import DurationSink, Expectations, Monitor
+
+
+@pytest.fixture(scope="module")
+def env():
+    return new_environment()
+
+
+@pytest.fixture(scope="module")
+def host_env():
+    """Host-solver environment for control-plane-bound scale loops."""
+    return new_environment(use_tpu_solver=False)
+
+
+@pytest.fixture(autouse=True)
+def _reset(request):
+    for name in ("env", "host_env"):
+        if name in request.fixturenames:
+            request.getfixturevalue(name).reset()
+    yield
+
+
+@pytest.fixture
+def monitor(env):
+    return Monitor(env)
+
+
+@pytest.fixture
+def expect(env):
+    return Expectations(env)
+
+
+@pytest.fixture(scope="session")
+def sink():
+    s = DurationSink()
+    yield s

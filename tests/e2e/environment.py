@@ -1,0 +1,122 @@
+"""Shared e2e environment helpers.
+
+Parity: ``test/pkg/environment/common/`` (expectations.go 939 LoC +
+monitor.go 256 LoC) and ``test/pkg/environment/aws/metrics.go`` — the
+Timestream duration sink. The reference's e2e tier runs against a real EKS
+cluster; this tier runs the same scenario shapes hermetically against the
+fake cloud + full controller manager, which is what "cluster" means here.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass, field
+
+
+class Monitor:
+    """Cluster observation helpers (parity: common/monitor.go)."""
+
+    def __init__(self, env):
+        self.env = env
+        self._node_baseline = set(env.cluster.nodes)
+
+    def reset_baseline(self) -> None:
+        self._node_baseline = set(self.env.cluster.nodes)
+
+    def created_nodes(self) -> list:
+        return [n for name, n in self.env.cluster.nodes.items() if name not in self._node_baseline]
+
+    def node_count(self) -> int:
+        return len(self.env.cluster.nodes)
+
+    def running_pods(self) -> int:
+        return sum(1 for p in self.env.cluster.pods.values() if not p.is_pending())
+
+    def pending_pods(self) -> int:
+        return len(self.env.cluster.pending_pods())
+
+    def node_utilization(self, resource: str = "cpu") -> float:
+        """Mean fraction of allocatable consumed across nodes."""
+        from karpenter_provider_aws_tpu.models.resources import ResourceVector
+
+        fractions = []
+        for node in self.env.cluster.nodes.values():
+            used = ResourceVector()
+            for pod in self.env.cluster.pods_on_node(node.name):
+                used = used + pod.requests
+            alloc = node.allocatable.get(resource)
+            if alloc > 0:
+                fractions.append(used.get(resource) / alloc)
+        return sum(fractions) / len(fractions) if fractions else 0.0
+
+
+@dataclass
+class Expectations:
+    """Step-until-settled assertions (parity: common/expectations.go —
+    EventuallyExpectHealthy / ExpectCreatedNodeCount and friends, with
+    reconcile steps standing in for wall-clock Eventually polling)."""
+
+    env: object
+    max_steps: int = 60
+
+    def eventually(self, predicate, what: str = "condition", step_advance_s: float = 0.0):
+        for _ in range(self.max_steps):
+            if predicate():
+                return
+            if step_advance_s:
+                self.env.clock.advance(step_advance_s)
+            self.env.step(1)
+        raise AssertionError(f"{what} not reached within {self.max_steps} reconcile steps")
+
+    def healthy(self, step_advance_s: float = 0.0):
+        """Every pod scheduled onto a ready node."""
+        self.eventually(
+            lambda: not self.env.cluster.pending_pods(),
+            "all pods scheduled",
+            step_advance_s=step_advance_s,
+        )
+
+    def created_node_count(self, monitor: Monitor, op: str, count: int):
+        ops = {"==": lambda a, b: a == b, ">=": lambda a, b: a >= b, "<=": lambda a, b: a <= b}
+        self.eventually(
+            lambda: ops[op](len(monitor.created_nodes()), count),
+            f"created-node count {op} {count}",
+        )
+
+    def no_orphan_instances(self):
+        """Every cloud instance is backed by a claim (leak-free teardown)."""
+        claimed = {
+            c.status.provider_id
+            for c in self.env.cluster.nodeclaims.values()
+            if c.status.provider_id
+        }
+        for inst in self.env.cloud.list_instances():
+            assert inst.provider_id in claimed, f"orphan instance {inst.id}"
+
+
+@dataclass
+class DurationSink:
+    """Scale-test measurement sink (parity: aws/metrics.go:34-38,79-119 —
+    provisioning/deprovisioningDuration pushed to the Timestream table
+    ``karpenterTesting.scaleTestDurations``; here a JSON-lines file)."""
+
+    path: str = field(
+        default_factory=lambda: os.environ.get("E2E_METRICS_PATH", "")
+    )
+    records: list = field(default_factory=list)
+
+    def record(self, metric: str, seconds: float, **dimensions) -> None:
+        row = {"metric": metric, "seconds": round(seconds, 4), **dimensions}
+        self.records.append(row)
+        if self.path:
+            with open(self.path, "a") as f:
+                f.write(json.dumps(row) + "\n")
+
+    def measure(self, metric: str, fn, **dimensions) -> float:
+        t0 = time.perf_counter()
+        fn()
+        dt = time.perf_counter() - t0
+        self.record(metric, dt, **dimensions)
+        return dt

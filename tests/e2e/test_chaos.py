@@ -1,0 +1,92 @@
+"""e2e: chaos suite (parity: test/suites/chaos + the fake fault-injection
+machinery — ICE storms, transient API errors, capacity-pool exhaustion;
+the cluster must converge anyway)."""
+
+from karpenter_provider_aws_tpu.models import Disruption, NodePool, Operator, Requirement
+from karpenter_provider_aws_tpu.models import labels as lbl
+from karpenter_provider_aws_tpu.models.pod import make_pods
+from karpenter_provider_aws_tpu.utils import errors
+
+
+def chaos_pool():
+    return NodePool(
+        name="default",
+        requirements=[Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m", "r"))],
+        disruption=Disruption(budgets=["100%"], consolidate_after_s=None),
+    )
+
+
+class TestChaosE2E:
+    def test_ice_storm_falls_back_to_other_pools(self, env, expect):
+        """ICE every offering the first solve wants; launches must land on
+        other pools via the unavailable-offerings feedback loop
+        (errors.go:44-52 → unavailableofferings.go:55-71 → masked solve)."""
+        env.apply_defaults(chaos_pool())
+        # first, learn what the solver would pick
+        probe = make_pods(1, "probe", {"cpu": "2", "memory": "4Gi"})
+        for p in probe:
+            env.cluster.apply(p)
+        env.step(3)
+        picked = next(iter(env.cluster.nodeclaims.values()))
+        picked_type = picked.labels[lbl.INSTANCE_TYPE_LABEL]
+        env.reset()
+        env.apply_defaults(chaos_pool())
+        # ICE that type across every zone and capacity type at the cloud
+        for z in env.cloud.zones:
+            for ct in ("spot", "on-demand"):
+                env.cloud.ice_pools.add((ct, picked_type, z))
+        for p in make_pods(3, "w", {"cpu": "2", "memory": "4Gi"}):
+            env.cluster.apply(p)
+        expect.eventually(
+            lambda: not env.cluster.pending_pods(), "pods landed despite ICE",
+            step_advance_s=1.0,
+        )
+        for claim in env.cluster.nodeclaims.values():
+            inst = env.cloud.get_instance(claim.status.provider_id.rsplit("/", 1)[-1])
+            assert inst.instance_type != picked_type
+
+    def test_transient_api_errors_retry_to_convergence(self, env, expect):
+        """A burst of 5xx-style cloud errors delays but does not wedge
+        provisioning (parity: NextError injection, chaos suite)."""
+        env.apply_defaults(chaos_pool())
+        for _ in range(3):
+            env.cloud.next_errors.append(errors.CloudError("throttled", code="RequestLimitExceeded"))
+        for p in make_pods(4, "w", {"cpu": "1", "memory": "2Gi"}):
+            env.cluster.apply(p)
+        expect.eventually(
+            lambda: not env.cluster.pending_pods(),
+            "converged through API errors",
+            step_advance_s=1.0,
+        )
+        expect.no_orphan_instances()
+
+    def test_capacity_pool_exhaustion_spills_remainder(self, env, expect):
+        """A finite capacity pool serves some launches then ICEs; the rest
+        spill to other offerings (fake capacity_pools + ICE classification)."""
+        env.apply_defaults(chaos_pool())
+        probe = make_pods(1, "probe", {"cpu": "2", "memory": "4Gi"})
+        for p in probe:
+            env.cluster.apply(p)
+        env.step(3)
+        picked = next(iter(env.cluster.nodeclaims.values()))
+        picked_type = picked.labels[lbl.INSTANCE_TYPE_LABEL]
+        picked_zone = picked.labels[lbl.TOPOLOGY_ZONE]
+        picked_ct = picked.labels[lbl.CAPACITY_TYPE]
+        env.reset()
+        env.apply_defaults(chaos_pool())
+        env.cloud.capacity_pools[(picked_ct, picked_type, picked_zone)] = 2
+        for p in make_pods(8, "w", {"cpu": "2", "memory": "4Gi"}):
+            env.cluster.apply(p)
+        expect.eventually(
+            lambda: not env.cluster.pending_pods(), "spilled past exhausted pool",
+            step_advance_s=1.0,
+        )
+
+    def test_ice_mask_expires_and_pool_returns(self, env):
+        """The ICE cache TTL (3m) re-admits the offering afterwards
+        (cache.go:28-30 semantics)."""
+        env.apply_defaults(chaos_pool())
+        env.catalog.unavailable.mark_unavailable("m5.large", "zone-a", "spot")
+        assert env.catalog.unavailable.is_unavailable("m5.large", "zone-a", "spot")
+        env.clock.advance(181)
+        assert not env.catalog.unavailable.is_unavailable("m5.large", "zone-a", "spot")

@@ -1,0 +1,109 @@
+"""e2e: deprovisioning suite (parity: test/suites/consolidation +
+expiration + the scale deprovisioning dimensions — consolidation delete,
+consolidation replace, emptiness, expiration, budgets)."""
+
+import pytest
+
+from karpenter_provider_aws_tpu.models import Disruption, NodePool, Operator, Requirement
+from karpenter_provider_aws_tpu.models import labels as lbl
+from karpenter_provider_aws_tpu.models.pod import make_pods
+
+
+def pool(policy="WhenUnderutilized", budgets=("100%",), consolidate_after_s=30.0,
+         expire_after_s=None):
+    return NodePool(
+        name="default",
+        requirements=[Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m", "r"))],
+        disruption=Disruption(
+            consolidation_policy=policy,
+            consolidate_after_s=consolidate_after_s,
+            expire_after_s=expire_after_s,
+            budgets=list(budgets),
+        ),
+    )
+
+
+class TestConsolidationE2E:
+    def test_delete_consolidation_after_scale_down(self, env, expect, monitor):
+        """Kill most of the workload; consolidation shrinks the fleet and
+        the survivors still fit (consolidation.md:9-15 delete path)."""
+        env.apply_defaults(pool())
+        pods = make_pods(12, "w", {"cpu": "1", "memory": "2Gi"})
+        for p in pods:
+            env.cluster.apply(p)
+        expect.healthy()
+        nodes_before = monitor.node_count()
+        for p in pods[2:]:
+            env.cluster.delete(p)
+        env.clock.advance(31)
+        expect.eventually(
+            lambda: monitor.node_count() < nodes_before,
+            "fleet shrank",
+            step_advance_s=5.0,
+        )
+        expect.healthy()
+        expect.no_orphan_instances()
+
+    def test_emptiness_policy_removes_only_empty_nodes(self, env, expect, monitor):
+        env.apply_defaults(pool(policy="WhenEmpty"))
+        pods = make_pods(6, "w", {"cpu": "1", "memory": "2Gi"})
+        for p in pods:
+            env.cluster.apply(p)
+        expect.healthy()
+        for p in pods:
+            env.cluster.delete(p)
+        env.clock.advance(31)
+        expect.eventually(
+            lambda: monitor.node_count() == 0, "all empty nodes gone", step_advance_s=5.0
+        )
+
+    def test_expiration_rotates_nodes(self, env, expect):
+        """expireAfter rolls every node; pods land on replacements
+        (parity: deprovisioning_test.go:574-577 expiration churn)."""
+        env.apply_defaults(pool(consolidate_after_s=None, expire_after_s=120.0))
+        pods = make_pods(4, "w", {"cpu": "1", "memory": "2Gi"})
+        for p in pods:
+            env.cluster.apply(p)
+        expect.healthy()
+        before = set(env.cluster.nodeclaims)
+        env.clock.advance(121)
+        expect.eventually(
+            lambda: not (set(env.cluster.nodeclaims) & before),
+            "expired claims replaced",
+            step_advance_s=2.0,
+        )
+        expect.healthy()
+
+    def test_budget_limits_parallel_disruption(self, env, expect):
+        """A "1" budget rolls nodes one at a time (core disruption budgets)."""
+        env.apply_defaults(pool(consolidate_after_s=None, expire_after_s=60.0, budgets=("1",)))
+        pods = make_pods(6, "w", {"cpu": "4", "memory": "8Gi"})
+        for p in pods:
+            env.cluster.apply(p)
+        expect.healthy()
+        assert len(env.cluster.nodeclaims) >= 2
+        env.clock.advance(61)
+        env.disruption.reconcile()
+        deleted_now = sum(1 for c in env.cluster.nodeclaims.values() if c.deleted)
+        assert deleted_now <= 1
+
+    def test_consolidation_respects_do_not_disrupt_pod(self, env, expect, monitor):
+        env.apply_defaults(pool())
+        protected = make_pods(
+            2, "keep", {"cpu": "1", "memory": "2Gi"},
+            annotations={lbl.ANNOTATION_DO_NOT_DISRUPT: "true"},
+        )
+        filler = make_pods(8, "fill", {"cpu": "1", "memory": "2Gi"})
+        for p in protected + filler:
+            env.cluster.apply(p)
+        expect.healthy()
+        protected_nodes = {p.node_name for p in protected}
+        for p in filler:
+            env.cluster.delete(p)
+        env.clock.advance(31)
+        for _ in range(10):
+            env.clock.advance(5)
+            env.step(1)
+        # nodes hosting protected pods survived
+        assert protected_nodes <= set(env.cluster.nodes)
+        assert all(not p.is_pending() for p in protected)

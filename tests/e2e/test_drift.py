@@ -1,0 +1,88 @@
+"""e2e: drift suite (parity: test/suites/drift — static-hash, image,
+subnet and security-group drift each roll the node through the disruption
+pipeline and a replacement absorbs the pods)."""
+
+import pytest
+
+from karpenter_provider_aws_tpu.models import Disruption, NodePool, Operator, Requirement
+from karpenter_provider_aws_tpu.models import labels as lbl
+from karpenter_provider_aws_tpu.models.nodeclass import SelectorTerm
+from karpenter_provider_aws_tpu.models.pod import make_pods
+
+
+def drift_pool():
+    return NodePool(
+        name="default",
+        requirements=[Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m", "r"))],
+        disruption=Disruption(budgets=["100%"], consolidate_after_s=None),
+    )
+
+
+@pytest.fixture
+def provisioned(env, expect):
+    _, nodeclass = env.apply_defaults(drift_pool())
+    for p in make_pods(3, "w", {"cpu": "1", "memory": "2Gi"}):
+        env.cluster.apply(p)
+    expect.healthy()
+    return nodeclass
+
+
+class TestDrift:
+    def _drain_and_settle(self, env, expect, before_claims):
+        expect.eventually(
+            lambda: all(
+                name not in env.cluster.nodeclaims for name in before_claims
+            ),
+            "drifted claims replaced",
+            step_advance_s=1.0,
+        )
+        expect.healthy()
+
+    def test_static_hash_drift_replaces_nodes(self, env, expect, provisioned):
+        """Mutating a hashed spec field drifts every node of the class
+        (parity: drift.go:41-136 static drift via hash annotation)."""
+        nodeclass = provisioned
+        before = set(env.cluster.nodeclaims)
+        nodeclass.tags = {"cost-center": "42"}  # hashed field
+        env.step(2)  # hash controller re-stamps, disruption sees drift
+        self._drain_and_settle(env, expect, before)
+        for claim in env.cluster.nodeclaims.values():
+            assert claim.annotations[lbl.ANNOTATION_NODECLASS_HASH] == nodeclass.hash()
+
+    def test_image_drift_when_selector_rolls(self, env, expect, provisioned):
+        """Pinning the selector to an image the nodes don't run drifts them
+        (parity: drift.go AMI drift; selector terms are not hashed, so this
+        is dynamic drift, not static)."""
+        nodeclass = provisioned
+        before = set(env.cluster.nodeclaims)
+        running_images = {
+            c.status.image_id for c in env.cluster.nodeclaims.values()
+        }
+        assert running_images  # sanity
+        nodeclass.image_selector = [SelectorTerm.of(name="standard-v1")]
+        env.cloudprovider.reset_caches()
+        env.step(2)
+        self._drain_and_settle(env, expect, before)
+        assert {
+            c.status.image_id for c in env.cluster.nodeclaims.values()
+        } == {"img-std-1"}
+
+    def test_security_group_drift(self, env, expect, provisioned):
+        from karpenter_provider_aws_tpu.fake.cloud import SecurityGroup
+
+        nodeclass = provisioned
+        before = set(env.cluster.nodeclaims)
+        # the cluster's SG is replaced: old sg deleted, new one discovered
+        env.cloud.security_groups = [
+            SecurityGroup(id="sg-2", name="replacement", tags={"discovery": "cluster-1"}),
+        ]
+        env.cloudprovider.reset_caches()
+        env.step(2)
+        self._drain_and_settle(env, expect, before)
+
+    def test_no_drift_no_churn(self, env, expect, provisioned):
+        before = set(env.cluster.nodeclaims)
+        for _ in range(5):
+            env.clock.advance(10)
+            env.step(1)
+        assert set(env.cluster.nodeclaims) == before

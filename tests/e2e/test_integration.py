@@ -1,0 +1,148 @@
+"""e2e: integration suite — full-loop provisioning scenarios
+(parity: test/suites/integration — scheduling, tagging, kubelet, selector
+resolution, limits, weighted pools — driven through the whole manager)."""
+
+from karpenter_provider_aws_tpu.models import (
+    Disruption,
+    Limits,
+    NodePool,
+    Operator,
+    Requirement,
+    Taint,
+)
+from karpenter_provider_aws_tpu.models import labels as lbl
+from karpenter_provider_aws_tpu.models.nodeclass import NodeClass, SelectorTerm
+from karpenter_provider_aws_tpu.models.pod import (
+    Toleration,
+    TopologySpreadConstraint,
+    make_pods,
+)
+
+
+class TestProvisioningE2E:
+    def test_pod_to_running_node(self, env, monitor, expect):
+        env.apply_defaults()
+        for p in make_pods(10, "web", {"cpu": "500m", "memory": "1Gi"}):
+            env.cluster.apply(p)
+        expect.healthy()
+        assert monitor.created_nodes()
+        # every created node is backed by a real cloud instance with tags
+        for node in monitor.created_nodes():
+            inst = env.cloud.get_instance(node.provider_id.rsplit("/", 1)[-1])
+            assert inst.tags.get("karpenter.tpu/nodepool") == "default"
+        expect.no_orphan_instances()
+
+    def test_nodeclass_not_ready_blocks_launch(self, env, expect):
+        """Claims cannot launch until the nodeclass resolves
+        (parity: cloudprovider.go:90-93 readiness gate)."""
+        nodeclass = NodeClass(
+            name="default",
+            role="node-role",
+            subnet_selector=[SelectorTerm.of(discovery="nonexistent")],
+        )
+        env.cluster.apply(nodeclass)
+        env.cluster.apply(NodePool(name="default"))
+        for p in make_pods(2, "w", {"cpu": "1", "memory": "2Gi"}):
+            env.cluster.apply(p)
+        env.step(3)
+        assert env.cluster.pending_pods()  # blocked: no subnets resolve
+        # fix the selector -> next reconcile resolves and launches
+        nodeclass.subnet_selector = [SelectorTerm.of(discovery="cluster-1")]
+        expect.healthy()
+
+    def test_taints_and_tolerations(self, env, expect):
+        pool, _ = env.apply_defaults(
+            NodePool(name="default", taints=[Taint(key="dedicated", value="ml")])
+        )
+        tolerant = make_pods(
+            2, "ml", {"cpu": "1", "memory": "2Gi"},
+            tolerations=[Toleration(key="dedicated", value="ml")],
+        )
+        intolerant = make_pods(1, "other", {"cpu": "1", "memory": "1Gi"})
+        for p in tolerant + intolerant:
+            env.cluster.apply(p)
+        env.step(4)
+        assert {p.name for p in env.cluster.pending_pods()} == {"other-0"}
+        assert all(not p.is_pending() for p in tolerant)
+
+    def test_weighted_pool_preference(self, env, monitor, expect):
+        """Higher-weight pool wins when both fit (core NodePool.spec.weight)."""
+        env.cluster.apply(NodeClass(name="default", role="node-role"))
+        env.cluster.apply(
+            NodePool(
+                name="preferred",
+                weight=10,
+                requirements=[Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c",))],
+            )
+        )
+        env.cluster.apply(
+            NodePool(
+                name="fallback",
+                weight=1,
+                requirements=[Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("m",))],
+            )
+        )
+        env.step(2)
+        for p in make_pods(4, "w", {"cpu": "1", "memory": "1Gi"}):
+            env.cluster.apply(p)
+        expect.healthy()
+        pools = {n.nodepool_name for n in monitor.created_nodes()}
+        assert pools == {"preferred"}
+
+    def test_pool_limits_cap_capacity_then_fallback(self, env, expect):
+        """When the preferred pool hits its resource limit the remaining pods
+        flow to the fallback pool (core limits + weight semantics)."""
+        env.cluster.apply(NodeClass(name="default", role="node-role"))
+        env.cluster.apply(
+            NodePool(name="small", weight=10, limits=Limits.of(cpu=4))
+        )
+        env.cluster.apply(NodePool(name="big", weight=1))
+        env.step(2)
+        for p in make_pods(12, "w", {"cpu": "2", "memory": "2Gi"}):
+            env.cluster.apply(p)
+        expect.healthy()
+        by_pool: dict[str, int] = {}
+        for c in env.cluster.nodeclaims.values():
+            by_pool[c.nodepool_name] = by_pool.get(c.nodepool_name, 0) + 1
+        assert by_pool.get("big", 0) >= 1, by_pool
+        # the limited pool stayed within 4 cpus of capacity
+        from karpenter_provider_aws_tpu.models.resources import ResourceVector
+
+        used = ResourceVector()
+        for c in env.cluster.claims_for_nodepool("small"):
+            used = used + c.status.capacity
+        assert used.get("cpu") <= 4000
+
+    def test_kubelet_max_pods_respected_end_to_end(self, env, expect):
+        pool, _ = env.apply_defaults()
+        from karpenter_provider_aws_tpu.models.nodeclass import KubeletConfiguration
+
+        pool.kubelet = KubeletConfiguration(max_pods=4)
+        for p in make_pods(9, "tiny", {"cpu": "50m", "memory": "64Mi"}):
+            env.cluster.apply(p)
+        expect.healthy()
+        for node in env.cluster.nodes.values():
+            assert len(env.cluster.pods_on_node(node.name)) <= 4
+
+    def test_zone_spread_end_to_end(self, env, expect):
+        env.apply_defaults()
+        pods = make_pods(
+            6, "spread", {"cpu": "500m", "memory": "512Mi"},
+            labels={"app": "spread"},
+            topology_spread=[
+                TopologySpreadConstraint(
+                    topology_key=lbl.TOPOLOGY_ZONE,
+                    max_skew=1,
+                    label_selector={"app": "spread"},
+                )
+            ],
+        )
+        for p in pods:
+            env.cluster.apply(p)
+        expect.healthy()
+        zones: dict[str, int] = {}
+        for p in pods:
+            node = env.cluster.nodes[p.node_name]
+            z = node.zone()
+            zones[z] = zones.get(z, 0) + 1
+        assert max(zones.values()) - min(zones.values()) <= 1

@@ -1,0 +1,76 @@
+"""e2e: interruption suite (parity: test/suites/interruption — queue
+events roll through drain + replacement with the ICE mask applied)."""
+
+from karpenter_provider_aws_tpu.models import Disruption, NodePool, Operator, Requirement
+from karpenter_provider_aws_tpu.models import labels as lbl
+from karpenter_provider_aws_tpu.models.pod import make_pods
+
+
+def quiet_pool():
+    return NodePool(
+        name="default",
+        requirements=[Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m", "r"))],
+        disruption=Disruption(budgets=["100%"], consolidate_after_s=None),
+    )
+
+
+def spot_warning(instance_id):
+    return {
+        "source": "aws.ec2",
+        "detail-type": "EC2 Spot Instance Interruption Warning",
+        "detail": {"instance-id": instance_id},
+    }
+
+
+class TestInterruptionE2E:
+    def test_spot_interruption_end_to_end(self, env, expect):
+        """Warning → drain → pods pending → replacement avoids the
+        interrupted pool (§3.3 + ICE-mask feedback into the next solve)."""
+        env.apply_defaults(quiet_pool())
+        pods = make_pods(4, "w", {"cpu": "1", "memory": "2Gi"})
+        for p in pods:
+            env.cluster.apply(p)
+        expect.healthy()
+        victim = next(iter(env.cluster.nodeclaims.values()))
+        itype = victim.labels[lbl.INSTANCE_TYPE_LABEL]
+        zone = victim.labels[lbl.TOPOLOGY_ZONE]
+        captype = victim.labels[lbl.CAPACITY_TYPE]
+        env.queue.send(spot_warning(victim.status.provider_id.rsplit("/", 1)[-1]))
+        expect.eventually(lambda: victim.deleted, "victim drained")
+        if captype == "spot":
+            assert env.catalog.unavailable.is_unavailable(itype, zone, "spot")
+        expect.healthy()  # displaced pods rescheduled
+        # no replacement landed on the interrupted offering
+        for claim in env.cluster.nodeclaims.values():
+            assert not (
+                claim.labels[lbl.INSTANCE_TYPE_LABEL] == itype
+                and claim.labels[lbl.TOPOLOGY_ZONE] == zone
+                and claim.labels[lbl.CAPACITY_TYPE] == "spot"
+                and captype == "spot"
+            )
+
+    def test_interruption_storm_drains_all_and_recovers(self, env, expect, monitor):
+        """Every node interrupted at once; the fleet rebuilds and all pods
+        run again (parity: the interruption storm chaos dimension)."""
+        env.apply_defaults(quiet_pool())
+        pods = make_pods(8, "w", {"cpu": "1", "memory": "2Gi"})
+        for p in pods:
+            env.cluster.apply(p)
+        expect.healthy()
+        victims = list(env.cluster.nodeclaims.values())
+        for claim in victims:
+            env.queue.send(spot_warning(claim.status.provider_id.rsplit("/", 1)[-1]))
+        expect.eventually(
+            lambda: all(v.name not in env.cluster.nodeclaims for v in victims),
+            "all victims gone",
+            step_advance_s=1.0,
+        )
+        expect.healthy()
+        assert monitor.running_pods() == len(pods)
+        assert len(env.queue) == 0
+
+    def test_queue_message_for_unknown_instance_is_dropped(self, env):
+        env.apply_defaults(quiet_pool())
+        env.queue.send(spot_warning("i-does-not-exist"))
+        env.interruption.reconcile()
+        assert len(env.queue) == 0

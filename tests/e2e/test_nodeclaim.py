@@ -1,0 +1,100 @@
+"""e2e: nodeclaim lifecycle suite (parity: test/suites/nodeclaim —
+launch → register → initialize → tag, teardown, leak reaping)."""
+
+from karpenter_provider_aws_tpu.cloudprovider.cloudprovider import MANAGED_TAG
+from karpenter_provider_aws_tpu.fake.cloud import Instance
+from karpenter_provider_aws_tpu.models import NodePool, Taint
+from karpenter_provider_aws_tpu.models import labels as lbl
+from karpenter_provider_aws_tpu.models.pod import Toleration, make_pods
+
+
+class TestNodeClaimLifecycle:
+    def test_claim_conditions_progress_to_initialized(self, env, expect):
+        env.apply_defaults()
+        for p in make_pods(2, "w", {"cpu": "1", "memory": "2Gi"}):
+            env.cluster.apply(p)
+        expect.healthy()
+        for claim in env.cluster.nodeclaims.values():
+            assert claim.is_launched()
+            assert claim.is_registered()
+            assert claim.is_initialized()
+            assert claim.status.node_name in env.cluster.nodes
+
+    def test_startup_taints_cleared_on_initialize(self, env, expect):
+        env.apply_defaults(
+            NodePool(
+                name="default",
+                startup_taints=[Taint(key="cni.example.com/uninitialized", value="true")],
+            )
+        )
+        for p in make_pods(1, "w", {"cpu": "1", "memory": "2Gi"}):
+            env.cluster.apply(p)
+        expect.healthy()
+        node = next(iter(env.cluster.nodes.values()))
+        assert not any(t.key == "cni.example.com/uninitialized" for t in node.taints)
+
+    def test_instance_tagged_after_registration(self, env, expect):
+        """Post-launch tagging decorates the instance with node identity
+        (parity: tagging/controller.go:56-115)."""
+        env.apply_defaults()
+        for p in make_pods(1, "w", {"cpu": "1", "memory": "2Gi"}):
+            env.cluster.apply(p)
+        expect.healthy()
+        claim = next(iter(env.cluster.nodeclaims.values()))
+        inst = env.cloud.get_instance(claim.status.provider_id.rsplit("/", 1)[-1])
+        expect.eventually(
+            lambda: env.cloud.get_instance(inst.id).tags.get("Name") == claim.status.node_name,
+            "instance Name tag",
+        )
+        assert env.cloud.get_instance(inst.id).tags.get("karpenter.tpu/nodeclaim") == claim.name
+
+    def test_claim_delete_terminates_instance_and_node(self, env, expect):
+        env.apply_defaults()
+        for p in make_pods(1, "w", {"cpu": "1", "memory": "2Gi"}):
+            env.cluster.apply(p)
+        expect.healthy()
+        claim = next(iter(env.cluster.nodeclaims.values()))
+        iid = claim.status.provider_id.rsplit("/", 1)[-1]
+        env.cluster.delete(claim)
+        expect.eventually(
+            lambda: claim.name not in env.cluster.nodeclaims, "claim finalized"
+        )
+        inst = env.cloud.instances.get(iid)
+        assert inst is None or inst.state in ("shutting-down", "terminated")
+
+    def test_leaked_instance_reaped_by_gc(self, env, expect):
+        """A managed cloud instance with no claim is terminated after the
+        30s grace (parity: garbagecollection/controller.go:51-104)."""
+        env.apply_defaults()
+        env.cloud.instances["i-leak"] = Instance(
+            id="i-leak",
+            instance_type="m5.large",
+            zone="zone-a",
+            capacity_type="on-demand",
+            image_id="img-std-2",
+            launch_time=env.clock.now(),
+            tags={MANAGED_TAG: "true"},
+        )
+        env.step(1)
+        assert env.cloud.instances["i-leak"].state == "running"  # inside grace
+        env.clock.advance(31)
+        expect.eventually(
+            lambda: env.cloud.instances["i-leak"].state in ("shutting-down", "terminated"),
+            "leak reaped",
+        )
+        assert "i-leak" in env.garbagecollection.reaped
+
+    def test_unmanaged_instance_not_reaped(self, env):
+        env.apply_defaults()
+        env.cloud.instances["i-user"] = Instance(
+            id="i-user",
+            instance_type="m5.large",
+            zone="zone-a",
+            capacity_type="on-demand",
+            image_id="img-std-2",
+            launch_time=env.clock.now(),
+            tags={},  # not managed by us
+        )
+        env.clock.advance(60)
+        env.step(3)
+        assert env.cloud.instances["i-user"].state == "running"

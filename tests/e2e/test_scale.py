@@ -1,0 +1,135 @@
+"""e2e: scale suite (parity: test/suites/scale provisioning_test.go +
+deprovisioning_test.go, scaled to hermetic-CI size — the reference's
+dimensions are 500-node provisioning and 200-node consolidation against
+real EC2; here the same scenario shapes run against the fake cloud with
+durations recorded to the DurationSink, our Timestream analogue.
+Scale up via E2E_SCALE_NODES / E2E_METRICS_PATH)."""
+
+import os
+
+from karpenter_provider_aws_tpu.models import Disruption, NodePool, Operator, Requirement
+from karpenter_provider_aws_tpu.models import labels as lbl
+from karpenter_provider_aws_tpu.models.pod import PodAffinityTerm, make_pods
+
+from .environment import Expectations, Monitor
+
+NODES = int(os.environ.get("E2E_SCALE_NODES", 100))
+
+
+def scale_pool(**dkw):
+    dkw.setdefault("budgets", ["100%"])
+    return NodePool(
+        name="default",
+        requirements=[Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m", "r"))],
+        disruption=Disruption(**dkw),
+    )
+
+
+def node_dense_pods(n, prefix="dense"):
+    """1 pod per node via self-matching hostname anti-affinity (the
+    reference forces node-density with hostPorts; same effect)."""
+    return make_pods(
+        n, prefix, {"cpu": "1", "memory": "2Gi"},
+        labels={"app": prefix},
+        anti_affinity=[
+            PodAffinityTerm(topology_key=lbl.HOSTNAME, label_selector={"app": prefix})
+        ],
+    )
+
+
+class TestScale:
+    def test_node_dense_provisioning(self, host_env, sink):
+        """N nodes, 1 pod/node (parity: provisioning_test.go:84-121)."""
+        env = host_env
+        env.apply_defaults(scale_pool(consolidate_after_s=None))
+        expect = Expectations(env, max_steps=30)
+        monitor = Monitor(env)
+        pods = node_dense_pods(NODES)
+
+        def run():
+            for p in pods:
+                env.cluster.apply(p)
+            expect.healthy()
+
+        dt = sink.measure(
+            "provisioningDuration", run,
+            dimensions="node-dense", pods=NODES, nodes=len(monitor.created_nodes()),
+        )
+        assert len(monitor.created_nodes()) == NODES
+        assert dt < 120, f"node-dense provisioning took {dt:.1f}s"
+
+    def test_pod_dense_provisioning(self, host_env, sink):
+        """N*20 pods packed densely (parity: the pod-dense dimension)."""
+        env = host_env
+        env.apply_defaults(scale_pool(consolidate_after_s=None))
+        expect = Expectations(env, max_steps=30)
+        monitor = Monitor(env)
+        pods = make_pods(NODES * 20, "poddense", {"cpu": "100m", "memory": "256Mi"})
+
+        def run():
+            for p in pods:
+                env.cluster.apply(p)
+            expect.healthy()
+
+        dt = sink.measure(
+            "provisioningDuration", run,
+            dimensions="pod-dense", pods=len(pods), nodes=len(monitor.created_nodes()),
+        )
+        # dense packing: far fewer nodes than pods
+        assert 0 < len(monitor.created_nodes()) < len(pods) / 4
+        assert dt < 120, f"pod-dense provisioning took {dt:.1f}s"
+
+    def test_consolidation_delete_scale(self, host_env, sink):
+        """Scale down 80% of the workload, consolidation shrinks the fleet
+        (parity: deprovisioning_test.go:338-343)."""
+        env = host_env
+        env.apply_defaults(scale_pool(consolidate_after_s=10.0))
+        expect = Expectations(env, max_steps=40)
+        monitor = Monitor(env)
+        pods = make_pods(NODES * 4, "w", {"cpu": "500m", "memory": "1Gi"})
+        for p in pods:
+            env.cluster.apply(p)
+        expect.healthy()
+        peak = monitor.node_count()
+        for p in pods[: int(len(pods) * 0.8)]:
+            env.cluster.delete(p)
+        env.clock.advance(11)
+
+        def run():
+            expect.eventually(
+                lambda: monitor.node_count() <= max(1, peak // 2),
+                "fleet halved",
+                step_advance_s=10.0,
+            )
+
+        sink.measure(
+            "deprovisioningDuration", run,
+            dimensions="consolidation-delete", nodes=peak,
+        )
+        assert not env.cluster.pending_pods()
+
+    def test_emptiness_scale(self, host_env, sink):
+        """Delete every pod; the whole fleet drains to zero
+        (parity: deprovisioning_test.go:518-522)."""
+        env = host_env
+        env.apply_defaults(
+            scale_pool(consolidation_policy="WhenEmpty", consolidate_after_s=5.0)
+        )
+        expect = Expectations(env, max_steps=40)
+        monitor = Monitor(env)
+        pods = make_pods(NODES * 2, "w", {"cpu": "1", "memory": "2Gi"})
+        for p in pods:
+            env.cluster.apply(p)
+        expect.healthy()
+        for p in pods:
+            env.cluster.delete(p)
+        env.clock.advance(6)
+
+        def run():
+            expect.eventually(
+                lambda: monitor.node_count() == 0, "fleet drained",
+                step_advance_s=10.0,
+            )
+
+        sink.measure("deprovisioningDuration", run, dimensions="emptiness")
+        assert len(env.cloud.list_instances()) == 0
