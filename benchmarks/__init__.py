@@ -1,0 +1,8 @@
+"""Benchmark tier (parity: the reference's `-tags=test_performance` bench
+suite + scale-test measurement harness, Makefile:90-91 and
+test/pkg/environment/aws/metrics.go). Run:
+
+    python -m benchmarks                 # all, JSON line per result
+    python -m benchmarks solve           # the 5 BASELINE.json solve configs
+    python -m benchmarks interruption    # queue throughput at 100/1k/5k/15k
+"""

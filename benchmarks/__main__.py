@@ -1,0 +1,27 @@
+"""Benchmark driver: ``python -m benchmarks [solve|interruption] [--scale X]``."""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("which", nargs="?", default="all", choices=["all", "solve", "interruption"])
+    ap.add_argument("--scale", type=float, default=1.0, help="problem-size multiplier")
+    ap.add_argument("--iters", type=int, default=10)
+    args = ap.parse_args()
+    if args.which in ("all", "solve"):
+        from .solve_configs import run_all as run_solve
+
+        run_solve(scale=args.scale, iters=args.iters)
+    if args.which in ("all", "interruption"):
+        from .interruption_bench import run_all as run_interruption
+
+        sizes = [max(1, int(n * args.scale)) for n in (100, 1_000, 5_000, 15_000)]
+        run_interruption(sizes)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

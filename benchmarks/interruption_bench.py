@@ -1,0 +1,83 @@
+"""Interruption throughput benchmark.
+
+Parity: ``pkg/controllers/interruption/interruption_benchmark_test.go:63-100``
+— 100 / 1,000 / 5,000 / 15,000 queued messages drained through the
+interruption controller against a fake cluster; reports messages/sec.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from karpenter_provider_aws_tpu.models import Disruption, NodePool
+from karpenter_provider_aws_tpu.models.nodeclaim import NodeClaim
+from karpenter_provider_aws_tpu.models import labels as lbl
+from karpenter_provider_aws_tpu.state.cluster import Node
+from karpenter_provider_aws_tpu.testenv import new_environment
+
+SIZES = (100, 1_000, 5_000, 15_000)
+
+
+def _env_with_claims(n):
+    env = new_environment(use_tpu_solver=False)
+    env.apply_defaults(
+        NodePool(name="default", disruption=Disruption(consolidate_after_s=None))
+    )
+    it = env.catalog.get("m5.large")
+    for i in range(n):
+        claim = NodeClaim.fresh(
+            nodepool_name="default",
+            nodeclass_name="default",
+            instance_type_options=[it.name],
+            zone_options=["zone-a"],
+            capacity_type_options=["spot"],
+        )
+        claim.status.provider_id = f"cloud:///zone-a/i-b{i}"
+        claim.labels.update(it.labels())
+        claim.labels[lbl.TOPOLOGY_ZONE] = "zone-a"
+        claim.labels[lbl.CAPACITY_TYPE] = "spot"
+        claim.status.set_condition("Launched", True)
+        env.cluster.apply(claim)
+        node = Node(
+            name=f"node-{claim.name}", provider_id=claim.status.provider_id,
+            nodepool_name="default", nodeclaim_name=claim.name, ready=True,
+        )
+        claim.status.node_name = node.name
+        env.cluster.apply(node)
+    return env
+
+
+def run_size(n) -> dict:
+    env = _env_with_claims(n)
+    for i in range(n):
+        env.queue.send({
+            "source": "aws.ec2",
+            "detail-type": "EC2 Spot Instance Interruption Warning",
+            "detail": {"instance-id": f"i-b{i}"},
+        })
+    before = len(env.cluster.nodeclaims)
+    t0 = time.perf_counter()
+    while len(env.queue):
+        env.interruption.reconcile()
+    dt = time.perf_counter() - t0
+    # claims without live instances are deleted outright (no finalizer hold)
+    drained = before - sum(
+        1 for c in env.cluster.nodeclaims.values() if not c.deleted
+    )
+    return {
+        "benchmark": f"interruption_throughput_{n}",
+        "messages": n,
+        "seconds": round(dt, 4),
+        "msgs_per_sec": round(n / dt, 1),
+        "claims_drained": drained,
+    }
+
+
+def run_all(sizes=SIZES):
+    out = []
+    for n in sizes:
+        row = run_size(n)
+        out.append(row)
+        print(json.dumps(row), flush=True)
+    return out
