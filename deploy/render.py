@@ -1,0 +1,69 @@
+"""Render deploy manifests from values.yaml (the Helm-template analogue).
+
+Usage: python deploy/render.py [--values deploy/values.yaml] [--out -]
+Substitutes ${key} / ${a.b} placeholders; no external deps (tiny flat-YAML
+reader, sufficient for values.yaml's two-level structure).
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import re
+import sys
+
+HERE = pathlib.Path(__file__).resolve().parent
+MANIFESTS = ("rbac.yaml", "deployment.yaml", "pdb-and-service.yaml")
+
+
+def load_values(path: pathlib.Path) -> dict[str, str]:
+    """Flatten two-level yaml into {'a': x, 'a.b': y} string values."""
+    out: dict[str, str] = {}
+    stack: list[str] = []
+    for raw in path.read_text().splitlines():
+        line = raw.split("#", 1)[0].rstrip()
+        if not line.strip():
+            continue
+        indent = len(line) - len(line.lstrip())
+        key, _, val = line.strip().partition(":")
+        raw_val = val.strip()
+        level = indent // 2
+        stack = stack[:level]
+        if raw_val:  # '""' is an explicit empty scalar, not a section
+            out[".".join(stack + [key])] = raw_val.strip("\"'")
+        else:
+            stack.append(key)
+    return out
+
+
+def render(text: str, values: dict[str, str]) -> str:
+    def sub(m: re.Match) -> str:
+        k = m.group(1)
+        if k not in values:
+            raise SystemExit(f"no value for ${{{k}}}")
+        return values[k]
+
+    return re.sub(r"\$\{([a-zA-Z0-9_.]+)\}", sub, text)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--values", default=str(HERE / "values.yaml"))
+    ap.add_argument("--out", default="-", help="'-' for stdout, else a directory")
+    args = ap.parse_args()
+    values = load_values(pathlib.Path(args.values))
+    docs = [render((HERE / m).read_text(), values) for m in MANIFESTS]
+    blob = "\n---\n".join(docs)
+    if args.out == "-":
+        sys.stdout.write(blob)
+    else:
+        outdir = pathlib.Path(args.out)
+        outdir.mkdir(parents=True, exist_ok=True)
+        for name, doc in zip(MANIFESTS, docs):
+            (outdir / name).write_text(doc)
+        print(f"rendered {len(MANIFESTS)} manifests to {outdir}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,66 @@
+"""L10 layer: deploy manifest rendering + kompat + allocatable-diff
+(reference: charts/karpenter templates, tools/kompat, tools/allocatable-diff)."""
+
+import importlib.util
+import json
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _load(relpath, name):
+    spec = importlib.util.spec_from_file_location(name, REPO / relpath)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestDeployRender:
+    def test_render_substitutes_every_placeholder(self):
+        render = _load("deploy/render.py", "render_mod")
+        values = render.load_values(REPO / "deploy" / "values.yaml")
+        assert values["replicas"] == "2"
+        assert values["resources.cpu"] == "1"
+        assert values["clusterEndpoint"] == ""  # explicit empty scalar
+        for m in render.MANIFESTS:
+            out = render.render((REPO / "deploy" / m).read_text(), values)
+            assert "${" not in out, f"unsubstituted placeholder in {m}"
+
+    def test_rendered_deployment_shape(self):
+        render = _load("deploy/render.py", "render_mod2")
+        values = render.load_values(REPO / "deploy" / "values.yaml")
+        out = render.render((REPO / "deploy" / "deployment.yaml").read_text(), values)
+        assert "replicas: 2" in out
+        assert "name: solver" in out          # TPU sidecar present
+        assert "google.com/tpu" in out
+        assert "--leader-elect=true" in out
+
+
+class TestKompat:
+    def test_matrix_and_window(self):
+        kompat = _load("tools/kompat.py", "kompat_mod")
+        m = kompat.matrix()
+        assert "1.23" in m and "karpenter-tpu" in m
+        assert kompat.check("1.27")
+        assert not kompat.check("1.99")
+        assert not kompat.check("2.0")
+        assert not kompat.check("garbage")
+
+
+class TestAllocatableDiff:
+    def test_model_matches_itself_and_flags_drift(self, tmp_path):
+        from karpenter_provider_aws_tpu.catalog import CatalogProvider
+
+        adiff = _load("tools/allocatable_diff.py", "adiff_mod")
+        cat = CatalogProvider()
+        live = [
+            {"instance_type": it.name, "allocatable": cat.allocatable(it).to_map()}
+            for it in cat.list()[:10]
+        ]
+        assert adiff.diff(live) == []
+        live[0]["allocatable"]["cpu"] *= 0.8
+        rows = adiff.diff(live)
+        assert rows and rows[0]["resource"] == "cpu"
+        assert adiff.diff([{"instance_type": "nope", "allocatable": {}}])[0]["error"]

@@ -1,0 +1,71 @@
+"""allocatable-diff: predicted-vs-actual allocatable drift checker.
+
+Parity: ``tools/allocatable-diff`` in the reference — compares the capacity
+model's predicted allocatable (what the scheduler packs against) with the
+values live nodes actually report, and flags divergence. Here "live" values
+come from a JSON file of node reports (or the fake cloud in tests); drift
+beyond tolerance means the overhead model (VM overhead %, kube-reserved
+curves, eviction thresholds) needs recalibration.
+
+Usage:
+    python tools/allocatable_diff.py --live nodes.json [--tolerance 0.02]
+
+nodes.json: [{"instance_type": "m5.large", "allocatable": {"cpu": 1930,
+              "memory": 6804, ...}}, ...]  (cpu milli, memory MiB)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+sys.path.insert(0, ".")
+
+from karpenter_provider_aws_tpu.catalog import CatalogProvider  # noqa: E402
+
+
+def diff(live_nodes: list[dict], tolerance: float = 0.02) -> list[dict]:
+    catalog = CatalogProvider()
+    rows = []
+    for node in live_nodes:
+        it = catalog.get(node["instance_type"])
+        if it is None:
+            rows.append({"instance_type": node["instance_type"], "error": "unknown type"})
+            continue
+        predicted = catalog.allocatable(it).to_map()
+        for resource, actual in node["allocatable"].items():
+            pred = predicted.get(resource, 0.0)
+            if pred == 0 and actual == 0:
+                continue
+            denom = max(abs(actual), 1e-9)
+            rel = abs(pred - actual) / denom
+            if rel > tolerance:
+                rows.append({
+                    "instance_type": it.name,
+                    "resource": resource,
+                    "predicted": round(pred, 1),
+                    "actual": actual,
+                    "relative_error": round(rel, 4),
+                })
+    return rows
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--live", required=True, help="JSON file of live node reports")
+    ap.add_argument("--tolerance", type=float, default=0.02)
+    args = ap.parse_args()
+    live = json.loads(open(args.live).read())
+    rows = diff(live, args.tolerance)
+    for r in rows:
+        print(json.dumps(r))
+    if rows:
+        print(f"{len(rows)} divergences beyond {args.tolerance:.0%}", file=sys.stderr)
+        return 1
+    print("allocatable model matches live nodes", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

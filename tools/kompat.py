@@ -1,0 +1,57 @@
+"""kompat: supported-version compatibility matrix tool.
+
+Parity: ``tools/kompat`` in the reference — renders the controller's
+supported Kubernetes version window as a compatibility matrix for docs and
+validates a given version against it.
+
+Usage:
+    python tools/kompat.py                 # print the matrix (markdown)
+    python tools/kompat.py --check 1.27    # exit 1 if unsupported
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+sys.path.insert(0, ".")
+
+from karpenter_provider_aws_tpu.providers.version import (  # noqa: E402
+    MAX_SUPPORTED_MINOR,
+    MIN_SUPPORTED_MINOR,
+)
+
+
+def matrix() -> str:
+    versions = [f"1.{m}" for m in range(MIN_SUPPORTED_MINOR, MAX_SUPPORTED_MINOR + 1)]
+    rows = [
+        "| KUBERNETES | " + " | ".join(versions) + " |",
+        "|---" * (len(versions) + 1) + "|",
+        "| karpenter-tpu | " + " | ".join(["✓"] * len(versions)) + " |",
+    ]
+    return "\n".join(rows)
+
+
+def check(version: str) -> bool:
+    try:
+        major, minor = version.split(".")[:2]
+        return int(major) == 1 and MIN_SUPPORTED_MINOR <= int(minor) <= MAX_SUPPORTED_MINOR
+    except ValueError:
+        return False
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--check", metavar="X.Y", help="validate a version against the window")
+    args = ap.parse_args()
+    if args.check:
+        ok = check(args.check)
+        print(f"{args.check}: {'supported' if ok else 'UNSUPPORTED'} "
+              f"(window 1.{MIN_SUPPORTED_MINOR}–1.{MAX_SUPPORTED_MINOR})")
+        return 0 if ok else 1
+    print(matrix())
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
