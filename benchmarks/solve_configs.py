@@ -97,8 +97,16 @@ def config3_topology(n=10_000):
     return pods, [_pool()]
 
 
-def config5_accelerators(n=4000):
-    """GPU/accelerator pods + cpu filler (nvidia.com/gpu, neuron)."""
+def config5_accelerators(n=4000, catalog=None):
+    """GPU/accelerator pods + capacity-reservation-aware packing: part of the
+    GPU fleet is pre-paid (reserved captype at price 0, hard-counted)."""
+    if catalog is not None:
+        from karpenter_provider_aws_tpu.catalog.reservations import Reservation
+
+        catalog.reservations.update([
+            Reservation(id="cr-gpu", instance_type="g5.12xlarge", zone="zone-a", count=20),
+            Reservation(id="cr-trn", instance_type="trn1.32xlarge", zone="zone-b", count=4),
+        ])
     pods = []
     pods += make_pods(n // 4, "gpu", {"cpu": "4", "memory": "16Gi", "nvidia.com/gpu": 1})
     pods += make_pods(n // 8, "neuron", {"cpu": "8", "memory": "32Gi", "aws.amazon.com/neuron": 1})
@@ -221,6 +229,8 @@ def run_all(scale=1.0, iters=DEFAULT_ITERS):
         ("config3_topology_10k", config3_topology, {"n": int(10_000 * scale)}),
         ("config5_accelerators", config5_accelerators, {"n": int(4000 * scale)}),
     ):
+        if builder is config5_accelerators:
+            kwargs["catalog"] = catalog
         pods, pools = builder(**kwargs)
         row = _run_config(name, pods, pools, catalog, iters=iters)
         out.append(row)

@@ -91,6 +91,9 @@ class CatalogProvider:
         self.pricing = pricing or PricingProvider()
         self.unavailable = unavailable or UnavailableOfferings(clock=self._clock)
         self.overhead = overhead or OverheadOptions()
+        from .reservations import ReservationStore
+
+        self.reservations = ReservationStore()
         self.zones = tuple(zones)
         self._catalog_seq = 0
         self._tensor_cache = TTLCache(default_ttl=CacheTTL.INSTANCE_TYPES, clock=self._clock)
@@ -150,6 +153,7 @@ class CatalogProvider:
             self._catalog_seq,
             self.pricing.seq_num(),
             self.unavailable.seq_num(),
+            self.reservations.seq_num(),
             self.overhead.vm_memory_overhead_percent,
             self.overhead.max_pods,
         )
@@ -173,15 +177,15 @@ class CatalogProvider:
             T, Z = len(self._types), len(self.zones)
             zone_idx = {z: i for i, z in enumerate(self.zones)}
             C = np.zeros((T, NUM_RESOURCES), dtype=np.float32)
-            price = np.full((T, Z, 2), np.inf, dtype=np.float32)
-            avail = np.zeros((T, Z, 2), dtype=bool)
+            price = np.full((T, Z, lbl.NUM_CAPACITY_TYPES), np.inf, dtype=np.float32)
+            avail = np.zeros((T, Z, lbl.NUM_CAPACITY_TYPES), dtype=bool)
             for ti, it in enumerate(self._types):
                 C[ti] = self.allocatable(it).v
                 for o in it.offerings:
                     zi = zone_idx.get(o.zone)
                     if zi is None:
                         continue
-                    ci = 0 if o.capacity_type == lbl.CAPACITY_TYPE_ON_DEMAND else 1
+                    ci = lbl.CAPACITY_TYPES.index(o.capacity_type)
                     live = o.available and not self.unavailable.is_unavailable(
                         it.name, o.zone, o.capacity_type
                     )
@@ -193,6 +197,16 @@ class CatalogProvider:
                     )
                     price[ti, zi, ci] = p
                     avail[ti, zi, ci] = live
+                # Reserved offerings come from the resolved reservation
+                # store, not the type's own offering list: price 0 (already
+                # paid) while count remains, ICE mask still applies.
+                for zi, zone in enumerate(self.zones):
+                    if self.reservations.remaining(it.name, zone) > 0:
+                        ci = lbl.RESERVED_INDEX
+                        price[ti, zi, ci] = 0.0
+                        avail[ti, zi, ci] = not self.unavailable.is_unavailable(
+                            it.name, zone, lbl.CAPACITY_TYPE_RESERVED
+                        )
             return CatalogTensors(
                 names=tuple(t.name for t in self._types),
                 zones=self.zones,

@@ -1,0 +1,92 @@
+"""Capacity reservations: pre-paid, count-limited capacity pools.
+
+The reservation-aware analogue of on-demand capacity reservations (ODCR):
+a reservation pins (instance_type, zone) capacity the cluster has already
+paid for, so the solver should prefer it over spot/on-demand whenever
+compatible — modeled as a third capacity type ``reserved`` whose offering
+price is 0 (marginal cost of using what is already bought).
+
+The store is the catalog-side resolved snapshot (populated from the cloud
+by the nodeclass status controller, like subnets/security groups); the
+cloud keeps ground truth and rejects launches past a reservation's count
+with an ICE-classified error, which flows through the standard
+unavailable-offerings feedback loop (BASELINE config #5).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class Reservation:
+    id: str
+    instance_type: str
+    zone: str
+    count: int
+    used: int = 0       # instances currently drawing from the reservation
+
+    @property
+    def remaining(self) -> int:
+        return max(self.count - self.used, 0)
+
+
+class ReservationStore:
+    """Thread-safe resolved-reservation snapshot with in-flight accounting."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._by_id: dict[str, Reservation] = {}
+        self._seq = 0
+
+    def update(self, reservations) -> None:
+        """Swap in the resolved set (status-controller refresh path)."""
+        with self._lock:
+            self._by_id = {r.id: r for r in reservations}
+            self._seq += 1
+
+    def list(self) -> list[Reservation]:
+        with self._lock:
+            return list(self._by_id.values())
+
+    def get(self, rid: str) -> Optional[Reservation]:
+        with self._lock:
+            return self._by_id.get(rid)
+
+    def remaining(self, instance_type: str, zone: str) -> int:
+        with self._lock:
+            return sum(
+                r.remaining
+                for r in self._by_id.values()
+                if r.instance_type == instance_type and r.zone == zone
+            )
+
+    def consume(self, instance_type: str, zone: str) -> Optional[str]:
+        """In-flight decrement at launch commit; returns the reservation id
+        or None when exhausted (the launch must fall back / ICE)."""
+        with self._lock:
+            for r in self._by_id.values():
+                if r.instance_type == instance_type and r.zone == zone and r.remaining > 0:
+                    r.used += 1
+                    self._seq += 1
+                    return r.id
+            return None
+
+    def release(self, rid: str) -> None:
+        """Instance backed by the reservation terminated; capacity returns."""
+        with self._lock:
+            r = self._by_id.get(rid)
+            if r is not None and r.used > 0:
+                r.used -= 1
+                self._seq += 1
+
+    def seq_num(self) -> int:
+        with self._lock:
+            return self._seq
+
+    def flush(self) -> None:
+        with self._lock:
+            self._by_id.clear()
+            self._seq += 1

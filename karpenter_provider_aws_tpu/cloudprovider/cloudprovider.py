@@ -220,11 +220,20 @@ class CloudProvider:
             zone, captype = offer
             if it is None:
                 return 0.0
+            if captype == lbl.CAPACITY_TYPE_RESERVED:
+                # pre-paid: marginal cost 0 while count remains, else
+                # unusable (skipped below too)
+                has = self.catalog.reservations.remaining(it.name, zone) > 0
+                return 0.0 if has else float("inf")
             if captype == lbl.CAPACITY_TYPE_SPOT:
                 return self.catalog.pricing.spot_price(it, zone)
             return self.catalog.pricing.on_demand_price(it)
 
         for zone, captype in sorted(joint, key=price):
+            if captype == lbl.CAPACITY_TYPE_RESERVED and not any(
+                self.catalog.reservations.remaining(t, zone) > 0 for t in type_names
+            ):
+                continue
             if any(
                 not self.catalog.unavailable.is_unavailable(t, zone, captype)
                 for t in type_names
@@ -243,6 +252,11 @@ class CloudProvider:
         claim.labels.update(it.labels())
         claim.labels[lbl.TOPOLOGY_ZONE] = inst.zone
         claim.labels[lbl.CAPACITY_TYPE] = inst.capacity_type
+        reservation_id = getattr(inst, "capacity_reservation_id", "")
+        if reservation_id:
+            claim.labels[lbl.CAPACITY_RESERVATION_ID] = reservation_id
+            # keep the catalog's in-flight view fresh between status refreshes
+            self.catalog.reservations.consume(inst.instance_type, inst.zone)
         claim.labels[lbl.NODEPOOL] = claim.nodepool_name
         claim.annotations.update(nodeclass.hash_annotations())
         claim.created_at = self.clock.now()

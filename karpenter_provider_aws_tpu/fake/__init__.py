@@ -8,6 +8,7 @@ anywhere in the test pyramid below e2e.
 """
 
 from .cloud import (  # noqa: F401
+    CapacityReservation,
     FakeCloud,
     Image,
     Instance,

@@ -43,6 +43,24 @@ class SecurityGroup:
 
 
 @dataclass
+class CapacityReservation:
+    """A pre-paid (instance_type, zone) capacity pool with a hard count
+    (the cloud-side ground truth behind catalog/reservations.py)."""
+
+    id: str
+    instance_type: str
+    zone: str
+    count: int
+    used: int = 0
+    name: str = ""
+    tags: dict[str, str] = field(default_factory=dict)
+
+    @property
+    def remaining(self) -> int:
+        return max(self.count - self.used, 0)
+
+
+@dataclass
 class Image:
     id: str
     name: str
@@ -66,6 +84,7 @@ class Instance:
     state: str = "running"          # pending | running | shutting-down | terminated
     launch_time: float = 0.0
     tags: dict[str, str] = field(default_factory=dict)
+    capacity_reservation_id: str = ""  # set for reserved-captype launches
 
     @property
     def provider_id(self) -> str:
@@ -124,6 +143,8 @@ class FakeCloud:
         ]
         self.instances: dict[str, Instance] = {}
         self.instance_profiles: dict[str, dict] = {}
+        # id -> CapacityReservation (count-limited pre-paid pools)
+        self.capacity_reservations: dict[str, "CapacityReservation"] = {}
         self.launch_templates: dict[str, LaunchTemplateData] = {}
         # Fault injection
         self.ice_pools: set[tuple[str, str, str]] = set()   # (captype, type, zone)
@@ -148,6 +169,7 @@ class FakeCloud:
             self.launch_templates.clear()
             self.ice_pools.clear()
             self.capacity_pools.clear()
+            self.capacity_reservations.clear()
             self.next_errors.clear()
             self.calls.clear()
 
@@ -186,6 +208,20 @@ class FakeCloud:
                         last_ice = pool
                         continue
                     self.capacity_pools[pool] = remaining - 1
+                reservation_id = ""
+                if captype == "reserved":
+                    # hard count: a reserved launch must draw from a live
+                    # reservation, else the pool is effectively ICE
+                    res = next(
+                        (r for r in self.capacity_reservations.values()
+                         if r.instance_type == itype and r.zone == zone and r.remaining > 0),
+                        None,
+                    )
+                    if res is None:
+                        last_ice = pool
+                        continue
+                    res.used += 1
+                    reservation_id = res.id
                 inst = Instance(
                     id=f"i-{next(_ids):08x}",
                     instance_type=itype,
@@ -196,6 +232,7 @@ class FakeCloud:
                     security_group_ids=req.security_group_ids,
                     launch_time=self.clock.now(),
                     tags=dict(req.tags),
+                    capacity_reservation_id=reservation_id,
                 )
                 self.instances[inst.id] = inst
                 return inst
@@ -237,6 +274,10 @@ class FakeCloud:
                 if inst is None:
                     results.append(NotFoundError(f"instance {i} not found"))
                 else:
+                    if inst.state != "terminated" and inst.capacity_reservation_id:
+                        res = self.capacity_reservations.get(inst.capacity_reservation_id)
+                        if res is not None and res.used > 0:
+                            res.used -= 1
                     inst.state = "terminated"
                     results.append(inst)
             return results
@@ -266,6 +307,12 @@ class FakeCloud:
             self._record("describe_security_groups", None)
             self._maybe_fail()
             return list(self.security_groups)
+
+    def describe_capacity_reservations(self) -> list[CapacityReservation]:
+        with self._lock:
+            self._record("describe_capacity_reservations", None)
+            self._maybe_fail()
+            return list(self.capacity_reservations.values())
 
     def describe_images(self) -> list[Image]:
         with self._lock:

@@ -19,7 +19,11 @@ HOSTNAME = "kubernetes.io/hostname"
 
 CAPACITY_TYPE_SPOT = "spot"
 CAPACITY_TYPE_ON_DEMAND = "on-demand"
-CAPACITY_TYPES = (CAPACITY_TYPE_ON_DEMAND, CAPACITY_TYPE_SPOT)
+CAPACITY_TYPE_RESERVED = "reserved"  # capacity-reservation-backed (pre-paid)
+CAPACITY_TYPES = (CAPACITY_TYPE_ON_DEMAND, CAPACITY_TYPE_SPOT, CAPACITY_TYPE_RESERVED)
+NUM_CAPACITY_TYPES = len(CAPACITY_TYPES)
+RESERVED_INDEX = CAPACITY_TYPES.index(CAPACITY_TYPE_RESERVED)
+CAPACITY_RESERVATION_ID = f"{GROUP}/capacity-reservation-id"
 
 # Instance-property labels (reference: labels.go:87-98 — 19 instance labels).
 INSTANCE_HYPERVISOR = f"{GROUP}/instance-hypervisor"

@@ -122,6 +122,7 @@ class NodeClassStatus:
     subnets: list = field(default_factory=list)           # resolved Subnet objects
     security_groups: list = field(default_factory=list)   # resolved SecurityGroup objects
     images: list = field(default_factory=list)            # resolved Image objects
+    capacity_reservations: list = field(default_factory=list)  # resolved reservations
     instance_profile: str = ""
     conditions: dict[str, Condition] = field(default_factory=dict)
 
@@ -140,6 +141,9 @@ class NodeClass:
     image_selector: list[SelectorTerm] = field(default_factory=list)
     subnet_selector: list[SelectorTerm] = field(default_factory=list)
     security_group_selector: list[SelectorTerm] = field(default_factory=list)
+    # Capacity-reservation discovery (ODCR analogue): reservations matching
+    # any term become 'reserved' capacity-type offerings at price 0.
+    capacity_reservation_selector: list[SelectorTerm] = field(default_factory=list)
     role: str = ""
     instance_profile: str = ""  # mutually exclusive with role
     user_data: str = ""
@@ -155,7 +159,8 @@ class NodeClass:
     # Fields excluded from the static drift hash because they are resolved
     # dynamically (parity: hash tags on ec2nodeclass.go spec fields).
     _HASH_EXCLUDE = ("status", "finalizers", "deleted", "image_selector",
-                     "subnet_selector", "security_group_selector")
+                     "subnet_selector", "security_group_selector",
+                     "capacity_reservation_selector")
 
     def hash(self) -> str:
         """Static drift hash over immutable spec fields

@@ -322,8 +322,8 @@ def encode_problem(
     compat = np.zeros((max(G, 1), T), dtype=bool)
     price = np.full((max(G, 1), T), np.inf, dtype=np.float32)
     zone_allowed = np.zeros((max(G, 1), Z), dtype=bool)
-    captype_allowed = np.zeros((max(G, 1), 2), dtype=bool)
-    group_window = np.zeros((max(G, 1), Z, 2), dtype=bool)
+    captype_allowed = np.zeros((max(G, 1), lbl.NUM_CAPACITY_TYPES), dtype=bool)
+    group_window = np.zeros((max(G, 1), Z, lbl.NUM_CAPACITY_TYPES), dtype=bool)
     max_per_node = np.full(max(G, 1), 1 << 30, dtype=np.int32)
 
     # Cache key: catalog seqnum + names — a refresh() bumps the seq even when

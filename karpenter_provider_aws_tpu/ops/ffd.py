@@ -180,7 +180,7 @@ def ffd_solve(
     multiple scans) while node state stays device-resident.
     """
     G, R = requests.shape
-    Z = group_window.shape[1]
+    Z, C = group_window.shape[1], group_window.shape[2]
     if max_per_node is None:
         max_per_node = jnp.full(G, 1 << 30, dtype=jnp.int32)
     if init_state is None:
@@ -189,7 +189,7 @@ def ffd_solve(
             node_price=jnp.zeros(max_nodes, dtype=jnp.float32),
             used=jnp.zeros((max_nodes, R), dtype=jnp.float32),
             node_cap=jnp.zeros((max_nodes, R), dtype=jnp.float32),
-            node_window=jnp.zeros((max_nodes, Z, 2), dtype=bool),
+            node_window=jnp.zeros((max_nodes, Z, C), dtype=bool),
             n_open=jnp.asarray(0, dtype=jnp.int32),
         )
 

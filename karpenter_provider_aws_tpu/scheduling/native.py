@@ -103,7 +103,8 @@ class NativeSolver:
             return [], {}
         T, R = problem.capacity.shape
         Z = problem.group_window.shape[1]
-        W = Z * 2
+        C = problem.group_window.shape[2]
+        W = Z * C
         num_pods = int(problem.counts[:G].sum())
         N = self.max_nodes or max(num_pods, 1)
 
@@ -141,9 +142,9 @@ class NativeSolver:
         specs = _decode_nodes(
             problem, node_type, node_price, used, n_open, placed,
             problem.nodepool.name if problem.nodepool else "",
-            node_window.reshape(N, Z, 2).astype(bool),
+            node_window.reshape(N, Z, C).astype(bool),
         )
         return specs, {g: int(c) for g, c in enumerate(unplaced) if c > 0}
 
-    def solve(self, pods, nodepools, catalog, in_use=None, occupancy=None):
-        return _solve_multi_nodepool(self, pods, nodepools, catalog, in_use, occupancy)
+    def solve(self, pods, nodepools, catalog, in_use=None, occupancy=None, type_allow=None):
+        return _solve_multi_nodepool(self, pods, nodepools, catalog, in_use, occupancy, type_allow)

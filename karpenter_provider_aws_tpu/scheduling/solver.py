@@ -249,7 +249,7 @@ class HostSolver:
         node_type = np.zeros(N, dtype=np.int32)
         node_price = np.zeros(N, dtype=np.float32)
         used = np.zeros((N, problem.capacity.shape[1]), dtype=np.float32)
-        node_window = np.zeros((N, Z, 2), dtype=bool)
+        node_window = np.zeros((N, Z, problem.group_window.shape[2]), dtype=bool)
         for n, node in enumerate(nodes):
             node_type[n] = node.type_index
             node_price[n] = node.price

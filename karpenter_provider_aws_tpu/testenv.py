@@ -63,6 +63,7 @@ class Environment:
         self.queue.reset()
         self.cluster.__init__(clock=self.clock)
         self.catalog.unavailable.flush()
+        self.catalog.reservations.flush()
         self.cloudprovider.reset_caches()
         self.provisioning.nominations.clear()
         self.provisioning.last_unschedulable.clear()

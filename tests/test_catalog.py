@@ -101,9 +101,11 @@ class TestOfferings:
         T, Z = len(catalog), len(catalog.zones)
         from karpenter_provider_aws_tpu.models.resources import NUM_RESOURCES
 
+        from karpenter_provider_aws_tpu.models import labels as lbl
+
         assert t.capacity.shape == (T, NUM_RESOURCES)
-        assert t.price.shape == (T, Z, 2)
-        assert t.available.shape == (T, Z, 2)
+        assert t.price.shape == (T, Z, lbl.NUM_CAPACITY_TYPES)
+        assert t.available.shape == (T, Z, lbl.NUM_CAPACITY_TYPES)
         assert t.available.any()
 
     def test_spot_cheaper_than_od(self, catalog):

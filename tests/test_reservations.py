@@ -1,0 +1,139 @@
+"""Capacity-reservation-aware packing (BASELINE config #5): reserved
+offerings are preferred at price 0, hard counts spill to spot/on-demand
+through the ICE feedback loop, and termination returns capacity."""
+
+import pytest
+
+from karpenter_provider_aws_tpu.fake import CapacityReservation
+from karpenter_provider_aws_tpu.models import Disruption, NodePool, Operator, Requirement
+from karpenter_provider_aws_tpu.models import labels as lbl
+from karpenter_provider_aws_tpu.models.nodeclass import SelectorTerm
+from karpenter_provider_aws_tpu.models.pod import make_pods
+from karpenter_provider_aws_tpu.testenv import new_environment
+
+
+@pytest.fixture(scope="module")
+def env():
+    return new_environment(use_tpu_solver=False)
+
+
+@pytest.fixture(autouse=True)
+def _reset(env):
+    env.reset()
+    yield
+
+
+def setup_reserved(env, count=3, itype="m5.4xlarge", zone="zone-a"):
+    env.cloud.capacity_reservations["cr-1"] = CapacityReservation(
+        id="cr-1", instance_type=itype, zone=zone, count=count,
+        tags={"team": "ml"},
+    )
+    _, nodeclass = env.apply_defaults(
+        NodePool(
+            name="default",
+            requirements=[Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m", "r"))],
+            disruption=Disruption(consolidate_after_s=None),
+        )
+    )
+    nodeclass.capacity_reservation_selector = [SelectorTerm.of(team="ml")]
+    env.nodeclass_status.reconcile()
+    return nodeclass
+
+
+class TestResolution:
+    def test_selector_resolves_into_status_and_store(self, env):
+        setup_reserved(env)
+        nc = env.cluster.nodeclasses["default"]
+        assert [r.id for r in nc.status.capacity_reservations] == ["cr-1"]
+        assert env.catalog.reservations.remaining("m5.4xlarge", "zone-a") == 3
+
+    def test_no_selector_no_reservations(self, env):
+        env.cloud.capacity_reservations["cr-1"] = CapacityReservation(
+            id="cr-1", instance_type="m5.4xlarge", zone="zone-a", count=3
+        )
+        env.apply_defaults()
+        nc = env.cluster.nodeclasses["default"]
+        assert nc.status.capacity_reservations == []
+        assert env.catalog.reservations.remaining("m5.4xlarge", "zone-a") == 0
+
+    def test_tensors_expose_reserved_at_price_zero(self, env):
+        setup_reserved(env)
+        t = env.catalog.tensors()
+        i = env.catalog.names().index("m5.4xlarge")
+        zi = env.catalog.zones.index("zone-a")
+        assert t.available[i, zi, lbl.RESERVED_INDEX]
+        assert t.price[i, zi, lbl.RESERVED_INDEX] == 0.0
+        # no other type/zone advertises reserved
+        assert t.available[:, :, lbl.RESERVED_INDEX].sum() == 1
+
+
+class TestPacking:
+    def test_solver_prefers_reserved_capacity(self, env):
+        setup_reserved(env, count=3)
+        pods = make_pods(8, "w", {"cpu": "2", "memory": "4Gi"})
+        for p in pods:
+            env.cluster.apply(p)
+        env.step(4)
+        assert not env.cluster.pending_pods()
+        reserved = [
+            c for c in env.cluster.nodeclaims.values()
+            if c.labels.get(lbl.CAPACITY_TYPE) == "reserved"
+        ]
+        assert reserved, "no claim landed on the reservation"
+        for c in reserved:
+            assert c.labels[lbl.CAPACITY_RESERVATION_ID] == "cr-1"
+            assert c.labels[lbl.INSTANCE_TYPE_LABEL] == "m5.4xlarge"
+            assert c.labels[lbl.TOPOLOGY_ZONE] == "zone-a"
+
+    def test_hard_count_spills_to_market_capacity(self, env):
+        setup_reserved(env, count=2)
+        pods = make_pods(40, "w", {"cpu": "4", "memory": "8Gi"})
+        for p in pods:
+            env.cluster.apply(p)
+        for _ in range(8):
+            env.step(1)
+            if not env.cluster.pending_pods():
+                break
+        assert not env.cluster.pending_pods()
+        by_captype: dict[str, int] = {}
+        for c in env.cluster.nodeclaims.values():
+            ct = c.labels.get(lbl.CAPACITY_TYPE)
+            by_captype[ct] = by_captype.get(ct, 0) + 1
+        assert by_captype.get("reserved", 0) <= 2
+        assert sum(v for k, v in by_captype.items() if k != "reserved") > 0
+        # the cloud never over-commits the reservation
+        assert env.cloud.capacity_reservations["cr-1"].used <= 2
+
+    def test_termination_returns_reserved_capacity(self, env):
+        setup_reserved(env, count=1)
+        pods = make_pods(2, "w", {"cpu": "2", "memory": "4Gi"})
+        for p in pods:
+            env.cluster.apply(p)
+        env.step(4)
+        res = env.cloud.capacity_reservations["cr-1"]
+        assert res.used == 1
+        victim = next(
+            c for c in env.cluster.nodeclaims.values()
+            if c.labels.get(lbl.CAPACITY_TYPE) == "reserved"
+        )
+        env.cluster.delete(victim)
+        env.step(2)
+        assert res.used == 0
+        # status refresh republishes the freed capacity to the catalog
+        env.nodeclass_status.reconcile()
+        assert env.catalog.reservations.remaining("m5.4xlarge", "zone-a") == 1
+
+    def test_pool_can_exclude_reserved(self, env):
+        setup_reserved(env)
+        pool = env.cluster.nodepools["default"]
+        pool.requirements.append(
+            Requirement(lbl.CAPACITY_TYPE, Operator.IN, ("on-demand", "spot"))
+        )
+        for p in make_pods(3, "w", {"cpu": "2", "memory": "4Gi"}):
+            env.cluster.apply(p)
+        env.step(4)
+        assert not env.cluster.pending_pods()
+        assert all(
+            c.labels.get(lbl.CAPACITY_TYPE) != "reserved"
+            for c in env.cluster.nodeclaims.values()
+        )

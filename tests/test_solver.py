@@ -87,7 +87,7 @@ class TestEncode:
         )
         pods = make_pods(1, "p", {"cpu": "1"})
         p = encode_problem(pods, catalog, od_pool)
-        assert p.group_captype_allowed[0].tolist() == [True, False]
+        assert p.group_captype_allowed[0].tolist() == [True, False, False]
         # price must equal the on-demand price, not the cheaper spot price
         t0 = int(np.nonzero(p.compat[0])[0][0])
         it = catalog.get(p.type_names[t0])
