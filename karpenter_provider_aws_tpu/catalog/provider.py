@@ -8,7 +8,7 @@ capacity-types with the ICE mask (instancetype.go:252-293).
 
 TPU-first addition: the provider also exports the problem *tensors* —
 allocatable capacity matrix ``C[T, R]``, offering price/availability arrays
-``price[T, Z, 2]`` / ``avail[T, Z, 2]`` — which are what actually ship to
+``price[T, Z, C]`` / ``avail[T, Z, C]`` (C = NUM_CAPACITY_TYPES) — which are what actually ship to
 the device (SURVEY.md section 7.1-7.2).
 """
 
@@ -179,12 +179,18 @@ class CatalogProvider:
             C = np.zeros((T, NUM_RESOURCES), dtype=np.float32)
             price = np.full((T, Z, lbl.NUM_CAPACITY_TYPES), np.inf, dtype=np.float32)
             avail = np.zeros((T, Z, lbl.NUM_CAPACITY_TYPES), dtype=bool)
+            reserved_remaining: dict[tuple[str, str], int] = {}
+            for r in self.reservations.list():
+                k = (r.instance_type, r.zone)
+                reserved_remaining[k] = reserved_remaining.get(k, 0) + r.remaining
             for ti, it in enumerate(self._types):
                 C[ti] = self.allocatable(it).v
                 for o in it.offerings:
                     zi = zone_idx.get(o.zone)
                     if zi is None:
                         continue
+                    if o.capacity_type not in lbl.CAPACITY_TYPES:
+                        continue  # unknown market (future data): degrade, don't crash
                     ci = lbl.CAPACITY_TYPES.index(o.capacity_type)
                     live = o.available and not self.unavailable.is_unavailable(
                         it.name, o.zone, o.capacity_type
@@ -201,7 +207,7 @@ class CatalogProvider:
                 # store, not the type's own offering list: price 0 (already
                 # paid) while count remains, ICE mask still applies.
                 for zi, zone in enumerate(self.zones):
-                    if self.reservations.remaining(it.name, zone) > 0:
+                    if reserved_remaining.get((it.name, zone), 0) > 0:
                         ci = lbl.RESERVED_INDEX
                         price[ti, zi, ci] = 0.0
                         avail[ti, zi, ci] = not self.unavailable.is_unavailable(
@@ -220,8 +226,9 @@ class CatalogProvider:
 @dataclass(frozen=True)
 class CatalogTensors:
     """The device-facing catalog snapshot. ``capacity[T, R]`` is allocatable
-    (overhead already subtracted); ``price``/``available`` are [T, Z, 2] with
-    capacity-type axis (0=on-demand, 1=spot) and ICE already masked."""
+    (overhead already subtracted); ``price``/``available`` are
+    [T, Z, NUM_CAPACITY_TYPES] with capacity-type axis (0=on-demand, 1=spot,
+    2=reserved) and ICE already masked."""
 
     names: tuple[str, ...]
     zones: tuple[str, ...]

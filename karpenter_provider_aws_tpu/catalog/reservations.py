@@ -74,6 +74,19 @@ class ReservationStore:
                     return r.id
             return None
 
+    def consume_id(self, rid: str) -> bool:
+        """In-flight decrement of the specific reservation the cloud drew
+        (the launch result's reservation id). Falls back to False when the
+        store hasn't discovered that id yet — the next status reconcile
+        syncs the true count."""
+        with self._lock:
+            r = self._by_id.get(rid)
+            if r is not None and r.remaining > 0:
+                r.used += 1
+                self._seq += 1
+                return True
+            return False
+
     def release(self, rid: str) -> None:
         """Instance backed by the reservation terminated; capacity returns."""
         with self._lock:

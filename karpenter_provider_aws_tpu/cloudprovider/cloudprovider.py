@@ -66,6 +66,9 @@ class CloudProvider:
         self.images = ImageProvider(cloud, clock=clock)
         self.instance_profiles = InstanceProfileProvider(cloud, clock=clock)
         self.launch_templates = LaunchTemplateProvider(cloud, self.cluster_info, clock=clock)
+        from ..providers.reservations import ReservationProvider
+
+        self.capacity_reservations = ReservationProvider(cloud, clock=clock)
         from ..utils.cache import CacheTTL, TTLCache
 
         self._launchable_cache = TTLCache(default_ttl=CacheTTL.DEFAULT, clock=clock)
@@ -255,8 +258,13 @@ class CloudProvider:
         reservation_id = getattr(inst, "capacity_reservation_id", "")
         if reservation_id:
             claim.labels[lbl.CAPACITY_RESERVATION_ID] = reservation_id
-            # keep the catalog's in-flight view fresh between status refreshes
-            self.catalog.reservations.consume(inst.instance_type, inst.zone)
+            # keep the catalog's in-flight view fresh between status
+            # refreshes — target the reservation the cloud actually drew
+            self.catalog.reservations.consume_id(reservation_id)
+            # a cached discovery snapshot now under-counts `used`: drop it so
+            # the next status reconcile re-describes instead of rolling the
+            # in-flight accounting back
+            self.capacity_reservations.reset()
         claim.labels[lbl.NODEPOOL] = claim.nodepool_name
         claim.annotations.update(nodeclass.hash_annotations())
         claim.created_at = self.clock.now()
@@ -270,6 +278,21 @@ class CloudProvider:
         if instance_id is None:
             raise errors.NotFoundError(f"claim {claim.name} has no provider id")
         self._terminate_batcher.add(instance_id)
+        # Return pre-paid capacity to the in-flight view immediately (the
+        # next status reconcile re-syncs true counts from the cloud). The
+        # label is popped so a retried delete can't double-release.
+        rid = claim.labels.pop(lbl.CAPACITY_RESERVATION_ID, None)
+        if rid:
+            self.catalog.reservations.release(rid)
+            self.capacity_reservations.reset()  # stale snapshot over-counts now
+
+    def pool_reserved_allowed(self, nodepool) -> bool:
+        """Reserved offerings in the shared catalog tensors are usable only
+        by pools whose nodeclass resolved capacity reservations; both the
+        provisioner and the consolidation replace path gate through this one
+        predicate so the two can never drift apart."""
+        nc = self.cluster.nodeclasses.get(nodepool.nodeclass_name)
+        return bool(nc is not None and getattr(nc.status, "capacity_reservations", None))
 
     def reset_caches(self) -> None:
         """Test-environment hook: drop every provider-side cache."""
@@ -278,6 +301,7 @@ class CloudProvider:
         self.images.reset()
         self.instance_profiles.reset()
         self.launch_templates.reset()
+        self.capacity_reservations.reset()
         self._launchable_cache.flush()
 
     def get(self, provider_id: str):

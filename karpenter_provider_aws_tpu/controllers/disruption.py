@@ -201,8 +201,13 @@ class DisruptionController:
         # repack target; the next reconcile re-evaluates from fresh state.
         if deleted_nodes:
             return
+        reserved_allow = {
+            name: self.cloudprovider.pool_reserved_allowed(pool)
+            for name, pool in pools.items()
+        }
         for ni, type_name, new_price, offering_options in cheaper_replacement(
-            ct, self.cloudprovider.catalog, nodepools=dict(pools)
+            ct, self.cloudprovider.catalog, nodepools=dict(pools),
+            reserved_allow=reserved_allow,
         ):
             if ni in deleted_nodes:
                 continue

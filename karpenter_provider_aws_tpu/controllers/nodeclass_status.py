@@ -22,14 +22,15 @@ class NodeClassStatusController:
         self.cloudprovider = cloudprovider
 
     def reconcile(self) -> None:
-        for nc in list(self.cluster.nodeclasses.values()):
-            if nc.deleted:
-                continue
+        live = [nc for nc in self.cluster.nodeclasses.values() if not nc.deleted]
+        # One cloud describe serves every nodeclass this pass (lazy: skipped
+        # entirely when no nodeclass selects reservations).
+        for nc in live:
             nc.finalizers.add(FINALIZER)
             nc.status.subnets = self.cloudprovider.subnets.list(nc)
             nc.status.security_groups = self.cloudprovider.security_groups.list(nc)
             nc.status.images = self.cloudprovider.images.list(nc)
-            self._resolve_reservations(nc)
+            nc.status.capacity_reservations = self.cloudprovider.capacity_reservations.list(nc)
             if nc.role or nc.instance_profile:
                 nc.status.instance_profile = self.cloudprovider.instance_profiles.create(nc)
 
@@ -49,33 +50,28 @@ class NodeClassStatusController:
                 )
             else:
                 nc.status.set_condition("Ready", True)
-
-    def _resolve_reservations(self, nc) -> None:
-        """Resolve capacityReservationSelector terms against the cloud and
-        publish the union across nodeclasses into the catalog store (the
-        tensors' 'reserved' offerings). No selector = no reservations."""
-        if not nc.capacity_reservation_selector:
-            nc.status.capacity_reservations = []
-        else:
-            all_res = self.cloudprovider.cloud.describe_capacity_reservations()
-            nc.status.capacity_reservations = [
-                r for r in all_res
-                if any(term.matches(r) for term in nc.capacity_reservation_selector)
-            ]
         self._publish_reservations()
 
     def _publish_reservations(self) -> None:
+        """Publish the cross-nodeclass union into the catalog store (the
+        tensors' 'reserved' offerings), once per reconcile. Deleted
+        nodeclasses are excluded — their stale status must not keep
+        advertising capacity nothing live selects."""
         from ..catalog.reservations import Reservation
 
         union: dict[str, Reservation] = {}
         for other in self.cluster.nodeclasses.values():
+            if other.deleted:
+                continue
             for r in getattr(other.status, "capacity_reservations", []):
                 union[r.id] = Reservation(
                     id=r.id, instance_type=r.instance_type, zone=r.zone,
                     count=r.count, used=r.used,
                 )
         store = self.cloudprovider.catalog.reservations
-        if {r.id: (r.count, r.used) for r in store.list()} != {
-            r.id: (r.count, r.used) for r in union.values()
-        }:
+
+        def fingerprint(rs):
+            return {r.id: (r.instance_type, r.zone, r.count, r.used) for r in rs}
+
+        if fingerprint(store.list()) != fingerprint(union.values()):
             store.update(union.values())

@@ -64,6 +64,10 @@ class ProvisioningController:
                 pool.name: self.cloudprovider.launchable_type_names(pool)
                 for pool in nodepools
             },
+            reserved_allow={
+                pool.name: self.cloudprovider.pool_reserved_allowed(pool)
+                for pool in nodepools
+            },
         )
         from ..metrics import SOLVE_DURATION, SOLVE_PODS
 

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import itertools
 import threading
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Optional
 
 from ..utils.clock import Clock, RealClock
@@ -312,7 +312,9 @@ class FakeCloud:
         with self._lock:
             self._record("describe_capacity_reservations", None)
             self._maybe_fail()
-            return list(self.capacity_reservations.values())
+            # snapshots, like a real describe call — callers caching these
+            # must not see later cloud-side mutations for free
+            return [replace(r) for r in self.capacity_reservations.values()]
 
     def describe_images(self) -> list[Image]:
         with self._lock:

@@ -129,7 +129,12 @@ def encode_cluster(cluster, catalog, gmax: int = GMAX_DEFAULT) -> Optional[Clust
             price[ni] = 0.0
             blocked[ni] = True
             continue
-        if node.capacity_type() == lbl.CAPACITY_TYPE_SPOT:
+        if node.capacity_type() == lbl.CAPACITY_TYPE_RESERVED:
+            # pre-paid: running cost 0, same as the reserved offering price —
+            # otherwise a reserved node looks replaceable by its own
+            # reservation (win_price 0 < on-demand) and churns forever
+            price[ni] = 0.0
+        elif node.capacity_type() == lbl.CAPACITY_TYPE_SPOT:
             price[ni] = catalog.pricing.spot_price(it, node.zone())
         else:
             price[ni] = catalog.pricing.on_demand_price(it)
@@ -259,7 +264,8 @@ def repack_set_feasible(
 
 
 def cheaper_replacement(
-    ct: ClusterTensors, catalog, nodepools: Optional[dict] = None, margin: float = 0.15
+    ct: ClusterTensors, catalog, nodepools: Optional[dict] = None, margin: float = 0.15,
+    reserved_allow: Optional[dict] = None,
 ) -> list:
     """[(node_index, type_name, new_price)] single-node replace candidates:
     all the node's pods fit one cheaper instance type (consolidation.md
@@ -298,7 +304,7 @@ def cheaper_replacement(
     # spec requirements only — template *labels* are stamped onto nodes, not
     # constraints the instance type must itself satisfy
     pool_masks: dict[str, np.ndarray] = {}
-    pool_windows: dict[str, np.ndarray] = {}  # [Z, 2] zone x captype allowance
+    pool_windows: dict[str, np.ndarray] = {}  # [Z, C] zone x captype allowance
     Z = len(tensors.zones)
     for name, pool in (nodepools or {}).items():
         reqs = Requirements(pool.requirements)
@@ -307,6 +313,10 @@ def cheaper_replacement(
         cvs = reqs.get(lbl.CAPACITY_TYPE)
         zrow = np.array([zvs.contains(z) for z in tensors.zones])
         crow = np.array([cvs.contains(ct_) for ct_ in lbl.CAPACITY_TYPES])
+        # reservation isolation: a pool whose nodeclass resolved no
+        # reservations may not replace onto another's pre-paid capacity
+        if reserved_allow is not None and not reserved_allow.get(name, False):
+            crow[lbl.RESERVED_INDEX] = False
         pool_windows[name] = zrow[:, None] & crow[None, :]
 
     def group_window(gi: int) -> np.ndarray:
@@ -340,6 +350,19 @@ def cheaper_replacement(
     N = len(ct.node_names)
     present = ct.group_counts > 0  # [N, GMAX]
     gw_cache: dict[int, np.ndarray] = {}
+    # Hard reserved counts, tracked across candidates within this pass: a
+    # single free reservation slot may justify at most ONE replacement —
+    # later candidates must price against market capacity or stay put.
+    res_left = np.zeros((T, Z), dtype=np.int64)
+    type_idx = {n: i for i, n in enumerate(tensors.names)}
+    zone_idx = {z: i for i, z in enumerate(tensors.zones)}
+    for r in catalog.reservations.list():
+        ti, zi = type_idx.get(r.instance_type), zone_idx.get(r.zone)
+        if ti is not None and zi is not None:
+            res_left[ti, zi] += r.remaining
+    fallback = np.ones((Z, lbl.NUM_CAPACITY_TYPES), dtype=bool)
+    if reserved_allow is not None:
+        fallback[:, lbl.RESERVED_INDEX] = False  # unknown pool: no reserved
     for i in range(N):
         if ct.blocked[i] or not present[i].any():
             continue
@@ -350,7 +373,7 @@ def cheaper_replacement(
             node_compat = node_compat & pool_mask
         # joint (zone, captype) window: pool allowance x every group on the
         # node — the replacement must be launchable where its pods may run
-        window = pool_windows.get(ct.nodepool_names[i], np.ones((Z, 2), dtype=bool)).copy()
+        window = pool_windows.get(ct.nodepool_names[i], fallback).copy()
         for g in gids:
             g = int(g)
             if g not in gw_cache:
@@ -358,18 +381,25 @@ def cheaper_replacement(
             window &= gw_cache[g]
         if not window.any():
             continue
-        # price per type restricted to the allowed, live offerings
+        # price per type restricted to the allowed, live offerings;
+        # reserved only where slots remain unclaimed this pass
         allowed = tensors.available & window[None, :, :]
+        allowed[:, :, lbl.RESERVED_INDEX] &= res_left > 0
         win_price = np.where(allowed, tensors.price, np.inf).min(axis=(1, 2))
         fits = (ct.used_total[i][None, :] <= tensors.capacity + 1e-4).all(axis=1)
         cheaper = win_price < ct.price[i] * (1.0 - margin) - 1e-9
         usable = node_compat & fits & cheaper & np.isfinite(win_price)
         if usable.any():
             t = int(np.where(usable, win_price, np.inf).argmin())
+            zi_win, ci_win = np.unravel_index(
+                np.argmin(np.where(allowed[t], tensors.price[t], np.inf)), (Z, lbl.NUM_CAPACITY_TYPES)
+            )
+            if ci_win == lbl.RESERVED_INDEX:
+                res_left[t, zi_win] -= 1  # this candidate claims the slot
             offering_options = [
                 (tensors.zones[zi], lbl.CAPACITY_TYPES[ci])
                 for zi in range(Z)
-                for ci in range(2)
+                for ci in range(lbl.NUM_CAPACITY_TYPES)
                 if allowed[t, zi, ci]
             ]
             out.append((i, tensors.names[t], float(win_price[t]), offering_options))

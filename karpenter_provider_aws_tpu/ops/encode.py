@@ -88,11 +88,11 @@ class EncodedProblem:
     # per-type live-offering window (ICE already masked). Joint — not two
     # marginal masks — so a (zone, captype) combination with no live offering
     # can never be advertised on a node.
-    group_window: np.ndarray = None           # [G, Z, 2] bool
-    type_window: np.ndarray = None            # [T, Z, 2] bool
+    group_window: np.ndarray = None           # [G, Z, C] bool (C = NUM_CAPACITY_TYPES)
+    type_window: np.ndarray = None            # [T, Z, C] bool
     # Marginal views kept for inspection/tests:
     group_zone_allowed: np.ndarray = None     # [G, Z] bool
-    group_captype_allowed: np.ndarray = None  # [G, 2] bool
+    group_captype_allowed: np.ndarray = None  # [G, C] bool
     # Hostname-topology cap: max replicas of the group on one node.
     max_per_node: np.ndarray = None           # [G] int32
     unencodable: list[tuple[Pod, str]] = field(default_factory=list)
@@ -171,6 +171,7 @@ def encode_problem(
     tensors: Optional[CatalogTensors] = None,
     occupancy: Optional[ZoneOccupancy] = None,
     allowed_types: Optional[set] = None,
+    allow_reserved: bool = True,
 ) -> EncodedProblem:
     """Build the dense solve tensors for one nodepool's candidate pods.
 
@@ -178,6 +179,11 @@ def encode_problem(
     incompatible requirements) land in ``unencodable`` with a reason, the
     analogue of the reference's per-pod filtering before Solve
     (cloudprovider.go:253-264 resolveInstanceTypes).
+
+    ``allow_reserved=False`` masks the reserved capacity type for every
+    group: reserved offerings in the shared catalog tensors belong to the
+    nodeclasses whose selector resolved them, and a pool whose nodeclass
+    selected none must not drain another's pre-paid capacity.
     """
     tensors = tensors if tensors is not None else catalog.tensors()
     types = catalog.list()
@@ -363,6 +369,8 @@ def encode_problem(
             pin[zone_pin] = True
             zone_allowed[gi] &= pin
         captype_allowed[gi] = [cvs.contains(ct) for ct in lbl.CAPACITY_TYPES]
+        if not allow_reserved:
+            captype_allowed[gi][lbl.RESERVED_INDEX] = False
         group_window[gi] = zone_allowed[gi][:, None] & captype_allowed[gi][None, :]
 
         # Static label compat, vectorized over T per requirement key.
@@ -386,7 +394,7 @@ def encode_problem(
             tensors.available
             & zone_allowed[gi][None, :, None]
             & captype_allowed[gi][None, None, :]
-        )  # [T, Z, 2]
+        )  # [T, Z, C]
         fits = (pod.requests.v[None, :] <= tensors.capacity + 1e-6).all(axis=1)
         row = static_ok & offer_ok.any(axis=(1, 2)) & fits
         compat[gi] = row

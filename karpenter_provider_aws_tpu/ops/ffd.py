@@ -41,7 +41,7 @@ class FFDResult(NamedTuple):
     node_price: jnp.ndarray   # [N] float32 $/hr committed at open
     used: jnp.ndarray         # [N, R] float32 resources packed onto each node
     node_cap: jnp.ndarray     # [N, R] float32 allocatable of committed type
-    node_window: jnp.ndarray  # [N, Z, 2] bool remaining (zone, captype) window
+    node_window: jnp.ndarray  # [N, Z, C] bool remaining (zone, captype) window
     n_open: jnp.ndarray       # [] int32 number of nodes opened
     placed: jnp.ndarray       # [G, N] int32 pods of group g placed on node n
     unplaced: jnp.ndarray     # [G] int32 pods that fit nowhere (or overflowed N)
@@ -168,8 +168,8 @@ def ffd_solve(
     compat: jnp.ndarray,       # [G, T] bool
     capacity: jnp.ndarray,     # [T, R] float32 allocatable
     price: jnp.ndarray,        # [G, T] float32, inf where unusable
-    group_window: jnp.ndarray, # [G, Z, 2] bool (zone, captype) the group allows
-    type_window: jnp.ndarray,  # [T, Z, 2] bool live offerings per type
+    group_window: jnp.ndarray, # [G, Z, C] bool (zone, captype) the group allows
+    type_window: jnp.ndarray,  # [T, Z, C] bool live offerings per type
     max_per_node: jnp.ndarray = None,  # [G] int32 hostname-topology cap
     max_nodes: int = 1024,
     init_state: _State | None = None,

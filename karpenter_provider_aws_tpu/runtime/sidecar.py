@@ -190,10 +190,12 @@ class RemoteSolver:
         )
         return decode_remote(problem, out)
 
-    def solve(self, pods, nodepools, catalog, in_use=None, occupancy=None):
+    def solve(self, pods, nodepools, catalog, in_use=None, occupancy=None, type_allow=None,
+              reserved_allow=None):
         from ..scheduling.solver import _solve_multi_nodepool
 
-        return _solve_multi_nodepool(self, pods, nodepools, catalog, in_use, occupancy)
+        return _solve_multi_nodepool(self, pods, nodepools, catalog, in_use, occupancy,
+                                     type_allow, reserved_allow)
 
 
 def serve(address: str = "127.0.0.1:50151") -> SolverServer:

@@ -146,5 +146,7 @@ class NativeSolver:
         )
         return specs, {g: int(c) for g, c in enumerate(unplaced) if c > 0}
 
-    def solve(self, pods, nodepools, catalog, in_use=None, occupancy=None, type_allow=None):
-        return _solve_multi_nodepool(self, pods, nodepools, catalog, in_use, occupancy, type_allow)
+    def solve(self, pods, nodepools, catalog, in_use=None, occupancy=None, type_allow=None,
+              reserved_allow=None):
+        return _solve_multi_nodepool(self, pods, nodepools, catalog, in_use, occupancy,
+                                     type_allow, reserved_allow)

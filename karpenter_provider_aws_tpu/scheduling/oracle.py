@@ -24,7 +24,7 @@ class OracleNode:
     price: float
     cap: np.ndarray
     used: np.ndarray
-    window: np.ndarray = None      # [Z, 2] bool remaining (zone, captype) window
+    window: np.ndarray = None      # [Z, C] bool remaining (zone, captype) window
     group_counts: dict[int, int] = field(default_factory=dict)
 
 

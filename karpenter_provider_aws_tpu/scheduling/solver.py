@@ -130,7 +130,7 @@ def _decode_nodes(
         # The solver narrowed each node's joint (zone, captype) window as
         # groups landed (intersected with the committed type's live
         # offerings), so every pair in it is directly launchable.
-        win = node_window[n]  # [Z, 2]
+        win = node_window[n]  # [Z, C]
         offering_options = [
             (z, ct)
             for zi, z in enumerate(problem.zones)
@@ -230,8 +230,10 @@ class TPUSolver:
         unplaced = {g: int(c) for g, c in enumerate(unplaced_arr) if c > 0}
         return specs, unplaced
 
-    def solve(self, pods, nodepools, catalog, in_use=None, occupancy=None, type_allow=None) -> SolveResult:
-        return _solve_multi_nodepool(self, pods, nodepools, catalog, in_use, occupancy, type_allow)
+    def solve(self, pods, nodepools, catalog, in_use=None, occupancy=None, type_allow=None,
+              reserved_allow=None) -> SolveResult:
+        return _solve_multi_nodepool(self, pods, nodepools, catalog, in_use, occupancy,
+                                     type_allow, reserved_allow)
 
 
 class HostSolver:
@@ -264,8 +266,10 @@ class HostSolver:
         )
         return specs, unplaced
 
-    def solve(self, pods, nodepools, catalog, in_use=None, occupancy=None, type_allow=None) -> SolveResult:
-        return _solve_multi_nodepool(self, pods, nodepools, catalog, in_use, occupancy, type_allow)
+    def solve(self, pods, nodepools, catalog, in_use=None, occupancy=None, type_allow=None,
+              reserved_allow=None) -> SolveResult:
+        return _solve_multi_nodepool(self, pods, nodepools, catalog, in_use, occupancy,
+                                     type_allow, reserved_allow)
 
 
 def _enforce_pool_constraints(
@@ -322,7 +326,8 @@ def _enforce_pool_constraints(
 
 
 def _solve_multi_nodepool(
-    impl, pods, nodepools, catalog, in_use=None, occupancy=None, type_allow=None
+    impl, pods, nodepools, catalog, in_use=None, occupancy=None, type_allow=None,
+    reserved_allow=None,
 ) -> SolveResult:
     t0 = time.perf_counter()
     result = SolveResult(num_pods=len(pods))
@@ -333,8 +338,11 @@ def _solve_multi_nodepool(
         if not remaining:
             break
         allowed = type_allow.get(pool.name) if type_allow else None
+        # reserved_allow: per-pool gate on the pre-paid capacity type; pools
+        # absent from an explicit map get no reserved access (isolation).
+        allow_res = reserved_allow.get(pool.name, False) if reserved_allow is not None else True
         problem = encode_problem(remaining, catalog, nodepool=pool, occupancy=occupancy,
-                                 allowed_types=allowed)
+                                 allowed_types=allowed, allow_reserved=allow_res)
         for pod, why in problem.unencodable:
             reasons[pod.uid] = f"nodepool {pool.name}: {why}"
         specs, unplaced = impl.solve_encoded(problem)

@@ -112,6 +112,9 @@ class TestPacking:
         env.step(4)
         res = env.cloud.capacity_reservations["cr-1"]
         assert res.used == 1
+        # retire the workload first so nothing re-provisions into the slot
+        for p in pods:
+            env.cluster.delete(p)
         victim = next(
             c for c in env.cluster.nodeclaims.values()
             if c.labels.get(lbl.CAPACITY_TYPE) == "reserved"
@@ -119,9 +122,27 @@ class TestPacking:
         env.cluster.delete(victim)
         env.step(2)
         assert res.used == 0
-        # status refresh republishes the freed capacity to the catalog
-        env.nodeclass_status.reconcile()
+        # the release is synchronous with the delete — no reconcile needed
         assert env.catalog.reservations.remaining("m5.4xlarge", "zone-a") == 1
+
+    def test_drained_pods_reclaim_freed_reservation(self, env):
+        """Deleting a reserved node releases the slot immediately, so its
+        evicted pods re-land on the reservation instead of spilling to
+        market capacity while the release lags a reconcile."""
+        setup_reserved(env, count=1)
+        for p in make_pods(2, "w", {"cpu": "2", "memory": "4Gi"}):
+            env.cluster.apply(p)
+        env.step(4)
+        victim = next(
+            c for c in env.cluster.nodeclaims.values()
+            if c.labels.get(lbl.CAPACITY_TYPE) == "reserved"
+        )
+        env.cluster.delete(victim)
+        env.step(3)
+        assert not env.cluster.pending_pods()
+        live = [c for c in env.cluster.nodeclaims.values() if not c.deleted]
+        assert any(c.labels.get(lbl.CAPACITY_TYPE) == "reserved" for c in live)
+        assert env.cloud.capacity_reservations["cr-1"].used == 1
 
     def test_pool_can_exclude_reserved(self, env):
         setup_reserved(env)
@@ -137,3 +158,141 @@ class TestPacking:
             c.labels.get(lbl.CAPACITY_TYPE) != "reserved"
             for c in env.cluster.nodeclaims.values()
         )
+
+
+class TestIsolationAndChurn:
+    def test_pool_without_selector_cannot_use_reservation(self, env):
+        """A second nodepool whose nodeclass selected no reservations must
+        not drain another nodeclass's pre-paid capacity."""
+        from karpenter_provider_aws_tpu.models.nodeclass import NodeClass
+
+        setup_reserved(env, count=3)
+        other_nc = NodeClass(name="other", role="node-role")
+        other_pool = NodePool(
+            name="other",
+            nodeclass_name="other",
+            weight=100,  # wins pool ordering: pods try it first
+            requirements=[Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m", "r"))],
+        )
+        env.cluster.apply(other_nc)
+        env.cluster.apply(other_pool)
+        env.nodeclass_status.reconcile()
+        for p in make_pods(4, "w", {"cpu": "2", "memory": "4Gi"}):
+            env.cluster.apply(p)
+        env.step(4)
+        assert not env.cluster.pending_pods()
+        for c in env.cluster.nodeclaims.values():
+            if c.nodepool_name == "other":
+                assert c.labels.get(lbl.CAPACITY_TYPE) != "reserved"
+        assert env.cloud.capacity_reservations["cr-1"].used == 0
+
+    def test_reserved_node_not_churned_by_consolidation(self, env):
+        """A node running on reserved capacity prices at 0 in the
+        consolidation snapshot — its own reservation must not look like a
+        cheaper replacement (perpetual churn)."""
+        from karpenter_provider_aws_tpu.ops.consolidate import cheaper_replacement, encode_cluster
+
+        setup_reserved(env, count=2)
+        for p in make_pods(2, "w", {"cpu": "2", "memory": "4Gi"}):
+            env.cluster.apply(p)
+        env.step(4)
+        assert any(
+            c.labels.get(lbl.CAPACITY_TYPE) == "reserved"
+            for c in env.cluster.nodeclaims.values()
+        )
+        ct = encode_cluster(env.cluster, env.catalog)
+        reserved_idx = [
+            i for i, name in enumerate(ct.node_names)
+            if env.cluster.nodes[name].capacity_type() == "reserved"
+        ]
+        assert reserved_idx
+        for i in reserved_idx:
+            assert ct.price[i] == 0.0
+        out = cheaper_replacement(
+            ct, env.catalog, nodepools=dict(env.cluster.nodepools),
+            reserved_allow={"default": True},
+        )
+        assert not any(i in reserved_idx for i, _, _, _ in out)
+
+    def test_delete_releases_reservation_immediately(self, env):
+        """CloudProvider.delete returns the pre-paid slot to the in-flight
+        store without waiting for the next status reconcile."""
+        setup_reserved(env, count=1)
+        for p in make_pods(1, "w", {"cpu": "2", "memory": "4Gi"}):
+            env.cluster.apply(p)
+        env.step(4)
+        assert env.catalog.reservations.remaining("m5.4xlarge", "zone-a") == 0
+        victim = next(
+            c for c in env.cluster.nodeclaims.values()
+            if c.labels.get(lbl.CAPACITY_TYPE) == "reserved"
+        )
+        env.cloudprovider.delete(victim)
+        # no reconcile: the release is synchronous with the delete
+        assert env.catalog.reservations.remaining("m5.4xlarge", "zone-a") == 1
+        # a retried delete must not double-release
+        try:
+            env.cloudprovider.delete(victim)
+        except Exception:
+            pass
+        assert env.catalog.reservations.remaining("m5.4xlarge", "zone-a") == 1
+
+    def test_deleted_nodeclass_stops_advertising(self, env):
+        setup_reserved(env, count=3)
+        assert env.catalog.reservations.remaining("m5.4xlarge", "zone-a") == 3
+        env.cluster.nodeclasses["default"].deleted = True
+        env.nodeclass_status.reconcile()
+        assert env.catalog.reservations.remaining("m5.4xlarge", "zone-a") == 0
+
+    def test_zone_change_republishes(self, env):
+        setup_reserved(env, count=3, zone="zone-a")
+        assert env.catalog.reservations.remaining("m5.4xlarge", "zone-a") == 3
+        env.cloud.capacity_reservations["cr-1"].zone = "zone-b"
+        env.cloudprovider.capacity_reservations.reset()  # expire discovery TTL
+        env.nodeclass_status.reconcile()
+        assert env.catalog.reservations.remaining("m5.4xlarge", "zone-a") == 0
+        assert env.catalog.reservations.remaining("m5.4xlarge", "zone-b") == 3
+
+    def test_one_reserved_slot_justifies_at_most_one_replacement(self, env):
+        """cheaper_replacement must track remaining reservation counts
+        across candidates in one pass: a single free slot cannot price
+        multiple replacements at 0."""
+        from karpenter_provider_aws_tpu.ops.consolidate import cheaper_replacement, encode_cluster
+
+        nodeclass = env.apply_defaults(
+            NodePool(
+                name="default",
+                requirements=[
+                    Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m", "r")),
+                    # cap node size at 16 vcpus so the two 10-cpu pods cannot
+                    # share one big bin -> exactly 2 market-capacity nodes
+                    Requirement(lbl.INSTANCE_CPU, Operator.LT, ("17",)),
+                ],
+                disruption=Disruption(consolidate_after_s=None),
+            )
+        )[1]
+        for p in make_pods(2, "w", {"cpu": "10", "memory": "20Gi"}):
+            env.cluster.apply(p)
+        env.step(4)
+        assert not env.cluster.pending_pods()
+        assert not any(
+            c.labels.get(lbl.CAPACITY_TYPE) == "reserved"
+            for c in env.cluster.nodeclaims.values()
+        )
+        # the reservation appears only after both nodes are running
+        env.cloud.capacity_reservations["cr-1"] = CapacityReservation(
+            id="cr-1", instance_type="m5.4xlarge", zone="zone-a", count=1,
+            tags={"team": "ml"},
+        )
+        nodeclass.capacity_reservation_selector = [SelectorTerm.of(team="ml")]
+        env.cloudprovider.capacity_reservations.reset()
+        env.nodeclass_status.reconcile()
+        assert env.catalog.reservations.remaining("m5.4xlarge", "zone-a") == 1
+        ct = encode_cluster(env.cluster, env.catalog)
+        assert ct is not None and len(ct.node_names) >= 2
+        out = cheaper_replacement(
+            ct, env.catalog, nodepools=dict(env.cluster.nodepools),
+            reserved_allow={"default": True},
+        )
+        zero_priced = [o for o in out if o[2] == 0.0]
+        assert zero_priced, "the free slot should justify one replacement"
+        assert len(zero_priced) == 1, "one slot justified multiple free replacements"

@@ -210,3 +210,57 @@ class TestLaunchTemplateReview:
         parsed = tomllib.loads(script)  # must be valid TOML
         assert parsed["settings"]["kernel"]["sysctl-flags"] == [True, False]
         assert parsed["settings"]["kernel"]["names"] == ["a'b", "c"]
+
+
+class TestConsolidateCapacityAxis:
+    """The (zone x captype) windows in cheaper_replacement must track
+    NUM_CAPACITY_TYPES (regression: hardcoded 2 after the reserved axis
+    landed — crash on missing pool, reserved excluded from offerings)."""
+
+    def _provisioned_env(self):
+        from karpenter_provider_aws_tpu.models import Disruption, Operator, Requirement
+        from karpenter_provider_aws_tpu.testenv import new_environment
+
+        env = new_environment(use_tpu_solver=False)
+        env.apply_defaults(
+            NodePool(
+                name="default",
+                requirements=[Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m", "r"))],
+                disruption=Disruption(consolidate_after_s=None),
+            )
+        )
+        for p in make_pods(3, "w", {"cpu": "1", "memory": "2Gi"}):
+            env.cluster.apply(p)
+        env.step(3)
+        assert not env.cluster.pending_pods()
+        return env
+
+    def test_missing_nodepool_window_falls_back_without_crash(self):
+        from karpenter_provider_aws_tpu.ops.consolidate import cheaper_replacement, encode_cluster
+
+        env = self._provisioned_env()
+        ct = encode_cluster(env.cluster, env.catalog)
+        assert ct is not None
+        # nodepools={} -> every node takes the all-ones fallback window,
+        # which must broadcast against [Z, NUM_CAPACITY_TYPES] group windows
+        cheaper_replacement(ct, env.catalog, nodepools={})
+
+    def test_reserved_offering_listed_in_replacement_options(self):
+        from karpenter_provider_aws_tpu.catalog.reservations import Reservation
+        from karpenter_provider_aws_tpu.ops.consolidate import cheaper_replacement, encode_cluster
+
+        env = self._provisioned_env()
+        node = next(iter(env.cluster.nodes.values()))
+        itype, zone = node.instance_type(), node.zone()
+        env.catalog.reservations.update(
+            [Reservation(id="cr-r", instance_type=itype, zone=zone, count=5)]
+        )
+        ct = encode_cluster(env.cluster, env.catalog)
+        pools = {"default": env.cluster.nodepools["default"]}
+        out = cheaper_replacement(ct, env.catalog, nodepools=pools)
+        # reserved price 0 beats any market price: the node's own type becomes
+        # the winner and (zone, reserved) must be in the launchable options
+        assert out, "reserved offering should enable a cheaper replacement"
+        winners = {name: opts for _, name, _, opts in out}
+        assert itype in winners
+        assert (zone, lbl.CAPACITY_TYPE_RESERVED) in winners[itype]
