@@ -278,21 +278,40 @@ class CloudProvider:
         if instance_id is None:
             raise errors.NotFoundError(f"claim {claim.name} has no provider id")
         self._terminate_batcher.add(instance_id)
-        # Return pre-paid capacity to the in-flight view immediately (the
-        # next status reconcile re-syncs true counts from the cloud). The
-        # label is popped so a retried delete can't double-release.
-        rid = claim.labels.pop(lbl.CAPACITY_RESERVATION_ID, None)
+        # Return pre-paid capacity to the in-flight view — but only once the
+        # cloud confirms the instance is actually terminated. Releasing on
+        # the API call alone would advertise the slot while the instance is
+        # still shutting down; an immediate relaunch would then ICE and
+        # blacklist the reserved offering for the whole ICE TTL. If the
+        # instance is still draining, the status reconcile re-syncs counts
+        # from the cloud once it lands. The label is popped so a retried
+        # delete can't double-release.
+        rid = claim.labels.get(lbl.CAPACITY_RESERVATION_ID)
         if rid:
-            self.catalog.reservations.release(rid)
-            self.capacity_reservations.reset()  # stale snapshot over-counts now
+            try:
+                terminated = self.cloud.get_instance(instance_id).state == "terminated"
+            except Exception:
+                terminated = True  # instance already gone
+            if terminated:
+                claim.labels.pop(lbl.CAPACITY_RESERVATION_ID, None)
+                self.catalog.reservations.release(rid)
+                self.capacity_reservations.reset()  # stale snapshot over-counts now
 
-    def pool_reserved_allowed(self, nodepool) -> bool:
-        """Reserved offerings in the shared catalog tensors are usable only
-        by pools whose nodeclass resolved capacity reservations; both the
-        provisioner and the consolidation replace path gate through this one
-        predicate so the two can never drift apart."""
+    def pool_reserved_allowed(self, nodepool) -> "set[tuple[str, str]]":
+        """The (instance_type, zone) reserved offerings this pool may use:
+        exactly its own nodeclass's resolved reservations. Per-pair — not a
+        boolean — because the catalog tensors advertise every nodeclass's
+        reservations globally, and a pool holding reservation X must not
+        drain another nodeclass's reservation Y. Both the provisioner and
+        the consolidation replace path gate through this one predicate so
+        the two can never drift apart."""
         nc = self.cluster.nodeclasses.get(nodepool.nodeclass_name)
-        return bool(nc is not None and getattr(nc.status, "capacity_reservations", None))
+        if nc is None:
+            return set()
+        return {
+            (r.instance_type, r.zone)
+            for r in getattr(nc.status, "capacity_reservations", []) or []
+        }
 
     def reset_caches(self) -> None:
         """Test-environment hook: drop every provider-side cache."""

@@ -313,8 +313,9 @@ class FakeCloud:
             self._record("describe_capacity_reservations", None)
             self._maybe_fail()
             # snapshots, like a real describe call — callers caching these
-            # must not see later cloud-side mutations for free
-            return [replace(r) for r in self.capacity_reservations.values()]
+            # must not see later cloud-side mutations for free (tags too:
+            # selector terms match on them)
+            return [replace(r, tags=dict(r.tags)) for r in self.capacity_reservations.values()]
 
     def describe_images(self) -> list[Image]:
         with self._lock:

@@ -81,6 +81,8 @@ class Pod:
     node_name: str = ""  # bound node, empty = pending
     phase: str = "Pending"
     owner_key: str = ""  # ReplicaSet/Deployment identity for grouping
+    # lazily computed by scheduling_key(); excluded from comparisons
+    _scheduling_key: Optional[tuple] = field(default=None, repr=False, compare=False)
 
     def __post_init__(self):
         if not self.uid:
@@ -155,16 +157,24 @@ class Pod:
     def scheduling_key(self) -> tuple:
         """Pods with equal keys are interchangeable to the solver; the
         encoder collapses them into one group with a count (the TPU-native
-        replacement for the reference's per-pod loop — SURVEY.md section 7)."""
-        return (
-            self.requests.v.tobytes(),
-            tuple(sorted(self.node_selector.items())),
-            tuple(sorted((r.key, r.operator.value, r.values, r.min_values) for r in self.node_affinity)),
-            tuple(sorted((t.key, t.operator, t.value, t.effect) for t in self.tolerations)),
-            tuple(sorted(self.topology_spread, key=lambda c: c.topology_key)),
-            tuple(sorted(self.anti_affinity, key=lambda a: a.topology_key)),
-            tuple(sorted(self.affinity, key=lambda a: a.topology_key)),
-        )
+        replacement for the reference's per-pod loop — SURVEY.md section 7).
+
+        Cached after first computation (admission-time keying): the fields it
+        covers are fixed at pod creation in this model, and the encoder calls
+        this once per pod per solve — at 50k pods the recompute would be the
+        single biggest host-side cost in the hot path."""
+        k = self._scheduling_key
+        if k is None:
+            k = self._scheduling_key = (
+                self.requests.v.tobytes(),
+                tuple(sorted(self.node_selector.items())),
+                tuple(sorted((r.key, r.operator.value, r.values, r.min_values) for r in self.node_affinity)),
+                tuple(sorted((t.key, t.operator, t.value, t.effect) for t in self.tolerations)),
+                tuple(sorted(self.topology_spread, key=lambda c: c.topology_key)),
+                tuple(sorted(self.anti_affinity, key=lambda a: a.topology_key)),
+                tuple(sorted(self.affinity, key=lambda a: a.topology_key)),
+            )
+        return k
 
 
 def make_pods(
@@ -175,7 +185,13 @@ def make_pods(
 ) -> list[Pod]:
     """Convenience constructor for test/bench workloads."""
     rv = ResourceVector.from_map(requests)
-    return [
+    pods = [
         Pod(name=f"{name_prefix}-{i}", requests=rv.copy(), **{k: (v.copy() if hasattr(v, "copy") else v) for k, v in kwargs.items()})
         for i in range(count)
     ]
+    # Clones share one spec: stamp the dedup key once (admission-time keying)
+    if pods:
+        key = pods[0].scheduling_key()
+        for p in pods[1:]:
+            p._scheduling_key = key
+    return pods

@@ -313,10 +313,6 @@ def cheaper_replacement(
         cvs = reqs.get(lbl.CAPACITY_TYPE)
         zrow = np.array([zvs.contains(z) for z in tensors.zones])
         crow = np.array([cvs.contains(ct_) for ct_ in lbl.CAPACITY_TYPES])
-        # reservation isolation: a pool whose nodeclass resolved no
-        # reservations may not replace onto another's pre-paid capacity
-        if reserved_allow is not None and not reserved_allow.get(name, False):
-            crow[lbl.RESERVED_INDEX] = False
         pool_windows[name] = zrow[:, None] & crow[None, :]
 
     def group_window(gi: int) -> np.ndarray:
@@ -360,9 +356,24 @@ def cheaper_replacement(
         ti, zi = type_idx.get(r.instance_type), zone_idx.get(r.zone)
         if ti is not None and zi is not None:
             res_left[ti, zi] += r.remaining
-    fallback = np.ones((Z, lbl.NUM_CAPACITY_TYPES), dtype=bool)
+    # Reservation isolation, per (type, zone): a replacement may only land
+    # on the reserved pairs its own pool's nodeclass resolved. reserved_allow
+    # maps pool -> set of (instance_type, zone); None = no gating (legacy
+    # single-tenant callers); unknown pools get nothing.
+    pool_rmask: dict[str, np.ndarray] = {}
     if reserved_allow is not None:
-        fallback[:, lbl.RESERVED_INDEX] = False  # unknown pool: no reserved
+        for pname, pairs in reserved_allow.items():
+            m = np.zeros((T, Z), dtype=bool)
+            if pairs is True:
+                m[:] = True
+            elif pairs:
+                for tname, zname in pairs:
+                    ti, zi = type_idx.get(tname), zone_idx.get(zname)
+                    if ti is not None and zi is not None:
+                        m[ti, zi] = True
+            pool_rmask[pname] = m
+        no_access = np.zeros((T, Z), dtype=bool)
+    fallback = np.ones((Z, lbl.NUM_CAPACITY_TYPES), dtype=bool)
     for i in range(N):
         if ct.blocked[i] or not present[i].any():
             continue
@@ -382,9 +393,14 @@ def cheaper_replacement(
         if not window.any():
             continue
         # price per type restricted to the allowed, live offerings;
-        # reserved only where slots remain unclaimed this pass
+        # reserved only where slots remain unclaimed this pass AND the
+        # node's pool holds the reservation
         allowed = tensors.available & window[None, :, :]
         allowed[:, :, lbl.RESERVED_INDEX] &= res_left > 0
+        if reserved_allow is not None:
+            allowed[:, :, lbl.RESERVED_INDEX] &= pool_rmask.get(
+                ct.nodepool_names[i], no_access
+            )
         win_price = np.where(allowed, tensors.price, np.inf).min(axis=(1, 2))
         fits = (ct.used_total[i][None, :] <= tensors.capacity + 1e-4).all(axis=1)
         cheaper = win_price < ct.price[i] * (1.0 - margin) - 1e-9

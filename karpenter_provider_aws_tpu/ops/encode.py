@@ -95,6 +95,11 @@ class EncodedProblem:
     group_captype_allowed: np.ndarray = None  # [G, C] bool
     # Hostname-topology cap: max replicas of the group on one node.
     max_per_node: np.ndarray = None           # [G] int32
+    # Exotic types (bare metal): kept out of ranked launch alternatives when
+    # standard types qualify (parity: instance.go:456-477
+    # filterExoticInstanceTypes — metal only launches when requested or when
+    # nothing else fits).
+    type_exotic: np.ndarray = None            # [T] bool
     unencodable: list[tuple[Pod, str]] = field(default_factory=list)
 
     @property
@@ -171,7 +176,7 @@ def encode_problem(
     tensors: Optional[CatalogTensors] = None,
     occupancy: Optional[ZoneOccupancy] = None,
     allowed_types: Optional[set] = None,
-    allow_reserved: bool = True,
+    allow_reserved=True,
 ) -> EncodedProblem:
     """Build the dense solve tensors for one nodepool's candidate pods.
 
@@ -180,15 +185,33 @@ def encode_problem(
     analogue of the reference's per-pod filtering before Solve
     (cloudprovider.go:253-264 resolveInstanceTypes).
 
-    ``allow_reserved=False`` masks the reserved capacity type for every
-    group: reserved offerings in the shared catalog tensors belong to the
-    nodeclasses whose selector resolved them, and a pool whose nodeclass
-    selected none must not drain another's pre-paid capacity.
+    ``allow_reserved`` controls access to the shared catalog's reserved
+    offerings, which belong to the nodeclasses whose selectors resolved
+    them: ``True`` = all (single-tenant callers), ``False``/empty = none, or
+    a set of ``(instance_type, zone)`` pairs = exactly this pool's own
+    nodeclass reservations — pool A holding ANY reservation must not drain
+    pool B's pre-paid capacity for a different (type, zone).
     """
     tensors = tensors if tensors is not None else catalog.tensors()
     types = catalog.list()
     T = len(types)
     Z = len(tensors.zones)
+
+    # Per-problem offering availability: the reserved axis is masked down to
+    # the pairs this pool may use; price/compat/type_window all derive from
+    # this one array so the gate cannot be bypassed downstream.
+    available = tensors.available
+    if allow_reserved is not True:
+        available = available.copy()
+        rmask = np.zeros((T, Z), dtype=bool)
+        if allow_reserved:  # a set of (type, zone) pairs
+            tidx = {n: i for i, n in enumerate(tensors.names)}
+            zidx = {z: i for i, z in enumerate(tensors.zones)}
+            for tname, zname in allow_reserved:
+                ti, zi = tidx.get(tname), zidx.get(zname)
+                if ti is not None and zi is not None:
+                    rmask[ti, zi] = True
+        available[:, :, lbl.RESERVED_INDEX] &= rmask
 
     pool_reqs = nodepool.scheduling_requirements() if nodepool else Requirements()
     # startupTaints are exempt from toleration checks: they are expected to
@@ -196,21 +219,31 @@ def encode_problem(
     taints = list(nodepool.taints) if nodepool else []
 
     # -- group pods by scheduling key -------------------------------------
+    # Dedup FIRST, then filter once per group: pods with equal keys are
+    # interchangeable (scheduling_key covers requests, selectors, affinity,
+    # tolerations, topology), so taint/compat checks on 50k pods collapse to
+    # checks on ~dozens of groups — this is the per-pod loop the TPU design
+    # moves off the hot path (SURVEY.md section 7).
+    raw_groups: dict[tuple, list[Pod]] = {}
+    for pod in pods:
+        raw_groups.setdefault(pod.scheduling_key(), []).append(pod)
     groups: dict[tuple, list[Pod]] = {}
     unencodable: list[tuple[Pod, str]] = []
-    for pod in pods:
+    for key, plist in raw_groups.items():
+        pod = plist[0]
         if taints and not pod.tolerates_all(taints):
-            unencodable.append((pod, "does not tolerate nodepool taints"))
+            unencodable.extend((p, "does not tolerate nodepool taints") for p in plist)
             continue
-        if not pod.requirements().compatible(pool_reqs):
-            unencodable.append((pod, "incompatible with nodepool requirements"))
+        reqs = pod.requirements()
+        if not reqs.compatible(pool_reqs):
+            unencodable.extend((p, "incompatible with nodepool requirements") for p in plist)
             continue
         # A hostname pin names an *existing* node; provisioning a fresh node
         # can never satisfy it (new nodes get new hostnames).
-        if pod.requirements().get(lbl.HOSTNAME).finite_values() is not None:
-            unencodable.append((pod, "pinned to an existing node via hostname"))
+        if reqs.get(lbl.HOSTNAME).finite_values() is not None:
+            unencodable.extend((p, "pinned to an existing node via hostname") for p in plist)
             continue
-        groups.setdefault(pod.scheduling_key(), []).append(pod)
+        groups[key] = plist
 
     # -- topology expansion ------------------------------------------------
     # Zone-level constraints are resolved HOST-side by splitting a group into
@@ -222,7 +255,7 @@ def encode_problem(
     zone_names = list(tensors.zones)
     pool_zone_vs = pool_reqs.get(lbl.TOPOLOGY_ZONE)
 
-    live_zone_mask = tensors.available.any(axis=(0, 2))  # [Z] any live offering
+    live_zone_mask = available.any(axis=(0, 2))  # [Z] any live offering
     zone_index = {z: zi for zi, z in enumerate(zone_names)}
 
     # (pods, zone_pin, mpn, zone_mask) — zone_mask is an extra [Z] allowance
@@ -369,8 +402,8 @@ def encode_problem(
             pin[zone_pin] = True
             zone_allowed[gi] &= pin
         captype_allowed[gi] = [cvs.contains(ct) for ct in lbl.CAPACITY_TYPES]
-        if not allow_reserved:
-            captype_allowed[gi][lbl.RESERVED_INDEX] = False
+        # (reserved-offering access is enforced via the masked `available`
+        # array above — price, compat, and type_window all derive from it)
         group_window[gi] = zone_allowed[gi][:, None] & captype_allowed[gi][None, :]
 
         # Static label compat, vectorized over T per requirement key.
@@ -391,7 +424,7 @@ def encode_problem(
 
         # x offering availability x single-pod resource fit.
         offer_ok = (
-            tensors.available
+            available
             & zone_allowed[gi][None, :, None]
             & captype_allowed[gi][None, None, :]
         )  # [T, Z, C]
@@ -439,10 +472,11 @@ def encode_problem(
         zones=tensors.zones,
         nodepool=nodepool,
         group_window=group_window,
-        type_window=tensors.available.copy(),
+        type_window=available.copy(),
         group_zone_allowed=zone_allowed,
         group_captype_allowed=captype_allowed,
         max_per_node=max_per_node,
+        type_exotic=np.array([getattr(t, "bare_metal", False) for t in types], dtype=bool),
         unencodable=unencodable,
     )
 
@@ -474,5 +508,6 @@ def pad_problem(p: EncodedProblem, group_bucket: Optional[int] = None) -> Encode
         group_zone_allowed=padg(p.group_zone_allowed),
         group_captype_allowed=padg(p.group_captype_allowed),
         max_per_node=padg(p.max_per_node, fill=1 << 30),
+        type_exotic=p.type_exotic,
         unencodable=p.unencodable,
     )

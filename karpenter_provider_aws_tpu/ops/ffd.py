@@ -161,6 +161,52 @@ def _step(capacity: jnp.ndarray, type_window: jnp.ndarray, state: _State, item):
     return new_state, (placed_row, unplaced.astype(jnp.int32))
 
 
+@functools.partial(jax.jit, static_argnames=("k",))
+def rank_launch_options(
+    placed: jnp.ndarray,       # [G, N] int32 pods of group g on node n
+    price: jnp.ndarray,        # [G, T] float32, inf where group can't use type
+    used: jnp.ndarray,         # [N, R] resources packed per node
+    capacity: jnp.ndarray,     # [T, R] allocatable per type
+    type_window: jnp.ndarray,  # [T, Z, C] live offerings
+    node_window: jnp.ndarray,  # [N, Z, C] remaining node window
+    node_type: jnp.ndarray,    # [N] committed type
+    exotic: jnp.ndarray,       # [T] bool bare-metal mask
+    k: int = 60,
+):
+    """Ranked launch alternatives per node, computed on device.
+
+    The host decode loop used to argsort a [T] price row per opened node —
+    O(n_open * T log T) python/numpy on the critical path. Here the whole
+    [N, T] ranking happens in one fused program: combined group price,
+    capacity fit, window intersection, the exotic-type filter
+    (instance.go:456-477), then top-k cheapest. Returns (idx [N, k],
+    ok [N, k]) — idx orders types cheapest-first, ok marks real candidates.
+    """
+    mask = (placed > 0).T                       # [N, G]
+    N, T = node_window.shape[0], price.shape[1]
+    # combined[n, t] = max over groups on n of price[g, t]  (inf -> a group
+    # can't use the type; -inf -> empty node). Accumulated group-by-group:
+    # the [N, G, T] broadcast would materialize gigabytes at solve scale,
+    # while G is small — an [N, T] accumulator over a G-loop stays in HBM.
+    def _acc(g, acc):
+        row = jnp.where(mask[:, g][:, None], price[g][None, :], -jnp.inf)
+        return jnp.maximum(acc, row)
+
+    combined = jax.lax.fori_loop(
+        0, placed.shape[0], _acc, jnp.full((N, T), -jnp.inf, dtype=price.dtype)
+    )
+    fits = (used[:, None, :] <= capacity[None, :, :] + _EPS).all(-1)   # [N, T]
+    window = (type_window[None] & node_window[:, None, :, :]).any((-2, -1))
+    usable = jnp.isfinite(combined) & (combined > -jnp.inf) & fits & window
+    # exotic filter: drop bare-metal when a standard type qualifies and the
+    # committed type itself is not bare-metal
+    nonexotic_ok = (usable & ~exotic[None, :]).any(-1) & ~exotic[node_type]
+    usable &= ~(exotic[None, :] & nonexotic_ok[:, None])
+    score = jnp.where(usable, combined, jnp.inf)
+    neg, idx = jax.lax.top_k(-score, k)
+    return idx, jnp.isfinite(neg)
+
+
 @functools.partial(jax.jit, static_argnames=("max_nodes",))
 def ffd_solve(
     requests: jnp.ndarray,     # [G, R] float32 (FFD-sorted by encode)

@@ -71,6 +71,7 @@ class Solver(Protocol):
         in_use=None,
         occupancy: Optional[ZoneOccupancy] = None,
         type_allow=None,
+        reserved_allow=None,
     ) -> SolveResult: ...
 
 
@@ -87,6 +88,8 @@ def _decode_nodes(
     placed: np.ndarray,
     nodepool_name: str,
     node_window: np.ndarray,
+    ranked_idx: Optional[np.ndarray] = None,   # [N, K] device-ranked types
+    ranked_ok: Optional[np.ndarray] = None,    # [N, K] validity
 ) -> list[NodeSpec]:
     """Turn device output into NodeSpecs with launch flexibility.
 
@@ -95,6 +98,10 @@ def _decode_nodes(
     scheduler handing CloudProvider.Create many instanceType options).
     A type qualifies if every group on the node accepts it (finite price)
     and its allocatable covers the node's packed resources.
+
+    ``ranked_idx``/``ranked_ok`` carry the ranking precomputed on device by
+    ``ops.ffd.rank_launch_options`` (TPU path); without them (host/native
+    solvers) the ranking runs here in numpy.
     """
     specs: list[NodeSpec] = []
     G = len(problem.group_pods)
@@ -112,17 +119,27 @@ def _decode_nodes(
             cursors[g] += take
         if not pods and not group_idx.size:
             continue
-        # combined per-type price across the node's groups (inf if any group
-        # cannot use the type) -> ranked alternatives; an alternative must
-        # also offer the node's final zone/captype window
-        combined = problem.price[group_idx].max(axis=0)  # [T]
-        fits = (used[n][None, :] <= cap + 1e-4).all(axis=1)
-        window = (problem.type_window & node_window[n][None, :, :]).any(axis=(1, 2))
-        usable = np.isfinite(combined) & fits & window
-        order = np.argsort(np.where(usable, combined, np.inf), kind="stable")
-        n_usable = int(usable.sum())
-        ranked = order[: min(n_usable, MAX_INSTANCE_TYPE_OPTIONS)]
         committed = int(node_type[n])
+        if ranked_idx is not None:
+            ranked = ranked_idx[n][ranked_ok[n]][:MAX_INSTANCE_TYPE_OPTIONS]
+        else:
+            # combined per-type price across the node's groups (inf if any
+            # group cannot use the type) -> ranked alternatives; an
+            # alternative must also offer the node's final window
+            combined = problem.price[group_idx].max(axis=0)  # [T]
+            fits = (used[n][None, :] <= cap + 1e-4).all(axis=1)
+            window = (problem.type_window & node_window[n][None, :, :]).any(axis=(1, 2))
+            usable = np.isfinite(combined) & fits & window
+            # Exotic (bare-metal) filter parity: instance.go:456-477 — metal
+            # types never ride along as launch alternatives when any standard
+            # type qualifies; lowest-price fleet allocation could otherwise
+            # land on hardware nobody asked for.
+            exotic = problem.type_exotic
+            if exotic is not None and (usable & ~exotic).any() and not exotic[committed]:
+                usable = usable & ~exotic
+            order = np.argsort(np.where(usable, combined, np.inf), kind="stable")
+            n_usable = int(usable.sum())
+            ranked = order[: min(n_usable, MAX_INSTANCE_TYPE_OPTIONS)]
         type_names = [problem.type_names[t] for t in ranked]
         if problem.type_names[committed] not in type_names:
             type_names = [problem.type_names[committed]] + type_names[:-1]
@@ -204,17 +221,36 @@ class TPUSolver:
             placed_chunks.append(res.placed)
             unplaced_chunks.append(res.unplaced)
 
+        # Launch-alternative ranking runs ON DEVICE (one fused [N, T]
+        # program) instead of an argsort per opened node on the host — at
+        # thousands of nodes x 700 types the host loop was the second
+        # biggest cost in the solve path.
+        from ..ops.ffd import rank_launch_options
+
+        placed_dev = placed_chunks[0] if len(placed_chunks) == 1 else jnp.concatenate(placed_chunks, axis=0)
+        exotic = (
+            jnp.asarray(problem.type_exotic)
+            if problem.type_exotic is not None
+            else jnp.zeros(problem.capacity.shape[0], dtype=bool)
+        )
+        k = min(MAX_INSTANCE_TYPE_OPTIONS, problem.capacity.shape[0])
+        ranked_idx_dev, ranked_ok_dev = rank_launch_options(
+            placed_dev, jnp.asarray(padded.price), state.used,
+            jnp.asarray(padded.capacity), jnp.asarray(padded.type_window),
+            state.node_window, state.node_type, exotic, k=k,
+        )
+
         # ONE device->host fetch for everything the decode needs. Each
         # individual np.asarray on a device array is a full transfer
         # round-trip (~tens of ms over a remote-device tunnel), and there
         # are 5 + 2*chunks of them — batching is the difference between
         # ~500 ms and ~70 ms end-to-end on a tunneled chip.
-        (placed_chunks, unplaced_chunks, node_type, node_price, used, n_open,
-         node_window) = jax.device_get(
-            (placed_chunks, unplaced_chunks, state.node_type, state.node_price,
-             state.used, state.n_open, state.node_window)
+        (placed, unplaced_chunks, node_type, node_price, used, n_open,
+         node_window, ranked_idx, ranked_ok) = jax.device_get(
+            (placed_dev, unplaced_chunks, state.node_type, state.node_price,
+             state.used, state.n_open, state.node_window,
+             ranked_idx_dev, ranked_ok_dev)
         )
-        placed = np.concatenate(placed_chunks, axis=0)
         unplaced_arr = np.concatenate(unplaced_chunks)[:G]
         n_open = int(n_open)
         specs = _decode_nodes(
@@ -226,6 +262,8 @@ class TPUSolver:
             placed,
             problem.nodepool.name if problem.nodepool else "",
             node_window,
+            ranked_idx=ranked_idx,
+            ranked_ok=ranked_ok,
         )
         unplaced = {g: int(c) for g, c in enumerate(unplaced_arr) if c > 0}
         return specs, unplaced

@@ -296,3 +296,48 @@ class TestIsolationAndChurn:
         zero_priced = [o for o in out if o[2] == 0.0]
         assert zero_priced, "the free slot should justify one replacement"
         assert len(zero_priced) == 1, "one slot justified multiple free replacements"
+
+    def test_pool_cannot_drain_another_nodeclass_reservation(self, env):
+        """Per-(type, zone) isolation: a pool whose nodeclass holds
+        reservation X must not consume another nodeclass's reservation Y,
+        even though both are published in the shared catalog tensors."""
+        from karpenter_provider_aws_tpu.models.nodeclass import NodeClass
+
+        env.cloud.capacity_reservations["cr-a"] = CapacityReservation(
+            id="cr-a", instance_type="m5.4xlarge", zone="zone-a", count=1,
+            tags={"team": "ml"},
+        )
+        env.cloud.capacity_reservations["cr-b"] = CapacityReservation(
+            id="cr-b", instance_type="c5.4xlarge", zone="zone-b", count=5,
+            tags={"team": "web"},
+        )
+        _, nc_a = env.apply_defaults(
+            NodePool(
+                name="default",
+                requirements=[Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m", "r"))],
+                disruption=Disruption(consolidate_after_s=None),
+            )
+        )
+        nc_a.capacity_reservation_selector = [SelectorTerm.of(team="ml")]
+        nc_b = NodeClass(name="web", role="node-role")
+        nc_b.capacity_reservation_selector = [SelectorTerm.of(team="web")]
+        env.cluster.apply(nc_b)
+        env.nodeclass_status.reconcile()
+        assert env.catalog.reservations.remaining("c5.4xlarge", "zone-b") == 5
+        # pool A demand far beyond cr-a's single slot; its spill must go to
+        # market capacity, never to team web's cr-b
+        for p in make_pods(12, "w", {"cpu": "4", "memory": "8Gi"}):
+            env.cluster.apply(p)
+        for _ in range(8):
+            env.step(1)
+            if not env.cluster.pending_pods():
+                break
+        assert not env.cluster.pending_pods()
+        assert env.cloud.capacity_reservations["cr-a"].used <= 1
+        assert env.cloud.capacity_reservations["cr-b"].used == 0
+        reserved_claims = [
+            c for c in env.cluster.nodeclaims.values()
+            if c.labels.get(lbl.CAPACITY_TYPE) == "reserved"
+        ]
+        for c in reserved_claims:
+            assert c.labels[lbl.CAPACITY_RESERVATION_ID] == "cr-a"
