@@ -1,0 +1,169 @@
+"""Pallas TPU kernel for the consolidation repack check.
+
+Semantics identical to ``ops.consolidate.repack_check`` (the batched
+"remove node i — do its pods fit on the other nodes?" proof, reference:
+designs/consolidation.md:5-15), but memory-shaped for the TPU:
+
+The vmapped XLA version materializes the per-candidate free-capacity state
+as ``[C, N, R]`` in HBM and rewrites it on every of the GMAX scan steps —
+at 5k nodes x 512-candidate chunks that is gigabytes of HBM traffic, and
+the op is bandwidth-bound (~1s p99 for the 5k-node sweep). Here each grid
+program owns ONE candidate and keeps its private free matrix in a VMEM
+scratch laid out ``[R_pad, N]`` (resources on sublanes, nodes on lanes — N
+is the 128-aligned axis), so the slot loop never touches HBM. The shared
+inputs (base free matrix, group requests, compat) are DMA'd to VMEM once
+and reused by every program in the grid.
+
+Per slot the kernel computes, fully on the VPU:
+  k[n]    = min_r floor((free[r, n] + eps) / req[r])   (req > 0 lanes only)
+  k[n]    = k[n] * compat[g, n] * (n != candidate)
+  place   = clip(cnt - exclusive_cumsum(k), 0, k)      (first-fit in index order)
+  free   -= req ⊗ place
+and accumulates the unplaced remainder; the candidate passes iff every
+slot's remainder is zero.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_EPS = 1e-4
+_BIG = np.float32(1 << 30)
+
+LANE = 128
+SUBLANE = 8
+
+
+def _pad_to(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+def _kernel(cand_ref, slots_ref, counts_ref, free_ref, req_ref, compat_ref,
+            ok_ref, free_c):
+    """One grid program = one candidate node's repack proof.
+
+    cand_ref   [1]        SMEM  candidate node index
+    slots_ref  [1, GMAX]  SMEM  group ids on the candidate
+    counts_ref [1, GMAX]  SMEM  pod counts per slot
+    free_ref   [RP, N]    VMEM  shared base free matrix (resources x nodes)
+    req_ref    [RP, G]    VMEM  shared group requests (resources x groups)
+    compat_ref [G, N]     VMEM  shared group x node compatibility (int8)
+    ok_ref     [1, 1]     SMEM  out: 1 iff all slots fully placed
+    free_c     [RP, N]    VMEM  scratch: candidate-private free capacity
+    """
+    i_node = cand_ref[0]
+    free_c[:] = free_ref[:]
+    gmax = slots_ref.shape[1]
+    n = free_ref.shape[1]
+    not_self = (
+        jax.lax.broadcasted_iota(jnp.int32, (1, n), 1) != i_node
+    )
+
+    def slot(s, leftover):
+        g = slots_ref[0, s]
+        cnt = counts_ref[0, s]
+        req = req_ref[:, pl.ds(g, 1)]                     # [RP, 1]
+        with_req = req > 0.0
+        ratio = jnp.where(
+            with_req,
+            jnp.floor((free_c[:] + _EPS) / jnp.where(with_req, req, 1.0)),
+            _BIG,
+        )                                                  # [RP, N]
+        k = jnp.min(ratio, axis=0, keepdims=True)          # [1, N]
+        k = jnp.clip(k, 0.0, _BIG)
+        ok = (compat_ref[pl.ds(g, 1), :] > 0) & not_self   # [1, N]
+        k = jnp.where(ok, k, 0.0)
+        cum_before = jnp.cumsum(k, axis=1) - k             # exclusive prefix
+        place = jnp.clip(cnt.astype(jnp.float32) - cum_before, 0.0, k)
+        free_c[:] = free_c[:] - req * place                # [RP,1]*[1,N] outer
+        return leftover + (cnt.astype(jnp.float32) - jnp.sum(place))
+
+    leftover = jax.lax.fori_loop(0, gmax, slot, jnp.float32(0.0))
+    ok_ref[0, 0] = (leftover <= 0.5).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _repack_call(candidates, slots, counts, free_t, req_t, compat_i8,
+                 interpret=False):
+    C = candidates.shape[0]
+    gmax = slots.shape[1]
+    RP, N = free_t.shape
+    G = req_t.shape[1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=0,
+        grid=(C,),
+        in_specs=[
+            pl.BlockSpec((1,), lambda i: (i,), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, gmax), lambda i: (i, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, gmax), lambda i: (i, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((RP, N), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((RP, G), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((G, N), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1), lambda i: (i, 0), memory_space=pltpu.SMEM),
+        scratch_shapes=[pltpu.VMEM((RP, N), jnp.float32)],
+    )
+    return pl.pallas_call(
+        _kernel,
+        out_shape=jax.ShapeDtypeStruct((C, 1), jnp.int32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(candidates, slots, counts, free_t, req_t, compat_i8)
+
+
+def repack_vmem_bytes(n_nodes: int, n_groups: int, n_res: int = 9) -> int:
+    """Estimated VMEM residency of the kernel's shared blocks + scratch."""
+    N = _pad_to(max(n_nodes, LANE), LANE)
+    RP = _pad_to(max(n_res, SUBLANE), SUBLANE)
+    G = _pad_to(max(n_groups, SUBLANE), SUBLANE)
+    return 2 * RP * N * 4 + RP * G * 4 + G * N * 4  # free + scratch + req + compat(int32 tiles)
+
+
+# Stay well under the ~16MB/core VMEM budget (pallas_guide.md "Memory
+# Hierarchy"): beyond this the XLA vmap path takes over.
+VMEM_BUDGET_BYTES = 12 * 1024 * 1024
+
+
+def repack_check_pallas(
+    free: np.ndarray,          # [N, R] float32
+    requests: np.ndarray,      # [G, R] float32
+    group_ids: np.ndarray,     # [C, GMAX] int32 (pre-gathered per candidate)
+    group_counts: np.ndarray,  # [C, GMAX] int32
+    compat: np.ndarray,        # [G, N] bool
+    candidates: np.ndarray,    # [C] int32 node indices
+    interpret: bool = False,
+) -> np.ndarray:
+    """ok[C] via the VMEM-resident kernel. Inputs are the *per-candidate*
+    slot tables (group_ids/counts already gathered to candidate order),
+    unlike ``repack_check`` which gathers on device."""
+    N, R = free.shape
+    G = requests.shape[0]
+    NP = _pad_to(max(N, LANE), LANE)
+    RP = _pad_to(max(R, SUBLANE), SUBLANE)
+    GP = _pad_to(max(G, SUBLANE), SUBLANE)
+
+    free_t = np.zeros((RP, NP), dtype=np.float32)
+    free_t[:R, :N] = free.T
+    req_t = np.zeros((RP, GP), dtype=np.float32)
+    req_t[:R, :G] = requests.T
+    compat_p = np.zeros((GP, NP), dtype=np.int8)
+    compat_p[:G, :N] = compat
+    # padded node columns: free 0 / compat 0 -> never targets; padded group
+    # rows only reachable from padded slots, which carry count 0
+
+    out = _repack_call(
+        jnp.asarray(candidates.astype(np.int32)),
+        jnp.asarray(group_ids.astype(np.int32)),
+        jnp.asarray(group_counts.astype(np.int32)),
+        jnp.asarray(free_t),
+        jnp.asarray(req_t),
+        jnp.asarray(compat_p),
+        interpret=interpret,
+    )
+    return np.asarray(out).reshape(-1).astype(bool)
