@@ -32,10 +32,14 @@ class ProvisioningController:
     name = "provisioning"
     interval_s = 10.0
 
-    def __init__(self, cluster: Cluster, solver: Solver, cloudprovider: CloudProvider):
+    def __init__(self, cluster: Cluster, solver: Solver, cloudprovider: CloudProvider,
+                 profiler=None):
+        from ..utils.observability import Profiler
+
         self.cluster = cluster
         self.solver = solver
         self.cloudprovider = cloudprovider
+        self.profiler = profiler or Profiler()
         # pod uid -> claim name nominations (kube-scheduler binds for real;
         # the registration controller honors these on node readiness)
         self.nominations: dict[str, str] = {}
@@ -54,21 +58,22 @@ class ProvisioningController:
             return
         from ..ops.encode import ZoneOccupancy
 
-        result = self.solver.solve(
-            pending,
-            nodepools,
-            self.cloudprovider.catalog,
-            in_use=self.cluster.in_use_by_nodepool(),
-            occupancy=ZoneOccupancy.from_cluster(self.cluster),
-            type_allow={
-                pool.name: self.cloudprovider.launchable_type_names(pool)
-                for pool in nodepools
-            },
-            reserved_allow={
-                pool.name: self.cloudprovider.pool_reserved_allowed(pool)
-                for pool in nodepools
-            },
-        )
+        with self.profiler.capture("solve"):
+            result = self.solver.solve(
+                pending,
+                nodepools,
+                self.cloudprovider.catalog,
+                in_use=self.cluster.in_use_by_nodepool(),
+                occupancy=ZoneOccupancy.from_cluster(self.cluster),
+                type_allow={
+                    pool.name: self.cloudprovider.launchable_type_names(pool)
+                    for pool in nodepools
+                },
+                reserved_allow={
+                    pool.name: self.cloudprovider.pool_reserved_allowed(pool)
+                    for pool in nodepools
+                },
+            )
         from ..metrics import SOLVE_DURATION, SOLVE_PODS
 
         SOLVE_DURATION.observe(result.solve_seconds)

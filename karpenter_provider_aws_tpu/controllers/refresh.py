@@ -20,16 +20,27 @@ class CatalogRefreshController:
     interval_s = CacheTTL.CATALOG_REFRESH_PERIOD
 
     def __init__(self, catalog: CatalogProvider, source: Optional[Callable] = None):
+        from ..utils.observability import ChangeMonitor
+
         self.catalog = catalog
         self.source = source  # () -> list[InstanceType]; None = regenerate
         self.refreshes = 0
+        self._monitor = ChangeMonitor()
 
     def reconcile(self) -> None:
+        import logging
+
         from ..catalog.instancetypes import generate_catalog
 
         types = self.source() if self.source else generate_catalog(self.catalog.zones)
         self.catalog.refresh(types)
         self.refreshes += 1
+        # log-on-change parity: instancetype.go:149-151 pretty.ChangeMonitor
+        summary = (len(types), tuple(sorted(t.name for t in types))[:5])
+        if self._monitor.has_changed("catalog", summary):
+            logging.getLogger("karpenter.tpu.catalog").info(
+                "instance-type catalog refreshed: %d types", len(types)
+            )
 
 
 class PricingRefreshController:

@@ -96,6 +96,12 @@ def new_operator(
     (the fake for tests; a real adapter in production)."""
     options = options or Options.from_env_and_args()
     clock = clock or RealClock()
+    from ..utils.observability import Profiler, enable_xla_dump, setup_logging
+
+    setup_logging(options.log_level)
+    if options.xla_dump_dir:
+        enable_xla_dump(options.xla_dump_dir)  # before the first jit compile
+    profiler = Profiler(options.profile_dir)
     if cloud is None:
         from ..fake import FakeCloud
 
@@ -136,7 +142,9 @@ def new_operator(
 
     solver = _build_solver(options)
 
-    provisioning = ProvisioningController(cluster, solver, cloudprovider)
+    provisioning = ProvisioningController(
+        cluster, solver, cloudprovider, profiler=profiler
+    )
     scheduling = SchedulingController(cluster, provisioning, clock=clock)
     registration = RegistrationController(cluster, provisioning, clock=clock)
     termination = TerminationController(cluster, cloudprovider)

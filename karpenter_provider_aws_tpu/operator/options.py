@@ -39,6 +39,9 @@ class Options:
     metrics_port: int = 8080                     # 0 = disabled
     drift_enabled: bool = True
     feature_gates: str = ""                      # "Drift=true,SpotToSpot=false"
+    log_level: str = "INFO"
+    profile_dir: str = ""                        # JAX profiler captures; "" = off
+    xla_dump_dir: str = ""                       # compiled-HLO dumps; "" = off
 
     @staticmethod
     def from_env_and_args(argv: Optional[list[str]] = None) -> "Options":

@@ -1,0 +1,152 @@
+"""Tracing/profiling + change-aware logging.
+
+The reference has no in-repo tracing (SURVEY.md section 5: observability is
+metrics+logs); the TPU framework adds what a device-backed control plane
+needs on top:
+
+- ``Profiler`` — JAX profiler capture around the solve path plus XLA dump
+  plumbing, so a slow solve can be traced down to the compiled HLO.
+- ``ChangeMonitor`` — log-only-on-change dedupe (parity:
+  ``pretty.ChangeMonitor`` used at
+  ``pkg/providers/instancetype/instancetype.go:149-151`` to avoid
+  re-logging an unchanged catalog every refresh).
+- ``setup_logging`` — structured key=value log lines (the zap sugared-
+  logger analogue, ``cmd/controller/main.go``'s logging bootstrap).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Optional
+
+
+class ChangeMonitor:
+    """Remembers the last value per key; ``has_changed`` is True once per
+    distinct value (re-armed after ``ttl_s`` so slow drifts still log)."""
+
+    def __init__(self, ttl_s: float = 24 * 3600.0, clock=None):
+        self._ttl = ttl_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._seen: dict[str, tuple[int, float]] = {}
+
+    def _now(self) -> float:
+        return self._clock.now() if self._clock is not None else time.monotonic()
+
+    def has_changed(self, key: str, value) -> bool:
+        h = hash(repr(value))
+        now = self._now()
+        with self._lock:
+            prev = self._seen.get(key)
+            if prev is not None and prev[0] == h and now - prev[1] < self._ttl:
+                return False
+            self._seen[key] = (h, now)
+            return True
+
+
+class Profiler:
+    """JAX profiler capture + trace annotations for the solve path.
+
+    ``profile_dir`` enables captures (viewable in TensorBoard/XProf /
+    Perfetto); empty = every method is a no-op, so call sites never branch.
+    ``capture(name)`` wraps one region; ``annotate(name)`` adds a named
+    trace span inside an active capture (cheap enough to leave on).
+    """
+
+    def __init__(self, profile_dir: str = ""):
+        self.profile_dir = profile_dir
+        self._active = False
+        self._lock = threading.Lock()
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.profile_dir)
+
+    def capture(self, name: str = "solve"):
+        return _Capture(self, name)
+
+    def annotate(self, name: str):
+        if not self.enabled:
+            return _NullCtx()
+        import jax.profiler
+
+        return jax.profiler.TraceAnnotation(name)
+
+
+class _NullCtx:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class _Capture:
+    def __init__(self, profiler: Profiler, name: str):
+        self._p = profiler
+        self._name = name
+        self._started = False
+
+    def __enter__(self):
+        if not self._p.enabled:
+            return self
+        with self._p._lock:
+            if self._p._active:  # one capture at a time; nested = annotation
+                return self
+            self._p._active = True
+        import jax.profiler
+
+        path = os.path.join(self._p.profile_dir, self._name)
+        os.makedirs(path, exist_ok=True)
+        jax.profiler.start_trace(path)
+        self._started = True
+        return self
+
+    def __exit__(self, *exc):
+        if self._started:
+            import jax.profiler
+
+            jax.profiler.stop_trace()
+            with self._p._lock:
+                self._p._active = False
+        return False
+
+
+def enable_xla_dump(dump_dir: str) -> None:
+    """Request compiled-HLO dumps. Must run before the first jit compile —
+    XLA reads the flag at backend initialization."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_dump_to" not in flags:
+        os.environ["XLA_FLAGS"] = f"{flags} --xla_dump_to={dump_dir}".strip()
+
+
+_LOG_CONFIGURED = False
+
+
+class _KVFormatter(logging.Formatter):
+    """ts level logger msg — structured single-line output (zap analogue)."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        base = super().format(record)
+        return base
+
+
+def setup_logging(level: str = "INFO") -> None:
+    global _LOG_CONFIGURED
+    if _LOG_CONFIGURED:
+        return
+    handler = logging.StreamHandler()
+    handler.setFormatter(
+        _KVFormatter(
+            fmt="%(asctime)s\t%(levelname)s\t%(name)s\t%(message)s",
+            datefmt="%Y-%m-%dT%H:%M:%S",
+        )
+    )
+    root = logging.getLogger("karpenter.tpu")
+    root.addHandler(handler)
+    root.setLevel(getattr(logging, level.upper(), logging.INFO))
+    root.propagate = False
+    _LOG_CONFIGURED = True

@@ -1,0 +1,97 @@
+"""Tracing/profiling + change-aware logging (SURVEY section 5: the TPU
+framework adds JAX profiler / XLA-dump hooks on top of the reference's
+metrics+logs observability)."""
+
+import logging
+import os
+
+import pytest
+
+from karpenter_provider_aws_tpu.utils.clock import FakeClock
+from karpenter_provider_aws_tpu.utils.observability import (
+    ChangeMonitor,
+    Profiler,
+    enable_xla_dump,
+    setup_logging,
+)
+
+
+class TestChangeMonitor:
+    def test_logs_once_per_value(self):
+        m = ChangeMonitor()
+        assert m.has_changed("catalog", (700, "m5"))
+        assert not m.has_changed("catalog", (700, "m5"))
+        assert m.has_changed("catalog", (701, "m5"))
+        assert not m.has_changed("catalog", (701, "m5"))
+
+    def test_ttl_rearms(self):
+        clk = FakeClock()
+        m = ChangeMonitor(ttl_s=60, clock=clk)
+        assert m.has_changed("k", "v")
+        assert not m.has_changed("k", "v")
+        clk.advance(61)
+        assert m.has_changed("k", "v")
+
+    def test_keys_independent(self):
+        m = ChangeMonitor()
+        assert m.has_changed("a", 1)
+        assert m.has_changed("b", 1)
+
+
+class TestProfiler:
+    def test_disabled_is_noop(self):
+        p = Profiler("")
+        assert not p.enabled
+        with p.capture("solve"):
+            pass
+        with p.annotate("encode"):
+            pass
+
+    def test_enabled_writes_trace(self, tmp_path):
+        p = Profiler(str(tmp_path))
+        with p.capture("solve"):
+            import jax.numpy as jnp
+
+            jnp.zeros(8).sum().block_until_ready()
+        # jax profiler writes a plugins/profile tree under the capture dir
+        out = list(os.walk(str(tmp_path / "solve")))
+        assert any(files for _, _, files in out), "no trace artifacts written"
+
+    def test_nested_capture_does_not_crash(self, tmp_path):
+        p = Profiler(str(tmp_path))
+        with p.capture("outer"):
+            with p.capture("inner"):  # degrades to no-op, not an error
+                pass
+
+
+class TestXlaDump:
+    def test_appends_flag_once(self, monkeypatch):
+        monkeypatch.setenv("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+        enable_xla_dump("/tmp/dump")
+        assert "--xla_dump_to=/tmp/dump" in os.environ["XLA_FLAGS"]
+        before = os.environ["XLA_FLAGS"]
+        enable_xla_dump("/tmp/dump")  # idempotent
+        assert os.environ["XLA_FLAGS"] == before
+
+
+class TestOptionsWiring:
+    def test_operator_accepts_observability_options(self):
+        from karpenter_provider_aws_tpu.operator.options import Options
+
+        o = Options(profile_dir="/tmp/prof", xla_dump_dir="", log_level="DEBUG")
+        o.validate()
+
+    def test_provisioning_uses_injected_profiler(self, tmp_path):
+        from karpenter_provider_aws_tpu.testenv import new_environment
+
+        env = new_environment(use_tpu_solver=False)
+        env.provisioning.profiler = Profiler(str(tmp_path))
+        env.apply_defaults()
+        from karpenter_provider_aws_tpu.models.pod import make_pods
+
+        for p in make_pods(2, "w", {"cpu": "1", "memory": "2Gi"}):
+            env.cluster.apply(p)
+        env.step(3)
+        assert not env.cluster.pending_pods()
+        out = list(os.walk(str(tmp_path / "solve")))
+        assert any(files for _, _, files in out)
