@@ -90,6 +90,7 @@ def _decode_nodes(
     node_window: np.ndarray,
     ranked_idx: Optional[np.ndarray] = None,   # [N, K] device-ranked types
     ranked_ok: Optional[np.ndarray] = None,    # [N, K] validity
+    stale_rank: Optional[np.ndarray] = None,   # [N] recompute ranking on host
 ) -> list[NodeSpec]:
     """Turn device output into NodeSpecs with launch flexibility.
 
@@ -120,7 +121,7 @@ def _decode_nodes(
         if not pods and not group_idx.size:
             continue
         committed = int(node_type[n])
-        if ranked_idx is not None:
+        if ranked_idx is not None and (stale_rank is None or not stale_rank[n]):
             ranked = ranked_idx[n][ranked_ok[n]][:MAX_INSTANCE_TYPE_OPTIONS]
         else:
             # combined per-type price across the node's groups (inf if any
@@ -170,13 +171,118 @@ def _decode_nodes(
     return specs
 
 
+def _refine_plan(
+    problem: EncodedProblem,
+    node_type: np.ndarray,    # [N]
+    node_price: np.ndarray,   # [N]
+    used: np.ndarray,         # [N, R] (mutated)
+    node_window: np.ndarray,  # [N, Z, C] (mutated)
+    placed: np.ndarray,       # [G', N] (mutated; G' >= G real groups)
+    n_open: int,
+    max_tries: int = 256,
+    util_threshold: float = 0.9,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Packed-cost refinement (SURVEY.md section 7.3): drop under-filled plan
+    nodes whose pods first-fit into the remaining nodes' slack.
+
+    The greedy FFD leaves a partial tail node per group run; when several
+    groups' tails interleave, the final plan can carry nodes the rest of the
+    plan could absorb. This pass re-runs the consolidation proof *on the
+    plan itself* (cheapest form of the LP-relaxation refinement: a
+    feasibility-preserving cost descent) and commits every drop — so the
+    launched cost can be strictly BELOW the reference's greedy, never above.
+
+    Candidates are the ``max_tries`` lowest-utilization nodes under
+    ``util_threshold``, tried most-expensive-first; every move respects
+    group compatibility (finite price for the receiver's committed type),
+    the joint (zone, captype) window (receivers narrow like the scan does),
+    and hostname caps. Returns (dropped[N], stale_rank[N]) — receivers'
+    precomputed launch rankings must be recomputed host-side.
+    """
+    G = len(problem.group_pods)
+    Nn = len(node_type)
+    idx = np.arange(Nn)
+    live = idx < n_open
+    pods_on = placed[:G].sum(axis=0)
+    cap = problem.capacity[node_type]          # [N, R] committed allocatable
+    free = cap - used
+    with np.errstate(invalid="ignore", divide="ignore"):
+        util = np.where(
+            live, (used / np.maximum(cap, 1e-9)).max(axis=1), np.inf
+        )
+    cand = live & (pods_on > 0) & (util < util_threshold)
+    cand_idx = idx[cand]
+    if cand_idx.size == 0:
+        return np.zeros(Nn, dtype=bool), np.zeros(Nn, dtype=bool)
+    # bounded: lowest-utilization pool, most-expensive-first within it
+    pool = cand_idx[np.argsort(util[cand_idx], kind="stable")][:max_tries]
+    pool = pool[np.argsort(-node_price[pool], kind="stable")]
+
+    dropped = np.zeros(Nn, dtype=bool)
+    stale = np.zeros(Nn, dtype=bool)
+    mpn = problem.max_per_node
+    finite_price = np.isfinite(problem.price)  # [G, T]
+    for n in pool:
+        gids = np.nonzero(placed[:G, n])[0]
+        # trial first-fit of every group of n into the surviving slack;
+        # windows narrow DURING the trial (a receiver taking group g1 then
+        # g2 must keep a non-empty joint window, like the device scan)
+        trial_free = free.copy()
+        trial_window = node_window.copy()
+        moves: list[tuple[int, np.ndarray]] = []
+        ok = True
+        for g in gids:
+            cnt = int(placed[g, n])
+            req = problem.requests[g]
+            gw = problem.group_window[g]
+            elig = live & ~dropped & (idx != n)
+            elig &= finite_price[g][node_type]
+            elig &= (trial_window & gw[None, :, :]).any(axis=(1, 2))
+            with_req = req > 0
+            ratio = np.where(
+                with_req[None, :],
+                np.floor((trial_free + 1e-4) / np.where(with_req, req, 1.0)[None, :]),
+                np.inf,
+            )
+            k = np.clip(np.nanmin(ratio, axis=1), 0, float(1 << 30)).astype(np.int64)
+            k = np.minimum(k, int(mpn[g]) - placed[g])
+            k = np.where(elig, k, 0)
+            cum = np.cumsum(k) - k
+            take = np.clip(cnt - cum, 0, k).astype(np.int64)
+            if int(take.sum()) < cnt:
+                ok = False
+                break
+            trial_free -= take[:, None] * req[None, :]
+            recv = take > 0
+            trial_window[recv] &= gw[None, :, :]
+            moves.append((int(g), take))
+        if not ok:
+            continue
+        # commit: move pods, grow receivers, adopt trial windows, drop node
+        for g, take in moves:
+            recv = np.nonzero(take)[0]
+            placed[g, recv] += take[recv]
+            used[recv] += take[recv, None] * problem.requests[g][None, :]
+            stale[recv] = True
+            placed[g, n] = 0
+        node_window[:] = trial_window
+        free = cap - used
+        free[n] = 0
+        used[n] = 0
+        dropped[n] = True
+    return dropped, stale
+
+
 class TPUSolver:
     """Device-backed solver. ``group_chunk`` bounds per-scan group axis; node
-    state carries across chunks on device."""
+    state carries across chunks on device. ``refine`` enables the
+    packed-cost descent pass (_refine_plan) on the decoded plan."""
 
-    def __init__(self, group_chunk: int = 1024, max_nodes: Optional[int] = None):
+    def __init__(self, group_chunk: int = 1024, max_nodes: Optional[int] = None,
+                 refine: bool = True):
         self.group_chunk = group_chunk
         self.max_nodes = max_nodes
+        self.refine = refine
 
     def solve_encoded(self, problem: EncodedProblem) -> tuple[list[NodeSpec], dict[int, int]]:
         import jax
@@ -253,6 +359,17 @@ class TPUSolver:
         )
         unplaced_arr = np.concatenate(unplaced_chunks)[:G]
         n_open = int(n_open)
+
+        # Packed-cost descent: drop plan nodes the rest of the plan absorbs.
+        stale_rank = None
+        if self.refine and n_open > 2:
+            # device_get arrays are read-only views; the descent mutates
+            placed, used, node_window = (
+                np.array(placed), np.array(used), np.array(node_window)
+            )
+            dropped, stale_rank = _refine_plan(
+                problem, node_type, node_price, used, node_window, placed, n_open
+            )
         specs = _decode_nodes(
             problem,
             node_type,
@@ -264,6 +381,7 @@ class TPUSolver:
             node_window,
             ranked_idx=ranked_idx,
             ranked_ok=ranked_ok,
+            stale_rank=stale_rank,
         )
         unplaced = {g: int(c) for g, c in enumerate(unplaced_arr) if c > 0}
         return specs, unplaced

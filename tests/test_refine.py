@@ -1,0 +1,147 @@
+"""Packed-cost refinement (_refine_plan): the post-FFD descent that drops
+plan nodes the remaining slack absorbs (SURVEY section 7.3's cost
+refinement). Safety property: never worse than greedy, never overfills,
+never strands a pod."""
+
+import numpy as np
+import pytest
+
+from karpenter_provider_aws_tpu.catalog import CatalogProvider
+from karpenter_provider_aws_tpu.models import NodePool, Operator, Requirement
+from karpenter_provider_aws_tpu.models import labels as lbl
+from karpenter_provider_aws_tpu.models.pod import make_pods
+from karpenter_provider_aws_tpu.ops.encode import encode_problem
+from karpenter_provider_aws_tpu.scheduling import HostSolver, TPUSolver
+from karpenter_provider_aws_tpu.scheduling.solver import _refine_plan
+
+
+def _mini_problem():
+    """One group of 1-cpu pods on a catalog wide enough for any node plan."""
+    catalog = CatalogProvider()
+    pool = NodePool(
+        name="default",
+        requirements=[Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m", "r"))],
+    )
+    pods = make_pods(4, "w", {"cpu": "1", "memory": "1Gi"})
+    return encode_problem(pods, catalog, pool)
+
+
+class TestRefinePlanUnit:
+    def test_drops_absorbable_node(self):
+        p = _mini_problem()
+        T = p.capacity.shape[0]
+        R = p.capacity.shape[1]
+        Z, C = p.group_window.shape[1], p.group_window.shape[2]
+        # pick a type with plenty of room for 4 pods
+        req = p.requests[0]
+        fits = (p.capacity + 1e-4 >= req[None, :] * 4).all(axis=1) & np.isfinite(p.price[0])
+        t = int(np.nonzero(fits)[0][0])
+        N = 4
+        node_type = np.full(N, t, dtype=np.int32)
+        node_price = np.array([1.0, 1.0, 0.0, 0.0], dtype=np.float32)
+        # node0: 3 pods, node1: 1 pod (the absorbable tail), 2 unopened
+        placed = np.zeros((p.requests.shape[0], N), dtype=np.int32)
+        placed[0, 0] = 3
+        placed[0, 1] = 1
+        used = (placed[0][:, None] * req[None, :]).astype(np.float32)
+        node_window = np.zeros((N, Z, C), dtype=bool)
+        node_window[:2] = (p.group_window[0] & p.type_window[t])[None, :, :]
+        dropped, stale = _refine_plan(
+            p, node_type, node_price, used, node_window, placed, n_open=2,
+        )
+        assert dropped[1] and not dropped[0]
+        assert placed[0, 0] == 4 and placed[0, 1] == 0
+        assert stale[0]  # receiver's ranking must be recomputed
+        np.testing.assert_allclose(used[0], req * 4)
+        assert used[1].sum() == 0
+
+    def test_no_drop_when_nothing_fits(self):
+        p = _mini_problem()
+        req = p.requests[0]
+        # choose the SMALLEST type that holds exactly 2 pods -> no slack
+        per = np.where(
+            (req > 0)[None, :], np.floor((p.capacity + 1e-4) / np.maximum(req, 1e-9)[None, :]), np.inf
+        ).min(axis=1)
+        ok = (per == 2) & np.isfinite(p.price[0])
+        if not ok.any():
+            pytest.skip("catalog has no 2-pod type for this request")
+        t = int(np.nonzero(ok)[0][0])
+        N = 2
+        Z, C = p.group_window.shape[1], p.group_window.shape[2]
+        node_type = np.full(N, t, dtype=np.int32)
+        node_price = np.ones(N, dtype=np.float32)
+        placed = np.zeros((p.requests.shape[0], N), dtype=np.int32)
+        placed[0, 0] = 2
+        placed[0, 1] = 2
+        used = (placed[0][:, None] * req[None, :]).astype(np.float32)
+        node_window = np.zeros((N, Z, C), dtype=bool)
+        node_window[:] = (p.group_window[0] & p.type_window[t])[None, :, :]
+        dropped, _ = _refine_plan(
+            p, node_type, node_price, used, node_window, placed, n_open=2
+        )
+        assert not dropped.any()
+
+    def test_window_conflict_blocks_move(self):
+        """A receiver whose joint window no longer intersects the group's
+        cannot absorb it, even with free capacity."""
+        p = _mini_problem()
+        req = p.requests[0]
+        fits = (p.capacity + 1e-4 >= req[None, :] * 4).all(axis=1) & np.isfinite(p.price[0])
+        t = int(np.nonzero(fits)[0][0])
+        N = 2
+        Z, C = p.group_window.shape[1], p.group_window.shape[2]
+        node_type = np.full(N, t, dtype=np.int32)
+        node_price = np.ones(N, dtype=np.float32)
+        placed = np.zeros((p.requests.shape[0], N), dtype=np.int32)
+        placed[0, 0] = 1
+        placed[0, 1] = 1
+        used = (placed[0][:, None] * req[None, :]).astype(np.float32)
+        node_window = np.zeros((N, Z, C), dtype=bool)
+        node_window[0] = p.group_window[0] & p.type_window[t]
+        # receiver node1's window is disjoint from the group's allowance
+        gw = p.group_window[0]
+        node_window[1] = ~gw & p.type_window[t]
+        dropped, _ = _refine_plan(
+            p, node_type, node_price, used, node_window, placed, n_open=2
+        )
+        assert not dropped[0]  # node1 may not take node0's pod
+
+
+class TestEndToEndProperties:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_refined_cost_never_worse_and_plan_sound(self, seed):
+        rng = np.random.RandomState(seed)
+        catalog = CatalogProvider()
+        pool = NodePool(
+            name="default",
+            requirements=[Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m", "r"))],
+        )
+        pods = []
+        for i in range(6):
+            cpu = int(rng.choice([500, 1000, 3000, 7000]))
+            pods += make_pods(
+                int(rng.randint(3, 30)), f"g{i}",
+                {"cpu": f"{cpu}m", "memory": f"{cpu * 2}Mi"},
+            )
+        greedy = HostSolver().solve(pods, [pool], catalog)
+        refined = TPUSolver(refine=True).solve(pods, [pool], catalog)
+        assert refined.pods_placed() == len(pods)
+        assert not refined.unschedulable
+        assert refined.total_cost <= greedy.total_cost + 1e-6
+        # no node overfilled: packed requests fit the committed type
+        for spec in refined.node_specs:
+            it = catalog.get(spec.instance_type_options[0])
+            total = sum((p.requests.v for p in spec.pods), np.zeros_like(pods[0].requests.v))
+            assert (total <= catalog.allocatable(it).v + 1e-3).all()
+            assert spec.offering_options, "empty launch window after refine"
+
+    def test_refine_off_matches_greedy_cost(self):
+        catalog = CatalogProvider()
+        pool = NodePool(
+            name="default",
+            requirements=[Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m", "r"))],
+        )
+        pods = make_pods(50, "w", {"cpu": "2", "memory": "4Gi"})
+        a = TPUSolver(refine=False).solve(pods, [pool], catalog)
+        b = HostSolver().solve(pods, [pool], catalog)
+        assert abs(a.total_cost - b.total_cost) < 1e-4
