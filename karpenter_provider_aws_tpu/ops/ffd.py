@@ -204,7 +204,11 @@ def rank_launch_options(
     usable &= ~(exotic[None, :] & nonexotic_ok[:, None])
     score = jnp.where(usable, combined, jnp.inf)
     neg, idx = jax.lax.top_k(-score, k)
-    return idx, jnp.isfinite(neg)
+    # valid entries form a prefix (finite scores sort before -inf), so a
+    # per-node count replaces a [N, k] bool mask; int16 halves the idx
+    # transfer (T < 32768 always holds for instance catalogs)
+    n_valid = jnp.sum(jnp.isfinite(neg), axis=1).astype(jnp.int16)
+    return idx.astype(jnp.int16), n_valid
 
 
 @functools.partial(jax.jit, static_argnames=("max_nodes",))
