@@ -28,6 +28,13 @@ from ..models.resources import ResourceVector
 DEFAULT_REGION = "region-1"
 DEFAULT_ZONES = ("zone-a", "zone-b", "zone-c", "zone-d")
 
+# Local-zone modeling (parity: the localzone e2e suite). Zones named
+# "<region>-lz<N>" — or listed here explicitly — carry a narrow stocked
+# family set, on-demand only, at a price premium, like real local zones.
+LOCAL_ZONE_NAMES: set = set()
+LOCAL_ZONE_FAMILIES = ("c5", "m5", "r5", "g4dn")
+LOCAL_ZONE_PRICE_FACTOR = 1.2
+
 
 @dataclass(frozen=True)
 class Offering:
@@ -377,6 +384,13 @@ def generate_catalog(zones=DEFAULT_ZONES, apply_generated: bool = True) -> list[
     for it in out:
         offerings = []
         for zi, zone in enumerate(zones):
+            if zone in LOCAL_ZONE_NAMES or zone.split("-lz")[0] != zone:
+                # Local zones (parity: the localzone e2e suite): only a
+                # narrow family set is stocked, and spot is not offered.
+                present = it.family in LOCAL_ZONE_FAMILIES
+                od = pricing.on_demand_price(it) * LOCAL_ZONE_PRICE_FACTOR
+                offerings.append(Offering(zone, lbl.CAPACITY_TYPE_ON_DEMAND, od, present))
+                continue
             # Newest-gen arm and exotic families are missing from some zones.
             present = not (_h(f"{it.family}:{zone}") % 17 == 0 and zi >= 2)
             od = pricing.on_demand_price(it)

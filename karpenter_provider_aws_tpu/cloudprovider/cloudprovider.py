@@ -255,6 +255,10 @@ class CloudProvider:
         claim.labels.update(it.labels())
         claim.labels[lbl.TOPOLOGY_ZONE] = inst.zone
         claim.labels[lbl.CAPACITY_TYPE] = inst.capacity_type
+        zone_types = getattr(self.cloud, "zone_types", None)
+        if zone_types:
+            claim.labels[lbl.ZONE_TYPE] = zone_types.get(inst.zone, "availability-zone")
+        claim.status.internal_ip = getattr(inst, "private_ip", "")
         reservation_id = getattr(inst, "capacity_reservation_id", "")
         if reservation_id:
             claim.labels[lbl.CAPACITY_RESERVATION_ID] = reservation_id

@@ -42,6 +42,7 @@ class RegistrationController:
                     taints=list(claim.taints) + list(claim.startup_taints),
                     capacity=claim.status.capacity,
                     allocatable=claim.status.allocatable,
+                    internal_ip=claim.status.internal_ip,
                     ready=True,
                     created_at=self.clock.now(),
                 )

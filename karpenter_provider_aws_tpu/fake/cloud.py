@@ -33,6 +33,7 @@ class Subnet:
     available_ips: int = 8192
     tags: dict[str, str] = field(default_factory=dict)
     public: bool = False
+    ipv6_native: bool = False  # nodes in this subnet get IPv6 internal IPs
 
 
 @dataclass
@@ -82,6 +83,7 @@ class Instance:
     subnet_id: str = ""
     security_group_ids: tuple[str, ...] = ()
     state: str = "running"          # pending | running | shutting-down | terminated
+    private_ip: str = ""
     launch_time: float = 0.0
     tags: dict[str, str] = field(default_factory=dict)
     capacity_reservation_id: str = ""  # set for reserved-captype launches
@@ -122,6 +124,9 @@ class FakeCloud:
         self.clock = clock or RealClock()
         self._lock = threading.RLock()
         self.zones = tuple(zones)
+        # zone -> "availability-zone" | "local-zone" (parity: the localzone
+        # suite selecting zones by type from DescribeAvailabilityZones)
+        self.zone_types: dict[str, str] = {z: "availability-zone" for z in zones}
         self.subnets: list[Subnet] = [
             Subnet(id=f"subnet-{i}", zone=z, tags={"discovery": "cluster-1"})
             for i, z in enumerate(zones)
@@ -222,13 +227,18 @@ class FakeCloud:
                         continue
                     res.used += 1
                     reservation_id = res.id
+                subnet_id = req.subnet_by_zone.get(zone, "")
+                subnet = next((sn for sn in self.subnets if sn.id == subnet_id), None)
+                seq = next(_ids)
+                ipv6 = subnet is not None and subnet.ipv6_native
                 inst = Instance(
-                    id=f"i-{next(_ids):08x}",
+                    id=f"i-{seq:08x}",
                     instance_type=itype,
                     zone=zone,
                     capacity_type=captype,
                     image_id=req.image_id,
-                    subnet_id=req.subnet_by_zone.get(zone, ""),
+                    subnet_id=subnet_id,
+                    private_ip=(f"fd00::{seq:x}" if ipv6 else f"10.0.{(seq >> 8) & 255}.{seq & 255}"),
                     security_group_ids=req.security_group_ids,
                     launch_time=self.clock.now(),
                     tags=dict(req.tags),
@@ -240,6 +250,11 @@ class FakeCloud:
             captype, itype, zone = last_ice
             return InsufficientCapacityError(instance_type=itype, zone=zone, capacity_type=captype)
         return InsufficientCapacityError(message="no launchable offering in request")
+
+    def describe_availability_zones(self) -> dict[str, str]:
+        with self._lock:
+            self._record("describe_availability_zones", None)
+            return dict(self.zone_types)
 
     # -- instance APIs -----------------------------------------------------
     def describe_instances(self, ids: list[str]) -> list[Instance]:

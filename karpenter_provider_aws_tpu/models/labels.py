@@ -14,6 +14,9 @@ ARCH = "kubernetes.io/arch"
 OS = "kubernetes.io/os"
 INSTANCE_TYPE_LABEL = "node.kubernetes.io/instance-type"
 TOPOLOGY_ZONE = "topology.kubernetes.io/zone"
+# availability-zone | local-zone (parity: the localzone e2e suite selecting
+# zones by type via DescribeAvailabilityZones)
+ZONE_TYPE = f"{GROUP}/zone-type"
 TOPOLOGY_REGION = "topology.kubernetes.io/region"
 HOSTNAME = "kubernetes.io/hostname"
 

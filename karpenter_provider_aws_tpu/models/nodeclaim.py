@@ -24,6 +24,7 @@ _seq = itertools.count()
 class NodeClaimStatus:
     provider_id: str = ""
     image_id: str = ""
+    internal_ip: str = ""
     node_name: str = ""
     capacity: ResourceVector = field(default_factory=ResourceVector)
     allocatable: ResourceVector = field(default_factory=ResourceVector)

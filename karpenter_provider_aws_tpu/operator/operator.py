@@ -129,7 +129,14 @@ def new_operator(
             max_timeout_s=options.batch_max_seconds,
         ),
         cluster_info=ClusterInfo(
-            name=options.cluster_name, endpoint=options.cluster_endpoint
+            name=options.cluster_name,
+            endpoint=options.cluster_endpoint,
+            ip_family=options.ip_family,
+            # KubeDNSIP discovery parity (operator.go:247-260): the kube-dns
+            # service IP is the 10th address of the service range — modeled
+            # here as family-typed defaults overridable by --cluster-dns-ip
+            dns_ip=options.cluster_dns_ip
+            or ("fd00:10::a" if options.ip_family == "ipv6" else "10.100.0.10"),
         ),
     )
     # Metrics decorator around the plugin boundary (parity: main.go:44).

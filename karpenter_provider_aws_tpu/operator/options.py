@@ -42,6 +42,8 @@ class Options:
     log_level: str = "INFO"
     profile_dir: str = ""                        # JAX profiler captures; "" = off
     xla_dump_dir: str = ""                       # compiled-HLO dumps; "" = off
+    ip_family: str = "ipv4"                      # ipv4 | ipv6 (cluster address family)
+    cluster_dns_ip: str = ""                     # "" = discover (KubeDNSIP parity)
 
     @staticmethod
     def from_env_and_args(argv: Optional[list[str]] = None) -> "Options":
@@ -74,6 +76,8 @@ class Options:
             raise ValueError("solver-sidecar-target required for the grpc backend")
         if self.batch_idle_seconds <= 0 or self.batch_max_seconds < self.batch_idle_seconds:
             raise ValueError("batch windows must satisfy 0 < idle <= max")
+        if self.ip_family not in ("ipv4", "ipv6"):
+            raise ValueError(f"ip-family must be ipv4 or ipv6, got {self.ip_family!r}")
 
     def gate(self, name: str, default: bool = True) -> bool:
         for pair in self.feature_gates.split(","):

@@ -28,6 +28,7 @@ class ClusterInfo:
     ca_bundle: str = ""
     dns_ip: str = ""
     version: str = ""
+    ip_family: str = "ipv4"  # ipv4 | ipv6 (parity: ipv6 suite + KubeDNSIP discovery)
 
 
 
@@ -58,6 +59,13 @@ class ShellBootstrap:
         self.taints = taints
         self.custom = custom
 
+    def _dns_ip(self) -> str:
+        """kubeletConfiguration ClusterDNS wins over the cluster-discovered
+        kube-dns IP (parity: the ipv6 suite's kubeletConfig kube-dns case)."""
+        if self.kubelet.cluster_dns:
+            return self.kubelet.cluster_dns[0]
+        return self.cluster.dns_ip
+
     def script(self) -> str:
         kubelet_args = list(self.kubelet.extra_args())
         if self.labels:
@@ -70,8 +78,10 @@ class ShellBootstrap:
             f"  --apiserver-endpoint '{self.cluster.endpoint}' \\",
             f"  --b64-cluster-ca '{self.cluster.ca_bundle}' \\",
         ]
-        if self.cluster.dns_ip:
-            lines.append(f"  --dns-cluster-ip '{self.cluster.dns_ip}' \\")
+        if self._dns_ip():
+            lines.append(f"  --dns-cluster-ip '{self._dns_ip()}' \\")
+        if self.cluster.ip_family == "ipv6":
+            lines.append("  --ip-family 'ipv6' \\")
         lines.append(f"  --kubelet-extra-args '{' '.join(kubelet_args)}'")
         generated = "\n".join(lines) + "\n"
         if not self.custom:
@@ -92,9 +102,15 @@ class NodeadmBootstrap(ShellBootstrap):
                     "apiServerEndpoint": self.cluster.endpoint,
                     "certificateAuthority": self.cluster.ca_bundle,
                     "cidr": "",
+                    "ipFamily": self.cluster.ip_family,
                 },
                 "kubelet": {
-                    "flags": self.kubelet.extra_args()
+                    "flags": (
+                        [f"--cluster-dns={self._dns_ip()}"]
+                        if self._dns_ip() and not self.kubelet.cluster_dns
+                        else []
+                    )
+                    + self.kubelet.extra_args()
                     + ([f"--node-labels={_node_labels_arg(self.labels)}"] if self.labels else [])
                     + ([f"--register-with-taints={_taints_arg(self.taints)}"] if self.taints else []),
                 },
@@ -121,8 +137,8 @@ class TomlBootstrap(ShellBootstrap):
         k8s["api-server"] = self.cluster.endpoint
         if self.cluster.ca_bundle:
             k8s["cluster-certificate"] = self.cluster.ca_bundle
-        if self.cluster.dns_ip:
-            k8s["cluster-dns-ip"] = self.cluster.dns_ip
+        if self._dns_ip():
+            k8s["cluster-dns-ip"] = self._dns_ip()
         if self.kubelet.max_pods is not None:
             k8s["max-pods"] = self.kubelet.max_pods
         if self.labels:

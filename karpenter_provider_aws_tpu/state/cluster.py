@@ -27,6 +27,7 @@ class Node:
     allocatable: ResourceVector = field(default_factory=ResourceVector)
     ready: bool = False
     cordoned: bool = False
+    internal_ip: str = ""
     created_at: float = 0.0
     # monotonic timestamp of the last pod bind/unbind touching this node;
     # consolidateAfter quiet windows are measured from here

@@ -87,11 +87,12 @@ class Environment:
         return pool, nodeclass
 
 
-def new_environment(solver: Optional[Solver] = None, use_tpu_solver: bool = True) -> Environment:
+def new_environment(solver: Optional[Solver] = None, use_tpu_solver: bool = True,
+                    zones=None, cluster_info=None) -> Environment:
     clock = FakeClock()
-    cloud = FakeCloud(clock=clock)
+    cloud = FakeCloud(clock=clock, **({"zones": zones} if zones else {}))
     queue = FakeQueue()
-    catalog = CatalogProvider(clock=clock)
+    catalog = CatalogProvider(clock=clock, **({"zones": zones} if zones else {}))
     cluster = Cluster(clock=clock)
     cloudprovider = CloudProvider(
         cloud,
@@ -99,6 +100,7 @@ def new_environment(solver: Optional[Solver] = None, use_tpu_solver: bool = True
         cluster,
         clock=clock,
         batcher_options=BatcherOptions(idle_timeout_s=0.001, max_timeout_s=0.05),
+        cluster_info=cluster_info,
     )
     solver = solver or (TPUSolver() if use_tpu_solver else HostSolver())
     provisioning = ProvisioningController(cluster, solver, cloudprovider)

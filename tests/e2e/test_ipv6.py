@@ -1,0 +1,98 @@
+"""e2e: IPv6 suite (parity: test/suites/ipv6 — nodes come up with IPv6
+internal addresses; kube-dns discovery flows into bootstrap; a
+kubeletConfiguration ClusterDNS override wins)."""
+
+import ipaddress
+
+import pytest
+
+from karpenter_provider_aws_tpu.models import NodePool, Operator, Requirement
+from karpenter_provider_aws_tpu.models import labels as lbl
+from karpenter_provider_aws_tpu.models.nodeclass import KubeletConfiguration
+from karpenter_provider_aws_tpu.models.pod import make_pods
+from karpenter_provider_aws_tpu.providers.bootstrap import ClusterInfo
+from karpenter_provider_aws_tpu.testenv import new_environment
+
+DNS6 = "fd00:10::a"
+
+
+@pytest.fixture(scope="module")
+def v6_env():
+    env = new_environment(
+        cluster_info=ClusterInfo(
+            name="cluster-1", endpoint="https://cluster-1", ip_family="ipv6",
+            dns_ip=DNS6,
+        )
+    )
+    for sn in env.cloud.subnets:
+        sn.ipv6_native = True
+    return env
+
+
+@pytest.fixture(autouse=True)
+def _reset(v6_env):
+    v6_env.reset()
+    yield
+
+
+def _is_v6(addr: str) -> bool:
+    try:
+        return ipaddress.ip_address(addr).version == 6
+    except ValueError:
+        return False
+
+
+class TestIPv6E2E:
+    def test_node_gets_ipv6_internal_address(self, v6_env):
+        """Parity: ipv6 suite 'provision an IPv6 node by discovering
+        kube-dns IPv6'."""
+        env = v6_env
+        env.apply_defaults(
+            NodePool(
+                name="default",
+                requirements=[Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m", "r"))],
+            )
+        )
+        for p in make_pods(1, "w", {"cpu": "1", "memory": "2Gi"}):
+            env.cluster.apply(p)
+        env.step(4)
+        assert not env.cluster.pending_pods()
+        nodes = list(env.cluster.nodes.values())
+        assert len(nodes) == 1
+        assert _is_v6(nodes[0].internal_ip), nodes[0].internal_ip
+        # generated bootstrap carries the discovered IPv6 kube-dns + family
+        lts = list(env.cloud.launch_templates.values())
+        assert lts
+        assert any(DNS6 in lt.user_data for lt in lts)
+        assert any("--ip-family 'ipv6'" in lt.user_data for lt in lts)
+
+    def test_kubelet_cluster_dns_override_wins(self, v6_env):
+        """Parity: ipv6 suite 'kubeletConfig kube-dns IP' — an explicit
+        ClusterDNS in the pool's kubelet configuration overrides the
+        cluster-discovered address in the bootstrap."""
+        env = v6_env
+        override = "fd00:beef::10"
+        pool = NodePool(
+            name="default",
+            requirements=[Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m", "r"))],
+        )
+        pool.kubelet = KubeletConfiguration(cluster_dns=(override,))
+        env.apply_defaults(pool)
+        for p in make_pods(1, "w", {"cpu": "1", "memory": "2Gi"}):
+            env.cluster.apply(p)
+        env.step(4)
+        assert not env.cluster.pending_pods()
+        lts = list(env.cloud.launch_templates.values())
+        assert lts
+        assert any(override in lt.user_data for lt in lts)
+        # the discovered address must NOT appear as the dns-cluster-ip
+        assert not any(f"--dns-cluster-ip '{DNS6}'" in lt.user_data for lt in lts)
+
+    def test_ipv4_cluster_keeps_v4_addresses(self):
+        env = new_environment()
+        env.apply_defaults()
+        for p in make_pods(1, "w", {"cpu": "1", "memory": "2Gi"}):
+            env.cluster.apply(p)
+        env.step(4)
+        node = next(iter(env.cluster.nodes.values()))
+        assert node.internal_ip and not _is_v6(node.internal_ip)

@@ -1,0 +1,98 @@
+"""e2e: local-zone suite (parity: test/suites/localzone — a NodePool pinned
+to local zones scales up there; local zones stock a narrow family set,
+on-demand only)."""
+
+import pytest
+
+from karpenter_provider_aws_tpu.catalog.instancetypes import LOCAL_ZONE_FAMILIES
+from karpenter_provider_aws_tpu.models import NodePool, Operator, Requirement
+from karpenter_provider_aws_tpu.models import labels as lbl
+from karpenter_provider_aws_tpu.models.pod import TopologySpreadConstraint, make_pods
+from karpenter_provider_aws_tpu.testenv import new_environment
+
+LZ = "zone-lz1"
+
+
+@pytest.fixture(scope="module")
+def lz_env():
+    env = new_environment(zones=("zone-a", "zone-b", LZ))
+    env.cloud.zone_types[LZ] = "local-zone"
+    return env
+
+
+@pytest.fixture(autouse=True)
+def _reset(lz_env):
+    lz_env.reset()
+    lz_env.cloud.zone_types[LZ] = "local-zone"
+    yield
+
+
+def _lz_pool():
+    return NodePool(
+        name="default",
+        requirements=[Requirement(lbl.TOPOLOGY_ZONE, Operator.IN, (LZ,))],
+    )
+
+
+class TestLocalZoneE2E:
+    def test_scale_up_in_local_zone(self, lz_env):
+        """Parity: localzone suite_test.go 'scale up nodes in a local zone' —
+        hostname-spread pods, one node each, all landing in the LZ."""
+        env = lz_env
+        env.apply_defaults(_lz_pool())
+        pods = make_pods(
+            3, "w", {"cpu": "2", "memory": "4Gi"},
+            labels={"foo": "bar"},
+            topology_spread=[TopologySpreadConstraint(
+                topology_key=lbl.HOSTNAME, max_skew=1,
+                label_selector={"foo": "bar"},
+            )],
+        )
+        for p in pods:
+            env.cluster.apply(p)
+        env.step(4)
+        assert not env.cluster.pending_pods()
+        nodes = list(env.cluster.nodes.values())
+        assert len(nodes) == 3  # hostname spread: one pod per node
+        for node in nodes:
+            assert node.zone() == LZ
+            assert node.labels.get(lbl.ZONE_TYPE) == "local-zone"
+            assert node.capacity_type() == "on-demand"  # no LZ spot
+
+    def test_only_stocked_families_launch(self, lz_env):
+        env = lz_env
+        env.apply_defaults(_lz_pool())
+        for p in make_pods(4, "w", {"cpu": "1", "memory": "2Gi"}):
+            env.cluster.apply(p)
+        env.step(4)
+        assert not env.cluster.pending_pods()
+        for c in env.cluster.nodeclaims.values():
+            family = c.labels[lbl.INSTANCE_TYPE_LABEL].split(".")[0]
+            assert family in LOCAL_ZONE_FAMILIES
+
+    def test_family_outside_lz_stock_is_unschedulable(self, lz_env):
+        """A pod demanding a family the local zone doesn't stock must be
+        reported unschedulable, not silently placed elsewhere."""
+        env = lz_env
+        env.apply_defaults(_lz_pool())
+        pods = make_pods(
+            1, "w", {"cpu": "1", "memory": "2Gi"},
+            node_selector={lbl.INSTANCE_FAMILY: "c7g"},
+        )
+        for p in pods:
+            env.cluster.apply(p)
+        env.step(3)
+        assert env.cluster.pending_pods()
+        assert env.provisioning.last_unschedulable
+
+    def test_az_pool_ignores_local_zone(self, lz_env):
+        """Without a zone pin, the solver prefers regular AZs — the LZ's
+        price premium keeps it a last resort."""
+        env = lz_env
+        env.apply_defaults(NodePool(name="default"))
+        for p in make_pods(4, "w", {"cpu": "1", "memory": "2Gi"}):
+            env.cluster.apply(p)
+        env.step(4)
+        assert not env.cluster.pending_pods()
+        for node in env.cluster.nodes.values():
+            assert node.zone() != LZ
