@@ -255,7 +255,7 @@ class CloudProvider:
         claim.labels.update(it.labels())
         claim.labels[lbl.TOPOLOGY_ZONE] = inst.zone
         claim.labels[lbl.CAPACITY_TYPE] = inst.capacity_type
-        zone_types = getattr(self.cloud, "zone_types", None)
+        zone_types = self._zone_types()
         if zone_types:
             claim.labels[lbl.ZONE_TYPE] = zone_types.get(inst.zone, "availability-zone")
         claim.status.internal_ip = getattr(inst, "private_ip", "")
@@ -300,6 +300,18 @@ class CloudProvider:
                 claim.labels.pop(lbl.CAPACITY_RESERVATION_ID, None)
                 self.catalog.reservations.release(rid)
                 self.capacity_reservations.reset()  # stale snapshot over-counts now
+
+    def _zone_types(self) -> dict:
+        """zone -> availability-zone|local-zone via the cloud's describe API
+        (DescribeAvailabilityZones analogue), TTL-cached — zone topology
+        changes at region-buildout cadence, not per launch."""
+        hit = self._launchable_cache.get("zone-types")
+        if hit is not None:
+            return hit
+        describe = getattr(self.cloud, "describe_availability_zones", None)
+        out = describe() if describe is not None else {}
+        self._launchable_cache.set("zone-types", out)
+        return out
 
     def pool_reserved_allowed(self, nodepool) -> "set[tuple[str, str]]":
         """The (instance_type, zone) reserved offerings this pool may use:

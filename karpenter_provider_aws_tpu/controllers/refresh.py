@@ -36,7 +36,8 @@ class CatalogRefreshController:
         self.catalog.refresh(types)
         self.refreshes += 1
         # log-on-change parity: instancetype.go:149-151 pretty.ChangeMonitor
-        summary = (len(types), tuple(sorted(t.name for t in types))[:5])
+        # (hash the FULL name set — any membership change must fire the log)
+        summary = (len(types), tuple(sorted(t.name for t in types)))
         if self._monitor.has_changed("catalog", summary):
             logging.getLogger("karpenter.tpu.catalog").info(
                 "instance-type catalog refreshed: %d types", len(types)

@@ -179,8 +179,10 @@ def rank_launch_options(
     O(n_open * T log T) python/numpy on the critical path. Here the whole
     [N, T] ranking happens in one fused program: combined group price,
     capacity fit, window intersection, the exotic-type filter
-    (instance.go:456-477), then top-k cheapest. Returns (idx [N, k],
-    ok [N, k]) — idx orders types cheapest-first, ok marks real candidates.
+    (instance.go:456-477), then top-k cheapest. Returns
+    ``(idx [N, k] int16, n_valid [N] int16)`` — idx orders types
+    cheapest-first and the first n_valid[n] entries of row n are real
+    candidates (finite scores sort before -inf, so validity is a prefix).
     """
     mask = (placed > 0).T                       # [N, G]
     N, T = node_window.shape[0], price.shape[1]

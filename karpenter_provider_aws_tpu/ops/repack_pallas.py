@@ -141,12 +141,18 @@ def repack_check_pallas(
 ) -> np.ndarray:
     """ok[C] via the VMEM-resident kernel. Inputs are the *per-candidate*
     slot tables (group_ids/counts already gathered to candidate order),
-    unlike ``repack_check`` which gathers on device."""
+    unlike ``repack_check`` which gathers on device.
+
+    Every axis is padded to a bucket so the kernel compiles once per bucket,
+    not once per cluster size: nodes/lanes to 128, the candidate grid to
+    256-wide bands (padding candidates carry zero slots and are sliced off)."""
     N, R = free.shape
+    C = candidates.shape[0]
     G = requests.shape[0]
     NP = _pad_to(max(N, LANE), LANE)
     RP = _pad_to(max(R, SUBLANE), SUBLANE)
     GP = _pad_to(max(G, SUBLANE), SUBLANE)
+    CP = _pad_to(max(C, 1), 256)
 
     free_t = np.zeros((RP, NP), dtype=np.float32)
     free_t[:R, :N] = free.T
@@ -157,13 +163,21 @@ def repack_check_pallas(
     # padded node columns: free 0 / compat 0 -> never targets; padded group
     # rows only reachable from padded slots, which carry count 0
 
+    gmax = group_ids.shape[1]
+    cand_p = np.zeros(CP, dtype=np.int32)
+    cand_p[:C] = candidates
+    slots_p = np.zeros((CP, gmax), dtype=np.int32)
+    slots_p[:C] = group_ids
+    counts_p = np.zeros((CP, gmax), dtype=np.int32)
+    counts_p[:C] = group_counts
+
     out = _repack_call(
-        jnp.asarray(candidates.astype(np.int32)),
-        jnp.asarray(group_ids.astype(np.int32)),
-        jnp.asarray(group_counts.astype(np.int32)),
+        jnp.asarray(cand_p),
+        jnp.asarray(slots_p),
+        jnp.asarray(counts_p),
         jnp.asarray(free_t),
         jnp.asarray(req_t),
         jnp.asarray(compat_p),
         interpret=interpret,
     )
-    return np.asarray(out).reshape(-1).astype(bool)
+    return np.asarray(out).reshape(-1)[:C].astype(bool)

@@ -100,9 +100,10 @@ def _decode_nodes(
     A type qualifies if every group on the node accepts it (finite price)
     and its allocatable covers the node's packed resources.
 
-    ``ranked_idx``/``ranked_ok`` carry the ranking precomputed on device by
-    ``ops.ffd.rank_launch_options`` (TPU path); without them (host/native
-    solvers) the ranking runs here in numpy.
+    ``ranked_idx``/``ranked_n`` carry the ranking precomputed on device by
+    ``ops.ffd.rank_launch_options`` (TPU path; ranked_n = per-node valid
+    prefix length); without them (host/native solvers) the ranking runs
+    here in numpy.
     """
     specs: list[NodeSpec] = []
     G = len(problem.group_pods)
