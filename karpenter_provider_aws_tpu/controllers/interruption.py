@@ -83,24 +83,25 @@ class InterruptionController:
         self.cloudprovider = cloudprovider
         self.queue = queue
         self.handled: list[InterruptionEvent] = []
+        # one persistent worker pool (parity: a fixed ParallelizeUntil width,
+        # controller.go:104) — a pool per batch costs more than the work
+        self._pool = ThreadPoolExecutor(
+            max_workers=PARALLELISM, thread_name_prefix="interruption"
+        )
 
     def reconcile(self) -> None:
         messages = self.queue.receive()
         if not messages:
             return
-        # provider-id -> claim map built once per batch (controller.go:254-292)
-        claims_by_instance = {}
-        for claim in self.cluster.snapshot_claims():
-            iid = claim.status.provider_id.rsplit("/", 1)[-1]
-            if iid:
-                claims_by_instance[iid] = claim
+        # instance-id -> claim resolution is the cluster's incrementally
+        # maintained O(1) index (parity: the per-batch map of
+        # controller.go:254-292, without the re-LIST per 10-message batch)
         if len(messages) == 1:
-            self._handle(messages[0], claims_by_instance)
+            self._handle(messages[0])
         else:
-            with ThreadPoolExecutor(max_workers=min(PARALLELISM, len(messages))) as pool:
-                list(pool.map(lambda m: self._handle(m, claims_by_instance), messages))
+            list(self._pool.map(self._handle, messages))
 
-    def _handle(self, message, claims_by_instance) -> None:
+    def _handle(self, message) -> None:
         try:
             event = parse_message(message.parsed())
         except Exception:
@@ -110,7 +111,7 @@ class InterruptionController:
         INTERRUPTION_MESSAGES.inc(kind=event.kind)
         self.handled.append(event)
         for iid in event.instance_ids:
-            claim = claims_by_instance.get(iid)
+            claim = self.cluster.claim_by_instance_id(iid)
             if claim is None:
                 continue
             if event.kind == "SpotInterruption":

@@ -143,6 +143,7 @@ def launch_claim(cluster: Cluster, cloudprovider: CloudProvider, pool, spec: Nod
     cluster.apply(claim)
     try:
         cloudprovider.create(claim)
+        cluster.apply(claim)  # re-apply: provider_id set -> claims_seq bump
         from ..metrics import NODES_CREATED
 
         NODES_CREATED.inc(nodepool=pool.name)

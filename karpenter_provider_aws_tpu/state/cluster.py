@@ -59,6 +59,14 @@ class Cluster:
         # Control-plane version surfaced to the version provider (parity:
         # the discovery client behind version.go; fakes set this directly).
         self.server_version: str = "1.29"
+        # Monotonic claim-store version: bumps on any nodeclaim add/remove/
+        # provider-id change, so derived snapshots can cache per version.
+        self.claims_seq: int = 0
+        # Incrementally-maintained instance-id index (the "indexed views"
+        # this class promises): O(1) per mutation, so a 15k-message
+        # interruption drain never re-lists the whole claim store per batch.
+        self._claims_by_iid: dict[str, NodeClaim] = {}
+        self._claim_iid: dict[str, str] = {}  # claim name -> indexed iid
 
     def _now(self) -> float:
         return self.clock.now() if self.clock is not None else 0.0
@@ -72,6 +80,8 @@ class Cluster:
                 self.nodeclasses[obj.name] = obj
             elif isinstance(obj, NodeClaim):
                 self.nodeclaims[obj.name] = obj
+                self.claims_seq += 1
+                self._index_claim(obj)
             elif isinstance(obj, Node):
                 self.nodes[obj.name] = obj
             elif isinstance(obj, Pod):
@@ -90,9 +100,14 @@ class Cluster:
                     self.nodeclasses.pop(obj.name, None)
             elif isinstance(obj, NodeClaim):
                 if obj.finalizers:
+                    # mark-only: membership and provider-id bindings are
+                    # unchanged, so claim indexes stay valid (they read the
+                    # live `deleted` flag off the shared object)
                     obj.deleted = True
                 else:
                     self.nodeclaims.pop(obj.name, None)
+                    self.claims_seq += 1
+                    self._unindex_claim(obj)
             elif isinstance(obj, Node):
                 self.nodes.pop(obj.name, None)
             elif isinstance(obj, Pod):
@@ -109,8 +124,32 @@ class Cluster:
             obj.finalizers.clear()
             if isinstance(obj, NodeClaim):
                 self.nodeclaims.pop(obj.name, None)
+                self.claims_seq += 1
+                self._unindex_claim(obj)
             elif isinstance(obj, NodeClass):
                 self.nodeclasses.pop(obj.name, None)
+
+    def _index_claim(self, claim: NodeClaim) -> None:
+        iid = claim.status.provider_id.rsplit("/", 1)[-1]
+        old = self._claim_iid.get(claim.name)
+        if old is not None and old != iid:
+            if self._claims_by_iid.get(old) is claim:
+                self._claims_by_iid.pop(old, None)
+        if iid:
+            self._claims_by_iid[iid] = claim
+            self._claim_iid[claim.name] = iid
+
+    def _unindex_claim(self, claim: NodeClaim) -> None:
+        iid = self._claim_iid.pop(claim.name, None)
+        if iid is not None and self._claims_by_iid.get(iid) is claim:
+            self._claims_by_iid.pop(iid, None)
+
+    def claim_by_instance_id(self, instance_id: str) -> Optional[NodeClaim]:
+        """O(1) lookup of the claim backing a cloud instance (parity: the
+        per-batch instance-id map of interruption controller.go:254-292,
+        kept fresh incrementally instead of rebuilt by LIST)."""
+        with self._lock:
+            return self._claims_by_iid.get(instance_id)
 
     # -- views -------------------------------------------------------------
     def pending_pods(self) -> list[Pod]:
