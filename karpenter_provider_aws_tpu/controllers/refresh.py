@@ -35,6 +35,9 @@ class CatalogRefreshController:
         types = self.source() if self.source else generate_catalog(self.catalog.zones)
         self.catalog.refresh(types)
         self.refreshes += 1
+        from ..metrics import publish_catalog_metrics
+
+        publish_catalog_metrics(types)
         # log-on-change parity: instancetype.go:149-151 pretty.ChangeMonitor
         # (hash the FULL name set — any membership change must fire the log)
         summary = (len(types), tuple(sorted(t.name for t in types)))

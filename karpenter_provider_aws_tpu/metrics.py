@@ -187,3 +187,35 @@ BATCH_SIZE = REGISTRY.histogram(
 ICE_EVENTS = REGISTRY.counter(
     "karpenter_insufficient_capacity_errors_total", "ICE occurrences"
 )
+BATCH_WINDOW = REGISTRY.histogram(
+    "karpenter_batcher_window_seconds",
+    "Time from a batch's first request to execution (parity: batcher window histograms, metrics.go:37-47)",
+    buckets=(0.001, 0.005, 0.01, 0.035, 0.1, 0.3, 1.0, 3.0),
+)
+# Catalog gauges (parity: instancetype metrics.go:32-75 — vCPU/memory per
+# type, offering price/availability per (type, zone, capacity type)).
+INSTANCE_TYPE_VCPU = REGISTRY.gauge(
+    "karpenter_instance_type_cpu_cores", "vCPU cores per instance type"
+)
+INSTANCE_TYPE_MEMORY = REGISTRY.gauge(
+    "karpenter_instance_type_memory_bytes", "Memory per instance type"
+)
+OFFERING_PRICE = REGISTRY.gauge(
+    "karpenter_instance_type_offering_price_estimate", "Offering $/hr"
+)
+OFFERING_AVAILABLE = REGISTRY.gauge(
+    "karpenter_instance_type_offering_available", "Offering availability (0/1)"
+)
+
+
+def publish_catalog_metrics(types) -> None:
+    """Refresh-time gauge publication (instancetype metrics.go parity)."""
+    for it in types:
+        INSTANCE_TYPE_VCPU.set(float(it.vcpus), instance_type=it.name)
+        INSTANCE_TYPE_MEMORY.set(float(it.memory_mib) * 1024 * 1024, instance_type=it.name)
+        for o in it.offerings:
+            labels = dict(
+                instance_type=it.name, zone=o.zone, capacity_type=o.capacity_type
+            )
+            OFFERING_PRICE.set(float(o.price), **labels)
+            OFFERING_AVAILABLE.set(1.0 if o.available else 0.0, **labels)

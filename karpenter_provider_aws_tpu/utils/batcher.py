@@ -99,10 +99,12 @@ class Batcher(Generic[T, U]):
         t.start()
 
     def _flush(self, key: Hashable) -> None:
+        import time as _time
+
         with self._lock:
             bucket = self._buckets.pop(key, [])
             timer = self._timers.pop(key, None)
-            self._first_seen.pop(key, None)
+            first = self._first_seen.pop(key, None)
             if timer is not None:
                 timer.cancel()
         if not bucket:
@@ -110,9 +112,11 @@ class Batcher(Generic[T, U]):
         self.batches_executed += 1
         self.batch_sizes.append(len(bucket))
         try:
-            from ..metrics import BATCH_SIZE
+            from ..metrics import BATCH_SIZE, BATCH_WINDOW
 
             BATCH_SIZE.observe(len(bucket))
+            if first is not None:
+                BATCH_WINDOW.observe(_time.monotonic() - first)
         except Exception:
             pass
         try:

@@ -95,3 +95,34 @@ class TestOptionsWiring:
         assert not env.cluster.pending_pods()
         out = list(os.walk(str(tmp_path / "solve")))
         assert any(files for _, _, files in out)
+
+
+class TestCatalogMetrics:
+    def test_refresh_publishes_gauges(self):
+        from karpenter_provider_aws_tpu.catalog import CatalogProvider
+        from karpenter_provider_aws_tpu.controllers.refresh import CatalogRefreshController
+        from karpenter_provider_aws_tpu.metrics import (
+            INSTANCE_TYPE_VCPU,
+            OFFERING_AVAILABLE,
+            OFFERING_PRICE,
+        )
+
+        catalog = CatalogProvider()
+        ctl = CatalogRefreshController(catalog)
+        ctl.reconcile()
+        it = catalog.list()[0]
+        assert INSTANCE_TYPE_VCPU.value(instance_type=it.name) == float(it.vcpus)
+        o = it.offerings[0]
+        labels = dict(instance_type=it.name, zone=o.zone, capacity_type=o.capacity_type)
+        assert OFFERING_PRICE.value(**labels) == float(o.price)
+        assert OFFERING_AVAILABLE.value(**labels) in (0.0, 1.0)
+
+    def test_batch_window_observed(self):
+        from karpenter_provider_aws_tpu.metrics import BATCH_WINDOW
+        from karpenter_provider_aws_tpu.utils.batcher import Batcher, BatcherOptions
+
+        b = Batcher(lambda reqs: [r for r in reqs],
+                    options=BatcherOptions(idle_timeout_s=0.001, max_timeout_s=0.01))
+        assert b.add(1) == 1
+        text = BATCH_WINDOW.expose()
+        assert any("karpenter_batcher_window_seconds" in line for line in text)
