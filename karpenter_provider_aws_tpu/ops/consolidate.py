@@ -197,34 +197,47 @@ def repack_check(
     return jax.vmap(one)(candidates)
 
 
-def _use_pallas_repack(ct: ClusterTensors) -> bool:
-    """Pallas kernel on real accelerators when the shared blocks fit VMEM;
-    the XLA vmap path otherwise. KARPENTER_TPU_REPACK=pallas|vmap overrides."""
+def _repack_backend(ct: ClusterTensors) -> str:
+    """pallas on real accelerators when the shared blocks fit VMEM; the XLA
+    vmap path otherwise; 'native' (C++) available for JAX-free deployments.
+    KARPENTER_TPU_REPACK=pallas|vmap|native overrides."""
     import os
 
     mode = os.environ.get("KARPENTER_TPU_REPACK", "auto")
-    if mode == "vmap":
-        return False
-    if mode == "pallas":
-        return True
+    if mode in ("vmap", "pallas", "native"):
+        return mode
     from .repack_pallas import VMEM_BUDGET_BYTES, repack_vmem_bytes
 
     if jax.default_backend() == "cpu":
-        return False  # interpret mode is for tests, not serving
+        return "vmap"  # interpret mode is for tests, not serving
     N, R = ct.free.shape
-    return repack_vmem_bytes(N, ct.requests.shape[0], R) <= VMEM_BUDGET_BYTES
+    if repack_vmem_bytes(N, ct.requests.shape[0], R) <= VMEM_BUDGET_BYTES:
+        return "pallas"
+    return "vmap"
 
 
 def consolidatable(ct: ClusterTensors, chunk: int = 512) -> np.ndarray:
     """can_delete[N]: pallas VMEM-resident kernel (one grid program per
-    candidate, zero HBM traffic in the slot loop) or chunked vmap lanes."""
+    candidate, zero HBM traffic in the slot loop), chunked vmap lanes, or
+    the C++ kernel."""
     N = len(ct.node_names)
     out = np.zeros(N, dtype=bool)
-    if _use_pallas_repack(ct):
+    backend = _repack_backend(ct)
+    if backend == "pallas":
         from .repack_pallas import repack_check_pallas
 
         cand = np.arange(N, dtype=np.int32)
         out[:] = repack_check_pallas(
+            ct.free, ct.requests, ct.group_ids, ct.group_counts,
+            ct.compat, cand,
+        )
+        out &= ~ct.blocked
+        return out
+    if backend == "native":
+        from ..scheduling.native import repack_check_native
+
+        cand = np.arange(N, dtype=np.int32)
+        out[:] = repack_check_native(
             ct.free, ct.requests, ct.group_ids, ct.group_counts,
             ct.compat, cand,
         )

@@ -74,8 +74,52 @@ def load_library() -> ctypes.CDLL:
             ctypes.POINTER(ctypes.c_int32),    # placed
             ctypes.POINTER(ctypes.c_int32),    # unplaced
         ]
+        lib.repack_check_native.restype = ctypes.c_int
+        lib.repack_check_native.argtypes = [
+            ctypes.POINTER(ctypes.c_float),    # free
+            ctypes.POINTER(ctypes.c_float),    # requests
+            ctypes.POINTER(ctypes.c_int32),    # group_ids
+            ctypes.POINTER(ctypes.c_int32),    # group_counts
+            ctypes.POINTER(ctypes.c_uint8),    # compat
+            ctypes.POINTER(ctypes.c_int32),    # candidates
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint8),    # ok out
+        ]
         _lib = lib
         return lib
+
+
+def repack_check_native(
+    free: np.ndarray,          # [N, R] float32
+    requests: np.ndarray,      # [G, R] float32
+    group_ids: np.ndarray,     # [C, GMAX] int32 (candidate-gathered rows)
+    group_counts: np.ndarray,  # [C, GMAX] int32
+    compat: np.ndarray,        # [G, N] bool
+    candidates: np.ndarray,    # [C] int32
+) -> np.ndarray:
+    """ok[C] via the C++ kernel — the JAX-free consolidation proof (same
+    semantics as ops/consolidate.repack_check and the pallas kernel)."""
+    lib = load_library()
+    free = np.ascontiguousarray(free, dtype=np.float32)
+    requests = np.ascontiguousarray(requests, dtype=np.float32)
+    group_ids = np.ascontiguousarray(group_ids, dtype=np.int32)
+    group_counts = np.ascontiguousarray(group_counts, dtype=np.int32)
+    compat_u8 = np.ascontiguousarray(compat, dtype=np.uint8)
+    candidates = np.ascontiguousarray(candidates, dtype=np.int32)
+    C, gmax = group_ids.shape
+    N, R = free.shape
+    G = requests.shape[0]
+    out = np.zeros(C, dtype=np.uint8)
+    rc = lib.repack_check_native(
+        _ptr(free, ctypes.c_float), _ptr(requests, ctypes.c_float),
+        _ptr(group_ids, ctypes.c_int32), _ptr(group_counts, ctypes.c_int32),
+        _ptr(compat_u8, ctypes.c_uint8), _ptr(candidates, ctypes.c_int32),
+        C, gmax, N, G, R,
+        _ptr(out, ctypes.c_uint8),
+    )
+    if rc != 0:
+        raise RuntimeError("native repack rejected inputs")
+    return out.astype(bool)
 
 
 def native_available() -> bool:

@@ -139,3 +139,51 @@ int ffd_solve_native(
 }
 
 }  // extern "C"
+
+extern "C" {
+
+// Consolidation repack proof (the native analogue of ops/consolidate.py's
+// repack_check / the pallas kernel): for each candidate node, do its pod
+// groups first-fit into the OTHER nodes' free capacity? Semantics identical
+// to the device paths: index-order first-fit, kEps floor arithmetic,
+// self-exclusion, per-slot leftovers.
+// Shapes: free[N*R] f32, requests[G*R] f32, group_ids[C*GMAX] i32,
+// group_counts[C*GMAX] i32, compat[G*N] u8, candidates[C] i32.
+// Output: ok[C] u8. Returns 0, or -1 on bad input.
+int repack_check_native(
+    const float* free_mat, const float* requests, const int32_t* group_ids,
+    const int32_t* group_counts, const uint8_t* compat,
+    const int32_t* candidates,
+    int C, int GMAX, int N, int G, int R,
+    uint8_t* ok_out) {
+    if (C < 0 || GMAX < 0 || N <= 0 || G <= 0 || R <= 0) return -1;
+    std::vector<float> free_c(static_cast<size_t>(N) * R);
+    for (int c = 0; c < C; ++c) {
+        const int self = candidates[c];
+        if (self < 0 || self >= N) return -1;
+        std::memcpy(free_c.data(), free_mat, sizeof(float) * N * R);
+        bool ok = true;
+        for (int s = 0; s < GMAX && ok; ++s) {
+            const int g = group_ids[c * GMAX + s];
+            int cnt = group_counts[c * GMAX + s];
+            if (cnt <= 0) continue;
+            if (g < 0 || g >= G) return -1;
+            const float* req = requests + static_cast<size_t>(g) * R;
+            for (int n = 0; n < N && cnt > 0; ++n) {
+                if (n == self || !compat[static_cast<size_t>(g) * N + n]) continue;
+                int k = fit_count(free_c.data() + static_cast<size_t>(n) * R,
+                                  nullptr, req, R);
+                if (k <= 0) continue;
+                const int take = k < cnt ? k : cnt;
+                float* fc = free_c.data() + static_cast<size_t>(n) * R;
+                for (int r = 0; r < R; ++r) fc[r] -= take * req[r];
+                cnt -= take;
+            }
+            if (cnt > 0) ok = false;
+        }
+        ok_out[c] = ok ? 1 : 0;
+    }
+    return 0;
+}
+
+}  // extern "C"

@@ -105,3 +105,51 @@ class TestBudget:
     def test_vmem_estimate_monotone(self):
         assert repack_vmem_bytes(5000, 64) < repack_vmem_bytes(5000, 2048)
         assert repack_vmem_bytes(5000, 64) < VMEM_BUDGET_BYTES  # bench scale fits
+
+
+class TestNativeRepack:
+    """The C++ repack kernel must agree with the vmap oracle too (the three
+    backends — vmap, pallas, native — are interchangeable proofs)."""
+
+    @pytest.mark.parametrize("seed,N,G,GMAX", [(0, 40, 8, 4), (5, 90, 12, 8)])
+    def test_matches_oracle(self, seed, N, G, GMAX):
+        native = pytest.importorskip("karpenter_provider_aws_tpu.scheduling.native")
+        try:
+            native.load_library()
+        except Exception as e:
+            pytest.skip(f"native toolchain unavailable: {e}")
+        rng = np.random.RandomState(seed)
+        free, requests, gids, gcounts, compat = _random_problem(rng, N, G, GMAX)
+        cand = np.arange(N, dtype=np.int32)
+        ref = _oracle(free, requests, gids, gcounts, compat, cand)
+        got = native.repack_check_native(free, requests, gids, gcounts, compat, cand)
+        assert (ref == got).all()
+
+    def test_consolidatable_native_backend(self, monkeypatch):
+        native = pytest.importorskip("karpenter_provider_aws_tpu.scheduling.native")
+        try:
+            native.load_library()
+        except Exception as e:
+            pytest.skip(f"native toolchain unavailable: {e}")
+        from karpenter_provider_aws_tpu.models import Disruption, NodePool, Operator, Requirement
+        from karpenter_provider_aws_tpu.models import labels as lbl
+        from karpenter_provider_aws_tpu.models.pod import make_pods
+        from karpenter_provider_aws_tpu.ops.consolidate import consolidatable, encode_cluster
+        from karpenter_provider_aws_tpu.testenv import new_environment
+
+        env = new_environment(use_tpu_solver=False)
+        env.apply_defaults(NodePool(
+            name="default",
+            requirements=[Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m", "r"))],
+            disruption=Disruption(consolidate_after_s=None),
+        ))
+        for p in make_pods(6, "w", {"cpu": "1", "memory": "2Gi"}):
+            env.cluster.apply(p)
+        env.step(3)
+        ct = encode_cluster(env.cluster, env.catalog)
+        assert ct is not None
+        monkeypatch.setenv("KARPENTER_TPU_REPACK", "native")
+        got = consolidatable(ct)
+        monkeypatch.setenv("KARPENTER_TPU_REPACK", "vmap")
+        ref = consolidatable(ct)
+        assert (got == ref).all()
