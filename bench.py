@@ -3,24 +3,98 @@
 Workload = BASELINE.json config #2-flavored: 50k heterogeneous pods (64
 distinct shapes, mixed constraints) x the full ~700-type catalog. The
 reference's greedy runs this loop on CPU inside the provisioner; the target
-is p99 < 200 ms on one TPU chip (BASELINE.md north star).
+is p99 < 200 ms on one TPU chip (BASELINE.md north star;
+reference scale suite: test/suites/scale/provisioning_test.go:84-121).
+
+Resilience contract (round-1 post-mortem: the whole round lost its only
+hardware datum to an uncaught backend-init error):
+  * The accelerator backend is probed in a SUBPROCESS first — a poisoned
+    backend init can never take down the measurement harness.
+  * Transient ``Unavailable`` init errors are retried with backoff.
+  * If the accelerator never comes up, the bench re-execs itself on CPU at
+    reduced scale and reports ``"device": "cpu-fallback"`` plus the probe
+    error — a degraded number beats no number.
+  * stdout carries exactly ONE JSON line, ALWAYS — even on unrecoverable
+    failure (then with an ``"error"`` field).
 
 Prints exactly ONE JSON line:
-  {"metric": ..., "value": ..., "unit": "ms", "vs_baseline": ...}
+  {"metric": ..., "value": ..., "unit": "ms", "vs_baseline": ..., ...}
 ``vs_baseline`` is target_ms / measured_p99 (>1.0 means beating the 200 ms
-target).
+target). Per-config latency + packed-cost-ratio detail for all 5 BASELINE
+configs is appended to ``BENCH_DETAIL.jsonl`` when BENCH_CONFIGS=1.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
+import traceback
 
 import numpy as np
 
 TARGET_MS = 200.0
+PROBE_ATTEMPTS = int(os.environ.get("BENCH_PROBE_ATTEMPTS", 3))
+PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", 300))
+PROBE_SLEEP_S = float(os.environ.get("BENCH_PROBE_SLEEP_S", 10))
+_FALLBACK_ENV = "BENCH_CPU_FALLBACK"
+
+_PROBE_SNIPPET = (
+    "import jax; ds = jax.devices(); "
+    "print('OK', jax.default_backend(), len(ds), ds[0].platform)"
+)
+
+
+def emit(obj: dict) -> None:
+    """The one stdout JSON line. Everything else goes to stderr."""
+    sys.stdout.write(json.dumps(obj) + "\n")
+    sys.stdout.flush()
+
+
+def probe_backend() -> tuple[bool, str]:
+    """Try accelerator init in a subprocess; returns (ok, info_or_error).
+
+    Subprocess isolation matters twice over: a hung init can be timed out,
+    and a failed init doesn't leave a poisoned backend cache in this
+    process (jax caches backend-init failure for the process lifetime).
+    """
+    last_err = ""
+    for attempt in range(1, PROBE_ATTEMPTS + 1):
+        t0 = time.time()
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", _PROBE_SNIPPET],
+                capture_output=True,
+                text=True,
+                timeout=PROBE_TIMEOUT_S,
+                cwd="/",
+            )
+        except subprocess.TimeoutExpired:
+            last_err = f"probe attempt {attempt} timed out after {PROBE_TIMEOUT_S}s"
+            print(last_err, file=sys.stderr)
+            continue
+        if out.returncode == 0 and "OK" in out.stdout:
+            info = out.stdout.strip().splitlines()[-1]
+            print(
+                f"backend probe ok (attempt {attempt}, {time.time()-t0:.1f}s): {info}",
+                file=sys.stderr,
+            )
+            return True, info
+        tail = (out.stderr or out.stdout).strip().splitlines()[-3:]
+        last_err = f"probe attempt {attempt} rc={out.returncode}: " + " | ".join(tail)
+        print(last_err, file=sys.stderr)
+        # Only sleep-retry on plausibly-transient failures; a structural
+        # error (ImportError etc.) won't heal.
+        transient = any(
+            k in last_err for k in ("UNAVAILABLE", "Unavailable", "DEADLINE", "timed out", "RESOURCE_EXHAUSTED")
+        )
+        if not transient:
+            break
+        if attempt < PROBE_ATTEMPTS:
+            time.sleep(PROBE_SLEEP_S * attempt)
+    return False, last_err
 
 
 def build_problem(num_pods: int):
@@ -39,7 +113,7 @@ def build_problem(num_pods: int):
     rng = np.random.RandomState(0)
     pods = []
     n_shapes = 64
-    per_shape = num_pods // n_shapes
+    per_shape = max(1, num_pods // n_shapes)
     for i in range(n_shapes):
         cpu_m = int(rng.choice([100, 250, 500, 1000, 2000, 4000, 8000]))
         mem_mi = cpu_m * int(rng.choice([1, 2, 4, 8]))
@@ -54,12 +128,7 @@ def build_problem(num_pods: int):
     return pad_problem(problem)
 
 
-def main() -> None:
-    num_pods = int(os.environ.get("BENCH_PODS", 50_000))
-    iters = int(os.environ.get("BENCH_ITERS", 300))
-    warmup = int(os.environ.get("BENCH_WARMUP", 20))
-    max_nodes = int(os.environ.get("BENCH_MAX_NODES", 4096))
-
+def measure(num_pods: int, iters: int, warmup: int, max_nodes: int) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -99,16 +168,98 @@ def main() -> None:
         run()
         times.append((time.perf_counter() - t0) * 1000.0)
     p99 = float(np.percentile(times, 99))
-    print(
-        json.dumps(
-            {
-                "metric": f"p99_ffd_solve_latency_{num_pods}pods_x_{problem.capacity.shape[0]}types",
-                "value": round(p99, 3),
-                "unit": "ms",
-                "vs_baseline": round(TARGET_MS / p99, 3),
-            }
-        )
-    )
+    return {
+        "metric": f"p99_ffd_solve_latency_{num_pods}pods_x_{problem.capacity.shape[0]}types",
+        "value": round(p99, 3),
+        "unit": "ms",
+        "vs_baseline": round(TARGET_MS / p99, 3),
+        "p50_ms": round(float(np.percentile(times, 50)), 3),
+        "device": jax.devices()[0].platform,
+        "iters": iters,
+    }
+
+
+def run_config_detail(scale: float, iters: int) -> None:
+    """All 5 BASELINE configs (latency + packed-cost ratio) → BENCH_DETAIL.jsonl."""
+    try:
+        import contextlib
+
+        from benchmarks.solve_configs import run_all
+
+        # run_all prints per-config rows; keep stdout reserved for the one
+        # primary JSON line.
+        with contextlib.redirect_stdout(sys.stderr):
+            rows = run_all(scale=scale, iters=iters)
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.jsonl"), "a") as f:
+            stamp = {"run_at_unix": int(time.time()), "scale": scale}
+            for row in rows:
+                f.write(json.dumps({**row, **stamp}) + "\n")
+    except Exception:
+        print("config-detail sweep failed:", file=sys.stderr)
+        traceback.print_exc()
+
+
+def main() -> None:
+    on_cpu_fallback = os.environ.get(_FALLBACK_ENV) == "1"
+    probe_err = os.environ.get("BENCH_PROBE_ERROR", "")
+
+    if on_cpu_fallback:
+        # The axon TPU-tunnel sitecustomize force-registers its platform via
+        # jax.config, which beats the JAX_PLATFORMS env var — override it
+        # back in-process or the "CPU" fallback would hang on tunnel init.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    if not on_cpu_fallback:
+        ok, info = probe_backend()
+        if not ok:
+            # Re-exec on CPU at reduced scale: a degraded measurement beats
+            # none (round-1 shipped rc=1 and zero data).
+            print("accelerator unavailable; re-exec on CPU fallback", file=sys.stderr)
+            env = dict(os.environ)
+            env.update({
+                "JAX_PLATFORMS": "cpu",
+                _FALLBACK_ENV: "1",
+                "BENCH_PROBE_ERROR": info[:500],
+                "BENCH_PODS": os.environ.get("BENCH_PODS_CPU", "8000"),
+                "BENCH_ITERS": os.environ.get("BENCH_ITERS_CPU", "30"),
+                "BENCH_WARMUP": "3",
+                "BENCH_MAX_NODES": os.environ.get("BENCH_MAX_NODES_CPU", "1024"),
+            })
+            res = subprocess.run([sys.executable, os.path.abspath(__file__)], env=env)
+            sys.exit(res.returncode)
+
+    num_pods = int(os.environ.get("BENCH_PODS", 50_000))
+    iters = int(os.environ.get("BENCH_ITERS", 300))
+    warmup = int(os.environ.get("BENCH_WARMUP", 20))
+    max_nodes = int(os.environ.get("BENCH_MAX_NODES", 4096))
+
+    try:
+        out = measure(num_pods, iters, warmup, max_nodes)
+    except Exception as e:
+        traceback.print_exc()
+        emit({
+            "metric": "p99_ffd_solve_latency",
+            "value": None,
+            "unit": "ms",
+            "vs_baseline": 0.0,
+            "error": f"{type(e).__name__}: {e}"[:800],
+            "device": "cpu-fallback" if on_cpu_fallback else "unknown",
+        })
+        sys.exit(0)  # rc=0: the JSON line IS the result, error field included
+
+    if on_cpu_fallback:
+        out["device"] = "cpu-fallback"
+        out["probe_error"] = probe_err
+        # CPU latency is not the north-star target; report honestly but keep
+        # vs_baseline comparable (target is a TPU target).
+    emit(out)
+
+    if os.environ.get("BENCH_CONFIGS", "1") == "1":
+        scale = float(os.environ.get("BENCH_CONFIG_SCALE", "0.2" if on_cpu_fallback else "1.0"))
+        citers = int(os.environ.get("BENCH_CONFIG_ITERS", "3" if on_cpu_fallback else "10"))
+        run_config_detail(scale, citers)
 
 
 if __name__ == "__main__":

@@ -294,8 +294,15 @@ class CloudProvider:
         if rid:
             try:
                 terminated = self.cloud.get_instance(instance_id).state == "terminated"
-            except Exception:
+            except errors.NotFoundError:
                 terminated = True  # instance already gone
+            except Exception:
+                # A transient describe error (throttle, injected fault) says
+                # nothing about instance state — keep the label so a retried
+                # delete re-confirms, and let the status reconcile re-sync
+                # counts. Releasing here would over-advertise the reserved
+                # offering and invite an ICE blacklist.
+                terminated = False
             if terminated:
                 claim.labels.pop(lbl.CAPACITY_RESERVATION_ID, None)
                 self.catalog.reservations.release(rid)

@@ -84,12 +84,26 @@ class Pod:
     # lazily computed by scheduling_key(); excluded from comparisons
     _scheduling_key: Optional[tuple] = field(default=None, repr=False, compare=False)
 
+    # Fields covered by scheduling_key(); assigning any of them invalidates
+    # the cached key. (In-place mutation of a field's container — e.g.
+    # ``pod.node_selector["k"] = v`` — is not detectable; assign a fresh
+    # value instead, which is what all in-tree callers do.)
+    _KEY_FIELDS = frozenset({
+        "requests", "node_selector", "node_affinity", "tolerations",
+        "topology_spread", "anti_affinity", "affinity",
+    })
+
     def __post_init__(self):
         if not self.uid:
             self.uid = f"pod-{next(_uid_counter)}"
         # One pod slot is always consumed.
         if self.requests.get("pods") == 0:
             self.requests.set("pods", 1)
+
+    def __setattr__(self, name, value):
+        if name in Pod._KEY_FIELDS and getattr(self, "_scheduling_key", None) is not None:
+            object.__setattr__(self, "_scheduling_key", None)
+        object.__setattr__(self, name, value)
 
     # -- scheduling views --------------------------------------------------
     def requirements(self) -> Requirements:

@@ -476,7 +476,20 @@ def encode_problem(
         group_zone_allowed=zone_allowed,
         group_captype_allowed=captype_allowed,
         max_per_node=max_per_node,
-        type_exotic=np.array([getattr(t, "bare_metal", False) for t in types], dtype=bool),
+        # Exotic = never a silent launch *alternative*: bare-metal AND
+        # accelerator hardware (reference filterExoticInstanceTypes,
+        # instance.go:456-477 — GPU/Neuron types are excluded from ranked
+        # options unless the committed choice itself is one, which the
+        # ffd-side filter already special-cases via ``exotic[committed]``).
+        type_exotic=np.array(
+            [
+                getattr(t, "bare_metal", False)
+                or getattr(t, "gpu_count", 0) > 0
+                or getattr(t, "accelerator_count", 0) > 0
+                for t in types
+            ],
+            dtype=bool,
+        ),
         unencodable=unencodable,
     )
 

@@ -133,7 +133,12 @@ class Cluster:
         iid = claim.status.provider_id.rsplit("/", 1)[-1]
         old = self._claim_iid.get(claim.name)
         if old is not None and old != iid:
-            if self._claims_by_iid.get(old) is claim:
+            # The stale entry may hold a *previous object* for this claim
+            # name (re-apply builds a new NodeClaim), so match by name, not
+            # object identity — otherwise interruption events would resolve
+            # the old instance id to a defunct claim.
+            prev = self._claims_by_iid.get(old)
+            if prev is not None and prev.name == claim.name:
                 self._claims_by_iid.pop(old, None)
         if iid:
             self._claims_by_iid[iid] = claim
@@ -141,8 +146,12 @@ class Cluster:
 
     def _unindex_claim(self, claim: NodeClaim) -> None:
         iid = self._claim_iid.pop(claim.name, None)
-        if iid is not None and self._claims_by_iid.get(iid) is claim:
-            self._claims_by_iid.pop(iid, None)
+        if iid is not None:
+            # Match by name, not object identity: the delete may arrive with
+            # a superseded object for this claim name (see _index_claim).
+            prev = self._claims_by_iid.get(iid)
+            if prev is not None and prev.name == claim.name:
+                self._claims_by_iid.pop(iid, None)
 
     def claim_by_instance_id(self, instance_id: str) -> Optional[NodeClaim]:
         """O(1) lookup of the claim backing a cloud instance (parity: the

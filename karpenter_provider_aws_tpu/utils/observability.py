@@ -97,11 +97,20 @@ class _Capture:
             if self._p._active:  # one capture at a time; nested = annotation
                 return self
             self._p._active = True
-        import jax.profiler
+        try:
+            import jax.profiler
 
-        path = os.path.join(self._p.profile_dir, self._name)
-        os.makedirs(path, exist_ok=True)
-        jax.profiler.start_trace(path)
+            path = os.path.join(self._p.profile_dir, self._name)
+            os.makedirs(path, exist_ok=True)
+            jax.profiler.start_trace(path)
+        except Exception as e:
+            # A failed start (bad dir, a second trace already running
+            # in-process) must not leave _active stuck True, or every
+            # future capture silently no-ops for the process lifetime.
+            with self._p._lock:
+                self._p._active = False
+            logging.getLogger(__name__).warning("profiler capture failed: %s", e)
+            return self
         self._started = True
         return self
 
