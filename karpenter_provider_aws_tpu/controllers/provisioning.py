@@ -33,13 +33,15 @@ class ProvisioningController:
     interval_s = 10.0
 
     def __init__(self, cluster: Cluster, solver: Solver, cloudprovider: CloudProvider,
-                 profiler=None):
+                 profiler=None, clock=None):
+        from ..utils.clock import RealClock
         from ..utils.observability import Profiler
 
         self.cluster = cluster
         self.solver = solver
         self.cloudprovider = cloudprovider
         self.profiler = profiler or Profiler()
+        self.clock = clock or getattr(cloudprovider, "clock", None) or RealClock()
         # pod uid -> claim name nominations (kube-scheduler binds for real;
         # the registration controller honors these on node readiness)
         self.nominations: dict[str, str] = {}
@@ -57,6 +59,7 @@ class ProvisioningController:
         if not nodepools:
             return
         from ..ops.encode import ZoneOccupancy
+        from ..scheduling.solver import snapshot_existing_capacity
 
         with self.profiler.capture("solve"):
             result = self.solver.solve(
@@ -73,6 +76,10 @@ class ProvisioningController:
                     pool.name: self.cloudprovider.pool_reserved_allowed(pool)
                     for pool in nodepools
                 },
+                # Live nodes ride into the solve as pre-opened capacity, so
+                # pending pods land on existing slack inside the device
+                # program instead of a host-side rebinder loop.
+                existing=snapshot_existing_capacity(self.cluster),
             )
         from ..metrics import SOLVE_DURATION, SOLVE_PODS
 
@@ -81,6 +88,7 @@ class ProvisioningController:
         self.last_unschedulable = result.unschedulable
         for pod, reason in result.unschedulable:
             log.info("pod %s unschedulable: %s", pod.name, reason)
+        self._apply_binds(result.binds)
         specs = result.node_specs
         if not specs:
             return
@@ -89,6 +97,33 @@ class ProvisioningController:
         else:
             with ThreadPoolExecutor(max_workers=min(MAX_LAUNCH_WORKERS, len(specs))) as pool:
                 list(pool.map(self._launch, specs))
+
+    def _apply_binds(self, binds) -> None:
+        """Bind planned pods onto existing nodes, re-verifying slack at apply
+        time: the 1 s host binder may have consumed the snapshotted free
+        capacity during a multi-second solve, and binding past it would
+        overcommit the node. Skipped pods stay pending and re-enter the next
+        solve."""
+        if not binds:
+            return
+        usage = self.cluster.node_usage()
+        nodes = {n.name: n for n in self.cluster.snapshot_nodes()}
+        free: dict[str, object] = {}
+        for pod, node_name in binds:
+            live = self.cluster.pods.get(pod.uid)
+            if live is None or not live.is_pending():
+                continue
+            node = nodes.get(node_name)
+            if node is None or not node.ready or node.cordoned:
+                continue
+            f = free.get(node_name)
+            if f is None:
+                used = usage.get(node_name)
+                f = node.allocatable.v - (used if used is not None else 0)
+            if (pod.requests.v > f + 1e-6).any():
+                continue  # slack raced away; re-solve next pass
+            self.cluster.bind_pod(pod.uid, node_name, now=self.clock.now())
+            free[node_name] = f - pod.requests.v
 
     def _prune_stale_nominations(self) -> None:
         """Drop nominations whose claim died before binding, so their pods

@@ -74,7 +74,7 @@ def _fit_counts(cap_rem: jnp.ndarray, req: jnp.ndarray) -> jnp.ndarray:
     return jnp.maximum(k, 0.0).astype(jnp.int32)
 
 
-def _step(capacity: jnp.ndarray, type_window: jnp.ndarray, state: _State, item):
+def _step(capacity: jnp.ndarray, type_window: jnp.ndarray, n_pre, state: _State, item):
     req, cnt, compat_g, price_g, gw, mpn = item
     N = state.used.shape[0]
     idx = jnp.arange(N)
@@ -82,7 +82,13 @@ def _step(capacity: jnp.ndarray, type_window: jnp.ndarray, state: _State, item):
 
     # -- 1. first-fit fill of open nodes ----------------------------------
     window_ok = (state.node_window & gw[None, :, :]).any((-2, -1))
-    node_ok = valid & compat_g[state.node_type] & window_ok
+    # Pre-opened rows [0, n_pre) are EXISTING cluster nodes (solve onto live
+    # slack before opening fresh capacity — the core scheduler packs onto
+    # in-flight/existing nodes inside Solve, designs/bin-packing.md:18-43).
+    # Hostname-capped groups stay off them: the per-node cap cannot see the
+    # matching pods already bound there, so the host binder owns those.
+    pre_ok = mpn >= (1 << 30)
+    node_ok = valid & compat_g[state.node_type] & window_ok & (pre_ok | (idx >= n_pre))
     k_fit = _fit_counts(state.node_cap - state.used, req)
     # hostname topology: at most mpn replicas of this group per node
     k_fit = jnp.minimum(k_fit, mpn)
@@ -225,11 +231,15 @@ def ffd_solve(
     max_per_node: jnp.ndarray = None,  # [G] int32 hostname-topology cap
     max_nodes: int = 1024,
     init_state: _State | None = None,
+    n_pre: jnp.ndarray | int = 0,
 ) -> FFDResult:
     """One compiled program per (G, T, Z, max_nodes) bucket.
 
     ``init_state`` lets the host chain chunked solves (group axis sliced into
-    multiple scans) while node state stays device-resident.
+    multiple scans) while node state stays device-resident. When its first
+    ``n_pre`` rows describe existing cluster nodes (committed type, current
+    usage, one-hot zone/captype window, price 0), the first-fit phase lands
+    pods on their slack before any new node opens.
     """
     G, R = requests.shape
     Z, C = group_window.shape[1], group_window.shape[2]
@@ -245,7 +255,7 @@ def ffd_solve(
             n_open=jnp.asarray(0, dtype=jnp.int32),
         )
 
-    step = functools.partial(_step, capacity, type_window)
+    step = functools.partial(_step, capacity, type_window, jnp.asarray(n_pre, dtype=jnp.int32))
     final, (placed, unplaced) = jax.lax.scan(
         step, init_state, (requests, counts, compat, price, group_window, max_per_node)
     )

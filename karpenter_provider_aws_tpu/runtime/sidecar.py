@@ -165,13 +165,19 @@ class RemoteSolver:
         self.client = client
         self.max_nodes = max_nodes
 
-    def solve_encoded(self, problem):
+    def solve_encoded(self, problem, existing=None):
         from ..ops.encode import bucket, pad_problem
+        from ..scheduling.solver import _host_prefill
         from .solver_bridge import decode_remote
 
+        binds = []
+        if existing:
+            # host-side prefill onto live nodes; only the fresh-capacity
+            # remainder crosses the sidecar wire
+            binds, problem = _host_prefill(problem, existing)
         G = len(problem.group_pods)
         if G == 0:
-            return [], {}
+            return [], binds, {}
         num_pods = int(problem.counts[:G].sum())
         from ..scheduling.solver import _node_bucket
 
@@ -188,14 +194,15 @@ class RemoteSolver:
             max_per_node=padded.max_per_node,
             max_nodes=np.int32(N),
         )
-        return decode_remote(problem, out)
+        specs, unplaced = decode_remote(problem, out)
+        return specs, binds, unplaced
 
     def solve(self, pods, nodepools, catalog, in_use=None, occupancy=None, type_allow=None,
-              reserved_allow=None):
+              reserved_allow=None, existing=None):
         from ..scheduling.solver import _solve_multi_nodepool
 
         return _solve_multi_nodepool(self, pods, nodepools, catalog, in_use, occupancy,
-                                     type_allow, reserved_allow)
+                                     type_allow, reserved_allow, existing)
 
 
 def serve(address: str = "127.0.0.1:50151") -> SolverServer:

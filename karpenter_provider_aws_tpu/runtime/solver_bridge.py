@@ -10,7 +10,7 @@ from ..scheduling.solver import _decode_nodes
 def decode_remote(problem, out: dict[str, np.ndarray]):
     G = len(problem.group_pods)
     n_open = int(out["n_open"])
-    specs = _decode_nodes(
+    specs, _ = _decode_nodes(
         problem,
         out["node_type"],
         out["node_price"],

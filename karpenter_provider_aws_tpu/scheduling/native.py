@@ -141,10 +141,18 @@ class NativeSolver:
         self.max_nodes = max_nodes
         load_library()
 
-    def solve_encoded(self, problem: EncodedProblem) -> tuple[list[NodeSpec], dict[int, int]]:
+    def solve_encoded(self, problem: EncodedProblem, existing=None):
+        # Existing capacity rides through the shared numpy prefill (the
+        # device scan's pre-opened phase, host-mirrored); the native kernel
+        # then solves only the fresh-capacity remainder.
+        from .solver import _host_prefill
+
+        binds = []
+        if existing:
+            binds, problem = _host_prefill(problem, existing)
         G = len(problem.group_pods)
         if G == 0:
-            return [], {}
+            return [], binds, {}
         T, R = problem.capacity.shape
         Z = problem.group_window.shape[1]
         C = problem.group_window.shape[2]
@@ -183,14 +191,14 @@ class NativeSolver:
         )
         if n_open < 0:
             raise RuntimeError("native solver rejected inputs")
-        specs = _decode_nodes(
+        specs, _ = _decode_nodes(
             problem, node_type, node_price, used, n_open, placed,
             problem.nodepool.name if problem.nodepool else "",
             node_window.reshape(N, Z, C).astype(bool),
         )
-        return specs, {g: int(c) for g, c in enumerate(unplaced) if c > 0}
+        return specs, binds, {g: int(c) for g, c in enumerate(unplaced) if c > 0}
 
     def solve(self, pods, nodepools, catalog, in_use=None, occupancy=None, type_allow=None,
-              reserved_allow=None):
+              reserved_allow=None, existing=None):
         return _solve_multi_nodepool(self, pods, nodepools, catalog, in_use, occupancy,
-                                     type_allow, reserved_allow)
+                                     type_allow, reserved_allow, existing)

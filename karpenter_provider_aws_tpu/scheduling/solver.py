@@ -51,15 +51,66 @@ class NodeSpec:
 
 
 @dataclass
+class ExistingNode:
+    """A live cluster node offered to the solver as pre-opened capacity.
+
+    The solve then packs pending pods onto existing slack *inside the same
+    device program* that opens new nodes (parity: the core scheduler's
+    in-flight/existing virtual nodes, designs/bin-packing.md:18-43) instead
+    of a host-side O(pods x nodes) loop."""
+
+    name: str
+    nodepool_name: str
+    instance_type: str
+    zone: str
+    capacity_type: str
+    used: np.ndarray         # [R] resources consumed by bound pods
+    allocatable: np.ndarray  # [R] node-reported allocatable
+    taints: tuple = ()       # actual node taints (may diverge from the pool)
+
+
+def snapshot_existing_capacity(cluster) -> list[ExistingNode]:
+    """Ready, uncordoned nodes with their current usage, solver-shaped.
+
+    Usage comes from one locked pass over the pod store (``node_usage``),
+    not a per-node scan."""
+    usage = cluster.node_usage()
+    out: list[ExistingNode] = []
+    for node in cluster.snapshot_nodes():
+        if not node.ready or node.cordoned:
+            continue
+        used = usage.get(node.name)
+        out.append(
+            ExistingNode(
+                name=node.name,
+                nodepool_name=node.nodepool_name,
+                instance_type=node.instance_type(),
+                zone=node.zone(),
+                capacity_type=node.capacity_type(),
+                used=(
+                    used.astype(np.float32)
+                    if used is not None
+                    else np.zeros_like(node.allocatable.v, dtype=np.float32)
+                ),
+                allocatable=node.allocatable.v.astype(np.float32),
+                taints=tuple(node.taints),
+            )
+        )
+    return out
+
+
+@dataclass
 class SolveResult:
     node_specs: list[NodeSpec] = field(default_factory=list)
+    # pods the plan lands on EXISTING nodes: (pod, node_name)
+    binds: list[tuple[Pod, str]] = field(default_factory=list)
     unschedulable: list[tuple[Pod, str]] = field(default_factory=list)
     total_cost: float = 0.0                    # $/hr of committed choices
     solve_seconds: float = 0.0
     num_pods: int = 0
 
     def pods_placed(self) -> int:
-        return sum(len(s.pods) for s in self.node_specs)
+        return sum(len(s.pods) for s in self.node_specs) + len(self.binds)
 
 
 class Solver(Protocol):
@@ -72,6 +123,7 @@ class Solver(Protocol):
         occupancy: Optional[ZoneOccupancy] = None,
         type_allow=None,
         reserved_allow=None,
+        existing: Optional[Sequence[ExistingNode]] = None,
     ) -> SolveResult: ...
 
 
@@ -91,8 +143,13 @@ def _decode_nodes(
     ranked_idx: Optional[np.ndarray] = None,   # [N, K] device-ranked types
     ranked_n: Optional[np.ndarray] = None,     # [N] valid prefix length
     stale_rank: Optional[np.ndarray] = None,   # [N] recompute ranking on host
-) -> list[NodeSpec]:
-    """Turn device output into NodeSpecs with launch flexibility.
+    n_pre: int = 0,
+    pre_names: Optional[Sequence[str]] = None,
+) -> tuple[list[NodeSpec], list[tuple[Pod, str]]]:
+    """Turn device output into NodeSpecs (new nodes) + binds (existing).
+
+    Rows ``[0, n_pre)`` are pre-opened existing nodes: their pods become
+    (pod, node_name) binds, not launches.
 
     Flexibility recovery: the solver commits one type per node, but the
     launch path wants ranked alternatives to survive ICE (parity: the
@@ -106,6 +163,7 @@ def _decode_nodes(
     here in numpy.
     """
     specs: list[NodeSpec] = []
+    binds: list[tuple[Pod, str]] = []
     G = len(problem.group_pods)
     # per-group cursor into the concrete pod lists
     cursors = [0] * G
@@ -143,6 +201,10 @@ def _decode_nodes(
             pods.extend(plist[cursors[g]: cursors[g] + take])
             cursors[g] += take
         if not pods and not group_idx.size:
+            continue
+        if n < n_pre:
+            name = pre_names[n]
+            binds.extend((pod, name) for pod in pods)
             continue
         committed = int(node_type[n])
         if ranked_idx is not None and (stale_rank is None or not stale_rank[n]):
@@ -184,7 +246,7 @@ def _decode_nodes(
                 estimated_price=float(node_price[n]),
             )
         )
-    return specs
+    return specs, binds
 
 
 def _refine_plan(
@@ -197,6 +259,8 @@ def _refine_plan(
     n_open: int,
     max_tries: int = 256,
     util_threshold: float = 0.9,
+    n_pre: int = 0,
+    node_cap: Optional[np.ndarray] = None,  # [N, R] actual per-node allocatable
 ) -> tuple[np.ndarray, np.ndarray]:
     """Packed-cost refinement (SURVEY.md section 7.3): drop under-filled plan
     nodes whose pods first-fit into the remaining nodes' slack.
@@ -220,13 +284,17 @@ def _refine_plan(
     idx = np.arange(Nn)
     live = idx < n_open
     pods_on = placed[:G].sum(axis=0)
-    cap = problem.capacity[node_type]          # [N, R] committed allocatable
+    # Actual per-node allocatable when provided (pre-opened existing nodes
+    # may report less than the catalog value); catalog fallback otherwise.
+    cap = node_cap if node_cap is not None else problem.capacity[node_type]
     free = cap - used
     with np.errstate(invalid="ignore", divide="ignore"):
         util = np.where(
             live, (used / np.maximum(cap, 1e-9)).max(axis=1), np.inf
         )
-    cand = live & (pods_on > 0) & (util < util_threshold)
+    # Existing nodes are never drop candidates here — retiring live capacity
+    # is the consolidation controller's call, not the provisioner's.
+    cand = live & (idx >= n_pre) & (pods_on > 0) & (util < util_threshold)
     cand_idx = idx[cand]
     if cand_idx.size == 0:
         return np.zeros(Nn, dtype=bool), np.zeros(Nn, dtype=bool)
@@ -254,6 +322,11 @@ def _refine_plan(
             elig = live & ~dropped & (idx != n)
             elig &= finite_price[g][node_type]
             elig &= (trial_window & gw[None, :, :]).any(axis=(1, 2))
+            if int(mpn[g]) < (1 << 30):
+                # hostname-capped groups stay off existing nodes (their
+                # per-node occupancy is invisible here — same rule as the
+                # device scan's pre_ok mask)
+                elig &= idx >= n_pre
             with_req = req > 0
             ratio = np.where(
                 with_req[None, :],
@@ -289,6 +362,112 @@ def _refine_plan(
     return dropped, stale
 
 
+def _encode_existing(problem: EncodedProblem, existing: Sequence[ExistingNode]):
+    """Existing nodes -> pre-opened row arrays in the problem's tensor space.
+
+    Nodes whose type/zone/captype fall outside the catalog snapshot are
+    skipped, as are nodes carrying scheduling-effect taints beyond the
+    pool template (group compat only covers template taints — an
+    out-of-band ``NoSchedule`` taint must not be silently violated).
+    Skipped nodes can still receive pods via the host binder."""
+    tidx = {n: i for i, n in enumerate(problem.type_names)}
+    zidx = {z: i for i, z in enumerate(problem.zones)}
+    cidx = {c: i for i, c in enumerate(lbl.CAPACITY_TYPES)}
+    Z, C = problem.group_window.shape[1], problem.group_window.shape[2]
+    template = {
+        (t.key, t.value, t.effect)
+        for t in (problem.nodepool.taints if problem.nodepool else [])
+    }
+    names: list[str] = []
+    ptype, pused, pcap, pwin = [], [], [], []
+    for e in existing:
+        t = tidx.get(e.instance_type)
+        z = zidx.get(e.zone)
+        c = cidx.get(e.capacity_type)
+        if t is None or z is None or c is None:
+            continue
+        if any(
+            getattr(tt, "effect", "") in ("NoSchedule", "NoExecute")
+            and (tt.key, tt.value, tt.effect) not in template
+            for tt in e.taints
+        ):
+            continue
+        w = np.zeros((Z, C), dtype=bool)
+        w[z, c] = True
+        names.append(e.name)
+        ptype.append(t)
+        pused.append(e.used)
+        pcap.append(e.allocatable)
+        pwin.append(w)
+    if not names:
+        return None
+    return (
+        names,
+        np.asarray(ptype, dtype=np.int32),
+        np.stack(pused).astype(np.float32),
+        np.stack(pcap).astype(np.float32),
+        np.stack(pwin),
+    )
+
+
+def _host_prefill(
+    problem: EncodedProblem, existing: Sequence[ExistingNode],
+) -> tuple[list[tuple[Pod, str]], EncodedProblem]:
+    """Numpy mirror of the device scan's pre-opened first-fit phase: land
+    groups on existing slack, return (binds, reduced problem) for the
+    fresh-capacity solve. Bound pods are taken from the FRONT of each
+    group's pod list so tail-based unplaced accounting stays valid."""
+    import dataclasses
+
+    pre = _encode_existing(problem, existing)
+    if pre is None:
+        return [], problem
+    names, ptype, pused, pcap, pwin = pre
+    G = len(problem.group_pods)
+    free = pcap - pused
+    win = pwin.copy()
+    finite = np.isfinite(problem.price)
+    mpn = problem.max_per_node
+    binds: list[tuple[Pod, str]] = []
+    counts = problem.counts.copy()
+    group_pods = list(problem.group_pods)
+    for g in range(G):
+        cnt = int(counts[g])
+        if cnt == 0 or int(mpn[g]) < (1 << 30):
+            continue  # hostname-capped groups: host binder's job
+        req = problem.requests[g]
+        gw = problem.group_window[g]
+        elig = problem.compat[g][ptype] & finite[g][ptype]
+        elig &= (win & gw[None, :, :]).any(axis=(1, 2))
+        with_req = req > 0
+        with np.errstate(invalid="ignore", divide="ignore"):
+            ratio = np.where(
+                with_req[None, :],
+                np.floor((free + 1e-4) / np.where(with_req, req, 1.0)[None, :]),
+                np.inf,
+            )
+        k = np.clip(np.nanmin(ratio, axis=1), 0, float(1 << 30)).astype(np.int64)
+        k = np.where(elig, k, 0)
+        cum = np.cumsum(k) - k
+        take = np.clip(cnt - cum, 0, k).astype(np.int64)
+        total = int(take.sum())
+        if total == 0:
+            continue
+        free -= take[:, None] * req[None, :]
+        recv = take > 0
+        win[recv] &= gw[None, :, :]
+        plist = group_pods[g]
+        pos = 0
+        for i in np.nonzero(recv)[0]:
+            binds.extend((p, names[i]) for p in plist[pos: pos + int(take[i])])
+            pos += int(take[i])
+        group_pods[g] = plist[pos:]
+        counts[g] = cnt - total
+    if not binds:
+        return [], problem
+    return binds, dataclasses.replace(problem, counts=counts, group_pods=group_pods)
+
+
 class TPUSolver:
     """Device-backed solver. ``group_chunk`` bounds per-scan group axis; node
     state carries across chunks on device. ``refine`` enables the
@@ -300,21 +479,58 @@ class TPUSolver:
         self.max_nodes = max_nodes
         self.refine = refine
 
-    def solve_encoded(self, problem: EncodedProblem) -> tuple[list[NodeSpec], dict[int, int]]:
+    def solve_encoded(
+        self, problem: EncodedProblem, existing: Optional[Sequence[ExistingNode]] = None,
+    ) -> tuple[list[NodeSpec], list[tuple[Pod, str]], dict[int, int]]:
         import jax
         import jax.numpy as jnp
 
         G = len(problem.group_pods)
         if G == 0:
-            return [], {}
+            return [], [], {}
         num_pods = int(problem.counts[:G].sum())
+
+        # Pre-open existing nodes: committed type index, current usage,
+        # one-hot (zone, captype) window, price 0 (sunk cost — filling live
+        # slack must always beat opening a new node).
+        pre_rows = _encode_existing(problem, existing) if existing else None
+        n_pre = len(pre_rows[0]) if pre_rows else 0
+
         N = self.max_nodes or _node_bucket(num_pods)
+        if n_pre:
+            N = bucket(n_pre + N, minimum=64)
         GB = bucket(G)
         padded = pad_problem(problem, GB)
 
+        state = None
+        if pre_rows:
+            from ..ops.ffd import _State as _S
+
+            names, ptype, pused, pcap, pwin = pre_rows
+            R = padded.requests.shape[1]
+            Z, C = padded.group_window.shape[1], padded.group_window.shape[2]
+            node_type = np.zeros(N, dtype=np.int32)
+            node_price = np.zeros(N, dtype=np.float32)
+            used0 = np.zeros((N, R), dtype=np.float32)
+            cap0 = np.zeros((N, R), dtype=np.float32)
+            win0 = np.zeros((N, Z, C), dtype=bool)
+            node_type[:n_pre] = ptype
+            used0[:n_pre] = pused
+            cap0[:n_pre] = pcap
+            win0[:n_pre] = pwin
+            state = _S(
+                node_type=jnp.asarray(node_type),
+                node_price=jnp.asarray(node_price),
+                used=jnp.asarray(used0),
+                node_cap=jnp.asarray(cap0),
+                node_window=jnp.asarray(win0),
+                n_open=jnp.asarray(n_pre, dtype=jnp.int32),
+            )
+        else:
+            names = []
+
         placed_chunks = []
         unplaced_chunks = []
-        state = None
         chunk = min(self.group_chunk, GB)
         for start in range(0, GB, chunk):
             sl = slice(start, start + chunk)
@@ -329,6 +545,7 @@ class TPUSolver:
                 max_per_node=jnp.asarray(padded.max_per_node[sl]),
                 max_nodes=N,
                 init_state=state,
+                n_pre=n_pre,
             )
             from ..ops.ffd import _State
 
@@ -368,10 +585,10 @@ class TPUSolver:
         # are 5 + 2*chunks of them — batching is the difference between
         # ~500 ms and ~70 ms end-to-end on a tunneled chip. Transfers are
         # slimmed: only the real group rows of `placed`, int16 rankings.
-        (placed, unplaced_chunks, node_type, node_price, used, n_open,
+        (placed, unplaced_chunks, node_type, node_price, used, node_cap, n_open,
          node_window, ranked_idx, ranked_n) = jax.device_get(
             (placed_dev[:G], unplaced_chunks, state.node_type, state.node_price,
-             state.used, state.n_open, state.node_window,
+             state.used, state.node_cap, state.n_open, state.node_window,
              ranked_idx_dev, ranked_n_dev)
         )
         unplaced_arr = np.concatenate(unplaced_chunks)[:G]
@@ -379,15 +596,16 @@ class TPUSolver:
 
         # Packed-cost descent: drop plan nodes the rest of the plan absorbs.
         stale_rank = None
-        if self.refine and n_open > 2:
+        if self.refine and n_open - n_pre > 2:
             # device_get arrays are read-only views; the descent mutates
             placed, used, node_window = (
                 np.array(placed), np.array(used), np.array(node_window)
             )
             dropped, stale_rank = _refine_plan(
-                problem, node_type, node_price, used, node_window, placed, n_open
+                problem, node_type, node_price, used, node_window, placed, n_open,
+                n_pre=n_pre, node_cap=node_cap,
             )
-        specs = _decode_nodes(
+        specs, binds = _decode_nodes(
             problem,
             node_type,
             node_price,
@@ -399,22 +617,29 @@ class TPUSolver:
             ranked_idx=ranked_idx,
             ranked_n=ranked_n,
             stale_rank=stale_rank,
+            n_pre=n_pre,
+            pre_names=names,
         )
         unplaced = {g: int(c) for g, c in enumerate(unplaced_arr) if c > 0}
-        return specs, unplaced
+        return specs, binds, unplaced
 
     def solve(self, pods, nodepools, catalog, in_use=None, occupancy=None, type_allow=None,
-              reserved_allow=None) -> SolveResult:
+              reserved_allow=None, existing=None) -> SolveResult:
         return _solve_multi_nodepool(self, pods, nodepools, catalog, in_use, occupancy,
-                                     type_allow, reserved_allow)
+                                     type_allow, reserved_allow, existing)
 
 
 class HostSolver:
     """Numpy fallback solver (and the oracle in tests)."""
 
-    def solve_encoded(self, problem: EncodedProblem) -> tuple[list[NodeSpec], dict[int, int]]:
+    def solve_encoded(
+        self, problem: EncodedProblem, existing: Optional[Sequence[ExistingNode]] = None,
+    ) -> tuple[list[NodeSpec], list[tuple[Pod, str]], dict[int, int]]:
         from .oracle import ffd_oracle
 
+        binds: list[tuple[Pod, str]] = []
+        if existing:
+            binds, problem = _host_prefill(problem, existing)
         nodes, unplaced = ffd_oracle(problem)
         G = len(problem.group_pods)
         n_open = len(nodes)
@@ -432,17 +657,17 @@ class HostSolver:
             node_window[n] = node.window
             for g, c in node.group_counts.items():
                 placed[g, n] = c
-        specs = _decode_nodes(
+        specs, _ = _decode_nodes(
             problem, node_type, node_price, used, n_open, placed,
             problem.nodepool.name if problem.nodepool else "",
             node_window,
         )
-        return specs, unplaced
+        return specs, binds, unplaced
 
     def solve(self, pods, nodepools, catalog, in_use=None, occupancy=None, type_allow=None,
-              reserved_allow=None) -> SolveResult:
+              reserved_allow=None, existing=None) -> SolveResult:
         return _solve_multi_nodepool(self, pods, nodepools, catalog, in_use, occupancy,
-                                     type_allow, reserved_allow)
+                                     type_allow, reserved_allow, existing)
 
 
 def _enforce_pool_constraints(
@@ -500,7 +725,7 @@ def _enforce_pool_constraints(
 
 def _solve_multi_nodepool(
     impl, pods, nodepools, catalog, in_use=None, occupancy=None, type_allow=None,
-    reserved_allow=None,
+    reserved_allow=None, existing=None,
 ) -> SolveResult:
     t0 = time.perf_counter()
     result = SolveResult(num_pods=len(pods))
@@ -518,7 +743,14 @@ def _solve_multi_nodepool(
                                  allowed_types=allowed, allow_reserved=allow_res)
         for pod, why in problem.unencodable:
             reasons[pod.uid] = f"nodepool {pool.name}: {why}"
-        specs, unplaced = impl.solve_encoded(problem)
+        # This pool's own live nodes ride along as pre-opened capacity (same
+        # taint/requirement semantics as the pool's fresh nodes, so group
+        # compat transfers soundly).
+        pool_existing = (
+            [e for e in existing if e.nodepool_name == pool.name] if existing else None
+        )
+        specs, binds, unplaced = impl.solve_encoded(problem, existing=pool_existing)
+        result.binds.extend(binds)
         specs, rejected = _enforce_pool_constraints(
             specs, pool, catalog, in_use.get(pool.name)
         )

@@ -165,6 +165,18 @@ class Cluster:
         with self._lock:
             return [p for p in self.pods.values() if p.is_pending()]
 
+    def node_usage(self) -> dict[str, "object"]:
+        """node name -> summed bound-pod requests, in ONE locked pass over
+        the pod store (callers used to run pods_on_node per node — O(nodes x
+        pods) with a lock round-trip per node)."""
+        out: dict[str, object] = {}
+        with self._lock:
+            for p in self.pods.values():
+                if p.node_name:
+                    cur = out.get(p.node_name)
+                    out[p.node_name] = p.requests.v if cur is None else cur + p.requests.v
+        return out
+
     def bind_pod(self, pod_uid: str, node_name: str, now: float = 0.0) -> None:
         with self._lock:
             pod = self.pods[pod_uid]

@@ -1,0 +1,172 @@
+"""Solving onto existing capacity: live nodes ride into the solve as
+pre-opened device state, so pending pods land on existing slack before any
+new node opens (parity: the core scheduler packing onto in-flight/existing
+nodes inside Solve — designs/bin-packing.md:18-43; VERDICT round-1 item #2).
+"""
+
+import numpy as np
+import pytest
+
+from karpenter_provider_aws_tpu.catalog import CatalogProvider
+from karpenter_provider_aws_tpu.models import Disruption, NodePool, Operator, Requirement
+from karpenter_provider_aws_tpu.models import labels as lbl
+from karpenter_provider_aws_tpu.models.pod import make_pods
+from karpenter_provider_aws_tpu.models.resources import ResourceVector
+from karpenter_provider_aws_tpu.scheduling import HostSolver, TPUSolver
+from karpenter_provider_aws_tpu.scheduling.solver import ExistingNode
+
+
+@pytest.fixture(scope="module")
+def catalog():
+    return CatalogProvider()
+
+
+def cmr_pool(name="default"):
+    return NodePool(
+        name=name,
+        requirements=[Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m", "r"))],
+        disruption=Disruption(consolidate_after_s=None),
+    )
+
+
+def existing_node(catalog, name="live-0", pool="default", min_vcpus=16, used=None):
+    it = next(
+        t for t in catalog.list() if t.category in ("c", "m") and t.vcpus >= min_vcpus
+    )
+    alloc = catalog.allocatable(it)
+    return (
+        ExistingNode(
+            name=name,
+            nodepool_name=pool,
+            instance_type=it.name,
+            zone=catalog.zones[0],
+            capacity_type="on-demand",
+            used=(used if used is not None else ResourceVector()).v.astype(np.float32),
+            allocatable=alloc.v.astype(np.float32),
+        ),
+        it,
+    )
+
+
+@pytest.mark.parametrize("solver_cls", [TPUSolver, HostSolver])
+class TestExistingCapacity:
+    def test_pods_land_on_existing_slack_before_new_nodes(self, catalog, solver_cls):
+        node, it = existing_node(catalog)
+        pods = make_pods(4, "w", {"cpu": "1", "memory": "1Gi"})
+        res = solver_cls().solve(pods, [cmr_pool()], catalog, existing=[node])
+        assert res.node_specs == []
+        assert len(res.binds) == 4
+        assert all(name == "live-0" for _, name in res.binds)
+        assert res.pods_placed() == 4
+        assert res.total_cost == 0.0  # existing capacity is sunk cost
+
+    def test_overflow_opens_new_nodes_after_filling_slack(self, catalog, solver_cls):
+        # existing node with room for ~2 pods; 30 pods total
+        node, it = existing_node(catalog, min_vcpus=4)
+        used = ResourceVector.from_map(
+            {"cpu": max(it.vcpus - 2.5, 0.5), "memory": "1Gi"}
+        )
+        node.used = used.v.astype(np.float32)
+        pods = make_pods(30, "w", {"cpu": "1", "memory": "1Gi"})
+        res = solver_cls().solve(pods, [cmr_pool()], catalog, existing=[node])
+        assert res.pods_placed() == 30
+        assert len(res.binds) >= 1          # slack used first
+        assert len(res.node_specs) >= 1     # remainder opens fresh capacity
+        assert all(name == "live-0" for _, name in res.binds)
+
+    def test_other_pools_existing_nodes_are_not_used(self, catalog, solver_cls):
+        node, _ = existing_node(catalog, pool="other")
+        pods = make_pods(2, "w", {"cpu": "1", "memory": "1Gi"})
+        res = solver_cls().solve(pods, [cmr_pool()], catalog, existing=[node])
+        assert res.binds == []
+        assert len(res.node_specs) >= 1
+
+    def test_full_existing_node_gets_nothing(self, catalog, solver_cls):
+        node, it = existing_node(catalog)
+        node.used = node.allocatable.copy()  # zero slack
+        pods = make_pods(3, "w", {"cpu": "1", "memory": "1Gi"})
+        res = solver_cls().solve(pods, [cmr_pool()], catalog, existing=[node])
+        assert res.binds == []
+        assert res.pods_placed() == 3
+
+    def test_zone_constrained_pods_respect_existing_node_zone(self, catalog, solver_cls):
+        node, _ = existing_node(catalog)  # lives in zones[0]
+        other_zone = catalog.zones[1]
+        pods = make_pods(
+            2, "w", {"cpu": "1", "memory": "1Gi"},
+            node_selector={lbl.TOPOLOGY_ZONE: other_zone},
+        )
+        res = solver_cls().solve(pods, [cmr_pool()], catalog, existing=[node])
+        assert res.binds == []  # wrong zone: must not bind
+        assert res.pods_placed() == 2
+        for spec in res.node_specs:
+            assert spec.zone_options == [other_zone]
+
+    def test_hostname_capped_pods_stay_off_existing_nodes(self, catalog, solver_cls):
+        from karpenter_provider_aws_tpu.models.pod import PodAffinityTerm
+
+        node, _ = existing_node(catalog)
+        pods = make_pods(
+            3, "w", {"cpu": "1", "memory": "1Gi"},
+            labels={"app": "w"},
+            anti_affinity=[
+                PodAffinityTerm(topology_key=lbl.HOSTNAME, label_selector={"app": "w"})
+            ],
+        )
+        res = solver_cls().solve(pods, [cmr_pool()], catalog, existing=[node])
+        # the scan can't see matching pods already bound on live nodes, so
+        # hostname-capped groups must go to fresh nodes (host binder's case)
+        assert res.binds == []
+        assert res.pods_placed() == 3
+        assert len(res.node_specs) == 3  # cap 1 per node
+
+    def test_out_of_band_node_taint_blocks_device_binds(self, catalog, solver_cls):
+        from karpenter_provider_aws_tpu.models import Taint
+
+        node, _ = existing_node(catalog)
+        # taint applied directly to the node, NOT in the pool template —
+        # group compat can't see it, so the node must be skipped entirely
+        node.taints = (Taint(key="maintenance", value="true", effect="NoSchedule"),)
+        pods = make_pods(2, "w", {"cpu": "1", "memory": "1Gi"})
+        res = solver_cls().solve(pods, [cmr_pool()], catalog, existing=[node])
+        assert res.binds == []
+        assert res.pods_placed() == 2  # fresh nodes instead
+
+    def test_taints_on_pool_respected_for_existing_nodes(self, catalog, solver_cls):
+        from karpenter_provider_aws_tpu.models import Taint
+
+        pool = cmr_pool(name="tainted")
+        pool.taints = [Taint(key="team", value="ml")]
+        node, _ = existing_node(catalog, pool="tainted")
+        pods = make_pods(2, "w", {"cpu": "1", "memory": "1Gi"})
+        res = solver_cls().solve(pods, [pool], catalog, existing=[node])
+        # pods don't tolerate the pool taint: neither binds nor launches
+        assert res.binds == []
+        assert res.node_specs == []
+        assert len(res.unschedulable) == 2
+
+
+class TestExistingCapacityControlPlane:
+    def test_provisioner_binds_to_live_slack_instead_of_launching(self):
+        from karpenter_provider_aws_tpu.testenv import new_environment
+
+        env = new_environment()
+        env.apply_defaults(cmr_pool())
+        # wave 1: create real capacity through the control loop
+        for p in make_pods(20, "seed", {"cpu": "500m", "memory": "1Gi"}):
+            env.cluster.apply(p)
+        env.step(3)
+        assert not env.cluster.pending_pods()
+        n_nodes = len(env.cluster.nodes)
+        n_claims = len(env.cluster.nodeclaims)
+        assert n_nodes >= 1
+        # wave 2: a few small pods that fit in the surviving slack — the
+        # provisioner must bind, not launch (drive provisioning directly so
+        # the host-side scheduling controller can't mask the device path)
+        wave2 = make_pods(2, "tiny", {"cpu": "100m", "memory": "128Mi"})
+        for p in wave2:
+            env.cluster.apply(p)
+        env.provisioning.reconcile()
+        assert len(env.cluster.nodeclaims) == n_claims  # no new launches
+        for p in wave2:
+            assert env.cluster.pods[p.uid].node_name != ""

@@ -143,13 +143,13 @@ class TestZeroRequestAlignment:
 
     @pytest.mark.skipif(not native_available(), reason="native build unavailable")
     def test_native_matches_oracle(self, catalog, pool):
-        specs, unplaced = NativeSolver().solve_encoded(self._problem(catalog, pool))
+        specs, _, unplaced = NativeSolver().solve_encoded(self._problem(catalog, pool))
         assert not unplaced
         assert len(specs) == 1
         assert len(specs[0].pods) == 3
 
     def test_tpu_matches_oracle(self, catalog, pool):
-        specs, unplaced = TPUSolver().solve_encoded(self._problem(catalog, pool))
+        specs, _, unplaced = TPUSolver().solve_encoded(self._problem(catalog, pool))
         assert not unplaced
         assert len(specs) == 1
         assert len(specs[0].pods) == 3

@@ -123,7 +123,7 @@ class TestParity:
 
     def check(self, pods, pool, catalog):
         problem = encode_problem(pods, catalog, pool)
-        tpu_specs, tpu_un = TPUSolver().solve_encoded(problem)
+        tpu_specs, _, tpu_un = TPUSolver().solve_encoded(problem)
         # re-encode: decode mutates nothing but cursors are internal
         problem2 = encode_problem(pods, catalog, pool)
         nodes, oracle_un = ffd_oracle(problem2)
@@ -168,8 +168,8 @@ class TestParity:
         problem = encode_problem(pods, catalog, pool)
         chunked = TPUSolver(group_chunk=8)
         whole = TPUSolver()
-        s1, u1 = chunked.solve_encoded(problem)
-        s2, u2 = whole.solve_encoded(encode_problem(pods, catalog, pool))
+        s1, _, u1 = chunked.solve_encoded(problem)
+        s2, _, u2 = whole.solve_encoded(encode_problem(pods, catalog, pool))
         assert len(s1) == len(s2)
         assert sorted(x.instance_type_options[0] for x in s1) == sorted(
             x.instance_type_options[0] for x in s2
