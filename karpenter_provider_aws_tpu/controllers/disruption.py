@@ -176,9 +176,15 @@ class DisruptionController:
         # designs/consolidation.md's simulated scheduling).
         can = consolidatable(ct)
         order = np.argsort(ct.disruption_cost, kind="stable")
-        candidates = [
-            int(ni) for ni in order if can[ni] and eligible(int(ni)) is not None
+        eligible_all = [
+            int(ni)
+            for ni in order
+            if not ct.blocked[ni] and eligible(int(ni)) is not None
         ]
+        # delete candidates additionally pass the device repack screen;
+        # multi-node REPLACE considers every eligible node (a node whose
+        # pods don't fit on survivors is exactly the replace case)
+        candidates = [ni for ni in eligible_all if can[ni]]
         deleted_nodes: set[int] = set()
         if candidates:
             lo, hi = 0, len(candidates)
@@ -195,12 +201,17 @@ class DisruptionController:
                 ):
                     deleted_nodes.add(ni)
 
-        # 2. replace-with-cheaper for survivors. Skipped whenever the delete
-        # phase disrupted anything this pass: the snapshot is stale and a
-        # replace could drain a node the delete-feasibility proof used as a
-        # repack target; the next reconcile re-evaluates from fresh state.
+        # 2. multi-node replace (N -> 1 cheaper): candidates whose pods
+        # repack onto survivors EXCEPT an overflow absorbed by one new,
+        # cheaper node (designs/consolidation.md:63-65;
+        # deprovisioning_test.go:391-395). Runs only when delete found
+        # nothing — a pure delete always beats paying for a replacement.
         if deleted_nodes:
             return
+        if eligible_all and self._multi_node_replace(ct, eligible_all, budget, pools):
+            return
+
+        # 3. single-node replace-with-cheaper for survivors.
         reserved_allow = {
             name: self.cloudprovider.pool_reserved_allowed(pool)
             for name, pool in pools.items()
@@ -228,6 +239,71 @@ class DisruptionController:
                     for pod in self.cluster.pods_on_node(node_name):
                         self.provisioning.nominations[pod.uid] = replacement.name
             self._disrupt(claim, f"consolidatable:replace->{type_name}", budget)
+
+    MAX_REPLACE_SET = 16  # bound the N of N->1 (stale-snapshot risk grows with N)
+    REPLACE_MARGIN = 0.15
+
+    def _multi_node_replace(self, ct, candidates, budget, pools) -> bool:
+        """Try replacing a cost-ordered candidate SET with one cheaper node.
+
+        Per pool (the replacement must belong to one pool), largest set
+        first: pods repack onto survivors with the overflow priced onto a
+        single new node; accepted when that node costs < (1 - margin) x the
+        set's combined price. Launch-before-delete, budget-aware, reserved
+        offerings untouched (replacement_for_groups). Returns True when a
+        replacement committed (snapshot is then stale — end the pass)."""
+        from ..ops.consolidate import replacement_for_groups
+
+        by_pool: dict[str, list[int]] = {}
+        for ni in candidates:
+            by_pool.setdefault(ct.nodepool_names[ni], []).append(ni)
+        for pool_name, cand in by_pool.items():
+            top = min(len(cand), self.MAX_REPLACE_SET, budget.get(pool_name, 0))
+            for m in range(top, 1, -1):
+                subset = cand[:m]
+                free_over = repack_set_feasible(ct, subset, allow_overflow=True)
+                _, overflow = free_over
+                if not overflow:
+                    continue  # pure delete set; phase 1 owns those
+                set_price = float(sum(ct.price[i] for i in subset))
+                rep = replacement_for_groups(
+                    ct, overflow, self.cloudprovider.catalog, pool_name,
+                    nodepools=dict(pools), margin=self.REPLACE_MARGIN,
+                    price_cap=set_price,
+                )
+                if rep is None:
+                    continue
+                type_name, new_price, offering_options = rep
+                claims = [
+                    self.cluster.nodeclaims.get(
+                        self.cluster.nodes[ct.node_names[i]].nodeclaim_name
+                    )
+                    for i in subset
+                    if ct.node_names[i] in self.cluster.nodes
+                ]
+                claims = [c for c in claims if c is not None and not c.deleted]
+                if len(claims) != len(subset):
+                    continue  # snapshot went stale under us
+                replacement = self._launch_replacement(
+                    claims[0], type_name, offering_options
+                )
+                if replacement is None:
+                    continue
+                log.info(
+                    "multi-node replace: %d nodes -> 1x %s ($%.4f < $%.4f)",
+                    len(subset), type_name, new_price, set_price,
+                )
+                if self.provisioning is not None:
+                    with self.provisioning._nominations_lock:
+                        for i in subset:
+                            for pod in self.cluster.pods_on_node(ct.node_names[i]):
+                                self.provisioning.nominations[pod.uid] = replacement.name
+                for claim in claims:
+                    self._disrupt(
+                        claim, f"consolidatable:multi-replace->{type_name}", budget
+                    )
+                return True
+        return False
 
     def _launch_replacement(self, old_claim, type_name: str, offering_options):
         """Launch the cheaper replacement BEFORE disrupting the old node

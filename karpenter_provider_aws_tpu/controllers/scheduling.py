@@ -136,8 +136,9 @@ class SchedulingController:
         if len(pending) > GENERAL_LOOP_MAX_PODS:
             # Bulk scale: bound THIS pass's work, topology cases first (no
             # other binder handles them); the device solve drains the bulk.
-            topo = [p for p in pending if _needs_host_binder(p)]
-            rest = [p for p in pending if not _needs_host_binder(p)]
+            topo, rest = [], []
+            for p in pending:
+                (topo if _needs_host_binder(p) else rest).append(p)
             pending = (topo + rest)[:GENERAL_LOOP_MAX_PODS]
         free = self._free_map()
         if not free:

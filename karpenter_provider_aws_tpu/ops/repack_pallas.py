@@ -44,7 +44,7 @@ def _pad_to(n: int, m: int) -> int:
     return ((n + m - 1) // m) * m
 
 
-def _kernel(cand_ref, slots_ref, counts_ref, free_ref, req_ref, compat_ref,
+def _kernel(cand_ref, slots_ref, counts_ref, free_ref, req_ref, cap_ref,
             ok_ref, free_c):
     """One grid program = one candidate node's repack proof.
 
@@ -53,7 +53,9 @@ def _kernel(cand_ref, slots_ref, counts_ref, free_ref, req_ref, compat_ref,
     counts_ref [1, GMAX]  SMEM  pod counts per slot
     free_ref   [RP, N]    VMEM  shared base free matrix (resources x nodes)
     req_ref    [RP, G]    VMEM  shared group requests (resources x groups)
-    compat_ref [G, N]     VMEM  shared group x node compatibility (int8)
+    cap_ref    [G, N]     VMEM  shared group x node cap (float32: 0 =
+                                incompatible, else max extra pods of g on
+                                n — hostname headroom, BIG = uncapped)
     ok_ref     [1, 1]     SMEM  out: 1 iff all slots fully placed
     free_c     [RP, N]    VMEM  scratch: candidate-private free capacity
     """
@@ -77,8 +79,8 @@ def _kernel(cand_ref, slots_ref, counts_ref, free_ref, req_ref, compat_ref,
         )                                                  # [RP, N]
         k = jnp.min(ratio, axis=0, keepdims=True)          # [1, N]
         k = jnp.clip(k, 0.0, _BIG)
-        ok = (compat_ref[pl.ds(g, 1), :] > 0) & not_self   # [1, N]
-        k = jnp.where(ok, k, 0.0)
+        k = jnp.minimum(k, cap_ref[pl.ds(g, 1), :])        # hostname headroom
+        k = jnp.where(not_self, k, 0.0)
         cum_before = jnp.cumsum(k, axis=1) - k             # exclusive prefix
         place = jnp.clip(cnt.astype(jnp.float32) - cum_before, 0.0, k)
         free_c[:] = free_c[:] - req * place                # [RP,1]*[1,N] outer
@@ -89,7 +91,7 @@ def _kernel(cand_ref, slots_ref, counts_ref, free_ref, req_ref, compat_ref,
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def _repack_call(candidates, slots, counts, free_t, req_t, compat_i8,
+def _repack_call(candidates, slots, counts, free_t, req_t, cap_f32,
                  interpret=False):
     C = candidates.shape[0]
     gmax = slots.shape[1]
@@ -114,7 +116,7 @@ def _repack_call(candidates, slots, counts, free_t, req_t, compat_i8,
         out_shape=jax.ShapeDtypeStruct((C, 1), jnp.int32),
         grid_spec=grid_spec,
         interpret=interpret,
-    )(candidates, slots, counts, free_t, req_t, compat_i8)
+    )(candidates, slots, counts, free_t, req_t, cap_f32)
 
 
 def repack_vmem_bytes(n_nodes: int, n_groups: int, n_res: int = 9) -> int:
@@ -135,7 +137,7 @@ def repack_check_pallas(
     requests: np.ndarray,      # [G, R] float32
     group_ids: np.ndarray,     # [C, GMAX] int32 (pre-gathered per candidate)
     group_counts: np.ndarray,  # [C, GMAX] int32
-    compat: np.ndarray,        # [G, N] bool
+    compat: np.ndarray,        # [G, N] bool, or float32 hostname-headroom cap
     candidates: np.ndarray,    # [C] int32 node indices
     interpret: bool = False,
 ) -> np.ndarray:
@@ -158,9 +160,13 @@ def repack_check_pallas(
     free_t[:R, :N] = free.T
     req_t = np.zeros((RP, GP), dtype=np.float32)
     req_t[:R, :G] = requests.T
-    compat_p = np.zeros((GP, NP), dtype=np.int8)
-    compat_p[:G, :N] = compat
-    # padded node columns: free 0 / compat 0 -> never targets; padded group
+    cap_p = np.zeros((GP, NP), dtype=np.float32)
+    cap_p[:G, :N] = (
+        np.where(compat, _BIG, np.float32(0.0))
+        if compat.dtype == bool
+        else compat.astype(np.float32)
+    )
+    # padded node columns: free 0 / cap 0 -> never targets; padded group
     # rows only reachable from padded slots, which carry count 0
 
     gmax = group_ids.shape[1]
@@ -177,7 +183,7 @@ def repack_check_pallas(
         jnp.asarray(counts_p),
         jnp.asarray(free_t),
         jnp.asarray(req_t),
-        jnp.asarray(compat_p),
+        jnp.asarray(cap_p),
         interpret=interpret,
     )
     return np.asarray(out).reshape(-1)[:C].astype(bool)

@@ -75,9 +75,14 @@ def snapshot_existing_capacity(cluster) -> list[ExistingNode]:
     Usage comes from one locked pass over the pod store (``node_usage``),
     not a per-node scan."""
     usage = cluster.node_usage()
+    # a node whose claim is draining is capacity that is going away — never
+    # offer it (same filter as consolidation's encode_cluster)
+    draining = {
+        c.status.node_name for c in cluster.snapshot_claims() if c.deleted
+    }
     out: list[ExistingNode] = []
     for node in cluster.snapshot_nodes():
-        if not node.ready or node.cordoned:
+        if not node.ready or node.cordoned or node.name in draining:
             continue
         used = usage.get(node.name)
         out.append(

@@ -1,0 +1,281 @@
+"""Topology-aware consolidation (round-1 VERDICT items #3/#4): nodes
+carrying topology-constrained pods consolidate when a topology-respecting
+repack exists — and never when it would violate the constraints — plus
+multi-node N->1 replace (designs/consolidation.md:63-65;
+deprovisioning_test.go:391-395).
+"""
+
+import numpy as np
+import pytest
+
+from karpenter_provider_aws_tpu.models import Disruption, NodePool, Operator, Requirement
+from karpenter_provider_aws_tpu.models import labels as lbl
+from karpenter_provider_aws_tpu.models.nodeclaim import NodeClaim
+from karpenter_provider_aws_tpu.models.pod import (
+    PodAffinityTerm,
+    TopologySpreadConstraint,
+    make_pods,
+)
+from karpenter_provider_aws_tpu.ops.consolidate import (
+    consolidatable,
+    encode_cluster,
+    repack_set_feasible,
+    replacement_for_groups,
+)
+from karpenter_provider_aws_tpu.state.cluster import Node
+from karpenter_provider_aws_tpu.testenv import new_environment
+
+
+@pytest.fixture(scope="module")
+def env():
+    return new_environment()
+
+
+@pytest.fixture(autouse=True)
+def _reset(env):
+    env.reset()
+    yield
+
+
+def pool_with(**kw):
+    kw.setdefault("budgets", ["100%"])
+    kw.setdefault("consolidate_after_s", 60)
+    return NodePool(
+        name="default",
+        requirements=[Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m", "r"))],
+        disruption=Disruption(**kw),
+    )
+
+
+def add_node(env, name, zone, pods, min_vcpus=8, max_vcpus=16, type_name=None):
+    """Manually wire a ready node + claim + bound pods (the benchmark
+    _synth_cluster pattern) so zone layout is deterministic."""
+    it = (
+        env.catalog.get(type_name)
+        if type_name
+        else next(
+            t
+            for t in env.catalog.list()
+            if t.category in ("c", "m") and min_vcpus <= t.vcpus <= max_vcpus
+        )
+    )
+    claim = NodeClaim.fresh(
+        nodepool_name="default",
+        nodeclass_name="default",
+        instance_type_options=[it.name],
+        zone_options=[zone],
+        capacity_type_options=["on-demand"],
+    )
+    claim.status.provider_id = f"cloud:///{zone}/i-{name}"
+    claim.status.capacity = it.capacity()
+    claim.status.allocatable = env.catalog.allocatable(it)
+    claim.labels.update(it.labels())
+    claim.labels[lbl.TOPOLOGY_ZONE] = zone
+    claim.labels[lbl.CAPACITY_TYPE] = "on-demand"
+    claim.labels[lbl.NODEPOOL] = "default"
+    for cond in ("Launched", "Registered", "Initialized"):
+        claim.status.set_condition(cond, True)
+    claim.finalizers.add("karpenter.tpu/termination")  # like a real launch
+    env.cluster.apply(claim)
+    node = Node(
+        name=name,
+        provider_id=claim.status.provider_id,
+        nodepool_name="default",
+        nodeclaim_name=claim.name,
+        labels=dict(claim.labels),
+        capacity=claim.status.capacity,
+        allocatable=claim.status.allocatable,
+        ready=True,
+    )
+    node.labels[lbl.HOSTNAME] = name
+    claim.status.node_name = name
+    env.cluster.apply(node)
+    for p in pods:
+        env.cluster.apply(p)
+        env.cluster.bind_pod(p.uid, name)
+    return node, claim
+
+
+def spread_pods(n, prefix, app):
+    return make_pods(
+        n, prefix, {"cpu": "500m", "memory": "512Mi"},
+        labels={"app": app},
+        topology_spread=[
+            TopologySpreadConstraint(
+                topology_key=lbl.TOPOLOGY_ZONE, max_skew=1, label_selector={"app": app}
+            )
+        ],
+    )
+
+
+def anti_pods(n, prefix, app):
+    return make_pods(
+        n, prefix, {"cpu": "500m", "memory": "512Mi"},
+        labels={"app": app},
+        anti_affinity=[
+            PodAffinityTerm(topology_key=lbl.HOSTNAME, label_selector={"app": app})
+        ],
+    )
+
+
+class TestEncodeTopology:
+    def test_topology_nodes_are_not_blanket_blocked(self, env):
+        env.apply_defaults(pool_with())
+        add_node(env, "n-a", "zone-a", spread_pods(1, "s", "web"))
+        ct = encode_cluster(env.cluster, env.catalog)
+        assert not ct.blocked.any()
+        assert ct.has_topology()
+
+    def test_hostname_cap_matrix(self, env):
+        env.apply_defaults(pool_with())
+        add_node(env, "n-a", "zone-a", anti_pods(1, "a", "db"))
+        add_node(env, "n-b", "zone-a", anti_pods(1, "b", "db"))
+        ct = encode_cluster(env.cluster, env.catalog)
+        # the anti group's cap on a node already carrying a matching pod is 0
+        gi = next(
+            i for i, pods in enumerate(ct.group_pods) if pods[0].anti_affinity
+        )
+        assert (ct.cap[gi] == 0).all()  # both nodes carry matching pods
+
+
+class TestHostnameAntiAffinityRepack:
+    def test_blocked_when_all_targets_carry_matching_pods(self, env):
+        env.apply_defaults(pool_with())
+        add_node(env, "n-a", "zone-a", anti_pods(1, "a", "db"))
+        add_node(env, "n-b", "zone-a", anti_pods(1, "b", "db"))
+        ct = encode_cluster(env.cluster, env.catalog)
+        for ni in range(2):
+            assert not repack_set_feasible(ct, [ni])
+        assert not consolidatable(ct).any()
+
+    def test_consolidates_when_a_target_lacks_matching_pods(self, env):
+        env.apply_defaults(pool_with())
+        add_node(env, "n-a", "zone-a", anti_pods(1, "a", "db"))
+        add_node(
+            env, "n-b", "zone-a",
+            make_pods(1, "plain", {"cpu": "500m", "memory": "512Mi"}),
+        )
+        ct = encode_cluster(env.cluster, env.catalog)
+        ia = ct.node_names.index("n-a")
+        assert repack_set_feasible(ct, [ia])
+        assert consolidatable(ct)[ia]
+
+
+class TestZoneSpreadRepack:
+    def test_blocked_when_move_would_violate_skew(self, env):
+        env.apply_defaults(pool_with())
+        ps = spread_pods(2, "s", "web")
+        add_node(env, "n-a", "zone-a", [ps[0]])
+        add_node(env, "n-b", "zone-b", [ps[1]])
+        ct = encode_cluster(env.cluster, env.catalog)
+        # deleting either node forces its pod into the other zone: counts
+        # become (0, 2) -> skew 2 > 1
+        for ni in range(2):
+            assert not repack_set_feasible(ct, [ni])
+        env.disruption.reconcile()
+        assert not any(c.deleted for c in env.cluster.nodeclaims.values())
+
+    def test_consolidates_within_zone_keeping_skew(self, env):
+        env.apply_defaults(pool_with())
+        ps = spread_pods(3, "s", "web")
+        add_node(env, "n-a1", "zone-a", [ps[0]])
+        add_node(env, "n-a2", "zone-a", [ps[1]])
+        add_node(env, "n-b", "zone-b", [ps[2]])
+        ct = encode_cluster(env.cluster, env.catalog)
+        ia1 = ct.node_names.index("n-a1")
+        # n-a1's pod can land on n-a2 (same zone: counts unchanged)
+        assert repack_set_feasible(ct, [ia1])
+        env.clock.advance(61)
+        env.disruption.reconcile()
+        deleted = [c for c in env.cluster.nodeclaims.values() if c.deleted]
+        assert len(deleted) >= 1
+        # the zone-b node must not be disrupted (its pod has nowhere legal)
+        names = {c.status.node_name for c in deleted}
+        assert "n-b" not in names
+
+
+class TestMultiNodeReplace:
+    def _two_stranded_nodes(self, env):
+        """Two nodes whose pods don't fit each other's slack, but whose
+        combined pods fit one cheaper node."""
+        env.apply_defaults(pool_with())
+        it16 = next(
+            t for t in env.catalog.list() if t.category in ("c", "m") and t.vcpus == 16
+        )
+        # each node: ~10 cpu of pods; free ~4-5 cpu -> 10 doesn't fit
+        a = make_pods(2, "a", {"cpu": "5", "memory": "4Gi"})
+        b = make_pods(2, "b", {"cpu": "5", "memory": "4Gi"})
+        add_node(env, "n-a", "zone-a", a, min_vcpus=16, max_vcpus=16)
+        add_node(env, "n-b", "zone-a", b, min_vcpus=16, max_vcpus=16)
+        return it16
+
+    def test_overflow_replacement_found(self, env):
+        self._two_stranded_nodes(env)
+        ct = encode_cluster(env.cluster, env.catalog)
+        free, overflow = repack_set_feasible(ct, [0, 1], allow_overflow=True)
+        assert overflow  # survivors can't absorb everything
+        pool = env.cluster.nodepools["default"]
+        set_price = float(ct.price.sum())
+        rep = replacement_for_groups(
+            ct, overflow, env.catalog, "default",
+            nodepools={"default": pool}, price_cap=set_price,
+        )
+        assert rep is not None
+        type_name, price, offerings = rep
+        assert price < set_price * 0.85
+        it = env.catalog.get(type_name)
+        assert it.vcpus >= 20  # absorbs all 20 cpu of pods
+
+    def test_controller_replaces_two_nodes_with_one(self, env):
+        self._two_stranded_nodes(env)
+        claims_before = set(env.cluster.nodeclaims)
+        env.clock.advance(61)
+        env.disruption.reconcile()
+        reasons = [r for _, r in env.disruption.disrupted]
+        assert any("multi-replace" in r for r in reasons), reasons
+        # both old claims draining, one replacement launched
+        old_deleted = [
+            c for n, c in env.cluster.nodeclaims.items()
+            if n in claims_before and c.deleted
+        ]
+        new_claims = [
+            c for n, c in env.cluster.nodeclaims.items() if n not in claims_before
+        ]
+        assert len(old_deleted) == 2
+        assert len(new_claims) == 1
+        env.step(5)  # drain, register replacement, rebind
+        assert not env.cluster.pending_pods()
+        # all 4 pods ended up on the single replacement node
+        live_nodes = [
+            n for n in env.cluster.nodes.values()
+            if not env.cluster.nodeclaims.get(n.nodeclaim_name, NodeClaim.fresh(
+                nodepool_name="x", nodeclass_name="x")).deleted
+        ]
+        assert len(live_nodes) == 1
+        assert len(env.cluster.pods_on_node(live_nodes[0].name)) == 4
+
+    def test_no_replace_when_not_cheaper(self, env):
+        """A set whose combined pods only fit an equal-or-pricier node must
+        not churn. The pool is pinned to on-demand so a spot replacement
+        cannot (legitimately) undercut the pair."""
+        pool = pool_with()
+        pool.requirements.append(
+            Requirement(lbl.CAPACITY_TYPE, Operator.IN, ("on-demand",))
+        )
+        env.apply_defaults(pool)
+        # nearly-full nodes on the CHEAPEST 16-vcpu type: the combined
+        # demand needs a 32-vcpu node, which at best costs the same 2x ->
+        # no 15% saving exists
+        cheapest16 = min(
+            (t for t in env.catalog.list() if t.category in ("c", "m") and t.vcpus == 16),
+            key=lambda t: env.catalog.pricing.on_demand_price(t),
+        )
+        a = make_pods(2, "a", {"cpu": "7", "memory": "12Gi"})
+        b = make_pods(2, "b", {"cpu": "7", "memory": "12Gi"})
+        add_node(env, "n-a", "zone-a", a, type_name=cheapest16.name)
+        add_node(env, "n-b", "zone-a", b, type_name=cheapest16.name)
+        before = len(env.disruption.disrupted)
+        env.clock.advance(61)
+        env.disruption.reconcile()
+        new = [r for _, r in env.disruption.disrupted[before:]]
+        assert not any("multi-replace" in r for r in new), new
