@@ -6,8 +6,12 @@ reference batches pods per provisioning loop anyway, and never shares a bin
 across batches). So the mesh axis ``pods`` shards pod groups; every device
 runs the identical jitted FFD scan on its shard (pure SPMD, zero per-step
 communication), and a final ``psum`` aggregates cost/node counts over ICI.
-The host merge pass can then consolidate partially-filled tail nodes, which
-is exactly the consolidation simulator's job (ops/consolidate.py).
+
+``merge_sharded_plan`` then consolidates the per-shard tail nodes on the
+host: the flattened cross-shard plan goes through the same packed-cost
+descent the single-device solve uses (_refine_plan) — under-filled nodes
+from one shard drain into another shard's slack, bounding the sharded
+solve's cost overhead vs the single-device plan.
 
 This mirrors how the reference scales: more concurrent reconciles, no shared
 state inside a solve — except here "a worker" is a TPU core on the mesh.
@@ -43,7 +47,8 @@ def sharded_solve_fn(mesh: Mesh, max_nodes: int):
         mesh=mesh,
         in_specs=(P(POD_AXIS), P(POD_AXIS), P(POD_AXIS), P(), P(POD_AXIS),
                   P(POD_AXIS), P(), P(POD_AXIS)),
-        out_specs=(P(POD_AXIS), P(POD_AXIS, None), P(POD_AXIS), P(POD_AXIS), P()),
+        out_specs=(P(POD_AXIS), P(POD_AXIS, None), P(POD_AXIS), P(POD_AXIS), P(),
+                   P(POD_AXIS), P(POD_AXIS, None, None), P(POD_AXIS, None)),
         check_vma=False,
     )
     def _solve_shard(requests, counts, compat, capacity, price,
@@ -61,16 +66,21 @@ def sharded_solve_fn(mesh: Mesh, max_nodes: int):
             res.n_open[None],
             res.unplaced[None, :],
             total_cost,
+            res.node_price[None, :],
+            res.node_window[None, :, :, :],
+            res.placed[None, :, :],
         )
 
     return jax.jit(_solve_shard)
 
 
-def solve_sharded(problem, mesh: Mesh, max_nodes: int = 1024):
+def solve_sharded(problem, mesh: Mesh, max_nodes: int = 1024, full: bool = False):
     """Host entry: pad the group axis to the mesh size, place shards, solve.
 
     Returns (node_type [D, N], used [D, N, R], n_open [D], unplaced [G],
-    total_cost) with per-device node namespaces.
+    total_cost) with per-device node namespaces; with ``full=True`` also
+    (node_price [D, N], node_window [D, N, Z, C], placed [D, Gs, N]) for
+    the cross-shard merge.
     """
     from ..ops.encode import bucket, pad_problem
 
@@ -94,11 +104,76 @@ def solve_sharded(problem, mesh: Mesh, max_nodes: int = 1024):
         jax.device_put(jnp.asarray(padded.type_window), rep),
         jax.device_put(jnp.asarray(padded.max_per_node), shard),
     )
-    node_type, used, n_open, unplaced, total_cost = fn(*args)
-    return (
+    (node_type, used, n_open, unplaced, total_cost,
+     node_price, node_window, placed) = jax.device_get(fn(*args))
+    out = (
         np.asarray(node_type),
         np.asarray(used),
         np.asarray(n_open).reshape(-1),
         np.asarray(unplaced).reshape(-1)[:G],
-        float(total_cost),
+        float(np.asarray(total_cost).reshape(-1)[0]),
     )
+    if full:
+        return out + (np.asarray(node_price), np.asarray(node_window), np.asarray(placed))
+    return out
+
+
+def merge_sharded_plan(problem, mesh: Mesh, max_nodes: int = 1024):
+    """Sharded solve + the promised cross-shard tail-node merge.
+
+    Flattens the per-device plans into one global node list and runs the
+    single-device packed-cost descent (scheduling.solver._refine_plan) over
+    it: an under-filled tail node from shard A drains into shard B's slack
+    whenever group compatibility, windows, and hostname caps allow — so the
+    merged cost is <= the raw sharded cost, closing most of the gap to the
+    single-device plan.
+
+    Returns a dict with the merged plan (node_type, node_price, used,
+    node_window, placed [G, M], n_open) plus unplaced, cost_sharded, and
+    cost_merged.
+    """
+    from ..scheduling.solver import _refine_plan
+
+    D = mesh.devices.size
+    (node_type, used, n_open, unplaced, cost_sharded,
+     node_price, node_window, placed) = solve_sharded(
+        problem, mesh, max_nodes=max_nodes, full=True
+    )
+    G = problem.requests.shape[0]
+    Gs = placed.shape[1]          # groups per shard (padded // D)
+    # compact: concatenate each shard's live rows into one global namespace
+    offsets = np.concatenate([[0], np.cumsum(n_open)]).astype(int)
+    M = int(offsets[-1])
+    R = used.shape[2]
+    Z, C = node_window.shape[2], node_window.shape[3]
+    m_type = np.zeros(M, dtype=node_type.dtype)
+    m_price = np.zeros(M, dtype=np.float32)
+    m_used = np.zeros((M, R), dtype=np.float32)
+    m_window = np.zeros((M, Z, C), dtype=bool)
+    m_placed = np.zeros((max(G, Gs * D), M), dtype=placed.dtype)
+    for d in range(D):
+        lo, hi = offsets[d], offsets[d + 1]
+        k = hi - lo
+        m_type[lo:hi] = node_type[d, :k]
+        m_price[lo:hi] = node_price[d, :k]
+        m_used[lo:hi] = used[d, :k]
+        m_window[lo:hi] = node_window[d, :k]
+        # shard d owns global group rows [d*Gs, (d+1)*Gs)
+        m_placed[d * Gs:(d + 1) * Gs, lo:hi] = placed[d, :, :k]
+    dropped, _ = _refine_plan(
+        problem, m_type, m_price, m_used, m_window, m_placed, M
+    )
+    live = np.arange(M) < M
+    cost_merged = float(np.where(live & ~dropped, m_price, 0.0).sum())
+    return {
+        "node_type": m_type,
+        "node_price": m_price,
+        "used": m_used,
+        "node_window": m_window,
+        "placed": m_placed[:G],
+        "n_open": M,
+        "dropped": dropped,
+        "unplaced": unplaced,
+        "cost_sharded": float(cost_sharded),
+        "cost_merged": cost_merged,
+    }
