@@ -293,11 +293,30 @@ class DisruptionController:
                     "multi-node replace: %d nodes -> 1x %s ($%.4f < $%.4f)",
                     len(subset), type_name, new_price, set_price,
                 )
+                # Nominate ONLY the overflow pods onto the replacement: the
+                # repack proof placed the rest on survivors, and the node was
+                # sized for the overflow alone. Survivor-bound pods stay
+                # un-nominated so the host binder re-lands them on survivors
+                # once the drain releases them. Pods within a group are
+                # interchangeable (same scheduling key + labels), so any
+                # overflow[g] of the group's pods on the subset will do.
                 if self.provisioning is not None:
+                    on_subset = {
+                        p.uid
+                        for i in subset
+                        for p in self.cluster.pods_on_node(ct.node_names[i])
+                    }
                     with self.provisioning._nominations_lock:
-                        for i in subset:
-                            for pod in self.cluster.pods_on_node(ct.node_names[i]):
-                                self.provisioning.nominations[pod.uid] = replacement.name
+                        for g, cnt in overflow.items():
+                            picked = 0
+                            for pod in ct.group_pods[g]:
+                                if picked >= cnt:
+                                    break
+                                if pod.uid in on_subset:
+                                    self.provisioning.nominations[pod.uid] = (
+                                        replacement.name
+                                    )
+                                    picked += 1
                 for claim in claims:
                     self._disrupt(
                         claim, f"consolidatable:multi-replace->{type_name}", budget

@@ -67,6 +67,7 @@ class RegistrationController:
         if self.provisioning is None:
             return
         node_name = claim.status.node_name
+        node = self.cluster.nodes.get(node_name)
         with self.provisioning._nominations_lock:
             mine = [
                 uid
@@ -75,7 +76,19 @@ class RegistrationController:
             ]
             for uid in mine:
                 del self.provisioning.nominations[uid]
+        if node is None:
+            return
+        # Free-capacity check mirroring provisioning._apply_binds: a
+        # nomination is a hint, not a reservation — binding past allocatable
+        # would overcommit the node (e.g. a replace sized only for overflow).
+        # Pods that don't fit stay pending and re-enter the next solve.
+        used = self.cluster.node_usage().get(node_name)
+        free = node.allocatable.v - (used if used is not None else 0)
         for uid in mine:
             pod = self.cluster.pods.get(uid)
-            if pod is not None and pod.is_pending():
-                self.cluster.bind_pod(uid, node_name, now=self.clock.now())
+            if pod is None or not pod.is_pending():
+                continue
+            if (pod.requests.v > free + 1e-6).any():
+                continue  # doesn't fit; provisioner re-solves it
+            self.cluster.bind_pod(uid, node_name, now=self.clock.now())
+            free = free - pod.requests.v

@@ -354,6 +354,11 @@ def consolidatable(ct: ClusterTensors, chunk: int = 512) -> np.ndarray:
     out = np.zeros(N, dtype=bool)
     backend = _repack_backend(ct)
     screen_cap = ct.cap if ct.cap is not None else ct.compat
+    if screen_cap.dtype != bool:
+        # uint16 wire format: the [G, N] cap is the largest upload of the
+        # sweep and H2D bandwidth dominates on a tunneled chip; 60000 ==
+        # uncapped (no node holds that many pods), exact otherwise
+        screen_cap = np.minimum(screen_cap, 60000).astype(np.uint16)
     if backend == "pallas":
         from .repack_pallas import repack_check_pallas
 
@@ -400,18 +405,29 @@ def repack_feasible_numpy(ct: ClusterTensors, free: np.ndarray, i: int) -> Optio
     return ok
 
 
-def _zone_budgets(con: ZoneConstraint, zcnt: np.ndarray) -> np.ndarray:
+def _zone_budgets(
+    con: ZoneConstraint, zcnt: np.ndarray, elig: Optional[np.ndarray] = None
+) -> np.ndarray:
     """Per-zone placement budget for one constraint given current matching
     counts ``zcnt[Z]``. Budgets are sound caps: any assignment within them
     keeps the constraint satisfied (spread uses the initial-minimum bound,
-    which is conservative but never wrong)."""
+    which is conservative but never wrong).
+
+    ``elig[Z]`` marks zones holding at least one surviving node compatible
+    with the placing group. Spread skew is computed over eligible domains
+    only (advisor round-2: a zero count from a zone the group can never
+    schedule into must not pin the budget — the reference's skew domain is
+    the set of eligible topology values)."""
     Z = zcnt.shape[0]
     if con.kind == "anti":
         return np.where(zcnt == 0, 1, 0).astype(np.int64)
     if con.kind == "block":
         return np.where(zcnt == 0, np.int64(_UNCAPPED), 0)
     if con.kind == "spread":
-        floor = int(zcnt.min()) if Z else 0
+        if elig is not None and elig.any():
+            floor = int(zcnt[elig].min())
+        else:
+            floor = int(zcnt.min()) if Z else 0
         return np.maximum(floor + con.skew - zcnt, 0).astype(np.int64)
     if con.kind == "affinity":
         if (zcnt > 0).any():
@@ -471,6 +487,7 @@ def repack_set_feasible(
         ]
 
     overflow: dict[int, int] = {}
+    _elig_zone_cache: dict[int, np.ndarray] = {}
 
     def _place_group(g: int, cnt: int) -> int:
         """First-fit cnt pods of group g onto survivors; returns leftover."""
@@ -496,7 +513,16 @@ def repack_set_feasible(
             cum_before = np.cumsum(k) - k
             place = np.clip(cnt - cum_before, 0, k)
         else:
-            budgets = [_zone_budgets(c, zone_cnt[g][ci]) for ci, c in enumerate(cons)]
+            if g not in _elig_zone_cache:
+                ok_nodes = ct.compat[g] & survivors  # [N]
+                _elig_zone_cache[g] = np.array(
+                    [bool(ok_nodes[ct.node_zone_idx == z].any()) for z in range(Z)]
+                )
+            elig_z = _elig_zone_cache[g]
+            budgets = [
+                _zone_budgets(c, zone_cnt[g][ci], elig=elig_z)
+                for ci, c in enumerate(cons)
+            ]
             seed = [b for b in budgets if (b < 0).any()]  # affinity seed mode
             budgets = [b for b in budgets if not (b < 0).any()]
             place = np.zeros(N, dtype=np.int64)

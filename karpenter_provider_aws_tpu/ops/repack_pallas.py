@@ -44,22 +44,35 @@ def _pad_to(n: int, m: int) -> int:
     return ((n + m - 1) // m) * m
 
 
-def _kernel(cand_ref, slots_ref, counts_ref, free_ref, req_ref, cap_ref,
+def _kernel(cand_ref, slots_ref, counts_ref, free_ref, req_ref, cap_slots_ref,
             ok_ref, free_c):
     """One grid program = one candidate node's repack proof.
 
-    cand_ref   [1]        SMEM  candidate node index
-    slots_ref  [1, GMAX]  SMEM  group ids on the candidate
-    counts_ref [1, GMAX]  SMEM  pod counts per slot
-    free_ref   [RP, N]    VMEM  shared base free matrix (resources x nodes)
-    req_ref    [RP, G]    VMEM  shared group requests (resources x groups)
-    cap_ref    [G, N]     VMEM  shared group x node cap (float32: 0 =
-                                incompatible, else max extra pods of g on
-                                n — hostname headroom, BIG = uncapped)
-    ok_ref     [1, 1]     SMEM  out: 1 iff all slots fully placed
-    free_c     [RP, N]    VMEM  scratch: candidate-private free capacity
+    cand/slots/counts ride as SCALAR-PREFETCH operands — whole arrays
+    resident in SMEM, indexed by ``program_id`` (TPU lowering rejects
+    SMEM *blocks* that don't tile by (8, 128), so per-program slicing via
+    BlockSpec is not an option for these small integer tables).
+
+    The per-slot cap row (hostname headroom / compat screen) arrives
+    pre-gathered to slot order — ``cap_slots[i, s] = cap[slots[i, s]]`` is
+    an XLA gather OUTSIDE the kernel; a [G, N] one-hot select per slot
+    inside it was the kernel's whole runtime (Mosaic cannot dynamically
+    index the sublane axis by a runtime g, and the select+reduce fallback
+    is O(G·N) VPU work per slot).
+
+    cand_ref      [C]           SMEM  candidate node index per program
+    slots_ref     [C, GMAX]     SMEM  group ids on each candidate
+    counts_ref    [C, GMAX]     SMEM  pod counts per slot
+    free_ref      [RP, N]       VMEM  shared base free matrix
+    req_ref       [RP, G]       VMEM  shared group requests
+    cap_slots_ref [1, GMAX, N]  VMEM  this candidate's per-slot cap rows
+                                      (0 = incompatible, else max extra
+                                      pods, BIG = uncapped)
+    ok_ref        [C, 1]        SMEM  out: 1 iff all slots fully placed
+    free_c        [RP, N]       VMEM  scratch: candidate-private free
     """
-    i_node = cand_ref[0]
+    i = pl.program_id(0)
+    i_node = cand_ref[i]
     free_c[:] = free_ref[:]
     gmax = slots_ref.shape[1]
     n = free_ref.shape[1]
@@ -67,10 +80,29 @@ def _kernel(cand_ref, slots_ref, counts_ref, free_ref, req_ref, cap_ref,
         jax.lax.broadcasted_iota(jnp.int32, (1, n), 1) != i_node
     )
 
+    lane_idx = jax.lax.broadcasted_iota(jnp.int32, (1, n), 1)
+    # req-column gather: Mosaic cannot dynamically slice the lane axis by a
+    # runtime g — one-hot select + reduce instead (tiny: [RP, G] per slot)
+    iota_req = jax.lax.broadcasted_iota(jnp.int32, req_ref.shape, 1)  # [RP, G]
+
+    def _prefix_sum(x):
+        """Inclusive prefix sum along lanes in log2(N) roll+mask steps —
+        Mosaic has no cumsum lowering; circular ``pltpu.roll`` plus an
+        iota mask emulates the shift."""
+        s = 1
+        while s < n:
+            shifted = pltpu.roll(x, s, 1)
+            x = x + jnp.where(lane_idx >= s, shifted, 0.0)
+            s *= 2
+        return x
+
     def slot(s, leftover):
-        g = slots_ref[0, s]
-        cnt = counts_ref[0, s]
-        req = req_ref[:, pl.ds(g, 1)]                     # [RP, 1]
+        g = slots_ref[i, s]
+        cnt = counts_ref[i, s]
+        req = jnp.sum(
+            jnp.where(iota_req == g, req_ref[:], 0.0), axis=1, keepdims=True
+        )                                                  # [RP, 1]
+        cap_g = cap_slots_ref[0, pl.ds(s, 1), :]           # [1, N]
         with_req = req > 0.0
         ratio = jnp.where(
             with_req,
@@ -79,52 +111,69 @@ def _kernel(cand_ref, slots_ref, counts_ref, free_ref, req_ref, cap_ref,
         )                                                  # [RP, N]
         k = jnp.min(ratio, axis=0, keepdims=True)          # [1, N]
         k = jnp.clip(k, 0.0, _BIG)
-        k = jnp.minimum(k, cap_ref[pl.ds(g, 1), :])        # hostname headroom
+        k = jnp.minimum(k, cap_g)                          # hostname headroom
         k = jnp.where(not_self, k, 0.0)
-        cum_before = jnp.cumsum(k, axis=1) - k             # exclusive prefix
+        cum_before = _prefix_sum(k) - k                    # exclusive prefix
         place = jnp.clip(cnt.astype(jnp.float32) - cum_before, 0.0, k)
         free_c[:] = free_c[:] - req * place                # [RP,1]*[1,N] outer
         return leftover + (cnt.astype(jnp.float32) - jnp.sum(place))
 
     leftover = jax.lax.fori_loop(0, gmax, slot, jnp.float32(0.0))
-    ok_ref[0, 0] = (leftover <= 0.5).astype(jnp.int32)
+    ok_ref[i, 0] = (leftover <= 0.5).astype(jnp.int32)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def _repack_call(candidates, slots, counts, free_t, req_t, cap_f32,
-                 interpret=False):
-    C = candidates.shape[0]
-    gmax = slots.shape[1]
+def _repack_call(cand_bands, slots_bands, counts_bands, free_t, req_t,
+                 cap_f32, interpret=False):
+    """All candidate bands in ONE dispatch: ``lax.map`` over 256-wide bands,
+    each a pallas_call whose grid is one band. Banding keeps the
+    scalar-prefetch slot tables + output window inside the ~1MB SMEM
+    budget; fusing the bands into one jit keeps a 5k-candidate sweep at
+    one host->device round-trip instead of twenty."""
+    B, C = cand_bands.shape
+    gmax = slots_bands.shape[2]
     RP, N = free_t.shape
     G = req_t.shape[1]
+    # cap ships as uint16 (4x slimmer over a tunneled chip than f32; the
+    # 60000 clamp is semantically uncapped — no node holds that many pods)
+    cap_f32 = cap_f32.astype(jnp.float32)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=0,
+        num_scalar_prefetch=3,  # cand, slots, counts: whole-array SMEM
         grid=(C,),
         in_specs=[
-            pl.BlockSpec((1,), lambda i: (i,), memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, gmax), lambda i: (i, 0), memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, gmax), lambda i: (i, 0), memory_space=pltpu.SMEM),
-            pl.BlockSpec((RP, N), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((RP, G), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((G, N), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((RP, N), lambda i, *_: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((RP, G), lambda i, *_: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (1, gmax, N), lambda i, *_: (i, 0, 0), memory_space=pltpu.VMEM
+            ),
         ],
-        out_specs=pl.BlockSpec((1, 1), lambda i: (i, 0), memory_space=pltpu.SMEM),
+        out_specs=pl.BlockSpec((C, 1), lambda i, *_: (0, 0), memory_space=pltpu.SMEM),
         scratch_shapes=[pltpu.VMEM((RP, N), jnp.float32)],
     )
-    return pl.pallas_call(
-        _kernel,
-        out_shape=jax.ShapeDtypeStruct((C, 1), jnp.int32),
-        grid_spec=grid_spec,
-        interpret=interpret,
-    )(candidates, slots, counts, free_t, req_t, cap_f32)
+
+    def one_band(args):
+        cand, slots, counts = args
+        # XLA-side gather: each candidate's per-slot cap rows, contiguous
+        # in HBM so the kernel DMAs one [GMAX, N] block per program
+        cap_slots = cap_f32[slots]  # [C, GMAX, N]
+        return pl.pallas_call(
+            _kernel,
+            out_shape=jax.ShapeDtypeStruct((C, 1), jnp.int32),
+            grid_spec=grid_spec,
+            interpret=interpret,
+        )(cand, slots, counts, free_t, req_t, cap_slots)
+
+    return jax.lax.map(one_band, (cand_bands, slots_bands, counts_bands))
 
 
-def repack_vmem_bytes(n_nodes: int, n_groups: int, n_res: int = 9) -> int:
+def repack_vmem_bytes(n_nodes: int, n_groups: int, n_res: int = 9,
+                      gmax: int = 32) -> int:
     """Estimated VMEM residency of the kernel's shared blocks + scratch."""
     N = _pad_to(max(n_nodes, LANE), LANE)
     RP = _pad_to(max(n_res, SUBLANE), SUBLANE)
     G = _pad_to(max(n_groups, SUBLANE), SUBLANE)
-    return 2 * RP * N * 4 + RP * G * 4 + G * N * 4  # free + scratch + req + compat(int32 tiles)
+    # free + scratch + req + double-buffered per-program cap_slots block
+    return 2 * RP * N * 4 + RP * G * 4 + 2 * gmax * N * 4
 
 
 # Stay well under the ~16MB/core VMEM budget (pallas_guide.md "Memory
@@ -146,25 +195,31 @@ def repack_check_pallas(
     unlike ``repack_check`` which gathers on device.
 
     Every axis is padded to a bucket so the kernel compiles once per bucket,
-    not once per cluster size: nodes/lanes to 128, the candidate grid to
-    256-wide bands (padding candidates carry zero slots and are sliced off)."""
+    not once per cluster size: nodes/lanes to 128, candidates to 256-wide
+    BANDS run as separate calls (padding candidates carry zero slots and
+    are sliced off). Banding keeps the scalar-prefetch slot tables + output
+    window inside the ~1MB SMEM budget — a 5k-candidate grid in one call
+    was 1.5MB of SMEM and failed to allocate on v5e."""
     N, R = free.shape
     C = candidates.shape[0]
     G = requests.shape[0]
     NP = _pad_to(max(N, LANE), LANE)
     RP = _pad_to(max(R, SUBLANE), SUBLANE)
     GP = _pad_to(max(G, SUBLANE), SUBLANE)
-    CP = _pad_to(max(C, 1), 256)
+    BAND = 256
+    CP = _pad_to(max(C, 1), BAND)
 
     free_t = np.zeros((RP, NP), dtype=np.float32)
     free_t[:R, :N] = free.T
     req_t = np.zeros((RP, GP), dtype=np.float32)
     req_t[:R, :G] = requests.T
-    cap_p = np.zeros((GP, NP), dtype=np.float32)
+    # uint16 wire format for the cap (H2D bandwidth is the sweep's cost on
+    # a tunneled chip): 60000 == uncapped, exact for any real headroom
+    cap_p = np.zeros((GP, NP), dtype=np.uint16)
     cap_p[:G, :N] = (
-        np.where(compat, _BIG, np.float32(0.0))
+        np.where(compat, np.uint16(60000), np.uint16(0))
         if compat.dtype == bool
-        else compat.astype(np.float32)
+        else np.minimum(compat, 60000).astype(np.uint16)
     )
     # padded node columns: free 0 / cap 0 -> never targets; padded group
     # rows only reachable from padded slots, which carry count 0
@@ -177,10 +232,14 @@ def repack_check_pallas(
     counts_p = np.zeros((CP, gmax), dtype=np.int32)
     counts_p[:C] = group_counts
 
+    # ONE device dispatch for the whole sweep (bands fused under lax.map)
+    # and ONE fetch: per-band transfers/dispatches over a tunneled chip
+    # cost ~10ms round-trip each and dominated the sweep.
+    B = CP // BAND
     out = _repack_call(
-        jnp.asarray(cand_p),
-        jnp.asarray(slots_p),
-        jnp.asarray(counts_p),
+        jnp.asarray(cand_p.reshape(B, BAND)),
+        jnp.asarray(slots_p.reshape(B, BAND, gmax)),
+        jnp.asarray(counts_p.reshape(B, BAND, gmax)),
         jnp.asarray(free_t),
         jnp.asarray(req_t),
         jnp.asarray(cap_p),

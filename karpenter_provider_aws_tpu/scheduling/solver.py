@@ -67,6 +67,7 @@ class ExistingNode:
     used: np.ndarray         # [R] resources consumed by bound pods
     allocatable: np.ndarray  # [R] node-reported allocatable
     taints: tuple = ()       # actual node taints (may diverge from the pool)
+    labels: dict = field(default_factory=dict)  # actual node labels (ditto)
 
 
 def snapshot_existing_capacity(cluster) -> list[ExistingNode]:
@@ -99,6 +100,7 @@ def snapshot_existing_capacity(cluster) -> list[ExistingNode]:
                 ),
                 allocatable=node.allocatable.v.astype(np.float32),
                 taints=tuple(node.taints),
+                labels=dict(node.labels),
             )
         )
     return out
@@ -373,8 +375,14 @@ def _encode_existing(problem: EncodedProblem, existing: Sequence[ExistingNode]):
     Nodes whose type/zone/captype fall outside the catalog snapshot are
     skipped, as are nodes carrying scheduling-effect taints beyond the
     pool template (group compat only covers template taints — an
-    out-of-band ``NoSchedule`` taint must not be silently violated).
-    Skipped nodes can still receive pods via the host binder."""
+    out-of-band ``NoSchedule`` taint must not be silently violated) and
+    nodes whose labels diverge from the pool template (advisor round-2
+    medium: group compat is computed from the CURRENT template, but a live
+    node carries labels stamped at launch — a since-edited template could
+    otherwise receive device-path binds its actual labels don't satisfy;
+    drift eventually replaces such nodes, but binds must not race it).
+    Skipped nodes can still receive pods via the host binder, which checks
+    actual labels."""
     tidx = {n: i for i, n in enumerate(problem.type_names)}
     zidx = {z: i for i, z in enumerate(problem.zones)}
     cidx = {c: i for i, c in enumerate(lbl.CAPACITY_TYPES)}
@@ -383,6 +391,7 @@ def _encode_existing(problem: EncodedProblem, existing: Sequence[ExistingNode]):
         (t.key, t.value, t.effect)
         for t in (problem.nodepool.taints if problem.nodepool else [])
     }
+    template_labels = dict(problem.nodepool.labels) if problem.nodepool else {}
     names: list[str] = []
     ptype, pused, pcap, pwin = [], [], [], []
     for e in existing:
@@ -395,6 +404,13 @@ def _encode_existing(problem: EncodedProblem, existing: Sequence[ExistingNode]):
             getattr(tt, "effect", "") in ("NoSchedule", "NoExecute")
             and (tt.key, tt.value, tt.effect) not in template
             for tt in e.taints
+        ):
+            continue
+        # labels stamped at launch must still agree with the template the
+        # compat matrix was computed from (e.labels empty = caller predates
+        # label snapshots; template-only callers keep the old behavior)
+        if e.labels and any(
+            e.labels.get(k) != v for k, v in template_labels.items()
         ):
             continue
         w = np.zeros((Z, C), dtype=bool)
@@ -501,9 +517,14 @@ class TPUSolver:
         pre_rows = _encode_existing(problem, existing) if existing else None
         n_pre = len(pre_rows[0]) if pre_rows else 0
 
+        # ``max_nodes`` bounds FRESH nodes only: pre-opened existing rows ride
+        # on top. n_pre is bucketed separately (coarse, power-of-2) so the
+        # compile shape stays stable as the live-node count drifts across
+        # steady-state reconciles — bucketing the SUM re-jitted the FFD scan
+        # every time n_pre crossed a boundary (advisor round-2).
         N = self.max_nodes or _node_bucket(num_pods)
         if n_pre:
-            N = bucket(n_pre + N, minimum=64)
+            N = N + bucket(n_pre, minimum=256)
         GB = bucket(G)
         padded = pad_problem(problem, GB)
 

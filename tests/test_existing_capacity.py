@@ -132,6 +132,34 @@ class TestExistingCapacity:
         assert res.binds == []
         assert res.pods_placed() == 2  # fresh nodes instead
 
+    def test_diverged_template_labels_block_device_binds(self, catalog, solver_cls):
+        # Node launched from an OLD template (team=a stamped); pool template
+        # has since moved to team=b. Group compat is computed from the
+        # current template, so a nodeSelector team=b pod would "fit" — but
+        # the node's real labels say team=a. The node must be skipped
+        # (advisor round-2 medium); drift will replace it eventually.
+        pool = cmr_pool()
+        pool.labels = {"team": "b"}
+        node, it = existing_node(catalog)
+        node.labels = {**it.labels(), "team": "a", lbl.TOPOLOGY_ZONE: node.zone,
+                       lbl.CAPACITY_TYPE: node.capacity_type, lbl.NODEPOOL: "default"}
+        pods = make_pods(2, "w", {"cpu": "1", "memory": "1Gi"},
+                         node_selector={"team": "b"})
+        res = solver_cls().solve(pods, [pool], catalog, existing=[node])
+        assert res.binds == []
+        assert res.pods_placed() == 2  # fresh team=b nodes instead
+
+    def test_template_matching_labels_still_bind(self, catalog, solver_cls):
+        pool = cmr_pool()
+        pool.labels = {"team": "b"}
+        node, it = existing_node(catalog)
+        node.labels = {**it.labels(), "team": "b", lbl.TOPOLOGY_ZONE: node.zone,
+                       lbl.CAPACITY_TYPE: node.capacity_type, lbl.NODEPOOL: "default"}
+        pods = make_pods(2, "w", {"cpu": "1", "memory": "1Gi"},
+                         node_selector={"team": "b"})
+        res = solver_cls().solve(pods, [pool], catalog, existing=[node])
+        assert len(res.binds) == 2
+
     def test_taints_on_pool_respected_for_existing_nodes(self, catalog, solver_cls):
         from karpenter_provider_aws_tpu.models import Taint
 

@@ -163,17 +163,57 @@ class TestHostnameAntiAffinityRepack:
 
 class TestZoneSpreadRepack:
     def test_blocked_when_move_would_violate_skew(self, env):
+        """zone-a retains a compatible-but-FULL survivor, so zone-a stays in
+        the skew domain: n-a1's pod can neither stay in zone-a (no room)
+        nor move to zone-b (counts (0, 2), skew 2 > 1). With no survivor in
+        the origin zone the domain would shrink and the move become legal —
+        see test_empty_zone_leaves_skew_domain."""
+        env.apply_defaults(pool_with())
+        spread = dict(
+            labels={"app": "web"},
+            topology_spread=[
+                TopologySpreadConstraint(
+                    topology_key=lbl.TOPOLOGY_ZONE, max_skew=1,
+                    label_selector={"app": "web"},
+                )
+            ],
+        )
+        pa = make_pods(1, "sa", {"cpu": "4", "memory": "2Gi"}, **spread)
+        pb = make_pods(1, "sb", {"cpu": "4", "memory": "2Gi"}, **spread)
+        add_node(env, "n-a1", "zone-a", pa, min_vcpus=16, max_vcpus=16)
+        add_node(
+            env, "n-a2", "zone-a",
+            make_pods(1, "fill", {"cpu": "12", "memory": "2Gi"}),
+            min_vcpus=16, max_vcpus=16,
+        )
+        add_node(env, "n-b", "zone-b", pb, min_vcpus=16, max_vcpus=16)
+        ct = encode_cluster(env.cluster, env.catalog)
+        ia1 = ct.node_names.index("n-a1")
+        assert not repack_set_feasible(ct, [ia1])
+        env.clock.advance(61)
+        env.disruption.reconcile()
+        # a zone-pinned replace-with-cheaper is legal (skew unchanged);
+        # a repack-DELETE of n-a1 is not
+        claim_a1 = env.cluster.nodes["n-a1"].nodeclaim_name
+        deletes = [
+            name for name, r in env.disruption.disrupted
+            if r == "consolidatable:delete"
+        ]
+        assert claim_a1 not in deletes
+
+    def test_empty_zone_leaves_skew_domain(self, env):
+        """Deleting the ONLY node of a zone removes that zone from the skew
+        domain (kube counts domains over eligible nodes): the pod relands in
+        the other zone legally, so the 1-1 pair IS consolidatable."""
         env.apply_defaults(pool_with())
         ps = spread_pods(2, "s", "web")
         add_node(env, "n-a", "zone-a", [ps[0]])
         add_node(env, "n-b", "zone-b", [ps[1]])
         ct = encode_cluster(env.cluster, env.catalog)
-        # deleting either node forces its pod into the other zone: counts
-        # become (0, 2) -> skew 2 > 1
         for ni in range(2):
-            assert not repack_set_feasible(ct, [ni])
-        env.disruption.reconcile()
-        assert not any(c.deleted for c in env.cluster.nodeclaims.values())
+            assert repack_set_feasible(ct, [ni])
+        # but never both at once (their pods need SOME survivor)
+        assert not repack_set_feasible(ct, [0, 1])
 
     def test_consolidates_within_zone_keeping_skew(self, env):
         env.apply_defaults(pool_with())
@@ -192,6 +232,43 @@ class TestZoneSpreadRepack:
         # the zone-b node must not be disrupted (its pod has nowhere legal)
         names = {c.status.node_name for c in deleted}
         assert "n-b" not in names
+
+
+class TestSpreadFloorEligibleZones:
+    def test_ineligible_zone_does_not_pin_spread_budget(self, env):
+        """A zone with no surviving node compatible with the group must not
+        drag the skew floor to zero (advisor round-2): pods selecting zones
+        a/b spread across them; a zone-c node in the vocabulary is
+        irrelevant to their skew domain."""
+        from karpenter_provider_aws_tpu.models import Operator, Requirement
+
+        env.apply_defaults(pool_with())
+        zone_ab = [
+            Requirement(lbl.TOPOLOGY_ZONE, Operator.IN, ("zone-a", "zone-b"))
+        ]
+        ps = make_pods(
+            3, "s", {"cpu": "500m", "memory": "512Mi"},
+            labels={"app": "web"},
+            node_affinity=zone_ab,
+            topology_spread=[
+                TopologySpreadConstraint(
+                    topology_key=lbl.TOPOLOGY_ZONE, max_skew=1,
+                    label_selector={"app": "web"},
+                )
+            ],
+        )
+        add_node(env, "n-a1", "zone-a", [ps[0]])
+        add_node(env, "n-a2", "zone-a", [ps[1]])
+        add_node(env, "n-b", "zone-b", [ps[2]])
+        # zone-c node: in the zone vocabulary, incompatible with the group
+        add_node(env, "n-c", "zone-c",
+                 make_pods(1, "plain", {"cpu": "500m", "memory": "512Mi"}))
+        ct = encode_cluster(env.cluster, env.catalog)
+        ia1 = ct.node_names.index("n-a1")
+        # n-a1's pod relands on n-a2 (zone-a): counts (2,1) over {a,b},
+        # skew 1 — legal. With the floor over ALL zones (zone-c count 0)
+        # the budget was max(0+1-1, 0)=0 everywhere and this was blocked.
+        assert repack_set_feasible(ct, [ia1])
 
 
 class TestMultiNodeReplace:
@@ -253,6 +330,62 @@ class TestMultiNodeReplace:
         ]
         assert len(live_nodes) == 1
         assert len(env.cluster.pods_on_node(live_nodes[0].name)) == 4
+
+    def test_survivor_absorption_nominates_only_overflow(self, env):
+        """When survivors absorb part of the disrupted set's pods, only the
+        overflow is nominated onto the replacement (advisor round-2 high):
+        nominating everything would bind pods past the replacement's
+        allocatable, since replacement_for_groups sized it for the overflow
+        alone."""
+        env.apply_defaults(pool_with())
+        # survivor: 32-vcpu node pinned by a do-not-disrupt pod, ~7 cpu free
+        # (absorbs exactly one of the 5/6-cpu pods below, not two)
+        pin = make_pods(
+            1, "pin", {"cpu": "24", "memory": "8Gi"},
+            annotations={lbl.ANNOTATION_DO_NOT_DISRUPT: "true"},
+        )
+        add_node(env, "n-s", "zone-a", pin, min_vcpus=32, max_vcpus=32)
+        # two stranded nodes: pods don't fit each other's slack, and the
+        # survivor's free absorbs only one pod from either alone
+        a = make_pods(2, "a", {"cpu": "5", "memory": "4Gi"})
+        b = make_pods(2, "b", {"cpu": "6", "memory": "4Gi"})
+        add_node(env, "n-a", "zone-a", a, min_vcpus=16, max_vcpus=16)
+        add_node(env, "n-b", "zone-a", b, min_vcpus=16, max_vcpus=16)
+        ct = encode_cluster(env.cluster, env.catalog)
+        ia, ib = ct.node_names.index("n-a"), ct.node_names.index("n-b")
+        # preconditions: neither node repacks alone, the pair overflows
+        assert not repack_set_feasible(ct, [ia])
+        assert not repack_set_feasible(ct, [ib])
+        _, overflow = repack_set_feasible(ct, [ia, ib], allow_overflow=True)
+        n_overflow = sum(overflow.values())
+        assert 0 < n_overflow < 4  # survivors absorbed some, not all
+
+        claims_before = set(env.cluster.nodeclaims)
+        env.clock.advance(61)
+        env.disruption.reconcile()
+        reasons = [r for _, r in env.disruption.disrupted]
+        assert any("multi-replace" in r for r in reasons), reasons
+        new_claims = [
+            n for n in env.cluster.nodeclaims if n not in claims_before
+        ]
+        assert len(new_claims) == 1
+        with env.provisioning._nominations_lock:
+            nominated = [
+                uid
+                for uid, cn in env.provisioning.nominations.items()
+                if cn == new_claims[0]
+            ]
+        assert len(nominated) == n_overflow  # overflow only, not all 4
+
+        env.step(5)  # drain, register replacement, rebind + re-solve
+        assert not env.cluster.pending_pods()
+        # no node is overcommitted: bound requests fit allocatable
+        usage = env.cluster.node_usage()
+        for node in env.cluster.nodes.values():
+            used = usage.get(node.name)
+            if used is None:
+                continue
+            assert (used <= node.allocatable.v + 1e-6).all(), node.name
 
     def test_no_replace_when_not_cheaper(self, env):
         """A set whose combined pods only fit an equal-or-pricier node must
