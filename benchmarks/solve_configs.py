@@ -129,7 +129,7 @@ def _run_config(name, pods, pools, catalog, iters=DEFAULT_ITERS):
         times.append((time.perf_counter() - t0) * 1000.0)
     host_res = host.solve(pods, pools, catalog)
     cost_ratio = (
-        res.total_cost / host_res.total_cost if host_res.total_cost > 0 else float("nan")
+        r.total_cost / host_res.total_cost if host_res.total_cost > 0 else float("nan")
     )
     return {
         "benchmark": name,
@@ -139,6 +139,13 @@ def _run_config(name, pods, pools, catalog, iters=DEFAULT_ITERS):
         "placed": res.pods_placed(),
         "unschedulable": len(res.unschedulable),
         "cost_vs_greedy": round(cost_ratio, 4),
+        # per-stage wall of the LAST iteration: encode (host tensorization),
+        # device (upload + scan + rank + fetch), decode (refine + specs)
+        "breakdown_ms": {
+            k: round(v, 1) for k, v in tpu.timings.items() if k.endswith("_ms")
+        },
+        "n_rows": tpu.timings.get("n_rows"),
+        "n_open": tpu.timings.get("n_open"),
     }
 
 
@@ -200,24 +207,90 @@ def _synth_cluster(n_nodes=5000, pods_per_node=8):
 
 
 def config4_consolidation(n_nodes=5000, iters=5):
-    """Multi-node consolidation repack sweep over a 5k-node cluster."""
+    """Multi-node consolidation repack sweep over a 5k-node cluster.
+
+    Measures BOTH device backends on whatever platform is live: the XLA
+    vmap path and the Pallas VMEM-resident kernel (compiled on real TPU;
+    interpret mode is test-only and not measured here). The encode step is
+    timed separately — it is host work shared by every backend."""
+    import jax
+
     from karpenter_provider_aws_tpu.ops.consolidate import consolidatable, encode_cluster
 
     env = _synth_cluster(n_nodes=n_nodes)
+    t0 = time.perf_counter()
     ct = encode_cluster(env.cluster, env.catalog)
-    mask = consolidatable(ct)  # warmup/compile
-    times = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        mask = consolidatable(ct)
-        times.append((time.perf_counter() - t0) * 1000.0)
-    return {
+    encode_ms = (time.perf_counter() - t0) * 1000.0
+
+    import os
+
+    backends = ["vmap"]
+    if jax.default_backend() != "cpu":
+        backends.append("pallas")
+    out = {
         "benchmark": "config4_consolidation_repack",
         "nodes": n_nodes,
-        "p99_ms": round(float(np.percentile(times, 99)), 3),
-        "p50_ms": round(float(np.percentile(times, 50)), 3),
-        "consolidatable_nodes": int(mask.sum()),
+        "encode_ms": round(encode_ms, 1),
+        "device": jax.default_backend(),
     }
+    mask = None
+    for backend in backends:
+        os.environ["KARPENTER_TPU_REPACK"] = backend
+        try:
+            mask = consolidatable(ct)  # warmup/compile
+            times = []
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                mask = consolidatable(ct)
+                times.append((time.perf_counter() - t0) * 1000.0)
+            out[f"{backend}_p99_ms"] = round(float(np.percentile(times, 99)), 3)
+            out[f"{backend}_p50_ms"] = round(float(np.percentile(times, 50)), 3)
+        except Exception as e:  # a backend failure must not lose the row
+            out[f"{backend}_error"] = f"{type(e).__name__}: {e}"[:200]
+        finally:
+            os.environ.pop("KARPENTER_TPU_REPACK", None)
+    # headline numbers = the single backend with the best p99 (p50 rides
+    # along from the SAME backend; independent mins could mix two backends
+    # into a latency pair neither produced)
+    measured = [b for b in backends if f"{b}_p99_ms" in out]
+    if measured:
+        best_b = min(measured, key=lambda b: out[f"{b}_p99_ms"])
+        out["p99_ms"] = out[f"{best_b}_p99_ms"]
+        out["p50_ms"] = out[f"{best_b}_p50_ms"]
+        out["best_backend"] = best_b
+    else:
+        out["p99_ms"] = out["p50_ms"] = None
+    out["consolidatable_nodes"] = int(mask.sum()) if mask is not None else -1
+    return out
+
+
+def config6_mixed_tail(scale=1):
+    """A workload where the packed-cost refinement beats the greedy FFD.
+
+    Greedy first-fit leaves two singleton tail nodes: the dual-arch group's
+    tail lands on the cheapest (arm) 16-vcpu node, then the amd64-pinned
+    group — incompatible with that node — opens its own. The dual pod fits
+    the amd tail's slack, so the refine pass drops the arm tail entirely;
+    the greedy cannot see this (its first-fit invariant only looks
+    backward). cost_vs_greedy < 1.0 is the point of this config."""
+    pool = NodePool(
+        name="default",
+        requirements=[
+            Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m")),
+            Requirement(lbl.INSTANCE_CPU, Operator.IN, ("16",)),
+        ],
+        disruption=Disruption(consolidate_after_s=None),
+    )
+    pods = []
+    # per 16-vcpu node: two 6-cpu dual pods (count 2k+1 -> singleton tail),
+    # three 4.5-cpu amd pods (count 3k+1 -> singleton tail w/ ~2.5 free + 6
+    # from allocatable margin)
+    pods += make_pods(21, "dual", {"cpu": "6", "memory": "4Gi"})
+    pods += make_pods(
+        31, "amd", {"cpu": "4500m", "memory": "4Gi"},
+        node_selector={lbl.ARCH: "amd64"},
+    )
+    return pods, [pool]
 
 
 def run_all(scale=1.0, iters=DEFAULT_ITERS):
@@ -228,6 +301,7 @@ def run_all(scale=1.0, iters=DEFAULT_ITERS):
         ("config2_heterogeneous_50k", config2_heterogeneous, {"n": int(50_000 * scale)}),
         ("config3_topology_10k", config3_topology, {"n": int(10_000 * scale)}),
         ("config5_accelerators", config5_accelerators, {"n": int(4000 * scale)}),
+        ("config6_mixed_tail_beats_greedy", config6_mixed_tail, {}),
     ):
         if builder is config5_accelerators:
             kwargs["catalog"] = catalog

@@ -383,55 +383,77 @@ def encode_problem(
     else:
         base_ok = np.ones(T, dtype=bool)
 
+    # Zone-pin expansion multiplies groups (one spread service -> one
+    # subgroup per zone) but subgroups of the same original group share ALL
+    # zone-independent work: requirements extraction, static label compat,
+    # resource fit, and the per-(type, zone) price floor. Compute those once
+    # per scheduling key; per subgroup only the [T, Z] zone combine remains.
+    shared: dict = {}
     for gi, (plist, zone_pin, mpn, zone_mask) in enumerate(expanded):
         pod = plist[0]
         requests[gi] = pod.requests.v
         counts[gi] = len(plist)
         max_per_node[gi] = mpn
-        reqs = _group_requirements(pod, nodepool)
+        ck = pod.scheduling_key()
+        hit = shared.get(ck)
+        if hit is None:
+            reqs = _group_requirements(pod, nodepool)
+            # Offering-level allowances: which zones / capacity types may
+            # serve this group (zone + capacity-type as requirements).
+            zvs = reqs.get(lbl.TOPOLOGY_ZONE)
+            cvs = reqs.get(lbl.CAPACITY_TYPE)
+            zrow = np.array([zvs.contains(z) for z in tensors.zones])
+            crow = np.array([cvs.contains(ct) for ct in lbl.CAPACITY_TYPES])
 
-        # Offering-level allowances: which zones / capacity types may serve
-        # this group (parity: zone + capacity-type as ordinary requirements).
-        zvs = reqs.get(lbl.TOPOLOGY_ZONE)
-        cvs = reqs.get(lbl.CAPACITY_TYPE)
-        zone_allowed[gi] = [zvs.contains(z) for z in tensors.zones]
+            # Static label compat, vectorized over T per requirement key.
+            static_ok = base_ok.copy()
+            for key, vs in reqs:
+                if key in _SKIP_KEYS or key in provided_keys:
+                    continue
+                arrays = label_arrays.get(key)
+                if arrays is None:
+                    # No type defines this label; satisfiable only if
+                    # absence is OK.
+                    if not vs.allow_undefined:
+                        static_ok[:] = False
+                        break
+                    continue
+                static_ok &= _contains_vec(vs, *arrays)
+                if not static_ok.any():
+                    break
+
+            fits = (pod.requests.v[None, :] <= tensors.capacity + 1e-6).all(axis=1)
+            # (reserved-offering access is enforced via the masked
+            # `available` array — price, compat, type_window derive from it)
+            offer_tc = available & crow[None, None, :]           # [T, Z, C]
+            price_tz = np.where(offer_tc, tensors.price, np.inf).min(axis=2)
+            avail_tz = offer_tc.any(axis=2)                      # [T, Z]
+            hit = (zrow, crow, static_ok, fits, price_tz, avail_tz)
+            shared[ck] = hit
+        zrow, crow, static_ok, fits, price_tz, avail_tz = hit
+
+        zone_allowed[gi] = zrow
         if zone_mask is not None:
             zone_allowed[gi] &= zone_mask
         if zone_pin is not None:
             pin = np.zeros(Z, dtype=bool)
             pin[zone_pin] = True
             zone_allowed[gi] &= pin
-        captype_allowed[gi] = [cvs.contains(ct) for ct in lbl.CAPACITY_TYPES]
-        # (reserved-offering access is enforced via the masked `available`
-        # array above — price, compat, and type_window all derive from it)
+        captype_allowed[gi] = crow
         group_window[gi] = zone_allowed[gi][:, None] & captype_allowed[gi][None, :]
 
-        # Static label compat, vectorized over T per requirement key.
-        static_ok = base_ok.copy()
-        for key, vs in reqs:
-            if key in _SKIP_KEYS or key in provided_keys:
-                continue
-            arrays = label_arrays.get(key)
-            if arrays is None:
-                # No type defines this label; satisfiable only if absence is OK.
-                if not vs.allow_undefined:
-                    static_ok[:] = False
-                    break
-                continue
-            static_ok &= _contains_vec(vs, *arrays)
-            if not static_ok.any():
-                break
-
-        # x offering availability x single-pod resource fit.
-        offer_ok = (
-            available
-            & zone_allowed[gi][None, :, None]
-            & captype_allowed[gi][None, None, :]
-        )  # [T, Z, C]
-        fits = (pod.requests.v[None, :] <= tensors.capacity + 1e-6).all(axis=1)
-        row = static_ok & offer_ok.any(axis=(1, 2)) & fits
+        zmask = zone_allowed[gi]
+        if zmask.all():
+            offer_any = avail_tz.any(axis=1)
+            row_price = price_tz.min(axis=1)
+        elif zmask.any():
+            offer_any = avail_tz[:, zmask].any(axis=1)
+            row_price = price_tz[:, zmask].min(axis=1)
+        else:
+            offer_any = np.zeros(T, dtype=bool)
+            row_price = np.full(T, np.inf, dtype=np.float32)
+        row = static_ok & offer_any & fits
         compat[gi] = row
-        row_price = np.where(offer_ok, tensors.price, np.inf).min(axis=(1, 2))
         price[gi] = np.where(row, row_price, np.inf)
 
     # -- FFD order: decreasing dominant share ------------------------------

@@ -8,4 +8,9 @@ kube/AWS API boundaries -> host<->device transfers. Collectives ride ICI
 SURVEY.md section 5 ("distributed communication backend").
 """
 
-from .mesh import make_mesh, solve_sharded, sharded_solve_fn  # noqa: F401
+from .mesh import (  # noqa: F401
+    make_mesh,
+    merge_sharded_plan,
+    sharded_solve_fn,
+    solve_sharded,
+)

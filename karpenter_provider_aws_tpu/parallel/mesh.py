@@ -160,11 +160,15 @@ def merge_sharded_plan(problem, mesh: Mesh, max_nodes: int = 1024):
         m_window[lo:hi] = node_window[d, :k]
         # shard d owns global group rows [d*Gs, (d+1)*Gs)
         m_placed[d * Gs:(d + 1) * Gs, lo:hi] = placed[d, :, :k]
+    # A merge pass is once-per-reconcile, not per-solve: spend a bigger
+    # descent budget than the in-solve refine, and admit nearly-full nodes
+    # as candidates (0.97) — shard tails often pack to ~0.9+ and still
+    # drain into another shard's slack.
     dropped, _ = _refine_plan(
-        problem, m_type, m_price, m_used, m_window, m_placed, M
+        problem, m_type, m_price, m_used, m_window, m_placed, M,
+        max_tries=512, util_threshold=0.97,
     )
-    live = np.arange(M) < M
-    cost_merged = float(np.where(live & ~dropped, m_price, 0.0).sum())
+    cost_merged = float(np.where(~dropped, m_price, 0.0).sum())
     return {
         "node_type": m_type,
         "node_price": m_price,

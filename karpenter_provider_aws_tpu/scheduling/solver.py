@@ -138,6 +138,44 @@ def _node_bucket(num_pods: int) -> int:
     return min(max(bucket(max(num_pods, 1), minimum=64), 64), 8192)
 
 
+def _estimate_nodes(problem: EncodedProblem, G: int) -> int:
+    """Demand-driven node-row estimate for the FFD scan.
+
+    Sizing N by pod count alone made every downstream stage (scan width,
+    device->host fetch, refine, decode) pay for rows that never open: 10k
+    half-cpu pods fit ~400 nodes, not 8192. Per group, assume the open
+    phase's own choice — the cheapest usable type — and count nodes at that
+    type's fit, capped by hostname topology; sum over groups (no-sharing
+    upper-ish bound), then 2x headroom. The solver retries at the full
+    pod-count bucket if the estimate ever proves too small (detected, not
+    assumed: rows exhausted AND pods unplaced)."""
+    counts = problem.counts[:G].astype(np.float64)
+    req = problem.requests[:G]
+    price = problem.price[:G]
+    finite = np.isfinite(price)
+    usable = finite.any(axis=1)
+    if not usable.any():
+        return 64
+    pref = np.argmin(np.where(finite, price, np.inf), axis=1)  # [G]
+    cap_pref = problem.capacity[pref]                          # [G, R]
+    with np.errstate(divide="ignore", invalid="ignore"):
+        ratio = np.where(
+            req > 0, (cap_pref + 1e-4) / np.where(req > 0, req, 1.0), np.inf
+        )
+    k_per_node = np.clip(ratio.min(axis=1), 1.0, float(1 << 30))
+    mpn = np.maximum(problem.max_per_node[:G], 1)
+    k_eff = np.minimum(k_per_node, mpn)
+    nodes_g = np.ceil(counts / k_eff)
+    # hostname-capped groups SHARE nodes with each other (different
+    # services' anti-affinity pods co-locate fine): counting them per-group
+    # overshoots by the number of capped services — take their max, not sum
+    capped = (problem.max_per_node[:G] < (1 << 30)) & usable
+    est = float(nodes_g[usable & ~capped].sum())
+    if capped.any():
+        est += float(nodes_g[capped].max())
+    return int(est * 2.0) + 8
+
+
 def _decode_nodes(
     problem: EncodedProblem,
     node_type: np.ndarray,
@@ -198,16 +236,29 @@ def _decode_nodes(
             _win_memo[key] = hit
         return hit
 
+    # One nonzero pass over the whole plan instead of a [G] slice per node,
+    # and one vectorized name materialization for every node's ranking —
+    # the per-node Python loops were ~1/6 of e2e solve wall at 2k+ nodes.
+    gq, nq = np.nonzero(placed[:G, :n_open])
+    by_node: dict[int, list[int]] = {}
+    for g, n in zip(gq.tolist(), nq.tolist()):
+        by_node.setdefault(n, []).append(g)
+    names_arr = np.asarray(problem.type_names, dtype=object)
+    all_ranked_names = None
+    if ranked_idx is not None:
+        kmax = min(ranked_idx.shape[1], MAX_INSTANCE_TYPE_OPTIONS)
+        all_ranked_names = names_arr[ranked_idx[:n_open, :kmax]]  # [n_open, k] obj
+
     for n in range(n_open):
+        group_idx = by_node.get(n, ())
         col = placed[:G, n]
-        group_idx = np.nonzero(col)[0]
         pods: list[Pod] = []
         for g in group_idx:
             take = int(col[g])
             plist = problem.group_pods[g]
             pods.extend(plist[cursors[g]: cursors[g] + take])
             cursors[g] += take
-        if not pods and not group_idx.size:
+        if not pods and not group_idx:
             continue
         if n < n_pre:
             name = pre_names[n]
@@ -215,7 +266,8 @@ def _decode_nodes(
             continue
         committed = int(node_type[n])
         if ranked_idx is not None and (stale_rank is None or not stale_rank[n]):
-            ranked = ranked_idx[n, : min(int(ranked_n[n]), MAX_INSTANCE_TYPE_OPTIONS)]
+            k_n = min(int(ranked_n[n]), MAX_INSTANCE_TYPE_OPTIONS)
+            type_names = all_ranked_names[n, :k_n].tolist()
         else:
             # combined per-type price across the node's groups (inf if any
             # group cannot use the type) -> ranked alternatives; an
@@ -234,7 +286,7 @@ def _decode_nodes(
             order = np.argsort(np.where(usable, combined, np.inf), kind="stable")
             n_usable = int(usable.sum())
             ranked = order[: min(n_usable, MAX_INSTANCE_TYPE_OPTIONS)]
-        type_names = [problem.type_names[t] for t in ranked]
+            type_names = [problem.type_names[t] for t in ranked]
         if problem.type_names[committed] not in type_names:
             type_names = [problem.type_names[committed]] + type_names[:-1]
 
@@ -288,46 +340,60 @@ def _refine_plan(
     """
     G = len(problem.group_pods)
     Nn = len(node_type)
-    idx = np.arange(Nn)
-    live = idx < n_open
-    pods_on = placed[:G].sum(axis=0)
+    dropped = np.zeros(Nn, dtype=bool)
+    stale = np.zeros(Nn, dtype=bool)
+    # Every array below is sliced to the LIVE rows: the node buffer is a
+    # power-of-2 bucket, and paying O(bucket) per trial when only n_open
+    # rows exist made this pass the biggest host cost of a topology solve.
+    # numpy basic slices are views — commits propagate to the caller.
+    L = n_open
+    placed_l = placed[:G, :L]
+    used_l = used[:L]
+    window_l = node_window[:L]
+    ntype_l = node_type[:L]
+    idx = np.arange(L)
+    pods_on = placed_l.sum(axis=0)
     # Actual per-node allocatable when provided (pre-opened existing nodes
     # may report less than the catalog value); catalog fallback otherwise.
-    cap = node_cap if node_cap is not None else problem.capacity[node_type]
-    free = cap - used
+    cap = (node_cap[:L] if node_cap is not None else problem.capacity[ntype_l])
+    free = cap - used_l
     with np.errstate(invalid="ignore", divide="ignore"):
-        util = np.where(
-            live, (used / np.maximum(cap, 1e-9)).max(axis=1), np.inf
-        )
+        util = (used_l / np.maximum(cap, 1e-9)).max(axis=1)
     # Existing nodes are never drop candidates here — retiring live capacity
     # is the consolidation controller's call, not the provisioner's.
-    cand = live & (idx >= n_pre) & (pods_on > 0) & (util < util_threshold)
+    cand = (idx >= n_pre) & (pods_on > 0) & (util < util_threshold)
     cand_idx = idx[cand]
     if cand_idx.size == 0:
-        return np.zeros(Nn, dtype=bool), np.zeros(Nn, dtype=bool)
+        return dropped, stale
     # bounded: lowest-utilization pool, most-expensive-first within it
     pool = cand_idx[np.argsort(util[cand_idx], kind="stable")][:max_tries]
     pool = pool[np.argsort(-node_price[pool], kind="stable")]
 
-    dropped = np.zeros(Nn, dtype=bool)
-    stale = np.zeros(Nn, dtype=bool)
+    dropped_l = dropped[:L]
+    stale_l = stale[:L]
     mpn = problem.max_per_node
     finite_price = np.isfinite(problem.price)  # [G, T]
+    fail_streak = 0
     for n in pool:
-        gids = np.nonzero(placed[:G, n])[0]
+        if fail_streak >= 32:
+            # cost descent is best-effort: a long failure run means the
+            # remaining (even-lower-utilization) candidates are unlikely to
+            # repack either — stop paying O(G x N) per miss
+            break
+        gids = np.nonzero(placed_l[:, n])[0]
         # trial first-fit of every group of n into the surviving slack;
         # windows narrow DURING the trial (a receiver taking group g1 then
         # g2 must keep a non-empty joint window, like the device scan)
         trial_free = free.copy()
-        trial_window = node_window.copy()
+        trial_window = window_l.copy()
         moves: list[tuple[int, np.ndarray]] = []
         ok = True
         for g in gids:
-            cnt = int(placed[g, n])
+            cnt = int(placed_l[g, n])
             req = problem.requests[g]
             gw = problem.group_window[g]
-            elig = live & ~dropped & (idx != n)
-            elig &= finite_price[g][node_type]
+            elig = ~dropped_l & (idx != n)
+            elig &= finite_price[g][ntype_l]
             elig &= (trial_window & gw[None, :, :]).any(axis=(1, 2))
             if int(mpn[g]) < (1 << 30):
                 # hostname-capped groups stay off existing nodes (their
@@ -341,7 +407,7 @@ def _refine_plan(
                 np.inf,
             )
             k = np.clip(np.nanmin(ratio, axis=1), 0, float(1 << 30)).astype(np.int64)
-            k = np.minimum(k, int(mpn[g]) - placed[g])
+            k = np.minimum(k, int(mpn[g]) - placed_l[g])
             k = np.where(elig, k, 0)
             cum = np.cumsum(k) - k
             take = np.clip(cnt - cum, 0, k).astype(np.int64)
@@ -353,19 +419,21 @@ def _refine_plan(
             trial_window[recv] &= gw[None, :, :]
             moves.append((int(g), take))
         if not ok:
+            fail_streak += 1
             continue
+        fail_streak = 0
         # commit: move pods, grow receivers, adopt trial windows, drop node
         for g, take in moves:
             recv = np.nonzero(take)[0]
-            placed[g, recv] += take[recv]
-            used[recv] += take[recv, None] * problem.requests[g][None, :]
-            stale[recv] = True
-            placed[g, n] = 0
-        node_window[:] = trial_window
-        free = cap - used
+            placed_l[g, recv] += take[recv]
+            used_l[recv] += take[recv, None] * problem.requests[g][None, :]
+            stale_l[recv] = True
+            placed_l[g, n] = 0
+        window_l[:] = trial_window
+        free = cap - used_l
         free[n] = 0
-        used[n] = 0
-        dropped[n] = True
+        used_l[n] = 0
+        dropped_l[n] = True
     return dropped, stale
 
 
@@ -499,6 +567,9 @@ class TPUSolver:
         self.group_chunk = group_chunk
         self.max_nodes = max_nodes
         self.refine = refine
+        # per-stage wall clock of the LAST solve (encode / device+transfer /
+        # refine / decode), for the bench breakdown and perf triage
+        self.timings: dict[str, float] = {}
 
     def solve_encoded(
         self, problem: EncodedProblem, existing: Optional[Sequence[ExistingNode]] = None,
@@ -516,116 +587,151 @@ class TPUSolver:
         # slack must always beat opening a new node).
         pre_rows = _encode_existing(problem, existing) if existing else None
         n_pre = len(pre_rows[0]) if pre_rows else 0
+        names = pre_rows[0] if pre_rows else []
 
-        # ``max_nodes`` bounds FRESH nodes only: pre-opened existing rows ride
-        # on top. n_pre is bucketed separately (coarse, power-of-2) so the
-        # compile shape stays stable as the live-node count drifts across
-        # steady-state reconciles — bucketing the SUM re-jitted the FFD scan
-        # every time n_pre crossed a boundary (advisor round-2).
-        N = self.max_nodes or _node_bucket(num_pods)
-        if n_pre:
-            N = N + bucket(n_pre, minimum=256)
         GB = bucket(G)
         padded = pad_problem(problem, GB)
 
-        state = None
-        if pre_rows:
-            from ..ops.ffd import _State as _S
+        def run(N: int):
+            state = None
+            if pre_rows:
+                from ..ops.ffd import _State as _S
 
-            names, ptype, pused, pcap, pwin = pre_rows
-            R = padded.requests.shape[1]
-            Z, C = padded.group_window.shape[1], padded.group_window.shape[2]
-            node_type = np.zeros(N, dtype=np.int32)
-            node_price = np.zeros(N, dtype=np.float32)
-            used0 = np.zeros((N, R), dtype=np.float32)
-            cap0 = np.zeros((N, R), dtype=np.float32)
-            win0 = np.zeros((N, Z, C), dtype=bool)
-            node_type[:n_pre] = ptype
-            used0[:n_pre] = pused
-            cap0[:n_pre] = pcap
-            win0[:n_pre] = pwin
-            state = _S(
-                node_type=jnp.asarray(node_type),
-                node_price=jnp.asarray(node_price),
-                used=jnp.asarray(used0),
-                node_cap=jnp.asarray(cap0),
-                node_window=jnp.asarray(win0),
-                n_open=jnp.asarray(n_pre, dtype=jnp.int32),
+                nm, ptype, pused, pcap, pwin = pre_rows
+                R = padded.requests.shape[1]
+                Z, C = padded.group_window.shape[1], padded.group_window.shape[2]
+                node_type0 = np.zeros(N, dtype=np.int32)
+                node_price0 = np.zeros(N, dtype=np.float32)
+                used0 = np.zeros((N, R), dtype=np.float32)
+                cap0 = np.zeros((N, R), dtype=np.float32)
+                win0 = np.zeros((N, Z, C), dtype=bool)
+                node_type0[:n_pre] = ptype
+                used0[:n_pre] = pused
+                cap0[:n_pre] = pcap
+                win0[:n_pre] = pwin
+                state = _S(
+                    node_type=jnp.asarray(node_type0),
+                    node_price=jnp.asarray(node_price0),
+                    used=jnp.asarray(used0),
+                    node_cap=jnp.asarray(cap0),
+                    node_window=jnp.asarray(win0),
+                    n_open=jnp.asarray(n_pre, dtype=jnp.int32),
+                )
+
+            placed_chunks = []
+            unplaced_chunks = []
+            chunk = min(self.group_chunk, GB)
+            for start in range(0, GB, chunk):
+                sl = slice(start, start + chunk)
+                res = ffd_solve(
+                    jnp.asarray(padded.requests[sl]),
+                    jnp.asarray(padded.counts[sl]),
+                    jnp.asarray(padded.compat[sl]),
+                    jnp.asarray(padded.capacity),
+                    jnp.asarray(padded.price[sl]),
+                    jnp.asarray(padded.group_window[sl]),
+                    jnp.asarray(padded.type_window),
+                    max_per_node=jnp.asarray(padded.max_per_node[sl]),
+                    max_nodes=N,
+                    init_state=state,
+                    n_pre=n_pre,
+                )
+                from ..ops.ffd import _State
+
+                state = _State(
+                    node_type=res.node_type,
+                    node_price=res.node_price,
+                    used=res.used,
+                    node_cap=res.node_cap,
+                    node_window=res.node_window,
+                    n_open=res.n_open,
+                )
+                placed_chunks.append(res.placed)
+                unplaced_chunks.append(res.unplaced)
+
+            # Launch-alternative ranking runs ON DEVICE (one fused [N, T]
+            # program) instead of an argsort per opened node on the host —
+            # at thousands of nodes x 700 types the host loop was the
+            # second biggest cost in the solve path.
+            from ..ops.ffd import rank_launch_options
+
+            placed_dev = (
+                placed_chunks[0]
+                if len(placed_chunks) == 1
+                else jnp.concatenate(placed_chunks, axis=0)
             )
+            exotic = (
+                jnp.asarray(problem.type_exotic)
+                if problem.type_exotic is not None
+                else jnp.zeros(problem.capacity.shape[0], dtype=bool)
+            )
+            k = min(MAX_INSTANCE_TYPE_OPTIONS, problem.capacity.shape[0])
+            ranked_idx_dev, ranked_n_dev = rank_launch_options(
+                placed_dev, jnp.asarray(padded.price), state.used,
+                jnp.asarray(padded.capacity), jnp.asarray(padded.type_window),
+                state.node_window, state.node_type, exotic, k=k,
+            )
+
+            # ONE device->host fetch for everything the decode needs. Each
+            # individual np.asarray on a device array is a full transfer
+            # round-trip (~tens of ms over a remote-device tunnel), and
+            # there are 5 + 2*chunks of them — batching is the difference
+            # between ~500 ms and ~70 ms end-to-end on a tunneled chip.
+            # Transfers are slimmed: only the real group rows of `placed`,
+            # int16 counts (per-node placements are bounded by the pods
+            # resource << 32k), int16 rankings; node_cap is reconstructed
+            # host-side from the committed types instead of fetched.
+            return jax.device_get(
+                (placed_dev[:G].astype(jnp.int16), unplaced_chunks,
+                 state.node_type, state.node_price, state.used, state.n_open,
+                 state.node_window, ranked_idx_dev, ranked_n_dev)
+            )
+
+        # ``max_nodes`` bounds FRESH nodes only: pre-opened existing rows
+        # ride on top, bucketed separately (coarse, power-of-2) so the
+        # compile shape stays stable as the live-node count drifts across
+        # steady-state reconciles (advisor round-2). Without an explicit
+        # cap, N starts at the demand estimate and retries at the full
+        # pod-count bucket iff the scan ran out of rows with pods left.
+        N_cap = self.max_nodes or _node_bucket(num_pods)
+        if self.max_nodes:
+            N = N_cap
         else:
-            names = []
-
-        placed_chunks = []
-        unplaced_chunks = []
-        chunk = min(self.group_chunk, GB)
-        for start in range(0, GB, chunk):
-            sl = slice(start, start + chunk)
-            res = ffd_solve(
-                jnp.asarray(padded.requests[sl]),
-                jnp.asarray(padded.counts[sl]),
-                jnp.asarray(padded.compat[sl]),
-                jnp.asarray(padded.capacity),
-                jnp.asarray(padded.price[sl]),
-                jnp.asarray(padded.group_window[sl]),
-                jnp.asarray(padded.type_window),
-                max_per_node=jnp.asarray(padded.max_per_node[sl]),
-                max_nodes=N,
-                init_state=state,
-                n_pre=n_pre,
-            )
-            from ..ops.ffd import _State
-
-            state = _State(
-                node_type=res.node_type,
-                node_price=res.node_price,
-                used=res.used,
-                node_cap=res.node_cap,
-                node_window=res.node_window,
-                n_open=res.n_open,
-            )
-            placed_chunks.append(res.placed)
-            unplaced_chunks.append(res.unplaced)
-
-        # Launch-alternative ranking runs ON DEVICE (one fused [N, T]
-        # program) instead of an argsort per opened node on the host — at
-        # thousands of nodes x 700 types the host loop was the second
-        # biggest cost in the solve path.
-        from ..ops.ffd import rank_launch_options
-
-        placed_dev = placed_chunks[0] if len(placed_chunks) == 1 else jnp.concatenate(placed_chunks, axis=0)
-        exotic = (
-            jnp.asarray(problem.type_exotic)
-            if problem.type_exotic is not None
-            else jnp.zeros(problem.capacity.shape[0], dtype=bool)
-        )
-        k = min(MAX_INSTANCE_TYPE_OPTIONS, problem.capacity.shape[0])
-        ranked_idx_dev, ranked_n_dev = rank_launch_options(
-            placed_dev, jnp.asarray(padded.price), state.used,
-            jnp.asarray(padded.capacity), jnp.asarray(padded.type_window),
-            state.node_window, state.node_type, exotic, k=k,
-        )
-
-        # ONE device->host fetch for everything the decode needs. Each
-        # individual np.asarray on a device array is a full transfer
-        # round-trip (~tens of ms over a remote-device tunnel), and there
-        # are 5 + 2*chunks of them — batching is the difference between
-        # ~500 ms and ~70 ms end-to-end on a tunneled chip. Transfers are
-        # slimmed: only the real group rows of `placed`, int16 rankings.
-        (placed, unplaced_chunks, node_type, node_price, used, node_cap, n_open,
-         node_window, ranked_idx, ranked_n) = jax.device_get(
-            (placed_dev[:G], unplaced_chunks, state.node_type, state.node_price,
-             state.used, state.node_cap, state.n_open, state.node_window,
-             ranked_idx_dev, ranked_n_dev)
-        )
+            N = min(bucket(max(_estimate_nodes(problem, G), 64), minimum=64), N_cap)
+        pre_extra = bucket(n_pre, minimum=256) if n_pre else 0
+        t_dev = time.perf_counter()
+        (placed, unplaced_chunks, node_type, node_price, used,
+         n_open, node_window, ranked_idx, ranked_n) = run(N + pre_extra)
         unplaced_arr = np.concatenate(unplaced_chunks)[:G]
         n_open = int(n_open)
+        if unplaced_arr.sum() > 0 and n_open >= N + pre_extra and N < N_cap:
+            # estimate proved too small (rows exhausted, pods left over):
+            # one retry at the full bucket
+            N = N_cap
+            (placed, unplaced_chunks, node_type, node_price, used,
+             n_open, node_window, ranked_idx, ranked_n) = run(N + pre_extra)
+            unplaced_arr = np.concatenate(unplaced_chunks)[:G]
+            n_open = int(n_open)
+        self.timings["device_ms"] = self.timings.get("device_ms", 0.0) + (
+            (time.perf_counter() - t_dev) * 1e3
+        )
+        self.timings["n_rows"] = self.timings.get("n_rows", 0) + N + pre_extra
+        self.timings["n_open"] = self.timings.get("n_open", 0) + n_open
+        # reconstructed, not fetched: committed types index the catalog
+        # capacity; pre-opened rows keep their node-reported allocatable
+        node_cap = problem.capacity[node_type]
+        if n_pre:
+            node_cap[:n_pre] = pre_rows[3]
 
         # Packed-cost descent: drop plan nodes the rest of the plan absorbs.
+        t_host = time.perf_counter()
         stale_rank = None
         if self.refine and n_open - n_pre > 2:
             # device_get arrays are read-only views; the descent mutates
+            # (placed widens from its int16 wire format for mpn arithmetic)
             placed, used, node_window = (
-                np.array(placed), np.array(used), np.array(node_window)
+                np.array(placed, dtype=np.int32), np.array(used),
+                np.array(node_window),
             )
             dropped, stale_rank = _refine_plan(
                 problem, node_type, node_price, used, node_window, placed, n_open,
@@ -647,6 +753,9 @@ class TPUSolver:
             pre_names=names,
         )
         unplaced = {g: int(c) for g, c in enumerate(unplaced_arr) if c > 0}
+        self.timings["decode_ms"] = self.timings.get("decode_ms", 0.0) + (
+            (time.perf_counter() - t_host) * 1e3
+        )
         return specs, binds, unplaced
 
     def solve(self, pods, nodepools, catalog, in_use=None, occupancy=None, type_allow=None,
@@ -754,6 +863,8 @@ def _solve_multi_nodepool(
     reserved_allow=None, existing=None,
 ) -> SolveResult:
     t0 = time.perf_counter()
+    if hasattr(impl, "timings"):
+        impl.timings = {}
     result = SolveResult(num_pods=len(pods))
     remaining: list[Pod] = list(pods)
     reasons: dict[str, str] = {}
@@ -765,8 +876,14 @@ def _solve_multi_nodepool(
         # reserved_allow: per-pool gate on the pre-paid capacity type; pools
         # absent from an explicit map get no reserved access (isolation).
         allow_res = reserved_allow.get(pool.name, False) if reserved_allow is not None else True
+        t_enc = time.perf_counter()
         problem = encode_problem(remaining, catalog, nodepool=pool, occupancy=occupancy,
                                  allowed_types=allowed, allow_reserved=allow_res)
+        if hasattr(impl, "timings"):
+            # accumulate across nodepools: one solve() = one breakdown
+            impl.timings["encode_ms"] = impl.timings.get("encode_ms", 0.0) + (
+                (time.perf_counter() - t_enc) * 1e3
+            )
         for pod, why in problem.unencodable:
             reasons[pod.uid] = f"nodepool {pool.name}: {why}"
         # This pool's own live nodes ride along as pre-opened capacity (same
