@@ -19,7 +19,7 @@ import enum
 from typing import Optional
 
 from ..catalog.provider import CatalogProvider
-from ..fake.cloud import LaunchRequest
+from .backend import LaunchRequest
 from ..models import labels as lbl
 from ..models.nodeclaim import NodeClaim
 from ..models.nodeclass import NodeClass
@@ -335,6 +335,13 @@ class CloudProvider:
             (r.instance_type, r.zone)
             for r in getattr(nc.status, "capacity_reservations", []) or []
         }
+
+    def close(self) -> None:
+        """Join the batchers' worker pools (their ThreadPoolExecutor threads
+        are non-daemon; a stuck wire call would otherwise pin interpreter
+        exit). Wired into Operator.stop()."""
+        self._fleet_batcher.close()
+        self._terminate_batcher.close()
 
     def reset_caches(self) -> None:
         """Test-environment hook: drop every provider-side cache."""

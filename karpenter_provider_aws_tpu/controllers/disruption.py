@@ -50,12 +50,16 @@ class DisruptionController:
         clock: Optional[Clock] = None,
         drift_enabled: bool = True,
         provisioning=None,
+        recorder=None,
     ):
+        from ..events import default_recorder
+
         self.cluster = cluster
         self.cloudprovider = cloudprovider
         self.clock = clock or RealClock()
         self.drift_enabled = drift_enabled
         self.provisioning = provisioning
+        self.recorder = recorder or default_recorder()
         self.disrupted: list[tuple[str, str]] = []  # (claim name, reason) log
 
     # -- budget accounting -------------------------------------------------
@@ -77,6 +81,7 @@ class DisruptionController:
         DISRUPTION_ACTIONS.inc(reason=reason.split(":")[0])
         self.disrupted.append((claim.name, reason))
         log.info("disrupting %s: %s", claim.name, reason)
+        self.recorder.publish("NodeClaim", claim.name, "Disrupted", reason)
         self.cluster.delete(claim)  # termination controller drains + reaps
         return True
 
@@ -115,6 +120,7 @@ class DisruptionController:
 
     def _reconcile_emptiness(self, budget) -> None:
         now = self.clock.now()
+        pods_by_node = self.cluster.pods_by_node()
         for claim, node in self._claims_with_nodes():
             pool = self.cluster.nodepools.get(claim.nodepool_name)
             if pool is None:
@@ -122,7 +128,7 @@ class DisruptionController:
             after = pool.disruption.consolidate_after_s
             if after is None:
                 continue
-            if self.cluster.pods_on_node(node.name):
+            if pods_by_node.get(node.name):
                 continue
             # quiet window from the last pod removal, not node age — a node
             # that just emptied gets the full consolidateAfter grace
@@ -342,4 +348,5 @@ class DisruptionController:
             capacity_type_options=sorted({ct for _, ct in offering_options}),
             offering_options=list(offering_options),
         )
-        return launch_claim(self.cluster, self.cloudprovider, pool, spec)
+        return launch_claim(self.cluster, self.cloudprovider, pool, spec,
+                            recorder=self.recorder)

@@ -78,10 +78,21 @@ class InterruptionController:
     name = "interruption"
     interval_s = 2.0
 
-    def __init__(self, cluster: Cluster, cloudprovider: CloudProvider, queue):
+    def __init__(self, cluster: Cluster, cloudprovider: CloudProvider, queue,
+                 recorder=None):
+        from ..events import default_recorder
+        from ..providers.queue import QueueProvider
+
+        if not isinstance(queue, QueueProvider):
+            # explicit raise, not assert: the seam check must survive -O
+            raise TypeError(
+                "queue must satisfy providers.queue.QueueProvider (the "
+                "declared adapter seam; parity: sqs.go:53-73)"
+            )
         self.cluster = cluster
         self.cloudprovider = cloudprovider
         self.queue = queue
+        self.recorder = recorder or default_recorder()
         self.handled: list[InterruptionEvent] = []
         # one persistent worker pool (parity: a fixed ParallelizeUntil width,
         # controller.go:104) — a pool per batch costs more than the work
@@ -125,5 +136,9 @@ class InterruptionController:
                     )
             if event.action_drain and not claim.deleted:
                 log.info("interruption %s: draining %s", event.kind, claim.name)
+                self.recorder.publish(
+                    "NodeClaim", claim.name, "Interrupted",
+                    f"{event.kind} for instance {iid}: cordon and drain",
+                )
                 self.cluster.delete(claim)  # cordon & drain via termination
         self.queue.delete(message.receipt)

@@ -19,6 +19,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
 from ..cloudprovider.cloudprovider import CloudProvider
+from ..models import labels as lbl
 from ..models.nodeclaim import NodeClaim
 from ..scheduling.solver import NodeSpec, Solver
 from ..state.cluster import Cluster
@@ -33,7 +34,8 @@ class ProvisioningController:
     interval_s = 10.0
 
     def __init__(self, cluster: Cluster, solver: Solver, cloudprovider: CloudProvider,
-                 profiler=None, clock=None):
+                 profiler=None, clock=None, recorder=None):
+        from ..events import default_recorder
         from ..utils.clock import RealClock
         from ..utils.observability import Profiler
 
@@ -41,6 +43,7 @@ class ProvisioningController:
         self.solver = solver
         self.cloudprovider = cloudprovider
         self.profiler = profiler or Profiler()
+        self.recorder = recorder or default_recorder()
         self.clock = clock or getattr(cloudprovider, "clock", None) or RealClock()
         # pod uid -> claim name nominations (kube-scheduler binds for real;
         # the registration controller honors these on node readiness)
@@ -86,8 +89,13 @@ class ProvisioningController:
         SOLVE_DURATION.observe(result.solve_seconds)
         SOLVE_PODS.inc(len(pending))
         self.last_unschedulable = result.unschedulable
+        from ..events import WARNING
+
         for pod, reason in result.unschedulable:
             log.info("pod %s unschedulable: %s", pod.name, reason)
+            self.recorder.publish(
+                "Pod", pod.name, "FailedScheduling", reason, type=WARNING
+            )
         self._apply_binds(result.binds)
         specs = result.node_specs
         if not specs:
@@ -140,7 +148,8 @@ class ProvisioningController:
         pool = self.cluster.nodepools.get(spec.nodepool_name)
         if pool is None:
             return
-        claim = launch_claim(self.cluster, self.cloudprovider, pool, spec)
+        claim = launch_claim(self.cluster, self.cloudprovider, pool, spec,
+                             recorder=self.recorder)
         if claim is None:
             return
         with self._nominations_lock:
@@ -154,7 +163,8 @@ class ProvisioningController:
             }
 
 
-def launch_claim(cluster: Cluster, cloudprovider: CloudProvider, pool, spec: NodeSpec):
+def launch_claim(cluster: Cluster, cloudprovider: CloudProvider, pool, spec: NodeSpec,
+                 recorder=None):
     """Build a NodeClaim from a NodeSpec and drive CloudProvider.Create.
 
     The single launch path for both the provisioner and the disruption
@@ -176,18 +186,30 @@ def launch_claim(cluster: Cluster, cloudprovider: CloudProvider, pool, spec: Nod
         startup_taints=list(pool.startup_taints),
     )
     cluster.apply(claim)
+    from ..events import WARNING, default_recorder
+
+    recorder = recorder or default_recorder()
     try:
         cloudprovider.create(claim)
         cluster.apply(claim)  # re-apply: provider_id set -> claims_seq bump
         from ..metrics import NODES_CREATED
 
         NODES_CREATED.inc(nodepool=pool.name)
+        recorder.publish(
+            "NodeClaim", claim.name, "Launched",
+            f"launched {claim.labels.get(lbl.INSTANCE_TYPE_LABEL, '?')} "
+            f"in {claim.labels.get(lbl.TOPOLOGY_ZONE, '?')} "
+            f"({claim.labels.get(lbl.CAPACITY_TYPE, '?')}) for pool {pool.name}",
+        )
         return claim
     except Exception as e:
         # ICE or launch failure: drop the claim; the unavailable cache now
         # masks the offering, so the next solve re-plans around it
         # (parity: instance.go:362-368 + provisioner retry).
         log.warning("launch failed for %s: %s", claim.name, e)
+        recorder.publish(
+            "NodeClaim", claim.name, "LaunchFailed", str(e)[:200], type=WARNING
+        )
         cluster.finalize(claim)
         cluster.delete(claim)
         return None

@@ -73,13 +73,16 @@ class SchedulingController:
         hit = cache.get(key)
         if hit is not None:
             return hit
+        by_node = cache.get("__pods_by_node__")
+        if by_node is None:
+            by_node = cache["__pods_by_node__"] = self.cluster.pods_by_node()
         counts: dict[str, int] = {}
         for other in nodes.values():
             z = other.zone()
             if not z:
                 continue
             counts.setdefault(z, 0)
-            for q in self.cluster.pods_on_node(other.name):
+            for q in by_node.get(other.name, ()):
                 if all(q.labels.get(k) == v for k, v in selector.items()):
                     counts[z] += 1
         cache[key] = counts

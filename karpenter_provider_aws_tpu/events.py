@@ -1,0 +1,126 @@
+"""Event recorder: the kube-Event analogue for operational visibility.
+
+Parity: the reference publishes an event for every interruption message,
+disruption decision, launch, and unschedulable pod through the core
+events.Recorder (`/root/reference/pkg/controllers/interruption/controller.go:219-238`
+uses recorder.Publish; the core decorates it with dedupe). Here the sink is
+an in-memory ring with TTL dedupe + a counter metric — the control plane has
+no apiserver, so "publishing" means: queryable by operators/tests, counted
+in metrics, logged once per dedupe window.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Optional
+
+log = logging.getLogger("karpenter.tpu.events")
+
+NORMAL = "Normal"
+WARNING = "Warning"
+
+
+@dataclass(frozen=True)
+class Event:
+    kind: str        # object kind: NodeClaim | Pod | Node | NodePool
+    name: str        # object name
+    type: str        # Normal | Warning
+    reason: str      # CamelCase machine key (Launched, Disrupted, ...)
+    message: str
+    at: float = 0.0
+    count: int = 1   # occurrences within the dedupe window
+
+
+class EventRecorder:
+    """Thread-safe bounded event sink with per-(object, reason, message)
+    TTL dedupe — repeats within the window bump a count instead of
+    appending (the core recorder's dedupe semantics)."""
+
+    def __init__(self, clock=None, dedupe_ttl_s: float = 120.0, capacity: int = 4096):
+        self.clock = clock
+        self.dedupe_ttl_s = dedupe_ttl_s
+        self._lock = threading.Lock()
+        self._ring: deque[Event] = deque(maxlen=capacity)
+        self._last: dict[tuple, tuple[float, Event]] = {}
+
+    def _now(self) -> float:
+        if self.clock is not None:
+            return self.clock.now()
+        import time
+
+        return time.monotonic()
+
+    def publish(
+        self,
+        kind: str,
+        name: str,
+        reason: str,
+        message: str,
+        type: str = NORMAL,
+    ) -> bool:
+        """Record one event; returns False when deduped into a prior one."""
+        key = (kind, name, reason, message)
+        now = self._now()
+        with self._lock:
+            hit = self._last.get(key)
+            if hit is not None and now - hit[0] < self.dedupe_ttl_s:
+                # count in place — no ring mutation (a deque.remove scan per
+                # hot deduped event would serialize publishers)
+                hit[2] += 1
+                return False
+            ev = Event(kind, name, type, reason, message, at=now)
+            self._last[key] = [now, ev, 1]
+            self._ring.append(ev)
+            # opportunistic eviction: the dedupe map would otherwise grow
+            # one entry per unique (object, reason, message) forever (claim
+            # names are unique per launch — weeks of churn = a leak)
+            if len(self._last) > 2 * self._ring.maxlen:
+                cutoff = now - self.dedupe_ttl_s
+                self._last = {
+                    k: v for k, v in self._last.items() if v[0] >= cutoff
+                }
+        try:
+            from .metrics import EVENTS
+
+            EVENTS.inc(type=type, reason=reason)
+        except Exception:
+            pass
+        log.info("%s %s/%s: %s (%s)", type, kind, name, reason, message)
+        return True
+
+    def events(
+        self,
+        kind: Optional[str] = None,
+        name: Optional[str] = None,
+        reason: Optional[str] = None,
+    ) -> list[Event]:
+        with self._lock:
+            out = []
+            for e in self._ring:
+                hit = self._last.get((e.kind, e.name, e.reason, e.message))
+                n = hit[2] if hit is not None and hit[1] is e else e.count
+                out.append(e if n == e.count else Event(
+                    e.kind, e.name, e.type, e.reason, e.message, at=e.at, count=n
+                ))
+        if kind is not None:
+            out = [e for e in out if e.kind == kind]
+        if name is not None:
+            out = [e for e in out if e.name == name]
+        if reason is not None:
+            out = [e for e in out if e.reason == reason]
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._last.clear()
+
+
+_default = EventRecorder()
+
+
+def default_recorder() -> EventRecorder:
+    return _default

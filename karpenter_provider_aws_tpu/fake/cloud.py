@@ -17,6 +17,7 @@ import threading
 from dataclasses import dataclass, field, replace
 from typing import Optional
 
+from ..cloudprovider.backend import LaunchRequest  # noqa: F401 (re-exported)
 from ..utils.clock import Clock, RealClock
 from ..utils.errors import (
     InsufficientCapacityError,
@@ -103,20 +104,6 @@ class LaunchTemplateData:
     block_devices: tuple = ()
     metadata_options: Optional[object] = None
     tags: dict[str, str] = field(default_factory=dict)
-
-
-@dataclass
-class LaunchRequest:
-    """One logical single-node launch; the batcher coalesces many of these
-    into one fleet call (parity: createfleet.go:52-110)."""
-
-    instance_type_options: list[str]          # ranked cheapest-first
-    offering_options: list[tuple[str, str]]   # launchable (zone, captype)
-    image_id: str
-    subnet_by_zone: dict[str, str] = field(default_factory=dict)
-    security_group_ids: tuple[str, ...] = ()
-    tags: dict[str, str] = field(default_factory=dict)
-    launch_template_name: str = ""            # "" = launch without a template
 
 
 class FakeCloud:

@@ -9,23 +9,15 @@ from __future__ import annotations
 import itertools
 import json
 import threading
-from dataclasses import dataclass, field
 from typing import Optional
+
+from ..providers.queue import MAX_RECEIVE, QueueMessage  # noqa: F401 (re-export)
 
 _ids = itertools.count(1)
 
 
-@dataclass
-class QueueMessage:
-    body: str
-    receipt: str = ""
-
-    def parsed(self) -> dict:
-        return json.loads(self.body)
-
-
 class FakeQueue:
-    MAX_RECEIVE = 10  # sqs.go:62 MaxNumberOfMessages
+    MAX_RECEIVE = MAX_RECEIVE  # sqs.go:62 MaxNumberOfMessages
 
     def __init__(self):
         self._lock = threading.Lock()

@@ -187,6 +187,11 @@ BATCH_SIZE = REGISTRY.histogram(
 ICE_EVENTS = REGISTRY.counter(
     "karpenter_insufficient_capacity_errors_total", "ICE occurrences"
 )
+EVENTS = REGISTRY.counter(
+    "karpenter_events_total",
+    "Events published by controllers, by type and reason (parity: the core "
+    "event recorder behind interruption controller.go:219-238)",
+)
 BATCH_WINDOW = REGISTRY.histogram(
     "karpenter_batcher_window_seconds",
     "Time from a batch's first request to execution (parity: batcher window histograms, metrics.go:37-47)",

@@ -96,6 +96,10 @@ class BlockDevice:
     throughput: Optional[int] = None
     encrypted: bool = True
     delete_on_termination: bool = True
+    # at most one mapping may be the root volume (CEL rule parity:
+    # ec2nodeclass.go:89 "must have only one blockDeviceMappings with
+    # rootVolume")
+    root_volume: bool = False
 
 
 @dataclass(frozen=True)

@@ -63,6 +63,7 @@ class Operator:
 
     def stop(self) -> None:
         self.manager.stop()
+        self.cloudprovider.close()  # join batcher worker pools
         REGISTRY.stop()
 
     def apply(self, obj):
@@ -103,6 +104,10 @@ def new_operator(
         enable_xla_dump(options.xla_dump_dir)  # before the first jit compile
     profiler = Profiler(options.profile_dir)
     if cloud is None:
+        # hermetic default: any object satisfying cloudprovider.backend
+        # .CloudBackend slots in here; the in-memory double is the only
+        # backend baked into this repo (parity: the reference's tier-1
+        # strategy — real clouds are adapters injected at this seam)
         from ..fake import FakeCloud
 
         cloud = FakeCloud(clock=clock)
@@ -149,8 +154,11 @@ def new_operator(
 
     solver = _build_solver(options)
 
+    from ..events import EventRecorder
+
+    recorder = EventRecorder(clock=clock)
     provisioning = ProvisioningController(
-        cluster, solver, cloudprovider, profiler=profiler
+        cluster, solver, cloudprovider, profiler=profiler, recorder=recorder
     )
     scheduling = SchedulingController(cluster, provisioning, clock=clock)
     registration = RegistrationController(cluster, provisioning, clock=clock)
@@ -161,6 +169,7 @@ def new_operator(
         clock=clock,
         drift_enabled=options.drift_enabled and options.gate("Drift", True),
         provisioning=provisioning,
+        recorder=recorder,
     )
     controllers = [
         NodeClassStatusController(cluster, cloudprovider),
@@ -180,7 +189,10 @@ def new_operator(
     # parity: interruption controller registered iff a queue is configured
     # (pkg/controllers/controllers.go:67-71)
     if options.interruption_queue and queue is not None:
-        controllers.insert(2, InterruptionController(cluster, cloudprovider, queue))
+        controllers.insert(
+            2,
+            InterruptionController(cluster, cloudprovider, queue, recorder=recorder),
+        )
 
     return Operator(
         options=options,

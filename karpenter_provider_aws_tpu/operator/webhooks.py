@@ -47,9 +47,28 @@ def validate_nodeclass(nc: NodeClass) -> None:
         v.append("imageFamily custom requires imageSelector terms")
     if nc.image_family == "custom" and not nc.user_data:
         v.append("imageFamily custom requires userData")
-    for term in nc.subnet_selector + nc.security_group_selector + nc.image_selector:
-        if not term.id and not term.tags and not term.name:
-            v.append("selector terms must set id, name, or tags")
+    # CEL rule parity (ec2nodeclass.go:31-51 selector-term XValidations):
+    # at least one of id/name/tags; 'id' mutually exclusive with the rest;
+    # term tags carry no empty keys/values; at most 30 terms per selector.
+    for label, terms in (
+        ("subnetSelectorTerms", nc.subnet_selector),
+        ("securityGroupSelectorTerms", nc.security_group_selector),
+        ("imageSelectorTerms", nc.image_selector),
+    ):
+        if len(terms) > 30:
+            v.append(f"{label}: at most 30 terms")
+        for term in terms:
+            if not term.id and not term.tags and not term.name:
+                v.append(f"{label}: terms must set id, name, or tags")
+            if term.id and (term.tags or term.name):
+                v.append(f"{label}: 'id' is mutually exclusive with other fields")
+            for k, val in term.tags:
+                if not k or not val:
+                    v.append(f"{label}: empty tag keys or values aren't supported")
+    if len(nc.block_devices) > 50:
+        v.append("at most 50 block device mappings")
+    if sum(1 for bd in nc.block_devices if bd.root_volume) > 1:
+        v.append("must have only one blockDeviceMappings with rootVolume")
     for bd in nc.block_devices:
         if bd.volume_size_gib <= 0:
             v.append("block device volume size must be positive")
@@ -58,8 +77,15 @@ def validate_nodeclass(nc: NodeClass) -> None:
         v.append("metadataOptions.httpTokens must be required|optional")
     if not 1 <= mo.http_put_response_hop_limit <= 64:
         v.append("metadataOptions hop limit must be in [1, 64]")
-    if any(k.startswith("karpenter.tpu/") for k in nc.tags):
-        v.append("tags may not use the karpenter.tpu/ namespace")
+    # restricted tags (CEL parity: ec2nodeclass.go:80-85 — empty keys, the
+    # cluster-ownership prefix, and the framework's own namespaces)
+    for k in nc.tags:
+        if not k:
+            v.append("empty tag keys aren't supported")
+        elif k.startswith("kubernetes.io/cluster"):
+            v.append("tag matches restricted prefix kubernetes.io/cluster/")
+        elif k.startswith("karpenter.tpu/"):
+            v.append("tags may not use the karpenter.tpu/ namespace")
     if v:
         raise AdmissionError(v)
 

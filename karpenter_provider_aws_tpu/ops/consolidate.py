@@ -117,9 +117,10 @@ def encode_cluster(cluster, catalog, gmax: int = GMAX_DEFAULT) -> Optional[Clust
     blocked = np.zeros(N, dtype=bool)
     disruption_cost = np.zeros(N, dtype=np.float32)
     used_total = np.zeros((N, NUM_RESOURCES), dtype=np.float32)
+    pods_by_node = cluster.pods_by_node()
     for ni, node in enumerate(nodes):
         per_node: dict[int, int] = {}
-        for pod in cluster.pods_on_node(node.name):
+        for pod in pods_by_node.get(node.name, ()):
             if pod.do_not_disrupt():
                 blocked[ni] = True
             key = (pod.scheduling_key(), tuple(sorted(pod.labels.items())))

@@ -54,11 +54,12 @@ class ZoneOccupancy:
         """Snapshot bound pods on nodes with a known zone (duck-typed so the
         state package need not be imported here)."""
         entries = []
+        pods_by_node = cluster.pods_by_node()
         for node in cluster.snapshot_nodes():
             zone = node.zone()
             if not zone:
                 continue
-            for pod in cluster.pods_on_node(node.name):
+            for pod in pods_by_node.get(node.name, ()):
                 entries.append((dict(pod.labels), zone))
         return cls(entries)
 

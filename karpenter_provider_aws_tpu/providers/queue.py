@@ -1,0 +1,42 @@
+"""The interruption-queue provider seam.
+
+Parity: ``/root/reference/pkg/providers/sqs/sqs.go:53-73`` — the reference
+isolates queue I/O behind a provider interface (long-poll receive of at most
+10 messages, explicit per-receipt delete, send for tests/tools) so the
+interruption controller never touches the wire client. ``QueueProvider`` is
+that declared seam here; ``fake.FakeQueue`` implements it in-memory, a real
+adapter (SQS/PubSub/...) slots in at operator wiring without touching the
+controller.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Protocol, runtime_checkable
+
+# sqs.go:62 MaxNumberOfMessages — one poll returns at most this many.
+MAX_RECEIVE = 10
+# sqs.go:63 WaitTimeSeconds — the long-poll window a real adapter should use.
+WAIT_TIME_S = 20
+
+
+@dataclass
+class QueueMessage:
+    """One received message: raw body + the receipt handle that deletes it."""
+
+    body: str
+    receipt: str = ""
+
+    def parsed(self) -> dict:
+        import json
+
+        return json.loads(self.body)
+
+
+@runtime_checkable
+class QueueProvider(Protocol):
+    def send(self, body) -> None: ...
+
+    def receive(self, max_messages: Optional[int] = None) -> list: ...
+
+    def delete(self, receipt: str) -> None: ...

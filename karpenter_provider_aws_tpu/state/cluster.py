@@ -190,6 +190,18 @@ class Cluster:
         with self._lock:
             return [p for p in self.pods.values() if p.node_name == node_name]
 
+    def pods_by_node(self) -> dict[str, list[Pod]]:
+        """node name -> bound pods, in ONE locked pass over the pod store.
+        Callers iterating nodes must use this instead of pods_on_node per
+        node — that is O(nodes x pods) with a lock round-trip per node and
+        was 6s of a 5k-node consolidation encode."""
+        out: dict[str, list[Pod]] = {}
+        with self._lock:
+            for p in self.pods.values():
+                if p.node_name:
+                    out.setdefault(p.node_name, []).append(p)
+        return out
+
     def node_by_provider_id(self, provider_id: str) -> Optional[Node]:
         with self._lock:
             for n in self.nodes.values():

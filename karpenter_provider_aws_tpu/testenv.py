@@ -13,6 +13,7 @@ from typing import Optional
 
 from .catalog.provider import CatalogProvider, OverheadOptions
 from .cloudprovider.cloudprovider import CloudProvider
+from .events import EventRecorder
 from .controllers import (
     DisruptionController,
     GarbageCollectionController,
@@ -57,9 +58,13 @@ class Environment:
     nodeclass_status: NodeClassStatusController
     nodeclass_termination: NodeClassTerminationController
     manager: Manager
+    # env-local event sink on the env's FakeClock (controllers publish here;
+    # two environments in one process never share or wipe each other's)
+    events: "EventRecorder" = None
 
     def reset(self) -> None:
         self.cloud.reset()
+        self.events.reset()
         self.queue.reset()
         self.cluster.__init__(clock=self.clock)
         self.catalog.unavailable.flush()
@@ -103,12 +108,16 @@ def new_environment(solver: Optional[Solver] = None, use_tpu_solver: bool = True
         cluster_info=cluster_info,
     )
     solver = solver or (TPUSolver() if use_tpu_solver else HostSolver())
-    provisioning = ProvisioningController(cluster, solver, cloudprovider)
+    recorder = EventRecorder(clock=clock)
+    provisioning = ProvisioningController(cluster, solver, cloudprovider,
+                                          recorder=recorder)
     scheduling = SchedulingController(cluster, provisioning, clock=clock)
     registration = RegistrationController(cluster, provisioning, clock=clock)
     termination = TerminationController(cluster, cloudprovider)
-    disruption = DisruptionController(cluster, cloudprovider, clock=clock, provisioning=provisioning)
-    interruption = InterruptionController(cluster, cloudprovider, queue)
+    disruption = DisruptionController(cluster, cloudprovider, clock=clock,
+                                      provisioning=provisioning, recorder=recorder)
+    interruption = InterruptionController(cluster, cloudprovider, queue,
+                                          recorder=recorder)
     gc = GarbageCollectionController(cluster, cloudprovider, clock=clock)
     tagging = TaggingController(cluster, cloudprovider)
     nc_hash = NodeClassHashController(cluster)
@@ -149,4 +158,5 @@ def new_environment(solver: Optional[Solver] = None, use_tpu_solver: bool = True
         nodeclass_status=nc_status,
         nodeclass_termination=nc_term,
         manager=manager,
+        events=recorder,
     )

@@ -15,6 +15,7 @@ tensor itself (all pods solved in one jit call).
 from __future__ import annotations
 
 import threading
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Callable, Generic, Hashable, TypeVar
 
@@ -27,7 +28,10 @@ class BatcherOptions:
     idle_timeout_s: float = 0.035   # createfleet.go:35 — 35ms
     max_timeout_s: float = 1.0      # createfleet.go:36 — 1s
     max_items: int = 1000           # createfleet.go:37
-    # max_request_workers in the reference; we execute inline per batch.
+    # Bounded fan-out pool for flushed batches (batcher.go:71-95 runs up to
+    # 100 concurrent request workers): one slow wire call must not
+    # serialize every later flush behind it.
+    max_request_workers: int = 100
 
 
 class _Pending(Generic[T, U]):
@@ -58,6 +62,13 @@ class Batcher(Generic[T, U]):
         self._buckets: dict[Hashable, list[_Pending]] = {}
         self._timers: dict[Hashable, threading.Timer] = {}
         self._first_seen: dict[Hashable, float] = {}
+        # worker fan-out: timer threads only DISPATCH; execution happens on
+        # this bounded pool (threads spawn lazily, so an idle batcher costs
+        # nothing)
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(self._opts.max_request_workers, 1),
+            thread_name_prefix="batcher",
+        )
         # metrics
         self.batches_executed = 0
         self.batch_sizes: list[int] = []
@@ -99,8 +110,8 @@ class Batcher(Generic[T, U]):
         t.start()
 
     def _flush(self, key: Hashable) -> None:
-        import time as _time
-
+        """Detach the bucket and hand it to the worker pool. Runs on timer
+        threads and on callers hitting max_items — both only dispatch."""
         with self._lock:
             bucket = self._buckets.pop(key, [])
             timer = self._timers.pop(key, None)
@@ -109,8 +120,21 @@ class Batcher(Generic[T, U]):
                 timer.cancel()
         if not bucket:
             return
-        self.batches_executed += 1
-        self.batch_sizes.append(len(bucket))
+        try:
+            self._pool.submit(self._execute, bucket, first)
+        except RuntimeError:  # pool shut down (interpreter teardown)
+            self._execute(bucket, first)
+
+    def close(self) -> None:
+        """Flush nothing further; reject new submits, join in-flight work."""
+        self._pool.shutdown(wait=True)
+
+    def _execute(self, bucket: list[_Pending], first) -> None:
+        import time as _time
+
+        with self._lock:  # pool workers race on the counters
+            self.batches_executed += 1
+            self.batch_sizes.append(len(bucket))
         try:
             from ..metrics import BATCH_SIZE, BATCH_WINDOW
 

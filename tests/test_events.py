@@ -1,0 +1,127 @@
+"""Event recorder (parity: the core events.Recorder the reference publishes
+through on every interruption / disruption / launch decision —
+interruption controller.go:219-238)."""
+
+import pytest
+
+from karpenter_provider_aws_tpu.events import WARNING, EventRecorder
+from karpenter_provider_aws_tpu.models import Disruption, NodePool, Operator, Requirement
+from karpenter_provider_aws_tpu.models import labels as lbl
+from karpenter_provider_aws_tpu.models.pod import make_pods
+from karpenter_provider_aws_tpu.testenv import new_environment
+from karpenter_provider_aws_tpu.utils.clock import FakeClock
+
+
+@pytest.fixture(scope="module")
+def env():
+    return new_environment()
+
+
+@pytest.fixture(autouse=True)
+def _reset(env):
+    env.reset()
+    yield
+
+
+class TestRecorder:
+    def test_publish_and_query(self):
+        r = EventRecorder(clock=FakeClock())
+        assert r.publish("NodeClaim", "c1", "Launched", "m5.large in zone-a")
+        assert r.publish("Pod", "p1", "FailedScheduling", "no fit", type=WARNING)
+        assert len(r.events()) == 2
+        assert r.events(kind="Pod")[0].type == WARNING
+        assert r.events(reason="Launched")[0].name == "c1"
+
+    def test_dedupe_window_counts(self):
+        clock = FakeClock()
+        r = EventRecorder(clock=clock, dedupe_ttl_s=60)
+        assert r.publish("Pod", "p1", "FailedScheduling", "no fit")
+        assert not r.publish("Pod", "p1", "FailedScheduling", "no fit")
+        assert not r.publish("Pod", "p1", "FailedScheduling", "no fit")
+        evs = r.events(kind="Pod")
+        assert len(evs) == 1 and evs[0].count == 3
+        clock.advance(61)
+        assert r.publish("Pod", "p1", "FailedScheduling", "no fit")
+
+    def test_capacity_bound(self):
+        r = EventRecorder(clock=FakeClock(), capacity=10)
+        for i in range(50):
+            r.publish("Pod", f"p{i}", "X", "y")
+        assert len(r.events()) == 10
+
+
+class TestControllerEvents:
+    def test_launch_publishes(self, env):
+        env.apply_defaults(
+            NodePool(
+                name="default",
+                requirements=[
+                    Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m"))
+                ],
+            )
+        )
+        for p in make_pods(4, "w", {"cpu": "1", "memory": "1Gi"}):
+            env.cluster.apply(p)
+        env.step(2)
+        launched = env.events.events(kind="NodeClaim", reason="Launched")
+        assert launched, "no Launched event after provisioning"
+
+    def test_unschedulable_publishes_warning(self, env):
+        env.apply_defaults(
+            NodePool(
+                name="default",
+                requirements=[
+                    Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m"))
+                ],
+            )
+        )
+        # impossible request: nothing in the catalog fits 10k cpus
+        for p in make_pods(1, "huge", {"cpu": "10000", "memory": "1Gi"}):
+            env.cluster.apply(p)
+        env.step(1)
+        evs = env.events.events(kind="Pod", reason="FailedScheduling")
+        assert evs and evs[0].type == WARNING
+
+    def test_disruption_publishes(self, env):
+        env.apply_defaults(
+            NodePool(
+                name="default",
+                requirements=[
+                    Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m"))
+                ],
+                disruption=Disruption(consolidate_after_s=30, budgets=["100%"]),
+            )
+        )
+        for p in make_pods(2, "w", {"cpu": "500m", "memory": "512Mi"}):
+            env.cluster.apply(p)
+        env.step(3)
+        # drop the pods; the node goes empty and gets disrupted
+        for p in list(env.cluster.pods.values()):
+            env.cluster.delete(p)
+        env.clock.advance(31)
+        env.step(2)
+        evs = env.events.events(kind="NodeClaim", reason="Disrupted")
+        assert evs, "no Disrupted event after emptiness consolidation"
+
+    def test_interruption_publishes(self, env):
+        env.apply_defaults(
+            NodePool(
+                name="default",
+                requirements=[
+                    Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m"))
+                ],
+            )
+        )
+        for p in make_pods(2, "w", {"cpu": "500m", "memory": "512Mi"}):
+            env.cluster.apply(p)
+        env.step(3)
+        claim = next(iter(env.cluster.nodeclaims.values()))
+        iid = claim.status.provider_id.rsplit("/", 1)[-1]
+        env.queue.send({
+            "source": "aws.ec2",
+            "detail-type": "EC2 Spot Instance Interruption Warning",
+            "detail": {"instance-id": iid},
+        })
+        env.interruption.reconcile()
+        evs = env.events.events(kind="NodeClaim", reason="Interrupted")
+        assert evs and iid in evs[0].message

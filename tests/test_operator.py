@@ -66,7 +66,7 @@ class TestWebhooks:
             validate_nodeclass(NodeClass(name="x", role="r", image_family="custom"))
 
     def test_nodeclass_empty_selector_term(self):
-        with pytest.raises(AdmissionError, match="selector terms"):
+        with pytest.raises(AdmissionError, match="terms must set"):
             validate_nodeclass(
                 NodeClass(name="x", role="r", subnet_selector=[SelectorTerm()])
             )
@@ -82,6 +82,64 @@ class TestWebhooks:
     def test_nodepool_bad_budget(self):
         with pytest.raises(AdmissionError, match="budget"):
             validate_nodepool(NodePool(name="p", disruption=Disruption(budgets=["lots"])))
+
+    # negative-path parity with the reference's CEL XValidation rules
+    # (ec2nodeclass.go kubebuilder markers)
+
+    def test_selector_id_mutually_exclusive(self):
+        # ec2nodeclass.go:33 "'id' is mutually exclusive..."
+        with pytest.raises(AdmissionError, match="mutually exclusive"):
+            validate_nodeclass(
+                NodeClass(name="x", role="r", subnet_selector=[
+                    SelectorTerm(id="subnet-1", tags=(("a", "b"),))
+                ])
+            )
+
+    def test_selector_term_cap_30(self):
+        # ec2nodeclass.go:34 MaxItems:=30
+        with pytest.raises(AdmissionError, match="at most 30"):
+            validate_nodeclass(
+                NodeClass(name="x", role="r", subnet_selector=[
+                    SelectorTerm(id=f"subnet-{i}") for i in range(31)
+                ])
+            )
+
+    def test_selector_empty_tag_values(self):
+        # ec2nodeclass.go:127 "empty tag keys or values aren't supported"
+        with pytest.raises(AdmissionError, match="empty tag"):
+            validate_nodeclass(
+                NodeClass(name="x", role="r", subnet_selector=[
+                    SelectorTerm(tags=(("k", ""),))
+                ])
+            )
+
+    def test_restricted_cluster_tag(self):
+        # ec2nodeclass.go:81 restricted kubernetes.io/cluster/ prefix
+        with pytest.raises(AdmissionError, match="kubernetes.io/cluster"):
+            validate_nodeclass(
+                NodeClass(name="x", role="r",
+                          tags={"kubernetes.io/cluster/mine": "owned"})
+            )
+
+    def test_single_root_volume(self):
+        # ec2nodeclass.go:89 "only one blockDeviceMappings with rootVolume"
+        from karpenter_provider_aws_tpu.models.nodeclass import BlockDevice
+
+        with pytest.raises(AdmissionError, match="rootVolume"):
+            validate_nodeclass(
+                NodeClass(name="x", role="r", block_devices=[
+                    BlockDevice(root_volume=True),
+                    BlockDevice(device_name="/dev/xvdb", root_volume=True),
+                ])
+            )
+
+    def test_queue_seam_protocol(self):
+        # the interruption controller takes the DECLARED adapter, not a
+        # duck-typed queue (sqs.go:53-73 provider seam)
+        from karpenter_provider_aws_tpu.fake import FakeQueue
+        from karpenter_provider_aws_tpu.providers.queue import QueueProvider
+
+        assert isinstance(FakeQueue(), QueueProvider)
 
     def test_admit_defaults_nodepool_captype(self):
         pool = admit(NodePool(name="p"))

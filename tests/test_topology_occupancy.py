@@ -161,6 +161,9 @@ class _FakeCluster:
     def pods_on_node(self, name):
         return self._pods.get(name, [])
 
+    def pods_by_node(self):
+        return dict(self._pods)
+
 
 class TestRebindTopology:
     def _controller(self, nodes, pods_by_node):
