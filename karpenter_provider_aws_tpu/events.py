@@ -44,7 +44,8 @@ class EventRecorder:
         self.dedupe_ttl_s = dedupe_ttl_s
         self._lock = threading.Lock()
         self._ring: deque[Event] = deque(maxlen=capacity)
-        self._last: dict[tuple, tuple[float, Event]] = {}
+        self._last: dict[tuple, list] = {}  # key -> [first_at, Event, count]
+        self._evict_at = 2 * capacity
 
     def _now(self) -> float:
         if self.clock is not None:
@@ -76,12 +77,16 @@ class EventRecorder:
             self._ring.append(ev)
             # opportunistic eviction: the dedupe map would otherwise grow
             # one entry per unique (object, reason, message) forever (claim
-            # names are unique per launch — weeks of churn = a leak)
-            if len(self._last) > 2 * self._ring.maxlen:
+            # names are unique per launch — weeks of churn = a leak). The
+            # threshold doubles whenever a sweep fails to shrink the map, so
+            # an event storm of >capacity live keys cannot make every
+            # publish pay an O(map) rebuild under the lock.
+            if len(self._last) > self._evict_at:
                 cutoff = now - self.dedupe_ttl_s
-                self._last = {
-                    k: v for k, v in self._last.items() if v[0] >= cutoff
-                }
+                kept = {k: v for k, v in self._last.items() if v[0] >= cutoff}
+                if len(kept) < len(self._last):
+                    self._last = kept
+                self._evict_at = max(2 * self._ring.maxlen, 2 * len(self._last))
         try:
             from .metrics import EVENTS
 
@@ -117,6 +122,7 @@ class EventRecorder:
         with self._lock:
             self._ring.clear()
             self._last.clear()
+            self._evict_at = 2 * self._ring.maxlen
 
 
 _default = EventRecorder()

@@ -24,12 +24,21 @@ class AdmissionError(ValueError):
 # -- NodeClass ---------------------------------------------------------------
 
 def default_nodeclass(nc: NodeClass) -> NodeClass:
+    from ..models.nodeclass import MetadataOptions
+    from ..providers.imagefamily import get_family
+
     if not nc.image_family:
         nc.image_family = "standard"
-    if not nc.block_devices:
-        from ..models.nodeclass import BlockDevice
+    from ..models.nodeclass import BlockDevice
 
-        nc.block_devices = [BlockDevice()]
+    family = get_family(nc.image_family)
+    # per-family defaults (parity: AMIFamily.DefaultBlockDeviceMappings /
+    # DefaultMetadataOptions, resolver.go:80-112) — the model's generic
+    # one-gp3-volume default counts as "unset" here
+    if not nc.block_devices or nc.block_devices == [BlockDevice()]:
+        nc.block_devices = family.default_block_device_mappings()
+    if nc.metadata_options == MetadataOptions():
+        nc.metadata_options = family.default_metadata_options()
     return nc
 
 
@@ -39,9 +48,9 @@ def validate_nodeclass(nc: NodeClass) -> None:
         v.append("role and instanceProfile are mutually exclusive")  # CEL rule parity
     if not nc.role and not nc.instance_profile:
         v.append("one of role or instanceProfile is required")
-    from ..providers.bootstrap import _FAMILIES
+    from ..providers.imagefamily import FAMILIES
 
-    if nc.image_family not in _FAMILIES:
+    if nc.image_family not in FAMILIES:
         v.append(f"unknown imageFamily {nc.image_family!r}")
     if nc.image_family == "custom" and not nc.image_selector:
         v.append("imageFamily custom requires imageSelector terms")

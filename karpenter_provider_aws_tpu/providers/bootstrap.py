@@ -156,6 +156,37 @@ class TomlBootstrap(ShellBootstrap):
         return _toml_dump(settings)
 
 
+class PowershellBootstrap(ShellBootstrap):
+    """PowerShell bootstrap (the windows-style family, windows.go): a
+    <powershell> document invoking the bootstrap script with kubelet args;
+    custom userdata is prepended inside the same block."""
+
+    def script(self) -> str:
+        args = []
+        if self._dns_ip() and not self.kubelet.cluster_dns:
+            args.append(f"--cluster-dns={self._dns_ip()}")
+        args += self.kubelet.extra_args()
+        if self.labels:
+            args.append(f"--node-labels={_node_labels_arg(self.labels)}")
+        if self.taints:
+            args.append(f"--register-with-taints={_taints_arg(self.taints)}")
+        lines = ["<powershell>"]
+        if self.custom:
+            lines.append(self.custom.rstrip("\n"))
+        lines += [
+            "[string]$BootstrapScript = 'C:\\Program Files\\Node\\Start-NodeBootstrap.ps1'",
+            "& $BootstrapScript "
+            + f"-ClusterName '{self.cluster.name}' "
+            + f"-APIServerEndpoint '{self.cluster.endpoint}' "
+            + (f"-Base64ClusterCA '{self.cluster.ca_bundle}' " if self.cluster.ca_bundle else "")
+            + (
+                "-KubeletExtraArgs '" + " ".join(args) + "' " if args else ""
+            ).rstrip(),
+            "</powershell>",
+        ]
+        return "\n".join(lines) + "\n"
+
+
 class CustomBootstrap(ShellBootstrap):
     """Verbatim user data; the user owns the whole bootstrap (custom.go)."""
 
@@ -188,16 +219,6 @@ def mime_merge(parts: Sequence[str]) -> str:
     return "\n".join(out) + "\n"
 
 
-_FAMILIES = {
-    "standard": ShellBootstrap,
-    "minimal": ShellBootstrap,
-    "gpu": ShellBootstrap,
-    "nodeadm": NodeadmBootstrap,
-    "bottlerocket": TomlBootstrap,
-    "custom": CustomBootstrap,
-}
-
-
 def bootstrapper_for(
     family: str,
     cluster: ClusterInfo,
@@ -207,10 +228,16 @@ def bootstrapper_for(
     custom: str = "",
 ) -> ShellBootstrap:
     """Family alias -> bootstrapper (parity: GetAMIFamily resolver.go:80-112).
-    Unknown families fall back to the shell family like the reference's
-    default-to-AL2 behavior."""
-    cls = _FAMILIES.get(family, ShellBootstrap)
-    return cls(cluster, kubelet or KubeletConfiguration(), labels or {}, taints, custom)
+
+    Thin delegate to the family strategy registry (providers.imagefamily) —
+    ONE family->bootstrapper mapping exists, and every path gets the same
+    feature-flag enforcement. Unknown families fall back to the standard
+    (shell) family like the reference's default-to-AL2 behavior."""
+    from .imagefamily import get_family  # here: imagefamily imports this module
+
+    return get_family(family).bootstrapper(
+        cluster, kubelet=kubelet, labels=labels, taints=taints, custom=custom
+    )
 
 
 def _deep_merge(base: dict, override: dict) -> dict:

@@ -39,7 +39,13 @@ class ImageProvider:
                 if any(term.matches(i) for term in nodeclass.image_selector)
             ]
         else:
-            images = [i for i in all_images if i.family == nodeclass.image_family]
+            # family strategy's default-image queries (the SSM-alias
+            # analogue, resolver.go DefaultAMIs); custom yields none —
+            # selector terms are mandatory there
+            from .imagefamily import get_family
+
+            aliases = {q.alias for q in get_family(nodeclass.image_family).default_images()}
+            images = [i for i in all_images if i.family in aliases]
         images = sorted(images, key=lambda i: -i.created_seq)
         self._cache.set(key, images)
         return images

@@ -19,7 +19,7 @@ from typing import Optional, Sequence
 from ..models.nodeclass import NodeClass
 from ..utils.cache import CacheTTL, TTLCache
 from ..utils.clock import Clock
-from .bootstrap import ClusterInfo, KubeletConfiguration, bootstrapper_for
+from .bootstrap import ClusterInfo, KubeletConfiguration
 
 log = logging.getLogger("karpenter.tpu.launchtemplates")
 
@@ -79,11 +79,13 @@ class LaunchTemplateProvider:
         comes from the kubelet config and efa is N/A)."""
         self._hydrate_once()
         out: dict[str, str] = {}
+        from .imagefamily import get_family
+
+        family = get_family(nodeclass.image_family)
         for image, _types in image_groups:
             # The NODECLASS family picks the bootstrapper — not the image's
             # (parity: resolver.go:80-112, AMIFamily comes from the spec).
-            boot = bootstrapper_for(
-                nodeclass.image_family,
+            boot = family.bootstrapper(
                 self.cluster_info,
                 kubelet=kubelet,
                 labels=labels,

@@ -62,6 +62,12 @@ class Environment:
     # two environments in one process never share or wipe each other's)
     events: "EventRecorder" = None
 
+    def close(self) -> None:
+        """Join the cloud provider's batcher worker pools. Environments are
+        commonly module-scoped and live to process exit; call this from
+        teardown when constructing many short-lived environments."""
+        self.cloudprovider.close()
+
     def reset(self) -> None:
         self.cloud.reset()
         self.events.reset()

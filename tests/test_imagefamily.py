@@ -1,0 +1,107 @@
+"""Image-family strategy layer (parity: amifamily resolver.go:80-112 —
+per-family DefaultAMIs / block-device mappings / metadata options / feature
+flags across the al2/al2023/bottlerocket/ubuntu/windows/custom analogues)."""
+
+import pytest
+
+from karpenter_provider_aws_tpu.models.nodeclass import (
+    KubeletConfiguration,
+    NodeClass,
+)
+from karpenter_provider_aws_tpu.operator.webhooks import admit
+from karpenter_provider_aws_tpu.providers.bootstrap import ClusterInfo
+from karpenter_provider_aws_tpu.providers.imagefamily import (
+    FAMILIES,
+    get_family,
+)
+
+INFO = ClusterInfo(name="cluster-1", endpoint="https://api.cluster-1", ca_bundle="Q0E=")
+
+
+class TestRegistry:
+    def test_all_reference_analogue_families_exist(self):
+        # al2->standard, al2023->nodeadm, bottlerocket, ubuntu, windows,
+        # custom (+ minimal/gpu variants)
+        for name in ("standard", "minimal", "gpu", "nodeadm", "bottlerocket",
+                     "ubuntu", "windows", "custom"):
+            assert name in FAMILIES
+
+    def test_unknown_falls_back_to_standard(self):
+        assert get_family("no-such").name == "standard"
+
+    def test_custom_has_no_default_images(self):
+        assert get_family("custom").default_images() == []
+
+
+class TestFamilyDefaults:
+    def test_bottlerocket_two_volumes_one_root(self):
+        devs = get_family("bottlerocket").default_block_device_mappings()
+        assert len(devs) == 2
+        assert sum(1 for d in devs if d.root_volume) == 1
+        assert {d.device_name for d in devs} == {"/dev/xvda", "/dev/xvdb"}
+
+    def test_windows_metadata_hop_limit_1(self):
+        mo = get_family("windows").default_metadata_options()
+        assert mo.http_put_response_hop_limit == 1
+        assert mo.http_tokens == "required"
+
+    def test_ubuntu_root_device(self):
+        devs = get_family("ubuntu").default_block_device_mappings()
+        assert devs[0].device_name == "/dev/sda1"
+
+    def test_admit_applies_family_defaults(self):
+        nc = admit(NodeClass(name="win", role="r", image_family="windows"))
+        assert nc.block_devices[0].device_name == "/dev/sda1"
+        assert nc.block_devices[0].volume_size_gib == 50
+        assert nc.metadata_options.http_put_response_hop_limit == 1
+
+
+class TestFeatureFlags:
+    def test_bottlerocket_rejects_eviction_soft(self):
+        fam = get_family("bottlerocket")
+        assert not fam.feature_flags().eviction_soft_enabled
+        with pytest.raises(ValueError, match="evictionSoft"):
+            fam.bootstrapper(
+                INFO, kubelet=KubeletConfiguration(eviction_soft=(("memory.available", "5%"),))
+            )
+
+    def test_bottlerocket_rejects_pods_per_core(self):
+        with pytest.raises(ValueError, match="podsPerCore"):
+            get_family("bottlerocket").bootstrapper(
+                INFO, kubelet=KubeletConfiguration(pods_per_core=4)
+            )
+
+    def test_standard_allows_both(self):
+        boot = get_family("standard").bootstrapper(
+            INFO,
+            kubelet=KubeletConfiguration(
+                pods_per_core=4, eviction_soft=(("memory.available", "5%"),)
+            ),
+        )
+        assert boot.script()
+
+    def test_windows_flags(self):
+        flags = get_family("windows").feature_flags()
+        assert not flags.supports_eni_limited_pod_density
+        assert not flags.uses_eni_limited_memory_overhead
+
+
+class TestBootstrapScripts:
+    def test_windows_powershell(self):
+        script = get_family("windows").bootstrapper(
+            INFO, labels={"team": "a"},
+        ).script()
+        assert script.startswith("<powershell>")
+        assert script.rstrip().endswith("</powershell>")
+        assert "-ClusterName 'cluster-1'" in script
+        assert "--node-labels=team=a" in script
+
+    def test_windows_custom_userdata_prepended(self):
+        script = get_family("windows").bootstrapper(
+            INFO, custom="Write-Host 'hi'",
+        ).script()
+        assert script.index("Write-Host") < script.index("$BootstrapScript")
+
+    def test_ubuntu_is_shell(self):
+        script = get_family("ubuntu").bootstrapper(INFO).script()
+        assert "cluster-1" in script
