@@ -121,7 +121,12 @@ def config5_accelerators(n=4000, catalog=None):
 def _run_config(name, pods, pools, catalog, iters=DEFAULT_ITERS):
     tpu = TPUSolver()
     host = HostSolver()
-    res = tpu.solve(pods, pools, catalog)  # warmup + compile
+    # Two warmups: the first compiles and seeds the solver's observed-n_open
+    # row sizing; the second compiles the settled (smaller) bucket. Timed
+    # iterations then measure steady-state serving, which is what the
+    # reconcile loop sees (recompiles happen once per workload shape).
+    res = tpu.solve(pods, pools, catalog)
+    tpu.solve(pods, pools, catalog)
     times = []
     for _ in range(iters):
         t0 = time.perf_counter()
@@ -261,6 +266,21 @@ def config4_consolidation(n_nodes=5000, iters=5):
     else:
         out["p99_ms"] = out["p50_ms"] = None
     out["consolidatable_nodes"] = int(mask.sum()) if mask is not None else -1
+
+    # Full controller pass at scale: encode + device screen + the host-side
+    # binary-search set validation + disruption commits (the end-to-end
+    # consolidation decision the reference's disruption controller makes).
+    try:
+        pool = env.cluster.nodepools["default"]
+        pool.disruption.consolidate_after_s = 60
+        pool.disruption.budgets = ["10%"]
+        env.clock.advance(120)
+        t0 = time.perf_counter()
+        env.disruption.reconcile()
+        out["controller_pass_ms"] = round((time.perf_counter() - t0) * 1000.0, 1)
+        out["disrupted_in_pass"] = len(env.disruption.disrupted)
+    except Exception as e:  # must not lose the row
+        out["controller_pass_error"] = f"{type(e).__name__}: {e}"[:200]
     return out
 
 

@@ -45,7 +45,7 @@ class EventRecorder:
         self._lock = threading.Lock()
         self._ring: deque[Event] = deque(maxlen=capacity)
         self._last: dict[tuple, list] = {}  # key -> [first_at, Event, count]
-        self._evict_at = 2 * capacity
+        self._next_sweep = 0.0
 
     def _now(self) -> float:
         if self.clock is not None:
@@ -77,16 +77,17 @@ class EventRecorder:
             self._ring.append(ev)
             # opportunistic eviction: the dedupe map would otherwise grow
             # one entry per unique (object, reason, message) forever (claim
-            # names are unique per launch — weeks of churn = a leak). The
-            # threshold doubles whenever a sweep fails to shrink the map, so
-            # an event storm of >capacity live keys cannot make every
-            # publish pay an O(map) rebuild under the lock.
-            if len(self._last) > self._evict_at:
+            # names are unique per launch — weeks of churn = a leak).
+            # Time-gated to at most one O(map) sweep per half-TTL, so an
+            # event storm cannot make every publish pay a rebuild under the
+            # lock, and expired storm entries are reclaimed within ~TTL/2
+            # of expiring instead of lingering behind a growth ratchet.
+            if len(self._last) > 2 * self._ring.maxlen and now >= self._next_sweep:
                 cutoff = now - self.dedupe_ttl_s
                 kept = {k: v for k, v in self._last.items() if v[0] >= cutoff}
                 if len(kept) < len(self._last):
                     self._last = kept
-                self._evict_at = max(2 * self._ring.maxlen, 2 * len(self._last))
+                self._next_sweep = now + self.dedupe_ttl_s / 2
         try:
             from .metrics import EVENTS
 
@@ -122,7 +123,7 @@ class EventRecorder:
         with self._lock:
             self._ring.clear()
             self._last.clear()
-            self._evict_at = 2 * self._ring.maxlen
+            self._next_sweep = 0.0
 
 
 _default = EventRecorder()

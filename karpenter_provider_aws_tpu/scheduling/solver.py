@@ -156,13 +156,27 @@ def _estimate_nodes(problem: EncodedProblem, G: int) -> int:
     usable = finite.any(axis=1)
     if not usable.any():
         return 64
-    pref = np.argmin(np.where(finite, price, np.inf), axis=1)  # [G]
-    cap_pref = problem.capacity[pref]                          # [G, R]
+    # per-(group, type) fit, then the OPEN phase's own choice rule — the
+    # type minimizing price per slot. Estimating at the cheapest-absolute
+    # type assumed tiny nodes and over-allocated rows ~2x on workloads
+    # where a larger type wins on $/slot.
     with np.errstate(divide="ignore", invalid="ignore"):
-        ratio = np.where(
-            req > 0, (cap_pref + 1e-4) / np.where(req > 0, req, 1.0), np.inf
-        )
-    k_per_node = np.clip(ratio.min(axis=1), 1.0, float(1 << 30))
+        fit = np.where(
+            (req > 0)[:, None, :],
+            np.floor(
+                (problem.capacity[None, :, :] + 1e-4)
+                / np.where(req > 0, req, 1.0)[:, None, :]
+            ),
+            np.inf,  # unrequested resources don't constrain
+        ).min(axis=2)                                          # [G, T]
+    k_gt = np.clip(fit, 0.0, float(1 << 30))
+    # eff is capped by the group's own count, mirroring the scan's
+    # eff = min(k, rem): a 100-slot node is only 50-slots-efficient for a
+    # 50-pod group
+    eff = np.minimum(k_gt, np.maximum(counts, 1.0)[:, None])
+    score = np.where(finite & (k_gt >= 1), price / np.maximum(eff, 1.0), np.inf)
+    pref = np.argmin(score, axis=1)                            # [G]
+    k_per_node = np.clip(k_gt[np.arange(G), pref], 1.0, float(1 << 30))
     mpn = np.maximum(problem.max_per_node[:G], 1)
     k_eff = np.minimum(k_per_node, mpn)
     nodes_g = np.ceil(counts / k_eff)
@@ -570,6 +584,12 @@ class TPUSolver:
         # per-stage wall clock of the LAST solve (encode / device+transfer /
         # refine / decode), for the bench breakdown and perf triage
         self.timings: dict[str, float] = {}
+        # observed n_open per problem signature: reconcile loops re-solve
+        # near-identical problems, and what the scan ACTUALLY opened beats
+        # any a-priori packing estimate (the static estimate can't see
+        # first-fit sharing and zonal-price-driven type choices). The retry
+        # path makes a stale low watermark safe.
+        self._n_open_hist: dict[tuple, int] = {}
 
     def solve_encoded(
         self, problem: EncodedProblem, existing: Optional[Sequence[ExistingNode]] = None,
@@ -694,10 +714,24 @@ class TPUSolver:
         # cap, N starts at the demand estimate and retries at the full
         # pod-count bucket iff the scan ran out of rows with pods left.
         N_cap = self.max_nodes or _node_bucket(num_pods)
+        hist_key = (
+            problem.nodepool.name if problem.nodepool else "",
+            GB,
+            bucket(max(num_pods, 1)),
+        )
         if self.max_nodes:
             N = N_cap
         else:
-            N = min(bucket(max(_estimate_nodes(problem, G), 64), minimum=64), N_cap)
+            hist = self._n_open_hist.get(hist_key)
+            # an observed n_open beats the static estimate in BOTH
+            # directions: it corrects over-allocation (sharing the estimate
+            # can't see) and under-allocation (which costs a full retry)
+            est = (
+                int(hist * 1.3) + 8
+                if hist is not None
+                else _estimate_nodes(problem, G)
+            )
+            N = min(bucket(max(est, 64), minimum=64), N_cap)
         pre_extra = bucket(n_pre, minimum=256) if n_pre else 0
         t_dev = time.perf_counter()
         (placed, unplaced_chunks, node_type, node_price, used,
@@ -717,6 +751,9 @@ class TPUSolver:
         )
         self.timings["n_rows"] = self.timings.get("n_rows", 0) + N + pre_extra
         self.timings["n_open"] = self.timings.get("n_open", 0) + n_open
+        self._n_open_hist[hist_key] = n_open - n_pre
+        if len(self._n_open_hist) > 256:  # bound: signatures are few in practice
+            self._n_open_hist.clear()
         # reconstructed, not fetched: committed types index the catalog
         # capacity; pre-opened rows keep their node-reported allocatable
         node_cap = problem.capacity[node_type]

@@ -234,6 +234,28 @@ class TestZoneSpreadRepack:
         assert "n-b" not in names
 
 
+class TestSpreadWaterFillAggregation:
+    def test_multi_candidate_spread_set_places_fully(self, env):
+        """A spread group's zone budgets rise as placements land (the floor
+        water-fills); the aggregated set validation must re-place the
+        remainder until quiescence instead of stopping at the entry budgets
+        (reviewer round-3: one-shot aggregation placed only max_skew pods
+        per zone and rejected feasible sets)."""
+        env.apply_defaults(pool_with())
+        ps = spread_pods(10, "s", "web")
+        add_node(env, "cand-a", "zone-a", ps[:5], min_vcpus=8, max_vcpus=8)
+        add_node(env, "cand-b", "zone-b", ps[5:], min_vcpus=8, max_vcpus=8)
+        # empty big survivors, one per zone: capacity is not the constraint
+        add_node(env, "surv-a", "zone-a", [], min_vcpus=16, max_vcpus=16)
+        add_node(env, "surv-b", "zone-b", [], min_vcpus=16, max_vcpus=16)
+        ct = encode_cluster(env.cluster, env.catalog)
+        ia = ct.node_names.index("cand-a")
+        ib = ct.node_names.index("cand-b")
+        # after removing BOTH candidates, matched counts are 0 everywhere;
+        # skew-1 budgets start at 1/zone but water-fill to 5/5
+        assert repack_set_feasible(ct, [ia, ib])
+
+
 class TestSpreadFloorEligibleZones:
     def test_ineligible_zone_does_not_pin_spread_budget(self, env):
         """A zone with no surviving node compatible with the group must not
