@@ -10,6 +10,7 @@ from .base import Controller, Manager  # noqa: F401
 from .provisioning import ProvisioningController  # noqa: F401
 from .registration import RegistrationController  # noqa: F401
 from .garbagecollection import GarbageCollectionController  # noqa: F401
+from .liveness import LivenessController  # noqa: F401
 from .tagging import TaggingController  # noqa: F401
 from .nodeclass_hash import NodeClassHashController  # noqa: F401
 from .nodeclass_status import NodeClassStatusController  # noqa: F401

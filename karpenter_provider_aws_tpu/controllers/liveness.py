@@ -1,0 +1,61 @@
+"""Liveness controller: reap NodeClaims that never become nodes.
+
+Parity: the core NodeClaim liveness controller (SURVEY.md section 2.2
+"NodePool/NodeClaim lifecycle ... registration, liveness, termination") —
+a claim whose instance launched but whose node never registered within the
+registration TTL (15 minutes upstream) is deleted, terminating the instance
+and returning its pods to the provisioner. Without this, a node that boots
+into a broken kubelet/CNI pins its capacity (and its nominated pods)
+forever.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ..state.cluster import Cluster
+from ..utils.clock import Clock, RealClock
+
+log = logging.getLogger("karpenter.tpu.liveness")
+
+REGISTRATION_TTL_S = 15 * 60.0  # upstream registration TTL
+
+
+class LivenessController:
+    name = "liveness"
+    interval_s = 30.0
+
+    def __init__(self, cluster: Cluster, clock: Optional[Clock] = None,
+                 ttl_s: float = REGISTRATION_TTL_S, recorder=None):
+        from ..events import default_recorder
+
+        self.cluster = cluster
+        self.clock = clock or RealClock()
+        self.ttl_s = ttl_s
+        self.recorder = recorder or default_recorder()
+        self.reaped: list[str] = []
+
+    def reconcile(self) -> None:
+        now = self.clock.now()
+        for claim in self.cluster.snapshot_claims():
+            if claim.deleted or claim.is_registered():
+                continue
+            if not claim.is_launched():
+                continue  # launch path owns pre-launch failures
+            if now - claim.created_at < self.ttl_s:
+                continue
+            log.warning(
+                "claim %s launched but never registered within %.0fs; reaping",
+                claim.name, self.ttl_s,
+            )
+            from ..events import WARNING
+
+            self.recorder.publish(
+                "NodeClaim", claim.name, "FailedRegistration",
+                f"instance never joined within {self.ttl_s:.0f}s; terminating",
+                type=WARNING,
+            )
+            self.reaped.append(claim.name)
+            # termination controller drains (no-op: no node) + terminates
+            self.cluster.delete(claim)

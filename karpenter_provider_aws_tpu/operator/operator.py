@@ -18,6 +18,7 @@ from ..cloudprovider.cloudprovider import CloudProvider
 from ..controllers import (
     DisruptionController,
     GarbageCollectionController,
+    LivenessController,
     InterruptionController,
     Manager,
     NodeClassHashController,
@@ -181,6 +182,7 @@ def new_operator(
         TaggingController(cluster, cloudprovider),
         disruption,
         GarbageCollectionController(cluster, cloudprovider, clock=clock),
+        LivenessController(cluster, clock=clock, recorder=recorder),
         NodeClassTerminationController(cluster, cloudprovider),
         CatalogRefreshController(catalog),
         PricingRefreshController(catalog),

@@ -583,29 +583,34 @@ def repack_set_feasible(
         weights=ct.group_counts[cand_arr].ravel(),
         minlength=G,
     ).astype(np.int64)
+    pending: dict[int, int] = {}
     for g in np.nonzero(totals)[0]:
         g = int(g)
         leftover = _place_group(g, int(totals[g]))
-        # Zone-spread budgets water-fill: every placement raises matched
-        # counts, which raises the floor and with it the next budgets — but
-        # _place_group computes budgets once at entry. Re-place the
-        # remainder until a full pass makes no progress, which reproduces
-        # the incremental (per-candidate) placement the aggregation
-        # replaced. Non-spread groups never progress on a retry (budgets
-        # are placement-independent), so they skip the loop.
-        while (
-            leftover > 0
-            and has_topo
-            and any(c.kind == "spread" for c in (ct.zone_constraints[g] or []))
-        ):
-            again = _place_group(g, leftover)
-            if again == leftover:
-                break
-            leftover = again
         if leftover > 0:
-            if not allow_overflow:
-                return None if return_free else False
-            overflow[g] = overflow.get(g, 0) + leftover
+            pending[g] = leftover
+    # Zone budgets are placement-DEPENDENT: spread floors water-fill upward
+    # as matched pods land, and affinity zones open when a later group's
+    # matching pods arrive — but _place_group computes budgets once at
+    # entry. Re-place every leftover until a full sweep makes no progress,
+    # which reproduces (and slightly generalizes) the incremental
+    # per-candidate placement the aggregation replaced. Without topology,
+    # budgets are capacity-only and capacity never grows — skip.
+    progressed = has_topo
+    while pending and progressed:
+        progressed = False
+        for g in list(pending):
+            leftover = _place_group(g, pending[g])
+            if leftover < pending[g]:
+                progressed = True
+            if leftover == 0:
+                del pending[g]
+            else:
+                pending[g] = leftover
+    for g, leftover in pending.items():
+        if not allow_overflow:
+            return None if return_free else False
+        overflow[g] = overflow.get(g, 0) + leftover
     if allow_overflow:
         return free, overflow
     return free if return_free else True

@@ -160,16 +160,21 @@ def _estimate_nodes(problem: EncodedProblem, G: int) -> int:
     # type minimizing price per slot. Estimating at the cheapest-absolute
     # type assumed tiny nodes and over-allocated rows ~2x on workloads
     # where a larger type wins on $/slot.
+    # per-resource mins accumulate into one [G, T] array — the one-shot
+    # [G, T, R] broadcast peaked at O(T) times more host memory for the
+    # same answer (R is small and fixed)
+    k_gt = np.full((G, problem.capacity.shape[0]), np.inf)
     with np.errstate(divide="ignore", invalid="ignore"):
-        fit = np.where(
-            (req > 0)[:, None, :],
-            np.floor(
-                (problem.capacity[None, :, :] + 1e-4)
-                / np.where(req > 0, req, 1.0)[:, None, :]
-            ),
-            np.inf,  # unrequested resources don't constrain
-        ).min(axis=2)                                          # [G, T]
-    k_gt = np.clip(fit, 0.0, float(1 << 30))
+        for r in range(req.shape[1]):
+            col = req[:, r]
+            rows = col > 0  # unrequested resources don't constrain
+            if not rows.any():
+                continue
+            ratio = np.floor(
+                (problem.capacity[None, :, r] + 1e-4) / col[rows][:, None]
+            )
+            k_gt[rows] = np.minimum(k_gt[rows], ratio)
+    k_gt = np.clip(k_gt, 0.0, float(1 << 30))
     # eff is capped by the group's own count, mirroring the scan's
     # eff = min(k, rem): a 100-slot node is only 50-slots-efficient for a
     # 50-pod group

@@ -18,6 +18,7 @@ from .controllers import (
     DisruptionController,
     GarbageCollectionController,
     InterruptionController,
+    LivenessController,
     Manager,
     NodeClassHashController,
     NodeClassStatusController,
@@ -53,6 +54,7 @@ class Environment:
     disruption: DisruptionController
     interruption: InterruptionController
     garbagecollection: GarbageCollectionController
+    liveness: LivenessController
     tagging: TaggingController
     nodeclass_hash: NodeClassHashController
     nodeclass_status: NodeClassStatusController
@@ -81,6 +83,7 @@ class Environment:
         self.disruption.disrupted.clear()
         self.interruption.handled.clear()
         self.garbagecollection.reaped.clear()
+        self.liveness.reaped.clear()
 
     def step(self, n: int = 1) -> None:
         """n deterministic reconcile passes over every controller."""
@@ -125,6 +128,7 @@ def new_environment(solver: Optional[Solver] = None, use_tpu_solver: bool = True
     interruption = InterruptionController(cluster, cloudprovider, queue,
                                           recorder=recorder)
     gc = GarbageCollectionController(cluster, cloudprovider, clock=clock)
+    liveness = LivenessController(cluster, clock=clock, recorder=recorder)
     tagging = TaggingController(cluster, cloudprovider)
     nc_hash = NodeClassHashController(cluster)
     nc_status = NodeClassStatusController(cluster, cloudprovider)
@@ -141,6 +145,7 @@ def new_environment(solver: Optional[Solver] = None, use_tpu_solver: bool = True
             tagging,
             disruption,
             gc,
+            liveness,
             nc_term,
         ]
     )
@@ -159,6 +164,7 @@ def new_environment(solver: Optional[Solver] = None, use_tpu_solver: bool = True
         disruption=disruption,
         interruption=interruption,
         garbagecollection=gc,
+        liveness=liveness,
         tagging=tagging,
         nodeclass_hash=nc_hash,
         nodeclass_status=nc_status,

@@ -168,6 +168,49 @@ class TestGC:
             assert inst.state == "running"
 
 
+class TestLiveness:
+    def test_unregistered_claim_reaped_after_ttl(self, env):
+        """A claim whose instance launched but never joined as a node is
+        deleted once the registration TTL passes (core liveness parity,
+        SURVEY.md section 2.2)."""
+        from karpenter_provider_aws_tpu.controllers.provisioning import launch_claim
+        from karpenter_provider_aws_tpu.scheduling.solver import NodeSpec
+
+        pool, _ = env.apply_defaults(cmr_pool())
+        spec = NodeSpec(
+            nodepool_name=pool.name,
+            instance_type_options=["c5.large"],
+            zone_options=["zone-a"],
+            capacity_type_options=["on-demand"],
+            offering_options=[("zone-a", "on-demand")],
+        )
+        claim = launch_claim(env.cluster, env.cloudprovider, pool, spec)
+        assert claim is not None and claim.is_launched()
+        # the fake kubelet (registration controller) is deliberately NOT run
+        env.liveness.reconcile()
+        assert not claim.deleted  # inside the TTL
+        env.clock.advance(15 * 60 + 1)
+        env.liveness.reconcile()
+        assert claim.deleted
+        assert claim.name in env.liveness.reaped
+        evs = env.events.events(kind="NodeClaim", reason="FailedRegistration")
+        assert evs and claim.name == evs[0].name
+        # drain + terminate through the normal path
+        env.step(2)
+        inst = env.cloud.instances[claim.status.provider_id.rsplit("/", 1)[-1]]
+        assert inst.state == "terminated"
+
+    def test_registered_claim_untouched(self, env):
+        env.apply_defaults(cmr_pool())
+        for p in make_pods(2, "w", {"cpu": "1", "memory": "2Gi"}):
+            env.cluster.apply(p)
+        env.step(2)  # launch + register
+        env.clock.advance(16 * 60)
+        env.liveness.reconcile()
+        assert env.liveness.reaped == []
+        assert all(not c.deleted for c in env.cluster.nodeclaims.values())
+
+
 class TestTagging:
     def test_instances_tagged_once_registered(self, env):
         env.apply_defaults(cmr_pool())
