@@ -1,0 +1,35 @@
+# Developer entry points (parity: the reference's Makefile targets —
+# presubmit/test at Makefile:59-64, deflake at :66-73, e2etests at :75-88,
+# benchmark at :90-91).
+
+PYTEST ?= python -m pytest
+
+.PHONY: presubmit test deflake stress e2etests benchmark interruption-bench verify multichip
+
+presubmit: test multichip  ## everything CI gates on
+
+test:  ## hermetic unit/behavior suites (CPU, no cloud)
+	$(PYTEST) tests/ -q
+
+deflake:  ## re-run the concurrency-sensitive suites until they fail (Ctrl-C to stop)
+	@i=1; while $(PYTEST) tests/test_stress.py tests/test_multichip.py \
+		tests/test_events.py -q; do \
+		echo "deflake pass $$i clean"; i=$$((i+1)); done
+
+stress:  ## one pass over the concurrency stress tier
+	$(PYTEST) tests/test_stress.py -q
+
+e2etests:  ## end-to-end suites against the fake cloud (serial, like the reference)
+	$(PYTEST) tests/e2e/ -q -p no:randomly
+
+benchmark:  ## the one-JSON-line bench on whatever accelerator is live
+	python bench.py
+
+interruption-bench:  ## reference tiers: 100/1k/5k/15k messages
+	python -c "from benchmarks.interruption_bench import run_all; run_all()"
+
+multichip:  ## the driver's multi-chip dry run on a virtual 8-device mesh
+	python -c "import os; os.environ['XLA_FLAGS']='--xla_force_host_platform_device_count=8'; \
+		import jax; jax.config.update('jax_platforms','cpu'); \
+		import __graft_entry__ as g; fn,a=g.entry(); jax.jit(fn)(*a); \
+		g.dryrun_multichip(8); print('multichip OK')"
