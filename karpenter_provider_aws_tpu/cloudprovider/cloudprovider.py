@@ -44,6 +44,7 @@ class DriftReason(str, enum.Enum):
     IMAGE = "ImageDrifted"                   # drift.go AMI drift
     SUBNET = "SubnetDrifted"
     SECURITY_GROUP = "SecurityGroupDrifted"
+    NODEPOOL = "NodePoolHashDrifted"         # core NodePool static drift
 
 
 class CloudProvider:
@@ -373,6 +374,15 @@ class CloudProvider:
 
     # -- IsDrifted ---------------------------------------------------------
     def is_drifted(self, claim: NodeClaim) -> DriftReason:
+        # NodePool template drift first: the pool the claim was stamped
+        # from has since changed labels/taints/requirements (core static
+        # drift). Independent of the nodeclass — a deleted nodeclass must
+        # not mask it (e.g. the pool was re-pointed and the old class
+        # removed, which is itself template drift).
+        pool = self.cluster.nodepools.get(claim.nodepool_name)
+        pool_stamp = claim.annotations.get(lbl.ANNOTATION_NODEPOOL_HASH)
+        if pool is not None and pool_stamp is not None and pool_stamp != pool.hash():
+            return DriftReason.NODEPOOL
         nodeclass = self.cluster.nodeclasses.get(claim.nodeclass_name)
         if nodeclass is None:
             return DriftReason.NONE

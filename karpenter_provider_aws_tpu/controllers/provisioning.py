@@ -185,6 +185,9 @@ def launch_claim(cluster: Cluster, cloudprovider: CloudProvider, pool, spec: Nod
         taints=list(pool.taints),
         startup_taints=list(pool.startup_taints),
     )
+    # template-hash stamp: a later pool edit drifts this claim (core
+    # NodePool static-drift analogue)
+    claim.annotations[lbl.ANNOTATION_NODEPOOL_HASH] = pool.hash()
     cluster.apply(claim)
     from ..events import WARNING, default_recorder
 

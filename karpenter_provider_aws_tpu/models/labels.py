@@ -51,6 +51,7 @@ INSTANCE_ACCELERATOR_COUNT = f"{GROUP}/instance-accelerator-count"
 
 # Annotations.
 ANNOTATION_NODECLASS_HASH = f"{GROUP}/nodeclass-hash"
+ANNOTATION_NODEPOOL_HASH = f"{GROUP}/nodepool-hash"
 ANNOTATION_NODECLASS_HASH_VERSION = f"{GROUP}/nodeclass-hash-version"
 ANNOTATION_INSTANCE_TAGGED = f"{GROUP}/tagged"
 ANNOTATION_DO_NOT_DISRUPT = "karpenter.sh/do-not-disrupt"

@@ -91,3 +91,34 @@ class NodePool:
         reqs = reqs.union(Requirements.from_labels(self.labels))
         reqs = reqs.union(Requirements.from_labels({lbl.NODEPOOL: self.name}))
         return reqs
+
+    # Fields excluded from the template-drift hash: they steer future
+    # decisions (which node to open next, when to disrupt), they don't
+    # change what is stamped onto an already-launched node. Everything
+    # else is included BY DEFAULT so a newly added template field drifts
+    # without anyone remembering to list it here (fail-safe; same pattern
+    # as NodeClass._HASH_EXCLUDE).
+    _HASH_EXCLUDE = ("name", "weight", "limits", "disruption")
+
+    def hash(self) -> str:
+        """Stable hash over the node TEMPLATE: everything stamped onto a
+        launched node. A claim whose stamped hash diverges is drifted and
+        gets replaced (the core's NodePool static-drift analogue)."""
+        import hashlib
+        import json
+        from dataclasses import asdict
+
+        spec = {}
+        for k, v in self.__dict__.items():
+            if k in self._HASH_EXCLUDE or k.startswith("_"):
+                continue
+            if hasattr(v, "__dataclass_fields__"):
+                v = asdict(v)
+            elif isinstance(v, list):
+                v = [
+                    asdict(x) if hasattr(x, "__dataclass_fields__") else x
+                    for x in v
+                ]
+            spec[k] = v
+        blob = json.dumps(spec, sort_keys=True, default=str).encode()
+        return hashlib.sha256(blob).hexdigest()[:16]

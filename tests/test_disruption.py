@@ -128,6 +128,19 @@ class TestDriftDisruption:
         env.disruption.reconcile()
         assert any("drifted" in r for _, r in env.disruption.disrupted)
 
+    def test_nodepool_template_drift_triggers_disruption(self, env):
+        """Editing the pool TEMPLATE (labels/taints/requirements) drifts
+        claims stamped from the old template (core NodePool static drift);
+        non-template knobs (weight, budgets) must not."""
+        pool, _ = env.apply_defaults(pool_with(consolidate_after_s=None))
+        provision(env, make_pods(2, "w", {"cpu": "1", "memory": "2Gi"}))
+        pool.weight = 7  # decision-steering field: NOT drift
+        env.disruption.reconcile()
+        assert not any("NodePool" in r for _, r in env.disruption.disrupted)
+        pool.labels = {"team": "b"}  # template field: drift
+        env.disruption.reconcile()
+        assert any("NodePoolHashDrifted" in r for _, r in env.disruption.disrupted)
+
 
 class TestBudgets:
     def test_budget_caps_disruptions_per_pass(self, env):
