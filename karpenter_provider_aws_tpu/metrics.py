@@ -153,15 +153,16 @@ class Registry:
             def log_message(self, *args):  # quiet
                 pass
 
-        self._http = ThreadingHTTPServer(("127.0.0.1", port), Handler)
-        thread = threading.Thread(target=self._http.serve_forever, daemon=True)
-        thread.start()
+        from .utils.httpserve import serve_on_loopback
+
+        self._http = serve_on_loopback(Handler, port)
         return self._http.server_address[1]
 
     def stop(self) -> None:
-        if self._http is not None:
-            self._http.shutdown()
-            self._http = None
+        from .utils.httpserve import stop_server
+
+        stop_server(self._http)
+        self._http = None
 
 
 # The default process-wide registry + well-known metrics (created lazily by

@@ -91,6 +91,23 @@ class ResourceVector:
     def to_map(self) -> dict[str, float]:
         return {name: float(self.v[i]) for i, name in enumerate(RESOURCE_AXES) if self.v[i] != 0}
 
+    def to_quantities(self) -> dict[str, str]:
+        """Unit-faithful k8s quantity strings: the inverse of ``from_map``
+        (``to_map`` exports raw AXIS units — millicores/MiB — which
+        ``from_map`` would re-parse as cores/bytes)."""
+        out: dict[str, str] = {}
+        for i, name in enumerate(RESOURCE_AXES):
+            val = float(self.v[i])
+            if val == 0:
+                continue
+            if name == "cpu":
+                out[name] = f"{val:g}m"          # axis unit IS millicores
+            elif name in ("memory", "ephemeral-storage"):
+                out[name] = f"{val:g}Mi"         # axis unit IS MiB
+            else:
+                out[name] = f"{val:g}"
+        return out
+
     def get(self, name: str) -> float:
         return float(self.v[_AXIS_INDEX[name]])
 

@@ -1,0 +1,210 @@
+"""HTTP admission boundary: the webhook-server analogue.
+
+Parity: ``pkg/webhooks/webhooks.go:30-60`` — the reference serves knative
+defaulting + validation admission over HTTPS for the apiserver. This
+framework has no apiserver, but an EXTERNAL control plane (the gRPC/Go
+split in ``runtime/``) still needs the admission chain as a network
+service, not a Python import. One endpoint, AdmissionReview-shaped:
+
+    POST /admit
+    {"kind": "NodeClass" | "NodePool", "object": {...}}
+      -> 200 {"allowed": true,  "object": {...defaulted...}}
+      -> 200 {"allowed": false, "violations": ["...", ...]}
+
+GET /healthz serves readiness. The JSON object schema mirrors the
+dataclass fields (`models/nodeclass.py`, `models/nodepool.py`).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from dataclasses import asdict
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..models.nodeclass import (
+    BlockDevice,
+    KubeletConfiguration,
+    MetadataOptions,
+    NodeClass,
+    SelectorTerm,
+)
+from ..models.nodepool import Disruption, Limits, NodePool, Taint
+from ..models.requirements import Operator, Requirement
+from .webhooks import AdmissionError, admit
+
+log = logging.getLogger("karpenter.tpu.admission")
+
+
+# -- deserialization ---------------------------------------------------------
+
+def _selector_terms(raw) -> list[SelectorTerm]:
+    out = []
+    for t in raw or []:
+        tags = t.get("tags") or {}
+        if isinstance(tags, dict):
+            tags = tuple(sorted(tags.items()))
+        else:
+            tags = tuple(tuple(p) for p in tags)
+        out.append(SelectorTerm(tags=tags, id=t.get("id", ""), name=t.get("name", "")))
+    return out
+
+
+def _kubelet(raw) -> Optional[KubeletConfiguration]:
+    if not raw:
+        return None
+    kw = {}
+    for k in ("max_pods", "pods_per_core", "image_gc_high_threshold_percent",
+              "image_gc_low_threshold_percent", "cpu_cfs_quota"):
+        if k in raw:
+            kw[k] = raw[k]
+    for k in ("system_reserved", "kube_reserved", "eviction_hard", "eviction_soft"):
+        if k in raw:
+            v = raw[k]
+            kw[k] = tuple(sorted(v.items())) if isinstance(v, dict) else tuple(
+                tuple(p) for p in v
+            )
+    if "cluster_dns" in raw:
+        kw["cluster_dns"] = tuple(raw["cluster_dns"])
+    return KubeletConfiguration(**kw)
+
+
+def nodeclass_from_dict(data: dict) -> NodeClass:
+    kw = {"name": data["name"]}
+    for k in ("image_family", "role", "instance_profile", "user_data"):
+        if k in data:
+            kw[k] = data[k]
+    if "tags" in data:
+        kw["tags"] = dict(data["tags"])
+    for field_name in ("image_selector", "subnet_selector",
+                       "security_group_selector", "capacity_reservation_selector"):
+        if field_name in data:
+            kw[field_name] = _selector_terms(data[field_name])
+    if "block_devices" in data:
+        kw["block_devices"] = [BlockDevice(**bd) for bd in data["block_devices"]]
+    if "metadata_options" in data:
+        kw["metadata_options"] = MetadataOptions(**data["metadata_options"])
+    return NodeClass(**kw)
+
+
+def nodepool_from_dict(data: dict) -> NodePool:
+    kw = {"name": data["name"]}
+    for k in ("nodeclass_name", "weight"):
+        if k in data:
+            kw[k] = data[k]
+    if "labels" in data:
+        kw["labels"] = dict(data["labels"])
+    if "annotations" in data:
+        kw["annotations"] = dict(data["annotations"])
+    if "requirements" in data:
+        kw["requirements"] = [
+            Requirement(
+                key=r["key"],
+                operator=Operator(r["operator"]),
+                values=tuple(r.get("values") or ()),
+                min_values=r.get("min_values"),
+            )
+            for r in data["requirements"]
+        ]
+    for k in ("taints", "startup_taints"):
+        if k in data:
+            kw[k] = [Taint(**t) for t in data[k]]
+    if "limits" in data:
+        raw = data["limits"]
+        kw["limits"] = (
+            Limits() if raw.get("unlimited", False) else Limits.of(
+                **{k.replace("-", "_"): v for k, v in (raw.get("resources") or {}).items()}
+            )
+        )
+    if "disruption" in data:
+        kw["disruption"] = Disruption(**data["disruption"])
+    if "kubelet" in data:
+        kw["kubelet"] = _kubelet(data["kubelet"])
+    return NodePool(**kw)
+
+
+_KINDS = {"NodeClass": nodeclass_from_dict, "NodePool": nodepool_from_dict}
+
+
+def review(body: dict) -> dict:
+    """One admission review: parse -> default -> validate -> re-serialize.
+    Never raises: every failure mode is a violations response (this is the
+    network boundary; callers can't catch Python exceptions)."""
+    kind = body.get("kind", "")
+    parser = _KINDS.get(kind)
+    if parser is None:
+        return {"allowed": False, "violations": [f"unknown kind {kind!r}"]}
+    try:
+        obj = parser(body.get("object") or {})
+    except Exception as e:  # any malformed shape: lists-as-strings etc.
+        return {"allowed": False, "violations": [f"malformed object: {e}"]}
+    try:
+        admitted = admit(obj)
+    except AdmissionError as e:
+        return {"allowed": False, "violations": list(e.violations)}
+    except Exception as e:  # validator tripped on a shape parse() let through
+        return {"allowed": False, "violations": [f"malformed object: {e}"]}
+    out = asdict(admitted)
+    out.pop("status", None)
+    out.pop("finalizers", None)
+    if isinstance(admitted, NodePool):
+        # Limits holds a ResourceVector (not a dataclass): re-serialize as
+        # unit-faithful k8s quantity strings so the object round-trips
+        out["limits"] = {
+            "unlimited": admitted.limits.unlimited,
+            "resources": admitted.limits.resources.to_quantities(),
+        }
+    return {"allowed": True, "object": json.loads(json.dumps(out, default=str))}
+
+
+class AdmissionServer:
+    """Serves the admission chain on localhost (TLS termination is the
+    deployment's job, like the reference's webhook Service)."""
+
+    def __init__(self):
+        self._http: Optional[ThreadingHTTPServer] = None
+
+    def serve(self, port: int = 0) -> int:
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                if self.path != "/healthz":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self._reply(200, b"ok\n", "text/plain")
+
+            def do_POST(self):  # noqa: N802
+                if self.path != "/admit":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                    result = review(body)
+                except Exception as e:  # malformed request must not 500-loop
+                    result = {"allowed": False, "violations": [f"bad request: {e}"]}
+                self._reply(200, json.dumps(result).encode(), "application/json")
+
+            def _reply(self, code: int, body: bytes, ctype: str):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # quiet
+                pass
+
+        from ..utils.httpserve import serve_on_loopback
+
+        self._http = serve_on_loopback(Handler, port)
+        log.info("admission server on 127.0.0.1:%d/admit", self._http.server_address[1])
+        return self._http.server_address[1]
+
+    def stop(self) -> None:
+        from ..utils.httpserve import stop_server
+
+        stop_server(self._http)
+        self._http = None

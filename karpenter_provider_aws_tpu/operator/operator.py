@@ -55,16 +55,25 @@ class Operator:
     manager: Manager
     metrics_port: int = 0
     version_provider: object = None
+    admission: object = None
+    admission_port: int = 0
 
     def start(self) -> None:
         if self.options.metrics_port:
             self.metrics_port = REGISTRY.serve(self.options.metrics_port)
             log.info("metrics on 127.0.0.1:%d/metrics", self.metrics_port)
+        if self.options.admission_port:
+            from .admission_server import AdmissionServer
+
+            self.admission = AdmissionServer()
+            self.admission_port = self.admission.serve(self.options.admission_port)
         self.manager.start()
 
     def stop(self) -> None:
         self.manager.stop()
         self.cloudprovider.close()  # join batcher worker pools
+        if self.admission is not None:
+            self.admission.stop()
         REGISTRY.stop()
 
     def apply(self, obj):

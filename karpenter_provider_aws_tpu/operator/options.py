@@ -37,6 +37,7 @@ class Options:
     solver_sidecar_target: str = ""              # for solver_backend=grpc
     max_nodes_per_solve: int = 0                 # 0 = auto bucket
     metrics_port: int = 8080                     # 0 = disabled
+    admission_port: int = 0                      # webhook-server analogue; 0 = disabled
     drift_enabled: bool = True
     feature_gates: str = ""                      # "Drift=true,SpotToSpot=false"
     log_level: str = "INFO"

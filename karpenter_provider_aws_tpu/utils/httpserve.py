@@ -1,0 +1,25 @@
+"""Loopback HTTP serving shared by the metrics and admission endpoints.
+
+One place owns the ThreadingHTTPServer lifecycle (bind on 127.0.0.1,
+daemon serve_forever thread, shutdown AND server_close — shutdown alone
+leaks the listening socket fd across serve/stop cycles)."""
+
+from __future__ import annotations
+
+import threading
+from http.server import ThreadingHTTPServer
+from typing import Optional
+
+
+def serve_on_loopback(handler_cls, port: int = 0) -> ThreadingHTTPServer:
+    """Bind on 127.0.0.1:port (0 = ephemeral) and serve on a daemon thread.
+    The bound port is ``server.server_address[1]``."""
+    server = ThreadingHTTPServer(("127.0.0.1", port), handler_cls)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server
+
+
+def stop_server(server: Optional[ThreadingHTTPServer]) -> None:
+    if server is not None:
+        server.shutdown()
+        server.server_close()

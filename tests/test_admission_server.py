@@ -1,0 +1,114 @@
+"""HTTP admission boundary (parity: webhooks.go:30-60 — the admission
+chain as a network service for external control planes)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from karpenter_provider_aws_tpu.operator.admission_server import (
+    AdmissionServer,
+    review,
+)
+
+
+@pytest.fixture(scope="module")
+def server():
+    s = AdmissionServer()
+    port = s.serve(0)
+    yield f"http://127.0.0.1:{port}"
+    s.stop()
+
+
+def _post(base, body):
+    req = urllib.request.Request(
+        base + "/admit",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+class TestReview:
+    def test_valid_nodeclass_defaulted(self):
+        out = review({"kind": "NodeClass", "object": {"name": "nc", "role": "r"}})
+        assert out["allowed"]
+        assert out["object"]["image_family"] == "standard"
+        assert out["object"]["block_devices"]  # family defaults applied
+
+    def test_invalid_nodeclass_violations(self):
+        out = review({
+            "kind": "NodeClass",
+            "object": {"name": "nc", "role": "r", "instance_profile": "p"},
+        })
+        assert not out["allowed"]
+        assert any("mutually exclusive" in v for v in out["violations"])
+
+    def test_nodepool_requirements_roundtrip(self):
+        out = review({
+            "kind": "NodePool",
+            "object": {
+                "name": "p",
+                "requirements": [
+                    {"key": "karpenter.tpu/instance-category", "operator": "In",
+                     "values": ["c", "m"]},
+                ],
+                "disruption": {"consolidate_after_s": 30, "budgets": ["20%"]},
+            },
+        })
+        assert out["allowed"], out
+        keys = [r["key"] for r in out["object"]["requirements"]]
+        assert "karpenter.tpu/instance-category" in keys
+
+    def test_restricted_nodepool_label_rejected(self):
+        out = review({
+            "kind": "NodePool",
+            "object": {"name": "p", "labels": {"kubernetes.io/hostname": "x"}},
+        })
+        assert not out["allowed"]
+
+    def test_limits_roundtrip(self):
+        """The defaulted object must re-submit cleanly AND preserve units
+        (Limits holds a ResourceVector, which needs its own serialization)."""
+        out = review({
+            "kind": "NodePool",
+            "object": {"name": "p", "limits": {"resources": {"cpu": "100", "memory": "10Gi"}}},
+        })
+        assert out["allowed"], out
+        res = out["object"]["limits"]["resources"]
+        assert res == {"cpu": "100000m", "memory": "10240Mi"}, res
+        again = review({"kind": "NodePool", "object": out["object"]})
+        assert again["allowed"], again
+        assert again["object"]["limits"]["resources"] == res  # fixed point
+
+    def test_malformed_selector_tags_violation_not_crash(self):
+        out = review({
+            "kind": "NodeClass",
+            "object": {"name": "n", "role": "r", "subnet_selector": [{"tags": "abc"}]},
+        })
+        assert not out["allowed"]
+
+    def test_unknown_kind(self):
+        out = review({"kind": "Gadget", "object": {"name": "g"}})
+        assert not out["allowed"]
+
+    def test_malformed_object(self):
+        out = review({"kind": "NodePool", "object": {"requirements": "nope"}})
+        assert not out["allowed"]
+
+
+class TestHTTP:
+    def test_admit_over_http(self, server):
+        out = _post(server, {"kind": "NodeClass", "object": {"name": "nc", "role": "r"}})
+        assert out["allowed"]
+
+    def test_reject_over_http(self, server):
+        out = _post(server, {"kind": "NodeClass", "object": {"name": "nc"}})
+        assert not out["allowed"]
+        assert out["violations"]
+
+    def test_healthz(self, server):
+        with urllib.request.urlopen(server + "/healthz", timeout=10) as resp:
+            assert resp.read() == b"ok\n"
