@@ -37,9 +37,14 @@ import numpy as np
 
 TARGET_MS = 200.0
 PROBE_ATTEMPTS = int(os.environ.get("BENCH_PROBE_ATTEMPTS", 3))
-# 900s: a TPU-tunnel cold start exceeded the old 300s window 3x in round 2
-# and cost the round its only hardware datum.
+# 900s first window: a TPU-tunnel cold start exceeded the old 300s window
+# 3x in round 2 and cost the round its only hardware datum. LATER attempts
+# get a short window: an attempt that burned the full 900s without the
+# backend coming up indicates a wedged tunnel (observed when a client dies
+# mid-transfer), and a wedge does not heal on the probe's timescale —
+# better to reach the CPU fallback with time to spare.
 PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", 900))
+PROBE_RETRY_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_RETRY_TIMEOUT_S", 180))
 PROBE_SLEEP_S = float(os.environ.get("BENCH_PROBE_SLEEP_S", 10))
 _FALLBACK_ENV = "BENCH_CPU_FALLBACK"
 
@@ -63,18 +68,24 @@ def probe_backend() -> tuple[bool, str]:
     process (jax caches backend-init failure for the process lifetime).
     """
     last_err = ""
+    hung = False  # a full-window hang indicates a wedge, not a cold start
     for attempt in range(1, PROBE_ATTEMPTS + 1):
+        # Only shorten AFTER an attempt hung out its whole window: fast
+        # transient failures (UNAVAILABLE during cold start) must keep the
+        # full budget, or a ~500s cold start loses its hardware datum.
+        window = PROBE_RETRY_TIMEOUT_S if hung else PROBE_TIMEOUT_S
         t0 = time.time()
         try:
             out = subprocess.run(
                 [sys.executable, "-c", _PROBE_SNIPPET],
                 capture_output=True,
                 text=True,
-                timeout=PROBE_TIMEOUT_S,
+                timeout=window,
                 cwd="/",
             )
         except subprocess.TimeoutExpired:
-            last_err = f"probe attempt {attempt} timed out after {PROBE_TIMEOUT_S}s"
+            hung = True
+            last_err = f"probe attempt {attempt} timed out after {window}s"
             print(last_err, file=sys.stderr)
             continue
         if out.returncode == 0 and "OK" in out.stdout:

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import threading
 import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import ThreadingHTTPServer
 from typing import Optional
 
 
@@ -134,26 +134,17 @@ class Registry:
     # -- /metrics endpoint -------------------------------------------------
     def serve(self, port: int) -> int:
         registry = self
+        from .utils.httpserve import QuietHandler, serve_on_loopback
 
-        class Handler(BaseHTTPRequestHandler):
+        class Handler(QuietHandler):
             def do_GET(self):  # noqa: N802
                 if self.path not in ("/metrics", "/healthz"):
-                    self.send_response(404)
-                    self.end_headers()
+                    self.reply(404, b"")
                     return
                 body = (
                     registry.expose() if self.path == "/metrics" else "ok\n"
                 ).encode()
-                self.send_response(200)
-                self.send_header("Content-Type", "text/plain; version=0.0.4")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-            def log_message(self, *args):  # quiet
-                pass
-
-        from .utils.httpserve import serve_on_loopback
+                self.reply(200, body, "text/plain; version=0.0.4")
 
         self._http = serve_on_loopback(Handler, port)
         return self._http.server_address[1]

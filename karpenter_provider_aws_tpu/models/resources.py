@@ -95,17 +95,24 @@ class ResourceVector:
         """Unit-faithful k8s quantity strings: the inverse of ``from_map``
         (``to_map`` exports raw AXIS units — millicores/MiB — which
         ``from_map`` would re-parse as cores/bytes)."""
+        def fmt(val: float) -> str:
+            # never exponent notation: parse_quantity's grammar is plain
+            # digits (a 1000-core limit as "1e+06m" would not re-parse)
+            if val == int(val):
+                return str(int(val))
+            return f"{val:f}".rstrip("0").rstrip(".")
+
         out: dict[str, str] = {}
         for i, name in enumerate(RESOURCE_AXES):
             val = float(self.v[i])
             if val == 0:
                 continue
             if name == "cpu":
-                out[name] = f"{val:g}m"          # axis unit IS millicores
+                out[name] = fmt(val) + "m"       # axis unit IS millicores
             elif name in ("memory", "ephemeral-storage"):
-                out[name] = f"{val:g}Mi"         # axis unit IS MiB
+                out[name] = fmt(val) + "Mi"      # axis unit IS MiB
             else:
-                out[name] = f"{val:g}"
+                out[name] = fmt(val)
         return out
 
     def get(self, name: str) -> float:

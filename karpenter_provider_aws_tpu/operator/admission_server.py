@@ -20,7 +20,7 @@ from __future__ import annotations
 import json
 import logging
 from dataclasses import asdict
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import ThreadingHTTPServer
 from typing import Optional
 
 from ..models.nodeclass import (
@@ -166,18 +166,18 @@ class AdmissionServer:
         self._http: Optional[ThreadingHTTPServer] = None
 
     def serve(self, port: int = 0) -> int:
-        class Handler(BaseHTTPRequestHandler):
+        from ..utils.httpserve import QuietHandler, serve_on_loopback
+
+        class Handler(QuietHandler):
             def do_GET(self):  # noqa: N802
                 if self.path != "/healthz":
-                    self.send_response(404)
-                    self.end_headers()
+                    self.reply(404, b"")
                     return
-                self._reply(200, b"ok\n", "text/plain")
+                self.reply(200, b"ok\n")
 
             def do_POST(self):  # noqa: N802
                 if self.path != "/admit":
-                    self.send_response(404)
-                    self.end_headers()
+                    self.reply(404, b"")
                     return
                 try:
                     length = int(self.headers.get("Content-Length", "0"))
@@ -185,19 +185,7 @@ class AdmissionServer:
                     result = review(body)
                 except Exception as e:  # malformed request must not 500-loop
                     result = {"allowed": False, "violations": [f"bad request: {e}"]}
-                self._reply(200, json.dumps(result).encode(), "application/json")
-
-            def _reply(self, code: int, body: bytes, ctype: str):
-                self.send_response(code)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-            def log_message(self, *args):  # quiet
-                pass
-
-        from ..utils.httpserve import serve_on_loopback
+                self.reply(200, json.dumps(result).encode(), "application/json")
 
         self._http = serve_on_loopback(Handler, port)
         log.info("admission server on 127.0.0.1:%d/admit", self._http.server_address[1])

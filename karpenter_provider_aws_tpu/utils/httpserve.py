@@ -7,8 +7,23 @@ leaks the listening socket fd across serve/stop cycles)."""
 from __future__ import annotations
 
 import threading
-from http.server import ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+
+
+class QuietHandler(BaseHTTPRequestHandler):
+    """Request handler base for internal endpoints: silenced access log +
+    one-call responses."""
+
+    def reply(self, code: int, body: bytes, ctype: str = "text/plain") -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):  # quiet
+        pass
 
 
 def serve_on_loopback(handler_cls, port: int = 0) -> ThreadingHTTPServer:
