@@ -51,6 +51,7 @@ class DisruptionController:
         drift_enabled: bool = True,
         provisioning=None,
         recorder=None,
+        spot_to_spot: bool = False,
     ):
         from ..events import default_recorder
 
@@ -58,6 +59,8 @@ class DisruptionController:
         self.cloudprovider = cloudprovider
         self.clock = clock or RealClock()
         self.drift_enabled = drift_enabled
+        # core SpotToSpotConsolidation feature gate (default off upstream)
+        self.spot_to_spot = spot_to_spot
         self.provisioning = provisioning
         self.recorder = recorder or default_recorder()
         self.disrupted: list[tuple[str, str]] = []  # (claim name, reason) log
@@ -224,7 +227,7 @@ class DisruptionController:
         }
         for ni, type_name, new_price, offering_options in cheaper_replacement(
             ct, self.cloudprovider.catalog, nodepools=dict(pools),
-            reserved_allow=reserved_allow,
+            reserved_allow=reserved_allow, spot_to_spot=self.spot_to_spot,
         ):
             if ni in deleted_nodes:
                 continue
@@ -276,6 +279,11 @@ class DisruptionController:
                     ct, overflow, self.cloudprovider.catalog, pool_name,
                     nodepools=dict(pools), margin=self.REPLACE_MARGIN,
                     price_cap=set_price,
+                    set_has_spot=any(
+                        ct.node_captype[i] == lbl.CAPACITY_TYPE_SPOT
+                        for i in subset
+                    ) if ct.node_captype else False,
+                    spot_to_spot=self.spot_to_spot,
                 )
                 if rep is None:
                     continue

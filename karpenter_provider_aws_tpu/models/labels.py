@@ -25,6 +25,7 @@ CAPACITY_TYPE_ON_DEMAND = "on-demand"
 CAPACITY_TYPE_RESERVED = "reserved"  # capacity-reservation-backed (pre-paid)
 CAPACITY_TYPES = (CAPACITY_TYPE_ON_DEMAND, CAPACITY_TYPE_SPOT, CAPACITY_TYPE_RESERVED)
 NUM_CAPACITY_TYPES = len(CAPACITY_TYPES)
+SPOT_INDEX = CAPACITY_TYPES.index(CAPACITY_TYPE_SPOT)
 RESERVED_INDEX = CAPACITY_TYPES.index(CAPACITY_TYPE_RESERVED)
 CAPACITY_RESERVATION_ID = f"{GROUP}/capacity-reservation-id"
 

@@ -180,6 +180,7 @@ def new_operator(
         drift_enabled=options.drift_enabled and options.gate("Drift", True),
         provisioning=provisioning,
         recorder=recorder,
+        spot_to_spot=options.gate("SpotToSpot", False),
     )
     controllers = [
         NodeClassStatusController(cluster, cloudprovider),

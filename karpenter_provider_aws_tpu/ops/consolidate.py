@@ -78,6 +78,7 @@ class ClusterTensors:
     node_zone: list = field(default_factory=list)         # [N] zone names
     zones: list = field(default_factory=list)             # zone vocabulary
     node_zone_idx: np.ndarray = None     # [N] int32 index into zones
+    node_captype: list = field(default_factory=list)      # [N] capacity types
 
     def has_topology(self) -> bool:
         return bool((self.mpn < _UNCAPPED).any()) or any(
@@ -276,6 +277,7 @@ def encode_cluster(cluster, catalog, gmax: int = GMAX_DEFAULT) -> Optional[Clust
         node_zone=node_zone,
         zones=zone_names,
         node_zone_idx=node_zone_idx,
+        node_captype=[n.capacity_type() for n in nodes],
     )
 
 
@@ -624,6 +626,8 @@ def replacement_for_groups(
     nodepools: Optional[dict] = None,
     margin: float = 0.15,
     price_cap: float = float("inf"),
+    set_has_spot: bool = False,
+    spot_to_spot: bool = False,
 ) -> Optional[tuple]:
     """Cheapest single node absorbing ``overflow`` (group id -> pod count):
     the one-new-node tail of multi-node consolidation replace
@@ -703,10 +707,26 @@ def replacement_for_groups(
 
     allowed = tensors.available & window[None, :, :]
     allowed[:, :, lbl.RESERVED_INDEX] = False  # see docstring
-    win_price = np.where(allowed, tensors.price, np.inf).min(axis=(1, 2))
     fits = (total[None, :] <= tensors.capacity + 1e-4).all(axis=1)
-    usable = node_compat & fits & np.isfinite(win_price)
-    usable &= win_price < price_cap * (1.0 - margin) - 1e-9
+
+    def _usable(a):
+        wp = np.where(a, tensors.price, np.inf).min(axis=(1, 2))
+        u = node_compat & fits & np.isfinite(wp)
+        u &= wp < price_cap * (1.0 - margin) - 1e-9
+        return u, wp
+
+    if set_has_spot and allowed[:, :, lbl.SPOT_INDEX].any():
+        # same SpotToSpotConsolidation gate as the single-node path: a set
+        # containing spot nodes only lands on a spot replacement when the
+        # gate is on AND >= MIN_TYPES_FOR_SPOT_TO_SPOT cheaper spot-capable
+        # types exist
+        spot_only = np.zeros_like(allowed)
+        spot_only[:, :, lbl.SPOT_INDEX] = allowed[:, :, lbl.SPOT_INDEX]
+        u_spot, _ = _usable(spot_only)
+        if not spot_to_spot or int(u_spot.sum()) < MIN_TYPES_FOR_SPOT_TO_SPOT:
+            allowed = allowed.copy()
+            allowed[:, :, lbl.SPOT_INDEX] = False
+    usable, win_price = _usable(allowed)
     if not usable.any():
         return None
     t = int(np.where(usable, win_price, np.inf).argmin())
@@ -719,9 +739,16 @@ def replacement_for_groups(
     return tensors.names[t], float(win_price[t]), offering_options
 
 
+# Core parity: MinInstanceTypesForSpotToSpotConsolidation — a spot node may
+# only be replaced by another spot offering when at least this many cheaper
+# instance types exist, otherwise consolidation walks the fleet toward the
+# top of the spot market and gets interrupted right back.
+MIN_TYPES_FOR_SPOT_TO_SPOT = 15
+
+
 def cheaper_replacement(
     ct: ClusterTensors, catalog, nodepools: Optional[dict] = None, margin: float = 0.15,
-    reserved_allow: Optional[dict] = None,
+    reserved_allow: Optional[dict] = None, spot_to_spot: bool = False,
 ) -> list:
     """[(node_index, type_name, new_price)] single-node replace candidates:
     all the node's pods fit one cheaper instance type (consolidation.md
@@ -730,7 +757,13 @@ def cheaper_replacement(
 
     ``margin`` demands a meaningful saving (default 15%) — with zero margin,
     zonal spot-price jitter makes replace oscillate forever: every pass finds
-    an epsilon-cheaper offering for the node it just created."""
+    an epsilon-cheaper offering for the node it just created.
+
+    ``spot_to_spot`` is the core SpotToSpotConsolidation feature gate
+    (default off, like upstream): a running SPOT node is never replaced by
+    another spot offering unless the gate is on AND at least
+    ``MIN_TYPES_FOR_SPOT_TO_SPOT`` cheaper spot-capable types qualify —
+    spot->on-demand/reserved replacements are always considered."""
     from ..models.requirements import Requirements
     from ..ops.encode import _SKIP_KEYS, _contains_vec, _label_arrays
 
@@ -866,10 +899,34 @@ def cheaper_replacement(
             allowed[:, :, lbl.RESERVED_INDEX] &= pool_rmask.get(
                 ct.nodepool_names[i], no_access
             )
-        win_price = np.where(allowed, tensors.price, np.inf).min(axis=(1, 2))
         fits = (ct.used_total[i][None, :] <= tensors.capacity + 1e-4).all(axis=1)
-        cheaper = win_price < ct.price[i] * (1.0 - margin) - 1e-9
-        usable = node_compat & fits & cheaper & np.isfinite(win_price)
+
+        def _score(a):
+            wp = np.where(a, tensors.price, np.inf).min(axis=(1, 2))
+            u = (
+                node_compat & fits & np.isfinite(wp)
+                & (wp < ct.price[i] * (1.0 - margin) - 1e-9)
+            )
+            return u, wp
+
+        usable, win_price = _score(allowed)
+        if (
+            ct.node_captype
+            and ct.node_captype[i] == lbl.CAPACITY_TYPE_SPOT
+            and allowed[:, :, lbl.SPOT_INDEX].any()
+        ):
+            # SpotToSpotConsolidation gate: spot->spot needs the gate on AND
+            # enough cheaper SPOT-CAPABLE types (cheapness via on-demand
+            # offerings doesn't diversify the spot pool) to stay off the
+            # top of the spot market
+            spot_only = np.zeros_like(allowed)
+            spot_only[:, :, lbl.SPOT_INDEX] = allowed[:, :, lbl.SPOT_INDEX]
+            u_spot, _ = _score(spot_only)
+            if not spot_to_spot or int(u_spot.sum()) < MIN_TYPES_FOR_SPOT_TO_SPOT:
+                non_spot = allowed.copy()
+                non_spot[:, :, lbl.SPOT_INDEX] = False
+                allowed = non_spot
+                usable, win_price = _score(allowed)
         if usable.any():
             t = int(np.where(usable, win_price, np.inf).argmin())
             zi_win, ci_win = np.unravel_index(

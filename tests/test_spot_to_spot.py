@@ -1,0 +1,136 @@
+"""SpotToSpotConsolidation gate (core parity): a running spot node is not
+replaced by another spot offering unless the gate is on AND at least 15
+cheaper instance types qualify — walking the fleet toward the top of the
+spot market just trades one interruption for the next."""
+
+import pytest
+
+from karpenter_provider_aws_tpu.models import Disruption, NodePool, Operator, Requirement
+from karpenter_provider_aws_tpu.models import labels as lbl
+from karpenter_provider_aws_tpu.models.nodeclaim import NodeClaim
+from karpenter_provider_aws_tpu.models.pod import make_pods
+from karpenter_provider_aws_tpu.ops.consolidate import (
+    MIN_TYPES_FOR_SPOT_TO_SPOT,
+    cheaper_replacement,
+    encode_cluster,
+)
+from karpenter_provider_aws_tpu.state.cluster import Node
+from karpenter_provider_aws_tpu.testenv import new_environment
+
+
+@pytest.fixture(scope="module")
+def env():
+    return new_environment()
+
+
+@pytest.fixture(autouse=True)
+def _reset(env):
+    env.reset()
+    yield
+
+
+def add_spot_node(env, name, it, zone="zone-a"):
+    claim = NodeClaim.fresh(
+        nodepool_name="default",
+        nodeclass_name="default",
+        instance_type_options=[it.name],
+        zone_options=[zone],
+        capacity_type_options=["spot"],
+    )
+    claim.status.provider_id = f"cloud:///{zone}/i-{name}"
+    claim.status.capacity = it.capacity()
+    claim.status.allocatable = env.catalog.allocatable(it)
+    claim.labels.update(it.labels())
+    claim.labels[lbl.TOPOLOGY_ZONE] = zone
+    claim.labels[lbl.CAPACITY_TYPE] = "spot"
+    claim.labels[lbl.NODEPOOL] = "default"
+    for cond in ("Launched", "Registered", "Initialized"):
+        claim.status.set_condition(cond, True)
+    env.cluster.apply(claim)
+    node = Node(
+        name=name,
+        provider_id=claim.status.provider_id,
+        nodepool_name="default",
+        nodeclaim_name=claim.name,
+        labels=dict(claim.labels),
+        capacity=claim.status.capacity,
+        allocatable=claim.status.allocatable,
+        ready=True,
+    )
+    node.labels[lbl.HOSTNAME] = name
+    claim.status.node_name = name
+    env.cluster.apply(node)
+    for p in make_pods(2, f"{name}-p", {"cpu": "1", "memory": "2Gi"}):
+        env.cluster.apply(p)
+        env.cluster.bind_pod(p.uid, name)
+    return node
+
+
+def priciest_16(env):
+    """Most expensive spot 16-vcpu c/m/r type — plenty of cheaper options."""
+    cands = [
+        t for t in env.catalog.list()
+        if t.category in ("c", "m", "r") and t.vcpus == 16
+    ]
+    return max(cands, key=lambda t: env.catalog.pricing.spot_price(t, "zone-a"))
+
+
+def wide_pool():
+    return NodePool(
+        name="default",
+        requirements=[Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m", "r"))],
+        disruption=Disruption(consolidate_after_s=60),
+    )
+
+
+class TestSpotToSpotGate:
+    def test_gate_off_never_offers_spot(self, env):
+        env.apply_defaults(wide_pool())
+        add_spot_node(env, "n-spot", priciest_16(env))
+        ct = encode_cluster(env.cluster, env.catalog)
+        out = cheaper_replacement(
+            ct, env.catalog,
+            nodepools=dict(env.cluster.nodepools),
+            spot_to_spot=False,
+        )
+        for _, _, _, offerings in out:
+            assert all(c != "spot" for _, c in offerings), offerings
+
+    def test_gate_on_with_wide_flexibility_offers_spot(self, env):
+        env.apply_defaults(wide_pool())
+        add_spot_node(env, "n-spot", priciest_16(env))
+        ct = encode_cluster(env.cluster, env.catalog)
+        out = cheaper_replacement(
+            ct, env.catalog,
+            nodepools=dict(env.cluster.nodepools),
+            spot_to_spot=True,
+        )
+        assert out, "expected a cheaper replacement for the priciest spot type"
+        # the full c/m/r catalog has >> 15 cheaper types: spot allowed
+        assert any(
+            c == "spot" for _, _, _, offerings in out for _, c in offerings
+        )
+
+    def test_gate_on_with_narrow_flexibility_stays_non_spot(self, env):
+        it = priciest_16(env)
+        # pool pinned to ONE instance type: 0 cheaper types < 15
+        pool = NodePool(
+            name="default",
+            requirements=[
+                Requirement(lbl.INSTANCE_TYPE_LABEL, Operator.IN, (it.name,))
+            ],
+            disruption=Disruption(consolidate_after_s=60),
+        )
+        env.apply_defaults(pool)
+        add_spot_node(env, "n-spot", it)
+        ct = encode_cluster(env.cluster, env.catalog)
+        out = cheaper_replacement(
+            ct, env.catalog,
+            nodepools=dict(env.cluster.nodepools),
+            spot_to_spot=True,
+        )
+        for _, _, _, offerings in out:
+            assert all(c != "spot" for _, c in offerings), offerings
+
+    def test_threshold_constant_matches_core(self):
+        assert MIN_TYPES_FOR_SPOT_TO_SPOT == 15
