@@ -66,19 +66,21 @@ class DisruptionController:
         self.disrupted: list[tuple[str, str]] = []  # (claim name, reason) log
 
     # -- budget accounting -------------------------------------------------
-    def _budget_left(self) -> dict[str, int]:
-        out: dict[str, int] = {}
-        for pool in self.cluster.nodepools.values():
-            claims = self.cluster.claims_for_nodepool(pool.name)
-            total = len(claims)
-            draining = sum(1 for c in claims if c.deleted)
-            out[pool.name] = max(pool.disruption.max_disruptions(total) - draining, 0)
-        return out
+    # reason-string prefix -> core DisruptionReason class (budget scoping)
+    _REASON_CLASS = {
+        "expired": "Expired",
+        "drifted": "Drifted",
+        "empty": "Empty",
+        "consolidatable": "Underutilized",
+    }
 
-    def _disrupt(self, claim, reason: str, budget: dict[str, int]) -> bool:
-        if budget.get(claim.nodepool_name, 0) <= 0:
+    def _budget_left(self) -> "_BudgetTracker":
+        return _BudgetTracker(self.cluster, self.clock.now())
+
+    def _disrupt(self, claim, reason: str, budget: "_BudgetTracker") -> bool:
+        rclass = self._REASON_CLASS.get(reason.split(":")[0], "")
+        if not budget.consume(claim.nodepool_name, rclass):
             return False
-        budget[claim.nodepool_name] -= 1
         from ..metrics import DISRUPTION_ACTIONS
 
         DISRUPTION_ACTIONS.inc(reason=reason.split(":")[0])
@@ -91,40 +93,54 @@ class DisruptionController:
     # -- reconcile ---------------------------------------------------------
     def reconcile(self) -> None:
         budget = self._budget_left()
-        self._reconcile_expiration(budget)
+        # one bulk pod view per pass (four methods consume it)
+        by_node = self.cluster.pods_by_node()
+        self._reconcile_expiration(budget, by_node)
         if self.drift_enabled:
-            self._reconcile_drift(budget)
-        self._reconcile_emptiness(budget)
+            self._reconcile_drift(budget, by_node)
+        self._reconcile_emptiness(budget, by_node)
         self._reconcile_consolidation(budget)
 
-    def _claims_with_nodes(self):
+    def _claims_with_nodes(self, pods_by_node=None):
+        if pods_by_node is None:
+            pods_by_node = self.cluster.pods_by_node()
         for claim in self.cluster.snapshot_claims():
             if claim.deleted or not claim.is_registered():
                 continue
             node = self.cluster.nodes.get(claim.status.node_name)
             if node is None or node.cordoned:
                 continue
+            # karpenter.sh/do-not-disrupt blocks EVERY voluntary disruption
+            # (expiration/drift/emptiness/consolidation): on the claim, the
+            # node, or any pod still running there
+            if (
+                claim.annotations.get(lbl.ANNOTATION_DO_NOT_DISRUPT) == "true"
+                or node.annotations.get(lbl.ANNOTATION_DO_NOT_DISRUPT) == "true"
+                or any(p.do_not_disrupt() for p in pods_by_node.get(node.name, ()))
+            ):
+                continue
             yield claim, node
 
-    def _reconcile_expiration(self, budget) -> None:
+    def _reconcile_expiration(self, budget, pods_by_node=None) -> None:
         now = self.clock.now()
-        for claim, node in self._claims_with_nodes():
+        for claim, node in self._claims_with_nodes(pods_by_node):
             pool = self.cluster.nodepools.get(claim.nodepool_name)
             if pool is None or pool.disruption.expire_after_s is None:
                 continue
             if now - claim.created_at >= pool.disruption.expire_after_s:
                 self._disrupt(claim, "expired", budget)
 
-    def _reconcile_drift(self, budget) -> None:
-        for claim, node in self._claims_with_nodes():
+    def _reconcile_drift(self, budget, pods_by_node=None) -> None:
+        for claim, node in self._claims_with_nodes(pods_by_node):
             reason = self.cloudprovider.is_drifted(claim)
             if reason != DriftReason.NONE:
                 self._disrupt(claim, f"drifted:{reason.value}", budget)
 
-    def _reconcile_emptiness(self, budget) -> None:
+    def _reconcile_emptiness(self, budget, pods_by_node=None) -> None:
         now = self.clock.now()
-        pods_by_node = self.cluster.pods_by_node()
-        for claim, node in self._claims_with_nodes():
+        if pods_by_node is None:
+            pods_by_node = self.cluster.pods_by_node()
+        for claim, node in self._claims_with_nodes(pods_by_node):
             pool = self.cluster.nodepools.get(claim.nodepool_name)
             if pool is None:
                 continue
@@ -173,6 +189,10 @@ class DisruptionController:
                     and now - max(node.created_at, node.last_pod_event) >= after
                     and claim is not None
                     and not claim.deleted
+                    # claim/node-level do-not-disrupt (pod-level rides in
+                    # ct.blocked already)
+                    and claim.annotations.get(lbl.ANNOTATION_DO_NOT_DISRUPT) != "true"
+                    and node.annotations.get(lbl.ANNOTATION_DO_NOT_DISRUPT) != "true"
                 ):
                     result = claim
             _eligible_cache[ni] = result
@@ -234,7 +254,7 @@ class DisruptionController:
             claim = eligible(int(ni))
             if claim is None:
                 continue
-            if budget.get(claim.nodepool_name, 0) <= 0:
+            if budget.left(claim.nodepool_name, "Underutilized") <= 0:
                 continue
             replacement = self._launch_replacement(claim, type_name, offering_options)
             if replacement is None:
@@ -267,7 +287,10 @@ class DisruptionController:
         for ni in candidates:
             by_pool.setdefault(ct.nodepool_names[ni], []).append(ni)
         for pool_name, cand in by_pool.items():
-            top = min(len(cand), self.MAX_REPLACE_SET, budget.get(pool_name, 0))
+            top = min(
+                len(cand), self.MAX_REPLACE_SET,
+                budget.left(pool_name, "Underutilized"),
+            )
             for m in range(top, 1, -1):
                 subset = cand[:m]
                 free_over = repack_set_feasible(ct, subset, allow_overflow=True)
@@ -358,3 +381,54 @@ class DisruptionController:
         )
         return launch_claim(self.cluster, self.cloudprovider, pool, spec,
                             recorder=self.recorder)
+
+
+class _BudgetTracker:
+    """Per-(pool, reason-class) disruption allowance for ONE pass.
+
+    Caps come from the pool's budgets that APPLY to the reason class at pass
+    time (reason scoping + cron-window schedules, models/nodepool.py Budget);
+    already-draining claims count against every class, as do disruptions
+    committed earlier in this pass (a drained node is a drained node,
+    whatever the reason)."""
+
+    def __init__(self, cluster, now: float):
+        self.cluster = cluster
+        self.now = now
+        self._used: dict[str, int] = {}
+        self._base: dict[tuple[str, str], int] = {}
+        # Snapshot totals/draining at PASS START: caps are computed lazily
+        # per reason class, and a claim this pass already disrupted (which
+        # _used counts) must not also count as "draining" — that would
+        # double-subtract and starve later reason classes.
+        self._totals: dict[str, int] = {}
+        self._draining: dict[str, int] = {}
+        for c in cluster.snapshot_claims():
+            self._totals[c.nodepool_name] = self._totals.get(c.nodepool_name, 0) + 1
+            if c.deleted:
+                self._draining[c.nodepool_name] = (
+                    self._draining.get(c.nodepool_name, 0) + 1
+                )
+
+    def _cap(self, pool_name: str, rclass: str) -> int:
+        key = (pool_name, rclass)
+        if key not in self._base:
+            pool = self.cluster.nodepools.get(pool_name)
+            cap = (
+                pool.disruption.max_disruptions(
+                    self._totals.get(pool_name, 0), rclass, self.now
+                )
+                if pool is not None
+                else 0
+            )
+            self._base[key] = max(cap - self._draining.get(pool_name, 0), 0)
+        return self._base[key]
+
+    def left(self, pool_name: str, rclass: str) -> int:
+        return max(self._cap(pool_name, rclass) - self._used.get(pool_name, 0), 0)
+
+    def consume(self, pool_name: str, rclass: str) -> bool:
+        if self.left(pool_name, rclass) <= 0:
+            return False
+        self._used[pool_name] = self._used.get(pool_name, 0) + 1
+        return True

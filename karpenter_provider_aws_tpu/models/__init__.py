@@ -13,7 +13,7 @@ from .requirements import (  # noqa: F401
 )
 from .resources import ResourceVector, RESOURCE_AXES  # noqa: F401
 from .pod import Pod, Toleration, TopologySpreadConstraint  # noqa: F401
-from .nodepool import NodePool, Taint, Disruption, Limits  # noqa: F401
+from .nodepool import Budget, Disruption, Limits, NodePool, Taint  # noqa: F401
 from .nodeclass import NodeClass, SelectorTerm, BlockDevice, MetadataOptions  # noqa: F401
 from .nodeclaim import NodeClaim, NodeClaimStatus, Condition  # noqa: F401
 from . import labels  # noqa: F401

@@ -43,6 +43,50 @@ class Limits:
         return bool((in_use.v[mask] > self.resources.v[mask]).any())
 
 
+# Budget reason classes (core DisruptionReason vocabulary).
+DISRUPTION_REASONS = ("Underutilized", "Empty", "Drifted", "Expired")
+
+
+@dataclass
+class Budget:
+    """One disruption budget (core NodePool.spec.disruption.budgets entry):
+    a node cap, optionally scoped to reasons and/or a cron-scheduled window.
+
+    ``nodes`` is "N" or "P%". ``reasons`` empty = every reason. ``schedule``
+    (5-field cron, UTC) + ``duration_s`` restrict the budget to
+    [match, match+duration) windows — outside them the budget does not
+    apply at all (core semantics: a schedule-gated "0" budget blocks
+    disruption only during its window)."""
+
+    nodes: str = "10%"
+    reasons: tuple[str, ...] = ()
+    schedule: Optional[str] = None
+    duration_s: Optional[float] = None
+
+    def applies(self, reason: str, now: Optional[float]) -> bool:
+        if self.reasons and reason not in self.reasons:
+            return False
+        if self.schedule is not None:
+            if now is None:
+                return True  # no clock: be conservative, apply
+            from ..utils.cron import CronSchedule
+
+            return CronSchedule(self.schedule).active_within(
+                now, self.duration_s or 60.0
+            )
+        return True
+
+    def cap(self, total_nodes: int) -> int:
+        import math
+
+        if self.nodes.endswith("%"):
+            # percentages round UP (k8s GetScaledValueFromIntOrPercent
+            # semantics as used by karpenter budgets): "10%" of 3 nodes
+            # allows 1 disruption, not 0
+            return math.ceil(total_nodes * float(self.nodes[:-1]) / 100.0)
+        return int(self.nodes)
+
+
 @dataclass
 class Disruption:
     """NodePool.spec.disruption (core): consolidation + expiration policy."""
@@ -50,22 +94,24 @@ class Disruption:
     consolidation_policy: str = "WhenUnderutilized"  # or WhenEmpty
     consolidate_after_s: Optional[float] = 0.0  # None = Never
     expire_after_s: Optional[float] = None  # None = Never
-    # disruption budgets: max share of nodes disruptable at once ("20%" or "5")
-    budgets: list[str] = field(default_factory=lambda: ["10%"])
+    # disruption budgets: plain "20%"/"5" strings (apply always, to every
+    # reason) or Budget objects with reasons/schedule scoping
+    budgets: list = field(default_factory=lambda: ["10%"])
 
-    def max_disruptions(self, total_nodes: int) -> int:
-        import math
+    def _budget_objs(self) -> list[Budget]:
+        return [b if isinstance(b, Budget) else Budget(nodes=b) for b in self.budgets]
 
+    def max_disruptions(
+        self, total_nodes: int, reason: str = "", now: Optional[float] = None
+    ) -> int:
+        """Disruptable-node cap for ``reason`` at ``now``: the minimum over
+        every budget that applies (reason in scope, schedule window active).
+        No applicable budget = no cap beyond the node count."""
         allowed = total_nodes
-        for b in self.budgets:
-            if b.endswith("%"):
-                # percentages round UP (k8s GetScaledValueFromIntOrPercent
-                # semantics as used by karpenter budgets): "10%" of 3 nodes
-                # allows 1 disruption, not 0
-                v = math.ceil(total_nodes * float(b[:-1]) / 100.0)
-            else:
-                v = int(b)
-            allowed = min(allowed, v)
+        for b in self._budget_objs():
+            if not b.applies(reason, now):
+                continue
+            allowed = min(allowed, b.cap(total_nodes))
         return max(allowed, 0)
 
 

@@ -126,13 +126,29 @@ def validate_nodepool(pool: NodePool) -> None:
         v.append("consolidateAfter must be >= 0")
     if d.expire_after_s is not None and d.expire_after_s <= 0:
         v.append("expireAfter must be positive")
+    from ..models.nodepool import DISRUPTION_REASONS, Budget
+
     for b in d.budgets:
+        nodes = b.nodes if isinstance(b, Budget) else b
         try:
-            val = float(b[:-1]) if b.endswith("%") else int(b)
+            val = float(nodes[:-1]) if nodes.endswith("%") else int(nodes)
             if val < 0:
-                v.append(f"budget {b!r} must be >= 0")
-        except ValueError:
-            v.append(f"malformed budget {b!r}")
+                v.append(f"budget {nodes!r} must be >= 0")
+        except (ValueError, AttributeError):
+            v.append(f"malformed budget {nodes!r}")
+        if isinstance(b, Budget):
+            for r in b.reasons:
+                if r not in DISRUPTION_REASONS:
+                    v.append(f"budget reason {r!r} not in {DISRUPTION_REASONS}")
+            if b.schedule is not None:
+                from ..utils.cron import CronSchedule
+
+                try:
+                    CronSchedule(b.schedule)
+                except ValueError as e:
+                    v.append(f"budget schedule: {e}")
+                if not b.duration_s or b.duration_s <= 0:
+                    v.append("budget schedule requires a positive duration")
     if not pool.nodeclass_name:
         v.append("nodeClassRef is required")
     if v:

@@ -1,0 +1,74 @@
+"""Minimal 5-field cron matching for disruption-budget schedules.
+
+Parity: core NodePool disruption budgets carry ``schedule`` (standard cron)
++ ``duration`` — the budget applies only inside [match, match+duration)
+windows (exercised by the reference's scale/expiration budget suites).
+Supports ``*``, ``*/n``, ``a``, ``a-b``, ``a-b/n`` and comma lists per
+field: minute hour day-of-month month day-of-week (0=Sunday, like cron).
+"""
+
+from __future__ import annotations
+
+import time as _time
+
+_FIELD_RANGES = ((0, 59), (0, 23), (1, 31), (1, 12), (0, 6))
+
+
+def _parse_field(spec: str, lo: int, hi: int) -> frozenset[int]:
+    out: set[int] = set()
+    for part in spec.split(","):
+        step = 1
+        if "/" in part:
+            part, step_s = part.split("/", 1)
+            step = int(step_s)
+        if part == "*":
+            a, b = lo, hi
+        elif "-" in part:
+            a_s, b_s = part.split("-", 1)
+            a, b = int(a_s), int(b_s)
+        else:
+            a = b = int(part)
+        if not (lo <= a <= hi and lo <= b <= hi and a <= b and step >= 1):
+            raise ValueError(f"bad cron field {spec!r}")
+        out.update(range(a, b + 1, step))
+    return frozenset(out)
+
+
+class CronSchedule:
+    def __init__(self, expr: str):
+        fields = expr.split()
+        if len(fields) != 5:
+            raise ValueError(f"cron needs 5 fields, got {expr!r}")
+        self.fields = [
+            _parse_field(f, lo, hi) for f, (lo, hi) in zip(fields, _FIELD_RANGES)
+        ]
+
+    def matches(self, ts: float) -> bool:
+        """Does the minute containing unix-time ``ts`` match (UTC)?"""
+        t = _time.gmtime(ts)
+        mi, h, dom, mo = t.tm_min, t.tm_hour, t.tm_mday, t.tm_mon
+        dow = (t.tm_wday + 1) % 7  # tm_wday: Monday=0; cron: Sunday=0
+        return (
+            mi in self.fields[0]
+            and h in self.fields[1]
+            and dom in self.fields[2]
+            and mo in self.fields[3]
+            and dow in self.fields[4]
+        )
+
+    def active_within(self, now: float, duration_s: float) -> bool:
+        """True iff ``now`` falls inside a [match, match+duration) window,
+        i.e. some match-minute start m satisfies now - duration < m <= now.
+        Scans match minutes backward (bounded at 7 days — budget windows
+        are hours-to-a-weekend in practice, and the scan is ~10k cheap
+        integer checks at that extreme)."""
+        duration_s = min(duration_s, 7 * 24 * 3600.0)
+        start_minute = int(now // 60)
+        k = 0
+        while True:
+            m_start = (start_minute - k) * 60
+            if m_start <= now - duration_s:
+                return False
+            if self.matches(m_start):
+                return True
+            k += 1

@@ -1,0 +1,132 @@
+"""Disruption budgets with reasons + cron-scheduled windows, and the
+do-not-disrupt annotation blocking every voluntary disruption (core
+NodePool.spec.disruption.budgets parity; exercised upstream by the scale
+and expiration budget suites)."""
+
+import pytest
+
+from karpenter_provider_aws_tpu.models import Budget, Disruption, NodePool, Operator, Requirement
+from karpenter_provider_aws_tpu.models import labels as lbl
+from karpenter_provider_aws_tpu.models.pod import make_pods
+from karpenter_provider_aws_tpu.testenv import new_environment
+from karpenter_provider_aws_tpu.utils.cron import CronSchedule
+
+
+@pytest.fixture(scope="module")
+def env():
+    return new_environment()
+
+
+@pytest.fixture(autouse=True)
+def _reset(env):
+    env.reset()
+    yield
+
+
+def pool_with(**kw):
+    return NodePool(
+        name="default",
+        requirements=[Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m"))],
+        disruption=Disruption(**kw),
+    )
+
+
+def provision(env, pods):
+    for p in pods:
+        env.cluster.apply(p)
+    env.step(3)
+    assert not env.cluster.pending_pods()
+
+
+class TestCron:
+    def test_basic_fields(self):
+        s = CronSchedule("30 2 * * *")
+        assert s.matches(2 * 3600 + 30 * 60)        # 1970-01-01 02:30 UTC
+        assert not s.matches(3 * 3600)
+
+    def test_ranges_steps_lists(self):
+        s = CronSchedule("*/15 8-17 * * 1-5")
+        # 1970-01-01 was a Thursday (cron dow 4)
+        assert s.matches(9 * 3600 + 45 * 60)
+        assert not s.matches(7 * 3600)              # before 08:00
+        # Saturday Jan 3 1970, 09:45
+        assert not s.matches(2 * 86400 + 9 * 3600 + 45 * 60)
+
+    def test_active_within_window(self):
+        s = CronSchedule("0 2 * * *")               # daily 02:00, UTC
+        assert s.active_within(2 * 3600 + 30 * 60, 3600)      # 02:30, 1h window
+        assert not s.active_within(3 * 3600 + 30 * 60, 3600)  # 03:30
+
+    def test_bad_exprs(self):
+        for expr in ("* * * *", "61 * * * *", "a * * * *"):
+            with pytest.raises(ValueError):
+                CronSchedule(expr)
+
+
+class TestReasonScopedBudgets:
+    def test_zero_budget_blocks_only_its_reason(self, env):
+        env.apply_defaults(pool_with(
+            expire_after_s=60,
+            consolidate_after_s=10,
+            budgets=[Budget(nodes="0", reasons=("Expired",)), "100%"],
+        ))
+        provision(env, make_pods(4, "w", {"cpu": "1", "memory": "2Gi"}))
+        # everything expires AND empties (pods removed) — only the empty
+        # reason may act
+        for p in list(env.cluster.pods.values()):
+            env.cluster.delete(p)
+        env.clock.advance(61)
+        env.disruption.reconcile()
+        reasons = {r for _, r in env.disruption.disrupted}
+        assert reasons and all(r == "empty" for r in reasons), reasons
+
+    def test_schedule_gated_blocking_budget(self, env):
+        """A '0 nodes' budget scheduled 02:00-03:00 UTC blocks expiration
+        only inside its window (upstream: 'should not allow expiration if
+        the budget is fully blocking during a scheduled time')."""
+        env.apply_defaults(pool_with(
+            expire_after_s=60,
+            consolidate_after_s=None,
+            budgets=[
+                Budget(nodes="0", schedule="0 2 * * *", duration_s=3600),
+                "100%",
+            ],
+        ))
+        provision(env, make_pods(2, "w", {"cpu": "1", "memory": "2Gi"}))
+        # FakeClock starts at epoch (00:00 UTC); jump inside the window
+        env.clock.advance(2 * 3600 + 20 * 60)       # 02:20, claims long expired
+        env.disruption.reconcile()
+        assert not env.disruption.disrupted
+        env.clock.advance(3600)                     # 03:20: window closed
+        env.disruption.reconcile()
+        assert env.disruption.disrupted
+
+
+class TestDoNotDisrupt:
+    def test_pod_annotation_blocks_expiration(self, env):
+        env.apply_defaults(pool_with(expire_after_s=60, consolidate_after_s=None,
+                                     budgets=["100%"]))
+        pods = make_pods(
+            2, "pin", {"cpu": "1", "memory": "2Gi"},
+            annotations={lbl.ANNOTATION_DO_NOT_DISRUPT: "true"},
+        )
+        provision(env, pods)
+        env.clock.advance(61)
+        env.disruption.reconcile()
+        assert not env.disruption.disrupted
+        # pods end: blocking ends with them
+        for p in list(env.cluster.pods.values()):
+            env.cluster.delete(p)
+        env.disruption.reconcile()
+        assert env.disruption.disrupted
+
+    def test_claim_annotation_blocks_consolidation(self, env):
+        env.apply_defaults(pool_with(consolidate_after_s=10, budgets=["100%"]))
+        provision(env, make_pods(6, "w", {"cpu": "1", "memory": "2Gi"}))
+        for claim in env.cluster.nodeclaims.values():
+            claim.annotations[lbl.ANNOTATION_DO_NOT_DISRUPT] = "true"
+        for p in list(env.cluster.pods.values())[2:]:
+            env.cluster.delete(p)
+        env.clock.advance(61)
+        env.disruption.reconcile()
+        assert not env.disruption.disrupted
