@@ -2,9 +2,16 @@
 
 Owns what the reference consumes from the core termination controller
 (SURVEY.md section 2.2 lifecycle): when a claim is deleted — by disruption,
-interruption, or the user — cordon its node, evict (unbind) its pods so
-they re-enter the scheduling pipeline, terminate the cloud instance, then
-remove the node and the finalizer.
+interruption, or the user — cordon its node, evict its pods so they
+re-enter the scheduling pipeline, terminate the cloud instance, then remove
+the node and the finalizer.
+
+Eviction goes through PodDisruptionBudget accounting (the core drains via
+the eviction API, which enforces PDBs): a pod whose eviction would push a
+covered workload below its budget stays bound, the claim keeps its
+finalizer, and the drain retries next pass — by then replacements evicted
+earlier have typically rescheduled and gone Running elsewhere, freeing more
+budget (a rolling drain).
 """
 
 from __future__ import annotations
@@ -22,6 +29,29 @@ class TerminationController:
         self.cluster = cluster
         self.cloudprovider = cloudprovider
 
+    def _evict(self, node) -> bool:
+        """Evict what the PDBs allow; True when the node is fully drained.
+        Budget headroom is computed once per pass and decremented per
+        eviction, so one pass can never overshoot a budget even when
+        several of its pods share the node."""
+        pods = self.cluster.pods_on_node(node.name)
+        if not pods:
+            return True
+        pdbs = list(self.cluster.pdbs.values())
+        all_pods = list(self.cluster.pods.values())
+        headroom = {p.name: p.disruptions_allowed(all_pods) for p in pdbs}
+        drained = True
+        for pod in pods:
+            covering = [p for p in pdbs if p.matches(pod)]
+            if any(headroom[p.name] <= 0 for p in covering):
+                drained = False  # blocked by a budget; retry next pass
+                continue
+            for p in covering:
+                headroom[p.name] -= 1
+            pod.node_name = ""
+            pod.phase = "Pending"
+        return drained
+
     def reconcile(self) -> None:
         for claim in self.cluster.snapshot_claims():
             if not claim.deleted:
@@ -29,9 +59,8 @@ class TerminationController:
             node = self.cluster.nodes.get(claim.status.node_name)
             if node is not None:
                 node.cordoned = True
-                for pod in self.cluster.pods_on_node(node.name):
-                    pod.node_name = ""
-                    pod.phase = "Pending"
+                if not self._evict(node):
+                    continue  # drain incomplete: keep claim + instance
             if claim.status.provider_id:
                 try:
                     self.cloudprovider.delete(claim)

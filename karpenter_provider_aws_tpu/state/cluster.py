@@ -10,6 +10,7 @@ from ..models import labels as lbl
 from ..models.nodeclaim import NodeClaim
 from ..models.nodeclass import NodeClass
 from ..models.nodepool import NodePool
+from ..models.pdb import PodDisruptionBudget
 from ..models.pod import Pod
 from ..models.resources import ResourceVector
 
@@ -56,6 +57,7 @@ class Cluster:
         self.nodeclaims: dict[str, NodeClaim] = {}
         self.nodes: dict[str, Node] = {}
         self.pods: dict[str, Pod] = {}
+        self.pdbs: dict[str, PodDisruptionBudget] = {}
         # Control-plane version surfaced to the version provider (parity:
         # the discovery client behind version.go; fakes set this directly).
         self.server_version: str = "1.29"
@@ -86,6 +88,8 @@ class Cluster:
                 self.nodes[obj.name] = obj
             elif isinstance(obj, Pod):
                 self.pods[obj.uid] = obj
+            elif isinstance(obj, PodDisruptionBudget):
+                self.pdbs[obj.name] = obj
             else:
                 raise TypeError(f"unknown object {type(obj)}")
 
@@ -115,6 +119,8 @@ class Cluster:
                 node = self.nodes.get(obj.node_name)
                 if node is not None:
                     node.last_pod_event = max(node.last_pod_event, self._now())
+            elif isinstance(obj, PodDisruptionBudget):
+                self.pdbs.pop(obj.name, None)
             else:
                 raise TypeError(f"unknown object {type(obj)}")
 

@@ -18,7 +18,8 @@ def _parse_field(spec: str, lo: int, hi: int) -> frozenset[int]:
     out: set[int] = set()
     for part in spec.split(","):
         step = 1
-        if "/" in part:
+        stepped = "/" in part
+        if stepped:
             part, step_s = part.split("/", 1)
             step = int(step_s)
         if part == "*":
@@ -27,7 +28,10 @@ def _parse_field(spec: str, lo: int, hi: int) -> frozenset[int]:
             a_s, b_s = part.split("-", 1)
             a, b = int(a_s), int(b_s)
         else:
-            a = b = int(part)
+            a = int(part)
+            # "n/step" means n..max/step (robfig/cron, which karpenter's
+            # core budget schedules use), not the single value n
+            b = hi if stepped else a
         if not (lo <= a <= hi and lo <= b <= hi and a <= b and step >= 1):
             raise ValueError(f"bad cron field {spec!r}")
         out.update(range(a, b + 1, step))
@@ -42,19 +46,23 @@ class CronSchedule:
         self.fields = [
             _parse_field(f, lo, hi) for f, (lo, hi) in zip(fields, _FIELD_RANGES)
         ]
+        # standard cron: when BOTH day fields are restricted (not "*"),
+        # day-of-month and day-of-week are ORed, not ANDed
+        self._dom_restricted = fields[2] != "*"
+        self._dow_restricted = fields[4] != "*"
 
     def matches(self, ts: float) -> bool:
         """Does the minute containing unix-time ``ts`` match (UTC)?"""
         t = _time.gmtime(ts)
         mi, h, dom, mo = t.tm_min, t.tm_hour, t.tm_mday, t.tm_mon
         dow = (t.tm_wday + 1) % 7  # tm_wday: Monday=0; cron: Sunday=0
-        return (
-            mi in self.fields[0]
-            and h in self.fields[1]
-            and dom in self.fields[2]
-            and mo in self.fields[3]
-            and dow in self.fields[4]
-        )
+        if not (mi in self.fields[0] and h in self.fields[1] and mo in self.fields[3]):
+            return False
+        dom_ok = dom in self.fields[2]
+        dow_ok = dow in self.fields[4]
+        if self._dom_restricted and self._dow_restricted:
+            return dom_ok or dow_ok
+        return dom_ok and dow_ok
 
     def active_within(self, now: float, duration_s: float) -> bool:
         """True iff ``now`` falls inside a [match, match+duration) window,

@@ -62,6 +62,27 @@ class TestCron:
             with pytest.raises(ValueError):
                 CronSchedule(expr)
 
+    def test_step_without_range_extends_to_max(self):
+        # robfig/cron semantics: "8/2" = 8,10,12..22 (not just 8)
+        s = CronSchedule("0 8/2 * * *")
+        assert s.matches(10 * 3600)
+        assert s.matches(22 * 3600)
+        assert not s.matches(9 * 3600)
+
+    def test_dom_dow_both_restricted_are_ored(self):
+        # standard cron: '0 2 15 * 4' fires on the 15th OR on Thursdays
+        s = CronSchedule("0 2 15 * 4")
+        # 1970-01-01 (the 1st) was a Thursday: dow matches, dom doesn't
+        assert s.matches(2 * 3600)
+        # 1970-01-15 02:00 (a Thursday too, but check a non-Thursday 15th:
+        # 1970-03-15 was a Sunday) — dom matches, dow doesn't
+        import calendar
+
+        ts = calendar.timegm((1970, 3, 15, 2, 0, 0))
+        assert s.matches(ts)
+        # 1970-01-02 (Friday the 2nd): neither
+        assert not s.matches(86400 + 2 * 3600)
+
 
 class TestReasonScopedBudgets:
     def test_zero_budget_blocks_only_its_reason(self, env):

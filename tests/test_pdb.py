@@ -1,0 +1,117 @@
+"""PodDisruptionBudget-aware draining (core parity: the termination
+controller drains via the eviction API, which enforces PDBs — disruption
+rolls through covered workloads instead of taking them down at once)."""
+
+import pytest
+
+from karpenter_provider_aws_tpu.models import Disruption, NodePool, Operator, Requirement
+from karpenter_provider_aws_tpu.models import labels as lbl
+from karpenter_provider_aws_tpu.models.pdb import PodDisruptionBudget
+from karpenter_provider_aws_tpu.models.pod import make_pods
+from karpenter_provider_aws_tpu.testenv import new_environment
+
+
+@pytest.fixture(scope="module")
+def env():
+    return new_environment()
+
+
+@pytest.fixture(autouse=True)
+def _reset(env):
+    env.reset()
+    yield
+
+
+def cmr_pool():
+    return NodePool(
+        name="default",
+        requirements=[Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m"))],
+        disruption=Disruption(consolidate_after_s=None),
+    )
+
+
+class TestDisruptionsAllowed:
+    def _pods(self, n_running, n_pending=0):
+        pods = make_pods(n_running + n_pending, "w", {"cpu": "1"}, labels={"app": "web"})
+        for p in pods[:n_running]:
+            p.node_name = "n1"
+            p.phase = "Running"
+        return pods
+
+    def test_min_available_int(self):
+        pdb = PodDisruptionBudget(name="pdb", selector={"app": "web"}, min_available=3)
+        assert pdb.disruptions_allowed(self._pods(5)) == 2
+        assert pdb.disruptions_allowed(self._pods(3)) == 0
+
+    def test_min_available_percent_rounds_up(self):
+        pdb = PodDisruptionBudget(name="pdb", selector={"app": "web"}, min_available="50%")
+        # 5 pods: need ceil(2.5) = 3 -> 2 allowed
+        assert pdb.disruptions_allowed(self._pods(5)) == 2
+
+    def test_max_unavailable(self):
+        pdb = PodDisruptionBudget(name="pdb", selector={"app": "web"}, max_unavailable=1)
+        assert pdb.disruptions_allowed(self._pods(4)) == 1
+        # one already pending (unavailable): no more allowed
+        assert pdb.disruptions_allowed(self._pods(3, n_pending=1)) == 0
+
+    def test_selector_scoping(self):
+        pdb = PodDisruptionBudget(name="pdb", selector={"app": "db"}, min_available=1)
+        others = self._pods(4)  # app=web: not covered
+        assert all(not pdb.matches(p) for p in others)
+
+
+class TestRollingDrain:
+    def test_drain_respects_min_available(self, env):
+        """6 covered pods, minAvailable=4: terminating their node evicts at
+        most 2 per pass; the drain completes only as replacements go
+        Running elsewhere, and coverage never drops below the budget."""
+        env.apply_defaults(cmr_pool())
+        pods = make_pods(
+            6, "web", {"cpu": "1", "memory": "2Gi"}, labels={"app": "web"}
+        )
+        for p in pods:
+            env.cluster.apply(p)
+        env.step(3)
+        assert not env.cluster.pending_pods()
+        env.cluster.apply(
+            PodDisruptionBudget(name="web-pdb", selector={"app": "web"},
+                                min_available=4)
+        )
+        # delete every claim: worst case, the whole fleet drains at once
+        for claim in list(env.cluster.nodeclaims.values()):
+            env.cluster.delete(claim)
+        for _ in range(12):
+            running = sum(
+                1 for p in env.cluster.pods.values()
+                if p.node_name and p.phase == "Running"
+            )
+            assert running >= 4, f"budget violated: {running} running"
+            env.step(1)
+        # eventually everything reschedules onto replacement nodes
+        assert not env.cluster.pending_pods()
+        assert sum(
+            1 for p in env.cluster.pods.values() if p.phase == "Running"
+        ) == 6
+
+    def test_fully_blocking_pdb_holds_finalizer(self, env):
+        env.apply_defaults(cmr_pool())
+        pods = make_pods(2, "db", {"cpu": "1", "memory": "2Gi"}, labels={"app": "db"})
+        for p in pods:
+            env.cluster.apply(p)
+        env.step(3)
+        env.cluster.apply(
+            PodDisruptionBudget(name="db-pdb", selector={"app": "db"},
+                                min_available=2)
+        )
+        claims = [c for c in env.cluster.nodeclaims.values()]
+        for c in claims:
+            env.cluster.delete(c)
+        env.step(3)
+        # pods untouched; claims still draining (finalizer held)
+        assert all(p.phase == "Running" for p in env.cluster.pods.values())
+        held = [c for c in env.cluster.nodeclaims.values() if c.deleted]
+        assert held, "fully-blocked drain must hold the claim finalizer"
+        # budget released -> drain completes
+        env.cluster.delete(env.cluster.pdbs["db-pdb"])
+        env.step(4)
+        assert not any(c.deleted for c in env.cluster.nodeclaims.values())
