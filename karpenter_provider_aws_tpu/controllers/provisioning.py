@@ -188,6 +188,8 @@ def launch_claim(cluster: Cluster, cloudprovider: CloudProvider, pool, spec: Nod
     # template-hash stamp: a later pool edit drifts this claim (core
     # NodePool static-drift analogue)
     claim.annotations[lbl.ANNOTATION_NODEPOOL_HASH] = pool.hash()
+    # grace snapshot: the termination deadline must survive pool edits
+    claim.termination_grace_period_s = pool.termination_grace_period_s
     cluster.apply(claim)
     from ..events import WARNING, default_recorder
 

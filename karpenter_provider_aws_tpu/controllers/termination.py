@@ -25,11 +25,29 @@ class TerminationController:
     name = "termination"
     interval_s = 2.0
 
-    def __init__(self, cluster: Cluster, cloudprovider: CloudProvider):
+    def __init__(self, cluster: Cluster, cloudprovider: CloudProvider, clock=None):
+        from ..utils.clock import RealClock
+
         self.cluster = cluster
         self.cloudprovider = cloudprovider
+        self.clock = clock or RealClock()
 
-    def _evict(self, node) -> bool:
+    def _past_grace(self, claim) -> bool:
+        """terminationGracePeriod (core): once a claim has been Deleting
+        longer than its grace period, the drain force-completes — PDBs and
+        do-not-disrupt stop holding the node. The period was snapshotted
+        onto the claim at launch (a pool edit/delete mid-drain must not
+        move or disable the deadline); pre-snapshot claims fall back to
+        the live pool."""
+        grace = claim.termination_grace_period_s
+        if grace is None:
+            pool = self.cluster.nodepools.get(claim.nodepool_name)
+            grace = pool.termination_grace_period_s if pool is not None else None
+        if grace is None:
+            return False
+        return self.clock.now() - claim.deleted_at >= grace
+
+    def _evict(self, node, force: bool = False) -> bool:
         """Evict what the PDBs allow; True when the node is fully drained.
         Budget headroom is computed once per pass and decremented per
         eviction, so one pass can never overshoot a budget even when
@@ -42,7 +60,13 @@ class TerminationController:
         headroom = {p.name: p.disruptions_allowed(all_pods) for p in pdbs}
         drained = True
         for pod in pods:
-            covering = [p for p in pdbs if p.matches(pod)]
+            if not force and pod.do_not_disrupt():
+                # do-not-disrupt holds the drain too (interruption/user
+                # deletes bypass the disruption controller's filter), until
+                # the grace deadline force-completes it
+                drained = False
+                continue
+            covering = [] if force else [p for p in pdbs if p.matches(pod)]
             if any(headroom[p.name] <= 0 for p in covering):
                 drained = False  # blocked by a budget; retry next pass
                 continue
@@ -59,7 +83,7 @@ class TerminationController:
             node = self.cluster.nodes.get(claim.status.node_name)
             if node is not None:
                 node.cordoned = True
-                if not self._evict(node):
+                if not self._evict(node, force=self._past_grace(claim)):
                     continue  # drain incomplete: keep claim + instance
             if claim.status.provider_id:
                 try:

@@ -51,6 +51,10 @@ class NodeClaim:
     startup_taints: list = field(default_factory=list)
     created_at: float = 0.0
     deleted: bool = False
+    deleted_at: float = 0.0  # clock time of the delete mark (grace periods)
+    # snapshotted from the pool at launch (core copies it onto the claim):
+    # the deadline must survive the pool being edited/deleted mid-drain
+    termination_grace_period_s: "Optional[float]" = None
     finalizers: set[str] = field(default_factory=set)
     status: NodeClaimStatus = field(default_factory=NodeClaimStatus)
     # Solver hints: candidate instance-type names ranked by the solve, passed

@@ -127,6 +127,10 @@ class NodePool:
     limits: Limits = field(default_factory=Limits)
     disruption: Disruption = field(default_factory=Disruption)
     weight: int = 0  # higher = preferred, like core NodePool.spec.weight
+    # terminationGracePeriod (core): after this long in Deleting, the drain
+    # force-completes — blocking PDBs and do-not-disrupt pods no longer
+    # hold the node. None = wait forever.
+    termination_grace_period_s: Optional[float] = None
     # Kubelet knobs templated onto every node of this pool (parity: the
     # v1beta1 NodePool.spec.template.spec.kubelet block).
     kubelet: "Optional[KubeletConfiguration]" = None

@@ -8,7 +8,6 @@ until replacements are Ready elsewhere.
 
 from __future__ import annotations
 
-import math
 from dataclasses import dataclass, field
 from typing import Mapping, Optional, Union
 
@@ -16,10 +15,14 @@ from typing import Mapping, Optional, Union
 def _resolve(value: Union[int, str], total: int, round_up: bool) -> int:
     """K8s intstr semantics: minAvailable percentages round UP,
     maxUnavailable percentages round DOWN (the conservative direction for
-    each field — the caller states which)."""
+    each field — the caller states which). Integer math, like
+    GetScaledValueFromIntOrPercent — float rounding diverges at exact
+    boundaries (ceil(50*0.14) = 8, but k8s' ceil(14*50/100) = 7)."""
     if isinstance(value, str) and value.endswith("%"):
-        pct = float(value[:-1]) / 100.0
-        return math.ceil(total * pct) if round_up else math.floor(total * pct)
+        pct = int(float(value[:-1]))
+        if round_up:
+            return (pct * total + 99) // 100
+        return pct * total // 100
     return int(value)
 
 

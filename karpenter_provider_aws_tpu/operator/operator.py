@@ -172,7 +172,7 @@ def new_operator(
     )
     scheduling = SchedulingController(cluster, provisioning, clock=clock)
     registration = RegistrationController(cluster, provisioning, clock=clock)
-    termination = TerminationController(cluster, cloudprovider)
+    termination = TerminationController(cluster, cloudprovider, clock=clock)
     disruption = DisruptionController(
         cluster,
         cloudprovider,

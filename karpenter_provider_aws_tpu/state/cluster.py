@@ -107,6 +107,8 @@ class Cluster:
                     # mark-only: membership and provider-id bindings are
                     # unchanged, so claim indexes stay valid (they read the
                     # live `deleted` flag off the shared object)
+                    if not obj.deleted:
+                        obj.deleted_at = self._now()
                     obj.deleted = True
                 else:
                     self.nodeclaims.pop(obj.name, None)

@@ -122,7 +122,7 @@ def new_environment(solver: Optional[Solver] = None, use_tpu_solver: bool = True
                                           recorder=recorder)
     scheduling = SchedulingController(cluster, provisioning, clock=clock)
     registration = RegistrationController(cluster, provisioning, clock=clock)
-    termination = TerminationController(cluster, cloudprovider)
+    termination = TerminationController(cluster, cloudprovider, clock=clock)
     disruption = DisruptionController(cluster, cloudprovider, clock=clock,
                                       provisioning=provisioning, recorder=recorder)
     interruption = InterruptionController(cluster, cloudprovider, queue,

@@ -46,10 +46,11 @@ class CronSchedule:
         self.fields = [
             _parse_field(f, lo, hi) for f, (lo, hi) in zip(fields, _FIELD_RANGES)
         ]
-        # standard cron: when BOTH day fields are restricted (not "*"),
-        # day-of-month and day-of-week are ORed, not ANDed
-        self._dom_restricted = fields[2] != "*"
-        self._dow_restricted = fields[4] != "*"
+        # standard cron: when BOTH day fields are restricted, day-of-month
+        # and day-of-week are ORed, not ANDed. "*" AND "*/n" count as
+        # unrestricted (robfig/cron sets the star bit for both).
+        self._dom_restricted = not fields[2].startswith("*")
+        self._dow_restricted = not fields[4].startswith("*")
 
     def matches(self, ts: float) -> bool:
         """Does the minute containing unix-time ``ts`` match (UTC)?"""

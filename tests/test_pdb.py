@@ -93,6 +93,30 @@ class TestRollingDrain:
             1 for p in env.cluster.pods.values() if p.phase == "Running"
         ) == 6
 
+    def test_termination_grace_force_drains(self, env):
+        """terminationGracePeriod: a fully-blocking PDB holds the drain only
+        until the grace deadline, then eviction force-completes (core
+        v1 NodePool.spec.template.spec.terminationGracePeriod)."""
+        pool = cmr_pool()
+        pool.termination_grace_period_s = 300
+        env.apply_defaults(pool)
+        pods = make_pods(2, "db", {"cpu": "1", "memory": "2Gi"}, labels={"app": "db"})
+        for p in pods:
+            env.cluster.apply(p)
+        env.step(3)
+        env.cluster.apply(
+            PodDisruptionBudget(name="db-pdb", selector={"app": "db"},
+                                min_available=2)
+        )
+        for c in list(env.cluster.nodeclaims.values()):
+            env.cluster.delete(c)
+        env.step(2)
+        assert any(c.deleted for c in env.cluster.nodeclaims.values())  # held
+        env.clock.advance(301)
+        env.step(3)
+        # grace expired: claims finalized despite the blocking budget
+        assert not any(c.deleted for c in env.cluster.nodeclaims.values())
+
     def test_fully_blocking_pdb_holds_finalizer(self, env):
         env.apply_defaults(cmr_pool())
         pods = make_pods(2, "db", {"cpu": "1", "memory": "2Gi"}, labels={"app": "db"})
