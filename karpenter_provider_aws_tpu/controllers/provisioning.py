@@ -54,7 +54,8 @@ class ProvisioningController:
     def reconcile(self) -> None:
         self._prune_stale_nominations()
         with self._nominations_lock:
-            nominated = set(self.nominations)
+            nominated_map = dict(self.nominations)
+        nominated = set(nominated_map)
         pending = [p for p in self.cluster.pending_pods() if p.uid not in nominated]
         if not pending:
             return
@@ -79,10 +80,10 @@ class ProvisioningController:
                     pool.name: self.cloudprovider.pool_reserved_allowed(pool)
                     for pool in nodepools
                 },
-                # Live nodes ride into the solve as pre-opened capacity, so
-                # pending pods land on existing slack inside the device
-                # program instead of a host-side rebinder loop.
-                existing=snapshot_existing_capacity(self.cluster),
+                # Live nodes AND in-flight claims ride into the solve as
+                # pre-opened capacity, so pending pods land on slack already
+                # owned (or already being launched) instead of opening more.
+                existing=snapshot_existing_capacity(self.cluster, nominated_map),
             )
         from ..metrics import SOLVE_DURATION, SOLVE_PODS
 
@@ -111,15 +112,28 @@ class ProvisioningController:
         time: the 1 s host binder may have consumed the snapshotted free
         capacity during a multi-second solve, and binding past it would
         overcommit the node. Skipped pods stay pending and re-enter the next
-        solve."""
+        solve. Plan rows targeting IN-FLIGHT claims become nominations —
+        registration binds them (with its own fit check) once the node
+        joins."""
+        from ..scheduling.solver import IN_FLIGHT_PREFIX
+
         if not binds:
             return
         usage = self.cluster.node_usage()
         nodes = {n.name: n for n in self.cluster.snapshot_nodes()}
+        claims = {c.name: c for c in self.cluster.snapshot_claims()}
         free: dict[str, object] = {}
         for pod, node_name in binds:
             live = self.cluster.pods.get(pod.uid)
             if live is None or not live.is_pending():
+                continue
+            if node_name.startswith(IN_FLIGHT_PREFIX):
+                cname = node_name[len(IN_FLIGHT_PREFIX):]
+                claim = claims.get(cname)
+                if claim is None or claim.deleted:
+                    continue  # launch died under us; re-solve next pass
+                with self._nominations_lock:
+                    self.nominations[pod.uid] = cname
                 continue
             node = nodes.get(node_name)
             if node is None or not node.ready or node.cordoned:

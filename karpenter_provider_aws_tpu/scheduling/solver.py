@@ -70,39 +70,77 @@ class ExistingNode:
     labels: dict = field(default_factory=dict)  # actual node labels (ditto)
 
 
-def snapshot_existing_capacity(cluster) -> list[ExistingNode]:
-    """Ready, uncordoned nodes with their current usage, solver-shaped.
+# ExistingNode.name prefix marking an IN-FLIGHT NodeClaim (launched, not
+# yet registered): plan "binds" to these become nominations, not pod binds.
+IN_FLIGHT_PREFIX = "nodeclaim:"
 
-    Usage comes from one locked pass over the pod store (``node_usage``),
-    not a per-node scan."""
+
+def snapshot_existing_capacity(cluster, nominations=None) -> list[ExistingNode]:
+    """Ready, uncordoned nodes with their current usage, solver-shaped —
+    plus IN-FLIGHT NodeClaims (launched, unregistered) as pre-opened
+    capacity, the core scheduler's in-flight virtual nodes: a pod burst
+    lands on capacity already being paid for instead of opening more.
+
+    Node usage comes from one locked pass over the pod store; in-flight
+    usage is the requests of pods already nominated onto each claim
+    (``nominations``: pod uid -> claim name)."""
     usage = cluster.node_usage()
+    claims = cluster.snapshot_claims()  # ONE snapshot for both passes below
     # a node whose claim is draining is capacity that is going away — never
     # offer it (same filter as consolidation's encode_cluster)
-    draining = {
-        c.status.node_name for c in cluster.snapshot_claims() if c.deleted
-    }
+    draining = {c.status.node_name for c in claims if c.deleted}
+
+    def row(name, pool, itype, zone, captype, used, allocatable, taints, labels):
+        return ExistingNode(
+            name=name,
+            nodepool_name=pool,
+            instance_type=itype,
+            zone=zone,
+            capacity_type=captype,
+            used=(
+                used.astype(np.float32)
+                if used is not None
+                else np.zeros_like(allocatable, dtype=np.float32)
+            ),
+            allocatable=allocatable.astype(np.float32),
+            taints=tuple(taints),
+            labels=dict(labels),
+        )
+
     out: list[ExistingNode] = []
     for node in cluster.snapshot_nodes():
         if not node.ready or node.cordoned or node.name in draining:
             continue
-        used = usage.get(node.name)
-        out.append(
-            ExistingNode(
-                name=node.name,
-                nodepool_name=node.nodepool_name,
-                instance_type=node.instance_type(),
-                zone=node.zone(),
-                capacity_type=node.capacity_type(),
-                used=(
-                    used.astype(np.float32)
-                    if used is not None
-                    else np.zeros_like(node.allocatable.v, dtype=np.float32)
-                ),
-                allocatable=node.allocatable.v.astype(np.float32),
-                taints=tuple(node.taints),
-                labels=dict(node.labels),
+        out.append(row(
+            node.name, node.nodepool_name, node.instance_type(), node.zone(),
+            node.capacity_type(), usage.get(node.name), node.allocatable.v,
+            node.taints, node.labels,
+        ))
+
+    nominated_used: dict[str, np.ndarray] = {}
+    for uid, cname in (nominations or {}).items():
+        pod = cluster.pods.get(uid)
+        if pod is not None:
+            cur = nominated_used.get(cname)
+            nominated_used[cname] = (
+                pod.requests.v if cur is None else cur + pod.requests.v
             )
-        )
+    for claim in claims:
+        if claim.deleted or not claim.is_launched() or claim.is_registered():
+            continue
+        itype = claim.labels.get(lbl.INSTANCE_TYPE_LABEL, "")
+        zone = claim.labels.get(lbl.TOPOLOGY_ZONE, "")
+        captype = claim.labels.get(lbl.CAPACITY_TYPE, "")
+        if not itype or not zone or claim.status.allocatable.is_zero():
+            continue  # launch not far enough along to offer
+        out.append(row(
+            IN_FLIGHT_PREFIX + claim.name, claim.nodepool_name, itype, zone,
+            captype, nominated_used.get(claim.name),
+            claim.status.allocatable.v,
+            # permanent taints only: startup taints clear before any
+            # nominated pod can bind (registration clears them)
+            claim.taints, claim.labels,
+        ))
     return out
 
 

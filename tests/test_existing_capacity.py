@@ -174,6 +174,54 @@ class TestExistingCapacity:
         assert len(res.unschedulable) == 2
 
 
+class TestInFlightCapacity:
+    def test_burst_lands_on_in_flight_claims(self):
+        """Pods arriving while a launch is still registering nominate onto
+        the in-flight claim's slack instead of opening another node (core:
+        in-flight nodeclaims are virtual nodes inside Solve)."""
+        from karpenter_provider_aws_tpu.testenv import new_environment
+
+        env = new_environment()
+        env.apply_defaults(cmr_pool())
+        for p in make_pods(2, "first", {"cpu": "2", "memory": "4Gi"}):
+            env.cluster.apply(p)
+        env.provisioning.reconcile()  # launch only: registration NOT run
+        claims_before = set(env.cluster.nodeclaims)
+        assert claims_before
+        assert all(
+            not c.is_registered() for c in env.cluster.nodeclaims.values()
+        )
+        # burst: small pods that fit the in-flight node's remaining slack
+        burst = make_pods(2, "burst", {"cpu": "500m", "memory": "512Mi"})
+        for p in burst:
+            env.cluster.apply(p)
+        env.provisioning.reconcile()
+        assert set(env.cluster.nodeclaims) == claims_before, "opened a new node"
+        with env.provisioning._nominations_lock:
+            noms = dict(env.provisioning.nominations)
+        for p in burst:
+            assert p.uid in noms, "burst pod not nominated onto in-flight claim"
+        env.step(3)  # registration binds everyone
+        assert not env.cluster.pending_pods()
+
+    def test_oversized_burst_still_opens_nodes(self):
+        from karpenter_provider_aws_tpu.testenv import new_environment
+
+        env = new_environment()
+        env.apply_defaults(cmr_pool())
+        for p in make_pods(1, "first", {"cpu": "2", "memory": "4Gi"}):
+            env.cluster.apply(p)
+        env.provisioning.reconcile()
+        claims_before = set(env.cluster.nodeclaims)
+        # burst too big for any in-flight slack
+        for p in make_pods(6, "burst", {"cpu": "60", "memory": "120Gi"}):
+            env.cluster.apply(p)
+        env.provisioning.reconcile()
+        assert len(env.cluster.nodeclaims) > len(claims_before)
+        env.step(3)
+        assert not env.cluster.pending_pods()
+
+
 class TestExistingCapacityControlPlane:
     def test_provisioner_binds_to_live_slack_instead_of_launching(self):
         from karpenter_provider_aws_tpu.testenv import new_environment
