@@ -52,6 +52,7 @@ class DisruptionController:
         provisioning=None,
         recorder=None,
         spot_to_spot: bool = False,
+        validation_period_s: float = 15.0,
     ):
         from ..events import default_recorder
 
@@ -61,6 +62,13 @@ class DisruptionController:
         self.drift_enabled = drift_enabled
         # core SpotToSpotConsolidation feature gate (default off upstream)
         self.spot_to_spot = spot_to_spot
+        # consolidation validation window (core: candidates are re-validated
+        # after a wait before committing, so a transient dip — a pod between
+        # restarts, a scale-down about to scale back — doesn't kill a node).
+        # A candidate must stay consolidatable for this long before any
+        # delete/replace commits. 0 = commit on first sight (tests).
+        self.validation_period_s = validation_period_s
+        self._consol_seen: dict[str, float] = {}
         self.provisioning = provisioning
         self.recorder = recorder or default_recorder()
         self.disrupted: list[tuple[str, str]] = []  # (claim name, reason) log
@@ -163,9 +171,14 @@ class DisruptionController:
             and p.disruption.consolidate_after_s is not None
             for p in pools.values()
         ):
+            # no candidates exist: validation first-seen times must not
+            # survive (a node returning as a candidate hours later would
+            # otherwise bypass the window)
+            self._consol_seen.clear()
             return
         ct = encode_cluster(self.cluster, self.cloudprovider.catalog)
         if ct is None:
+            self._consol_seen.clear()
             return
         nodes = {n.name: n for n in self.cluster.snapshot_nodes()}
         now = self.clock.now()
@@ -210,6 +223,21 @@ class DisruptionController:
             for ni in order
             if not ct.blocked[ni] and eligible(int(ni)) is not None
         ]
+        # Validation window: a candidate commits only after staying
+        # consolidatable for validation_period_s (first-seen times pruned
+        # when a claim stops being a candidate, so a flapping node restarts
+        # its clock).
+        current = {eligible(ni).name: ni for ni in eligible_all}
+        self._consol_seen = {
+            name: self._consol_seen.get(name, now) for name in current
+        }
+        if self.validation_period_s > 0:
+            eligible_all = [
+                ni
+                for ni in eligible_all
+                if now - self._consol_seen[eligible(ni).name]
+                >= self.validation_period_s
+            ]
         # delete candidates additionally pass the device repack screen;
         # multi-node REPLACE considers every eligible node (a node whose
         # pods don't fit on survivors is exactly the replace case)
@@ -241,6 +269,7 @@ class DisruptionController:
             return
 
         # 3. single-node replace-with-cheaper for survivors.
+        validated = set(eligible_all)
         reserved_allow = {
             name: self.cloudprovider.pool_reserved_allowed(pool)
             for name, pool in pools.items()
@@ -254,6 +283,8 @@ class DisruptionController:
             claim = eligible(int(ni))
             if claim is None:
                 continue
+            if int(ni) not in validated:
+                continue  # not yet through the validation window
             if budget.left(claim.nodepool_name, "Underutilized") <= 0:
                 continue
             replacement = self._launch_replacement(claim, type_name, offering_options)

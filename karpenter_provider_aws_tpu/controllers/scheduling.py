@@ -118,8 +118,10 @@ class SchedulingController:
             if self._zone_counts(a.label_selector, nodes, cache).get(zone, 0) > 0:
                 return False
         ztop = pod.zone_topology_term()
-        if ztop is None or ztop[0] == "anti":
-            return True  # anti already fully handled above
+        if ztop is None or ztop[0] in ("anti", "soft_spread"):
+            # anti already fully handled above; soft spread is a PREFERENCE —
+            # the binder must never reject live slack over it
+            return True
         mode, skew, selector = ztop
         counts = self._zone_counts(selector, nodes, cache)
         if mode == "affinity":

@@ -165,6 +165,12 @@ class Pod:
         for a in self.affinity:
             if a.topology_key == lbl.TOPOLOGY_ZONE and a.matches(self):
                 return ("affinity", 0, dict(a.label_selector))
+        # ScheduleAnyway: a PREFERENCE — balance when possible, relax
+        # instead of going unschedulable (lowest precedence: a required
+        # term above always wins the zone axis)
+        for c in self.topology_spread:
+            if c.topology_key == lbl.TOPOLOGY_ZONE and c.when_unsatisfiable == "ScheduleAnyway":
+                return ("soft_spread", max(c.max_skew, 1), dict(c.label_selector))
         return None
 
     # -- grouping (dedup) key ----------------------------------------------

@@ -327,7 +327,7 @@ def encode_problem(
                     unencodable.append(
                         (pod_i, "zone anti-affinity: no zone without a matching pod left")
                     )
-        else:  # spread: greedy water-fill with the incremental skew check
+        else:  # spread / soft_spread: greedy water-fill w/ incremental skew
             # Place each pod in the lowest-count *live* zone that keeps
             # max-min skew <= max_skew over the allowed domain (dead/ICE'd
             # zones still count toward the domain minimum, so a fully-ICE'd
@@ -335,6 +335,7 @@ def encode_problem(
             # semantics, kube-scheduler's per-pod check).
             counts = dict(e)
             assign = {zi: 0 for zi in allowed_z}
+            placed = 0
             for _ in range(len(plist)):
                 floor = min(counts.values())
                 cands = [zi for zi in live if counts[zi] + 1 - floor <= skew]
@@ -343,16 +344,35 @@ def encode_problem(
                 zi = min(cands, key=lambda z: (counts[z], z))
                 counts[zi] += 1
                 assign[zi] += 1
+                placed += 1
+            if mode == "soft_spread":
+                # ScheduleAnyway: the skew cap is a preference — relax it
+                # for the remainder instead of failing, still favoring the
+                # emptiest live zones (kube-scheduler scores, we round-robin)
+                for _ in range(len(plist) - placed):
+                    if not live:
+                        break
+                    zi = min(live, key=lambda z: (counts[z], z))
+                    counts[zi] += 1
+                    assign[zi] += 1
+                    placed += 1
             start = 0
             for zi in allowed_z:
                 take = assign[zi]
                 if take:
                     expanded.append((plist[start : start + take], zi, mpn, None))
                     start += take
-            for pod_i in plist[start:]:
-                unencodable.append(
-                    (pod_i, "zone topology spread unsatisfiable (max skew / zone availability)")
-                )
+            if mode == "soft_spread" and start < len(plist):
+                # no live allowed zone at all: hand the rest to the generic
+                # path unpinned (a preference must never make pods pend) —
+                # keeping the non-self anti-affinity zone mask, which is a
+                # HARD constraint
+                expanded.append((plist[start:], None, mpn, anti_mask))
+            else:
+                for pod_i in plist[start:]:
+                    unencodable.append(
+                        (pod_i, "zone topology spread unsatisfiable (max skew / zone availability)")
+                    )
 
     group_list = [e[0] for e in expanded]
     G = len(group_list)

@@ -81,6 +81,7 @@ class Environment:
         self.provisioning.nominations.clear()
         self.provisioning.last_unschedulable.clear()
         self.disruption.disrupted.clear()
+        self.disruption._consol_seen.clear()
         self.interruption.handled.clear()
         self.garbagecollection.reaped.clear()
         self.liveness.reaped.clear()
@@ -123,8 +124,11 @@ def new_environment(solver: Optional[Solver] = None, use_tpu_solver: bool = True
     scheduling = SchedulingController(cluster, provisioning, clock=clock)
     registration = RegistrationController(cluster, provisioning, clock=clock)
     termination = TerminationController(cluster, cloudprovider, clock=clock)
+    # validation_period_s=0: specs drive single reconcile passes; the
+    # window's own behavior is tested explicitly in test_disruption
     disruption = DisruptionController(cluster, cloudprovider, clock=clock,
-                                      provisioning=provisioning, recorder=recorder)
+                                      provisioning=provisioning, recorder=recorder,
+                                      validation_period_s=0.0)
     interruption = InterruptionController(cluster, cloudprovider, queue,
                                           recorder=recorder)
     gc = GarbageCollectionController(cluster, cloudprovider, clock=clock)

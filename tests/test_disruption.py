@@ -142,6 +142,56 @@ class TestDriftDisruption:
         assert any("NodePoolHashDrifted" in r for _, r in env.disruption.disrupted)
 
 
+class TestValidationWindow:
+    @pytest.fixture(autouse=True)
+    def _window(self, env):
+        env.disruption.validation_period_s = 15.0
+        yield
+        env.disruption.validation_period_s = 0.0
+
+    def _thin_out(self, env, pods):
+        """Delete most pods but keep one per stretch, so every node retains
+        a pod — emptiness (which has no validation window) must not fire."""
+        for i, p in enumerate(pods):
+            if i % 8 != 0:
+                env.cluster.delete(p)
+
+    def test_candidate_must_persist_before_commit(self, env):
+        """Core consolidation validation: a node must stay consolidatable
+        across the validation window before any delete commits — a
+        transient dip never kills a node on first sight."""
+        env.apply_defaults(pool_with(consolidate_after_s=10))
+        pods = make_pods(30, "w", {"cpu": "1", "memory": "2Gi"})
+        provision(env, pods)
+        self._thin_out(env, pods)
+        env.clock.advance(61)
+        env.disruption.reconcile()  # first sight: starts the window
+        assert not any(
+            r.startswith("consolidatable") for _, r in env.disruption.disrupted
+        )
+        env.clock.advance(16)
+        env.disruption.reconcile()  # window passed: commits
+        assert any(
+            r.startswith("consolidatable") for _, r in env.disruption.disrupted
+        )
+
+    def test_flapping_candidate_restarts_window(self, env):
+        env.apply_defaults(pool_with(consolidate_after_s=10))
+        pods = make_pods(30, "w", {"cpu": "1", "memory": "2Gi"})
+        provision(env, pods)
+        self._thin_out(env, pods)
+        env.clock.advance(61)
+        env.disruption.reconcile()  # window starts
+        # load returns: candidates vanish, first-seen entries prune
+        refill = make_pods(26, "w2", {"cpu": "1", "memory": "2Gi"})
+        provision(env, refill)
+        env.clock.advance(16)
+        env.disruption.reconcile()
+        assert not any(
+            r.startswith("consolidatable") for _, r in env.disruption.disrupted
+        )
+
+
 class TestBudgets:
     def test_budget_caps_disruptions_per_pass(self, env):
         pool = pool_with(expire_after_s=60, consolidate_after_s=None)

@@ -71,6 +71,62 @@ class TestZoneSpread:
             assert spec.zone_options == ["zone-a"]
 
 
+def soft_zone_spread(max_skew=1):
+    return TopologySpreadConstraint(
+        topology_key=lbl.TOPOLOGY_ZONE, max_skew=max_skew,
+        when_unsatisfiable="ScheduleAnyway", label_selector={"app": "web"},
+    )
+
+
+@pytest.mark.parametrize("solver_cls", [TPUSolver, HostSolver])
+class TestSoftZoneSpread:
+    def test_balances_when_possible(self, catalog, pool, solver_cls):
+        pods = make_pods(12, "w", {"cpu": "1", "memory": "2Gi"},
+                         labels={"app": "web"},
+                         topology_spread=[soft_zone_spread()])
+        res = solver_cls().solve(pods, [pool], catalog)
+        assert res.pods_placed() == 12
+        by_zone = {}
+        for spec in res.node_specs:
+            by_zone[spec.zone_options[0]] = (
+                by_zone.get(spec.zone_options[0], 0) + len(spec.pods)
+            )
+        counts = sorted(by_zone.values())
+        assert len(by_zone) == 4
+        assert counts[-1] - counts[0] <= 1
+
+    def test_never_unschedulable_when_constrained_to_one_zone(
+        self, catalog, pool, solver_cls
+    ):
+        """The defining difference from DoNotSchedule: pinning every pod to
+        one zone violates any skew, but ScheduleAnyway relaxes instead of
+        pending."""
+        pods = make_pods(6, "w", {"cpu": "1", "memory": "2Gi"},
+                         labels={"app": "web"},
+                         topology_spread=[soft_zone_spread()])
+        for p in pods:
+            p.node_selector = {lbl.TOPOLOGY_ZONE: "zone-a"}
+        res = solver_cls().solve(pods, [pool], catalog)
+        assert res.pods_placed() == 6
+        assert not res.unschedulable
+        for spec in res.node_specs:
+            assert spec.zone_options == ["zone-a"]
+
+    def test_hard_spread_wins_when_both_present(self, catalog, pool, solver_cls):
+        pods = make_pods(8, "w", {"cpu": "1", "memory": "2Gi"},
+                         labels={"app": "web"},
+                         topology_spread=[zone_spread(), soft_zone_spread(3)])
+        res = solver_cls().solve(pods, [pool], catalog)
+        assert res.pods_placed() == 8
+        by_zone = {}
+        for spec in res.node_specs:
+            by_zone[spec.zone_options[0]] = (
+                by_zone.get(spec.zone_options[0], 0) + len(spec.pods)
+            )
+        counts = sorted(by_zone.values())
+        assert counts[-1] - counts[0] <= 1  # the HARD term's skew holds
+
+
 @pytest.mark.parametrize("solver_cls", [TPUSolver, HostSolver])
 class TestHostnameTopology:
     def test_anti_affinity_one_pod_per_node(self, catalog, pool, solver_cls):
