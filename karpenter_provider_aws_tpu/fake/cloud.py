@@ -132,6 +132,9 @@ class FakeCloud:
             Image(id="img-br-arm-1", name="bottlerocket-arm-v1", family="bottlerocket", arch="arm64", created_seq=1),
             Image(id="img-nodeadm-1", name="nodeadm-v1", family="nodeadm", arch="amd64", created_seq=1),
             Image(id="img-nodeadm-arm-1", name="nodeadm-arm-v1", family="nodeadm", arch="arm64", created_seq=1),
+            Image(id="img-ubuntu-1", name="ubuntu-v1", family="ubuntu", arch="amd64", created_seq=1),
+            Image(id="img-ubuntu-arm-1", name="ubuntu-arm-v1", family="ubuntu", arch="arm64", created_seq=1),
+            Image(id="img-win-1", name="windows-v1", family="windows", arch="amd64", created_seq=1),
         ]
         self.instances: dict[str, Instance] = {}
         self.instance_profiles: dict[str, dict] = {}

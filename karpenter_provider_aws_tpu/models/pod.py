@@ -73,6 +73,11 @@ class Pod:
     # Required-during-scheduling node affinity, flattened to requirement terms
     # (OR across terms is not yet supported; terms are ANDed like nodeSelector).
     node_affinity: list[Requirement] = field(default_factory=list)
+    # Preferred-during-scheduling node affinity (soft): the solver tries to
+    # honor these, then relaxes them for pods that would otherwise pend
+    # (karpenter's preference-relaxation; weights collapse to all-or-nothing
+    # — one relaxation round drops them together).
+    preferred_node_affinity: list[Requirement] = field(default_factory=list)
     tolerations: list[Toleration] = field(default_factory=list)
     topology_spread: list[TopologySpreadConstraint] = field(default_factory=list)
     anti_affinity: list[PodAffinityTerm] = field(default_factory=list)
@@ -89,8 +94,8 @@ class Pod:
     # ``pod.node_selector["k"] = v`` — is not detectable; assign a fresh
     # value instead, which is what all in-tree callers do.)
     _KEY_FIELDS = frozenset({
-        "requests", "node_selector", "node_affinity", "tolerations",
-        "topology_spread", "anti_affinity", "affinity",
+        "requests", "node_selector", "node_affinity", "preferred_node_affinity",
+        "tolerations", "topology_spread", "anti_affinity", "affinity",
     })
 
     def __post_init__(self):
@@ -189,6 +194,7 @@ class Pod:
                 self.requests.v.tobytes(),
                 tuple(sorted(self.node_selector.items())),
                 tuple(sorted((r.key, r.operator.value, r.values, r.min_values) for r in self.node_affinity)),
+                tuple(sorted((r.key, r.operator.value, r.values, r.min_values) for r in self.preferred_node_affinity)),
                 tuple(sorted((t.key, t.operator, t.value, t.effect) for t in self.tolerations)),
                 tuple(sorted(self.topology_spread, key=lambda c: c.topology_key)),
                 tuple(sorted(self.anti_affinity, key=lambda a: a.topology_key)),

@@ -112,8 +112,13 @@ class EncodedProblem:
         return int(self.counts.sum())
 
 
-def _group_requirements(pod: Pod, nodepool: Optional[NodePool]) -> Requirements:
+def _group_requirements(
+    pod: Pod, nodepool: Optional[NodePool], include_preferences: bool = False
+) -> Requirements:
     reqs = pod.requirements()
+    if include_preferences and pod.preferred_node_affinity:
+        for r in pod.preferred_node_affinity:
+            reqs.add(r)
     if nodepool is not None:
         reqs = reqs.union(nodepool.scheduling_requirements())
     return reqs
@@ -178,6 +183,7 @@ def encode_problem(
     occupancy: Optional[ZoneOccupancy] = None,
     allowed_types: Optional[set] = None,
     allow_reserved=True,
+    include_preferences: bool = True,
 ) -> EncodedProblem:
     """Build the dense solve tensors for one nodepool's candidate pods.
 
@@ -418,7 +424,7 @@ def encode_problem(
         ck = pod.scheduling_key()
         hit = shared.get(ck)
         if hit is None:
-            reqs = _group_requirements(pod, nodepool)
+            reqs = _group_requirements(pod, nodepool, include_preferences)
             # Offering-level allowances: which zones / capacity types may
             # serve this group (zone + capacity-type as requirements).
             zvs = reqs.get(lbl.TOPOLOGY_ZONE)

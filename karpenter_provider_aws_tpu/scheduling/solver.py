@@ -949,36 +949,68 @@ def _solve_multi_nodepool(
     remaining: list[Pod] = list(pods)
     reasons: dict[str, str] = {}
     in_use = in_use or {}
-    for pool in sorted(nodepools, key=lambda p: -p.weight):
-        if not remaining:
-            break
+    # State shared across pools AND relaxation rounds, so the relaxed round
+    # never re-offers what an earlier round consumed:
+    #  - used_delta: existing-node slack bound by earlier rounds
+    #  - launched_extra: capacity launched per pool (counts against limits)
+    used_delta: dict[str, np.ndarray] = {}
+    launched_extra: dict[str, object] = {}
+
+    def pool_round(pods_in, pool, include_preferences):
+        import dataclasses
+
         allowed = type_allow.get(pool.name) if type_allow else None
         # reserved_allow: per-pool gate on the pre-paid capacity type; pools
         # absent from an explicit map get no reserved access (isolation).
-        allow_res = reserved_allow.get(pool.name, False) if reserved_allow is not None else True
+        allow_res = (
+            reserved_allow.get(pool.name, False)
+            if reserved_allow is not None
+            else True
+        )
         t_enc = time.perf_counter()
-        problem = encode_problem(remaining, catalog, nodepool=pool, occupancy=occupancy,
-                                 allowed_types=allowed, allow_reserved=allow_res)
+        problem = encode_problem(
+            pods_in, catalog, nodepool=pool, occupancy=occupancy,
+            allowed_types=allowed, allow_reserved=allow_res,
+            include_preferences=include_preferences,
+        )
         if hasattr(impl, "timings"):
-            # accumulate across nodepools: one solve() = one breakdown
+            # accumulate across rounds: one solve() = one breakdown
             impl.timings["encode_ms"] = impl.timings.get("encode_ms", 0.0) + (
                 (time.perf_counter() - t_enc) * 1e3
             )
         for pod, why in problem.unencodable:
             reasons[pod.uid] = f"nodepool {pool.name}: {why}"
         # This pool's own live nodes ride along as pre-opened capacity (same
-        # taint/requirement semantics as the pool's fresh nodes, so group
-        # compat transfers soundly).
-        pool_existing = (
-            [e for e in existing if e.nodepool_name == pool.name] if existing else None
-        )
+        # taint/requirement semantics as the pool's fresh nodes), with slack
+        # already bound by earlier rounds subtracted.
+        pool_existing = None
+        if existing:
+            pool_existing = []
+            for e in existing:
+                if e.nodepool_name != pool.name:
+                    continue
+                d = used_delta.get(e.name)
+                pool_existing.append(
+                    e if d is None else dataclasses.replace(e, used=e.used + d)
+                )
         specs, binds, unplaced = impl.solve_encoded(problem, existing=pool_existing)
+        for pod, name in binds:
+            cur = used_delta.get(name)
+            used_delta[name] = pod.requests.v if cur is None else cur + pod.requests.v
         result.binds.extend(binds)
-        specs, rejected = _enforce_pool_constraints(
-            specs, pool, catalog, in_use.get(pool.name)
-        )
+        used = in_use.get(pool.name)
+        extra = launched_extra.get(pool.name)
+        if extra is not None:
+            used = extra if used is None else used + extra
+        specs, rejected = _enforce_pool_constraints(specs, pool, catalog, used)
+        for spec in specs:
+            it = catalog.get(spec.instance_type_options[0])
+            if it is not None:
+                cap = it.capacity()
+                prev = launched_extra.get(pool.name)
+                launched_extra[pool.name] = cap if prev is None else prev + cap
         result.node_specs.extend(specs)
-        # pods that didn't land fall through to the next nodepool
+        # pods that didn't land fall through
         leftover: list[Pod] = [p for p, _ in problem.unencodable]
         for pod, why in rejected:
             reasons[pod.uid] = f"nodepool {pool.name}: {why}"
@@ -988,7 +1020,25 @@ def _solve_multi_nodepool(
             leftover.extend(plist[len(plist) - cnt:])
             for pod in plist[len(plist) - cnt:]:
                 reasons[pod.uid] = f"nodepool {pool.name}: no instance type fits"
-        remaining = leftover
+        return leftover
+
+    def full_round(pods_list, include_preferences):
+        rem = pods_list
+        for pool in sorted(nodepools, key=lambda p: -p.weight):
+            if not rem:
+                break
+            rem = pool_round(rem, pool, include_preferences)
+        return rem
+
+    remaining = full_round(remaining, True)
+    # Preference relaxation AFTER the full pool sweep (karpenter relaxes
+    # only once every nodepool has been tried with preferences intact — a
+    # later pool that can honor the preference must win over relaxing at
+    # an earlier one).
+    prefs = [p for p in remaining if p.preferred_node_affinity]
+    if prefs:
+        others = [p for p in remaining if not p.preferred_node_affinity]
+        remaining = others + full_round(prefs, False)
     for pod in remaining:
         result.unschedulable.append(
             (pod, reasons.get(pod.uid, "no nodepool can schedule this pod"))

@@ -86,6 +86,52 @@ class TestFeatureFlags:
         assert not flags.uses_eni_limited_memory_overhead
 
 
+class TestFamilyLaunchE2E:
+    @pytest.mark.parametrize("family,marker", [
+        # ubuntu's shell userdata matches other shell families, so its
+        # discriminator is the family's /dev/sda1 root device (applied by
+        # admission defaults); windows has its own userdata dialect
+        ("ubuntu", ("device", "/dev/sda1")),
+        ("windows", ("userdata", "<powershell>")),
+    ])
+    def test_family_launches_end_to_end(self, family, marker):
+        """A nodeclass on the new families resolves an image, renders its
+        family's defaults into the launch template, and runs pods."""
+        from karpenter_provider_aws_tpu.models import NodePool, Operator, Requirement
+        from karpenter_provider_aws_tpu.models import labels as lbl
+        from karpenter_provider_aws_tpu.models.nodeclass import NodeClass as NC
+        from karpenter_provider_aws_tpu.models.pod import make_pods
+        from karpenter_provider_aws_tpu.testenv import new_environment
+
+        env = new_environment()
+        nodeclass = admit(NC(name="default", role="node-role", image_family=family))
+        pool = NodePool(
+            name="default",
+            requirements=[
+                Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m")),
+                Requirement(lbl.ARCH, Operator.IN, ("amd64",)),
+            ],
+        )
+        env.cluster.apply(nodeclass)
+        env.cluster.apply(pool)
+        env.nodeclass_status.reconcile()
+        env.nodeclass_hash.reconcile()
+        for p in make_pods(2, "w", {"cpu": "1", "memory": "2Gi"}):
+            env.cluster.apply(p)
+        env.step(3)
+        assert not env.cluster.pending_pods()
+        lts = env.cloud.describe_launch_templates()
+        assert lts, "no launch template created"
+        kind, expect = marker
+        if kind == "userdata":
+            assert any(expect in lt.user_data for lt in lts), family
+        else:
+            assert any(
+                any(bd.device_name == expect for bd in lt.block_devices)
+                for lt in lts
+            ), family
+
+
 class TestBootstrapScripts:
     def test_windows_powershell(self):
         script = get_family("windows").bootstrapper(

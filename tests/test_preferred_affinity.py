@@ -1,0 +1,79 @@
+"""Preferred (soft) node affinity with relaxation (karpenter core: the
+scheduler tries preferences, then relaxes them instead of leaving pods
+pending)."""
+
+import pytest
+
+from karpenter_provider_aws_tpu.catalog import CatalogProvider
+from karpenter_provider_aws_tpu.models import NodePool, Operator, Requirement
+from karpenter_provider_aws_tpu.models import labels as lbl
+from karpenter_provider_aws_tpu.models.pod import make_pods
+from karpenter_provider_aws_tpu.scheduling import HostSolver, TPUSolver
+
+
+@pytest.fixture(scope="module")
+def catalog():
+    return CatalogProvider()
+
+
+def cmr_pool():
+    return NodePool(
+        name="default",
+        requirements=[Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m"))],
+    )
+
+
+@pytest.mark.parametrize("solver_cls", [TPUSolver, HostSolver])
+class TestPreferredAffinity:
+    def test_preference_honored_when_satisfiable(self, catalog, solver_cls):
+        pods = make_pods(
+            6, "w", {"cpu": "1", "memory": "2Gi"},
+            preferred_node_affinity=[
+                Requirement(lbl.ARCH, Operator.IN, ("arm64",))
+            ],
+        )
+        res = solver_cls().solve(pods, [cmr_pool()], catalog)
+        assert res.pods_placed() == 6
+        for spec in res.node_specs:
+            it = catalog.get(spec.instance_type_options[0])
+            assert it.arch == "arm64", "preference ignored though satisfiable"
+
+    def test_unsatisfiable_preference_is_relaxed(self, catalog, solver_cls):
+        # preferred zone does not exist: pods must still place (relaxation),
+        # never pend over a preference
+        pods = make_pods(
+            4, "w", {"cpu": "1", "memory": "2Gi"},
+            preferred_node_affinity=[
+                Requirement(lbl.TOPOLOGY_ZONE, Operator.IN, ("zone-nope",))
+            ],
+        )
+        res = solver_cls().solve(pods, [cmr_pool()], catalog)
+        assert res.pods_placed() == 4
+        assert not res.unschedulable
+
+    def test_hard_requirements_still_win(self, catalog, solver_cls):
+        # hard amd64 + preferred arm64: intersection is empty under the
+        # preference, so the relaxed round places on amd64
+        pods = make_pods(
+            4, "w", {"cpu": "1", "memory": "2Gi"},
+            node_selector={lbl.ARCH: "amd64"},
+            preferred_node_affinity=[
+                Requirement(lbl.ARCH, Operator.IN, ("arm64",))
+            ],
+        )
+        res = solver_cls().solve(pods, [cmr_pool()], catalog)
+        assert res.pods_placed() == 4
+        for spec in res.node_specs:
+            assert catalog.get(spec.instance_type_options[0]).arch == "amd64"
+
+    def test_mixed_batch(self, catalog, solver_cls):
+        plain = make_pods(3, "p", {"cpu": "1", "memory": "2Gi"})
+        pref = make_pods(
+            3, "q", {"cpu": "1", "memory": "2Gi"},
+            preferred_node_affinity=[
+                Requirement(lbl.TOPOLOGY_ZONE, Operator.IN, ("zone-nope",))
+            ],
+        )
+        res = solver_cls().solve(plain + pref, [cmr_pool()], catalog)
+        assert res.pods_placed() == 6
+        assert not res.unschedulable
