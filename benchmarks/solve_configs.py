@@ -229,7 +229,7 @@ def config4_consolidation(n_nodes=5000, iters=5):
 
     import os
 
-    backends = ["vmap"]
+    backends = ["vmap", "native"]
     if jax.default_backend() != "cpu":
         backends.append("pallas")
     out = {
