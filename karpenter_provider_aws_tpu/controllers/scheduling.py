@@ -95,6 +95,28 @@ class SchedulingController:
         from ..models import labels as lbl
 
         cache = cache if cache is not None else {}
+        if pod.hostname_colocated():
+            # required co-location: once any matching pod is bound, only its
+            # node(s) qualify (binding the first replica seeds the node).
+            # Seeded-node sets are selector-keyed and node-independent —
+            # memoized per reconcile pass like _zone_counts.
+            for a in pod.affinity:
+                if a.topology_key != lbl.HOSTNAME or not a.matches(pod):
+                    continue
+                key = ("__seeded__", tuple(sorted(a.label_selector.items())))
+                seeded = cache.get(key)
+                if seeded is None:
+                    seeded = {
+                        q.node_name
+                        for q in self.cluster.pods.values()
+                        if q.node_name and all(
+                            q.labels.get(k) == v
+                            for k, v in a.label_selector.items()
+                        )
+                    }
+                    cache[key] = seeded
+                if seeded and node.name not in seeded:
+                    return False
         cap = pod.hostname_cap()
         if cap < (1 << 30):
             selectors = [

@@ -150,6 +150,15 @@ class Pod:
                 cap = min(cap, 1)
         return cap
 
+    def hostname_colocated(self) -> bool:
+        """Required SELF-matching hostname pod affinity: every replica of
+        the group must land on ONE node (the "pack my replicas together"
+        co-location case; the encoder turns the group atomic)."""
+        return any(
+            a.topology_key == lbl.HOSTNAME and a.matches(self)
+            for a in self.affinity
+        )
+
     def zone_topology(self) -> Optional[tuple[str, int]]:
         """('spread', max_skew) | ('anti', 1) | ('affinity', 0) | None for the
         zone axis."""

@@ -122,7 +122,12 @@ def encode_cluster(cluster, catalog, gmax: int = GMAX_DEFAULT) -> Optional[Clust
     for ni, node in enumerate(nodes):
         per_node: dict[int, int] = {}
         for pod in pods_by_node.get(node.name, ()):
-            if pod.do_not_disrupt():
+            if pod.do_not_disrupt() or pod.hostname_colocated():
+                # co-located groups move as ONE unit; the repack simulator
+                # places per-pod, so nodes holding them are conservatively
+                # not disruption candidates (single-replace still moves the
+                # whole node's pods to one replacement, which is sound, but
+                # blocked gates both — revisit if it matters)
                 blocked[ni] = True
             key = (pod.scheduling_key(), tuple(sorted(pod.labels.items())))
             gi = groups.get(key)

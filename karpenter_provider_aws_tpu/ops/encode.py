@@ -96,6 +96,9 @@ class EncodedProblem:
     group_captype_allowed: np.ndarray = None  # [G, C] bool
     # Hostname-topology cap: max replicas of the group on one node.
     max_per_node: np.ndarray = None           # [G] int32
+    # Required hostname co-location: the group is ONE summed super-pod
+    # (count 1); decode expands it back into its pods on the single node.
+    atomic: np.ndarray = None                 # [G] bool
     # Exotic types (bare metal): kept out of ranked launch alternatives when
     # standard types qualify (parity: instance.go:456-477
     # filterExoticInstanceTypes — metal only launches when requested or when
@@ -173,6 +176,54 @@ def _contains_vec(vs, vals: np.ndarray, fvals: np.ndarray) -> np.ndarray:
     else:
         ok &= np.isin(vals, list(vs.values))
     return np.where(defined, ok, vs.allow_undefined)
+
+
+_UNSATISFIABLE = object()  # sentinel from _atomic_zone_mask
+
+
+def _atomic_zone_mask(pod, occupancy, zone_names, Z, unit: int = 1):
+    """Zone allowance for a co-located (atomic) group: the unit lands in
+    ONE zone, so zone terms reduce to a zone mask. Returns a [Z] bool mask,
+    None (unrestricted), or _UNSATISFIABLE (hard conflict)."""
+    mask = np.ones(Z, dtype=bool)
+    restricted = False
+    zindex = {z: i for i, z in enumerate(zone_names)}
+
+    def occ(selector):
+        return occupancy.counts(selector) if occupancy is not None else {}
+
+    for a in pod.anti_affinity:
+        if a.topology_key != lbl.TOPOLOGY_ZONE:
+            continue
+        # zones already holding matching pods are off-limits (self or not)
+        for z, c in occ(a.label_selector).items():
+            if c > 0 and z in zindex:
+                mask[zindex[z]] = False
+                restricted = True
+    for a in pod.affinity:
+        if a.topology_key == lbl.TOPOLOGY_ZONE:
+            seeded = [z for z, c in occ(a.label_selector).items() if c > 0]
+            if seeded:
+                m2 = np.zeros(Z, dtype=bool)
+                for z in seeded:
+                    if z in zindex:
+                        m2[zindex[z]] = True
+                mask &= m2
+                restricted = True
+    for c in pod.topology_spread:
+        if (
+            c.topology_key == lbl.TOPOLOGY_ZONE
+            and c.when_unsatisfiable == "DoNotSchedule"
+        ):
+            # the whole unit in one zone gives that zone +unit matching
+            # pods: satisfiable only when the skew bound tolerates it
+            counts = occ(c.label_selector)
+            floor = min(
+                (counts.get(z, 0) for z in zone_names), default=0
+            )
+            if floor + c.max_skew < unit:
+                return _UNSATISFIABLE
+    return mask if restricted else None
 
 
 def encode_problem(
@@ -265,12 +316,47 @@ def encode_problem(
     live_zone_mask = available.any(axis=(0, 2))  # [Z] any live offering
     zone_index = {z: zi for zi, z in enumerate(zone_names)}
 
-    # (pods, zone_pin, mpn, zone_mask) — zone_mask is an extra [Z] allowance
-    # from non-self anti-affinity terms, applied when the group is not pinned.
-    expanded: list[tuple[list[Pod], Optional[int], int, Optional[np.ndarray]]] = []
+    # (pods, zone_pin, mpn, zone_mask, atomic) — zone_mask is an extra [Z]
+    # allowance from non-self anti-affinity terms, applied when the group is
+    # not pinned; atomic marks required-hostname-co-location groups (every
+    # replica on ONE node: encoded as a single summed super-pod).
+    expanded: list[tuple] = []
     for plist in groups.values():
         pod = plist[0]
         mpn = pod.hostname_cap()
+        if pod.hostname_colocated():
+            # Co-located group: zone splitting would scatter replicas
+            # across zones/nodes — the whole group travels as one unit.
+            # mpn=1 keeps it off pre-opened existing rows (their matching
+            # occupancy is invisible to the solve) and caps one unit/node.
+            self_sel = next(
+                a.label_selector for a in pod.affinity
+                if a.topology_key == lbl.HOSTNAME and a.matches(pod)
+            )
+            if occupancy is not None and any(
+                c > 0 for c in occupancy.counts(self_sel).values()
+            ):
+                # the group is already seeded on some node: pending
+                # replicas must JOIN it — that is the rebinder's job
+                # (scheduling controller); a fresh node would split the
+                # group. They pend if the seeded node is full, exactly
+                # like kube-scheduler.
+                unencodable.extend(
+                    (p, "co-located group already running; replicas must "
+                        "join its node") for p in plist
+                )
+                continue
+            zmask = _atomic_zone_mask(pod, occupancy, zone_names, Z,
+                                      unit=len(plist))
+            if zmask is _UNSATISFIABLE:
+                unencodable.extend(
+                    (p, "hostname co-location conflicts with zone topology "
+                        "spread (whole group lands in one zone)")
+                    for p in plist
+                )
+                continue
+            expanded.append((plist, None, 1, zmask, True))
+            continue
         ztop = pod.zone_topology_term()
         allowed_z = [
             zi for zi, z in enumerate(zone_names)
@@ -294,7 +380,7 @@ def encode_problem(
                             anti_mask[zone_index[z]] = False
                 allowed_z = [zi for zi in allowed_z if anti_mask[zi]]
         if ztop is None or not allowed_z:
-            expanded.append((plist, None, mpn, anti_mask))
+            expanded.append((plist, None, mpn, anti_mask, False))
             continue
         mode, skew, selector = ztop
         # Existing bound replicas matching the term's selector, per zone —
@@ -319,7 +405,7 @@ def encode_problem(
                 continue
             else:
                 pin = next((zi for zi in allowed_z if zi in live), allowed_z[0])
-            expanded.append((plist, pin, mpn, None))
+            expanded.append((plist, pin, mpn, None, False))
         elif mode == "anti":
             # Each replica needs a zone with NO matching pod, existing or new.
             empty = sorted(
@@ -328,7 +414,7 @@ def encode_problem(
             )
             for i, pod_i in enumerate(plist):
                 if i < len(empty):
-                    expanded.append(([pod_i], empty[i], mpn, None))
+                    expanded.append(([pod_i], empty[i], mpn, None, False))
                 else:
                     unencodable.append(
                         (pod_i, "zone anti-affinity: no zone without a matching pod left")
@@ -366,14 +452,14 @@ def encode_problem(
             for zi in allowed_z:
                 take = assign[zi]
                 if take:
-                    expanded.append((plist[start : start + take], zi, mpn, None))
+                    expanded.append((plist[start : start + take], zi, mpn, None, False))
                     start += take
             if mode == "soft_spread" and start < len(plist):
                 # no live allowed zone at all: hand the rest to the generic
                 # path unpinned (a preference must never make pods pend) —
                 # keeping the non-self anti-affinity zone mask, which is a
                 # HARD constraint
-                expanded.append((plist[start:], None, mpn, anti_mask))
+                expanded.append((plist[start:], None, mpn, anti_mask, False))
             else:
                 for pod_i in plist[start:]:
                     unencodable.append(
@@ -391,6 +477,7 @@ def encode_problem(
     captype_allowed = np.zeros((max(G, 1), lbl.NUM_CAPACITY_TYPES), dtype=bool)
     group_window = np.zeros((max(G, 1), Z, lbl.NUM_CAPACITY_TYPES), dtype=bool)
     max_per_node = np.full(max(G, 1), 1 << 30, dtype=np.int32)
+    atomic = np.zeros(max(G, 1), dtype=bool)
 
     # Cache key: catalog seqnum + names — a refresh() bumps the seq even when
     # type names are unchanged, so stale label arrays can't be served.
@@ -416,10 +503,17 @@ def encode_problem(
     # resource fit, and the per-(type, zone) price floor. Compute those once
     # per scheduling key; per subgroup only the [T, Z] zone combine remains.
     shared: dict = {}
-    for gi, (plist, zone_pin, mpn, zone_mask) in enumerate(expanded):
+    for gi, (plist, zone_pin, mpn, zone_mask, is_atomic) in enumerate(expanded):
         pod = plist[0]
-        requests[gi] = pod.requests.v
-        counts[gi] = len(plist)
+        if is_atomic:
+            # co-located group: one summed super-pod; the fit check below
+            # then requires a type that holds the WHOLE group
+            requests[gi] = np.sum([p.requests.v for p in plist], axis=0)
+            counts[gi] = 1
+            atomic[gi] = True
+        else:
+            requests[gi] = pod.requests.v
+            counts[gi] = len(plist)
         max_per_node[gi] = mpn
         ck = pod.scheduling_key()
         hit = shared.get(ck)
@@ -458,6 +552,10 @@ def encode_problem(
             hit = (zrow, crow, static_ok, fits, price_tz, avail_tz)
             shared[ck] = hit
         zrow, crow, static_ok, fits, price_tz, avail_tz = hit
+        if is_atomic:
+            # the cached fit is per-pod; an atomic group needs a type that
+            # holds the whole summed unit
+            fits = (requests[gi][None, :] <= tensors.capacity + 1e-6).all(axis=1)
 
         zone_allowed[gi] = zrow
         if zone_mask is not None:
@@ -497,6 +595,7 @@ def encode_problem(
         captype_allowed[:G] = captype_allowed[:G][order]
         group_window[:G] = group_window[:G][order]
         max_per_node[:G] = max_per_node[:G][order]
+        atomic[:G] = atomic[:G][order]
         group_list = [group_list[i] for i in order]
 
     # Per-pool kubelet maxPods clamps the pods axis of every candidate type
@@ -525,6 +624,7 @@ def encode_problem(
         group_zone_allowed=zone_allowed,
         group_captype_allowed=captype_allowed,
         max_per_node=max_per_node,
+        atomic=atomic,
         # Exotic = never a silent launch *alternative*: bare-metal AND
         # accelerator hardware (reference filterExoticInstanceTypes,
         # instance.go:456-477 — GPU/Neuron types are excluded from ranked
@@ -570,6 +670,7 @@ def pad_problem(p: EncodedProblem, group_bucket: Optional[int] = None) -> Encode
         group_zone_allowed=padg(p.group_zone_allowed),
         group_captype_allowed=padg(p.group_captype_allowed),
         max_per_node=padg(p.max_per_node, fill=1 << 30),
+        atomic=padg(p.atomic) if p.atomic is not None else None,
         type_exotic=p.type_exotic,
         unencodable=p.unencodable,
     )

@@ -313,6 +313,13 @@ def _decode_nodes(
         for g in group_idx:
             take = int(col[g])
             plist = problem.group_pods[g]
+            if problem.atomic is not None and problem.atomic[g]:
+                # atomic (co-located) group: its one placed unit IS the
+                # whole pod list
+                if take > 0:
+                    pods.extend(plist[cursors[g]:])
+                    cursors[g] = len(plist)
+                continue
             pods.extend(plist[cursors[g]: cursors[g] + take])
             cursors[g] += take
         if not pods and not group_idx:
@@ -1017,9 +1024,16 @@ def _solve_multi_nodepool(
             leftover.append(pod)
         for g, cnt in unplaced.items():
             plist = problem.group_pods[g]
-            leftover.extend(plist[len(plist) - cnt:])
-            for pod in plist[len(plist) - cnt:]:
-                reasons[pod.uid] = f"nodepool {pool.name}: no instance type fits"
+            if problem.atomic is not None and problem.atomic[g]:
+                # one unplaced unit = every pod of the co-located group
+                tail = plist
+            else:
+                tail = plist[len(plist) - cnt:]
+            leftover.extend(tail)
+            for pod in tail:
+                reasons[pod.uid] = (
+                    f"nodepool {pool.name}: no instance type fits"
+                )
         return leftover
 
     def full_round(pods_list, include_preferences):

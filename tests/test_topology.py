@@ -71,6 +71,82 @@ class TestZoneSpread:
             assert spec.zone_options == ["zone-a"]
 
 
+@pytest.mark.parametrize("solver_cls", [TPUSolver, HostSolver])
+class TestHostnameColocation:
+    def _pods(self, n, cpu="1"):
+        return make_pods(
+            n, "co", {"cpu": cpu, "memory": "2Gi"}, labels={"app": "db"},
+            affinity=[
+                PodAffinityTerm(topology_key=lbl.HOSTNAME,
+                                label_selector={"app": "db"})
+            ],
+        )
+
+    def test_group_lands_on_one_node(self, catalog, pool, solver_cls):
+        pods = self._pods(4)
+        res = solver_cls().solve(pods, [pool], catalog)
+        assert res.pods_placed() == 4
+        with_pods = [s for s in res.node_specs if s.pods]
+        assert len(with_pods) == 1, "co-located group split across nodes"
+        assert len(with_pods[0].pods) == 4
+        it = catalog.get(with_pods[0].instance_type_options[0])
+        assert it.vcpus >= 4  # must hold the whole unit
+
+    def test_unfittable_unit_is_unschedulable_together(self, catalog, pool, solver_cls):
+        # 4 x 200cpu = 800cpu: no single type holds the unit
+        pods = self._pods(4, cpu="200")
+        res = solver_cls().solve(pods, [pool], catalog)
+        assert len(res.unschedulable) == 4
+        assert res.pods_placed() == 0
+
+    def test_scale_up_joins_seeded_node(self, catalog, pool, solver_cls):
+        """New replicas of an already-running co-located group JOIN its node
+        via the rebinder instead of launching a splitting node."""
+        from karpenter_provider_aws_tpu.testenv import new_environment
+
+        env = new_environment(
+            solver=solver_cls() if solver_cls is HostSolver else None
+        )
+        from karpenter_provider_aws_tpu.models import NodePool, Operator, Requirement
+
+        # pin node size so the seeded node has slack for joiners (the FFD
+        # otherwise sizes the node tightly to the first unit — joining
+        # replicas would pend, which is kube-consistent but not this test)
+        env.apply_defaults(NodePool(
+            name="default",
+            requirements=[
+                Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m")),
+                Requirement(lbl.INSTANCE_CPU, Operator.IN, ("16",)),
+            ],
+        ))
+        first = self._pods(2)
+        for p in first:
+            env.cluster.apply(p)
+        env.step(3)
+        assert not env.cluster.pending_pods()
+        seeded = {env.cluster.pods[p.uid].node_name for p in first}
+        assert len(seeded) == 1
+        claims_before = set(env.cluster.nodeclaims)
+        # scale up: 2 more replicas of the same group
+        more = self._pods(2)
+        for p in more:
+            env.cluster.apply(p)
+        env.step(3)
+        assert not env.cluster.pending_pods()
+        assert set(env.cluster.nodeclaims) == claims_before, "split the group"
+        assert {env.cluster.pods[p.uid].node_name for p in more} == seeded
+
+    def test_colocated_and_plain_pods_mix(self, catalog, pool, solver_cls):
+        plain = make_pods(6, "p", {"cpu": "1", "memory": "2Gi"})
+        res = solver_cls().solve(self._pods(3) + plain, [pool], catalog)
+        assert res.pods_placed() == 9
+        co_nodes = {
+            id(s) for s in res.node_specs
+            if any(p.labels.get("app") == "db" for p in s.pods)
+        }
+        assert len(co_nodes) == 1
+
+
 def soft_zone_spread(max_skew=1):
     return TopologySpreadConstraint(
         topology_key=lbl.TOPOLOGY_ZONE, max_skew=max_skew,
