@@ -139,6 +139,14 @@ class SchedulingController:
                 continue
             if self._zone_counts(a.label_selector, nodes, cache).get(zone, 0) > 0:
                 return False
+        # required NON-self zone affinity: the node's zone must already run
+        # the target workload (self-matching terms ride ztop below)
+        for a in pod.affinity:
+            if a.topology_key != lbl.TOPOLOGY_ZONE or a.matches(pod):
+                continue
+            counts = self._zone_counts(a.label_selector, nodes, cache)
+            if counts.get(zone, 0) <= 0:
+                return False
         ztop = pod.zone_topology_term()
         if ztop is None or ztop[0] in ("anti", "soft_spread"):
             # anti already fully handled above; soft spread is a PREFERENCE —

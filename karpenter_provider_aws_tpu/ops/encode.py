@@ -365,19 +365,47 @@ def encode_problem(
         ]
         # Zones already holding pods matched by any NON-self zone
         # anti-affinity term are off-limits regardless of the pod's own
-        # topology mode (e.g. a web pod that must avoid zones running db).
+        # topology mode (e.g. a web pod that must avoid zones running db);
+        # NON-self zone AFFINITY restricts to zones where the target
+        # workload already runs (required co-zone with another app).
         anti_mask: Optional[np.ndarray] = None
         if occupancy is not None:
             other_terms = [
                 a for a in pod.anti_affinity
                 if a.topology_key == lbl.TOPOLOGY_ZONE and not a.matches(pod)
             ]
-            if other_terms:
+            other_aff = [
+                a for a in pod.affinity
+                if a.topology_key == lbl.TOPOLOGY_ZONE and not a.matches(pod)
+            ]
+            if other_terms or other_aff:
                 anti_mask = np.ones(Z, dtype=bool)
                 for a in other_terms:
                     for z, c in occupancy.counts(a.label_selector).items():
                         if c > 0 and z in zone_index:
                             anti_mask[zone_index[z]] = False
+                unseeded_reason = ""
+                for a in other_aff:
+                    seeded = np.zeros(Z, dtype=bool)
+                    hits = occupancy.counts(a.label_selector)
+                    had_hits = any(c > 0 for c in hits.values())
+                    for z, c in hits.items():
+                        if c > 0 and z in zone_index:
+                            seeded[zone_index[z]] = True
+                    if not seeded.any():
+                        # pending either way, but say WHY accurately
+                        unseeded_reason = (
+                            "required zone affinity: matching pods run only "
+                            "in zones outside this nodepool"
+                            if had_hits
+                            else "required zone affinity: no matching pods "
+                                 "are running in any zone"
+                        )
+                        break
+                    anti_mask &= seeded
+                if unseeded_reason:
+                    unencodable.extend((p, unseeded_reason) for p in plist)
+                    continue
                 allowed_z = [zi for zi in allowed_z if anti_mask[zi]]
         if ztop is None or not allowed_z:
             expanded.append((plist, None, mpn, anti_mask, False))

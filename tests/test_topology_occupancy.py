@@ -88,6 +88,32 @@ class TestScaleUpOccupancy:
         assert res.pods_placed() == 3
         assert {spec.zone_options[0] for spec in res.node_specs} == {"zone-b"}
 
+    def test_non_self_affinity_follows_target_workload(self, catalog, pool, solver_cls):
+        # web pods (no app=web selector match on themselves here: the term
+        # targets app=db) must land only in zones where db runs
+        pods = make_pods(
+            2, "w", {"cpu": "1"}, labels={"app": "web"},
+            affinity=[PodAffinityTerm(topology_key=lbl.TOPOLOGY_ZONE,
+                                      label_selector={"app": "db"})],
+        )
+        entries = [({"app": "db"}, "zone-c")] * 2
+        res = solver_cls().solve(pods, [pool], catalog,
+                                 occupancy=ZoneOccupancy(entries))
+        assert res.pods_placed() == 2
+        assert {s.zone_options[0] for s in res.node_specs} == {"zone-c"}
+
+    def test_non_self_affinity_pending_when_target_absent(self, catalog, pool, solver_cls):
+        pods = make_pods(
+            2, "w", {"cpu": "1"}, labels={"app": "web"},
+            affinity=[PodAffinityTerm(topology_key=lbl.TOPOLOGY_ZONE,
+                                      label_selector={"app": "db"})],
+        )
+        res = solver_cls().solve(pods, [pool], catalog,
+                                 occupancy=ZoneOccupancy([]))
+        assert res.pods_placed() == 0
+        assert len(res.unschedulable) == 2
+        assert "no matching pods" in res.unschedulable[0][1]
+
 
 class TestSpreadICE:
     def _ice_zone(self, catalog, zone):
