@@ -16,6 +16,8 @@ Everything here is host-side numpy; jax only sees the finished arrays.
 
 from __future__ import annotations
 
+import threading
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Mapping, Optional, Sequence
 
@@ -226,6 +228,44 @@ def _atomic_zone_mask(pod, occupancy, zone_names, Z, unit: int = 1):
     return mask if restricted else None
 
 
+#: Encoded-problem cache across reconcile passes. The provisioning loop
+#: re-solves near-identical problems back to back (pending set unchanged
+#: while launches are in flight); the reference caches its entire
+#: instance-type list under a seqnum composite key for the same reason
+#: (instancetype.go:121-139). Keyed on pod identity (safe against id reuse
+#: because the cached problem itself keeps every pod alive), the nodepool
+#: template hash, and the catalog seqnum key; skipped when a ZoneOccupancy
+#: is supplied (its content has no cheap version stamp).
+_PROBLEM_CACHE: "OrderedDict[tuple, EncodedProblem]" = OrderedDict()
+_PROBLEM_CACHE_MAX = 8
+_PROBLEM_CACHE_LOCK = threading.Lock()
+
+
+def _problem_cache_key(pods, catalog, nodepool, occupancy, allowed_types,
+                       allow_reserved, include_preferences, tensors):
+    # A caller-supplied tensors snapshot bypasses the cache entirely: it may
+    # be a what-if view that catalog.cache_key() cannot distinguish.
+    if occupancy is not None or tensors is not None or not pods:
+        return None
+    if allow_reserved is True:
+        reserved_key = True
+    elif allow_reserved:
+        reserved_key = frozenset(allow_reserved)
+    else:
+        reserved_key = False
+    return (
+        tuple(map(id, pods)),
+        # catalog.uid, not id(catalog): the cached problem does not keep the
+        # catalog alive, so a freed catalog's address could be reused
+        catalog.uid,
+        catalog.cache_key(),
+        (nodepool.name, nodepool.weight, nodepool.hash()) if nodepool else None,
+        frozenset(allowed_types) if allowed_types is not None else None,
+        reserved_key,
+        include_preferences,
+    )
+
+
 def encode_problem(
     pods: Sequence[Pod],
     catalog: CatalogProvider,
@@ -250,6 +290,16 @@ def encode_problem(
     nodeclass reservations — pool A holding ANY reservation must not drain
     pool B's pre-paid capacity for a different (type, zone).
     """
+    ckey = _problem_cache_key(pods, catalog, nodepool, occupancy,
+                              allowed_types, allow_reserved,
+                              include_preferences, tensors)
+    if ckey is not None:
+        with _PROBLEM_CACHE_LOCK:
+            hit = _PROBLEM_CACHE.get(ckey)
+            if hit is not None:
+                _PROBLEM_CACHE.move_to_end(ckey)
+                return hit
+
     tensors = tensors if tensors is not None else catalog.tensors()
     types = catalog.list()
     T = len(types)
@@ -637,7 +687,7 @@ def encode_problem(
         capacity = capacity.copy()
         capacity[:, _PODS] = np.minimum(capacity[:, _PODS], float(kubelet.max_pods))
 
-    return EncodedProblem(
+    out = EncodedProblem(
         requests=requests,
         counts=counts,
         compat=compat,
@@ -669,6 +719,12 @@ def encode_problem(
         ),
         unencodable=unencodable,
     )
+    if ckey is not None:
+        with _PROBLEM_CACHE_LOCK:
+            _PROBLEM_CACHE[ckey] = out
+            while len(_PROBLEM_CACHE) > _PROBLEM_CACHE_MAX:
+                _PROBLEM_CACHE.popitem(last=False)
+    return out
 
 
 def pad_problem(p: EncodedProblem, group_bucket: Optional[int] = None) -> EncodedProblem:
