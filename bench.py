@@ -175,11 +175,20 @@ def measure(num_pods: int, iters: int, warmup: int, max_nodes: int) -> dict:
     for _ in range(warmup):
         run()
 
+    import gc
+
     times = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        run()
-        times.append((time.perf_counter() - t0) * 1000.0)
+    gc.collect()
+    gc.freeze()
+    gc.disable()
+    try:
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            run()
+            times.append((time.perf_counter() - t0) * 1000.0)
+    finally:
+        gc.enable()
+        gc.unfreeze()
     p99 = float(np.percentile(times, 99))
     return {
         "metric": f"p99_ffd_solve_latency_{num_pods}pods_x_{problem.capacity.shape[0]}types",
@@ -193,20 +202,29 @@ def measure(num_pods: int, iters: int, warmup: int, max_nodes: int) -> dict:
 
 
 def run_config_detail(scale: float, iters: int) -> None:
-    """All 5 BASELINE configs (latency + packed-cost ratio) → BENCH_DETAIL.jsonl."""
+    """All 5 BASELINE configs (latency + packed-cost ratio) → BENCH_DETAIL.jsonl.
+
+    Rows stream to disk as each config completes: a tunnel wedge mid-sweep
+    (observed in practice) kills the process, and rows buffered for an
+    end-of-sweep write die with it."""
     try:
         import contextlib
 
         from benchmarks.solve_configs import run_all
 
+        detail_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.jsonl"
+        )
+        stamp = {"run_at_unix": int(time.time()), "scale": scale}
+
+        def on_row(row):
+            with open(detail_path, "a") as f:
+                f.write(json.dumps({**row, **stamp}) + "\n")
+
         # run_all prints per-config rows; keep stdout reserved for the one
         # primary JSON line.
         with contextlib.redirect_stdout(sys.stderr):
-            rows = run_all(scale=scale, iters=iters)
-        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.jsonl"), "a") as f:
-            stamp = {"run_at_unix": int(time.time()), "scale": scale}
-            for row in rows:
-                f.write(json.dumps({**row, **stamp}) + "\n")
+            run_all(scale=scale, iters=iters, on_row=on_row)
     except Exception:
         print("config-detail sweep failed:", file=sys.stderr)
         traceback.print_exc()
@@ -269,11 +287,8 @@ def main() -> None:
         # vs_baseline comparable (target is a TPU target).
     emit(out)
 
-    if os.environ.get("BENCH_CONFIGS", "1") == "1":
-        scale = float(os.environ.get("BENCH_CONFIG_SCALE", "0.2" if on_cpu_fallback else "1.0"))
-        citers = int(os.environ.get("BENCH_CONFIG_ITERS", "3" if on_cpu_fallback else "10"))
-        run_config_detail(scale, citers)
-
+    # Interruption tiers run FIRST: they are host-only (a tunnel wedge in
+    # the device sweep below cannot take them down with it).
     if os.environ.get("BENCH_INTERRUPTION", "1") == "1":
         # reference tiers: 100/1k/5k/15k messages
         # (interruption_benchmark_test.go:63-78)
@@ -296,6 +311,13 @@ def main() -> None:
         except Exception:
             print("interruption bench failed:", file=sys.stderr)
             traceback.print_exc()
+
+    if os.environ.get("BENCH_CONFIGS", "1") == "1":
+        scale = float(os.environ.get("BENCH_CONFIG_SCALE", "0.2" if on_cpu_fallback else "1.0"))
+        # 30 iters on hardware: a p99 over 10 samples is just the max and one
+        # tunnel spike dominates it; 30 dilutes that sensitivity at ~5s/config.
+        citers = int(os.environ.get("BENCH_CONFIG_ITERS", "3" if on_cpu_fallback else "30"))
+        run_config_detail(scale, citers)
 
 
 if __name__ == "__main__":

@@ -84,20 +84,33 @@ class InstanceType:
         return self.max_enis * (self.ips_per_eni - 1) + 2
 
     def capacity(self, max_pods: Optional[int] = None, ephemeral_gib: int = 20) -> ResourceVector:
-        pods = max_pods if max_pods is not None else self.eni_limited_pods()
-        return ResourceVector.from_map(
-            {
-                "cpu": self.vcpus,
-                "memory": f"{self.memory_mib}Mi",
-                "pods": pods,
-                "ephemeral-storage": f"{max(self.local_nvme_gib, ephemeral_gib)}Gi",
-                "nvidia.com/gpu": self.gpu_count if self.gpu_manufacturer == "nvidia" else 0,
-                "amd.com/gpu": self.gpu_count if self.gpu_manufacturer == "amd" else 0,
-                "aws.amazon.com/neuron": self.accelerator_count if self.accelerator_manufacturer == "aws" else 0,
-                "vpc.amazonaws.com/efa": self.efa_count,
-                "vpc.amazonaws.com/pod-eni": self.branch_enis,
-            }
-        )
+        # Memoized per (max_pods, ephemeral_gib): the limits/launch loops call
+        # this once per PLAN NODE and the quantity re-parse dominated their
+        # host time at thousands of nodes. A fresh copy is returned so a
+        # caller mutating its vector cannot poison the memo.
+        key = (max_pods, ephemeral_gib)
+        memo = self.__dict__.get("_capacity_memo")
+        if memo is None:
+            memo = {}
+            self.__dict__["_capacity_memo"] = memo
+        v = memo.get(key)
+        if v is None:
+            pods = max_pods if max_pods is not None else self.eni_limited_pods()
+            v = ResourceVector.from_map(
+                {
+                    "cpu": self.vcpus,
+                    "memory": f"{self.memory_mib}Mi",
+                    "pods": pods,
+                    "ephemeral-storage": f"{max(self.local_nvme_gib, ephemeral_gib)}Gi",
+                    "nvidia.com/gpu": self.gpu_count if self.gpu_manufacturer == "nvidia" else 0,
+                    "amd.com/gpu": self.gpu_count if self.gpu_manufacturer == "amd" else 0,
+                    "aws.amazon.com/neuron": self.accelerator_count if self.accelerator_manufacturer == "aws" else 0,
+                    "vpc.amazonaws.com/efa": self.efa_count,
+                    "vpc.amazonaws.com/pod-eni": self.branch_enis,
+                }
+            ).v
+            memo[key] = v
+        return ResourceVector(v.copy())
 
     def labels(self) -> dict[str, str]:
         """The node labels this type advertises (parity: types.go:75-161

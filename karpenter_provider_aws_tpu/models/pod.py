@@ -88,6 +88,10 @@ class Pod:
     owner_key: str = ""  # ReplicaSet/Deployment identity for grouping
     # lazily computed by scheduling_key(); excluded from comparisons
     _scheduling_key: Optional[tuple] = field(default=None, repr=False, compare=False)
+    # bumped on every scheduling-relevant field assignment; cross-solve
+    # caches (ops.encode._PROBLEM_CACHE) key on (id, _version) pairs so a
+    # sanctioned field reassignment can never serve a stale encoding
+    _version: int = field(default=0, repr=False, compare=False)
 
     # Fields covered by scheduling_key(); assigning any of them invalidates
     # the cached key. (In-place mutation of a field's container — e.g.
@@ -97,6 +101,9 @@ class Pod:
         "requests", "node_selector", "node_affinity", "preferred_node_affinity",
         "tolerations", "topology_spread", "anti_affinity", "affinity",
     })
+    # Fields that invalidate cross-solve encodings: the key fields plus
+    # labels (selector-matching input for topology terms).
+    _VERSION_FIELDS = _KEY_FIELDS | {"labels"}
 
     def __post_init__(self):
         if not self.uid:
@@ -108,6 +115,8 @@ class Pod:
     def __setattr__(self, name, value):
         if name in Pod._KEY_FIELDS and getattr(self, "_scheduling_key", None) is not None:
             object.__setattr__(self, "_scheduling_key", None)
+        if name in Pod._VERSION_FIELDS:
+            object.__setattr__(self, "_version", getattr(self, "_version", 0) + 1)
         object.__setattr__(self, name, value)
 
     # -- scheduling views --------------------------------------------------

@@ -254,7 +254,12 @@ def _problem_cache_key(pods, catalog, nodepool, occupancy, allowed_types,
     else:
         reserved_key = False
     return (
+        # (id, version) pairs: the cached problem keeps every pod alive (so
+        # ids cannot be recycled), and the version bumps on any sanctioned
+        # scheduling-field reassignment (Pod.__setattr__) so a mutated pod
+        # can never be served its stale encoding
         tuple(map(id, pods)),
+        tuple(p._version for p in pods),
         # catalog.uid, not id(catalog): the cached problem does not keep the
         # catalog alive, so a freed catalog's address could be reused
         catalog.uid,

@@ -313,24 +313,29 @@ def _decode_nodes(
         return hit
 
     # One nonzero pass over the whole plan instead of a [G] slice per node,
-    # and one vectorized name materialization for every node's ranking —
-    # the per-node Python loops were ~1/6 of e2e solve wall at 2k+ nodes.
+    # and ONE bulk ranked-name materialization (a single C-level .tolist()
+    # of the [n_open, k] name matrix) — the per-node Python loops and
+    # per-node fancy-index + tolist were ~1/6 of e2e solve wall at 2k+ nodes.
     gq, nq = np.nonzero(placed[:G, :n_open])
-    by_node: dict[int, list[int]] = {}
-    for g, n in zip(gq.tolist(), nq.tolist()):
-        by_node.setdefault(n, []).append(g)
-    names_arr = np.asarray(problem.type_names, dtype=object)
+    cq = placed[gq, nq]
+    by_node: dict[int, list[tuple[int, int]]] = {}
+    for g, n, c in zip(gq.tolist(), nq.tolist(), cq.tolist()):
+        by_node.setdefault(n, []).append((g, c))
     all_ranked_names = None
     if ranked_idx is not None:
         kmax = min(ranked_idx.shape[1], MAX_INSTANCE_TYPE_OPTIONS)
-        all_ranked_names = names_arr[ranked_idx[:n_open, :kmax]]  # [n_open, k] obj
+        names_arr = np.asarray(problem.type_names, dtype=object)
+        all_ranked_names = names_arr[ranked_idx[:n_open, :kmax]].tolist()
+        ranked_n_l = np.minimum(
+            np.asarray(ranked_n[:n_open], dtype=np.int64), kmax
+        ).tolist()
+    node_type_l = np.asarray(node_type[:n_open], dtype=np.int64).tolist()
 
     for n in range(n_open):
-        group_idx = by_node.get(n, ())
-        col = placed[:G, n]
+        group_take = by_node.get(n, ())
         pods: list[Pod] = []
-        for g in group_idx:
-            take = int(col[g])
+        group_idx = [g for g, _ in group_take]
+        for g, take in group_take:
             plist = problem.group_pods[g]
             if problem.atomic is not None and problem.atomic[g]:
                 # atomic (co-located) group: its one placed unit IS the
@@ -347,10 +352,9 @@ def _decode_nodes(
             name = pre_names[n]
             binds.extend((pod, name) for pod in pods)
             continue
-        committed = int(node_type[n])
+        committed = node_type_l[n]
         if ranked_idx is not None and (stale_rank is None or not stale_rank[n]):
-            k_n = min(int(ranked_n[n]), MAX_INSTANCE_TYPE_OPTIONS)
-            type_names = all_ranked_names[n, :k_n].tolist()
+            type_names = all_ranked_names[n][: ranked_n_l[n]]
         else:
             # combined per-type price across the node's groups (inf if any
             # group cannot use the type) -> ranked alternatives; an
@@ -870,6 +874,9 @@ class TPUSolver:
             used = placed[:G].T.astype(np.float32) @ problem.requests[:G]
             if n_pre:
                 used[:n_pre] += pre_rows[2]
+        # the dense-plan device buffers are only needed by the overflow
+        # fallback above — release them before the host refine/decode phase
+        handles = None  # noqa: F841
         self.timings["device_ms"] = self.timings.get("device_ms", 0.0) + (
             (time.perf_counter() - t_dev) * 1e3
         )

@@ -1,0 +1,208 @@
+"""The cross-reconcile caches on the solve hot path.
+
+Three layers, each the TPU-side analogue of the reference's seqnum
+composite cache (instancetype.go:121-139):
+
+ 1. encoded-problem cache (ops.encode._PROBLEM_CACHE) — same pods + pool +
+    catalog seqnums => the same EncodedProblem object, no re-tensorization;
+ 2. content-addressed device upload cache (TPUSolver._dput) — byte-identical
+    host arrays are uploaded once;
+ 3. sparse plan wire format (ops.ffd.compact_plan) — the [G, N] placement
+    matrix travels as (flat-idx, count) pairs and is reconstructed densely.
+
+Every invalidation path matters more than the hit path: a stale solve
+launches the wrong capacity.
+"""
+
+import numpy as np
+import pytest
+
+from karpenter_provider_aws_tpu.catalog import CatalogProvider
+from karpenter_provider_aws_tpu.models import NodePool, Operator, Requirement
+from karpenter_provider_aws_tpu.models import labels as lbl
+from karpenter_provider_aws_tpu.models.pod import make_pods
+from karpenter_provider_aws_tpu.ops import encode as enc
+from karpenter_provider_aws_tpu.ops.encode import ZoneOccupancy, encode_problem
+from karpenter_provider_aws_tpu.ops.ffd import compact_plan
+from karpenter_provider_aws_tpu.scheduling import TPUSolver
+
+
+@pytest.fixture
+def catalog():
+    return CatalogProvider()
+
+
+@pytest.fixture
+def pool():
+    return NodePool(
+        name="default",
+        requirements=[Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m", "r"))],
+    )
+
+
+class TestProblemCache:
+    def test_identical_inputs_hit(self, catalog, pool):
+        pods = make_pods(50, "w", {"cpu": "500m", "memory": "1Gi"})
+        p1 = encode_problem(pods, catalog, pool)
+        p2 = encode_problem(pods, catalog, pool)
+        assert p1 is p2
+
+    def test_different_pod_list_misses(self, catalog, pool):
+        pods_a = make_pods(50, "a", {"cpu": "500m", "memory": "1Gi"})
+        pods_b = make_pods(50, "b", {"cpu": "500m", "memory": "1Gi"})
+        assert encode_problem(pods_a, catalog, pool) is not encode_problem(
+            pods_b, catalog, pool
+        )
+
+    def test_catalog_seq_bump_invalidates(self, catalog, pool):
+        """An ICE mark bumps the unavailable seqnum; the cached problem's
+        type_window would otherwise keep advertising the dead offering."""
+        pods = make_pods(50, "w", {"cpu": "500m", "memory": "1Gi"})
+        p1 = encode_problem(pods, catalog, pool)
+        catalog.unavailable.mark_unavailable("c7g.6xlarge", "zone-a", "on-demand")
+        p2 = encode_problem(pods, catalog, pool)
+        assert p1 is not p2
+        ti = p2.type_names.index("c7g.6xlarge")
+        zi = p2.zones.index("zone-a")
+        ci = lbl.CAPACITY_TYPES.index("on-demand")
+        assert p1.type_window[ti, zi, ci]
+        assert not p2.type_window[ti, zi, ci]
+
+    def test_pool_template_change_invalidates(self, catalog):
+        pods = make_pods(20, "w", {"cpu": "1"})
+        pool_a = NodePool(name="p", labels={"team": "a"})
+        pool_b = NodePool(name="p", labels={"team": "b"})
+        assert encode_problem(pods, catalog, pool_a) is not encode_problem(
+            pods, catalog, pool_b
+        )
+
+    def test_occupancy_bypasses_cache(self, catalog, pool):
+        """ZoneOccupancy has no version stamp, so caching under it could
+        serve topology decisions computed against a stale cluster."""
+        pods = make_pods(20, "w", {"cpu": "1"})
+        occ = ZoneOccupancy()
+        p1 = encode_problem(pods, catalog, pool, occupancy=occ)
+        p2 = encode_problem(pods, catalog, pool, occupancy=occ)
+        assert p1 is not p2
+
+    def test_explicit_tensors_bypass_cache(self, catalog, pool):
+        pods = make_pods(20, "w", {"cpu": "1"})
+        p1 = encode_problem(pods, catalog, pool)  # cached under the plain key
+        snap = catalog.tensors()
+        p2 = encode_problem(pods, catalog, pool, tensors=snap)
+        assert p1 is not p2
+
+    def test_include_preferences_is_part_of_the_key(self, catalog, pool):
+        pods = make_pods(20, "w", {"cpu": "1"})
+        p1 = encode_problem(pods, catalog, pool, include_preferences=True)
+        p2 = encode_problem(pods, catalog, pool, include_preferences=False)
+        assert p1 is not p2
+
+    def test_pod_field_reassignment_invalidates(self, catalog, pool):
+        """The sanctioned mutation path (assign a fresh field value,
+        Pod.__setattr__) must invalidate — a stale encoding would size
+        nodes for the old requests."""
+        from karpenter_provider_aws_tpu.models.resources import ResourceVector
+
+        pods = make_pods(10, "w", {"cpu": "500m", "memory": "1Gi"})
+        p1 = encode_problem(pods, catalog, pool)
+        pods[0].requests = ResourceVector.from_map({"cpu": "8", "memory": "32Gi"})
+        p2 = encode_problem(pods, catalog, pool)
+        assert p1 is not p2
+        assert any(
+            np.isclose(p2.requests[:len(p2.group_pods), 0], 8000).any()
+            for _ in [0]
+        )
+
+    def test_pod_label_reassignment_invalidates(self, catalog, pool):
+        pods = make_pods(10, "w", {"cpu": "1"})
+        p1 = encode_problem(pods, catalog, pool)
+        pods[3].labels = {**pods[3].labels, "tier": "gold"}
+        assert encode_problem(pods, catalog, pool) is not p1
+
+    def test_cache_is_bounded(self, catalog, pool):
+        for i in range(enc._PROBLEM_CACHE_MAX + 4):
+            encode_problem(make_pods(2, f"w{i}", {"cpu": "1"}), catalog, pool)
+        assert len(enc._PROBLEM_CACHE) <= enc._PROBLEM_CACHE_MAX
+
+
+class TestDeviceUploadCache:
+    def test_equal_content_uploads_once(self):
+        s = TPUSolver()
+        a = s._dput(np.arange(100, dtype=np.float32))
+        b = s._dput(np.arange(100, dtype=np.float32))  # distinct host array
+        assert a is b
+
+    def test_content_change_misses(self):
+        s = TPUSolver()
+        a = s._dput(np.arange(100, dtype=np.float32))
+        changed = np.arange(100, dtype=np.float32)
+        changed[7] = -1.0
+        b = s._dput(changed)
+        assert a is not b
+        np.testing.assert_array_equal(np.asarray(b), changed)
+
+    def test_same_bytes_different_shape_miss(self):
+        s = TPUSolver()
+        a = s._dput(np.zeros((4, 2), dtype=np.float32))
+        b = s._dput(np.zeros((2, 4), dtype=np.float32))
+        assert a is not b
+
+    def test_budget_evicts_lru(self, monkeypatch):
+        s = TPUSolver()
+        s._dev_cache_budget = 100 * 4  # 100 float32s
+        first = np.arange(60, dtype=np.float32)
+        s._dput(first)
+        s._dput(np.arange(60, 120, dtype=np.float32))  # over budget: evicts first
+        assert s._dev_cache_bytes <= s._dev_cache_budget
+        assert len(s._dev_cache) == 1
+
+
+class TestCompactPlan:
+    def _roundtrip(self, placed, max_entries):
+        nz, cnt, total = compact_plan(placed, max_entries)
+        nz, cnt, total = np.asarray(nz), np.asarray(cnt), int(total)
+        dense = np.zeros(placed.size, dtype=np.int32)
+        valid = nz >= 0
+        dense[nz[valid]] = cnt[valid]
+        return dense.reshape(placed.shape), total
+
+    def test_roundtrip_exact(self):
+        rng = np.random.RandomState(0)
+        placed = np.zeros((16, 64), dtype=np.int32)
+        mask = rng.rand(16, 64) < 0.1
+        placed[mask] = rng.randint(1, 200, mask.sum())
+        dense, total = self._roundtrip(placed, 256)
+        assert total == int((placed > 0).sum())
+        np.testing.assert_array_equal(dense, placed)
+
+    def test_empty_plan(self):
+        dense, total = self._roundtrip(np.zeros((4, 8), dtype=np.int32), 16)
+        assert total == 0
+        assert dense.sum() == 0
+
+    def test_overflow_detected(self):
+        placed = np.ones((8, 8), dtype=np.int32)  # 64 nonzeros
+        _, _, total = compact_plan(placed, 16)
+        assert int(total) == 64  # > max_entries: caller must fall back
+
+    def test_solver_dense_fallback_on_overflow(self, catalog, pool, monkeypatch):
+        """Force the sparse buffer to overflow: the solve must transparently
+        fetch the dense plan and produce an identical placement."""
+        import karpenter_provider_aws_tpu.scheduling.solver as sv
+
+        pods = make_pods(300, "w", {"cpu": "500m", "memory": "1Gi"})
+        want = TPUSolver().solve(pods, [pool], catalog)
+
+        real = compact_plan
+
+        def tiny(placed, max_entries):
+            return real(placed, 2)  # guaranteed overflow
+
+        import karpenter_provider_aws_tpu.ops.ffd as ffd_mod
+
+        monkeypatch.setattr(ffd_mod, "compact_plan", tiny)
+        got = TPUSolver().solve(pods, [pool], catalog)
+        assert got.pods_placed() == want.pods_placed() == 300
+        assert got.total_cost == pytest.approx(want.total_cost)
+        assert len(got.node_specs) == len(want.node_specs)
