@@ -35,6 +35,9 @@ from ..utils.clock import Clock, RealClock
 
 MANAGED_TAG = "karpenter.tpu/managed"
 NODEPOOL_TAG = "karpenter.tpu/nodepool"
+# instance.go:52 instanceTypeFlexibilityThreshold: minimum type flexibility
+# for a spot->on-demand fallback launch
+OD_FALLBACK_FLEXIBILITY_MIN = 5
 NODECLAIM_TAG = "karpenter.tpu/nodeclaim"
 
 
@@ -125,12 +128,55 @@ class CloudProvider:
                 message="all candidate offerings are ICE-cached"
             )
 
+        # Mixed-captype launches drop spot types costlier than the cheapest
+        # ATTAINABLE on-demand type (parity: instance.go:429-451
+        # filterUnwantedSpot) — the fleet's lowest-price walk could otherwise
+        # land on a bigger spot box when the best-ranked type's offering is
+        # ICE-masked and a cheap on-demand one would have served. Dropping
+        # types invalidates the offering ranking (it is priced against the
+        # best-ranked type) and can retire pairs only the dropped types kept
+        # alive, so the offerings are recomputed from the survivors.
+        filtered = self._filter_unwanted_spot(type_options, offerings)
+        if filtered is not type_options:
+            type_options = filtered
+            offerings = list(
+                self._live_offerings(claim, [t.name for t in type_options])
+            )
+            if not offerings:
+                raise errors.InsufficientCapacityError(
+                    message="all candidate offerings are ICE-cached"
+                )
+
         zones = sorted({z for z, _ in offerings})
         subnet_by_zone = self.subnets.zonal_subnets_for_launch(nodeclass, zones)
         offerings = [o for o in offerings if o[0] in subnet_by_zone]
         if not offerings:
             raise errors.CloudError("no subnet available in candidate zones", code="NoSubnets")
         sgs = tuple(g.id for g in self.security_groups.list(nodeclass))
+
+        # On-demand fallback flexibility gate (parity: instance.go:270-289
+        # checkODFallback): spot was allowed but every offering that
+        # actually remains launchable (post-ICE, post-subnet) is on-demand —
+        # launching that fallback with almost no type flexibility risks
+        # immediate ICE churn, so the reference refuses below 5 options and
+        # so do we. Reserved (pre-paid) launches are exempt.
+        allowed_cts = {ct for _, ct in (claim.offering_options or ())} or set(
+            claim.capacity_type_options or ()
+        )
+        live_cts = {ct for _, ct in offerings}
+        if (
+            lbl.CAPACITY_TYPE_SPOT in allowed_cts
+            and lbl.CAPACITY_TYPE_SPOT not in live_cts
+            and lbl.CAPACITY_TYPE_ON_DEMAND in live_cts
+            and lbl.CAPACITY_TYPE_RESERVED not in live_cts
+            and len(type_options) < OD_FALLBACK_FLEXIBILITY_MIN
+        ):
+            raise errors.CloudError(
+                f"at least {OD_FALLBACK_FLEXIBILITY_MIN} instance types are "
+                "recommended when flexible to spot but falling back to "
+                f"on-demand; this launch has {len(type_options)}",
+                code="InsufficientTypeFlexibility",
+            )
 
         # Ensure the launch template for this image group (parity:
         # launchtemplate.EnsureAll at instance.go launch time).
@@ -184,6 +230,50 @@ class CloudProvider:
             raise
         self.subnets.release_unused(subnet_by_zone, result.zone)
         return self._instance_to_claim(claim, result, nodeclass)
+
+    def _filter_unwanted_spot(self, type_options, offerings):
+        """During a MIXED capacity-type launch, drop candidate types whose
+        cheapest live offering is costlier than the cheapest on-demand
+        price among the candidates (parity: instance.go:429-451
+        filterUnwantedSpot). Spot-only or on-demand-only launches pass
+        through untouched, and the cheapest-on-demand type itself always
+        survives, so the result is never empty."""
+        spot_zones = [z for z, ct in offerings if ct == lbl.CAPACITY_TYPE_SPOT]
+        od_zones = [z for z, ct in offerings if ct == lbl.CAPACITY_TYPE_ON_DEMAND]
+        has_reserved = any(ct == lbl.CAPACITY_TYPE_RESERVED for _, ct in offerings)
+        # Reserved (pre-paid, marginal price 0) launches are exempt: the
+        # price comparison below only understands the spot/on-demand market,
+        # and dropping the reservation's own type would forfeit the slot.
+        if not spot_zones or not od_zones or has_reserved:
+            return type_options
+        unavailable = self.catalog.unavailable.is_unavailable
+
+        def live_od(t):
+            # the comparison floor must be ATTAINABLE: an ICE-cached
+            # on-demand price is not a price anyone can launch at
+            # (reference computes over Offerings.Available() only)
+            if any(
+                not unavailable(t.name, z, lbl.CAPACITY_TYPE_ON_DEMAND)
+                for z in od_zones
+            ):
+                return self.catalog.pricing.on_demand_price(t)
+            return float("inf")
+
+        cheapest_od = min((live_od(t) for t in type_options), default=float("inf"))
+        if cheapest_od == float("inf"):
+            return type_options  # no attainable on-demand: nothing to compare
+
+        def cheapest_live(t):
+            best = live_od(t)
+            for z in spot_zones:
+                if not unavailable(t.name, z, lbl.CAPACITY_TYPE_SPOT):
+                    best = min(best, self.catalog.pricing.spot_price(t, z))
+            return best
+
+        kept = [t for t in type_options if cheapest_live(t) <= cheapest_od + 1e-9]
+        if len(kept) == len(type_options):
+            return type_options  # identity signals "nothing dropped" to the caller
+        return kept or type_options
 
     def launchable_type_names(self, nodepool) -> "Optional[set[str]]":
         """Types a nodepool's nodeclass can actually boot: at least one

@@ -118,6 +118,102 @@ class TestICEFeedback:
         used_types = {n.instance_type() for n in env.cluster.nodes.values()}
         assert first_choice not in used_types
 
+    def test_mixed_captype_launch_filters_unwanted_spot(self, env):
+        """parity: instance.go:429-451 filterUnwantedSpot — in a MIXED
+        spot+on-demand launch, a candidate whose cheapest live offering is
+        costlier than the cheapest on-demand among the candidates never
+        reaches the fleet (a big spot box must not beat a sufficient cheap
+        on-demand one when the best-ranked type ICEs away)."""
+        from karpenter_provider_aws_tpu.controllers.provisioning import launch_claim
+        from karpenter_provider_aws_tpu.scheduling.solver import NodeSpec
+
+        pool, _ = env.apply_defaults(cmr_pool())
+        small, big = "c5.large", "m5.24xlarge"
+        # big's spot price above small's on-demand price everywhere
+        env.catalog.pricing.update_spot(
+            {(big, z): env.catalog.pricing.on_demand_price(env.catalog.get(small)) * 3
+             for z in env.catalog.zones}
+        )
+        spec = NodeSpec(
+            nodepool_name=pool.name,
+            instance_type_options=[small, big],
+            zone_options=["zone-a"],
+            capacity_type_options=["spot", "on-demand"],
+            offering_options=[("zone-a", "spot"), ("zone-a", "on-demand")],
+        )
+        claim = launch_claim(env.cluster, env.cloudprovider, pool, spec)
+        assert claim is not None and claim.is_launched()
+        sent = env.cloud.calls["create_fleet"][-1]
+        types_sent = {t for r in sent for t in r.instance_type_options}
+        assert big not in types_sent
+        assert small in types_sent
+        # spot-only launch keeps the expensive type (no OD to compare against)
+        spec2 = NodeSpec(
+            nodepool_name=pool.name,
+            instance_type_options=[big],
+            zone_options=["zone-a"],
+            capacity_type_options=["spot"],
+            offering_options=[("zone-a", "spot")],
+        )
+        claim2 = launch_claim(env.cluster, env.cloudprovider, pool, spec2)
+        assert claim2 is not None and claim2.is_launched()
+        sent2 = env.cloud.calls["create_fleet"][-1]
+        assert {t for r in sent2 for t in r.instance_type_options} == {big}
+
+    def test_spot_filter_recomputes_offerings_and_gates_fallback(self, env):
+        """Dropping the only type with a live spot offering must retire the
+        spot pair and expose the launch as an on-demand fallback — which the
+        flexibility gate then refuses at <5 options (review finding: the
+        gate was evaluated against offerings only the dropped type served)."""
+        from karpenter_provider_aws_tpu.controllers.provisioning import launch_claim
+        from karpenter_provider_aws_tpu.scheduling.solver import NodeSpec
+
+        pool, _ = env.apply_defaults(cmr_pool())
+        cheap, pricey = "c5.large", "m5.24xlarge"
+        env.catalog.pricing.update_spot(
+            {(pricey, z): env.catalog.pricing.on_demand_price(env.catalog.get(cheap)) * 3
+             for z in env.catalog.zones}
+        )
+        for z in env.catalog.zones:  # cheap type: spot ICE'd everywhere
+            env.catalog.unavailable.mark_unavailable(cheap, z, "spot")
+        spec = NodeSpec(
+            nodepool_name=pool.name,
+            instance_type_options=[cheap, pricey],
+            zone_options=["zone-a"],
+            capacity_type_options=["spot", "on-demand"],
+            offering_options=[("zone-a", "spot"), ("zone-a", "on-demand")],
+        )
+        assert launch_claim(env.cluster, env.cloudprovider, pool, spec) is None
+        assert not env.cloud.calls.get("create_fleet")
+
+    def test_od_fallback_requires_type_flexibility(self, env):
+        """parity: instance.go:270-289 checkODFallback — spot allowed but
+        ICE'd away everywhere leaves an on-demand fallback; with <5 type
+        options the launch refuses (ICE churn risk) instead of proceeding."""
+        from karpenter_provider_aws_tpu.controllers.provisioning import launch_claim
+        from karpenter_provider_aws_tpu.scheduling.solver import NodeSpec
+
+        pool, _ = env.apply_defaults(cmr_pool())
+        narrow = ["c5.large", "c5.xlarge"]
+        wide = ["c5.large", "c5.xlarge", "c5.2xlarge", "m5.large", "m5.xlarge", "r5.large"]
+        for t in set(narrow + wide):
+            for z in env.catalog.zones:
+                env.catalog.unavailable.mark_unavailable(t, z, "spot")
+
+        def spec_for(types):
+            return NodeSpec(
+                nodepool_name=pool.name,
+                instance_type_options=list(types),
+                zone_options=["zone-a"],
+                capacity_type_options=["spot", "on-demand"],
+                offering_options=[("zone-a", "spot"), ("zone-a", "on-demand")],
+            )
+
+        assert launch_claim(env.cluster, env.cloudprovider, pool, spec_for(narrow)) is None
+        claim = launch_claim(env.cluster, env.cloudprovider, pool, spec_for(wide))
+        assert claim is not None and claim.is_launched()
+        assert claim.labels[lbl.CAPACITY_TYPE] == "on-demand"
+
     def test_fleet_ice_populates_unavailable_cache(self, env):
         env.apply_defaults(cmr_pool())
         pods = make_pods(3, "w", {"cpu": "1", "memory": "2Gi"})
