@@ -49,7 +49,12 @@ class ZoneOccupancy:
     convention as ``PodAffinityTerm.matches``)."""
 
     def __init__(self, entries: Optional[Sequence[tuple[Mapping[str, str], str]]] = None):
-        self._entries: list[tuple[Mapping[str, str], str]] = list(entries or [])
+        # private copies of the label mappings: fingerprint() memoizes over
+        # this content, so a caller mutating its own dict after construction
+        # must not be able to desynchronize counts() from the fingerprint
+        self._entries: list[tuple[dict[str, str], str]] = [
+            (dict(labels), zone) for labels, zone in (entries or [])
+        ]
 
     @classmethod
     def from_cluster(cls, cluster) -> "ZoneOccupancy":
@@ -72,6 +77,31 @@ class ZoneOccupancy:
             if all(labels.get(k) == v for k, v in selector.items()):
                 out[zone] = out.get(zone, 0) + 1
         return out
+
+    def fingerprint(self) -> frozenset:
+        """Order-insensitive content identity, computed once. Lets the
+        encoded-problem cache span occupancy-bearing solves: between
+        reconciles the bound-pod snapshot is usually unchanged, and equal
+        snapshots produce identical topology decisions. The EXACT multiset
+        (not a hash of it) is returned so a hash collision can never serve
+        another snapshot's encoding; frozenset caches its own hash, so key
+        lookups stay O(1) after the first. The entries list is never mutated
+        after construction (both constructors build it whole), so memoizing
+        is sound."""
+        fp = getattr(self, "_fingerprint", None)
+        if fp is None:
+            from collections import Counter
+
+            # Counter keeps duplicate (labels, zone) pairs distinct — a
+            # plain frozenset would collapse two identical pods into one
+            fp = frozenset(
+                Counter(
+                    (tuple(sorted(labels.items())), zone)
+                    for labels, zone in self._entries
+                ).items()
+            )
+            self._fingerprint = fp
+        return fp
 
 
 @dataclass
@@ -232,10 +262,12 @@ def _atomic_zone_mask(pod, occupancy, zone_names, Z, unit: int = 1):
 #: re-solves near-identical problems back to back (pending set unchanged
 #: while launches are in flight); the reference caches its entire
 #: instance-type list under a seqnum composite key for the same reason
-#: (instancetype.go:121-139). Keyed on pod identity (safe against id reuse
-#: because the cached problem itself keeps every pod alive), the nodepool
-#: template hash, and the catalog seqnum key; skipped when a ZoneOccupancy
-#: is supplied (its content has no cheap version stamp).
+#: (instancetype.go:121-139). Keyed on pod (id, version) pairs (safe against
+#: id reuse because the cached problem itself keeps every pod alive), the
+#: nodepool template hash, the catalog seqnum key, and — when a ZoneOccupancy
+#: is supplied — its exact content fingerprint (equal bound-pod multisets
+#: produce identical topology decisions). Only a caller-supplied tensors
+#: snapshot bypasses the cache (a what-if view the key cannot distinguish).
 _PROBLEM_CACHE: "OrderedDict[tuple, EncodedProblem]" = OrderedDict()
 _PROBLEM_CACHE_MAX = 8
 _PROBLEM_CACHE_LOCK = threading.Lock()
@@ -245,7 +277,7 @@ def _problem_cache_key(pods, catalog, nodepool, occupancy, allowed_types,
                        allow_reserved, include_preferences, tensors):
     # A caller-supplied tensors snapshot bypasses the cache entirely: it may
     # be a what-if view that catalog.cache_key() cannot distinguish.
-    if occupancy is not None or tensors is not None or not pods:
+    if tensors is not None or not pods:
         return None
     if allow_reserved is True:
         reserved_key = True
@@ -268,6 +300,10 @@ def _problem_cache_key(pods, catalog, nodepool, occupancy, allowed_types,
         frozenset(allowed_types) if allowed_types is not None else None,
         reserved_key,
         include_preferences,
+        # occupancy participates by content fingerprint: between reconciles
+        # the bound-pod snapshot is usually unchanged, and equal snapshots
+        # produce identical topology decisions
+        occupancy.fingerprint() if occupancy is not None else None,
     )
 
 

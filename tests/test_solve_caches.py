@@ -76,14 +76,20 @@ class TestProblemCache:
             pods, catalog, pool_b
         )
 
-    def test_occupancy_bypasses_cache(self, catalog, pool):
-        """ZoneOccupancy has no version stamp, so caching under it could
-        serve topology decisions computed against a stale cluster."""
+    def test_equal_occupancy_content_hits(self, catalog, pool):
+        """Occupancy participates by content fingerprint: two snapshots of
+        the same bound-pod multiset (even distinct objects) hit; a snapshot
+        with different content misses."""
         pods = make_pods(20, "w", {"cpu": "1"})
-        occ = ZoneOccupancy()
-        p1 = encode_problem(pods, catalog, pool, occupancy=occ)
-        p2 = encode_problem(pods, catalog, pool, occupancy=occ)
-        assert p1 is not p2
+        occ_a = ZoneOccupancy([({"app": "db"}, "zone-a")])
+        occ_b = ZoneOccupancy([({"app": "db"}, "zone-a")])
+        p1 = encode_problem(pods, catalog, pool, occupancy=occ_a)
+        assert encode_problem(pods, catalog, pool, occupancy=occ_b) is p1
+        occ_c = ZoneOccupancy([({"app": "db"}, "zone-b")])
+        assert encode_problem(pods, catalog, pool, occupancy=occ_c) is not p1
+        # multiplicity matters: two identical bound pods != one
+        occ_d = ZoneOccupancy([({"app": "db"}, "zone-a"), ({"app": "db"}, "zone-a")])
+        assert encode_problem(pods, catalog, pool, occupancy=occ_d) is not p1
 
     def test_explicit_tensors_bypass_cache(self, catalog, pool):
         pods = make_pods(20, "w", {"cpu": "1"})
