@@ -65,6 +65,11 @@ class CloudBackend(Protocol):
     # -- networking / discovery -------------------------------------------
     def describe_availability_zones(self) -> dict[str, str]: ...
 
+    # Cluster network facts: at least service_ipv4_cidr / service_ipv6_cidr
+    # (parity: EKS DescribeCluster feeding launchtemplate.go:429-450
+    # ResolveClusterCIDR).
+    def describe_cluster(self) -> dict: ...
+
     def describe_subnets(self) -> list: ...
 
     def describe_security_groups(self) -> list: ...

@@ -148,7 +148,13 @@ class CloudProvider:
                 )
 
         zones = sorted({z for z, _ in offerings})
-        subnet_by_zone = self.subnets.zonal_subnets_for_launch(nodeclass, zones)
+        # ONE discovery snapshot drives both the zonal pick and the
+        # public-IP inference: a cache expiry between two reads could pin
+        # associatePublicIP=False onto a launch into a public subnet.
+        subnet_snapshot = self.subnets.list(nodeclass)
+        subnet_by_zone = self.subnets.zonal_subnets_for_launch(
+            nodeclass, zones, subnets=subnet_snapshot
+        )
         offerings = [o for o in offerings if o[0] in subnet_by_zone]
         if not offerings:
             raise errors.CloudError("no subnet available in candidate zones", code="NoSubnets")
@@ -188,6 +194,12 @@ class CloudProvider:
                 labels=dict(claim.labels),
                 taints=list(claim.taints) + list(claim.startup_taints),
                 kubelet=getattr(pool, "kubelet", None) if pool else None,
+                # explicit False only when every resolved subnet is known
+                # private (parity: subnet.go:119-130); same snapshot as the
+                # zonal pick above
+                associate_public_ip=self.subnets.associate_public_ip_value(
+                    nodeclass, subnets=subnet_snapshot
+                ),
             )[image.id]
 
         lt_name = ensure_template()

@@ -18,13 +18,16 @@ ORPHAN_AGE_S = 30.0  # garbagecollection/controller.go:61 — 30s grace
 
 class GarbageCollectionController:
     name = "garbagecollection"
-    interval_s = 10.0  # adaptive 10s..2m in the reference (controller.go:84)
+    # Adaptive requeue (controller.go:84): 10s for the first 20 successful
+    # passes — catching post-startup leaks quickly — then 2m steady-state.
+    interval_s = 10.0
 
     def __init__(self, cluster: Cluster, cloudprovider: CloudProvider, clock: Optional[Clock] = None):
         self.cluster = cluster
         self.cloudprovider = cloudprovider
         self.clock = clock or RealClock()
         self.reaped: list[str] = []
+        self._successful_passes = 0
 
     def reconcile(self) -> None:
         claimed = {
@@ -39,13 +42,16 @@ class GarbageCollectionController:
             if inst.provider_id not in claimed
             and now - inst.launch_time >= ORPHAN_AGE_S
         ]
-        if not orphans:
-            return
-        # one batched wire call for the whole reap (parity: 100-way parallel
-        # reap over a single LIST, terminate batching at 500/call)
-        self.cloudprovider.cloud.terminate_instances([i.id for i in orphans])
-        for inst in orphans:
-            self.reaped.append(inst.id)
-            node = self.cluster.node_by_provider_id(inst.provider_id)
-            if node is not None:
-                self.cluster.delete(node)
+        if orphans:
+            # one batched wire call for the whole reap (parity: 100-way
+            # parallel reap over a single LIST, terminate batching 500/call)
+            self.cloudprovider.cloud.terminate_instances([i.id for i in orphans])
+            for inst in orphans:
+                self.reaped.append(inst.id)
+                node = self.cluster.node_by_provider_id(inst.provider_id)
+                if node is not None:
+                    self.cluster.delete(node)
+        # only an error-free pass counts toward backing off (controller.go:84)
+        self._successful_passes += 1
+        if self._successful_passes > 20:
+            self.interval_s = 120.0

@@ -104,6 +104,8 @@ class LaunchTemplateData:
     block_devices: tuple = ()
     metadata_options: Optional[object] = None
     tags: dict[str, str] = field(default_factory=dict)
+    # None = subnet default; False = explicitly disabled (subnet.go:119-130)
+    associate_public_ip: Optional[bool] = None
 
 
 class FakeCloud:
@@ -246,6 +248,16 @@ class FakeCloud:
             self._record("describe_availability_zones", None)
             return dict(self.zone_types)
 
+    def describe_cluster(self) -> dict:
+        """Cluster network facts (EKS DescribeCluster analogue)."""
+        with self._lock:
+            self._record("describe_cluster", None)
+            self._maybe_fail()
+            return {
+                "service_ipv4_cidr": "10.100.0.0/16",
+                "service_ipv6_cidr": "fd00:10::/108",
+            }
+
     # -- instance APIs -----------------------------------------------------
     def describe_instances(self, ids: list[str]) -> list[Instance]:
         with self._lock:
@@ -332,7 +344,8 @@ class FakeCloud:
     def create_launch_template(self, name: str, image_id: str, user_data: str = "",
                                instance_profile: str = "", security_group_ids=(),
                                block_devices=(), metadata_options=None,
-                               tags: Optional[dict[str, str]] = None) -> LaunchTemplateData:
+                               tags: Optional[dict[str, str]] = None,
+                               associate_public_ip: Optional[bool] = None) -> LaunchTemplateData:
         with self._lock:
             self._record("create_launch_template", name)
             self._maybe_fail()
@@ -342,6 +355,7 @@ class FakeCloud:
                 security_group_ids=tuple(security_group_ids),
                 block_devices=tuple(block_devices),
                 metadata_options=metadata_options, tags=dict(tags or {}),
+                associate_public_ip=associate_public_ip,
             )
             self.launch_templates[name] = lt
             return lt

@@ -133,6 +133,7 @@ def new_operator(
     )
     cluster = Cluster(clock=clock)
     from ..providers.bootstrap import ClusterInfo
+    from ..providers.launchtemplates import resolve_service_cidr as _cidr
 
     cloudprovider = CloudProvider(
         cloud,
@@ -152,6 +153,10 @@ def new_operator(
             # here as family-typed defaults overridable by --cluster-dns-ip
             dns_ip=options.cluster_dns_ip
             or ("fd00:10::a" if options.ip_family == "ipv6" else "10.100.0.10"),
+            # service-CIDR discovery (launchtemplate.go:429-450
+            # ResolveClusterCIDR): a startup failure leaves it empty and the
+            # launch-template provider retries from the launch path
+            service_cidr=_cidr(cloud, options.ip_family),
         ),
     )
     # Metrics decorator around the plugin boundary (parity: main.go:44).

@@ -67,7 +67,8 @@ class ZoneOccupancy:
             if not zone:
                 continue
             for pod in pods_by_node.get(node.name, ()):
-                entries.append((dict(pod.labels), zone))
+                # no copy here: the constructor's defensive copy suffices
+                entries.append((pod.labels, zone))
         return cls(entries)
 
     def counts(self, selector: Mapping[str, str]) -> dict[str, int]:

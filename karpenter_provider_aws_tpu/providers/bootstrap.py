@@ -29,6 +29,10 @@ class ClusterInfo:
     dns_ip: str = ""
     version: str = ""
     ip_family: str = "ipv4"  # ipv4 | ipv6 (parity: ipv6 suite + KubeDNSIP discovery)
+    # service CIDR, discovered from the cloud's cluster description
+    # (parity: launchtemplate.go:429-450 ResolveClusterCIDR); consumed by
+    # the nodeadm family's NodeConfig
+    service_cidr: str = ""
 
 
 
@@ -101,7 +105,7 @@ class NodeadmBootstrap(ShellBootstrap):
                     "name": self.cluster.name,
                     "apiServerEndpoint": self.cluster.endpoint,
                     "certificateAuthority": self.cluster.ca_bundle,
-                    "cidr": "",
+                    "cidr": self.cluster.service_cidr,
                     "ipFamily": self.cluster.ip_family,
                 },
                 "kubelet": {

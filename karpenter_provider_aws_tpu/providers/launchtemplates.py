@@ -39,6 +39,9 @@ class ResolvedTemplate:
     block_devices: tuple = ()
     metadata_options: Optional[object] = None
     tags: tuple[tuple[str, str], ...] = ()
+    # None = leave the subnet's default; False = explicitly disable (set when
+    # every resolved subnet is known private — subnet.go:119-130)
+    associate_public_ip: Optional[bool] = None
 
     def content_hash(self) -> str:
         blob = json.dumps(
@@ -50,18 +53,59 @@ class ResolvedTemplate:
                 "bdm": [asdict(b) for b in self.block_devices],
                 "md": asdict(self.metadata_options) if self.metadata_options else None,
                 "tags": list(self.tags),
+                "public_ip": self.associate_public_ip,
             },
             sort_keys=True,
         ).encode()
         return hashlib.sha256(blob).hexdigest()[:16]
 
 
+def resolve_service_cidr(cloud, ip_family: str) -> str:
+    """Cluster service CIDR from the backend's cluster description (parity:
+    launchtemplate.go:429-450 ResolveClusterCIDR — ipv4 preferred, ipv6
+    fallback, empty when the backend cannot say)."""
+    describe = getattr(cloud, "describe_cluster", None)
+    if describe is None:
+        return ""
+    try:
+        info = describe() or {}
+    except Exception as e:
+        log.warning("cluster CIDR resolution failed (will retry): %s", e)
+        return ""
+    if ip_family == "ipv6":
+        return info.get("service_ipv6_cidr") or info.get("service_ipv4_cidr") or ""
+    return info.get("service_ipv4_cidr") or info.get("service_ipv6_cidr") or ""
+
+
 class LaunchTemplateProvider:
     def __init__(self, cloud, cluster_info: ClusterInfo, clock: Optional[Clock] = None):
+        from ..utils.clock import RealClock
+
         self.cloud = cloud
         self.cluster_info = cluster_info
         self._cache = TTLCache(default_ttl=CacheTTL.LAUNCH_TEMPLATE, clock=clock)
         self._hydrated = False
+        self._clock = clock or RealClock()
+        self._cidr_next_try = 0.0
+
+    def _maybe_resolve_cidr(self) -> None:
+        """Retry service-CIDR discovery until it succeeds (parity: the
+        reference re-calls ResolveClusterCIDR from the launch path and
+        no-ops once resolved, launchtemplate.go:429-432). Rate-limited so a
+        down endpoint cannot add a describe call to every launch."""
+        if self.cluster_info.service_cidr:
+            return
+        now = self._clock.now()
+        if now < self._cidr_next_try:
+            return
+        self._cidr_next_try = now + 60.0
+        cidr = resolve_service_cidr(self.cloud, self.cluster_info.ip_family)
+        if cidr:
+            # ClusterInfo is frozen; late CIDR discovery is the one sanctioned
+            # mutation (the reference stores it in an atomic.Pointer for the
+            # same reason, launchtemplate.go:81)
+            object.__setattr__(self.cluster_info, "service_cidr", cidr)
+            log.info("discovered cluster service CIDR %s", cidr)
 
     # -- the launch path ---------------------------------------------------
     def ensure_all(
@@ -71,6 +115,7 @@ class LaunchTemplateProvider:
         labels: Optional[dict] = None,
         taints: Sequence = (),
         kubelet: Optional[KubeletConfiguration] = None,
+        associate_public_ip: Optional[bool] = None,
     ) -> dict[str, str]:
         """image_id -> launch template name, creating what is missing.
 
@@ -78,6 +123,7 @@ class LaunchTemplateProvider:
         (amiID, maxPods, efa); our grouping key is the image, since maxPods
         comes from the kubelet config and efa is N/A)."""
         self._hydrate_once()
+        self._maybe_resolve_cidr()
         out: dict[str, str] = {}
         from .imagefamily import get_family
 
@@ -101,6 +147,7 @@ class LaunchTemplateProvider:
                 block_devices=tuple(nodeclass.block_devices),
                 metadata_options=nodeclass.metadata_options,
                 tags=tuple(sorted(nodeclass.tags.items())),
+                associate_public_ip=associate_public_ip,
             )
             out[image.id] = self._ensure_one(nodeclass, resolved)
         self._gc_stale(nodeclass, keep=set(out.values()))
@@ -126,6 +173,7 @@ class LaunchTemplateProvider:
                 security_group_ids=resolved.security_group_ids,
                 block_devices=resolved.block_devices,
                 metadata_options=resolved.metadata_options,
+                associate_public_ip=resolved.associate_public_ip,
                 tags={
                     # user tags first: the managed tags must win or hydration
                     # and termination teardown lose track of the template

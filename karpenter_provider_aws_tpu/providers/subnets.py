@@ -57,15 +57,20 @@ class SubnetProvider:
         self._cache.set(key, subnets)
         return subnets
 
-    def zonal_subnets_for_launch(self, nodeclass: NodeClass, zones) -> dict[str, str]:
+    def zonal_subnets_for_launch(self, nodeclass: NodeClass, zones,
+                                 subnets=None) -> dict[str, str]:
         """zone -> subnet id, choosing the most-available-IP subnet per zone
-        and pre-deducting one IP (given back by ``release_unused``)."""
+        and pre-deducting one IP (given back by ``release_unused``).
+        ``subnets`` lets the caller pin one discovery snapshot across every
+        decision of a single launch (see associate_public_ip_value)."""
+        if subnets is None:
+            subnets = self.list(nodeclass)
         with self._lock:
             chosen: dict[str, str] = {}
             for zone in zones:
                 best = None
                 best_ips = -1
-                for s in self.list(nodeclass):
+                for s in subnets:
                     if s.zone != zone:
                         continue
                     effective = s.available_ips - len(self._prune(s.id))
@@ -77,6 +82,20 @@ class SubnetProvider:
                         self.clock.now() + CacheTTL.INFLIGHT_IPS
                     )
             return chosen
+
+    def associate_public_ip_value(self, nodeclass: NodeClass,
+                                  subnets=None) -> Optional[bool]:
+        """Explicit ``False`` only when EVERY subnet the nodeclass resolves
+        is known to not auto-assign public IPs; ``None`` (leave the cloud
+        default) when any subnet is public or unknown (parity:
+        subnet.go:119-130 AssociatePublicIPAddressValue). Pass the SAME
+        ``subnets`` snapshot the launch selected from, or a cache expiry
+        between the two reads could pin False onto a public-subnet launch."""
+        if subnets is None:
+            subnets = self.list(nodeclass)
+        if subnets and all(getattr(s, "public", None) is False for s in subnets):
+            return False
+        return None
 
     def release_unused(self, chosen: dict[str, str], used_zone: str) -> None:
         """Give back pre-deducted IPs for the zones the launch didn't use."""

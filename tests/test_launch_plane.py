@@ -102,6 +102,12 @@ class TestBootstrap:
         assert "apiServerEndpoint" in script
         assert "--max-pods=10" in script
 
+    def test_nodeadm_carries_service_cidr(self):
+        info = ClusterInfo(name="prod", endpoint="https://api", ca_bundle="Q0E=",
+                           service_cidr="10.100.0.0/16")
+        script = bootstrapper_for("nodeadm", info).script()
+        assert 'cidr: "10.100.0.0/16"' in script
+
     def test_custom_family_verbatim(self):
         script = bootstrapper_for("custom", self.info, custom="my-exact-script").script()
         assert script == "my-exact-script"
@@ -127,6 +133,34 @@ class TestLaunchTemplates:
         # launched requests carried the template
         reqs = [r for batch in env.cloud.calls["create_fleet"] for r in batch]
         assert all(r.launch_template_name for r in reqs)
+
+    def test_public_ip_disabled_only_when_all_subnets_private(self, env):
+        """parity: subnet.go:119-130 AssociatePublicIPAddressValue — the
+        template pins associatePublicIP=False iff every resolved subnet is
+        known private; any public subnet leaves the cloud default (None)."""
+        for s in env.cloud.subnets:
+            s.public = False
+        self._provision(env)
+        lts = env.cloud.describe_launch_templates()
+        assert all(lt.associate_public_ip is False for lt in lts)
+        # a public subnet flips the inference back to "leave default" and
+        # the changed parameter mints a NEW template hash
+        env.cloud.subnets[0].public = True
+        env.cloudprovider.subnets.reset()
+        env.cloudprovider.launch_templates._cache.flush()
+        self._provision(env, n=2)
+        lts2 = env.cloud.describe_launch_templates()
+        assert any(lt.associate_public_ip is None for lt in lts2)
+
+    def test_gc_requeue_backs_off_after_20_clean_passes(self, env):
+        """parity: garbagecollection/controller.go:84 — 10s requeue for the
+        first 20 successful passes, 2m steady-state after."""
+        assert env.garbagecollection.interval_s == 10.0
+        for _ in range(20):
+            env.garbagecollection.reconcile()
+        assert env.garbagecollection.interval_s == 10.0
+        env.garbagecollection.reconcile()
+        assert env.garbagecollection.interval_s == 120.0
 
     def test_template_deduped_across_launches(self, env):
         self._provision(env, n=2)
