@@ -166,6 +166,16 @@ class TestOperatorWiring:
         assert not op.cluster.pending_pods()
         assert len(op.cluster.nodes) >= 1
 
+    def test_service_cidr_discovered_from_backend(self):
+        """parity: launchtemplate.go:429-450 ResolveClusterCIDR — the
+        operator resolves the service CIDR from the backend's cluster
+        description and the nodeadm bootstrap carries it."""
+        op = new_operator(Options(solver_backend="host"))
+        info = op.cloudprovider.launch_templates.cluster_info
+        assert info.service_cidr == "10.100.0.0/16"
+        op6 = new_operator(Options(solver_backend="host", ip_family="ipv6"))
+        assert op6.cloudprovider.launch_templates.cluster_info.service_cidr == "fd00:10::/108"
+
     def test_interruption_gated_on_queue_option(self):
         from karpenter_provider_aws_tpu.fake import FakeQueue
 
