@@ -10,6 +10,7 @@ view plus ``designs/consolidation.md:24-36`` cost inputs.
 from __future__ import annotations
 
 import itertools
+import threading
 from dataclasses import dataclass, field
 from typing import Mapping, Optional, Sequence
 
@@ -18,6 +19,15 @@ from .resources import ResourceVector
 from . import labels as lbl
 
 _uid_counter = itertools.count()
+# scheduling_key -> interned token (see Pod.scheduling_token). Never cleared:
+# re-interning a key under a fresh number would hand equal-key pods different
+# tokens, and the encoder grouping by token would then SPLIT a constraint-
+# coupled group (atomic co-location, self-matching anti-affinity, spread) —
+# a correctness bug, not an efficiency loss. Growth is bounded by the number
+# of distinct scheduling shapes seen over the process lifetime (~1KB each).
+_TOKEN_INTERN: dict[tuple, int] = {}
+_TOKEN_LOCK = threading.Lock()
+_token_counter = itertools.count()
 
 
 @dataclass(frozen=True)
@@ -88,6 +98,7 @@ class Pod:
     owner_key: str = ""  # ReplicaSet/Deployment identity for grouping
     # lazily computed by scheduling_key(); excluded from comparisons
     _scheduling_key: Optional[tuple] = field(default=None, repr=False, compare=False)
+    _scheduling_token: Optional[int] = field(default=None, repr=False, compare=False)
     # bumped on every scheduling-relevant field assignment; cross-solve
     # caches (ops.encode._PROBLEM_CACHE) key on (id, _version) pairs so a
     # sanctioned field reassignment can never serve a stale encoding
@@ -115,6 +126,7 @@ class Pod:
     def __setattr__(self, name, value):
         if name in Pod._KEY_FIELDS and getattr(self, "_scheduling_key", None) is not None:
             object.__setattr__(self, "_scheduling_key", None)
+            object.__setattr__(self, "_scheduling_token", None)
         if name in Pod._VERSION_FIELDS:
             object.__setattr__(self, "_version", getattr(self, "_version", 0) + 1)
         object.__setattr__(self, name, value)
@@ -197,6 +209,22 @@ class Pod:
         return None
 
     # -- grouping (dedup) key ----------------------------------------------
+    def scheduling_token(self) -> int:
+        """Process-interned integer standing for scheduling_key(): equal keys
+        share one token. Grouping 50k pods hashes 50k large nested tuples
+        per solve through the dict; the token reduces that to one tuple hash
+        per pod LIFETIME (the token memoizes alongside the key and
+        __setattr__ invalidation clears both)."""
+        t = self._scheduling_token
+        if t is None:
+            key = self.scheduling_key()
+            with _TOKEN_LOCK:  # atomic check-then-insert: concurrent solves
+                t = _TOKEN_INTERN.get(key)  # must never mint two tokens for
+                if t is None:               # one key (group-splitting bug)
+                    t = _TOKEN_INTERN[key] = next(_token_counter)
+            self._scheduling_token = t
+        return t
+
     def scheduling_key(self) -> tuple:
         """Pods with equal keys are interchangeable to the solver; the
         encoder collapses them into one group with a count (the TPU-native

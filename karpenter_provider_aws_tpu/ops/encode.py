@@ -374,10 +374,10 @@ def encode_problem(
     # tolerations, topology), so taint/compat checks on 50k pods collapse to
     # checks on ~dozens of groups — this is the per-pod loop the TPU design
     # moves off the hot path (SURVEY.md section 7).
-    raw_groups: dict[tuple, list[Pod]] = {}
+    raw_groups: dict[int, list[Pod]] = {}  # keyed by interned scheduling token
     for pod in pods:
-        raw_groups.setdefault(pod.scheduling_key(), []).append(pod)
-    groups: dict[tuple, list[Pod]] = {}
+        raw_groups.setdefault(pod.scheduling_token(), []).append(pod)
+    groups: dict[int, list[Pod]] = {}
     unencodable: list[tuple[Pod, str]] = []
     for key, plist in raw_groups.items():
         pod = plist[0]
@@ -635,7 +635,7 @@ def encode_problem(
             requests[gi] = pod.requests.v
             counts[gi] = len(plist)
         max_per_node[gi] = mpn
-        ck = pod.scheduling_key()
+        ck = pod.scheduling_token()
         hit = shared.get(ck)
         if hit is None:
             reqs = _group_requirements(pod, nodepool, include_preferences)
