@@ -676,6 +676,12 @@ class TPUSolver:
         self._dev_cache_budget = int(
             os.environ.get("KARPENTER_TPU_DEVCACHE_MB", "256")
         ) * (1 << 20)
+        # FFD backend: "auto" resolves to the Pallas kernel on TPU (VMEM-
+        # resident state, one kernel for the whole group scan) and the XLA
+        # scan elsewhere; KARPENTER_TPU_FFD forces xla / pallas /
+        # pallas-interpret. A Pallas failure under auto falls back to xla
+        # for the solver's lifetime.
+        self._ffd_mode = os.environ.get("KARPENTER_TPU_FFD", "auto")
 
     def _dput(self, x: np.ndarray):
         """device_put through the content-addressed cache."""
@@ -716,7 +722,7 @@ class TPUSolver:
         GB = bucket(G)
         padded = pad_problem(problem, GB)
 
-        def run(N: int):
+        def _run_xla(N: int):
             state = None
             if pre_rows:
                 from ..ops.ffd import _State as _S
@@ -772,6 +778,59 @@ class TPUSolver:
                 )
                 placed_chunks.append(res.placed)
                 unplaced_chunks.append(res.unplaced)
+            return state, placed_chunks, unplaced_chunks
+
+        def _run_pallas(N: int):
+            # One kernel over the whole group axis: node state stays in VMEM
+            # across all G steps instead of streaming [N, R] through HBM per
+            # scan iteration (see ops/ffd_pallas.py).
+            from ..ops.ffd import _State as _S
+            from ..ops.ffd_pallas import ffd_solve_pallas
+
+            init = None
+            if pre_rows:
+                nm, ptype, pused, pcap, pwin = pre_rows
+                init = (ptype, np.zeros(len(ptype), np.float32), pused, pcap,
+                        pwin, n_pre)
+            res = ffd_solve_pallas(
+                padded.requests, padded.counts, padded.compat,
+                padded.capacity, padded.price, padded.group_window,
+                padded.type_window, max_per_node=padded.max_per_node,
+                max_nodes=N, init_state=init, n_pre=n_pre,
+                interpret=self._ffd_mode == "pallas-interpret",
+                dput=self._dput,
+            )
+            state = _S(
+                node_type=res.node_type, node_price=res.node_price,
+                used=res.used, node_cap=res.node_cap,
+                node_window=res.node_window, n_open=res.n_open,
+            )
+            return state, [res.placed], [res.unplaced]
+
+        def run(N: int):
+            mode = self._ffd_mode
+            if mode == "auto":
+                mode = "pallas" if jax.default_backend() == "tpu" else "xla"
+            if mode.startswith("pallas"):
+                try:
+                    state, placed_chunks, unplaced_chunks = _run_pallas(N)
+                except Exception as e:
+                    if self._ffd_mode != "auto":
+                        raise
+                    # auto-selected pallas failed (e.g. Mosaic lowering gap):
+                    # fall back to the XLA scan for this solver's lifetime —
+                    # LOUDLY, or nobody ever learns the kernel isn't running
+                    import logging
+
+                    logging.getLogger("karpenter.tpu.solver").warning(
+                        "pallas FFD backend failed; falling back to the XLA "
+                        "scan for this solver: %s: %s", type(e).__name__, e,
+                    )
+                    self.timings["pallas_fallback"] = f"{type(e).__name__}: {e}"[:200]
+                    self._ffd_mode = "xla"
+                    state, placed_chunks, unplaced_chunks = _run_xla(N)
+            else:
+                state, placed_chunks, unplaced_chunks = _run_xla(N)
 
             # Launch-alternative ranking runs ON DEVICE (one fused [N, T]
             # program) instead of an argsort per opened node on the host —
