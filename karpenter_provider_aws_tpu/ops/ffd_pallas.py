@@ -391,6 +391,7 @@ def ffd_solve_pallas(
     n_pre=0,
     interpret: bool = False,
     dput=None,
+    pack_memo: Optional[dict] = None,
 ) -> FFDResult:
     """Drop-in for ``ffd.ffd_solve`` backed by the Pallas kernel.
 
@@ -431,19 +432,29 @@ def ffd_solve_pallas(
     if n_words > LANE:
         raise ValueError(f"type axis {T} too wide for compat bit block")
 
-    requests_l = np.zeros((G, R_LANES), dtype=np.float32)
-    requests_l[:, :R] = requests
-    price_p = np.full((G, TP), _BIG, dtype=np.float32)
-    price_p[:, :T] = np.where(np.isfinite(price), price, _BIG)
-    compat_f = np.zeros((G, TP), dtype=np.float32)
-    compat_f[:, :T] = compat
-    capacity_t = np.zeros((RP, TP), dtype=np.float32)
-    capacity_t[:R, :T] = capacity.T
-    cbits = np.zeros((G, LANE), dtype=np.int32)
-    cbits[:, :n_words] = pack_compat_bits(compat, n_words)
-    twbits = np.zeros((1, TP), dtype=np.int32)
-    twbits[0, :T] = pack_window_bits(type_window)
-    gwbits = pack_window_bits(group_window)
+    # The packed problem tensors are N-independent; callers that re-solve a
+    # cached problem (the reconcile loop) pass a problem-scoped dict and pay
+    # the numpy packing once.
+    packed = pack_memo.get("packed") if pack_memo is not None else None
+    if packed is None:
+        requests_l = np.zeros((G, R_LANES), dtype=np.float32)
+        requests_l[:, :R] = requests
+        price_p = np.full((G, TP), _BIG, dtype=np.float32)
+        price_p[:, :T] = np.where(np.isfinite(price), price, _BIG)
+        compat_f = np.zeros((G, TP), dtype=np.float32)
+        compat_f[:, :T] = compat
+        capacity_t = np.zeros((RP, TP), dtype=np.float32)
+        capacity_t[:R, :T] = capacity.T
+        cbits = np.zeros((G, LANE), dtype=np.int32)
+        cbits[:, :n_words] = pack_compat_bits(compat, n_words)
+        twbits = np.zeros((1, TP), dtype=np.int32)
+        twbits[0, :T] = pack_window_bits(type_window)
+        gwbits = pack_window_bits(group_window)
+        packed = (requests_l, price_p, compat_f, capacity_t, cbits, twbits,
+                  gwbits)
+        if pack_memo is not None:
+            pack_memo["packed"] = packed
+    (requests_l, price_p, compat_f, capacity_t, cbits, twbits, gwbits) = packed
 
     ntype0 = np.zeros((1, N), dtype=np.int32)
     nprice0 = np.zeros((1, N), dtype=np.float32)

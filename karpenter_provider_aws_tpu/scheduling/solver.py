@@ -720,7 +720,12 @@ class TPUSolver:
         names = pre_rows[0] if pre_rows else []
 
         GB = bucket(G)
-        padded = pad_problem(problem, GB)
+        # pad_problem copies unless GB == G; memoize on the (cached) problem
+        # so re-solves reuse one padded object and its packed-tensor memo
+        pad_memo = problem.__dict__.setdefault("_pad_memo", {})
+        padded = pad_memo.get(GB)
+        if padded is None:
+            padded = pad_memo[GB] = pad_problem(problem, GB)
 
         def _run_xla(N: int):
             state = None
@@ -792,6 +797,7 @@ class TPUSolver:
                 nm, ptype, pused, pcap, pwin = pre_rows
                 init = (ptype, np.zeros(len(ptype), np.float32), pused, pcap,
                         pwin, n_pre)
+            memo = padded.__dict__.setdefault("_pallas_pack_memo", {})
             res = ffd_solve_pallas(
                 padded.requests, padded.counts, padded.compat,
                 padded.capacity, padded.price, padded.group_window,
@@ -799,6 +805,7 @@ class TPUSolver:
                 max_nodes=N, init_state=init, n_pre=n_pre,
                 interpret=self._ffd_mode == "pallas-interpret",
                 dput=self._dput,
+                pack_memo=memo,
             )
             state = _S(
                 node_type=res.node_type, node_price=res.node_price,
