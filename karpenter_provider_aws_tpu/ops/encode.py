@@ -39,6 +39,104 @@ def bucket(n: int, minimum: int = 8) -> int:
     return b
 
 
+def water_fill(counts: dict, live, skew: int, P: int) -> tuple[dict, dict, int]:
+    """Skew-capped greedy water-fill, batched by level.
+
+    Exactly replicates the sequential rule (kube-scheduler's per-pod
+    DoNotSchedule check): each pod goes to the lowest-(count, index) LIVE
+    zone whose count would stay within ``floor + skew``, where ``floor`` is
+    the min count over ALL zones in ``counts`` (dead zones pin it). The
+    per-pod loop was the cold-encode hotspot at 10k+ spread pods; batching
+    by level places every eligible min-level zone's pod in one step (the
+    sequential order provably interleaves exactly that way: ties break by
+    index, and raising the zones at the min level cannot change any
+    selected zone's eligibility mid-level).
+
+    Returns (updated counts, assignment per zone, placed).
+    """
+    zis = sorted(counts)
+    c = np.array([counts[z] for z in zis], dtype=np.int64)
+    is_live = np.array([z in live for z in zis], dtype=bool)
+    assign = np.zeros(len(zis), dtype=np.int64)
+    placed = 0
+    while placed < P and len(zis):
+        floor = int(c.min())
+        elig = is_live & (c + 1 - floor <= skew)
+        if not elig.any():
+            break
+        m = int(c[elig].min())
+        sel = elig & (c == m)           # the working set S, all at level m
+        n_sel = int(sel.sum())
+        # Batch S upward by WHOLE LEVELS to the next barrier: the
+        # sequential rule provably cycles S in index order level by level
+        # until (a) the next ELIGIBLE zone's level is reached (it joins S),
+        # (b) the floor/skew interaction changes — the floor is pinned by a
+        # non-eligible zone at or below m (cap = pin + skew), or S climbs
+        # onto a non-eligible zone's level (floor stops riding; recompute) —
+        # or (c) the pod budget runs out.
+        barrier = P
+        above = elig & (c > m)
+        if above.any():
+            barrier = min(barrier, int(c[above].min()) - m)   # join
+        non_elig = c[~elig]
+        if non_elig.size:
+            f0n = int(non_elig.min())
+            barrier = min(
+                barrier, (f0n + skew - m) if f0n <= m else (f0n - m)
+            )
+        full_levels = (P - placed) // n_sel
+        delta = min(barrier, full_levels)
+        if delta >= 1:
+            c[sel] += delta
+            assign[sel] += delta
+            placed += delta * n_sel
+            continue
+        # budget < one full level: the remainder goes to S in index order
+        idxs = np.flatnonzero(sel)[: P - placed]
+        c[idxs] += 1
+        assign[idxs] += 1
+        placed += len(idxs)
+    return (
+        {z: int(v) for z, v in zip(zis, c)},
+        {z: int(a) for z, a in zip(zis, assign)},
+        placed,
+    )
+
+
+def balanced_fill(counts: dict, live, P: int) -> tuple[dict, int]:
+    """Uncapped balanced fill over LIVE zones (the ScheduleAnyway
+    relaxation): every pod to the lowest-(count, index) live zone. Closed
+    form: raise minima to a common water level, remainder to the
+    lowest-index zones at the level. Returns (assignment, placed)."""
+    zis = [z for z in sorted(counts) if z in live]
+    if not zis or P <= 0:
+        return {}, 0
+    c = np.array([counts[z] for z in zis], dtype=np.int64)
+    order = np.argsort(c, kind="stable")
+    cs = c[order]
+    # find the largest level L with sum(max(0, L - c)) <= P
+    prefix = np.cumsum(cs)
+    k = len(cs)
+    lo, hi = int(cs[0]), int(cs[-1]) + (P // k) + 1
+    while lo < hi:
+        mid = (lo + hi + 1) // 2
+        j = int(np.searchsorted(cs, mid, side="left"))
+        cost = mid * j - (int(prefix[j - 1]) if j else 0)
+        if cost <= P:
+            lo = mid
+        else:
+            hi = mid - 1
+    L = lo
+    j = int(np.searchsorted(cs, L, side="left"))
+    cost = L * j - (int(prefix[j - 1]) if j else 0)
+    assign = np.maximum(L - c, 0)
+    r = P - cost
+    if r > 0:
+        at_level = np.flatnonzero(np.maximum(c, L) == L)  # index order
+        assign[at_level[:r]] += 1
+    return {z: int(a) for z, a in zip(zis, assign) if a}, int(assign.sum())
+
+
 class ZoneOccupancy:
     """Per-zone counts of already-bound pods, for topology accounting.
 
@@ -545,29 +643,15 @@ def encode_problem(
             # zones still count toward the domain minimum, so a fully-ICE'd
             # zone caps how high the others may grow — DoNotSchedule
             # semantics, kube-scheduler's per-pod check).
-            counts = dict(e)
-            assign = {zi: 0 for zi in allowed_z}
-            placed = 0
-            for _ in range(len(plist)):
-                floor = min(counts.values())
-                cands = [zi for zi in live if counts[zi] + 1 - floor <= skew]
-                if not cands:
-                    break
-                zi = min(cands, key=lambda z: (counts[z], z))
-                counts[zi] += 1
-                assign[zi] += 1
-                placed += 1
-            if mode == "soft_spread":
+            counts, assign, placed = water_fill(e, live, skew, len(plist))
+            if mode == "soft_spread" and placed < len(plist) and live:
                 # ScheduleAnyway: the skew cap is a preference — relax it
                 # for the remainder instead of failing, still favoring the
                 # emptiest live zones (kube-scheduler scores, we round-robin)
-                for _ in range(len(plist) - placed):
-                    if not live:
-                        break
-                    zi = min(live, key=lambda z: (counts[z], z))
-                    counts[zi] += 1
-                    assign[zi] += 1
-                    placed += 1
+                extra, more = balanced_fill(counts, live, len(plist) - placed)
+                for zi, a in extra.items():
+                    assign[zi] = assign.get(zi, 0) + a
+                placed += more
             start = 0
             for zi in allowed_z:
                 take = assign[zi]

@@ -302,3 +302,83 @@ class TestHistogramExposition:
         assert 't_bucket{le="5.0"} 1' in text
         assert 't_bucket{le="+Inf"} 1' in text
         assert "t_count 1" in text
+
+
+class TestWaterFillEquivalence:
+    """The batched water_fill/balanced_fill must replicate the sequential
+    per-pod rule EXACTLY (it decides zone-spread placement shares)."""
+
+    @staticmethod
+    def _seq_water(counts, live, skew, P):
+        counts = dict(counts)
+        assign = {z: 0 for z in counts}
+        placed = 0
+        for _ in range(P):
+            floor = min(counts.values())
+            cands = [z for z in live if counts[z] + 1 - floor <= skew]
+            if not cands:
+                break
+            zi = min(cands, key=lambda z: (counts[z], z))
+            counts[zi] += 1
+            assign[zi] += 1
+            placed += 1
+        return counts, assign, placed
+
+    @staticmethod
+    def _seq_balanced(counts, live, P):
+        counts = dict(counts)
+        assign = {}
+        placed = 0
+        for _ in range(P):
+            if not live:
+                break
+            zi = min(live, key=lambda z: (counts[z], z))
+            counts[zi] += 1
+            assign[zi] = assign.get(zi, 0) + 1
+            placed += 1
+        return assign, placed
+
+    def test_water_fill_matches_sequential(self):
+        import numpy as np
+
+        from karpenter_provider_aws_tpu.ops.encode import water_fill
+
+        rng = np.random.RandomState(0)
+        for trial in range(300):
+            nz = rng.randint(1, 7)
+            counts = {z: int(rng.randint(0, 6)) for z in range(nz)}
+            live = {z for z in range(nz) if rng.rand() < 0.7}
+            skew = int(rng.randint(1, 4))
+            P = int(rng.randint(0, 40))
+            want = self._seq_water(counts, live, skew, P)
+            got = water_fill(counts, live, skew, P)
+            assert got[1] == want[1] and got[2] == want[2], (
+                trial, counts, live, skew, P, got, want
+            )
+            assert got[0] == want[0]
+
+    def test_water_fill_single_live_zone_jump(self):
+        from karpenter_provider_aws_tpu.ops.encode import water_fill
+
+        # lone live zone below the rest: the fast path must not overshoot
+        counts = {0: 0, 1: 9, 2: 9}
+        want = self._seq_water(counts, {0}, 2, 30)
+        got = water_fill(counts, {0}, 2, 30)
+        assert got[1] == want[1] and got[2] == want[2]
+
+    def test_balanced_fill_matches_sequential(self):
+        import numpy as np
+
+        from karpenter_provider_aws_tpu.ops.encode import balanced_fill
+
+        rng = np.random.RandomState(1)
+        for trial in range(300):
+            nz = rng.randint(1, 7)
+            counts = {z: int(rng.randint(0, 8)) for z in range(nz)}
+            live = {z for z in range(nz) if rng.rand() < 0.7}
+            P = int(rng.randint(0, 50))
+            want = self._seq_balanced(counts, live, P)
+            got = balanced_fill(counts, live, P)
+            assert got[0] == want[0] and got[1] == want[1], (
+                trial, counts, live, P, got, want
+            )
