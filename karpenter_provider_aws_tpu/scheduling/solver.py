@@ -680,8 +680,11 @@ class TPUSolver:
         # resident state, one kernel for the whole group scan) and the XLA
         # scan elsewhere; KARPENTER_TPU_FFD forces xla / pallas /
         # pallas-interpret. A Pallas failure under auto falls back to xla
-        # for the solver's lifetime.
+        # for the solver's lifetime — and the FIRST auto-pallas solve is
+        # cross-checked on device against the XLA scan (a Mosaic miscompile
+        # would otherwise ship silently wrong plans).
         self._ffd_mode = os.environ.get("KARPENTER_TPU_FFD", "auto")
+        self._pallas_verified = False
 
     def _dput(self, x: np.ndarray):
         """device_put through the content-addressed cache."""
@@ -821,6 +824,25 @@ class TPUSolver:
             if mode.startswith("pallas"):
                 try:
                     state, placed_chunks, unplaced_chunks = _run_pallas(N)
+                    if self._ffd_mode == "auto" and not self._pallas_verified:
+                        # one-time compiled-kernel self-check: both backends
+                        # are deterministic implementations of the same
+                        # algorithm, so any divergence is a miscompile
+                        sx, px, ux = _run_xla(N)
+                        same = bool(
+                            jnp.array_equal(placed_chunks[0],
+                                            jnp.concatenate(px, axis=0))
+                            and jnp.array_equal(
+                                jnp.concatenate(unplaced_chunks),
+                                jnp.concatenate(ux))
+                            and int(state.n_open) == int(sx.n_open)
+                        )
+                        if not same:
+                            raise RuntimeError(
+                                "pallas FFD kernel diverged from the XLA "
+                                "scan on the verification solve"
+                            )
+                        self._pallas_verified = True
                 except Exception as e:
                     if self._ffd_mode != "auto":
                         raise

@@ -169,6 +169,79 @@ def test_compat_bit_packing():
             assert ((int(bits[g, w]) >> b) & 1) == int(compat[g, t])
 
 
+def _auto_tpu_solver(monkeypatch, pallas_impl):
+    """A TPUSolver in 'auto' mode with the backend probe forced to 'tpu'
+    and the pallas entry point replaced (interpret under the hood)."""
+    import karpenter_provider_aws_tpu.ops.ffd_pallas as fp
+    import karpenter_provider_aws_tpu.scheduling.solver as sv
+    from karpenter_provider_aws_tpu.scheduling import TPUSolver
+
+    monkeypatch.setattr(sv.jax if hasattr(sv, "jax") else __import__("jax"),
+                        "default_backend", lambda: "tpu")
+    monkeypatch.setattr(fp, "ffd_solve_pallas", pallas_impl)
+    s = TPUSolver()
+    s._ffd_mode = "auto"
+    return s
+
+
+def _solve_small(s):
+    from karpenter_provider_aws_tpu.catalog import CatalogProvider
+    from karpenter_provider_aws_tpu.models import NodePool, Operator, Requirement
+    from karpenter_provider_aws_tpu.models import labels as lbl
+    from karpenter_provider_aws_tpu.models.pod import make_pods
+
+    catalog = CatalogProvider()
+    pool = NodePool(
+        name="default",
+        requirements=[Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m", "r"))],
+    )
+    pods = make_pods(60, "w", {"cpu": "500m", "memory": "1Gi"})
+    return s.solve(pods, [pool], catalog)
+
+
+def test_auto_mode_first_solve_verifies_against_xla(monkeypatch):
+    import functools
+
+    from karpenter_provider_aws_tpu.ops.ffd_pallas import ffd_solve_pallas
+
+    interp = functools.partial(ffd_solve_pallas, interpret=True)
+
+    def impl(*a, interpret=False, **kw):
+        kw.pop("dput", None)
+        return interp(*a, **kw)
+
+    s = _auto_tpu_solver(monkeypatch, impl)
+    res = _solve_small(s)
+    assert res.pods_placed() == 60
+    assert s._pallas_verified, "first auto solve must run the self-check"
+    assert s._ffd_mode == "auto"  # still on pallas
+
+
+def test_auto_mode_divergence_falls_back_to_xla(monkeypatch):
+    import dataclasses
+    import functools
+
+    import jax.numpy as jnp
+
+    from karpenter_provider_aws_tpu.ops.ffd_pallas import ffd_solve_pallas
+
+    interp = functools.partial(ffd_solve_pallas, interpret=True)
+
+    def corrupted(*a, interpret=False, **kw):
+        kw.pop("dput", None)
+        res = interp(*a, **kw)
+        # simulate a miscompile: one placement row zeroed out
+        return res._replace(placed=res.placed.at[:, 0].set(0))
+
+    s = _auto_tpu_solver(monkeypatch, corrupted)
+    res = _solve_small(s)
+    # the divergence must be caught, the solver pinned to xla, and the
+    # RETURNED plan computed by the trustworthy backend
+    assert s._ffd_mode == "xla"
+    assert "pallas_fallback" in s.timings
+    assert res.pods_placed() == 60
+
+
 def test_solver_integration_pallas_backend(monkeypatch):
     """TPUSolver with KARPENTER_TPU_FFD=pallas (interpret on CPU) produces
     the same plan as the XLA path end-to-end."""
