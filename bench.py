@@ -44,8 +44,13 @@ PROBE_ATTEMPTS = int(os.environ.get("BENCH_PROBE_ATTEMPTS", 3))
 # mid-transfer), and a wedge does not heal on the probe's timescale —
 # better to reach the CPU fallback with time to spare.
 PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", 900))
-PROBE_RETRY_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_RETRY_TIMEOUT_S", 180))
-PROBE_SLEEP_S = float(os.environ.get("BENCH_PROBE_SLEEP_S", 10))
+# A wedged tunnel heals on the server's session-reap timescale (tens of
+# minutes, observed >1h) — short retry windows after a full-window hang just
+# burn attempts, and an aborted half-connected probe can re-wedge it. Long
+# retry windows + a long sleep give one recovery a real chance while still
+# reaching the CPU fallback within ~45 min worst case.
+PROBE_RETRY_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_RETRY_TIMEOUT_S", 600))
+PROBE_SLEEP_S = float(os.environ.get("BENCH_PROBE_SLEEP_S", 60))
 _FALLBACK_ENV = "BENCH_CPU_FALLBACK"
 
 _PROBE_SNIPPET = (
