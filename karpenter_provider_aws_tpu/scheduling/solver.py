@@ -842,6 +842,30 @@ class TPUSolver:
                                 "pallas FFD kernel diverged from the XLA "
                                 "scan on the verification solve"
                             )
+                        # both backends are warm now — time them and pin the
+                        # faster for this solver's lifetime (a kernel that
+                        # loses to the fused scan must not degrade serving)
+                        import jax as _jax
+
+                        def _clock(fn):
+                            best = float("inf")
+                            for _ in range(2):
+                                t0 = time.perf_counter()
+                                st, _pc, _uc = fn(N)
+                                _jax.block_until_ready(st.n_open)
+                                best = min(best, time.perf_counter() - t0)
+                            return best
+
+                        tp, tx = _clock(_run_pallas), _clock(_run_xla)
+                        if tx < tp:
+                            import logging
+
+                            logging.getLogger("karpenter.tpu.solver").info(
+                                "XLA scan beats pallas FFD here "
+                                "(%.1fms vs %.1fms); pinning xla",
+                                tx * 1e3, tp * 1e3,
+                            )
+                            self._ffd_mode = "xla"
                         self._pallas_verified = True
                 except Exception as e:
                     if self._ffd_mode != "auto":

@@ -214,7 +214,10 @@ def test_auto_mode_first_solve_verifies_against_xla(monkeypatch):
     res = _solve_small(s)
     assert res.pods_placed() == 60
     assert s._pallas_verified, "first auto solve must run the self-check"
-    assert s._ffd_mode == "auto"  # still on pallas
+    # the self-check also races the backends and may legitimately pin the
+    # faster one (interpret-mode pallas always loses on CPU)
+    assert s._ffd_mode in ("auto", "xla")
+    assert "pallas_fallback" not in s.timings  # no DIVERGENCE occurred
 
 
 def test_auto_mode_divergence_falls_back_to_xla(monkeypatch):
