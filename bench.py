@@ -182,28 +182,73 @@ def measure(num_pods: int, iters: int, warmup: int, max_nodes: int) -> dict:
 
     import gc
 
-    times = []
-    gc.collect()
-    gc.freeze()
-    gc.disable()
-    try:
-        for _ in range(iters):
-            t0 = time.perf_counter()
-            run()
-            times.append((time.perf_counter() - t0) * 1000.0)
-    finally:
-        gc.enable()
-        gc.unfreeze()
+    def timed_loop(fn, n):
+        out = []
+        gc.collect()
+        gc.freeze()
+        gc.disable()
+        try:
+            for _ in range(n):
+                t0 = time.perf_counter()
+                fn()
+                out.append((time.perf_counter() - t0) * 1000.0)
+        finally:
+            gc.enable()
+            gc.unfreeze()
+        return out
+
+    times = timed_loop(run, iters)
     p99 = float(np.percentile(times, 99))
-    return {
+    result = {
         "metric": f"p99_ffd_solve_latency_{num_pods}pods_x_{problem.capacity.shape[0]}types",
         "value": round(p99, 3),
         "unit": "ms",
         "vs_baseline": round(TARGET_MS / p99, 3),
         "p50_ms": round(float(np.percentile(times, 50)), 3),
         "device": jax.devices()[0].platform,
+        "backend": "xla-scan",
         "iters": iters,
     }
+
+    # On TPU, also time the Pallas kernel (VMEM-resident state, one kernel
+    # for the whole group scan) and report the better backend as the
+    # headline — both figures stay in the line for comparison.
+    if jax.default_backend() == "tpu":
+        try:
+            from karpenter_provider_aws_tpu.ops.ffd_pallas import ffd_solve_pallas
+
+            def run_pallas():
+                res = ffd_solve_pallas(
+                    problem.requests, problem.counts, problem.compat,
+                    problem.capacity, problem.price, problem.group_window,
+                    problem.type_window, max_per_node=problem.max_per_node,
+                    max_nodes=max_nodes,
+                )
+                jax.block_until_ready(res.node_type)
+                return res
+
+            res_p = run_pallas()  # compile
+            # correctness gate: the kernel must match the scan exactly
+            if int(np.asarray(res_p.unplaced).sum()) != unplaced or not np.array_equal(
+                np.asarray(res_p.placed), np.asarray(res.placed)
+            ):
+                raise RuntimeError("pallas kernel diverged from the XLA scan")
+            for _ in range(warmup):
+                run_pallas()
+            times_p = timed_loop(run_pallas, iters)
+            p99_p = float(np.percentile(times_p, 99))
+            result["xla_p99_ms"] = result["value"]
+            result["pallas_p99_ms"] = round(p99_p, 3)
+            if p99_p < p99:
+                result["value"] = round(p99_p, 3)
+                result["vs_baseline"] = round(TARGET_MS / p99_p, 3)
+                result["p50_ms"] = round(float(np.percentile(times_p, 50)), 3)
+                result["backend"] = "pallas"
+        except Exception as e:
+            print(f"pallas headline skipped: {type(e).__name__}: {e}", file=sys.stderr)
+            result["pallas_error"] = f"{type(e).__name__}: {e}"[:200]
+
+    return result
 
 
 def run_config_detail(scale: float, iters: int) -> None:
