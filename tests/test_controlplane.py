@@ -160,6 +160,39 @@ class TestICEFeedback:
         sent2 = env.cloud.calls["create_fleet"][-1]
         assert {t for r in sent2 for t in r.instance_type_options} == {big}
 
+    def test_spot_filter_ignores_unattainable_od_floor(self, env):
+        """An ICE-cached on-demand price is not a price anyone can launch
+        at: it must not become the comparison floor that evicts the only
+        genuinely launchable (spot) candidate (reference computes over
+        Offerings.Available() only)."""
+        from karpenter_provider_aws_tpu.controllers.provisioning import launch_claim
+        from karpenter_provider_aws_tpu.scheduling.solver import NodeSpec
+
+        pool, _ = env.apply_defaults(cmr_pool())
+        cheap, other = "c5.large", "m5.large"
+        # cheap's ON-DEMAND is ICE'd everywhere and its spot is pricey;
+        # other's spot is live and mid-priced (above cheap's dead OD price)
+        for z in env.catalog.zones:
+            env.catalog.unavailable.mark_unavailable(cheap, z, "on-demand")
+        od_cheap = env.catalog.pricing.on_demand_price(env.catalog.get(cheap))
+        env.catalog.pricing.update_spot(
+            {(cheap, z): od_cheap * 5 for z in env.catalog.zones}
+            | {(other, z): od_cheap * 1.5 for z in env.catalog.zones}
+        )
+        spec = NodeSpec(
+            nodepool_name=pool.name,
+            instance_type_options=[cheap, other],
+            zone_options=["zone-a"],
+            capacity_type_options=["spot", "on-demand"],
+            offering_options=[("zone-a", "spot"), ("zone-a", "on-demand")],
+        )
+        claim = launch_claim(env.cluster, env.cloudprovider, pool, spec)
+        assert claim is not None and claim.is_launched()
+        sent = env.cloud.calls["create_fleet"][-1]
+        types_sent = {t for r in sent for t in r.instance_type_options}
+        # the genuinely launchable candidate survived the filter
+        assert other in types_sent
+
     def test_spot_filter_recomputes_offerings_and_gates_fallback(self, env):
         """Dropping the only type with a live spot offering must retire the
         spot pair and expose the launch as an on-demand fallback — which the
