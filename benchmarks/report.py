@@ -1,0 +1,81 @@
+"""Summarize BENCH_DETAIL.jsonl: latest row per benchmark -> BENCH_SUMMARY.md.
+
+Run: python -m benchmarks.report
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+
+
+def latest_rows(path: Path) -> dict[str, dict]:
+    rows: dict[str, dict] = {}
+    if not path.exists():
+        return rows
+    for line in path.read_text().splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        key = row.get("benchmark") or row.get("metric")
+        if not key:
+            continue
+        # prefer full-scale rows; within a scale, the newest wins
+        prev = rows.get(key)
+        if prev is not None and prev.get("scale", 1.0) > row.get("scale", 1.0):
+            continue
+        if (
+            prev is None
+            or row.get("scale", 1.0) > prev.get("scale", 1.0)
+            or row.get("run_at_unix", 0) >= prev.get("run_at_unix", 0)
+        ):
+            rows[key] = row
+    return rows
+
+
+def fmt(row: dict) -> str:
+    bits = []
+    for k in ("pods", "nodes", "messages"):
+        if k in row:
+            bits.append(f"{row[k]:,} {k}")
+    for k in ("value", "p99_ms", "p95_ms", "p50_ms", "msgs_per_sec",
+              "pallas_p99_ms", "vmap_p99_ms", "native_p99_ms", "encode_ms",
+              "controller_pass_ms", "cost_vs_greedy"):
+        if k in row and row[k] is not None:
+            v = row[k]
+            bits.append(f"{k}={v:,.3f}" if isinstance(v, float) else f"{k}={v}")
+    if "device" in row:
+        bits.append(f"[{row['device']}]")
+    if "backend" in row:
+        bits.append(f"[{row['backend']}]")
+    return " · ".join(bits)
+
+
+def main() -> None:
+    rows = latest_rows(ROOT / "BENCH_DETAIL.jsonl")
+    lines = [
+        "# BENCH_SUMMARY — latest full-scale row per benchmark",
+        "",
+        f"Generated {time.strftime('%Y-%m-%d %H:%MZ', time.gmtime())} from "
+        "`BENCH_DETAIL.jsonl` (append-only history; this file is derived).",
+        "",
+    ]
+    for key in sorted(rows):
+        row = rows[key]
+        stamp = time.strftime(
+            "%Y-%m-%d", time.gmtime(row.get("run_at_unix", 0))
+        )
+        lines.append(f"- **{key}** ({stamp}): {fmt(row)}")
+    (ROOT / "BENCH_SUMMARY.md").write_text("\n".join(lines) + "\n")
+    print(f"wrote BENCH_SUMMARY.md ({len(rows)} benchmarks)")
+
+
+if __name__ == "__main__":
+    main()
