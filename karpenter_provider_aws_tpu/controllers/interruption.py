@@ -32,27 +32,48 @@ class InterruptionEvent:
     kind: str               # SpotInterruption | Rebalance | ScheduledChange | StateChange | Unknown
     instance_ids: tuple[str, ...]
     action_drain: bool
+    # typed recorder reason + severity (parity: the per-kind events in
+    # interruption/events/events.go — SpotInterrupted,
+    # SpotRebalanceRecommendation, InstanceStopping, InstanceTerminating,
+    # InstanceUnhealthy); published for EVERY matched claim, drain or not
+    reason: str = "Interrupted"
+    severity: str = "Warning"
 
 
 def _parse_spot(detail) -> InterruptionEvent:
-    return InterruptionEvent("SpotInterruption", (detail.get("instance-id", ""),), True)
+    return InterruptionEvent(
+        "SpotInterruption", (detail.get("instance-id", ""),), True,
+        reason="SpotInterrupted",
+    )
 
 
 def _parse_rebalance(detail) -> InterruptionEvent:
-    return InterruptionEvent("Rebalance", (detail.get("instance-id", ""),), False)
+    return InterruptionEvent(
+        "Rebalance", (detail.get("instance-id", ""),), False,
+        reason="SpotRebalanceRecommendation", severity="Normal",
+    )
 
 
 def _parse_state_change(detail) -> InterruptionEvent:
     state = detail.get("state", "")
     drain = state in ("stopping", "stopped", "shutting-down", "terminated")
-    return InterruptionEvent("StateChange", (detail.get("instance-id", ""),), drain)
+    reason = (
+        "InstanceStopping" if state in ("stopping", "stopped")
+        else "InstanceTerminating" if state in ("shutting-down", "terminated")
+        else "Interrupted"
+    )
+    return InterruptionEvent(
+        "StateChange", (detail.get("instance-id", ""),), drain, reason=reason
+    )
 
 
 def _parse_scheduled_change(detail) -> InterruptionEvent:
     ids = tuple(
         e.get("entityValue", "") for e in detail.get("affectedEntities", [])
     ) or (detail.get("instance-id", ""),)
-    return InterruptionEvent("ScheduledChange", ids, True)
+    return InterruptionEvent(
+        "ScheduledChange", ids, True, reason="InstanceUnhealthy"
+    )
 
 
 # (source, detail-type) -> parser (parity: parser.go DefaultParsers)
@@ -134,11 +155,15 @@ class InterruptionController:
                     self.cloudprovider.catalog.unavailable.mark_unavailable(
                         itype, zone, lbl.CAPACITY_TYPE_SPOT, reason="SpotInterruption"
                     )
+            # typed event for every matched claim — informational kinds
+            # (rebalance) publish too, exactly like the reference
+            self.recorder.publish(
+                "NodeClaim", claim.name, event.reason,
+                f"{event.kind} for instance {iid}"
+                + (": cordon and drain" if event.action_drain else ""),
+                type=event.severity,
+            )
             if event.action_drain and not claim.deleted:
                 log.info("interruption %s: draining %s", event.kind, claim.name)
-                self.recorder.publish(
-                    "NodeClaim", claim.name, "Interrupted",
-                    f"{event.kind} for instance {iid}: cordon and drain",
-                )
                 self.cluster.delete(claim)  # cordon & drain via termination
         self.queue.delete(message.receipt)

@@ -123,5 +123,51 @@ class TestControllerEvents:
             "detail": {"instance-id": iid},
         })
         env.interruption.reconcile()
-        evs = env.events.events(kind="NodeClaim", reason="Interrupted")
+        # typed reason parity: interruption/events/events.go SpotInterrupted
+        evs = env.events.events(kind="NodeClaim", reason="SpotInterrupted")
         assert evs and iid in evs[0].message
+        assert evs[0].type == "Warning"
+
+
+class TestTypedInterruptionReasons:
+    """parity: interruption/events/events.go — per-kind reasons and
+    severities, and informational kinds publish WITHOUT draining."""
+
+    def test_rebalance_publishes_normal_and_does_not_drain(self, env):
+        from karpenter_provider_aws_tpu.models import NodePool, Requirement, Operator, Disruption
+        from karpenter_provider_aws_tpu.models import labels as lbl
+        from karpenter_provider_aws_tpu.models.pod import make_pods
+
+        env.apply_defaults(NodePool(
+            name="default", disruption=Disruption(consolidate_after_s=None),
+            requirements=[Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m"))],
+        ))
+        for p in make_pods(2, "w", {"cpu": "500m", "memory": "512Mi"}):
+            env.cluster.apply(p)
+        env.step(3)
+        claim = next(iter(env.cluster.nodeclaims.values()))
+        iid = claim.status.provider_id.rsplit("/", 1)[-1]
+        env.queue.send({
+            "source": "aws.ec2",
+            "detail-type": "EC2 Instance Rebalance Recommendation",
+            "detail": {"instance-id": iid},
+        })
+        env.interruption.reconcile()
+        evs = env.events.events(kind="NodeClaim", reason="SpotRebalanceRecommendation")
+        assert evs and evs[0].type == "Normal"
+        assert not claim.deleted  # informational only
+
+    def test_state_change_reasons_split_by_state(self, env):
+        from karpenter_provider_aws_tpu.controllers.interruption import _parse_state_change
+
+        assert _parse_state_change({"state": "stopping"}).reason == "InstanceStopping"
+        assert _parse_state_change({"state": "stopped"}).reason == "InstanceStopping"
+        assert _parse_state_change({"state": "shutting-down"}).reason == "InstanceTerminating"
+        assert _parse_state_change({"state": "terminated"}).reason == "InstanceTerminating"
+        assert not _parse_state_change({"state": "running"}).action_drain
+
+    def test_scheduled_change_is_instance_unhealthy(self, env):
+        from karpenter_provider_aws_tpu.controllers.interruption import _parse_scheduled_change
+
+        ev = _parse_scheduled_change({"affectedEntities": [{"entityValue": "i-1"}]})
+        assert ev.reason == "InstanceUnhealthy" and ev.action_drain
