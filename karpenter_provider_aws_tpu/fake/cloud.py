@@ -246,6 +246,7 @@ class FakeCloud:
     def describe_availability_zones(self) -> dict[str, str]:
         with self._lock:
             self._record("describe_availability_zones", None)
+            self._maybe_fail()
             return dict(self.zone_types)
 
     def describe_cluster(self) -> dict:

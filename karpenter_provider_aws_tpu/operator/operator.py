@@ -122,6 +122,17 @@ def new_operator(
 
         cloud = FakeCloud(clock=clock)
 
+    # Cloud-connectivity preflight FIRST (parity: operator.go:205-212
+    # CheckEC2Connectivity's dry-run DescribeInstanceTypes): a broken
+    # backend/credentials must fail operator construction loudly, before
+    # any provider consumes (or swallows) the first error.
+    try:
+        cloud.describe_availability_zones()
+    except Exception as e:
+        raise RuntimeError(
+            f"cloud backend connectivity preflight failed: {type(e).__name__}: {e}"
+        ) from e
+
     pricing = PricingProvider(isolated_vpc=options.isolated_vpc)
     catalog = CatalogProvider(
         pricing=pricing,

@@ -166,6 +166,16 @@ class TestOperatorWiring:
         assert not op.cluster.pending_pods()
         assert len(op.cluster.nodes) >= 1
 
+    def test_connectivity_preflight_fails_construction(self):
+        """parity: operator.go:205-212 CheckEC2Connectivity — a broken
+        backend fails operator construction loudly."""
+        from karpenter_provider_aws_tpu.fake import FakeCloud
+
+        cloud = FakeCloud()
+        cloud.next_errors.append(ConnectionError("no route to cloud"))
+        with pytest.raises(RuntimeError, match="connectivity preflight"):
+            new_operator(Options(solver_backend="host"), cloud=cloud)
+
     def test_service_cidr_discovered_from_backend(self):
         """parity: launchtemplate.go:429-450 ResolveClusterCIDR — the
         operator resolves the service CIDR from the backend's cluster
