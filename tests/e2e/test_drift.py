@@ -49,6 +49,17 @@ class TestDrift:
         for claim in env.cluster.nodeclaims.values():
             assert claim.annotations[lbl.ANNOTATION_NODECLASS_HASH] == nodeclass.hash()
 
+    def test_nodepool_template_drift_replaces_nodes(self, env, expect, provisioned):
+        """Editing the NodePool's node TEMPLATE (stamped labels) drifts
+        every node launched from the old template (core NodePool
+        static-drift analogue; round-3 NodePoolHashDrifted)."""
+        before = set(env.cluster.nodeclaims)
+        pool = env.cluster.nodepools["default"]
+        pool.labels = {**pool.labels, "team": "rotated"}
+        self._drain_and_settle(env, expect, before)
+        for claim in env.cluster.nodeclaims.values():
+            assert claim.labels.get("team") == "rotated"
+
     def test_image_drift_when_selector_rolls(self, env, expect, provisioned):
         """Pinning the selector to an image the nodes don't run drifts them
         (parity: drift.go AMI drift; selector terms are not hashed, so this
