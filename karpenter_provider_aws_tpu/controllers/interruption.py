@@ -155,15 +155,25 @@ class InterruptionController:
                     self.cloudprovider.catalog.unavailable.mark_unavailable(
                         itype, zone, lbl.CAPACITY_TYPE_SPOT, reason="SpotInterruption"
                     )
-            # typed event for every matched claim — informational kinds
-            # (rebalance) publish too, exactly like the reference
+            if claim.deleted:
+                # at-least-once queue redelivery of an already-handled
+                # interruption: the ICE mark above refreshed its TTL; a
+                # duplicate event per redelivery would just be noise
+                continue
+            if not event.action_drain and event.reason == "Interrupted":
+                # non-actionable state change (e.g. 'running'/'pending'):
+                # the reference's parser drops these outright — no event
+                continue
+            # typed event for every actionable kind — informational kinds
+            # with their own reason (rebalance) publish too, exactly like
+            # the reference
             self.recorder.publish(
                 "NodeClaim", claim.name, event.reason,
                 f"{event.kind} for instance {iid}"
                 + (": cordon and drain" if event.action_drain else ""),
                 type=event.severity,
             )
-            if event.action_drain and not claim.deleted:
+            if event.action_drain:
                 log.info("interruption %s: draining %s", event.kind, claim.name)
                 self.cluster.delete(claim)  # cordon & drain via termination
         self.queue.delete(message.receipt)

@@ -124,9 +124,14 @@ class Pod:
             self.requests.set("pods", 1)
 
     def __setattr__(self, name, value):
-        if name in Pod._KEY_FIELDS and getattr(self, "_scheduling_key", None) is not None:
-            object.__setattr__(self, "_scheduling_key", None)
-            object.__setattr__(self, "_scheduling_token", None)
+        if name in Pod._KEY_FIELDS:
+            if getattr(self, "_scheduling_key", None) is not None:
+                object.__setattr__(self, "_scheduling_key", None)
+            # token clears UNCONDITIONALLY: a racing scheduling_token() may
+            # have memoized a token from the pre-assignment key while
+            # _scheduling_key was transiently None (review round-3)
+            if getattr(self, "_scheduling_token", None) is not None:
+                object.__setattr__(self, "_scheduling_token", None)
         if name in Pod._VERSION_FIELDS:
             object.__setattr__(self, "_version", getattr(self, "_version", 0) + 1)
         object.__setattr__(self, name, value)
@@ -222,7 +227,13 @@ class Pod:
                 t = _TOKEN_INTERN.get(key)  # must never mint two tokens for
                 if t is None:               # one key (group-splitting bug)
                     t = _TOKEN_INTERN[key] = next(_token_counter)
-            self._scheduling_token = t
+            # memoize only if the key is still current: a racing KEY-field
+            # assignment cleared _scheduling_key, and storing a token
+            # derived from the old key would be PERMANENTLY stale (the
+            # __setattr__ clear already happened). The identity check makes
+            # the store atomic-enough: same object => same key content.
+            if self._scheduling_key is key:
+                self._scheduling_token = t
         return t
 
     def scheduling_key(self) -> tuple:
