@@ -150,6 +150,18 @@ def measure(num_pods: int, iters: int, warmup: int, max_nodes: int) -> dict:
     import jax
     import jax.numpy as jnp
 
+    if os.environ.get("BENCH_COMPILE_CACHE", "1") == "1":
+        # persistent jit cache: the probe subprocess, a CPU-fallback
+        # re-exec, and repeat bench runs share compiled (G, N, T) buckets
+        # instead of paying ~20-40s each per process
+        from karpenter_provider_aws_tpu.utils.observability import (
+            enable_compilation_cache,
+        )
+
+        enable_compilation_cache(
+            os.environ.get("BENCH_COMPILE_CACHE_DIR", "/tmp/karpenter_tpu_jit_cache")
+        )
+
     from karpenter_provider_aws_tpu.ops.ffd import ffd_solve
 
     problem = build_problem(num_pods)

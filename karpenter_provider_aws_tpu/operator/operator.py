@@ -112,6 +112,10 @@ def new_operator(
     setup_logging(options.log_level)
     if options.xla_dump_dir:
         enable_xla_dump(options.xla_dump_dir)  # before the first jit compile
+    if options.compilation_cache_dir:
+        from ..utils.observability import enable_compilation_cache
+
+        enable_compilation_cache(options.compilation_cache_dir)
     profiler = Profiler(options.profile_dir)
     if cloud is None:
         # hermetic default: any object satisfying cloudprovider.backend

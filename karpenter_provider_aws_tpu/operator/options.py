@@ -43,6 +43,9 @@ class Options:
     log_level: str = "INFO"
     profile_dir: str = ""                        # JAX profiler captures; "" = off
     xla_dump_dir: str = ""                       # compiled-HLO dumps; "" = off
+    # persistent jit cache: restarts skip the ~20-40s per-shape-bucket
+    # compile (keyed on HLO + compiler version; staleness impossible)
+    compilation_cache_dir: str = ""              # "" = off
     ip_family: str = "ipv4"                      # ipv4 | ipv6 (cluster address family)
     cluster_dns_ip: str = ""                     # "" = discover (KubeDNSIP parity)
 

@@ -124,6 +124,24 @@ class _Capture:
         return False
 
 
+def enable_compilation_cache(cache_dir: str) -> None:
+    """Persistent jit-compilation cache: the solver's (G, N, T) shape
+    buckets compile once per PROCESS otherwise, and a reconcile-loop
+    restart (or the bench harness) pays ~20-40s per bucket again. The
+    cache keys on HLO + compiler version, so staleness is impossible by
+    construction. Call before the first jit compile."""
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # cache every compile, not just the >1s ones (default threshold)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception as e:  # unknown knob on an old jax: feature, not a fault
+        logging.getLogger("karpenter.tpu.observability").warning(
+            "compilation cache unavailable: %s", e
+        )
+
+
 def enable_xla_dump(dump_dir: str) -> None:
     """Request compiled-HLO dumps. Must run before the first jit compile —
     XLA reads the flag at backend initialization."""
