@@ -151,9 +151,9 @@ def measure(num_pods: int, iters: int, warmup: int, max_nodes: int) -> dict:
     import jax.numpy as jnp
 
     if os.environ.get("BENCH_COMPILE_CACHE", "1") == "1":
-        # persistent jit cache: the probe subprocess, a CPU-fallback
-        # re-exec, and repeat bench runs share compiled (G, N, T) buckets
-        # instead of paying ~20-40s each per process
+        # persistent jit cache: the CPU-fallback re-exec and repeat bench
+        # runs share compiled (G, N, T) buckets instead of paying ~20-40s
+        # each per process (the probe only does backend init — unaffected)
         from karpenter_provider_aws_tpu.utils.observability import (
             enable_compilation_cache,
         )

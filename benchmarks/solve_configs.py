@@ -118,21 +118,20 @@ def config5_accelerators(n=4000, catalog=None):
     return pods, pools
 
 
-def _run_config(name, pods, pools, catalog, iters=DEFAULT_ITERS):
+def _timed_solves(solve, iters):
+    """Two warmups then ``iters`` timed calls of ``solve()``.
+
+    Warmup #1 compiles and seeds the solver's observed-n_open row sizing;
+    warmup #2 compiles the settled (smaller) bucket. Timed iterations then
+    measure steady-state serving, which is what the reconcile loop sees.
+    GC is frozen across the timed loop: a gen-2 collection over a 50k-pod
+    object graph injects ~100 ms spikes that measure the allocator, not
+    the solver (a long-lived controller would freeze its startup graph the
+    same way). Returns (first_result, last_result, times_ms)."""
     import gc
 
-    tpu = TPUSolver()
-    host = HostSolver()
-    # Two warmups: the first compiles and seeds the solver's observed-n_open
-    # row sizing; the second compiles the settled (smaller) bucket. Timed
-    # iterations then measure steady-state serving, which is what the
-    # reconcile loop sees (recompiles happen once per workload shape).
-    # GC is frozen across the timed loop: a gen-2 collection over a 50k-pod
-    # object graph injects ~100 ms spikes that measure the allocator, not
-    # the solver (a long-lived controller would freeze its startup graph
-    # the same way).
-    res = tpu.solve(pods, pools, catalog)
-    tpu.solve(pods, pools, catalog)
+    res = solve()
+    last = solve()
     times = []
     gc.collect()
     gc.freeze()
@@ -140,11 +139,18 @@ def _run_config(name, pods, pools, catalog, iters=DEFAULT_ITERS):
     try:
         for _ in range(iters):
             t0 = time.perf_counter()
-            r = tpu.solve(pods, pools, catalog)
+            last = solve()
             times.append((time.perf_counter() - t0) * 1000.0)
     finally:
         gc.enable()
         gc.unfreeze()
+    return res, last, times
+
+
+def _run_config(name, pods, pools, catalog, iters=DEFAULT_ITERS):
+    tpu = TPUSolver()
+    host = HostSolver()
+    res, r, times = _timed_solves(lambda: tpu.solve(pods, pools, catalog), iters)
     host_res = host.solve(pods, pools, catalog)
     cost_ratio = (
         r.total_cost / host_res.total_cost if host_res.total_cost > 0 else float("nan")
@@ -350,8 +356,6 @@ def config7_steady_state(n_nodes=2000, n_pending=500, iters=DEFAULT_ITERS):
     rows and only the overflow opens fresh capacity. This measures that
     end-to-end path (snapshot + encode + device solve onto n_pre rows +
     binds/specs decode) at 2k live nodes."""
-    import gc
-
     from karpenter_provider_aws_tpu.scheduling import TPUSolver
     from karpenter_provider_aws_tpu.scheduling.solver import (
         snapshot_existing_capacity,
@@ -366,20 +370,7 @@ def config7_steady_state(n_nodes=2000, n_pending=500, iters=DEFAULT_ITERS):
         existing = snapshot_existing_capacity(env.cluster)
         return tpu.solve(pods, pools, env.catalog, existing=existing)
 
-    res = one()
-    one()
-    times = []
-    gc.collect()
-    gc.freeze()
-    gc.disable()
-    try:
-        for _ in range(iters):
-            t0 = time.perf_counter()
-            r = one()
-            times.append((time.perf_counter() - t0) * 1000.0)
-    finally:
-        gc.enable()
-        gc.unfreeze()
+    res, _, times = _timed_solves(one, iters)
     placed = res.pods_placed()  # includes binds onto live nodes
     return {
         "benchmark": "config7_steady_state_2k_live_nodes",

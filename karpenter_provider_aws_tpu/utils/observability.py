@@ -132,14 +132,19 @@ def enable_compilation_cache(cache_dir: str) -> None:
     construction. Call before the first jit compile."""
     import jax
 
+    log = logging.getLogger("karpenter.tpu.observability")
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
-        # cache every compile, not just the >1s ones (default threshold)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     except Exception as e:  # unknown knob on an old jax: feature, not a fault
-        logging.getLogger("karpenter.tpu.observability").warning(
-            "compilation cache unavailable: %s", e
-        )
+        log.warning("compilation cache unavailable: %s", e)
+        return
+    try:
+        # cache every compile, not just the >1s ones (default threshold —
+        # which would skip exactly the sub-second shape-bucket compiles
+        # this feature exists to cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception as e:
+        log.info("compilation cache active with default threshold: %s", e)
 
 
 def enable_xla_dump(dump_dir: str) -> None:
