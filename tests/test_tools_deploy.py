@@ -64,3 +64,39 @@ class TestAllocatableDiff:
         rows = adiff.diff(live)
         assert rows and rows[0]["resource"] == "cpu"
         assert adiff.diff([{"instance_type": "nope", "allocatable": {}}])[0]["error"]
+
+
+class TestBenchReport:
+    def test_latest_full_scale_row_wins(self, tmp_path, monkeypatch):
+        import json
+
+        import benchmarks.report as rep
+
+        detail = tmp_path / "BENCH_DETAIL.jsonl"
+        rows = [
+            {"benchmark": "x", "p99_ms": 5.0, "scale": 0.2, "run_at_unix": 100},
+            {"benchmark": "x", "p99_ms": 9.0, "scale": 1.0, "run_at_unix": 50},
+            {"benchmark": "x", "p99_ms": 7.0, "scale": 1.0, "run_at_unix": 60},
+            {"metric": "headline", "value": 1.0, "run_at_unix": 10},
+            "not json at all",
+        ]
+        detail.write_text(
+            "\n".join(r if isinstance(r, str) else json.dumps(r) for r in rows)
+        )
+        latest = rep.latest_rows(detail)
+        assert latest["x"]["p99_ms"] == 7.0  # full-scale beats 0.2; newest wins
+        assert latest["headline"]["value"] == 1.0
+
+    def test_main_writes_summary(self, tmp_path, monkeypatch):
+        import json
+
+        import benchmarks.report as rep
+
+        monkeypatch.setattr(rep, "ROOT", tmp_path)
+        (tmp_path / "BENCH_DETAIL.jsonl").write_text(
+            json.dumps({"benchmark": "b", "pods": 10, "p99_ms": 1.5,
+                        "run_at_unix": 1785400000}) + "\n"
+        )
+        rep.main()
+        text = (tmp_path / "BENCH_SUMMARY.md").read_text()
+        assert "**b**" in text and "p99_ms=1.500" in text
