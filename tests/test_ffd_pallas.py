@@ -151,6 +151,24 @@ def test_pre_opened_existing_rows_match():
     _assert_equal(res_p, res_x, Z, C)
 
 
+def test_pack_memo_reused_across_solves():
+    """The N-independent packed tensors are built once per problem: the
+    caller's memo dict is filled on the first call and identical objects
+    come back on the second."""
+    rng = np.random.RandomState(5)
+    args = _random_problem(rng, 6, 20, 4, 3, 3)
+    requests, counts, compat, capacity, price, gw, tw, mpn = args
+    memo = {}
+    ffd_solve_pallas(requests, counts, compat, capacity, price, gw, tw,
+                     max_per_node=mpn, max_nodes=64, interpret=True,
+                     pack_memo=memo)
+    packed_first = memo["packed"]
+    ffd_solve_pallas(requests, counts, compat, capacity, price, gw, tw,
+                     max_per_node=mpn, max_nodes=64, interpret=True,
+                     pack_memo=memo)
+    assert memo["packed"] is packed_first
+
+
 def test_window_bit_packing_roundtrip():
     rng = np.random.RandomState(3)
     win = rng.rand(17, 4, 3) < 0.5
