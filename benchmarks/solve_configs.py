@@ -118,7 +118,7 @@ def config5_accelerators(n=4000, catalog=None):
     return pods, pools
 
 
-def _timed_solves(solve, iters):
+def _timed_solves(solve, iters, snap=None, warmups=2):
     """Two warmups then ``iters`` timed calls of ``solve()``.
 
     Warmup #1 compiles and seeds the solver's observed-n_open row sizing;
@@ -127,12 +127,18 @@ def _timed_solves(solve, iters):
     GC is frozen across the timed loop: a gen-2 collection over a 50k-pod
     object graph injects ~100 ms spikes that measure the allocator, not
     the solver (a long-lived controller would freeze its startup graph the
-    same way). Returns (first_result, last_result, times_ms)."""
+    same way). ``snap()`` (if given) is called after each timed iteration
+    and its dict appended to the returned per-iteration stage list.
+    Returns (first_result, last_result, times_ms, stage_rows)."""
     import gc
 
-    res = solve()
-    last = solve()
+    res = last = None
+    for _ in range(warmups):
+        last = solve()
+        if res is None:
+            res = last
     times = []
+    stage_rows = []
     gc.collect()
     gc.freeze()
     gc.disable()
@@ -141,21 +147,71 @@ def _timed_solves(solve, iters):
             t0 = time.perf_counter()
             last = solve()
             times.append((time.perf_counter() - t0) * 1000.0)
+            if snap is not None:
+                stage_rows.append(snap())
     finally:
         gc.enable()
         gc.unfreeze()
-    return res, last, times
+    if res is None:
+        res = last
+    return res, last, times, stage_rows
 
 
-def _run_config(name, pods, pools, catalog, iters=DEFAULT_ITERS):
+def _stage_percentiles(stage_rows) -> tuple[dict, dict]:
+    """Per-stage p50/p99 across iterations from snapshot dicts."""
+    keys = sorted({k for row in stage_rows for k in row if k.endswith("_ms")})
+    p50, p99 = {}, {}
+    for k in keys:
+        vals = [row.get(k, 0.0) for row in stage_rows]
+        p50[k] = round(float(np.percentile(vals, 50)), 2)
+        p99[k] = round(float(np.percentile(vals, 99)), 2)
+    return p50, p99
+
+
+def measure_link_rtt(n=40) -> dict | None:
+    """Round-trip a tiny array through the device ``n`` times.
+
+    Over the axon tunnel this measures the per-transfer latency floor and
+    its jitter — the quantity the end-to-end p99 tail is attributed to.
+    Returns None on the CPU backend (no link to measure)."""
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return None
+    x = np.zeros(64, np.float32)
+    times = []
+    jax.device_get(jax.device_put(x))  # warm the path
+    for i in range(n):
+        x[0] = i  # defeat any content caching
+        t0 = time.perf_counter()
+        jax.device_get(jax.device_put(x))
+        times.append((time.perf_counter() - t0) * 1000.0)
+    return {
+        "benchmark": "link_rtt_probe",
+        "n": n,
+        "p50_ms": round(float(np.percentile(times, 50)), 2),
+        "p95_ms": round(float(np.percentile(times, 95)), 2),
+        "p99_ms": round(float(np.percentile(times, 99)), 2),
+        "max_ms": round(float(np.max(times)), 2),
+        "note": "put+get round trip of a 256B array; ~2 one-way transfers",
+    }
+
+
+def _run_config(name, pods, pools, catalog, iters=DEFAULT_ITERS, link=None):
+    import os
+
     tpu = TPUSolver()
     host = HostSolver()
-    res, r, times = _timed_solves(lambda: tpu.solve(pods, pools, catalog), iters)
+    snap = lambda: dict(tpu.timings)  # noqa: E731 — per-solve stage walls
+    res, r, times, stage_rows = _timed_solves(
+        lambda: tpu.solve(pods, pools, catalog), iters, snap=snap
+    )
     host_res = host.solve(pods, pools, catalog)
     cost_ratio = (
         r.total_cost / host_res.total_cost if host_res.total_cost > 0 else float("nan")
     )
-    return {
+    stage_p50, stage_p99 = _stage_percentiles(stage_rows)
+    out = {
         "benchmark": name,
         "pods": len(pods),
         "p99_ms": round(float(np.percentile(times, 99)), 3),
@@ -166,14 +222,50 @@ def _run_config(name, pods, pools, catalog, iters=DEFAULT_ITERS):
         "placed": res.pods_placed(),
         "unschedulable": len(res.unschedulable),
         "cost_vs_greedy": round(cost_ratio, 4),
-        # per-stage wall of the LAST iteration: encode (host tensorization),
-        # device (upload + scan + rank + fetch), decode (refine + specs)
-        "breakdown_ms": {
-            k: round(v, 1) for k, v in tpu.timings.items() if k.endswith("_ms")
-        },
+        # per-stage p50/p99 ACROSS iterations: encode (host tensorization),
+        # upload (device_put cache misses), device (dispatch+compute+fetch),
+        # decode (refine + specs). The tail attribution the north star asks
+        # for lives here: a device_ms p99>>p50 with flat encode/decode p99s
+        # plus a jittery link_rtt_probe row pins the tail on the tunnel.
+        "stage_p50_ms": stage_p50,
+        "stage_p99_ms": stage_p99,
         "n_rows": tpu.timings.get("n_rows"),
         "n_open": tpu.timings.get("n_open"),
     }
+
+    # Attribution pass: a short loop with the sync stage split on, so
+    # device_ms decomposes into compute (dispatch+kernels+1 sync RTT) and
+    # fetch (result bytes over the link). From it, the local-device
+    # projection: what p99 would be with the device on local PCIe —
+    # encode + decode + compute, minus half a link round trip (the sync
+    # wait), with upload (content-cached in steady state) and fetch
+    # (hundreds of KB; ~GB/s locally) excluded.
+    try:
+        os.environ["KARPENTER_TPU_STAGE_SYNC"] = "1"
+        n_attr = min(iters, 10)
+        _, _, _, attr_rows = _timed_solves(
+            lambda: tpu.solve(pods, pools, catalog), n_attr, snap=snap, warmups=0
+        )
+        a50, a99 = _stage_percentiles(attr_rows)
+        out["sync_stage_p50_ms"] = a50
+        out["sync_stage_p99_ms"] = a99
+        link_half = (link["p50_ms"] / 2.0) if link else 0.0
+        local = [
+            row.get("encode_ms", 0.0)
+            + row.get("decode_ms", 0.0)
+            + max(row.get("compute_ms", row.get("device_ms", 0.0)) - link_half, 0.0)
+            for row in attr_rows
+        ]
+        out["projected_local_p99_ms"] = round(float(np.percentile(local, 99)), 2)
+        out["projected_local_p50_ms"] = round(float(np.percentile(local, 50)), 2)
+    except Exception as e:  # attribution is best-effort; the row survives
+        out["attribution_error"] = f"{type(e).__name__}: {e}"[:200]
+    finally:
+        os.environ.pop("KARPENTER_TPU_STAGE_SYNC", None)
+    if link:
+        out["link_rtt_p50_ms"] = link["p50_ms"]
+        out["link_rtt_p99_ms"] = link["p99_ms"]
+    return out
 
 
 def _synth_cluster(n_nodes=5000, pods_per_node=8):
@@ -370,10 +462,13 @@ def config7_steady_state(n_nodes=2000, n_pending=500, iters=DEFAULT_ITERS):
         existing = snapshot_existing_capacity(env.cluster)
         return tpu.solve(pods, pools, env.catalog, existing=existing)
 
-    res, _, times = _timed_solves(one, iters)
+    res, _, times, stage_rows = _timed_solves(one, iters, snap=lambda: dict(tpu.timings))
+    stage_p50, stage_p99 = _stage_percentiles(stage_rows)
     placed = res.pods_placed()  # includes binds onto live nodes
     return {
         "benchmark": "config7_steady_state_2k_live_nodes",
+        "stage_p50_ms": stage_p50,
+        "stage_p99_ms": stage_p99,
         "nodes": n_nodes,
         "pods": n_pending,
         "p99_ms": round(float(np.percentile(times, 99)), 3),
@@ -402,6 +497,14 @@ def run_all(scale=1.0, iters=DEFAULT_ITERS, on_row=None):
         if on_row is not None:
             on_row(row)
 
+    link = None
+    try:
+        link = measure_link_rtt()
+        if link is not None:
+            emit(link)
+    except Exception as e:
+        print(f"link probe failed: {type(e).__name__}: {e}", flush=True)
+
     for name, builder, kwargs in (
         ("config1_homogeneous_2k", config1_homogeneous, {"n": int(2000 * scale)}),
         ("config2_heterogeneous_50k", config2_heterogeneous, {"n": int(50_000 * scale)}),
@@ -412,7 +515,7 @@ def run_all(scale=1.0, iters=DEFAULT_ITERS, on_row=None):
         if builder is config5_accelerators:
             kwargs["catalog"] = catalog
         pods, pools = builder(**kwargs)
-        emit(_run_config(name, pods, pools, catalog, iters=iters))
+        emit(_run_config(name, pods, pools, catalog, iters=iters, link=link))
     emit(config7_steady_state(n_nodes=int(2000 * scale),
                               n_pending=int(500 * scale), iters=iters))
     emit(config4_consolidation(n_nodes=int(5000 * scale)))

@@ -696,7 +696,20 @@ class TPUSolver:
         if hit is not None:
             self._dev_cache.move_to_end(key)
             return hit
+        t0 = time.perf_counter()
         arr = jax.device_put(x)
+        if os.environ.get("KARPENTER_TPU_STAGE_SYNC") == "1":
+            # device_put returns once the copy is enqueued; only a block
+            # sees the real transfer wall. Serving keeps the async pipeline
+            # (uploads overlap); the bench attribution pass pays the sync.
+            jax.block_until_ready(arr)
+        # upload attribution (cache misses only — hits cost nothing): over a
+        # remote-device tunnel each upload pays ~RTT + bytes/bandwidth, and
+        # the bench's per-stage p99 needs to see it separately
+        self.timings["upload_ms"] = self.timings.get("upload_ms", 0.0) + (
+            (time.perf_counter() - t0) * 1e3
+        )
+        self.timings["upload_bytes"] = self.timings.get("upload_bytes", 0) + x.nbytes
         self._dev_cache[key] = arr
         self._dev_cache_bytes += x.nbytes
         while self._dev_cache_bytes > self._dev_cache_budget and len(self._dev_cache) > 1:
@@ -818,6 +831,7 @@ class TPUSolver:
             return state, [res.placed], [res.unplaced]
 
         def run(N: int):
+            t_run0 = time.perf_counter()
             mode = self._ffd_mode
             if mode == "auto":
                 mode = "pallas" if jax.default_backend() == "tpu" else "xla"
@@ -919,6 +933,26 @@ class TPUSolver:
             # caller falls back to a dense fetch via the returned handles.
             E = bucket(max(1024, 2 * N, 4 * GB))
             nz_dev, cnt_dev, total_dev = compact_plan(placed_dev, E)
+            if os.environ.get("KARPENTER_TPU_STAGE_SYNC") == "1":
+                # opt-in stage split for bench attribution: wait for the
+                # compute chain before the fetch so device_ms decomposes
+                # into compute (dispatch+kernels, incl. one sync RTT) and
+                # fetch (result bytes over the link). Costs ~1 extra RTT —
+                # never enabled in the serving path.
+                jax.block_until_ready((nz_dev, cnt_dev, total_dev, ranked_n_dev))
+                self.timings["compute_ms"] = self.timings.get(
+                    "compute_ms", 0.0
+                ) + (time.perf_counter() - t_run0) * 1e3
+                t_fetch = time.perf_counter()
+                fetched = jax.device_get(
+                    (nz_dev, cnt_dev, total_dev, unplaced_chunks,
+                     state.node_type, state.node_price, state.n_open,
+                     state.node_window, ranked_idx_dev, ranked_n_dev)
+                )
+                self.timings["fetch_ms"] = self.timings.get(
+                    "fetch_ms", 0.0
+                ) + (time.perf_counter() - t_fetch) * 1e3
+                return fetched, (placed_dev, state)
             fetched = jax.device_get(
                 (nz_dev, cnt_dev, total_dev, unplaced_chunks,
                  state.node_type, state.node_price, state.n_open,
