@@ -56,6 +56,11 @@ class PricingProvider:
 
     def __init__(self, isolated_vpc: bool = False):
         self._od_overrides: dict[str, float] = {}
+        # per-(type, zone) on-demand overrides: AWS on-demand is regional,
+        # but the launch-path price comparisons are per-OFFERING (reference
+        # iterates Offerings.Available() prices) — a live backend that does
+        # report zonal variance must be representable
+        self._od_zone_overrides: dict[tuple[str, str], float] = {}
         self._spot_overrides: dict[tuple[str, str], float] = {}
         self._lock = threading.RLock()
         self._seq = 0
@@ -97,6 +102,15 @@ class PricingProvider:
             static = self._static_od(it.name)
             return static if static is not None else self._model_od(it)
 
+    def on_demand_price_zonal(self, it: "InstanceType", zone: str) -> float:
+        """Per-(type, zone) on-demand offering price: the zonal override if
+        a live backend set one, else the regional price."""
+        with self._lock:
+            override = self._od_zone_overrides.get((it.name, zone))
+            if override is not None:
+                return override
+        return self.on_demand_price(it)
+
     def spot_price(self, it: "InstanceType", zone: str) -> float:
         """Zonal spot; default derived from on-demand when no live data
         (parity: pricing.go:141-156 spotPrice fallback)."""
@@ -118,6 +132,13 @@ class PricingProvider:
             self._od_overrides.update(prices)
             self._seq += 1
 
+    def update_on_demand_zonal(self, prices: Mapping[tuple[str, str], float]) -> None:
+        if self.isolated_vpc:
+            return
+        with self._lock:
+            self._od_zone_overrides.update(prices)
+            self._seq += 1
+
     def update_spot(self, prices: Mapping[tuple[str, str], float]) -> None:
         if self.isolated_vpc:
             return
@@ -128,6 +149,7 @@ class PricingProvider:
     def reset(self) -> None:
         with self._lock:
             self._od_overrides.clear()
+            self._od_zone_overrides.clear()
             self._spot_overrides.clear()
             self._seq += 1
 

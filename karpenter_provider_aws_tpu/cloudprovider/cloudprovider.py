@@ -166,7 +166,12 @@ class CloudProvider:
         # launching that fallback with almost no type flexibility risks
         # immediate ICE churn, so the reference refuses below 5 options and
         # so do we. Reserved (pre-paid) launches are exempt.
-        allowed_cts = {ct for _, ct in (claim.offering_options or ())} or set(
+        # UNION of solve-time live offerings and the claim's capacity-type
+        # requirements: if spot was ICE-cached at solve time the offerings
+        # carry only on-demand, but the claim's requirements still allow
+        # spot — the reference derives this gate from the requirements
+        # (instance.go:272), so the fallback check must still fire.
+        allowed_cts = {ct for _, ct in (claim.offering_options or ())} | set(
             claim.capacity_type_options or ()
         )
         live_cts = {ct for _, ct in offerings}
@@ -262,14 +267,19 @@ class CloudProvider:
 
         def live_od(t):
             # the comparison floor must be ATTAINABLE: an ICE-cached
-            # on-demand price is not a price anyone can launch at
-            # (reference computes over Offerings.Available() only)
-            if any(
-                not unavailable(t.name, z, lbl.CAPACITY_TYPE_ON_DEMAND)
-                for z in od_zones
-            ):
-                return self.catalog.pricing.on_demand_price(t)
-            return float("inf")
+            # on-demand price is not a price anyone can launch at, and the
+            # price compared is the cheapest per-(type, zone) OFFERING
+            # price over live zones (reference computes over
+            # Offerings.Available(), per-offering prices) — not one
+            # zone-independent number per type.
+            return min(
+                (
+                    self.catalog.pricing.on_demand_price_zonal(t, z)
+                    for z in od_zones
+                    if not unavailable(t.name, z, lbl.CAPACITY_TYPE_ON_DEMAND)
+                ),
+                default=float("inf"),
+            )
 
         cheapest_od = min((live_od(t) for t in type_options), default=float("inf"))
         if cheapest_od == float("inf"):

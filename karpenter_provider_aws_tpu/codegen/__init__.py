@@ -10,11 +10,15 @@ same data-not-API-calls philosophy, same refresh workflow
 (``python -m karpenter_provider_aws_tpu.codegen``).
 """
 
+from .aws_snapshot_gen import generate_aws_snapshot
 from .bandwidth_gen import generate_bandwidth
 from .instancetype_testdata_gen import generate_instancetype_testdata
 from .prices_gen import generate_prices
 from .vpc_limits_gen import generate_vpc_limits
 
+# aws-snapshot is intentionally NOT in the default set: it needs the
+# reference tree on disk (dev-time only); the committed snapshot is the
+# source of truth everywhere else.
 GENERATORS = {
     "vpc-limits": generate_vpc_limits,
     "bandwidth": generate_bandwidth,

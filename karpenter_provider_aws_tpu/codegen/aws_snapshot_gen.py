@@ -1,0 +1,107 @@
+"""Generates catalog/aws_snapshot.json — the frozen real-world catalog.
+
+Reference parity: ``hack/codegen.sh:10-41`` scrapes public AWS data into
+committed ``zz_generated.*.go`` tables. This generator plays the same role
+with the same provenance chain, one hop removed: it parses those committed
+reference tables (real us-east-1 prices generated 2024-04-25, real per-type
+VPC ENI/branch limits, real bandwidth megabits) into one JSON snapshot that
+is CHECKED IN. The catalog generator consumes the snapshot at import time;
+this parser only runs at dev time when the reference tree is present (the
+moral analogue of codegen.sh needing AWS credentials).
+
+Parsed sources (data tables only — no code):
+ - pkg/providers/pricing/zz_generated.pricing_aws.go   (on-demand $/hr)
+ - pkg/providers/instancetype/zz_generated.vpclimits.go (ENI/IP/branch/hyp)
+ - pkg/providers/instancetype/zz_generated.bandwidth.go (network Mbps)
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import re
+
+from ._emit import CATALOG_DIR
+
+REFERENCE = pathlib.Path("/root/reference")
+SNAPSHOT_PATH = CATALOG_DIR / "aws_snapshot.json"
+
+
+def _parse_prices(src: str) -> dict[str, float]:
+    pairs = re.findall(r'"([a-z0-9][a-z0-9.\-]+)":\s*([0-9.]+)', src)
+    return {n: float(p) for n, p in pairs if "." in n}
+
+
+def _parse_vpclimits(src: str) -> dict[str, dict]:
+    out: dict[str, dict] = {}
+    # entry blocks: "name": { Interface: N, IPv4PerInterface: N, ...
+    # IsTrunkingCompatible: bool, BranchInterface: N, ... Hypervisor: "x" }
+    for m in re.finditer(
+        r'"([a-z0-9.\-]+)":\s*\{(.*?)\n\t\},', src, re.DOTALL
+    ):
+        name, body = m.group(1), m.group(2)
+
+        def _int(field: str) -> int:
+            mm = re.search(rf"{field}:\s*(\d+)", body)
+            return int(mm.group(1)) if mm else 0
+
+        hyp = re.search(r'Hypervisor:\s*"([a-z]*)"', body)
+        out[name] = {
+            "enis": _int("Interface"),
+            "ips": _int("IPv4PerInterface"),
+            "branch": _int("BranchInterface"),
+            "trunk": "IsTrunkingCompatible:    true" in body
+            or "IsTrunkingCompatible: true" in body,
+            "hyp": hyp.group(1) if hyp else "",
+        }
+    return out
+
+
+def _parse_bandwidth(src: str) -> dict[str, int]:
+    body = src.split("InstanceTypeBandwidthMegabits", 1)[-1]
+    return {
+        n: int(v)
+        for n, v in re.findall(r'"([a-z0-9.\-]+)":\s*(\d+)', body)
+        if "." in n
+    }
+
+
+def generate_aws_snapshot() -> pathlib.Path:
+    if not REFERENCE.exists():
+        raise FileNotFoundError(
+            "reference tree not present; the committed snapshot is the "
+            "source of truth in this checkout"
+        )
+    prices = _parse_prices(
+        (REFERENCE / "pkg/providers/pricing/zz_generated.pricing_aws.go").read_text()
+    )
+    limits = _parse_vpclimits(
+        (REFERENCE / "pkg/providers/instancetype/zz_generated.vpclimits.go").read_text()
+    )
+    bandwidth = _parse_bandwidth(
+        (REFERENCE / "pkg/providers/instancetype/zz_generated.bandwidth.go").read_text()
+    )
+    types = {}
+    for name in sorted(prices):
+        row: dict = {"od": prices[name]}
+        lim = limits.get(name)
+        if lim:
+            row.update(lim)
+        bw = bandwidth.get(name)
+        if bw is not None:
+            row["bw"] = bw
+        types[name] = row
+    snapshot = {
+        "provenance": (
+            "parsed from karpenter-provider-aws zz_generated data tables: "
+            "us-east-1 on-demand prices (generated 2024-04-25), VPC "
+            "ENI/branch limits (2024-04-30), bandwidth megabits"
+        ),
+        "types": types,
+    }
+    SNAPSHOT_PATH.write_text(json.dumps(snapshot, indent=1, sort_keys=True) + "\n")
+    return SNAPSHOT_PATH
+
+
+if __name__ == "__main__":
+    print(generate_aws_snapshot())

@@ -18,12 +18,12 @@ FIXTURE_NAMES = (
     "c5.large", "c5.xlarge", "c5.2xlarge", "c5.metal",
     "c6g.large", "c6g.xlarge", "c7g.16xlarge", "c7gn.8xlarge",
     "m5.large", "m5.4xlarge", "m6a.xlarge", "m7g.2xlarge",
-    "r5.large", "r5.24xlarge", "r6gd.4xlarge", "x7.8xlarge",
+    "r5.large", "r5.24xlarge", "r6gd.4xlarge", "x2idn.16xlarge",
     "t3.micro", "t3.medium", "t4g.small", "t4g.xlarge",
     "i3.2xlarge", "i4i.8xlarge", "d3.xlarge",
     "g4dn.xlarge", "g5.12xlarge", "g5g.xlarge", "p4d.24xlarge", "p5.48xlarge",
     "inf1.6xlarge", "inf2.24xlarge", "trn1.32xlarge",
-    "hpc6a.96xlarge",
+    "hpc7g.16xlarge",
 )
 
 _FIELDS = (

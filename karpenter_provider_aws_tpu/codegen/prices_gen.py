@@ -14,15 +14,22 @@ from ._emit import CATALOG_DIR, write_module
 
 
 def generate_prices() -> pathlib.Path:
-    from ..catalog.instancetypes import DEFAULT_ZONES, generate_catalog
-    from ..catalog.pricing import PricingProvider, _jitter
+    """Real us-east-1 on-demand seed prices from the committed snapshot
+    (the reference's 2024-04-25 table), plus zonal spot seeds derived as a
+    deterministic 24-44% fraction of on-demand — the reference's own
+    fallback rule when no live spot data exists (pricing.go:141-156), which
+    also guarantees spot < on-demand for every seeded offering."""
+    import json
 
+    from ..catalog.instancetypes import DEFAULT_ZONES, generate_catalog
+    from ..catalog.pricing import _jitter
+
+    snapshot = json.loads((CATALOG_DIR / "aws_snapshot.json").read_text())["types"]
     types = generate_catalog(apply_generated=False)
-    pricing = PricingProvider()
     od_lines = ["INITIAL_ON_DEMAND_PRICES: dict[str, float] = {\n"]
     spot_lines = ["INITIAL_SPOT_PRICES: dict[str, dict[str, float]] = {\n"]
     for it in sorted(types, key=lambda t: t.name):
-        od = pricing._model_od(it)
+        od = snapshot[it.name]["od"]
         od_lines.append(f"    {it.name!r}: {od},\n")
         per_zone = ", ".join(
             f"{z!r}: {round(od * _jitter(f'{it.name}:{z}', 0.24, 0.44), 5)}"

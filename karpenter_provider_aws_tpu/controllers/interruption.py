@@ -116,9 +116,16 @@ class InterruptionController:
         self.recorder = recorder or default_recorder()
         self.handled: list[InterruptionEvent] = []
         # one persistent worker pool (parity: a fixed ParallelizeUntil width,
-        # controller.go:104) — a pool per batch costs more than the work
-        self._pool = ThreadPoolExecutor(
-            max_workers=PARALLELISM, thread_name_prefix="interruption"
+        # controller.go:104) — a pool per batch costs more than the work.
+        # Only blocking providers get it: fan-out exists to overlap queue/
+        # network round-trips, and for an in-memory queue the dispatch
+        # overhead dominates the (GIL-bound) handler work.
+        self._pool = (
+            ThreadPoolExecutor(
+                max_workers=PARALLELISM, thread_name_prefix="interruption"
+            )
+            if getattr(queue, "blocking_io", True)
+            else None
         )
 
     def reconcile(self) -> None:
@@ -128,8 +135,9 @@ class InterruptionController:
         # instance-id -> claim resolution is the cluster's incrementally
         # maintained O(1) index (parity: the per-batch map of
         # controller.go:254-292, without the re-LIST per 10-message batch)
-        if len(messages) == 1:
-            self._handle(messages[0])
+        if self._pool is None or len(messages) == 1:
+            for m in messages:
+                self._handle(m)
         else:
             list(self._pool.map(self._handle, messages))
 

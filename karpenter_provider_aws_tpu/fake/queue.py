@@ -18,6 +18,7 @@ _ids = itertools.count(1)
 
 class FakeQueue:
     MAX_RECEIVE = MAX_RECEIVE  # sqs.go:62 MaxNumberOfMessages
+    blocking_io = False  # in-memory: handlers run inline, no worker pool
 
     def __init__(self):
         self._lock = threading.Lock()

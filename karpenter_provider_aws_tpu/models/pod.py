@@ -28,6 +28,10 @@ _uid_counter = itertools.count()
 _TOKEN_INTERN: dict[tuple, int] = {}
 _TOKEN_LOCK = threading.Lock()
 _token_counter = itertools.count()
+# (scheduling_token, labels) -> interned consolidation-group token (see
+# Pod.group_token). Same never-renumber rule as _TOKEN_INTERN.
+_GROUP_INTERN: dict[tuple, int] = {}
+_group_counter = itertools.count()
 
 
 @dataclass(frozen=True)
@@ -99,6 +103,9 @@ class Pod:
     # lazily computed by scheduling_key(); excluded from comparisons
     _scheduling_key: Optional[tuple] = field(default=None, repr=False, compare=False)
     _scheduling_token: Optional[int] = field(default=None, repr=False, compare=False)
+    # (version, token) memo for group_token(); version-guarded because
+    # labels participate and labels bump _version on reassignment
+    _group_token: Optional[tuple] = field(default=None, repr=False, compare=False)
     # bumped on every scheduling-relevant field assignment; cross-solve
     # caches (ops.encode._PROBLEM_CACHE) key on (id, _version) pairs so a
     # sanctioned field reassignment can never serve a stale encoding
@@ -135,6 +142,16 @@ class Pod:
         if name in Pod._VERSION_FIELDS:
             object.__setattr__(self, "_version", getattr(self, "_version", 0) + 1)
         object.__setattr__(self, name, value)
+
+    def bump_version(self) -> None:
+        """Explicit invalidation after IN-PLACE mutation of a scheduling
+        field's container (e.g. ``pod.labels[k] = v`` — a common k8s
+        idiom). ``__setattr__`` only sees reassignment; a caller that
+        mutates in place must call this (or reassign a fresh container) or
+        cross-solve caches may serve the pod's stale encoding."""
+        object.__setattr__(self, "_scheduling_key", None)
+        object.__setattr__(self, "_scheduling_token", None)
+        object.__setattr__(self, "_version", getattr(self, "_version", 0) + 1)
 
     # -- scheduling views --------------------------------------------------
     def requirements(self) -> Requirements:
@@ -234,6 +251,30 @@ class Pod:
             # the store atomic-enough: same object => same key content.
             if self._scheduling_key is key:
                 self._scheduling_token = t
+        return t
+
+    def group_token(self) -> int:
+        """Interned token for the CONSOLIDATION grouping identity:
+        (scheduling shape, exact labels). Labels ride along because the
+        repack validator matches selectors against a group representative's
+        labels — two pods with equal scheduling keys but different labels
+        must not share a group. Memoized per (pod, _version): labels
+        reassignment (or ``bump_version()`` after in-place mutation) bumps
+        the version and forces a re-intern."""
+        memo = self._group_token
+        if memo is not None and memo[0] == self._version:
+            return memo[1]
+        # capture the version BEFORE reading labels: a concurrent labels
+        # reassignment between key computation and the store must leave a
+        # memo that the version guard rejects, never a permanently-stale
+        # token under the new version (same race _scheduling_token fixed)
+        v = self._version
+        key = (self.scheduling_token(), tuple(sorted(self.labels.items())))
+        with _TOKEN_LOCK:
+            t = _GROUP_INTERN.get(key)
+            if t is None:
+                t = _GROUP_INTERN[key] = next(_group_counter)
+        object.__setattr__(self, "_group_token", (v, t))
         return t
 
     def scheduling_key(self) -> tuple:

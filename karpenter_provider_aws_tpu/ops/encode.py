@@ -367,9 +367,22 @@ def _atomic_zone_mask(pod, occupancy, zone_names, Z, unit: int = 1):
 #: is supplied — its exact content fingerprint (equal bound-pod multisets
 #: produce identical topology decisions). Only a caller-supplied tensors
 #: snapshot bypasses the cache (a what-if view the key cannot distinguish).
+#: CONTRACT: the (id, version) pod keys below only observe field
+#: REASSIGNMENT (Pod.__setattr__). In-place mutation of a field's container
+#: (``pod.labels[k] = v``) is invisible — such a caller must invoke
+#: ``pod.bump_version()`` or reassign a fresh container, else this cache can
+#: serve a stale encoding and launch capacity sized from old requests/
+#: selectors. ``invalidate_problem_cache()`` is the big hammer for callers
+#: that cannot touch the pods.
 _PROBLEM_CACHE: "OrderedDict[tuple, EncodedProblem]" = OrderedDict()
 _PROBLEM_CACHE_MAX = 8
 _PROBLEM_CACHE_LOCK = threading.Lock()
+
+
+def invalidate_problem_cache() -> None:
+    """Drop every cached encoding (see the mutation contract above)."""
+    with _PROBLEM_CACHE_LOCK:
+        _PROBLEM_CACHE.clear()
 
 
 def _problem_cache_key(pods, catalog, nodepool, occupancy, allowed_types,

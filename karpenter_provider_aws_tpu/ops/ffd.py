@@ -218,7 +218,11 @@ def rank_launch_options(
     # materializing [tile, G, T], and the whole [N, G, T] sweep is a few ms
     # of VPU work — the previous per-group fori_loop serialized G tiny
     # kernels and dominated the post-scan device time at G in the hundreds.
-    TILE = 512
+    # The tile is bounded by G*T so that even an UNFUSED [TILE, G, T]
+    # materialization stays under ~256 MB of HBM (at G=1024 x T=700 a flat
+    # 512-tile would risk ~1.4 GB if the where ever fails to fold).
+    G_ = price.shape[0]
+    TILE = int(max(8, min(512, (256 << 20) // max(1, G_ * T * 4))))
 
     def _tile(nm):
         return jnp.max(

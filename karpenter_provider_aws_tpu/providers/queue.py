@@ -35,6 +35,16 @@ class QueueMessage:
 
 @runtime_checkable
 class QueueProvider(Protocol):
+    # Optional attribute (NOT a Protocol member — a data member would make
+    # structural isinstance fail for adapters that omit it): providers may
+    # set ``blocking_io = False`` to declare receive/delete never touch the
+    # network. The interruption controller fans message handling over a
+    # worker pool ONLY for blocking providers (the reference's
+    # ParallelizeUntil(10) exists to overlap SQS and kube round-trips,
+    # controller.go:104); for an in-memory provider the pool is pure
+    # dispatch overhead on GIL-bound work and halves small-drain
+    # throughput. Consumers read it via getattr(queue, "blocking_io", True).
+
     def send(self, body) -> None: ...
 
     def receive(self, max_messages: Optional[int] = None) -> list: ...

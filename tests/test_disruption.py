@@ -21,12 +21,17 @@ def _reset(env):
     yield
 
 
-def pool_with(**disruption_kwargs):
+def pool_with(max_cpu=None, **disruption_kwargs):
     disruption_kwargs.setdefault("budgets", ["100%"])
     disruption_kwargs.setdefault("consolidate_after_s", None)
+    reqs = [Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m", "r"))]
+    if max_cpu is not None:
+        # the real catalog carries 192-448 vCPU giants; tests asserting
+        # multi-node plans pin the node size so pods cannot all land on one
+        reqs.append(Requirement(lbl.INSTANCE_CPU, Operator.LT, (str(max_cpu),)))
     return NodePool(
         name="default",
-        requirements=[Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m", "r"))],
+        requirements=reqs,
         disruption=Disruption(**disruption_kwargs),
     )
 
@@ -160,7 +165,7 @@ class TestValidationWindow:
         """Core consolidation validation: a node must stay consolidatable
         across the validation window before any delete commits — a
         transient dip never kills a node on first sight."""
-        env.apply_defaults(pool_with(consolidate_after_s=10))
+        env.apply_defaults(pool_with(max_cpu=17, consolidate_after_s=10))
         pods = make_pods(30, "w", {"cpu": "1", "memory": "2Gi"})
         provision(env, pods)
         self._thin_out(env, pods)
@@ -176,7 +181,7 @@ class TestValidationWindow:
         )
 
     def test_flapping_candidate_restarts_window(self, env):
-        env.apply_defaults(pool_with(consolidate_after_s=10))
+        env.apply_defaults(pool_with(max_cpu=17, consolidate_after_s=10))
         pods = make_pods(30, "w", {"cpu": "1", "memory": "2Gi"})
         provision(env, pods)
         self._thin_out(env, pods)
@@ -194,7 +199,7 @@ class TestValidationWindow:
 
 class TestBudgets:
     def test_budget_caps_disruptions_per_pass(self, env):
-        pool = pool_with(expire_after_s=60, consolidate_after_s=None)
+        pool = pool_with(max_cpu=100, expire_after_s=60, consolidate_after_s=None)
         pool.disruption.budgets = ["1"]
         env.apply_defaults(pool)
         # several nodes: one pod each, big enough that each pod needs its own node
@@ -208,7 +213,7 @@ class TestBudgets:
 class TestConsolidation:
     def test_underutilized_nodes_consolidated(self, env):
         # consolidate only after a quiet window, so provisioning settles first
-        env.apply_defaults(pool_with(consolidate_after_s=60))
+        env.apply_defaults(pool_with(max_cpu=17, consolidate_after_s=60))
         pods = make_pods(30, "w", {"cpu": "1", "memory": "2Gi"})
         provision(env, pods)
         # most pods finish: the remaining few should repack onto fewer nodes
@@ -253,7 +258,7 @@ class TestConsolidation:
         assert any("replace" in r or "delete" in r for _, r in env.disruption.disrupted)
 
     def test_do_not_disrupt_respected(self, env):
-        env.apply_defaults(pool_with(consolidate_after_s=60))
+        env.apply_defaults(pool_with(max_cpu=17, consolidate_after_s=60))
         pods = make_pods(
             2, "w", {"cpu": "1", "memory": "2Gi"},
             annotations={lbl.ANNOTATION_DO_NOT_DISRUPT: "true"},

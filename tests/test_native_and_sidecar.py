@@ -51,7 +51,8 @@ class TestNativeSolver:
     def test_parity_with_tpu(self, catalog, pool):
         pods = workload()
         rn = NativeSolver().solve(pods, [pool], catalog)
-        rt = TPUSolver().solve(pods, [pool], catalog)
+        # refine=False: the native path is the plain greedy scan
+        rt = TPUSolver(refine=False).solve(pods, [pool], catalog)
         assert len(rn.node_specs) == len(rt.node_specs)
         assert rn.total_cost == pytest.approx(rt.total_cost, rel=1e-4)
 
@@ -90,7 +91,8 @@ class TestSidecar:
 
         pods = workload()
         remote = RemoteSolver(client).solve(pods, [pool], catalog)
-        local = TPUSolver().solve(pods, [pool], catalog)
+        # refine=False: the sidecar wire carries the plain greedy plan
+        local = TPUSolver(refine=False).solve(pods, [pool], catalog)
         assert remote.pods_placed() == local.pods_placed()
         assert len(remote.node_specs) == len(local.node_specs)
         assert remote.total_cost == pytest.approx(local.total_cost, rel=1e-5)

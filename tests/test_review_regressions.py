@@ -264,3 +264,61 @@ class TestConsolidateCapacityAxis:
         winners = {name: opts for _, name, _, opts in out}
         assert itype in winners
         assert (zone, lbl.CAPACITY_TYPE_RESERVED) in winners[itype]
+
+
+class TestAdviceRound3:
+    """Round-3 advisor findings (ADVICE.md): stale-encoding contract,
+    OD-fallback gate source, zonal OD price floor."""
+
+    def test_bump_version_invalidates_cached_encoding(self, catalog, pool):
+        # In-place label mutation (common k8s idiom) + bump_version() must
+        # defeat the cross-solve problem cache; without the bump the stale
+        # encoding would be served (documented reassignment-only contract).
+        pods = make_pods(4, "w", {"cpu": "500m", "memory": "1Gi"})
+        p1 = encode_problem(pods, catalog, pool)
+        p_same = encode_problem(pods, catalog, pool)
+        assert p_same is p1  # cache hit while nothing changed
+        pods[0].labels["team"] = "ml"  # in-place: invisible to __setattr__
+        pods[0].bump_version()
+        p2 = encode_problem(pods, catalog, pool)
+        assert p2 is not p1
+
+    def test_od_fallback_gate_fires_when_spot_ice_cached_at_solve_time(self):
+        # Claim whose offerings carry only on-demand (spot was ICE-cached at
+        # solve time) but whose capacity-type REQUIREMENTS still allow spot:
+        # the flexibility gate must still refuse a 1-type OD fallback
+        # (reference checks the requirements, instance.go:272).
+        from karpenter_provider_aws_tpu.testenv import new_environment
+        from karpenter_provider_aws_tpu.models.nodeclaim import NodeClaim
+        from karpenter_provider_aws_tpu.utils import errors
+
+        env = new_environment(use_tpu_solver=False)
+        env.apply_defaults(NodePool(name="default"))
+        claim = NodeClaim.fresh(
+            nodepool_name="default",
+            nodeclass_name="default",
+            instance_type_options=["m5.large"],
+            zone_options=["zone-a"],
+            capacity_type_options=["spot", "on-demand"],
+        )
+        claim.offering_options = [("zone-a", "on-demand")]
+        env.cluster.apply(claim)
+        with pytest.raises(errors.CloudError) as ei:
+            env.cloudprovider.create(claim)
+        assert ei.value.code == "InsufficientTypeFlexibility"
+
+    def test_spot_filter_uses_cheapest_zonal_od_floor(self, catalog):
+        # A zonal OD override below the regional price must become the
+        # comparison floor (per-offering prices, not one per-type number).
+        it = next(t for t in catalog.list() if t.category == "m" and t.vcpus == 2)
+        regional = catalog.pricing.on_demand_price(it)
+        catalog.pricing.update_on_demand_zonal({(it.name, "zone-b"): regional * 0.5})
+        try:
+            assert catalog.pricing.on_demand_price_zonal(it, "zone-b") == pytest.approx(
+                regional * 0.5
+            )
+            assert catalog.pricing.on_demand_price_zonal(it, "zone-a") == pytest.approx(
+                regional
+            )
+        finally:
+            catalog.pricing.reset()

@@ -59,10 +59,10 @@ class TestProblemCache:
         type_window would otherwise keep advertising the dead offering."""
         pods = make_pods(50, "w", {"cpu": "500m", "memory": "1Gi"})
         p1 = encode_problem(pods, catalog, pool)
-        catalog.unavailable.mark_unavailable("c7g.6xlarge", "zone-a", "on-demand")
+        catalog.unavailable.mark_unavailable("c7g.4xlarge", "zone-a", "on-demand")
         p2 = encode_problem(pods, catalog, pool)
         assert p1 is not p2
-        ti = p2.type_names.index("c7g.6xlarge")
+        ti = p2.type_names.index("c7g.4xlarge")
         zi = p2.zones.index("zone-a")
         ci = lbl.CAPACITY_TYPES.index("on-demand")
         assert p1.type_window[ti, zi, ci]

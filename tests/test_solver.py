@@ -123,7 +123,9 @@ class TestParity:
 
     def check(self, pods, pool, catalog):
         problem = encode_problem(pods, catalog, pool)
-        tpu_specs, _, tpu_un = TPUSolver().solve_encoded(problem)
+        # refine=False: the oracle is the PLAIN greedy; the refine pass can
+        # legitimately drop nodes below it (covered by test_refine.py)
+        tpu_specs, _, tpu_un = TPUSolver(refine=False).solve_encoded(problem)
         # re-encode: decode mutates nothing but cursors are internal
         problem2 = encode_problem(pods, catalog, pool)
         nodes, oracle_un = ffd_oracle(problem2)
