@@ -254,6 +254,24 @@ def child_measure() -> None:
     emit(result)
 
 
+def child_multichip() -> None:
+    """Virtual-mesh rows (sharded solve+merge, sharded 5k screen) — host
+    only, stream to BENCH_DETAIL.jsonl."""
+    import contextlib
+
+    from benchmarks.multichip_bench import run_all as run_multichip
+
+    scale = float(os.environ.get("BENCH_MULTICHIP_SCALE", "1.0"))
+    stamp = {"run_at_unix": int(time.time()), "scale": scale}
+
+    def on_row(row):
+        with open(DETAIL_PATH, "a") as f:
+            f.write(json.dumps({**row, **stamp}) + "\n")
+
+    with contextlib.redirect_stdout(sys.stderr):
+        run_multichip(scale=scale, on_row=on_row)
+
+
 def child_configs() -> None:
     """The BASELINE config sweep; rows stream to BENCH_DETAIL.jsonl."""
     _force_cpu_if_asked()
@@ -390,6 +408,12 @@ def main() -> None:
         _, err = run_child("host", min(240.0, _remaining() - SAFETY_MARGIN_S))
         if err:
             errors.append(err)
+        # virtual-mesh multichip rows: sharded solve+merge and the
+        # mesh-sharded 5k consolidation screen (own process: the virtual
+        # platform must be set before jax initializes)
+        _, err = run_child("multichip", min(420.0, _remaining() - SAFETY_MARGIN_S))
+        if err:
+            errors.append(err)
 
     # Phase B: CPU headline at reduced scale — ALWAYS produces a fallback
     # headline before any accelerator is touched.
@@ -475,7 +499,8 @@ if __name__ == "__main__":
         if arg.startswith("--child="):
             child = arg.split("=", 1)[1]
             try:
-                {"host": child_host, "measure": child_measure, "configs": child_configs}[child]()
+                {"host": child_host, "measure": child_measure,
+                 "configs": child_configs, "multichip": child_multichip}[child]()
             except Exception as e:
                 traceback.print_exc()
                 if child == "measure":

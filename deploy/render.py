@@ -46,6 +46,26 @@ def render(text: str, values: dict[str, str]) -> str:
     return re.sub(r"\$\{([a-zA-Z0-9_.]+)\}", sub, text)
 
 
+def _import_crds():
+    sys.path.insert(0, str(HERE.parent))
+    from karpenter_provider_aws_tpu.operator import crds
+
+    return crds
+
+
+def _crd_docs() -> list[str]:
+    """CRD artifacts with the admission rules encoded (parity: the
+    reference bundles pkg/apis/crds/ into its chart). JSON is valid YAML,
+    so the docs concatenate into the same stream."""
+    import json
+
+    crds = _import_crds()
+    return [
+        json.dumps(crds.nodeclass_crd(), indent=1),
+        json.dumps(crds.nodepool_crd(), indent=1),
+    ]
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--values", default=str(HERE / "values.yaml"))
@@ -53,15 +73,17 @@ def main() -> int:
     args = ap.parse_args()
     values = load_values(pathlib.Path(args.values))
     docs = [render((HERE / m).read_text(), values) for m in MANIFESTS]
-    blob = "\n---\n".join(docs)
     if args.out == "-":
-        sys.stdout.write(blob)
+        sys.stdout.write("\n---\n".join(_crd_docs() + docs))
     else:
         outdir = pathlib.Path(args.out)
         outdir.mkdir(parents=True, exist_ok=True)
         for name, doc in zip(MANIFESTS, docs):
             (outdir / name).write_text(doc)
-        print(f"rendered {len(MANIFESTS)} manifests to {outdir}")
+        written = _import_crds().write_crds(outdir / "crds")
+        print(
+            f"rendered {len(MANIFESTS)} manifests + {len(written)} CRDs to {outdir}"
+        )
     return 0
 
 

@@ -62,6 +62,15 @@ class CloudBackend(Protocol):
 
     def tag_instance(self, instance_id: str, tags: dict[str, str]) -> None: ...
 
+    # -- coordination ------------------------------------------------------
+    # Leader-election lease host (parity: the coordination.k8s.io Lease the
+    # reference's controller-runtime manager uses, cmd/controller/main.go:34).
+    # try_acquire_lease is a CAS acquire-or-renew returning the holder AFTER
+    # the attempt; release_lease is the voluntary hand-off.
+    def try_acquire_lease(self, name: str, holder: str, ttl_s: float) -> str: ...
+
+    def release_lease(self, name: str, holder: str) -> None: ...
+
     # -- networking / discovery -------------------------------------------
     def describe_availability_zones(self) -> dict[str, str]: ...
 

@@ -25,13 +25,28 @@ class Controller(Protocol):
 
 
 class Manager:
-    def __init__(self, controllers: list[Controller]):
+    def __init__(self, controllers: list[Controller], elector=None):
         self.controllers = list(controllers)
+        # Leader election (parity: controller-runtime manager's lease gate,
+        # cmd/controller/main.go:34): when an elector is present it runs
+        # like any controller, and every OTHER controller is idled while
+        # this replica does not hold the lease — two replicas of a
+        # node-launching control loop must never both write.
+        self.elector = elector
+        if elector is not None:
+            self.controllers.insert(0, elector)
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         # last reconcile errors, newest last (bounded); controller-runtime
         # parity: a failing reconcile is logged and requeued, never fatal.
         self.errors: list[tuple[str, Exception]] = []
+
+    def _idled(self, c: Controller) -> bool:
+        return (
+            self.elector is not None
+            and c is not self.elector
+            and not self.elector.is_leader()
+        )
 
     def start(self) -> None:
         for c in self.controllers:
@@ -41,17 +56,31 @@ class Manager:
 
     def _run(self, c: Controller) -> None:
         while not self._stop.is_set():
-            try:
-                c.reconcile()
-            except Exception as e:
-                log.exception("controller %s reconcile failed", c.name)
-                self._record_error(c, e)
+            if not self._idled(c):
+                try:
+                    c.reconcile()
+                except Exception as e:
+                    log.exception("controller %s reconcile failed", c.name)
+                    self._record_error(c, e)
             self._stop.wait(c.interval_s)
 
     def stop(self, timeout: float = 5.0) -> None:
         self._stop.set()
         for t in self._threads:
             t.join(timeout=timeout)
+        if self.elector is not None:
+            stuck = [t.name for t in self._threads if t.is_alive()]
+            if stuck:
+                # a reconcile is still mid-write: releasing now would let a
+                # successor start writing concurrently — keep the lease and
+                # let the TTL fence the hand-off instead
+                log.warning(
+                    "not releasing leader lease: %s still running", stuck
+                )
+            else:
+                # clean shutdown hands the lease off instead of making the
+                # successor wait out the TTL
+                self.elector.release()
 
     def _record_error(self, c: Controller, e: Exception) -> None:
         self.errors.append((c.name, e))
@@ -60,8 +89,11 @@ class Manager:
     def reconcile_all_once(self) -> None:
         """Deterministic single pass in registration order (test helper).
         Errors are isolated per controller, exactly like the threaded path —
-        one failing reconcile must not starve the others."""
+        one failing reconcile must not starve the others. Leadership gating
+        applies exactly like the threaded path too."""
         for c in self.controllers:
+            if self._idled(c):
+                continue
             try:
                 c.reconcile()
             except Exception as e:

@@ -123,6 +123,8 @@ class FakeCloud:
         self.security_groups: list[SecurityGroup] = [
             SecurityGroup(id="sg-1", name="default", tags={"discovery": "cluster-1"}),
         ]
+        # leader-election leases: name -> (holder, expires_at)
+        self._leases: dict[str, tuple[str, float]] = {}
         self.images: list[Image] = [
             Image(id="img-std-2", name="standard-v2", family="standard", arch="amd64", created_seq=2),
             Image(id="img-std-arm-2", name="standard-arm-v2", family="standard", arch="arm64", created_seq=2),
@@ -248,6 +250,28 @@ class FakeCloud:
             self._record("describe_availability_zones", None)
             self._maybe_fail()
             return dict(self.zone_types)
+
+    # -- coordination (leader-election lease host) -------------------------
+    def try_acquire_lease(self, name: str, holder: str, ttl_s: float) -> str:
+        """CAS acquire-or-renew: the current holder renews, anyone else
+        takes over only after expiry. Returns the holder AFTER the attempt
+        (parity: the coordination.k8s.io Lease the reference's manager
+        rides, cmd/controller/main.go:34)."""
+        with self._lock:
+            self._maybe_fail()
+            now = self.clock.now()
+            lease = self._leases.get(name)
+            if lease is None or lease[0] == holder or now >= lease[1]:
+                self._leases[name] = (holder, now + ttl_s)
+                return holder
+            return lease[0]
+
+    def release_lease(self, name: str, holder: str) -> None:
+        """Voluntary hand-off; only the holder may release."""
+        with self._lock:
+            lease = self._leases.get(name)
+            if lease is not None and lease[0] == holder:
+                del self._leases[name]
 
     def describe_cluster(self) -> dict:
         """Cluster network facts (EKS DescribeCluster analogue)."""

@@ -1,0 +1,600 @@
+"""CRD artifacts: machine-readable API schemas with the admission rules.
+
+Parity: ``pkg/apis/crds/`` — the reference ships CustomResourceDefinitions
+whose openAPI v3 schemas carry CEL ``x-kubernetes-validations`` markers
+(authored in ``pkg/apis/v1beta1/ec2nodeclass.go:29-120``), so an external
+apiserver enforces the same rules the webhooks do. This module emits the
+equivalent artifacts for NodeClass and NodePool (written into the deploy
+bundle by ``deploy/render.py``), plus:
+
+ - converters from the in-memory models to the CRD spec wire shape, and
+ - a validator (`validate_object`) that enforces the schema EXACTLY as
+   shipped — structural openAPI constraints plus evaluation of the CEL
+   rule strings via a small CEL-subset interpreter — so tests can prove
+   the artifact rejects what ``webhooks.admit()`` rejects (the rule
+   strings themselves are under test, not a parallel re-implementation).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Optional
+
+from ..models import labels as lbl
+
+API_GROUP = "karpenter.tpu"
+RESTRICTED_KEYS = sorted(lbl.RESTRICTED_LABELS | {lbl.NODEPOOL})
+
+
+# ---------------------------------------------------------------------------
+# CEL-subset interpreter (the dialect used by the rules below): literals,
+# self paths, indexing, ! == != < <= > >= && || ?: in, has(), size(),
+# .exists() .exists_one() .all() .startsWith()
+# ---------------------------------------------------------------------------
+
+_TOKEN = re.compile(
+    r"\s*(?:(?P<num>\d+(?:\.\d+)?)|(?P<str>'[^']*')|(?P<id>[A-Za-z_][A-Za-z0-9_]*)"
+    r"|(?P<op>&&|\|\||[!<>=]=|[()\[\],.!<>?:]))"
+)
+
+
+def _tokenize(src: str) -> list[str]:
+    out, i = [], 0
+    while i < len(src):
+        m = _TOKEN.match(src, i)
+        if m is None:
+            raise ValueError(f"bad CEL at {src[i:]!r}")
+        out.append(m.group(m.lastgroup))
+        i = m.end()
+    return out
+
+
+def _get_field(obj, name: str):
+    if isinstance(obj, dict):
+        return obj.get(name)
+    return getattr(obj, name)
+
+
+class _Cel:
+    """Compiles the token stream to closures env->value, so `&&`/`||`/`?:`
+    short-circuit exactly like CEL (an eager evaluator would error on
+    `has(self.x) && self.x > 0` when x is absent)."""
+
+    def __init__(self, tokens: list[str]):
+        self.t = tokens
+        self.i = 0
+
+    def peek(self) -> Optional[str]:
+        return self.t[self.i] if self.i < len(self.t) else None
+
+    def next(self) -> str:
+        tok = self.t[self.i]
+        self.i += 1
+        return tok
+
+    def expect(self, tok: str) -> None:
+        got = self.next()
+        if got != tok:
+            raise ValueError(f"expected {tok!r}, got {got!r}")
+
+    # precedence climbing: ternary < or < and < cmp < unary < member
+    def expr(self):
+        cond = self.or_()
+        if self.peek() == "?":
+            self.next()
+            a = self.expr()
+            self.expect(":")
+            b = self.expr()
+            return lambda env: a(env) if cond(env) else b(env)
+        return cond
+
+    def or_(self):
+        v = self.and_()
+        while self.peek() == "||":
+            self.next()
+            lhs, rhs = v, self.and_()
+            v = (lambda a, b: lambda env: bool(a(env)) or bool(b(env)))(lhs, rhs)
+        return v
+
+    def and_(self):
+        v = self.cmp()
+        while self.peek() == "&&":
+            self.next()
+            lhs, rhs = v, self.cmp()
+            v = (lambda a, b: lambda env: bool(a(env)) and bool(b(env)))(lhs, rhs)
+        return v
+
+    _CMP = {
+        "==": lambda a, b: a == b,
+        "!=": lambda a, b: a != b,
+        "<": lambda a, b: a < b,
+        "<=": lambda a, b: a <= b,
+        ">": lambda a, b: a > b,
+        ">=": lambda a, b: a >= b,
+        "in": lambda a, b: a in b,
+    }
+
+    def cmp(self):
+        v = self.unary()
+        while self.peek() in self._CMP:
+            fn = self._CMP[self.next()]
+            lhs, rhs = v, self.unary()
+            v = (lambda f, a, b: lambda env: f(a(env), b(env)))(fn, lhs, rhs)
+        return v
+
+    def unary(self):
+        if self.peek() == "!":
+            self.next()
+            inner = self.unary()
+            return lambda env: not inner(env)
+        return self.member()
+
+    def member(self):
+        v = self.atom()
+        while True:
+            tok = self.peek()
+            if tok == ".":
+                self.next()
+                name = self.next()
+                if self.peek() == "(":
+                    self.next()
+                    v = self.call_method(v, name)
+                else:
+                    v = (lambda r, n: lambda env: _get_field(r(env), n))(v, name)
+            elif tok == "[":
+                self.next()
+                idx = self.expr()
+                self.expect("]")
+                v = (lambda r, ix: lambda env: r(env)[ix(env)])(v, idx)
+            else:
+                return v
+
+    def call_method(self, recv, name: str):
+        if name in ("exists", "exists_one", "all"):
+            var = self.next()
+            self.expect(",")
+            body = self.expr()
+            self.expect(")")
+
+            def macro(env, recv=recv, var=var, body=body, name=name):
+                items = list(recv(env))  # map -> keys, list -> elements
+                hits = sum(1 for item in items if body({**env, var: item}))
+                if name == "exists":
+                    return hits > 0
+                if name == "exists_one":
+                    return hits == 1
+                return hits == len(items)
+
+            return macro
+        if name == "startsWith":
+            arg = self.expr()
+            self.expect(")")
+            return (
+                lambda r, a: lambda env: isinstance(r(env), str)
+                and r(env).startswith(a(env))
+            )(recv, arg)
+        raise ValueError(f"unknown method {name}")
+
+    def atom(self):
+        tok = self.next()
+        if tok == "(":
+            v = self.expr()
+            self.expect(")")
+            return v
+        if tok == "[":
+            items = []
+            while self.peek() != "]":
+                items.append(self.expr())
+                if self.peek() == ",":
+                    self.next()
+            self.expect("]")
+            return lambda env: [it(env) for it in items]
+        if tok.startswith("'"):
+            s = tok[1:-1]
+            return lambda env: s
+        if tok and tok[0].isdigit():
+            n = float(tok) if "." in tok else int(tok)
+            return lambda env: n
+        if tok == "true":
+            return lambda env: True
+        if tok == "false":
+            return lambda env: False
+        if tok == "has":
+            self.expect("(")
+            root = self.next()
+            parts = []
+            while self.peek() == ".":
+                self.next()
+                parts.append(self.next())
+            self.expect(")")
+
+            def has(env, root=root, parts=tuple(parts)):
+                base = env[root]
+                for p in parts[:-1]:
+                    base = _get_field(base, p)
+                    if base is None:
+                        return False
+                return _get_field(base, parts[-1]) is not None
+
+            return has
+        if tok == "size":
+            self.expect("(")
+            v = self.expr()
+            self.expect(")")
+            return lambda env: len(v(env))
+        name = tok
+        return lambda env: env[name]
+
+
+def cel_eval(rule: str, self_value) -> bool:
+    program = _Cel(_tokenize(rule)).expr()
+    return bool(program({"self": self_value}))
+
+
+# ---------------------------------------------------------------------------
+# Schema walker: the subset of structural openAPI v3 the CRDs below use.
+# ---------------------------------------------------------------------------
+
+_TYPES = {
+    "object": dict, "array": list, "string": str,
+    "boolean": bool, "integer": (int,), "number": (int, float),
+}
+
+
+def _walk(schema: dict, value, path: str, out: list[str]) -> None:
+    t = schema.get("type")
+    if t and value is not None:
+        expected = _TYPES[t]
+        if t == "boolean":
+            ok = isinstance(value, bool)
+        elif t == "integer":
+            ok = isinstance(value, int) and not isinstance(value, bool)
+        elif t == "number":
+            ok = isinstance(value, (int, float)) and not isinstance(value, bool)
+        else:
+            ok = isinstance(value, expected)
+        if not ok:
+            out.append(f"{path}: expected {t}")
+            return
+    if value is None:
+        return
+    if "enum" in schema and value not in schema["enum"]:
+        out.append(f"{path}: {value!r} not in {schema['enum']}")
+    if "minimum" in schema and isinstance(value, (int, float)) and value < schema["minimum"]:
+        out.append(f"{path}: {value} below minimum {schema['minimum']}")
+    if "maximum" in schema and isinstance(value, (int, float)) and value > schema["maximum"]:
+        out.append(f"{path}: {value} above maximum {schema['maximum']}")
+    if "pattern" in schema and isinstance(value, str) and not re.fullmatch(schema["pattern"], value):
+        out.append(f"{path}: {value!r} does not match {schema['pattern']}")
+    if isinstance(value, list):
+        if "maxItems" in schema and len(value) > schema["maxItems"]:
+            out.append(f"{path}: more than {schema['maxItems']} items")
+        items = schema.get("items")
+        if items:
+            for i, item in enumerate(value):
+                _walk(items, item, f"{path}[{i}]", out)
+    if isinstance(value, dict):
+        for req in schema.get("required", ()):
+            if value.get(req) is None:
+                out.append(f"{path}: missing required field {req!r}")
+        props = schema.get("properties", {})
+        for k, sub in props.items():
+            if k in value:
+                _walk(sub, value[k], f"{path}.{k}", out)
+        addl = schema.get("additionalProperties")
+        if isinstance(addl, dict):
+            for k, v in value.items():
+                if k not in props:
+                    _walk(addl, v, f"{path}.{k}", out)
+    for rule in schema.get("x-kubernetes-validations", ()):
+        try:
+            ok = cel_eval(rule["rule"], value)
+        except Exception as e:  # a broken shipped rule must fail loudly
+            out.append(f"{path}: rule {rule['rule']!r} errored: {e}")
+            continue
+        if not ok:
+            out.append(f"{path}: {rule.get('message', rule['rule'])}")
+
+
+def validate_object(crd: dict, obj: dict) -> list[str]:
+    """Violations of ``obj`` (a {spec: ...} dict) against the CRD schema."""
+    schema = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+    out: list[str] = []
+    _walk(schema, obj, crd["spec"]["names"]["kind"], out)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The CRDs
+# ---------------------------------------------------------------------------
+
+def _selector_term_schema() -> dict:
+    return {
+        "type": "object",
+        "properties": {
+            "id": {"type": "string"},
+            "name": {"type": "string"},
+            "tags": {"type": "object", "additionalProperties": {"type": "string"}},
+        },
+        "x-kubernetes-validations": [
+            {"rule": "self.id != '' || self.name != '' || size(self.tags) > 0",
+             "message": "terms must set id, name, or tags"},
+            {"rule": "self.id == '' || (self.name == '' && size(self.tags) == 0)",
+             "message": "'id' is mutually exclusive with other fields"},
+            {"rule": "!self.tags.exists(k, k == '' || self.tags[k] == '')",
+             "message": "empty tag keys or values aren't supported"},
+        ],
+    }
+
+
+def _crd(kind: str, plural: str, spec_schema: dict) -> dict:
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"{plural}.{API_GROUP}"},
+        "spec": {
+            "group": API_GROUP,
+            "names": {"kind": kind, "plural": plural, "singular": kind.lower()},
+            "scope": "Cluster",
+            "versions": [{
+                "name": "v1",
+                "served": True,
+                "storage": True,
+                "schema": {"openAPIV3Schema": {
+                    "type": "object",
+                    "required": ["spec"],
+                    "properties": {"spec": spec_schema},
+                }},
+            }],
+        },
+    }
+
+
+def nodeclass_crd() -> dict:
+    from ..providers.imagefamily import FAMILIES
+
+    spec = {
+        "type": "object",
+        "properties": {
+            "role": {"type": "string"},
+            "instanceProfile": {"type": "string"},
+            "imageFamily": {"type": "string", "enum": sorted(FAMILIES)},
+            "userData": {"type": "string"},
+            "subnetSelectorTerms": {
+                "type": "array", "maxItems": 30, "items": _selector_term_schema(),
+            },
+            "securityGroupSelectorTerms": {
+                "type": "array", "maxItems": 30, "items": _selector_term_schema(),
+            },
+            "imageSelectorTerms": {
+                "type": "array", "maxItems": 30, "items": _selector_term_schema(),
+            },
+            "blockDeviceMappings": {
+                "type": "array", "maxItems": 50,
+                "items": {
+                    "type": "object",
+                    "properties": {
+                        "deviceName": {"type": "string"},
+                        "volumeSizeGiB": {"type": "integer", "minimum": 1},
+                        "volumeType": {"type": "string"},
+                        "rootVolume": {"type": "boolean"},
+                        "encrypted": {"type": "boolean"},
+                    },
+                },
+            },
+            "metadataOptions": {
+                "type": "object",
+                "properties": {
+                    "httpEndpoint": {"type": "string", "enum": ["enabled", "disabled"]},
+                    "httpProtocolIPv6": {"type": "string", "enum": ["enabled", "disabled"]},
+                    "httpPutResponseHopLimit": {"type": "integer", "minimum": 1, "maximum": 64},
+                    "httpTokens": {"type": "string", "enum": ["required", "optional"]},
+                },
+            },
+            "tags": {"type": "object", "additionalProperties": {"type": "string"}},
+        },
+        "x-kubernetes-validations": [
+            {"rule": "(self.role != '') != (self.instanceProfile != '')",
+             "message": "exactly one of role or instanceProfile is required"},
+            {"rule": "self.imageFamily != 'custom' || size(self.imageSelectorTerms) > 0",
+             "message": "imageFamily custom requires imageSelector terms"},
+            {"rule": "self.imageFamily != 'custom' || self.userData != ''",
+             "message": "imageFamily custom requires userData"},
+            {"rule": "!self.tags.exists(k, k == '')",
+             "message": "empty tag keys aren't supported"},
+            {"rule": "!self.tags.exists(k, k.startsWith('kubernetes.io/cluster'))",
+             "message": "tag matches restricted prefix kubernetes.io/cluster/"},
+            {"rule": f"!self.tags.exists(k, k.startsWith('{lbl.GROUP}/'))",
+             "message": f"tags may not use the {lbl.GROUP}/ namespace"},
+            {"rule": "!self.blockDeviceMappings.exists(b, b.rootVolume) || "
+                     "self.blockDeviceMappings.exists_one(b, b.rootVolume)",
+             "message": "must have only one blockDeviceMappings with rootVolume"},
+        ],
+    }
+    return _crd("NodeClass", "nodeclasses", spec)
+
+
+def nodepool_crd() -> dict:
+    from ..models.nodepool import DISRUPTION_REASONS
+
+    restricted = "[" + ", ".join(f"'{k}'" for k in RESTRICTED_KEYS) + "]"
+    spec = {
+        "type": "object",
+        "required": ["nodeClassRef"],
+        "properties": {
+            "nodeClassRef": {
+                "type": "object",
+                "properties": {"name": {"type": "string"}},
+                "x-kubernetes-validations": [
+                    {"rule": "self.name != ''", "message": "nodeClassRef is required"},
+                ],
+            },
+            "weight": {"type": "integer"},
+            "labels": {"type": "object", "additionalProperties": {"type": "string"}},
+            "requirements": {
+                "type": "array",
+                "items": {
+                    "type": "object",
+                    "required": ["key", "operator"],
+                    "properties": {
+                        "key": {"type": "string"},
+                        "operator": {
+                            "type": "string",
+                            "enum": ["In", "NotIn", "Exists", "DoesNotExist", "Gt", "Lt"],
+                        },
+                        "values": {"type": "array", "items": {"type": "string"}},
+                        "minValues": {"type": "integer", "minimum": 1},
+                    },
+                    "x-kubernetes-validations": [
+                        {"rule": f"!(self.key in {restricted})",
+                         "message": "requirement on restricted label"},
+                    ],
+                },
+            },
+            "disruption": {
+                "type": "object",
+                "properties": {
+                    "consolidationPolicy": {
+                        "type": "string",
+                        "enum": ["WhenEmpty", "WhenUnderutilized"],
+                    },
+                    "consolidateAfter": {"type": "number"},
+                    "expireAfter": {"type": "number"},
+                    "budgets": {
+                        "type": "array",
+                        "items": {
+                            "type": "object",
+                            "properties": {
+                                "nodes": {
+                                    "type": "string",
+                                    "pattern": r"[0-9]+(\.[0-9]+)?%|[0-9]+",
+                                },
+                                "reasons": {
+                                    "type": "array",
+                                    "items": {"type": "string",
+                                              "enum": list(DISRUPTION_REASONS)},
+                                },
+                                "schedule": {"type": "string"},
+                                "duration": {"type": "number"},
+                            },
+                            "x-kubernetes-validations": [
+                                {"rule": "!has(self.schedule) || "
+                                         "(has(self.duration) && self.duration > 0)",
+                                 "message": "budget schedule requires a positive duration"},
+                            ],
+                        },
+                    },
+                },
+                "x-kubernetes-validations": [
+                    {"rule": "!has(self.consolidateAfter) || self.consolidateAfter >= 0",
+                     "message": "consolidateAfter must be >= 0"},
+                    {"rule": "!has(self.expireAfter) || self.expireAfter > 0",
+                     "message": "expireAfter must be positive"},
+                ],
+            },
+        },
+        "x-kubernetes-validations": [
+            {"rule": f"!self.labels.exists(k, k in {restricted})",
+             "message": "template label is restricted"},
+        ],
+    }
+    return _crd("NodePool", "nodepools", spec)
+
+
+# ---------------------------------------------------------------------------
+# Model -> wire-shape converters (so one object can take both paths)
+# ---------------------------------------------------------------------------
+
+def _terms(terms) -> list[dict]:
+    return [
+        {"id": t.id, "name": t.name, "tags": {k: v for k, v in t.tags}}
+        for t in terms
+    ]
+
+
+def nodeclass_to_obj(nc) -> dict:
+    return {"spec": {
+        "role": nc.role,
+        "instanceProfile": nc.instance_profile,
+        "imageFamily": nc.image_family,
+        "userData": nc.user_data,
+        "subnetSelectorTerms": _terms(nc.subnet_selector),
+        "securityGroupSelectorTerms": _terms(nc.security_group_selector),
+        "imageSelectorTerms": _terms(nc.image_selector),
+        "blockDeviceMappings": [
+            {
+                "deviceName": bd.device_name,
+                "volumeSizeGiB": bd.volume_size_gib,
+                "volumeType": bd.volume_type,
+                "rootVolume": bd.root_volume,
+                "encrypted": bd.encrypted,
+            }
+            for bd in nc.block_devices
+        ],
+        "metadataOptions": {
+            "httpEndpoint": nc.metadata_options.http_endpoint,
+            "httpProtocolIPv6": nc.metadata_options.http_protocol_ipv6,
+            "httpPutResponseHopLimit": nc.metadata_options.http_put_response_hop_limit,
+            "httpTokens": nc.metadata_options.http_tokens,
+        },
+        "tags": dict(nc.tags),
+    }}
+
+
+def nodepool_to_obj(pool) -> dict:
+    from ..models.nodepool import Budget
+
+    budgets = []
+    for b in pool.disruption.budgets:
+        if not isinstance(b, Budget):
+            b = Budget(nodes=b)
+        row: dict[str, Any] = {"nodes": b.nodes, "reasons": list(b.reasons)}
+        if b.schedule is not None:
+            row["schedule"] = b.schedule
+        if b.duration_s is not None:
+            row["duration"] = b.duration_s
+        budgets.append(row)
+    d: dict[str, Any] = {
+        "consolidationPolicy": pool.disruption.consolidation_policy,
+        "budgets": budgets,
+    }
+    if pool.disruption.consolidate_after_s is not None:
+        d["consolidateAfter"] = pool.disruption.consolidate_after_s
+    if pool.disruption.expire_after_s is not None:
+        d["expireAfter"] = pool.disruption.expire_after_s
+    reqs = []
+    for r in pool.requirements:
+        row = {
+            "key": r.key,
+            "operator": getattr(r.operator, "value", str(r.operator)),
+            "values": [str(v) for v in r.values],
+        }
+        if r.min_values is not None:
+            row["minValues"] = r.min_values
+        reqs.append(row)
+    return {"spec": {
+        "nodeClassRef": {"name": pool.nodeclass_name},
+        "weight": pool.weight,
+        "labels": dict(pool.labels),
+        "requirements": reqs,
+        "disruption": d,
+    }}
+
+
+def write_crds(outdir) -> list:
+    """Write both CRD artifacts as JSON (JSON is valid YAML) — called by
+    deploy/render.py alongside the manifests."""
+    import json
+    import pathlib
+
+    outdir = pathlib.Path(outdir)
+    outdir.mkdir(parents=True, exist_ok=True)
+    written = []
+    for name, crd in (
+        (f"{API_GROUP}_nodeclasses.json", nodeclass_crd()),
+        (f"{API_GROUP}_nodepools.json", nodepool_crd()),
+    ):
+        p = outdir / name
+        p.write_text(json.dumps(crd, indent=1) + "\n")
+        written.append(p)
+    return written

@@ -1,0 +1,100 @@
+"""Leader election: single-writer gating for multi-replica deployments.
+
+Parity: the reference inherits leader election from the controller-runtime
+manager (``cmd/controller/main.go:34`` — a coordination.k8s.io Lease with
+CAS acquire/renew) and ships 2 replicas behind it
+(``charts/karpenter/templates/deployment.yaml``). Here the lease lives in
+the cloud backend (``CloudBackend.try_acquire_lease`` — the control-plane
+store this framework talks to; the fake hosts it in-memory, a real adapter
+maps it to its coordination primitive), and the elector runs as a normal
+controller: every tick it CAS-renews, and the ``Manager`` idles every other
+controller while this replica does not hold the lease.
+
+Timings follow client-go's defaults shape: lease TTL 15 s, renew every 2 s
+— a dead leader is succeeded within one TTL, and a paused leader (GC,
+network blip) shorter than the TTL never loses the lease mid-flight.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import time
+import uuid
+from typing import Optional
+
+from ..utils.clock import Clock
+
+log = logging.getLogger("karpenter.tpu.leaderelection")
+
+LEASE_NAME = "karpenter-tpu-controller-leader"
+LEASE_TTL_S = 15.0
+RENEW_INTERVAL_S = 2.0
+
+
+class LeaderElector:
+    """A controller that maintains (or contends for) the leader lease."""
+
+    name = "leaderelection"
+
+    def __init__(
+        self,
+        cloud,
+        identity: str = "",
+        lease_name: str = LEASE_NAME,
+        ttl_s: float = LEASE_TTL_S,
+        clock: Optional[Clock] = None,
+    ):
+        self.cloud = cloud
+        self.identity = identity or f"{socket.gethostname()}-{uuid.uuid4().hex[:8]}"
+        self.lease_name = lease_name
+        self.ttl_s = ttl_s
+        self.interval_s = RENEW_INTERVAL_S
+        self.clock = clock
+        self._leader = False
+        self._renewed_at: Optional[float] = None
+
+    def _now(self) -> float:
+        return self.clock.now() if self.clock is not None else time.monotonic()
+
+    def reconcile(self) -> None:
+        holder = self.cloud.try_acquire_lease(
+            self.lease_name, self.identity, self.ttl_s
+        )
+        was = self._leader
+        self._leader = holder == self.identity
+        if self._leader:
+            self._renewed_at = self._now()
+        if self._leader and not was:
+            log.info("%s acquired leadership (%s)", self.identity, self.lease_name)
+        elif was and not self._leader:
+            # lost the lease (e.g. a pause longer than the TTL let another
+            # replica steal it): stop writing IMMEDIATELY — the Manager
+            # gates every other controller on is_leader()
+            log.warning(
+                "%s LOST leadership to %s (%s)",
+                self.identity, holder, self.lease_name,
+            )
+
+    def is_leader(self) -> bool:
+        """Leadership requires a renewal inside the last TTL. Without this
+        local deadline, a leader whose CAS renewals FAIL (cloud/API errors)
+        would keep writing on stale state while a contender steals the
+        expired lease — split-brain. client-go's elector drops leadership
+        the same way when it cannot renew within the lease duration."""
+        if not self._leader or self._renewed_at is None:
+            return False
+        if self._now() - self._renewed_at > self.ttl_s:
+            self._leader = False
+            log.warning(
+                "%s dropping leadership: no successful renew within %.0fs",
+                self.identity, self.ttl_s,
+            )
+        return self._leader
+
+    def release(self) -> None:
+        """Voluntary hand-off (clean shutdown): drop the lease so the
+        successor does not wait out the TTL."""
+        if self._leader:
+            self.cloud.release_lease(self.lease_name, self.identity)
+            self._leader = False

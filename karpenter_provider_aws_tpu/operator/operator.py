@@ -102,9 +102,12 @@ def new_operator(
     cloud=None,
     queue=None,
     clock: Optional[Clock] = None,
+    cluster: Optional[Cluster] = None,
 ) -> Operator:
     """Build the full control plane. ``cloud`` is the cloud backend handle
-    (the fake for tests; a real adapter in production)."""
+    (the fake for tests; a real adapter in production). ``cluster`` lets
+    multi-replica tests share one state store the way two replicas share
+    one apiserver."""
     options = options or Options.from_env_and_args()
     clock = clock or RealClock()
     from ..utils.observability import Profiler, enable_xla_dump, setup_logging
@@ -146,7 +149,7 @@ def new_operator(
         ),
         clock=clock,
     )
-    cluster = Cluster(clock=clock)
+    cluster = cluster if cluster is not None else Cluster(clock=clock)
     from ..providers.bootstrap import ClusterInfo
     from ..providers.launchtemplates import resolve_service_cidr as _cidr
 
@@ -226,11 +229,19 @@ def new_operator(
             InterruptionController(cluster, cloudprovider, queue, recorder=recorder),
         )
 
+    elector = None
+    if options.leader_elect:
+        from .leaderelection import LeaderElector
+
+        elector = LeaderElector(
+            cloud, identity=options.leader_identity, clock=clock
+        )
+
     return Operator(
         options=options,
         cluster=cluster,
         catalog=catalog,
         cloudprovider=cloudprovider,
-        manager=Manager(controllers),
+        manager=Manager(controllers, elector=elector),
         version_provider=version_provider,
     )

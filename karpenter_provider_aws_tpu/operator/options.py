@@ -48,6 +48,11 @@ class Options:
     compilation_cache_dir: str = ""              # "" = off
     ip_family: str = "ipv4"                      # ipv4 | ipv6 (cluster address family)
     cluster_dns_ip: str = ""                     # "" = discover (KubeDNSIP parity)
+    # single-writer gating for multi-replica deployments (parity: the
+    # controller-runtime manager lease, cmd/controller/main.go:34; the
+    # shipped deployment.yaml runs 2 replicas behind this flag)
+    leader_elect: bool = False
+    leader_identity: str = ""                    # "" = hostname + random suffix
 
     @staticmethod
     def from_env_and_args(argv: Optional[list[str]] = None) -> "Options":

@@ -396,37 +396,48 @@ def repack_check(
 
 
 def _repack_backend(ct: ClusterTensors) -> str:
-    """pallas on real accelerators when the shared blocks fit VMEM; the XLA
+    """mesh (candidate axis sharded over the devices) on real multi-chip;
+    pallas on single accelerators when the shared blocks fit VMEM; the XLA
     vmap path otherwise; 'native' (C++) available for JAX-free deployments.
-    KARPENTER_TPU_REPACK=pallas|vmap|native overrides."""
+    KARPENTER_TPU_REPACK=mesh|pallas|vmap|native overrides."""
     import os
 
     mode = os.environ.get("KARPENTER_TPU_REPACK", "auto")
-    if mode in ("vmap", "pallas", "native"):
+    if mode in ("vmap", "pallas", "native", "mesh"):
         return mode
     from .repack_pallas import VMEM_BUDGET_BYTES, repack_vmem_bytes
 
     if jax.default_backend() == "cpu":
         return "vmap"  # interpret mode is for tests, not serving
+    if len(jax.devices()) > 1:
+        # real multi-chip: D devices screen the candidate axis D-ways
+        return "mesh"
     N, R = ct.free.shape
     if repack_vmem_bytes(N, ct.requests.shape[0], R) <= VMEM_BUDGET_BYTES:
         return "pallas"
     return "vmap"
 
 
+def screen_cap_wire(ct: ClusterTensors) -> np.ndarray:
+    """The screen's [G, N] capability matrix in wire form, shared by every
+    backend (single-device AND the mesh-sharded screen — one encoding rule,
+    one place). uint16: the cap is the largest upload of the sweep and H2D
+    bandwidth dominates on a tunneled chip; 60000 == uncapped (no node
+    holds that many pods), exact otherwise."""
+    screen_cap = ct.cap if ct.cap is not None else ct.compat
+    if screen_cap.dtype != bool:
+        screen_cap = np.minimum(screen_cap, 60000).astype(np.uint16)
+    return screen_cap
+
+
 def consolidatable(ct: ClusterTensors, chunk: int = 512) -> np.ndarray:
     """can_delete[N]: pallas VMEM-resident kernel (one grid program per
-    candidate, zero HBM traffic in the slot loop), chunked vmap lanes, or
-    the C++ kernel."""
+    candidate, zero HBM traffic in the slot loop), chunked vmap lanes,
+    mesh-sharded lanes, or the C++ kernel."""
     N = len(ct.node_names)
     out = np.zeros(N, dtype=bool)
     backend = _repack_backend(ct)
-    screen_cap = ct.cap if ct.cap is not None else ct.compat
-    if screen_cap.dtype != bool:
-        # uint16 wire format: the [G, N] cap is the largest upload of the
-        # sweep and H2D bandwidth dominates on a tunneled chip; 60000 ==
-        # uncapped (no node holds that many pods), exact otherwise
-        screen_cap = np.minimum(screen_cap, 60000).astype(np.uint16)
+    screen_cap = screen_cap_wire(ct)
     if backend == "pallas":
         from .repack_pallas import repack_check_pallas
 
@@ -437,6 +448,10 @@ def consolidatable(ct: ClusterTensors, chunk: int = 512) -> np.ndarray:
         )
         out &= ~ct.blocked
         return out
+    if backend == "mesh":
+        from ..parallel import make_mesh, screen_sharded
+
+        return screen_sharded(ct, make_mesh())
     if backend == "native":
         from ..scheduling.native import repack_check_native
 

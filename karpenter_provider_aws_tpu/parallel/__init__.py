@@ -11,6 +11,8 @@ SURVEY.md section 5 ("distributed communication backend").
 from .mesh import (  # noqa: F401
     make_mesh,
     merge_sharded_plan,
+    screen_sharded,
+    sharded_screen_fn,
     sharded_solve_fn,
     solve_sharded,
 )

@@ -32,12 +32,22 @@ from ..ops.ffd import ffd_solve
 POD_AXIS = "pods"
 
 
-def make_mesh(n_devices: Optional[int] = None) -> Mesh:
-    devices = jax.devices()
-    n = n_devices or len(devices)
+@functools.lru_cache(maxsize=8)
+def _cached_mesh(devices: tuple, n: int) -> Mesh:
     return Mesh(np.array(devices[:n]), (POD_AXIS,))
 
 
+def make_mesh(n_devices: Optional[int] = None) -> Mesh:
+    # cached per device tuple: callers (and jit caches keyed on the mesh)
+    # must see ONE mesh object per configuration, not a fresh one per
+    # reconcile; a backend reinit (tests) changes the device tuple and
+    # naturally gets a fresh entry
+    devices = tuple(jax.devices())
+    n = n_devices or len(devices)
+    return _cached_mesh(devices, n)
+
+
+@functools.lru_cache(maxsize=16)
 def sharded_solve_fn(mesh: Mesh, max_nodes: int):
     """Build the jitted SPMD solve: inputs sharded on the group axis, node
     state replicated per shard, cost psum'd over ICI."""
@@ -115,6 +125,62 @@ def solve_sharded(problem, mesh: Mesh, max_nodes: int = 1024, full: bool = False
     )
     if full:
         return out + (np.asarray(node_price), np.asarray(node_window), np.asarray(placed))
+    return out
+
+
+@functools.lru_cache(maxsize=16)
+def sharded_screen_fn(mesh: Mesh):
+    """Build the jitted SPMD consolidation screen: the candidate axis is
+    sharded over the mesh, cluster tensors replicated — each device answers
+    "remove node i, do its pods fit elsewhere?" for its slice of candidates.
+    Pure SPMD (the screen reads shared state, writes disjoint lanes), so
+    there is zero cross-device communication; D devices screen a 5k-node
+    cluster D-ways in parallel (SURVEY.md sections 2.3 / 7.7). lru_cache:
+    jax.jit caches by function identity — rebuilding the shard_map closure
+    per reconcile would recompile the screen every disruption pass."""
+    from ..ops.consolidate import repack_check
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P(), P(), P(POD_AXIS)),
+        out_specs=P(POD_AXIS),
+        check_vma=False,
+    )
+    def _screen(free, requests, gids, gcounts, cap, candidates):
+        return repack_check(free, requests, gids, gcounts, cap, candidates)
+
+    return jax.jit(_screen)
+
+
+def screen_sharded(ct, mesh: Mesh) -> np.ndarray:
+    """Mesh-parallel ``consolidatable``: can_delete[N] with the candidate
+    axis split across the mesh devices. Exact same semantics as the
+    single-device screen (consolidate.consolidatable) — the blocked mask and
+    the hostname-headroom cap ride along unchanged."""
+    from ..ops.consolidate import screen_cap_wire
+
+    N = len(ct.node_names)
+    D = mesh.devices.size
+    screen_cap = screen_cap_wire(ct)
+    # pad candidates to a multiple of the mesh size; padded lanes re-screen
+    # node 0 and are discarded
+    NB = N if N % D == 0 else N + (D - N % D)
+    cand = np.zeros(NB, dtype=np.int32)
+    cand[:N] = np.arange(N, dtype=np.int32)
+    fn = sharded_screen_fn(mesh)
+    shard = NamedSharding(mesh, P(POD_AXIS))
+    rep = NamedSharding(mesh, P())
+    ok = jax.device_get(fn(
+        jax.device_put(jnp.asarray(ct.free), rep),
+        jax.device_put(jnp.asarray(ct.requests), rep),
+        jax.device_put(jnp.asarray(ct.group_ids), rep),
+        jax.device_put(jnp.asarray(ct.group_counts), rep),
+        jax.device_put(jnp.asarray(screen_cap), rep),
+        jax.device_put(jnp.asarray(cand), shard),
+    ))
+    out = np.asarray(ok)[:N].copy()
+    out &= ~ct.blocked
     return out
 
 

@@ -1,0 +1,200 @@
+"""CRD artifacts: the shipped schema must reject what admit() rejects.
+
+Round-3 VERDICT missing #3: validation lived only inside the Python
+process; the CRD JSON (openAPI v3 + CEL x-kubernetes-validations, parity
+``pkg/apis/crds/``) is the machine-readable contract an external apiserver
+enforces. Every case here takes ONE object through BOTH paths — the
+in-process webhook chain and the shipped schema evaluated as written —
+and asserts they agree.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from karpenter_provider_aws_tpu.models import labels as lbl
+from karpenter_provider_aws_tpu.models.nodeclass import (
+    BlockDevice,
+    MetadataOptions,
+    NodeClass,
+    SelectorTerm,
+)
+from karpenter_provider_aws_tpu.models.nodepool import Budget, Disruption, NodePool
+from karpenter_provider_aws_tpu.models.requirements import Operator, Requirement
+from karpenter_provider_aws_tpu.operator.crds import (
+    cel_eval,
+    nodeclass_crd,
+    nodeclass_to_obj,
+    nodepool_crd,
+    nodepool_to_obj,
+    validate_object,
+)
+from karpenter_provider_aws_tpu.operator.webhooks import AdmissionError, admit
+
+
+def both_reject_nodeclass(nc: NodeClass):
+    with pytest.raises(AdmissionError):
+        admit(nc)
+    violations = validate_object(nodeclass_crd(), nodeclass_to_obj(nc))
+    assert violations, "schema accepted what admit() rejected"
+    return violations
+
+
+def both_reject_nodepool(pool: NodePool):
+    with pytest.raises(AdmissionError):
+        admit(pool)
+    violations = validate_object(nodepool_crd(), nodepool_to_obj(pool))
+    assert violations, "schema accepted what admit() rejected"
+    return violations
+
+
+class TestCelInterpreter:
+    def test_basics(self):
+        assert cel_eval("self.a != '' && self.b == 2", {"a": "x", "b": 2})
+        assert cel_eval("(self.a != '') != (self.b != '')", {"a": "x", "b": ""})
+        assert not cel_eval("(self.a != '') != (self.b != '')", {"a": "x", "b": "y"})
+        assert cel_eval("size(self.xs) > 1", {"xs": [1, 2]})
+        assert cel_eval("self.tags.exists(k, k.startsWith('a/'))", {"tags": {"a/b": "1"}})
+        assert cel_eval("self.xs.exists_one(x, x.r)", {"xs": [{"r": True}, {"r": False}]})
+        assert not cel_eval("self.xs.exists_one(x, x.r)", {"xs": [{"r": True}, {"r": True}]})
+        assert cel_eval("!has(self.sched) || self.dur > 0", {"dur": 0})
+        assert not cel_eval("!has(self.sched) || self.dur > 0", {"sched": "x", "dur": 0})
+        assert cel_eval("self.k in ['a', 'b']", {"k": "a"})
+        assert cel_eval("self.x > 1 ? self.y == 2 : self.y == 3", {"x": 2, "y": 2})
+
+
+class TestNodeClassParity:
+    def _valid(self, **kw) -> NodeClass:
+        return NodeClass(name="nc", role="node-role", **kw)
+
+    def test_valid_passes_both(self):
+        nc = admit(self._valid())
+        assert validate_object(nodeclass_crd(), nodeclass_to_obj(nc)) == []
+
+    def test_role_and_profile_both_set(self):
+        both_reject_nodeclass(self._valid(instance_profile="ip-1"))
+
+    def test_neither_role_nor_profile(self):
+        both_reject_nodeclass(NodeClass(name="nc"))
+
+    def test_unknown_image_family(self):
+        both_reject_nodeclass(self._valid(image_family="windows95"))
+
+    def test_custom_family_needs_selector_and_userdata(self):
+        both_reject_nodeclass(self._valid(image_family="custom"))
+
+    def test_selector_term_empty(self):
+        both_reject_nodeclass(self._valid(subnet_selector=[SelectorTerm()]))
+
+    def test_selector_term_id_exclusive(self):
+        both_reject_nodeclass(
+            self._valid(subnet_selector=[SelectorTerm.of(id="sn-1", discovery="x")])
+        )
+
+    def test_selector_term_empty_tag_value(self):
+        both_reject_nodeclass(
+            self._valid(security_group_selector=[SelectorTerm(tags=(("k", ""),))])
+        )
+
+    def test_too_many_terms(self):
+        both_reject_nodeclass(
+            self._valid(subnet_selector=[SelectorTerm.of(name=f"s{i}") for i in range(31)])
+        )
+
+    def test_two_root_volumes(self):
+        both_reject_nodeclass(self._valid(block_devices=[
+            BlockDevice(root_volume=True),
+            BlockDevice(device_name="/dev/xvdb", root_volume=True),
+        ]))
+
+    def test_nonpositive_volume(self):
+        both_reject_nodeclass(self._valid(block_devices=[BlockDevice(volume_size_gib=0)]))
+
+    def test_bad_http_tokens(self):
+        both_reject_nodeclass(
+            self._valid(metadata_options=MetadataOptions(http_tokens="maybe"))
+        )
+
+    def test_hop_limit_range(self):
+        both_reject_nodeclass(
+            self._valid(metadata_options=MetadataOptions(http_put_response_hop_limit=65))
+        )
+
+    def test_restricted_tags(self):
+        both_reject_nodeclass(self._valid(tags={"kubernetes.io/cluster/x": "owned"}))
+        both_reject_nodeclass(self._valid(tags={f"{lbl.GROUP}/internal": "1"}))
+        both_reject_nodeclass(self._valid(tags={"": "v"}))
+
+
+class TestNodePoolParity:
+    def test_valid_passes_both(self):
+        pool = admit(NodePool(name="p"))
+        assert validate_object(nodepool_crd(), nodepool_to_obj(pool)) == []
+
+    def test_restricted_requirement_key(self):
+        both_reject_nodepool(NodePool(name="p", requirements=[
+            Requirement(lbl.HOSTNAME, Operator.IN, ("n1",)),
+        ]))
+
+    def test_min_values_below_one(self):
+        both_reject_nodepool(NodePool(name="p", requirements=[
+            Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c",), min_values=0),
+        ]))
+
+    def test_restricted_template_label(self):
+        both_reject_nodepool(NodePool(name="p", labels={lbl.NODEPOOL: "x"}))
+
+    def test_bad_consolidation_policy(self):
+        both_reject_nodepool(NodePool(
+            name="p", disruption=Disruption(consolidation_policy="Sometimes"),
+        ))
+
+    def test_negative_consolidate_after(self):
+        both_reject_nodepool(NodePool(
+            name="p", disruption=Disruption(consolidate_after_s=-1),
+        ))
+
+    def test_nonpositive_expire_after(self):
+        both_reject_nodepool(NodePool(
+            name="p", disruption=Disruption(expire_after_s=0),
+        ))
+
+    def test_malformed_budget(self):
+        both_reject_nodepool(NodePool(
+            name="p", disruption=Disruption(budgets=["lots"]),
+        ))
+
+    def test_bad_budget_reason(self):
+        both_reject_nodepool(NodePool(
+            name="p",
+            disruption=Disruption(budgets=[Budget(nodes="1", reasons=("Vibes",))]),
+        ))
+
+    def test_budget_schedule_requires_duration(self):
+        both_reject_nodepool(NodePool(
+            name="p",
+            disruption=Disruption(budgets=[Budget(nodes="1", schedule="0 9 * * *")]),
+        ))
+
+    def test_missing_nodeclass_ref(self):
+        both_reject_nodepool(NodePool(name="p", nodeclass_name=""))
+
+
+class TestRenderShipsCrds:
+    def test_render_writes_crd_files(self, tmp_path):
+        import json
+        import subprocess
+        import sys
+
+        out = subprocess.run(
+            [sys.executable, "deploy/render.py", "--out", str(tmp_path)],
+            capture_output=True, text=True,
+        )
+        assert out.returncode == 0, out.stderr
+        crds = sorted((tmp_path / "crds").glob("*.json"))
+        assert len(crds) == 2
+        for p in crds:
+            doc = json.loads(p.read_text())
+            assert doc["kind"] == "CustomResourceDefinition"
+            schema = doc["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+            assert schema["properties"]["spec"]["type"] == "object"
