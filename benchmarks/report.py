@@ -47,7 +47,9 @@ def fmt(row: dict) -> str:
             bits.append(f"{row[k]:,} {k}")
     for k in ("value", "p99_ms", "p95_ms", "p50_ms", "msgs_per_sec",
               "pallas_p99_ms", "vmap_p99_ms", "native_p99_ms", "encode_ms",
-              "controller_pass_ms", "cost_vs_greedy"):
+              "controller_pass_ms", "cost_vs_greedy",
+              "projected_local_p99_ms", "link_rtt_p99_ms",
+              "single_device_ms", "cost_merged", "max_ms"):
         if k in row and row[k] is not None:
             v = row[k]
             bits.append(f"{k}={v:,.3f}" if isinstance(v, float) else f"{k}={v}")

@@ -264,7 +264,10 @@ def _walk(schema: dict, value, path: str, out: list[str]) -> None:
         out.append(f"{path}: {value} below minimum {schema['minimum']}")
     if "maximum" in schema and isinstance(value, (int, float)) and value > schema["maximum"]:
         out.append(f"{path}: {value} above maximum {schema['maximum']}")
-    if "pattern" in schema and isinstance(value, str) and not re.fullmatch(schema["pattern"], value):
+    # re.search, not fullmatch: the apiserver's openAPI pattern semantics
+    # are PARTIAL match — the validator must agree with what actually
+    # ships, so unanchored patterns fail the parity tests here too
+    if "pattern" in schema and isinstance(value, str) and not re.search(schema["pattern"], value):
         out.append(f"{path}: {value!r} does not match {schema['pattern']}")
     if isinstance(value, list):
         if "maxItems" in schema and len(value) > schema["maxItems"]:
@@ -467,7 +470,9 @@ def nodepool_crd() -> dict:
                             "properties": {
                                 "nodes": {
                                     "type": "string",
-                                    "pattern": r"[0-9]+(\.[0-9]+)?%|[0-9]+",
+                                    # anchored: the apiserver evaluates
+                                    # openAPI patterns as PARTIAL matches
+                                    "pattern": r"^([0-9]+(\.[0-9]+)?%|[0-9]+)$",
                                 },
                                 "reasons": {
                                     "type": "array",

@@ -30,6 +30,11 @@ log = logging.getLogger("karpenter.tpu.leaderelection")
 LEASE_NAME = "karpenter-tpu-controller-leader"
 LEASE_TTL_S = 15.0
 RENEW_INTERVAL_S = 2.0
+# Local renew deadline as a fraction of the TTL (client-go: renewDeadline
+# 10s STRICTLY below leaseDuration 15s). The margin is the point: a leader
+# must stop writing strictly BEFORE the lease host would let a contender
+# steal, or clock skew / boundary ties make both replicas leaders at once.
+RENEW_DEADLINE_FRACTION = 2.0 / 3.0
 
 
 class LeaderElector:
@@ -77,18 +82,20 @@ class LeaderElector:
             )
 
     def is_leader(self) -> bool:
-        """Leadership requires a renewal inside the last TTL. Without this
-        local deadline, a leader whose CAS renewals FAIL (cloud/API errors)
-        would keep writing on stale state while a contender steals the
-        expired lease — split-brain. client-go's elector drops leadership
-        the same way when it cannot renew within the lease duration."""
+        """Leadership requires a renewal inside the renew deadline (2/3 of
+        the TTL). Without this local deadline, a leader whose CAS renewals
+        FAIL (cloud/API errors) would keep writing on stale state while a
+        contender steals the expired lease — split-brain; and the deadline
+        sits strictly BELOW the TTL so the old leader stops writing before
+        the lease host would ever allow a steal (client-go's
+        renewDeadline < leaseDuration shape)."""
         if not self._leader or self._renewed_at is None:
             return False
-        if self._now() - self._renewed_at > self.ttl_s:
+        if self._now() - self._renewed_at > self.ttl_s * RENEW_DEADLINE_FRACTION:
             self._leader = False
             log.warning(
                 "%s dropping leadership: no successful renew within %.0fs",
-                self.identity, self.ttl_s,
+                self.identity, self.ttl_s * RENEW_DEADLINE_FRACTION,
             )
         return self._leader
 

@@ -112,7 +112,11 @@ def default_nodepool(pool: NodePool) -> NodePool:
 def validate_nodepool(pool: NodePool) -> None:
     v: list[str] = []
     for r in pool.requirements:
-        if r.key in lbl.RESTRICTED_LABELS:
+        # karpenter.sh/nodepool rides along with the restricted set: the
+        # controller stamps it itself, a template requirement on it is
+        # always a mistake (and the shipped CRD rule rejects it — the
+        # webhook must agree in BOTH directions)
+        if r.key in lbl.RESTRICTED_LABELS or r.key == lbl.NODEPOOL:
             v.append(f"requirement on restricted label {r.key}")
         if r.min_values is not None and r.min_values < 1:
             v.append("minValues must be >= 1")

@@ -418,6 +418,28 @@ def _repack_backend(ct: ClusterTensors) -> str:
     return "vmap"
 
 
+def force_repack_backend(mode: str):
+    """Context manager pinning KARPENTER_TPU_REPACK, RESTORING any
+    pre-existing value on exit (a bare set-then-pop would silently delete
+    an operator's forced backend for the rest of the process)."""
+    import contextlib
+    import os
+
+    @contextlib.contextmanager
+    def _cm():
+        prev = os.environ.get("KARPENTER_TPU_REPACK")
+        os.environ["KARPENTER_TPU_REPACK"] = mode
+        try:
+            yield
+        finally:
+            if prev is None:
+                os.environ.pop("KARPENTER_TPU_REPACK", None)
+            else:
+                os.environ["KARPENTER_TPU_REPACK"] = prev
+
+    return _cm()
+
+
 def screen_cap_wire(ct: ClusterTensors) -> np.ndarray:
     """The screen's [G, N] capability matrix in wire form, shared by every
     backend (single-device AND the mesh-sharded screen — one encoding rule,

@@ -1,0 +1,105 @@
+"""Upgrade e2e: operator restart + hash-version migration.
+
+Parity: the reference's e2e-upgrade workflow (install old controller,
+provision, upgrade in place, assert nothing churns) and the hash-version
+migration path (``pkg/controllers/nodeclass/hash/controller.go:83-120``).
+Level-triggered state is the upgrade story here: a NEW controller set over
+the SAME cluster + cloud (the restart shape — all state re-derived from
+objects, SURVEY.md section 5 "checkpoint/resume") must adopt the running
+fleet without churning it.
+"""
+
+from __future__ import annotations
+
+from karpenter_provider_aws_tpu.models import labels as lbl
+from karpenter_provider_aws_tpu.models.pod import make_pods
+
+
+def _provision(env, n_pods=12):
+    env.apply_defaults()
+    pods = make_pods(n_pods, "w", {"cpu": "1", "memory": "2Gi"})
+    for p in pods:
+        env.cluster.apply(p)
+    env.step(6)
+    assert not env.cluster.pending_pods()
+    return pods
+
+
+class TestOperatorRestart:
+    def test_new_controller_set_adopts_fleet_without_churn(self, host_env):
+        """Restart = fresh controllers over the same state store. The new
+        'process' must neither relaunch capacity (no new instances), nor
+        reap healthy nodes (GC must see the claims), nor drift-flag
+        anything (hash re-stamp is idempotent)."""
+        from karpenter_provider_aws_tpu.controllers import (
+            GarbageCollectionController,
+            NodeClassHashController,
+            ProvisioningController,
+        )
+
+        env = host_env
+        _provision(env)
+        instances_before = set(env.cloud.instances)
+        claims_before = set(env.cluster.nodeclaims)
+
+        # "restarted process": brand-new controller objects, same stores
+        prov2 = ProvisioningController(
+            env.cluster, env.solver, env.cloudprovider, recorder=env.events
+        )
+        gc2 = GarbageCollectionController(env.cluster, env.cloudprovider, clock=env.clock)
+        hash2 = NodeClassHashController(env.cluster)
+        for _ in range(4):
+            hash2.reconcile()
+            prov2.reconcile()
+            gc2.reconcile()
+            env.clock.advance(35)  # past the GC grace window
+            gc2.reconcile()
+        assert set(env.cloud.instances) == instances_before, "restart churned capacity"
+        assert set(env.cluster.nodeclaims) == claims_before
+        # drift must not fire from the restart alone
+        env.disruption.reconcile()
+        assert not any("drift" in r for _, r in env.disruption.disrupted)
+
+    def test_restart_resumes_pending_work(self, host_env):
+        """Pods applied while the 'old process' is down are picked up by
+        the new controller set (level-triggered, no replay log needed)."""
+        env = host_env
+        _provision(env, n_pods=4)
+        for p in make_pods(6, "late", {"cpu": "1", "memory": "2Gi"}):
+            env.cluster.apply(p)
+        from karpenter_provider_aws_tpu.controllers import ProvisioningController
+
+        prov2 = ProvisioningController(
+            env.cluster, env.solver, env.cloudprovider, recorder=env.events
+        )
+        prov2.reconcile()
+        env.step(4)
+        assert not env.cluster.pending_pods()
+
+
+class TestHashVersionMigration:
+    def test_version_bump_restamps_claims_instead_of_drifting(self, host_env):
+        """An upgrade that changes the hash-version must migrate stamped
+        claim hashes (controller.go:83-120) — not flag the whole fleet
+        drifted."""
+        env = host_env
+        _provision(env)
+        nc = env.cluster.nodeclasses["default"]
+        # simulate the OLD process having stamped an older hash-version:
+        # claims carry annotations from a previous hash algorithm
+        for claim in env.cluster.nodeclaims.values():
+            claim.annotations[lbl.ANNOTATION_NODECLASS_HASH] = "old-algo-hash"
+            claim.annotations[lbl.ANNOTATION_NODECLASS_HASH_VERSION] = "v0-legacy"
+        nc.status.set_condition("hash-version", True, reason="v0-legacy")
+
+        env.nodeclass_hash.reconcile()
+
+        for claim in env.cluster.nodeclaims.values():
+            assert (
+                claim.annotations[lbl.ANNOTATION_NODECLASS_HASH_VERSION]
+                == lbl.NODECLASS_HASH_VERSION
+            )
+            assert claim.annotations[lbl.ANNOTATION_NODECLASS_HASH] == nc.hash()
+        # and the fleet is NOT drift-disrupted afterwards
+        env.disruption.reconcile()
+        assert not any("drift" in r for _, r in env.disruption.disrupted)

@@ -198,3 +198,25 @@ class TestRenderShipsCrds:
             assert doc["kind"] == "CustomResourceDefinition"
             schema = doc["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
             assert schema["properties"]["spec"]["type"] == "object"
+
+
+class TestAcceptDirectionParity:
+    """Review finding: parity must hold in BOTH directions — an object the
+    webhook accepts must pass the schema, including near the rule edges."""
+
+    def test_nodepool_label_requirement_rejected_by_both(self):
+        both_reject_nodepool(NodePool(name="p", requirements=[
+            Requirement(lbl.NODEPOOL, Operator.IN, ("x",)),
+        ]))
+
+    def test_unanchored_pattern_cannot_hide(self):
+        # '5lots' partial-matches an unanchored pattern; with apiserver
+        # (partial) semantics in the validator, only an ANCHORED pattern
+        # rejects it — this pins the anchoring
+        both_reject_nodepool(NodePool(
+            name="p", disruption=Disruption(budgets=["5lots"]),
+        ))
+
+    def test_percentage_budgets_accepted_by_both(self):
+        pool = admit(NodePool(name="p", disruption=Disruption(budgets=["33.3%", "7"])))
+        assert validate_object(nodepool_crd(), nodepool_to_obj(pool)) == []

@@ -124,19 +124,17 @@ class TestShardedScreen:
         return encode_cluster(env.cluster, env.catalog)
 
     def test_matches_single_device_screen_exactly(self):
-        import os
-
-        from karpenter_provider_aws_tpu.ops.consolidate import consolidatable
+        from karpenter_provider_aws_tpu.ops.consolidate import (
+            consolidatable,
+            force_repack_backend,
+        )
         from karpenter_provider_aws_tpu.parallel import make_mesh, screen_sharded
 
         ct = self._ct()
         mesh = make_mesh(8)
         sharded = screen_sharded(ct, mesh)
-        os.environ["KARPENTER_TPU_REPACK"] = "vmap"
-        try:
+        with force_repack_backend("vmap"):
             single = consolidatable(ct)
-        finally:
-            os.environ.pop("KARPENTER_TPU_REPACK", None)
         assert (sharded == single).all()
         assert sharded.sum() > 0
 
@@ -148,19 +146,14 @@ class TestShardedScreen:
         assert ok.shape == (61,)
 
     def test_mesh_backend_via_env(self):
-        import os
-
-        from karpenter_provider_aws_tpu.ops.consolidate import consolidatable
+        from karpenter_provider_aws_tpu.ops.consolidate import (
+            consolidatable,
+            force_repack_backend,
+        )
 
         ct = self._ct()
-        os.environ["KARPENTER_TPU_REPACK"] = "mesh"
-        try:
+        with force_repack_backend("mesh"):
             mesh_ok = consolidatable(ct)
-        finally:
-            os.environ.pop("KARPENTER_TPU_REPACK", None)
-        os.environ["KARPENTER_TPU_REPACK"] = "vmap"
-        try:
+        with force_repack_backend("vmap"):
             vmap_ok = consolidatable(ct)
-        finally:
-            os.environ.pop("KARPENTER_TPU_REPACK", None)
         assert (mesh_ok == vmap_ok).all()
