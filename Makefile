@@ -4,7 +4,7 @@
 
 PYTEST ?= python -m pytest
 
-.PHONY: presubmit test deflake stress e2etests benchmark interruption-bench verify multichip native
+.PHONY: presubmit test deflake stress e2etests benchmark interruption-bench verify multichip native soak
 
 presubmit: test multichip  ## everything CI gates on
 
@@ -40,3 +40,6 @@ native:  ## build the C++ artifacts (FFD kernel lib + gRPC sidecar client)
 	mkdir -p native/build
 	g++ -O2 -o native/build/sidecar_client tools/sidecar_client.cpp -ldl -lz
 	@echo sidecar_client OK
+
+soak:  ## randomized churn with convergence invariants (SOAK_ROUNDS scales)
+	SOAK_ROUNDS=$${SOAK_ROUNDS:-150} $(PYTEST) tests/test_soak.py -q
