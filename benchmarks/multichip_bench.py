@@ -80,7 +80,9 @@ def bench_sharded_screen(n_nodes=5000, iters=3) -> dict:
         single_ms = (time.perf_counter() - t0) * 1000.0
     assert (ok == single).all(), "mesh screen diverged from single-device"
     return {
-        "benchmark": f"multichip_{N_DEVICES}dev_{n_nodes // 1000}k_screen",
+        # exact node count in the key: truncating to a k-suffix collides
+        # different scales under one BENCH_SUMMARY row
+        "benchmark": f"multichip_{N_DEVICES}dev_{n_nodes}node_screen",
         "nodes": n_nodes,
         "devices": N_DEVICES,
         "p99_ms": round(float(np.percentile(times, 99)), 3),

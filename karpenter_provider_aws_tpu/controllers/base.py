@@ -41,6 +41,10 @@ class Manager:
         # parity: a failing reconcile is logged and requeued, never fatal.
         self.errors: list[tuple[str, Exception]] = []
 
+    def is_running(self) -> bool:
+        """Reconcile loops are up and not stopping (the /readyz source)."""
+        return bool(self._threads) and not self._stop.is_set()
+
     def _idled(self, c: Controller) -> bool:
         return (
             self.elector is not None

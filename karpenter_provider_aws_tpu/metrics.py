@@ -131,20 +131,39 @@ class Registry:
                 lines.extend(m.expose())
         return "\n".join(lines) + "\n"
 
-    # -- /metrics endpoint -------------------------------------------------
-    def serve(self, port: int) -> int:
+    # -- /metrics + health endpoints ---------------------------------------
+    def serve(self, port: int, readiness=None) -> int:
+        """Serve /metrics, /healthz (liveness: the process answers), and
+        /readyz (readiness: the shipped deployment.yaml probes it —
+        ``readiness`` is an optional callable the operator wires to "the
+        manager is running"; a follower replica IS ready: it serves as a
+        hot standby and must not be restarted by the kubelet)."""
         registry = self
         from .utils.httpserve import QuietHandler, serve_on_loopback
 
         class Handler(QuietHandler):
             def do_GET(self):  # noqa: N802
-                if self.path not in ("/metrics", "/healthz"):
+                if self.path == "/metrics":
+                    self.reply(
+                        200, registry.expose().encode(),
+                        "text/plain; version=0.0.4",
+                    )
+                elif self.path == "/healthz":
+                    self.reply(200, b"ok\n", "text/plain; version=0.0.4")
+                elif self.path == "/readyz":
+                    ready = True
+                    if readiness is not None:
+                        try:
+                            ready = bool(readiness())
+                        except Exception:
+                            ready = False
+                    self.reply(
+                        200 if ready else 503,
+                        b"ok\n" if ready else b"not ready\n",
+                        "text/plain; version=0.0.4",
+                    )
+                else:
                     self.reply(404, b"")
-                    return
-                body = (
-                    registry.expose() if self.path == "/metrics" else "ok\n"
-                ).encode()
-                self.reply(200, body, "text/plain; version=0.0.4")
 
         self._http = serve_on_loopback(Handler, port)
         return self._http.server_address[1]

@@ -60,7 +60,13 @@ class Operator:
 
     def start(self) -> None:
         if self.options.metrics_port:
-            self.metrics_port = REGISTRY.serve(self.options.metrics_port)
+            # readiness = "the manager's reconcile threads are up" (a
+            # follower replica is ready standby — leadership is NOT part
+            # of readiness, or the kubelet would restart followers)
+            self.metrics_port = REGISTRY.serve(
+                self.options.metrics_port,
+                readiness=self.manager.is_running,
+            )
             log.info("metrics on 127.0.0.1:%d/metrics", self.metrics_port)
         if self.options.admission_port:
             from .admission_server import AdmissionServer

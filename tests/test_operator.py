@@ -207,6 +207,27 @@ class TestOperatorWiring:
             assert "karpenter_solver_solve_duration_seconds" in body
             health = urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz").read().decode()
             assert health == "ok\n"
+            # /readyz without a readiness callable defaults ready
+            ready = urllib.request.urlopen(f"http://127.0.0.1:{port}/readyz").read().decode()
+            assert ready == "ok\n"
+        finally:
+            REGISTRY.stop()
+
+    def test_readyz_tracks_readiness_callable(self):
+        from karpenter_provider_aws_tpu.metrics import REGISTRY
+
+        state = {"ready": False}
+        port = REGISTRY.serve(0, readiness=lambda: state["ready"])
+        try:
+            # the shipped deployment.yaml probes /readyz: not ready -> 503
+            import urllib.error
+
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"http://127.0.0.1:{port}/readyz")
+            assert ei.value.code == 503
+            state["ready"] = True
+            ready = urllib.request.urlopen(f"http://127.0.0.1:{port}/readyz").read().decode()
+            assert ready == "ok\n"
         finally:
             REGISTRY.stop()
 
