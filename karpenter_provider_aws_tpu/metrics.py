@@ -203,6 +203,14 @@ EVENTS = REGISTRY.counter(
     "Events published by controllers, by type and reason (parity: the core "
     "event recorder behind interruption controller.go:219-238)",
 )
+SIDECAR_RPC_SECONDS = REGISTRY.histogram(
+    "karpenter_sidecar_rpc_duration_seconds",
+    "Solver-sidecar RPC latency by method, server side",
+    buckets=(0.001, 0.005, 0.02, 0.05, 0.1, 0.2, 0.5, 1.0, 5.0, 30.0),
+)
+SIDECAR_ERRORS = REGISTRY.counter(
+    "karpenter_sidecar_rpc_errors_total", "Solver-sidecar RPC failures by method"
+)
 BATCH_WINDOW = REGISTRY.histogram(
     "karpenter_batcher_window_seconds",
     "Time from a batch's first request to execution (parity: batcher window histograms, metrics.go:37-47)",

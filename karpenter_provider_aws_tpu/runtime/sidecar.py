@@ -60,7 +60,39 @@ class SolverServer:
         self.port = self._server.add_insecure_port(address)
 
     # -- handlers ----------------------------------------------------------
+    @staticmethod
+    def _timed(method: str):
+        """RPC latency/error accounting (SURVEY.md section 5: 'optional
+        gRPC tracing' — the sidecar is a process boundary and its latency
+        must be observable server-side, not just at the client)."""
+        import contextlib
+        import time
+
+        from ..metrics import SIDECAR_ERRORS, SIDECAR_RPC_SECONDS
+
+        @contextlib.contextmanager
+        def _cm():
+            t0 = time.perf_counter()
+            try:
+                yield
+            except Exception as e:
+                # error-type label, same convention as the cloudprovider
+                # metrics decorator — dashboards distinguish bad payloads
+                # from device failures
+                SIDECAR_ERRORS.inc(method=method, error=type(e).__name__)
+                raise
+            finally:
+                SIDECAR_RPC_SECONDS.observe(
+                    time.perf_counter() - t0, method=method
+                )
+
+        return _cm()
+
     def _solve(self, request: bytes, context) -> bytes:
+        with self._timed("Solve"):
+            return self._solve_inner(request)
+
+    def _solve_inner(self, request: bytes) -> bytes:
         import jax.numpy as jnp
 
         from ..ops.ffd import ffd_solve
@@ -89,6 +121,10 @@ class SolverServer:
         )
 
     def _simulate(self, request: bytes, context) -> bytes:
+        with self._timed("SimulateConsolidation"):
+            return self._simulate_inner(request)
+
+    def _simulate_inner(self, request: bytes) -> bytes:
         import jax.numpy as jnp
 
         from ..ops.consolidate import repack_check
@@ -105,9 +141,14 @@ class SolverServer:
         return pack(ok=np.asarray(ok))
 
     def _health(self, request: bytes, context) -> bytes:
-        import jax
+        # instrumented too: jax.devices() is exactly what stalls when the
+        # device runtime wedges, and Health is the probe that must show it
+        with self._timed("Health"):
+            import jax
 
-        return pack(device_count=np.asarray(len(jax.devices()), dtype=np.int32))
+            return pack(
+                device_count=np.asarray(len(jax.devices()), dtype=np.int32)
+            )
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> int:

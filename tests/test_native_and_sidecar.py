@@ -121,6 +121,29 @@ class TestSidecar:
         with pytest.raises(grpc.RpcError):
             client._call("Solve", b"not an npz archive")
 
+    def test_rpc_latency_and_errors_observable(self, catalog, pool, client):
+        """SURVEY section 5 'optional gRPC tracing': server-side RPC latency
+        histograms + error counters per method."""
+        import grpc
+
+        from karpenter_provider_aws_tpu.metrics import REGISTRY
+        from karpenter_provider_aws_tpu.runtime.sidecar import RemoteSolver
+
+        RemoteSolver(client).solve(
+            make_pods(5, "m", {"cpu": "500m"}), [pool], catalog
+        )
+        with pytest.raises(grpc.RpcError):
+            client._call("Solve", b"garbage")
+        exposed = REGISTRY.expose()
+        assert 'karpenter_sidecar_rpc_duration_seconds_count{method="Solve"}' in exposed
+        err_lines = [
+            l for l in exposed.splitlines()
+            if l.startswith("karpenter_sidecar_rpc_errors_total{")
+            and 'method="Solve"' in l
+        ]
+        # error-type label, same convention as the cloudprovider decorator
+        assert err_lines and any('error="ValueError"' in l for l in err_lines)
+
 
 class TestZeroRequestAlignment:
     """An all-zero request row (only possible via raw tensors — Pod always

@@ -1,0 +1,67 @@
+"""Regenerate docs/configuration.md from the Options dataclass.
+
+Run: python tools/gen_config_docs.py
+The table is derived (flag/env/default straight from the dataclass, notes
+from the field's inline comment) so it cannot drift from the code.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+import sys
+from dataclasses import fields
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from karpenter_provider_aws_tpu.operator.options import Options
+
+    src = (REPO / "karpenter_provider_aws_tpu/operator/options.py").read_text()
+    comments: dict[str, str] = {}
+    for line in src.splitlines():
+        m = re.match(r"\s*(\w+):.*?=.*?#\s*(.*)", line)
+        if m:
+            comments[m.group(1)] = m.group(2).strip()
+
+    d = Options()
+    rows = []
+    for f in fields(Options):
+        flag = "--" + f.name.replace("_", "-")
+        env = f.name.upper()
+        default = getattr(d, f.name)
+        default_s = repr(default) if default != "" else '""'
+        rows.append(
+            f"| `{flag}` | `{env}` | `{default_s}` | {comments.get(f.name, '')} |"
+        )
+
+    doc = (
+        "# Configuration reference\n\n"
+        "Every option is settable as a CLI flag or an environment variable (flag\n"
+        "wins; parity: the reference's flag/env layering in\n"
+        "`pkg/operator/options/options.go:35-57`). This table is GENERATED from\n"
+        "the `Options` dataclass — regenerate with\n"
+        "`python tools/gen_config_docs.py` after changing fields.\n\n"
+        "| Flag | Env var | Default | Notes |\n|---|---|---|---|\n"
+        + "\n".join(rows)
+        + "\n\n"
+        "Feature gates ride `--feature-gates` as `Name=true,...` (reference:\n"
+        '`FEATURE_GATES="Drift=true"`); currently consulted gates are `Drift`\n'
+        "(default on) and `SpotToSpot` (default off).\n\n"
+        "Solver backends (`--solver-backend`): `tpu` (jitted device path,\n"
+        "default), `host` (pure numpy), `native` (C++ via ctypes), `grpc`\n"
+        "(`--solver-sidecar-target` points at a sidecar started with\n"
+        "`python -m karpenter_provider_aws_tpu --sidecar`).\n"
+    )
+    (REPO / "docs/configuration.md").write_text(doc)
+    print(f"docs/configuration.md regenerated ({len(rows)} options)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
