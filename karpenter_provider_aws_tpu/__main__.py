@@ -31,14 +31,33 @@ def main(argv=None) -> int:
         level=logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
+    import os
+
+    if os.environ.get("JAX_PLATFORMS"):
+        # honor the operator's platform choice even when a site plugin
+        # force-registers another platform via jax.config (which beats the
+        # env var); must run before any backend initializes
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     if _sidecar_requested(argv):
+        from .metrics import REGISTRY
         from .runtime.sidecar import serve
 
         address = "127.0.0.1:50151"
+        metrics_port = 8081  # distinct from the operator's 8080 default
         for i, a in enumerate(argv):
             if a == "--address" and i + 1 < len(argv):
                 address = argv[i + 1]
+            if a == "--metrics-port" and i + 1 < len(argv):
+                metrics_port = int(argv[i + 1])
         server = serve(address)
+        if metrics_port:
+            # the per-method RPC histograms/error counters accumulate in
+            # THIS process — without a scrape endpoint here they would be
+            # write-only in the real split deployment
+            port = REGISTRY.serve(metrics_port)
+            print(f"sidecar metrics on 127.0.0.1:{port}/metrics", flush=True)
         print(f"solver sidecar on {address}", flush=True)
         server.wait()
         return 0

@@ -59,6 +59,19 @@ class Operator:
     admission_port: int = 0
 
     def start(self) -> None:
+        # Freeze the construction-time object graph out of the collector's
+        # working set (measured: a gen-2 pass over a 50k-pod graph injects
+        # ~100ms spikes straight into solve p99 — the bench freezes for the
+        # same reason, solve_configs._timed_solves). Long-lived operators
+        # never free this graph anyway; freezing just stops re-scanning it.
+        # stop() unfreezes, so embedders cycling operators in one process
+        # do not accumulate permanently-uncollectable heap.
+        if self.options.gc_freeze:
+            import gc
+
+            gc.collect()
+            gc.freeze()
+            self._gc_frozen = True
         if self.options.metrics_port:
             # readiness = "the manager's reconcile threads are up" (a
             # follower replica is ready standby — leadership is NOT part
@@ -76,6 +89,11 @@ class Operator:
         self.manager.start()
 
     def stop(self) -> None:
+        if getattr(self, "_gc_frozen", False):
+            import gc
+
+            gc.unfreeze()
+            self._gc_frozen = False
         self.manager.stop()
         self.cloudprovider.close()  # join batcher worker pools
         if self.admission is not None:

@@ -8,6 +8,7 @@ process boundary around ``ops.ffd.ffd_solve`` / ``ops.consolidate``.
 
 from __future__ import annotations
 
+import contextlib
 import io
 import logging
 from concurrent import futures
@@ -61,32 +62,22 @@ class SolverServer:
 
     # -- handlers ----------------------------------------------------------
     @staticmethod
+    @contextlib.contextmanager
     def _timed(method: str):
         """RPC latency/error accounting (SURVEY.md section 5: 'optional
         gRPC tracing' — the sidecar is a process boundary and its latency
-        must be observable server-side, not just at the client)."""
-        import contextlib
-        import time
-
+        must be observable server-side, not just at the client). Latency
+        rides the registry's own Histogram.time(); errors carry the
+        error-type label, same convention as the cloudprovider metrics
+        decorator."""
         from ..metrics import SIDECAR_ERRORS, SIDECAR_RPC_SECONDS
 
-        @contextlib.contextmanager
-        def _cm():
-            t0 = time.perf_counter()
+        with SIDECAR_RPC_SECONDS.time(method=method):
             try:
                 yield
             except Exception as e:
-                # error-type label, same convention as the cloudprovider
-                # metrics decorator — dashboards distinguish bad payloads
-                # from device failures
                 SIDECAR_ERRORS.inc(method=method, error=type(e).__name__)
                 raise
-            finally:
-                SIDECAR_RPC_SECONDS.observe(
-                    time.perf_counter() - t0, method=method
-                )
-
-        return _cm()
 
     def _solve(self, request: bytes, context) -> bytes:
         with self._timed("Solve"):

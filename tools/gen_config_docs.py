@@ -24,10 +24,18 @@ def main() -> int:
 
     src = (REPO / "karpenter_provider_aws_tpu/operator/options.py").read_text()
     comments: dict[str, str] = {}
+    pending: list[str] = []  # block comment lines preceding a field
     for line in src.splitlines():
-        m = re.match(r"\s*(\w+):.*?=.*?#\s*(.*)", line)
+        cm = re.match(r"\s*#\s?(.*)", line)
+        if cm:
+            pending.append(cm.group(1).strip())
+            continue
+        m = re.match(r"\s*(\w+):.*?=[^#]*(?:#\s*(.*))?$", line)
         if m:
-            comments[m.group(1)] = m.group(2).strip()
+            inline = (m.group(2) or "").strip()
+            block = " ".join(pending)
+            comments[m.group(1)] = inline or block
+        pending = []
 
     d = Options()
     rows = []
