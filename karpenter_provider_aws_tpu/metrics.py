@@ -139,7 +139,7 @@ class Registry:
         manager is running"; a follower replica IS ready: it serves as a
         hot standby and must not be restarted by the kubelet)."""
         registry = self
-        from .utils.httpserve import QuietHandler, serve_on_loopback
+        from .utils.httpserve import QuietHandler, serve_http
 
         class Handler(QuietHandler):
             def do_GET(self):  # noqa: N802
@@ -165,7 +165,7 @@ class Registry:
                 else:
                     self.reply(404, b"")
 
-        self._http = serve_on_loopback(Handler, port)
+        self._http = serve_http(Handler, port)
         return self._http.server_address[1]
 
     def stop(self) -> None:

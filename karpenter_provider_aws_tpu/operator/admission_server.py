@@ -166,7 +166,7 @@ class AdmissionServer:
         self._http: Optional[ThreadingHTTPServer] = None
 
     def serve(self, port: int = 0) -> int:
-        from ..utils.httpserve import QuietHandler, serve_on_loopback
+        from ..utils.httpserve import QuietHandler, serve_http
 
         class Handler(QuietHandler):
             def do_GET(self):  # noqa: N802
@@ -187,7 +187,7 @@ class AdmissionServer:
                     result = {"allowed": False, "violations": [f"bad request: {e}"]}
                 self.reply(200, json.dumps(result).encode(), "application/json")
 
-        self._http = serve_on_loopback(Handler, port)
+        self._http = serve_http(Handler, port)  # pod-IP reachable: the apiserver calls in over the network
         log.info("admission server on 127.0.0.1:%d/admit", self._http.server_address[1])
         return self._http.server_address[1]
 

@@ -134,6 +134,12 @@ def new_operator(
     one apiserver."""
     options = options or Options.from_env_and_args()
     clock = clock or RealClock()
+    if not options.prune_types:
+        # the encoder reads the env knob (it has no Options handle); the
+        # flag is the discoverable spelling of the same switch
+        import os
+
+        os.environ["KARPENTER_TPU_PRUNE_TYPES"] = "0"
     from ..utils.observability import Profiler, enable_xla_dump, setup_logging
 
     setup_logging(options.log_level)

@@ -56,6 +56,10 @@ class Options:
     # freeze the startup object graph out of the GC working set (gen-2
     # passes over large pod graphs inject ~100ms spikes into solve p99)
     gc_freeze: bool = True
+    # type-axis compaction: drop catalog types no pod group can use from
+    # the device tensors (the encode also honors the raw
+    # KARPENTER_TPU_PRUNE_TYPES env var for non-operator callers)
+    prune_types: bool = True
 
     @staticmethod
     def from_env_and_args(argv: Optional[list[str]] = None) -> "Options":

@@ -16,6 +16,7 @@ Everything here is host-side numpy; jax only sees the finished arrays.
 
 from __future__ import annotations
 
+import os
 import threading
 from collections import OrderedDict
 from dataclasses import dataclass, field
@@ -37,6 +38,19 @@ def bucket(n: int, minimum: int = 8) -> int:
     while b < n:
         b *= 2
     return b
+
+
+def _ladder_bucket(n: int, minimum: int = 8) -> int:
+    """Next value >= n on the {2^k, 1.5 * 2^k} ladder (overshoot <= 1.5x
+    for one extra compile bucket per octave — same rule as the solver's
+    node-row sizing)."""
+    p = minimum
+    while True:
+        if n <= p:
+            return p
+        if n <= p * 3 // 2:
+            return p * 3 // 2
+        p *= 2
 
 
 def water_fill(counts: dict, live, skew: int, P: int) -> tuple[dict, dict, int]:
@@ -826,6 +840,57 @@ def encode_problem(
         capacity = capacity.copy()
         capacity[:, _PODS] = np.minimum(capacity[:, _PODS], float(kubelet.max_pods))
 
+    type_names = tensors.names
+    type_window_out = available.copy()
+    type_exotic = np.array(
+        [
+            getattr(t, "bare_metal", False)
+            or getattr(t, "gpu_count", 0) > 0
+            or getattr(t, "accelerator_count", 0) > 0
+            for t in types
+        ],
+        dtype=bool,
+    )
+
+    # -- type-axis compaction ----------------------------------------------
+    # Types NO group can use (incompatible, or infinite price everywhere)
+    # can never be chosen by the scan, the refine pass, or the launch
+    # ranking — yet they cost device work in every [.., T] program. A
+    # category-pinned pool (the common case: c/m/r) uses ~half the catalog,
+    # so compacting the axis cuts the scan's per-step width, the rank
+    # program, and the upload bytes accordingly. The kept set is bucketed
+    # on the {2^k, 1.5*2^k} ladder (bounded compile shapes as the usable
+    # set drifts) and padded with never-usable filler (price inf, compat
+    # false, empty windows). KARPENTER_TPU_PRUNE_TYPES=0 disables.
+    if (
+        G > 0
+        and os.environ.get("KARPENTER_TPU_PRUNE_TYPES", "1") == "1"
+    ):
+        usable_t = compat[:G].any(axis=0) & np.isfinite(price[:G]).any(axis=0)
+        kept = np.nonzero(usable_t)[0]
+        K = len(kept)
+        if 0 < K < T:
+            TB = min(_ladder_bucket(K, minimum=64), T)
+            if TB < T:
+                Gb = compat.shape[0]
+                cap_new = np.zeros((TB, capacity.shape[1]), dtype=np.float32)
+                cap_new[:K] = capacity[kept]
+                price_new = np.full((Gb, TB), np.inf, dtype=price.dtype)
+                price_new[:, :K] = price[:, kept]
+                compat_new = np.zeros((Gb, TB), dtype=bool)
+                compat_new[:, :K] = compat[:, kept]
+                win_new = np.zeros(
+                    (TB,) + type_window_out.shape[1:], dtype=type_window_out.dtype
+                )
+                win_new[:K] = type_window_out[kept]
+                exo_new = np.zeros(TB, dtype=bool)
+                exo_new[:K] = type_exotic[kept]
+                names_new = tuple(type_names[i] for i in kept) + tuple(
+                    f"__pruned_{i}" for i in range(TB - K)
+                )
+                capacity, price, compat = cap_new, price_new, compat_new
+                type_window_out, type_exotic, type_names = win_new, exo_new, names_new
+
     out = EncodedProblem(
         requests=requests,
         counts=counts,
@@ -833,11 +898,11 @@ def encode_problem(
         capacity=capacity,
         price=price,
         group_pods=group_list,
-        type_names=tensors.names,
+        type_names=type_names,
         zones=tensors.zones,
         nodepool=nodepool,
         group_window=group_window,
-        type_window=available.copy(),
+        type_window=type_window_out,
         group_zone_allowed=zone_allowed,
         group_captype_allowed=captype_allowed,
         max_per_node=max_per_node,
@@ -847,15 +912,7 @@ def encode_problem(
         # instance.go:456-477 — GPU/Neuron types are excluded from ranked
         # options unless the committed choice itself is one, which the
         # ffd-side filter already special-cases via ``exotic[committed]``).
-        type_exotic=np.array(
-            [
-                getattr(t, "bare_metal", False)
-                or getattr(t, "gpu_count", 0) > 0
-                or getattr(t, "accelerator_count", 0) > 0
-                for t in types
-            ],
-            dtype=bool,
-        ),
+        type_exotic=type_exotic,
         unencodable=unencodable,
     )
     if ckey is not None:

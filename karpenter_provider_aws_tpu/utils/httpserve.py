@@ -1,8 +1,11 @@
-"""Loopback HTTP serving shared by the metrics and admission endpoints.
+"""HTTP serving shared by the metrics and admission endpoints.
 
-One place owns the ThreadingHTTPServer lifecycle (bind on 127.0.0.1,
-daemon serve_forever thread, shutdown AND server_close — shutdown alone
-leaks the listening socket fd across serve/stop cycles)."""
+One place owns the ThreadingHTTPServer lifecycle (daemon serve_forever
+thread, shutdown AND server_close — shutdown alone leaks the listening
+socket fd across serve/stop cycles). Binds ALL interfaces by default:
+kubelet httpGet probes and Prometheus scrapes connect to the POD IP, so a
+loopback-only bind silently fails every shipped probe (callers wanting
+loopback pass host="127.0.0.1")."""
 
 from __future__ import annotations
 
@@ -26,12 +29,18 @@ class QuietHandler(BaseHTTPRequestHandler):
         pass
 
 
-def serve_on_loopback(handler_cls, port: int = 0) -> ThreadingHTTPServer:
-    """Bind on 127.0.0.1:port (0 = ephemeral) and serve on a daemon thread.
-    The bound port is ``server.server_address[1]``."""
-    server = ThreadingHTTPServer(("127.0.0.1", port), handler_cls)
+def serve_http(handler_cls, port: int = 0, host: str = "") -> ThreadingHTTPServer:
+    """Bind host:port ("" = all interfaces, 0 = ephemeral port) and serve
+    on a daemon thread. The bound port is ``server.server_address[1]``."""
+    server = ThreadingHTTPServer((host, port), handler_cls)
     threading.Thread(target=server.serve_forever, daemon=True).start()
     return server
+
+
+# Backwards-compatible alias (pre-round-4 name; loopback was the old
+# default and broke in-cluster probes/scrapes).
+def serve_on_loopback(handler_cls, port: int = 0) -> ThreadingHTTPServer:
+    return serve_http(handler_cls, port, host="127.0.0.1")
 
 
 def stop_server(server: Optional[ThreadingHTTPServer]) -> None:

@@ -112,7 +112,11 @@ class TestEncode:
                 catalog.unavailable.mark_unavailable(name, z, ct)
         try:
             p2 = encode_problem(pods, catalog, pool)
-            assert not p2.compat[0][victim]
+            if name in p2.type_names:
+                assert not p2.compat[0][p2.type_names.index(name)]
+            # else: every offering ICE'd -> the type got PRUNED from the
+            # problem outright (type-axis compaction) — the strongest form
+            # of "the dead offering is no longer advertised"
         finally:
             catalog.unavailable.flush()
 
@@ -240,3 +244,59 @@ class TestPackingQuality:
             assert (total <= alloc.v + 1e-3).all(), (
                 spec.instance_type_options[0], total, alloc.v
             )
+
+
+class TestTypeAxisCompaction:
+    """Pruning types no group can use must not change ANY outcome — it only
+    shrinks the device programs. Equivalence is asserted plan-for-plan."""
+
+    def test_pruned_matches_unpruned_exactly(self, catalog):
+        import os
+
+        from karpenter_provider_aws_tpu.models import Operator as Op
+        from karpenter_provider_aws_tpu.models import Requirement
+
+        pool = NodePool(name="default", requirements=[
+            Requirement(lbl.INSTANCE_CATEGORY, Op.IN, ("c", "m", "r")),
+        ])
+        pods = (
+            make_pods(60, "a", {"cpu": "500m", "memory": "1Gi"})
+            + make_pods(20, "b", {"cpu": "2", "memory": "8Gi"},
+                        node_selector={lbl.ARCH: "arm64"})
+        )
+        from karpenter_provider_aws_tpu.ops.encode import invalidate_problem_cache
+
+        def solve():
+            invalidate_problem_cache()
+            problem = encode_problem(pods, catalog, pool)
+            specs, _, unplaced = TPUSolver(refine=False).solve_encoded(problem)
+            return problem, specs, unplaced
+
+        p1, s1, u1 = solve()
+        os.environ["KARPENTER_TPU_PRUNE_TYPES"] = "0"
+        try:
+            p2, s2, u2 = solve()
+        finally:
+            os.environ.pop("KARPENTER_TPU_PRUNE_TYPES", None)
+        assert p1.capacity.shape[0] < p2.capacity.shape[0]  # actually pruned
+        assert u1 == u2
+        assert len(s1) == len(s2)
+        for a, b in zip(s1, s2):
+            assert a.instance_type_options == b.instance_type_options
+            assert a.zone_options == b.zone_options
+            assert a.capacity_type_options == b.capacity_type_options
+            assert len(a.pods) == len(b.pods)
+            assert a.estimated_price == pytest.approx(b.estimated_price)
+
+    def test_no_pruned_filler_ever_surfaces(self, catalog):
+        from karpenter_provider_aws_tpu.models import Operator as Op
+        from karpenter_provider_aws_tpu.models import Requirement
+
+        pool = NodePool(name="default", requirements=[
+            Requirement(lbl.INSTANCE_CATEGORY, Op.IN, ("c",)),
+        ])
+        pods = make_pods(40, "w", {"cpu": "1", "memory": "2Gi"})
+        res = TPUSolver().solve(pods, [pool], catalog)
+        assert res.pods_placed() == 40
+        for spec in res.node_specs:
+            assert all(not n.startswith("__pruned_") for n in spec.instance_type_options)
