@@ -190,9 +190,11 @@ def launch_claim(cluster: Cluster, cloudprovider: CloudProvider, pool, spec: Nod
     claim = NodeClaim.fresh(
         nodepool_name=pool.name,
         nodeclass_name=pool.nodeclass_name,
-        instance_type_options=spec.instance_type_options,
-        zone_options=spec.zone_options,
-        capacity_type_options=spec.capacity_type_options,
+        # copies: NodeSpec option lists are SHARED across same-window specs
+        # (decode optimization); the long-lived claim must own its own
+        instance_type_options=list(spec.instance_type_options),
+        zone_options=list(spec.zone_options),
+        capacity_type_options=list(spec.capacity_type_options),
         offering_options=list(spec.offering_options),
         labels=dict(pool.labels),
         annotations=dict(pool.annotations),

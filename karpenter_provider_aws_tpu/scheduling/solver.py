@@ -304,10 +304,14 @@ def _decode_nodes(
         hit = _win_memo.get(key)
         if hit is None:
             w = nw[n]
+            # TUPLES: these are shared across every NodeSpec with the same
+            # window — immutability makes the read-only contract structural
+            # (a consumer trying .append/.sort raises instead of corrupting
+            # sibling specs); launch_claim list()-copies what it keeps
             hit = (
-                [(z, ct) for zi, z in enumerate(zs) for ci, ct in enumerate(cts) if w[zi, ci]],
-                [z for zi, z in enumerate(zs) if win_z[n, zi]],
-                [ct for ci, ct in enumerate(cts) if win_c[n, ci]],
+                tuple((z, ct) for zi, z in enumerate(zs) for ci, ct in enumerate(cts) if w[zi, ci]),
+                tuple(z for zi, z in enumerate(zs) if win_z[n, zi]),
+                tuple(ct for ci, ct in enumerate(cts) if win_c[n, ci]),
             )
             _win_memo[key] = hit
         return hit
@@ -379,15 +383,20 @@ def _decode_nodes(
 
         # The solver narrowed each node's joint (zone, captype) window as
         # groups landed (intersected with the committed type's live
-        # offerings), so every pair in it is directly launchable.
+        # offerings), so every pair in it is directly launchable. The
+        # option lists are SHARED across specs with the same window (plans
+        # carry a handful of distinct windows across thousands of nodes,
+        # and consumers treat them as read-only snapshots — the claim
+        # builder copies what it keeps): per-spec list copies were a
+        # measurable slice of decode at thousands of nodes.
         offering_options, zone_options, captype_options = _window_lists(n)
         specs.append(
             NodeSpec(
                 nodepool_name=nodepool_name,
                 instance_type_options=type_names,
-                zone_options=list(zone_options),
-                capacity_type_options=list(captype_options),
-                offering_options=list(offering_options),
+                zone_options=zone_options,
+                capacity_type_options=captype_options,
+                offering_options=offering_options,
                 pods=pods,
                 estimated_price=float(node_price[n]),
             )
