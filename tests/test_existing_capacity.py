@@ -100,7 +100,7 @@ class TestExistingCapacity:
         assert res.binds == []  # wrong zone: must not bind
         assert res.pods_placed() == 2
         for spec in res.node_specs:
-            assert spec.zone_options == [other_zone]
+            assert list(spec.zone_options) == [other_zone]
 
     def test_hostname_capped_pods_stay_off_existing_nodes(self, catalog, solver_cls):
         from karpenter_provider_aws_tpu.models.pod import PodAffinityTerm

@@ -42,7 +42,7 @@ class TestNodeWindows:
             assert spec.zone_options, "unlaunchable: empty zone options"
             zones = {p.node_selector[lbl.TOPOLOGY_ZONE] for p in spec.pods}
             assert len(zones) == 1
-            assert spec.zone_options == sorted(zones)
+            assert list(spec.zone_options) == sorted(zones)
 
     def test_captype_disjoint_groups_never_share_a_node(self, catalog, solver_cls):
         od = NodePool(name="p")

@@ -68,7 +68,7 @@ class TestZoneSpread:
         res = solver_cls().solve(pods, [pool], catalog)
         assert res.pods_placed() == 6
         for spec in res.node_specs:
-            assert spec.zone_options == ["zone-a"]
+            assert list(spec.zone_options) == ["zone-a"]
 
 
 @pytest.mark.parametrize("solver_cls", [TPUSolver, HostSolver])
@@ -186,7 +186,7 @@ class TestSoftZoneSpread:
         assert res.pods_placed() == 6
         assert not res.unschedulable
         for spec in res.node_specs:
-            assert spec.zone_options == ["zone-a"]
+            assert list(spec.zone_options) == ["zone-a"]
 
     def test_hard_spread_wins_when_both_present(self, catalog, pool, solver_cls):
         pods = make_pods(8, "w", {"cpu": "1", "memory": "2Gi"},
