@@ -56,7 +56,17 @@ class TestRefinePlanUnit:
         assert used[1].sum() == 0
 
     def test_no_drop_when_nothing_fits(self):
-        p = _mini_problem()
+        # request shape chosen so the REAL catalog has types holding
+        # exactly 2 pods (1cpu/1Gi has none: allocatable math rounds the
+        # small types to 1-or-3 pods)
+        catalog = CatalogProvider()
+        pool = NodePool(
+            name="default",
+            requirements=[Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m", "r"))],
+        )
+        p = encode_problem(
+            make_pods(4, "w", {"cpu": "3500m", "memory": "6Gi"}), catalog, pool
+        )
         req = p.requests[0]
         # choose the SMALLEST type that holds exactly 2 pods -> no slack
         per = np.where(
