@@ -34,15 +34,20 @@ class NativeBuildError(RuntimeError):
     pass
 
 
+_BUILD_FLAGS = ("-O3", "-funroll-loops", "-shared", "-fPIC", "-std=c++17")
+
+
 def _build_library() -> Path:
     src = _SRC.read_bytes()
-    digest = hashlib.sha256(src).hexdigest()[:16]
+    # flags participate in the cache key: a flag change must rebuild, not
+    # silently reuse the old object
+    digest = hashlib.sha256(src + " ".join(_BUILD_FLAGS).encode()).hexdigest()[:16]
     out = _BUILD_DIR / f"libffd-{digest}.so"
     if out.exists():
         return out
     _BUILD_DIR.mkdir(parents=True, exist_ok=True)
     tmp = out.with_suffix(".so.tmp")
-    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", str(_SRC), "-o", str(tmp)]
+    cmd = ["g++", *_BUILD_FLAGS, str(_SRC), "-o", str(tmp)]
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
         raise NativeBuildError(f"native build failed: {proc.stderr}")
