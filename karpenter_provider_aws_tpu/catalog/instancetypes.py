@@ -105,6 +105,7 @@ class InstanceType:
                     "nvidia.com/gpu": self.gpu_count if self.gpu_manufacturer == "nvidia" else 0,
                     "amd.com/gpu": self.gpu_count if self.gpu_manufacturer == "amd" else 0,
                     "aws.amazon.com/neuron": self.accelerator_count if self.accelerator_manufacturer == "aws" else 0,
+                    "habana.ai/gaudi": self.accelerator_count if self.accelerator_manufacturer == "habana" else 0,
                     "vpc.amazonaws.com/efa": self.efa_count,
                     "vpc.amazonaws.com/pod-eni": self.branch_enis,
                 }

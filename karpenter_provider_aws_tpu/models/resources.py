@@ -27,6 +27,7 @@ RESOURCE_AXES: tuple[str, ...] = (
     "nvidia.com/gpu",           # count
     "amd.com/gpu",              # count
     "aws.amazon.com/neuron",    # count
+    "habana.ai/gaudi",          # count (dl1 family accelerators)
     "vpc.amazonaws.com/efa",    # count
     "vpc.amazonaws.com/pod-eni",  # count (branch interfaces, security-group-per-pod)
 )
@@ -34,7 +35,7 @@ NUM_RESOURCES = len(RESOURCE_AXES)
 _AXIS_INDEX = {name: i for i, name in enumerate(RESOURCE_AXES)}
 
 CPU, MEMORY, PODS, EPHEMERAL = 0, 1, 2, 3
-NVIDIA_GPU, AMD_GPU, NEURON, EFA, POD_ENI = 4, 5, 6, 7, 8
+NVIDIA_GPU, AMD_GPU, NEURON, GAUDI, EFA, POD_ENI = 4, 5, 6, 7, 8, 9
 
 # Extended-resource label parity: pkg/apis/v1beta1/labels.go:87-98 resources.
 EXTENDED_RESOURCES = RESOURCE_AXES[4:]

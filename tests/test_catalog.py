@@ -246,3 +246,22 @@ class TestCatalogFidelity:
         assert set(snap) == set(want)
         for name, row in snap.items():
             assert row["od"] == pytest.approx(want[name]), name
+
+
+class TestGaudiResource:
+    def test_dl1_exports_habana_gaudi(self, session_catalog):
+        """labels.go:90 parity: dl1's Gaudi accelerators are a schedulable
+        extended resource (habana.ai/gaudi), like neuron/gpu."""
+        from karpenter_provider_aws_tpu.models.pod import make_pods
+        from karpenter_provider_aws_tpu.models import NodePool
+        from karpenter_provider_aws_tpu.scheduling import HostSolver
+
+        it = session_catalog.get("dl1.24xlarge")
+        assert it.accelerator_manufacturer == "habana"
+        assert it.capacity().get("habana.ai/gaudi") == 8
+        pods = make_pods(2, "g", {"cpu": "4", "memory": "16Gi", "habana.ai/gaudi": 1})
+        res = HostSolver().solve(pods, [NodePool(name="default")], session_catalog)
+        assert res.pods_placed() == 2
+        assert all(
+            s.instance_type_options[0].startswith("dl1") for s in res.node_specs
+        )
