@@ -105,17 +105,90 @@ def _force_cpu_if_asked() -> None:
 
 
 def child_host() -> None:
-    """Host-only rows: interruption throughput tiers. No jax device use."""
+    """Host-only rows: interruption throughput tiers + the cross-language
+    sidecar RPC round trip. No jax device use in THIS process."""
     import contextlib
 
     from benchmarks.interruption_bench import run_all as run_interruption
 
+    def write_rows(rows):
+        # stream IMMEDIATELY: a later step timing out must not lose rows
+        # already measured (the module's core contract)
+        stamp = {"run_at_unix": int(time.time())}
+        with open(DETAIL_PATH, "a") as f:
+            for row in rows:
+                f.write(json.dumps({**row, **stamp}) + "\n")
+
     with contextlib.redirect_stdout(sys.stderr):
-        rows = run_interruption()
-    stamp = {"run_at_unix": int(time.time())}
-    with open(DETAIL_PATH, "a") as f:
-        for row in rows:
-            f.write(json.dumps({**row, **stamp}) + "\n")
+        write_rows(run_interruption())
+    try:
+        write_rows([_cpp_sidecar_row()])
+    except Exception as e:  # best-effort row; toolchain may be absent
+        print(f"cpp sidecar row skipped: {type(e).__name__}: {e}", file=sys.stderr)
+
+
+def _cpp_sidecar_row() -> dict:
+    """Cross-language serving latency: the C++ client (tools/
+    sidecar_client.cpp) benches Solve against a live CPU sidecar — the
+    whole wire path (gRPC over HTTP/2 + npz codec) with zero Python on
+    the client side."""
+    import shutil
+    import signal as _signal
+
+    client = os.path.join(REPO, "native", "build", "sidecar_client")
+    src = os.path.join(REPO, "tools", "sidecar_client.cpp")
+    # rebuild when missing OR older than the source (a pre-bench-mode
+    # binary would fail 'unknown mode' forever otherwise)
+    if not os.path.exists(client) or os.path.getmtime(client) < os.path.getmtime(src):
+        if shutil.which("g++") is None:
+            raise RuntimeError("no C++ toolchain")
+        os.makedirs(os.path.dirname(client), exist_ok=True)
+        subprocess.run(
+            ["g++", "-O2", "-o", client, src, "-ldl", "-lz"],
+            check=True, capture_output=True,
+        )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"  # the CLI honors it in-process
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "karpenter_provider_aws_tpu", "--sidecar",
+         "--address", "127.0.0.1:50179", "--metrics-port", "0"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env, cwd=REPO,
+    )
+    try:
+        deadline = time.time() + 60
+        out = None
+        while time.time() < deadline:
+            probe = subprocess.run(
+                [client, "health", "50179"], capture_output=True, text=True,
+                timeout=30,
+            )
+            if probe.returncode == 0:
+                out = subprocess.run(
+                    [client, "bench", "50179", "100"], capture_output=True,
+                    text=True, timeout=120,
+                )
+                break
+            time.sleep(1.0)
+        if out is None or out.returncode != 0:
+            raise RuntimeError((out.stderr if out else "sidecar never came up")[:200])
+        row = json.loads(out.stdout.strip())
+    finally:
+        proc.send_signal(_signal.SIGTERM)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            # a slow JAX teardown must not discard the measured row or
+            # leak a listener on the fixed port
+            proc.kill()
+            proc.wait(timeout=10)
+    return {
+        "benchmark": "sidecar_rpc_from_cpp",
+        "iters": row["iters"],
+        "p50_ms": row["p50_ms"],
+        "p99_ms": row["p99_ms"],
+        "device": "cpu",
+        "note": "C++ client, gRPC/HTTP2 + npz wire, tiny Solve",
+    }
 
 
 def child_measure() -> None:

@@ -23,6 +23,8 @@
 #include <dlfcn.h>
 #include <zlib.h>
 
+#include <algorithm>
+#include <chrono>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -384,10 +386,9 @@ static Array b1(std::vector<size_t> shape, std::vector<uint8_t> v) {
   return a;
 }
 
-int run_solve(const CurlApi &api, int port) {
-  // 2 groups x 3 types x 2 resources, 1 zone x 1 captype. Group 0: 5 pods of
-  // [1, 2]; group 1: 3 pods of [2, 4]. Type capacities [4, 8] / [8, 16] /
-  // [2, 4] at prices 1.0 / 1.8 / 0.6 (per group, same across groups).
+static std::vector<std::pair<std::string, Array>> solve_tensors() {
+  // the one tiny fixed Solve problem shared by the solve and bench modes
+  // (and mirrored in numpy by the hermetic cross-check test)
   std::vector<std::pair<std::string, Array>> t;
   t.push_back({"requests", f32({2, 2}, {1, 2, 2, 4})});
   t.push_back({"counts", i32({2}, {5, 3})});
@@ -398,6 +399,14 @@ int run_solve(const CurlApi &api, int port) {
   t.push_back({"type_window", b1({3, 1, 1}, {1, 1, 1})});
   t.push_back({"max_per_node", i32({2}, {1 << 30, 1 << 30})});
   t.push_back({"max_nodes", i32({}, {16})});
+  return t;
+}
+
+int run_solve(const CurlApi &api, int port) {
+  // 2 groups x 3 types x 2 resources, 1 zone x 1 captype. Group 0: 5 pods of
+  // [1, 2]; group 1: 3 pods of [2, 4]. Type capacities [4, 8] / [8, 16] /
+  // [2, 4] at prices 1.0 / 1.8 / 0.6 (per group, same across groups).
+  auto t = solve_tensors();
   auto out = grpc_call(api, port, "Solve", t);
   const Array &n_open = out.at("n_open");
   const Array &placed = out.at("placed");
@@ -445,9 +454,39 @@ int run_health(const CurlApi &api, int port) {
   return 0;
 }
 
+int run_bench(const CurlApi &api, int port, int iters) {
+  // serving latency of the cross-language path: the same Solve tensors,
+  // round-tripped repeatedly; prints p50/p99 over the timed iterations
+  if (iters <= 0) {
+    fprintf(stderr, "bench iters must be positive\n");
+    return 2;
+  }
+  auto t = solve_tensors();
+  grpc_call(api, port, "Solve", t);  // warm (compile)
+  grpc_call(api, port, "Solve", t);
+  std::vector<double> ms;
+  for (int i = 0; i < iters; i++) {
+    auto t0 = std::chrono::steady_clock::now();
+    grpc_call(api, port, "Solve", t);
+    auto t1 = std::chrono::steady_clock::now();
+    ms.push_back(std::chrono::duration<double, std::milli>(t1 - t0).count());
+  }
+  std::sort(ms.begin(), ms.end());
+  auto pct = [&](double p) {
+    size_t idx = (size_t)(p * (ms.size() - 1));
+    return ms[idx];
+  };
+  printf(
+      "{\"method\": \"Solve\", \"iters\": %d, \"p50_ms\": %.3f, "
+      "\"p99_ms\": %.3f}\n",
+      iters, pct(0.50), pct(0.99));
+  return 0;
+}
+
 int main(int argc, char **argv) {
-  if (argc != 3) {
-    fprintf(stderr, "usage: %s <health|solve|simulate> <port>\n", argv[0]);
+  if (argc < 3) {
+    fprintf(stderr, "usage: %s <health|solve|simulate|bench> <port> [iters]\n",
+            argv[0]);
     return 2;
   }
   try {
@@ -457,6 +496,8 @@ int main(int argc, char **argv) {
     if (mode == "health") return run_health(api, port);
     if (mode == "solve") return run_solve(api, port);
     if (mode == "simulate") return run_simulate(api, port);
+    if (mode == "bench")
+      return run_bench(api, port, argc > 3 ? atoi(argv[3]) : 50);
     fprintf(stderr, "unknown mode %s\n", mode.c_str());
     return 2;
   } catch (const std::exception &e) {
