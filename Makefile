@@ -4,7 +4,7 @@
 
 PYTEST ?= python -m pytest
 
-.PHONY: presubmit test deflake stress e2etests benchmark interruption-bench verify multichip native soak
+.PHONY: presubmit test deflake stress e2etests benchmark interruption-bench verify multichip native soak sidecar-client
 
 presubmit: test multichip  ## everything CI gates on
 
@@ -34,9 +34,13 @@ multichip:  ## the driver's multi-chip dry run on a virtual 8-device mesh
 		import __graft_entry__ as g; fn,a=g.entry(); jax.jit(fn)(*a); \
 		g.dryrun_multichip(8); print('multichip OK')"
 
-native:  ## build the C++ artifacts (FFD kernel lib + gRPC sidecar client)
+native: sidecar-client  ## build the C++ artifacts (FFD kernel lib + gRPC sidecar client)
 	python -c "from karpenter_provider_aws_tpu.scheduling.native import native_available; \
 		assert native_available(), 'native FFD build failed'; print('libffd OK')"
+
+sidecar-client: native/build/sidecar_client  ## the zero-Python gRPC client
+
+native/build/sidecar_client: tools/sidecar_client.cpp
 	mkdir -p native/build
 	g++ -O2 -o native/build/sidecar_client tools/sidecar_client.cpp -ldl -lz
 	@echo sidecar_client OK

@@ -135,36 +135,44 @@ def _cpp_sidecar_row() -> dict:
     import shutil
     import signal as _signal
 
+    import socket
+
     client = os.path.join(REPO, "native", "build", "sidecar_client")
-    src = os.path.join(REPO, "tools", "sidecar_client.cpp")
-    # rebuild when missing OR older than the source (a pre-bench-mode
-    # binary would fail 'unknown mode' forever otherwise)
-    if not os.path.exists(client) or os.path.getmtime(client) < os.path.getmtime(src):
-        if shutil.which("g++") is None:
-            raise RuntimeError("no C++ toolchain")
-        os.makedirs(os.path.dirname(client), exist_ok=True)
-        subprocess.run(
-            ["g++", "-O2", "-o", client, src, "-ldl", "-lz"],
-            check=True, capture_output=True,
-        )
+    if shutil.which("g++") is None and not os.path.exists(client):
+        raise RuntimeError("no C++ toolchain")
+    # ONE build recipe: the Makefile target (mtime-aware) — a second g++
+    # invocation here would drift flags from what `make` produces
+    subprocess.run(
+        ["make", "-s", "sidecar-client"], check=True, capture_output=True,
+        cwd=REPO,
+    )
+    # ephemeral port: a fixed port can be held by an orphan from a killed
+    # earlier run, whose health probe would pass and silently measure a
+    # STALE server build
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"  # the CLI honors it in-process
     proc = subprocess.Popen(
         [sys.executable, "-m", "karpenter_provider_aws_tpu", "--sidecar",
-         "--address", "127.0.0.1:50179", "--metrics-port", "0"],
+         "--address", f"127.0.0.1:{port}", "--metrics-port", "0"],
         stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env, cwd=REPO,
+        start_new_session=True,  # killable as a group even via killpg
     )
     try:
         deadline = time.time() + 60
         out = None
         while time.time() < deadline:
+            if proc.poll() is not None:
+                raise RuntimeError(f"sidecar exited rc={proc.returncode} at startup")
             probe = subprocess.run(
-                [client, "health", "50179"], capture_output=True, text=True,
+                [client, "health", str(port)], capture_output=True, text=True,
                 timeout=30,
             )
             if probe.returncode == 0:
                 out = subprocess.run(
-                    [client, "bench", "50179", "100"], capture_output=True,
+                    [client, "bench", str(port), "100"], capture_output=True,
                     text=True, timeout=120,
                 )
                 break
@@ -173,13 +181,15 @@ def _cpp_sidecar_row() -> dict:
             raise RuntimeError((out.stderr if out else "sidecar never came up")[:200])
         row = json.loads(out.stdout.strip())
     finally:
-        proc.send_signal(_signal.SIGTERM)
+        try:
+            os.killpg(proc.pid, _signal.SIGTERM)
+        except ProcessLookupError:
+            pass
         try:
             proc.wait(timeout=10)
         except subprocess.TimeoutExpired:
-            # a slow JAX teardown must not discard the measured row or
-            # leak a listener on the fixed port
-            proc.kill()
+            # a slow JAX teardown must not discard the measured row
+            os.killpg(proc.pid, _signal.SIGKILL)
             proc.wait(timeout=10)
     return {
         "benchmark": "sidecar_rpc_from_cpp",

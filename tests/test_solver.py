@@ -300,3 +300,74 @@ class TestTypeAxisCompaction:
         assert res.pods_placed() == 40
         for spec in res.node_specs:
             assert all(not n.startswith("__pruned_") for n in spec.instance_type_options)
+
+
+class TestRandomizedBackendEquivalence:
+    """Randomized cross-backend fuzz: the device scan and the numpy oracle
+    must produce IDENTICAL placement matrices (committed types + takes per
+    group) over random constraint-diverse workloads on the real catalog.
+    (Compare plans, not ranked launch options — those deliberately lead
+    with the cheapest type that fits the node's packed usage.)"""
+
+    def test_scan_matches_oracle_on_random_workloads(self, catalog):
+        import jax.numpy as jnp
+
+        from karpenter_provider_aws_tpu.models import Operator as Op
+        from karpenter_provider_aws_tpu.models import Requirement
+        from karpenter_provider_aws_tpu.ops.encode import (
+            invalidate_problem_cache,
+            pad_problem,
+        )
+        from karpenter_provider_aws_tpu.ops.ffd import ffd_solve
+        from karpenter_provider_aws_tpu.scheduling.oracle import ffd_oracle
+
+        rng = np.random.RandomState(123)
+        for trial in range(6):
+            cats = tuple(
+                rng.choice(["c", "m", "r", "t", "i", "x"],
+                           size=rng.randint(1, 4), replace=False)
+            )
+            pool = NodePool(name="default", requirements=[
+                Requirement(lbl.INSTANCE_CATEGORY, Op.IN, cats),
+            ])
+            pods = []
+            for g in range(rng.randint(1, 8)):
+                cpu = int(rng.choice([100, 250, 500, 1000, 3000, 7000]))
+                mem = cpu * int(rng.choice([1, 2, 4, 8]))
+                kw = {}
+                r = rng.rand()
+                if r < 0.2:
+                    kw["node_selector"] = {lbl.ARCH: str(rng.choice(["arm64", "amd64"]))}
+                elif r < 0.35:
+                    kw["node_selector"] = {lbl.TOPOLOGY_ZONE: str(rng.choice(catalog.zones))}
+                elif r < 0.45:
+                    kw["node_selector"] = {lbl.CAPACITY_TYPE: "on-demand"}
+                pods += make_pods(int(rng.randint(1, 40)), f"f{trial}g{g}",
+                                  {"cpu": f"{cpu}m", "memory": f"{mem}Mi"}, **kw)
+            invalidate_problem_cache()
+            p = encode_problem(pods, catalog, pool)
+            pp = pad_problem(p)
+            res = ffd_solve(
+                jnp.asarray(pp.requests), jnp.asarray(pp.counts),
+                jnp.asarray(pp.compat), jnp.asarray(pp.capacity),
+                jnp.asarray(pp.price), jnp.asarray(pp.group_window),
+                jnp.asarray(pp.type_window),
+                max_per_node=jnp.asarray(pp.max_per_node), max_nodes=128,
+            )
+            nodes, un = ffd_oracle(p, max_nodes=128)  # same cap as the scan
+            G = len(p.group_pods)
+            placed = np.asarray(res.placed)[:G]
+            ntype = np.asarray(res.node_type)
+            n_open = int(res.n_open)
+            assert n_open == len(nodes), f"trial {trial}: node count"
+            assert sum(un.values()) == int(np.asarray(res.unplaced)[:G].sum())
+            for g in range(G):
+                scan_pairs = sorted(
+                    (p.type_names[ntype[n]], int(placed[g, n]))
+                    for n in range(n_open) if placed[g, n] > 0
+                )
+                or_pairs = sorted(
+                    (p.type_names[n.type_index], c)
+                    for n in nodes for gg, c in n.group_counts.items() if gg == g
+                )
+                assert scan_pairs == or_pairs, f"trial {trial} group {g}"

@@ -15,7 +15,7 @@
 // either) and the npz codec (ZIP store/deflate + NPY v1.0) is implemented
 // here against zlib.
 //
-// Usage: sidecar_client <health|solve|simulate> <port>
+// Usage: sidecar_client <health|solve|simulate|bench> <port> [iters]
 // Prints one JSON line with the parsed result; exit 0 on grpc-status 0.
 //
 // Build: g++ -O2 -o sidecar_client sidecar_client.cpp -ldl -lz
