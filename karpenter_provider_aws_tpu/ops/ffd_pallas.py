@@ -57,11 +57,13 @@ def _kernel(
     mpn_ref,       # [G] i32
     gwbits_ref,    # [G] i32 group (zone x captype) window bits
     lim_ref,       # [2] i32: (n_limit = caller max_nodes rows, n_pre)
-    # VMEM inputs:
-    req_ref,       # [1, R_LANES] f32 block: group requests (first R lanes)
-    price_ref,     # [1, T_pad] f32 block: group price row (inf = unusable)
-    compat_ref,    # [1, T_pad] f32 block: group compat row (1.0 / 0.0)
-    cbits_ref,     # [1, LANE] i32 block: compat row bit-packed (T/32 words)
+    # VMEM inputs (per-group arrays carry a singleton sublane axis so the
+    # grid-blocked BlockSpec's last two dims EQUAL the array dims — jax
+    # >= 0.9 rejects Blocked(1) on a >1 sublane axis):
+    req_ref,       # [1, 1, R_LANES] f32 block: group requests (first R lanes)
+    price_ref,     # [1, 1, T_pad] f32 block: group price row (inf = unusable)
+    compat_ref,    # [1, 1, T_pad] f32 block: group compat row (1.0 / 0.0)
+    cbits_ref,     # [1, 1, LANE] i32 block: compat row bit-packed (T/32 words)
     capacity_ref,  # [R_pad, T_pad] f32: allocatable per type (shared)
     twbits_ref,    # [1, T_pad] i32: live-offering bits per type (shared)
     ntype0_ref,    # [1, N] i32 initial state
@@ -71,7 +73,7 @@ def _kernel(
     wbits0_ref,    # [1, N] i32
     nopen0_ref,    # [1, LANE] i32 (lane 0 = initial n_open)
     # outputs:
-    placed_ref,    # [1, N] i32 block per group
+    placed_ref,    # [1, 1, N] i32 block per group
     unplaced_ref,  # [G, 1] i32 (SMEM)
     ntype_o,       # [1, N] i32 final state
     nprice_o,      # [1, N] f32
@@ -117,7 +119,7 @@ def _kernel(
     lane128 = jax.lax.broadcasted_iota(jnp.int32, (1, LANE), 1)
 
     def _req(r):
-        return jnp.sum(jnp.where(lane128 == r, req_ref[:, :LANE], 0.0))
+        return jnp.sum(jnp.where(lane128 == r, req_ref[0, :, :LANE], 0.0))
 
     req_sc = [_req(r) for r in range(n_resources)]
 
@@ -152,7 +154,7 @@ def _kernel(
     nt = ntype_s[:]
     word = jnp.zeros((1, N), dtype=jnp.int32)
     hi = jax.lax.shift_right_logical(nt, 5)
-    cb_row = cbits_ref[:]                       # [1, LANE]
+    cb_row = cbits_ref[0]                       # [1, LANE]
     for w in range(n_words):
         bits_w = jnp.sum(jnp.where(lane128 == w, cb_row, 0))
         word = jnp.where(hi == w, bits_w, word)
@@ -181,10 +183,10 @@ def _kernel(
     rem0 = cnt - jnp.sum(place)
 
     # -- 2. open new nodes for the remainder ------------------------------
-    T = price_ref.shape[1]
+    T = price_ref.shape[2]
     iota_t = jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
-    price_row = price_ref[:]
-    compat_row = compat_ref[:] > 0.5
+    price_row = price_ref[0]
+    compat_row = compat_ref[0] > 0.5
     k_type = jnp.full((1, T), _BIG, dtype=jnp.float32)
     for r in range(n_resources):
         req_r = req_sc[r]
@@ -255,7 +257,7 @@ def _kernel(
         open_cond, open_body, (rem0, jnp.float32(0.0), nopen)
     )
     nopen_s[0] = nopen_f
-    placed_ref[:] = (place + opened_s[:]).astype(jnp.int32)
+    placed_ref[0] = (place + opened_s[:]).astype(jnp.int32)
     unplaced_ref[g, 0] = unplaced_f.astype(jnp.int32)
     nopen_o[0, 0] = nopen_f
 
@@ -317,14 +319,24 @@ def _ffd_pallas_call(
     N = ntype0.shape[1]
     n_words = (TP + 31) // 32
 
+    # Per-group arrays get a singleton sublane axis: a (1, X) block over a
+    # (G, X) array is an illegal Blocked(1) sublane under jax >= 0.9, but
+    # (1, 1, X) over (G, 1, X) has its last two dims equal to the array's.
+    requests_l = requests_l[:, None, :]
+    price_p = price_p[:, None, :]
+    compat_f = compat_f[:, None, :]
+    cbits = cbits[:, None, :]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,  # counts, mpn, gwbits, lim
         grid=(G,),
         in_specs=[
-            pl.BlockSpec((1, requests_l.shape[1]), lambda g, *_: (g, 0)),
-            pl.BlockSpec((1, TP), lambda g, *_: (g, 0)),
-            pl.BlockSpec((1, TP), lambda g, *_: (g, 0)),
-            pl.BlockSpec((1, LANE), lambda g, *_: (g, 0)),
+            pl.BlockSpec(
+                (1, 1, requests_l.shape[2]), lambda g, *_: (g, 0, 0)
+            ),
+            pl.BlockSpec((1, 1, TP), lambda g, *_: (g, 0, 0)),
+            pl.BlockSpec((1, 1, TP), lambda g, *_: (g, 0, 0)),
+            pl.BlockSpec((1, 1, LANE), lambda g, *_: (g, 0, 0)),
             pl.BlockSpec((RP, TP), lambda g, *_: (0, 0)),
             pl.BlockSpec((1, TP), lambda g, *_: (0, 0)),
             pl.BlockSpec((1, N), lambda g, *_: (0, 0)),
@@ -335,7 +347,7 @@ def _ffd_pallas_call(
             pl.BlockSpec((1, LANE), lambda g, *_: (0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, N), lambda g, *_: (g, 0)),
+            pl.BlockSpec((1, 1, N), lambda g, *_: (g, 0, 0)),
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, N), lambda g, *_: (0, 0)),
             pl.BlockSpec((1, N), lambda g, *_: (0, 0)),
@@ -355,7 +367,7 @@ def _ffd_pallas_call(
         ],
     )
     out_shapes = [
-        jax.ShapeDtypeStruct((G, N), jnp.int32),      # placed
+        jax.ShapeDtypeStruct((G, 1, N), jnp.int32),   # placed
         jax.ShapeDtypeStruct((G, 1), jnp.int32),      # unplaced
         jax.ShapeDtypeStruct((1, N), jnp.int32),      # ntype
         jax.ShapeDtypeStruct((1, N), jnp.float32),    # nprice
@@ -367,7 +379,7 @@ def _ffd_pallas_call(
     kernel = functools.partial(
         _kernel, n_resources=n_resources, n_words=n_words
     )
-    return pl.pallas_call(
+    placed, *rest = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=out_shapes,
@@ -375,6 +387,7 @@ def _ffd_pallas_call(
     )(counts, mpn, gwbits, lim,
       requests_l, price_p, compat_f, cbits, capacity_t, twbits,
       ntype0, nprice0, used0, cap0, wbits0, nopen0)
+    return (placed[:, 0, :], *rest)
 
 
 def ffd_solve_pallas(
