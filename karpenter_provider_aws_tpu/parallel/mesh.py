@@ -84,14 +84,9 @@ def sharded_solve_fn(mesh: Mesh, max_nodes: int):
     return jax.jit(_solve_shard)
 
 
-def solve_sharded(problem, mesh: Mesh, max_nodes: int = 1024, full: bool = False):
-    """Host entry: pad the group axis to the mesh size, place shards, solve.
-
-    Returns (node_type [D, N], used [D, N, R], n_open [D], unplaced [G],
-    total_cost) with per-device node namespaces; with ``full=True`` also
-    (node_price [D, N], node_window [D, N, Z, C], placed [D, Gs, N]) for
-    the cross-shard merge.
-    """
+def pad_problem_for_mesh(problem, mesh: Mesh):
+    """Pad the group axis to a mesh-divisible bucket (the layout contract
+    shared by the solve path and the partition-evidence bench)."""
     from ..ops.encode import bucket, pad_problem
 
     n_dev = mesh.devices.size
@@ -99,12 +94,17 @@ def solve_sharded(problem, mesh: Mesh, max_nodes: int = 1024, full: bool = False
     GB = max(bucket(G), n_dev)
     if GB % n_dev:
         GB += n_dev - (GB % n_dev)
-    padded = pad_problem(problem, GB)
+    return pad_problem(problem, GB)
 
-    fn = sharded_solve_fn(mesh, max_nodes)
+
+def place_solve_args(padded, mesh: Mesh):
+    """Device-put a padded problem with ``sharded_solve_fn``'s layout:
+    group-axis tensors sharded, catalog tensors replicated. ONE home for
+    the arg order/spec contract — the evidence bench lowers exactly what
+    this places."""
     shard = NamedSharding(mesh, P(POD_AXIS))
     rep = NamedSharding(mesh, P())
-    args = (
+    return (
         jax.device_put(jnp.asarray(padded.requests), shard),
         jax.device_put(jnp.asarray(padded.counts), shard),
         jax.device_put(jnp.asarray(padded.compat), shard),
@@ -114,6 +114,20 @@ def solve_sharded(problem, mesh: Mesh, max_nodes: int = 1024, full: bool = False
         jax.device_put(jnp.asarray(padded.type_window), rep),
         jax.device_put(jnp.asarray(padded.max_per_node), shard),
     )
+
+
+def solve_sharded(problem, mesh: Mesh, max_nodes: int = 1024, full: bool = False):
+    """Host entry: pad the group axis to the mesh size, place shards, solve.
+
+    Returns (node_type [D, N], used [D, N, R], n_open [D], unplaced [G],
+    total_cost) with per-device node namespaces; with ``full=True`` also
+    (node_price [D, N], node_window [D, N, Z, C], placed [D, Gs, N]) for
+    the cross-shard merge.
+    """
+    G = problem.requests.shape[0]
+    padded = pad_problem_for_mesh(problem, mesh)
+    fn = sharded_solve_fn(mesh, max_nodes)
+    args = place_solve_args(padded, mesh)
     (node_type, used, n_open, unplaced, total_cost,
      node_price, node_window, placed) = jax.device_get(fn(*args))
     out = (
@@ -153,32 +167,38 @@ def sharded_screen_fn(mesh: Mesh):
     return jax.jit(_screen)
 
 
+def place_screen_args(ct, mesh: Mesh):
+    """Device-put cluster tensors with ``sharded_screen_fn``'s layout:
+    cluster state replicated, the candidate axis (padded to a mesh
+    multiple; padded lanes re-screen node 0 and are discarded) sharded.
+    Shared by the screen path and the partition-evidence bench."""
+    from ..ops.consolidate import screen_cap_wire
+
+    N = len(ct.node_names)
+    D = mesh.devices.size
+    NB = N if N % D == 0 else N + (D - N % D)
+    cand = np.zeros(NB, dtype=np.int32)
+    cand[:N] = np.arange(N, dtype=np.int32)
+    shard = NamedSharding(mesh, P(POD_AXIS))
+    rep = NamedSharding(mesh, P())
+    return (
+        jax.device_put(jnp.asarray(ct.free), rep),
+        jax.device_put(jnp.asarray(ct.requests), rep),
+        jax.device_put(jnp.asarray(ct.group_ids), rep),
+        jax.device_put(jnp.asarray(ct.group_counts), rep),
+        jax.device_put(jnp.asarray(screen_cap_wire(ct)), rep),
+        jax.device_put(jnp.asarray(cand), shard),
+    )
+
+
 def screen_sharded(ct, mesh: Mesh) -> np.ndarray:
     """Mesh-parallel ``consolidatable``: can_delete[N] with the candidate
     axis split across the mesh devices. Exact same semantics as the
     single-device screen (consolidate.consolidatable) — the blocked mask and
     the hostname-headroom cap ride along unchanged."""
-    from ..ops.consolidate import screen_cap_wire
-
     N = len(ct.node_names)
-    D = mesh.devices.size
-    screen_cap = screen_cap_wire(ct)
-    # pad candidates to a multiple of the mesh size; padded lanes re-screen
-    # node 0 and are discarded
-    NB = N if N % D == 0 else N + (D - N % D)
-    cand = np.zeros(NB, dtype=np.int32)
-    cand[:N] = np.arange(N, dtype=np.int32)
     fn = sharded_screen_fn(mesh)
-    shard = NamedSharding(mesh, P(POD_AXIS))
-    rep = NamedSharding(mesh, P())
-    ok = jax.device_get(fn(
-        jax.device_put(jnp.asarray(ct.free), rep),
-        jax.device_put(jnp.asarray(ct.requests), rep),
-        jax.device_put(jnp.asarray(ct.group_ids), rep),
-        jax.device_put(jnp.asarray(ct.group_counts), rep),
-        jax.device_put(jnp.asarray(screen_cap), rep),
-        jax.device_put(jnp.asarray(cand), shard),
-    ))
+    ok = jax.device_get(fn(*place_screen_args(ct, mesh)))
     out = np.asarray(ok)[:N].copy()
     out &= ~ct.blocked
     return out

@@ -157,3 +157,28 @@ class TestShardedScreen:
         with force_repack_backend("vmap"):
             vmap_ok = consolidatable(ct)
         assert (mesh_ok == vmap_ok).all()
+
+
+class TestPartitionEvidence:
+    """The virtual-CPU-mesh wall-clock rows cannot show a speedup (one
+    host's cores execute all D shards); what must hold regardless of
+    hardware is that XLA's SPMD partitioner divided the work. These pin
+    the compiler-level facts the multichip_partition_evidence bench row
+    reports."""
+
+    def test_partition_evidence_row(self):
+        from benchmarks.multichip_bench import partition_evidence
+
+        row = partition_evidence(n_nodes=200, num_pods=2000)
+        # screen: per-device FLOPs ~ 1/D of the single-device compile, and
+        # zero collectives (replicated reads, disjoint writes)
+        assert row["screen_collectives"] == 0
+        assert row["screen_flops_per_device_ratio"] == pytest.approx(
+            1.0 / N_DEV, rel=0.10
+        )
+        # solve: the scan's group axis divides exactly; the only
+        # communication is the scalar cost psum
+        assert row["solve_groups_total"] % N_DEV == 0
+        assert row["solve_groups_per_device"] == row["solve_groups_total"] // N_DEV
+        assert row["solve_collectives"] == ["all-reduce"]
+        assert row["solve_collective_bytes_per_solve"] == 4
