@@ -28,6 +28,15 @@ The open-new-nodes phase reproduces ``ffd._step``'s ``while_loop``: each
 iteration opens every full node of the current cost-per-slot winner at
 once and re-scores the partial tail, so trip count is bounded by the
 number of distinct winning types per group.
+
+Which backend wins is PROBLEM-DEPENDENT under jax 0.9's Mosaic: this
+kernel still beats the scan on synthetic mixes with few distinct
+winning types per group, but on the real-catalog headline problem the
+open-phase trip count (fine-grained price ladder -> many winners as the
+remainder shrinks) makes it ~2x slower than the scan (measured fenced
+on v5e: 100 ms vs 68 ms; round 3's Mosaic had it winning at 85.6 ms).
+``scheduling.solver``'s ``auto`` mode self-races both on the first
+solve and pins the faster, so serving always gets the winner.
 """
 
 from __future__ import annotations
