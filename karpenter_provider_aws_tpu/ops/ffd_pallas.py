@@ -29,14 +29,16 @@ iteration opens every full node of the current cost-per-slot winner at
 once and re-scores the partial tail, so trip count is bounded by the
 number of distinct winning types per group.
 
-Which backend wins is PROBLEM-DEPENDENT under jax 0.9's Mosaic: this
-kernel still beats the scan on synthetic mixes with few distinct
-winning types per group, but on the real-catalog headline problem the
-open-phase trip count (fine-grained price ladder -> many winners as the
-remainder shrinks) makes it ~2x slower than the scan (measured fenced
-on v5e: 100 ms vs 68 ms; round 3's Mosaic had it winning at 85.6 ms).
+Which backend wins is PROBLEM-DEPENDENT under jax 0.9's Mosaic: at
+identical shapes (G=64, T=768, N=4096) the kernel beats the scan on
+synthetic content (fenced on v5e: 59 ms vs 68 ms) but loses on the
+real-catalog headline problem (100 ms vs 68 ms; round 3's Mosaic had it
+winning there at 85.6 ms). The open-phase ``while_loop`` trip count is
+NOT the cause — the real problem averages 1.6 trips/group (max 5) —
+so the content-sensitivity lives somewhere in Mosaic's 0.9 codegen and
+is not currently attributable from this side of the tunnel.
 ``scheduling.solver``'s ``auto`` mode self-races both on the first
-solve and pins the faster, so serving always gets the winner.
+solve and pins the faster, so serving always gets the winner either way.
 """
 
 from __future__ import annotations
