@@ -53,7 +53,8 @@ def fmt(row: dict) -> str:
     for k in ("pods", "nodes", "messages"):
         if k in row:
             bits.append(f"{row[k]:,} {k}")
-    for k in ("value", "p99_ms", "p95_ms", "p50_ms", "msgs_per_sec",
+    for k in ("value", "device_amortized_ms", "p99_ms", "p95_ms", "p50_ms",
+              "msgs_per_sec",
               "pallas_p99_ms", "vmap_p99_ms", "native_p99_ms", "encode_ms",
               "controller_pass_ms", "cost_vs_greedy",
               "projected_local_p99_ms", "link_rtt_p99_ms",
