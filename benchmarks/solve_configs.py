@@ -249,6 +249,12 @@ def _run_config(name, pods, pools, catalog, iters=DEFAULT_ITERS, link=None):
         a50, a99 = _stage_percentiles(attr_rows)
         out["sync_stage_p50_ms"] = a50
         out["sync_stage_p99_ms"] = a99
+        # Deliberately conservative: compute_ms includes at least one full
+        # tunnel round trip but only half is subtracted, so projected_local
+        # is an UPPER bound on local-chip latency. The headline row's
+        # device_amortized_ms (bench.py chained-dispatch slope) witnesses
+        # the true device cost (~3 ms at 50k; the projections here carry
+        # tens of ms of residual link time).
         link_half = (link["p50_ms"] / 2.0) if link else 0.0
         local = [
             row.get("encode_ms", 0.0)
