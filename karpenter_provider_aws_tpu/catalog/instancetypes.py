@@ -83,12 +83,13 @@ class InstanceType:
         """parity: types.go:326-340 — enis * (ips-per-eni - 1) + 2."""
         return self.max_enis * (self.ips_per_eni - 1) + 2
 
-    def capacity(self, max_pods: Optional[int] = None, ephemeral_gib: int = 20) -> ResourceVector:
-        # Memoized per (max_pods, ephemeral_gib): the limits/launch loops call
-        # this once per PLAN NODE and the quantity re-parse dominated their
-        # host time at thousands of nodes. A fresh copy is returned so a
-        # caller mutating its vector cannot poison the memo.
-        key = (max_pods, ephemeral_gib)
+    def capacity(self, max_pods: Optional[int] = None, ephemeral_gib: int = 20,
+                 instance_store_policy: Optional[str] = None) -> ResourceVector:
+        # Memoized per (max_pods, ephemeral_gib, policy): the limits/launch
+        # loops call this once per PLAN NODE and the quantity re-parse
+        # dominated their host time at thousands of nodes. A fresh copy is
+        # returned so a caller mutating its vector cannot poison the memo.
+        key = (max_pods, ephemeral_gib, instance_store_policy)
         memo = self.__dict__.get("_capacity_memo")
         if memo is None:
             memo = {}
@@ -96,12 +97,21 @@ class InstanceType:
         v = memo.get(key)
         if v is None:
             pods = max_pods if max_pods is not None else self.eni_limited_pods()
+            # Instance-store disks become ephemeral-storage ONLY under the
+            # RAID0 policy; otherwise the EBS root volume's size is the
+            # node's ephemeral capacity (parity: types.go:218-224
+            # ephemeralStorage — RAID0 -> InstanceStorageInfo.TotalSizeInGB,
+            # else block-device size).
+            if instance_store_policy == "RAID0" and self.local_nvme_gib:
+                ephemeral = self.local_nvme_gib
+            else:
+                ephemeral = ephemeral_gib
             v = ResourceVector.from_map(
                 {
                     "cpu": self.vcpus,
                     "memory": f"{self.memory_mib}Mi",
                     "pods": pods,
-                    "ephemeral-storage": f"{max(self.local_nvme_gib, ephemeral_gib)}Gi",
+                    "ephemeral-storage": f"{ephemeral}Gi",
                     "nvidia.com/gpu": self.gpu_count if self.gpu_manufacturer == "nvidia" else 0,
                     "amd.com/gpu": self.gpu_count if self.gpu_manufacturer == "amd" else 0,
                     "aws.amazon.com/neuron": self.accelerator_count if self.accelerator_manufacturer == "aws" else 0,

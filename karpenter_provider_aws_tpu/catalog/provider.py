@@ -125,11 +125,15 @@ class CatalogProvider:
             self._tensor_cache.flush()
 
     # -- allocatable math --------------------------------------------------
-    def allocatable(self, it: InstanceType, max_pods: Optional[int] = None) -> ResourceVector:
+    def allocatable(self, it: InstanceType, max_pods: Optional[int] = None,
+                    ephemeral_gib: int = 20,
+                    instance_store_policy: Optional[str] = None) -> ResourceVector:
         """capacity - VM overhead - kube/system reserved - eviction
         (parity: types.go:182-215 Allocatable). ``max_pods`` is the per-pool
         kubelet override, which wins over the global overhead option
-        (parity: the kubelet maxPods input to types.go pods())."""
+        (parity: the kubelet maxPods input to types.go pods());
+        ``ephemeral_gib``/``instance_store_policy`` come from the nodeclass
+        (root block device size; RAID0 instance-store policy)."""
         o = self.overhead
         if max_pods is not None:
             pods = float(max_pods)
@@ -139,7 +143,8 @@ class CatalogProvider:
             pods = float(max(1, (it.max_enis - o.reserved_enis) * (it.ips_per_eni - 1) + 2))
             if o.pods_per_core:
                 pods = min(pods, float(o.pods_per_core * it.vcpus))
-        cap = it.capacity(max_pods=int(pods))
+        cap = it.capacity(max_pods=int(pods), ephemeral_gib=ephemeral_gib,
+                          instance_store_policy=instance_store_policy)
         v = cap.v.copy()
         v[MEMORY] = v[MEMORY] * (1.0 - o.vm_memory_overhead_percent)
         v[MEMORY] -= kube_reserved_memory_mib(pods) + o.system_reserved_memory_mib + o.eviction_threshold_memory_mib

@@ -363,8 +363,17 @@ class CloudProvider:
         pool = self.cluster.nodepools.get(claim.nodepool_name)
         kubelet = getattr(pool, "kubelet", None) if pool else None
         max_pods = kubelet.max_pods if kubelet is not None else None
-        claim.status.capacity = it.capacity(max_pods=max_pods)
-        claim.status.allocatable = self.catalog.allocatable(it, max_pods=max_pods)
+        # ephemeral-storage follows the nodeclass: root EBS volume size, or
+        # the total instance store under the RAID0 policy (types.go:218-244)
+        ephemeral_gib = nodeclass.root_volume_size_gib()
+        claim.status.capacity = it.capacity(
+            max_pods=max_pods, ephemeral_gib=ephemeral_gib,
+            instance_store_policy=nodeclass.instance_store_policy,
+        )
+        claim.status.allocatable = self.catalog.allocatable(
+            it, max_pods=max_pods, ephemeral_gib=ephemeral_gib,
+            instance_store_policy=nodeclass.instance_store_policy,
+        )
         claim.labels.update(it.labels())
         claim.labels[lbl.TOPOLOGY_ZONE] = inst.zone
         claim.labels[lbl.CAPACITY_TYPE] = inst.capacity_type

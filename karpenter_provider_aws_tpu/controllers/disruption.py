@@ -277,6 +277,7 @@ class DisruptionController:
         for ni, type_name, new_price, offering_options in cheaper_replacement(
             ct, self.cloudprovider.catalog, nodepools=dict(pools),
             reserved_allow=reserved_allow, spot_to_spot=self.spot_to_spot,
+            nodeclass_by_pool=self._nodeclass_by_pool(pools),
         ):
             if ni in deleted_nodes:
                 continue
@@ -317,6 +318,7 @@ class DisruptionController:
         by_pool: dict[str, list[int]] = {}
         for ni in candidates:
             by_pool.setdefault(ct.nodepool_names[ni], []).append(ni)
+        ncmap = self._nodeclass_by_pool(pools)
         for pool_name, cand in by_pool.items():
             top = min(
                 len(cand), self.MAX_REPLACE_SET,
@@ -333,6 +335,7 @@ class DisruptionController:
                     ct, overflow, self.cloudprovider.catalog, pool_name,
                     nodepools=dict(pools), margin=self.REPLACE_MARGIN,
                     price_cap=set_price,
+                    nodeclass_by_pool=ncmap,
                     set_has_spot=any(
                         ct.node_captype[i] == lbl.CAPACITY_TYPE_SPOT
                         for i in subset
@@ -391,6 +394,14 @@ class DisruptionController:
                     )
                 return True
         return False
+
+    def _nodeclass_by_pool(self, pools) -> dict:
+        """pool name -> resolved NodeClass (ephemeral-storage fit rules
+        follow the nodeclass — same map the provisioning solve passes)."""
+        return {
+            name: self.cluster.nodeclasses.get(pool.nodeclass_name)
+            for name, pool in pools.items()
+        }
 
     def _launch_replacement(self, old_claim, type_name: str, offering_options):
         """Launch the cheaper replacement BEFORE disrupting the old node

@@ -84,6 +84,12 @@ class ProvisioningController:
                 # pre-opened capacity, so pending pods land on slack already
                 # owned (or already being launched) instead of opening more.
                 existing=snapshot_existing_capacity(self.cluster, nominated_map),
+                # per-pool nodeclass: ephemeral-storage capacity follows its
+                # root volume + instanceStorePolicy (types.go:218-244)
+                nodeclass_by_pool={
+                    pool.name: self.cluster.nodeclasses.get(pool.nodeclass_name)
+                    for pool in nodepools
+                },
             )
         from ..metrics import SOLVE_DURATION, SOLVE_PODS
 

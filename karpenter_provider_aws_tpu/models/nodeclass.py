@@ -156,6 +156,12 @@ class NodeClass:
     tags: dict[str, str] = field(default_factory=dict)
     vm_memory_overhead_percent: float = 0.075  # options.go VMMemoryOverheadPercent default
     detailed_monitoring: bool = False
+    # How instance-store (local NVMe) disks are used. "RAID0" makes them the
+    # node's ephemeral-storage (capacity = total instance-store size) and the
+    # bootstrap configures the RAID (parity: ec2nodeclass.go:93-95 +
+    # types.go:218-224 ephemeralStorage + eksbootstrap.go:80-82 /
+    # nodeadm.go:86-88). None leaves ephemeral-storage on the EBS root.
+    instance_store_policy: Optional[str] = None  # None | "RAID0"
     status: NodeClassStatus = field(default_factory=NodeClassStatus)
     finalizers: set[str] = field(default_factory=set)
     deleted: bool = False
@@ -180,6 +186,17 @@ class NodeClass:
             spec[k] = v
         blob = json.dumps(spec, sort_keys=True, default=str).encode()
         return hashlib.sha256(blob).hexdigest()[:16]
+
+    def root_volume_size_gib(self) -> int:
+        """Size of the root EBS volume: the device marked rootVolume, else
+        the first mapping, else the 20 GiB family default. ONE home for the
+        rule — claim capacity (cloudprovider) and the solve tensor (encode)
+        must agree on it (parity: types.go:225-244 block-device resolution)."""
+        root = next(
+            (b for b in self.block_devices if b.root_volume),
+            self.block_devices[0] if self.block_devices else None,
+        )
+        return root.volume_size_gib if root else 20
 
     def hash_annotations(self) -> dict[str, str]:
         return {

@@ -395,6 +395,8 @@ def nodeclass_crd() -> dict:
                 },
             },
             "tags": {"type": "object", "additionalProperties": {"type": "string"}},
+            # parity: ec2nodeclass.go:93-95 kubebuilder Enum=RAID0
+            "instanceStorePolicy": {"type": "string", "enum": ["RAID0"]},
         },
         "x-kubernetes-validations": [
             {"rule": "(self.role != '') != (self.instanceProfile != '')",
@@ -543,6 +545,10 @@ def nodeclass_to_obj(nc) -> dict:
             "httpTokens": nc.metadata_options.http_tokens,
         },
         "tags": dict(nc.tags),
+        **(
+            {"instanceStorePolicy": nc.instance_store_policy}
+            if nc.instance_store_policy is not None else {}
+        ),
     }}
 
 

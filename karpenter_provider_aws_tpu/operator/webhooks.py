@@ -56,6 +56,11 @@ def validate_nodeclass(nc: NodeClass) -> None:
         v.append("imageFamily custom requires imageSelector terms")
     if nc.image_family == "custom" and not nc.user_data:
         v.append("imageFamily custom requires userData")
+    # enum parity: ec2nodeclass.go InstanceStorePolicy kubebuilder enum
+    if nc.instance_store_policy not in (None, "RAID0"):
+        v.append(
+            f"instanceStorePolicy must be RAID0 or unset, got {nc.instance_store_policy!r}"
+        )
     # CEL rule parity (ec2nodeclass.go:31-51 selector-term XValidations):
     # at least one of id/name/tags; 'id' mutually exclusive with the rest;
     # term tags carry no empty keys/values; at most 30 terms per selector.

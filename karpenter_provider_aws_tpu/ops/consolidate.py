@@ -730,6 +730,7 @@ def replacement_for_groups(
     price_cap: float = float("inf"),
     set_has_spot: bool = False,
     spot_to_spot: bool = False,
+    nodeclass_by_pool: Optional[dict] = None,
 ) -> Optional[tuple]:
     """Cheapest single node absorbing ``overflow`` (group id -> pod count):
     the one-new-node tail of multi-node consolidation replace
@@ -809,7 +810,12 @@ def replacement_for_groups(
 
     allowed = tensors.available & window[None, :, :]
     allowed[:, :, lbl.RESERVED_INDEX] = False  # see docstring
-    fits = (total[None, :] <= tensors.capacity + 1e-4).all(axis=1)
+    from ..ops.encode import effective_capacity
+
+    cap = effective_capacity(
+        tensors.capacity, types, (nodeclass_by_pool or {}).get(pool_name)
+    )
+    fits = (total[None, :] <= cap + 1e-4).all(axis=1)
 
     def _usable(a):
         wp = np.where(a, tensors.price, np.inf).min(axis=(1, 2))
@@ -851,6 +857,7 @@ MIN_TYPES_FOR_SPOT_TO_SPOT = 15
 def cheaper_replacement(
     ct: ClusterTensors, catalog, nodepools: Optional[dict] = None, margin: float = 0.15,
     reserved_allow: Optional[dict] = None, spot_to_spot: bool = False,
+    nodeclass_by_pool: Optional[dict] = None,
 ) -> list:
     """[(node_index, type_name, new_price)] single-node replace candidates:
     all the node's pods fit one cheaper instance type (consolidation.md
@@ -875,6 +882,18 @@ def cheaper_replacement(
     catalog_seq = tensors.key[0] if tensors.key else 0
     label_arrays = _label_arrays(types, (catalog.uid, catalog_seq, tensors.names))
     min_price = tensors.min_price()  # [T]
+    from ..ops.encode import effective_capacity
+
+    _cap_memo: dict = {}
+
+    def _cap_for(pool_name):
+        # per-pool effective capacity (nodeclass ephemeral rules); one
+        # adjusted copy per pool, not per node
+        if pool_name not in _cap_memo:
+            _cap_memo[pool_name] = effective_capacity(
+                tensors.capacity, types, (nodeclass_by_pool or {}).get(pool_name)
+            )
+        return _cap_memo[pool_name]
 
     def static_mask(reqs: Requirements) -> np.ndarray:
         row = np.ones(T, dtype=bool)
@@ -1001,7 +1020,8 @@ def cheaper_replacement(
             allowed[:, :, lbl.RESERVED_INDEX] &= pool_rmask.get(
                 ct.nodepool_names[i], no_access
             )
-        fits = (ct.used_total[i][None, :] <= tensors.capacity + 1e-4).all(axis=1)
+        cap_i = _cap_for(ct.nodepool_names[i])
+        fits = (ct.used_total[i][None, :] <= cap_i + 1e-4).all(axis=1)
 
         def _score(a):
             wp = np.where(a, tensors.price, np.inf).min(axis=(1, 2))

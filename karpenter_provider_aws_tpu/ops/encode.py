@@ -399,8 +399,32 @@ def invalidate_problem_cache() -> None:
         _PROBLEM_CACHE.clear()
 
 
+
+def effective_capacity(capacity, types, nodeclass):
+    """[T, R] allocatable with the EPHEMERAL column following the nodeclass:
+    root EBS volume size by default, total instance store under the RAID0
+    policy (types.go:218-244). Shared by the provisioning encode and the
+    consolidation replacement screens so fit decisions agree everywhere.
+    Returns ``capacity`` itself when there is no nodeclass to apply."""
+    if nodeclass is None:
+        return capacity
+    from ..models.resources import EPHEMERAL as _EPH
+
+    root_mib = float(nodeclass.root_volume_size_gib() * 1024)
+    eph = np.full(len(types), root_mib, dtype=np.float32)
+    if nodeclass.instance_store_policy == "RAID0":
+        nvme_mib = np.array(
+            [t.local_nvme_gib * 1024.0 for t in types], dtype=np.float32
+        )
+        eph = np.where(nvme_mib > 0, nvme_mib, eph)
+    out = capacity.copy()
+    out[:, _EPH] = eph
+    return out
+
+
 def _problem_cache_key(pods, catalog, nodepool, occupancy, allowed_types,
-                       allow_reserved, include_preferences, tensors):
+                       allow_reserved, include_preferences, tensors,
+                       nodeclass=None):
     # A caller-supplied tensors snapshot bypasses the cache entirely: it may
     # be a what-if view that catalog.cache_key() cannot distinguish.
     if tensors is not None or not pods:
@@ -423,6 +447,9 @@ def _problem_cache_key(pods, catalog, nodepool, occupancy, allowed_types,
         catalog.uid,
         catalog.cache_key(),
         (nodepool.name, nodepool.weight, nodepool.hash()) if nodepool else None,
+        # ephemeral-storage capacity follows the nodeclass (RAID0 policy +
+        # root volume size) -> different nodeclass, different tensors
+        nodeclass.hash() if nodeclass is not None else None,
         frozenset(allowed_types) if allowed_types is not None else None,
         reserved_key,
         include_preferences,
@@ -442,6 +469,7 @@ def encode_problem(
     allowed_types: Optional[set] = None,
     allow_reserved=True,
     include_preferences: bool = True,
+    nodeclass=None,
 ) -> EncodedProblem:
     """Build the dense solve tensors for one nodepool's candidate pods.
 
@@ -459,7 +487,8 @@ def encode_problem(
     """
     ckey = _problem_cache_key(pods, catalog, nodepool, occupancy,
                               allowed_types, allow_reserved,
-                              include_preferences, tensors)
+                              include_preferences, tensors,
+                              nodeclass=nodeclass)
     if ckey is not None:
         with _PROBLEM_CACHE_LOCK:
             hit = _PROBLEM_CACHE.get(ckey)
@@ -471,6 +500,12 @@ def encode_problem(
     types = catalog.list()
     T = len(types)
     Z = len(tensors.zones)
+
+    # Effective per-type capacity: ephemeral-storage follows the pool's
+    # NODECLASS (GetInstanceTypes is per-NodePool + nodeclass in the
+    # reference for exactly this reason). Computed HERE so the per-pod fit
+    # prefilter and the solve tensor agree.
+    cap_eff = effective_capacity(tensors.capacity, types, nodeclass)
 
     # Per-problem offering availability: the reserved axis is masked down to
     # the pairs this pool may use; price/compat/type_window all derive from
@@ -774,7 +809,7 @@ def encode_problem(
                 if not static_ok.any():
                     break
 
-            fits = (pod.requests.v[None, :] <= tensors.capacity + 1e-6).all(axis=1)
+            fits = (pod.requests.v[None, :] <= cap_eff + 1e-6).all(axis=1)
             # (reserved-offering access is enforced via the masked
             # `available` array — price, compat, type_window derive from it)
             offer_tc = available & crow[None, None, :]           # [T, Z, C]
@@ -786,7 +821,7 @@ def encode_problem(
         if is_atomic:
             # the cached fit is per-pod; an atomic group needs a type that
             # holds the whole summed unit
-            fits = (requests[gi][None, :] <= tensors.capacity + 1e-6).all(axis=1)
+            fits = (requests[gi][None, :] <= cap_eff + 1e-6).all(axis=1)
 
         zone_allowed[gi] = zrow
         if zone_mask is not None:
@@ -814,7 +849,7 @@ def encode_problem(
 
     # -- FFD order: decreasing dominant share ------------------------------
     if G > 0:
-        ref_cap = tensors.capacity.max(axis=0)
+        ref_cap = cap_eff.max(axis=0)
         ref_cap[ref_cap == 0] = 1.0
         dominant = (requests[:G] / ref_cap[None, :]).max(axis=1)
         order = np.argsort(-dominant, kind="stable")
@@ -832,7 +867,7 @@ def encode_problem(
     # Per-pool kubelet maxPods clamps the pods axis of every candidate type
     # (parity: kubelet maxPods feeding types.go pods(); GetInstanceTypes is
     # per-NodePool in the reference for exactly this reason).
-    capacity = tensors.capacity.astype(np.float32)
+    capacity = cap_eff.astype(np.float32)
     kubelet = getattr(nodepool, "kubelet", None) if nodepool else None
     if kubelet is not None and kubelet.max_pods is not None:
         from ..models.resources import PODS as _PODS

@@ -56,12 +56,17 @@ class ShellBootstrap:
     init runs parts in order, user parts first)."""
 
     def __init__(self, cluster: ClusterInfo, kubelet: KubeletConfiguration,
-                 labels: Mapping[str, str], taints: Sequence, custom: str = ""):
+                 labels: Mapping[str, str], taints: Sequence, custom: str = "",
+                 instance_store_policy: Optional[str] = None):
         self.cluster = cluster
         self.kubelet = kubelet
         self.labels = labels
         self.taints = taints
         self.custom = custom
+        # "RAID0" -> the bootstrap assembles instance-store disks into the
+        # node filesystem (families that cannot honor it simply ignore it,
+        # like the reference's bottlerocket/windows/custom bootstrappers)
+        self.instance_store_policy = instance_store_policy
 
     def _dns_ip(self) -> str:
         """kubeletConfiguration ClusterDNS wins over the cluster-discovered
@@ -86,6 +91,9 @@ class ShellBootstrap:
             lines.append(f"  --dns-cluster-ip '{self._dns_ip()}' \\")
         if self.cluster.ip_family == "ipv6":
             lines.append("  --ip-family 'ipv6' \\")
+        if self.instance_store_policy == "RAID0":
+            # parity: eksbootstrap.go:80-82 (--local-disks raid0)
+            lines.append("  --local-disks raid0 \\")
         lines.append(f"  --kubelet-extra-args '{' '.join(kubelet_args)}'")
         generated = "\n".join(lines) + "\n"
         if not self.custom:
@@ -120,6 +128,9 @@ class NodeadmBootstrap(ShellBootstrap):
                 },
             },
         }
+        if self.instance_store_policy == "RAID0":
+            # parity: nodeadm.go:86-88 (LocalStorage.Strategy = RAID0)
+            cfg["spec"]["instance"] = {"localStorage": {"strategy": "RAID0"}}
         generated = "# node.karpenter.tpu NodeConfig\n" + _yaml_dump(cfg)
         if not self.custom:
             return generated
@@ -230,6 +241,7 @@ def bootstrapper_for(
     labels: Optional[Mapping[str, str]] = None,
     taints: Sequence = (),
     custom: str = "",
+    instance_store_policy: Optional[str] = None,
 ) -> ShellBootstrap:
     """Family alias -> bootstrapper (parity: GetAMIFamily resolver.go:80-112).
 
@@ -240,7 +252,8 @@ def bootstrapper_for(
     from .imagefamily import get_family  # here: imagefamily imports this module
 
     return get_family(family).bootstrapper(
-        cluster, kubelet=kubelet, labels=labels, taints=taints, custom=custom
+        cluster, kubelet=kubelet, labels=labels, taints=taints, custom=custom,
+        instance_store_policy=instance_store_policy,
     )
 
 

@@ -70,7 +70,8 @@ class ImageFamily:
         return FeatureFlags()
 
     def bootstrapper(self, cluster, kubelet: Optional[KubeletConfiguration] = None,
-                     labels=None, taints=(), custom: str = ""):
+                     labels=None, taints=(), custom: str = "",
+                     instance_store_policy: Optional[str] = None):
         # feature-flag enforcement (parity: bottlerocket.go rejecting
         # evictionSoft in UserData): a kubelet knob the family's agent
         # cannot honor fails loudly at resolve time, not silently on-node
@@ -85,7 +86,8 @@ class ImageFamily:
                     f"family {self.name} does not support podsPerCore"
                 )
         return self.bootstrap_cls(
-            cluster, kubelet or KubeletConfiguration(), labels or {}, taints, custom
+            cluster, kubelet or KubeletConfiguration(), labels or {}, taints,
+            custom, instance_store_policy=instance_store_policy,
         )
 
 

@@ -137,6 +137,7 @@ class LaunchTemplateProvider:
                 labels=labels,
                 taints=taints,
                 custom=nodeclass.user_data,
+                instance_store_policy=nodeclass.instance_store_policy,
             )
             resolved = ResolvedTemplate(
                 image_id=image.id,

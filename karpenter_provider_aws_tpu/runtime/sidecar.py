@@ -230,11 +230,14 @@ class RemoteSolver:
         return specs, binds, unplaced
 
     def solve(self, pods, nodepools, catalog, in_use=None, occupancy=None, type_allow=None,
-              reserved_allow=None, existing=None):
+              reserved_allow=None, existing=None, nodeclass_by_pool=None):
         from ..scheduling.solver import _solve_multi_nodepool
 
+        # the nodeclass-adjusted capacity tensor is built host-side by
+        # encode_problem, so the sidecar wire needs no new fields
         return _solve_multi_nodepool(self, pods, nodepools, catalog, in_use, occupancy,
-                                     type_allow, reserved_allow, existing)
+                                     type_allow, reserved_allow, existing,
+                                     nodeclass_by_pool=nodeclass_by_pool)
 
 
 def serve(address: str = "127.0.0.1:50151") -> SolverServer:

@@ -172,6 +172,7 @@ class Solver(Protocol):
         type_allow=None,
         reserved_allow=None,
         existing: Optional[Sequence[ExistingNode]] = None,
+        nodeclass_by_pool=None,
     ) -> SolveResult: ...
 
 
@@ -1076,9 +1077,10 @@ class TPUSolver:
         return specs, binds, unplaced
 
     def solve(self, pods, nodepools, catalog, in_use=None, occupancy=None, type_allow=None,
-              reserved_allow=None, existing=None) -> SolveResult:
+              reserved_allow=None, existing=None, nodeclass_by_pool=None) -> SolveResult:
         return _solve_multi_nodepool(self, pods, nodepools, catalog, in_use, occupancy,
-                                     type_allow, reserved_allow, existing)
+                                     type_allow, reserved_allow, existing,
+                                     nodeclass_by_pool=nodeclass_by_pool)
 
 
 class HostSolver:
@@ -1117,9 +1119,10 @@ class HostSolver:
         return specs, binds, unplaced
 
     def solve(self, pods, nodepools, catalog, in_use=None, occupancy=None, type_allow=None,
-              reserved_allow=None, existing=None) -> SolveResult:
+              reserved_allow=None, existing=None, nodeclass_by_pool=None) -> SolveResult:
         return _solve_multi_nodepool(self, pods, nodepools, catalog, in_use, occupancy,
-                                     type_allow, reserved_allow, existing)
+                                     type_allow, reserved_allow, existing,
+                                     nodeclass_by_pool=nodeclass_by_pool)
 
 
 def _enforce_pool_constraints(
@@ -1127,6 +1130,7 @@ def _enforce_pool_constraints(
     pool: NodePool,
     catalog: CatalogProvider,
     in_use,
+    nodeclass=None,
 ) -> tuple[list[NodeSpec], list[tuple[Pod, str]]]:
     """Apply NodePool.spec.limits and requirement minValues to a node plan.
 
@@ -1165,7 +1169,16 @@ def _enforce_pool_constraints(
                 continue
         if not pool.limits.unlimited:
             it = catalog.get(spec.instance_type_options[0])
-            candidate = in_use + it.capacity()
+            # capacity accounting must match what the claim will record
+            # (nodeclass ephemeral rules), or limits drift from reality
+            candidate = in_use + it.capacity(
+                ephemeral_gib=(
+                    nodeclass.root_volume_size_gib() if nodeclass else 20
+                ),
+                instance_store_policy=(
+                    nodeclass.instance_store_policy if nodeclass else None
+                ),
+            )
             if pool.limits.exceeded_by(candidate):
                 for pod in spec.pods:
                     rejected.append((pod, "would exceed nodepool limits"))
@@ -1177,7 +1190,7 @@ def _enforce_pool_constraints(
 
 def _solve_multi_nodepool(
     impl, pods, nodepools, catalog, in_use=None, occupancy=None, type_allow=None,
-    reserved_allow=None, existing=None,
+    reserved_allow=None, existing=None, nodeclass_by_pool=None,
 ) -> SolveResult:
     t0 = time.perf_counter()
     if hasattr(impl, "timings"):
@@ -1209,6 +1222,7 @@ def _solve_multi_nodepool(
             pods_in, catalog, nodepool=pool, occupancy=occupancy,
             allowed_types=allowed, allow_reserved=allow_res,
             include_preferences=include_preferences,
+            nodeclass=(nodeclass_by_pool or {}).get(pool.name),
         )
         if hasattr(impl, "timings"):
             # accumulate across rounds: one solve() = one breakdown
@@ -1239,11 +1253,21 @@ def _solve_multi_nodepool(
         extra = launched_extra.get(pool.name)
         if extra is not None:
             used = extra if used is None else used + extra
-        specs, rejected = _enforce_pool_constraints(specs, pool, catalog, used)
+        pool_nc = (nodeclass_by_pool or {}).get(pool.name)
+        specs, rejected = _enforce_pool_constraints(
+            specs, pool, catalog, used, nodeclass=pool_nc
+        )
         for spec in specs:
             it = catalog.get(spec.instance_type_options[0])
             if it is not None:
-                cap = it.capacity()
+                cap = it.capacity(
+                    ephemeral_gib=(
+                        pool_nc.root_volume_size_gib() if pool_nc else 20
+                    ),
+                    instance_store_policy=(
+                        pool_nc.instance_store_policy if pool_nc else None
+                    ),
+                )
                 prev = launched_extra.get(pool.name)
                 launched_extra[pool.name] = cap if prev is None else prev + cap
         result.node_specs.extend(specs)

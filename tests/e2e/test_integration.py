@@ -146,3 +146,31 @@ class TestProvisioningE2E:
             z = node.zone()
             zones[z] = zones.get(z, 0) + 1
         assert max(zones.values()) - min(zones.values()) <= 1
+
+    def test_instance_store_policy_raid0_end_to_end(self, env, expect):
+        """A RAID0 nodeclass launches nodes whose ephemeral-storage counts
+        the instance store and whose userdata assembles the RAID (parity:
+        types.go:218-224 + eksbootstrap.go:80-82)."""
+        nodeclass = NodeClass(
+            name="default", role="node-role", instance_store_policy="RAID0"
+        )
+        env.cluster.apply(nodeclass)
+        env.cluster.apply(NodePool(name="default"))
+        env.nodeclass_status.reconcile()
+        env.nodeclass_hash.reconcile()
+        # a pod whose ephemeral request only fits if instance store counts
+        for p in make_pods(2, "scratch", {"cpu": "2", "memory": "4Gi",
+                                          "ephemeral-storage": "200Gi"}):
+            env.cluster.apply(p)
+        expect.healthy()
+        claims = [c for c in env.cluster.nodeclaims.values()]
+        assert claims
+        for c in claims:
+            it = env.catalog.get(c.labels[lbl.INSTANCE_TYPE_LABEL])
+            assert it.local_nvme_gib >= 200, "landed on a non-NVMe type"
+            assert c.status.capacity.get("ephemeral-storage") == it.local_nvme_gib * 1024
+        assert env.cloud.launch_templates, "no launch templates created"
+        assert all(
+            "--local-disks raid0" in lt.user_data
+            for lt in env.cloud.launch_templates.values()
+        )

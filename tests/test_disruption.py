@@ -372,3 +372,48 @@ class TestConsolidationKernel:
             host = repack_feasible_numpy(ct, ct.free, i) is not None
             if not ct.blocked[i]:
                 assert bool(can_device[i]) == host, f"node {i}"
+
+
+class TestRAID0Consolidation:
+    """The replacement screens must use the NODECLASS's ephemeral rules
+    (review regression: provisioning got the RAID0 capacity override but
+    consolidation compared pods against the nodeclass-blind 20GiB tensor,
+    permanently excluding storage-heavy RAID0 nodes from replace)."""
+
+    def test_cheaper_replacement_sees_raid0_ephemeral(self, env):
+        from karpenter_provider_aws_tpu.models.nodeclass import NodeClass
+        from karpenter_provider_aws_tpu.ops.consolidate import (
+            cheaper_replacement,
+            encode_cluster,
+        )
+
+        nodeclass = NodeClass(
+            name="default", role="node-role", instance_store_policy="RAID0"
+        )
+        env.cluster.apply(nodeclass)
+        pool = pool_with(consolidate_after_s=None)
+        pool.requirements = [
+            Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m", "r", "i", "d"))
+        ]
+        env.cluster.apply(pool)
+        env.nodeclass_status.reconcile()
+        env.nodeclass_hash.reconcile()
+        provision(env, make_pods(2, "scratch", {
+            "cpu": "1", "memory": "2Gi", "ephemeral-storage": "150Gi",
+        }))
+        ct = encode_cluster(env.cluster, env.catalog)
+        assert ct is not None
+        pools = {pool.name: pool}
+        ncmap = {pool.name: nodeclass}
+        # With the nodeclass threaded, candidate fits exist (NVMe types can
+        # hold 150Gi); nodeclass-blind, every type capped at 20Gi and the
+        # screen returns nothing structurally fit-capable.
+        rows_blind = cheaper_replacement(
+            ct, env.catalog, nodepools=pools, margin=-10.0
+        )
+        rows_aware = cheaper_replacement(
+            ct, env.catalog, nodepools=pools, margin=-10.0,
+            nodeclass_by_pool=ncmap,
+        )
+        assert not rows_blind
+        assert rows_aware

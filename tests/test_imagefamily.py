@@ -151,3 +151,74 @@ class TestBootstrapScripts:
     def test_ubuntu_is_shell(self):
         script = get_family("ubuntu").bootstrapper(INFO).script()
         assert "cluster-1" in script
+
+
+class TestInstanceStorePolicy:
+    """instanceStorePolicy=RAID0 parity: ec2nodeclass.go:93-95, the
+    eksbootstrap.go:80-82 --local-disks flag, nodeadm.go:86-88
+    LocalStorage.Strategy, and types.go:218-224 ephemeral-storage math."""
+
+    def test_shell_family_emits_local_disks_flag(self):
+        script = get_family("standard").bootstrapper(
+            INFO, instance_store_policy="RAID0",
+        ).script()
+        assert "--local-disks raid0" in script
+
+    def test_shell_family_omits_flag_without_policy(self):
+        script = get_family("standard").bootstrapper(INFO).script()
+        assert "--local-disks" not in script
+
+    def test_nodeadm_family_emits_local_storage_strategy(self):
+        script = get_family("nodeadm").bootstrapper(
+            INFO, instance_store_policy="RAID0",
+        ).script()
+        assert "localStorage" in script and "RAID0" in script
+
+    def test_toml_family_ignores_policy(self):
+        script = get_family("bottlerocket").bootstrapper(
+            INFO, instance_store_policy="RAID0",
+        ).script()
+        assert "RAID0" not in script
+
+    def test_capacity_counts_instance_store_only_under_raid0(self):
+        from karpenter_provider_aws_tpu.catalog import generate_catalog
+
+        nvme = next(t for t in generate_catalog() if t.local_nvme_gib)
+        plain = nvme.capacity().get("ephemeral-storage")
+        raided = nvme.capacity(instance_store_policy="RAID0").get("ephemeral-storage")
+        assert plain == 20 * 1024  # root EBS volume only (MiB)
+        assert raided == nvme.local_nvme_gib * 1024
+
+    def test_nodeclass_hash_changes_with_policy(self):
+        from karpenter_provider_aws_tpu.models import NodeClass
+
+        a = NodeClass(name="a", role="r")
+        b = NodeClass(name="a", role="r", instance_store_policy="RAID0")
+        assert a.hash() != b.hash()
+
+    def test_admission_rejects_unknown_policy(self):
+        import pytest
+
+        from karpenter_provider_aws_tpu.models import NodeClass
+        from karpenter_provider_aws_tpu.operator.webhooks import validate_nodeclass
+
+        with pytest.raises(Exception) as exc:
+            validate_nodeclass(
+                NodeClass(name="a", role="r", instance_store_policy="RAID5")
+            )
+        assert "instanceStorePolicy" in str(exc.value)
+
+    def test_crd_schema_round_trip(self):
+        from karpenter_provider_aws_tpu.models import NodeClass
+        from karpenter_provider_aws_tpu.operator.crds import (
+            nodeclass_crd,
+            nodeclass_to_obj,
+            validate_object,
+        )
+
+        crd = nodeclass_crd()
+        ok = nodeclass_to_obj(NodeClass(name="a", role="r", instance_store_policy="RAID0"))
+        assert validate_object(crd, ok) == []
+        bad = nodeclass_to_obj(NodeClass(name="a", role="r"))
+        bad["spec"]["instanceStorePolicy"] = "RAID5"
+        assert validate_object(crd, bad)
