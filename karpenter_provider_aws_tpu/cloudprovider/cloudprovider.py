@@ -365,14 +365,11 @@ class CloudProvider:
         max_pods = kubelet.max_pods if kubelet is not None else None
         # ephemeral-storage follows the nodeclass: root EBS volume size, or
         # the total instance store under the RAID0 policy (types.go:218-244)
-        ephemeral_gib = nodeclass.root_volume_size_gib()
         claim.status.capacity = it.capacity(
-            max_pods=max_pods, ephemeral_gib=ephemeral_gib,
-            instance_store_policy=nodeclass.instance_store_policy,
+            max_pods=max_pods, **nodeclass.capacity_kwargs()
         )
         claim.status.allocatable = self.catalog.allocatable(
-            it, max_pods=max_pods, ephemeral_gib=ephemeral_gib,
-            instance_store_policy=nodeclass.instance_store_policy,
+            it, max_pods=max_pods, **nodeclass.capacity_kwargs()
         )
         claim.labels.update(it.labels())
         claim.labels[lbl.TOPOLOGY_ZONE] = inst.zone

@@ -106,6 +106,7 @@ class LaunchTemplateData:
     tags: dict[str, str] = field(default_factory=dict)
     # None = subnet default; False = explicitly disabled (subnet.go:119-130)
     associate_public_ip: Optional[bool] = None
+    detailed_monitoring: bool = False
 
 
 class FakeCloud:
@@ -370,7 +371,8 @@ class FakeCloud:
                                instance_profile: str = "", security_group_ids=(),
                                block_devices=(), metadata_options=None,
                                tags: Optional[dict[str, str]] = None,
-                               associate_public_ip: Optional[bool] = None) -> LaunchTemplateData:
+                               associate_public_ip: Optional[bool] = None,
+                               detailed_monitoring: bool = False) -> LaunchTemplateData:
         with self._lock:
             self._record("create_launch_template", name)
             self._maybe_fail()
@@ -381,6 +383,7 @@ class FakeCloud:
                 block_devices=tuple(block_devices),
                 metadata_options=metadata_options, tags=dict(tags or {}),
                 associate_public_ip=associate_public_ip,
+                detailed_monitoring=detailed_monitoring,
             )
             self.launch_templates[name] = lt
             return lt

@@ -198,6 +198,16 @@ class NodeClass:
         )
         return root.volume_size_gib if root else 20
 
+    def capacity_kwargs(self) -> dict:
+        """kwargs for InstanceType.capacity()/CatalogProvider.allocatable()
+        derived from this nodeclass — the ONE home for how a nodeclass
+        shapes node capacity (fit accounting, limits accounting, and claim
+        status must agree)."""
+        return {
+            "ephemeral_gib": self.root_volume_size_gib(),
+            "instance_store_policy": self.instance_store_policy,
+        }
+
     def hash_annotations(self) -> dict[str, str]:
         return {
             lbl.ANNOTATION_NODECLASS_HASH: self.hash(),

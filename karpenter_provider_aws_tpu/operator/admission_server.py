@@ -73,7 +73,7 @@ def _kubelet(raw) -> Optional[KubeletConfiguration]:
 def nodeclass_from_dict(data: dict) -> NodeClass:
     kw = {"name": data["name"]}
     for k in ("image_family", "role", "instance_profile", "user_data",
-              "instance_store_policy"):
+              "instance_store_policy", "detailed_monitoring"):
         if k in data:
             kw[k] = data[k]
     if "tags" in data:

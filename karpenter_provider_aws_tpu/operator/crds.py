@@ -397,6 +397,8 @@ def nodeclass_crd() -> dict:
             "tags": {"type": "object", "additionalProperties": {"type": "string"}},
             # parity: ec2nodeclass.go:93-95 kubebuilder Enum=RAID0
             "instanceStorePolicy": {"type": "string", "enum": ["RAID0"]},
+            # parity: ec2nodeclass.go:96-98 DetailedMonitoring
+            "detailedMonitoring": {"type": "boolean"},
         },
         "x-kubernetes-validations": [
             {"rule": "(self.role != '') != (self.instanceProfile != '')",
@@ -545,6 +547,7 @@ def nodeclass_to_obj(nc) -> dict:
             "httpTokens": nc.metadata_options.http_tokens,
         },
         "tags": dict(nc.tags),
+        "detailedMonitoring": nc.detailed_monitoring,
         **(
             {"instanceStorePolicy": nc.instance_store_policy}
             if nc.instance_store_policy is not None else {}

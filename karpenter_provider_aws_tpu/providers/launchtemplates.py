@@ -42,6 +42,9 @@ class ResolvedTemplate:
     # None = leave the subnet's default; False = explicitly disable (set when
     # every resolved subnet is known private — subnet.go:119-130)
     associate_public_ip: Optional[bool] = None
+    # CloudWatch detailed monitoring (parity: launchtemplate.go:255-257
+    # Monitoring.Enabled from nodeclass.spec.detailedMonitoring)
+    detailed_monitoring: bool = False
 
     def content_hash(self) -> str:
         blob = json.dumps(
@@ -54,6 +57,7 @@ class ResolvedTemplate:
                 "md": asdict(self.metadata_options) if self.metadata_options else None,
                 "tags": list(self.tags),
                 "public_ip": self.associate_public_ip,
+                "monitoring": self.detailed_monitoring,
             },
             sort_keys=True,
         ).encode()
@@ -149,6 +153,7 @@ class LaunchTemplateProvider:
                 metadata_options=nodeclass.metadata_options,
                 tags=tuple(sorted(nodeclass.tags.items())),
                 associate_public_ip=associate_public_ip,
+                detailed_monitoring=nodeclass.detailed_monitoring,
             )
             out[image.id] = self._ensure_one(nodeclass, resolved)
         self._gc_stale(nodeclass, keep=set(out.values()))
@@ -175,6 +180,7 @@ class LaunchTemplateProvider:
                 block_devices=resolved.block_devices,
                 metadata_options=resolved.metadata_options,
                 associate_public_ip=resolved.associate_public_ip,
+                detailed_monitoring=resolved.detailed_monitoring,
                 tags={
                     # user tags first: the managed tags must win or hydration
                     # and termination teardown lose track of the template

@@ -204,6 +204,7 @@ class NativeSolver:
         return specs, binds, {g: int(c) for g, c in enumerate(unplaced) if c > 0}
 
     def solve(self, pods, nodepools, catalog, in_use=None, occupancy=None, type_allow=None,
-              reserved_allow=None, existing=None):
+              reserved_allow=None, existing=None, nodeclass_by_pool=None):
         return _solve_multi_nodepool(self, pods, nodepools, catalog, in_use, occupancy,
-                                     type_allow, reserved_allow, existing)
+                                     type_allow, reserved_allow, existing,
+                                     nodeclass_by_pool=nodeclass_by_pool)

@@ -1172,12 +1172,7 @@ def _enforce_pool_constraints(
             # capacity accounting must match what the claim will record
             # (nodeclass ephemeral rules), or limits drift from reality
             candidate = in_use + it.capacity(
-                ephemeral_gib=(
-                    nodeclass.root_volume_size_gib() if nodeclass else 20
-                ),
-                instance_store_policy=(
-                    nodeclass.instance_store_policy if nodeclass else None
-                ),
+                **(nodeclass.capacity_kwargs() if nodeclass else {})
             )
             if pool.limits.exceeded_by(candidate):
                 for pod in spec.pods:
@@ -1261,12 +1256,7 @@ def _solve_multi_nodepool(
             it = catalog.get(spec.instance_type_options[0])
             if it is not None:
                 cap = it.capacity(
-                    ephemeral_gib=(
-                        pool_nc.root_volume_size_gib() if pool_nc else 20
-                    ),
-                    instance_store_policy=(
-                        pool_nc.instance_store_policy if pool_nc else None
-                    ),
+                    **(pool_nc.capacity_kwargs() if pool_nc else {})
                 )
                 prev = launched_extra.get(pool.name)
                 launched_extra[pool.name] = cap if prev is None else prev + cap

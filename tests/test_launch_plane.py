@@ -134,6 +134,16 @@ class TestLaunchTemplates:
         reqs = [r for batch in env.cloud.calls["create_fleet"] for r in batch]
         assert all(r.launch_template_name for r in reqs)
 
+    def test_detailed_monitoring_reaches_template(self, env):
+        """parity: launchtemplate.go:255-257 Monitoring.Enabled follows
+        nodeclass.spec.detailedMonitoring (default off)."""
+        nc = env.cluster.nodeclasses["default"]
+        nc.detailed_monitoring = True
+        env.cloudprovider.launch_templates._cache.flush()
+        self._provision(env)
+        lts = env.cloud.describe_launch_templates()
+        assert lts and all(lt.detailed_monitoring for lt in lts)
+
     def test_public_ip_disabled_only_when_all_subnets_private(self, env):
         """parity: subnet.go:119-130 AssociatePublicIPAddressValue — the
         template pins associatePublicIP=False iff every resolved subnet is
