@@ -29,6 +29,9 @@ class LaunchRequest:
     security_group_ids: tuple[str, ...] = ()
     tags: dict[str, str] = field(default_factory=dict)
     launch_template_name: str = ""            # "" = launch without a template
+    # reserved EC2 launch context, verbatim pass-through (instance.go:220)
+    context: str = ""
+
 
 
 @runtime_checkable

@@ -199,11 +199,16 @@ class CloudProvider:
                 labels=dict(claim.labels),
                 taints=list(claim.taints) + list(claim.startup_taints),
                 kubelet=getattr(pool, "kubelet", None) if pool else None,
-                # explicit False only when every resolved subnet is known
-                # private (parity: subnet.go:119-130); same snapshot as the
-                # zonal pick above
-                associate_public_ip=self.subnets.associate_public_ip_value(
-                    nodeclass, subnets=subnet_snapshot
+                # the user's explicit setting wins (ec2nodeclass.go:45-47);
+                # otherwise explicit False only when every resolved subnet
+                # is known private (subnet.go:119-130); same snapshot as
+                # the zonal pick above
+                associate_public_ip=(
+                    nodeclass.associate_public_ip
+                    if nodeclass.associate_public_ip is not None
+                    else self.subnets.associate_public_ip_value(
+                        nodeclass, subnets=subnet_snapshot
+                    )
                 ),
             )[image.id]
 
@@ -214,6 +219,7 @@ class CloudProvider:
             image_id=image.id,
             subnet_by_zone=subnet_by_zone,
             security_group_ids=sgs,
+            context=nodeclass.context,
             tags={
                 MANAGED_TAG: "true",
                 NODEPOOL_TAG: claim.nodepool_name,

@@ -104,7 +104,8 @@ class LaunchTemplateData:
     block_devices: tuple = ()
     metadata_options: Optional[object] = None
     tags: dict[str, str] = field(default_factory=dict)
-    # None = subnet default; False = explicitly disabled (subnet.go:119-130)
+    # None = subnet default; True/False = pinned (spec override or private-
+    # subnet inference — ec2nodeclass.go:45-47, subnet.go:119-130)
     associate_public_ip: Optional[bool] = None
     detailed_monitoring: bool = False
 

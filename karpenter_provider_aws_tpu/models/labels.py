@@ -57,11 +57,12 @@ ANNOTATION_NODECLASS_HASH_VERSION = f"{GROUP}/nodeclass-hash-version"
 ANNOTATION_INSTANCE_TAGGED = f"{GROUP}/tagged"
 ANNOTATION_DO_NOT_DISRUPT = "karpenter.sh/do-not-disrupt"
 
-# v2: instance_store_policy joined the NodeClass static hash — the bump
-# makes the hash controller RE-STAMP existing claims' annotations instead
-# of letting the new field's presence falsely drift-flag the whole fleet
+# Bump whenever a field joins the NodeClass static hash: the hash
+# controller then RE-STAMPS existing claims' annotations instead of
+# letting the new field's presence falsely drift-flag the whole fleet
 # (parity: hash/controller.go:83-120 hash-version migration).
-NODECLASS_HASH_VERSION = "v2"
+# v2: instance_store_policy; v3: associate_public_ip + context.
+NODECLASS_HASH_VERSION = "v3"
 
 # Labels whose values are numeric and thus support Gt/Lt requirements.
 NUMERIC_LABELS = frozenset(

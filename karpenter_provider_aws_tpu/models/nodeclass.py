@@ -162,6 +162,13 @@ class NodeClass:
     # types.go:218-224 ephemeralStorage + eksbootstrap.go:80-82 /
     # nodeadm.go:86-88). None leaves ephemeral-storage on the EBS root.
     instance_store_policy: Optional[str] = None  # None | "RAID0"
+    # Explicit public-IP override (parity: ec2nodeclass.go:45-47). None =
+    # infer from the resolved subnets (subnet.go:119-130); True/False wins.
+    associate_public_ip: Optional[bool] = None
+    # Reserved EC2 launch context, passed through to the fleet request
+    # verbatim (parity: ec2nodeclass.go:116-119 + instance.go:220).
+    context: str = ""
+
     status: NodeClassStatus = field(default_factory=NodeClassStatus)
     finalizers: set[str] = field(default_factory=set)
     deleted: bool = False

@@ -73,7 +73,8 @@ def _kubelet(raw) -> Optional[KubeletConfiguration]:
 def nodeclass_from_dict(data: dict) -> NodeClass:
     kw = {"name": data["name"]}
     for k in ("image_family", "role", "instance_profile", "user_data",
-              "instance_store_policy", "detailed_monitoring"):
+              "instance_store_policy", "detailed_monitoring",
+              "associate_public_ip", "context"):
         if k in data:
             kw[k] = data[k]
     if "tags" in data:

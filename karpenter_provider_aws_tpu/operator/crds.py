@@ -399,6 +399,9 @@ def nodeclass_crd() -> dict:
             "instanceStorePolicy": {"type": "string", "enum": ["RAID0"]},
             # parity: ec2nodeclass.go:96-98 DetailedMonitoring
             "detailedMonitoring": {"type": "boolean"},
+            # parity: ec2nodeclass.go:45-47 / :116-119
+            "associatePublicIPAddress": {"type": "boolean"},
+            "context": {"type": "string"},
         },
         "x-kubernetes-validations": [
             {"rule": "(self.role != '') != (self.instanceProfile != '')",
@@ -548,6 +551,11 @@ def nodeclass_to_obj(nc) -> dict:
         },
         "tags": dict(nc.tags),
         "detailedMonitoring": nc.detailed_monitoring,
+        **(
+            {"associatePublicIPAddress": nc.associate_public_ip}
+            if nc.associate_public_ip is not None else {}
+        ),
+        **({"context": nc.context} if nc.context else {}),
         **(
             {"instanceStorePolicy": nc.instance_store_policy}
             if nc.instance_store_policy is not None else {}

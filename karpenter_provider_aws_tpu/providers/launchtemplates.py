@@ -39,8 +39,9 @@ class ResolvedTemplate:
     block_devices: tuple = ()
     metadata_options: Optional[object] = None
     tags: tuple[tuple[str, str], ...] = ()
-    # None = leave the subnet's default; False = explicitly disable (set when
-    # every resolved subnet is known private — subnet.go:119-130)
+    # None = leave the subnet's default; True/False = pin it — either the
+    # user's spec override (ec2nodeclass.go:45-47) or inferred False when
+    # every resolved subnet is known private (subnet.go:119-130)
     associate_public_ip: Optional[bool] = None
     # CloudWatch detailed monitoring (parity: launchtemplate.go:255-257
     # Monitoring.Enabled from nodeclass.spec.detailedMonitoring)

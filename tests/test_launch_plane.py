@@ -291,3 +291,31 @@ class TestCustomFamilyLaunch:
         env.step(3)
         lts = env.cloud.describe_launch_templates()
         assert lts and all(t.user_data == "my-exact-bootstrap" for t in lts)
+
+
+class TestPublicIPOverrideAndContext:
+    """associatePublicIPAddress as a SPEC field (ec2nodeclass.go:45-47 —
+    the user's setting wins over subnet inference) and the reserved EC2
+    launch context pass-through (instance.go:220)."""
+
+    def _provision(self, env, n=2):
+        for p in make_pods(n, "w", {"cpu": "1", "memory": "2Gi"}):
+            env.cluster.apply(p)
+        env.step(3)
+
+    def test_explicit_public_ip_wins_over_private_subnets(self, env):
+        for s in env.cloud.subnets:
+            s.public = False          # inference alone would pin False
+        nc = env.cluster.nodeclasses["default"]
+        nc.associate_public_ip = True
+        env.cloudprovider.launch_templates._cache.flush()
+        self._provision(env)
+        lts = env.cloud.describe_launch_templates()
+        assert lts and all(lt.associate_public_ip is True for lt in lts)
+
+    def test_context_reaches_fleet_request(self, env):
+        nc = env.cluster.nodeclasses["default"]
+        nc.context = "ctx-outpost-1"
+        self._provision(env)
+        reqs = [r for batch in env.cloud.calls["create_fleet"] for r in batch]
+        assert reqs and all(r.context == "ctx-outpost-1" for r in reqs)
