@@ -33,7 +33,6 @@ class LaunchRequest:
     context: str = ""
 
 
-
 @runtime_checkable
 class CloudBackend(Protocol):
     """Everything the framework calls on the cloud, in one place.

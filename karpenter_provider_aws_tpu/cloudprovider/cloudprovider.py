@@ -371,11 +371,10 @@ class CloudProvider:
         max_pods = kubelet.max_pods if kubelet is not None else None
         # ephemeral-storage follows the nodeclass: root EBS volume size, or
         # the total instance store under the RAID0 policy (types.go:218-244)
-        claim.status.capacity = it.capacity(
-            max_pods=max_pods, **nodeclass.capacity_kwargs()
-        )
+        cap_kw = nodeclass.capacity_kwargs()
+        claim.status.capacity = it.capacity(max_pods=max_pods, **cap_kw)
         claim.status.allocatable = self.catalog.allocatable(
-            it, max_pods=max_pods, **nodeclass.capacity_kwargs()
+            it, max_pods=max_pods, **cap_kw
         )
         claim.labels.update(it.labels())
         claim.labels[lbl.TOPOLOGY_ZONE] = inst.zone

@@ -277,7 +277,7 @@ class DisruptionController:
         for ni, type_name, new_price, offering_options in cheaper_replacement(
             ct, self.cloudprovider.catalog, nodepools=dict(pools),
             reserved_allow=reserved_allow, spot_to_spot=self.spot_to_spot,
-            nodeclass_by_pool=self._nodeclass_by_pool(pools),
+            nodeclass_by_pool=self.cluster.nodeclass_by_pool(pools),
         ):
             if ni in deleted_nodes:
                 continue
@@ -318,7 +318,7 @@ class DisruptionController:
         by_pool: dict[str, list[int]] = {}
         for ni in candidates:
             by_pool.setdefault(ct.nodepool_names[ni], []).append(ni)
-        ncmap = self._nodeclass_by_pool(pools)
+        ncmap = self.cluster.nodeclass_by_pool(pools)
         for pool_name, cand in by_pool.items():
             top = min(
                 len(cand), self.MAX_REPLACE_SET,
@@ -394,14 +394,6 @@ class DisruptionController:
                     )
                 return True
         return False
-
-    def _nodeclass_by_pool(self, pools) -> dict:
-        """pool name -> resolved NodeClass (ephemeral-storage fit rules
-        follow the nodeclass — same map the provisioning solve passes)."""
-        return {
-            name: self.cluster.nodeclasses.get(pool.nodeclass_name)
-            for name, pool in pools.items()
-        }
 
     def _launch_replacement(self, old_claim, type_name: str, offering_options):
         """Launch the cheaper replacement BEFORE disrupting the old node

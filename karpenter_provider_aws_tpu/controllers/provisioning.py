@@ -86,10 +86,7 @@ class ProvisioningController:
                 existing=snapshot_existing_capacity(self.cluster, nominated_map),
                 # per-pool nodeclass: ephemeral-storage capacity follows its
                 # root volume + instanceStorePolicy (types.go:218-244)
-                nodeclass_by_pool={
-                    pool.name: self.cluster.nodeclasses.get(pool.nodeclass_name)
-                    for pool in nodepools
-                },
+                nodeclass_by_pool=self.cluster.nodeclass_by_pool(nodepools),
             )
         from ..metrics import SOLVE_DURATION, SOLVE_PODS
 

@@ -168,7 +168,6 @@ class NodeClass:
     # Reserved EC2 launch context, passed through to the fleet request
     # verbatim (parity: ec2nodeclass.go:116-119 + instance.go:220).
     context: str = ""
-
     status: NodeClassStatus = field(default_factory=NodeClassStatus)
     finalizers: set[str] = field(default_factory=set)
     deleted: bool = False

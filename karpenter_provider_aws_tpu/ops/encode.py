@@ -399,7 +399,6 @@ def invalidate_problem_cache() -> None:
         _PROBLEM_CACHE.clear()
 
 
-
 def effective_capacity(capacity, types, nodeclass):
     """[T, R] allocatable with the EPHEMERAL column following the nodeclass:
     root EBS volume size by default, total instance store under the RAID0

@@ -198,6 +198,18 @@ class Cluster:
         with self._lock:
             return [p for p in self.pods.values() if p.node_name == node_name]
 
+    def nodeclass_by_pool(self, pools) -> dict:
+        """pool name -> resolved NodeClass (or None). The per-pool map the
+        solve and consolidation paths consume: nodeclass ephemeral rules
+        (root volume, instanceStorePolicy) shape per-pool capacity."""
+        items = pools.items() if hasattr(pools, "items") else (
+            (p.name, p) for p in pools
+        )
+        return {
+            name: self.nodeclasses.get(pool.nodeclass_name)
+            for name, pool in items
+        }
+
     def pods_by_node(self) -> dict[str, list[Pod]]:
         """node name -> bound pods, in ONE locked pass over the pod store.
         Callers iterating nodes must use this instead of pods_on_node per
