@@ -57,6 +57,11 @@ class KubeletConfiguration:
     kube_reserved: tuple[tuple[str, str], ...] = ()
     eviction_hard: tuple[tuple[str, str], ...] = ()
     eviction_soft: tuple[tuple[str, str], ...] = ()
+    # signal -> duration string, e.g. ("memory.available", "1m0s")
+    # (parity: bootstrap.go:64 --eviction-soft-grace-period)
+    eviction_soft_grace_period: tuple[tuple[str, str], ...] = ()
+    # parity: bootstrap.go:66-68 --eviction-max-pod-grace-period
+    eviction_max_pod_grace_period: Optional[int] = None
     image_gc_high_threshold_percent: Optional[int] = None
     image_gc_low_threshold_percent: Optional[int] = None
     cpu_cfs_quota: Optional[bool] = None
@@ -75,9 +80,14 @@ class KubeletConfiguration:
             ("--kube-reserved", self.kube_reserved),
             ("--eviction-hard", self.eviction_hard),
             ("--eviction-soft", self.eviction_soft),
+            ("--eviction-soft-grace-period", self.eviction_soft_grace_period),
         ):
             if pairs:
                 args.append(flag + "=" + ",".join(f"{k}={v}" for k, v in pairs))
+        if self.eviction_max_pod_grace_period is not None:
+            args.append(
+                f"--eviction-max-pod-grace-period={self.eviction_max_pod_grace_period}"
+            )
         if self.image_gc_high_threshold_percent is not None:
             args.append(f"--image-gc-high-threshold={self.image_gc_high_threshold_percent}")
         if self.image_gc_low_threshold_percent is not None:

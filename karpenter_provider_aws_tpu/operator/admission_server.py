@@ -56,10 +56,12 @@ def _kubelet(raw) -> Optional[KubeletConfiguration]:
         return None
     kw = {}
     for k in ("max_pods", "pods_per_core", "image_gc_high_threshold_percent",
-              "image_gc_low_threshold_percent", "cpu_cfs_quota"):
+              "image_gc_low_threshold_percent", "cpu_cfs_quota",
+              "eviction_max_pod_grace_period"):
         if k in raw:
             kw[k] = raw[k]
-    for k in ("system_reserved", "kube_reserved", "eviction_hard", "eviction_soft"):
+    for k in ("system_reserved", "kube_reserved", "eviction_hard",
+              "eviction_soft", "eviction_soft_grace_period"):
         if k in raw:
             v = raw[k]
             kw[k] = tuple(sorted(v.items())) if isinstance(v, dict) else tuple(

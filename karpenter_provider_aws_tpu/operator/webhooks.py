@@ -128,6 +128,16 @@ def validate_nodepool(pool: NodePool) -> None:
     for key in pool.labels:
         if key in lbl.RESTRICTED_LABELS or key == lbl.NODEPOOL:
             v.append(f"template label {key} is restricted")
+    # evictionSoft <-> evictionSoftGracePeriod must pair BOTH directions
+    # (parity: the reference CRD's kubelet XValidations — a soft threshold
+    # without a grace period makes the kubelet refuse to start)
+    if pool.kubelet is not None:
+        soft = {k for k, _ in pool.kubelet.eviction_soft}
+        grace = {k for k, _ in pool.kubelet.eviction_soft_grace_period}
+        for k in sorted(soft - grace):
+            v.append(f"evictionSoft {k} has no matching evictionSoftGracePeriod")
+        for k in sorted(grace - soft):
+            v.append(f"evictionSoftGracePeriod {k} has no matching evictionSoft")
     d = pool.disruption
     if d.consolidation_policy not in ("WhenEmpty", "WhenUnderutilized"):
         v.append(f"unknown consolidationPolicy {d.consolidation_policy!r}")

@@ -77,7 +77,10 @@ class ImageFamily:
         # cannot honor fails loudly at resolve time, not silently on-node
         flags = self.feature_flags()
         if kubelet is not None:
-            if kubelet.eviction_soft and not flags.eviction_soft_enabled:
+            if (
+                (kubelet.eviction_soft or kubelet.eviction_soft_grace_period)
+                and not flags.eviction_soft_enabled
+            ):
                 raise ValueError(
                     f"family {self.name} does not support evictionSoft"
                 )

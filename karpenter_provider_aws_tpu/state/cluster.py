@@ -201,14 +201,18 @@ class Cluster:
     def nodeclass_by_pool(self, pools) -> dict:
         """pool name -> resolved NodeClass (or None). The per-pool map the
         solve and consolidation paths consume: nodeclass ephemeral rules
-        (root volume, instanceStorePolicy) shape per-pool capacity."""
-        items = pools.items() if hasattr(pools, "items") else (
-            (p.name, p) for p in pools
-        )
-        return {
-            name: self.nodeclasses.get(pool.nodeclass_name)
-            for name, pool in items
-        }
+        (root volume, instanceStorePolicy) shape per-pool capacity. Locked
+        snapshot like every other Cluster read: callers hand in the LIVE
+        nodepools dict, which mutates under apply() from other threads."""
+        with self._lock:
+            items = list(
+                pools.items() if hasattr(pools, "items")
+                else ((p.name, p) for p in pools)
+            )
+            return {
+                name: self.nodeclasses.get(pool.nodeclass_name)
+                for name, pool in items
+            }
 
     def pods_by_node(self) -> dict[str, list[Pod]]:
         """node name -> bound pods, in ONE locked pass over the pod store.

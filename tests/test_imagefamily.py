@@ -222,3 +222,25 @@ class TestInstanceStorePolicy:
         bad = nodeclass_to_obj(NodeClass(name="a", role="r"))
         bad["spec"]["instanceStorePolicy"] = "RAID5"
         assert validate_object(crd, bad)
+
+
+class TestEvictionGracePeriods:
+    """kubelet evictionSoftGracePeriod / evictionMaxPodGracePeriod flow to
+    the bootstrap args (parity: bootstrap.go:64-68)."""
+
+    def test_grace_period_args(self):
+        from karpenter_provider_aws_tpu.models.nodeclass import (
+            KubeletConfiguration,
+        )
+
+        k = KubeletConfiguration(
+            eviction_soft=(("memory.available", "500Mi"),),
+            eviction_soft_grace_period=(("memory.available", "1m0s"),),
+            eviction_max_pod_grace_period=120,
+        )
+        args = k.extra_args()
+        assert "--eviction-soft=memory.available=500Mi" in args
+        assert "--eviction-soft-grace-period=memory.available=1m0s" in args
+        assert "--eviction-max-pod-grace-period=120" in args
+        script = get_family("standard").bootstrapper(INFO, kubelet=k).script()
+        assert "--eviction-soft-grace-period=memory.available=1m0s" in script

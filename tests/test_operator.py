@@ -268,3 +268,36 @@ class TestMetrics:
             env.cluster.apply(p)
         env.step(2)
         assert sum(NODES_CREATED._values.values()) > before
+
+
+class TestEvictionPairingValidation:
+    """evictionSoft and evictionSoftGracePeriod must pair in BOTH
+    directions (reference CRD kubelet XValidations)."""
+
+    def test_soft_without_grace_rejected(self):
+        import pytest
+
+        from karpenter_provider_aws_tpu.models.nodeclass import (
+            KubeletConfiguration,
+        )
+        from karpenter_provider_aws_tpu.models.nodepool import NodePool
+        from karpenter_provider_aws_tpu.operator.webhooks import (
+            AdmissionError,
+            validate_nodepool,
+        )
+
+        pool = NodePool(name="p", kubelet=KubeletConfiguration(
+            eviction_soft=(("memory.available", "500Mi"),),
+        ))
+        with pytest.raises(AdmissionError, match="evictionSoftGracePeriod"):
+            validate_nodepool(pool)
+        pool2 = NodePool(name="p", kubelet=KubeletConfiguration(
+            eviction_soft_grace_period=(("memory.available", "1m0s"),),
+        ))
+        with pytest.raises(AdmissionError, match="no matching evictionSoft"):
+            validate_nodepool(pool2)
+        paired = NodePool(name="p", kubelet=KubeletConfiguration(
+            eviction_soft=(("memory.available", "500Mi"),),
+            eviction_soft_grace_period=(("memory.available", "1m0s"),),
+        ))
+        validate_nodepool(paired)  # no raise
