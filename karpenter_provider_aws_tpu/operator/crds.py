@@ -504,6 +504,55 @@ def nodepool_crd() -> dict:
                      "message": "expireAfter must be positive"},
                 ],
             },
+            # parity: the core NodePool CRD's kubelet section, including the
+            # evictionSoft <-> evictionSoftGracePeriod pairing XValidations
+            "kubelet": {
+                "type": "object",
+                "properties": {
+                    "maxPods": {"type": "integer", "minimum": 0},
+                    "podsPerCore": {"type": "integer", "minimum": 0},
+                    "clusterDNS": {"type": "array", "items": {"type": "string"}},
+                    "systemReserved": {"type": "object",
+                                       "additionalProperties": {"type": "string"}},
+                    "kubeReserved": {"type": "object",
+                                     "additionalProperties": {"type": "string"}},
+                    "evictionHard": {"type": "object",
+                                     "additionalProperties": {"type": "string"}},
+                    "evictionSoft": {"type": "object",
+                                     "additionalProperties": {"type": "string"}},
+                    "evictionSoftGracePeriod": {
+                        "type": "object",
+                        "additionalProperties": {"type": "string"},
+                    },
+                    "evictionMaxPodGracePeriod": {"type": "integer"},
+                    "imageGCHighThresholdPercent": {
+                        "type": "integer", "minimum": 0, "maximum": 100,
+                    },
+                    "imageGCLowThresholdPercent": {
+                        "type": "integer", "minimum": 0, "maximum": 100,
+                    },
+                    "cpuCFSQuota": {"type": "boolean"},
+                },
+                "x-kubernetes-validations": [
+                    {"rule": "!has(self.evictionSoft) || "
+                             "self.evictionSoft.all(k, "
+                             "has(self.evictionSoftGracePeriod) && "
+                             "k in self.evictionSoftGracePeriod)",
+                     "message": "evictionSoft requires a matching "
+                                "evictionSoftGracePeriod"},
+                    {"rule": "!has(self.evictionSoftGracePeriod) || "
+                             "self.evictionSoftGracePeriod.all(k, "
+                             "has(self.evictionSoft) && k in self.evictionSoft)",
+                     "message": "evictionSoftGracePeriod requires a matching "
+                                "evictionSoft"},
+                    {"rule": "!has(self.imageGCHighThresholdPercent) || "
+                             "!has(self.imageGCLowThresholdPercent) || "
+                             "self.imageGCHighThresholdPercent > "
+                             "self.imageGCLowThresholdPercent",
+                     "message": "imageGCHighThresholdPercent must be greater "
+                                "than imageGCLowThresholdPercent"},
+                ],
+            },
         },
         "x-kubernetes-validations": [
             {"rule": f"!self.labels.exists(k, k in {restricted})",
@@ -594,13 +643,42 @@ def nodepool_to_obj(pool) -> dict:
         if r.min_values is not None:
             row["minValues"] = r.min_values
         reqs.append(row)
-    return {"spec": {
+    spec: dict[str, Any] = {
         "nodeClassRef": {"name": pool.nodeclass_name},
         "weight": pool.weight,
         "labels": dict(pool.labels),
         "requirements": reqs,
         "disruption": d,
-    }}
+    }
+    if pool.kubelet is not None:
+        k = pool.kubelet
+        kd: dict[str, Any] = {}
+        for attr, key in (
+            ("max_pods", "maxPods"),
+            ("pods_per_core", "podsPerCore"),
+            ("eviction_max_pod_grace_period", "evictionMaxPodGracePeriod"),
+            ("image_gc_high_threshold_percent", "imageGCHighThresholdPercent"),
+            ("image_gc_low_threshold_percent", "imageGCLowThresholdPercent"),
+            ("cpu_cfs_quota", "cpuCFSQuota"),
+        ):
+            val = getattr(k, attr)
+            if val is not None:
+                kd[key] = val
+        if k.cluster_dns:
+            kd["clusterDNS"] = list(k.cluster_dns)
+        for attr, key in (
+            ("system_reserved", "systemReserved"),
+            ("kube_reserved", "kubeReserved"),
+            ("eviction_hard", "evictionHard"),
+            ("eviction_soft", "evictionSoft"),
+            ("eviction_soft_grace_period", "evictionSoftGracePeriod"),
+        ):
+            pairs = getattr(k, attr)
+            if pairs:
+                kd[key] = dict(pairs)
+        if kd:
+            spec["kubelet"] = kd
+    return {"spec": spec}
 
 
 def write_crds(outdir) -> list:

@@ -132,12 +132,35 @@ def validate_nodepool(pool: NodePool) -> None:
     # (parity: the reference CRD's kubelet XValidations — a soft threshold
     # without a grace period makes the kubelet refuse to start)
     if pool.kubelet is not None:
-        soft = {k for k, _ in pool.kubelet.eviction_soft}
-        grace = {k for k, _ in pool.kubelet.eviction_soft_grace_period}
+        k8 = pool.kubelet
+        soft = {k for k, _ in k8.eviction_soft}
+        grace = {k for k, _ in k8.eviction_soft_grace_period}
         for k in sorted(soft - grace):
             v.append(f"evictionSoft {k} has no matching evictionSoftGracePeriod")
         for k in sorted(grace - soft):
             v.append(f"evictionSoftGracePeriod {k} has no matching evictionSoft")
+        # range parity with the shipped CRD schema (both directions: what
+        # the webhook admits, the apiserver must accept, and vice versa)
+        if k8.max_pods is not None and k8.max_pods < 0:
+            v.append("kubelet.maxPods must be >= 0")
+        if k8.pods_per_core is not None and k8.pods_per_core < 0:
+            v.append("kubelet.podsPerCore must be >= 0")
+        for name, pct in (
+            ("imageGCHighThresholdPercent", k8.image_gc_high_threshold_percent),
+            ("imageGCLowThresholdPercent", k8.image_gc_low_threshold_percent),
+        ):
+            if pct is not None and not 0 <= pct <= 100:
+                v.append(f"kubelet.{name} must be in [0, 100]")
+        if (
+            k8.image_gc_high_threshold_percent is not None
+            and k8.image_gc_low_threshold_percent is not None
+            and k8.image_gc_high_threshold_percent
+            <= k8.image_gc_low_threshold_percent
+        ):
+            v.append(
+                "kubelet.imageGCHighThresholdPercent must be greater than "
+                "imageGCLowThresholdPercent"
+            )
     d = pool.disruption
     if d.consolidation_policy not in ("WhenEmpty", "WhenUnderutilized"):
         v.append(f"unknown consolidationPolicy {d.consolidation_policy!r}")

@@ -220,3 +220,58 @@ class TestAcceptDirectionParity:
     def test_percentage_budgets_accepted_by_both(self):
         pool = admit(NodePool(name="p", disruption=Disruption(budgets=["33.3%", "7"])))
         assert validate_object(nodepool_crd(), nodepool_to_obj(pool)) == []
+
+
+class TestKubeletCRDSection:
+    """The NodePool CRD's kubelet schema + the pairing XValidations match
+    the webhook (reference: core NodePool CRD kubelet markers)."""
+
+    def _pool(self, **kubelet_kwargs):
+        from karpenter_provider_aws_tpu.models.nodeclass import (
+            KubeletConfiguration,
+        )
+        from karpenter_provider_aws_tpu.models.nodepool import NodePool
+
+        return NodePool(name="p", kubelet=KubeletConfiguration(**kubelet_kwargs))
+
+    def test_paired_eviction_accepted(self):
+        pool = self._pool(
+            eviction_soft=(("memory.available", "500Mi"),),
+            eviction_soft_grace_period=(("memory.available", "1m0s"),),
+            max_pods=110,
+        )
+        assert validate_object(nodepool_crd(), nodepool_to_obj(pool)) == []
+
+    def test_soft_without_grace_rejected_by_both_paths(self):
+        pool = self._pool(eviction_soft=(("memory.available", "500Mi"),))
+        violations = both_reject_nodepool(pool)
+        assert any("evictionSoftGracePeriod" in x for x in violations)
+
+    def test_grace_without_soft_rejected_by_both_paths(self):
+        pool = self._pool(
+            eviction_soft_grace_period=(("memory.available", "1m0s"),)
+        )
+        violations = both_reject_nodepool(pool)
+        assert any("requires a matching" in x for x in violations)
+
+    def test_gc_threshold_ordering_rejected_by_both_paths(self):
+        pool = self._pool(
+            image_gc_high_threshold_percent=10,
+            image_gc_low_threshold_percent=90,
+        )
+        violations = both_reject_nodepool(pool)
+        assert any("imageGCHighThresholdPercent" in x for x in violations)
+
+    def test_negative_max_pods_rejected_by_both_paths(self):
+        both_reject_nodepool(self._pool(max_pods=-1))
+
+    def test_kubelet_round_trips(self):
+        obj = nodepool_to_obj(self._pool(
+            max_pods=58, pods_per_core=4, cluster_dns=("10.0.0.10",),
+            kube_reserved=(("cpu", "100m"),),
+        ))
+        k = obj["spec"]["kubelet"]
+        assert k == {
+            "maxPods": 58, "podsPerCore": 4, "clusterDNS": ["10.0.0.10"],
+            "kubeReserved": {"cpu": "100m"},
+        }
