@@ -94,6 +94,22 @@ class TestAllocatable:
         it = session_catalog.get("c5.large")  # 3 ENIs x 10 IPs -> 3*9+2 = 29
         assert it.eni_limited_pods() == 29
 
+    def test_reserved_enis_shrink_pod_density(self):
+        # --reserved-enis parity (options.go:56, VPC CNI custom networking):
+        # reserved interfaces leave the max-pods math entirely
+        base = CatalogProvider(overhead=OverheadOptions(reserved_enis=0))
+        reserved = CatalogProvider(overhead=OverheadOptions(reserved_enis=1))
+        it = base.get("c5.large")           # 3 ENIs x 10 IPs
+        assert base.allocatable(it).v[PODS] == 29          # 3*9 + 2
+        it_r = reserved.get("c5.large")
+        assert reserved.allocatable(it_r).v[PODS] == 20    # 2*9 + 2
+
+    def test_pods_per_core_caps_density(self):
+        # podsPerCore bounds ENI-derived density (kubelet pods-per-core)
+        p = CatalogProvider(overhead=OverheadOptions(pods_per_core=2))
+        it = p.get("c5.large")              # 2 vCPU -> cap at 4
+        assert p.allocatable(it).v[PODS] == 4
+
 
 class TestOfferings:
     def test_tensor_shapes(self, catalog):
