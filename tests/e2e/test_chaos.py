@@ -2,7 +2,7 @@
 machinery — ICE storms, transient API errors, capacity-pool exhaustion;
 the cluster must converge anyway)."""
 
-from karpenter_provider_aws_tpu.models import Disruption, NodePool, Operator, Requirement
+from karpenter_provider_aws_tpu.models import Disruption, NodePool, Operator, Requirement, Taint
 from karpenter_provider_aws_tpu.models import labels as lbl
 from karpenter_provider_aws_tpu.models.pod import make_pods
 from karpenter_provider_aws_tpu.utils import errors
@@ -90,3 +90,54 @@ class TestChaosE2E:
         assert env.catalog.unavailable.is_unavailable("m5.large", "zone-a", "spot")
         env.clock.advance(181)
         assert not env.catalog.unavailable.is_unavailable("m5.large", "zone-a", "spot")
+
+
+class TestRunawayScaleUp:
+    """Parity: chaos/suite_test.go:73-141 — an adversarial taint-adder
+    poisons every node right after it joins (its pod is evicted and can
+    never re-land there), so provisioning keeps launching while disruption
+    keeps reaping. The guard: the cluster must never accumulate nodes —
+    the loop stays 1-node-in-flight, not a runaway."""
+
+    def _run(self, env, pool, rounds=30, bound=6):
+        env.apply_defaults(pool)
+        for p in make_pods(1, "app", {"cpu": "1", "memory": "2Gi"}):
+            env.cluster.apply(p)
+        poisoned = set()
+        for _ in range(rounds):
+            env.step(1)
+            env.clock.advance(45.0)
+            for node in list(env.cluster.nodes.values()):
+                if node.name in poisoned or not node.ready:
+                    continue
+                # the taint-adder: NoExecute-style poison + evict its pods
+                node.taints = list(node.taints) + [
+                    Taint(key="test", value="true", effect="NoExecute")
+                ]
+                poisoned.add(node.name)
+                for pod in env.cluster.pods_on_node(node.name):
+                    pod.node_name = ""
+                    pod.phase = "Pending"
+            assert len(env.cluster.nodes) < bound, (
+                f"runaway: {len(env.cluster.nodes)} nodes"
+            )
+
+    def test_no_runaway_with_consolidation(self, env):
+        self._run(env, NodePool(
+            name="default",
+            requirements=[Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m", "r"))],
+            disruption=Disruption(
+                budgets=["100%"], consolidation_policy="WhenUnderutilized",
+                consolidate_after_s=0.0,
+            ),
+        ))
+
+    def test_no_runaway_with_emptiness(self, env):
+        self._run(env, NodePool(
+            name="default",
+            requirements=[Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m", "r"))],
+            disruption=Disruption(
+                budgets=["100%"], consolidation_policy="WhenEmpty",
+                consolidate_after_s=30.0,
+            ),
+        ))
