@@ -93,7 +93,7 @@ def bench_sharded_screen(n_nodes=5000, iters=3) -> dict:
     }
 
 
-def partition_evidence(n_nodes=2000, num_pods=10_000) -> dict:
+def partition_evidence(n_nodes=2000, num_pods=10_000, devices=None) -> dict:
     """Compiler-level proof that the sharded programs divide the work.
 
     Wall-clock on a virtual CPU mesh cannot show a speedup (all D "devices"
@@ -151,8 +151,8 @@ def partition_evidence(n_nodes=2000, num_pods=10_000) -> dict:
             ca = ca[0]
         return float(ca.get("flops", 0.0))
 
-    mesh = make_mesh(N_DEVICES)
-    D = N_DEVICES
+    D = devices or N_DEVICES
+    mesh = make_mesh(D)
 
     # --- screen: FLOP partition + no communication -----------------------
     env = _synth_cluster(n_nodes=n_nodes)
