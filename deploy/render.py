@@ -13,7 +13,7 @@ import re
 import sys
 
 HERE = pathlib.Path(__file__).resolve().parent
-MANIFESTS = ("rbac.yaml", "deployment.yaml", "pdb-and-service.yaml")
+MANIFESTS = ("rbac.yaml", "deployment.yaml", "pdb-and-service.yaml", "webhooks.yaml")
 
 
 def load_values(path: pathlib.Path) -> dict[str, str]:
@@ -53,6 +53,57 @@ def _import_crds():
     return crds
 
 
+def webhook_cert_values(service: str = "karpenter-tpu",
+                        namespace: str = "karpenter") -> dict[str, str]:
+    """Generate the webhook serving cert at render time: a fresh
+    self-signed pair whose SAN covers the webhook Service DNS names, plus
+    the caBundle the registrations embed — so the rendered manifests work
+    as applied with no external cert manager (the reference instead runs a
+    knative cert injector at runtime; re-render to rotate here)."""
+    import base64
+    import datetime
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME,
+                                         f"{service}.{namespace}.svc")])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name).issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=3650))
+        .add_extension(x509.SubjectAlternativeName([
+            x509.DNSName(service),
+            x509.DNSName(f"{service}.{namespace}"),
+            x509.DNSName(f"{service}.{namespace}.svc"),
+            x509.DNSName(f"{service}.{namespace}.svc.cluster.local"),
+        ]), critical=False)
+        .add_extension(x509.BasicConstraints(ca=True, path_length=None),
+                       critical=True)
+        .sign(key, hashes.SHA256())
+    )
+    cert_pem = cert.public_bytes(serialization.Encoding.PEM)
+    key_pem = key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.TraditionalOpenSSL,
+        serialization.NoEncryption(),
+    )
+    b64 = lambda b: base64.b64encode(b).decode()  # noqa: E731
+    return {
+        "webhookCertData": b64(cert_pem),
+        "webhookKeyData": b64(key_pem),
+        # self-signed: the serving cert IS the trust anchor
+        "webhookCaBundle": b64(cert_pem),
+    }
+
+
 def _crd_docs() -> list[str]:
     """CRD artifacts with the admission rules encoded (parity: the
     reference bundles pkg/apis/crds/ into its chart). JSON is valid YAML,
@@ -72,6 +123,7 @@ def main() -> int:
     ap.add_argument("--out", default="-", help="'-' for stdout, else a directory")
     args = ap.parse_args()
     values = load_values(pathlib.Path(args.values))
+    values.update(webhook_cert_values())
     docs = [render((HERE / m).read_text(), values) for m in MANIFESTS]
     if args.out == "-":
         sys.stdout.write("\n---\n".join(_crd_docs() + docs))

@@ -131,11 +131,70 @@ def nodepool_from_dict(data: dict) -> NodePool:
 _KINDS = {"NodeClass": nodeclass_from_dict, "NodePool": nodepool_from_dict}
 
 
+def review_admission_review(body: dict) -> dict:
+    """A REAL apiserver's AdmissionReview v1 envelope (what the chart's
+    webhook registration routes here): ``{apiVersion: admission.k8s.io/v1,
+    kind: AdmissionReview, request: {uid, kind: {kind}, object: {...}}}``.
+    The embedded object is the CRD wire shape (camelCase spec), so it runs
+    the CRD schema + CEL gate first, then the admission chain; the reply
+    carries the required ``.response.uid`` and, for defaulting, a JSONPatch
+    (``patchType: JSONPatch``, base64) replacing the spec — the envelope the
+    apiserver demands of both Mutating and Validating configurations."""
+    import base64
+
+    from . import crds
+    from .manifests import admit_wire_object
+
+    request = body.get("request") or {}
+    uid = request.get("uid", "")
+
+    def deny(*messages: str) -> dict:
+        return {
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "response": {
+                "uid": uid,
+                "allowed": False,
+                "status": {"message": "; ".join(messages) or "denied"},
+            },
+        }
+
+    kind = (request.get("kind") or {}).get("kind", "")
+    raw = request.get("object") or {}
+    # ONE shared gate with manifest ingestion (schema + CEL + defaulting +
+    # validation) so the wire path and examples/ loading can never diverge
+    admitted, violations = admit_wire_object(kind, raw)
+    if violations:
+        return deny(*violations)
+    defaulted_spec = (
+        crds.nodeclass_to_obj(admitted)
+        if kind == "NodeClass"
+        else crds.nodepool_to_obj(admitted)
+    )["spec"]
+    patch = json.dumps(
+        [{"op": "replace" if "spec" in raw else "add",
+          "path": "/spec", "value": defaulted_spec}]
+    ).encode()
+    return {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "response": {
+            "uid": uid,
+            "allowed": True,
+            "patchType": "JSONPatch",
+            "patch": base64.b64encode(patch).decode(),
+        },
+    }
+
+
 def review(body: dict) -> dict:
     """One admission review: parse -> default -> validate -> re-serialize.
     Never raises: every failure mode is a violations response (this is the
     network boundary; callers can't catch Python exceptions)."""
     kind = body.get("kind", "")
+    if kind == "AdmissionReview":
+        # apiserver envelope: full CRD-schema + admission path, enveloped reply
+        return review_admission_review(body)
     parser = _KINDS.get(kind)
     if parser is None:
         return {"allowed": False, "violations": [f"unknown kind {kind!r}"]}
@@ -169,7 +228,10 @@ class AdmissionServer:
     def __init__(self):
         self._http: Optional[ThreadingHTTPServer] = None
 
-    def serve(self, port: int = 0) -> int:
+    def serve(self, port: int = 0, tls_dir: str = "") -> int:
+        """``tls_dir`` holding tls.crt/tls.key (a mounted kubernetes.io/tls
+        Secret, e.g. karpenter-tpu-cert) serves HTTPS — required when the
+        apiserver routes to us via the chart's webhook Service."""
         from ..utils.httpserve import QuietHandler, serve_http
 
         class Handler(QuietHandler):
@@ -191,8 +253,12 @@ class AdmissionServer:
                     result = {"allowed": False, "violations": [f"bad request: {e}"]}
                 self.reply(200, json.dumps(result).encode(), "application/json")
 
-        self._http = serve_http(Handler, port)  # pod-IP reachable: the apiserver calls in over the network
-        log.info("admission server on 127.0.0.1:%d/admit", self._http.server_address[1])
+        # pod-IP reachable: the apiserver calls in over the network
+        self._http = serve_http(Handler, port, tls_dir=tls_dir)
+        log.info(
+            "admission server on :%d/admit (%s)",
+            self._http.server_address[1], "https" if tls_dir else "http",
+        )
         return self._http.server_address[1]
 
     def stop(self) -> None:

@@ -226,9 +226,19 @@ class _Cel:
         return lambda env: env[name]
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=512)
+def _compile_rule(rule: str):
+    """Rules are static strings compiled to closures; caching makes the
+    admission hot path re-use them instead of re-tokenizing every rule on
+    every apiserver write (advisor round-5)."""
+    return _Cel(_tokenize(rule)).expr()
+
+
 def cel_eval(rule: str, self_value) -> bool:
-    program = _Cel(_tokenize(rule)).expr()
-    return bool(program({"self": self_value}))
+    return bool(_compile_rule(rule)({"self": self_value}))
 
 
 # ---------------------------------------------------------------------------
@@ -319,14 +329,36 @@ def _selector_term_schema() -> dict:
             "name": {"type": "string"},
             "tags": {"type": "object", "additionalProperties": {"type": "string"}},
         },
+        # Every rule guards optional fields with has(): CEL field access on
+        # an absent field ERRORS (apiserver and this evaluator agree), and a
+        # rule error rejects the object — an unguarded rule would reject
+        # valid manifests that simply omit the field.
         "x-kubernetes-validations": [
-            {"rule": "self.id != '' || self.name != '' || size(self.tags) > 0",
+            {"rule": "(has(self.id) && self.id != '') || "
+                     "(has(self.name) && self.name != '') || "
+                     "(has(self.tags) && size(self.tags) > 0)",
              "message": "terms must set id, name, or tags"},
-            {"rule": "self.id == '' || (self.name == '' && size(self.tags) == 0)",
+            {"rule": "!(has(self.id) && self.id != '') || "
+                     "(!(has(self.name) && self.name != '') && "
+                     "(!has(self.tags) || size(self.tags) == 0))",
              "message": "'id' is mutually exclusive with other fields"},
-            {"rule": "!self.tags.exists(k, k == '' || self.tags[k] == '')",
+            {"rule": "!has(self.tags) || "
+                     "!self.tags.exists(k, k == '' || self.tags[k] == '')",
              "message": "empty tag keys or values aren't supported"},
         ],
+    }
+
+
+def _taint_schema() -> dict:
+    return {
+        "type": "object",
+        "required": ["key", "effect"],
+        "properties": {
+            "key": {"type": "string", "pattern": r"."},  # non-empty
+            "value": {"type": "string"},
+            "effect": {"type": "string",
+                       "enum": ["NoSchedule", "PreferNoSchedule", "NoExecute"]},
+        },
     }
 
 
@@ -372,6 +404,10 @@ def nodeclass_crd() -> dict:
             "imageSelectorTerms": {
                 "type": "array", "maxItems": 30, "items": _selector_term_schema(),
             },
+            # ODCR discovery terms (parity: capacityReservationSelectorTerms)
+            "capacityReservationSelectorTerms": {
+                "type": "array", "maxItems": 30, "items": _selector_term_schema(),
+            },
             "blockDeviceMappings": {
                 "type": "array", "maxItems": 50,
                 "items": {
@@ -403,21 +439,29 @@ def nodeclass_crd() -> dict:
             "associatePublicIPAddress": {"type": "boolean"},
             "context": {"type": "string"},
         },
+        # has()-guarded throughout: unguarded access to an absent optional
+        # field errors (apiserver semantics) and would reject valid objects
         "x-kubernetes-validations": [
-            {"rule": "(self.role != '') != (self.instanceProfile != '')",
+            {"rule": "(has(self.role) && self.role != '') != "
+                     "(has(self.instanceProfile) && self.instanceProfile != '')",
              "message": "exactly one of role or instanceProfile is required"},
-            {"rule": "self.imageFamily != 'custom' || size(self.imageSelectorTerms) > 0",
+            {"rule": "!has(self.imageFamily) || self.imageFamily != 'custom' || "
+                     "(has(self.imageSelectorTerms) && size(self.imageSelectorTerms) > 0)",
              "message": "imageFamily custom requires imageSelector terms"},
-            {"rule": "self.imageFamily != 'custom' || self.userData != ''",
+            {"rule": "!has(self.imageFamily) || self.imageFamily != 'custom' || "
+                     "(has(self.userData) && self.userData != '')",
              "message": "imageFamily custom requires userData"},
-            {"rule": "!self.tags.exists(k, k == '')",
+            {"rule": "!has(self.tags) || !self.tags.exists(k, k == '')",
              "message": "empty tag keys aren't supported"},
-            {"rule": "!self.tags.exists(k, k.startsWith('kubernetes.io/cluster'))",
+            {"rule": "!has(self.tags) || "
+                     "!self.tags.exists(k, k.startsWith('kubernetes.io/cluster'))",
              "message": "tag matches restricted prefix kubernetes.io/cluster/"},
-            {"rule": f"!self.tags.exists(k, k.startsWith('{lbl.GROUP}/'))",
+            {"rule": f"!has(self.tags) || "
+                     f"!self.tags.exists(k, k.startsWith('{lbl.GROUP}/'))",
              "message": f"tags may not use the {lbl.GROUP}/ namespace"},
-            {"rule": "!self.blockDeviceMappings.exists(b, b.rootVolume) || "
-                     "self.blockDeviceMappings.exists_one(b, b.rootVolume)",
+            {"rule": "!has(self.blockDeviceMappings) || "
+                     "!self.blockDeviceMappings.exists(b, has(b.rootVolume) && b.rootVolume) || "
+                     "self.blockDeviceMappings.exists_one(b, has(b.rootVolume) && b.rootVolume)",
              "message": "must have only one blockDeviceMappings with rootVolume"},
         ],
     }
@@ -436,11 +480,16 @@ def nodepool_crd() -> dict:
                 "type": "object",
                 "properties": {"name": {"type": "string"}},
                 "x-kubernetes-validations": [
-                    {"rule": "self.name != ''", "message": "nodeClassRef is required"},
+                    {"rule": "has(self.name) && self.name != ''",
+                     "message": "nodeClassRef is required"},
                 ],
             },
             "weight": {"type": "integer"},
             "labels": {"type": "object", "additionalProperties": {"type": "string"}},
+            # parity: core NodePool.spec.limits — resource-name -> quantity
+            "limits": {"type": "object", "additionalProperties": {"type": "string"}},
+            "taints": {"type": "array", "items": _taint_schema()},
+            "startupTaints": {"type": "array", "items": _taint_schema()},
             "requirements": {
                 "type": "array",
                 "items": {
@@ -555,7 +604,7 @@ def nodepool_crd() -> dict:
             },
         },
         "x-kubernetes-validations": [
-            {"rule": f"!self.labels.exists(k, k in {restricted})",
+            {"rule": f"!has(self.labels) || !self.labels.exists(k, k in {restricted})",
              "message": "template label is restricted"},
         ],
     }
@@ -582,6 +631,7 @@ def nodeclass_to_obj(nc) -> dict:
         "subnetSelectorTerms": _terms(nc.subnet_selector),
         "securityGroupSelectorTerms": _terms(nc.security_group_selector),
         "imageSelectorTerms": _terms(nc.image_selector),
+        "capacityReservationSelectorTerms": _terms(nc.capacity_reservation_selector),
         "blockDeviceMappings": [
             {
                 "deviceName": bd.device_name,
@@ -650,6 +700,14 @@ def nodepool_to_obj(pool) -> dict:
         "requirements": reqs,
         "disruption": d,
     }
+    for attr, key in (("taints", "taints"), ("startup_taints", "startupTaints")):
+        ts = getattr(pool, attr)
+        if ts:
+            spec[key] = [
+                {"key": t.key, "value": t.value, "effect": t.effect} for t in ts
+            ]
+    if not pool.limits.unlimited:
+        spec["limits"] = pool.limits.resources.to_quantities()
     if pool.kubelet is not None:
         k = pool.kubelet
         kd: dict[str, Any] = {}

@@ -1,0 +1,393 @@
+"""Manifest ingestion: k8s wire-shape YAML -> validated model objects.
+
+The inverse of ``crds.nodeclass_to_obj`` / ``crds.nodepool_to_obj`` — the
+operator's CR-ingestion path. The reference gets this for free from
+controller-runtime's scheme decoding (``cmd/controller/main.go:32-73``
+registers the typed ``pkg/apis/v1beta1`` structs); here the decode is
+explicit, and every document passes the SAME two gates an apiserver-routed
+object passes:
+
+ 1. CRD structural schema + CEL XValidations (``crds.validate_object`` —
+    what the apiserver enforces from ``pkg/apis/crds/*.yaml``), then
+ 2. the admission chain (``webhooks.admit`` = defaulting + validation,
+    parity ``pkg/webhooks/webhooks.go:30-60``).
+
+Workload documents (Pod / Deployment) decode into solver ``Pod`` models —
+the analogue of the scheduler watching pending pods. Used by ``examples/``
+loading, tests, and any host embedding the framework without a live
+apiserver.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Union
+
+from ..models.nodeclass import (
+    BlockDevice,
+    KubeletConfiguration,
+    MetadataOptions,
+    NodeClass,
+    SelectorTerm,
+)
+from ..models.nodepool import Budget, Disruption, Limits, NodePool, Taint
+from ..models.pod import (
+    Pod,
+    PodAffinityTerm,
+    Toleration,
+    TopologySpreadConstraint,
+)
+from ..models.requirements import Operator, Requirement
+from ..models.resources import ResourceVector
+from . import crds
+from .webhooks import admit
+
+API_VERSION = f"{crds.API_GROUP}/v1"
+
+
+class ManifestError(ValueError):
+    """A document failed schema validation, admission, or decoding."""
+
+
+def load_documents(text: str) -> list[dict]:
+    """YAML stream -> list of non-empty documents."""
+    import yaml
+
+    return [d for d in yaml.safe_load_all(text) if d]
+
+
+# -- wire -> model decoders --------------------------------------------------
+
+def _terms_from(raw) -> list[SelectorTerm]:
+    out = []
+    for t in raw or ():
+        out.append(SelectorTerm(
+            tags=tuple(sorted((t.get("tags") or {}).items())),
+            id=t.get("id", ""),
+            name=t.get("name", ""),
+        ))
+    return out
+
+
+def _taints_from(raw) -> list[Taint]:
+    return [
+        Taint(key=t["key"], value=t.get("value", ""),
+              effect=t.get("effect", "NoSchedule"))
+        for t in raw or ()
+    ]
+
+
+def _requirements_from(raw) -> list[Requirement]:
+    return [
+        Requirement(
+            key=r["key"],
+            operator=Operator(r["operator"]),
+            values=tuple(str(v) for v in r.get("values") or ()),
+            min_values=r.get("minValues"),
+        )
+        for r in raw or ()
+    ]
+
+
+_KUBELET_KEYS = (
+    ("maxPods", "max_pods"),
+    ("podsPerCore", "pods_per_core"),
+    ("evictionMaxPodGracePeriod", "eviction_max_pod_grace_period"),
+    ("imageGCHighThresholdPercent", "image_gc_high_threshold_percent"),
+    ("imageGCLowThresholdPercent", "image_gc_low_threshold_percent"),
+    ("cpuCFSQuota", "cpu_cfs_quota"),
+)
+_KUBELET_MAPS = (
+    ("systemReserved", "system_reserved"),
+    ("kubeReserved", "kube_reserved"),
+    ("evictionHard", "eviction_hard"),
+    ("evictionSoft", "eviction_soft"),
+    ("evictionSoftGracePeriod", "eviction_soft_grace_period"),
+)
+
+
+def _kubelet_from(raw) -> KubeletConfiguration:
+    kw = {}
+    for wire, attr in _KUBELET_KEYS:
+        if wire in raw:
+            kw[attr] = raw[wire]
+    for wire, attr in _KUBELET_MAPS:
+        if wire in raw:
+            kw[attr] = tuple(sorted(raw[wire].items()))
+    if "clusterDNS" in raw:
+        kw["cluster_dns"] = tuple(raw["clusterDNS"])
+    return KubeletConfiguration(**kw)
+
+
+def nodepool_from_obj(obj: dict, name: str = "") -> NodePool:
+    """{spec: ...} wire shape -> NodePool (inverse of nodepool_to_obj).
+
+    Absent optional wire fields take model defaults; ``consolidateAfter`` /
+    ``expireAfter`` absent means the model default (0 / Never respectively),
+    matching what ``nodepool_to_obj`` omits."""
+    spec = obj.get("spec") or {}
+    kw: dict = {"name": name or _meta_name(obj)}
+    if "nodeClassRef" in spec:
+        kw["nodeclass_name"] = spec["nodeClassRef"].get("name", "default")
+    for wire, attr in (("weight", "weight"), ("labels", "labels")):
+        if wire in spec:
+            kw[attr] = spec[wire]
+    kw["requirements"] = _requirements_from(spec.get("requirements"))
+    kw["taints"] = _taints_from(spec.get("taints"))
+    kw["startup_taints"] = _taints_from(spec.get("startupTaints"))
+    if spec.get("limits"):
+        kw["limits"] = Limits(
+            resources=ResourceVector.from_map(spec["limits"]), unlimited=False
+        )
+    d = spec.get("disruption")
+    if d:
+        dkw: dict = {}
+        if "consolidationPolicy" in d:
+            dkw["consolidation_policy"] = d["consolidationPolicy"]
+        if "consolidateAfter" in d:
+            dkw["consolidate_after_s"] = d["consolidateAfter"]
+        if "expireAfter" in d:
+            dkw["expire_after_s"] = d["expireAfter"]
+        if "budgets" in d:
+            dkw["budgets"] = [
+                Budget(
+                    nodes=str(b.get("nodes", "10%")),
+                    reasons=tuple(b.get("reasons") or ()),
+                    schedule=b.get("schedule"),
+                    duration_s=b.get("duration"),
+                )
+                for b in d["budgets"]
+            ]
+        kw["disruption"] = Disruption(**dkw)
+    if spec.get("kubelet"):
+        kw["kubelet"] = _kubelet_from(spec["kubelet"])
+    return NodePool(**kw)
+
+
+def nodeclass_from_obj(obj: dict, name: str = "") -> NodeClass:
+    """{spec: ...} wire shape -> NodeClass (inverse of nodeclass_to_obj)."""
+    spec = obj.get("spec") or {}
+    kw: dict = {"name": name or _meta_name(obj)}
+    for wire, attr in (
+        ("role", "role"),
+        ("instanceProfile", "instance_profile"),
+        ("imageFamily", "image_family"),
+        ("userData", "user_data"),
+        ("tags", "tags"),
+        ("detailedMonitoring", "detailed_monitoring"),
+        ("associatePublicIPAddress", "associate_public_ip"),
+        ("context", "context"),
+        ("instanceStorePolicy", "instance_store_policy"),
+    ):
+        if wire in spec and spec[wire] is not None:
+            kw[attr] = spec[wire]
+    for wire, attr in (
+        ("imageSelectorTerms", "image_selector"),
+        ("subnetSelectorTerms", "subnet_selector"),
+        ("securityGroupSelectorTerms", "security_group_selector"),
+        ("capacityReservationSelectorTerms", "capacity_reservation_selector"),
+    ):
+        if wire in spec:
+            kw[attr] = _terms_from(spec[wire])
+    if "blockDeviceMappings" in spec:
+        kw["block_devices"] = [
+            BlockDevice(
+                device_name=bd.get("deviceName", "/dev/xvda"),
+                volume_size_gib=bd.get("volumeSizeGiB", 20),
+                volume_type=bd.get("volumeType", "gp3"),
+                root_volume=bd.get("rootVolume", False),
+                encrypted=bd.get("encrypted", True),
+            )
+            for bd in spec["blockDeviceMappings"]
+        ]
+    if "metadataOptions" in spec:
+        mo = spec["metadataOptions"]
+        kw["metadata_options"] = MetadataOptions(**{
+            attr: mo[wire]
+            for wire, attr in (
+                ("httpEndpoint", "http_endpoint"),
+                ("httpProtocolIPv6", "http_protocol_ipv6"),
+                ("httpPutResponseHopLimit", "http_put_response_hop_limit"),
+                ("httpTokens", "http_tokens"),
+            )
+            if wire in mo
+        })
+    return NodeClass(**kw)
+
+
+# -- workload decoding -------------------------------------------------------
+
+def _pod_from_podspec(name: str, podspec: dict, labels: dict,
+                      replicas: int = 1, owner_key: str = "") -> list[Pod]:
+    # container requests sum into the pod's effective request; summed in
+    # axis units (ResourceVector addition), NOT by re-parsing quantities
+    requests = ResourceVector()
+    for c in podspec.get("containers") or ():
+        requests = requests + ResourceVector.from_map(
+            (c.get("resources") or {}).get("requests") or {}
+        )
+    tolerations = [
+        Toleration(
+            key=t.get("key", ""), operator=t.get("operator", "Equal"),
+            value=t.get("value", ""), effect=t.get("effect", ""),
+        )
+        for t in podspec.get("tolerations") or ()
+    ]
+    spread = [
+        TopologySpreadConstraint(
+            topology_key=t["topologyKey"],
+            max_skew=t.get("maxSkew", 1),
+            when_unsatisfiable=t.get("whenUnsatisfiable", "DoNotSchedule"),
+            label_selector=(t.get("labelSelector") or {}).get("matchLabels", {}),
+        )
+        for t in podspec.get("topologySpreadConstraints") or ()
+    ]
+    affinity = podspec.get("affinity") or {}
+
+    def _pod_terms(section: str) -> list[PodAffinityTerm]:
+        sec = affinity.get(section) or {}
+        return [
+            PodAffinityTerm(
+                topology_key=t["topologyKey"],
+                label_selector=(t.get("labelSelector") or {}).get("matchLabels", {}),
+            )
+            for t in sec.get("requiredDuringSchedulingIgnoredDuringExecution") or ()
+        ]
+
+    node_affinity: list[Requirement] = []
+    preferred: list[Requirement] = []
+    na = affinity.get("nodeAffinity") or {}
+    req_terms = (na.get("requiredDuringSchedulingIgnoredDuringExecution") or {}
+                 ).get("nodeSelectorTerms") or ()
+    for term in req_terms:
+        node_affinity += _requirements_from(
+            [{**e, "minValues": None} for e in term.get("matchExpressions") or ()]
+        )
+    for pref in na.get("preferredDuringSchedulingIgnoredDuringExecution") or ():
+        preferred += _requirements_from(
+            [{**e, "minValues": None}
+             for e in (pref.get("preference") or {}).get("matchExpressions") or ()]
+        )
+    out = []
+    for i in range(replicas):
+        out.append(Pod(
+            name=f"{name}-{i}" if replicas > 1 else name,
+            labels=dict(labels),
+            # a fresh vector per replica: Pod.__post_init__ mutates it
+            requests=ResourceVector(requests.v.copy()),
+            node_selector=dict(podspec.get("nodeSelector") or {}),
+            node_affinity=list(node_affinity),
+            preferred_node_affinity=list(preferred),
+            tolerations=list(tolerations),
+            topology_spread=list(spread),
+            anti_affinity=_pod_terms("podAntiAffinity"),
+            affinity=_pod_terms("podAffinity"),
+            owner_key=owner_key,
+        ))
+    return out
+
+
+def pods_from_workload(doc: dict) -> list[Pod]:
+    """Pod or Deployment manifest -> solver Pod models (replicas expanded)."""
+    kind = doc.get("kind")
+    name = _meta_name(doc)
+    if kind == "Pod":
+        return _pod_from_podspec(
+            name, doc.get("spec") or {},
+            (doc.get("metadata") or {}).get("labels") or {},
+        )
+    if kind == "Deployment":
+        spec = doc.get("spec") or {}
+        template = spec.get("template") or {}
+        return _pod_from_podspec(
+            name,
+            template.get("spec") or {},
+            (template.get("metadata") or {}).get("labels") or {},
+            replicas=spec.get("replicas", 1),
+            owner_key=f"deployment/{name}",
+        )
+    raise ManifestError(f"unsupported workload kind {kind!r}")
+
+
+# -- the validated load path -------------------------------------------------
+
+def _meta_name(doc: dict) -> str:
+    return (doc.get("metadata") or {}).get("name") or doc.get("name") or ""
+
+
+# The CRD dicts are pure functions of static code; the admission hot path
+# must not rebuild the whole nested schema per apiserver write. Callers of
+# these cached copies treat them as read-only.
+_CRD_CACHE: dict[str, dict] = {}
+
+
+def cached_crd(kind: str) -> dict:
+    crd = _CRD_CACHE.get(kind)
+    if crd is None:
+        crd = _CRD_CACHE[kind] = (
+            crds.nodeclass_crd() if kind == "NodeClass" else crds.nodepool_crd()
+        )
+    return crd
+
+
+def admit_wire_object(kind: str, raw: dict) -> tuple[object, list[str]]:
+    """THE wire-admission gate, shared by manifest loading and the webhook
+    envelope path: CRD structural schema + CEL -> decode -> defaulting +
+    validation. Returns (admitted_object, []) or (None, violations)."""
+    if kind not in ("NodeClass", "NodePool"):
+        return None, [f"unsupported kind {kind!r}"]
+    violations = crds.validate_object(cached_crd(kind), {"spec": raw.get("spec") or {}})
+    if violations:
+        return None, violations
+    try:
+        obj = (nodeclass_from_obj if kind == "NodeClass" else nodepool_from_obj)(raw)
+        return admit(obj), []
+    except Exception as e:
+        msgs = list(getattr(e, "violations", ())) or [f"malformed object: {e}"]
+        return None, msgs
+
+
+def load_object(doc: dict) -> Union[NodeClass, NodePool, list[Pod]]:
+    """One document through the full gate: CRD schema -> decode -> admission.
+
+    Raises ManifestError listing every violation (schema violations and
+    admission violations use the same channel, like an apiserver reply)."""
+    kind = doc.get("kind")
+    if kind in ("Pod", "Deployment"):
+        return pods_from_workload(doc)
+    if kind not in ("NodeClass", "NodePool"):
+        raise ManifestError(f"unsupported kind {kind!r}")
+    api = doc.get("apiVersion")
+    if api != API_VERSION:
+        raise ManifestError(f"{kind} {_meta_name(doc)!r}: apiVersion {api!r} "
+                            f"(want {API_VERSION})")
+    obj, violations = admit_wire_object(kind, doc)
+    if violations:
+        raise ManifestError(
+            f"{kind} {_meta_name(doc)!r}: " + "; ".join(violations)
+        )
+    return obj
+
+
+def load_manifest(text: str) -> list:
+    """A whole YAML stream through load_object, in document order."""
+    return [load_object(d) for d in load_documents(text)]
+
+
+def iter_example_files(examples_dir) -> Iterable:
+    import pathlib
+
+    root = pathlib.Path(examples_dir)
+    return sorted(p for p in root.rglob("*.yaml") if p.is_file())
+
+
+__all__ = [
+    "API_VERSION",
+    "ManifestError",
+    "load_documents",
+    "load_manifest",
+    "load_object",
+    "nodeclass_from_obj",
+    "nodepool_from_obj",
+    "pods_from_workload",
+    "iter_example_files",
+]

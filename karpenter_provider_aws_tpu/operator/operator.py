@@ -85,7 +85,10 @@ class Operator:
             from .admission_server import AdmissionServer
 
             self.admission = AdmissionServer()
-            self.admission_port = self.admission.serve(self.options.admission_port)
+            self.admission_port = self.admission.serve(
+                self.options.admission_port,
+                tls_dir=self.options.admission_tls_dir,
+            )
         self.manager.start()
 
     def stop(self) -> None:
@@ -151,13 +154,30 @@ def new_operator(
         enable_compilation_cache(options.compilation_cache_dir)
     profiler = Profiler(options.profile_dir)
     if cloud is None:
-        # hermetic default: any object satisfying cloudprovider.backend
-        # .CloudBackend slots in here; the in-memory double is the only
-        # backend baked into this repo (parity: the reference's tier-1
-        # strategy — real clouds are adapters injected at this seam)
-        from ..fake import FakeCloud
+        if options.cloud_backend == "aws":
+            # production wiring (operator.go:92-106): one signed session —
+            # credential chain, optional STS assume-role, retryer,
+            # user-agent — behind the CloudBackend Protocol
+            from ..providers.aws import AwsCloudBackend, Session
 
-        cloud = FakeCloud(clock=clock)
+            session = Session(
+                region=options.aws_region,
+                assume_role_arn=options.assume_role_arn,
+            )
+            cloud = AwsCloudBackend(session, cluster_name=options.cluster_name)
+            if queue is None and options.interruption_queue:
+                from ..providers.aws import SqsQueueProvider
+
+                queue = SqsQueueProvider.from_queue_name(
+                    session, options.interruption_queue
+                )
+        else:
+            # hermetic default: any object satisfying cloudprovider.backend
+            # .CloudBackend slots in here (parity: the reference's tier-1
+            # strategy — real clouds are adapters injected at this seam)
+            from ..fake import FakeCloud
+
+            cloud = FakeCloud(clock=clock)
 
     # Cloud-connectivity preflight FIRST (parity: operator.go:205-212
     # CheckEC2Connectivity's dry-run DescribeInstanceTypes): a broken

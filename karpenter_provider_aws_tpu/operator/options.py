@@ -38,6 +38,9 @@ class Options:
     max_nodes_per_solve: int = 0                 # 0 = auto bucket
     metrics_port: int = 8080                     # 0 = disabled
     admission_port: int = 0                      # webhook-server analogue; 0 = disabled
+    # dir with tls.crt/tls.key (mounted kubernetes.io/tls Secret); non-empty
+    # serves the admission endpoint over HTTPS, as the apiserver requires
+    admission_tls_dir: str = ""
     drift_enabled: bool = True
     feature_gates: str = ""                      # "Drift=true,SpotToSpot=false"
     log_level: str = "INFO"
@@ -60,6 +63,14 @@ class Options:
     # the device tensors (the encode also honors the raw
     # KARPENTER_TPU_PRUNE_TYPES env var for non-operator callers)
     prune_types: bool = True
+    # which cloud backend to wire when none is injected: the in-memory
+    # fake (hermetic default) or the production AWS adapter
+    # (providers/aws/, signed stdlib clients)
+    cloud_backend: str = "fake"                  # fake | aws
+    # STS assume-role for the AWS backend (operator.go:96-100 parity;
+    # base credentials then only ever sign AssumeRole)
+    assume_role_arn: str = ""
+    aws_region: str = ""                         # "" = AWS_REGION env
 
     @staticmethod
     def from_env_and_args(argv: Optional[list[str]] = None) -> "Options":
@@ -94,6 +105,8 @@ class Options:
             raise ValueError("batch windows must satisfy 0 < idle <= max")
         if self.ip_family not in ("ipv4", "ipv6"):
             raise ValueError(f"ip-family must be ipv4 or ipv6, got {self.ip_family!r}")
+        if self.cloud_backend not in ("fake", "aws"):
+            raise ValueError(f"unknown cloud backend {self.cloud_backend!r}")
 
     def gate(self, name: str, default: bool = True) -> bool:
         for pair in self.feature_gates.split(","):

@@ -1,0 +1,290 @@
+"""EC2 query-protocol client: the calls the framework's L4 makes.
+
+Request construction mirrors the reference's SDK inputs call-for-call:
+CreateFleet with per-(LT, zone, type) overrides
+(``/root/reference/pkg/providers/instance/instance.go:202-258,320-360``),
+DescribeInstanceTypes/Offerings pagination
+(``pkg/providers/instancetype/instancetype.go:181-250``), subnet/SG/image
+discovery, launch-template lifecycle
+(``pkg/providers/launchtemplate/launchtemplate.go:202-312``). The wire
+format is the EC2 query protocol: flattened ``A.N.B``-style form params in,
+XML out.
+"""
+
+from __future__ import annotations
+
+import xml.etree.ElementTree as ET
+from typing import Iterator, Optional
+
+from .session import Session
+
+API_VERSION = "2016-11-15"
+
+
+def flatten(params: dict, out: Optional[dict] = None, prefix: str = "") -> dict:
+    """dict/list structure -> EC2 query params (1-based list indices)."""
+    out = {} if out is None else out
+    for k, v in params.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            flatten(v, out, f"{key}.")
+        elif isinstance(v, (list, tuple)):
+            for i, item in enumerate(v, 1):
+                if isinstance(item, dict):
+                    flatten(item, out, f"{key}.{i}.")
+                else:
+                    out[f"{key}.{i}"] = str(item)
+        elif isinstance(v, bool):
+            out[key] = "true" if v else "false"
+        elif v is not None:
+            out[key] = str(v)
+    return out
+
+
+def _strip(tag: str) -> str:
+    return tag.split("}", 1)[-1]
+
+
+def xml_to_data(el: ET.Element):
+    """EC2 XML -> plain data: repeated ``<item>`` children become lists,
+    leaves become strings."""
+    children = list(el)
+    if not children:
+        return el.text or ""
+    if all(_strip(c.tag) == "item" for c in children):
+        return [xml_to_data(c) for c in children]
+    out: dict = {}
+    for c in children:
+        name = _strip(c.tag)
+        val = xml_to_data(c)
+        if name in out:  # repeated non-item child: promote to list
+            cur = out[name]
+            out[name] = cur + [val] if isinstance(cur, list) else [cur, val]
+        else:
+            out[name] = val
+    return out
+
+
+class Ec2Client:
+    def __init__(self, session: Session, endpoint: str = ""):
+        self.session = session
+        self.endpoint = endpoint
+
+    def _call(self, action: str, params: Optional[dict] = None) -> dict:
+        q = {"Action": action, "Version": API_VERSION}
+        q.update(flatten(params or {}))
+        root = self.session.call_query("ec2", q, endpoint=self.endpoint)
+        data = xml_to_data(root)
+        return data if isinstance(data, dict) else {"items": data}
+
+    # -- preflight (operator.go:205-212 CheckEC2Connectivity) --------------
+
+    def check_connectivity(self) -> None:
+        """DryRun DescribeInstanceTypes; DryRunOperation IS success."""
+        from .transport import AwsApiError
+
+        try:
+            self._call("DescribeInstanceTypes", {"DryRun": True, "MaxResults": 5})
+        except AwsApiError as e:
+            if e.code != "DryRunOperation":
+                raise
+
+    # -- capacity ----------------------------------------------------------
+
+    def create_fleet(self, *, launch_template_configs: list[dict],
+                     target_capacity: int, capacity_type: str,
+                     on_demand_options: Optional[dict] = None,
+                     spot_options: Optional[dict] = None,
+                     tags: Optional[dict[str, str]] = None,
+                     context: str = "") -> dict:
+        """CreateFleet type=instant (instance.go:202-258): one call per
+        batcher flush; overrides carry (InstanceType, SubnetId, AZ,
+        Priority); tag specifications for instance + volume."""
+        params: dict = {
+            "Type": "instant",
+            "LaunchTemplateConfigs": launch_template_configs,
+            "TargetCapacitySpecification": {
+                "TotalTargetCapacity": target_capacity,
+                "DefaultTargetCapacityType": capacity_type,
+            },
+        }
+        if capacity_type == "spot":
+            params["SpotOptions"] = spot_options or {
+                "AllocationStrategy": "price-capacity-optimized",
+            }
+        else:
+            params["OnDemandOptions"] = on_demand_options or {
+                "AllocationStrategy": "lowest-price",
+            }
+        if context:
+            params["Context"] = context
+        if tags:
+            tag_list = [{"Key": k, "Value": v} for k, v in sorted(tags.items())]
+            params["TagSpecification"] = [
+                {"ResourceType": "instance", "Tag": tag_list},
+                {"ResourceType": "volume", "Tag": tag_list},
+            ]
+        return self._call("CreateFleet", params)
+
+    def describe_instances(self, ids: list[str]) -> list[dict]:
+        out: list[dict] = []
+        token = None
+        while True:
+            params: dict = {"InstanceId": list(ids)}
+            if token:
+                params["NextToken"] = token
+            data = self._call("DescribeInstances", params)
+            for res in _as_list(data.get("reservationSet")):
+                out.extend(_as_list(res.get("instancesSet")))
+            token = data.get("nextToken")
+            if not token:
+                return out
+
+    def list_instances_by_tags(self, tag_filters: dict[str, str]) -> list[dict]:
+        filters = [
+            {"Name": f"tag:{k}", "Value": [v]} for k, v in sorted(tag_filters.items())
+        ]
+        filters.append({"Name": "instance-state-name",
+                        "Value": ["pending", "running", "shutting-down", "stopping", "stopped"]})
+        out: list[dict] = []
+        token = None
+        while True:
+            params: dict = {"Filter": filters}
+            if token:
+                params["NextToken"] = token
+            data = self._call("DescribeInstances", params)
+            for res in _as_list(data.get("reservationSet")):
+                out.extend(_as_list(res.get("instancesSet")))
+            token = data.get("nextToken")
+            if not token:
+                return out
+
+    def terminate_instances(self, ids: list[str]) -> list[dict]:
+        data = self._call("TerminateInstances", {"InstanceId": list(ids)})
+        return _as_list(data.get("instancesSet"))
+
+    def create_tags(self, resource_ids: list[str], tags: dict[str, str]) -> None:
+        self._call("CreateTags", {
+            "ResourceId": list(resource_ids),
+            "Tag": [{"Key": k, "Value": v} for k, v in sorted(tags.items())],
+        })
+
+    # -- discovery ---------------------------------------------------------
+
+    def describe_subnets(self, filters: Optional[list[dict]] = None) -> list[dict]:
+        data = self._call("DescribeSubnets", {"Filter": filters} if filters else {})
+        return _as_list(data.get("subnetSet"))
+
+    def describe_security_groups(self, filters: Optional[list[dict]] = None) -> list[dict]:
+        data = self._call(
+            "DescribeSecurityGroups", {"Filter": filters} if filters else {}
+        )
+        return _as_list(data.get("securityGroupInfo"))
+
+    def describe_images(self, filters: Optional[list[dict]] = None,
+                        image_ids: Optional[list[str]] = None) -> list[dict]:
+        params: dict = {}
+        if filters:
+            params["Filter"] = filters
+        if image_ids:
+            params["ImageId"] = image_ids
+        data = self._call("DescribeImages", params)
+        return _as_list(data.get("imagesSet"))
+
+    def describe_availability_zones(self) -> list[dict]:
+        data = self._call("DescribeAvailabilityZones")
+        return _as_list(data.get("availabilityZoneInfo"))
+
+    def describe_capacity_reservations(self, filters: Optional[list[dict]] = None) -> list[dict]:
+        params: dict = {"Filter": filters} if filters else {}
+        out: list[dict] = []
+        token = None
+        while True:
+            if token:
+                params["NextToken"] = token
+            data = self._call("DescribeCapacityReservations", params)
+            out.extend(_as_list(data.get("capacityReservationSet")))
+            token = data.get("nextToken")
+            if not token:
+                return out
+
+    # -- instance types (instancetype.go:181-250 pagination) ---------------
+
+    def describe_instance_types(self) -> Iterator[dict]:
+        token = None
+        while True:
+            params: dict = {"MaxResults": 100}
+            if token:
+                params["NextToken"] = token
+            data = self._call("DescribeInstanceTypes", params)
+            yield from _as_list(data.get("instanceTypeSet"))
+            token = data.get("nextToken")
+            if not token:
+                return
+
+    def describe_instance_type_offerings(self, location_type: str = "availability-zone") -> Iterator[dict]:
+        token = None
+        while True:
+            params: dict = {"LocationType": location_type, "MaxResults": 1000}
+            if token:
+                params["NextToken"] = token
+            data = self._call("DescribeInstanceTypeOfferings", params)
+            yield from _as_list(data.get("instanceTypeOfferingSet"))
+            token = data.get("nextToken")
+            if not token:
+                return
+
+    # -- spot pricing (pricing.go:278-296) ---------------------------------
+
+    def describe_spot_price_history(self, instance_types: Optional[list[str]] = None,
+                                    product_description: str = "Linux/UNIX") -> Iterator[dict]:
+        token = None
+        while True:
+            params: dict = {"ProductDescription": [product_description]}
+            if instance_types:
+                params["InstanceType"] = instance_types
+            if token:
+                params["NextToken"] = token
+            data = self._call("DescribeSpotPriceHistory", params)
+            yield from _as_list(data.get("spotPriceHistorySet"))
+            token = data.get("nextToken")
+            if not token:
+                return
+
+    # -- launch templates (launchtemplate.go:202-312) ----------------------
+
+    def create_launch_template(self, name: str, data: dict,
+                               tags: Optional[dict[str, str]] = None) -> dict:
+        params: dict = {"LaunchTemplateName": name, "LaunchTemplateData": data}
+        if tags:
+            params["TagSpecification"] = [{
+                "ResourceType": "launch-template",
+                "Tag": [{"Key": k, "Value": v} for k, v in sorted(tags.items())],
+            }]
+        return self._call("CreateLaunchTemplate", params)
+
+    def describe_launch_templates(self, name_prefix: str = "") -> list[dict]:
+        params: dict = {}
+        if name_prefix:
+            params["Filter"] = [
+                {"Name": "launch-template-name", "Value": [name_prefix + "*"]}
+            ]
+        out: list[dict] = []
+        token = None
+        while True:
+            if token:
+                params["NextToken"] = token
+            data = self._call("DescribeLaunchTemplates", params)
+            out.extend(_as_list(data.get("launchTemplates")))
+            token = data.get("nextToken")
+            if not token:
+                return out
+
+    def delete_launch_template(self, name: str) -> None:
+        self._call("DeleteLaunchTemplate", {"LaunchTemplateName": name})
+
+
+def _as_list(v) -> list:
+    if v is None or v == "":
+        return []
+    return v if isinstance(v, list) else [v]

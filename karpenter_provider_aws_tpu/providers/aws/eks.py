@@ -1,0 +1,20 @@
+"""EKS cluster-discovery client.
+
+Parity: ``/root/reference/pkg/operator/operator.go:214-245`` — cluster
+endpoint + CA bundle + service CIDR discovery via DescribeCluster, feeding
+bootstrap userdata and the kube-dns IP inference."""
+
+from __future__ import annotations
+
+from .session import Session
+
+
+class EksClient:
+    def __init__(self, session: Session):
+        self.session = session
+
+    def describe_cluster(self, name: str) -> dict:
+        data = self.session.call_rest_json(
+            "eks", "GET", f"/clusters/{name}"
+        )
+        return data.get("cluster", {})

@@ -1,0 +1,56 @@
+"""IAM instance-profile client.
+
+Parity: ``/root/reference/pkg/providers/instanceprofile/instanceprofile.go:60-105``
+— idempotent create (EntityAlreadyExists tolerated), role attach, and the
+remove-role-then-delete teardown ordering."""
+
+from __future__ import annotations
+
+from .session import Session
+from .transport import AwsApiError
+
+API_VERSION = "2010-05-08"
+
+
+class IamClient:
+    def __init__(self, session: Session):
+        self.session = session
+
+    def _call(self, action: str, params: dict) -> None:
+        q = {"Action": action, "Version": API_VERSION}
+        q.update(params)
+        self.session.call_query("iam", q)
+
+    def create_instance_profile(self, name: str, role: str,
+                                tags: dict[str, str]) -> None:
+        params: dict = {"InstanceProfileName": name}
+        for i, (k, v) in enumerate(sorted(tags.items()), 1):
+            params[f"Tags.member.{i}.Key"] = k
+            params[f"Tags.member.{i}.Value"] = v
+        try:
+            self._call("CreateInstanceProfile", params)
+        except AwsApiError as e:
+            if e.code != "EntityAlreadyExists":
+                raise
+        try:
+            self._call("AddRoleToInstanceProfile", {
+                "InstanceProfileName": name, "RoleName": role,
+            })
+        except AwsApiError as e:
+            if e.code != "LimitExceeded":  # role already attached
+                raise
+
+    def delete_instance_profile(self, name: str, role: str = "") -> None:
+        if role:
+            try:
+                self._call("RemoveRoleFromInstanceProfile", {
+                    "InstanceProfileName": name, "RoleName": role,
+                })
+            except AwsApiError as e:
+                if e.code != "NoSuchEntity":
+                    raise
+        try:
+            self._call("DeleteInstanceProfile", {"InstanceProfileName": name})
+        except AwsApiError as e:
+            if e.code != "NoSuchEntity":  # idempotent delete
+                raise

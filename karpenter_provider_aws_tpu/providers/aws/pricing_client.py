@@ -1,0 +1,101 @@
+"""Live pricing refresh client (Pricing API + spot history).
+
+Parity: ``/root/reference/pkg/providers/pricing/pricing.go:158-296`` —
+on-demand prices via the Pricing service's ``GetProducts`` (json protocol,
+X-Amz-Target) with the metal / non-metal TWO-FILTER fan-out and
+pagination; spot prices via EC2 ``DescribeSpotPriceHistory`` per zone.
+Feeds ``catalog.pricing.PricingProvider.apply_overrides`` — the catalog
+remains the single price authority, this client only refreshes it.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from .ec2 import Ec2Client
+from .session import Session
+
+TARGET = "AWSPriceListService.GetProducts"
+
+
+def _od_filters(region: str, metal: bool) -> list[dict]:
+    """pricing.go:160-210: Shared/Compute Instance for standard types,
+    Dedicated/Compute Instance (bare metal) for metal."""
+    return [
+        {"Field": "regionCode", "Type": "TERM_MATCH", "Value": region},
+        {"Field": "serviceCode", "Type": "TERM_MATCH", "Value": "AmazonEC2"},
+        {"Field": "preInstalledSw", "Type": "TERM_MATCH", "Value": "NA"},
+        {"Field": "operatingSystem", "Type": "TERM_MATCH", "Value": "Linux"},
+        {"Field": "capacitystatus", "Type": "TERM_MATCH", "Value": "Used"},
+        {"Field": "marketoption", "Type": "TERM_MATCH", "Value": "OnDemand"},
+        {
+            "Field": "tenancy", "Type": "TERM_MATCH",
+            "Value": "Dedicated" if metal else "Shared",
+        },
+        {
+            "Field": "productFamily", "Type": "TERM_MATCH",
+            "Value": "Compute Instance (bare metal)" if metal else "Compute Instance",
+        },
+    ]
+
+
+def parse_price_item(price_json: str) -> Optional[tuple[str, float]]:
+    """One GetProducts PriceList entry -> (instance_type, $/hr)."""
+    try:
+        item = json.loads(price_json)
+        itype = item["product"]["attributes"]["instanceType"]
+        terms = item["terms"]["OnDemand"]
+        for term in terms.values():
+            for dim in term["priceDimensions"].values():
+                usd = float(dim["pricePerUnit"]["USD"])
+                if usd > 0:
+                    return itype, usd
+    except (KeyError, ValueError, TypeError):
+        return None
+    return None
+
+
+class PricingClient:
+    def __init__(self, session: Session, ec2: Optional[Ec2Client] = None):
+        self.session = session
+        self.ec2 = ec2 or Ec2Client(session)
+
+    def fetch_on_demand(self, region: str) -> dict[str, float]:
+        """Both GetProducts fan-outs (standard + metal), paginated."""
+        prices: dict[str, float] = {}
+        for metal in (False, True):
+            token = None
+            while True:
+                payload: dict = {
+                    "ServiceCode": "AmazonEC2",
+                    "Filters": _od_filters(region, metal),
+                    "MaxResults": 100,
+                }
+                if token:
+                    payload["NextToken"] = token
+                data = self.session.call_json("pricing", TARGET, payload)
+                for pj in data.get("PriceList", []):
+                    parsed = parse_price_item(pj)
+                    if parsed:
+                        prices[parsed[0]] = parsed[1]
+                token = data.get("NextToken")
+                if not token:
+                    break
+        return prices
+
+    def fetch_spot(self, instance_types: Optional[list[str]] = None
+                   ) -> dict[tuple[str, str], float]:
+        """(instance_type, zone) -> latest $/hr from spot history
+        (pricing.go:278-296; newest timestamp wins per pool)."""
+        latest: dict[tuple[str, str], tuple[str, float]] = {}
+        for row in self.ec2.describe_spot_price_history(instance_types):
+            key = (row.get("instanceType", ""), row.get("availabilityZone", ""))
+            ts = row.get("timestamp", "")
+            try:
+                price = float(row.get("spotPrice", ""))
+            except ValueError:
+                continue
+            if key not in latest or ts > latest[key][0]:
+                latest[key] = (ts, price)
+        return {k: v[1] for k, v in latest.items()}

@@ -1,0 +1,301 @@
+"""AWS session: credential chain, STS assume-role, retryer, user-agent.
+
+Parity target: ``/root/reference/pkg/operator/operator.go:92-106`` — the
+reference builds ONE aws-sdk session carrying (1) an STS assume-role
+credential provider when ``--assume-role-arn`` is set, (2) the SDK default
+retryer, (3) a user-agent handler stamping the karpenter version, (4)
+region discovery from IMDS when unset. This module is that session for the
+stdlib client: every adapter call funnels through ``Session.call`` which
+signs (SigV4), stamps the user agent, retries on the SDK's retryable
+classes with exponential backoff + jitter, and refreshes assume-role
+credentials before expiry.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.parse
+import xml.etree.ElementTree as ET
+from typing import Callable, Optional
+
+from ... import __version__ as _pkg_version
+from .sigv4 import Credentials, SignableRequest, sign
+from .transport import (
+    AwsApiError,
+    AwsRequest,
+    AwsResponse,
+    Transport,
+    UrllibTransport,
+)
+
+USER_AGENT = f"karpenter-tpu/{_pkg_version} (sigv4-stdlib)"
+
+# aws-sdk-go DefaultRetryer parity: 3 retries max, retryable on throttle /
+# 5xx / clock-skew codes, full-jitter exponential backoff.
+MAX_RETRIES = 3
+RETRYABLE_CODES = frozenset({
+    "Throttling", "ThrottlingException", "ThrottledException",
+    "RequestLimitExceeded", "TooManyRequestsException",
+    "ProvisionedThroughputExceededException", "RequestThrottled",
+    "RequestThrottledException", "EC2ThrottledException",
+    "InternalError", "InternalFailure", "ServiceUnavailable",
+    "RequestExpired",  # clock skew: retry after re-signing with fresh date
+})
+
+
+def _now_amz() -> str:
+    return time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+
+
+class CredentialError(Exception):
+    pass
+
+
+def env_credentials() -> Optional[Credentials]:
+    """The chain's first link (env vars), like the SDK's EnvProvider."""
+    ak = os.environ.get("AWS_ACCESS_KEY_ID", "")
+    sk = os.environ.get("AWS_SECRET_ACCESS_KEY", "")
+    if not ak or not sk:
+        return None
+    return Credentials(ak, sk, os.environ.get("AWS_SESSION_TOKEN", ""))
+
+
+def shared_file_credentials(path: str = "", profile: str = "") -> Optional[Credentials]:
+    """~/.aws/credentials INI (SharedCredentialsProvider parity)."""
+    import configparser
+
+    path = path or os.environ.get(
+        "AWS_SHARED_CREDENTIALS_FILE",
+        os.path.expanduser("~/.aws/credentials"),
+    )
+    profile = profile or os.environ.get("AWS_PROFILE", "default")
+    if not os.path.exists(path):
+        return None
+    cp = configparser.ConfigParser()
+    cp.read(path)
+    if profile not in cp:
+        return None
+    sec = cp[profile]
+    if "aws_access_key_id" not in sec:
+        return None
+    return Credentials(
+        sec["aws_access_key_id"],
+        sec.get("aws_secret_access_key", ""),
+        sec.get("aws_session_token", ""),
+    )
+
+
+_ENDPOINT_OVERRIDE_ENV = "AWS_ENDPOINT_URL"
+
+
+def default_endpoint(service: str, region: str) -> str:
+    """Regional endpoint, overridable for tests/local stacks via
+    AWS_ENDPOINT_URL (all services) or AWS_ENDPOINT_URL_<SERVICE>."""
+    specific = os.environ.get(f"{_ENDPOINT_OVERRIDE_ENV}_{service.upper()}")
+    if specific:
+        return specific
+    generic = os.environ.get(_ENDPOINT_OVERRIDE_ENV)
+    if generic:
+        return generic
+    if service == "iam":
+        return "https://iam.amazonaws.com"
+    # pricing has endpoints only in a few regions (pricing.go:91-101)
+    if service == "pricing":
+        if region.startswith("ap-"):
+            return "https://api.pricing.ap-south-1.amazonaws.com"
+        if region.startswith("cn-"):
+            return "https://api.pricing.cn-northwest-1.amazonaws.com.cn"
+        if region.startswith("eu-"):
+            return "https://api.pricing.eu-central-1.amazonaws.com"
+        return "https://api.pricing.us-east-1.amazonaws.com"
+    return f"https://{service}.{region}.amazonaws.com"
+
+
+def _parse_error(service: str, resp: AwsResponse) -> AwsApiError:
+    body = resp.body.decode("utf-8", "replace")
+    code, message = "UnknownError", body[:300]
+    try:
+        if body.lstrip().startswith("{"):
+            d = json.loads(body)
+            code = (d.get("__type") or d.get("code") or code).split("#")[-1]
+            message = d.get("message") or d.get("Message") or message
+        else:
+            root = ET.fromstring(body)
+            # both query-error shapes: <ErrorResponse><Error><Code> and
+            # <Response><Errors><Error><Code>
+            el = root.find(".//{*}Error")
+            if el is None:
+                el = root.find(".//Error")
+            if el is not None:
+                code = (el.findtext("{*}Code") or el.findtext("Code") or code)
+                message = (el.findtext("{*}Message") or el.findtext("Message")
+                           or message)
+    except Exception:
+        pass
+    return AwsApiError(resp.status, code, message)
+
+
+class Session:
+    """One signed, retried, user-agent-stamped wire path for all adapters.
+
+    ``assume_role_arn`` mirrors --assume-role-arn: when set, base
+    credentials only ever sign STS AssumeRole calls; everything else signs
+    with the (auto-refreshed) assumed credentials
+    (operator.go:96-100 stscreds.NewCredentials).
+    """
+
+    def __init__(
+        self,
+        region: str = "",
+        credentials: Optional[Credentials] = None,
+        transport: Optional[Transport] = None,
+        assume_role_arn: str = "",
+        assume_role_duration_s: int = 900,
+        session_name: str = "karpenter-tpu",
+        sleep: Callable[[float], None] = time.sleep,
+        now_amz: Callable[[], str] = _now_amz,
+        rand: Callable[[], float] = None,
+    ):
+        self.region = region or os.environ.get(
+            "AWS_REGION", os.environ.get("AWS_DEFAULT_REGION", "")
+        )
+        self._base_creds = credentials or env_credentials() or shared_file_credentials()
+        self.transport = transport or UrllibTransport()
+        self.assume_role_arn = assume_role_arn
+        self.assume_role_duration_s = assume_role_duration_s
+        self.session_name = session_name
+        self._assumed: Optional[Credentials] = None
+        self._sleep = sleep
+        self._now_amz = now_amz
+        import random
+
+        self._rand = rand or random.random
+
+    # -- credentials -------------------------------------------------------
+
+    def credentials(self) -> Credentials:
+        if not self.assume_role_arn:
+            if self._base_creds is None:
+                raise CredentialError(
+                    "no AWS credentials: set AWS_ACCESS_KEY_ID/"
+                    "AWS_SECRET_ACCESS_KEY or a shared credentials file"
+                )
+            return self._base_creds
+        if self._assumed is None or (
+            self._assumed.expiration
+            and self._assumed.expiration - time.time() < 60
+        ):
+            self._assumed = self._assume_role()
+        return self._assumed
+
+    def _assume_role(self) -> Credentials:
+        if self._base_creds is None:
+            raise CredentialError("assume-role requires base credentials")
+        params = {
+            "Action": "AssumeRole",
+            "Version": "2011-06-15",
+            "RoleArn": self.assume_role_arn,
+            "RoleSessionName": self.session_name,
+            "DurationSeconds": str(self.assume_role_duration_s),
+        }
+        resp = self._do(
+            "sts", f"https://sts.{self.region}.amazonaws.com",
+            params=params, creds=self._base_creds,
+        )
+        root = ET.fromstring(resp.body)
+        ns = {"sts": "https://sts.amazonaws.com/doc/2011-06-15/"}
+        cred = root.find(".//sts:Credentials", ns)
+        if cred is None:  # namespace-agnostic fallback
+            cred = root.find(".//{*}Credentials")
+        if cred is None:
+            raise CredentialError("AssumeRole reply had no Credentials")
+
+        def _txt(tag: str) -> str:
+            return (cred.findtext(f"sts:{tag}", namespaces=ns)
+                    or cred.findtext(f"{{*}}{tag}") or "")
+
+        exp = _txt("Expiration")
+        exp_unix = 0.0
+        if exp:
+            import calendar
+
+            exp_unix = calendar.timegm(
+                time.strptime(exp.split(".")[0].rstrip("Z"), "%Y-%m-%dT%H:%M:%S")
+            )
+        return Credentials(
+            _txt("AccessKeyId"), _txt("SecretAccessKey"), _txt("SessionToken"),
+            expiration=exp_unix,
+        )
+
+    # -- the wire ----------------------------------------------------------
+
+    def call_query(self, service: str, params: dict[str, str],
+                   endpoint: str = "") -> ET.Element:
+        """AWS query-protocol call (EC2/IAM/STS/SQS): form-encoded action
+        params, XML reply parsed to the root element."""
+        resp = self._retrying(
+            service, endpoint or default_endpoint(service, self.region),
+            params=params,
+        )
+        return ET.fromstring(resp.body)
+
+    def call_json(self, service: str, target: str, payload: dict,
+                  endpoint: str = "") -> dict:
+        """AWS json-protocol call (Pricing): X-Amz-Target + JSON body."""
+        resp = self._retrying(
+            service, endpoint or default_endpoint(service, self.region),
+            json_target=target, payload=payload,
+        )
+        return json.loads(resp.body) if resp.body else {}
+
+    def call_rest_json(self, service: str, method: str, path: str,
+                       endpoint: str = "") -> dict:
+        """REST-JSON call (EKS DescribeCluster)."""
+        resp = self._retrying(
+            service, endpoint or default_endpoint(service, self.region),
+            method=method, path=path,
+        )
+        return json.loads(resp.body) if resp.body else {}
+
+    def _retrying(self, service: str, endpoint: str, **kw) -> AwsResponse:
+        """DefaultRetryer parity: MAX_RETRIES with full-jitter exponential
+        backoff on retryable codes and 5xx."""
+        attempt = 0
+        while True:
+            try:
+                return self._do(service, endpoint, creds=self.credentials(), **kw)
+            except AwsApiError as e:
+                retryable = e.code in RETRYABLE_CODES or e.status >= 500
+                if not retryable or attempt >= MAX_RETRIES:
+                    raise
+                # full-jitter: U(0, min(cap, base * 2^attempt)); SDK base
+                # 30ms scale for throttles
+                delay = self._rand() * min(5.0, 0.03 * (2 ** attempt) * 10)
+                self._sleep(delay)
+                attempt += 1
+
+    def _do(self, service: str, endpoint: str, params: Optional[dict] = None,
+            json_target: str = "", payload: Optional[dict] = None,
+            method: str = "POST", path: str = "",
+            creds: Optional[Credentials] = None) -> AwsResponse:
+        url = endpoint.rstrip("/") + (path or "/")
+        headers = {"user-agent": USER_AGENT}
+        body = b""
+        if params is not None:
+            body = urllib.parse.urlencode(sorted(params.items())).encode()
+            headers["content-type"] = "application/x-www-form-urlencoded; charset=utf-8"
+        elif json_target:
+            body = json.dumps(payload or {}).encode()
+            headers["content-type"] = "application/x-amz-json-1.1"
+            headers["x-amz-target"] = json_target
+        sreq = SignableRequest(method=method, url=url, headers=headers, body=body)
+        sign(sreq, creds, service, self.region or "us-east-1", self._now_amz())
+        resp = self.transport(AwsRequest(
+            method=method, url=url, headers=sreq.headers, body=body,
+            service=service, region=self.region,
+        ))
+        if resp.status >= 300:
+            raise _parse_error(service, resp)
+        return resp

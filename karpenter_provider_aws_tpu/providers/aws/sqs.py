@@ -1,0 +1,87 @@
+"""SQS adapter implementing the framework's ``QueueProvider`` seam.
+
+Parity: ``/root/reference/pkg/providers/sqs/sqs.go:53-101`` — long-poll
+receive with MaxNumberOfMessages=10, VisibilityTimeout=20s,
+WaitTimeSeconds=20 (the SQS long-poll maximum), plus send and per-receipt
+delete. The interruption controller consumes this through the
+``QueueProvider`` Protocol (``providers/queue.py``) and never sees the
+wire."""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from ..queue import MAX_RECEIVE, WAIT_TIME_S, QueueMessage
+from .session import Session
+
+API_VERSION = "2012-11-05"
+
+
+class SqsQueueProvider:
+    """QueueProvider over the SQS query protocol."""
+
+    # receive/delete are real network long-polls: the interruption
+    # controller keeps its worker fan-out (see providers/queue.py)
+    blocking_io = True
+
+    def __init__(self, session: Session, queue_url: str):
+        self.session = session
+        self.queue_url = queue_url
+
+    @classmethod
+    def from_queue_name(cls, session: Session, name: str) -> "SqsQueueProvider":
+        """GetQueueUrl at construction (controllers.go:67-71 resolves the
+        --interruption-queue name the same way)."""
+        root = session.call_query("sqs", {
+            "Action": "GetQueueUrl", "Version": API_VERSION, "QueueName": name,
+        })
+        url = root.findtext(".//{*}QueueUrl") or ""
+        if not url:
+            raise ValueError(f"no queue url for {name!r}")
+        return cls(session, url)
+
+    def name(self) -> str:
+        return self.queue_url.rsplit("/", 1)[-1]
+
+    def _call(self, action: str, extra: dict) -> "object":
+        params = {"Action": action, "Version": API_VERSION,
+                  "QueueUrl": self.queue_url}
+        params.update(extra)
+        # SQS query calls go to the queue's own host, not the service
+        # endpoint (the URL embeds account + name)
+        from urllib.parse import urlsplit
+
+        endpoint = "{0.scheme}://{0.netloc}".format(urlsplit(self.queue_url))
+        return self.session.call_query("sqs", params, endpoint=endpoint)
+
+    # -- QueueProvider -----------------------------------------------------
+
+    def send(self, body) -> None:
+        if not isinstance(body, str):
+            body = json.dumps(body)
+        self._call("SendMessage", {"MessageBody": body})
+
+    def receive(self, max_messages: Optional[int] = None) -> list[QueueMessage]:
+        """One long poll (sqs.go:53-73): at most 10 messages, 20s wait,
+        20s visibility, system attributes requested."""
+        root = self._call("ReceiveMessage", {
+            "MaxNumberOfMessages": str(min(max_messages or MAX_RECEIVE, MAX_RECEIVE)),
+            "VisibilityTimeout": "20",
+            "WaitTimeSeconds": str(WAIT_TIME_S),
+            "AttributeName.1": "SentTimestamp",
+            "MessageAttributeName.1": "All",
+        })
+        out = []
+        for msg in root.iter():
+            if msg.tag.split("}")[-1] != "Message":
+                continue
+            out.append(QueueMessage(
+                body=msg.findtext("{*}Body") or msg.findtext("Body") or "",
+                receipt=(msg.findtext("{*}ReceiptHandle")
+                         or msg.findtext("ReceiptHandle") or ""),
+            ))
+        return out
+
+    def delete(self, receipt: str) -> None:
+        self._call("DeleteMessage", {"ReceiptHandle": receipt})

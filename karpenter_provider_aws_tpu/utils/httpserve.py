@@ -29,10 +29,25 @@ class QuietHandler(BaseHTTPRequestHandler):
         pass
 
 
-def serve_http(handler_cls, port: int = 0, host: str = "") -> ThreadingHTTPServer:
+def serve_http(handler_cls, port: int = 0, host: str = "",
+               tls_dir: str = "") -> ThreadingHTTPServer:
     """Bind host:port ("" = all interfaces, 0 = ephemeral port) and serve
-    on a daemon thread. The bound port is ``server.server_address[1]``."""
+    on a daemon thread. The bound port is ``server.server_address[1]``.
+
+    ``tls_dir``: directory holding ``tls.crt`` + ``tls.key`` (the shape a
+    mounted kubernetes.io/tls Secret presents) — non-empty wraps the
+    listener in TLS, which is how the webhook endpoint serves the
+    apiserver (clientConfig.service is always HTTPS)."""
     server = ThreadingHTTPServer((host, port), handler_cls)
+    if tls_dir:
+        import os
+        import ssl
+
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(
+            os.path.join(tls_dir, "tls.crt"), os.path.join(tls_dir, "tls.key")
+        )
+        server.socket = ctx.wrap_socket(server.socket, server_side=True)
     threading.Thread(target=server.serve_forever, daemon=True).start()
     return server
 
