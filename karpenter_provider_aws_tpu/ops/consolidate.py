@@ -452,6 +452,21 @@ def screen_cap_wire(ct: ClusterTensors) -> np.ndarray:
     return screen_cap
 
 
+def live_slot_width(group_counts: np.ndarray) -> int:
+    """Smallest power-of-two slot width covering every node's ACTUAL
+    group count. Slots are front-packed by the encode (counts > 0 form a
+    prefix), so slicing the slot axis to this width is exact — and it is
+    THE config4 lever: a production cluster's nodes carry a handful of
+    distinct pod groups (the 5k-node bench: 1), while the tensors pad to
+    GMAX=32, so every backend was doing 4-32x the slot work and HBM/VMEM
+    traffic the problem contains."""
+    s = int((group_counts > 0).sum(axis=1).max()) if group_counts.size else 1
+    w = 1
+    while w < s:
+        w *= 2
+    return min(w, group_counts.shape[1] if group_counts.ndim == 2 else w)
+
+
 def consolidatable(ct: ClusterTensors, chunk: int = 512) -> np.ndarray:
     """can_delete[N]: pallas VMEM-resident kernel (one grid program per
     candidate, zero HBM traffic in the slot loop), chunked vmap lanes,
@@ -460,12 +475,15 @@ def consolidatable(ct: ClusterTensors, chunk: int = 512) -> np.ndarray:
     out = np.zeros(N, dtype=bool)
     backend = _repack_backend(ct)
     screen_cap = screen_cap_wire(ct)
+    S = live_slot_width(ct.group_counts)
+    gids_s = ct.group_ids[:, :S]
+    gcounts_s = ct.group_counts[:, :S]
     if backend == "pallas":
         from .repack_pallas import repack_check_pallas
 
         cand = np.arange(N, dtype=np.int32)
         out[:] = repack_check_pallas(
-            ct.free, ct.requests, ct.group_ids, ct.group_counts,
+            ct.free, ct.requests, gids_s, gcounts_s,
             screen_cap, cand,
         )
         out &= ~ct.blocked
@@ -482,15 +500,15 @@ def consolidatable(ct: ClusterTensors, chunk: int = 512) -> np.ndarray:
         # (repack_set_feasible) remains the enforcement point either way.
         cand = np.arange(N, dtype=np.int32)
         out[:] = repack_check_native(
-            ct.free, ct.requests, ct.group_ids, ct.group_counts,
+            ct.free, ct.requests, gids_s, gcounts_s,
             ct.compat, cand,
         )
         out &= ~ct.blocked
         return out
     free = jnp.asarray(ct.free)
     requests = jnp.asarray(ct.requests)
-    gids = jnp.asarray(ct.group_ids)
-    gcounts = jnp.asarray(ct.group_counts)
+    gids = jnp.asarray(gids_s)
+    gcounts = jnp.asarray(gcounts_s)
     cap = jnp.asarray(screen_cap)
     for start in range(0, N, chunk):
         idx = np.arange(start, min(start + chunk, N), dtype=np.int32)

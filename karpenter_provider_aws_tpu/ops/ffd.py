@@ -249,7 +249,14 @@ def rank_launch_options(
     # per-node count replaces a [N, k] bool mask; int16 halves the idx
     # transfer (T < 32768 always holds for instance catalogs)
     n_valid = jnp.sum(jnp.isfinite(neg), axis=1).astype(jnp.int16)
-    return idx.astype(jnp.int16), n_valid
+    # best usable price per node: the commit-downsize pass re-commits a
+    # node to ranked[0] when its FINAL load fits a cheaper type than the
+    # scan chose at open time (the scan cannot see the final load; the
+    # greedy baseline never revisits). Same estimator family as the scan's
+    # node_price — max over the node's groups of group-level price — so a
+    # downsize is strictly cheaper under a conservative estimate.
+    best_price = -neg[:, 0]
+    return idx.astype(jnp.int16), n_valid, best_price
 
 
 @functools.partial(jax.jit, static_argnames=("max_nodes",))

@@ -172,8 +172,9 @@ def place_screen_args(ct, mesh: Mesh):
     cluster state replicated, the candidate axis (padded to a mesh
     multiple; padded lanes re-screen node 0 and are discarded) sharded.
     Shared by the screen path and the partition-evidence bench."""
-    from ..ops.consolidate import screen_cap_wire
+    from ..ops.consolidate import live_slot_width, screen_cap_wire
 
+    S = live_slot_width(ct.group_counts)
     N = len(ct.node_names)
     D = mesh.devices.size
     NB = N if N % D == 0 else N + (D - N % D)
@@ -184,8 +185,10 @@ def place_screen_args(ct, mesh: Mesh):
     return (
         jax.device_put(jnp.asarray(ct.free), rep),
         jax.device_put(jnp.asarray(ct.requests), rep),
-        jax.device_put(jnp.asarray(ct.group_ids), rep),
-        jax.device_put(jnp.asarray(ct.group_counts), rep),
+        # slot axis sliced to the live width (see consolidate.live_slot_width
+        # — semantics-exact; GMAX padding was 4-32x wasted slot work)
+        jax.device_put(jnp.asarray(ct.group_ids[:, :S]), rep),
+        jax.device_put(jnp.asarray(ct.group_counts[:, :S]), rep),
         jax.device_put(jnp.asarray(screen_cap_wire(ct)), rep),
         jax.device_put(jnp.asarray(cand), shard),
     )

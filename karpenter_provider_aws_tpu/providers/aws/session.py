@@ -276,6 +276,24 @@ class Session:
                 self._sleep(delay)
                 attempt += 1
 
+    @staticmethod
+    def _signing_region(service: str, endpoint: str, default: str) -> str:
+        """The region a request must be SIGNED for is the ENDPOINT's, not
+        the session's: IAM is global (us-east-1 scope only) and Pricing
+        lives in a few fixed regions — signing those with the session
+        region fails auth everywhere else (advisor round-5)."""
+        if service == "iam":
+            return "us-east-1"
+        host = urllib.parse.urlsplit(endpoint).netloc
+        # api.pricing.<region>.amazonaws.com / <svc>.<region>.amazonaws.com
+        parts = host.split(".")
+        for i, p in enumerate(parts):
+            if p == "amazonaws" and i >= 1:
+                cand = parts[i - 1]
+                if "-" in cand and not cand.startswith("pricing"):
+                    return cand
+        return default or "us-east-1"
+
     def _do(self, service: str, endpoint: str, params: Optional[dict] = None,
             json_target: str = "", payload: Optional[dict] = None,
             method: str = "POST", path: str = "",
@@ -291,7 +309,9 @@ class Session:
             headers["content-type"] = "application/x-amz-json-1.1"
             headers["x-amz-target"] = json_target
         sreq = SignableRequest(method=method, url=url, headers=headers, body=body)
-        sign(sreq, creds, service, self.region or "us-east-1", self._now_amz())
+        sign(sreq, creds, service,
+             self._signing_region(service, endpoint, self.region),
+             self._now_amz())
         resp = self.transport(AwsRequest(
             method=method, url=url, headers=sreq.headers, body=body,
             service=service, region=self.region,

@@ -72,6 +72,11 @@ class UrllibTransport:
             return AwsResponse(
                 status=e.code, body=e.read(), headers=dict(e.headers or {}),
             )
+        except (urllib.error.URLError, OSError) as e:
+            # connection resets / DNS blips must enter the retry loop like
+            # the SDK DefaultRetryer's connection-error class — raw
+            # URLError would bypass Session._retrying entirely
+            raise AwsApiError(599, "ConnectionError", str(e)) from e
 
 
 def _fixture_shape(req: AwsRequest) -> dict:
