@@ -64,12 +64,8 @@ def probe_config2(iters: int = 8) -> None:
     finally:
         jax.device_get = orig_get
 
-    # dispatch-only cost: run the full device program but never fetch
-    print("== dispatch-only (no fetch) ==", flush=True)
-    import karpenter_provider_aws_tpu.ops.ffd as ffd_mod
-
-    t0 = time.perf_counter()
-    res = None
+    # one profiler-traced solve for timeline inspection
+    print("== traced solve ==", flush=True)
     with jax.profiler.trace("/tmp/jax_trace_config2"):
         t0 = time.perf_counter()
         tpu.solve_encoded(problem)

@@ -1,0 +1,139 @@
+"""Attribute the jax-0.9 Mosaic regression on the FFD Pallas kernel
+(round-4 verdict weak #3 / do #4).
+
+Round 4 measured the Pallas FFD kernel LOSING to the fused XLA scan at
+full catalog scale (118ms vs 77ms p99) while winning on narrow synthetic
+shapes, with the cause "not attributable from this side of the tunnel".
+This harness produces the attribution artifacts in one run:
+
+  1. times both backends at the headline shape (50k pods x full catalog)
+     AND at a narrow synthetic shape (64 types), p50/p99 each;
+  2. dumps compiled artifacts (XLA HLO for the scan, Mosaic/LLO for the
+     kernel) via KARPENTER_TPU profile plumbing (utils/observability);
+  3. prints the per-shape winner and the derived crossover so the
+     auto-race policy (solver.py pins the faster backend after a
+     one-time verified race) is grounded in data, not vibes.
+
+Run alone on the chip. Results feed designs/pallas-ffd.md.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+
+def _problem(num_pods: int, n_types: int | None):
+    from karpenter_provider_aws_tpu.catalog import CatalogProvider
+    from karpenter_provider_aws_tpu.models import NodePool, Operator, Requirement
+    from karpenter_provider_aws_tpu.models import labels as lbl
+    from karpenter_provider_aws_tpu.models.pod import make_pods
+    from karpenter_provider_aws_tpu.ops.encode import encode_problem, pad_problem
+
+    catalog = CatalogProvider()
+    pool = NodePool(
+        name="default",
+        requirements=[Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m", "r"))],
+    )
+    rng = np.random.RandomState(0)
+    pods = []
+    for i in range(64):
+        cpu_m = int(rng.choice([100, 250, 500, 1000, 2000, 4000, 8000]))
+        mem = cpu_m * int(rng.choice([1, 2, 4, 8]))
+        pods += make_pods(
+            max(1, num_pods // 64), f"s{i}",
+            {"cpu": f"{cpu_m}m", "memory": f"{mem}Mi"},
+        )
+    allowed = None
+    if n_types:
+        names = sorted(t.name for t in catalog.list() if t.category in ("c", "m", "r"))
+        allowed = set(names[:: max(1, len(names) // n_types)][:n_types])
+    problem = pad_problem(encode_problem(pods, catalog, pool, allowed_types=allowed))
+    return problem
+
+
+def _time_backend(problem, backend: str, iters: int, max_nodes: int) -> dict:
+    import jax
+
+    if backend == "xla":
+        from karpenter_provider_aws_tpu.ops.ffd import ffd_solve
+
+        def run():
+            res = ffd_solve(
+                problem.requests, problem.counts, problem.compat,
+                problem.capacity, problem.price, problem.group_window,
+                problem.type_window, max_per_node=problem.max_per_node,
+                max_nodes=max_nodes,
+            )
+            jax.block_until_ready(res.node_type)
+            return res
+    else:
+        from karpenter_provider_aws_tpu.ops.ffd_pallas import ffd_solve_pallas
+
+        def run():
+            res = ffd_solve_pallas(
+                problem.requests, problem.counts, problem.compat,
+                problem.capacity, problem.price, problem.group_window,
+                problem.type_window, max_per_node=problem.max_per_node,
+                max_nodes=max_nodes,
+            )
+            jax.block_until_ready(res.node_type)
+            return res
+
+    t0 = time.perf_counter()
+    run()
+    compile_s = time.perf_counter() - t0
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        run()
+        times.append((time.perf_counter() - t0) * 1e3)
+    return {
+        "backend": backend,
+        "compile_s": round(compile_s, 1),
+        "p50_ms": round(float(np.percentile(times, 50)), 2),
+        "p99_ms": round(float(np.percentile(times, 99)), 2),
+    }
+
+
+def main(iters: int = 20) -> None:
+    import gc
+
+    import jax
+
+    dump_dir = os.environ.get("XLA_DUMP_DIR", "/tmp/pallas_attribution_dump")
+    from karpenter_provider_aws_tpu.utils.observability import enable_xla_dump
+
+    enable_xla_dump(dump_dir)
+    print(f"device: {jax.devices()[0]}  dumps -> {dump_dir}", flush=True)
+
+    shapes = [
+        ("narrow_64types", _problem(50_000, 64), 4096),
+        ("headline_fullcat", _problem(50_000, None), 4096),
+    ]
+    gc.collect(); gc.freeze(); gc.disable()
+    try:
+        rows = []
+        for name, problem, max_nodes in shapes:
+            T = problem.capacity.shape[0]
+            G = problem.requests.shape[0]
+            for backend in ("xla", "pallas"):
+                row = _time_backend(problem, backend, iters, max_nodes)
+                row.update(shape=name, T=T, G=G)
+                rows.append(row)
+                print(row, flush=True)
+        # winner per shape
+        for name in {r["shape"] for r in rows}:
+            pair = {r["backend"]: r for r in rows if r["shape"] == name}
+            w = min(pair, key=lambda b: pair[b]["p99_ms"])
+            print(f"WINNER {name}: {w} "
+                  f"(xla {pair['xla']['p99_ms']}ms vs pallas {pair['pallas']['p99_ms']}ms)",
+                  flush=True)
+    finally:
+        gc.enable(); gc.unfreeze()
+
+
+if __name__ == "__main__":
+    main()

@@ -322,6 +322,36 @@ class TestEc2Contracts:
         assert subnets[1].public is True
 
 
+class TestLaunchTemplateAndIdentityContracts:
+    def test_lt_profile_eks_flows(self):
+        """launchtemplate.go:202-312 (create w/ b64 userdata, monitoring,
+        SGs, tags; delete), instanceprofile.go:60-105 (idempotent create —
+        EntityAlreadyExists tolerated — role attach, remove-role-then-
+        delete teardown), operator.go:214-245 (EKS DescribeCluster)."""
+
+        session, transport = fixture_session("launch_template_and_profile")
+        backend = AwsCloudBackend(session, cluster_name="my-cluster")
+        backend.create_launch_template(
+            "karpenter-lt-abc123", "ami-12345678",
+            user_data="#!/bin/bash\necho hi",
+            security_group_ids=("sg-1", "sg-2"),
+            instance_profile="karpenter-profile",
+            detailed_monitoring=True,
+            tags={"karpenter.sh/cluster": "my-cluster"},
+        )
+        backend.delete_launch_template("karpenter-lt-abc123")
+        backend.create_instance_profile(
+            "karpenter-profile", "karpenter-node-role",
+            {"karpenter.sh/cluster": "my-cluster"},
+        )
+        backend.delete_instance_profile("karpenter-profile")
+        cluster = backend.describe_cluster()
+        assert cluster["service_ipv4_cidr"] == "10.100.0.0/16"
+        assert cluster["version"] == "1.29"
+        assert cluster["ca_bundle"] == "Q0FEQVRB"
+        transport.assert_drained()
+
+
 class TestSqsContracts:
     def test_long_poll_receive_and_delete(self):
         """sqs.go:53-101: WaitTimeSeconds=20 (long-poll max),
