@@ -452,15 +452,27 @@ def screen_cap_wire(ct: ClusterTensors) -> np.ndarray:
     return screen_cap
 
 
+def live_slots(group_counts: np.ndarray) -> np.ndarray:
+    """Per-row LIVE slot count: one past the last nonzero slot. THE
+    definition shared by the host-side slot-axis slice and the pallas
+    kernel's per-candidate trip bound — they must never diverge."""
+    gmax = group_counts.shape[-1]
+    return np.where(
+        group_counts > 0, np.arange(gmax, dtype=np.int32) + 1, 0
+    ).max(axis=-1).astype(np.int32)
+
+
 def live_slot_width(group_counts: np.ndarray) -> int:
-    """Smallest power-of-two slot width covering every node's ACTUAL
-    group count. Slots are front-packed by the encode (counts > 0 form a
-    prefix), so slicing the slot axis to this width is exact — and it is
-    THE config4 lever: a production cluster's nodes carry a handful of
+    """Smallest power-of-two slot width covering every LIVE slot (one past
+    the last nonzero — exact for any table, since zero-count slots are
+    no-ops wherever they sit; the encode front-packs anyway). This is THE
+    config4 lever: a production cluster's nodes carry a handful of
     distinct pod groups (the 5k-node bench: 1), while the tensors pad to
     GMAX=32, so every backend was doing 4-32x the slot work and HBM/VMEM
     traffic the problem contains."""
-    s = int((group_counts > 0).sum(axis=1).max()) if group_counts.size else 1
+    if not group_counts.size:
+        return 1
+    s = int(live_slots(group_counts).max())
     w = 1
     while w < s:
         w *= 2

@@ -44,8 +44,8 @@ def _pad_to(n: int, m: int) -> int:
     return ((n + m - 1) // m) * m
 
 
-def _kernel(cand_ref, slots_ref, counts_ref, free_ref, req_ref, cap_slots_ref,
-            ok_ref, free_c):
+def _kernel(cand_ref, slots_ref, counts_ref, nslots_ref, free_ref, req_ref,
+            cap_slots_ref, ok_ref, free_c):
     """One grid program = one candidate node's repack proof.
 
     cand/slots/counts ride as SCALAR-PREFETCH operands — whole arrays
@@ -63,6 +63,10 @@ def _kernel(cand_ref, slots_ref, counts_ref, free_ref, req_ref, cap_slots_ref,
     cand_ref      [C]           SMEM  candidate node index per program
     slots_ref     [C, GMAX]     SMEM  group ids on each candidate
     counts_ref    [C, GMAX]     SMEM  pod counts per slot
+    nslots_ref    [C]           SMEM  LIVE slot count per candidate — the
+                                      slot loop's dynamic trip bound (slots
+                                      are front-packed; most nodes carry a
+                                      handful of groups vs the GMAX pad)
     free_ref      [RP, N]       VMEM  shared base free matrix
     req_ref       [RP, G]       VMEM  shared group requests
     cap_slots_ref [1, GMAX, N]  VMEM  this candidate's per-slot cap rows
@@ -74,7 +78,7 @@ def _kernel(cand_ref, slots_ref, counts_ref, free_ref, req_ref, cap_slots_ref,
     i = pl.program_id(0)
     i_node = cand_ref[i]
     free_c[:] = free_ref[:]
-    gmax = slots_ref.shape[1]
+    gmax = nslots_ref[i]  # dynamic: only the candidate's LIVE slots run
     n = free_ref.shape[1]
     not_self = (
         jax.lax.broadcasted_iota(jnp.int32, (1, n), 1) != i_node
@@ -123,8 +127,8 @@ def _kernel(cand_ref, slots_ref, counts_ref, free_ref, req_ref, cap_slots_ref,
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def _repack_call(cand_bands, slots_bands, counts_bands, free_t, req_t,
-                 cap_f32, interpret=False):
+def _repack_call(cand_bands, slots_bands, counts_bands, nslots_bands,
+                 free_t, req_t, cap_f32, interpret=False):
     """All candidate bands in ONE dispatch: ``lax.map`` over 256-wide bands,
     each a pallas_call whose grid is one band. Banding keeps the
     scalar-prefetch slot tables + output window inside the ~1MB SMEM
@@ -138,7 +142,7 @@ def _repack_call(cand_bands, slots_bands, counts_bands, free_t, req_t,
     # 60000 clamp is semantically uncapped — no node holds that many pods)
     cap_f32 = cap_f32.astype(jnp.float32)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,  # cand, slots, counts: whole-array SMEM
+        num_scalar_prefetch=4,  # cand, slots, counts, nslots: SMEM tables
         grid=(C,),
         in_specs=[
             pl.BlockSpec((RP, N), lambda i, *_: (0, 0), memory_space=pltpu.VMEM),
@@ -152,7 +156,7 @@ def _repack_call(cand_bands, slots_bands, counts_bands, free_t, req_t,
     )
 
     def one_band(args):
-        cand, slots, counts = args
+        cand, slots, counts, nslots = args
         # XLA-side gather: each candidate's per-slot cap rows, contiguous
         # in HBM so the kernel DMAs one [GMAX, N] block per program
         cap_slots = cap_f32[slots]  # [C, GMAX, N]
@@ -161,9 +165,11 @@ def _repack_call(cand_bands, slots_bands, counts_bands, free_t, req_t,
             out_shape=jax.ShapeDtypeStruct((C, 1), jnp.int32),
             grid_spec=grid_spec,
             interpret=interpret,
-        )(cand, slots, counts, free_t, req_t, cap_slots)
+        )(cand, slots, counts, nslots, free_t, req_t, cap_slots)
 
-    return jax.lax.map(one_band, (cand_bands, slots_bands, counts_bands))
+    return jax.lax.map(
+        one_band, (cand_bands, slots_bands, counts_bands, nslots_bands)
+    )
 
 
 def repack_vmem_bytes(n_nodes: int, n_groups: int, n_res: int = 9,
@@ -231,6 +237,14 @@ def repack_check_pallas(
     slots_p[:C] = group_ids
     counts_p = np.zeros((CP, gmax), dtype=np.int32)
     counts_p[:C] = group_counts
+    # live slots per candidate: the kernel's dynamic trip bound (zero-count
+    # slots anywhere are no-ops, so this is exact even for non-front-packed
+    # tables); padded candidates run 0. ONE definition with the host-side
+    # slot-axis slice (consolidate.live_slots).
+    from .consolidate import live_slots
+
+    nslots_p = np.zeros(CP, dtype=np.int32)
+    nslots_p[:C] = live_slots(group_counts)
 
     # ONE device dispatch for the whole sweep (bands fused under lax.map)
     # and ONE fetch: per-band transfers/dispatches over a tunneled chip
@@ -240,6 +254,7 @@ def repack_check_pallas(
         jnp.asarray(cand_p.reshape(B, BAND)),
         jnp.asarray(slots_p.reshape(B, BAND, gmax)),
         jnp.asarray(counts_p.reshape(B, BAND, gmax)),
+        jnp.asarray(nslots_p.reshape(B, BAND)),
         jnp.asarray(free_t),
         jnp.asarray(req_t),
         jnp.asarray(cap_p),
