@@ -110,6 +110,21 @@ class Operator:
         return self.cluster.apply(admit(obj))
 
 
+def _aws_pricing(cloud):
+    """A PricingClient when (and only when) the backend is the AWS
+    adapter; memoized on the backend so every source shares one client."""
+    from ..providers.aws.backend import AwsCloudBackend
+
+    if not isinstance(cloud, AwsCloudBackend):
+        return None
+    client = getattr(cloud, "_pricing_client", None)
+    if client is None:
+        from ..providers.aws import PricingClient
+
+        client = cloud._pricing_client = PricingClient(cloud.session, cloud.ec2)
+    return client
+
+
 def _build_solver(options: Options):
     if options.solver_backend == "host":
         return HostSolver()
@@ -184,14 +199,27 @@ def new_operator(
     # backend/credentials must fail operator construction loudly, before
     # any provider consumes (or swallows) the first error.
     try:
-        cloud.describe_availability_zones()
+        zone_types = cloud.describe_availability_zones()
     except Exception as e:
         raise RuntimeError(
             f"cloud backend connectivity preflight failed: {type(e).__name__}: {e}"
         ) from e
 
     pricing = PricingProvider(isolated_vpc=options.isolated_vpc)
+    # The catalog's zone axis ADOPTS the backend's zones (the preflight
+    # already fetched them): live feeds key spot prices and offerings by
+    # the cloud's real AZ names, and a catalog stuck on its synthetic
+    # defaults would silently never match them (round-5 live-pricing drive
+    # caught exactly this).
+    # availability zones only: local/wavelength zones carry a tiny subset
+    # of types (cloudprovider.py zone-type gating handles launches there);
+    # putting them on the synthetic-catalog zone axis would fabricate
+    # offerings that don't exist
+    zones = tuple(sorted(
+        z for z, zt in zone_types.items() if zt == "availability-zone"
+    )) if zone_types else None
     catalog = CatalogProvider(
+        **({"zones": zones} if zones else {}),
         pricing=pricing,
         overhead=OverheadOptions(
             vm_memory_overhead_percent=options.vm_memory_overhead_percent,
@@ -255,6 +283,7 @@ def new_operator(
         recorder=recorder,
         spot_to_spot=options.gate("SpotToSpot", False),
     )
+    live_pricing = _aws_pricing(cloud) if not options.isolated_vpc else None
     controllers = [
         NodeClassStatusController(cluster, cloudprovider),
         NodeClassHashController(cluster),
@@ -268,7 +297,23 @@ def new_operator(
         LivenessController(cluster, clock=clock, recorder=recorder),
         NodeClassTerminationController(cluster, cloudprovider),
         CatalogRefreshController(catalog),
-        PricingRefreshController(catalog),
+        # Live pricing refresh sources when the AWS backend is wired
+        # (pricing.go:158-296 parity: GetProducts OD fan-out + spot
+        # history BATCHED BY the catalog's own types); isolated-VPC skips
+        # entirely (pricing.go:164-170 — don't even build the client).
+        PricingRefreshController(
+            catalog,
+            od_source=live_pricing and (
+                lambda: live_pricing.fetch_on_demand(
+                    cloud.session.region or "us-east-1"
+                )
+            ),
+            spot_source=live_pricing and (
+                lambda: live_pricing.fetch_spot(
+                    [t.name for t in catalog.list()]
+                )
+            ),
+        ),
         VersionRefreshController(version_provider),
     ]
     # parity: interruption controller registered iff a queue is configured

@@ -455,6 +455,89 @@ class TestOperatorWiring:
             server.shutdown()
             server.server_close()
 
+    def test_live_pricing_refresh_through_operator(self, monkeypatch):
+        """--cloud-backend=aws wires the PricingRefreshController to the
+        live Pricing/spot clients (pricing.go:158-296); one reconcile
+        updates the catalog's prices from the wire."""
+        import urllib.parse
+
+        from karpenter_provider_aws_tpu.utils.httpserve import (
+            QuietHandler,
+            serve_http,
+        )
+
+        price_item = json.dumps({
+            "product": {"attributes": {"instanceType": "c5.large"}},
+            "terms": {"OnDemand": {"X": {"priceDimensions": {"Y": {
+                "pricePerUnit": {"USD": "9.9900000000"}}}}}},
+        })
+
+        class Handler(QuietHandler):
+            def do_POST(self):
+                ln = int(self.headers.get("Content-Length", "0"))
+                raw = self.rfile.read(ln).decode()
+                if "json" in (self.headers.get("Content-Type") or ""):
+                    self.reply(200, json.dumps(
+                        {"PriceList": [price_item]}
+                    ).encode(), "application/json")
+                    return
+                body = dict(urllib.parse.parse_qsl(raw))
+                action = body.get("Action", "")
+                xml = {
+                    "DescribeAvailabilityZones": (
+                        "<r><availabilityZoneInfo><item>"
+                        "<zoneName>us-east-1a</zoneName>"
+                        "<zoneType>availability-zone</zoneType>"
+                        "</item></availabilityZoneInfo></r>"
+                    ),
+                    "DescribeSpotPriceHistory": (
+                        "<r><spotPriceHistorySet><item>"
+                        "<instanceType>c5.large</instanceType>"
+                        "<availabilityZone>us-east-1a</availabilityZone>"
+                        "<spotPrice>0.123</spotPrice>"
+                        "<timestamp>2026-07-31T00:00:00Z</timestamp>"
+                        "</item></spotPriceHistorySet></r>"
+                    ),
+                }.get(action, "<r/>")
+                self.reply(200, xml.encode(), "text/xml")
+
+            def do_GET(self):
+                self.reply(200, json.dumps({"cluster": {
+                    "endpoint": "https://example.eks", "version": "1.29",
+                    "kubernetesNetworkConfig": {"serviceIpv4Cidr": "10.100.0.0/16"},
+                }}).encode(), "application/json")
+
+        server = serve_http(Handler, 0, host="127.0.0.1")
+        port = server.server_address[1]
+        monkeypatch.setenv("AWS_ENDPOINT_URL", f"http://127.0.0.1:{port}")
+        monkeypatch.setenv("AWS_ACCESS_KEY_ID", "AKIDTEST")
+        monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "secret")
+        monkeypatch.setenv("AWS_REGION", "us-east-1")
+        from karpenter_provider_aws_tpu.controllers.refresh import (
+            PricingRefreshController,
+        )
+        from karpenter_provider_aws_tpu.operator.operator import new_operator
+        from karpenter_provider_aws_tpu.operator.options import Options
+
+        try:
+            op = new_operator(options=Options(
+                cloud_backend="aws", solver_backend="host", metrics_port=0,
+            ))
+            pricing_ctrl = next(
+                c for c in op.manager.controllers
+                if isinstance(c, PricingRefreshController)
+            )
+            assert pricing_ctrl.od_source is not None
+            assert pricing_ctrl.spot_source is not None
+            pricing_ctrl.reconcile()
+            it = op.catalog.get("c5.large")
+            assert op.catalog.pricing.on_demand_price(it) == 9.99
+            assert op.catalog.pricing.spot_price(it, "us-east-1a") == 0.123
+            op.stop()
+        finally:
+            server.shutdown()
+            server.server_close()
+
     def test_bad_credentials_fail_preflight_loudly(self, monkeypatch):
         from karpenter_provider_aws_tpu.operator.operator import new_operator
         from karpenter_provider_aws_tpu.operator.options import Options
