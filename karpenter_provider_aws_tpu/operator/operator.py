@@ -110,21 +110,6 @@ class Operator:
         return self.cluster.apply(admit(obj))
 
 
-def _aws_pricing(cloud):
-    """A PricingClient when (and only when) the backend is the AWS
-    adapter; memoized on the backend so every source shares one client."""
-    from ..providers.aws.backend import AwsCloudBackend
-
-    if not isinstance(cloud, AwsCloudBackend):
-        return None
-    client = getattr(cloud, "_pricing_client", None)
-    if client is None:
-        from ..providers.aws import PricingClient
-
-        client = cloud._pricing_client = PricingClient(cloud.session, cloud.ec2)
-    return client
-
-
 def _build_solver(options: Options):
     if options.solver_backend == "host":
         return HostSolver()
@@ -218,6 +203,14 @@ def new_operator(
     zones = tuple(sorted(
         z for z, zt in zone_types.items() if zt == "availability-zone"
     )) if zone_types else None
+    if zone_types and not zones:
+        # falling back to synthetic defaults here would recreate the
+        # silent zone-name mismatch this adoption exists to fix — fail
+        # like the preflight does
+        raise RuntimeError(
+            "cloud backend reported zones but none typed "
+            f"'availability-zone': {zone_types!r}"
+        )
     catalog = CatalogProvider(
         **({"zones": zones} if zones else {}),
         pricing=pricing,
@@ -283,7 +276,20 @@ def new_operator(
         recorder=recorder,
         spot_to_spot=options.gate("SpotToSpot", False),
     )
-    live_pricing = _aws_pricing(cloud) if not options.isolated_vpc else None
+    from ..providers.aws.backend import AwsCloudBackend
+
+    live_pricing = None
+    pricing_region = "us-east-1"
+    if isinstance(cloud, AwsCloudBackend) and not options.isolated_vpc:
+        from ..providers.aws import PricingClient
+
+        live_pricing = PricingClient(cloud.session, cloud.ec2)
+        pricing_region = cloud.session.region or options.aws_region or "us-east-1"
+        if not cloud.session.region:
+            log.warning(
+                "no AWS region configured; pricing refresh filters by %s",
+                pricing_region,
+            )
     controllers = [
         NodeClassStatusController(cluster, cloudprovider),
         NodeClassHashController(cluster),
@@ -304,9 +310,7 @@ def new_operator(
         PricingRefreshController(
             catalog,
             od_source=live_pricing and (
-                lambda: live_pricing.fetch_on_demand(
-                    cloud.session.region or "us-east-1"
-                )
+                lambda: live_pricing.fetch_on_demand(pricing_region)
             ),
             spot_source=live_pricing and (
                 lambda: live_pricing.fetch_spot(

@@ -14,6 +14,7 @@ Three layers of proof:
     error taxonomy (ICE -> InsufficientCapacityError etc.).
 """
 
+import contextlib
 import json
 import pathlib
 
@@ -389,46 +390,72 @@ class TestPricingContracts:
         assert spot == {("c5.large", "us-east-1a"): 0.0337}
 
 
+@contextlib.contextmanager
+def fake_aws_endpoint(monkeypatch, zones=("us-east-1a",),
+                      query_responder=None, json_responder=None):
+    """ONE local fake-AWS endpoint for the operator-wire tests: query
+    protocol (form POST) dispatched by Action with a default
+    DescribeAvailabilityZones answer, json protocol (Pricing) via
+    ``json_responder``, EKS DescribeCluster on GET. Wires the env
+    (endpoint override + creds + region) and yields the recorded action
+    list. ``query_responder(action, params) -> xml | None`` overrides any
+    query action."""
+    import urllib.parse
+
+    from karpenter_provider_aws_tpu.utils.httpserve import (
+        QuietHandler,
+        serve_http,
+    )
+
+    az_items = "".join(
+        f"<item><zoneName>{z}</zoneName>"
+        f"<zoneType>availability-zone</zoneType></item>" for z in zones
+    )
+    az_xml = f"<r><availabilityZoneInfo>{az_items}</availabilityZoneInfo></r>"
+    actions: list[str] = []
+
+    class Handler(QuietHandler):
+        def do_POST(self):
+            ln = int(self.headers.get("Content-Length", "0"))
+            raw = self.rfile.read(ln).decode()
+            if "json" in (self.headers.get("Content-Type") or ""):
+                actions.append(self.headers.get("X-Amz-Target", "json"))
+                out = json_responder(json.loads(raw)) if json_responder else {}
+                self.reply(200, json.dumps(out).encode(), "application/json")
+                return
+            params = dict(urllib.parse.parse_qsl(raw))
+            action = params.get("Action", "")
+            actions.append(action)
+            xml = query_responder(action, params) if query_responder else None
+            if xml is None:
+                xml = az_xml if action == "DescribeAvailabilityZones" else "<r/>"
+            self.reply(200, xml.encode(), "text/xml")
+
+        def do_GET(self):  # EKS DescribeCluster (rest-json)
+            actions.append("DescribeCluster")
+            self.reply(200, json.dumps({"cluster": {
+                "endpoint": "https://example.eks",
+                "version": "1.29",
+                "kubernetesNetworkConfig": {"serviceIpv4Cidr": "10.100.0.0/16"},
+            }}).encode(), "application/json")
+
+    server = serve_http(Handler, 0, host="127.0.0.1")
+    monkeypatch.setenv(
+        "AWS_ENDPOINT_URL", f"http://127.0.0.1:{server.server_address[1]}"
+    )
+    monkeypatch.setenv("AWS_ACCESS_KEY_ID", "AKIDTEST")
+    monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "secret")
+    monkeypatch.setenv("AWS_REGION", "us-east-1")
+    try:
+        yield server, actions
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
 class TestOperatorWiring:
     """--cloud-backend=aws builds the whole control plane over the signed
     adapter against a local HTTP endpoint — real sockets, zero cloud."""
-
-    def _fake_aws(self):
-        import urllib.parse
-
-        from karpenter_provider_aws_tpu.utils.httpserve import (
-            QuietHandler,
-            serve_http,
-        )
-
-        actions: list[str] = []
-
-        class Handler(QuietHandler):
-            def do_POST(self):
-                ln = int(self.headers.get("Content-Length", "0"))
-                body = dict(urllib.parse.parse_qsl(self.rfile.read(ln).decode()))
-                action = body.get("Action", "")
-                actions.append(action)
-                xml = {
-                    "DescribeAvailabilityZones": (
-                        "<r><availabilityZoneInfo><item>"
-                        "<zoneName>us-east-1a</zoneName>"
-                        "<zoneType>availability-zone</zoneType>"
-                        "</item></availabilityZoneInfo></r>"
-                    ),
-                }.get(action, "<r/>")
-                self.reply(200, xml.encode(), "text/xml")
-
-            def do_GET(self):  # EKS DescribeCluster (rest-json)
-                actions.append("DescribeCluster")
-                self.reply(200, json.dumps({"cluster": {
-                    "endpoint": "https://example.eks",
-                    "version": "1.29",
-                    "kubernetesNetworkConfig": {"serviceIpv4Cidr": "10.100.0.0/16"},
-                }}).encode(), "application/json")
-
-        server = serve_http(Handler, 0, host="127.0.0.1")
-        return server, actions
 
     def test_new_operator_with_aws_backend(self, monkeypatch):
         from karpenter_provider_aws_tpu.operator.operator import new_operator
@@ -437,89 +464,49 @@ class TestOperatorWiring:
             AwsCloudBackend,
         )
 
-        server, actions = self._fake_aws()
-        port = server.server_address[1]
-        monkeypatch.setenv("AWS_ENDPOINT_URL", f"http://127.0.0.1:{port}")
-        monkeypatch.setenv("AWS_ACCESS_KEY_ID", "AKIDTEST")
-        monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "secret")
-        monkeypatch.setenv("AWS_REGION", "us-east-1")
-        try:
+        with fake_aws_endpoint(monkeypatch) as (server, actions):
             op = new_operator(options=Options(
                 cloud_backend="aws", solver_backend="host", metrics_port=0,
             ))
             assert isinstance(op.cloudprovider.cloud, AwsCloudBackend)
             # the preflight (operator.go:205-212 parity) hit the wire
             assert "DescribeAvailabilityZones" in actions
+            # zone adoption: the catalog's axis is the backend's AZs
+            assert op.catalog.zones == ("us-east-1a",)
             op.stop()
-        finally:
-            server.shutdown()
-            server.server_close()
 
     def test_live_pricing_refresh_through_operator(self, monkeypatch):
         """--cloud-backend=aws wires the PricingRefreshController to the
         live Pricing/spot clients (pricing.go:158-296); one reconcile
         updates the catalog's prices from the wire."""
-        import urllib.parse
-
-        from karpenter_provider_aws_tpu.utils.httpserve import (
-            QuietHandler,
-            serve_http,
-        )
-
         price_item = json.dumps({
             "product": {"attributes": {"instanceType": "c5.large"}},
             "terms": {"OnDemand": {"X": {"priceDimensions": {"Y": {
                 "pricePerUnit": {"USD": "9.9900000000"}}}}}},
         })
 
-        class Handler(QuietHandler):
-            def do_POST(self):
-                ln = int(self.headers.get("Content-Length", "0"))
-                raw = self.rfile.read(ln).decode()
-                if "json" in (self.headers.get("Content-Type") or ""):
-                    self.reply(200, json.dumps(
-                        {"PriceList": [price_item]}
-                    ).encode(), "application/json")
-                    return
-                body = dict(urllib.parse.parse_qsl(raw))
-                action = body.get("Action", "")
-                xml = {
-                    "DescribeAvailabilityZones": (
-                        "<r><availabilityZoneInfo><item>"
-                        "<zoneName>us-east-1a</zoneName>"
-                        "<zoneType>availability-zone</zoneType>"
-                        "</item></availabilityZoneInfo></r>"
-                    ),
-                    "DescribeSpotPriceHistory": (
-                        "<r><spotPriceHistorySet><item>"
-                        "<instanceType>c5.large</instanceType>"
-                        "<availabilityZone>us-east-1a</availabilityZone>"
-                        "<spotPrice>0.123</spotPrice>"
-                        "<timestamp>2026-07-31T00:00:00Z</timestamp>"
-                        "</item></spotPriceHistorySet></r>"
-                    ),
-                }.get(action, "<r/>")
-                self.reply(200, xml.encode(), "text/xml")
+        def query(action, params):
+            if action == "DescribeSpotPriceHistory":
+                return (
+                    "<r><spotPriceHistorySet><item>"
+                    "<instanceType>c5.large</instanceType>"
+                    "<availabilityZone>us-east-1a</availabilityZone>"
+                    "<spotPrice>0.123</spotPrice>"
+                    "<timestamp>2026-07-31T00:00:00Z</timestamp>"
+                    "</item></spotPriceHistorySet></r>"
+                )
+            return None
 
-            def do_GET(self):
-                self.reply(200, json.dumps({"cluster": {
-                    "endpoint": "https://example.eks", "version": "1.29",
-                    "kubernetesNetworkConfig": {"serviceIpv4Cidr": "10.100.0.0/16"},
-                }}).encode(), "application/json")
-
-        server = serve_http(Handler, 0, host="127.0.0.1")
-        port = server.server_address[1]
-        monkeypatch.setenv("AWS_ENDPOINT_URL", f"http://127.0.0.1:{port}")
-        monkeypatch.setenv("AWS_ACCESS_KEY_ID", "AKIDTEST")
-        monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "secret")
-        monkeypatch.setenv("AWS_REGION", "us-east-1")
         from karpenter_provider_aws_tpu.controllers.refresh import (
             PricingRefreshController,
         )
         from karpenter_provider_aws_tpu.operator.operator import new_operator
         from karpenter_provider_aws_tpu.operator.options import Options
 
-        try:
+        with fake_aws_endpoint(
+            monkeypatch, query_responder=query,
+            json_responder=lambda payload: {"PriceList": [price_item]},
+        ):
             op = new_operator(options=Options(
                 cloud_backend="aws", solver_backend="host", metrics_port=0,
             ))
@@ -534,9 +521,99 @@ class TestOperatorWiring:
             assert op.catalog.pricing.on_demand_price(it) == 9.99
             assert op.catalog.pricing.spot_price(it, "us-east-1a") == 0.123
             op.stop()
-        finally:
-            server.shutdown()
-            server.server_close()
+
+    def test_interruption_drain_through_sqs_wire(self, monkeypatch):
+        """The full involuntary-disruption loop over the wire: operator
+        wires SqsQueueProvider from --interruption-queue (GetQueueUrl),
+        one reconcile long-polls a spot-interruption EventBridge message,
+        the claim is drained and the offering ICE-masked, and the message
+        is deleted (controller.go:83-226 + sqs.go:53-101)."""
+        import threading
+
+        state = {"instance_id": None, "deleted": [], "polls": 0, "port": 0}
+        lock = threading.Lock()
+
+        def query(a, params):
+            if a == "GetQueueUrl":
+                url = f"http://127.0.0.1:{state['port']}/123/karpenter-events"
+                return (f"<r><GetQueueUrlResult><QueueUrl>{url}</QueueUrl>"
+                        f"</GetQueueUrlResult></r>")
+            if a == "ReceiveMessage":
+                with lock:
+                    state["polls"] += 1
+                    iid = state["instance_id"]
+                    first = state["polls"] == 1
+                if iid and first:
+                    detail = json.dumps({
+                        "version": "0", "source": "aws.ec2",
+                        "detail-type": "EC2 Spot Instance Interruption Warning",
+                        "detail": {"instance-id": iid,
+                                   "instance-action": "terminate"},
+                    }).replace("<", "&lt;")
+                    return ("<r><ReceiveMessageResult><Message>"
+                            "<MessageId>m1</MessageId>"
+                            "<ReceiptHandle>rh1</ReceiptHandle>"
+                            f"<Body>{detail}</Body>"
+                            "</Message></ReceiveMessageResult></r>")
+                return "<r><ReceiveMessageResult/></r>"
+            if a == "DeleteMessage":
+                with lock:
+                    state["deleted"].append(params.get("ReceiptHandle"))
+                return "<r/>"
+            return None
+
+        from karpenter_provider_aws_tpu.controllers.interruption import (
+            InterruptionController,
+        )
+        from karpenter_provider_aws_tpu.models.nodeclaim import NodeClaim
+        from karpenter_provider_aws_tpu.operator.operator import new_operator
+        from karpenter_provider_aws_tpu.operator.options import Options
+        from karpenter_provider_aws_tpu.providers.aws import SqsQueueProvider
+
+        with fake_aws_endpoint(
+            monkeypatch, zones=("zone-a", "zone-b"), query_responder=query,
+        ) as (server, actions):
+            state["port"] = server.server_address[1]
+            op = new_operator(options=Options(
+                cloud_backend="aws", solver_backend="host", metrics_port=0,
+                interruption_queue="karpenter-events",
+            ))
+            ic = next(
+                c for c in op.manager.controllers
+                if isinstance(c, InterruptionController)
+            )
+            assert isinstance(ic.queue, SqsQueueProvider)
+            assert ic.queue.name() == "karpenter-events"
+            # a live spot claim whose instance the event names
+            claim = NodeClaim.fresh(
+                nodepool_name="default", nodeclass_name="default",
+                instance_type_options=["c5.large"], zone_options=["zone-a"],
+                capacity_type_options=["spot"],
+            )
+            claim.status.provider_id = "cloud:///zone-a/i-spot1234"
+            from karpenter_provider_aws_tpu.models import labels as lbl
+
+            claim.labels[lbl.INSTANCE_TYPE_LABEL] = "c5.large"
+            claim.labels[lbl.TOPOLOGY_ZONE] = "zone-a"
+            claim.labels[lbl.CAPACITY_TYPE] = "spot"
+            claim.status.set_condition("Launched", True)
+            op.cluster.apply(claim)
+            state["instance_id"] = "i-spot1234"
+            ic.reconcile()
+            # drained: claim marked deleted; offering ICE-masked; msg deleted
+            stored = next(
+                (c for c in op.cluster.snapshot_claims() if c.name == claim.name),
+                None,
+            )
+            # drained: marked deleted (graceful drain) or already finalized
+            assert stored is None or stored.deleted, (
+                "spot interruption must drain the claim"
+            )
+            assert op.catalog.unavailable.is_unavailable(
+                "c5.large", "zone-a", "spot"
+            )
+            assert state["deleted"] == ["rh1"]
+            op.stop()
 
     def test_bad_credentials_fail_preflight_loudly(self, monkeypatch):
         from karpenter_provider_aws_tpu.operator.operator import new_operator
