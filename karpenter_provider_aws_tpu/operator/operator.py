@@ -284,8 +284,11 @@ def new_operator(
         from ..providers.aws import PricingClient
 
         live_pricing = PricingClient(cloud.session, cloud.ec2)
-        pricing_region = cloud.session.region or options.aws_region or "us-east-1"
-        if not cloud.session.region:
+        # injected backends may carry a region-less session; options fill
+        # in, and only a true fallback to the default gets the warning
+        pricing_region = cloud.session.region or options.aws_region
+        if not pricing_region:
+            pricing_region = "us-east-1"
             log.warning(
                 "no AWS region configured; pricing refresh filters by %s",
                 pricing_region,

@@ -85,6 +85,74 @@ class TestCommitDownsize:
         assert on <= off + 1e-6
 
 
+class TestPipelinedMultiPool:
+    """The dispatch-pipelined multi-pool solve (round-5): pool k+1 is
+    dispatched on pool k's host-certain leftovers; NON-certain leftovers
+    (limits/minValues rejections) catch up sequentially."""
+
+    def test_limits_stragglers_catch_up(self, session_catalog):
+        from karpenter_provider_aws_tpu.models.nodepool import Limits
+
+        p1 = NodePool(
+            name="limited", weight=10,
+            requirements=[Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m"))],
+            limits=Limits.of(cpu="8"),
+            disruption=Disruption(consolidate_after_s=None),
+        )
+        p2 = NodePool(
+            name="overflow",
+            requirements=[Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m"))],
+            disruption=Disruption(consolidate_after_s=None),
+        )
+        # 50 cpu of demand against an 8-cpu limit: most pods are
+        # limits-REJECTED (not host-certain — the device solve places
+        # them, the host constraint pass rejects), so they must reach
+        # pool2 via the sequential catch-up, not the speculation
+        pods = make_pods(100, "w", {"cpu": "500m", "memory": "1Gi"})
+        res = TPUSolver().solve(pods, [p1, p2], session_catalog)
+        assert res.pods_placed() == 100
+        assert not res.unschedulable
+        by_pool: dict = {}
+        for s in res.node_specs:
+            by_pool[s.nodepool_name] = by_pool.get(s.nodepool_name, 0) + len(s.pods)
+        assert by_pool.get("limited", 0) > 0, "limited pool took its share"
+        assert by_pool.get("overflow", 0) >= 90, by_pool
+        # equivalence: sequential host solver lands the same split
+        host = HostSolver().solve(pods, [p1, p2], session_catalog)
+        assert host.pods_placed() == 100
+
+    def test_gpu_pods_speculate_to_accel_pool(self, session_catalog):
+        """Host-certain leftovers (no usable type in pool1) take the
+        SPECULATIVE path: both pools' programs in flight before a fetch."""
+        from karpenter_provider_aws_tpu.models.nodepool import Taint
+        from karpenter_provider_aws_tpu.models.pod import Toleration
+
+        p1 = NodePool(
+            name="default",
+            requirements=[Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m", "r"))],
+            disruption=Disruption(consolidate_after_s=None),
+        )
+        p2 = NodePool(
+            name="accel",
+            requirements=[Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("g", "p"))],
+            taints=[Taint(key="accel", value="true")],
+            disruption=Disruption(consolidate_after_s=None),
+        )
+        pods = make_pods(60, "cpu", {"cpu": "1", "memory": "2Gi"})
+        pods += make_pods(
+            8, "gpu", {"cpu": "2", "memory": "8Gi", "nvidia.com/gpu": 1},
+            tolerations=[Toleration(key="accel", value="true")],
+        )
+        res = TPUSolver().solve(pods, [p1, p2], session_catalog)
+        assert res.pods_placed() == 68
+        gpu_specs = [
+            s for s in res.node_specs
+            if any(p.requests.get("nvidia.com/gpu") > 0 for p in s.pods)
+        ]
+        assert gpu_specs
+        assert all(s.nodepool_name == "accel" for s in gpu_specs)
+
+
 class TestRefineSkip:
     def test_skip_engages_only_after_noop_refines(self, session_catalog, monkeypatch):
         import karpenter_provider_aws_tpu.scheduling.solver as S
