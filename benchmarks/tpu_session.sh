@@ -1,0 +1,24 @@
+#!/bin/bash
+# One budgeted TPU measurement session (run when the tunnel is healthy;
+# NEVER alongside another TPU process, NEVER under a killing timeout —
+# see .claude/skills/verify/SKILL.md gotchas).
+#
+#   bash benchmarks/tpu_session.sh
+#
+# 1. bench.py full run (probe + headline + config sweep) — rows stream to
+#    BENCH_DETAIL.jsonl, one JSON line on stdout.
+# 2. Pallas FFD attribution (xla vs pallas at narrow + headline shapes).
+# 3. BENCH_SUMMARY.md regeneration.
+set -eu
+cd "$(dirname "$0")/.."
+
+echo "== phase 1: bench.py (full) ==" >&2
+# set -e makes a bench.py failure abort the session: regenerating the
+# summary from a partial sweep would present incomplete numbers as done
+BENCH_TOTAL_BUDGET_S=${BENCH_TOTAL_BUDGET_S:-1080} python bench.py
+
+echo "== phase 2: pallas attribution ==" >&2
+python -m benchmarks.pallas_attribution || echo "attribution failed (non-fatal)" >&2
+
+echo "== phase 3: summary ==" >&2
+python -m benchmarks.report

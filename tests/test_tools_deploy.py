@@ -207,6 +207,17 @@ class TestDeployRender:
             srv.stop()
 
 
+class TestGeneratedDocs:
+    def test_api_reference_is_current(self):
+        """docs/api.md must match what the generator emits from the live
+        CRD schemas — a schema change without a doc regen fails here."""
+        gen = _load("tools/gen_api_docs.py", "gen_api_docs_mod")
+        committed = (REPO / "docs" / "api.md").read_text()
+        assert committed == gen.build_doc(), (
+            "docs/api.md is stale; run python tools/gen_api_docs.py"
+        )
+
+
 class TestKompat:
     def test_matrix_and_window(self):
         kompat = _load("tools/kompat.py", "kompat_mod")

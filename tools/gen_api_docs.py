@@ -1,0 +1,121 @@
+"""Generate docs/api.md from the CRD schemas — the API-reference page of
+the reference's website (karpenter.sh docs 'NodePools'/'NodeClasses'
+pages), derived from the SAME artifacts the apiserver would enforce so the
+docs cannot drift from the schema.
+
+Run: python tools/gen_api_docs.py
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+
+def _type_of(schema: dict) -> str:
+    t = schema.get("type", "object")
+    if t == "array":
+        return f"[]{_type_of(schema.get('items', {}))}"
+    if t == "object" and isinstance(schema.get("additionalProperties"), dict):
+        return f"map[string]{_type_of(schema['additionalProperties'])}"
+    if "enum" in schema:
+        return " \\| ".join(f"`{v}`" for v in schema["enum"])
+    return t
+
+
+def _constraints(schema: dict) -> str:
+    out = []
+    for k, label in (("minimum", "min"), ("maximum", "max"),
+                     ("maxItems", "maxItems"), ("pattern", "pattern")):
+        if k in schema:
+            v = schema[k]
+            if k == "pattern":
+                # '|' splits GFM table cells even inside backticks
+                out.append(f"{label} `{str(v).replace('|', chr(92) + '|')}`")
+            else:
+                out.append(f"{label} {v}")
+    return ", ".join(out)
+
+
+def _walk(schema: dict, path: str, rows: list, rules: list) -> None:
+    for rule in schema.get("x-kubernetes-validations", ()):
+        rules.append((path or ".", rule["rule"], rule.get("message", "")))
+    props = schema.get("properties", {})
+    required = set(schema.get("required", ()))
+    for name, sub in props.items():
+        p = f"{path}.{name}" if path else name
+        rows.append((
+            p, _type_of(sub), "yes" if name in required else "",
+            _constraints(sub),
+        ))
+        _walk(sub, p, rows, rules)
+    if isinstance(schema.get("items"), dict):
+        _walk(schema["items"], f"{path}[]", rows, rules)
+    if isinstance(schema.get("additionalProperties"), dict):
+        _walk(schema["additionalProperties"], f"{path}.*", rows, rules)
+
+
+def render(kind: str, crd: dict) -> list[str]:
+    spec = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+    rows: list = []
+    rules: list = []
+    _walk(spec.get("properties", {}).get("spec", {}), "spec", rows, rules)
+    lines = [
+        f"## {kind}",
+        "",
+        f"`apiVersion: {crd['spec']['group']}/"
+        f"{crd['spec']['versions'][0]['name']}` · "
+        f"`kind: {kind}` · scope `{crd['spec']['scope']}`",
+        "",
+        "| Field | Type | Required | Constraints |",
+        "|---|---|---|---|",
+    ]
+    for p, t, req, cons in rows:
+        lines.append(f"| `{p}` | {t} | {req} | {cons} |")
+    if rules:
+        lines += [
+            "",
+            f"### {kind} validation rules (CEL, enforced at admission)",
+            "",
+            "| Scope | Rule | Message |",
+            "|---|---|---|",
+        ]
+        for path, rule, msg in rules:
+            esc = rule.replace("|", "\\|")
+            lines.append(f"| `{path}` | `{esc}` | {msg} |")
+    lines.append("")
+    return lines
+
+
+def build_doc() -> str:
+    """The full docs/api.md content — ONE builder shared by main() and the
+    currency test, so a header edit can't desync them."""
+    from karpenter_provider_aws_tpu.operator import crds
+
+    lines = [
+        "# API reference",
+        "",
+        "GENERATED from the CRD schemas (`operator/crds.py`) — regenerate",
+        "with `python tools/gen_api_docs.py`. These are the same artifacts",
+        "the apiserver enforces (and `tests/test_cel_rules.py` pins), so",
+        "this page cannot drift from what admission actually accepts.",
+        "Copy-paste manifests live in [`examples/`](../examples/README.md).",
+        "",
+    ]
+    lines += render("NodePool", crds.nodepool_crd())
+    lines += render("NodeClass", crds.nodeclass_crd())
+    return "\n".join(lines)
+
+
+def main() -> int:
+    out = ROOT / "docs" / "api.md"
+    out.write_text(build_doc())
+    print(f"wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
