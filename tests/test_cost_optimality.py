@@ -153,6 +153,42 @@ class TestPipelinedMultiPool:
         assert all(s.nodepool_name == "accel" for s in gpu_specs)
 
 
+class TestSparsePlanSelfSizing:
+    """Round-5 config2 fix: an overflowing sparse-plan buffer silently
+    cost a dense-fallback fetch every solve (the overflow->dense-fallback
+    CORRECTNESS is pinned in test_solve_caches.py; here we pin the
+    history->buffer-size plumbing, which only matters above the static
+    floor and so can't be reached by a naturally-sized hermetic plan)."""
+
+    def test_observed_nonzeros_grow_the_buffer(self, session_catalog, monkeypatch):
+        from karpenter_provider_aws_tpu.ops import ffd as ffd_mod
+
+        orig_compact = ffd_mod.compact_plan
+        calls: list = []
+
+        def spy_compact(placed, max_entries):
+            calls.append(max_entries)
+            return orig_compact(placed, max_entries)
+
+        # solver imports compact_plan from ops.ffd inside dispatch —
+        # patching the source module is the one effective patch point
+        monkeypatch.setattr(ffd_mod, "compact_plan", spy_compact)
+
+        pods = make_pods(96, "w", {"cpu": "500m", "memory": "1Gi"})
+        pool = _pool()
+        tpu = TPUSolver()
+        tpu.solve(pods, [pool], session_catalog)
+        assert calls, "dispatch must size a sparse buffer"
+        floor = calls[-1]
+        # a prior solve that observed MANY nonzeros (a config2-scale plan)
+        # must size the next buffer past the static floor
+        key = next(iter(tpu._nz_hist))
+        tpu._nz_hist[key] = 50_000
+        tpu.solve(pods, [pool], session_catalog)
+        assert calls[-1] >= 75_000, (calls[-1], floor)
+        assert calls[-1] > floor
+
+
 class TestRefineSkip:
     def test_skip_engages_only_after_noop_refines(self, session_catalog, monkeypatch):
         import karpenter_provider_aws_tpu.scheduling.solver as S
