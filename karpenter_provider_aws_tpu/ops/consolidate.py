@@ -494,12 +494,32 @@ def consolidatable(ct: ClusterTensors, chunk: int = 512) -> np.ndarray:
         from .repack_pallas import repack_check_pallas
 
         cand = np.arange(N, dtype=np.int32)
-        out[:] = repack_check_pallas(
-            ct.free, ct.requests, gids_s, gcounts_s,
-            screen_cap, cand,
-        )
-        out &= ~ct.blocked
-        return out
+        try:
+            out[:] = repack_check_pallas(
+                ct.free, ct.requests, gids_s, gcounts_s,
+                screen_cap, cand,
+            )
+            out &= ~ct.blocked
+            return out
+        except Exception as e:
+            import os
+
+            # only a REAL pin (a valid backend name) forfeits the
+            # fallback; "auto", unset, or a typo all keep it — the
+            # auto-selected case is exactly what the fallback protects
+            if os.environ.get("KARPENTER_TPU_REPACK") in (
+                "vmap", "pallas", "native", "mesh"
+            ):
+                raise  # explicitly pinned: fail loudly, don't mask
+            # auto-selected kernel hit a lowering/runtime gap: the
+            # disruption pass must not die for it — fall through to the
+            # vmap path, LOUDLY (same policy as the FFD auto-race)
+            import logging
+
+            logging.getLogger("karpenter.tpu.consolidate").warning(
+                "pallas repack backend failed; using the vmap screen: "
+                "%s: %s", type(e).__name__, e,
+            )
     if backend == "mesh":
         from ..parallel import make_mesh, screen_sharded
 

@@ -153,3 +153,45 @@ class TestNativeRepack:
         monkeypatch.setenv("KARPENTER_TPU_REPACK", "vmap")
         ref = consolidatable(ct)
         assert (got == ref).all()
+
+
+class TestAutoFallback:
+    """Round-5: an auto-selected pallas repack that hits a lowering/runtime
+    gap must not kill the disruption pass — it falls to the vmap screen,
+    loudly; an EXPLICITLY pinned backend still raises."""
+
+    def _ct(self):
+        from benchmarks.solve_configs import _synth_cluster
+        from karpenter_provider_aws_tpu.ops.consolidate import encode_cluster
+
+        env = _synth_cluster(n_nodes=40, pods_per_node=3)
+        return encode_cluster(env.cluster, env.catalog)
+
+    def test_auto_falls_back_pinned_raises(self, monkeypatch):
+        import karpenter_provider_aws_tpu.ops.consolidate as C
+        import karpenter_provider_aws_tpu.ops.repack_pallas as RP
+
+        ct = self._ct()
+        # the reference answer FIRST, before any patching
+        monkeypatch.setenv("KARPENTER_TPU_REPACK", "vmap")
+        ref = C.consolidatable(ct)
+        assert ref.any(), "scenario must have consolidatable nodes"
+        monkeypatch.delenv("KARPENTER_TPU_REPACK")
+
+        monkeypatch.setattr(C, "_repack_backend", lambda ct: "pallas")
+
+        def boom(*a, **k):
+            raise RuntimeError("synthetic lowering gap")
+
+        monkeypatch.setattr(RP, "repack_check_pallas", boom)
+        # auto (env unset): vmap fallback producing the REAL answer
+        ok = C.consolidatable(ct)
+        assert ok.shape == ref.shape == (40,)
+        assert (ok == ref).all()
+        # KARPENTER_TPU_REPACK=auto explicitly set still keeps the fallback
+        monkeypatch.setenv("KARPENTER_TPU_REPACK", "auto")
+        assert (C.consolidatable(ct) == ref).all()
+        # a REAL pin forfeits it: loud failure
+        monkeypatch.setenv("KARPENTER_TPU_REPACK", "pallas")
+        with pytest.raises(RuntimeError, match="synthetic"):
+            C.consolidatable(ct)
