@@ -67,8 +67,27 @@ def _remaining() -> float:
     return TOTAL_BUDGET_S - (time.time() - _T0)
 
 
+def stamp(row: dict, **overrides) -> dict:
+    """Attach the provenance stamp (device, backend, git sha — trace/
+    provenance.py) to a bench row. jax-free in the parent: device_info
+    only reads an ALREADY-imported jax, so the parent stamps 'host'."""
+    from karpenter_provider_aws_tpu.trace.provenance import stamp_row
+
+    return stamp_row(row, **overrides)
+
+
 def emit(obj: dict) -> None:
-    """The one stdout JSON line. Everything else goes to stderr."""
+    """The one stdout JSON line. Everything else goes to stderr.
+
+    REFUSES rows without a provenance stamp (the round-5 verdict's fix:
+    a bench row must never again be silent about device/backend/revision).
+    Every producer stamps at the source; this is the backstop that makes
+    an unstamped row a loud bug instead of an ambiguous artifact."""
+    if "provenance" not in obj:
+        raise ValueError(
+            "refusing to emit bench row without provenance stamp: "
+            f"{obj.get('metric') or obj.get('benchmark') or obj}"
+        )
     sys.stdout.write(json.dumps(obj) + "\n")
     sys.stdout.flush()
 
@@ -114,10 +133,12 @@ def child_host() -> None:
     def write_rows(rows):
         # stream IMMEDIATELY: a later step timing out must not lose rows
         # already measured (the module's core contract)
-        stamp = {"run_at_unix": int(time.time())}
+        at = {"run_at_unix": int(time.time())}
         with open(DETAIL_PATH, "a") as f:
             for row in rows:
-                f.write(json.dumps({**row, **stamp}) + "\n")
+                if "provenance" not in row:
+                    stamp(row)
+                f.write(json.dumps({**row, **at}) + "\n")
 
     with contextlib.redirect_stdout(sys.stderr):
         write_rows(run_interruption())
@@ -385,6 +406,10 @@ def child_measure() -> None:
             print(f"pallas headline skipped: {type(e).__name__}: {e}", file=sys.stderr)
             result["pallas_error"] = f"{type(e).__name__}: {e}"[:200]
 
+    # jax is live in this child: the stamp carries the real platform +
+    # device count alongside the measured backend and problem scale
+    stamp(result, backend=result["backend"],
+          scale={"pods": num_pods, "types": n_catalog, "iters": iters})
     emit(result)
 
 
@@ -396,11 +421,13 @@ def child_multichip() -> None:
     from benchmarks.multichip_bench import run_all as run_multichip
 
     scale = float(os.environ.get("BENCH_MULTICHIP_SCALE", "1.0"))
-    stamp = {"run_at_unix": int(time.time()), "scale": scale}
+    at = {"run_at_unix": int(time.time()), "scale": scale}
 
     def on_row(row):
+        if "provenance" not in row:
+            stamp(row)
         with open(DETAIL_PATH, "a") as f:
-            f.write(json.dumps({**row, **stamp}) + "\n")
+            f.write(json.dumps({**row, **at}) + "\n")
 
     with contextlib.redirect_stdout(sys.stderr):
         run_multichip(scale=scale, on_row=on_row)
@@ -417,11 +444,13 @@ def child_configs() -> None:
 
     scale = float(os.environ.get("BENCH_CONFIG_SCALE", "1.0"))
     iters = int(os.environ.get("BENCH_CONFIG_ITERS", "30"))
-    stamp = {"run_at_unix": int(time.time()), "scale": scale}
+    at = {"run_at_unix": int(time.time()), "scale": scale}
 
     def on_row(row):
+        if "provenance" not in row:
+            stamp(row)
         with open(DETAIL_PATH, "a") as f:
-            f.write(json.dumps({**row, **stamp}) + "\n")
+            f.write(json.dumps({**row, **at}) + "\n")
 
     with contextlib.redirect_stdout(sys.stderr):
         run_all(scale=scale, iters=iters, on_row=on_row)
@@ -514,14 +543,14 @@ def probe_backend(window: float) -> tuple[bool, str]:
 
 def main() -> None:
     phases = os.environ.get("BENCH_PHASES", "host,cpu,probe,tpu,configs").split(",")
-    fallback_line = {
+    fallback_line = stamp({
         "metric": "p99_ffd_solve_latency",
         "value": None,
         "unit": "ms",
         "vs_baseline": 0.0,
         "error": "no measurement completed",
         "device": "none",
-    }
+    })
 
     # Watchdog: if anything impossible hangs the parent (it shouldn't —
     # every child has a hard timeout), emit whatever we have and exit 0.
@@ -529,6 +558,8 @@ def main() -> None:
 
     def _alarm(signum, frame):
         log("WATCHDOG fired — emitting best available line")
+        if "provenance" not in state["line"]:
+            stamp(state["line"])  # the emergency line must emit, not refuse
         emit(state["line"])
         os._exit(0)
 
@@ -623,6 +654,8 @@ def main() -> None:
         line["probe_error"] = probe_info[:400]
     if errors:
         line["phase_errors"] = [e[:200] for e in errors[:6]]
+    if "provenance" not in line:  # a child line predating the stamp contract
+        stamp(line)
     emit(line)
     signal.alarm(0)
     sys.exit(0)
@@ -639,13 +672,13 @@ if __name__ == "__main__":
                 traceback.print_exc()
                 if child == "measure":
                     # the parent parses stdout; an error line beats silence
-                    emit({
+                    emit(stamp({
                         "metric": "p99_ffd_solve_latency",
                         "value": None,
                         "unit": "ms",
                         "vs_baseline": 0.0,
                         "error": f"{type(e).__name__}: {e}"[:800],
-                    })
+                    }))
                 sys.exit(1)
             sys.exit(0)
     main()

@@ -62,10 +62,21 @@ def fmt(row: dict) -> str:
         if k in row and row[k] is not None:
             v = row[k]
             bits.append(f"{k}={v:,.3f}" if isinstance(v, float) else f"{k}={v}")
-    if "device" in row:
-        bits.append(f"[{row['device']}]")
-    if "backend" in row:
-        bits.append(f"[{row['backend']}]")
+    prov = row.get("provenance")
+    if isinstance(prov, dict):
+        # the provenance stamp is authoritative for device/backend — a row
+        # can no longer publish a number whose hardware is ambiguous
+        label = f"{prov.get('device', '?')}/{prov.get('backend', '?')}"
+        if prov.get("fallback"):
+            label += "(fallback)"
+        sha = prov.get("git_sha", "")
+        bits.append(f"[{label}@{sha}]" if sha else f"[{label}]")
+    else:
+        if "device" in row:
+            bits.append(f"[{row['device']}]")
+        if "backend" in row:
+            bits.append(f"[{row['backend']}]")
+        bits.append("[UNSTAMPED]")
     return " · ".join(bits)
 
 

@@ -368,6 +368,12 @@ def _run_config(name, pods, pools, catalog, iters=DEFAULT_ITERS, link=None):
     if link:
         out["link_rtt_p50_ms"] = link["p50_ms"]
         out["link_rtt_p99_ms"] = link["p99_ms"]
+    # the row carries the LAST timed solve's provenance record verbatim —
+    # device kind, backend (fallbacks named), scale, per-phase ms, git sha
+    # (bench.py refuses rows without one)
+    if r.provenance is not None:
+        out["backend"] = r.provenance.backend
+        out["provenance"] = r.provenance.as_dict()
     return out
 
 
@@ -468,7 +474,10 @@ def config4_consolidation(n_nodes=5000, iters=5):
         "encode_ms": round(encode_ms, 1),
         "device": jax.default_backend(),
     }
+    from karpenter_provider_aws_tpu.trace.provenance import last_record, stamp_row
+
     mask = None
+    prov_by_backend = {}
     for backend in backends:
         os.environ["KARPENTER_TPU_REPACK"] = backend
         try:
@@ -480,6 +489,10 @@ def config4_consolidation(n_nodes=5000, iters=5):
                 times.append((time.perf_counter() - t0) * 1000.0)
             out[f"{backend}_p99_ms"] = round(float(np.percentile(times, 99)), 3)
             out[f"{backend}_p50_ms"] = round(float(np.percentile(times, 50)), 3)
+            # capture THIS backend's screen record now — the registry's
+            # last record after the loop would describe whichever backend
+            # ran last, not the one whose number gets published
+            prov_by_backend[backend] = last_record("consolidate.screen")
         except Exception as e:  # a backend failure must not lose the row
             out[f"{backend}_error"] = f"{type(e).__name__}: {e}"[:200]
         finally:
@@ -496,6 +509,11 @@ def config4_consolidation(n_nodes=5000, iters=5):
     else:
         out["p99_ms"] = out["p50_ms"] = None
     out["consolidatable_nodes"] = int(mask.sum()) if mask is not None else -1
+    # provenance: the record captured during the BEST backend's timed loop
+    # — its wall/fallback/device must describe the published number, not
+    # whichever backend happened to run last in the sweep
+    screen_prov = prov_by_backend.get(out.get("best_backend"))
+    stamp_row(out, provenance=screen_prov)
 
     # Full controller pass at scale: encode + device screen + the host-side
     # binary-search set validation + disruption commits (the end-to-end
@@ -595,11 +613,13 @@ def config7_steady_state(n_nodes=2000, n_pending=500, iters=DEFAULT_ITERS):
         existing = snapshot_existing_capacity(env.cluster)
         return tpu.solve(pods, pools, env.catalog, existing=existing)
 
-    res, _, times, stage_rows = _timed_solves(one, iters, snap=lambda: dict(tpu.timings))
+    res, last, times, stage_rows = _timed_solves(one, iters, snap=lambda: dict(tpu.timings))
     stage_p50, stage_p99 = _stage_percentiles(stage_rows)
     placed = res.pods_placed()  # includes binds onto live nodes
+    prov = (last or res).provenance
     return {
         "benchmark": "config7_steady_state_2k_live_nodes",
+        **({"backend": prov.backend, "provenance": prov.as_dict()} if prov else {}),
         "stage_p50_ms": stage_p50,
         "stage_p99_ms": stage_p99,
         "nodes": n_nodes,
@@ -625,6 +645,11 @@ def run_all(scale=1.0, iters=DEFAULT_ITERS, on_row=None):
     out = []
 
     def emit(row):
+        if "provenance" not in row:
+            # link-rtt and other host-built rows get the ambient stamp
+            from karpenter_provider_aws_tpu.trace.provenance import stamp_row
+
+            stamp_row(row)
         out.append(row)
         print(json.dumps(row), flush=True)
         if on_row is not None:

@@ -117,21 +117,77 @@ def _crd_docs() -> list[str]:
     ]
 
 
+KEY_PLACEHOLDER = "RENDERED-TO-FILE-SEE-STDERR"
+
+
+def _write_private(path: pathlib.Path, data: bytes) -> None:
+    """Write key-bearing content 0600. fchmod, not just the open mode:
+    the mode argument only applies at CREATION, so re-rendering over a
+    file a pre-hardening run left 0644 must still tighten it."""
+    import os
+
+    path.parent.mkdir(parents=True, exist_ok=True)
+    fd = os.open(str(path), os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    os.fchmod(fd, 0o600)
+    with os.fdopen(fd, "wb") as f:
+        f.write(data)
+
+
+def _write_key_file(path: pathlib.Path, key_b64: str) -> None:
+    """Key material lands in a 0600 file, never in a pipe: stdout gets
+    captured by shells, CI logs, and `kubectl apply -f -` transcripts —
+    none of which should hold a TLS private key."""
+    import base64
+
+    _write_private(path, base64.b64decode(key_b64))
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--values", default=str(HERE / "values.yaml"))
     ap.add_argument("--out", default="-", help="'-' for stdout, else a directory")
+    ap.add_argument(
+        "--key-out", default=str(HERE / "webhook-tls.key"),
+        help="where the generated TLS private key is written (0600) when "
+             "rendering to stdout; the streamed Secret carries a "
+             "placeholder to patch from this file",
+    )
     args = ap.parse_args()
     values = load_values(pathlib.Path(args.values))
     values.update(webhook_cert_values())
-    docs = [render((HERE / m).read_text(), values) for m in MANIFESTS]
+    key_b64 = values["webhookKeyData"]
     if args.out == "-":
+        # the private key NEVER reaches stdout: it goes to --key-out and
+        # the rendered Secret carries a placeholder the operator patches
+        # (kubectl create secret tls ... --key deploy/webhook-tls.key)
+        key_path = pathlib.Path(args.key_out)
+        _write_key_file(key_path, key_b64)
+        import base64
+
+        values["webhookKeyData"] = base64.b64encode(
+            KEY_PLACEHOLDER.encode()
+        ).decode()
+        docs = [render((HERE / m).read_text(), values) for m in MANIFESTS]
         sys.stdout.write("\n---\n".join(_crd_docs() + docs))
+        print(
+            f"webhook TLS private key written to {key_path} (0600); the "
+            "streamed Secret's tls.key is a placeholder — patch it from "
+            "that file before applying",
+            file=sys.stderr,
+        )
     else:
+        docs = [render((HERE / m).read_text(), values) for m in MANIFESTS]
         outdir = pathlib.Path(args.out)
         outdir.mkdir(parents=True, exist_ok=True)
         for name, doc in zip(MANIFESTS, docs):
-            (outdir / name).write_text(doc)
+            if name == "webhooks.yaml":
+                # this manifest embeds the serving key — 0600 like the
+                # key file, not the umask default a backup/artifact
+                # upload would sweep up world-readable
+                _write_private(outdir / name, doc.encode())
+            else:
+                (outdir / name).write_text(doc)
+        _write_key_file(outdir / "webhook-tls.key", key_b64)
         written = _import_crds().write_crds(outdir / "crds")
         print(
             f"rendered {len(MANIFESTS)} manifests + {len(written)} CRDs to {outdir}"

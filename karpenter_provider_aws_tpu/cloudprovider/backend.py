@@ -87,7 +87,10 @@ class CloudBackend(Protocol):
 
     def describe_capacity_reservations(self) -> list: ...
 
-    def describe_images(self) -> list: ...
+    # ``selector_terms`` (optional SelectorTerm sequence) lets the backend
+    # push discovery scoping into the wire call (AWS: per-term
+    # DescribeImages filters/ids/owners); None = account-wide discovery.
+    def describe_images(self, selector_terms=None) -> list: ...
 
     # -- launch templates --------------------------------------------------
     def create_launch_template(self, name: str, image_id: str, user_data: str = "",

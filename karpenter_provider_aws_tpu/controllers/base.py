@@ -14,6 +14,8 @@ import logging
 import threading
 from typing import Optional, Protocol
 
+from ..trace import span as trace_span
+
 log = logging.getLogger("karpenter.tpu")
 
 
@@ -62,7 +64,12 @@ class Manager:
         while not self._stop.is_set():
             if not self._idled(c):
                 try:
-                    c.reconcile()
+                    # flight-recorded: every reconcile is a span, so the
+                    # /metrics per-controller latency histogram and the
+                    # Chrome trace of a live manager come for free (the
+                    # span's error attr marks failing passes)
+                    with trace_span(f"controller.{c.name}"):
+                        c.reconcile()
                 except Exception as e:
                     log.exception("controller %s reconcile failed", c.name)
                     self._record_error(c, e)
@@ -99,7 +106,8 @@ class Manager:
             if self._idled(c):
                 continue
             try:
-                c.reconcile()
+                with trace_span(f"controller.{c.name}"):
+                    c.reconcile()
             except Exception as e:
                 log.exception("controller %s reconcile failed", c.name)
                 self._record_error(c, e)

@@ -361,11 +361,19 @@ class FakeCloud:
             # selector terms match on them)
             return [replace(r, tags=dict(r.tags)) for r in self.capacity_reservations.values()]
 
-    def describe_images(self) -> list[Image]:
+    def describe_images(self, selector_terms=None) -> list[Image]:
         with self._lock:
-            self._record("describe_images", None)
+            self._record("describe_images", selector_terms)
             self._maybe_fail()
-            return [i for i in self.images if not i.deprecated]
+            live = [i for i in self.images if not i.deprecated]
+            if not selector_terms:
+                return live
+            # mirror the AWS backend's wire scoping: union of per-term
+            # matches (the provider's host-side filter then re-applies)
+            return [
+                i for i in live
+                if any(t.matches(i) for t in selector_terms)
+            ]
 
     # -- launch templates --------------------------------------------------
     def create_launch_template(self, name: str, image_id: str, user_data: str = "",

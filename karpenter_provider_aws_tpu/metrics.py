@@ -182,6 +182,32 @@ REGISTRY = Registry()
 SOLVE_DURATION = REGISTRY.histogram(
     "karpenter_solver_solve_duration_seconds", "End-to-end Solve() latency"
 )
+# Per-phase solve latency, fed by the trace/ flight recorder's metrics
+# bridge (trace/export.py): encode / dispatch / device / decode spans land
+# here with a phase label, so /metrics can attribute a slow solve without
+# a profiler attach. Buckets skew low: phases are ms-scale where the
+# end-to-end solve is tens-to-hundreds of ms.
+SOLVE_PHASE_SECONDS = REGISTRY.histogram(
+    "karpenter_solver_phase_duration_seconds",
+    "Solve latency by phase (encode/dispatch/device/decode), from trace spans",
+    buckets=(0.0005, 0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 5.0),
+)
+RECONCILE_SECONDS = REGISTRY.histogram(
+    "karpenter_controller_reconcile_duration_seconds",
+    "Controller reconcile latency by controller, from trace spans "
+    "(parity: controller-runtime's controller_runtime_reconcile_time_seconds)",
+    buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0, 120.0),
+)
+AWS_REQUEST_SECONDS = REGISTRY.histogram(
+    "karpenter_aws_request_duration_seconds",
+    "Signed AWS API call latency by service, from trace spans (includes "
+    "retries; the retry count rides the span and the counter below)",
+    buckets=(0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 5.0, 30.0),
+)
+AWS_REQUEST_RETRIES = REGISTRY.counter(
+    "karpenter_aws_request_retries_total",
+    "AWS API retry attempts by service (DefaultRetryer parity)",
+)
 SOLVE_PODS = REGISTRY.counter("karpenter_solver_pods_total", "Pods passed through Solve()")
 NODES_CREATED = REGISTRY.counter("karpenter_nodes_created_total", "Nodes launched")
 NODES_TERMINATED = REGISTRY.counter("karpenter_nodes_terminated_total", "Nodes terminated")

@@ -20,21 +20,39 @@ from . import labels as lbl
 @dataclass(frozen=True)
 class SelectorTerm:
     """Discovery selector for subnets / security groups / images
-    (parity: SubnetSelectorTerm / SecurityGroupSelectorTerm / AMISelectorTerm)."""
+    (parity: SubnetSelectorTerm / SecurityGroupSelectorTerm / AMISelectorTerm).
+
+    ``owner`` (AMISelectorTerm.Owner parity) scopes the WIRE discovery
+    call (DescribeImages Owner param) — it narrows what the cloud returns
+    rather than what ``matches`` accepts host-side, since discovered
+    resource models carry no owner field to check against."""
 
     tags: tuple[tuple[str, str], ...] = ()
     id: str = ""
     name: str = ""
+    owner: str = ""
 
     @staticmethod
-    def of(id: str = "", name: str = "", **tags) -> "SelectorTerm":
-        return SelectorTerm(tags=tuple(sorted(tags.items())), id=id, name=name)
+    def of(id: str = "", name: str = "", owner: str = "", **tags) -> "SelectorTerm":
+        return SelectorTerm(
+            tags=tuple(sorted(tags.items())), id=id, name=name, owner=owner
+        )
 
     def matches(self, resource) -> bool:
         if self.id:
             return resource.id == self.id
-        if self.name and getattr(resource, "name", "") != self.name:
-            return False
+        if self.name:
+            rname = getattr(resource, "name", "")
+            if "*" in self.name or "?" in self.name:
+                # EC2 DescribeImages name filters take shell-style
+                # wildcards; the host-side enforcement point must accept
+                # exactly what the scoped wire call matched
+                import fnmatch
+
+                if not fnmatch.fnmatchcase(rname, self.name):
+                    return False
+            elif rname != self.name:
+                return False
         rtags = getattr(resource, "tags", {})
         for k, v in self.tags:
             if v == "*":
@@ -42,7 +60,9 @@ class SelectorTerm:
                     return False
             elif rtags.get(k) != v:
                 return False
-        return bool(self.tags) or bool(self.name)
+        # an owner-only term constrains at the wire (Owner param); host-side
+        # it accepts whatever that scoped discovery returned
+        return bool(self.tags) or bool(self.name) or bool(self.owner)
 
 
 @dataclass(frozen=True)

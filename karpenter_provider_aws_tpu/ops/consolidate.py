@@ -95,6 +95,13 @@ def encode_cluster(cluster, catalog, gmax: int = GMAX_DEFAULT) -> Optional[Clust
     validates the full topology semantics before any disruption commits.
     Groups are split by pod labels as well as scheduling key, so a group
     representative's labels are exact for selector-matching accounting."""
+    from ..trace import span as _span
+
+    with _span("consolidate.encode"):
+        return _encode_cluster(cluster, catalog, gmax)
+
+
+def _encode_cluster(cluster, catalog, gmax: int) -> Optional[ClusterTensors]:
     from ..models import labels as lbl
 
     # A node whose claim is already draining (deleted) is neither a
@@ -482,8 +489,38 @@ def live_slot_width(group_counts: np.ndarray) -> int:
 def consolidatable(ct: ClusterTensors, chunk: int = 512) -> np.ndarray:
     """can_delete[N]: pallas VMEM-resident kernel (one grid program per
     candidate, zero HBM traffic in the slot loop), chunked vmap lanes,
-    mesh-sharded lanes, or the C++ kernel."""
+    mesh-sharded lanes, or the C++ kernel.
+
+    Every sweep is flight-recorded (``consolidate.screen`` span) and
+    leaves a provenance record naming the backend that ACTUALLY ran —
+    including a pallas->vmap fallback — readable via
+    ``trace.last_record("consolidate.screen")``; the bench's config4 rows
+    carry it so a screen number can never be silent about its kernel."""
+    import time as _time
+
+    from ..trace import span as _span
+    from ..trace.provenance import screen_record
+
+    t0 = _time.perf_counter()
+    with _span("consolidate.screen", nodes=len(ct.node_names)) as sp:
+        out, used_backend, fallback = _screen(ct, chunk)
+        sp.set(backend=used_backend)
+        if fallback:
+            sp.set(fallback=fallback)
+    screen_record(
+        backend=used_backend, nodes=len(ct.node_names),
+        wall_ms=(_time.perf_counter() - t0) * 1e3, fallback=fallback,
+    )
+    return out
+
+
+def _screen(ct: ClusterTensors, chunk: int) -> tuple[np.ndarray, str, str]:
+    """The screen body behind ``consolidatable``: returns (mask, the
+    backend that ran, fallback reason or ""). Split out so the wrapper can
+    stamp provenance for every exit path without touching the dispatch
+    logic."""
     N = len(ct.node_names)
+    fallback = ""
     out = np.zeros(N, dtype=bool)
     backend = _repack_backend(ct)
     screen_cap = screen_cap_wire(ct)
@@ -500,7 +537,7 @@ def consolidatable(ct: ClusterTensors, chunk: int = 512) -> np.ndarray:
                 screen_cap, cand,
             )
             out &= ~ct.blocked
-            return out
+            return out, "pallas", fallback
         except Exception as e:
             import os
 
@@ -520,10 +557,11 @@ def consolidatable(ct: ClusterTensors, chunk: int = 512) -> np.ndarray:
                 "pallas repack backend failed; using the vmap screen: "
                 "%s: %s", type(e).__name__, e,
             )
+            fallback = f"{type(e).__name__}: {e}"[:200]
     if backend == "mesh":
         from ..parallel import make_mesh, screen_sharded
 
-        return screen_sharded(ct, make_mesh())
+        return screen_sharded(ct, make_mesh()), "mesh", fallback
     if backend == "native":
         from ..scheduling.native import repack_check_native
 
@@ -536,7 +574,7 @@ def consolidatable(ct: ClusterTensors, chunk: int = 512) -> np.ndarray:
             ct.compat, cand,
         )
         out &= ~ct.blocked
-        return out
+        return out, "native", fallback
     free = jnp.asarray(ct.free)
     requests = jnp.asarray(ct.requests)
     gids = jnp.asarray(gids_s)
@@ -550,7 +588,8 @@ def consolidatable(ct: ClusterTensors, chunk: int = 512) -> np.ndarray:
         out[idx] = ok[: len(idx)]
     out &= ~ct.blocked
     # an empty node is trivially "repackable"; emptiness is handled separately
-    return out
+    # "vmap-fallback" when the auto-selected pallas kernel failed into here
+    return out, ("vmap-fallback" if fallback else "vmap"), fallback
 
 
 def repack_feasible_numpy(ct: ClusterTensors, free: np.ndarray, i: int) -> Optional[np.ndarray]:

@@ -303,21 +303,52 @@ class AwsCloudBackend:
             if w.get("state") == "active"
         ]
 
-    def describe_images(self) -> list[Image]:
-        out = []
-        for w in self.ec2.describe_images(
-            filters=[{"Name": "state", "Value": ["available"]}]
-        ):
-            out.append(Image(
-                id=w.get("imageId", ""),
-                name=w.get("name", ""),
-                arch="arm64" if w.get("architecture") == "arm64" else "amd64",
-                created_seq=int(_parse_time(w.get("creationDate", ""))),
-                deprecated=bool(w.get("deprecationTime", "")
-                                and w["deprecationTime"] < _iso_now()),
-                tags=_tags(w.get("tagSet")),
-            ))
-        return out
+    def describe_images(self, selector_terms=None) -> list[Image]:
+        """Scoped image discovery (ami.go:176-199 parity): each selector
+        term becomes ITS OWN DescribeImages call with the term pushed into
+        the wire — ids as ImageId, name as a name filter, tags as tag
+        filters, owner as the Owner param — instead of one unscoped
+        describe of every AMI the account can see (tens of thousands of
+        public images, paged). Results are unioned by image id; the host-
+        side ``term.matches`` filter in ImageProvider stays the
+        enforcement point. No terms = the old account-wide discovery (the
+        family-alias path needs the full set)."""
+        base = [{"Name": "state", "Value": ["available"]}]
+        calls: list[tuple] = []  # (filters, image_ids, owners)
+        for t in (selector_terms or ()):
+            if getattr(t, "id", ""):
+                # explicit id: resolve exactly it (no state filter — a
+                # pinned AMI is the operator's call, like the reference)
+                calls.append((None, [t.id], None))
+                continue
+            fl = list(base)
+            if getattr(t, "name", ""):
+                fl.append({"Name": "name", "Value": [t.name]})
+            for k, v in getattr(t, "tags", ()):
+                if v == "*":
+                    fl.append({"Name": "tag-key", "Value": [k]})
+                else:
+                    fl.append({"Name": f"tag:{k}", "Value": [v]})
+            owner = getattr(t, "owner", "")
+            calls.append((fl, None, [owner] if owner else None))
+        if not calls:
+            calls.append((base, None, None))
+        by_id: dict[str, Image] = {}
+        for fl, ids, owners in calls:
+            for w in self.ec2.describe_images(
+                filters=fl, image_ids=ids, owners=owners
+            ):
+                img = Image(
+                    id=w.get("imageId", ""),
+                    name=w.get("name", ""),
+                    arch="arm64" if w.get("architecture") == "arm64" else "amd64",
+                    created_seq=int(_parse_time(w.get("creationDate", ""))),
+                    deprecated=bool(w.get("deprecationTime", "")
+                                    and w["deprecationTime"] < _iso_now()),
+                    tags=_tags(w.get("tagSet")),
+                )
+                by_id[img.id] = img
+        return list(by_id.values())
 
     # -- launch templates --------------------------------------------------
 

@@ -182,14 +182,29 @@ class Ec2Client:
         return _as_list(data.get("securityGroupInfo"))
 
     def describe_images(self, filters: Optional[list[dict]] = None,
-                        image_ids: Optional[list[str]] = None) -> list[dict]:
+                        image_ids: Optional[list[str]] = None,
+                        owners: Optional[list[str]] = None) -> list[dict]:
+        """DescribeImages, paginated (ami.go:176-199 parity: selector
+        terms become server-side filters/ids/owners, and big shared-AMI
+        accounts page — an unpaginated call silently truncated at the
+        service's first-page cap)."""
         params: dict = {}
         if filters:
             params["Filter"] = filters
         if image_ids:
             params["ImageId"] = image_ids
-        data = self._call("DescribeImages", params)
-        return _as_list(data.get("imagesSet"))
+        if owners:
+            params["Owner"] = owners
+        out: list[dict] = []
+        token = None
+        while True:
+            if token:
+                params["NextToken"] = token
+            data = self._call("DescribeImages", params)
+            out.extend(_as_list(data.get("imagesSet")))
+            token = data.get("nextToken")
+            if not token:
+                return out
 
     def describe_availability_zones(self) -> list[dict]:
         data = self._call("DescribeAvailabilityZones")

@@ -15,12 +15,14 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 import urllib.parse
 import xml.etree.ElementTree as ET
 from typing import Callable, Optional
 
 from ... import __version__ as _pkg_version
+from ...trace import span as trace_span
 from .sigv4 import Credentials, SignableRequest, sign
 from .transport import (
     AwsApiError,
@@ -167,6 +169,10 @@ class Session:
         self.assume_role_duration_s = assume_role_duration_s
         self.session_name = session_name
         self._assumed: Optional[Credentials] = None
+        # serializes the assume-role refresh: the interruption worker
+        # fan-out calls credentials() concurrently, and N threads seeing
+        # the same expiry must produce ONE STS AssumeRole, not N
+        self._creds_lock = threading.Lock()
         self._sleep = sleep
         self._now_amz = now_amz
         import random
@@ -174,6 +180,12 @@ class Session:
         self._rand = rand or random.random
 
     # -- credentials -------------------------------------------------------
+
+    @staticmethod
+    def _expiring(creds: Optional[Credentials]) -> bool:
+        return creds is None or (
+            creds.expiration and creds.expiration - time.time() < 60
+        )
 
     def credentials(self) -> Credentials:
         if not self.assume_role_arn:
@@ -183,11 +195,14 @@ class Session:
                     "AWS_SECRET_ACCESS_KEY or a shared credentials file"
                 )
             return self._base_creds
-        if self._assumed is None or (
-            self._assumed.expiration
-            and self._assumed.expiration - time.time() < 60
-        ):
-            self._assumed = self._assume_role()
+        # double-checked under the lock: concurrent expiry (the
+        # interruption worker fan-out) must trigger exactly one STS
+        # AssumeRole — parallel refreshes hammer STS and can interleave a
+        # stale grab of a half-written credential
+        if self._expiring(self._assumed):
+            with self._creds_lock:
+                if self._expiring(self._assumed):
+                    self._assumed = self._assume_role()
         return self._assumed
 
     def _assume_role(self) -> Credentials:
@@ -259,22 +274,47 @@ class Session:
         )
         return json.loads(resp.body) if resp.body else {}
 
+    @staticmethod
+    def _span_action(kw: dict) -> str:
+        """Human label for the request span: the query Action, the json
+        X-Amz-Target, or the REST path."""
+        params = kw.get("params")
+        if params and params.get("Action"):
+            return params["Action"]
+        if kw.get("json_target"):
+            return kw["json_target"]
+        return kw.get("path") or "/"
+
     def _retrying(self, service: str, endpoint: str, **kw) -> AwsResponse:
         """DefaultRetryer parity: MAX_RETRIES with full-jitter exponential
-        backoff on retryable codes and 5xx."""
-        attempt = 0
-        while True:
-            try:
-                return self._do(service, endpoint, creds=self.credentials(), **kw)
-            except AwsApiError as e:
-                retryable = e.code in RETRYABLE_CODES or e.status >= 500
-                if not retryable or attempt >= MAX_RETRIES:
-                    raise
-                # full-jitter: U(0, min(cap, base * 2^attempt)); SDK base
-                # 30ms scale for throttles
-                delay = self._rand() * min(5.0, 0.03 * (2 ** attempt) * 10)
-                self._sleep(delay)
-                attempt += 1
+        backoff on retryable codes and 5xx. The whole call (retries and
+        backoff sleeps included) is one flight-recorder span carrying the
+        retry count — so a reconcile stall traces straight to the throttled
+        AWS action, and /metrics gets per-service latency + retry totals."""
+        # prime the credential chain BEFORE the span: an assume-role
+        # refresh is a full STS round trip and must not be attributed to
+        # the wrapped service's latency histogram (nor report its
+        # CredentialError as this service's span error)
+        self.credentials()
+        with trace_span(f"aws.{service}", action=self._span_action(kw)) as sp:
+            attempt = 0
+            while True:
+                try:
+                    resp = self._do(
+                        service, endpoint, creds=self.credentials(), **kw
+                    )
+                    sp.set(retries=attempt, status=resp.status)
+                    return resp
+                except AwsApiError as e:
+                    retryable = e.code in RETRYABLE_CODES or e.status >= 500
+                    if not retryable or attempt >= MAX_RETRIES:
+                        sp.set(retries=attempt, error_code=e.code)
+                        raise
+                    # full-jitter: U(0, min(cap, base * 2^attempt)); SDK base
+                    # 30ms scale for throttles
+                    delay = self._rand() * min(5.0, 0.03 * (2 ** attempt) * 10)
+                    self._sleep(delay)
+                    attempt += 1
 
     @staticmethod
     def _signing_region(service: str, endpoint: str, default: str) -> str:

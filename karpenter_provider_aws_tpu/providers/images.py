@@ -32,13 +32,20 @@ class ImageProvider:
         hit = self._cache.get(key)
         if hit is not None:
             return hit
-        all_images = self.cloud.describe_images()
         if nodeclass.image_selector:
+            # selector terms ride into the backend so discovery is scoped
+            # at the wire (AWS: per-term DescribeImages filters/ids/owners
+            # + pagination) instead of describing the whole account; the
+            # host-side matches() pass below stays the enforcement point
+            all_images = self.cloud.describe_images(
+                selector_terms=list(nodeclass.image_selector)
+            )
             images = [
                 i for i in all_images
                 if any(term.matches(i) for term in nodeclass.image_selector)
             ]
         else:
+            all_images = self.cloud.describe_images()
             # family strategy's default-image queries (the SSM-alias
             # analogue, resolver.go DefaultAMIs); custom yields none —
             # selector terms are mandatory there

@@ -71,13 +71,18 @@ class SolverServer:
         error-type label, same convention as the cloudprovider metrics
         decorator."""
         from ..metrics import SIDECAR_ERRORS, SIDECAR_RPC_SECONDS
+        from ..trace import span as trace_span
 
         with SIDECAR_RPC_SECONDS.time(method=method):
-            try:
-                yield
-            except Exception as e:
-                SIDECAR_ERRORS.inc(method=method, error=type(e).__name__)
-                raise
+            # the flight recorder sees the same region: a Chrome trace of
+            # the sidecar shows RPC lanes alongside the solve phases the
+            # handler runs (server-side attribution, SURVEY.md section 5)
+            with trace_span(f"sidecar.{method}"):
+                try:
+                    yield
+                except Exception as e:
+                    SIDECAR_ERRORS.inc(method=method, error=type(e).__name__)
+                    raise
 
     def _solve(self, request: bytes, context) -> bytes:
         with self._timed("Solve"):
@@ -196,6 +201,9 @@ class RemoteSolver:
     def __init__(self, client: SolverClient, max_nodes: Optional[int] = None):
         self.client = client
         self.max_nodes = max_nodes
+
+    def backend_label(self) -> str:
+        return "sidecar"
 
     def solve_encoded(self, problem, existing=None):
         from ..ops.encode import bucket, pad_problem

@@ -29,6 +29,8 @@ from ..models.nodepool import NodePool
 from ..models.pod import Pod
 from ..ops.encode import EncodedProblem, ZoneOccupancy, bucket, encode_problem, pad_problem
 from ..ops.ffd import ffd_solve
+from ..trace import span as trace_span
+from ..trace.provenance import ProvenanceRecord, solve_record
 
 # Launch-path truncation parity: instance.go:52-53 — at most 60 instance
 # types are carried into a single launch request.
@@ -156,6 +158,11 @@ class SolveResult:
     total_cost: float = 0.0                    # $/hr of committed choices
     solve_seconds: float = 0.0
     num_pods: int = 0
+    # what computed this plan: device kind, kernel backend (incl. fallback),
+    # scale, per-phase timings, git sha — stamped by _solve_multi_nodepool
+    # on EVERY solve so no downstream consumer (bench rows above all) can
+    # be ambiguous about where a number came from (trace/provenance.py)
+    provenance: Optional[ProvenanceRecord] = None
 
     def pods_placed(self) -> int:
         return sum(len(s.pods) for s in self.node_specs) + len(self.binds)
@@ -779,6 +786,23 @@ class TPUSolver:
         self._ffd_mode = os.environ.get("KARPENTER_TPU_FFD", "auto")
         self._pallas_verified = False
 
+    def backend_label(self) -> str:
+        """The FFD backend the LAST solve actually ran (provenance field):
+        resolves "auto", and names a mid-solve pallas->xla fallback
+        explicitly — a bench row must never claim the kernel ran when the
+        scan did the work."""
+        if "pallas_fallback" in self.timings:
+            return "xla-scan(pallas-fallback)"
+        mode = self._ffd_mode
+        if mode == "auto":
+            try:
+                import jax
+
+                mode = "pallas" if jax.default_backend() == "tpu" else "xla"
+            except Exception:
+                mode = "xla"
+        return {"xla": "xla-scan"}.get(mode, mode)
+
     def _dput(self, x: np.ndarray):
         """device_put through the content-addressed cache."""
         import jax
@@ -936,6 +960,16 @@ class TPUSolver:
             return state, [res.placed], [res.unplaced]
 
         def dispatch(N: int):
+            # dispatch span = compile-bucket lookup + uploads + program
+            # enqueue (everything before the first transfer wait); the
+            # backend attr names the kernel that actually ran, fallback
+            # included (backend_label resolves after _dispatch_body)
+            with trace_span("solve.dispatch", rows=N, groups=G) as sp:
+                out = _dispatch_body(N)
+                sp.set(backend=self.backend_label())
+                return out
+
+        def _dispatch_body(N: int):
             t_run0 = time.perf_counter()
             mode = self._ffd_mode
             if mode == "auto":
@@ -1120,20 +1154,25 @@ class TPUSolver:
     def _wait(self, problem, pending, fetch_refs, run, N, N_cap, pre_extra,
               hist_key, pre_rows, names, n_pre, GB, t_dev):
         G = len(problem.group_pods)
-        ((nz, nz_cnt, total_nz, unplaced_chunks, node_type, node_price,
-          n_open, node_window, ranked_idx, ranked_n, best_price),
-         handles) = fetch_refs(pending)
-        unplaced_arr = np.concatenate(unplaced_chunks)[:G]
-        n_open = int(n_open)
-        if unplaced_arr.sum() > 0 and n_open >= N + pre_extra and N < N_cap:
-            # estimate proved too small (rows exhausted, pods left over):
-            # one retry at the full bucket
-            N = N_cap
+        # device span: the transfer wait (compute completion + result bytes
+        # over the link), including the row-exhaustion retry when it fires
+        with trace_span("solve.device", rows=N + pre_extra) as dev_sp:
             ((nz, nz_cnt, total_nz, unplaced_chunks, node_type, node_price,
               n_open, node_window, ranked_idx, ranked_n, best_price),
-             handles) = run(N + pre_extra)
+             handles) = fetch_refs(pending)
             unplaced_arr = np.concatenate(unplaced_chunks)[:G]
             n_open = int(n_open)
+            if unplaced_arr.sum() > 0 and n_open >= N + pre_extra and N < N_cap:
+                # estimate proved too small (rows exhausted, pods left over):
+                # one retry at the full bucket
+                N = N_cap
+                dev_sp.set(retried_rows=N + pre_extra)
+                ((nz, nz_cnt, total_nz, unplaced_chunks, node_type, node_price,
+                  n_open, node_window, ranked_idx, ranked_n, best_price),
+                 handles) = run(N + pre_extra)
+                unplaced_arr = np.concatenate(unplaced_chunks)[:G]
+                n_open = int(n_open)
+            dev_sp.set(n_open=n_open)
 
         # Dense plan reconstruction from the sparse wire format: `placed`
         # scatters back in microseconds, and `used` is exactly
@@ -1202,44 +1241,45 @@ class TPUSolver:
 
         # Packed-cost descent: drop plan nodes the rest of the plan absorbs.
         t_host = time.perf_counter()
-        stale_rank = None
-        run_refine = self.refine and n_open - n_pre > 2
-        if run_refine and self._refine_zero_streak.get(hist_key, 0) >= 2:
-            ctr = self._refine_skip_ctr.get(hist_key, 0) + 1
-            self._refine_skip_ctr[hist_key] = ctr
-            if ctr % 8 != 0:  # skip, but re-check every 8th solve
-                run_refine = False
-        if run_refine:
-            dropped, stale_rank = _refine_plan(
-                problem, node_type, node_price, used, node_window, placed, n_open,
-                n_pre=n_pre, node_cap=node_cap,
-            )
-            if dropped.any():
-                self._refine_zero_streak[hist_key] = 0
-                self._refine_skip_ctr.pop(hist_key, None)
-            else:
-                self._refine_zero_streak[hist_key] = (
-                    self._refine_zero_streak.get(hist_key, 0) + 1
+        with trace_span("solve.decode", n_open=n_open):
+            stale_rank = None
+            run_refine = self.refine and n_open - n_pre > 2
+            if run_refine and self._refine_zero_streak.get(hist_key, 0) >= 2:
+                ctr = self._refine_skip_ctr.get(hist_key, 0) + 1
+                self._refine_skip_ctr[hist_key] = ctr
+                if ctr % 8 != 0:  # skip, but re-check every 8th solve
+                    run_refine = False
+            if run_refine:
+                dropped, stale_rank = _refine_plan(
+                    problem, node_type, node_price, used, node_window, placed, n_open,
+                    n_pre=n_pre, node_cap=node_cap,
                 )
-        specs, binds = _decode_nodes(
-            problem,
-            node_type,
-            node_price,
-            used,
-            n_open,
-            placed,
-            problem.nodepool.name if problem.nodepool else "",
-            node_window,
-            ranked_idx=ranked_idx,
-            ranked_n=ranked_n,
-            stale_rank=stale_rank,
-            n_pre=n_pre,
-            pre_names=names,
-        )
-        unplaced = {g: int(c) for g, c in enumerate(unplaced_arr) if c > 0}
-        self.timings["decode_ms"] = self.timings.get("decode_ms", 0.0) + (
-            (time.perf_counter() - t_host) * 1e3
-        )
+                if dropped.any():
+                    self._refine_zero_streak[hist_key] = 0
+                    self._refine_skip_ctr.pop(hist_key, None)
+                else:
+                    self._refine_zero_streak[hist_key] = (
+                        self._refine_zero_streak.get(hist_key, 0) + 1
+                    )
+            specs, binds = _decode_nodes(
+                problem,
+                node_type,
+                node_price,
+                used,
+                n_open,
+                placed,
+                problem.nodepool.name if problem.nodepool else "",
+                node_window,
+                ranked_idx=ranked_idx,
+                ranked_n=ranked_n,
+                stale_rank=stale_rank,
+                n_pre=n_pre,
+                pre_names=names,
+            )
+            unplaced = {g: int(c) for g, c in enumerate(unplaced_arr) if c > 0}
+            self.timings["decode_ms"] = self.timings.get("decode_ms", 0.0) + (
+                (time.perf_counter() - t_host) * 1e3
+            )
         return specs, binds, unplaced
 
     def solve(self, pods, nodepools, catalog, in_use=None, occupancy=None, type_allow=None,
@@ -1251,6 +1291,9 @@ class TPUSolver:
 
 class HostSolver:
     """Numpy fallback solver (and the oracle in tests)."""
+
+    def backend_label(self) -> str:
+        return "host"
 
     def solve_encoded(
         self, problem: EncodedProblem, existing: Optional[Sequence[ExistingNode]] = None,
@@ -1349,6 +1392,53 @@ def _enforce_pool_constraints(
     return kept, rejected
 
 
+def certainly_unplaceable(problem, pool_existing=None) -> list[Pod]:
+    """Pods a pool's device solve is GUARANTEED to leave unplaced,
+    computed host-side from the encode: a group with no instance type
+    that is compatible AND finitely priced AND has a live (zone,
+    captype) offering inside the group's window can never place —
+    exactly the device scan's no-usable-type condition. (Capacity
+    shortfalls are NOT certain: the scan retries at the full node
+    bucket; limits/minValues rejections happen host-side after.)
+
+    Pre-opened EXISTING rows weaken the condition (ADVICE.md high —
+    the double-placement bug): ffd._step's phase-1 first-fit gates
+    only on committed-type compat + window intersection (ffd.py:91),
+    NOT on live offerings or finite price, so a group the fresh-capacity
+    test calls hopeless could still land on a live node's slack
+    (spot offerings ICE'd while spot nodes run). Such a group is NOT
+    certain; calling it certain chained its pods into pool k+1's
+    pipelined problem while pool k's in-flight solve could still bind
+    them — one pod placed twice. The predicate mirrors the device
+    gate conservatively (no fit check: a non-fitting group merely
+    rides the sequential straggler pass, it can never double-place).
+    Hostname-capped groups are barred from pre-opened rows by the
+    scan's ``pre_ok`` mask, so existing nodes don't rescue them."""
+    G = len(problem.group_pods)
+    live = np.einsum(
+        "gzc,tzc->gt", problem.group_window[:G], problem.type_window
+    ) > 0
+    usable = (
+        problem.compat[:G] & np.isfinite(problem.price[:G]) & live
+    ).any(axis=1)
+    if pool_existing and not usable.all():
+        pre = _encode_existing(problem, pool_existing)
+        if pre is not None:
+            _, ptype, _pused, _pcap, pwin = pre
+            compat_pre = problem.compat[:G][:, ptype]          # [G, P]
+            win_pre = np.einsum(
+                "gzc,pzc->gp", problem.group_window[:G], pwin
+            ) > 0
+            uncapped = problem.max_per_node[:G] >= (1 << 30)
+            usable = usable | (
+                (compat_pre & win_pre).any(axis=1) & uncapped
+            )
+    out: list[Pod] = []
+    for g in np.nonzero(~usable)[0]:
+        out.extend(problem.group_pods[g])
+    return out
+
+
 def _solve_multi_nodepool(
     impl, pods, nodepools, catalog, in_use=None, occupancy=None, type_allow=None,
     reserved_allow=None, existing=None, nodeclass_by_pool=None,
@@ -1379,12 +1469,13 @@ def _solve_multi_nodepool(
             else True
         )
         t_enc = time.perf_counter()
-        problem = encode_problem(
-            pods_in, catalog, nodepool=pool, occupancy=occupancy,
-            allowed_types=allowed, allow_reserved=allow_res,
-            include_preferences=include_preferences,
-            nodeclass=(nodeclass_by_pool or {}).get(pool.name),
-        )
+        with trace_span("solve.encode", pool=pool.name, pods=len(pods_in)):
+            problem = encode_problem(
+                pods_in, catalog, nodepool=pool, occupancy=occupancy,
+                allowed_types=allowed, allow_reserved=allow_res,
+                include_preferences=include_preferences,
+                nodeclass=(nodeclass_by_pool or {}).get(pool.name),
+            )
         if hasattr(impl, "timings"):
             # accumulate across rounds: one solve() = one breakdown
             impl.timings["encode_ms"] = impl.timings.get("encode_ms", 0.0) + (
@@ -1408,26 +1499,6 @@ def _solve_multi_nodepool(
                     e if d is None else dataclasses.replace(e, used=e.used + d)
                 )
         return problem, pool_existing
-
-    def certainly_unplaceable(problem) -> list[Pod]:
-        """Pods this pool's device solve is GUARANTEED to leave unplaced,
-        computed host-side from the encode: a group with no instance type
-        that is compatible AND finitely priced AND has a live (zone,
-        captype) offering inside the group's window can never place —
-        exactly the device scan's no-usable-type condition. (Capacity
-        shortfalls are NOT certain: the scan retries at the full node
-        bucket; limits/minValues rejections happen host-side after.)"""
-        G = len(problem.group_pods)
-        live = np.einsum(
-            "gzc,tzc->gt", problem.group_window[:G], problem.type_window
-        ) > 0
-        usable = (
-            problem.compat[:G] & np.isfinite(problem.price[:G]) & live
-        ).any(axis=1)
-        out: list[Pod] = []
-        for g in np.nonzero(~usable)[0]:
-            out.extend(problem.group_pods[g])
-        return out
 
     def dispatch_pool(problem, pool_existing):
         if hasattr(impl, "dispatch_encoded"):
@@ -1507,9 +1578,26 @@ def _solve_multi_nodepool(
             if not rem:
                 break
             problem, pool_existing = pool_encode(rem, pool, include_preferences)
-            pending = dispatch_pool(problem, pool_existing)
             certain = [p for p, _ in problem.unencodable]
-            certain += certainly_unplaceable(problem)
+            hopeless = certainly_unplaceable(problem, pool_existing)
+            if hopeless:
+                # Structurally exclude certain groups from THIS pool's
+                # device program (the ADVICE.md fix's second arm): their
+                # pods are being chained into pool k+1, so zeroing their
+                # counts here makes double placement impossible even if
+                # the certainty predicate and the device's placement gate
+                # ever drift apart again — a pod can never be owned by
+                # two pools' in-flight solves at once.
+                import dataclasses
+
+                hopeless_uids = {p.uid for p in hopeless}
+                counts = problem.counts.copy()
+                for g, plist in enumerate(problem.group_pods):
+                    if plist and plist[0].uid in hopeless_uids:
+                        counts[g] = 0
+                problem = dataclasses.replace(problem, counts=counts)
+            certain += hopeless
+            pending = dispatch_pool(problem, pool_existing)
             staged.append((pool, problem, pending, {p.uid for p in certain}))
             rem = certain
         stragglers: list[Pod] = []
@@ -1527,19 +1615,35 @@ def _solve_multi_nodepool(
             rem = rem + later
         return rem
 
-    remaining = full_round(remaining, True)
-    # Preference relaxation AFTER the full pool sweep (karpenter relaxes
-    # only once every nodepool has been tried with preferences intact — a
-    # later pool that can honor the preference must win over relaxing at
-    # an earlier one).
-    prefs = [p for p in remaining if p.preferred_node_affinity]
-    if prefs:
-        others = [p for p in remaining if not p.preferred_node_affinity]
-        remaining = others + full_round(prefs, False)
+    with trace_span("solve", pods=len(pods), nodepools=len(nodepools)) as sp:
+        remaining = full_round(remaining, True)
+        # Preference relaxation AFTER the full pool sweep (karpenter relaxes
+        # only once every nodepool has been tried with preferences intact — a
+        # later pool that can honor the preference must win over relaxing at
+        # an earlier one).
+        prefs = [p for p in remaining if p.preferred_node_affinity]
+        if prefs:
+            others = [p for p in remaining if not p.preferred_node_affinity]
+            remaining = others + full_round(prefs, False)
+        sp.set(unschedulable=len(remaining))
     for pod in remaining:
         result.unschedulable.append(
             (pod, reasons.get(pod.uid, "no nodepool can schedule this pod"))
         )
     result.total_cost = float(sum(s.estimated_price for s in result.node_specs))
     result.solve_seconds = time.perf_counter() - t0
+    result.provenance = solve_record(
+        backend=(
+            impl.backend_label() if hasattr(impl, "backend_label") else "host"
+        ),
+        timings=getattr(impl, "timings", None),
+        num_pods=len(pods),
+        wall_ms=result.solve_seconds * 1e3,
+        extra_scale={
+            "nodepools": len(nodepools),
+            "node_specs": len(result.node_specs),
+            "binds": len(result.binds),
+            "unschedulable": len(result.unschedulable),
+        },
+    )
     return result

@@ -1,0 +1,51 @@
+"""Flight-recorder tracing & provenance for the solve hot path, the
+controller loops, and the AWS wire layer.
+
+Three pieces (designs/tracing.md):
+
+- ``spans``      — a low-overhead monotonic-clock span recorder: context
+                   manager + decorator API, thread-local span stack,
+                   bounded ring buffer, near-zero cost when disabled.
+- ``export``     — Chrome trace-event JSON export of the ring buffer plus
+                   the bridge that feeds span durations into the
+                   ``metrics.py`` histograms (so ``/metrics`` exposes
+                   per-phase latency without a second instrumentation
+                   layer).
+- ``provenance`` — the per-solve provenance record (device kind, chosen
+                   kernel backend, scale, per-phase timings, git sha)
+                   attached to every solver result and stamped into every
+                   bench row, so no measurement can be silent about what
+                   hardware/backend produced it.
+
+The round-5 verdict motivated this: headline latency claims went stale
+because nothing in the system stamped bench rows with device/backend, and
+the end-to-end p99 could not be decomposed into encode / transfer /
+device-solve / decode authoritatively. Every future perf claim is now a
+machine-checkable artifact.
+"""
+
+from .export import (
+    MetricsBridge,
+    to_chrome_trace,
+    validate_chrome_trace,
+    write_chrome_trace,
+)
+from .provenance import ProvenanceRecord, git_sha, last_record, stamp_row
+from .spans import TRACER, Span, Tracer, annotate, span, traced
+
+__all__ = [
+    "TRACER",
+    "Span",
+    "Tracer",
+    "span",
+    "traced",
+    "annotate",
+    "ProvenanceRecord",
+    "stamp_row",
+    "git_sha",
+    "last_record",
+    "MetricsBridge",
+    "to_chrome_trace",
+    "write_chrome_trace",
+    "validate_chrome_trace",
+]

@@ -1,0 +1,156 @@
+"""Span export: Chrome trace-event JSON + the metrics bridge.
+
+Chrome export makes the flight recorder's tape loadable in
+``chrome://tracing`` / Perfetto / ``about:tracing`` — complete "X" (duration)
+events on one process lane, thread lanes per recording thread, span attrs
+as ``args``. The format is the Trace Event Format's JSON-object flavor
+(``{"traceEvents": [...]}``), timestamps in microseconds.
+
+The metrics bridge closes the loop with ``metrics.py``: span durations feed
+the per-phase ``Histogram`` families on finish, so ``/metrics`` exposes the
+same latencies the tape records — one instrumentation layer, two consumers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Iterable, Optional
+
+from .spans import TRACER, Span, Tracer
+
+# span-name prefix -> (histogram attr in metrics.py, label key). The bridge
+# resolves histograms lazily so importing trace/ never forces the metrics
+# registry (and its well-known families) to exist first.
+_PHASE_PREFIX = "solve."
+_CONTROLLER_PREFIX = "controller."
+_AWS_PREFIX = "aws."
+_CONSOLIDATE_PREFIX = "consolidate."
+
+
+def to_chrome_trace(spans: Iterable[Span], pid: Optional[int] = None) -> dict:
+    """Spans -> Trace Event Format dict (JSON-object flavor).
+
+    ``ts``/``dur`` are microseconds on the perf_counter timebase — absolute
+    values are meaningless across processes, deltas are exact within one.
+    """
+    pid = os.getpid() if pid is None else pid
+    events = []
+    for s in spans:
+        events.append({
+            "name": s.name,
+            "ph": "X",                       # complete event: ts + dur
+            "ts": s.t0_ns / 1e3,
+            "dur": s.dur_ns / 1e3,
+            "pid": pid,
+            "tid": s.tid,
+            "cat": s.name.split(".", 1)[0],
+            "args": {
+                **{k: _jsonable(v) for k, v in s.attrs.items()},
+                "span_id": s.span_id,
+                "parent_id": s.parent_id,
+            },
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def _jsonable(v):
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    return str(v)
+
+
+def write_chrome_trace(path: str, spans: Optional[Iterable[Span]] = None,
+                       tracer: Tracer = TRACER) -> str:
+    """Dump spans (default: the tracer's current tape) to ``path``."""
+    doc = to_chrome_trace(tracer.snapshot() if spans is None else spans)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path
+
+
+def validate_chrome_trace(doc) -> list[str]:
+    """Structural validation of a trace-event document (what the tests —
+    and a doubting reviewer — run against an exported 2k-pod solve).
+    Returns a list of problems; empty == valid."""
+    problems: list[str] = []
+    if isinstance(doc, (str, bytes)):
+        try:
+            doc = json.loads(doc)
+        except json.JSONDecodeError as e:
+            return [f"not JSON: {e}"]
+    if not isinstance(doc, dict) or "traceEvents" not in doc:
+        return ["missing traceEvents"]
+    events = doc["traceEvents"]
+    if not isinstance(events, list):
+        return ["traceEvents is not a list"]
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            problems.append(f"event {i}: not an object")
+            continue
+        for key in ("name", "ph", "ts", "pid", "tid"):
+            if key not in ev:
+                problems.append(f"event {i}: missing {key!r}")
+        if ev.get("ph") == "X":
+            if not isinstance(ev.get("dur"), (int, float)) or ev["dur"] < 0:
+                problems.append(f"event {i}: bad dur {ev.get('dur')!r}")
+        if not isinstance(ev.get("ts"), (int, float)) or ev.get("ts", -1) < 0:
+            problems.append(f"event {i}: bad ts {ev.get('ts')!r}")
+    return problems
+
+
+class MetricsBridge:
+    """on_finish hook feeding span durations into the metrics registry.
+
+    Name taxonomy -> histogram family + label:
+
+    - ``solve.<phase>``        -> SOLVE_PHASE_SECONDS{phase=...}
+    - ``consolidate.<phase>``  -> SOLVE_PHASE_SECONDS{phase=consolidate.<phase>}
+    - ``controller.<name>``    -> RECONCILE_SECONDS{controller=...}
+    - ``aws.<service>``        -> AWS_REQUEST_SECONDS{service=...} (+ the
+      retry counter when the span carries a ``retries`` attr > 0)
+
+    Installed once per process (idempotent via ``install``).
+    """
+
+    _installed_lock = threading.Lock()
+    _installed: Optional["MetricsBridge"] = None
+
+    def __call__(self, span: Span) -> None:
+        from .. import metrics as m
+
+        if span.name.startswith(_PHASE_PREFIX):
+            m.SOLVE_PHASE_SECONDS.observe(
+                span.duration_s, phase=span.name[len(_PHASE_PREFIX):]
+            )
+        elif span.name.startswith(_CONSOLIDATE_PREFIX):
+            m.SOLVE_PHASE_SECONDS.observe(span.duration_s, phase=span.name)
+        elif span.name.startswith(_CONTROLLER_PREFIX):
+            m.RECONCILE_SECONDS.observe(
+                span.duration_s,
+                controller=span.name[len(_CONTROLLER_PREFIX):],
+            )
+        elif span.name.startswith(_AWS_PREFIX):
+            m.AWS_REQUEST_SECONDS.observe(
+                span.duration_s, service=span.name[len(_AWS_PREFIX):]
+            )
+            retries = span.attrs.get("retries", 0)
+            if retries:
+                m.AWS_REQUEST_RETRIES.inc(
+                    retries, service=span.name[len(_AWS_PREFIX):]
+                )
+
+    @classmethod
+    def install(cls, tracer: Tracer = TRACER) -> "MetricsBridge":
+        with cls._installed_lock:
+            if cls._installed is None:
+                cls._installed = cls()
+                tracer.on_finish(cls._installed)
+            return cls._installed
+
+
+# Auto-install on first import of the trace package: every instrumented
+# layer that records a span also populates /metrics, with no wiring step
+# for operators to forget.
+MetricsBridge.install()

@@ -37,6 +37,9 @@ class TestBenchContract:
         assert len(lines) == 1, f"stdout must carry exactly ONE line: {lines}"
         row = json.loads(lines[0])
         assert {"metric", "value", "unit", "vs_baseline"} <= set(row)
+        # every emitted row carries the provenance stamp (trace/provenance)
+        assert row["provenance"]["schema"] == 1
+        assert row["provenance"]["git_sha"]
         assert dt < 30
 
     def test_cpu_phase_produces_fallback_headline(self):
@@ -55,6 +58,9 @@ class TestBenchContract:
         assert row["device"] == "cpu-fallback"
         assert row["value"] is not None and row["value"] > 0
         assert row["vs_baseline"] > 0
+        # the measuring child stamped the real platform it ran on
+        assert row["provenance"]["device"] == "cpu"
+        assert row["provenance"]["backend"]
         # the probe was skipped by phase selection, and that is recorded
         assert "probe" in row.get("probe_error", "")
 

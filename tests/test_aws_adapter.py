@@ -259,6 +259,92 @@ class TestAssumeRole:
         assert len(tokens) == 3
 
 
+class TestAssumeRoleConcurrency:
+    def test_concurrent_expiry_triggers_one_sts_call(self):
+        """session.py satellite: the interruption worker fan-out can hit
+        ``credentials()`` from many threads at the same expired instant —
+        the refresh must collapse to EXACTLY one STS AssumeRole (parallel
+        refreshes hammer STS and can interleave a half-written grab)."""
+        import threading
+        import time as _time
+
+        from karpenter_provider_aws_tpu.providers.aws.transport import (
+            AwsResponse,
+        )
+
+        calls = []
+        barrier = threading.Barrier(8)
+        body = (
+            '<AssumeRoleResponse xmlns="https://sts.amazonaws.com/doc/'
+            '2011-06-15/"><AssumeRoleResult><Credentials>'
+            "<AccessKeyId>ASIAEXAMPLE</AccessKeyId>"
+            "<SecretAccessKey>assumedsecret</SecretAccessKey>"
+            "<SessionToken>ASSUMED_SESSION_TOKEN</SessionToken>"
+            "<Expiration>2099-01-01T00:00:00Z</Expiration>"
+            "</Credentials></AssumeRoleResult></AssumeRoleResponse>"
+        )
+
+        def transport(req):
+            calls.append(req.url)
+            _time.sleep(0.02)  # widen the race window
+            return AwsResponse(status=200, body=body.encode(), headers={})
+
+        session = Session(
+            region="us-east-1",
+            credentials=Credentials("AKIDEXAMPLE", "secret"),
+            transport=transport,
+            assume_role_arn="arn:aws:iam::123456789012:role/KarpenterNodeRole",
+            sleep=lambda s: None,
+            rand=lambda: 0.0,
+        )
+        errors = []
+
+        def worker():
+            barrier.wait()
+            try:
+                creds = session.credentials()
+                assert creds.session_token == "ASSUMED_SESSION_TOKEN"
+            except Exception as e:  # pragma: no cover - surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert not errors
+        assert len(calls) == 1, f"expected 1 AssumeRole, saw {len(calls)}"
+
+
+class TestDescribeImagesContracts:
+    def test_selector_terms_scope_and_paginate(self):
+        """backend.py satellite: selector terms push ids/names/tags/owners
+        into DescribeImages server-side (per-term calls) and the client
+        follows NextToken — replayed against the golden paginated wire."""
+        from karpenter_provider_aws_tpu.models.nodeclass import SelectorTerm
+
+        session, transport = fixture_session("describe_images_paginated")
+        backend = AwsCloudBackend(session, cluster_name="my-cluster")
+        images = backend.describe_images(selector_terms=[
+            SelectorTerm.of(name="my-ami-*", owner="137112412989"),
+            SelectorTerm.of(id="ami-pinned"),
+        ])
+        transport.assert_drained()
+        got = {i.id for i in images}
+        # both pages of the scoped call + the pinned-id call, unioned
+        assert got == {"ami-page1a", "ami-page1b", "ami-page2a", "ami-pinned"}
+        by_id = {i.id: i for i in images}
+        assert by_id["ami-page1b"].arch == "arm64"
+        assert by_id["ami-page1a"].tags == {"team": "ml"}
+        # the host-side enforcement point (ImageProvider re-applies
+        # term.matches) must accept what the scoped wire call returned —
+        # wildcard name terms match shell-style, like the EC2 filter did
+        term = SelectorTerm.of(name="my-ami-*", owner="137112412989")
+        assert all(
+            term.matches(i) for i in images if i.id != "ami-pinned"
+        ), "wildcard selector rejected wire-matched images host-side"
+
+
 class TestEc2Contracts:
     def test_create_fleet_shape_and_result_scatter(self):
         """createfleet.go:52-110 + instance.go:202-258: one instant-type

@@ -48,6 +48,135 @@ def existing_node(catalog, name="live-0", pool="default", min_vcpus=16, used=Non
     )
 
 
+class TestDoublePlacementRegression:
+    """ADVICE.md high: ``certainly_unplaceable`` ignored pre-opened rows.
+
+    The pipelined multi-pool path chains pods a pool is CERTAIN to leave
+    unplaced into the next pool's problem before fetching the first
+    pool's result. The certainty predicate only checked fresh-capacity
+    usability (compat & finite price & live offering) while the device's
+    first-fit phase gates pre-opened EXISTING rows on committed-type
+    compat + window only (ops/ffd.py:91) — any drift between the two lets
+    one pod be owned by two pools at once (bound AND chained). Two-arm
+    fix: the predicate now accounts for pre-opened rows, AND certain
+    groups are structurally zeroed out of pool k's device program so
+    double placement is impossible even if the gates drift again."""
+
+    def _iced_spot_catalog(self):
+        catalog = CatalogProvider()
+        for it in catalog.list():
+            for o in it.offerings:
+                if o.capacity_type == "spot":
+                    catalog.unavailable.mark_unavailable(
+                        it.name, o.zone, "spot"
+                    )
+        return catalog
+
+    def test_iced_spot_offering_with_live_spot_node_places_once(self):
+        """The ICE'd-spot-offering-while-spot-nodes-run scenario: every
+        pod must land in exactly ONE of binds / node_specs /
+        unschedulable across the whole pipelined two-pool solve."""
+        catalog = self._iced_spot_catalog()
+        node, it = existing_node(catalog, pool="spot-pool")
+        node.capacity_type = "spot"
+        pools = [cmr_pool("spot-pool"), cmr_pool("fallback")]
+        pools[0].weight = 10
+        pools[1].weight = 1
+        pods = make_pods(
+            4, "w", {"cpu": "1", "memory": "1Gi"},
+            node_selector={lbl.CAPACITY_TYPE: "spot"},
+        )
+        res = TPUSolver().solve(pods, pools, catalog, existing=[node])
+        bound = [p.uid for p, _ in res.binds]
+        spec_pods = [p.uid for s in res.node_specs for p in s.pods]
+        unsched = [p.uid for p, _ in res.unschedulable]
+        placements = bound + spec_pods + unsched
+        assert len(placements) == len(set(placements)), (
+            f"pods placed/reported more than once: binds={bound} "
+            f"specs={spec_pods} unschedulable={unsched}"
+        )
+        # every pod is accounted for exactly once (today the encode's
+        # compat embeds offering liveness, so the solver leaves these to
+        # the host binder rather than binding the slack itself — the
+        # invariant under regression is the exactly-once accounting)
+        assert sorted(placements) == sorted(p.uid for p in pods)
+
+    def test_certainty_predicate_accounts_for_preopened_rows(self):
+        """Direct predicate check with an adversarial problem: a group
+        whose FRESH usability is empty but whose compat row accepts the
+        existing node's committed type (the exact drift ADVICE.md
+        describes — ffd phase-1 would first-fit it onto the live node).
+        The old predicate called such a group certain, chaining its pods
+        to pool k+1 while pool k's device solve could still bind them."""
+        import dataclasses
+
+        from karpenter_provider_aws_tpu.ops.encode import encode_problem
+        from karpenter_provider_aws_tpu.scheduling.solver import (
+            certainly_unplaceable,
+        )
+
+        catalog = self._iced_spot_catalog()
+        node, it = existing_node(catalog, pool="spot-pool")
+        node.capacity_type = "spot"
+        pool = cmr_pool("spot-pool")
+        pods = make_pods(
+            2, "w", {"cpu": "1", "memory": "1Gi"},
+            node_selector={lbl.CAPACITY_TYPE: "spot"},
+        )
+        problem = encode_problem(pods, catalog, pool)
+        assert len(problem.group_pods) == 1
+        # no fresh capacity anywhere: without existing nodes the group is
+        # certain (both before and after the fix)
+        assert len(certainly_unplaceable(problem)) == 2
+        # drift simulation: device-side compat accepts the node's type
+        # even though no live offering exists (static-compat semantics)
+        t_idx = list(problem.type_names).index(it.name)
+        compat = problem.compat.copy()
+        compat[0, t_idx] = True
+        doctored = dataclasses.replace(problem, compat=compat)
+        # with the live node offered as a pre-opened row, the group must
+        # NOT be certain — the device's phase-1 gate could place it there
+        assert certainly_unplaceable(doctored, [node]) == []
+        # a hostname-capped group stays certain: the scan's pre_ok mask
+        # bars it from pre-opened rows regardless of compat
+        capped = dataclasses.replace(
+            doctored, max_per_node=np.ones_like(doctored.max_per_node)
+        )
+        assert len(certainly_unplaceable(capped, [node])) == 2
+
+    def test_certain_groups_still_fall_through_pools(self):
+        """The fix must not over-retain: with NO existing capacity, a
+        group with no live offering in pool k still chains into pool k+1
+        (where it can place) inside one pipelined solve."""
+        catalog = CatalogProvider()
+        for it in catalog.list():
+            for o in it.offerings:
+                if o.capacity_type == "spot":
+                    catalog.unavailable.mark_unavailable(
+                        it.name, o.zone, "spot"
+                    )
+        pools = [cmr_pool("spot-pool"), cmr_pool("fallback")]
+        pools[0].weight = 10
+        pools[1].weight = 1
+        # no captype pin: pool k has no spot but on-demand offerings are
+        # live, so this places in pool k; the spot-pinned shape must reach
+        # the fallback pool's verdict without double counting
+        pinned = make_pods(
+            2, "s", {"cpu": "1", "memory": "1Gi"},
+            node_selector={lbl.CAPACITY_TYPE: "spot"},
+        )
+        free = make_pods(2, "f", {"cpu": "1", "memory": "1Gi"})
+        res = TPUSolver().solve(pinned + free, pools, catalog)
+        placements = (
+            [p.uid for p, _ in res.binds]
+            + [p.uid for s in res.node_specs for p in s.pods]
+            + [p.uid for p, _ in res.unschedulable]
+        )
+        assert len(placements) == len(set(placements)) == 4
+        assert {p.uid for p, _ in res.unschedulable} == {p.uid for p in pinned}
+        assert res.pods_placed() == 2
+
+
 @pytest.mark.parametrize("solver_cls", [TPUSolver, HostSolver])
 class TestExistingCapacity:
     def test_pods_land_on_existing_slack_before_new_nodes(self, catalog, solver_cls):

@@ -126,3 +126,47 @@ class TestCatalogMetrics:
         assert b.add(1) == 1
         text = BATCH_WINDOW.expose()
         assert any("karpenter_batcher_window_seconds" in line for line in text)
+
+
+class TestPerPhaseHistogramsOnMetrics:
+    """trace/ tentpole acceptance: the flight recorder's spans feed the
+    per-phase latency histograms, and they are visible on the actual
+    /metrics endpoint — not just the in-process registry objects."""
+
+    def test_solve_phases_visible_on_metrics_endpoint(self):
+        import urllib.request
+
+        from karpenter_provider_aws_tpu.metrics import REGISTRY
+        from karpenter_provider_aws_tpu.models.pod import make_pods
+        from karpenter_provider_aws_tpu.testenv import new_environment
+
+        env = new_environment(use_tpu_solver=True)
+        env.apply_defaults()
+        for p in make_pods(4, "w", {"cpu": "1", "memory": "2Gi"}):
+            env.cluster.apply(p)
+        env.step(3)
+        assert not env.cluster.pending_pods()
+        port = REGISTRY.serve(0)
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10
+            ).read().decode()
+        finally:
+            REGISTRY.stop()
+        # per-phase solve latency from the span bridge
+        assert "karpenter_solver_phase_duration_seconds_bucket" in body
+        for phase in ("encode", "device", "decode"):
+            assert f'phase="{phase}"' in body, f"phase {phase} missing from /metrics"
+        # per-controller reconcile latency (provisioning ran in env.step)
+        assert "karpenter_controller_reconcile_duration_seconds_bucket" in body
+        assert 'controller="provisioning"' in body
+
+    def test_reconcile_histogram_records_for_every_controller_in_env(self):
+        from karpenter_provider_aws_tpu.metrics import RECONCILE_SECONDS
+        from karpenter_provider_aws_tpu.testenv import new_environment
+
+        env = new_environment(use_tpu_solver=False)
+        env.apply_defaults()
+        env.step(1)
+        seen = {dict(k).get("controller") for k in RECONCILE_SECONDS._counts}
+        assert "provisioning" in seen

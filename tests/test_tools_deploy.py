@@ -7,6 +7,8 @@ import pathlib
 import subprocess
 import sys
 
+import pytest
+
 REPO = pathlib.Path(__file__).resolve().parent.parent
 
 
@@ -15,6 +17,65 @@ def _load(relpath, name):
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
+
+
+class TestWebhookHardening:
+    def test_rules_exclude_status_subresources(self):
+        """Status subresource writes are the controller's own reconcile
+        traffic; routing them through the (single-replica, self-hosted)
+        webhook made every reconcile depend on the webhook being up."""
+        text = (REPO / "deploy" / "webhooks.yaml").read_text()
+        assert "nodeclasses/status" not in text
+        assert "nodepools/status" not in text
+        assert '"nodeclasses", "nodepools"' in text
+
+    def test_mutating_failure_policy_is_ignore(self):
+        text = (REPO / "deploy" / "webhooks.yaml").read_text()
+        mutating = text.split("ValidatingWebhookConfiguration")[0]
+        validating = text.split("ValidatingWebhookConfiguration")[1]
+        assert "failurePolicy: Ignore" in mutating
+        # validation still gates writes — only defaulting degrades soft
+        assert "failurePolicy: Fail" in validating
+
+    def test_stdout_render_excludes_private_key(self, tmp_path):
+        """Satellite: render.py must not write the generated TLS private
+        key to stdout (shells, CI logs, and `kubectl apply -f -`
+        transcripts all capture it) — it goes to a 0600 file instead."""
+        pytest.importorskip("cryptography")
+        import base64
+        import os
+
+        key_out = tmp_path / "webhook-tls.key"
+        out = subprocess.run(
+            [sys.executable, str(REPO / "deploy" / "render.py"),
+             "--out", "-", "--key-out", str(key_out)],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert out.returncode == 0, out.stderr[-800:]
+        assert "PRIVATE KEY" not in out.stdout
+        render = _load("deploy/render.py", "render_mod_key")
+        placeholder_b64 = base64.b64encode(
+            render.KEY_PLACEHOLDER.encode()
+        ).decode()
+        assert placeholder_b64 in out.stdout  # Secret carries the marker
+        assert key_out.exists()
+        assert (os.stat(key_out).st_mode & 0o777) == 0o600
+        assert b"PRIVATE KEY" in key_out.read_bytes()
+        assert str(key_out) in out.stderr  # operator told where it went
+
+    def test_dir_render_writes_key_file(self, tmp_path):
+        pytest.importorskip("cryptography")
+        import os
+
+        out = subprocess.run(
+            [sys.executable, str(REPO / "deploy" / "render.py"),
+             "--out", str(tmp_path / "rendered")],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert out.returncode == 0, out.stderr[-800:]
+        key = tmp_path / "rendered" / "webhook-tls.key"
+        assert key.exists()
+        assert (os.stat(key).st_mode & 0o777) == 0o600
 
 
 class TestDeployRender:

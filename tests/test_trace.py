@@ -1,0 +1,336 @@
+"""The trace/ flight recorder: span nesting + exception safety, disabled-
+mode overhead, Chrome trace-event export, the metrics bridge, and the
+per-solve provenance records (ISSUE satellite: every backend path must
+stamp its results)."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from karpenter_provider_aws_tpu.catalog import CatalogProvider
+from karpenter_provider_aws_tpu.models import Disruption, NodePool, Operator, Requirement
+from karpenter_provider_aws_tpu.models import labels as lbl
+from karpenter_provider_aws_tpu.models.pod import make_pods
+from karpenter_provider_aws_tpu.scheduling import HostSolver, TPUSolver
+from karpenter_provider_aws_tpu.trace import (
+    TRACER,
+    Tracer,
+    to_chrome_trace,
+    validate_chrome_trace,
+    write_chrome_trace,
+)
+from karpenter_provider_aws_tpu.trace.provenance import (
+    ProvenanceRecord,
+    git_sha,
+    last_record,
+    stamp_row,
+)
+
+
+@pytest.fixture(scope="module")
+def catalog():
+    return CatalogProvider()
+
+
+@pytest.fixture
+def pool():
+    return NodePool(
+        name="default",
+        requirements=[Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m", "r"))],
+        disruption=Disruption(consolidate_after_s=None),
+    )
+
+
+class TestSpans:
+    def test_nesting_parent_child_edges(self):
+        t = Tracer(capacity=16)
+        with t.span("outer") as o:
+            with t.span("inner") as i:
+                assert i.span.parent_id == o.span.span_id
+        spans = t.snapshot()
+        assert [s.name for s in spans] == ["inner", "outer"]  # finish order
+        inner, outer = spans
+        assert inner.parent_id == outer.span_id
+        assert outer.parent_id == 0
+        assert inner.dur_ns >= 0 and outer.dur_ns >= inner.dur_ns
+
+    def test_exception_safety_pops_stack_and_marks_error(self):
+        t = Tracer()
+        with pytest.raises(ValueError):
+            with t.span("boom"):
+                raise ValueError("x")
+        assert t.current() is None  # stack fully unwound
+        (s,) = t.snapshot()
+        assert s.attrs["error"] == "ValueError"
+        # the NEXT span on this thread must be a root, not a child of the
+        # raised one
+        with t.span("after"):
+            pass
+        assert t.snapshot()[-1].parent_id == 0
+
+    def test_annotate_hits_innermost_live_span(self):
+        t = Tracer()
+        with t.span("a"):
+            with t.span("b"):
+                t.annotate(retries=3)
+        spans = {s.name: s for s in t.snapshot()}
+        assert spans["b"].attrs["retries"] == 3
+        assert "retries" not in spans["a"].attrs
+
+    def test_ring_buffer_bounded(self):
+        t = Tracer(capacity=4)
+        for i in range(10):
+            with t.span(f"s{i}"):
+                pass
+        names = [s.name for s in t.snapshot()]
+        assert names == ["s6", "s7", "s8", "s9"]  # oldest evicted
+
+    def test_disabled_mode_no_allocation_growth(self):
+        import tracemalloc
+
+        t = Tracer(enabled=False)
+        # one shared no-op object — nothing allocated per call site
+        assert t.span("x", a=1) is t.span("y", b=2)
+        tracemalloc.start()
+        before = tracemalloc.take_snapshot()
+        for _ in range(2000):
+            with t.span("hot", attr="val"):
+                pass
+        after = tracemalloc.take_snapshot()
+        tracemalloc.stop()
+        growth = sum(
+            s.size_diff
+            for s in after.compare_to(before, "lineno")
+            if s.size_diff > 0
+        )
+        assert growth < 16_384, f"disabled tracer grew {growth} bytes"
+        assert t.snapshot() == []
+
+    def test_threads_get_independent_stacks(self):
+        t = Tracer(capacity=64)
+        errs = []
+
+        def worker(n):
+            try:
+                with t.span(f"root-{n}"):
+                    with t.span(f"child-{n}") as c:
+                        pass
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert not errs
+        by_name = {s.name: s for s in t.snapshot()}
+        for i in range(4):
+            child, root = by_name[f"child-{i}"], by_name[f"root-{i}"]
+            assert child.parent_id == root.span_id
+
+    def test_traced_decorator(self):
+        t = Tracer()
+
+        @t.traced("solve.custom")
+        def fn(x):
+            return x + 1
+
+        assert fn(1) == 2
+        assert t.snapshot()[-1].name == "solve.custom"
+
+    def test_finish_callback_failures_swallowed(self):
+        t = Tracer()
+        t.on_finish(lambda s: 1 / 0)
+        with t.span("safe"):
+            pass  # must not raise
+        assert t.snapshot()[-1].name == "safe"
+
+
+class TestChromeExport:
+    def test_round_trip_validates(self, tmp_path):
+        t = Tracer()
+        with t.span("solve.encode", pool="default"):
+            with t.span("solve.device", rows=128):
+                pass
+        path = str(tmp_path / "trace.json")
+        write_chrome_trace(path, tracer=t)
+        with open(path) as f:
+            doc = json.load(f)
+        assert validate_chrome_trace(doc) == []
+        events = doc["traceEvents"]
+        assert {e["name"] for e in events} == {"solve.encode", "solve.device"}
+        enc = next(e for e in events if e["name"] == "solve.encode")
+        assert enc["ph"] == "X" and enc["dur"] >= 0
+        assert enc["args"]["pool"] == "default"
+        # parent linkage survives export
+        dev = next(e for e in events if e["name"] == "solve.device")
+        assert dev["args"]["parent_id"] == enc["args"]["span_id"]
+
+    def test_validator_rejects_malformed(self):
+        assert validate_chrome_trace("not json {{") != []
+        assert validate_chrome_trace({"events": []}) != []
+        bad = {"traceEvents": [{"name": "x", "ph": "X", "ts": -1, "pid": 1, "tid": 1, "dur": -5}]}
+        assert validate_chrome_trace(bad) != []
+
+    def test_2k_pod_solve_exports_valid_trace(self, catalog, pool, tmp_path):
+        """Acceptance criterion: a Chrome trace export of a 2k-pod solve
+        validates as trace-event JSON and carries the phase taxonomy."""
+        TRACER.drain()
+        pods = make_pods(2000, "web", {"cpu": "500m", "memory": "1Gi"})
+        res = TPUSolver().solve(pods, [pool], catalog)
+        assert res.pods_placed() == 2000
+        spans = TRACER.drain()
+        names = {s.name for s in spans}
+        assert {"solve", "solve.encode", "solve.dispatch",
+                "solve.device", "solve.decode"} <= names
+        doc = to_chrome_trace(spans)
+        assert validate_chrome_trace(json.dumps(doc)) == []
+        assert len(doc["traceEvents"]) == len(spans)
+
+
+class TestMetricsBridge:
+    def test_solve_phases_reach_metrics_registry(self, catalog, pool):
+        from karpenter_provider_aws_tpu.metrics import REGISTRY, SOLVE_PHASE_SECONDS
+
+        def count(phase):
+            key = tuple(sorted({"phase": phase}.items()))
+            counts = SOLVE_PHASE_SECONDS._counts.get(key)
+            return counts[-1] if counts else 0
+
+        before = {p: count(p) for p in ("encode", "device", "decode")}
+        pods = make_pods(32, "w", {"cpu": "1", "memory": "1Gi"})
+        TPUSolver().solve(pods, [pool], catalog)
+        for phase in ("encode", "device", "decode"):
+            assert count(phase) > before[phase], f"phase {phase} not bridged"
+        text = REGISTRY.expose()
+        assert 'karpenter_solver_phase_duration_seconds_bucket{le="+Inf",phase="encode"}' in text
+
+    def test_controller_spans_feed_reconcile_histogram(self):
+        from karpenter_provider_aws_tpu.controllers.base import Manager
+        from karpenter_provider_aws_tpu.metrics import RECONCILE_SECONDS
+
+        class Dummy:
+            name = "dummy-traced"
+            interval_s = 1.0
+
+            def reconcile(self):
+                pass
+
+        key = tuple(sorted({"controller": "dummy-traced"}.items()))
+        before = (RECONCILE_SECONDS._counts.get(key) or [0])[-1]
+        Manager([Dummy()]).reconcile_all_once()
+        after = (RECONCILE_SECONDS._counts.get(key) or [0])[-1]
+        assert after == before + 1
+
+    def test_aws_spans_feed_service_histogram_and_retries(self):
+        from karpenter_provider_aws_tpu.metrics import (
+            AWS_REQUEST_RETRIES,
+            AWS_REQUEST_SECONDS,
+        )
+
+        key = tuple(sorted({"service": "ec2"}.items()))
+        before = (AWS_REQUEST_SECONDS._counts.get(key) or [0])[-1]
+        retries_before = AWS_REQUEST_RETRIES.value(service="ec2")
+        with TRACER.span("aws.ec2", action="DescribeImages") as sp:
+            sp.set(retries=2, status=200)
+        after = (AWS_REQUEST_SECONDS._counts.get(key) or [0])[-1]
+        assert after == before + 1
+        assert AWS_REQUEST_RETRIES.value(service="ec2") == retries_before + 2
+
+
+class TestProvenance:
+    def test_host_solver_stamps(self, catalog, pool):
+        pods = make_pods(8, "w", {"cpu": "1", "memory": "1Gi"})
+        res = HostSolver().solve(pods, [pool], catalog)
+        prov = res.provenance
+        assert prov is not None
+        assert prov.kind == "solve"
+        assert prov.backend == "host"
+        assert prov.scale["pods"] == 8
+        assert prov.wall_ms > 0
+        assert prov.git_sha and prov.git_sha != ""
+        d = prov.as_dict()
+        json.dumps(d)  # JSON-ready
+        assert d["schema"] == 1
+
+    def test_tpu_solver_xla_path_stamps(self, catalog, pool):
+        pods = make_pods(8, "w", {"cpu": "1", "memory": "1Gi"})
+        res = TPUSolver().solve(pods, [pool], catalog)
+        prov = res.provenance
+        assert prov.backend == "xla-scan"  # auto resolves off-TPU
+        assert prov.device in ("cpu", "tpu", "gpu")
+        assert prov.device_count >= 1
+        assert "encode" in prov.phases_ms and "device" in prov.phases_ms
+        assert prov.fallback == ""
+
+    def test_tpu_solver_pallas_interpret_path_stamps(self, catalog, pool, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_FFD", "pallas-interpret")
+        pods = make_pods(4, "w", {"cpu": "1", "memory": "1Gi"})
+        res = TPUSolver().solve(pods, [pool], catalog)
+        assert res.provenance.backend == "pallas-interpret"
+        assert res.pods_placed() == 4
+
+    def test_fallback_is_named_in_backend_label(self):
+        solver = TPUSolver()
+        solver.timings["pallas_fallback"] = "RuntimeError: mosaic gap"
+        assert solver.backend_label() == "xla-scan(pallas-fallback)"
+        record = ProvenanceRecord(kind="solve", backend=solver.backend_label(),
+                                  fallback=solver.timings["pallas_fallback"])
+        assert "(fallback)" in record.label()
+
+    def test_consolidation_screen_stamps_vmap_backend(self):
+        from karpenter_provider_aws_tpu.ops.consolidate import (
+            consolidatable,
+            encode_cluster,
+        )
+        from karpenter_provider_aws_tpu.testenv import new_environment
+
+        env = new_environment(use_tpu_solver=False)
+        env.apply_defaults()
+        for p in make_pods(3, "w", {"cpu": "1", "memory": "2Gi"}):
+            env.cluster.apply(p)
+        env.step(3)
+        ct = encode_cluster(env.cluster, env.catalog)
+        assert ct is not None
+        consolidatable(ct)
+        rec = last_record("consolidate.screen")
+        assert rec is not None
+        assert rec.kind == "consolidate.screen"
+        assert rec.backend in ("vmap", "vmap-fallback", "pallas", "mesh", "native")
+        assert rec.scale["nodes"] == len(ct.node_names)
+        assert rec.wall_ms >= 0
+
+    def test_stamp_row_ambient_and_explicit(self):
+        row = {"benchmark": "x", "p99_ms": 1.0}
+        stamp_row(row)
+        assert row["provenance"]["git_sha"] == git_sha()
+        assert row["provenance"]["schema"] == 1
+        rec = ProvenanceRecord(kind="solve", backend="xla-scan", device="tpu")
+        row2 = stamp_row({"benchmark": "y"}, provenance=rec)
+        assert row2["provenance"]["backend"] == "xla-scan"
+        assert row2["provenance"]["device"] == "tpu"
+
+
+class TestBenchStampEnforcement:
+    def _bench(self):
+        import importlib.util
+        import pathlib
+
+        repo = pathlib.Path(__file__).resolve().parent.parent
+        spec = importlib.util.spec_from_file_location("bench_mod", repo / "bench.py")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_emit_refuses_unstamped_rows(self, capsys):
+        bench = self._bench()
+        with pytest.raises(ValueError, match="provenance"):
+            bench.emit({"metric": "p99", "value": 1.0})
+        row = bench.stamp({"metric": "p99", "value": 1.0})
+        bench.emit(row)
+        out = capsys.readouterr().out.strip()
+        parsed = json.loads(out)
+        assert parsed["provenance"]["git_sha"] == git_sha()
