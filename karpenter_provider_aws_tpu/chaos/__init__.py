@@ -1,0 +1,99 @@
+"""Deterministic chaos engineering: seeded fault injection at the wire,
+cloud, and queue seams; declarative scenario timelines; cluster
+invariant checking.
+
+The ROADMAP north star demands a control plane that survives throttling
+storms, ICE, spot interruption waves, STS outages, and eventual-
+consistency lag — not once by hand, but continuously and reproducibly.
+PR 1's flight recorder (``trace/``) lets us OBSERVE the system under
+stress; this subsystem PRODUCES the stress deterministically, so a
+robustness regression is a red test, not a production incident.
+
+Five pieces (designs/fault-injection.md):
+
+- ``faults``     — composable, seeded fault primitives with match
+                   predicates over (service, action, probability, count,
+                   time window)
+- ``transport``  — ``ChaosTransport``, a fault-injecting decorator for
+                   any ``Transport`` at the wire seam, synthesizing real
+                   AWS error bodies; plus ``StubAwsTransport``, the
+                   hermetic healthy endpoint, and the ``ChaosLog``
+                   determinism witness
+- ``cloud``      — fake-cloud/queue hooks: capacity-pool drying,
+                   instance vanish, EventBridge-shaped spot-interruption
+                   injection, DescribeInstances consistency lag
+- ``plan``       — JSON-loadable scenario timelines (chaos as data) and
+                   the four canned scenarios (spot-storm, api-brownout,
+                   sts-outage, eventual-consistency)
+- ``invariants`` + ``harness`` — run the REAL controllers against a
+                   scenario on a stepped clock, then assert the cluster
+                   healed: pods bound once, no leaked instances, ICE
+                   masks expired, queue drained, reconvergence within
+                   budget, zero controller crashes
+
+Entry point: ``python -m karpenter_provider_aws_tpu.chaos --scenario
+spot-storm --seed 7`` (runs twice, proves the fault sequence is
+byte-identical, prints the invariant report).
+"""
+
+from .faults import (
+    ConnectionDrop,
+    CredentialExpiry,
+    EventualConsistencyLag,
+    Fault,
+    FAULT_KINDS,
+    Ice,
+    InjectedLatency,
+    InstanceVanish,
+    ServerError,
+    SpotInterrupt,
+    Throttle,
+    fault_from_dict,
+)
+from .cloud import (
+    inject_spot_interruptions,
+    install_consistency_lag,
+    instance_state_change_message,
+    spot_interruption_message,
+    uninstall_consistency_lag,
+)
+from .harness import ChaosHarness, ChaosReport, run_deterministic, run_scenario
+from .invariants import INVARIANTS, InvariantResult, check_all
+from .plan import Scenario, TimedFault, Workload, canned, list_canned
+from .transport import ChaosLog, ChaosTransport, Injection, StubAwsTransport
+
+__all__ = [
+    "ChaosHarness",
+    "ChaosLog",
+    "ChaosReport",
+    "ChaosTransport",
+    "ConnectionDrop",
+    "CredentialExpiry",
+    "EventualConsistencyLag",
+    "FAULT_KINDS",
+    "Fault",
+    "INVARIANTS",
+    "Ice",
+    "InjectedLatency",
+    "Injection",
+    "InstanceVanish",
+    "InvariantResult",
+    "Scenario",
+    "ServerError",
+    "SpotInterrupt",
+    "StubAwsTransport",
+    "Throttle",
+    "TimedFault",
+    "Workload",
+    "canned",
+    "check_all",
+    "fault_from_dict",
+    "inject_spot_interruptions",
+    "install_consistency_lag",
+    "instance_state_change_message",
+    "list_canned",
+    "run_deterministic",
+    "run_scenario",
+    "spot_interruption_message",
+    "uninstall_consistency_lag",
+]

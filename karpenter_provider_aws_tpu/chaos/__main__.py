@@ -1,0 +1,88 @@
+"""CLI: run a chaos scenario and print its invariant report.
+
+    python -m karpenter_provider_aws_tpu.chaos --scenario spot-storm --seed 7
+
+By default every scenario runs TWICE with the same seed and the two
+fault sequences are diffed — determinism is part of the contract, not an
+optional check (``--runs 1`` skips it, ``--runs 3`` tightens it). Exit
+status: 0 iff every run's invariants passed and the sequences matched.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m karpenter_provider_aws_tpu.chaos",
+        description="Run a deterministic chaos scenario against the real "
+                    "controllers and check cluster invariants.",
+    )
+    parser.add_argument(
+        "--scenario", default="",
+        help="canned scenario name or a path to a scenario JSON file",
+    )
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--runs", type=int, default=2,
+        help="same-seed runs to diff for determinism (default 2)",
+    )
+    parser.add_argument(
+        "--json", dest="json_out", default="",
+        help="also write the first run's full report (+ fault sequence) here",
+    )
+    parser.add_argument(
+        "--tpu-solver", action="store_true",
+        help="use the TPU solver instead of the host solver",
+    )
+    parser.add_argument("--list", action="store_true",
+                        help="list canned scenarios and exit")
+    args = parser.parse_args(argv)
+
+    import os
+
+    from .harness import run_scenario
+    from .plan import Scenario, canned, list_canned
+
+    if args.list or not args.scenario:
+        for name in list_canned():
+            print(f"  {name}: {canned(name).description[:100]}")
+        return 0 if args.list else 2
+
+    if os.path.exists(args.scenario):
+        scenario = Scenario.from_file(args.scenario)
+    else:
+        scenario = canned(args.scenario)
+
+    reports = []
+    for i in range(max(args.runs, 1)):
+        report = run_scenario(scenario, seed=args.seed,
+                              use_tpu_solver=args.tpu_solver)
+        reports.append(report)
+        print(report.summary())
+
+    ok = all(r.passed for r in reports)
+    first = reports[0]
+    for i, r in enumerate(reports[1:], start=2):
+        if r.signature != first.signature:
+            print(f"DETERMINISM FAIL: run 1 and run {i} fault sequences "
+                  f"diverge with seed {args.seed}", file=sys.stderr)
+            ok = False
+        else:
+            print(f"determinism: run {i} fault sequence byte-identical to "
+                  f"run 1 ({len(first.signature.encode())} bytes)")
+
+    if args.json_out:
+        doc = first.as_dict()
+        doc["fault_sequence"] = first.signature.splitlines()
+        with open(args.json_out, "w") as f:
+            json.dump(doc, f, indent=1)
+        print(f"report written to {args.json_out}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

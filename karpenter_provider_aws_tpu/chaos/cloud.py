@@ -1,0 +1,122 @@
+"""Cloud- and queue-seam fault hooks for the fake backend.
+
+``ChaosTransport`` sabotages the wire; this module sabotages the CLOUD —
+the ``fake.FakeCloud`` / ``fake.FakeQueue`` pair every controller runs
+against in the harness: capacity-pool drying, instance vanish,
+EventBridge-shaped spot-interruption message injection, and
+DescribeInstances eventual-consistency lag. Everything is deterministic:
+samples come from the caller's seeded RNG over id-sorted instances, and
+the lag wrapper reads the cloud's own injected clock.
+
+The ``cloud`` arguments are duck-typed against the FakeCloud surface
+(``instances``/``_lock``/``clock``/``ice_pools``/read methods) rather
+than importing ``fake`` — the backend-contract suite forbids production
+modules from depending on the fakes; the harness obtains its fakes
+through ``testenv``, the sanctioned seam.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+from typing import Optional
+
+
+def spot_interruption_message(instance_id: str) -> dict:
+    """The EventBridge envelope ``controllers/interruption.py`` parses
+    (parity: the aws.ec2 Spot Instance Interruption Warning shape the
+    reference's parser.go matches on)."""
+    return {
+        "version": "0",
+        "id": f"chaos-{instance_id}",
+        "source": "aws.ec2",
+        "detail-type": "EC2 Spot Instance Interruption Warning",
+        "detail": {"instance-id": instance_id, "instance-action": "terminate"},
+    }
+
+
+def instance_state_change_message(instance_id: str, state: str) -> dict:
+    """EC2 Instance State-change Notification envelope."""
+    return {
+        "version": "0",
+        "id": f"chaos-{instance_id}-{state}",
+        "source": "aws.ec2",
+        "detail-type": "EC2 Instance State-change Notification",
+        "detail": {"instance-id": instance_id, "state": state},
+    }
+
+
+def inject_spot_interruptions(queue, cloud, fraction: float = 1.0,
+                              count: Optional[int] = None,
+                              rng: Optional[random.Random] = None) -> tuple[str, ...]:
+    """Warn a deterministic sample of running SPOT instances; returns the
+    warned instance ids (oldest-id order) so the caller can later
+    terminate them (the real reclaim) or assert on the set."""
+    with cloud._lock:
+        spot = sorted(
+            (i.id for i in cloud.instances.values()
+             if i.state == "running" and i.capacity_type == "spot"),
+        )
+    if count is None:
+        count = len(spot) if fraction >= 1.0 else int(len(spot) * fraction)
+    count = min(count, len(spot))
+    if count < len(spot):
+        rng = rng or random.Random(0)
+        picked = sorted(rng.sample(spot, count))
+    else:
+        picked = spot
+    for iid in picked:
+        queue.send(json.dumps(spot_interruption_message(iid)))
+    return tuple(picked)
+
+
+def dry_pools(cloud, pools) -> set[tuple[str, str, str]]:
+    """ICE the given (capacity_type, instance_type, zone) triples; returns
+    the triples actually added (so ``restore_pools`` undoes exactly that)."""
+    pools = {tuple(p) for p in pools}
+    added = pools - cloud.ice_pools
+    cloud.ice_pools |= added
+    return added
+
+def restore_pools(cloud, pools) -> None:
+    cloud.ice_pools -= {tuple(p) for p in pools}
+
+
+# -- eventual-consistency lag ------------------------------------------------
+# DescribeInstances in EC2 is read-after-write eventually consistent: a
+# just-launched instance can be invisible to reads for a while. The wrapper
+# rebinds the two read methods on ONE FakeCloud instance to hide instances
+# younger than lag_s on the cloud's own clock. The GC controller's 30s
+# orphan grace exists precisely for this gap — a lag above it is the
+# interesting regime.
+
+_LAG_ATTR = "_chaos_consistency_lag"
+
+
+def install_consistency_lag(cloud, lag_s: float) -> None:
+    if getattr(cloud, _LAG_ATTR, None) is not None:
+        uninstall_consistency_lag(cloud)
+    orig_list = cloud.list_instances
+    orig_describe = cloud.describe_instances
+
+    def visible(insts):
+        horizon = cloud.clock.now() - lag_s
+        return [i for i in insts if i.launch_time <= horizon]
+
+    def lagged_list(tag_filters=None):
+        return visible(orig_list(tag_filters))
+
+    def lagged_describe(ids):
+        return visible(orig_describe(ids))
+
+    cloud.list_instances = lagged_list
+    cloud.describe_instances = lagged_describe
+    setattr(cloud, _LAG_ATTR, (orig_list, orig_describe))
+
+
+def uninstall_consistency_lag(cloud) -> None:
+    saved = getattr(cloud, _LAG_ATTR, None)
+    if saved is None:
+        return
+    cloud.list_instances, cloud.describe_instances = saved[0], saved[1]
+    setattr(cloud, _LAG_ATTR, None)

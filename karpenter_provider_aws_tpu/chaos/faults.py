@@ -1,0 +1,408 @@
+"""Composable, seeded fault primitives.
+
+Each fault is a small dataclass carrying its MATCH PREDICATE — service
+glob, action glob, probability, max fire count, optional time window —
+plus the behavior that runs when it fires. Two behavior surfaces exist,
+and a fault may implement either or both:
+
+- ``intercept(req, ctx)`` — wire-level: called by ``ChaosTransport`` with
+  the outgoing ``AwsRequest``; returns a synthesized ``AwsResponse`` (a
+  REAL AWS error body, so ``Session._parse_error`` and ``_retrying`` are
+  exercised end-to-end), raises (connection drop), or returns ``None`` to
+  pass through (latency injection sleeps first).
+- ``on_activate(harness)`` / ``on_deactivate(harness)`` — cloud/queue/
+  session-level: called by the scenario driver at window edges to mutate
+  the fake cloud (ICE pools, vanished instances), the queue (EventBridge-
+  shaped spot warnings), or the session (credential-cache expiry).
+
+Determinism contract: a fault NEVER reads ambient randomness or wall
+time. Probability draws come from the seeded RNG the caller passes to
+``should_fire``; time comes from the injected clock. Two runs with the
+same seed therefore produce byte-identical fault sequences.
+
+Reference shapes: the error bodies mirror what the AWS query/json
+protocols actually send (the same shapes ``_parse_error`` handles —
+EC2's ``<Response><Errors>``, the ``<ErrorResponse>`` flavor everywhere
+else, ``__type`` for json-protocol services).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+from dataclasses import dataclass, field, fields
+from typing import Optional
+
+from ..providers.aws.transport import AwsApiError, AwsRequest, AwsResponse
+
+
+def classify_request(req: AwsRequest) -> tuple[str, str]:
+    """(service, action) for match predicates: the query-protocol Action
+    param, the json-protocol X-Amz-Target, or the REST path."""
+    import urllib.parse
+
+    service = req.service or ""
+    target = next(
+        (v for k, v in req.headers.items() if k.lower() == "x-amz-target"), ""
+    )
+    if target:
+        return service, target
+    if req.body:
+        ctype = next(
+            (v for k, v in req.headers.items() if k.lower() == "content-type"),
+            "",
+        )
+        if "x-www-form-urlencoded" in ctype:
+            params = dict(urllib.parse.parse_qsl(req.body.decode(), keep_blank_values=True))
+            if params.get("Action"):
+                return service, params["Action"]
+    path = urllib.parse.urlsplit(req.url).path or "/"
+    return service, path
+
+
+def synthesize_error_body(req: AwsRequest, code: str, message: str) -> bytes:
+    """A wire-accurate error body for the protocol this request speaks,
+    chosen exactly the way ``Session._parse_error`` branches: json for
+    json-protocol requests, EC2's double-nested query shape for ec2,
+    the ``<ErrorResponse>`` shape for every other query service."""
+    is_json = any(
+        k.lower() == "x-amz-target" or
+        (k.lower() == "content-type" and "json" in v)
+        for k, v in req.headers.items()
+    )
+    if is_json:
+        import json
+
+        return json.dumps({"__type": code, "message": message}).encode()
+    if req.service == "ec2":
+        return (
+            f"<Response><Errors><Error><Code>{code}</Code>"
+            f"<Message>{message}</Message></Error></Errors>"
+            f"<RequestID>chaos-req-1</RequestID></Response>"
+        ).encode()
+    return (
+        f"<ErrorResponse><Error><Type>Sender</Type><Code>{code}</Code>"
+        f"<Message>{message}</Message></Error>"
+        f"<RequestId>chaos-req-1</RequestId></ErrorResponse>"
+    ).encode()
+
+
+@dataclass
+class Fault:
+    """Base predicate: (service, action, probability, count, window)."""
+
+    kind = "Fault"
+    wire = False  # True: participates in the ChaosTransport seam
+
+    service: str = "*"               # fnmatch glob over req.service
+    action: str = "*"                # fnmatch glob over Action/target/path
+    probability: float = 1.0         # per-matching-request fire chance
+    count: Optional[int] = None      # max total fires (None = unlimited)
+    start_s: Optional[float] = None  # optional fault-local window (clock
+    end_s: Optional[float] = None    # seconds); scenario windows usually
+    #                                  live in plan.TimedFault instead
+    fires: int = field(default=0, init=False, compare=False)
+
+    def matches(self, service: str, action: str, now: Optional[float] = None) -> bool:
+        if not fnmatch.fnmatchcase(service, self.service):
+            return False
+        if not fnmatch.fnmatchcase(action, self.action):
+            return False
+        if now is not None:
+            if self.start_s is not None and now < self.start_s:
+                return False
+            if self.end_s is not None and now >= self.end_s:
+                return False
+        return True
+
+    def should_fire(self, rng) -> bool:
+        """Count/probability gate. Draws from ``rng`` only when the fault
+        is probabilistic, so deterministic faults don't consume stream."""
+        if self.count is not None and self.fires >= self.count:
+            return False
+        if self.probability >= 1.0:
+            return True
+        return rng.random() < self.probability
+
+    # wire seam (ChaosTransport); None = pass through to the inner transport
+    def intercept(self, req: AwsRequest, ctx) -> Optional[AwsResponse]:
+        return None
+
+    # scenario-driver seam (harness); default no-ops
+    def on_activate(self, harness) -> None:
+        pass
+
+    def on_deactivate(self, harness) -> None:
+        pass
+
+    def describe(self) -> str:
+        return f"{self.kind}({self.service}.{self.action} p={self.probability:g})"
+
+    def to_dict(self) -> dict:
+        d = {"kind": self.kind}
+        for f in fields(self):
+            if not f.init or f.name.startswith("_"):
+                continue
+            v = getattr(self, f.name)
+            default = f.default
+            if v != default:
+                d[f.name] = list(v) if isinstance(v, tuple) else v
+        return d
+
+
+# -- wire faults -------------------------------------------------------------
+
+@dataclass
+class Throttle(Fault):
+    """AWS throttling reply (RequestLimitExceeded by default), optionally
+    carrying a Retry-After header the retryer must honor."""
+
+    kind = "Throttle"
+    wire = True
+
+    code: str = "RequestLimitExceeded"
+    status: int = 400
+    retry_after_s: float = 0.0
+
+    def intercept(self, req: AwsRequest, ctx) -> Optional[AwsResponse]:
+        headers = {}
+        if self.retry_after_s > 0:
+            headers["Retry-After"] = f"{self.retry_after_s:g}"
+        return AwsResponse(
+            status=self.status,
+            body=synthesize_error_body(req, self.code, "chaos: slow down"),
+            headers=headers,
+        )
+
+
+@dataclass
+class ServerError(Fault):
+    """5xx reply (retryable by status, DefaultRetryer parity)."""
+
+    kind = "ServerError"
+    wire = True
+
+    code: str = "InternalError"
+    status: int = 500
+
+    def intercept(self, req: AwsRequest, ctx) -> Optional[AwsResponse]:
+        return AwsResponse(
+            status=self.status,
+            body=synthesize_error_body(req, self.code, "chaos: internal failure"),
+        )
+
+
+@dataclass
+class ConnectionDrop(Fault):
+    """Connection reset / DNS blip: raises the same synthetic 599
+    ``ConnectionError`` shape ``UrllibTransport`` raises, so the drop
+    enters ``Session._retrying`` exactly like a production one."""
+
+    kind = "ConnectionDrop"
+    wire = True
+
+    def intercept(self, req: AwsRequest, ctx) -> Optional[AwsResponse]:
+        raise AwsApiError(599, "ConnectionError", "chaos: connection dropped")
+
+
+@dataclass
+class InjectedLatency(Fault):
+    """Sleeps on the injected clock, then passes the request through.
+    Under a FakeClock the sleep ADVANCES virtual time — deterministic
+    slow-API simulation with zero wall-clock cost."""
+
+    kind = "InjectedLatency"
+    wire = True
+
+    delay_s: float = 0.25
+
+    def intercept(self, req: AwsRequest, ctx) -> Optional[AwsResponse]:
+        ctx.clock.sleep(self.delay_s)
+        return None  # pass through after the delay
+
+
+@dataclass
+class CredentialExpiry(Fault):
+    """Two-sided credential fault: as a wire fault it answers 403
+    ``ExpiredToken`` (non-retryable — the caller must re-auth); at
+    activation it drops the harness session's cached assume-role
+    credentials, forcing the next call through a full STS round trip
+    (which an overlapping STS fault can then break)."""
+
+    kind = "CredentialExpiry"
+
+    reply_on_wire: bool = False  # default: only expire the cached creds
+
+    @property
+    def wire(self) -> bool:
+        return self.reply_on_wire
+
+    def intercept(self, req: AwsRequest, ctx) -> Optional[AwsResponse]:
+        if not self.reply_on_wire:
+            return None
+        return AwsResponse(
+            status=403,
+            body=synthesize_error_body(
+                req, "ExpiredToken", "chaos: security token expired"
+            ),
+        )
+
+    def on_activate(self, harness) -> None:
+        session = getattr(harness, "session", None)
+        if session is not None:
+            session._assumed = None  # force re-assume on next call
+
+
+# -- cloud / queue faults ----------------------------------------------------
+
+@dataclass
+class Ice(Fault):
+    """Dry the fake cloud's capacity pools: every (capacity_type,
+    instance_type, zone) triple expanded from the globs is ICE'd for the
+    window, then restored."""
+
+    kind = "Ice"
+
+    instance_types: tuple = ("*",)
+    zones: tuple = ("*",)
+    capacity_types: tuple = ("spot", "on-demand")
+    _added: set = field(default_factory=set, init=False, compare=False)
+
+    def _expand(self, harness) -> set[tuple[str, str, str]]:
+        cloud = harness.env.cloud
+        zones = tuple(
+            z for z in cloud.zones
+            if any(fnmatch.fnmatchcase(z, g) for g in self.zones)
+        )
+        # "*" instance types dry the pools the cluster is actually using
+        # (plus anything already launched); a full-catalog expansion would
+        # be ~700 types x zones of noise.
+        if self.instance_types == ("*",):
+            itypes = sorted({
+                i.instance_type for i in cloud.instances.values()
+            }) or ["*"]
+        else:
+            itypes = list(self.instance_types)
+        return {
+            (ct, it, z)
+            for ct in self.capacity_types for it in itypes for z in zones
+        }
+
+    def on_activate(self, harness) -> None:
+        from .cloud import dry_pools
+
+        self._added = dry_pools(harness.env.cloud, self._expand(harness))
+        harness.record_cloud_fault(
+            self, f"iced {len(self._added)} pools"
+        )
+
+    def on_deactivate(self, harness) -> None:
+        from .cloud import restore_pools
+
+        restore_pools(harness.env.cloud, self._added)
+        self._added = set()
+
+
+@dataclass
+class SpotInterrupt(Fault):
+    """EventBridge-shaped spot interruption warnings for a deterministic
+    sample of running spot instances; the instances are cloud-terminated
+    at window end (the real 2-minute warning -> reclaim sequence)."""
+
+    kind = "SpotInterrupt"
+
+    fraction: float = 1.0
+    terminate: bool = True
+    _warned: tuple = field(default=(), init=False, compare=False)
+
+    def on_activate(self, harness) -> None:
+        from .cloud import inject_spot_interruptions
+
+        self._warned = inject_spot_interruptions(
+            harness.env.queue, harness.env.cloud,
+            fraction=self.fraction, rng=harness.cloud_rng,
+        )
+        harness.record_cloud_fault(
+            self,
+            "warned " + ",".join(harness.stable_id(i) for i in self._warned),
+        )
+
+    def on_deactivate(self, harness) -> None:
+        if self.terminate and self._warned:
+            harness.env.cloud.terminate_instances(list(self._warned))
+        self._warned = ()
+
+
+@dataclass
+class InstanceVanish(Fault):
+    """Out-of-band instance loss: the newest N running instances flip to
+    terminated at the cloud with NO warning message — the GC/liveness
+    path has to notice on its own."""
+
+    kind = "InstanceVanish"
+
+    vanish_count: int = 1
+
+    def on_activate(self, harness) -> None:
+        cloud = harness.env.cloud
+        with cloud._lock:
+            running = sorted(
+                (i for i in cloud.instances.values() if i.state == "running"),
+                key=lambda i: i.id,
+            )
+        victims = [i.id for i in running[-self.vanish_count:]]
+        if victims:
+            cloud.terminate_instances(victims)
+        harness.record_cloud_fault(
+            self, "vanished " + ",".join(harness.stable_id(i) for i in victims)
+        )
+
+
+@dataclass
+class EventualConsistencyLag(Fault):
+    """DescribeInstances/ListInstances lag: instances launched within the
+    last ``lag_s`` (virtual) seconds are invisible to reads — the classic
+    EC2 read-after-write gap the GC grace period exists for."""
+
+    kind = "EventualConsistencyLag"
+
+    lag_s: float = 45.0
+
+    def on_activate(self, harness) -> None:
+        from .cloud import install_consistency_lag
+
+        install_consistency_lag(harness.env.cloud, self.lag_s)
+        harness.record_cloud_fault(self, f"lag={self.lag_s:g}s")
+
+    def on_deactivate(self, harness) -> None:
+        from .cloud import uninstall_consistency_lag
+
+        uninstall_consistency_lag(harness.env.cloud)
+
+
+FAULT_KINDS: dict[str, type] = {
+    cls.kind: cls
+    for cls in (
+        Throttle, ServerError, ConnectionDrop, InjectedLatency,
+        CredentialExpiry, Ice, SpotInterrupt, InstanceVanish,
+        EventualConsistencyLag,
+    )
+}
+
+
+def fault_from_dict(d: dict) -> Fault:
+    """Inverse of ``Fault.to_dict`` — how scenario JSON becomes faults."""
+    d = dict(d)
+    kind = d.pop("kind", None)
+    cls = FAULT_KINDS.get(kind or "")
+    if cls is None:
+        raise ValueError(
+            f"unknown fault kind {kind!r}; known: {sorted(FAULT_KINDS)}"
+        )
+    allowed = {f.name for f in fields(cls) if f.init}
+    unknown = set(d) - allowed
+    if unknown:
+        raise ValueError(f"{kind}: unknown fields {sorted(unknown)}")
+    for k, v in list(d.items()):
+        if isinstance(v, list):
+            d[k] = tuple(v)
+    return cls(**d)

@@ -1,0 +1,352 @@
+"""The chaos harness: real controllers + scenario timeline + invariants.
+
+One ``ChaosHarness`` owns a full hermetic environment (``testenv`` — the
+fake cloud/queue, the complete controller manager, an injectable
+FakeClock), a REAL ``Session`` pointed at
+``ChaosTransport(StubAwsTransport())`` so the signed wire path
+(SigV4 -> send -> ``_parse_error`` -> ``_retrying``) runs under fault
+fire, and the scenario driver that advances virtual time step by step:
+activate/deactivate timeline faults at their windows, apply workload
+waves, run every controller once per step, probe the wire once per step.
+
+After the timeline, every remaining fault is cleared and the settle
+phase gives the controllers ``scenario.settle_reconciles`` passes (at
+5 virtual seconds each — past the ICE TTL and the GC orphan grace) to
+re-converge; then the invariants run and a ``ChaosReport`` is built.
+
+Determinism: all randomness comes from three streams derived from the
+seed (wire-fault draws, cloud-fault sampling, retry jitter), all time
+from the FakeClock, and the report's ``signature()`` normalizes instance
+ids to per-run ordinals — so two same-seed runs in one process (where
+the fake cloud's global id counter keeps counting) still produce
+byte-identical fault sequences. The acceptance gate in
+``chaos/__main__.py`` runs every scenario twice and diffs exactly this.
+
+While a scenario runs, the harness registers an ambient provenance
+provider (``trace/provenance.py``): every solve record produced under
+chaos carries the scenario name, seed, and the fault kinds active at
+solve time — and each sabotaged request's ``aws.<service>`` span is
+annotated with ``chaos_fault`` by the transport.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+from dataclasses import dataclass, field
+from typing import Optional, Union
+
+from ..models import Disruption, NodePool, Operator, Requirement
+from ..models import labels as lbl
+from ..models.pod import make_pods
+from ..providers.aws import Credentials, Ec2Client, Session
+from ..providers.aws.session import CredentialError
+from ..providers.aws.transport import AwsApiError
+from ..testenv import new_environment
+from ..trace import provenance
+from ..utils.cache import CacheTTL
+from .cloud import uninstall_consistency_lag
+from .invariants import InvariantResult, check_all
+from .plan import Scenario, TimedFault, canned
+from .transport import ChaosLog, ChaosTransport, StubAwsTransport
+
+# settle pacing: each settle pass advances this much virtual time, so the
+# default 60-pass budget crosses the ICE TTL (180s) and GC grace (30s)
+SETTLE_ADVANCE_S = 5.0
+
+
+@dataclass
+class ChaosReport:
+    """The machine-checkable outcome of one scenario run."""
+
+    scenario: str
+    seed: int
+    steps: int
+    injections: int
+    faults_by_kind: dict
+    invariants: list[InvariantResult]
+    retry_attempts: float = 0.0
+    probe_failures: int = 0
+    probe_calls: int = 0
+    nodes_launched: int = 0
+    signature: str = ""
+    settle_steps_used: int = 0
+
+    @property
+    def passed(self) -> bool:
+        return all(r.passed for r in self.invariants)
+
+    def as_dict(self) -> dict:
+        return {
+            "scenario": self.scenario,
+            "seed": self.seed,
+            "passed": self.passed,
+            "steps": self.steps,
+            "injections": self.injections,
+            "faults_by_kind": dict(self.faults_by_kind),
+            "retry_attempts": self.retry_attempts,
+            "probe_failures": self.probe_failures,
+            "probe_calls": self.probe_calls,
+            "nodes_launched": self.nodes_launched,
+            "settle_steps_used": self.settle_steps_used,
+            "invariants": [
+                {"name": r.name, "passed": r.passed, "detail": r.detail}
+                for r in self.invariants
+            ],
+        }
+
+    def summary(self) -> str:
+        lines = [
+            f"chaos report: scenario={self.scenario} seed={self.seed} "
+            f"{'PASSED' if self.passed else 'FAILED'}",
+            f"  steps={self.steps} injections={self.injections} "
+            f"retries={self.retry_attempts:g} "
+            f"probe_failures={self.probe_failures}/{self.probe_calls} "
+            f"nodes_launched={self.nodes_launched}",
+            "  faults: " + (
+                ", ".join(f"{k}x{v}" for k, v in sorted(self.faults_by_kind.items()))
+                or "none"
+            ),
+        ]
+        lines += ["  " + r.line() for r in self.invariants]
+        return "\n".join(lines)
+
+
+class ChaosHarness:
+    def __init__(self, scenario: Union[Scenario, str], seed: int = 0,
+                 use_tpu_solver: bool = False):
+        sc = canned(scenario) if isinstance(scenario, str) else scenario
+        # private clone via the data round-trip: fault instances carry
+        # per-run state (fire counts, warned-instance sets), so sharing
+        # one Scenario object across harnesses would break determinism
+        self.scenario = Scenario.from_dict(sc.to_dict())
+        self.seed = int(seed)
+        self.env = new_environment(use_tpu_solver=use_tpu_solver)
+        self.log = ChaosLog()
+        # three independent deterministic streams: interleaving wire draws
+        # with cloud sampling (or jitter) must not shift either sequence
+        self.cloud_rng = random.Random(f"{self.seed}:cloud")
+        self.wire = ChaosTransport(
+            StubAwsTransport(), clock=self.env.clock,
+            rng=random.Random(f"{self.seed}:wire"), log=self.log,
+        )
+        self.session = Session(
+            region="us-east-1",
+            credentials=Credentials("AKIDCHAOS", "chaos-base-secret"),
+            transport=self.wire,
+            assume_role_arn=(
+                "arn:aws:iam::123456789012:role/ChaosRole"
+                if self.scenario.assume_role else ""
+            ),
+            sleep=lambda s: None,  # backoff time is virtual; don't stall tests
+            now_amz=lambda: "20260804T000000Z",
+            rand=random.Random(f"{self.seed}:jitter").random,
+        )
+        self._ec2 = Ec2Client(self.session)
+        # audit + report state
+        self.bind_events: list[tuple[str, str]] = []
+        self.double_binds: list[str] = []
+        self._id_ranks: dict[str, int] = {}
+        self.active: list[TimedFault] = []
+        self.probe_failures = 0
+        self.probe_calls = 0
+        self.settle_steps_used = 0
+        self.errors_baseline = len(self.env.manager.errors)
+        self._install_bind_audit()
+
+    # -- determinism helpers -------------------------------------------------
+
+    def stable_id(self, instance_id: str) -> str:
+        """Per-run ordinal for an instance id: the fake cloud's global id
+        counter keeps counting across runs in one process, so raw ids
+        would break the byte-identical-signature contract."""
+        if instance_id not in self._id_ranks:
+            self._id_ranks[instance_id] = len(self._id_ranks)
+        return f"i#{self._id_ranks[instance_id]}"
+
+    def record_cloud_fault(self, fault, detail: str = "") -> None:
+        self.log.record(
+            t=self.env.clock.now(), kind=fault.kind, service="cloud",
+            action="inject", detail=detail or fault.describe(),
+        )
+        ChaosTransport._count(fault.kind)
+
+    def active_fault_kinds(self) -> list[str]:
+        return sorted({tf.fault.kind for tf in self.active})
+
+    # -- audit hooks ---------------------------------------------------------
+
+    def _install_bind_audit(self) -> None:
+        cluster = self.env.cluster
+        orig_bind = cluster.bind_pod
+
+        def audited_bind(pod_uid, node_name, now=0.0):
+            pod = cluster.pods.get(pod_uid)
+            if pod is not None and pod.node_name and pod.node_name != node_name:
+                self.double_binds.append(
+                    f"{pod.name}: {pod.node_name} -> {node_name}"
+                )
+            self.bind_events.append((pod_uid, node_name))
+            return orig_bind(pod_uid, node_name, now)
+
+        cluster.bind_pod = audited_bind
+
+    # -- scenario driving ----------------------------------------------------
+
+    def _apply_pool(self) -> None:
+        sc = self.scenario
+        requirements = [
+            Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, tuple(sc.categories)),
+        ]
+        if sc.capacity_types:
+            requirements.append(
+                Requirement(lbl.CAPACITY_TYPE, Operator.IN, tuple(sc.capacity_types))
+            )
+        self.env.apply_defaults(NodePool(
+            name="default",
+            requirements=requirements,
+            disruption=Disruption(budgets=["100%"], consolidate_after_s=None),
+        ))
+
+    def _apply_workload(self, w) -> None:
+        for p in make_pods(w.pods, f"{w.name}-{int(w.at_s)}",
+                           {"cpu": w.cpu, "memory": w.memory}):
+            self.env.cluster.apply(p)
+        self.log.record(
+            t=self.env.clock.now(), kind="Workload", service="cluster",
+            action="apply", detail=f"{w.pods} pods {w.cpu}cpu/{w.memory}",
+        )
+
+    def _probe(self) -> None:
+        """One signed EC2 call per step: the wire canary that drags the
+        whole Session pipeline through whatever faults are active."""
+        self.probe_calls += 1
+        try:
+            self._ec2.describe_availability_zones()
+        except (AwsApiError, CredentialError):
+            self.probe_failures += 1
+
+    def _activate(self, tf: TimedFault) -> None:
+        self.active.append(tf)
+        self.log.record(
+            t=self.env.clock.now(), kind=tf.fault.kind, service="timeline",
+            action="activate", detail=tf.fault.describe(),
+        )
+        if _is_wire_fault(tf.fault):
+            self.wire.add_fault(tf.fault)
+        tf.fault.on_activate(self)
+
+    def _deactivate(self, tf: TimedFault) -> None:
+        if tf in self.active:
+            self.active.remove(tf)
+        self.log.record(
+            t=self.env.clock.now(), kind=tf.fault.kind, service="timeline",
+            action="deactivate", detail=tf.fault.describe(),
+        )
+        if _is_wire_fault(tf.fault):
+            self.wire.remove_fault(tf.fault)
+        tf.fault.on_deactivate(self)
+
+    def run(self) -> ChaosReport:
+        sc = self.scenario
+        nodes_before = len(self.env.cluster.nodes)
+        retries_before = _retries_total()
+        provider = lambda: {  # noqa: E731
+            "chaos_scenario": sc.name,
+            "chaos_seed": self.seed,
+            "chaos_active_faults": ",".join(self.active_fault_kinds()),
+        }
+        provenance.register_ambient_provider(provider)
+        pending_tl = sorted(sc.timeline, key=lambda t: t.at_s)
+        pending_wl = sorted(sc.workloads, key=lambda w: w.at_s)
+        steps = 0
+        try:
+            self._apply_pool()
+            t = 0.0
+            while t < sc.duration_s:
+                # windows close before new ones open at the same instant
+                for tf in [tf for tf in self.active
+                           if tf.end_s is not None and t >= tf.end_s]:
+                    self._deactivate(tf)
+                while pending_tl and t >= pending_tl[0].at_s:
+                    self._activate(pending_tl.pop(0))
+                while pending_wl and t >= pending_wl[0].at_s:
+                    self._apply_workload(pending_wl.pop(0))
+                self.env.step(1)
+                self._probe()
+                self.env.clock.advance(sc.step_s)
+                t += sc.step_s
+                steps += 1
+            # fault-clear: everything still active ends now
+            for tf in list(self.active):
+                self._deactivate(tf)
+            uninstall_consistency_lag(self.env.cloud)
+            self.wire.clear_faults()
+            # settle: re-converge within the budget, crossing the ICE TTL
+            # and the GC orphan grace in virtual time
+            converged_at = None
+            for i in range(sc.settle_reconciles):
+                self.env.clock.advance(SETTLE_ADVANCE_S)
+                self.env.step(1)
+                self._probe()
+                steps += 1
+                if converged_at is None and not self.env.cluster.pending_pods() \
+                        and len(self.env.queue) == 0:
+                    converged_at = i + 1
+            self.settle_steps_used = converged_at or sc.settle_reconciles
+            # make certain the ICE TTL has fully lapsed before invariants
+            self.env.clock.advance(CacheTTL.UNAVAILABLE_OFFERINGS + 1.0)
+            self.env.step(1)
+            steps += 1
+            invariants = check_all(self)
+        finally:
+            provenance.unregister_ambient_provider(provider)
+            self.env.close()
+        return ChaosReport(
+            scenario=sc.name,
+            seed=self.seed,
+            steps=steps,
+            injections=len(self.log),
+            faults_by_kind=self.log.by_kind(),
+            invariants=invariants,
+            retry_attempts=_retries_total() - retries_before,
+            probe_failures=self.probe_failures,
+            probe_calls=self.probe_calls,
+            nodes_launched=max(0, len(self.env.cluster.nodes) - nodes_before),
+            signature=self.log.signature(),
+            settle_steps_used=self.settle_steps_used,
+        )
+
+
+def _is_wire_fault(fault) -> bool:
+    """A fault participates in the wire seam iff it declares ``wire``
+    (cloud/queue faults keep the base ``False``)."""
+    return bool(getattr(fault, "wire", False))
+
+
+def _retries_total() -> float:
+    from ..metrics import AWS_REQUEST_RETRIES
+
+    return AWS_REQUEST_RETRIES.total()
+
+
+def run_scenario(scenario: Union[Scenario, str], seed: int = 0,
+                 use_tpu_solver: bool = False) -> ChaosReport:
+    """Build a fresh harness and run one scenario end to end."""
+    return ChaosHarness(scenario, seed=seed, use_tpu_solver=use_tpu_solver).run()
+
+
+def run_deterministic(scenario: Union[Scenario, str], seed: int = 0,
+                      runs: int = 2) -> list[ChaosReport]:
+    """The acceptance gate: run the scenario ``runs`` times with the same
+    seed and raise unless every fault sequence is byte-identical."""
+    reports = [run_scenario(scenario, seed=seed) for _ in range(runs)]
+    first = reports[0].signature
+    for i, r in enumerate(reports[1:], start=2):
+        if r.signature != first:
+            raise AssertionError(
+                f"non-deterministic fault sequence: run 1 and run {i} "
+                f"diverge with seed {seed}\n--- run 1 ---\n{first}\n"
+                f"--- run {i} ---\n{r.signature}"
+            )
+    return reports

@@ -1,0 +1,139 @@
+"""Cluster invariants a chaos run must uphold.
+
+Each invariant is a function ``(harness) -> InvariantResult`` evaluated
+AFTER the fault-clear settle phase: faults are allowed to hurt (pending
+pods, masked offerings, drained nodes mid-run), but once they clear the
+system must heal completely. The registry is data (``INVARIANTS``), so a
+scenario report always lists every check it ran, and new invariants
+compose without touching the harness.
+
+The list (designs/fault-injection.md):
+
+- ``pods-bound-once``       no pod was ever re-bound to a second node
+                            while still bound to the first (the bind
+                            audit hook records every ``cluster.bind_pod``)
+- ``converged``             no pending pods after the settle budget, and
+                            convergence happened within
+                            ``scenario.settle_reconciles`` passes
+- ``no-leaked-instances``   every running cloud instance is backed by a
+                            live NodeClaim after GC settles
+- ``ice-mask-expired``      the unavailable-offerings cache drained once
+                            faults cleared and the TTL elapsed
+- ``queue-drained``         the interruption queue is empty (no poison
+                            message redelivered forever)
+- ``controllers-healthy``   no controller reconcile raised during the
+                            whole run (faults must surface as behavior,
+                            never as crashes)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class InvariantResult:
+    name: str
+    passed: bool
+    detail: str = ""
+
+    def line(self) -> str:
+        return f"[{'PASS' if self.passed else 'FAIL'}] {self.name}: {self.detail}"
+
+
+def _result(name: str, passed: bool, detail: str) -> InvariantResult:
+    return InvariantResult(name=name, passed=bool(passed), detail=detail)
+
+
+def check_pods_bound_once(harness) -> InvariantResult:
+    violations = list(harness.double_binds)
+    env = harness.env
+    homeless = [
+        p.name for p in env.cluster.pods.values()
+        if p.node_name and p.node_name not in env.cluster.nodes
+    ]
+    ok = not violations and not homeless
+    detail = f"{len(harness.bind_events)} binds audited"
+    if violations:
+        detail = f"re-bound while bound: {violations[:4]}"
+    elif homeless:
+        detail = f"bound to missing nodes: {homeless[:4]}"
+    return _result("pods-bound-once", ok, detail)
+
+
+def check_converged(harness) -> InvariantResult:
+    pending = harness.env.cluster.pending_pods()
+    budget = harness.scenario.settle_reconciles
+    if pending:
+        return _result(
+            "converged", False,
+            f"{len(pending)} pods still pending after {budget} settle passes",
+        )
+    return _result(
+        "converged", True,
+        f"re-converged in {harness.settle_steps_used}/{budget} passes after faults cleared",
+    )
+
+
+def check_no_leaked_instances(harness) -> InvariantResult:
+    env = harness.env
+    claimed = {
+        c.status.provider_id
+        for c in env.cluster.nodeclaims.values()
+        if c.status.provider_id and not c.deleted
+    }
+    # read the cloud's ground truth directly — any consistency-lag wrapper
+    # was uninstalled at fault-clear, but don't depend on that here
+    with env.cloud._lock:
+        running = [
+            i for i in env.cloud.instances.values() if i.state != "terminated"
+        ]
+    leaked = [i.id for i in running if i.provider_id not in claimed]
+    return _result(
+        "no-leaked-instances", not leaked,
+        (f"leaked: {[harness.stable_id(i) for i in leaked[:4]]}" if leaked
+         else f"{len(running)} running instances all claimed"),
+    )
+
+
+def check_ice_mask_expired(harness) -> InvariantResult:
+    entries = harness.env.catalog.unavailable.entries()
+    return _result(
+        "ice-mask-expired", not entries,
+        (f"{len(entries)} offerings still masked: {entries[:4]}" if entries
+         else "unavailable-offerings cache empty"),
+    )
+
+
+def check_queue_drained(harness) -> InvariantResult:
+    depth = len(harness.env.queue)
+    return _result(
+        "queue-drained", depth == 0,
+        f"queue depth {depth} "
+        f"(received {harness.env.queue.received_count}, "
+        f"deleted {harness.env.queue.deleted_count})",
+    )
+
+
+def check_controllers_healthy(harness) -> InvariantResult:
+    errors = harness.env.manager.errors[harness.errors_baseline:]
+    return _result(
+        "controllers-healthy", not errors,
+        (f"{len(errors)} reconcile errors: "
+         + ", ".join(f"{n}:{type(e).__name__}" for n, e in errors[:4])
+         if errors else "no reconcile raised"),
+    )
+
+
+INVARIANTS = (
+    check_pods_bound_once,
+    check_converged,
+    check_no_leaked_instances,
+    check_ice_mask_expired,
+    check_queue_drained,
+    check_controllers_healthy,
+)
+
+
+def check_all(harness) -> list[InvariantResult]:
+    return [check(harness) for check in INVARIANTS]

@@ -1,0 +1,178 @@
+"""Declarative scenario timelines: chaos as data.
+
+A ``Scenario`` is a JSON-serializable plan: workloads arrive at fixed
+virtual times, faults activate at ``at_s`` and deactivate after
+``duration_s``, all driven by the injectable ``utils/clock.py`` FakeClock
+stepping in ``step_s`` increments. Because the plan is data, scenarios
+live in ``chaos/scenarios/*.json`` (the four canned ones ship there) and
+operators can write their own without touching code
+(``docs/chaos.md``).
+
+Schema (``designs/fault-injection.md`` documents it in full)::
+
+    {
+      "name": "spot-storm",
+      "description": "...",
+      "duration_s": 200,
+      "step_s": 1.0,
+      "settle_reconciles": 60,
+      "assume_role": false,
+      "pool": {"capacity_types": ["spot"], "categories": ["c", "m", "r"]},
+      "workloads": [{"at_s": 0, "pods": 8, "cpu": "2", "memory": "4Gi"}],
+      "timeline": [
+        {"at_s": 60, "duration_s": 120,
+         "fault": {"kind": "SpotInterrupt", "fraction": 1.0}}
+      ]
+    }
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .faults import Fault, fault_from_dict
+
+_SCENARIO_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "scenarios")
+
+
+@dataclass
+class TimedFault:
+    """Activate ``fault`` at ``at_s``; deactivate after ``duration_s``
+    (``None`` = stays active until the scenario's fault-clear phase)."""
+
+    at_s: float
+    fault: Fault
+    duration_s: Optional[float] = None
+
+    @property
+    def end_s(self) -> Optional[float]:
+        return None if self.duration_s is None else self.at_s + self.duration_s
+
+    def to_dict(self) -> dict:
+        d = {"at_s": self.at_s, "fault": self.fault.to_dict()}
+        if self.duration_s is not None:
+            d["duration_s"] = self.duration_s
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TimedFault":
+        return cls(
+            at_s=float(d["at_s"]),
+            fault=fault_from_dict(d["fault"]),
+            duration_s=(None if d.get("duration_s") is None
+                        else float(d["duration_s"])),
+        )
+
+
+@dataclass
+class Workload:
+    """A wave of pending pods applied at ``at_s``."""
+
+    at_s: float = 0.0
+    pods: int = 4
+    cpu: str = "1"
+    memory: str = "2Gi"
+    name: str = "chaos"
+
+    def to_dict(self) -> dict:
+        return {"at_s": self.at_s, "pods": self.pods, "cpu": self.cpu,
+                "memory": self.memory, "name": self.name}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Workload":
+        return cls(
+            at_s=float(d.get("at_s", 0.0)), pods=int(d.get("pods", 4)),
+            cpu=str(d.get("cpu", "1")), memory=str(d.get("memory", "2Gi")),
+            name=str(d.get("name", "chaos")),
+        )
+
+
+@dataclass
+class Scenario:
+    name: str
+    description: str = ""
+    duration_s: float = 120.0
+    step_s: float = 1.0
+    # post-timeline convergence budget: the cluster must re-converge
+    # within this many reconcile passes after every fault clears
+    # (invariants.py asserts it)
+    settle_reconciles: int = 60
+    # build the harness Session with an assume-role chain (sts scenarios)
+    assume_role: bool = False
+    capacity_types: tuple = ()            # () = pool default (any)
+    categories: tuple = ("c", "m", "r")
+    workloads: list[Workload] = field(default_factory=list)
+    timeline: list[TimedFault] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        d: dict = {
+            "name": self.name,
+            "description": self.description,
+            "duration_s": self.duration_s,
+            "step_s": self.step_s,
+            "settle_reconciles": self.settle_reconciles,
+            "workloads": [w.to_dict() for w in self.workloads],
+            "timeline": [t.to_dict() for t in sorted(self.timeline, key=lambda t: t.at_s)],
+        }
+        if self.assume_role:
+            d["assume_role"] = True
+        pool: dict = {}
+        if self.capacity_types:
+            pool["capacity_types"] = list(self.capacity_types)
+        if self.categories != ("c", "m", "r"):
+            pool["categories"] = list(self.categories)
+        if pool:
+            d["pool"] = pool
+        return d
+
+    def to_json(self, indent: int = 1) -> str:
+        return json.dumps(self.to_dict(), indent=indent)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Scenario":
+        pool = d.get("pool", {}) or {}
+        return cls(
+            name=str(d["name"]),
+            description=str(d.get("description", "")),
+            duration_s=float(d.get("duration_s", 120.0)),
+            step_s=float(d.get("step_s", 1.0)),
+            settle_reconciles=int(d.get("settle_reconciles", 60)),
+            assume_role=bool(d.get("assume_role", False)),
+            capacity_types=tuple(pool.get("capacity_types", ())),
+            categories=tuple(pool.get("categories", ("c", "m", "r"))),
+            workloads=[Workload.from_dict(w) for w in d.get("workloads", [])],
+            timeline=sorted(
+                (TimedFault.from_dict(t) for t in d.get("timeline", [])),
+                key=lambda t: t.at_s,
+            ),
+        )
+
+    @classmethod
+    def from_json(cls, text: str) -> "Scenario":
+        return cls.from_dict(json.loads(text))
+
+    @classmethod
+    def from_file(cls, path: str) -> "Scenario":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+
+def list_canned() -> list[str]:
+    """Names of the shipped scenarios (chaos/scenarios/*.json)."""
+    if not os.path.isdir(_SCENARIO_DIR):
+        return []
+    return sorted(
+        f[:-5] for f in os.listdir(_SCENARIO_DIR) if f.endswith(".json")
+    )
+
+
+def canned(name: str) -> Scenario:
+    path = os.path.join(_SCENARIO_DIR, f"{name}.json")
+    if not os.path.exists(path):
+        raise ValueError(
+            f"unknown canned scenario {name!r}; shipped: {list_canned()}"
+        )
+    return Scenario.from_file(path)

@@ -1,0 +1,188 @@
+"""ChaosTransport: seeded fault injection at the wire seam.
+
+Wraps ANY ``Transport`` (``UrllibTransport``, ``ReplayTransport``, the
+stub below) behind the same one-callable contract
+(``providers/aws/transport.py``), so the whole Session stack — SigV4
+signing, ``_parse_error``, ``_retrying`` backoff — runs unmodified while
+faults fire underneath it. Every injection is recorded into a
+``ChaosLog`` whose ``signature()`` is byte-identical across same-seed
+runs, counted in ``karpenter_chaos_faults_injected_total`` per kind, and
+stamped onto the innermost live trace span (the ``aws.<service>``
+request span), so a flight-recorder tape of a chaos run shows exactly
+which requests were sabotaged.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass
+from typing import Iterable, Optional
+
+from ..providers.aws.transport import AwsRequest, AwsResponse, Transport
+from ..trace import annotate as trace_annotate
+from ..utils.clock import Clock, RealClock
+from .faults import Fault, classify_request
+
+
+@dataclass(frozen=True)
+class Injection:
+    """One recorded fault firing (or scenario-level activation event)."""
+
+    seq: int
+    t: float                 # injected-clock seconds (scenario time)
+    kind: str
+    service: str
+    action: str
+    detail: str = ""
+
+    def line(self) -> str:
+        return (
+            f"{self.seq:04d} t={self.t:09.3f} {self.kind} "
+            f"{self.service or '-'}.{self.action or '-'} {self.detail}".rstrip()
+        )
+
+
+class ChaosLog:
+    """Append-only injection record; the determinism witness.
+
+    ``signature()`` is the canonical byte string two same-seed runs must
+    agree on — it contains only seeded-RNG/virtual-clock-derived facts
+    (no wall time, no process-global counters).
+    """
+
+    def __init__(self):
+        self.records: list[Injection] = []
+
+    def record(self, t: float, kind: str, service: str = "", action: str = "",
+               detail: str = "") -> Injection:
+        inj = Injection(
+            seq=len(self.records), t=float(t), kind=kind,
+            service=service, action=action, detail=detail,
+        )
+        self.records.append(inj)
+        return inj
+
+    def by_kind(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for r in self.records:
+            out[r.kind] = out.get(r.kind, 0) + 1
+        return out
+
+    def signature(self) -> str:
+        return "\n".join(r.line() for r in self.records)
+
+    def __len__(self) -> int:
+        return len(self.records)
+
+
+class ChaosTransport:
+    """Fault-injecting ``Transport`` decorator.
+
+    Faults are consulted in registration order; the first one whose
+    predicate matches AND whose probability draw fires wins. A fault
+    whose ``intercept`` returns ``None`` (latency) falls through to the
+    next fault, then to the inner transport — so latency composes with
+    throttles the way a slow, overloaded API actually behaves.
+    """
+
+    def __init__(self, inner: Transport, faults: Iterable[Fault] = (),
+                 seed: int = 0, clock: Optional[Clock] = None,
+                 rng: Optional[random.Random] = None,
+                 log: Optional[ChaosLog] = None):
+        self.inner = inner
+        self.faults: list[Fault] = list(faults)
+        self.rng = rng or random.Random(seed)
+        self.clock = clock or RealClock()
+        # explicit None-check: an empty ChaosLog is falsy (__len__ == 0)
+        self.log = ChaosLog() if log is None else log
+
+    def add_fault(self, fault: Fault) -> Fault:
+        self.faults.append(fault)
+        return fault
+
+    def remove_fault(self, fault: Fault) -> None:
+        if fault in self.faults:
+            self.faults.remove(fault)
+
+    def clear_faults(self) -> None:
+        self.faults.clear()
+
+    def __call__(self, req: AwsRequest) -> AwsResponse:
+        service, action = classify_request(req)
+        now = self.clock.now()
+        for fault in list(self.faults):
+            if not fault.matches(service, action, now):
+                continue
+            if not fault.should_fire(self.rng):
+                continue
+            fault.fires += 1
+            self.log.record(
+                t=now, kind=fault.kind, service=service, action=action,
+                detail=fault.describe(),
+            )
+            self._count(fault.kind)
+            # the innermost live span here is Session._retrying's
+            # aws.<service> span — the tape shows the sabotage in place
+            trace_annotate(chaos_fault=fault.kind)
+            out = fault.intercept(req, self)  # may raise (ConnectionDrop)
+            if out is not None:
+                return out
+        return self.inner(req)
+
+    @staticmethod
+    def _count(kind: str) -> None:
+        try:
+            from ..metrics import CHAOS_FAULTS_INJECTED
+
+            CHAOS_FAULTS_INJECTED.inc(kind=kind)
+        except Exception:
+            pass
+
+
+# -- the hermetic "healthy AWS" ---------------------------------------------
+
+_STS_ASSUME_ROLE_BODY = """<AssumeRoleResponse xmlns="https://sts.amazonaws.com/doc/2011-06-15/">
+ <AssumeRoleResult>
+  <Credentials>
+   <AccessKeyId>ASIACHAOS{n}</AccessKeyId>
+   <SecretAccessKey>chaos-secret-{n}</SecretAccessKey>
+   <SessionToken>chaos-token-{n}</SessionToken>
+   <Expiration>{expiration}</Expiration>
+  </Credentials>
+ </AssumeRoleResult>
+</AssumeRoleResponse>"""
+
+
+class StubAwsTransport:
+    """Always-healthy inner transport: minimal protocol-correct success
+    bodies per (service, action). The chaos harness points a real
+    ``Session`` at ``ChaosTransport(StubAwsTransport())`` so the full
+    sign -> send -> parse -> retry pipeline runs hermetically; only the
+    faults make it misbehave."""
+
+    def __init__(self):
+        self.calls: list[tuple[str, str]] = []
+        self._sts_serial = 0
+
+    def __call__(self, req: AwsRequest) -> AwsResponse:
+        service, action = classify_request(req)
+        self.calls.append((service, action))
+        if service == "sts" and action == "AssumeRole":
+            self._sts_serial += 1
+            # expiration is checked against wall time.time() by
+            # Session._expiring — keep it comfortably in the future
+            expiration = time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime(time.time() + 3600)
+            )
+            body = _STS_ASSUME_ROLE_BODY.format(
+                n=self._sts_serial, expiration=expiration
+            ).encode()
+            return AwsResponse(200, body)
+        if any(k.lower() == "x-amz-target" for k in req.headers):
+            return AwsResponse(200, b"{}")
+        name = action if action and not action.startswith("/") else "Unknown"
+        return AwsResponse(
+            200,
+            f"<{name}Response><requestId>chaos-ok</requestId></{name}Response>".encode(),
+        )

@@ -142,14 +142,35 @@ class InterruptionController:
             list(self._pool.map(self._handle, messages))
 
     def _handle(self, message) -> None:
+        """Per-message isolation: a raising handler (recorder, cluster
+        write) must not abort the rest of the ``pool.map`` batch, and the
+        message is deleted REGARDLESS of handler outcome — the documented
+        at-least-once semantics. Without this, one poison message aborts
+        its batch undeleted and is redelivered forever."""
         try:
             event = parse_message(message.parsed())
         except Exception:
             event = InterruptionEvent("Unknown", (), False)
-        from ..metrics import INTERRUPTION_MESSAGES
+        from ..metrics import INTERRUPTION_MESSAGE_ERRORS, INTERRUPTION_MESSAGES
 
         INTERRUPTION_MESSAGES.inc(kind=event.kind)
         self.handled.append(event)
+        try:
+            self._act(event)
+        except Exception:
+            INTERRUPTION_MESSAGE_ERRORS.inc(kind=event.kind)
+            log.exception(
+                "interruption handler failed for %s; deleting message anyway "
+                "(at-least-once)", event.kind,
+            )
+        finally:
+            try:
+                self.queue.delete(message.receipt)
+            except Exception:
+                # delete failure = redelivery later; that IS at-least-once
+                log.exception("interruption message delete failed")
+
+    def _act(self, event: InterruptionEvent) -> None:
         for iid in event.instance_ids:
             claim = self.cluster.claim_by_instance_id(iid)
             if claim is None:
@@ -184,4 +205,3 @@ class InterruptionController:
             if event.action_drain:
                 log.info("interruption %s: draining %s", event.kind, claim.name)
                 self.cluster.delete(claim)  # cordon & drain via termination
-        self.queue.delete(message.receipt)

@@ -36,6 +36,11 @@ class Counter:
     def value(self, **labels) -> float:
         return self._values.get(tuple(sorted(labels.items())), 0.0)
 
+    def total(self) -> float:
+        """Sum across every label set (e.g. all-services retry volume)."""
+        with self._lock:
+            return float(sum(self._values.values()))
+
     def expose(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
         for key, v in sorted(self._values.items()):
@@ -208,6 +213,11 @@ AWS_REQUEST_RETRIES = REGISTRY.counter(
     "karpenter_aws_request_retries_total",
     "AWS API retry attempts by service (DefaultRetryer parity)",
 )
+AWS_REQUEST_RETRY_REASONS = REGISTRY.counter(
+    "karpenter_aws_request_retry_reason_total",
+    "AWS API retry attempts by service and cause class "
+    "(throttle / server / connection) — what chaos runs assert on",
+)
 SOLVE_PODS = REGISTRY.counter("karpenter_solver_pods_total", "Pods passed through Solve()")
 NODES_CREATED = REGISTRY.counter("karpenter_nodes_created_total", "Nodes launched")
 NODES_TERMINATED = REGISTRY.counter("karpenter_nodes_terminated_total", "Nodes terminated")
@@ -216,6 +226,21 @@ DISRUPTION_ACTIONS = REGISTRY.counter(
 )
 INTERRUPTION_MESSAGES = REGISTRY.counter(
     "karpenter_interruption_messages_total", "Interruption queue messages by kind"
+)
+INTERRUPTION_MESSAGE_ERRORS = REGISTRY.counter(
+    "karpenter_interruption_message_errors_total",
+    "Interruption messages whose handler raised; the message is still "
+    "deleted (documented at-least-once semantics) instead of poisoning "
+    "the queue with eternal redelivery",
+)
+CHAOS_FAULTS_INJECTED = REGISTRY.counter(
+    "karpenter_chaos_faults_injected_total",
+    "Chaos faults injected by kind (chaos/ subsystem)",
+)
+ICE_CACHE_SIZE = REGISTRY.gauge(
+    "karpenter_ice_cache_size",
+    "Offerings currently masked by the unavailable-offerings (ICE) cache "
+    "— chaos scenarios assert its growth under storms and decay after",
 )
 BATCH_SIZE = REGISTRY.histogram(
     "karpenter_batcher_batch_size", "Requests per coalesced batch",

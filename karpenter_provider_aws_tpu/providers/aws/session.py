@@ -37,14 +37,28 @@ USER_AGENT = f"karpenter-tpu/{_pkg_version} (sigv4-stdlib)"
 # aws-sdk-go DefaultRetryer parity: 3 retries max, retryable on throttle /
 # 5xx / clock-skew codes, full-jitter exponential backoff.
 MAX_RETRIES = 3
-RETRYABLE_CODES = frozenset({
+THROTTLE_CODES = frozenset({
     "Throttling", "ThrottlingException", "ThrottledException",
     "RequestLimitExceeded", "TooManyRequestsException",
     "ProvisionedThroughputExceededException", "RequestThrottled",
     "RequestThrottledException", "EC2ThrottledException",
+})
+RETRYABLE_CODES = THROTTLE_CODES | frozenset({
     "InternalError", "InternalFailure", "ServiceUnavailable",
     "RequestExpired",  # clock skew: retry after re-signing with fresh date
 })
+# backoff cap (full-jitter upper bound AND the Retry-After clamp)
+RETRY_DELAY_CAP_S = 5.0
+
+
+def _retry_reason(e: AwsApiError) -> str:
+    """Which class triggered backoff — throttle vs server vs connection
+    (the span tag + per-reason counter chaos runs assert on)."""
+    if e.code in THROTTLE_CODES or e.status == 429:
+        return "throttle"
+    if e.code == "ConnectionError" or e.status == 599:
+        return "connection"
+    return "server"
 
 
 def _now_amz() -> str:
@@ -136,7 +150,16 @@ def _parse_error(service: str, resp: AwsResponse) -> AwsApiError:
                            or message)
     except Exception:
         pass
-    return AwsApiError(resp.status, code, message)
+    retry_after = None
+    ra = next(
+        (v for k, v in resp.headers.items() if k.lower() == "retry-after"), ""
+    )
+    if ra:
+        try:
+            retry_after = float(ra)
+        except ValueError:
+            pass  # HTTP-date form: rare on AWS; fall back to jitter
+    return AwsApiError(resp.status, code, message, retry_after=retry_after)
 
 
 class Session:
@@ -310,9 +333,24 @@ class Session:
                     if not retryable or attempt >= MAX_RETRIES:
                         sp.set(retries=attempt, error_code=e.code)
                         raise
-                    # full-jitter: U(0, min(cap, base * 2^attempt)); SDK base
-                    # 30ms scale for throttles
-                    delay = self._rand() * min(5.0, 0.03 * (2 ** attempt) * 10)
+                    reason = _retry_reason(e)
+                    sp.set(retry_reason=reason)
+                    from ...metrics import AWS_REQUEST_RETRY_REASONS
+
+                    AWS_REQUEST_RETRY_REASONS.inc(
+                        service=service, reason=reason
+                    )
+                    if e.retry_after is not None and e.retry_after > 0:
+                        # the server said when to come back; honor it
+                        # (clamped to the backoff cap — a hostile header
+                        # must not stall a reconcile for minutes)
+                        delay = min(RETRY_DELAY_CAP_S, e.retry_after)
+                    else:
+                        # full-jitter: U(0, min(cap, base * 2^attempt));
+                        # SDK base 30ms scale for throttles
+                        delay = self._rand() * min(
+                            RETRY_DELAY_CAP_S, 0.03 * (2 ** attempt) * 10
+                        )
                     self._sleep(delay)
                     attempt += 1
 

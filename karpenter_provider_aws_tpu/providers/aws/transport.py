@@ -41,13 +41,19 @@ Transport = Callable[[AwsRequest], AwsResponse]
 
 class AwsApiError(Exception):
     """A non-2xx AWS reply, with the wire error code extracted (the
-    adapter-layer twin of utils.errors' taxonomy inputs)."""
+    adapter-layer twin of utils.errors' taxonomy inputs).
 
-    def __init__(self, status: int, code: str, message: str):
+    ``retry_after`` carries a throttle reply's Retry-After header in
+    seconds when the server sent one — the retryer prefers it (clamped)
+    over its own full-jitter guess."""
+
+    def __init__(self, status: int, code: str, message: str,
+                 retry_after: Optional[float] = None):
         super().__init__(f"{code} ({status}): {message}")
         self.status = status
         self.code = code
         self.message = message
+        self.retry_after = retry_after
 
 
 class UrllibTransport:

@@ -91,9 +91,12 @@ class ProvenanceRecord:
     git_sha: str = field(default_factory=git_sha)
     created_unix: float = field(default_factory=time.time)
     schema: int = SCHEMA_VERSION
+    # ambient context stamped at record() time (e.g. the chaos harness's
+    # scenario/seed/active-fault set); empty outside special regimes
+    context: dict = field(default_factory=dict)
 
     def as_dict(self) -> dict:
-        return {
+        d = {
             "kind": self.kind,
             "device": self.device,
             "device_count": self.device_count,
@@ -108,6 +111,9 @@ class ProvenanceRecord:
             "created_unix": int(self.created_unix),
             "schema": self.schema,
         }
+        if self.context:
+            d["context"] = dict(self.context)
+        return d
 
     def label(self) -> str:
         """Short human label for summaries: ``tpu/pallas@abc123``."""
@@ -125,7 +131,29 @@ _RECENT_LOCK = threading.Lock()
 _RECENT_CAP = 64
 
 
+# Ambient context providers: a running subsystem (the chaos harness) can
+# register a callable whose dict is merged into every record's ``context``
+# at creation — a solve that happened under an active fault says so in its
+# provenance forever, without the solver knowing chaos exists. Provider
+# failures are swallowed: provenance must not take down the path it stamps.
+_ambient_providers: list = []
+
+
+def register_ambient_provider(provider) -> None:
+    _ambient_providers.append(provider)
+
+
+def unregister_ambient_provider(provider) -> None:
+    if provider in _ambient_providers:
+        _ambient_providers.remove(provider)
+
+
 def record(rec: ProvenanceRecord) -> ProvenanceRecord:
+    for provider in list(_ambient_providers):
+        try:
+            rec.context.update(provider() or {})
+        except Exception:
+            pass
     with _RECENT_LOCK:
         _RECENT.setdefault(rec.kind, deque(maxlen=_RECENT_CAP)).append(rec)
     return rec

@@ -59,6 +59,7 @@ class Batcher(Generic[T, U]):
         self._hasher = hasher
         self._opts = options or BatcherOptions()
         self._lock = threading.Lock()
+        self._closed = False
         self._buckets: dict[Hashable, list[_Pending]] = {}
         self._timers: dict[Hashable, threading.Timer] = {}
         self._first_seen: dict[Hashable, float] = {}
@@ -79,6 +80,8 @@ class Batcher(Generic[T, U]):
         key = self._hasher(request)
         flush_now = False
         with self._lock:
+            if self._closed:
+                raise RuntimeError("batcher is closed")
             bucket = self._buckets.setdefault(key, [])
             bucket.append(p)
             if len(bucket) >= self._opts.max_items:
@@ -126,7 +129,19 @@ class Batcher(Generic[T, U]):
             self._execute(bucket, first)
 
     def close(self) -> None:
-        """Flush nothing further; reject new submits, join in-flight work."""
+        """Reject new submits, cancel armed timers, flush every pending
+        bucket, then join in-flight work. A bare pool shutdown would
+        leave armed ``threading.Timer``s live and pending buckets
+        unflushed — every in-flight ``add()`` caller would hang until
+        the 4xmax+30s watchdog instead of getting its result."""
+        with self._lock:
+            self._closed = True
+            pending = list(self._buckets)
+            for t in self._timers.values():
+                t.cancel()
+            self._timers.clear()
+        for key in pending:
+            self._flush(key)
         self._pool.shutdown(wait=True)
 
     def _execute(self, bucket: list[_Pending], first) -> None:

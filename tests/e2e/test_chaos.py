@@ -1,6 +1,9 @@
 """e2e: chaos suite (parity: test/suites/chaos + the fake fault-injection
 machinery — ICE storms, transient API errors, capacity-pool exhaustion;
-the cluster must converge anyway)."""
+the cluster must converge anyway). The slow soak at the bottom runs the
+chaos/ subsystem's four canned scenarios across a seed sweep."""
+
+import pytest
 
 from karpenter_provider_aws_tpu.models import Disruption, NodePool, Operator, Requirement, Taint
 from karpenter_provider_aws_tpu.models import labels as lbl
@@ -141,3 +144,28 @@ class TestRunawayScaleUp:
                 consolidate_after_s=30.0,
             ),
         ))
+
+
+@pytest.mark.slow
+class TestChaosScenarioSoak:
+    """Soak the chaos/ harness: every canned scenario under several seeds
+    (each a fresh environment + seeded fault stream), every invariant
+    must hold, and every seed must be self-reproducible. This is the
+    long-running robustness sweep the fast tier samples with one seed."""
+
+    def test_canned_scenarios_across_seeds(self):
+        from karpenter_provider_aws_tpu.chaos import list_canned, run_scenario
+
+        failures = []
+        for name in list_canned():
+            for seed in (1, 7, 23):
+                report = run_scenario(name, seed=seed)
+                if not report.passed:
+                    failures.append(f"{name} seed={seed}:\n{report.summary()}")
+        assert not failures, "\n\n".join(failures)
+
+    def test_determinism_across_seeds(self):
+        from karpenter_provider_aws_tpu.chaos import list_canned, run_deterministic
+
+        for name in list_canned():
+            run_deterministic(name, seed=5, runs=2)  # raises on divergence

@@ -1,0 +1,558 @@
+"""Chaos subsystem: seeded fault injection, scenario timelines, invariant
+checking — plus the satellite regressions that ride with it (interruption
+poison-message isolation, batcher close semantics, Retry-After honoring,
+ICE-cache locking/gauge).
+
+The four canned scenarios each run end to end (fast: stepped FakeClock,
+host solver); the determinism contract — same seed, byte-identical fault
+sequence — is asserted directly, which is the acceptance gate
+``python -m karpenter_provider_aws_tpu.chaos --scenario spot-storm
+--seed 7`` enforces from the CLI.
+"""
+
+import json
+import pathlib
+import random
+import threading
+import time
+
+import pytest
+
+from karpenter_provider_aws_tpu.chaos import (
+    ChaosTransport,
+    ConnectionDrop,
+    EventualConsistencyLag,
+    Ice,
+    InjectedLatency,
+    Scenario,
+    ServerError,
+    SpotInterrupt,
+    StubAwsTransport,
+    Throttle,
+    canned,
+    fault_from_dict,
+    inject_spot_interruptions,
+    install_consistency_lag,
+    list_canned,
+    run_deterministic,
+    run_scenario,
+    spot_interruption_message,
+    uninstall_consistency_lag,
+)
+from karpenter_provider_aws_tpu.chaos.faults import synthesize_error_body
+from karpenter_provider_aws_tpu.providers.aws import (
+    AwsApiError,
+    Credentials,
+    Ec2Client,
+    ReplayTransport,
+    Session,
+)
+from karpenter_provider_aws_tpu.providers.aws.session import _parse_error
+from karpenter_provider_aws_tpu.providers.aws.transport import (
+    AwsRequest,
+    AwsResponse,
+)
+from karpenter_provider_aws_tpu.utils.clock import FakeClock
+
+GOLDEN = pathlib.Path(__file__).resolve().parent / "golden" / "aws"
+
+
+def chaos_session(transport, **kw):
+    return Session(
+        region="us-east-1",
+        credentials=Credentials("AKIDEXAMPLE", "secret"),
+        transport=transport,
+        sleep=kw.pop("sleep", lambda s: None),
+        now_amz=lambda: "20260804T000000Z",
+        rand=kw.pop("rand", lambda: 0.0),
+        **kw,
+    )
+
+
+# ---------------------------------------------------------------------------
+# fault primitives
+# ---------------------------------------------------------------------------
+
+class TestFaultPrimitives:
+    def test_match_predicates_service_action_glob(self):
+        f = Throttle(service="ec2", action="Describe*")
+        assert f.matches("ec2", "DescribeInstances")
+        assert not f.matches("ec2", "CreateFleet")
+        assert not f.matches("sqs", "DescribeInstances")
+
+    def test_match_window(self):
+        f = Throttle(start_s=10.0, end_s=20.0)
+        assert not f.matches("ec2", "X", now=9.9)
+        assert f.matches("ec2", "X", now=10.0)
+        assert not f.matches("ec2", "X", now=20.0)
+
+    def test_count_limits_fires(self):
+        f = Throttle(count=2)
+        rng = random.Random(0)
+        assert f.should_fire(rng)
+        f.fires = 2
+        assert not f.should_fire(rng)
+
+    def test_probability_draws_are_seeded(self):
+        draws = [
+            [Throttle(probability=0.5).should_fire(random.Random(7))
+             for _ in range(20)]
+            for _ in range(2)
+        ]
+        assert draws[0] == draws[1]
+
+    def test_dict_round_trip(self):
+        for f in (
+            Throttle(service="ec2", probability=0.4, retry_after_s=1.5),
+            ServerError(code="ServiceUnavailable", status=503),
+            ConnectionDrop(action="CreateFleet"),
+            InjectedLatency(delay_s=0.5),
+            Ice(capacity_types=("spot",)),
+            SpotInterrupt(fraction=0.5, terminate=False),
+            EventualConsistencyLag(lag_s=30.0),
+        ):
+            clone = fault_from_dict(json.loads(json.dumps(f.to_dict())))
+            assert clone == f, f.kind
+
+    def test_unknown_kind_and_field_rejected(self):
+        with pytest.raises(ValueError):
+            fault_from_dict({"kind": "Nope"})
+        with pytest.raises(ValueError):
+            fault_from_dict({"kind": "Throttle", "bogus": 1})
+
+    def test_error_bodies_parse_like_real_aws(self):
+        """Synthesized bodies must round-trip through _parse_error into
+        the exact codes the retryer classifies on — all three protocol
+        shapes."""
+        ec2_req = AwsRequest("POST", "https://ec2.us-east-1.amazonaws.com/",
+                             service="ec2")
+        body = synthesize_error_body(ec2_req, "RequestLimitExceeded", "slow")
+        err = _parse_error("ec2", AwsResponse(400, body))
+        assert err.code == "RequestLimitExceeded"
+
+        sqs_req = AwsRequest("POST", "https://sqs.us-east-1.amazonaws.com/",
+                             service="sqs")
+        body = synthesize_error_body(sqs_req, "ServiceUnavailable", "down")
+        assert _parse_error("sqs", AwsResponse(503, body)).code == "ServiceUnavailable"
+
+        json_req = AwsRequest(
+            "POST", "https://api.pricing.us-east-1.amazonaws.com/",
+            headers={"x-amz-target": "AWSPriceListService.GetProducts",
+                     "content-type": "application/x-amz-json-1.1"},
+            service="pricing",
+        )
+        body = synthesize_error_body(json_req, "ThrottlingException", "slow")
+        assert _parse_error("pricing", AwsResponse(400, body)).code == "ThrottlingException"
+
+
+# ---------------------------------------------------------------------------
+# the chaos transport at the wire seam
+# ---------------------------------------------------------------------------
+
+class TestChaosTransport:
+    def test_throttle_drives_session_retrying_end_to_end(self):
+        clock = FakeClock()
+        ct = ChaosTransport(StubAwsTransport(), clock=clock)
+        ct.add_fault(Throttle(count=2))
+        session = chaos_session(ct)
+        Ec2Client(session).describe_availability_zones()  # no raise: retried
+        assert len(ct.log) == 2
+        assert [r.kind for r in ct.log.records] == ["Throttle", "Throttle"]
+        assert ct.log.records[0].action == "DescribeAvailabilityZones"
+
+    def test_connection_drop_is_retryable(self):
+        ct = ChaosTransport(StubAwsTransport(), clock=FakeClock())
+        ct.add_fault(ConnectionDrop(count=1))
+        Ec2Client(chaos_session(ct)).describe_availability_zones()
+        assert ct.log.records[0].kind == "ConnectionDrop"
+
+    def test_latency_advances_fake_clock_and_passes_through(self):
+        clock = FakeClock()
+        ct = ChaosTransport(StubAwsTransport(), clock=clock)
+        ct.add_fault(InjectedLatency(delay_s=2.5, count=1))
+        Ec2Client(chaos_session(ct)).describe_availability_zones()
+        assert clock.now() == 2.5  # virtual cost only
+
+    def test_exhausted_retries_surface_the_real_error(self):
+        ct = ChaosTransport(StubAwsTransport(), clock=FakeClock())
+        ct.add_fault(ServerError(code="ServiceUnavailable", status=503))
+        with pytest.raises(AwsApiError) as e:
+            Ec2Client(chaos_session(ct)).describe_availability_zones()
+        assert e.value.code == "ServiceUnavailable"
+
+    def test_injection_metric_counts_by_kind(self):
+        from karpenter_provider_aws_tpu.metrics import CHAOS_FAULTS_INJECTED
+
+        before = CHAOS_FAULTS_INJECTED.value(kind="Throttle")
+        ct = ChaosTransport(StubAwsTransport(), clock=FakeClock())
+        ct.add_fault(Throttle(count=1))
+        Ec2Client(chaos_session(ct)).describe_availability_zones()
+        assert CHAOS_FAULTS_INJECTED.value(kind="Throttle") == before + 1
+
+    def test_chaos_fault_annotated_on_request_span(self):
+        from karpenter_provider_aws_tpu.trace import TRACER
+
+        ct = ChaosTransport(StubAwsTransport(), clock=FakeClock())
+        ct.add_fault(Throttle(count=1))
+        Ec2Client(chaos_session(ct)).describe_availability_zones()
+        aws_spans = [s for s in TRACER.snapshot() if s.name == "aws.ec2"]
+        assert aws_spans and aws_spans[-1].attrs.get("chaos_fault") == "Throttle"
+        assert aws_spans[-1].attrs.get("retries", 0) >= 1
+
+    def test_composes_with_replay_transport(self):
+        """ChaosTransport over ReplayTransport: the fault answers first,
+        the golden contract replay still verifies the retried request."""
+        replay = ReplayTransport.from_file(GOLDEN / "throttle_retry_success.json")
+        # the fixture itself contains the throttle exchanges; wrap it and
+        # add a latency fault to prove pass-through composition
+        clock = FakeClock()
+        ct = ChaosTransport(replay, clock=clock)
+        ct.add_fault(InjectedLatency(delay_s=1.0))
+        zones = Ec2Client(chaos_session(ct)).describe_availability_zones()
+        assert zones and zones[0]["zoneName"] == "us-east-1a"
+        replay.assert_drained()
+        assert clock.now() == 3.0  # one virtual second per wire attempt
+
+
+# ---------------------------------------------------------------------------
+# session retry satellites: Retry-After + per-class reasons
+# ---------------------------------------------------------------------------
+
+class TestRetryAfterAndReasons:
+    def test_golden_throttle_retry_success_honors_retry_after(self):
+        """The shipped golden fixture: throttle (Retry-After: 1.2) ->
+        503 -> success. The first backoff is the server's number, the
+        second is full-jitter (rand=0 -> 0)."""
+        sleeps = []
+        transport = ReplayTransport.from_file(GOLDEN / "throttle_retry_success.json")
+        session = chaos_session(transport, sleep=sleeps.append)
+        zones = Ec2Client(session).describe_availability_zones()
+        assert [z["zoneName"] for z in zones] == ["us-east-1a"]
+        assert sleeps == [1.2, 0.0]
+        transport.assert_drained()
+
+    def test_retry_after_clamped_to_cap(self):
+        calls = []
+        sleeps = []
+
+        def transport(req):
+            calls.append(1)
+            if len(calls) == 1:
+                return AwsResponse(
+                    400,
+                    b"<Response><Errors><Error><Code>RequestLimitExceeded"
+                    b"</Code><Message>x</Message></Error></Errors></Response>",
+                    headers={"Retry-After": "120"},
+                )
+            return AwsResponse(200, b"<DescribeAvailabilityZonesResponse/>")
+
+        Ec2Client(chaos_session(transport, sleep=sleeps.append)).describe_availability_zones()
+        assert sleeps == [5.0]  # hostile header clamped to the 5s cap
+
+    def test_retry_reason_classes_tagged_and_counted(self):
+        from karpenter_provider_aws_tpu.metrics import AWS_REQUEST_RETRY_REASONS
+        from karpenter_provider_aws_tpu.trace import TRACER
+
+        before = {
+            r: AWS_REQUEST_RETRY_REASONS.value(service="ec2", reason=r)
+            for r in ("throttle", "server", "connection")
+        }
+        replies = [
+            AwsResponse(400, b"<Response><Errors><Error><Code>RequestLimitExceeded"
+                             b"</Code><Message>x</Message></Error></Errors></Response>"),
+            AwsResponse(503, b"<Response><Errors><Error><Code>InternalError"
+                             b"</Code><Message>x</Message></Error></Errors></Response>"),
+            None,  # sentinel: raise a connection error
+            AwsResponse(200, b"<DescribeAvailabilityZonesResponse/>"),
+        ]
+
+        def transport(req):
+            reply = replies.pop(0)
+            if reply is None:
+                raise AwsApiError(599, "ConnectionError", "reset by chaos")
+            return reply
+
+        Ec2Client(chaos_session(transport)).describe_availability_zones()
+        for r in ("throttle", "server", "connection"):
+            assert AWS_REQUEST_RETRY_REASONS.value(service="ec2", reason=r) == before[r] + 1
+        span = [s for s in TRACER.snapshot() if s.name == "aws.ec2"][-1]
+        assert span.attrs["retries"] == 3
+        assert span.attrs["retry_reason"] == "connection"  # last class wins
+
+
+# ---------------------------------------------------------------------------
+# cloud/queue hooks
+# ---------------------------------------------------------------------------
+
+class TestCloudHooks:
+    def test_spot_message_parses_as_interruption(self):
+        from karpenter_provider_aws_tpu.controllers.interruption import parse_message
+
+        ev = parse_message(spot_interruption_message("i-0abc"))
+        assert ev.kind == "SpotInterruption"
+        assert ev.instance_ids == ("i-0abc",)
+        assert ev.action_drain
+
+    def test_inject_spot_interruptions_deterministic_sample(self):
+        from karpenter_provider_aws_tpu.fake import FakeCloud, FakeQueue
+        from karpenter_provider_aws_tpu.fake.cloud import Instance
+
+        cloud = FakeCloud()
+        for i in range(6):
+            inst = Instance(id=f"i-{i:04d}", instance_type="m5.large",
+                            zone="zone-a", capacity_type="spot" if i < 4 else "on-demand",
+                            image_id="img-std-2")
+            cloud.instances[inst.id] = inst
+        picks = [
+            inject_spot_interruptions(FakeQueue(), cloud, fraction=0.5,
+                                      rng=random.Random(3))
+            for _ in range(2)
+        ]
+        assert picks[0] == picks[1]
+        assert len(picks[0]) == 2
+        assert all(cloud.instances[i].capacity_type == "spot" for i in picks[0])
+
+    def test_consistency_lag_hides_then_reveals(self):
+        from karpenter_provider_aws_tpu.fake import FakeCloud
+        from karpenter_provider_aws_tpu.fake.cloud import Instance
+
+        clock = FakeClock(start=100.0)
+        cloud = FakeCloud(clock=clock)
+        inst = Instance(id="i-new", instance_type="m5.large", zone="zone-a",
+                        capacity_type="spot", image_id="img-std-2",
+                        launch_time=clock.now())
+        cloud.instances[inst.id] = inst
+        install_consistency_lag(cloud, lag_s=45.0)
+        assert cloud.list_instances() == []
+        assert cloud.describe_instances(["i-new"]) == []
+        clock.advance(46.0)
+        assert [i.id for i in cloud.list_instances()] == ["i-new"]
+        uninstall_consistency_lag(cloud)
+        clock.advance(-46.0)  # rewound: the unwrapped reads see it anyway
+        assert [i.id for i in cloud.list_instances()] == ["i-new"]
+
+
+# ---------------------------------------------------------------------------
+# scenario plans
+# ---------------------------------------------------------------------------
+
+class TestScenarioPlans:
+    def test_canned_scenarios_ship(self):
+        assert list_canned() == [
+            "api-brownout", "eventual-consistency", "spot-storm", "sts-outage",
+        ]
+
+    def test_scenario_json_round_trip(self):
+        for name in list_canned():
+            sc = canned(name)
+            clone = Scenario.from_json(sc.to_json())
+            assert clone == sc, name
+
+    def test_timeline_is_sorted_on_load(self):
+        sc = Scenario.from_dict({
+            "name": "x",
+            "timeline": [
+                {"at_s": 30, "fault": {"kind": "Throttle"}},
+                {"at_s": 10, "fault": {"kind": "ServerError"}},
+            ],
+        })
+        assert [t.at_s for t in sc.timeline] == [10, 30]
+
+
+# ---------------------------------------------------------------------------
+# the four canned scenarios, end to end (fast: stepped clock, host solver)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def reports():
+    return {name: run_scenario(name, seed=7) for name in list_canned()}
+
+
+class TestCannedScenarios:
+    def test_all_invariants_pass(self, reports):
+        for name, report in reports.items():
+            assert report.passed, f"{name}:\n{report.summary()}"
+
+    def test_spot_storm_drained_and_relaunched(self, reports):
+        r = reports["spot-storm"]
+        assert r.faults_by_kind.get("SpotInterrupt", 0) >= 2
+        # warnings were received AND deleted (queue-drained invariant
+        # already asserts depth 0; this pins that traffic existed)
+        assert any("warned i#" in line for line in r.signature.splitlines())
+
+    def test_api_brownout_drives_session_retrying(self, reports):
+        """Acceptance: retry-count spans > 0, no controller crash, no
+        leaked instance."""
+        r = reports["api-brownout"]
+        assert r.retry_attempts > 0
+        assert r.faults_by_kind.get("Throttle", 0) > 0
+        by_name = {i.name: i for i in r.invariants}
+        assert by_name["controllers-healthy"].passed
+        assert by_name["no-leaked-instances"].passed
+
+    def test_sts_outage_fails_closed_then_recovers(self, reports):
+        r = reports["sts-outage"]
+        assert r.probe_failures > 0               # the outage bit
+        assert r.probe_failures < r.probe_calls   # ...and recovery happened
+        assert r.faults_by_kind.get("CredentialExpiry", 0) >= 1
+
+    def test_eventual_consistency_no_false_reaps(self, reports):
+        r = reports["eventual-consistency"]
+        assert r.nodes_launched >= 1
+        by_name = {i.name: i for i in r.invariants}
+        assert by_name["no-leaked-instances"].passed
+        assert by_name["pods-bound-once"].passed
+
+    def test_same_seed_byte_identical_fault_sequence(self):
+        """The acceptance gate: two same-seed runs, identical sequences
+        (run_deterministic raises on divergence)."""
+        a, b = run_deterministic("spot-storm", seed=7, runs=2)
+        assert a.signature == b.signature
+        assert len(a.signature) > 0
+
+    def test_different_seed_diverges_brownout(self, reports):
+        """Sanity that the seed MEANS something: a different seed shifts
+        the probabilistic brownout sequence."""
+        other = run_scenario("api-brownout", seed=8)
+        assert other.signature != reports["api-brownout"].signature
+
+    def test_report_dict_is_json_ready(self, reports):
+        doc = json.loads(json.dumps(reports["spot-storm"].as_dict()))
+        assert doc["scenario"] == "spot-storm"
+        assert doc["passed"] is True
+        assert {i["name"] for i in doc["invariants"]} >= {
+            "pods-bound-once", "converged", "no-leaked-instances",
+            "ice-mask-expired", "queue-drained", "controllers-healthy",
+        }
+
+    def test_solve_provenance_stamped_with_chaos_context(self):
+        """Solves that happen under active faults carry the scenario in
+        their provenance context forever."""
+        from karpenter_provider_aws_tpu.trace.provenance import last_record
+
+        run_scenario("api-brownout", seed=11)
+        rec = last_record("solve")
+        assert rec is not None
+        assert rec.context.get("chaos_scenario") == "api-brownout"
+        assert rec.context.get("chaos_seed") == 11
+        assert "context" in rec.as_dict()
+
+
+# ---------------------------------------------------------------------------
+# satellite regressions
+# ---------------------------------------------------------------------------
+
+class TestInterruptionPoisonMessage:
+    def test_poison_message_counted_deleted_and_batch_continues(self):
+        """A handler raising mid-message (recorder.publish here) must not
+        abort the batch or leave the message for eternal redelivery."""
+        from karpenter_provider_aws_tpu.metrics import (
+            INTERRUPTION_MESSAGE_ERRORS,
+        )
+        from karpenter_provider_aws_tpu.models import NodePool
+        from karpenter_provider_aws_tpu.models.pod import make_pods
+        from karpenter_provider_aws_tpu.testenv import new_environment
+
+        env = new_environment(use_tpu_solver=False)
+        try:
+            env.apply_defaults(NodePool(name="default"))
+            for p in make_pods(2, "w", {"cpu": "1", "memory": "2Gi"}):
+                env.cluster.apply(p)
+            env.step(3)
+            iids = sorted(env.cloud.instances)
+            assert len(iids) >= 1
+
+            class PoisonRecorder:
+                def publish(self, *a, **kw):
+                    raise RuntimeError("poisoned recorder")
+
+            env.interruption.recorder = PoisonRecorder()
+            before = INTERRUPTION_MESSAGE_ERRORS.value(kind="SpotInterruption")
+            env.queue.send(json.dumps(spot_interruption_message(iids[0])))
+            env.queue.send(json.dumps({"source": "aws.ec2",
+                                       "detail-type": "EC2 Instance Rebalance Recommendation",
+                                       "detail": {"instance-id": iids[-1]}}))
+            env.interruption.reconcile()
+            # both messages deleted despite the poisoned handler
+            assert len(env.queue) == 0
+            assert env.queue.deleted_count == 2
+            assert INTERRUPTION_MESSAGE_ERRORS.value(kind="SpotInterruption") == before + 1
+            # both messages were parsed and recorded before the poison hit
+            assert {e.kind for e in env.interruption.handled} >= {"SpotInterruption"}
+        finally:
+            env.close()
+
+
+class TestBatcherClose:
+    def test_close_flushes_pending_bucket_and_cancels_timers(self):
+        from karpenter_provider_aws_tpu.utils.batcher import (
+            Batcher,
+            BatcherOptions,
+        )
+
+        b = Batcher(
+            executor=lambda reqs: [r * 2 for r in reqs],
+            options=BatcherOptions(idle_timeout_s=60.0, max_timeout_s=120.0),
+        )
+        results = {}
+        t = threading.Thread(target=lambda: results.update(v=b.add(21)))
+        t.start()
+        for _ in range(200):  # wait for the add() to arm its timer
+            with b._lock:
+                if b._buckets:
+                    break
+            time.sleep(0.005)
+        t0 = time.monotonic()
+        b.close()
+        t.join(timeout=10.0)
+        assert not t.is_alive(), "pending add() hung through close()"
+        assert results["v"] == 42
+        assert time.monotonic() - t0 < 30.0  # not the 4xmax+30s watchdog
+        assert b._timers == {}
+        with pytest.raises(RuntimeError):
+            b.add(1)
+
+
+class TestUnavailableEntriesAndGauge:
+    def test_entries_under_lock_and_gauge_tracks_live_set(self, clock):
+        from karpenter_provider_aws_tpu.metrics import ICE_CACHE_SIZE
+        from karpenter_provider_aws_tpu.utils import UnavailableOfferings
+
+        u = UnavailableOfferings(clock=clock)
+        u.mark_unavailable("m5.large", "zone-a", "spot")
+        u.mark_unavailable("c5.large", "zone-b", "on-demand")
+        assert ICE_CACHE_SIZE.value() == 2.0
+        assert sorted(u.entries()) == [
+            ("on-demand", "c5.large", "zone-b"),
+            ("spot", "m5.large", "zone-a"),
+        ]
+        clock.advance(181.0)  # TTL lapses silently inside TTLCache
+        assert u.entries() == []
+        assert ICE_CACHE_SIZE.value() == 0.0
+
+    def test_concurrent_entries_and_marks_do_not_tear(self, clock):
+        from karpenter_provider_aws_tpu.utils import UnavailableOfferings
+
+        u = UnavailableOfferings(clock=clock)
+        stop = threading.Event()
+        errors = []
+
+        def reader():
+            while not stop.is_set():
+                try:
+                    for e in u.entries():
+                        assert len(e) == 3
+                except Exception as exc:  # pragma: no cover
+                    errors.append(exc)
+
+        threads = [threading.Thread(target=reader) for _ in range(3)]
+        for t in threads:
+            t.start()
+        for i in range(300):
+            u.mark_unavailable(f"t{i % 7}.large", f"zone-{i % 3}", "spot")
+            if i % 5 == 0:
+                u.flush()
+        stop.set()
+        for t in threads:
+            t.join(timeout=5.0)
+        assert not errors
