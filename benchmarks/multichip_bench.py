@@ -63,6 +63,10 @@ def bench_sharded_screen(n_nodes=5000, iters=3) -> dict:
     )
     from karpenter_provider_aws_tpu.parallel import make_mesh, screen_sharded
 
+    import os
+
+    from karpenter_provider_aws_tpu.parallel.mesh import screen_lanes_per_device
+
     env = _synth_cluster(n_nodes=n_nodes)
     ct = encode_cluster(env.cluster, env.catalog)
     mesh = make_mesh(N_DEVICES)
@@ -79,6 +83,22 @@ def bench_sharded_screen(n_nodes=5000, iters=3) -> dict:
         single = consolidatable(ct)
         single_ms = (time.perf_counter() - t0) * 1000.0
     assert (ok == single).all(), "mesh screen diverged from single-device"
+    native_floor = int(os.environ.get("KARPENTER_TPU_MESH_SCREEN_NATIVE_N", 1024))
+    native_ok = False
+    try:  # mirror the fallback's own availability probe: the row must name
+        # the path that actually RAN, not the one the thresholds intended
+        from karpenter_provider_aws_tpu.scheduling.native import (  # noqa: F401
+            repack_check_native,
+        )
+
+        native_ok = True
+    except Exception:
+        pass
+    screen_mode = (
+        "native-fallback"
+        if n_nodes >= native_floor and not ct.has_topology() and native_ok
+        else "mesh-chunked"
+    )
     return {
         # exact node count in the key: truncating to a k-suffix collides
         # different scales under one BENCH_SUMMARY row
@@ -89,6 +109,11 @@ def bench_sharded_screen(n_nodes=5000, iters=3) -> dict:
         "p50_ms": round(float(np.percentile(times, 50)), 3),
         "single_device_ms": round(single_ms, 3),
         "consolidatable_nodes": int(ok.sum()),
+        # the scaling-cliff guards (see parallel/mesh.py screen_sharded):
+        # chunked lanes bound per-device memory; a big-N CPU (virtual) mesh
+        # answers via the native kernel instead of 8-way-sharding one host
+        "screen_mode": screen_mode,
+        "lanes_per_device": screen_lanes_per_device(n_nodes, ct.free.shape[1]),
         "device": "cpu-virtual-mesh",
     }
 
@@ -222,6 +247,9 @@ def run_all(scale: float = 1.0, on_row=None) -> list[dict]:
     for fn, kwargs in (
         (bench_solve_merge, {"num_pods": int(2000 * scale)}),
         (bench_sharded_screen, {"n_nodes": max(int(5000 * scale), 200)}),
+        # a second row UNDER the native-fallback floor: proves the chunked
+        # mesh path itself (the one real multi-chip hardware runs) scales
+        (bench_sharded_screen, {"n_nodes": max(int(500 * scale), 200)}),
         (partition_evidence, {"n_nodes": max(int(2000 * scale), 200),
                               "num_pods": max(int(10_000 * scale), 2000)}),
     ):

@@ -58,7 +58,11 @@ def fmt(row: dict) -> str:
               "pallas_p99_ms", "vmap_p99_ms", "native_p99_ms", "encode_ms",
               "controller_pass_ms", "cost_vs_greedy",
               "projected_local_p99_ms", "link_rtt_p99_ms",
-              "single_device_ms", "cost_merged", "max_ms"):
+              "single_device_ms", "cost_merged", "max_ms",
+              # incremental-encode rows (docs/performance.md)
+              "full_encode_ms", "hit_ms", "patch_p50_ms", "patch_p99_ms",
+              "first_pass_ms", "second_pass_ms", "screen_mode",
+              "probe_error"):
         if k in row and row[k] is not None:
             v = row[k]
             bits.append(f"{k}={v:,.3f}" if isinstance(v, float) else f"{k}={v}")

@@ -101,13 +101,17 @@ class DisruptionController:
     # -- reconcile ---------------------------------------------------------
     def reconcile(self) -> None:
         budget = self._budget_left()
-        # one bulk pod view per pass (four methods consume it)
+        # one bulk pod view per pass (four methods consume it; the
+        # consolidation encode patches from it too). The revision is
+        # captured FIRST so the incremental encoder re-patches anything
+        # that mutates between this snapshot and the encode.
+        rev0 = getattr(self.cluster, "rev", None)
         by_node = self.cluster.pods_by_node()
         self._reconcile_expiration(budget, by_node)
         if self.drift_enabled:
             self._reconcile_drift(budget, by_node)
         self._reconcile_emptiness(budget, by_node)
-        self._reconcile_consolidation(budget)
+        self._reconcile_consolidation(budget, by_node, rev0)
 
     def _claims_with_nodes(self, pods_by_node=None):
         if pods_by_node is None:
@@ -163,7 +167,8 @@ class DisruptionController:
                 continue
             self._disrupt(claim, "empty", budget)
 
-    def _reconcile_consolidation(self, budget) -> None:
+    def _reconcile_consolidation(self, budget, pods_by_node=None,
+                                 rev0=None) -> None:
         pools = self.cluster.nodepools
         # Skip the whole encode + device screen when no pool can consolidate.
         if not any(
@@ -176,12 +181,17 @@ class DisruptionController:
             # otherwise bypass the window)
             self._consol_seen.clear()
             return
-        ct = encode_cluster(self.cluster, self.cloudprovider.catalog)
+        # one encode per pass, incrementally patched across passes; the
+        # pass's shared pod view rides along so the encoder never re-lists
+        ct = encode_cluster(self.cluster, self.cloudprovider.catalog,
+                            pods_by_node=pods_by_node, rev_floor=rev0)
         if ct is None:
             self._consol_seen.clear()
             return
         nodes = {n.name: n for n in self.cluster.snapshot_nodes()}
         now = self.clock.now()
+        if pods_by_node is None:
+            pods_by_node = self.cluster.pods_by_node()
         _eligible_cache: dict[int, object] = {}
 
         def eligible(ni: int) -> Optional[object]:
@@ -189,6 +199,14 @@ class DisruptionController:
                 return _eligible_cache[ni]
             result = None
             node = nodes.get(ct.node_names[ni])
+            # live pod-level do-not-disrupt recheck: ct.blocked carries it
+            # from encode time, but an annotation stamped since (an
+            # in-place mutation the change journal cannot see) must still
+            # protect the node before anything commits this pass
+            if node is not None and any(
+                p.do_not_disrupt() for p in pods_by_node.get(node.name, ())
+            ):
+                node = None
             if node is not None:
                 pool = pools.get(node.nodepool_name)
                 claim = self.cluster.nodeclaims.get(node.nodeclaim_name)

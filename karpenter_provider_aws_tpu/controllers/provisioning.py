@@ -52,7 +52,17 @@ class ProvisioningController:
         self.last_unschedulable: list = []
 
     def reconcile(self) -> None:
+        from ..models.pod import POD_WRITE_SEQ
+
         self._prune_stale_nominations()
+        # revision components are captured BEFORE the pending snapshot: a
+        # mutation racing the list read then leaves the token OLDER than the
+        # pods (at worst one extra cache miss next pass) — capturing after
+        # would let a newer token alias a stale pod list into the
+        # encoded-problem cache
+        rev0 = getattr(self.cluster, "rev", None)
+        epoch0 = getattr(self.cluster, "epoch", None)
+        pod_seq0 = POD_WRITE_SEQ.v
         with self._nominations_lock:
             nominated_map = dict(self.nominations)
         nominated = set(nominated_map)
@@ -65,6 +75,17 @@ class ProvisioningController:
         from ..ops.encode import ZoneOccupancy
         from ..scheduling.solver import snapshot_existing_capacity
 
+        # O(1) revision token for the encoded-problem cache: the pending set
+        # is fully determined by (store epoch, store revision, nominations),
+        # so the cache key skips the per-pod id/version tuples. epoch is an
+        # identity object — a reset store can never alias an old revision —
+        # and POD_WRITE_SEQ rides along so a direct pod field reassignment
+        # (bumps Pod._version, not cluster.rev) still misses the cache.
+        revision = (
+            (epoch0, rev0, pod_seq0, frozenset(nominated))
+            if epoch0 is not None and rev0 is not None
+            else None
+        )
         with self.profiler.capture("solve"):
             result = self.solver.solve(
                 pending,
@@ -72,6 +93,7 @@ class ProvisioningController:
                 self.cloudprovider.catalog,
                 in_use=self.cluster.in_use_by_nodepool(),
                 occupancy=ZoneOccupancy.from_cluster(self.cluster),
+                revision=revision,
                 type_allow={
                     pool.name: self.cloudprovider.launchable_type_names(pool)
                     for pool in nodepools

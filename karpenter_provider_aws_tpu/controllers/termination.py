@@ -72,8 +72,9 @@ class TerminationController:
                 continue
             for p in covering:
                 headroom[p.name] -= 1
-            pod.node_name = ""
-            pod.phase = "Pending"
+            # through the store so the change journal sees the unbind (the
+            # incremental encoders patch from it)
+            self.cluster.unbind_pod(pod.uid)
         return drained
 
     def reconcile(self) -> None:

@@ -242,6 +242,17 @@ ICE_CACHE_SIZE = REGISTRY.gauge(
     "Offerings currently masked by the unavailable-offerings (ICE) cache "
     "— chaos scenarios assert its growth under storms and decay after",
 )
+ENCODE_CACHE = REGISTRY.counter(
+    "karpenter_encode_cache_total",
+    "Encode-cache outcomes by path (cluster = consolidation ClusterTensors, "
+    "problem = provisioning EncodedProblem, occupancy = bound-pod zone "
+    "snapshot) and outcome (hit = served unchanged, patch = delta-patched, "
+    "full = rebuilt from scratch)",
+)
+ENCODE_PATCH_ROWS = REGISTRY.counter(
+    "karpenter_encode_patch_rows_total",
+    "Node rows rewritten by incremental cluster-encode patches",
+)
 BATCH_SIZE = REGISTRY.histogram(
     "karpenter_batcher_batch_size", "Requests per coalesced batch",
     buckets=(1, 2, 5, 10, 50, 100, 500, 1000),

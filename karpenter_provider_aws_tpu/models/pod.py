@@ -34,6 +34,25 @@ _GROUP_INTERN: dict[tuple, int] = {}
 _group_counter = itertools.count()
 
 
+class _Seq:
+    """Process-wide write-sequence cell (a mutable int). Shared with
+    state/cluster.py's NODE_WRITE_SEQ — one definition for both."""
+
+    __slots__ = ("v",)
+
+    def __init__(self):
+        self.v = 0
+
+
+#: Bumped by every scheduling-relevant Pod field write, process-wide. The
+#: O(1) revision token the provisioning loop hands the encoded-problem
+#: cache folds this in: a direct ``pod.requests = ...`` reassignment bumps
+#: Pod._version but NOT the cluster revision, and without this sequence the
+#: revision-keyed cache would serve the pod's stale encoding (the legacy
+#: per-pod (id, _version) key caught exactly that).
+POD_WRITE_SEQ = _Seq()
+
+
 @dataclass(frozen=True)
 class Toleration:
     key: str = ""
@@ -139,9 +158,14 @@ class Pod:
             # _scheduling_key was transiently None (review round-3)
             if getattr(self, "_scheduling_token", None) is not None:
                 object.__setattr__(self, "_scheduling_token", None)
+        object.__setattr__(self, name, value)
+        # version bumps AFTER the field write: a reader that keys on the new
+        # version has then necessarily seen (or will re-read) the new value,
+        # so caches can only over-invalidate, never pin a stale encoding
+        # under a fresh version
         if name in Pod._VERSION_FIELDS:
             object.__setattr__(self, "_version", getattr(self, "_version", 0) + 1)
-        object.__setattr__(self, name, value)
+            POD_WRITE_SEQ.v += 1
 
     def bump_version(self) -> None:
         """Explicit invalidation after IN-PLACE mutation of a scheduling
@@ -152,6 +176,7 @@ class Pod:
         object.__setattr__(self, "_scheduling_key", None)
         object.__setattr__(self, "_scheduling_token", None)
         object.__setattr__(self, "_version", getattr(self, "_version", 0) + 1)
+        POD_WRITE_SEQ.v += 1
 
     # -- scheduling views --------------------------------------------------
     def requirements(self) -> Requirements:

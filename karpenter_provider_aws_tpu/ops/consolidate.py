@@ -48,6 +48,10 @@ class ZoneConstraint:
     kind: str
     skew: int
     match: np.ndarray   # [G] bool
+    # The term's raw label selector. Carried so the incremental encoder can
+    # extend ``match`` when new groups appear without re-deriving terms from
+    # the representative pod (and so a dumped constraint is debuggable).
+    selector: Optional[dict] = None
 
 
 @dataclass
@@ -86,7 +90,10 @@ class ClusterTensors:
         )
 
 
-def encode_cluster(cluster, catalog, gmax: int = GMAX_DEFAULT) -> Optional[ClusterTensors]:
+def encode_cluster(cluster, catalog, gmax: int = GMAX_DEFAULT,
+                   pods_by_node=None, incremental: Optional[bool] = None,
+                   rev_floor: Optional[int] = None,
+                   ) -> Optional[ClusterTensors]:
     """Snapshot ready nodes with claims into consolidation tensors.
 
     Topology-constrained pods no longer block their node outright (round-1
@@ -94,14 +101,40 @@ def encode_cluster(cluster, catalog, gmax: int = GMAX_DEFAULT) -> Optional[Clust
     device screen enforces hostname headroom, and ``repack_set_feasible``
     validates the full topology semantics before any disruption commits.
     Groups are split by pod labels as well as scheduling key, so a group
-    representative's labels are exact for selector-matching accounting."""
+    representative's labels are exact for selector-matching accounting.
+
+    Incremental by default when the cluster exposes the change journal
+    (state.Cluster): a persistent per-(cluster, catalog) encoder patches
+    dirty node rows from the journal instead of re-tensorizing 5k nodes
+    per reconcile, falling back to this full encode on journal overflow,
+    catalog change, or heavy churn (see ops/encode_delta.py).
+    ``KARPENTER_TPU_INCREMENTAL_ENCODE=0`` disables. ``pods_by_node`` lets
+    the disruption controller share its already-built per-pass pod view.
+    """
+    import os
+
     from ..trace import span as _span
 
-    with _span("consolidate.encode"):
-        return _encode_cluster(cluster, catalog, gmax)
+    if incremental is None:
+        incremental = (
+            os.environ.get("KARPENTER_TPU_INCREMENTAL_ENCODE", "1") == "1"
+            and getattr(cluster, "changes_since", None) is not None
+        )
+    with _span("consolidate.encode") as sp:
+        if incremental:
+            from .encode_delta import incremental_encode_cluster
+
+            return incremental_encode_cluster(
+                cluster, catalog, gmax, pods_by_node=pods_by_node,
+                rev_floor=rev_floor, span=sp,
+            )
+        if sp is not None and hasattr(sp, "set"):
+            sp.set(mode="full")
+        return _encode_cluster(cluster, catalog, gmax, pods_by_node=pods_by_node)
 
 
-def _encode_cluster(cluster, catalog, gmax: int) -> Optional[ClusterTensors]:
+def _encode_cluster(cluster, catalog, gmax: int,
+                    pods_by_node=None) -> Optional[ClusterTensors]:
     from ..models import labels as lbl
 
     # A node whose claim is already draining (deleted) is neither a
@@ -122,7 +155,8 @@ def _encode_cluster(cluster, catalog, gmax: int) -> Optional[ClusterTensors]:
     # ---- flatten pods over nodes; everything per-pod below is ONE pass ----
     # (the previous per-pod Python accumulation was the 80x encode gap vs
     # the native path at 5k nodes — round-3 VERDICT weak #3)
-    pods_by_node = cluster.pods_by_node()
+    if pods_by_node is None:
+        pods_by_node = cluster.pods_by_node()
     node_pods = [pods_by_node.get(n.name, ()) for n in nodes]
     pods_flat = [p for plist in node_pods for p in plist]
     P = len(pods_flat)
@@ -254,7 +288,8 @@ def _encode_cluster(cluster, catalog, gmax: int) -> Optional[ClusterTensors]:
             row = np.array([_matches(a.label_selector, o) for o in reps])
             cons.append(
                 ZoneConstraint(
-                    kind="anti" if a.matches(rep) else "block", skew=1, match=row
+                    kind="anti" if a.matches(rep) else "block", skew=1, match=row,
+                    selector=dict(a.label_selector),
                 )
             )
         # ALL zone terms, not just zone_topology_term()'s highest-precedence
@@ -267,12 +302,14 @@ def _encode_cluster(cluster, catalog, gmax: int) -> Optional[ClusterTensors]:
             ):
                 row = np.array([_matches(c.label_selector, o) for o in reps])
                 cons.append(
-                    ZoneConstraint(kind="spread", skew=max(int(c.max_skew), 1), match=row)
+                    ZoneConstraint(kind="spread", skew=max(int(c.max_skew), 1),
+                                   match=row, selector=dict(c.label_selector))
                 )
         for a in rep.affinity:
             if a.topology_key == lbl.TOPOLOGY_ZONE:
                 row = np.array([_matches(a.label_selector, o) for o in reps])
-                cons.append(ZoneConstraint(kind="affinity", skew=0, match=row))
+                cons.append(ZoneConstraint(kind="affinity", skew=0, match=row,
+                                           selector=dict(a.label_selector)))
         zone_constraints.append(cons)
 
     # screen cap: compat gated, hostname headroom subtracted (the device
@@ -1000,19 +1037,63 @@ def cheaper_replacement(
 
     from ..models import labels as lbl
 
-    # spec requirements only — template *labels* are stamped onto nodes, not
-    # constraints the instance type must itself satisfy
-    pool_masks: dict[str, np.ndarray] = {}
-    pool_windows: dict[str, np.ndarray] = {}  # [Z, C] zone x captype allowance
     Z = len(tensors.zones)
-    for name, pool in (nodepools or {}).items():
-        reqs = Requirements(pool.requirements)
-        pool_masks[name] = static_mask(reqs)
-        zvs = reqs.get(lbl.TOPOLOGY_ZONE)
-        cvs = reqs.get(lbl.CAPACITY_TYPE)
-        zrow = np.array([zvs.contains(z) for z in tensors.zones])
-        crow = np.array([cvs.contains(ct_) for ct_ in lbl.CAPACITY_TYPES])
-        pool_windows[name] = zrow[:, None] & crow[None, :]
+    # Per-ct memo for everything derivable from (catalog snapshot, pools):
+    # the incremental encoder returns the SAME ct object across unchanged
+    # passes, so the [G, T] compat matrix, pool masks/windows, and group
+    # windows are computed once per (snapshot, pool set) instead of per
+    # reconcile — the "screen -> candidate eval -> repack re-derive the
+    # tensors" cost the delta-encoding round removes.
+    memo = ct.__dict__.setdefault("_replace_memo", {})
+    pools_sig = tuple(sorted(
+        (name, pool.hash()) for name, pool in (nodepools or {}).items()
+    ))
+    nc_sig = tuple(sorted(
+        (name, nc.hash() if nc is not None else None)
+        for name, nc in (nodeclass_by_pool or {}).items()
+    ))
+    mkey = (catalog.uid, tensors.key, pools_sig, nc_sig)
+    if memo.get("key") != mkey:
+        memo.clear()
+        memo["key"] = mkey
+        # spec requirements only — template *labels* are stamped onto
+        # nodes, not constraints the instance type must itself satisfy
+        pool_masks: dict[str, np.ndarray] = {}
+        pool_windows: dict[str, np.ndarray] = {}  # [Z, C] allowance
+        for name, pool in (nodepools or {}).items():
+            reqs = Requirements(pool.requirements)
+            pool_masks[name] = static_mask(reqs)
+            zvs = reqs.get(lbl.TOPOLOGY_ZONE)
+            cvs = reqs.get(lbl.CAPACITY_TYPE)
+            zrow = np.array([zvs.contains(z) for z in tensors.zones])
+            crow = np.array([cvs.contains(ct_) for ct_ in lbl.CAPACITY_TYPES])
+            pool_windows[name] = zrow[:, None] & crow[None, :]
+        # group x type compat via the same vectorized path as encode
+        G = ct.requests.shape[0]
+        compat_t = np.ones((G, T), dtype=bool)
+        for gi, pods in enumerate(ct.group_pods):
+            reqs = pods[0].requirements()
+            row = np.ones(T, dtype=bool)
+            for key, vs in reqs:
+                if key in (lbl.TOPOLOGY_ZONE, lbl.CAPACITY_TYPE,
+                           lbl.HOSTNAME, lbl.NODEPOOL):
+                    continue
+                arrays = label_arrays.get(key)
+                if arrays is None:
+                    if not vs.allow_undefined:
+                        row[:] = False
+                        break
+                    continue
+                row &= _contains_vec(vs, *arrays)
+            compat_t[gi] = row
+        memo["pool_masks"] = pool_masks
+        memo["pool_windows"] = pool_windows
+        memo["compat_t"] = compat_t
+        memo["gw"] = {}
+        memo["dec"] = {}
+    pool_masks = memo["pool_masks"]
+    pool_windows = memo["pool_windows"]
+    compat_t = memo["compat_t"]
 
     def group_window(gi: int) -> np.ndarray:
         reqs = ct.group_pods[gi][0].requirements()
@@ -1022,29 +1103,10 @@ def cheaper_replacement(
         crow = np.array([cvs.contains(ct_) for ct_ in lbl.CAPACITY_TYPES])
         return zrow[:, None] & crow[None, :]
 
-    # group x type compat via the same vectorized requirement path as encode
-    G = ct.requests.shape[0]
-    compat_t = np.ones((G, T), dtype=bool)
-    for gi, pods in enumerate(ct.group_pods):
-        reqs = pods[0].requirements()
-        row = np.ones(T, dtype=bool)
-        from ..models import labels as lbl
-        for key, vs in reqs:
-            if key in (lbl.TOPOLOGY_ZONE, lbl.CAPACITY_TYPE, lbl.HOSTNAME, lbl.NODEPOOL):
-                continue
-            arrays = label_arrays.get(key)
-            if arrays is None:
-                if not vs.allow_undefined:
-                    row[:] = False
-                    break
-                continue
-            row &= _contains_vec(vs, *arrays)
-        compat_t[gi] = row
-
     out = []
     N = len(ct.node_names)
     present = ct.group_counts > 0  # [N, GMAX]
-    gw_cache: dict[int, np.ndarray] = {}
+    gw_cache: dict[int, np.ndarray] = memo["gw"]
     # Hard reserved counts, tracked across candidates within this pass: a
     # single free reservation slot may justify at most ONE replacement —
     # later candidates must price against market capacity or stay put.
@@ -1073,10 +1135,35 @@ def cheaper_replacement(
             pool_rmask[pname] = m
         no_access = np.zeros((T, Z), dtype=bool)
     fallback = np.ones((Z, lbl.NUM_CAPACITY_TYPES), dtype=bool)
+    # Per-node-CLASS decision cache: thousands of nodes collapse to the
+    # distinct (pool, group set, zone, captype, price, fill) combinations
+    # actually present, within a pass and — because the memo lives on the
+    # (persistent) ct — across unchanged passes. Disabled whenever hard
+    # reservation slots are in play: those decisions mutate res_left and
+    # may not be replayed.
+    dec: dict = memo["dec"]
+    _MISS = object()
+    cacheable = not bool(res_left.any())
     for i in range(N):
         if ct.blocked[i] or not present[i].any():
             continue
         gids = ct.group_ids[i][present[i]]
+        dkey = None
+        if cacheable:
+            dkey = (
+                ct.nodepool_names[i],
+                tuple(sorted({int(g) for g in gids})),
+                ct.node_zone[i] if ct.node_zone else None,
+                ct.node_captype[i] if ct.node_captype else None,
+                float(ct.price[i]),
+                ct.used_total[i].tobytes(),
+                margin, spot_to_spot,
+            )
+            hit = dec.get(dkey, _MISS)
+            if hit is not _MISS:
+                if hit is not None:
+                    out.append((i,) + hit)
+                continue
         node_compat = compat_t[gids].all(axis=0)  # [T]
         pool_mask = pool_masks.get(ct.nodepool_names[i])
         if pool_mask is not None:
@@ -1099,6 +1186,8 @@ def cheaper_replacement(
             zrow = np.array([z == ct.node_zone[i] for z in tensors.zones])
             window &= zrow[:, None]
         if not window.any():
+            if dkey is not None:
+                dec[dkey] = None
             continue
         # price per type restricted to the allowed, live offerings;
         # reserved only where slots remain unclaimed this pass AND the
@@ -1151,5 +1240,10 @@ def cheaper_replacement(
                 for ci in range(lbl.NUM_CAPACITY_TYPES)
                 if allowed[t, zi, ci]
             ]
-            out.append((i, tensors.names[t], float(win_price[t]), offering_options))
+            result = (tensors.names[t], float(win_price[t]), offering_options)
+            if dkey is not None:  # cacheable => reserved can't have won
+                dec[dkey] = result
+            out.append((i,) + result)
+        elif dkey is not None:
+            dec[dkey] = None
     return out

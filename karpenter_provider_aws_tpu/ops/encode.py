@@ -151,6 +151,14 @@ def balanced_fill(counts: dict, live, P: int) -> tuple[dict, int]:
     return {z: int(a) for z, a in zip(zis, assign) if a}, int(assign.sum())
 
 
+def _count_encode_cache(path: str, outcome: str) -> None:
+    """Encode-cache observability (metrics.ENCODE_CACHE); lazy import so
+    ops/ keeps no import-time edge onto the metrics registry."""
+    from ..metrics import ENCODE_CACHE
+
+    ENCODE_CACHE.inc(path=path, outcome=outcome)
+
+
 class ZoneOccupancy:
     """Per-zone counts of already-bound pods, for topology accounting.
 
@@ -171,7 +179,39 @@ class ZoneOccupancy:
     @classmethod
     def from_cluster(cls, cluster) -> "ZoneOccupancy":
         """Snapshot bound pods on nodes with a known zone (duck-typed so the
-        state package need not be imported here)."""
+        state package need not be imported here).
+
+        Revision-cached: building this is O(bound pods) with a dict copy per
+        pod, paid every reconcile in steady state even though the bound set
+        rarely changes between passes. When the cluster exposes the change
+        journal (state.Cluster), the previous snapshot is reused as long as
+        no pod or node mutation landed since it was taken — which also keeps
+        its memoized ``fingerprint()``, so the encoded-problem cache key
+        costs O(1) instead of O(bound pods) per pass."""
+        from ..models.pod import POD_WRITE_SEQ
+        from ..state.cluster import NODE_WRITE_SEQ
+
+        rev = getattr(cluster, "rev", None)
+        epoch = getattr(cluster, "epoch", None)
+        changes_since = getattr(cluster, "changes_since", None)
+        # the write sequences cover direct object mutations the journal
+        # cannot see (node label reassignment changing a zone, pod label
+        # reassignment changing selector matches). Captured BEFORE any read
+        # of cluster state, so a mutation racing the snapshot build below
+        # invalidates the stored entry instead of hiding inside it.
+        seqs = (NODE_WRITE_SEQ.v, POD_WRITE_SEQ.v)
+        if rev is not None and epoch is not None and changes_since is not None:
+            cached = cluster.__dict__.get("_occupancy_cache")
+            if cached is not None and cached[0] is epoch and cached[3] == seqs:
+                _, c_rev, occ, _ = cached
+                if c_rev == rev:
+                    _count_encode_cache("occupancy", "hit")
+                    return occ
+                ch = changes_since(c_rev)
+                if ch is not None and "pod" not in ch and "node" not in ch:
+                    cluster.__dict__["_occupancy_cache"] = (epoch, rev, occ, seqs)
+                    _count_encode_cache("occupancy", "hit")
+                    return occ
         entries = []
         pods_by_node = cluster.pods_by_node()
         for node in cluster.snapshot_nodes():
@@ -181,7 +221,11 @@ class ZoneOccupancy:
             for pod in pods_by_node.get(node.name, ()):
                 # no copy here: the constructor's defensive copy suffices
                 entries.append((pod.labels, zone))
-        return cls(entries)
+        out = cls(entries)
+        if rev is not None and epoch is not None:
+            cluster.__dict__["_occupancy_cache"] = (epoch, rev, out, seqs)
+            _count_encode_cache("occupancy", "full")
+        return out
 
     def counts(self, selector: Mapping[str, str]) -> dict[str, int]:
         """zone -> number of bound pods matching the label selector."""
@@ -423,7 +467,7 @@ def effective_capacity(capacity, types, nodeclass):
 
 def _problem_cache_key(pods, catalog, nodepool, occupancy, allowed_types,
                        allow_reserved, include_preferences, tensors,
-                       nodeclass=None):
+                       nodeclass=None, revision=None):
     # A caller-supplied tensors snapshot bypasses the cache entirely: it may
     # be a what-if view that catalog.cache_key() cannot distinguish.
     if tensors is not None or not pods:
@@ -434,13 +478,23 @@ def _problem_cache_key(pods, catalog, nodepool, occupancy, allowed_types,
         reserved_key = frozenset(allow_reserved)
     else:
         reserved_key = False
-    return (
+    if revision is not None:
+        # Revision path: the caller asserts the pod list is a pure function
+        # of ``revision`` (e.g. (cluster.epoch, cluster.rev, nominated set)
+        # — the pending set is derived state). The O(len(pods)) id/version
+        # tuples collapse to the revision token + a length sanity check;
+        # everything below (catalog seqnums, pool/nodeclass hashes,
+        # occupancy fingerprint) still participates, so offering, template,
+        # and topology changes invalidate exactly as on the legacy path.
+        pods_key = ("rev", revision, len(pods), id(pods[0]))
+    else:
         # (id, version) pairs: the cached problem keeps every pod alive (so
         # ids cannot be recycled), and the version bumps on any sanctioned
         # scheduling-field reassignment (Pod.__setattr__) so a mutated pod
         # can never be served its stale encoding
-        tuple(map(id, pods)),
-        tuple(p._version for p in pods),
+        pods_key = (tuple(map(id, pods)), tuple(p._version for p in pods))
+    return (
+        pods_key,
         # catalog.uid, not id(catalog): the cached problem does not keep the
         # catalog alive, so a freed catalog's address could be reused
         catalog.uid,
@@ -469,6 +523,7 @@ def encode_problem(
     allow_reserved=True,
     include_preferences: bool = True,
     nodeclass=None,
+    revision=None,
 ) -> EncodedProblem:
     """Build the dense solve tensors for one nodepool's candidate pods.
 
@@ -483,17 +538,25 @@ def encode_problem(
     a set of ``(instance_type, zone)`` pairs = exactly this pool's own
     nodeclass reservations — pool A holding ANY reservation must not drain
     pool B's pre-paid capacity for a different (type, zone).
+
+    ``revision`` (optional, opaque hashable): the cross-reconcile cache key
+    uses it IN PLACE of the per-pod (id, version) tuples — an O(1) revision
+    check instead of an O(pods) key rebuild. The caller must guarantee the
+    pod list is fully determined by the revision (the provisioning loop
+    passes ``(cluster.epoch, cluster.rev, frozenset(nominated))``).
     """
     ckey = _problem_cache_key(pods, catalog, nodepool, occupancy,
                               allowed_types, allow_reserved,
                               include_preferences, tensors,
-                              nodeclass=nodeclass)
+                              nodeclass=nodeclass, revision=revision)
     if ckey is not None:
         with _PROBLEM_CACHE_LOCK:
             hit = _PROBLEM_CACHE.get(ckey)
             if hit is not None:
                 _PROBLEM_CACHE.move_to_end(ckey)
+                _count_encode_cache("problem", "hit")
                 return hit
+        _count_encode_cache("problem", "full")
 
     tensors = tensors if tensors is not None else catalog.tensors()
     types = catalog.list()

@@ -194,15 +194,94 @@ def place_screen_args(ct, mesh: Mesh):
     )
 
 
-def screen_sharded(ct, mesh: Mesh) -> np.ndarray:
+def screen_lanes_per_device(n_nodes: int, n_resources: int) -> int:
+    """Per-device lane budget for one screen dispatch: each lane's scan
+    carries a [N, R] free matrix, so unchunked lanes materialize a
+    [lanes, N, R] f32 intermediate per step — at 5k nodes and 625
+    lanes/device that is ~110MB PER DEVICE and was the
+    `multichip_8dev_5000node_screen` 20s cliff. KARPENTER_TPU_MESH_LANE_BYTES
+    (default 32MiB, read per call like every sibling knob) caps that
+    intermediate; lanes beyond it run as extra dispatches of the same
+    compiled program (stable shapes — one compile per cluster size)."""
+    import os
+
+    budget = int(os.environ.get("KARPENTER_TPU_MESH_LANE_BYTES", 32 << 20))
+    per_lane = max(n_nodes * n_resources * 4, 1)
+    return max(16, budget // per_lane)
+
+
+def screen_sharded(ct, mesh: Mesh, lanes_per_device: Optional[int] = None) -> np.ndarray:
     """Mesh-parallel ``consolidatable``: can_delete[N] with the candidate
     axis split across the mesh devices. Exact same semantics as the
     single-device screen (consolidate.consolidatable) — the blocked mask and
-    the hostname-headroom cap ride along unchanged."""
+    the hostname-headroom cap ride along unchanged.
+
+    The candidate axis is CHUNKED to ``lanes_per_device`` lanes per dispatch
+    (auto-sized via KARPENTER_TPU_MESH_LANE_BYTES) so per-device memory stays flat
+    as the cluster grows. On a CPU (virtual) mesh, where D-way sharding of
+    one host's cores is pure overhead, clusters past
+    ``KARPENTER_TPU_MESH_SCREEN_NATIVE_N`` nodes fall back to the C++ screen
+    when it is available and the cluster carries no hostname caps (the
+    native kernel screens compat only) — the 5k-node virtual-mesh row went
+    from ~20s to the native kernel's tens of ms."""
+    import os
+
+    from ..ops.consolidate import live_slot_width, screen_cap_wire
+
     N = len(ct.node_names)
+    D = mesh.devices.size
+    is_cpu_mesh = all(d.platform == "cpu" for d in mesh.devices.flat)
+    native_floor = int(os.environ.get("KARPENTER_TPU_MESH_SCREEN_NATIVE_N", 1024))
+    if is_cpu_mesh and N >= native_floor and not ct.has_topology():
+        try:
+            from ..scheduling.native import repack_check_native
+
+            S = live_slot_width(ct.group_counts)
+            cand = np.arange(N, dtype=np.int32)
+            out = np.asarray(repack_check_native(
+                ct.free, ct.requests, ct.group_ids[:, :S],
+                ct.group_counts[:, :S], ct.compat, cand,
+            ), dtype=bool).copy()
+            out &= ~ct.blocked
+            return out
+        except Exception as e:
+            # no native build: the chunked mesh path still answers, but say
+            # so — silently re-entering the O(N^2) CPU path at 5k nodes is
+            # the 20s cliff this fallback exists to avoid
+            import logging
+
+            logging.getLogger("karpenter.tpu.mesh").warning(
+                "native screen fallback unavailable on the cpu mesh; "
+                "using the chunked mesh screen: %s: %s",
+                type(e).__name__, e,
+            )
+    lanes = lanes_per_device or screen_lanes_per_device(N, ct.free.shape[1])
+    chunk = lanes * D
+    if chunk >= N:
+        fn = sharded_screen_fn(mesh)
+        ok = jax.device_get(fn(*place_screen_args(ct, mesh)))
+        out = np.asarray(ok)[:N].copy()
+        out &= ~ct.blocked
+        return out
+    S = live_slot_width(ct.group_counts)
+    shard = NamedSharding(mesh, P(POD_AXIS))
+    rep = NamedSharding(mesh, P())
+    free = jax.device_put(jnp.asarray(ct.free), rep)
+    requests = jax.device_put(jnp.asarray(ct.requests), rep)
+    gids = jax.device_put(jnp.asarray(ct.group_ids[:, :S]), rep)
+    gcounts = jax.device_put(jnp.asarray(ct.group_counts[:, :S]), rep)
+    cap = jax.device_put(jnp.asarray(screen_cap_wire(ct)), rep)
     fn = sharded_screen_fn(mesh)
-    ok = jax.device_get(fn(*place_screen_args(ct, mesh)))
-    out = np.asarray(ok)[:N].copy()
+    out = np.zeros(N, dtype=bool)
+    for start in range(0, N, chunk):
+        idx = np.arange(start, min(start + chunk, N), dtype=np.int32)
+        cand = np.zeros(chunk, dtype=np.int32)  # fixed shape: one compile
+        cand[: len(idx)] = idx
+        cand_dev = jax.device_put(jnp.asarray(cand), shard)
+        ok = np.asarray(jax.device_get(
+            fn(free, requests, gids, gcounts, cap, cand_dev)
+        ))
+        out[idx] = ok[: len(idx)]
     out &= ~ct.blocked
     return out
 

@@ -1283,10 +1283,12 @@ class TPUSolver:
         return specs, binds, unplaced
 
     def solve(self, pods, nodepools, catalog, in_use=None, occupancy=None, type_allow=None,
-              reserved_allow=None, existing=None, nodeclass_by_pool=None) -> SolveResult:
+              reserved_allow=None, existing=None, nodeclass_by_pool=None,
+              revision=None) -> SolveResult:
         return _solve_multi_nodepool(self, pods, nodepools, catalog, in_use, occupancy,
                                      type_allow, reserved_allow, existing,
-                                     nodeclass_by_pool=nodeclass_by_pool)
+                                     nodeclass_by_pool=nodeclass_by_pool,
+                                     revision=revision)
 
 
 class HostSolver:
@@ -1328,10 +1330,12 @@ class HostSolver:
         return specs, binds, unplaced
 
     def solve(self, pods, nodepools, catalog, in_use=None, occupancy=None, type_allow=None,
-              reserved_allow=None, existing=None, nodeclass_by_pool=None) -> SolveResult:
+              reserved_allow=None, existing=None, nodeclass_by_pool=None,
+              revision=None) -> SolveResult:
         return _solve_multi_nodepool(self, pods, nodepools, catalog, in_use, occupancy,
                                      type_allow, reserved_allow, existing,
-                                     nodeclass_by_pool=nodeclass_by_pool)
+                                     nodeclass_by_pool=nodeclass_by_pool,
+                                     revision=revision)
 
 
 def _enforce_pool_constraints(
@@ -1441,7 +1445,7 @@ def certainly_unplaceable(problem, pool_existing=None) -> list[Pod]:
 
 def _solve_multi_nodepool(
     impl, pods, nodepools, catalog, in_use=None, occupancy=None, type_allow=None,
-    reserved_allow=None, existing=None, nodeclass_by_pool=None,
+    reserved_allow=None, existing=None, nodeclass_by_pool=None, revision=None,
 ) -> SolveResult:
     t0 = time.perf_counter()
     if hasattr(impl, "timings"):
@@ -1475,6 +1479,9 @@ def _solve_multi_nodepool(
                 allowed_types=allowed, allow_reserved=allow_res,
                 include_preferences=include_preferences,
                 nodeclass=(nodeclass_by_pool or {}).get(pool.name),
+                # the caller's O(1) cluster-revision token replaces the
+                # O(pods) id/version key when provided (ops/encode.py)
+                revision=revision,
             )
         if hasattr(impl, "timings"):
             # accumulate across rounds: one solve() = one breakdown

@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 import threading
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
@@ -11,7 +12,7 @@ from ..models.nodeclaim import NodeClaim
 from ..models.nodeclass import NodeClass
 from ..models.nodepool import NodePool
 from ..models.pdb import PodDisruptionBudget
-from ..models.pod import Pod
+from ..models.pod import Pod, _Seq
 from ..models.resources import ResourceVector
 
 
@@ -33,6 +34,23 @@ class Node:
     # monotonic timestamp of the last pod bind/unbind touching this node;
     # consolidateAfter quiet windows are measured from here
     last_pod_event: float = 0.0
+    # bumped on EVERY field assignment (controllers flip ready/cordoned and
+    # reassign labels in place on the live object, outside Cluster methods).
+    # The incremental cluster encoder compares this per row, so direct
+    # attribute mutation can never serve stale tensors. ``last_pod_event``
+    # is exempt: it never shapes tensors and is written on every bind —
+    # tracking it would force the defensive O(N) scan every pass.
+    _version: int = field(default=0, repr=False, compare=False)
+
+    def __setattr__(self, name, value):
+        # field FIRST, version after: a reader that observes the new version
+        # has then necessarily seen (or will re-read) the new field value,
+        # so the encoder's read-version-then-fields protocol can only ever
+        # over-invalidate, never record a fresh version over a stale field
+        object.__setattr__(self, name, value)
+        if name != "_version" and name != "last_pod_event":
+            object.__setattr__(self, "_version", getattr(self, "_version", 0) + 1)
+            NODE_WRITE_SEQ.v += 1
 
     def zone(self) -> str:
         return self.labels.get(lbl.TOPOLOGY_ZONE, "")
@@ -44,10 +62,33 @@ class Node:
         return self.labels.get(lbl.INSTANCE_TYPE_LABEL, "")
 
 
+# Bounded change-journal length: at the production reconcile cadence this
+# covers thousands of mutations between encode passes; overflow simply
+# forces one full re-encode (never a correctness loss).
+JOURNAL_CAP = 4096
+
+
+#: Bumped by every tracked Node field write, across all clusters. The
+#: incremental encoder snapshots it per pass: unchanged means NO node
+#: attribute anywhere was touched, so the defensive per-row version scan
+#: (which exists only to catch direct writes that bypass Cluster methods)
+#: can be skipped entirely that pass.
+NODE_WRITE_SEQ = _Seq()
+
+
 class Cluster:
     """Thread-safe object store with the handful of indexed views the
     controllers need. All mutation goes through methods so tests can observe
-    ordering; watches are replaced by level-triggered re-listing."""
+    ordering; watches are replaced by level-triggered re-listing.
+
+    Every mutation bumps a monotonic revision ``rev`` and appends a
+    ``(rev, kind, name)`` entry to a bounded change journal. Consumers that
+    keep derived snapshots (the incremental cluster/problem encoders, the
+    zone-occupancy cache) call :meth:`changes_since` to learn exactly what
+    moved since their snapshot revision — or that the journal rolled over
+    and a full rebuild is due. For pods, ``name`` is the affected NODE name
+    (bind/unbind journal the node whose tensors the change dirties; pending
+    pods journal ``""``)."""
 
     def __init__(self, clock=None):
         self.clock = clock
@@ -64,32 +105,112 @@ class Cluster:
         # Monotonic claim-store version: bumps on any nodeclaim add/remove/
         # provider-id change, so derived snapshots can cache per version.
         self.claims_seq: int = 0
+        # Monotonic store revision + bounded change journal (see class doc).
+        self.rev: int = 0
+        self._journal: deque = deque(maxlen=JOURNAL_CAP)
+        self._journal_evicted_rev: int = 0  # newest rev lost to the cap
+        # Epoch token: identifies THIS store incarnation. Environment.reset()
+        # re-runs __init__ on the same object, so revision-keyed caches held
+        # by other components key on the epoch object identity and can never
+        # mistake a reset store (rev back at 0) for their old snapshot.
+        self.epoch: object = object()
         # Incrementally-maintained instance-id index (the "indexed views"
         # this class promises): O(1) per mutation, so a 15k-message
         # interruption drain never re-lists the whole claim store per batch.
         self._claims_by_iid: dict[str, NodeClaim] = {}
         self._claim_iid: dict[str, str] = {}  # claim name -> indexed iid
+        # Incrementally-maintained bound-pod index, consumed ONLY by
+        # pods_on_nodes (the incremental encoder's per-patch fetch): O(1)
+        # per sanctioned mutation instead of an O(pods) store scan per
+        # encode. pods_by_node()/pods_on_node() intentionally stay full
+        # scans — they are the source of truth even for pods whose
+        # node_name was mutated outside Cluster methods.
+        self._pods_index: dict[str, dict[str, Pod]] = {}  # node -> uid -> Pod
+        self._pod_node: dict[str, str] = {}               # uid -> indexed node
 
     def _now(self) -> float:
         return self.clock.now() if self.clock is not None else 0.0
+
+    # -- bound-pod index ---------------------------------------------------
+    def _index_pod(self, pod: Pod) -> None:
+        """Point the bound-pod index at ``pod``'s current binding (callers
+        hold the lock)."""
+        target = pod.node_name or ""
+        cur = self._pod_node.get(pod.uid)
+        if cur is not None and cur != target:
+            bucket = self._pods_index.get(cur)
+            if bucket is not None:
+                bucket.pop(pod.uid, None)
+        if target:
+            self._pods_index.setdefault(target, {})[pod.uid] = pod
+            self._pod_node[pod.uid] = target
+        else:
+            self._pod_node.pop(pod.uid, None)
+
+    def _unindex_pod(self, uid: str) -> None:
+        cur = self._pod_node.pop(uid, None)
+        if cur is not None:
+            bucket = self._pods_index.get(cur)
+            if bucket is not None:
+                bucket.pop(uid, None)
+
+    # -- change journal ----------------------------------------------------
+    def _record(self, kind: str, name: str) -> None:
+        """Bump ``rev`` and journal one mutation (callers hold the lock)."""
+        self.rev += 1
+        j = self._journal
+        if len(j) == JOURNAL_CAP:
+            self._journal_evicted_rev = j[0][0]
+        j.append((self.rev, kind, name))
+
+    def changes_since(self, rev: int) -> Optional[dict[str, list[str]]]:
+        """Mutations after ``rev`` as ``{kind: [names, in order]}``.
+
+        Returns ``{}`` when nothing changed, and ``None`` when the bounded
+        journal no longer covers ``(rev, now]`` (the caller must rebuild
+        from scratch). Names repeat in mutation order — consumers that want
+        a dirty SET dedup themselves; consumers that care about ordering
+        (row allocation mirroring store insertion order) get it."""
+        with self._lock:
+            if rev == self.rev:
+                return {}
+            if rev < self._journal_evicted_rev:
+                return None
+            out: dict[str, list[str]] = {}
+            for r, kind, name in self._journal:
+                if r > rev:
+                    out.setdefault(kind, []).append(name)
+            return out
 
     # -- apply/delete ------------------------------------------------------
     def apply(self, obj) -> None:
         with self._lock:
             if isinstance(obj, NodePool):
                 self.nodepools[obj.name] = obj
+                self._record("pool", obj.name)
             elif isinstance(obj, NodeClass):
                 self.nodeclasses[obj.name] = obj
+                self._record("nodeclass", obj.name)
             elif isinstance(obj, NodeClaim):
                 self.nodeclaims[obj.name] = obj
                 self.claims_seq += 1
                 self._index_claim(obj)
+                self._record("claim", obj.name)
             elif isinstance(obj, Node):
                 self.nodes[obj.name] = obj
+                self._record("node", obj.name)
             elif isinstance(obj, Pod):
+                prev = self.pods.get(obj.uid)
                 self.pods[obj.uid] = obj
+                if prev is not None and prev is not obj and prev.node_name:
+                    # replacement may move the binding: both nodes dirty
+                    if prev.node_name != obj.node_name:
+                        self._record("pod", prev.node_name)
+                self._index_pod(obj)
+                self._record("pod", obj.node_name or "")
             elif isinstance(obj, PodDisruptionBudget):
                 self.pdbs[obj.name] = obj
+                self._record("pdb", obj.name)
             else:
                 raise TypeError(f"unknown object {type(obj)}")
 
@@ -97,11 +218,13 @@ class Cluster:
         with self._lock:
             if isinstance(obj, NodePool):
                 self.nodepools.pop(obj.name, None)
+                self._record("pool", obj.name)
             elif isinstance(obj, NodeClass):
                 if obj.finalizers:
                     obj.deleted = True  # finalizer semantics: mark, don't drop
                 else:
                     self.nodeclasses.pop(obj.name, None)
+                self._record("nodeclass", obj.name)
             elif isinstance(obj, NodeClaim):
                 if obj.finalizers:
                     # mark-only: membership and provider-id bindings are
@@ -114,15 +237,22 @@ class Cluster:
                     self.nodeclaims.pop(obj.name, None)
                     self.claims_seq += 1
                     self._unindex_claim(obj)
+                self._record("claim", obj.name)
             elif isinstance(obj, Node):
                 self.nodes.pop(obj.name, None)
+                self._record("node", obj.name)
             elif isinstance(obj, Pod):
-                self.pods.pop(obj.uid, None)
+                stored = self.pods.pop(obj.uid, None)
+                self._unindex_pod(obj.uid)
                 node = self.nodes.get(obj.node_name)
                 if node is not None:
                     node.last_pod_event = max(node.last_pod_event, self._now())
+                self._record("pod", obj.node_name or "")
+                if stored is not None and stored.node_name != obj.node_name:
+                    self._record("pod", stored.node_name or "")
             elif isinstance(obj, PodDisruptionBudget):
                 self.pdbs.pop(obj.name, None)
+                self._record("pdb", obj.name)
             else:
                 raise TypeError(f"unknown object {type(obj)}")
 
@@ -134,8 +264,10 @@ class Cluster:
                 self.nodeclaims.pop(obj.name, None)
                 self.claims_seq += 1
                 self._unindex_claim(obj)
+                self._record("claim", obj.name)
             elif isinstance(obj, NodeClass):
                 self.nodeclasses.pop(obj.name, None)
+                self._record("nodeclass", obj.name)
 
     def _index_claim(self, claim: NodeClaim) -> None:
         iid = claim.status.provider_id.rsplit("/", 1)[-1]
@@ -188,11 +320,41 @@ class Cluster:
     def bind_pod(self, pod_uid: str, node_name: str, now: float = 0.0) -> None:
         with self._lock:
             pod = self.pods[pod_uid]
+            old = pod.node_name
             pod.node_name = node_name
             pod.phase = "Running"
             node = self.nodes.get(node_name)
             if node is not None:
                 node.last_pod_event = max(node.last_pod_event, now)
+            self._index_pod(pod)
+            self._record("pod", node_name)
+            if old and old != node_name:
+                self._record("pod", old)
+
+    def unbind_pod(self, pod_uid: str) -> None:
+        """Release a pod back to Pending (the drain/evict path). The inverse
+        of :meth:`bind_pod`, and like it the ONLY sanctioned way to change a
+        stored pod's binding — a direct ``pod.node_name = ...`` write is
+        invisible to the change journal and can serve stale tensors."""
+        with self._lock:
+            pod = self.pods.get(pod_uid)
+            if pod is None:
+                return
+            old = pod.node_name
+            node = self.nodes.get(old)
+            if node is not None:
+                node.last_pod_event = max(node.last_pod_event, self._now())
+            pod.node_name = ""
+            pod.phase = "Pending"
+            self._index_pod(pod)
+            self._record("pod", old or "")
+
+    def note_pod_update(self, pod: Pod) -> None:
+        """Journal an in-place/field mutation of a stored pod (labels,
+        requests, annotations ...). Pair with ``Pod.bump_version()`` for
+        container mutations; encoders otherwise cannot see the change."""
+        with self._lock:
+            self._record("pod", pod.node_name or "")
 
     def pods_on_node(self, node_name: str) -> list[Pod]:
         with self._lock:
@@ -224,6 +386,20 @@ class Cluster:
             for p in self.pods.values():
                 if p.node_name:
                     out.setdefault(p.node_name, []).append(p)
+        return out
+
+    def pods_on_nodes(self, names) -> dict[str, list[Pod]]:
+        """node name -> bound pods for exactly ``names``, from the
+        incrementally-maintained bound-pod index: O(returned pods), however
+        large the store. This is the incremental encoder's per-patch fetch;
+        it sees every binding made through Cluster methods (the sanctioned
+        mutation surface — bind_pod/unbind_pod/apply/delete)."""
+        out: dict[str, list[Pod]] = {}
+        with self._lock:
+            for name in names:
+                bucket = self._pods_index.get(name)
+                if bucket:
+                    out[name] = list(bucket.values())
         return out
 
     def node_by_provider_id(self, provider_id: str) -> Optional[Node]:

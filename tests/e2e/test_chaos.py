@@ -119,8 +119,10 @@ class TestRunawayScaleUp:
                 ]
                 poisoned.add(node.name)
                 for pod in env.cluster.pods_on_node(node.name):
-                    pod.node_name = ""
-                    pod.phase = "Pending"
+                    # through the store: the change journal must see the
+                    # eviction (direct node_name writes are unsanctioned
+                    # and invisible to the incremental encoder)
+                    env.cluster.unbind_pod(pod.uid)
             assert len(env.cluster.nodes) < bound, (
                 f"runaway: {len(env.cluster.nodes)} nodes"
             )

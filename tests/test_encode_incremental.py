@@ -1,0 +1,367 @@
+"""Incremental (delta-aware) encoding: the PR-3 tentpole contract.
+
+The property at the center: for ANY sequence of sanctioned cluster
+mutations (node add/remove, pod bind/unbind, nodeclaim updates, occupancy
+changes — plus direct attribute flips, which the defensive version scan
+covers), the incrementally patched ``ClusterTensors`` must be EXACTLY equal
+(canonical form, no tolerance) to a from-scratch ``_encode_cluster``.
+
+Also here: the change journal's semantics, every full-re-encode fallback
+trigger (journal overflow, catalog seqnum change, heavy churn, refresh
+period, store epoch change), the revision-cached ``ZoneOccupancy``, and the
+``/metrics`` encode-cache counters guarding against silent cache
+regressions (two identical reconcile passes against the fake cloud must
+increment the hit counter).
+"""
+
+import urllib.request
+
+import numpy as np
+import pytest
+
+from karpenter_provider_aws_tpu.catalog import CatalogProvider
+from karpenter_provider_aws_tpu.models import Disruption, NodePool
+from karpenter_provider_aws_tpu.models import labels as lbl
+from karpenter_provider_aws_tpu.models.nodeclaim import NodeClaim
+from karpenter_provider_aws_tpu.models.pod import (
+    PodAffinityTerm,
+    TopologySpreadConstraint,
+    make_pods,
+)
+from karpenter_provider_aws_tpu.ops.consolidate import _encode_cluster, encode_cluster
+from karpenter_provider_aws_tpu.ops.encode import ZoneOccupancy
+from karpenter_provider_aws_tpu.ops.encode_delta import (
+    canonical_equal,
+    canonical_form,
+    invalidate_cluster_encoders,
+)
+from karpenter_provider_aws_tpu.state.cluster import JOURNAL_CAP, Cluster, Node
+
+
+def _add_node(cluster, catalog, i, zone="zone-a", pool="default"):
+    candidates = [t for t in catalog.list() if t.category in ("c", "m")]
+    it = candidates[i % len(candidates)]
+    claim = NodeClaim.fresh(
+        nodepool_name=pool,
+        nodeclass_name="default",
+        instance_type_options=[it.name],
+        zone_options=[zone],
+        capacity_type_options=["spot"],
+    )
+    claim.status.provider_id = f"cloud:///{zone}/i-enc{i}"
+    claim.status.capacity = it.capacity()
+    claim.status.allocatable = catalog.allocatable(it)
+    claim.labels.update(it.labels())
+    claim.labels[lbl.TOPOLOGY_ZONE] = zone
+    claim.labels[lbl.CAPACITY_TYPE] = "spot"
+    claim.labels[lbl.NODEPOOL] = pool
+    for c in ("Launched", "Registered", "Initialized"):
+        claim.status.set_condition(c, True)
+    cluster.apply(claim)
+    node = Node(
+        name=f"node-enc{i}",
+        provider_id=claim.status.provider_id,
+        nodepool_name=pool,
+        nodeclaim_name=claim.name,
+        labels=dict(claim.labels),
+        capacity=claim.status.capacity,
+        allocatable=claim.status.allocatable,
+        ready=True,
+    )
+    node.labels[lbl.HOSTNAME] = node.name
+    claim.status.node_name = node.name
+    cluster.apply(node)
+    return node, claim
+
+
+def _small_cluster(catalog, n=12):
+    cluster = Cluster()
+    cluster.apply(NodePool(name="default",
+                           disruption=Disruption(consolidate_after_s=60)))
+    nodes = []
+    for i in range(n):
+        zone = ("zone-a", "zone-b", "zone-c")[i % 3]
+        node, _ = _add_node(cluster, catalog, i, zone=zone)
+        nodes.append(node)
+        for p in make_pods(1 + i % 3, f"seed{i}",
+                           {"cpu": "250m", "memory": "512Mi"}):
+            cluster.apply(p)
+            cluster.bind_pod(p.uid, node.name)
+    return cluster, nodes
+
+
+def _assert_equal(cluster, catalog, tag=""):
+    inc = encode_cluster(cluster, catalog)
+    fresh = _encode_cluster(cluster, catalog, 32)
+    diffs = canonical_equal(canonical_form(inc), canonical_form(fresh))
+    assert not diffs, f"{tag}: patched tensors diverge from fresh encode: {diffs}"
+    return inc
+
+
+class TestChangeJournal:
+    def test_rev_monotonic_and_changes(self, session_catalog):
+        cluster = Cluster()
+        r0 = cluster.rev
+        node, claim = _add_node(cluster, session_catalog, 0)
+        assert cluster.rev > r0
+        ch = cluster.changes_since(r0)
+        assert "node" in ch and node.name in ch["node"]
+        assert "claim" in ch and claim.name in ch["claim"]
+        assert cluster.changes_since(cluster.rev) == {}
+
+    def test_pod_entries_carry_node_names(self, session_catalog):
+        cluster = Cluster()
+        node, _ = _add_node(cluster, session_catalog, 0)
+        p = make_pods(1, "w", {"cpu": "100m"})[0]
+        cluster.apply(p)
+        r = cluster.rev
+        cluster.bind_pod(p.uid, node.name)
+        assert node.name in cluster.changes_since(r)["pod"]
+        r = cluster.rev
+        cluster.unbind_pod(p.uid)
+        assert node.name in cluster.changes_since(r)["pod"]
+
+    def test_overflow_returns_none(self):
+        cluster = Cluster()
+        r0 = cluster.rev
+        for i in range(JOURNAL_CAP + 5):
+            cluster._record("pdb", f"x{i}")
+        assert cluster.changes_since(r0) is None
+        # a recent revision is still covered
+        r1 = cluster.rev
+        cluster._record("pdb", "y")
+        assert cluster.changes_since(r1) == {"pdb": ["y"]}
+
+    def test_unbind_pod_through_store(self, session_catalog):
+        cluster = Cluster()
+        node, _ = _add_node(cluster, session_catalog, 0)
+        p = make_pods(1, "w", {"cpu": "100m"})[0]
+        cluster.apply(p)
+        cluster.bind_pod(p.uid, node.name)
+        assert cluster.pods_on_nodes([node.name])[node.name] == [p]
+        cluster.unbind_pod(p.uid)
+        assert p.is_pending()
+        assert cluster.pods_on_nodes([node.name]) == {}
+
+
+class TestIncrementalClusterEncode:
+    def test_unchanged_cluster_returns_same_object(self, session_catalog):
+        cluster, _ = _small_cluster(session_catalog)
+        ct1 = encode_cluster(cluster, session_catalog)
+        ct2 = encode_cluster(cluster, session_catalog)
+        assert ct1 is ct2
+
+    def test_full_matches_fresh(self, session_catalog):
+        cluster, _ = _small_cluster(session_catalog)
+        _assert_equal(cluster, session_catalog, "cold")
+
+    def test_catalog_seq_change_forces_full_and_matches(self, session_catalog):
+        # a private catalog: ICE marks must not leak into other tests
+        catalog = CatalogProvider()
+        cluster, _ = _small_cluster(catalog)
+        ct1 = encode_cluster(cluster, catalog)
+        catalog.unavailable.mark_unavailable("c7g.4xlarge", "zone-a", "on-demand")
+        ct2 = encode_cluster(cluster, catalog)
+        assert ct2 is not ct1
+        _assert_equal(cluster, catalog, "post-catalog-change")
+
+    def test_journal_overflow_falls_back_to_full(self, session_catalog):
+        cluster, nodes = _small_cluster(session_catalog)
+        encode_cluster(cluster, session_catalog)
+        for i in range(JOURNAL_CAP + 5):
+            cluster._record("pdb", f"noise{i}")
+        _assert_equal(cluster, session_catalog, "post-overflow")
+
+    def test_epoch_reset_is_not_served_stale(self, session_catalog):
+        cluster, _ = _small_cluster(session_catalog)
+        ct1 = encode_cluster(cluster, session_catalog)
+        assert ct1 is not None
+        cluster.__init__()  # Environment.reset() re-runs __init__ in place
+        assert encode_cluster(cluster, session_catalog) is None
+
+    def test_kill_switch(self, session_catalog, monkeypatch):
+        cluster, _ = _small_cluster(session_catalog)
+        monkeypatch.setenv("KARPENTER_TPU_INCREMENTAL_ENCODE", "0")
+        ct1 = encode_cluster(cluster, session_catalog)
+        ct2 = encode_cluster(cluster, session_catalog)
+        assert ct1 is not ct2  # full encode every call
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_property_random_mutation_sequences(self, session_catalog, seed):
+        """THE acceptance property: after every randomized mutation batch,
+        patched tensors == fresh encode, exactly."""
+        rng = np.random.RandomState(seed)
+        cluster, nodes = _small_cluster(session_catalog)
+        names = [n.name for n in nodes]
+        encode_cluster(cluster, session_catalog)
+        next_node = len(nodes)
+        for step in range(25):
+            for _ in range(rng.randint(1, 5)):
+                op = rng.randint(7)
+                if op == 0:  # bind a fresh pod (sometimes topology-bearing)
+                    kwargs = {}
+                    r = rng.rand()
+                    if r < 0.2:
+                        kwargs = dict(
+                            labels={"app": f"s{rng.randint(3)}"},
+                            topology_spread=[TopologySpreadConstraint(
+                                topology_key=lbl.TOPOLOGY_ZONE, max_skew=1,
+                                label_selector={"app": f"s{rng.randint(3)}"},
+                            )],
+                        )
+                    elif r < 0.35:
+                        kwargs = dict(
+                            labels={"app": f"a{rng.randint(3)}"},
+                            anti_affinity=[PodAffinityTerm(
+                                topology_key=lbl.HOSTNAME,
+                                label_selector={"app": f"a{rng.randint(3)}"},
+                            )],
+                        )
+                    elif r < 0.45:
+                        kwargs = dict(node_selector={lbl.ARCH: "arm64"})
+                    p = make_pods(1, f"m{seed}_{step}", {
+                        "cpu": f"{int(rng.choice([100, 250, 500]))}m",
+                        "memory": "256Mi",
+                    }, **kwargs)[0]
+                    cluster.apply(p)
+                    cluster.bind_pod(p.uid, names[rng.randint(len(names))])
+                elif op == 1:  # unbind
+                    bound = [p for p in cluster.pods.values() if p.node_name]
+                    if bound:
+                        cluster.unbind_pod(bound[rng.randint(len(bound))].uid)
+                elif op == 2:  # delete a bound pod
+                    bound = [p for p in cluster.pods.values() if p.node_name]
+                    if bound:
+                        cluster.delete(bound[rng.randint(len(bound))])
+                elif op == 3:  # direct eligibility flip (defensive scan)
+                    n = cluster.nodes.get(names[rng.randint(len(names))])
+                    if n is not None:
+                        n.cordoned = not n.cordoned
+                elif op == 4:  # nodeclaim update: mark a claim deleted
+                    live = [c for c in cluster.nodeclaims.values()
+                            if not c.deleted]
+                    if len(live) > 3:
+                        c = live[rng.randint(len(live))]
+                        c.finalizers = ["karpenter"]
+                        cluster.delete(c)
+                elif op == 5:  # add a whole node
+                    zone = ("zone-a", "zone-b", "zone-c", "zone-d")[
+                        rng.randint(4)]
+                    node, _ = _add_node(cluster, session_catalog, next_node,
+                                        zone=zone)
+                    names.append(node.name)
+                    next_node += 1
+                else:  # remove a node object entirely
+                    n = cluster.nodes.get(names[rng.randint(len(names))])
+                    if n is not None:
+                        cluster.delete(n)
+            _assert_equal(cluster, session_catalog, f"seed{seed} step{step}")
+
+    def test_heavy_churn_falls_back_to_full(self, session_catalog):
+        """Touching most of the cluster patches nothing — the encoder must
+        rebuild (and still match)."""
+        from karpenter_provider_aws_tpu.metrics import ENCODE_CACHE
+
+        cluster, nodes = _small_cluster(session_catalog, n=10)
+        encode_cluster(cluster, session_catalog)
+        full0 = ENCODE_CACHE.value(path="cluster", outcome="full")
+        for node in nodes[:8]:  # 80% of rows dirty > PATCH_FRAC
+            p = make_pods(1, f"hc{node.name}", {"cpu": "100m"})[0]
+            cluster.apply(p)
+            cluster.bind_pod(p.uid, node.name)
+        _assert_equal(cluster, session_catalog, "heavy churn")
+        assert ENCODE_CACHE.value(path="cluster", outcome="full") > full0
+
+
+class TestOccupancyRevisionCache:
+    def test_same_revision_reuses_snapshot(self, session_catalog):
+        cluster, nodes = _small_cluster(session_catalog, n=4)
+        occ1 = ZoneOccupancy.from_cluster(cluster)
+        occ2 = ZoneOccupancy.from_cluster(cluster)
+        assert occ1 is occ2
+
+    def test_pod_change_invalidates(self, session_catalog):
+        cluster, nodes = _small_cluster(session_catalog, n=4)
+        occ1 = ZoneOccupancy.from_cluster(cluster)
+        p = make_pods(1, "w", {"cpu": "100m"}, labels={"app": "db"})[0]
+        cluster.apply(p)
+        cluster.bind_pod(p.uid, nodes[0].name)
+        occ2 = ZoneOccupancy.from_cluster(cluster)
+        assert occ2 is not occ1
+        zone = nodes[0].zone()
+        assert occ2.counts({"app": "db"}).get(zone) == 1
+
+    def test_unrelated_change_keeps_snapshot(self, session_catalog):
+        cluster, nodes = _small_cluster(session_catalog, n=4)
+        occ1 = ZoneOccupancy.from_cluster(cluster)
+        cluster.apply(NodePool(name="other"))  # pool churn: zones unaffected
+        assert ZoneOccupancy.from_cluster(cluster) is occ1
+
+    def test_reset_store_rebuilds(self, session_catalog):
+        cluster, nodes = _small_cluster(session_catalog, n=4)
+        occ1 = ZoneOccupancy.from_cluster(cluster)
+        cluster.__init__()
+        occ2 = ZoneOccupancy.from_cluster(cluster)
+        assert occ2 is not occ1
+        assert occ2.counts({}) == {}
+
+    def test_direct_node_label_mutation_invalidates(self, session_catalog):
+        """A node label reassignment outside Cluster methods (no journal
+        entry) must still invalidate via NODE_WRITE_SEQ — the zone is an
+        occupancy input (review finding)."""
+        cluster, nodes = _small_cluster(session_catalog, n=4)
+        occ1 = ZoneOccupancy.from_cluster(cluster)
+        nodes[0].labels = {**nodes[0].labels, lbl.TOPOLOGY_ZONE: "zone-moved"}
+        occ2 = ZoneOccupancy.from_cluster(cluster)
+        assert occ2 is not occ1
+        assert "zone-moved" in occ2.counts({})
+
+
+class TestEncodeCacheMetrics:
+    def test_two_identical_reconciles_increment_hit_counter(self):
+        """S5 guard: two identical disruption passes against the fake cloud
+        must hit the persistent encoder, visible at /metrics — a silent
+        cache regression (every pass a full re-encode) fails here."""
+        from karpenter_provider_aws_tpu.metrics import REGISTRY
+        from karpenter_provider_aws_tpu.testenv import new_environment
+
+        env = new_environment(use_tpu_solver=False)
+        pool, _ = env.apply_defaults()
+        pool.disruption.consolidate_after_s = 60
+        pool.disruption.consolidation_policy = "WhenUnderutilized"
+        pool.disruption.budgets = ["0%"]  # decide-only: pass 2 must see an
+        # IDENTICAL cluster, not one minus pass 1's disruptions
+        for i in range(4):
+            node, _ = _add_node(env.cluster, env.catalog, i)
+            for p in make_pods(2, f"w{i}", {"cpu": "250m", "memory": "512Mi"}):
+                env.cluster.apply(p)
+                env.cluster.bind_pod(p.uid, node.name)
+        env.clock.advance(120)
+
+        def metric_value(text: str, line_prefix: str) -> float:
+            for line in text.splitlines():
+                if line.startswith(line_prefix):
+                    return float(line.rsplit(" ", 1)[1])
+            return 0.0
+
+        prefix = ('karpenter_encode_cache_total{outcome="hit",path="cluster"}')
+        port = REGISTRY.serve(0)
+        try:
+            env.disruption.reconcile()  # pass 1: full build
+            before = metric_value(
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics").read().decode(),
+                prefix,
+            )
+            env.disruption.reconcile()  # pass 2: identical cluster -> hit
+            after = metric_value(
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics").read().decode(),
+                prefix,
+            )
+        finally:
+            REGISTRY.stop()
+            env.close()
+        assert after >= before + 1, (
+            f"encode-cache hit counter did not increment ({before} -> {after})"
+        )

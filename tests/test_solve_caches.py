@@ -132,6 +132,113 @@ class TestProblemCache:
         assert len(enc._PROBLEM_CACHE) <= enc._PROBLEM_CACHE_MAX
 
 
+def _nodeclass(name, gib):
+    from karpenter_provider_aws_tpu.models.nodeclass import BlockDevice, NodeClass
+
+    return NodeClass(
+        name=name,
+        block_devices=[BlockDevice(device_name="/dev/xvda",
+                                   volume_size_gib=gib, root_volume=True)],
+    )
+
+
+class TestProblemCacheInvalidation:
+    """Every stale-encode hazard forces a fresh encode — under BOTH key
+    paths: the legacy per-pod (id, version) key and the O(1) revision key
+    (``revision=``). A stale EncodedProblem sizes and launches the wrong
+    capacity, so the invalidation matrix is the part that must never
+    regress."""
+
+    REV = ("epoch-sentinel", 7)  # a constant revision: the CLUSTER state is
+    # identical across the paired calls, only the keyed inputs change
+
+    def _encode_both(self, pods, catalog, pool, **kw):
+        legacy = encode_problem(pods, catalog, pool, **kw)
+        rev = encode_problem(pods, catalog, pool, revision=self.REV, **kw)
+        return legacy, rev
+
+    def test_invalidate_problem_cache_forces_fresh(self, catalog, pool):
+        pods = make_pods(10, "w", {"cpu": "500m"})
+        l1, r1 = self._encode_both(pods, catalog, pool)
+        assert encode_problem(pods, catalog, pool) is l1
+        assert encode_problem(pods, catalog, pool, revision=self.REV) is r1
+        enc.invalidate_problem_cache()
+        assert encode_problem(pods, catalog, pool) is not l1
+        assert encode_problem(pods, catalog, pool, revision=self.REV) is not r1
+
+    def test_occupancy_fingerprint_change_forces_fresh(self, catalog, pool):
+        pods = make_pods(6, "w", {"cpu": "1"})
+        occ_a = ZoneOccupancy([({"app": "db"}, "zone-a")])
+        l1, r1 = self._encode_both(pods, catalog, pool, occupancy=occ_a)
+        occ_b = ZoneOccupancy([({"app": "db"}, "zone-b")])
+        l2 = encode_problem(pods, catalog, pool, occupancy=occ_b)
+        r2 = encode_problem(pods, catalog, pool, occupancy=occ_b,
+                            revision=self.REV)
+        assert l2 is not l1 and r2 is not r1
+        # equal content (a distinct object) still hits on both paths
+        occ_c = ZoneOccupancy([({"app": "db"}, "zone-a")])
+        assert encode_problem(pods, catalog, pool, occupancy=occ_c) is l1
+        assert encode_problem(pods, catalog, pool, occupancy=occ_c,
+                              revision=self.REV) is r1
+
+    def test_nodeclass_hash_change_forces_fresh(self, catalog, pool):
+        from karpenter_provider_aws_tpu.models.resources import EPHEMERAL
+
+        pods = make_pods(6, "w", {"cpu": "1"})
+        nc_a = _nodeclass("nc", 20)
+        nc_b = _nodeclass("nc", 200)  # same name, different root volume
+        assert nc_a.hash() != nc_b.hash()
+        l1, r1 = self._encode_both(pods, catalog, pool, nodeclass=nc_a)
+        l2 = encode_problem(pods, catalog, pool, nodeclass=nc_b)
+        r2 = encode_problem(pods, catalog, pool, nodeclass=nc_b,
+                            revision=self.REV)
+        assert l2 is not l1 and r2 is not r1
+        # and the fresh encode actually reflects the bigger root volume
+        assert l2.capacity[:, EPHEMERAL].max() > l1.capacity[:, EPHEMERAL].max()
+
+    def test_allowed_types_change_forces_fresh(self, catalog, pool):
+        pods = make_pods(6, "w", {"cpu": "1"})
+        names = [t.name for t in catalog.list()]
+        allow_a = set(names)
+        allow_b = set(names[: len(names) // 2])
+        l1, r1 = self._encode_both(pods, catalog, pool, allowed_types=allow_a)
+        l2 = encode_problem(pods, catalog, pool, allowed_types=allow_b)
+        r2 = encode_problem(pods, catalog, pool, allowed_types=allow_b,
+                            revision=self.REV)
+        assert l2 is not l1 and r2 is not r1
+
+    def test_price_change_forces_fresh(self, catalog, pool):
+        pods = make_pods(6, "w", {"cpu": "1"})
+        l1, r1 = self._encode_both(pods, catalog, pool)
+        catalog.pricing.update_on_demand({"c7g.4xlarge": 123.45})  # seq bump
+        l2 = encode_problem(pods, catalog, pool)
+        r2 = encode_problem(pods, catalog, pool, revision=self.REV)
+        assert l2 is not l1 and r2 is not r1
+
+    def test_revision_change_forces_fresh(self, catalog, pool):
+        pods = make_pods(6, "w", {"cpu": "1"})
+        r1 = encode_problem(pods, catalog, pool, revision=("e", 1))
+        assert encode_problem(pods, catalog, pool, revision=("e", 1)) is r1
+        assert encode_problem(pods, catalog, pool, revision=("e", 2)) is not r1
+
+    def test_pod_field_reassignment_moves_pod_write_seq(self, catalog, pool):
+        """A direct pod field reassignment bumps POD_WRITE_SEQ, which the
+        provisioning loop folds into its revision token — so the revision
+        path can never serve the pod's stale encoding (review finding)."""
+        from karpenter_provider_aws_tpu.models.pod import POD_WRITE_SEQ
+        from karpenter_provider_aws_tpu.models.resources import ResourceVector
+
+        pods = make_pods(5, "w", {"cpu": "500m", "memory": "1Gi"})
+        rev1 = ("e", 1, POD_WRITE_SEQ.v)
+        r1 = encode_problem(pods, catalog, pool, revision=rev1)
+        pods[0].requests = ResourceVector.from_map({"cpu": "8", "memory": "32Gi"})
+        rev2 = ("e", 1, POD_WRITE_SEQ.v)
+        assert rev2 != rev1  # the seq moved: the token cannot be reused
+        r2 = encode_problem(pods, catalog, pool, revision=rev2)
+        assert r2 is not r1
+        assert np.isclose(r2.requests[: len(r2.group_pods), 0], 8000).any()
+
+
 class TestDeviceUploadCache:
     def test_equal_content_uploads_once(self):
         s = TPUSolver()

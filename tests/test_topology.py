@@ -282,9 +282,8 @@ class TestSchedulerTopology:
         assert not env.cluster.pending_pods()
         # evict one pod; the scheduler must not double it onto a sibling node
         victim = pods[0]
-        old_node = victim.node_name
-        victim.node_name = ""
-        victim.phase = "Pending"
+        # through the store so caches/journal observe the eviction
+        env.cluster.unbind_pod(victim.uid)
         env.scheduling.reconcile()
         if not victim.is_pending():
             others = {p.node_name for p in pods[1:]}
