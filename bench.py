@@ -173,6 +173,15 @@ def child_host() -> None:
 
     with contextlib.redirect_stdout(sys.stderr):
         write_rows(run_interruption())
+    # lifecycle-SLI summary rows (p50/p99 time-to-bind in deterministic
+    # virtual seconds): the guard rail future perf PRs regress against
+    try:
+        from benchmarks.sli_bench import run_all as run_sli
+
+        with contextlib.redirect_stdout(sys.stderr):
+            write_rows(run_sli())
+    except Exception as e:
+        print(f"sli rows skipped: {type(e).__name__}: {e}", file=sys.stderr)
     try:
         write_rows([_cpp_sidecar_row()])
     except Exception as e:  # best-effort row; toolchain may be absent

@@ -62,6 +62,10 @@ def fmt(row: dict) -> str:
               # incremental-encode rows (docs/performance.md)
               "full_encode_ms", "hit_ms", "patch_p50_ms", "patch_p99_ms",
               "first_pass_ms", "second_pass_ms", "screen_mode",
+              # lifecycle-SLI columns (docs/observability.md): virtual-
+              # seconds time-to-bind/ready through the controller stack
+              "bind_count", "unbound", "ready_count", "p50_s", "p99_s",
+              "max_s",
               "probe_error"):
         if k in row and row[k] is not None:
             v = row[k]

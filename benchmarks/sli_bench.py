@@ -1,0 +1,109 @@
+"""Lifecycle-SLI bench rows: p50/p99 pod time-to-bind and claim
+time-to-ready through the REAL controller stack on a stepped FakeClock.
+
+Waves of pods land while virtual time advances between reconcile passes,
+so the measured time-to-bind is the controller pipeline's own latency in
+deterministic virtual seconds (solve -> launch -> registration -> bind),
+not wall noise. Rows land in BENCH_DETAIL.jsonl and surface as SLI
+columns in BENCH_SUMMARY.md — a future perf PR that regresses scheduling
+latency moves these numbers visibly.
+
+Run directly: ``python -m benchmarks.sli_bench`` (stamps + appends rows).
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def _pct(samples, q):
+    from karpenter_provider_aws_tpu.obs import percentile
+
+    return percentile(samples, q)
+
+
+def run_all(on_row=None, waves: int = 6, pods_per_wave: int = 50,
+            step_advance_s: float = 5.0):
+    """Returns (and streams via ``on_row``) the SLI summary rows."""
+    from karpenter_provider_aws_tpu.models.pod import make_pods
+    from karpenter_provider_aws_tpu.testenv import new_environment
+
+    rows = []
+    env = new_environment(use_tpu_solver=False)
+    try:
+        env.apply_defaults()
+        t0 = time.perf_counter()
+        for w in range(waves):
+            for p in make_pods(
+                pods_per_wave, f"sli-w{w}", {"cpu": "500m", "memory": "1Gi"}
+            ):
+                env.cluster.apply(p)
+            # two passes per wave with virtual time between them: launch +
+            # registration/bind land on distinct virtual timestamps
+            for _ in range(2):
+                env.step(1)
+                env.clock.advance(step_advance_s)
+        # settle: everything must bind for the percentiles to mean "bind"
+        for _ in range(5):
+            if not env.cluster.pending_pods():
+                break
+            env.step(1)
+            env.clock.advance(step_advance_s)
+        wall_s = time.perf_counter() - t0
+
+        binds = env.obs.sli.bind_durations()
+        readies = env.obs.sli.ready_durations()
+        unbound = len(env.cluster.pending_pods())
+        rows.append({
+            "benchmark": "pod_time_to_bind_sli",
+            "pods": waves * pods_per_wave,
+            "bind_count": len(binds),
+            "unbound": unbound,
+            "p50_s": _pct(binds, 0.50),
+            "p99_s": _pct(binds, 0.99),
+            "max_s": round(max(binds), 3) if binds else None,
+            "virtual_step_s": step_advance_s,
+            "wall_s": round(wall_s, 3),
+            "device": "host",
+            "backend": "host",
+            "note": "virtual seconds through the full controller stack "
+                    "(FakeClock; deterministic)",
+        })
+        rows.append({
+            "benchmark": "nodeclaim_time_to_ready_sli",
+            "ready_count": len(readies),
+            "p50_s": _pct(readies, 0.50),
+            "p99_s": _pct(readies, 0.99),
+            "virtual_step_s": step_advance_s,
+            "device": "host",
+            "backend": "host",
+        })
+    finally:
+        env.close()
+    if on_row is not None:
+        for row in rows:
+            on_row(row)
+    return rows
+
+
+def main() -> None:
+    import json
+    import os
+
+    from karpenter_provider_aws_tpu.trace.provenance import stamp_row
+
+    detail = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_DETAIL.jsonl",
+    )
+    at = {"run_at_unix": int(time.time())}
+    with open(detail, "a") as f:
+        for row in run_all():
+            stamp_row(row)
+            f.write(json.dumps({**row, **at}) + "\n")
+            print(row["benchmark"], {k: v for k, v in row.items()
+                                     if k.endswith("_s") or k.endswith("count")})
+
+
+if __name__ == "__main__":
+    main()

@@ -53,9 +53,11 @@ class DisruptionController:
         recorder=None,
         spot_to_spot: bool = False,
         validation_period_s: float = 15.0,
+        obs=None,
     ):
         from ..events import default_recorder
 
+        self.obs = obs
         self.cluster = cluster
         self.cloudprovider = cloudprovider
         self.clock = clock or RealClock()
@@ -72,6 +74,11 @@ class DisruptionController:
         self.provisioning = provisioning
         self.recorder = recorder or default_recorder()
         self.disrupted: list[tuple[str, str]] = []  # (claim name, reason) log
+        # budget-reject audit dedupe: (claim, reason class) -> last record
+        # time. An exhausted budget re-rejects the same candidates every
+        # pass; without this the identical reject records would cycle the
+        # bounded audit ring and evict the history it exists to retain.
+        self._reject_logged: dict[tuple, float] = {}
 
     # -- budget accounting -------------------------------------------------
     # reason-string prefix -> core DisruptionReason class (budget scoping)
@@ -85,9 +92,40 @@ class DisruptionController:
     def _budget_left(self) -> "_BudgetTracker":
         return _BudgetTracker(self.cluster, self.clock.now())
 
-    def _disrupt(self, claim, reason: str, budget: "_BudgetTracker") -> bool:
+    def _audit(self):
+        if self.obs is None:
+            from ..obs import default_obs
+
+            self.obs = default_obs()
+        return self.obs.audit
+
+    REJECT_AUDIT_TTL_S = 300.0  # one reject record per (claim, reason) per window
+
+    def _disrupt(self, claim, reason: str, budget: "_BudgetTracker",
+                 detail: dict = None) -> bool:
         rclass = self._REASON_CLASS.get(reason.split(":")[0], "")
+        audit = self._audit()
         if not budget.consume(claim.nodepool_name, rclass):
+            # a candidate the budget turned down is itself a decision the
+            # audit plane must retain — "why was this node NOT disrupted" —
+            # but TTL-deduped: an exhausted budget re-rejects every pass
+            now = self.clock.now()
+            key = (claim.name, reason.split(":")[0])
+            last = self._reject_logged.get(key)
+            if last is None or now - last >= self.REJECT_AUDIT_TTL_S:
+                self._reject_logged[key] = now
+                if len(self._reject_logged) > 4096:  # bounded: drop expired
+                    cutoff = now - self.REJECT_AUDIT_TTL_S
+                    self._reject_logged = {
+                        k: t for k, t in self._reject_logged.items()
+                        if t >= cutoff
+                    }
+                audit.record(
+                    "disruption", "NodeClaim", claim.name, "reject:budget",
+                    dict(detail or {}, reason=reason,
+                         nodepool=claim.nodepool_name),
+                    at=now, rev=getattr(self.cluster, "rev", None),
+                )
             return False
         from ..metrics import DISRUPTION_ACTIONS
 
@@ -95,6 +133,11 @@ class DisruptionController:
         self.disrupted.append((claim.name, reason))
         log.info("disrupting %s: %s", claim.name, reason)
         self.recorder.publish("NodeClaim", claim.name, "Disrupted", reason)
+        audit.record(
+            "disruption", "NodeClaim", claim.name, f"accept:{reason}",
+            dict(detail or {}, nodepool=claim.nodepool_name),
+            at=self.clock.now(), rev=getattr(self.cluster, "rev", None),
+        )
         self.cluster.delete(claim)  # termination controller drains + reaps
         return True
 
@@ -272,7 +315,8 @@ class DisruptionController:
             for ni in candidates[:lo]:
                 claim = eligible(ni)
                 if claim is not None and self._disrupt(
-                    claim, "consolidatable:delete", budget
+                    claim, "consolidatable:delete", budget,
+                    detail={"savings_per_hour": round(float(ct.price[ni]), 4)},
                 ):
                     deleted_nodes.add(ni)
 
@@ -317,7 +361,17 @@ class DisruptionController:
                 with self.provisioning._nominations_lock:
                     for pod in self.cluster.pods_on_node(node_name):
                         self.provisioning.nominations[pod.uid] = replacement.name
-            self._disrupt(claim, f"consolidatable:replace->{type_name}", budget)
+            self._disrupt(
+                claim, f"consolidatable:replace->{type_name}", budget,
+                detail={
+                    "old_price": round(float(ct.price[int(ni)]), 4),
+                    "new_price": round(float(new_price), 4),
+                    "savings_per_hour": round(
+                        float(ct.price[int(ni)]) - float(new_price), 4
+                    ),
+                    "replacement": replacement.name,
+                },
+            )
 
     MAX_REPLACE_SET = 16  # bound the N of N->1 (stale-snapshot risk grows with N)
     REPLACE_MARGIN = 0.15
@@ -406,9 +460,17 @@ class DisruptionController:
                                         replacement.name
                                     )
                                     picked += 1
+                multi_detail = {
+                    "set_size": len(subset),
+                    "set_price": round(set_price, 4),
+                    "new_price": round(float(new_price), 4),
+                    "savings_per_hour": round(set_price - float(new_price), 4),
+                    "replacement": replacement.name,
+                }
                 for claim in claims:
                     self._disrupt(
-                        claim, f"consolidatable:multi-replace->{type_name}", budget
+                        claim, f"consolidatable:multi-replace->{type_name}",
+                        budget, detail=multi_detail,
                     )
                 return True
         return False

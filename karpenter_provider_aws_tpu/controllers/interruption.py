@@ -100,7 +100,7 @@ class InterruptionController:
     interval_s = 2.0
 
     def __init__(self, cluster: Cluster, cloudprovider: CloudProvider, queue,
-                 recorder=None):
+                 recorder=None, obs=None):
         from ..events import default_recorder
         from ..providers.queue import QueueProvider
 
@@ -114,6 +114,7 @@ class InterruptionController:
         self.cloudprovider = cloudprovider
         self.queue = queue
         self.recorder = recorder or default_recorder()
+        self.obs = obs
         self.handled: list[InterruptionEvent] = []
         # one persistent worker pool (parity: a fixed ParallelizeUntil width,
         # controller.go:104) — a pool per batch costs more than the work.
@@ -204,4 +205,17 @@ class InterruptionController:
             )
             if event.action_drain:
                 log.info("interruption %s: draining %s", event.kind, claim.name)
+                self._audit().record(
+                    "interruption", "NodeClaim", claim.name,
+                    f"drain:{event.kind}",
+                    {"instance_id": iid, "reason": event.reason},
+                    rev=getattr(self.cluster, "rev", None),
+                )
                 self.cluster.delete(claim)  # cordon & drain via termination
+
+    def _audit(self):
+        if self.obs is None:
+            from ..obs import default_obs
+
+            self.obs = default_obs()
+        return self.obs.audit

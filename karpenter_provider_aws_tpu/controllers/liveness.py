@@ -27,17 +27,28 @@ class LivenessController:
     interval_s = 30.0
 
     def __init__(self, cluster: Cluster, clock: Optional[Clock] = None,
-                 ttl_s: float = REGISTRATION_TTL_S, recorder=None):
+                 ttl_s: float = REGISTRATION_TTL_S, recorder=None, obs=None):
         from ..events import default_recorder
 
         self.cluster = cluster
         self.clock = clock or RealClock()
         self.ttl_s = ttl_s
         self.recorder = recorder or default_recorder()
+        # obs bundle: this loop doubles as the SLO engine's heartbeat
+        # (budget gauges, fast-burn events, idle event-recorder sweep)
+        self.obs = obs
         self.reaped: list[str] = []
+
+    def _obs(self):
+        if self.obs is None:
+            from ..obs import default_obs
+
+            self.obs = default_obs()
+        return self.obs
 
     def reconcile(self) -> None:
         now = self.clock.now()
+        obs = self._obs()
         for claim in self.cluster.snapshot_claims():
             if claim.deleted or claim.is_registered():
                 continue
@@ -57,5 +68,16 @@ class LivenessController:
                 type=WARNING,
             )
             self.reaped.append(claim.name)
+            # a reap is an SLO miss (the claim never became a node) and a
+            # decision the audit plane retains
+            obs.sli.claim_reaped(claim.name, now=now)
+            obs.audit.record(
+                "lifecycle", "NodeClaim", claim.name, "reap:registration",
+                {"ttl_s": self.ttl_s, "age_s": round(now - claim.created_at, 1)},
+                at=now, rev=getattr(self.cluster, "rev", None),
+            )
             # termination controller drains (no-op: no node) + terminates
             self.cluster.delete(claim)
+        # the judgment pass: SLO evaluation (budget gauges + fast-burn
+        # Warning events) and idle housekeeping ride the liveness cadence
+        obs.tick(now=now)

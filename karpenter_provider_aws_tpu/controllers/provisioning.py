@@ -34,7 +34,7 @@ class ProvisioningController:
     interval_s = 10.0
 
     def __init__(self, cluster: Cluster, solver: Solver, cloudprovider: CloudProvider,
-                 profiler=None, clock=None, recorder=None):
+                 profiler=None, clock=None, recorder=None, obs=None):
         from ..events import default_recorder
         from ..utils.clock import RealClock
         from ..utils.observability import Profiler
@@ -44,6 +44,9 @@ class ProvisioningController:
         self.cloudprovider = cloudprovider
         self.profiler = profiler or Profiler()
         self.recorder = recorder or default_recorder()
+        # obs bundle (audit ring + oracle sampler); None = process default,
+        # resolved lazily so hermetic environments always inject their own
+        self.obs = obs
         self.clock = clock or getattr(cloudprovider, "clock", None) or RealClock()
         # pod uid -> claim name nominations (kube-scheduler binds for real;
         # the registration controller honors these on node readiness)
@@ -86,35 +89,46 @@ class ProvisioningController:
             if epoch0 is not None and rev0 is not None
             else None
         )
+        occupancy = ZoneOccupancy.from_cluster(self.cluster)
+        type_allow = {
+            pool.name: self.cloudprovider.launchable_type_names(pool)
+            for pool in nodepools
+        }
+        reserved_allow = {
+            pool.name: self.cloudprovider.pool_reserved_allowed(pool)
+            for pool in nodepools
+        }
+        nodeclass_by_pool = self.cluster.nodeclass_by_pool(nodepools)
         with self.profiler.capture("solve"):
             result = self.solver.solve(
                 pending,
                 nodepools,
                 self.cloudprovider.catalog,
                 in_use=self.cluster.in_use_by_nodepool(),
-                occupancy=ZoneOccupancy.from_cluster(self.cluster),
+                occupancy=occupancy,
                 revision=revision,
-                type_allow={
-                    pool.name: self.cloudprovider.launchable_type_names(pool)
-                    for pool in nodepools
-                },
-                reserved_allow={
-                    pool.name: self.cloudprovider.pool_reserved_allowed(pool)
-                    for pool in nodepools
-                },
+                type_allow=type_allow,
+                reserved_allow=reserved_allow,
                 # Live nodes AND in-flight claims ride into the solve as
                 # pre-opened capacity, so pending pods land on slack already
                 # owned (or already being launched) instead of opening more.
                 existing=snapshot_existing_capacity(self.cluster, nominated_map),
                 # per-pool nodeclass: ephemeral-storage capacity follows its
                 # root volume + instanceStorePolicy (types.go:218-244)
-                nodeclass_by_pool=self.cluster.nodeclass_by_pool(nodepools),
+                nodeclass_by_pool=nodeclass_by_pool,
             )
         from ..metrics import SOLVE_DURATION, SOLVE_PODS
 
         SOLVE_DURATION.observe(result.solve_seconds)
         SOLVE_PODS.inc(len(pending))
         self.last_unschedulable = result.unschedulable
+        obs = self._obs()
+        self._audit_solve(result, obs.audit, rev0)
+        # one SLI event per solve pass: good iff every pod was placed
+        obs.slo.record(
+            "solve-success", good=not result.unschedulable,
+            at=self.clock.now(),
+        )
         from ..events import WARNING
 
         for pod, reason in result.unschedulable:
@@ -124,13 +138,82 @@ class ProvisioningController:
             )
         self._apply_binds(result.binds)
         specs = result.node_specs
-        if not specs:
-            return
-        if len(specs) == 1:
-            self._launch(specs[0])
-        else:
-            with ThreadPoolExecutor(max_workers=min(MAX_LAUNCH_WORKERS, len(specs))) as pool:
-                list(pool.map(self._launch, specs))
+        if specs:
+            if len(specs) == 1:
+                self._launch(specs[0])
+            else:
+                with ThreadPoolExecutor(max_workers=min(MAX_LAUNCH_WORKERS, len(specs))) as pool:
+                    list(pool.map(self._launch, specs))
+        # Sampled oracle price gap LAST, after binds and launches are
+        # applied: quality telemetry must never add latency to pod
+        # time-to-bind — the SLI this subsystem measures. Keyed on
+        # (epoch, rev) at call time, so an unchanged follow-up pass never
+        # re-runs the oracle.
+        obs.oracle.maybe_sample(
+            self.cluster, result, pending, nodepools,
+            self.cloudprovider.catalog, occupancy=occupancy,
+            type_allow=type_allow, reserved_allow=reserved_allow,
+            nodeclass_by_pool=nodeclass_by_pool, revision=revision,
+        )
+
+    def _obs(self):
+        if self.obs is None:
+            from ..obs import default_obs
+
+            self.obs = default_obs()
+        return self.obs
+
+    def _audit_solve(self, result, audit, rev) -> None:
+        """One audit record per placement decision this solve made: the
+        winning target (instance type + price for launches, node for
+        binds) plus the top rejected alternatives, joined to the solve's
+        provenance label so ``obs explain`` can name the machinery."""
+        now = self.clock.now()
+        prov = result.provenance.label() if result.provenance else ""
+        catalog = self.cloudprovider.catalog
+        for pod, node_name in result.binds:
+            audit.record(
+                "placement", "Pod", pod.name, f"bind:{node_name}",
+                {"node": node_name, "provenance": prov},
+                at=now, rev=rev,
+            )
+        for spec in result.node_specs:
+            winner = spec.instance_type_options[0] if spec.instance_type_options else "?"
+            alts = []
+            for alt in spec.instance_type_options[1:4]:
+                it = catalog.get(alt)
+                price = (
+                    catalog.pricing.on_demand_price(it)
+                    if it is not None else None
+                )
+                alts.append({
+                    "instance_type": alt,
+                    "price": round(float(price), 4) if price is not None else None,
+                })
+            detail = {
+                "instance_type": winner,
+                "nodepool": spec.nodepool_name,
+                "price": round(float(spec.estimated_price), 4),
+                "zones": list(spec.zone_options),
+                "capacity_types": list(spec.capacity_type_options),
+                "rejected_alternatives": alts,
+                "provenance": prov,
+            }
+            for pod in spec.pods:
+                audit.record(
+                    "placement", "Pod", pod.name, f"launch:{winner}",
+                    detail, at=now, rev=rev,
+                )
+        for pod, reason in result.unschedulable:
+            audit.record(
+                "placement", "Pod", pod.name, "unschedulable",
+                {"reason": reason, "provenance": prov}, at=now, rev=rev,
+            )
+
+    def _note_nominated(self, uid: str) -> None:
+        observer = getattr(self.cluster, "observer", None)
+        if observer is not None:
+            observer.pod_nominated(uid, now=self.clock.now())
 
     def _apply_binds(self, binds) -> None:
         """Bind planned pods onto existing nodes, re-verifying slack at apply
@@ -159,6 +242,7 @@ class ProvisioningController:
                     continue  # launch died under us; re-solve next pass
                 with self._nominations_lock:
                     self.nominations[pod.uid] = cname
+                self._note_nominated(pod.uid)
                 continue
             node = nodes.get(node_name)
             if node is None or not node.ready or node.cordoned:
@@ -194,6 +278,8 @@ class ProvisioningController:
         with self._nominations_lock:
             for pod in spec.pods:
                 self.nominations[pod.uid] = claim.name
+        for pod in spec.pods:
+            self._note_nominated(pod.uid)
 
     def forget_nominations_for(self, claim_name: str) -> None:
         with self._nominations_lock:

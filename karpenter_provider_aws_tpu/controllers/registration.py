@@ -26,6 +26,7 @@ class RegistrationController:
         self.clock = clock or RealClock()
 
     def reconcile(self) -> None:
+        observer = getattr(self.cluster, "observer", None)
         for claim in list(self.cluster.nodeclaims.values()):
             if claim.deleted or not claim.is_launched():
                 continue
@@ -50,6 +51,10 @@ class RegistrationController:
                 self.cluster.apply(node)
                 claim.status.node_name = node.name
                 claim.status.set_condition("Registered", True)
+                if observer is not None:
+                    # condition flips happen on the live object, outside
+                    # Cluster methods — notify the lifecycle SLI directly
+                    observer.claim_registered(claim, now=self.clock.now())
             if not claim.is_initialized():
                 # initialization: startup taints are expected to be cleared
                 # by their owners (CNI etc.); the fake kubelet clears them
@@ -61,6 +66,8 @@ class RegistrationController:
                         t for t in node.taints if (t.key, t.value, t.effect) not in startup
                     ]
                 claim.status.set_condition("Initialized", True)
+                if observer is not None:
+                    observer.claim_ready(claim, now=self.clock.now())
             self._bind_nominated(claim)
 
     def _bind_nominated(self, claim) -> None:

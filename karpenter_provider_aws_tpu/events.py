@@ -83,11 +83,7 @@ class EventRecorder:
             # lock, and expired storm entries are reclaimed within ~TTL/2
             # of expiring instead of lingering behind a growth ratchet.
             if len(self._last) > 2 * self._ring.maxlen and now >= self._next_sweep:
-                cutoff = now - self.dedupe_ttl_s
-                kept = {k: v for k, v in self._last.items() if v[0] >= cutoff}
-                if len(kept) < len(self._last):
-                    self._last = kept
-                self._next_sweep = now + self.dedupe_ttl_s / 2
+                self._sweep_locked(now)
         try:
             from .metrics import EVENTS
 
@@ -96,6 +92,41 @@ class EventRecorder:
             pass
         log.info("%s %s/%s: %s (%s)", type, kind, name, reason, message)
         return True
+
+    def _sweep_locked(self, now: float) -> int:
+        """Drop expired dedupe entries (caller holds the lock). Before an
+        entry goes, its live repeat count is written back onto the ring
+        Event it shadows, so ``events()`` keeps reporting the true count
+        after the dedupe map forgets the key."""
+        cutoff = now - self.dedupe_ttl_s
+        expired = [k for k, v in self._last.items() if v[0] < cutoff]
+        for k in expired:
+            v = self._last.pop(k)
+            if v[2] != v[1].count:
+                object.__setattr__(v[1], "count", v[2])
+        self._next_sweep = now + self.dedupe_ttl_s / 2
+        return len(expired)
+
+    def sweep(self, now: Optional[float] = None) -> int:
+        """Idle-cluster memory hygiene: evict expired dedupe entries even
+        when no new events arrive (publish only sweeps opportunistically,
+        so a quiet cluster after an event storm would otherwise hold the
+        whole map until the NEXT storm). Called from the obs/ engine tick;
+        returns the number of entries dropped."""
+        now = self._now() if now is None else now
+        with self._lock:
+            return self._sweep_locked(now)
+
+    def query(
+        self,
+        kind: Optional[str] = None,
+        name: Optional[str] = None,
+        reason: Optional[str] = None,
+    ) -> list[Event]:
+        """Filterable accessor over the retained ring (the ``obs explain``
+        CLI's join surface) — alias of :meth:`events` with the filter
+        semantics spelled out: every non-None argument must match."""
+        return self.events(kind=kind, name=name, reason=reason)
 
     def events(
         self,

@@ -34,16 +34,24 @@ class Counter:
             self._values[key] = self._values.get(key, 0.0) + amount
 
     def value(self, **labels) -> float:
-        return self._values.get(tuple(sorted(labels.items())), 0.0)
+        # read under the lock: a concurrent inc() resizing the dict must
+        # not race this lookup (CPython dicts don't tear, but the
+        # lock-free read was still an unordered peek at a mid-update map)
+        with self._lock:
+            return self._values.get(tuple(sorted(labels.items())), 0.0)
 
     def total(self) -> float:
         """Sum across every label set (e.g. all-services retry volume)."""
         with self._lock:
             return float(sum(self._values.values()))
 
+    def _snapshot(self) -> list[tuple]:
+        with self._lock:
+            return sorted(self._values.items())
+
     def expose(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
-        for key, v in sorted(self._values.items()):
+        for key, v in self._snapshot():
             out.append(f"{self.name}{_fmt_labels(dict(key))} {v}")
         return out
 
@@ -56,7 +64,7 @@ class Gauge(Counter):
 
     def expose(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
-        for key, v in sorted(self._values.items()):
+        for key, v in self._snapshot():
             out.append(f"{self.name}{_fmt_labels(dict(key))} {v}")
         return out
 
@@ -96,15 +104,23 @@ class Histogram:
         return _Timer()
 
     def expose(self) -> list[str]:
+        # snapshot under the lock: a concurrent observe() appends bucket
+        # rows and mutates count lists in place — expose must render a
+        # coherent point-in-time view, not a mid-update one
+        with self._lock:
+            snap = sorted(
+                (key, list(counts), self._sums[key])
+                for key, counts in self._counts.items()
+            )
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
-        for key, counts in sorted(self._counts.items()):
+        for key, counts, total in snap:
             labels = dict(key)
             for i, b in enumerate(self.buckets):
                 lab = dict(labels, le=str(b))
                 out.append(f"{self.name}_bucket{_fmt_labels(lab)} {sum(counts[: i + 1])}")
             lab = dict(labels, le="+Inf")
             out.append(f"{self.name}_bucket{_fmt_labels(lab)} {counts[-1]}")
-            out.append(f"{self.name}_sum{_fmt_labels(labels)} {self._sums[key]}")
+            out.append(f"{self.name}_sum{_fmt_labels(labels)} {total}")
             out.append(f"{self.name}_count{_fmt_labels(labels)} {counts[-1]}")
         return out
 
@@ -114,11 +130,42 @@ class Registry:
         self._metrics: list = []
         self._lock = threading.Lock()
         self._http: Optional[ThreadingHTTPServer] = None
+        # /debug/* page providers: path -> zero-arg callable returning a
+        # JSON-serializable object (the obs/ subsystem registers /debug/slo,
+        # /debug/decisions, /debug/cluster here)
+        self._debug_pages: dict = {}
 
     def register(self, metric):
         with self._lock:
             self._metrics.append(metric)
         return metric
+
+    def register_debug_page(self, path: str, provider) -> None:
+        """Expose ``provider()`` as JSON at ``path`` (must start with
+        /debug/) on the metrics HTTP server. Re-registration replaces —
+        a fresh hermetic environment owns the pages."""
+        if not path.startswith("/debug/"):
+            raise ValueError(f"debug pages live under /debug/: {path!r}")
+        with self._lock:
+            self._debug_pages[path] = provider
+
+    def debug_page(self, path: str):
+        """Render one registered page to a JSON-ready object (None when
+        unregistered). Provider errors surface as an error payload — an
+        introspection endpoint must never take down the scrape server."""
+        with self._lock:
+            provider = self._debug_pages.get(path)
+        if provider is None:
+            return None
+        try:
+            return provider()
+        except Exception as e:  # pragma: no cover - defensive
+            return {"error": f"{type(e).__name__}: {e}"}
+
+    def metric_names(self) -> set[str]:
+        """Registered family names (the docs schema-drift guard's source)."""
+        with self._lock:
+            return {m.name for m in self._metrics}
 
     def counter(self, name: str, help_: str = "") -> Counter:
         return self.register(Counter(name, help_))
@@ -155,6 +202,19 @@ class Registry:
                     )
                 elif self.path == "/healthz":
                     self.reply(200, b"ok\n", "text/plain; version=0.0.4")
+                elif self.path.startswith("/debug/"):
+                    page = registry.debug_page(self.path)
+                    if page is None:
+                        self.reply(404, b"unknown debug page\n")
+                    else:
+                        import json
+
+                        self.reply(
+                            200,
+                            json.dumps(page, indent=2, default=str).encode()
+                            + b"\n",
+                            "application/json",
+                        )
                 elif self.path == "/readyz":
                     ready = True
                     if readiness is not None:
@@ -278,6 +338,67 @@ BATCH_WINDOW = REGISTRY.histogram(
     "Time from a batch's first request to execution (parity: batcher window histograms, metrics.go:37-47)",
     buckets=(0.001, 0.005, 0.01, 0.035, 0.1, 0.3, 1.0, 3.0),
 )
+# -- obs/ subsystem: lifecycle SLIs, solver quality, SLOs, audit ----------
+POD_SCHEDULING_SECONDS = REGISTRY.histogram(
+    "karpenter_pod_scheduling_duration_seconds",
+    "Pod lifecycle SLI by phase: nominate = pending->nominated, "
+    "bind = pending->bound (parity: the reference's pod-startup "
+    "histograms). Fed by the obs/ cluster observer on every sanctioned "
+    "bind, in the store clock's time base",
+    buckets=(0.5, 1, 5, 15, 30, 60, 120, 300, 600, 1800),
+)
+NODECLAIM_LIFECYCLE_SECONDS = REGISTRY.histogram(
+    "karpenter_nodeclaim_lifecycle_duration_seconds",
+    "NodeClaim phase transitions: launch = created->launched, register = "
+    "launched->registered, ready = registered->initialized, total = "
+    "created->initialized (obs/ lifecycle SLI)",
+    buckets=(1, 5, 15, 30, 60, 120, 300, 600, 900, 1800),
+)
+SLO_BUDGET_REMAINING = REGISTRY.gauge(
+    "karpenter_slo_error_budget_remaining",
+    "Fraction of the SLO's error budget left over its compliance window "
+    "(1 = untouched, 0 = exhausted), per declared SLO (obs/slo.py)",
+)
+SLO_BURN_RATE = REGISTRY.gauge(
+    "karpenter_slo_burn_rate",
+    "Error-budget burn rate per SLO and rule window (1.0 = burning "
+    "exactly the sustainable rate; fast-burn Warning events fire when "
+    "both windows of a rule exceed its factor)",
+)
+SOLVE_PACKING_EFFICIENCY = REGISTRY.gauge(
+    "karpenter_solver_packing_efficiency",
+    "Requested/allocatable per resource across the nodes the last solve "
+    "committed to launch (1.0 = perfectly packed; solver-quality SLI)",
+)
+CLUSTER_PACKING_EFFICIENCY = REGISTRY.gauge(
+    "karpenter_cluster_packing_efficiency",
+    "Bound-pod requests / node allocatable per resource across live "
+    "nodes, refreshed by each consolidation screen sweep",
+)
+SOLVE_COST_VS_ORACLE = REGISTRY.gauge(
+    "karpenter_solver_cost_vs_oracle",
+    "Committed launch cost / FFD-oracle cost for the sampled solve "
+    "(scheduling/oracle.py; sampled off the hot path, pure-launch "
+    "passes only — ~1.0 means the device plan matches the oracle)",
+)
+UNSCHEDULABLE_PODS = REGISTRY.counter(
+    "karpenter_solver_unschedulable_pods_total",
+    "Pods a solve pass left unschedulable (solver-quality SLI; the "
+    "per-pod reasons ride the audit log and FailedScheduling events)",
+)
+LEADER = REGISTRY.gauge(
+    "karpenter_leader",
+    "1 when this replica holds the leader lease, else 0 (by identity). "
+    "docs/troubleshooting.md points operators here for split-brain triage "
+    "— the docs referenced it before it existed; the obs/ schema-drift "
+    "guard caught that",
+)
+AUDIT_RECORDS = REGISTRY.counter(
+    "karpenter_audit_records_total",
+    "Decision audit records appended, by kind (placement / disruption / "
+    "interruption / eviction / lifecycle — obs/audit.py)",
+)
+
 # Catalog gauges (parity: instancetype metrics.go:32-75 — vCPU/memory per
 # type, offering price/availability per (type, zone, capacity type)).
 INSTANCE_TYPE_VCPU = REGISTRY.gauge(

@@ -1,0 +1,82 @@
+"""CLI: ``python -m karpenter_provider_aws_tpu.obs explain <kind>/<name>``.
+
+Joins the decision audit log with events and trace provenance for one
+object. ``--audit-file`` reads a JSONL ring dumped by ``AuditLog.dump``
+(the offline mode operators use against a collected artifact); without it
+the process-default audit log is consulted (useful in-process, mostly
+empty from a cold CLI). ``slo`` prints the engine's spec table.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .audit import AuditLog, default_audit
+from .explain import explain, render_text
+from .slo import default_slos
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m karpenter_provider_aws_tpu.obs",
+        description="observability toolbox: decision explain + SLO specs",
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_explain = sub.add_parser(
+        "explain", help="join audit + events + provenance for one object"
+    )
+    p_explain.add_argument(
+        "subject", help="object as <kind>/<name>, e.g. Pod/web-0 or "
+                        "NodeClaim/default-abc12",
+    )
+    p_explain.add_argument(
+        "--audit-file", default="",
+        help="JSONL audit dump to query (AuditLog.dump output); default: "
+             "the in-process audit ring",
+    )
+    p_explain.add_argument(
+        "--json", action="store_true", help="emit the joined view as JSON"
+    )
+
+    p_slo = sub.add_parser("slo", help="print the shipped SLO specs")
+    p_slo.add_argument("--json", action="store_true")
+
+    args = parser.parse_args(argv)
+
+    if args.cmd == "slo":
+        specs = [s.as_dict() for s in default_slos()]
+        if args.json:
+            print(json.dumps(specs, indent=2))
+        else:
+            for s in specs:
+                print(
+                    f"{s['name']}: {s['objective']:.3%} over {s['window_s']:.0f}s"
+                    + (
+                        f", threshold {s['threshold_s']:.0f}s"
+                        if s["threshold_s"] is not None else ""
+                    )
+                    + f" — {s['description']}"
+                )
+        return 0
+
+    if "/" not in args.subject:
+        print("subject must be <kind>/<name>", file=sys.stderr)
+        return 2
+    kind, name = args.subject.split("/", 1)
+    if args.audit_file:
+        audit = AuditLog.load_jsonl(args.audit_file)
+    else:
+        audit = default_audit()
+    view = explain(kind, name, audit=audit)
+    if args.json:
+        print(json.dumps(view, indent=2))
+    else:
+        print(render_text(view))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

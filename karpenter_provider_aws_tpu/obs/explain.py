@@ -1,0 +1,109 @@
+"""``obs explain``: join audit records, events, and trace provenance.
+
+One question — "why did the controller decide X about <kind>/<name>?" —
+answered from three planes at once: the decision audit ring (what was
+chosen and what was rejected), the event recorder (what was announced),
+and trace provenance (what machinery computed it). Works against live
+in-process objects (hermetic tests, the operator) or a dumped audit JSONL
+(the CLI's offline mode).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def explain(
+    kind: str,
+    name: str,
+    audit=None,
+    recorder=None,
+    limit: int = 50,
+) -> dict:
+    """JSON-ready joined view for one object.
+
+    ``kind`` is the subject kind (Pod / NodeClaim / Node / SLO ...);
+    ``audit`` an AuditLog (or a pre-loaded list of AuditRecords);
+    ``recorder`` an EventRecorder. Absent planes join as empty lists.
+    """
+    records: list = []
+    if audit is not None:
+        if hasattr(audit, "query"):
+            records = audit.query(subject_kind=kind, subject=name, limit=limit)
+        else:  # a list loaded from JSONL
+            records = [
+                r for r in audit
+                if r.subject_kind == kind and r.subject == name
+            ][-limit:]
+    events: list = []
+    if recorder is not None:
+        events = recorder.query(kind=kind, name=name)
+
+    # provenance join: prefer the stamp each audit record carried at
+    # decision time; fall back to the most recent live solve record
+    provenance: Optional[dict] = None
+    for r in reversed(records):
+        stamp = r.detail.get("provenance")
+        if stamp:
+            provenance = stamp if isinstance(stamp, dict) else {"label": stamp}
+            break
+    if provenance is None:
+        try:
+            from ..trace.provenance import last_record
+
+            rec = last_record("solve")
+            if rec is not None:
+                provenance = rec.as_dict()
+        except Exception:
+            provenance = None
+
+    return {
+        "subject": f"{kind}/{name}",
+        "audit": [r.as_dict() for r in records],
+        "events": [
+            {
+                "type": e.type, "reason": e.reason, "message": e.message,
+                "at": round(e.at, 3), "count": e.count,
+            }
+            for e in events
+        ],
+        "provenance": provenance,
+    }
+
+
+def render_text(view: dict) -> str:
+    """Human rendering of an ``explain`` view."""
+    lines = [f"== {view['subject']} =="]
+    if not view["audit"] and not view["events"]:
+        lines.append("no audit records or events retained for this object")
+    if view["audit"]:
+        lines.append("decisions (oldest first):")
+        for r in view["audit"]:
+            detail = {
+                k: v for k, v in r.get("detail", {}).items()
+                if k != "provenance"
+            }
+            extra = f"  {detail}" if detail else ""
+            lines.append(
+                f"  [{r['at']:>10.3f}] {r['kind']:<13} {r['decision']}{extra}"
+            )
+    if view["events"]:
+        lines.append("events:")
+        for e in view["events"]:
+            count = f" x{e['count']}" if e.get("count", 1) > 1 else ""
+            lines.append(
+                f"  [{e['at']:>10.3f}] {e['type']}/{e['reason']}{count}: "
+                f"{e['message']}"
+            )
+    prov = view.get("provenance")
+    if prov:
+        if "label" in prov and len(prov) == 1:
+            lines.append(f"provenance: {prov['label']}")
+        else:
+            lines.append(
+                "provenance: "
+                f"{prov.get('device', '?')}/{prov.get('backend', '?')}"
+                f"@{prov.get('git_sha', '?')}"
+                + (f" quality={prov['quality']}" if prov.get("quality") else "")
+            )
+    return "\n".join(lines)

@@ -1,0 +1,184 @@
+"""Solver-quality telemetry: was the fast answer also a good one?
+
+Two layers:
+
+ - **In-band (every solve, cheap):** ``solve_quality`` computes packing
+   efficiency (requested/allocatable per resource across committed
+   launches) and the unschedulable rate from the finished ``SolveResult``
+   alone — O(specs + pods), stamped into the solve's
+   ``ProvenanceRecord.quality`` and exported as gauges.
+
+ - **Sampled (off the hot path):** ``OracleSampler`` replays the pending
+   set through the pure-numpy FFD oracle (``scheduling/oracle.py``) and
+   publishes ``karpenter_solver_cost_vs_oracle`` — committed cost over the
+   oracle's cost. Sampling is keyed on the cluster ``(epoch, rev)`` token:
+   an unchanged pass NEVER re-runs the oracle (the <1ms warm-pass
+   contract), and pure-launch passes only (binds to existing capacity
+   make the all-new-nodes oracle incomparable). ``KARPENTER_TPU_ORACLE_SAMPLE=0``
+   disables outright.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+import numpy as np
+
+log = logging.getLogger("karpenter.tpu.obs")
+
+
+def packing_efficiency(requested: np.ndarray, allocatable: np.ndarray) -> dict:
+    """Per-resource requested/allocatable for the resources that exist on
+    both sides (cpu/memory always; accelerators when present)."""
+    from ..models.resources import RESOURCE_AXES
+
+    out: dict[str, float] = {}
+    for i, name in enumerate(RESOURCE_AXES):
+        if allocatable[i] > 0 and requested[i] > 0:
+            out[name] = round(float(requested[i] / allocatable[i]), 4)
+    return out
+
+
+# Resources each packing gauge has ever reported: a resource that leaves
+# the efficiency map (cluster emptied, workload shape changed) is zeroed
+# rather than left frozen at its last value — a dashboard reading a
+# packing gauge must never see a dead number.
+_reported: dict[int, set] = {}
+
+
+def _set_packing_gauges(gauge, eff: dict) -> None:
+    seen = _reported.setdefault(id(gauge), set())
+    for resource in seen - set(eff):
+        gauge.set(0.0, resource=resource)
+    for resource, v in eff.items():
+        gauge.set(v, resource=resource)
+    seen |= set(eff)
+
+
+def solve_quality(result, catalog) -> dict:
+    """Compute + export the in-band quality block for one SolveResult.
+    Cheap and exception-safe: quality must never take down the solve."""
+    from ..metrics import SOLVE_PACKING_EFFICIENCY, UNSCHEDULABLE_PODS
+    from ..models.resources import NUM_RESOURCES
+
+    quality: dict = {}
+    try:
+        requested = np.zeros(NUM_RESOURCES, dtype=np.float64)
+        allocatable = np.zeros(NUM_RESOURCES, dtype=np.float64)
+        for spec in result.node_specs:
+            it = catalog.get(spec.instance_type_options[0]) if spec.instance_type_options else None
+            if it is not None:
+                allocatable += np.asarray(it.capacity().v, dtype=np.float64)
+            for pod in spec.pods:
+                requested += np.asarray(pod.requests.v, dtype=np.float64)
+        if result.node_specs and allocatable.any():
+            eff = packing_efficiency(requested, allocatable)
+            _set_packing_gauges(SOLVE_PACKING_EFFICIENCY, eff)
+            if eff:
+                quality["packing_efficiency"] = eff
+        n_unsched = len(result.unschedulable)
+        if n_unsched:
+            UNSCHEDULABLE_PODS.inc(n_unsched)
+        if result.num_pods:
+            quality["unschedulable_rate"] = round(n_unsched / result.num_pods, 4)
+        prov = result.provenance
+        if prov is not None and prov.fallback:
+            quality["fallback"] = prov.fallback
+        if prov is not None and quality:
+            prov.quality.update(quality)
+    except Exception:  # pragma: no cover - defensive
+        log.exception("solve quality telemetry failed")
+    return quality
+
+
+class OracleSampler:
+    """Price-optimality gap vs the FFD oracle, sampled off the hot path."""
+
+    def __init__(self):
+        self._last_key: Optional[tuple] = None
+
+    def maybe_sample(
+        self, cluster, result, pods, nodepools, catalog,
+        occupancy=None, type_allow=None, reserved_allow=None,
+        nodeclass_by_pool=None, revision=None,
+    ) -> Optional[float]:
+        """Returns the gap (committed/oracle) when sampled, else None.
+
+        Skips when: disabled, the cluster ``(epoch, rev)`` is unchanged
+        since the last sample (identical passes pay nothing), the plan
+        binds to existing capacity (oracle incomparable), nothing
+        launched, or more than one nodepool competed (the oracle is
+        single-pool)."""
+        if os.environ.get("KARPENTER_TPU_ORACLE_SAMPLE", "1") != "1":
+            return None
+        key = (
+            getattr(cluster, "epoch", None),
+            getattr(cluster, "rev", None),
+        )
+        if key == self._last_key:
+            return None
+        self._last_key = key
+        if result.binds or not result.node_specs or len(nodepools) != 1:
+            return None
+        try:
+            from ..ops.encode import encode_problem
+            from ..scheduling.oracle import ffd_oracle, oracle_cost
+
+            pool = nodepools[0]
+            # same arguments as the solve's own encode, so the revision-
+            # keyed problem cache almost always serves this for free
+            problem = encode_problem(
+                pods, catalog, nodepool=pool, occupancy=occupancy,
+                allowed_types=(type_allow or {}).get(pool.name),
+                allow_reserved=(
+                    reserved_allow.get(pool.name, False)
+                    if reserved_allow is not None else True
+                ),
+                nodeclass=(nodeclass_by_pool or {}).get(pool.name),
+                revision=revision,
+            )
+            nodes, _unplaced = ffd_oracle(problem)
+            base = oracle_cost(nodes)
+            if base <= 0:
+                return None
+            gap = float(result.total_cost) / base
+            from ..metrics import SOLVE_COST_VS_ORACLE
+
+            SOLVE_COST_VS_ORACLE.set(gap)
+            if result.provenance is not None:
+                result.provenance.quality["cost_vs_oracle"] = round(gap, 4)
+            return gap
+        except Exception:  # pragma: no cover - defensive
+            log.exception("oracle quality sample failed")
+            return None
+
+
+_last_pack: tuple = (None, None)  # (weakref to the last ct, its efficiency)
+
+
+def cluster_packing(ct) -> dict:
+    """Per-resource bound/allocatable across a consolidation snapshot's
+    live nodes (``ClusterTensors``) — the cluster-wide packing SLI the
+    screen sweep refreshes each pass. O(N x R) numpy sums, memoized on
+    tensor identity: a no-change warm pass serves the SAME ClusterTensors
+    object (ops/encode_delta.py contract), so it pays a pointer compare
+    here, keeping the <1ms warm-pass budget intact."""
+    global _last_pack
+    import weakref
+
+    from ..metrics import CLUSTER_PACKING_EFFICIENCY
+
+    ref, cached = _last_pack
+    if ref is not None and ref() is ct:
+        return cached
+    used = np.asarray(ct.used_total, dtype=np.float64).sum(axis=0)
+    cap = used + np.asarray(ct.free, dtype=np.float64).sum(axis=0)
+    eff = packing_efficiency(used, cap)
+    _set_packing_gauges(CLUSTER_PACKING_EFFICIENCY, eff)
+    try:
+        _last_pack = (weakref.ref(ct), eff)
+    except TypeError:  # pragma: no cover - non-weakrefable snapshot
+        _last_pack = (None, None)
+    return eff

@@ -1,0 +1,205 @@
+"""Lifecycle SLIs: pod pending->nominated->bound, claim created->ready.
+
+``LifecycleSLI`` is the cluster observer (``state.Cluster.observer``): the
+sanctioned mutation surface (apply/bind_pod/unbind_pod/delete) and the
+registration/liveness controllers call its hooks, and it turns transitions
+into:
+
+ - ``karpenter_pod_scheduling_duration_seconds{phase}`` histograms
+   (nominate = pending->nominated, bind = pending->bound),
+ - ``karpenter_nodeclaim_lifecycle_duration_seconds{phase}`` histograms
+   (launch / register / ready / total),
+ - SLI events fed to the SLO engine (pod-time-to-bind,
+   nodeclaim-time-to-ready),
+ - eviction audit records (one per drained pod — the chaos acceptance
+   surface), and
+ - bounded raw-duration rings so the bench can report exact p50/p99
+   time-to-bind instead of reconstructing percentiles from buckets.
+
+All timestamps are in the cluster store's clock base (FakeClock under
+test/chaos — transitions are deterministic per seed). Hooks never call
+back into the Cluster: they may run under its lock.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Optional
+
+SAMPLE_CAP = 4096  # bounded raw-duration history (bench percentile source)
+
+
+def percentile(samples, q: float):
+    """Nearest-rank percentile over raw samples (None when empty) — THE
+    percentile used by /debug/cluster and the SLI bench rows, so the two
+    can never disagree about the same samples."""
+    s = sorted(samples)
+    if not s:
+        return None
+    return round(float(s[min(len(s) - 1, int(q * len(s)))]), 3)
+
+
+class LifecycleSLI:
+    def __init__(self, clock=None, engine=None, audit=None):
+        self.clock = clock
+        self.engine = engine       # SLOEngine or None
+        self.audit = audit         # AuditLog or None
+        self._lock = threading.Lock()
+        self._pod_pending: dict[str, float] = {}      # uid -> pending-at
+        self._pod_name: dict[str, str] = {}           # uid -> name (audit)
+        self._claims: dict[str, dict] = {}            # name -> phase times
+        self.bind_samples: deque = deque(maxlen=SAMPLE_CAP)   # (uid, seconds)
+        self.ready_samples: deque = deque(maxlen=SAMPLE_CAP)  # (claim, seconds)
+
+    def _now(self) -> float:
+        if self.clock is not None:
+            return self.clock.now()
+        import time
+
+        return time.monotonic()
+
+    # -- pod lifecycle -----------------------------------------------------
+    def pod_applied(self, pod, now: Optional[float] = None) -> None:
+        """First sight of a pending pod starts its scheduling clock;
+        re-applies of a tracked pod are no-ops."""
+        now = self._now() if now is None else now
+        with self._lock:
+            self._pod_name[pod.uid] = pod.name
+            if pod.node_name:
+                # applied already-bound (restored state): nothing to time
+                self._pod_pending.pop(pod.uid, None)
+            elif pod.uid not in self._pod_pending:
+                self._pod_pending[pod.uid] = now
+
+    def pod_nominated(self, uid: str, now: Optional[float] = None) -> None:
+        now = self._now() if now is None else now
+        with self._lock:
+            t0 = self._pod_pending.get(uid)
+        if t0 is None:
+            return
+        from ..metrics import POD_SCHEDULING_SECONDS
+
+        POD_SCHEDULING_SECONDS.observe(max(0.0, now - t0), phase="nominate")
+
+    def pod_bound(self, uid: str, node_name: str, now: Optional[float] = None) -> None:
+        now = self._now() if now is None else now
+        with self._lock:
+            t0 = self._pod_pending.pop(uid, None)
+        if t0 is None:
+            return
+        dur = max(0.0, now - t0)
+        from ..metrics import POD_SCHEDULING_SECONDS
+
+        POD_SCHEDULING_SECONDS.observe(dur, phase="bind")
+        with self._lock:
+            self.bind_samples.append((uid, dur))
+        if self.engine is not None:
+            self.engine.record_latency("pod-time-to-bind", dur, at=now)
+
+    def pod_unbound(self, uid: str, old_node: str, now: Optional[float] = None) -> None:
+        """Eviction/drain: the pod re-enters pending and its scheduling
+        clock restarts; one eviction audit record per drained pod."""
+        now = self._now() if now is None else now
+        with self._lock:
+            self._pod_pending[uid] = now
+            name = self._pod_name.get(uid, uid)
+        if self.audit is not None:
+            from .audit import EVICTION
+
+            self.audit.record(
+                EVICTION, "Pod", name, f"evict:{old_node or '?'}",
+                {"node": old_node, "uid": uid}, at=now,
+            )
+
+    def pod_deleted(self, uid: str) -> None:
+        with self._lock:
+            self._pod_pending.pop(uid, None)
+            self._pod_name.pop(uid, None)
+
+    # -- nodeclaim lifecycle -----------------------------------------------
+    def claim_applied(self, claim, now: Optional[float] = None) -> None:
+        """Tracks created (first sight) and launched (provider id set) —
+        both flow through Cluster.apply, so no controller changes needed."""
+        now = self._now() if now is None else now
+        launched = bool(claim.status.provider_id)
+        from ..metrics import NODECLAIM_LIFECYCLE_SECONDS
+
+        with self._lock:
+            st = self._claims.get(claim.name)
+            if st is None:
+                st = self._claims[claim.name] = {"created": now}
+            if launched and "launched" not in st:
+                st["launched"] = now
+                delta = max(0.0, now - st["created"])
+            else:
+                return
+        NODECLAIM_LIFECYCLE_SECONDS.observe(delta, phase="launch")
+
+    def claim_registered(self, claim, now: Optional[float] = None) -> None:
+        now = self._now() if now is None else now
+        from ..metrics import NODECLAIM_LIFECYCLE_SECONDS
+
+        with self._lock:
+            st = self._claims.setdefault(claim.name, {"created": now})
+            if "registered" in st:
+                return
+            st["registered"] = now
+            base = st.get("launched", st["created"])
+        NODECLAIM_LIFECYCLE_SECONDS.observe(
+            max(0.0, now - base), phase="register"
+        )
+
+    def claim_ready(self, claim, now: Optional[float] = None) -> None:
+        now = self._now() if now is None else now
+        from ..metrics import NODECLAIM_LIFECYCLE_SECONDS
+
+        with self._lock:
+            st = self._claims.setdefault(claim.name, {"created": now})
+            if "ready" in st:
+                return
+            st["ready"] = now
+            base = st.get("registered", st.get("launched", st["created"]))
+            total = max(0.0, now - st["created"])
+            self.ready_samples.append((claim.name, total))
+        NODECLAIM_LIFECYCLE_SECONDS.observe(max(0.0, now - base), phase="ready")
+        NODECLAIM_LIFECYCLE_SECONDS.observe(total, phase="total")
+        if self.engine is not None:
+            self.engine.record_latency("nodeclaim-time-to-ready", total, at=now)
+
+    def claim_reaped(self, claim_name: str, now: Optional[float] = None) -> None:
+        """Liveness reap: the claim never became a node — an SLO miss."""
+        now = self._now() if now is None else now
+        if self.engine is not None:
+            self.engine.record_bad("nodeclaim-time-to-ready", at=now)
+        with self._lock:
+            self._claims.pop(claim_name, None)
+
+    def claim_gone(self, claim_name: str) -> None:
+        with self._lock:
+            self._claims.pop(claim_name, None)
+
+    # -- introspection -----------------------------------------------------
+    def pending_ages(self, now: Optional[float] = None) -> dict[str, float]:
+        now = self._now() if now is None else now
+        with self._lock:
+            return {
+                self._pod_name.get(uid, uid): max(0.0, now - t0)
+                for uid, t0 in self._pod_pending.items()
+            }
+
+    def bind_durations(self) -> list[float]:
+        with self._lock:
+            return [d for _, d in self.bind_samples]
+
+    def ready_durations(self) -> list[float]:
+        with self._lock:
+            return [d for _, d in self.ready_samples]
+
+    def reset(self) -> None:
+        with self._lock:
+            self._pod_pending.clear()
+            self._pod_name.clear()
+            self._claims.clear()
+            self.bind_samples.clear()
+            self.ready_samples.clear()

@@ -68,6 +68,9 @@ class LeaderElector:
         )
         was = self._leader
         self._leader = holder == self.identity
+        from ..metrics import LEADER
+
+        LEADER.set(1.0 if self._leader else 0.0, identity=self.identity)
         if self._leader:
             self._renewed_at = self._now()
         if self._leader and not was:
@@ -105,3 +108,6 @@ class LeaderElector:
         if self._leader:
             self.cloud.release_lease(self.lease_name, self.identity)
             self._leader = False
+            from ..metrics import LEADER
+
+            LEADER.set(0.0, identity=self.identity)

@@ -261,8 +261,14 @@ def new_operator(
     from ..events import EventRecorder
 
     recorder = EventRecorder(clock=clock)
+    # the observability bundle: lifecycle SLIs on this cluster, SLO engine
+    # on this recorder, /debug/{slo,decisions,cluster} on the metrics server
+    from .. import obs as obs_mod
+
+    obs_bundle = obs_mod.install(cluster=cluster, recorder=recorder, clock=clock)
     provisioning = ProvisioningController(
-        cluster, solver, cloudprovider, profiler=profiler, recorder=recorder
+        cluster, solver, cloudprovider, profiler=profiler, recorder=recorder,
+        obs=obs_bundle,
     )
     scheduling = SchedulingController(cluster, provisioning, clock=clock)
     registration = RegistrationController(cluster, provisioning, clock=clock)
@@ -275,6 +281,7 @@ def new_operator(
         provisioning=provisioning,
         recorder=recorder,
         spot_to_spot=options.gate("SpotToSpot", False),
+        obs=obs_bundle,
     )
     from ..providers.aws.backend import AwsCloudBackend
 
@@ -303,7 +310,8 @@ def new_operator(
         TaggingController(cluster, cloudprovider),
         disruption,
         GarbageCollectionController(cluster, cloudprovider, clock=clock),
-        LivenessController(cluster, clock=clock, recorder=recorder),
+        LivenessController(cluster, clock=clock, recorder=recorder,
+                           obs=obs_bundle),
         NodeClassTerminationController(cluster, cloudprovider),
         CatalogRefreshController(catalog),
         # Live pricing refresh sources when the AWS backend is wired
@@ -328,7 +336,8 @@ def new_operator(
     if options.interruption_queue and queue is not None:
         controllers.insert(
             2,
-            InterruptionController(cluster, cloudprovider, queue, recorder=recorder),
+            InterruptionController(cluster, cloudprovider, queue,
+                                   recorder=recorder, obs=obs_bundle),
         )
 
     elector = None

@@ -544,10 +544,21 @@ def consolidatable(ct: ClusterTensors, chunk: int = 512) -> np.ndarray:
         sp.set(backend=used_backend)
         if fallback:
             sp.set(fallback=fallback)
-    screen_record(
+    rec = screen_record(
         backend=used_backend, nodes=len(ct.node_names),
         wall_ms=(_time.perf_counter() - t0) * 1e3, fallback=fallback,
     )
+    # cluster-wide packing SLI rides the sweep's provenance (and the
+    # karpenter_cluster_packing_efficiency gauge): every screen answer
+    # names how packed the cluster it judged actually was
+    try:
+        from ..obs.quality import cluster_packing
+
+        eff = cluster_packing(ct)
+        if eff:
+            rec.quality["packing_efficiency"] = eff
+    except Exception:
+        pass
     return out
 
 

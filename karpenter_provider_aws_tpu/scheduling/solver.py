@@ -1653,4 +1653,10 @@ def _solve_multi_nodepool(
             "unschedulable": len(result.unschedulable),
         },
     )
+    # answer-quality stamp (packing efficiency, unschedulable rate,
+    # fallback) on the SAME provenance record every consumer reads —
+    # cheap O(specs + pods), exception-safe inside solve_quality
+    from ..obs.quality import solve_quality
+
+    solve_quality(result, catalog)
     return result

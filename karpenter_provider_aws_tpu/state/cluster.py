@@ -93,6 +93,11 @@ class Cluster:
     def __init__(self, clock=None):
         self.clock = clock
         self._lock = threading.RLock()
+        # Lifecycle observer (obs/sli.py LifecycleSLI): the sanctioned
+        # mutation surface notifies it of pod/claim transitions. Preserved
+        # across Environment.reset (which re-runs __init__ on the same
+        # object) — the obs bundle outlives a store wipe and resets itself.
+        self.observer = getattr(self, "observer", None)
         self.nodepools: dict[str, NodePool] = {}
         self.nodeclasses: dict[str, NodeClass] = {}
         self.nodeclaims: dict[str, NodeClaim] = {}
@@ -196,6 +201,8 @@ class Cluster:
                 self.claims_seq += 1
                 self._index_claim(obj)
                 self._record("claim", obj.name)
+                if self.observer is not None:
+                    self.observer.claim_applied(obj, now=self._now())
             elif isinstance(obj, Node):
                 self.nodes[obj.name] = obj
                 self._record("node", obj.name)
@@ -208,6 +215,8 @@ class Cluster:
                         self._record("pod", prev.node_name)
                 self._index_pod(obj)
                 self._record("pod", obj.node_name or "")
+                if self.observer is not None:
+                    self.observer.pod_applied(obj, now=self._now())
             elif isinstance(obj, PodDisruptionBudget):
                 self.pdbs[obj.name] = obj
                 self._record("pdb", obj.name)
@@ -237,6 +246,8 @@ class Cluster:
                     self.nodeclaims.pop(obj.name, None)
                     self.claims_seq += 1
                     self._unindex_claim(obj)
+                    if self.observer is not None:
+                        self.observer.claim_gone(obj.name)
                 self._record("claim", obj.name)
             elif isinstance(obj, Node):
                 self.nodes.pop(obj.name, None)
@@ -250,6 +261,8 @@ class Cluster:
                 self._record("pod", obj.node_name or "")
                 if stored is not None and stored.node_name != obj.node_name:
                     self._record("pod", stored.node_name or "")
+                if self.observer is not None:
+                    self.observer.pod_deleted(obj.uid)
             elif isinstance(obj, PodDisruptionBudget):
                 self.pdbs.pop(obj.name, None)
                 self._record("pdb", obj.name)
@@ -265,6 +278,8 @@ class Cluster:
                 self.claims_seq += 1
                 self._unindex_claim(obj)
                 self._record("claim", obj.name)
+                if self.observer is not None:
+                    self.observer.claim_gone(obj.name)
             elif isinstance(obj, NodeClass):
                 self.nodeclasses.pop(obj.name, None)
                 self._record("nodeclass", obj.name)
@@ -330,6 +345,12 @@ class Cluster:
             self._record("pod", node_name)
             if old and old != node_name:
                 self._record("pod", old)
+            if self.observer is not None:
+                # bind time in the caller's clock base (controllers pass
+                # clock.now()); falls back to store time when unstamped
+                self.observer.pod_bound(
+                    pod_uid, node_name, now=now if now else self._now()
+                )
 
     def unbind_pod(self, pod_uid: str) -> None:
         """Release a pod back to Pending (the drain/evict path). The inverse
@@ -348,6 +369,8 @@ class Cluster:
             pod.phase = "Pending"
             self._index_pod(pod)
             self._record("pod", old or "")
+            if self.observer is not None:
+                self.observer.pod_unbound(pod_uid, old or "", now=self._now())
 
     def note_pod_update(self, pod: Pod) -> None:
         """Journal an in-place/field mutation of a stored pod (labels,

@@ -63,6 +63,9 @@ class Environment:
     # env-local event sink on the env's FakeClock (controllers publish here;
     # two environments in one process never share or wipe each other's)
     events: "EventRecorder" = None
+    # env-local observability bundle (obs/): audit ring, SLO engine,
+    # lifecycle SLI observer — installed on this env's cluster
+    obs: "object" = None
 
     def close(self) -> None:
         """Join the cloud provider's batcher worker pools. Environments are
@@ -73,6 +76,7 @@ class Environment:
     def reset(self) -> None:
         self.cloud.reset()
         self.events.reset()
+        self.obs.reset()
         self.queue.reset()
         self.cluster.__init__(clock=self.clock)
         self.catalog.unavailable.flush()
@@ -82,6 +86,7 @@ class Environment:
         self.provisioning.last_unschedulable.clear()
         self.disruption.disrupted.clear()
         self.disruption._consol_seen.clear()
+        self.disruption._reject_logged.clear()
         self.interruption.handled.clear()
         self.garbagecollection.reaped.clear()
         self.liveness.reaped.clear()
@@ -119,8 +124,13 @@ def new_environment(solver: Optional[Solver] = None, use_tpu_solver: bool = True
     )
     solver = solver or (TPUSolver() if use_tpu_solver else HostSolver())
     recorder = EventRecorder(clock=clock)
+    # env-local observability bundle: lifecycle observer on THIS cluster,
+    # SLO engine + audit ring on THIS clock/recorder (obs/)
+    from . import obs as obs_mod
+
+    obs_bundle = obs_mod.install(cluster=cluster, recorder=recorder, clock=clock)
     provisioning = ProvisioningController(cluster, solver, cloudprovider,
-                                          recorder=recorder)
+                                          recorder=recorder, obs=obs_bundle)
     scheduling = SchedulingController(cluster, provisioning, clock=clock)
     registration = RegistrationController(cluster, provisioning, clock=clock)
     termination = TerminationController(cluster, cloudprovider, clock=clock)
@@ -128,11 +138,12 @@ def new_environment(solver: Optional[Solver] = None, use_tpu_solver: bool = True
     # window's own behavior is tested explicitly in test_disruption
     disruption = DisruptionController(cluster, cloudprovider, clock=clock,
                                       provisioning=provisioning, recorder=recorder,
-                                      validation_period_s=0.0)
+                                      validation_period_s=0.0, obs=obs_bundle)
     interruption = InterruptionController(cluster, cloudprovider, queue,
-                                          recorder=recorder)
+                                          recorder=recorder, obs=obs_bundle)
     gc = GarbageCollectionController(cluster, cloudprovider, clock=clock)
-    liveness = LivenessController(cluster, clock=clock, recorder=recorder)
+    liveness = LivenessController(cluster, clock=clock, recorder=recorder,
+                                  obs=obs_bundle)
     tagging = TaggingController(cluster, cloudprovider)
     nc_hash = NodeClassHashController(cluster)
     nc_status = NodeClassStatusController(cluster, cloudprovider)
@@ -175,4 +186,5 @@ def new_environment(solver: Optional[Solver] = None, use_tpu_solver: bool = True
         nodeclass_termination=nc_term,
         manager=manager,
         events=recorder,
+        obs=obs_bundle,
     )

@@ -94,6 +94,11 @@ class ProvenanceRecord:
     # ambient context stamped at record() time (e.g. the chaos harness's
     # scenario/seed/active-fault set); empty outside special regimes
     context: dict = field(default_factory=dict)
+    # answer-quality telemetry stamped by the obs/ subsystem: packing
+    # efficiency per resource, cost-vs-oracle gap, unschedulable rate —
+    # so a latency number can never again be silent about whether the
+    # fast answer was also a good one
+    quality: dict = field(default_factory=dict)
 
     def as_dict(self) -> dict:
         d = {
@@ -113,6 +118,8 @@ class ProvenanceRecord:
         }
         if self.context:
             d["context"] = dict(self.context)
+        if self.quality:
+            d["quality"] = dict(self.quality)
         return d
 
     def label(self) -> str:

@@ -50,6 +50,57 @@ class TestRecorder:
         assert len(r.events()) == 10
 
 
+class TestQueryAccessor:
+    def test_query_filters_match_events(self):
+        r = EventRecorder(clock=FakeClock())
+        r.publish("NodeClaim", "c1", "Launched", "m5.large")
+        r.publish("NodeClaim", "c1", "Disrupted", "empty")
+        r.publish("Pod", "p1", "FailedScheduling", "no fit", type=WARNING)
+        assert len(r.query(kind="NodeClaim", name="c1")) == 2
+        assert r.query(kind="NodeClaim", name="c1", reason="Launched")[0].message == "m5.large"
+        assert r.query(kind="Pod") == r.events(kind="Pod")
+        assert r.query(name="nope") == []
+
+
+class TestIdleSweep:
+    def test_sweep_drops_expired_entries_without_new_events(self):
+        clock = FakeClock()
+        r = EventRecorder(clock=clock, dedupe_ttl_s=60)
+        for i in range(50):
+            r.publish("NodeClaim", f"c{i}", "Launched", "x")
+        assert len(r._last) == 50
+        clock.advance(61)
+        # NO new publish: the idle sweep alone must reclaim the map
+        dropped = r.sweep()
+        assert dropped == 50
+        assert len(r._last) == 0
+        # the ring is untouched — history survives dedupe-map hygiene
+        assert len(r.events()) == 50
+
+    def test_sweep_preserves_dedupe_counts_on_ring_events(self):
+        clock = FakeClock()
+        r = EventRecorder(clock=clock, dedupe_ttl_s=60)
+        r.publish("Pod", "p1", "FailedScheduling", "no fit")
+        for _ in range(4):
+            r.publish("Pod", "p1", "FailedScheduling", "no fit")
+        clock.advance(61)
+        r.sweep()
+        # the repeat count was written back before the entry was dropped
+        assert r.events(name="p1")[0].count == 5
+
+    def test_sweep_keeps_fresh_entries(self):
+        clock = FakeClock()
+        r = EventRecorder(clock=clock, dedupe_ttl_s=60)
+        r.publish("Pod", "old", "R", "m")
+        clock.advance(40)
+        r.publish("Pod", "new", "R", "m")
+        clock.advance(30)  # old is 70s stale, new is 30s
+        assert r.sweep() == 1
+        assert ("Pod", "new", "R", "m") in r._last
+        # still deduping inside the fresh entry's window
+        assert not r.publish("Pod", "new", "R", "m")
+
+
 class TestControllerEvents:
     def test_launch_publishes(self, env):
         env.apply_defaults(
