@@ -150,12 +150,48 @@ def bench_controller_pass(n_nodes=5000) -> dict:
     }
 
 
+def bench_breaker_overhead(iters: int = 50000) -> dict:
+    """Resilience micro-bench: the warm no-fault breaker check the solver
+    dispatch pays on EVERY solve (registry lookup + available() peek +
+    allow() + record_success()). The ISSUE 5 acceptance bound is < 0.1 ms
+    per check; measured cost is a few lock acquisitions (~1 us)."""
+    from karpenter_provider_aws_tpu.resilience import breakers
+
+    br = breakers.get("bench.overhead")
+    # warm the path once, then measure
+    breakers.get("bench.overhead").available()
+    br.allow()
+    br.record_success()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        breakers.get("bench.overhead").available()
+        br.allow()
+        br.record_success()
+    per_check_ms = (time.perf_counter() - t0) * 1e3 / iters
+    budget_ms = 0.1
+    row = {
+        "benchmark": "breaker_check_overhead",
+        "iters": iters,
+        "breaker_check_ms": round(per_check_ms, 6),
+        "budget_ms": budget_ms,
+        "within_budget": per_check_ms < budget_ms,
+        "device": "host",
+        "note": "warm closed-breaker check on the solver dispatch path",
+    }
+    assert per_check_ms < budget_ms, (
+        f"breaker check {per_check_ms:.4f} ms exceeds the "
+        f"{budget_ms} ms acceptance budget"
+    )
+    return row
+
+
 def run_all(scale: float = 1.0, on_row=None) -> list[dict]:
     rows = []
     n = max(int(5000 * scale), 200)
     for fn, kwargs in (
         (bench_incremental_encode, {"n_nodes": n}),
         (bench_controller_pass, {"n_nodes": n}),
+        (bench_breaker_overhead, {}),
     ):
         row = fn(**kwargs)
         rows.append(row)

@@ -39,6 +39,7 @@ byte-identical, prints the invariant report).
 from .faults import (
     ConnectionDrop,
     CredentialExpiry,
+    DeviceLost,
     EventualConsistencyLag,
     Fault,
     FAULT_KINDS,
@@ -69,6 +70,7 @@ __all__ = [
     "ChaosTransport",
     "ConnectionDrop",
     "CredentialExpiry",
+    "DeviceLost",
     "EventualConsistencyLag",
     "FAULT_KINDS",
     "Fault",

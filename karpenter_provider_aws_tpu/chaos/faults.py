@@ -358,6 +358,67 @@ class InstanceVanish(Fault):
 
 
 @dataclass
+class DeviceLost(Fault):
+    """Device-runtime loss at the solver dispatch seam: while active,
+    every FFD dispatch whose backend matches ``backends`` raises
+    ``DeviceLostError`` (via ``resilience.faultgate``) — the shape of a
+    Mosaic lowering gap, a wedged TPU tunnel, or a killed sidecar. The
+    resilience layer must absorb it: circuit breakers open after the
+    failure threshold, provisioning degrades to the pure-host FFD path,
+    and pods keep binding (``solver-brownout`` is the canned proof).
+
+    Deterministic like its peers: probability draws come from the
+    harness's seeded cloud RNG, every raise is recorded into the
+    ``ChaosLog`` (part of the byte-identical signature), and the hook is
+    removed at window end."""
+
+    kind = "DeviceLost"
+
+    backends: tuple = ("*",)   # fnmatch globs over the dispatching backend
+
+    def on_activate(self, harness) -> None:
+        from ..resilience import faultgate
+
+        fault = self
+
+        def hook(backend: str) -> None:
+            if not any(
+                fnmatch.fnmatchcase(backend, g) for g in fault.backends
+            ):
+                return
+            if not fault.should_fire(harness.cloud_rng):
+                return
+            fault.fires += 1
+            harness.log.record(
+                t=harness.env.clock.now(), kind=fault.kind,
+                service="solver", action=backend, detail=fault.describe(),
+            )
+            try:
+                from ..metrics import CHAOS_FAULTS_INJECTED
+
+                CHAOS_FAULTS_INJECTED.inc(kind=fault.kind)
+            except Exception:
+                pass
+            raise faultgate.DeviceLostError(
+                f"chaos: device lost during {backend} dispatch"
+            )
+
+        self._hook = hook
+        faultgate.install(hook)
+        harness.record_cloud_fault(
+            self, f"backends={','.join(self.backends)}"
+        )
+
+    def on_deactivate(self, harness) -> None:
+        from ..resilience import faultgate
+
+        hook = getattr(self, "_hook", None)
+        if hook is not None:
+            faultgate.remove(hook)
+            self._hook = None
+
+
+@dataclass
 class EventualConsistencyLag(Fault):
     """DescribeInstances/ListInstances lag: instances launched within the
     last ``lag_s`` (virtual) seconds are invisible to reads — the classic
@@ -384,7 +445,7 @@ FAULT_KINDS: dict[str, type] = {
     for cls in (
         Throttle, ServerError, ConnectionDrop, InjectedLatency,
         CredentialExpiry, Ice, SpotInterrupt, InstanceVanish,
-        EventualConsistencyLag,
+        DeviceLost, EventualConsistencyLag,
     )
 }
 

@@ -55,6 +55,12 @@ from .transport import ChaosLog, ChaosTransport, StubAwsTransport
 SETTLE_ADVANCE_S = 5.0
 
 
+def _process_breakers():
+    from ..resilience import breakers
+
+    return breakers
+
+
 @dataclass
 class ChaosReport:
     """The machine-checkable outcome of one scenario run."""
@@ -121,7 +127,11 @@ class ChaosHarness:
         # one Scenario object across harnesses would break determinism
         self.scenario = Scenario.from_dict(sc.to_dict())
         self.seed = int(seed)
-        self.env = new_environment(use_tpu_solver=use_tpu_solver)
+        # the scenario may demand the device solver (DeviceLost/breaker
+        # scenarios are meaningless against the host solver)
+        self.env = new_environment(
+            use_tpu_solver=use_tpu_solver or self.scenario.solver == "tpu"
+        )
         self.log = ChaosLog()
         # three independent deterministic streams: interleaving wire draws
         # with cloud sampling (or jitter) must not shift either sequence
@@ -141,6 +151,10 @@ class ChaosHarness:
             sleep=lambda s: None,  # backoff time is virtual; don't stall tests
             now_amz=lambda: "20260804T000000Z",
             rand=random.Random(f"{self.seed}:jitter").random,
+            # the process breaker registry: new_environment just re-keyed
+            # it onto THIS env's FakeClock, so aws.* breaker decisions are
+            # clock-deterministic and land on /debug/health with the rest
+            breakers=_process_breakers(),
         )
         self._ec2 = Ec2Client(self.session)
         # audit + report state

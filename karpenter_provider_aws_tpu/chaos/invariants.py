@@ -21,6 +21,9 @@ The list (designs/fault-injection.md):
                             faults cleared and the TTL elapsed
 - ``queue-drained``         the interruption queue is empty (no poison
                             message redelivered forever)
+- ``breakers-recovered``    no circuit breaker is wedged open once the
+                            settle phase ends (closed, or at least ready
+                            to admit a half-open probe)
 - ``controllers-healthy``   no controller reconcile raised during the
                             whole run (faults must surface as behavior,
                             never as crashes)
@@ -115,6 +118,28 @@ def check_queue_drained(harness) -> InvariantResult:
     )
 
 
+def check_breakers_recovered(harness) -> InvariantResult:
+    """After faults clear and the settle budget runs, no circuit breaker
+    may be WEDGED open: every registered breaker is either closed (a
+    post-recovery probe succeeded) or at least ready to admit one (its
+    recovery window has elapsed — ``available()``); a breaker that is
+    open with an unexpired window after the whole settle phase means the
+    recovery machinery itself is broken."""
+    from ..resilience import breakers
+
+    snap = breakers.snapshot()
+    stuck = {
+        name: state["state"]
+        for name, state in snap.items()
+        if state["state"] != "closed" and not breakers.get(name).available()
+    }
+    return _result(
+        "breakers-recovered", not stuck,
+        (f"wedged open after settle: {stuck}" if stuck
+         else f"{len(snap)} breakers closed or probe-ready"),
+    )
+
+
 def check_controllers_healthy(harness) -> InvariantResult:
     errors = harness.env.manager.errors[harness.errors_baseline:]
     return _result(
@@ -131,6 +156,7 @@ INVARIANTS = (
     check_no_leaked_instances,
     check_ice_mask_expired,
     check_queue_drained,
+    check_breakers_recovered,
     check_controllers_healthy,
 )
 

@@ -102,6 +102,9 @@ class Scenario:
     settle_reconciles: int = 60
     # build the harness Session with an assume-role chain (sts scenarios)
     assume_role: bool = False
+    # which Solver the environment runs: "host" (default, fast) or "tpu"
+    # (the device path — what DeviceLost/breaker scenarios exercise)
+    solver: str = "host"
     capacity_types: tuple = ()            # () = pool default (any)
     categories: tuple = ("c", "m", "r")
     workloads: list[Workload] = field(default_factory=list)
@@ -119,6 +122,8 @@ class Scenario:
         }
         if self.assume_role:
             d["assume_role"] = True
+        if self.solver != "host":
+            d["solver"] = self.solver
         pool: dict = {}
         if self.capacity_types:
             pool["capacity_types"] = list(self.capacity_types)
@@ -141,6 +146,7 @@ class Scenario:
             step_s=float(d.get("step_s", 1.0)),
             settle_reconciles=int(d.get("settle_reconciles", 60)),
             assume_role=bool(d.get("assume_role", False)),
+            solver=str(d.get("solver", "host")),
             capacity_types=tuple(pool.get("capacity_types", ())),
             categories=tuple(pool.get("categories", ("c", "m", "r"))),
             workloads=[Workload.from_dict(w) for w in d.get("workloads", [])],

@@ -124,6 +124,7 @@ class ProvisioningController:
         self.last_unschedulable = result.unschedulable
         obs = self._obs()
         self._audit_solve(result, obs.audit, rev0)
+        self._audit_degraded(result, obs.audit, rev0, len(pending))
         # one SLI event per solve pass: good iff every pod was placed
         obs.slo.record(
             "solve-success", good=not result.unschedulable,
@@ -209,6 +210,32 @@ class ProvisioningController:
                 "placement", "Pod", pod.name, "unschedulable",
                 {"reason": reason, "provenance": prov}, at=now, rev=rev,
             )
+
+    def _audit_degraded(self, result, audit, rev, num_pods: int) -> None:
+        """One audit record + Warning event per solve served in degraded
+        mode (device breakers open / device failure -> pure-host FFD), so
+        ``obs explain`` and the decision log say WHY placements suddenly
+        carry a host backend (designs/circuit-breakers.md)."""
+        prov = result.provenance
+        if prov is None or not prov.backend.endswith("(degraded)"):
+            return
+        from ..events import WARNING
+
+        audit.record(
+            "resilience", "Solver", "provisioning", "degraded:host-ffd",
+            {
+                "fallback": prov.fallback,
+                "backend": prov.backend,
+                "pods": num_pods,
+                "node_specs": len(result.node_specs),
+            },
+            at=self.clock.now(), rev=rev,
+        )
+        self.recorder.publish(
+            "Solver", "provisioning", "DegradedProvisioning",
+            f"device solver unavailable ({prov.fallback or 'device failure'}); "
+            f"{num_pods} pods served via the host FFD path", type=WARNING,
+        )
 
     def _note_nominated(self, uid: str) -> None:
         observer = getattr(self.cluster, "observer", None)

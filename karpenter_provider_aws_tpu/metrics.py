@@ -398,6 +398,30 @@ AUDIT_RECORDS = REGISTRY.counter(
     "Decision audit records appended, by kind (placement / disruption / "
     "interruption / eviction / lifecycle — obs/audit.py)",
 )
+# -- resilience/ subsystem: circuit breakers, crash-loop supervision ------
+CIRCUIT_STATE = REGISTRY.gauge(
+    "karpenter_circuit_state",
+    "Circuit-breaker state per dependency (0 = closed, 1 = half-open, "
+    "2 = open); keyed instances guard each solver backend "
+    "(solver.pallas / solver.xla-scan / solver.mesh / solver.sidecar) "
+    "and each AWS service (aws.<service>) — resilience/breaker.py",
+)
+CIRCUIT_TRANSITIONS = REGISTRY.counter(
+    "karpenter_circuit_transitions_total",
+    "Circuit-breaker state transitions by breaker name and target state "
+    "(to = closed / half-open / open)",
+)
+CONTROLLER_STUCK = REGISTRY.gauge(
+    "karpenter_controller_stuck",
+    "1 while a controller's in-flight reconcile has exceeded N x its "
+    "interval (the Manager watchdog; a Warning event fires on the edge), "
+    "else 0",
+)
+CRASHLOOP_BACKOFFS = REGISTRY.counter(
+    "karpenter_controller_crashloop_backoff_total",
+    "Crash-loop backoffs armed by consecutive reconcile failures, per "
+    "controller (reset on the first successful reconcile)",
+)
 
 # Catalog gauges (parity: instancetype metrics.go:32-75 — vCPU/memory per
 # type, offering price/availability per (type, zone, capacity type)).

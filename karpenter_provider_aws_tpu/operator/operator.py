@@ -160,9 +160,14 @@ def new_operator(
             # user-agent — behind the CloudBackend Protocol
             from ..providers.aws import AwsCloudBackend, Session
 
+            from ..resilience import breakers as _breakers
+
             session = Session(
                 region=options.aws_region,
                 assume_role_arn=options.assume_role_arn,
+                # the process registry: per-service aws.* breakers show
+                # up on /debug/health next to the solver breakers
+                breakers=_breakers,
             )
             cloud = AwsCloudBackend(session, cluster_name=options.cluster_name)
             if queue is None and options.interruption_queue:
@@ -353,6 +358,7 @@ def new_operator(
         cluster=cluster,
         catalog=catalog,
         cloudprovider=cloudprovider,
-        manager=Manager(controllers, elector=elector),
+        manager=Manager(controllers, elector=elector, clock=clock,
+                        recorder=recorder),
         version_provider=version_provider,
     )

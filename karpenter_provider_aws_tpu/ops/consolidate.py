@@ -567,6 +567,8 @@ def _screen(ct: ClusterTensors, chunk: int) -> tuple[np.ndarray, str, str]:
     backend that ran, fallback reason or ""). Split out so the wrapper can
     stamp provenance for every exit path without touching the dispatch
     logic."""
+    from ..resilience import breakers as _rbreakers
+
     N = len(ct.node_names)
     fallback = ""
     out = np.zeros(N, dtype=bool)
@@ -578,38 +580,68 @@ def _screen(ct: ClusterTensors, chunk: int) -> tuple[np.ndarray, str, str]:
     if backend == "pallas":
         from .repack_pallas import repack_check_pallas
 
-        cand = np.arange(N, dtype=np.int32)
-        try:
-            out[:] = repack_check_pallas(
-                ct.free, ct.requests, gids_s, gcounts_s,
-                screen_cap, cand,
-            )
-            out &= ~ct.blocked
-            return out, "pallas", fallback
-        except Exception as e:
-            import os
+        br = _rbreakers.get("solver.pallas")
+        if not br.allow():
+            # open breaker: the kernel failed repeatedly on recent sweeps
+            # — go straight to the vmap screen without re-paying the
+            # failure latency; the half-open probe re-admits the kernel
+            # after the recovery window
+            fallback = "breaker:solver.pallas"
+        else:
+            cand = np.arange(N, dtype=np.int32)
+            try:
+                out[:] = repack_check_pallas(
+                    ct.free, ct.requests, gids_s, gcounts_s,
+                    screen_cap, cand,
+                )
+                out &= ~ct.blocked
+                br.record_success()
+                return out, "pallas", fallback
+            except Exception as e:
+                import os
 
-            # only a REAL pin (a valid backend name) forfeits the
-            # fallback; "auto", unset, or a typo all keep it — the
-            # auto-selected case is exactly what the fallback protects
-            if os.environ.get("KARPENTER_TPU_REPACK") in (
-                "vmap", "pallas", "native", "mesh"
-            ):
-                raise  # explicitly pinned: fail loudly, don't mask
-            # auto-selected kernel hit a lowering/runtime gap: the
-            # disruption pass must not die for it — fall through to the
-            # vmap path, LOUDLY (same policy as the FFD auto-race)
-            import logging
+                br.record_failure(e)
+                # only a REAL pin (a valid backend name) forfeits the
+                # fallback; "auto", unset, or a typo all keep it — the
+                # auto-selected case is exactly what the fallback protects
+                if os.environ.get("KARPENTER_TPU_REPACK") in (
+                    "vmap", "pallas", "native", "mesh"
+                ):
+                    raise  # explicitly pinned: fail loudly, don't mask
+                # auto-selected kernel hit a lowering/runtime gap: the
+                # disruption pass must not die for it — fall through to the
+                # vmap path, LOUDLY (same policy as the FFD auto-race)
+                import logging
 
-            logging.getLogger("karpenter.tpu.consolidate").warning(
-                "pallas repack backend failed; using the vmap screen: "
-                "%s: %s", type(e).__name__, e,
-            )
-            fallback = f"{type(e).__name__}: {e}"[:200]
+                logging.getLogger("karpenter.tpu.consolidate").warning(
+                    "pallas repack backend failed; using the vmap screen: "
+                    "%s: %s", type(e).__name__, e,
+                )
+                fallback = f"{type(e).__name__}: {e}"[:200]
     if backend == "mesh":
         from ..parallel import make_mesh, screen_sharded
 
-        return screen_sharded(ct, make_mesh()), "mesh", fallback
+        br = _rbreakers.get("solver.mesh")
+        if not br.allow():
+            fallback = "breaker:solver.mesh"
+        else:
+            try:
+                res = screen_sharded(ct, make_mesh())
+                br.record_success()
+                return res, "mesh", fallback
+            except Exception as e:
+                import os
+
+                br.record_failure(e)
+                if os.environ.get("KARPENTER_TPU_REPACK") == "mesh":
+                    raise  # explicitly pinned: fail loudly, don't mask
+                import logging
+
+                logging.getLogger("karpenter.tpu.consolidate").warning(
+                    "mesh screen backend failed; using the vmap screen: "
+                    "%s: %s", type(e).__name__, e,
+                )
+                fallback = f"{type(e).__name__}: {e}"[:200]
     if backend == "native":
         from ..scheduling.native import repack_check_native
 

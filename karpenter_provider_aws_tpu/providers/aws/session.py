@@ -49,6 +49,20 @@ RETRYABLE_CODES = THROTTLE_CODES | frozenset({
 })
 # backoff cap (full-jitter upper bound AND the Retry-After clamp)
 RETRY_DELAY_CAP_S = 5.0
+# hard wall cap per LOGICAL call: retries + Retry-After sleeps together
+# must never exceed this (a hostile header or a long throttle storm must
+# not stall a reconcile for minutes). Distinct from the per-attempt clamp
+# above; surfaced as retry_reason="budget" when it stops the ladder.
+REQUEST_DEADLINE_DEFAULT_S = 60.0
+
+
+def _request_deadline_s() -> float:
+    try:
+        return float(os.environ.get(
+            "KARPENTER_TPU_REQUEST_DEADLINE_S", "",
+        ) or REQUEST_DEADLINE_DEFAULT_S)
+    except ValueError:
+        return REQUEST_DEADLINE_DEFAULT_S
 
 
 def _retry_reason(e: AwsApiError) -> str:
@@ -182,6 +196,7 @@ class Session:
         sleep: Callable[[float], None] = time.sleep,
         now_amz: Callable[[], str] = _now_amz,
         rand: Callable[[], float] = None,
+        breakers=None,
     ):
         self.region = region or os.environ.get(
             "AWS_REGION", os.environ.get("AWS_DEFAULT_REGION", "")
@@ -201,6 +216,18 @@ class Session:
         import random
 
         self._rand = rand or random.random
+        # per-service circuit breakers (aws.ec2, aws.sqs, ...): a service
+        # whose logical calls fail repeatedly — ladders exhausted — is
+        # refused instantly until its recovery window passes, instead of
+        # paying the full retry ladder on every reconcile. Private
+        # registry by default (each Session owns its failure memory); the
+        # operator and the chaos harness pass the process registry so
+        # breaker state shows on /debug/health and under the FakeClock.
+        if breakers is None:
+            from ...resilience.breaker import BreakerRegistry
+
+            breakers = BreakerRegistry()
+        self._breakers = breakers
 
     # -- credentials -------------------------------------------------------
 
@@ -313,46 +340,119 @@ class Session:
         backoff on retryable codes and 5xx. The whole call (retries and
         backoff sleeps included) is one flight-recorder span carrying the
         retry count — so a reconcile stall traces straight to the throttled
-        AWS action, and /metrics gets per-service latency + retry totals."""
+        AWS action, and /metrics gets per-service latency + retry totals.
+
+        Two resilience bounds on top of the SDK ladder:
+        - a hard deadline per logical call (KARPENTER_TPU_REQUEST_DEADLINE_S,
+          default 60 s) on the SUM of backoff sleeps — a hostile Retry-After
+          stream cannot stall the caller indefinitely — plus the ambient
+          per-reconcile budget when one is in scope; both stop the ladder
+          with retry_reason="budget";
+        - a per-service circuit breaker: after consecutive exhausted
+          ladders the service is refused instantly (AwsApiError 503
+          CircuitOpen) until its recovery window passes. Definitive 4xx
+          answers (EntityAlreadyExists, NotFound, ...) are the service
+          WORKING and count as breaker successes — idempotent callers
+          use them as normal control flow.
+        """
+        breaker = self._breakers.get(f"aws.{service}")
+        if not breaker.allow():
+            raise AwsApiError(
+                503, "CircuitOpen",
+                f"circuit breaker aws.{service} is open "
+                f"({breaker.last_error or 'recent failures'})",
+            )
         # prime the credential chain BEFORE the span: an assume-role
         # refresh is a full STS round trip and must not be attributed to
         # the wrapped service's latency histogram (nor report its
         # CredentialError as this service's span error)
-        self.credentials()
+        try:
+            self.credentials()
+        except Exception:
+            # a credential failure is not the wrapped service's fault —
+            # hand back the (possibly half-open) probe without a verdict
+            breaker.release()
+            raise
         with trace_span(f"aws.{service}", action=self._span_action(kw)) as sp:
-            attempt = 0
-            while True:
-                try:
-                    resp = self._do(
-                        service, endpoint, creds=self.credentials(), **kw
-                    )
-                    sp.set(retries=attempt, status=resp.status)
-                    return resp
-                except AwsApiError as e:
-                    retryable = e.code in RETRYABLE_CODES or e.status >= 500
-                    if not retryable or attempt >= MAX_RETRIES:
-                        sp.set(retries=attempt, error_code=e.code)
-                        raise
-                    reason = _retry_reason(e)
-                    sp.set(retry_reason=reason)
-                    from ...metrics import AWS_REQUEST_RETRY_REASONS
+            try:
+                return self._ladder(
+                    service, endpoint, kw, sp, breaker,
+                    deadline=_request_deadline_s(),
+                )
+            except AwsApiError:
+                raise  # the ladder already gave the breaker its verdict
+            except BaseException:
+                # anything else (CredentialError mid-ladder, transport
+                # bugs) is not the wrapped service's fault: hand back a
+                # possibly-held half-open probe so the breaker can't
+                # wedge with _probe_inflight stuck True
+                breaker.release()
+                raise
 
-                    AWS_REQUEST_RETRY_REASONS.inc(
-                        service=service, reason=reason
+    def _ladder(self, service, endpoint, kw, sp, breaker, deadline):
+        from ...metrics import AWS_REQUEST_RETRY_REASONS
+        from ...resilience import budget as _budget
+
+        slept = 0.0
+        attempt = 0
+        while True:
+            try:
+                resp = self._do(
+                    service, endpoint, creds=self.credentials(), **kw
+                )
+                sp.set(retries=attempt, status=resp.status)
+                breaker.record_success()
+                return resp
+            except AwsApiError as e:
+                retryable = e.code in RETRYABLE_CODES or e.status >= 500
+                if not retryable:
+                    # a definitive 4xx means the service ANSWERED —
+                    # idempotent callers treat codes like
+                    # EntityAlreadyExists / NotFound as normal control
+                    # flow, so this must never count against the
+                    # breaker (it closes a half-open probe instead)
+                    sp.set(retries=attempt, error_code=e.code)
+                    breaker.record_success()
+                    raise
+                if attempt >= MAX_RETRIES:
+                    sp.set(retries=attempt, error_code=e.code)
+                    breaker.record_failure(e)
+                    raise
+                reason = _retry_reason(e)
+                if e.retry_after is not None and e.retry_after > 0:
+                    # the server said when to come back; honor it
+                    # (clamped to the backoff cap — a hostile header
+                    # must not stall a reconcile for minutes)
+                    delay = min(RETRY_DELAY_CAP_S, e.retry_after)
+                else:
+                    # full-jitter: U(0, min(cap, base * 2^attempt));
+                    # SDK base 30ms scale for throttles
+                    delay = self._rand() * min(
+                        RETRY_DELAY_CAP_S, 0.03 * (2 ** attempt) * 10
                     )
-                    if e.retry_after is not None and e.retry_after > 0:
-                        # the server said when to come back; honor it
-                        # (clamped to the backoff cap — a hostile header
-                        # must not stall a reconcile for minutes)
-                        delay = min(RETRY_DELAY_CAP_S, e.retry_after)
-                    else:
-                        # full-jitter: U(0, min(cap, base * 2^attempt));
-                        # SDK base 30ms scale for throttles
-                        delay = self._rand() * min(
-                            RETRY_DELAY_CAP_S, 0.03 * (2 ** attempt) * 10
-                        )
-                    self._sleep(delay)
-                    attempt += 1
+                # deadline check BEFORE sleeping: the remaining wall
+                # is the per-call cap minus sleeps already taken,
+                # further shrunk by the ambient reconcile budget
+                remaining = deadline - slept
+                ambient = _budget.remaining()
+                if ambient is not None:
+                    remaining = min(remaining, ambient)
+                if delay >= remaining:
+                    sp.set(retries=attempt, retry_reason="budget",
+                           error_code=e.code)
+                    AWS_REQUEST_RETRY_REASONS.inc(
+                        service=service, reason="budget"
+                    )
+                    breaker.record_failure(e)
+                    raise
+                sp.set(retry_reason=reason)
+                AWS_REQUEST_RETRY_REASONS.inc(
+                    service=service, reason=reason
+                )
+                self._sleep(delay)
+                slept += delay
+                _budget.charge(delay)
+                attempt += 1
 
     @staticmethod
     def _signing_region(service: str, endpoint: str, default: str) -> str:

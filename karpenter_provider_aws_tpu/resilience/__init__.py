@@ -1,0 +1,48 @@
+"""Resilience layer: circuit breakers, deadline budgets, fault seam.
+
+Three primitives threaded through the seams where the control plane
+meets unreliable dependencies (``designs/circuit-breakers.md``):
+
+- ``breaker``   — keyed closed/open/half-open ``CircuitBreaker`` per
+  solver backend and AWS service; an open breaker is skipped instantly
+  instead of re-paying the failure latency every pass, and stamps
+  ``fallback="breaker:<name>"`` into solve provenance.
+- ``budget``    — per-reconcile deadline budgets propagated ambiently
+  into solver RPC timeouts and the AWS retry ladder.
+- ``faultgate`` — the solver-dispatch fault seam the chaos ``DeviceLost``
+  primitive raises through.
+
+The capstone behavior: when every device backend's breaker is open,
+provisioning degrades to the pure-host FFD path (pods keep binding) with
+degraded provenance + an audit record — ``chaos/scenarios/
+solver-brownout.json`` proves the full open -> half-open -> closed cycle
+end to end.
+"""
+
+from . import budget, faultgate
+from .breaker import (
+    BreakerOpen,
+    BreakerRegistry,
+    CircuitBreaker,
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    breakers,
+)
+from .faultgate import DeviceLostError
+
+__all__ = [
+    "Budget",
+    "BreakerOpen",
+    "BreakerRegistry",
+    "CircuitBreaker",
+    "CLOSED",
+    "DeviceLostError",
+    "HALF_OPEN",
+    "OPEN",
+    "breakers",
+    "budget",
+    "faultgate",
+]
+
+Budget = budget.Budget

@@ -1,0 +1,274 @@
+"""Circuit breakers: bounded memory for failing dependencies.
+
+The reference control plane survives brownouts because the SDK bounds
+every retry ladder and controller-runtime requeues failing reconciles;
+what neither gives you is MEMORY — a broken dependency (a Pallas kernel
+hitting a Mosaic gap, a wedged sidecar, a throttling AWS service) is
+re-attempted at full failure latency on every pass. A ``CircuitBreaker``
+closes that hole with the classic three-state machine:
+
+- ``closed``    — traffic flows; consecutive failures are counted and
+                  reset on any success.
+- ``open``      — after ``failure_threshold`` consecutive failures the
+                  breaker trips: callers are refused instantly (no
+                  failure latency paid) until ``recovery_s`` has elapsed
+                  on the injected clock.
+- ``half-open`` — after the recovery window ONE probe call is admitted;
+                  its outcome decides: success -> closed, failure ->
+                  open again (with a fresh recovery window). Concurrent
+                  callers during the probe are refused — the single-probe
+                  token is handed out under the lock.
+
+Determinism contract: time comes from the injectable clock (FakeClock-
+compatible), state changes happen only on ``allow`` / ``record_*`` calls
+— never on a background thread — so chaos runs stepping virtual time get
+byte-identical transition sequences per seed.
+
+Every breaker exports its state to ``karpenter_circuit_state{name}``
+(0 = closed, 1 = half-open, 2 = open) and each transition to
+``karpenter_circuit_transitions_total{name,to}``. Keyed instances live
+in a ``BreakerRegistry``; the process-wide default (``resilience.
+breakers``) is re-pointed at each hermetic environment's clock by
+``testenv.new_environment`` so breaker state can never leak stale wall
+time into a virtual-clock run.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, Optional
+
+from ..utils.clock import Clock, RealClock
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half-open"
+
+# gauge encoding: ordered by "how broken" so dashboards can max() over it
+STATE_VALUE = {CLOSED: 0.0, HALF_OPEN: 1.0, OPEN: 2.0}
+
+DEFAULT_FAILURE_THRESHOLD = 3
+DEFAULT_RECOVERY_S = 30.0
+
+
+class BreakerOpen(RuntimeError):
+    """Raised (or signalled) when a call is refused by an open breaker."""
+
+    def __init__(self, name: str):
+        super().__init__(f"circuit breaker {name!r} is open")
+        self.breaker_name = name
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+class CircuitBreaker:
+    """closed -> open -> half-open state machine with an injectable clock.
+
+    Integration contract: call ``allow()`` immediately before attempting
+    the dependency (it consumes the half-open probe token), then exactly
+    one of ``record_success()`` / ``record_failure()`` with the outcome.
+    ``available()`` is the non-consuming peek for routing decisions
+    ("would a call be admitted?") — it never changes state.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        clock: Optional[Clock] = None,
+        failure_threshold: Optional[int] = None,
+        recovery_s: Optional[float] = None,
+    ):
+        self.name = name
+        self._clock = clock or RealClock()
+        self.failure_threshold = failure_threshold or _env_int(
+            "KARPENTER_TPU_BREAKER_THRESHOLD", DEFAULT_FAILURE_THRESHOLD
+        )
+        self.recovery_s = (
+            recovery_s
+            if recovery_s is not None
+            else _env_float("KARPENTER_TPU_BREAKER_RECOVERY_S", DEFAULT_RECOVERY_S)
+        )
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._failures = 0
+        self._opened_at = 0.0
+        self._probe_inflight = False
+        self.last_error = ""
+        # bounded (t, to_state) history — what tests and /debug/health read
+        self.history: list[tuple[float, str]] = []
+        self._publish(CLOSED, transition=False)
+
+    # -- state machine -----------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def is_open(self) -> bool:
+        return self.state == OPEN
+
+    def available(self) -> bool:
+        """Would a call be admitted right now? Never mutates state (an
+        open breaker past its recovery window answers True — the actual
+        ``allow()`` performs the open -> half-open transition)."""
+        with self._lock:
+            if self._state == CLOSED:
+                return True
+            if self._state == OPEN:
+                return self._clock.now() - self._opened_at >= self.recovery_s
+            return not self._probe_inflight
+
+    def allow(self) -> bool:
+        """Admission check; consumes the single half-open probe token."""
+        with self._lock:
+            if self._state == CLOSED:
+                return True
+            if self._state == OPEN:
+                if self._clock.now() - self._opened_at < self.recovery_s:
+                    return False
+                self._transition(HALF_OPEN)
+                self._probe_inflight = True
+                return True
+            # HALF_OPEN: exactly one concurrent probe
+            if self._probe_inflight:
+                return False
+            self._probe_inflight = True
+            return True
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._probe_inflight = False
+            if self._state != CLOSED:
+                self._transition(CLOSED)
+
+    def release(self) -> None:
+        """Hand back an admitted probe without a verdict (the attempt
+        never reached the dependency — e.g. a credential failure before
+        the wire). State is unchanged; a half-open probe slot reopens."""
+        with self._lock:
+            self._probe_inflight = False
+
+    def record_failure(self, error: Optional[BaseException] = None) -> None:
+        with self._lock:
+            if error is not None:
+                self.last_error = f"{type(error).__name__}: {error}"[:200]
+            self._probe_inflight = False
+            if self._state == HALF_OPEN:
+                # failed probe: re-arm a fresh recovery window
+                self._opened_at = self._clock.now()
+                self._transition(OPEN)
+            elif self._state == CLOSED:
+                self._failures += 1
+                if self._failures >= self.failure_threshold:
+                    self._opened_at = self._clock.now()
+                    self._transition(OPEN)
+            else:
+                # failures reported while already open (e.g. a racing
+                # caller that was admitted just before the trip) refresh
+                # the recovery window
+                self._opened_at = self._clock.now()
+
+    def guard(self, fn: Callable, *args, **kwargs):
+        """Run ``fn`` through the breaker: raises ``BreakerOpen`` when
+        refused, records the outcome otherwise."""
+        if not self.allow():
+            raise BreakerOpen(self.name)
+        try:
+            out = fn(*args, **kwargs)
+        except Exception as e:
+            self.record_failure(e)
+            raise
+        self.record_success()
+        return out
+
+    # -- introspection -----------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "state": self._state,
+                "consecutive_failures": self._failures,
+                "failure_threshold": self.failure_threshold,
+                "recovery_s": self.recovery_s,
+                "opened_at": self._opened_at if self._state != CLOSED else None,
+                "last_error": self.last_error,
+                "transitions": len(self.history),
+            }
+
+    # -- internals ---------------------------------------------------------
+
+    def _transition(self, to: str) -> None:
+        # lock held by caller
+        self._state = to
+        self.history.append((self._clock.now(), to))
+        del self.history[:-64]
+        self._publish(to, transition=True)
+
+    def _publish(self, state: str, transition: bool) -> None:
+        try:
+            from ..metrics import CIRCUIT_STATE, CIRCUIT_TRANSITIONS
+
+            CIRCUIT_STATE.set(STATE_VALUE[state], name=self.name)
+            if transition:
+                CIRCUIT_TRANSITIONS.inc(name=self.name, to=state)
+        except Exception:
+            pass  # telemetry must never take down the guarded path
+
+
+class BreakerRegistry:
+    """Keyed breaker instances sharing one clock (``solver.pallas``,
+    ``solver.xla-scan``, ``solver.mesh``, ``solver.sidecar``,
+    ``aws.<service>``, ...). ``configure(clock=...)`` drops all state and
+    re-points the clock — a fresh hermetic environment owns the registry
+    the same way it owns the /debug pages."""
+
+    def __init__(self, clock: Optional[Clock] = None):
+        self._clock = clock or RealClock()
+        self._lock = threading.Lock()
+        self._breakers: dict[str, CircuitBreaker] = {}
+
+    def configure(self, clock: Optional[Clock] = None) -> None:
+        with self._lock:
+            if clock is not None:
+                self._clock = clock
+            self._breakers.clear()
+
+    reset = configure
+
+    def get(self, name: str, **kwargs) -> CircuitBreaker:
+        with self._lock:
+            br = self._breakers.get(name)
+            if br is None:
+                br = CircuitBreaker(name, clock=self._clock, **kwargs)
+                self._breakers[name] = br
+            return br
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._breakers)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            items = list(self._breakers.items())
+        return {name: br.snapshot() for name, br in sorted(items)}
+
+
+# the process-wide default registry (solver backends, controllers, the
+# operator's AWS session); hermetic environments re-configure it onto
+# their FakeClock at construction
+breakers = BreakerRegistry()

@@ -1,0 +1,55 @@
+"""The solver-dispatch fault seam.
+
+Device backends fail through runtime machinery (Mosaic lowering, a
+wedged TPU tunnel, a dead sidecar process) that hermetic tests cannot
+reach. This gate is the injection point: the solver calls
+``check(<backend>)`` immediately before running a device backend, and an
+installed hook may raise — the chaos ``DeviceLost`` fault uses it to
+simulate device loss deterministically (seeded, clock-driven), which the
+breaker + degraded-mode path must then absorb.
+
+Empty-gate cost is one truthiness test on a module list — nothing on the
+warm no-fault path (the <0.1 ms breaker-check budget covers it with
+orders of magnitude to spare).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+Hook = Callable[[str], None]
+
+
+class DeviceLostError(RuntimeError):
+    """A (simulated or real) device-runtime loss at the dispatch seam."""
+
+
+_hooks: list[Hook] = []
+
+
+def install(hook: Hook) -> Hook:
+    _hooks.append(hook)
+    return hook
+
+
+def remove(hook: Hook) -> None:
+    if hook in _hooks:
+        _hooks.remove(hook)
+
+
+def clear() -> None:
+    del _hooks[:]
+
+
+def active() -> bool:
+    return bool(_hooks)
+
+
+def check(backend: str) -> None:
+    """Give every installed hook a chance to fail this dispatch. Called
+    with the backend about to run ("pallas", "xla-scan", "sidecar",
+    "mesh"); a hook raises to simulate the loss, returns to pass."""
+    if not _hooks:
+        return
+    for hook in list(_hooks):
+        hook(backend)

@@ -161,51 +161,176 @@ class SolverServer:
 
 class SolverClient:
     """Tensor-bundle client; also usable as a TPUSolver drop-in through
-    ``RemoteSolver`` below."""
+    ``RemoteSolver`` below.
+
+    Sidecar-restart survival (resilience layer): ``close()`` is
+    idempotent; an ``UNAVAILABLE`` status re-dials the channel once and
+    retries the call; after any reconnect the first solve is gated behind
+    a ``Health`` probe so work never lands on a half-initialized device
+    runtime. RPC deadlines shrink to the ambient per-reconcile budget
+    when one is in scope (resilience/budget.py) instead of always paying
+    the flat default below.
+    """
 
     # A hung sidecar must not wedge the reconcile loop behind a deadline-less
     # RPC: first jit of a new shape bucket can take ~40s, so the default
     # leaves generous headroom over that, but is still finite.
     DEFAULT_TIMEOUT_S = 120.0
+    # never hand gRPC a zero/negative deadline, even with a dry budget —
+    # the error should be DEADLINE_EXCEEDED from the wire, not a local throw
+    MIN_TIMEOUT_S = 0.05
 
     def __init__(self, target: str, timeout_s: Optional[float] = None):
+        import threading
+
+        self._target = target
+        self._lock = threading.Lock()
+        self._closed = False
+        self._needs_probe = False
         self._channel = grpc.insecure_channel(target)
         self.timeout_s = timeout_s if timeout_s is not None else self.DEFAULT_TIMEOUT_S
 
-    def _call(self, method: str, payload: bytes, timeout_s: Optional[float] = None) -> bytes:
-        fn = self._channel.unary_unary(
+    def _effective_timeout(self, timeout_s: Optional[float]) -> float:
+        timeout = timeout_s or self.timeout_s
+        from ..resilience import budget
+
+        remaining = budget.remaining()
+        if remaining is not None:
+            timeout = min(timeout, remaining)
+        return max(timeout, self.MIN_TIMEOUT_S)
+
+    def _stub(self, method: str):
+        with self._lock:
+            if self._closed or self._channel is None:
+                raise RuntimeError("SolverClient is closed")
+            channel = self._channel
+        return channel.unary_unary(
             f"/{SERVICE}/{method}",
             request_serializer=bytes,
             response_deserializer=bytes,
         )
-        return fn(payload, timeout=timeout_s or self.timeout_s)
+
+    def _call(self, method: str, payload: bytes, timeout_s: Optional[float] = None) -> bytes:
+        timeout = self._effective_timeout(timeout_s)
+        try:
+            return self._stub(method)(payload, timeout=timeout)
+        except grpc.RpcError as e:
+            code = e.code() if hasattr(e, "code") else None
+            if code != grpc.StatusCode.UNAVAILABLE or self._closed:
+                raise
+            # sidecar restarted (or the connection died) under us: one
+            # re-dial, health-gate the new channel, then retry the call
+            log.warning(
+                "sidecar %s UNAVAILABLE on %s; re-dialing", self._target, method
+            )
+            self._redial()
+            if method != "Health":
+                self.health()
+            # wait_for_ready: the fresh channel may still be connecting —
+            # the retry must ride the connection attempt out (within the
+            # deadline) instead of failing fast mid-handshake. The
+            # deadline is RECOMPUTED: the first attempt + health probe
+            # already spent ambient reconcile budget, and the retry must
+            # fit what is left, not what was left at entry.
+            return self._stub(method)(
+                payload, timeout=self._effective_timeout(timeout_s),
+                wait_for_ready=True,
+            )
+
+    def _redial(self) -> None:
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("SolverClient is closed")
+            old, self._channel = self._channel, grpc.insecure_channel(self._target)
+            self._needs_probe = True
+        try:
+            if old is not None:
+                old.close()
+        except Exception:
+            pass
 
     def solve(self, **tensors) -> dict[str, np.ndarray]:
+        if self._needs_probe:
+            self.health()  # gate the first post-reconnect solve
         return unpack(self._call("Solve", pack(**tensors)))
 
     def simulate_consolidation(self, **tensors) -> dict[str, np.ndarray]:
+        if self._needs_probe:
+            self.health()
         return unpack(self._call("SimulateConsolidation", pack(**tensors)))
 
     def health(self) -> int:
-        return int(unpack(self._call("Health", pack(), timeout_s=10.0))["device_count"])
+        # a health probe never deserves more deadline than a solve, and
+        # 10s is plenty for a live runtime to answer
+        count = int(unpack(self._call(
+            "Health", pack(), timeout_s=min(10.0, self.timeout_s),
+        ))["device_count"])
+        self._needs_probe = False
+        return count
 
     def close(self) -> None:
-        self._channel.close()
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            channel, self._channel = self._channel, None
+        try:
+            if channel is not None:
+                channel.close()
+        except Exception:
+            pass
 
 
 class RemoteSolver:
     """Solver-plugin implementation backed by a sidecar: encode host-side,
     solve across the process boundary, decode host-side (the exact split the
-    BASELINE north star describes for the Go control plane)."""
+    BASELINE north star describes for the Go control plane).
+
+    Guarded by the ``solver.sidecar`` circuit breaker: a dead/restarting
+    sidecar fails a few solves (each served from the host FFD instead of
+    erroring the reconcile), trips the breaker so subsequent solves skip
+    the RPC latency entirely, and is re-admitted by a half-open probe
+    after the recovery window.
+    """
 
     def __init__(self, client: SolverClient, max_nodes: Optional[int] = None):
         self.client = client
         self.max_nodes = max_nodes
+        # per-solve stage timings + fallback notes (same contract as
+        # TPUSolver.timings; _solve_multi_nodepool resets per solve and
+        # solve_record lifts *_fallback keys into provenance)
+        self.timings: dict = {}
 
     def backend_label(self) -> str:
+        if self.timings.get("degraded"):
+            return "host-ffd(degraded)"
         return "sidecar"
 
     def solve_encoded(self, problem, existing=None):
+        from ..resilience import breakers, faultgate
+        from ..scheduling.solver import host_solve_encoded
+
+        breaker = breakers.get("solver.sidecar")
+        if not breaker.allow():
+            self.timings["breaker_fallback"] = "breaker:solver.sidecar"
+            self.timings["degraded"] = "host-ffd"
+            return host_solve_encoded(problem, existing)
+        try:
+            faultgate.check("sidecar")
+            out = self._solve_remote(problem, existing)
+        except Exception as e:
+            breaker.record_failure(e)
+            log.warning(
+                "sidecar solve failed; serving this solve from the host "
+                "FFD path: %s: %s", type(e).__name__, e,
+            )
+            self.timings["sidecar_fallback"] = f"{type(e).__name__}: {e}"[:200]
+            self.timings["degraded"] = "host-ffd"
+            return host_solve_encoded(problem, existing)
+        breaker.record_success()
+        return out
+
+    def _solve_remote(self, problem, existing=None):
         from ..ops.encode import bucket, pad_problem
         from ..scheduling.solver import _host_prefill
         from .solver_bridge import decode_remote

@@ -37,6 +37,12 @@ from ..trace.provenance import ProvenanceRecord, solve_record
 MAX_INSTANCE_TYPE_OPTIONS = 60
 
 
+def _solver_log():
+    import logging
+
+    return logging.getLogger("karpenter.tpu.solver")
+
+
 @dataclass
 class NodeSpec:
     """One node to create: ranked launch options + the pods it was packed for.
@@ -788,11 +794,17 @@ class TPUSolver:
 
     def backend_label(self) -> str:
         """The FFD backend the LAST solve actually ran (provenance field):
-        resolves "auto", and names a mid-solve pallas->xla fallback
-        explicitly — a bench row must never claim the kernel ran when the
-        scan did the work."""
+        resolves "auto", and names a mid-solve pallas->xla fallback — or a
+        breaker-driven degradation to the pure-host path — explicitly: a
+        bench row must never claim the kernel ran when the scan (or the
+        host FFD) did the work."""
+        if self.timings.get("degraded"):
+            return "host-ffd(degraded)"
         if "pallas_fallback" in self.timings:
             return "xla-scan(pallas-fallback)"
+        return {"xla": "xla-scan"}.get(self._resolved_mode(), self._resolved_mode())
+
+    def _resolved_mode(self) -> str:
         mode = self._ffd_mode
         if mode == "auto":
             try:
@@ -801,7 +813,7 @@ class TPUSolver:
                 mode = "pallas" if jax.default_backend() == "tpu" else "xla"
             except Exception:
                 mode = "xla"
-        return {"xla": "xla-scan"}.get(mode, mode)
+        return mode
 
     def _dput(self, x: np.ndarray):
         """device_put through the content-addressed cache."""
@@ -848,7 +860,101 @@ class TPUSolver:
         tunneled device each blocking fetch costs a full link RTT, so two
         sequential pool rounds paid two RTTs where one suffices
         (round-4 verdict weak #2 — config5's two pools measured 2x the
-        single-pool link cost)."""
+        single-pool link cost).
+
+        Resilience wrapper: when every device backend's circuit breaker
+        is open the dispatch degrades straight to the pure-host FFD path
+        (no device failure latency paid, ``fallback="breaker:<names>"``
+        stamped into provenance); a device failure at dispatch or fetch
+        time records against the running backend's breaker and falls
+        through to the same host path, so one broken accelerator runtime
+        can never take pod binding down with it."""
+        from ..resilience import breakers as _rbreakers
+
+        G = len(problem.group_pods)
+        if G == 0:
+            return _PendingSolve(wait=lambda: ([], [], {}))
+        names = self._device_breaker_names()
+        if not any(_rbreakers.get(n).available() for n in names):
+            # degraded provisioning mode: all device backends' breakers
+            # open — pods must keep binding via the host FFD
+            self.timings["breaker_fallback"] = "breaker:" + "+".join(names)
+            self.timings["degraded"] = "host-ffd"
+            _solver_log().warning(
+                "all device FFD breakers open (%s); serving this solve "
+                "from the host FFD path", "+".join(names),
+            )
+            return _PendingSolve(
+                wait=lambda: host_solve_encoded(problem, existing)
+            )
+        try:
+            pending = self._dispatch_device(problem, existing)
+        except Exception as e:
+            # bind via a default: the except variable is unbound by the
+            # time the deferred wait() runs
+            return _PendingSolve(
+                wait=lambda err=e: self._device_failed(problem, existing, err)
+            )
+
+        def _wait_guarded():
+            try:
+                out = pending.wait()
+            except Exception as e:
+                return self._device_failed(problem, existing, e)
+            self._device_breaker().record_success()
+            return out
+
+        return _PendingSolve(wait=_wait_guarded)
+
+    def _device_breaker_names(self) -> list[str]:
+        """The breakers guarding this solver's device path: the kernel
+        that would run first plus its in-solver fallback."""
+        mode = self._resolved_mode()
+        names = ["solver.pallas"] if mode.startswith("pallas") else []
+        names.append("solver.xla-scan")
+        return names
+
+    def _device_breaker(self):
+        """The breaker of the backend the current solve actually ran —
+        or, for failures BEFORE any backend dispatched (encode/upload
+        device_put), the backend that would have run first."""
+        from ..resilience import breakers as _rbreakers
+
+        backend = self.timings.get("ffd_backend")
+        if backend is None:
+            return _rbreakers.get(self._device_breaker_names()[0])
+        return _rbreakers.get(
+            "solver.pallas" if backend == "pallas" else "solver.xla-scan"
+        )
+
+    def _device_failed(self, problem, existing, e):
+        """A device solve failed at dispatch or fetch time: feed the
+        breaker, then serve THIS solve from the host FFD so the reconcile
+        still places pods. ``KARPENTER_TPU_DEGRADED_MODE=0`` (or an
+        explicitly pinned FFD backend) restores fail-loud behavior."""
+        from ..resilience.breaker import BreakerOpen
+
+        if isinstance(e, BreakerOpen):
+            self.timings["breaker_fallback"] = f"breaker:{e.breaker_name}"
+        else:
+            if not getattr(e, "__breaker_recorded__", False):
+                self._device_breaker().record_failure(e)
+            self.timings["device_fallback"] = f"{type(e).__name__}: {e}"[:200]
+        pinned = os.environ.get("KARPENTER_TPU_FFD") not in (None, "", "auto")
+        if (os.environ.get("KARPENTER_TPU_DEGRADED_MODE", "1") == "0"
+                or (pinned and not isinstance(e, BreakerOpen))):
+            raise e
+        if not isinstance(e, BreakerOpen):
+            _solver_log().warning(
+                "device FFD backend failed; serving this solve from the "
+                "host FFD path: %s: %s", type(e).__name__, e,
+            )
+        self.timings["degraded"] = "host-ffd"
+        return host_solve_encoded(problem, existing)
+
+    def _dispatch_device(
+        self, problem: EncodedProblem, existing: Optional[Sequence[ExistingNode]] = None,
+    ) -> "_PendingSolve":
         import jax
         import jax.numpy as jnp
 
@@ -970,12 +1076,28 @@ class TPUSolver:
                 return out
 
         def _dispatch_body(N: int):
+            from ..resilience import faultgate
+            from ..resilience import breakers as _rbreakers
+            from ..resilience.breaker import BreakerOpen
+
             t_run0 = time.perf_counter()
             mode = self._ffd_mode
             if mode == "auto":
                 mode = "pallas" if jax.default_backend() == "tpu" else "xla"
+            ran = False
             if mode.startswith("pallas"):
-                try:
+                br_p = _rbreakers.get("solver.pallas")
+                if not br_p.allow():
+                    # open breaker: skip the broken kernel WITHOUT paying
+                    # its failure latency again; the half-open probe
+                    # re-admits it after the recovery window — bounded
+                    # memory where the old lifetime pin was forever and
+                    # the memoryless retry was every pass
+                    self.timings["breaker_fallback"] = "breaker:solver.pallas"
+                else:
+                  try:
+                    faultgate.check("pallas")
+                    self.timings["ffd_backend"] = "pallas"
                     state, placed_chunks, unplaced_chunks = _run_pallas(N)
                     if self._ffd_mode == "auto" and not self._pallas_verified:
                         # one-time compiled-kernel self-check: both backends
@@ -1020,22 +1142,33 @@ class TPUSolver:
                             )
                             self._ffd_mode = "xla"
                         self._pallas_verified = True
-                except Exception as e:
+                    br_p.record_success()
+                    ran = True
+                  except Exception as e:
+                    br_p.record_failure(e)
                     if self._ffd_mode != "auto":
+                        # tagged so the dispatch guard doesn't record the
+                        # same failure against the breaker twice
+                        e.__breaker_recorded__ = True
                         raise
                     # auto-selected pallas failed (e.g. Mosaic lowering gap):
-                    # fall back to the XLA scan for this solver's lifetime —
-                    # LOUDLY, or nobody ever learns the kernel isn't running
-                    import logging
-
-                    logging.getLogger("karpenter.tpu.solver").warning(
+                    # fall back to the XLA scan — LOUDLY, or nobody ever
+                    # learns the kernel isn't running. The breaker (not a
+                    # lifetime pin) remembers: after the failure threshold
+                    # the kernel is skipped outright, and the half-open
+                    # probe re-admits it once the recovery window passes.
+                    _solver_log().warning(
                         "pallas FFD backend failed; falling back to the XLA "
-                        "scan for this solver: %s: %s", type(e).__name__, e,
+                        "scan for this solve: %s: %s", type(e).__name__, e,
                     )
                     self.timings["pallas_fallback"] = f"{type(e).__name__}: {e}"[:200]
-                    self._ffd_mode = "xla"
-                    state, placed_chunks, unplaced_chunks = _run_xla(N)
-            else:
+            if not ran:
+                br_x = _rbreakers.get("solver.xla-scan")
+                if not br_x.allow():
+                    # caught by dispatch_encoded's guard -> host FFD
+                    raise BreakerOpen("solver.xla-scan")
+                self.timings["ffd_backend"] = "xla"
+                faultgate.check("xla-scan")
                 state, placed_chunks, unplaced_chunks = _run_xla(N)
 
             # Launch-alternative ranking runs ON DEVICE (one fused [N, T]
@@ -1291,6 +1424,44 @@ class TPUSolver:
                                      revision=revision)
 
 
+def host_solve_encoded(
+    problem: EncodedProblem, existing: Optional[Sequence[ExistingNode]] = None,
+) -> tuple[list[NodeSpec], list[tuple[Pod, str]], dict[int, int]]:
+    """The pure-host FFD solve: ``HostSolver``'s body, shared with the
+    device solvers' degraded mode — when every device backend's circuit
+    breaker is open (or a device attempt just failed), provisioning falls
+    through to this path so pods keep binding while the accelerator side
+    is on fire (designs/circuit-breakers.md)."""
+    from .oracle import ffd_oracle
+
+    binds: list[tuple[Pod, str]] = []
+    if existing:
+        binds, problem = _host_prefill(problem, existing)
+    nodes, unplaced = ffd_oracle(problem)
+    G = len(problem.group_pods)
+    n_open = len(nodes)
+    N = max(n_open, 1)
+    Z = problem.group_window.shape[1]
+    placed = np.zeros((G, N), dtype=np.int32)
+    node_type = np.zeros(N, dtype=np.int32)
+    node_price = np.zeros(N, dtype=np.float32)
+    used = np.zeros((N, problem.capacity.shape[1]), dtype=np.float32)
+    node_window = np.zeros((N, Z, problem.group_window.shape[2]), dtype=bool)
+    for n, node in enumerate(nodes):
+        node_type[n] = node.type_index
+        node_price[n] = node.price
+        used[n] = node.used
+        node_window[n] = node.window
+        for g, c in node.group_counts.items():
+            placed[g, n] = c
+    specs, _ = _decode_nodes(
+        problem, node_type, node_price, used, n_open, placed,
+        problem.nodepool.name if problem.nodepool else "",
+        node_window,
+    )
+    return specs, binds, unplaced
+
+
 class HostSolver:
     """Numpy fallback solver (and the oracle in tests)."""
 
@@ -1300,34 +1471,7 @@ class HostSolver:
     def solve_encoded(
         self, problem: EncodedProblem, existing: Optional[Sequence[ExistingNode]] = None,
     ) -> tuple[list[NodeSpec], list[tuple[Pod, str]], dict[int, int]]:
-        from .oracle import ffd_oracle
-
-        binds: list[tuple[Pod, str]] = []
-        if existing:
-            binds, problem = _host_prefill(problem, existing)
-        nodes, unplaced = ffd_oracle(problem)
-        G = len(problem.group_pods)
-        n_open = len(nodes)
-        N = max(n_open, 1)
-        Z = problem.group_window.shape[1]
-        placed = np.zeros((G, N), dtype=np.int32)
-        node_type = np.zeros(N, dtype=np.int32)
-        node_price = np.zeros(N, dtype=np.float32)
-        used = np.zeros((N, problem.capacity.shape[1]), dtype=np.float32)
-        node_window = np.zeros((N, Z, problem.group_window.shape[2]), dtype=bool)
-        for n, node in enumerate(nodes):
-            node_type[n] = node.type_index
-            node_price[n] = node.price
-            used[n] = node.used
-            node_window[n] = node.window
-            for g, c in node.group_counts.items():
-                placed[g, n] = c
-        specs, _ = _decode_nodes(
-            problem, node_type, node_price, used, n_open, placed,
-            problem.nodepool.name if problem.nodepool else "",
-            node_window,
-        )
-        return specs, binds, unplaced
+        return host_solve_encoded(problem, existing)
 
     def solve(self, pods, nodepools, catalog, in_use=None, occupancy=None, type_allow=None,
               reserved_allow=None, existing=None, nodeclass_by_pool=None,

@@ -110,6 +110,13 @@ class Environment:
 def new_environment(solver: Optional[Solver] = None, use_tpu_solver: bool = True,
                     zones=None, cluster_info=None) -> Environment:
     clock = FakeClock()
+    # the resilience layer follows the freshest environment: breakers are
+    # re-keyed onto THIS clock (stale wall-time state must never leak into
+    # a virtual-clock run) and any leftover chaos dispatch hooks cleared
+    from .resilience import breakers, faultgate
+
+    breakers.configure(clock=clock)
+    faultgate.clear()
     cloud = FakeCloud(clock=clock, **({"zones": zones} if zones else {}))
     queue = FakeQueue()
     catalog = CatalogProvider(clock=clock, **({"zones": zones} if zones else {}))
@@ -162,7 +169,9 @@ def new_environment(solver: Optional[Solver] = None, use_tpu_solver: bool = True
             gc,
             liveness,
             nc_term,
-        ]
+        ],
+        clock=clock,
+        recorder=recorder,
     )
     return Environment(
         clock=clock,

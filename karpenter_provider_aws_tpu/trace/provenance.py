@@ -193,8 +193,16 @@ def solve_record(
         if k in timings:
             scale[k] = int(timings[k])
     scale.update(extra_scale or {})
-    if not fallback and isinstance(timings.get("pallas_fallback"), str):
-        fallback = timings["pallas_fallback"]
+    if not fallback:
+        # breaker-driven skips and device failures outrank the in-solve
+        # pallas->xla note: an open breaker must be visible in every
+        # ``obs explain`` output (resilience/breaker.py)
+        for key in ("breaker_fallback", "sidecar_fallback",
+                    "device_fallback", "pallas_fallback"):
+            v = timings.get(key)
+            if isinstance(v, str) and v:
+                fallback = v
+                break
     return record(ProvenanceRecord(
         kind="solve", device=device, device_count=count, backend=backend,
         fallback=fallback, scale=scale, phases_ms=phases, wall_ms=wall_ms,

@@ -171,3 +171,17 @@ class TestChaosScenarioSoak:
 
         for name in list_canned():
             run_deterministic(name, seed=5, runs=2)  # raises on divergence
+
+    def test_solver_brownout_seed_sweep(self):
+        """Resilience soak: the device-loss scenario (TPU solver, circuit
+        breakers, degraded host provisioning) across a wider seed sweep —
+        every seed must bind all pods, recover its breakers, and be
+        byte-identical with itself."""
+        from karpenter_provider_aws_tpu.chaos import run_deterministic
+        from karpenter_provider_aws_tpu.resilience import breakers
+
+        for seed in (1, 3, 7, 23, 42):
+            a, b = run_deterministic("solver-brownout", seed=seed, runs=2)
+            assert a.passed, f"seed={seed}:\n{a.summary()}"
+            assert a.faults_by_kind.get("DeviceLost", 0) >= 3, seed
+            assert breakers.get("solver.xla-scan").state == "closed", seed

@@ -21,6 +21,7 @@ import pytest
 from karpenter_provider_aws_tpu.chaos import (
     ChaosTransport,
     ConnectionDrop,
+    DeviceLost,
     EventualConsistencyLag,
     Ice,
     InjectedLatency,
@@ -110,6 +111,7 @@ class TestFaultPrimitives:
             Ice(capacity_types=("spot",)),
             SpotInterrupt(fraction=0.5, terminate=False),
             EventualConsistencyLag(lag_s=30.0),
+            DeviceLost(backends=("xla-scan", "pallas")),
         ):
             clone = fault_from_dict(json.loads(json.dumps(f.to_dict())))
             assert clone == f, f.kind
@@ -339,7 +341,8 @@ class TestCloudHooks:
 class TestScenarioPlans:
     def test_canned_scenarios_ship(self):
         assert list_canned() == [
-            "api-brownout", "eventual-consistency", "spot-storm", "sts-outage",
+            "api-brownout", "eventual-consistency", "solver-brownout",
+            "spot-storm", "sts-outage",
         ]
 
     def test_scenario_json_round_trip(self):
@@ -424,6 +427,52 @@ class TestCannedScenarios:
             "pods-bound-once", "converged", "no-leaked-instances",
             "ice-mask-expired", "queue-drained", "controllers-healthy",
         }
+
+    def test_solver_brownout_binds_via_host_while_breakers_open(self, reports):
+        """Acceptance (ISSUE 5 capstone): DeviceLost kills every device
+        dispatch; the first failures are served host-side in-pass, the
+        breaker opens, later waves ride the degraded path, and ALL pods
+        still bind (converged + pods-bound-once already assert binding;
+        this pins the degradation behavior)."""
+        r = reports["solver-brownout"]
+        assert r.passed, r.summary()
+        # fewer DeviceLost fires than solve-bearing waves under the fault:
+        # once the breaker opens the device path is not even attempted
+        assert r.faults_by_kind.get("DeviceLost", 0) >= 3
+        by_name = {i.name: i for i in r.invariants}
+        assert by_name["breakers-recovered"].passed
+        assert by_name["controllers-healthy"].passed
+
+    def test_solver_brownout_breaker_full_cycle_and_audit(self):
+        """The breaker walks closed -> open -> half-open -> open (probe
+        under fire) -> half-open -> closed (recovery wave), provisioning
+        writes degraded audit records + Warning events, and solve
+        provenance carries the breaker fallback."""
+        from karpenter_provider_aws_tpu.chaos import ChaosHarness
+        from karpenter_provider_aws_tpu.resilience import breakers
+
+        h = ChaosHarness("solver-brownout", seed=7)
+        report = h.run()
+        assert report.passed, report.summary()
+        br = breakers.get("solver.xla-scan")
+        assert br.state == "closed"
+        transitions = [to for _, to in br.history]
+        assert transitions == [
+            "open", "half-open", "open", "half-open", "closed",
+        ]
+        recs = h.env.obs.audit.query(kind="resilience")
+        assert recs, "expected degraded-mode audit records"
+        assert {r.decision for r in recs} == {"degraded:host-ffd"}
+        fallbacks = {r.detail["fallback"] for r in recs}
+        assert "breaker:solver.xla-scan" in fallbacks  # open-breaker passes
+        assert any("DeviceLostError" in f for f in fallbacks)  # failing passes
+        events = h.env.events.query(kind="Solver", name="provisioning")
+        assert any(e.reason == "DegradedProvisioning" for e in events)
+
+    def test_solver_brownout_same_seed_byte_identical(self):
+        a, b = run_deterministic("solver-brownout", seed=3, runs=2)
+        assert a.signature == b.signature
+        assert "DeviceLost" in a.signature
 
     def test_solve_provenance_stamped_with_chaos_context(self):
         """Solves that happen under active faults carry the scenario in

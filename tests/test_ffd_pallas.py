@@ -254,12 +254,19 @@ def test_auto_mode_divergence_falls_back_to_xla(monkeypatch):
         # simulate a miscompile: one placement row zeroed out
         return res._replace(placed=res.placed.at[:, 0].set(0))
 
+    from karpenter_provider_aws_tpu.resilience import breakers
+    from karpenter_provider_aws_tpu.utils.clock import FakeClock
+
+    breakers.configure(clock=FakeClock())
     s = _auto_tpu_solver(monkeypatch, corrupted)
     res = _solve_small(s)
-    # the divergence must be caught, the solver pinned to xla, and the
-    # RETURNED plan computed by the trustworthy backend
-    assert s._ffd_mode == "xla"
+    # the divergence must be caught, THIS solve served by the XLA scan,
+    # and the failure charged to the solver.pallas circuit breaker — the
+    # breaker (not the old lifetime pin) now owns the memory of a broken
+    # kernel, so a healthy kernel is re-admitted after recovery
     assert "pallas_fallback" in s.timings
+    assert s._ffd_mode == "auto"
+    assert breakers.get("solver.pallas").snapshot()["consecutive_failures"] == 1
     assert res.pods_placed() == 60
 
 

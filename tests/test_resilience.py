@@ -1,0 +1,887 @@
+"""Resilience layer: circuit breakers, deadline budgets, crash-loop
+supervision, degraded provisioning, and the sidecar-restart satellites
+(designs/circuit-breakers.md / docs/resilience.md)."""
+
+import threading
+import time
+
+import pytest
+
+from karpenter_provider_aws_tpu.resilience import (
+    Budget,
+    BreakerOpen,
+    BreakerRegistry,
+    CircuitBreaker,
+    budget,
+    faultgate,
+)
+from karpenter_provider_aws_tpu.utils.clock import FakeClock
+
+
+# ---------------------------------------------------------------------------
+# breaker state machine
+# ---------------------------------------------------------------------------
+
+class TestCircuitBreaker:
+    def test_closed_until_threshold_then_open(self):
+        clock = FakeClock()
+        br = CircuitBreaker("x", clock=clock, failure_threshold=3, recovery_s=30)
+        assert br.state == "closed"
+        br.record_failure(RuntimeError("a"))
+        br.record_failure(RuntimeError("b"))
+        assert br.state == "closed" and br.allow()
+        br.record_failure(RuntimeError("c"))
+        assert br.state == "open"
+        assert not br.allow()
+        assert "RuntimeError: c" == br.last_error
+
+    def test_success_resets_failure_streak(self):
+        br = CircuitBreaker("x", clock=FakeClock(), failure_threshold=3)
+        br.record_failure()
+        br.record_failure()
+        br.record_success()
+        br.record_failure()
+        br.record_failure()
+        assert br.state == "closed"  # streak restarted after the success
+
+    def test_open_transitions_half_open_after_recovery(self):
+        clock = FakeClock()
+        br = CircuitBreaker("x", clock=clock, failure_threshold=1, recovery_s=30)
+        br.record_failure()
+        assert not br.allow() and not br.available()
+        clock.advance(29.0)
+        assert not br.allow()
+        clock.advance(1.0)
+        assert br.available()          # non-consuming peek
+        assert br.allow()              # consumes the probe
+        assert br.state == "half-open"
+
+    def test_half_open_probe_success_closes(self):
+        clock = FakeClock()
+        br = CircuitBreaker("x", clock=clock, failure_threshold=1, recovery_s=10)
+        br.record_failure()
+        clock.advance(10)
+        assert br.allow()
+        br.record_success()
+        assert br.state == "closed"
+        assert [to for _, to in br.history] == ["open", "half-open", "closed"]
+
+    def test_half_open_probe_failure_rearms_recovery(self):
+        clock = FakeClock()
+        br = CircuitBreaker("x", clock=clock, failure_threshold=1, recovery_s=10)
+        br.record_failure()
+        clock.advance(10)
+        assert br.allow()
+        br.record_failure()
+        assert br.state == "open"
+        assert not br.allow()          # fresh window from the failed probe
+        clock.advance(10)
+        assert br.allow()
+
+    def test_half_open_admits_exactly_one_concurrent_probe(self):
+        clock = FakeClock()
+        br = CircuitBreaker("x", clock=clock, failure_threshold=1, recovery_s=5)
+        br.record_failure()
+        clock.advance(5)
+        granted = []
+        barrier = threading.Barrier(8)
+
+        def caller():
+            barrier.wait()
+            if br.allow():
+                granted.append(threading.get_ident())
+
+        threads = [threading.Thread(target=caller) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(granted) == 1
+        # the probe resolves and the single-slot semantics repeat
+        br.record_failure()
+        clock.advance(5)
+        assert br.allow() and not br.allow()
+
+    def test_release_hands_back_probe_without_verdict(self):
+        clock = FakeClock()
+        br = CircuitBreaker("x", clock=clock, failure_threshold=1, recovery_s=5)
+        br.record_failure()
+        clock.advance(5)
+        assert br.allow() and not br.allow()
+        br.release()
+        assert br.state == "half-open" and br.allow()
+
+    def test_guard_raises_breaker_open_and_records(self):
+        br = CircuitBreaker("dep", clock=FakeClock(), failure_threshold=1)
+        with pytest.raises(ValueError):
+            br.guard(lambda: (_ for _ in ()).throw(ValueError("boom")))
+        assert br.state == "open"
+        with pytest.raises(BreakerOpen) as ei:
+            br.guard(lambda: 42)
+        assert ei.value.breaker_name == "dep"
+
+    def test_metrics_exported_per_breaker(self):
+        from karpenter_provider_aws_tpu.metrics import REGISTRY
+
+        clock = FakeClock()
+        reg = BreakerRegistry(clock=clock)
+        br = reg.get("metrics-probe", failure_threshold=1, recovery_s=1)
+        br.record_failure()
+        body = REGISTRY.expose()
+        assert 'karpenter_circuit_state{name="metrics-probe"} 2.0' in body
+        assert ('karpenter_circuit_transitions_total'
+                '{name="metrics-probe",to="open"} 1.0') in body
+
+    def test_registry_configure_drops_state_and_rekeys_clock(self):
+        reg = BreakerRegistry()
+        reg.get("a").record_failure()
+        clock = FakeClock()
+        reg.configure(clock=clock)
+        assert reg.names() == []
+        br = reg.get("a", failure_threshold=1, recovery_s=7)
+        br.record_failure()
+        clock.advance(7)
+        assert br.allow()  # recovery measured on the NEW clock
+
+    def test_breaker_check_overhead_under_point1_ms(self):
+        """Acceptance: the warm no-fault path (registry lookup + available
+        + allow + record_success) stays far under 0.1 ms per check."""
+        from karpenter_provider_aws_tpu.resilience import breakers
+
+        breakers.configure(clock=FakeClock())
+        br = breakers.get("overhead-probe")
+        n = 20000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            breakers.get("overhead-probe").available()
+            br.allow()
+            br.record_success()
+        per_check_ms = (time.perf_counter() - t0) * 1e3 / n
+        assert per_check_ms < 0.1, f"breaker check cost {per_check_ms:.4f} ms"
+
+
+# ---------------------------------------------------------------------------
+# deadline budgets
+# ---------------------------------------------------------------------------
+
+class TestBudget:
+    def test_remaining_tracks_clock_and_charges(self):
+        clock = FakeClock()
+        b = Budget(10.0, clock=clock)
+        assert b.remaining() == 10.0
+        clock.advance(4.0)
+        assert b.remaining() == 6.0
+        b.charge(5.0)          # charges and clock elapse don't double-count:
+        assert b.remaining() == 5.0  # max(clock=4, charged=5)
+        clock.advance(7.0)
+        assert b.expired
+
+    def test_scope_is_thread_local_and_nested(self):
+        assert budget.current() is None
+        with budget.scope(Budget(10.0, clock=FakeClock())) as outer:
+            assert budget.current() is outer
+            with budget.scope(Budget(2.0, clock=FakeClock())) as inner:
+                assert budget.current() is inner
+            assert budget.current() is outer
+        assert budget.current() is None
+        seen = []
+
+        def other_thread():
+            seen.append(budget.current())
+
+        with budget.scope(Budget(1.0)):
+            t = threading.Thread(target=other_thread)
+            t.start()
+            t.join()
+        assert seen == [None]
+
+    def test_sidecar_timeout_shrinks_to_ambient_budget(self):
+        pytest.importorskip("grpc")
+        from karpenter_provider_aws_tpu.runtime.sidecar import SolverClient
+
+        client = SolverClient.__new__(SolverClient)  # no channel needed
+        client.timeout_s = 120.0
+        assert client._effective_timeout(None) == 120.0
+        clock = FakeClock()
+        with budget.scope(Budget(4.0, clock=clock)):
+            assert client._effective_timeout(None) == 4.0
+            clock.advance(10.0)
+            # dry budget still hands gRPC a positive deadline
+            assert client._effective_timeout(None) == SolverClient.MIN_TIMEOUT_S
+
+
+# ---------------------------------------------------------------------------
+# Session: hard per-call deadline + per-service breakers
+# ---------------------------------------------------------------------------
+
+def _throttled_session(monkeypatch, retry_after_s, deadline_s, sleeps):
+    """A Session whose wire always answers a Throttle carrying a hostile
+    Retry-After, with the deadline pinned and every sleep recorded."""
+    import random
+
+    from karpenter_provider_aws_tpu.chaos.faults import Throttle
+    from karpenter_provider_aws_tpu.chaos.transport import (
+        ChaosTransport,
+        StubAwsTransport,
+    )
+    from karpenter_provider_aws_tpu.providers.aws import Credentials, Session
+
+    monkeypatch.setenv("KARPENTER_TPU_REQUEST_DEADLINE_S", str(deadline_s))
+    wire = ChaosTransport(
+        StubAwsTransport(),
+        faults=[Throttle(retry_after_s=retry_after_s)],
+        rng=random.Random(1),
+        clock=FakeClock(),
+    )
+    return Session(
+        region="us-east-1",
+        credentials=Credentials("AKID", "secret"),
+        transport=wire,
+        sleep=sleeps.append,
+        rand=random.Random(2).random,
+    )
+
+
+class TestSessionDeadline:
+    def test_hostile_retry_after_capped_by_request_deadline(self, monkeypatch):
+        """Satellite regression: the retry ladder's TOTAL wall (sleeps,
+        Retry-After included) is hard-capped per logical call and the
+        stop is surfaced as retry_reason='budget'."""
+        from karpenter_provider_aws_tpu.metrics import AWS_REQUEST_RETRY_REASONS
+        from karpenter_provider_aws_tpu.providers.aws.transport import AwsApiError
+
+        sleeps = []
+        session = _throttled_session(
+            monkeypatch, retry_after_s=100.0, deadline_s=6.0, sleeps=sleeps,
+        )
+        before = AWS_REQUEST_RETRY_REASONS.value(service="ec2", reason="budget")
+        with pytest.raises(AwsApiError) as ei:
+            session.call_query("ec2", {"Action": "DescribeInstances"})
+        # the real throttle error surfaces (not a budget-shaped one)...
+        assert ei.value.code == "RequestLimitExceeded"
+        # ...after exactly one 5s-clamped sleep: the second would cross
+        # the 6s deadline, so the ladder stops there
+        assert sleeps == [5.0]
+        assert AWS_REQUEST_RETRY_REASONS.value(
+            service="ec2", reason="budget"
+        ) == before + 1
+
+    def test_ambient_reconcile_budget_stops_the_ladder(self, monkeypatch):
+        from karpenter_provider_aws_tpu.providers.aws.transport import AwsApiError
+
+        sleeps = []
+        session = _throttled_session(
+            monkeypatch, retry_after_s=100.0, deadline_s=60.0, sleeps=sleeps,
+        )
+        clock = FakeClock()
+        with budget.scope(Budget(3.0, clock=clock)):
+            with pytest.raises(AwsApiError):
+                session.call_query("ec2", {"Action": "DescribeInstances"})
+        assert sleeps == []  # 5s clamped delay > 3s ambient budget: no sleep
+
+    def test_within_deadline_ladder_still_retries_to_success(self, monkeypatch):
+        import random
+
+        from karpenter_provider_aws_tpu.chaos.faults import Throttle
+        from karpenter_provider_aws_tpu.chaos.transport import (
+            ChaosTransport,
+            StubAwsTransport,
+        )
+        from karpenter_provider_aws_tpu.providers.aws import Credentials, Session
+
+        monkeypatch.setenv("KARPENTER_TPU_REQUEST_DEADLINE_S", "60")
+        sleeps = []
+        wire = ChaosTransport(
+            StubAwsTransport(),
+            faults=[Throttle(retry_after_s=2.0, count=2)],
+            rng=random.Random(1), clock=FakeClock(),
+        )
+        session = Session(
+            region="us-east-1", credentials=Credentials("AKID", "secret"),
+            transport=wire, sleep=sleeps.append, rand=random.Random(2).random,
+        )
+        root = session.call_query("ec2", {"Action": "DescribeInstances"})
+        assert root is not None
+        assert sleeps == [2.0, 2.0]
+
+
+class TestSessionBreakers:
+    def _failing_session(self, clock):
+        import random
+
+        from karpenter_provider_aws_tpu.chaos.faults import ServerError
+        from karpenter_provider_aws_tpu.chaos.transport import (
+            ChaosTransport,
+            StubAwsTransport,
+        )
+        from karpenter_provider_aws_tpu.providers.aws import Credentials, Session
+
+        wire = ChaosTransport(
+            StubAwsTransport(), rng=random.Random(1), clock=clock,
+        )
+        fault = ServerError(service="ec2")
+        registry = BreakerRegistry(clock=clock)
+        session = Session(
+            region="us-east-1", credentials=Credentials("AKID", "secret"),
+            transport=wire, sleep=lambda s: None,
+            rand=random.Random(2).random, breakers=registry,
+        )
+        return session, wire, fault, registry
+
+    def test_consecutive_exhausted_ladders_open_the_service_breaker(self):
+        from karpenter_provider_aws_tpu.providers.aws.transport import AwsApiError
+
+        clock = FakeClock()
+        session, wire, fault, registry = self._failing_session(clock)
+        wire.add_fault(fault)
+        calls_before = None
+        for _ in range(3):
+            with pytest.raises(AwsApiError) as ei:
+                session.call_query("ec2", {"Action": "DescribeInstances"})
+            assert ei.value.code == "InternalError"
+        assert registry.get("aws.ec2").state == "open"
+        # open breaker: refused instantly WITHOUT touching the wire
+        calls_before = len(wire.inner.calls)
+        with pytest.raises(AwsApiError) as ei:
+            session.call_query("ec2", {"Action": "DescribeInstances"})
+        assert ei.value.code == "CircuitOpen"
+        assert len(wire.inner.calls) == calls_before
+        # other services are unaffected (keyed instances)
+        assert session.call_query("sqs", {"Action": "ListQueues"}) is not None
+
+    def test_definitive_4xx_answers_do_not_trip_the_breaker(self):
+        """Idempotent callers use EntityAlreadyExists / NotFound as normal
+        control flow — a definitive 4xx is the service WORKING and must
+        count as a breaker success, never a failure."""
+        import random
+
+        from karpenter_provider_aws_tpu.chaos.faults import Throttle
+        from karpenter_provider_aws_tpu.chaos.transport import (
+            ChaosTransport,
+            StubAwsTransport,
+        )
+        from karpenter_provider_aws_tpu.providers.aws import Credentials, Session
+        from karpenter_provider_aws_tpu.providers.aws.transport import AwsApiError
+
+        clock = FakeClock()
+        registry = BreakerRegistry(clock=clock)
+        # Throttle with a non-retryable code shape: a definitive client error
+        wire = ChaosTransport(
+            StubAwsTransport(),
+            faults=[Throttle(service="iam", code="EntityAlreadyExists",
+                             status=409)],
+            rng=random.Random(1), clock=clock,
+        )
+        session = Session(
+            region="us-east-1", credentials=Credentials("AKID", "secret"),
+            transport=wire, sleep=lambda s: None,
+            rand=random.Random(2).random, breakers=registry,
+        )
+        br = registry.get("aws.iam")
+        for _ in range(br.failure_threshold + 2):
+            with pytest.raises(AwsApiError) as ei:
+                session.call_query("iam", {"Action": "CreateRole"})
+            assert ei.value.code == "EntityAlreadyExists"
+        assert br.state == "closed"
+        assert br.snapshot()["consecutive_failures"] == 0
+
+    def test_credential_failure_releases_half_open_probe(self):
+        """A credential failure before/within the ladder is not the
+        wrapped service's fault: the half-open probe token must be handed
+        back, not wedged in-flight forever."""
+        import random
+
+        from karpenter_provider_aws_tpu.chaos.transport import StubAwsTransport
+        from karpenter_provider_aws_tpu.providers.aws import Credentials, Session
+        from karpenter_provider_aws_tpu.providers.aws.session import (
+            CredentialError,
+        )
+
+        clock = FakeClock()
+        registry = BreakerRegistry(clock=clock)
+        session = Session(
+            region="us-east-1", credentials=Credentials("AKID", "secret"),
+            transport=StubAwsTransport(), sleep=lambda s: None,
+            rand=random.Random(2).random, breakers=registry,
+        )
+        br = registry.get("aws.ec2")
+        for _ in range(br.failure_threshold):
+            br.record_failure(RuntimeError("outage"))
+        clock.advance(br.recovery_s)  # half-open probe is now admissible
+        session._base_creds = None    # the credential chain breaks
+        with pytest.raises(CredentialError):
+            session.call_query("ec2", {"Action": "DescribeInstances"})
+        # the probe was released without a verdict: still admissible
+        assert br.state == "half-open"
+        assert br.available()
+        session._base_creds = Credentials("AKID", "secret")
+        assert session.call_query("ec2", {"Action": "DescribeInstances"}) is not None
+        assert br.state == "closed"
+
+    def test_breaker_recovers_half_open_to_closed(self):
+        clock = FakeClock()
+        session, wire, fault, registry = self._failing_session(clock)
+        wire.add_fault(fault)
+        from karpenter_provider_aws_tpu.providers.aws.transport import AwsApiError
+
+        for _ in range(3):
+            with pytest.raises(AwsApiError):
+                session.call_query("ec2", {"Action": "DescribeInstances"})
+        wire.remove_fault(fault)  # the outage ends
+        br = registry.get("aws.ec2")
+        assert br.state == "open"
+        clock.advance(br.recovery_s)
+        assert session.call_query("ec2", {"Action": "DescribeInstances"}) is not None
+        assert br.state == "closed"
+
+
+# ---------------------------------------------------------------------------
+# Manager supervision: crash-loop backoff, watchdog, /debug/health
+# ---------------------------------------------------------------------------
+
+class _Flaky:
+    name = "flaky"
+    interval_s = 10.0
+
+    def __init__(self):
+        self.fail = True
+        self.calls = 0
+
+    def reconcile(self):
+        self.calls += 1
+        if self.fail:
+            raise RuntimeError("kaboom")
+
+
+class TestCrashLoopBackoff:
+    def _manager(self, controllers):
+        from karpenter_provider_aws_tpu.controllers.base import Manager
+        from karpenter_provider_aws_tpu.events import EventRecorder
+
+        clock = FakeClock()
+        return Manager(
+            controllers, clock=clock, recorder=EventRecorder(clock=clock),
+        ), clock
+
+    def test_backoff_arms_after_grace_and_grows(self):
+        from karpenter_provider_aws_tpu.controllers.base import (
+            CRASH_BACKOFF_GRACE,
+        )
+
+        c = _Flaky()
+        mgr, clock = self._manager([c])
+        for _ in range(CRASH_BACKOFF_GRACE):
+            mgr.reconcile_all_once()
+        assert c.calls == CRASH_BACKOFF_GRACE
+        # now in backoff: passes are skipped until the window elapses
+        mgr.reconcile_all_once()
+        assert c.calls == CRASH_BACKOFF_GRACE
+        clock.advance(1.0)  # base backoff
+        mgr.reconcile_all_once()
+        assert c.calls == CRASH_BACKOFF_GRACE + 1
+        # the window doubled: +1s is no longer enough
+        clock.advance(1.0)
+        mgr.reconcile_all_once()
+        assert c.calls == CRASH_BACKOFF_GRACE + 1
+        clock.advance(1.0)
+        mgr.reconcile_all_once()
+        assert c.calls == CRASH_BACKOFF_GRACE + 2
+
+    def test_success_resets_streak_and_backoff(self):
+        from karpenter_provider_aws_tpu.controllers.base import (
+            CRASH_BACKOFF_GRACE,
+        )
+
+        c = _Flaky()
+        mgr, clock = self._manager([c])
+        for _ in range(CRASH_BACKOFF_GRACE):
+            mgr.reconcile_all_once()
+        clock.advance(1.0)
+        c.fail = False
+        mgr.reconcile_all_once()   # succeeds
+        c.fail = True
+        calls = c.calls
+        # streak reset: the next failures get the full grace again
+        for _ in range(CRASH_BACKOFF_GRACE):
+            mgr.reconcile_all_once()
+        assert c.calls == calls + CRASH_BACKOFF_GRACE
+        health = mgr.health()
+        assert health["controllers"]["flaky"]["consecutive_failures"] == \
+            CRASH_BACKOFF_GRACE
+
+    def test_one_crashing_controller_does_not_starve_others(self):
+        class Healthy:
+            name = "healthy"
+            interval_s = 10.0
+            calls = 0
+
+            def reconcile(self):
+                Healthy.calls += 1
+
+        c = _Flaky()
+        mgr, clock = self._manager([c, Healthy()])
+        for _ in range(6):
+            mgr.reconcile_all_once()
+        assert Healthy.calls == 6
+
+    def test_elector_is_exempt_from_crashloop_backoff(self):
+        """Backing off the elector stops lease renewal and idles every
+        other controller — a transient API brownout must not freeze a
+        single-replica deployment past the brownout itself."""
+        from karpenter_provider_aws_tpu.controllers.base import (
+            CRASH_BACKOFF_GRACE,
+            Manager,
+        )
+
+        class FlakyElector:
+            name = "leader-election"
+            interval_s = 2.0
+            calls = 0
+            fail = True
+
+            def reconcile(self):
+                FlakyElector.calls += 1
+                if self.fail:
+                    raise RuntimeError("lease CAS failed")
+
+            def is_leader(self):
+                return True
+
+        elector = FlakyElector()
+        mgr = Manager([_Flaky()], elector=elector, clock=FakeClock())
+        for _ in range(CRASH_BACKOFF_GRACE + 3):
+            mgr.reconcile_all_once()
+        # the elector ran EVERY pass despite failing; the plain controller
+        # entered backoff after the grace
+        assert FlakyElector.calls == CRASH_BACKOFF_GRACE + 3
+
+    def test_counter_increments_per_armed_backoff(self):
+        from karpenter_provider_aws_tpu.controllers.base import (
+            CRASH_BACKOFF_GRACE,
+        )
+        from karpenter_provider_aws_tpu.metrics import CRASHLOOP_BACKOFFS
+
+        c = _Flaky()
+        c.name = "flaky-counter"
+        mgr, clock = self._manager([c])
+        before = CRASHLOOP_BACKOFFS.value(controller="flaky-counter")
+        for _ in range(CRASH_BACKOFF_GRACE):
+            mgr.reconcile_all_once()
+        assert CRASHLOOP_BACKOFFS.value(controller="flaky-counter") == before + 1
+
+
+class TestWatchdog:
+    def test_wedged_reconcile_flags_stuck_gauge_and_event(self):
+        from karpenter_provider_aws_tpu.controllers.base import (
+            Manager,
+            STUCK_FACTOR,
+        )
+        from karpenter_provider_aws_tpu.events import EventRecorder
+        from karpenter_provider_aws_tpu.metrics import CONTROLLER_STUCK
+
+        clock = FakeClock()
+        recorder = EventRecorder(clock=clock)
+        release = threading.Event()
+        started = threading.Event()
+
+        class Wedged:
+            name = "wedged"
+            interval_s = 10.0
+
+            def reconcile(self):
+                started.set()
+                release.wait(timeout=30)
+
+        mgr = Manager([Wedged()], clock=clock, recorder=recorder)
+        t = threading.Thread(target=mgr._reconcile_one, args=(mgr.controllers[0],))
+        t.start()
+        assert started.wait(timeout=5)
+        try:
+            assert mgr.check_stuck() == []          # not past the limit yet
+            clock.advance(10.0 * STUCK_FACTOR + 1)
+            assert mgr.check_stuck() == ["wedged"]
+            assert CONTROLLER_STUCK.value(controller="wedged") == 1.0
+            events = recorder.query(kind="Controller", name="wedged")
+            assert any(e.reason == "ReconcileStuck" for e in events)
+            # edge-triggered: a second check does not duplicate the event
+            assert mgr.check_stuck() == ["wedged"]
+            assert len([e for e in events if e.reason == "ReconcileStuck"]) == 1
+        finally:
+            release.set()
+            t.join(timeout=10)
+        # the reconcile finally returned: the gauge clears
+        assert CONTROLLER_STUCK.value(controller="wedged") == 0.0
+        assert mgr.health()["controllers"]["wedged"]["stuck"] is False
+
+
+class TestDebugHealth:
+    def test_health_page_joins_breakers_controllers_errors(self):
+        from karpenter_provider_aws_tpu.metrics import REGISTRY
+        from karpenter_provider_aws_tpu.resilience import breakers
+        from karpenter_provider_aws_tpu.testenv import new_environment
+
+        env = new_environment(use_tpu_solver=False)
+        try:
+            env.apply_defaults()
+            env.step(1)
+            breakers.get("solver.sidecar").record_failure(RuntimeError("x"))
+            page = REGISTRY.debug_page("/debug/health")
+            assert page is not None
+            assert "provisioning" in page["controllers"]
+            ctrl = page["controllers"]["provisioning"]
+            assert ctrl["consecutive_failures"] == 0
+            assert ctrl["in_backoff"] is False
+            assert page["breakers"]["solver.sidecar"]["consecutive_failures"] == 1
+            assert page["breakers"]["solver.sidecar"]["state"] == "closed"
+            assert page["recent_errors"] == []
+            import json
+
+            json.dumps(page)  # must be JSON-ready for the metrics server
+        finally:
+            env.close()
+
+
+# ---------------------------------------------------------------------------
+# degraded provisioning mode (device breakers open -> host FFD)
+# ---------------------------------------------------------------------------
+
+class TestDegradedProvisioning:
+    def test_all_device_breakers_open_falls_through_to_host_ffd(self):
+        from karpenter_provider_aws_tpu.catalog import CatalogProvider
+        from karpenter_provider_aws_tpu.models import NodePool
+        from karpenter_provider_aws_tpu.models.pod import make_pods
+        from karpenter_provider_aws_tpu.resilience import breakers
+        from karpenter_provider_aws_tpu.scheduling.solver import (
+            HostSolver,
+            TPUSolver,
+        )
+
+        breakers.configure(clock=FakeClock())
+        catalog = CatalogProvider()
+        pool = NodePool(name="default")
+        solver = TPUSolver()
+        br = breakers.get("solver.xla-scan")
+        for _ in range(br.failure_threshold):
+            br.record_failure(RuntimeError("device on fire"))
+        assert br.state == "open"
+        pods = make_pods(6, "deg", {"cpu": "1", "memory": "2Gi"})
+        result = solver.solve(pods, [pool], catalog)
+        assert result.pods_placed() == 6
+        assert result.provenance.backend == "host-ffd(degraded)"
+        assert result.provenance.fallback == "breaker:solver.xla-scan"
+        # the degraded plan matches the host solver's (same FFD)
+        host = HostSolver().solve(pods, [pool], catalog)
+        assert result.total_cost == pytest.approx(host.total_cost, rel=1e-5)
+        # recovery: close the breaker, the device path resumes
+        br.record_success()
+        result2 = solver.solve(
+            make_pods(2, "ok", {"cpu": "1"}), [pool], catalog
+        )
+        assert result2.provenance.backend == "xla-scan"
+        assert not result2.provenance.fallback
+
+    def test_device_failure_served_from_host_in_the_same_solve(self):
+        from karpenter_provider_aws_tpu.catalog import CatalogProvider
+        from karpenter_provider_aws_tpu.models import NodePool
+        from karpenter_provider_aws_tpu.models.pod import make_pods
+        from karpenter_provider_aws_tpu.resilience import breakers
+        from karpenter_provider_aws_tpu.scheduling.solver import TPUSolver
+
+        breakers.configure(clock=FakeClock())
+        hook = faultgate.install(
+            lambda backend: (_ for _ in ()).throw(
+                faultgate.DeviceLostError(f"lost {backend}")
+            )
+        )
+        try:
+            result = TPUSolver().solve(
+                make_pods(3, "f", {"cpu": "1"}), [NodePool(name="default")],
+                CatalogProvider(),
+            )
+        finally:
+            faultgate.remove(hook)
+        assert result.pods_placed() == 3
+        assert result.provenance.backend == "host-ffd(degraded)"
+        assert "DeviceLostError" in result.provenance.fallback
+        assert breakers.get("solver.xla-scan").snapshot()[
+            "consecutive_failures"
+        ] == 1
+
+    def test_degraded_mode_kill_switch(self, monkeypatch):
+        from karpenter_provider_aws_tpu.catalog import CatalogProvider
+        from karpenter_provider_aws_tpu.models import NodePool
+        from karpenter_provider_aws_tpu.models.pod import make_pods
+        from karpenter_provider_aws_tpu.resilience import breakers
+        from karpenter_provider_aws_tpu.scheduling.solver import TPUSolver
+
+        monkeypatch.setenv("KARPENTER_TPU_DEGRADED_MODE", "0")
+        breakers.configure(clock=FakeClock())
+        hook = faultgate.install(
+            lambda backend: (_ for _ in ()).throw(
+                faultgate.DeviceLostError(f"lost {backend}")
+            )
+        )
+        try:
+            with pytest.raises(faultgate.DeviceLostError):
+                TPUSolver().solve(
+                    make_pods(2, "k", {"cpu": "1"}),
+                    [NodePool(name="default")], CatalogProvider(),
+                )
+        finally:
+            faultgate.remove(hook)
+
+    def test_provisioning_stamps_degraded_audit_and_event(self):
+        from karpenter_provider_aws_tpu.models.pod import make_pods
+        from karpenter_provider_aws_tpu.resilience import breakers
+        from karpenter_provider_aws_tpu.testenv import new_environment
+
+        env = new_environment(use_tpu_solver=True)
+        try:
+            env.apply_defaults()
+            br = breakers.get("solver.xla-scan")
+            for _ in range(br.failure_threshold):
+                br.record_failure(RuntimeError("dead device"))
+            for p in make_pods(3, "w", {"cpu": "1", "memory": "2Gi"}):
+                env.cluster.apply(p)
+            env.step(2)
+            assert not env.cluster.pending_pods()  # pods bound anyway
+            recs = env.obs.audit.query(kind="resilience")
+            assert recs and recs[0].decision == "degraded:host-ffd"
+            assert recs[0].detail["fallback"] == "breaker:solver.xla-scan"
+            events = env.events.query(kind="Solver", name="provisioning")
+            assert any(e.reason == "DegradedProvisioning" for e in events)
+        finally:
+            env.close()
+
+
+# ---------------------------------------------------------------------------
+# satellite: sidecar restart survival
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def _grpc():
+    return pytest.importorskip("grpc")
+
+
+class TestSidecarRestart:
+    def _free_port(self):
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def test_close_is_idempotent(self, _grpc):
+        from karpenter_provider_aws_tpu.runtime.sidecar import SolverClient
+
+        client = SolverClient("127.0.0.1:1")
+        client.close()
+        client.close()  # second close: no raise
+        with pytest.raises(RuntimeError):
+            client._call("Health", b"")
+
+    def test_redial_and_health_gate_after_sidecar_restart(self, _grpc):
+        from karpenter_provider_aws_tpu.catalog import CatalogProvider
+        from karpenter_provider_aws_tpu.models import NodePool
+        from karpenter_provider_aws_tpu.models.pod import make_pods
+        from karpenter_provider_aws_tpu.resilience import breakers
+        from karpenter_provider_aws_tpu.runtime.sidecar import (
+            RemoteSolver,
+            SolverClient,
+            SolverServer,
+        )
+
+        breakers.configure(clock=FakeClock())
+        catalog = CatalogProvider()
+        pool = NodePool(name="default")
+        port = self._free_port()
+        addr = f"127.0.0.1:{port}"
+        server = SolverServer(addr)
+        server.start()
+        client = SolverClient(addr, timeout_s=30.0)
+        solver = RemoteSolver(client)
+        probes = []
+        orig_health = client.health
+        client.health = lambda: probes.append(1) or orig_health()
+        try:
+            r1 = solver.solve(make_pods(4, "a", {"cpu": "1"}), [pool], catalog)
+            assert r1.pods_placed() == 4
+            assert r1.provenance.backend == "sidecar"
+            # kill the sidecar: the next solve hits UNAVAILABLE, re-dials,
+            # finds it still down, and is served host-side instead of
+            # erroring the reconcile
+            server.stop(grace=0.2)
+            client.timeout_s = 2.0
+            rdown = solver.solve(
+                make_pods(2, "down", {"cpu": "1"}), [pool], catalog
+            )
+            assert rdown.pods_placed() == 2
+            assert rdown.provenance.backend == "host-ffd(degraded)"
+            assert client._needs_probe  # the re-dial armed the gate
+            # restart ON THE SAME PORT: the first solve after the
+            # reconnect must be health-gated
+            server = SolverServer(addr)
+            server.start()
+            probes.clear()
+            client.timeout_s = 30.0
+            r2 = solver.solve(make_pods(4, "b", {"cpu": "1"}), [pool], catalog)
+            assert r2.pods_placed() == 4
+            assert probes, "expected a Health probe before the first solve"
+            assert not client._needs_probe
+            assert r2.provenance.backend == "sidecar"
+            assert breakers.get("solver.sidecar").state == "closed"
+        finally:
+            client.close()
+            server.stop(grace=0.2)
+
+    def test_dead_sidecar_degrades_to_host_and_breaker_opens(self, _grpc):
+        from karpenter_provider_aws_tpu.catalog import CatalogProvider
+        from karpenter_provider_aws_tpu.models import NodePool
+        from karpenter_provider_aws_tpu.models.pod import make_pods
+        from karpenter_provider_aws_tpu.resilience import breakers
+        from karpenter_provider_aws_tpu.runtime.sidecar import (
+            RemoteSolver,
+            SolverClient,
+        )
+
+        breakers.configure(clock=FakeClock())
+        client = SolverClient(f"127.0.0.1:{self._free_port()}", timeout_s=0.5)
+        solver = RemoteSolver(client)
+        catalog = CatalogProvider()
+        pool = NodePool(name="default")
+        br = breakers.get("solver.sidecar")
+        try:
+            for i in range(br.failure_threshold):
+                r = solver.solve(
+                    make_pods(2, f"p{i}", {"cpu": "1"}), [pool], catalog
+                )
+                # every solve still places pods — served host-side
+                assert r.pods_placed() == 2
+                assert r.provenance.backend == "host-ffd(degraded)"
+            assert br.state == "open"
+            # with the breaker open the RPC is skipped outright
+            r = solver.solve(make_pods(2, "q", {"cpu": "1"}), [pool], catalog)
+            assert r.pods_placed() == 2
+            assert r.provenance.fallback == "breaker:solver.sidecar"
+        finally:
+            client.close()
+
+
+# ---------------------------------------------------------------------------
+# faultgate plumbing
+# ---------------------------------------------------------------------------
+
+class TestFaultGate:
+    def test_install_check_remove(self):
+        seen = []
+        hook = faultgate.install(seen.append)
+        try:
+            faultgate.check("pallas")
+            assert seen == ["pallas"]
+            assert faultgate.active()
+        finally:
+            faultgate.remove(hook)
+        faultgate.check("pallas")
+        assert seen == ["pallas"] and not faultgate.active()
