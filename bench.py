@@ -77,18 +77,36 @@ def stamp(row: dict, **overrides) -> dict:
     return stamp_row(row, **overrides)
 
 
+def check_backend(obj: dict) -> None:
+    """A stamped row whose provenance says ``backend=unknown`` is as
+    ambiguous as an unstamped one (the ``[cpu/unknown@...]`` rows this
+    guard retired): every producer must name the backend that actually ran
+    — "host" for pure-host control loops included — at emit time."""
+    prov = obj.get("provenance")
+    backend = prov.get("backend") if isinstance(prov, dict) else None
+    if backend in (None, "", "unknown"):
+        raise ValueError(
+            "refusing bench row with unknown backend (stamp a real backend "
+            "label at the producer): "
+            f"{obj.get('metric') or obj.get('benchmark') or obj}"
+        )
+
+
 def emit(obj: dict) -> None:
     """The one stdout JSON line. Everything else goes to stderr.
 
     REFUSES rows without a provenance stamp (the round-5 verdict's fix:
-    a bench row must never again be silent about device/backend/revision).
-    Every producer stamps at the source; this is the backstop that makes
-    an unstamped row a loud bug instead of an ambiguous artifact."""
+    a bench row must never again be silent about device/backend/revision)
+    and rows whose stamp carries ``backend=unknown`` (same ambiguity, one
+    level down). Every producer stamps at the source; this is the backstop
+    that makes an unstamped row a loud bug instead of an ambiguous
+    artifact."""
     if "provenance" not in obj:
         raise ValueError(
             "refusing to emit bench row without provenance stamp: "
             f"{obj.get('metric') or obj.get('benchmark') or obj}"
         )
+    check_backend(obj)
     sys.stdout.write(json.dumps(obj) + "\n")
     sys.stdout.flush()
 
@@ -169,6 +187,7 @@ def child_host() -> None:
             for row in rows:
                 if "provenance" not in row:
                     stamp(row)
+                check_backend(row)
                 f.write(json.dumps({**row, **at}) + "\n")
 
     with contextlib.redirect_stdout(sys.stderr):
@@ -258,6 +277,7 @@ def _cpp_sidecar_row() -> dict:
         "p50_ms": row["p50_ms"],
         "p99_ms": row["p99_ms"],
         "device": "cpu",
+        "backend": "sidecar",
         "note": "C++ client, gRPC/HTTP2 + npz wire, tiny Solve",
     }
 
@@ -468,11 +488,38 @@ def child_encode() -> None:
     def on_row(row):
         if "provenance" not in row:
             stamp(row)
+        check_backend(row)
         with open(DETAIL_PATH, "a") as f:
             f.write(json.dumps({**row, **at}) + "\n")
 
     with contextlib.redirect_stdout(sys.stderr):
         run_encode(scale=scale, on_row=on_row)
+
+
+def child_device_state() -> None:
+    """Device-residency rows: full-upload vs scatter-patch cost and
+    chained vs unchained screen dispatch (ops/device_state.py). Runs on
+    whatever backend the env dictates (the CPU child measures the host
+    floor; a TPU child measures the real link win)."""
+    import contextlib
+
+    _force_cpu_if_asked()
+    _enable_jit_cache()
+
+    from benchmarks.device_state_bench import run_all as run_device_state
+
+    scale = float(os.environ.get("BENCH_DEVICE_STATE_SCALE", "1.0"))
+    at = {"run_at_unix": int(time.time()), "scale": scale}
+
+    def on_row(row):
+        if "provenance" not in row:
+            stamp(row)
+        check_backend(row)
+        with open(DETAIL_PATH, "a") as f:
+            f.write(json.dumps({**row, **at}) + "\n")
+
+    with contextlib.redirect_stdout(sys.stderr):
+        run_device_state(scale=scale, on_row=on_row)
 
 
 def child_multichip() -> None:
@@ -488,6 +535,7 @@ def child_multichip() -> None:
     def on_row(row):
         if "provenance" not in row:
             stamp(row)
+        check_backend(row)
         with open(DETAIL_PATH, "a") as f:
             f.write(json.dumps({**row, **at}) + "\n")
 
@@ -511,6 +559,7 @@ def child_configs() -> None:
     def on_row(row):
         if "provenance" not in row:
             stamp(row)
+        check_backend(row)
         with open(DETAIL_PATH, "a") as f:
             f.write(json.dumps({**row, **at}) + "\n")
 
@@ -625,6 +674,7 @@ def probe_backend(window: float) -> tuple[bool, str]:
             f.write(json.dumps(stamp({
                 "benchmark": "accelerator_probe",
                 "device": "cpu-fallback",
+                "backend": "none",
                 "probe_error": info[:400],
                 "run_at_unix": int(time.time()),
             })) + "\n")
@@ -642,6 +692,7 @@ def main() -> None:
         "vs_baseline": 0.0,
         "error": "no measurement completed",
         "device": "none",
+        "backend": "none",
     })
 
     # Watchdog: if anything impossible hangs the parent (it shouldn't —
@@ -669,6 +720,15 @@ def main() -> None:
         # the warm controller pass (host-side numpy; CPU-forced child)
         _, err = run_child(
             "encode", min(300.0, _remaining() - SAFETY_MARGIN_S),
+            env_extra={"BENCH_FORCE_CPU": "1"},
+        )
+        if err:
+            errors.append(err)
+        # device-residency rows: upload-vs-scatter-patch cost + chained
+        # vs unchained screen dispatch (CPU-forced child measures the
+        # host floor; the TPU configs phase re-measures on the chip)
+        _, err = run_child(
+            "device_state", min(300.0, _remaining() - SAFETY_MARGIN_S),
             env_extra={"BENCH_FORCE_CPU": "1"},
         )
         if err:
@@ -770,7 +830,8 @@ if __name__ == "__main__":
             try:
                 {"host": child_host, "measure": child_measure,
                  "configs": child_configs, "multichip": child_multichip,
-                 "encode": child_encode}[child]()
+                 "encode": child_encode,
+                 "device_state": child_device_state}[child]()
             except Exception as e:
                 traceback.print_exc()
                 if child == "measure":
@@ -781,6 +842,7 @@ if __name__ == "__main__":
                         "unit": "ms",
                         "vs_baseline": 0.0,
                         "error": f"{type(e).__name__}: {e}"[:800],
+                        "backend": "none",
                     }))
                 sys.exit(1)
             sys.exit(0)

@@ -105,6 +105,7 @@ def bench_incremental_encode(n_nodes=5000, churn_frac=0.01, iters=30) -> dict:
         "verified": not diffs,
         "verify_diffs": diffs,
         "device": "host",
+        "backend": "host",
         "note": "encode is host-side numpy; device-independent",
     }
 
@@ -176,6 +177,7 @@ def bench_breaker_overhead(iters: int = 50000) -> dict:
         "budget_ms": budget_ms,
         "within_budget": per_check_ms < budget_ms,
         "device": "host",
+        "backend": "host",
         "note": "warm closed-breaker check on the solver dispatch path",
     }
     assert per_check_ms < budget_ms, (

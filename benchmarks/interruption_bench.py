@@ -71,6 +71,10 @@ def run_size(n) -> dict:
         "seconds": round(dt, 4),
         "msgs_per_sec": round(n / dt, 1),
         "claims_drained": drained,
+        # pure-host control loop (queue drain + store mutations; no device
+        # kernel runs) — the provenance stamp must say so, not "unknown"
+        "device": "host",
+        "backend": "host",
     }
 
 

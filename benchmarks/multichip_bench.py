@@ -49,6 +49,7 @@ def bench_solve_merge(num_pods=2000, iters=5) -> dict:
         "cost_sharded": round(merged["cost_sharded"], 3),
         "unplaced": int(merged["unplaced"].sum()),
         "device": "cpu-virtual-mesh",
+        "backend": "mesh",
     }
 
 
@@ -67,38 +68,61 @@ def bench_sharded_screen(n_nodes=5000, iters=3) -> dict:
 
     from karpenter_provider_aws_tpu.parallel.mesh import screen_lanes_per_device
 
+    from karpenter_provider_aws_tpu.parallel.mesh import last_screen_mode
+
     env = _synth_cluster(n_nodes=n_nodes)
     ct = encode_cluster(env.cluster, env.catalog)
     mesh = make_mesh(N_DEVICES)
-    ok = screen_sharded(ct, mesh)  # compile
+    # warm-up: the measured-cost chooser explores each bounded mode once
+    # (and compiles it) before the timed loop, so exploration/compile never
+    # lands in a timed iteration — the row measures the mode the chooser
+    # actually serves with
+    for _ in range(3):
+        ok = screen_sharded(ct, mesh)
     times = []
     for _ in range(iters):
         t0 = time.perf_counter()
         ok = screen_sharded(ct, mesh)
         times.append((time.perf_counter() - t0) * 1000.0)
-    # single-device comparison on the same process/devices
+    screen_mode = last_screen_mode()
+    # the chunked-mesh path's own cost, pinned explicitly: real multi-chip
+    # hardware runs this path, so its figure must survive even when the
+    # CPU-virtual chooser (rightly) prefers the native kernel here. Bounded
+    # exactly like the chooser's explore: above the bound the virtual-mesh
+    # cliff (20s at 5k nodes) is a known quantity not worth re-paying.
+    mesh_chunked_ms = None
+    ok_mesh = ok
+    explore_bound = int(
+        os.environ.get("KARPENTER_TPU_MESH_SCREEN_NATIVE_N", 1024)
+    )
+    if n_nodes < explore_bound:
+        prev_pin = os.environ.get("KARPENTER_TPU_MESH_SCREEN_MODE")
+        os.environ["KARPENTER_TPU_MESH_SCREEN_MODE"] = "mesh"
+        try:
+            screen_sharded(ct, mesh)  # compile/warm
+            t0 = time.perf_counter()
+            ok_mesh = screen_sharded(ct, mesh)
+            if last_screen_mode() == "mesh-chunked":
+                mesh_chunked_ms = round((time.perf_counter() - t0) * 1000.0, 3)
+            # else: the mesh path is unusable in this runtime (no
+            # jax.shard_map) and the pin fell back to native — a native
+            # figure must not publish under the mesh column
+        finally:
+            if prev_pin is None:
+                os.environ.pop("KARPENTER_TPU_MESH_SCREEN_MODE", None)
+            else:  # restore a pre-existing operator/test pin
+                os.environ["KARPENTER_TPU_MESH_SCREEN_MODE"] = prev_pin
+    # single-device comparison on the same process/devices; the ct-identity
+    # mask memo must not stand in for the actual vmap sweep being compared
     with force_repack_backend("vmap"):
         single = consolidatable(ct)  # compile
+        ct.__dict__.pop("_screen_mask_memo", None)
         t0 = time.perf_counter()
         single = consolidatable(ct)
         single_ms = (time.perf_counter() - t0) * 1000.0
+    ct.__dict__.pop("_screen_mask_memo", None)
     assert (ok == single).all(), "mesh screen diverged from single-device"
-    native_floor = int(os.environ.get("KARPENTER_TPU_MESH_SCREEN_NATIVE_N", 1024))
-    native_ok = False
-    try:  # mirror the fallback's own availability probe: the row must name
-        # the path that actually RAN, not the one the thresholds intended
-        from karpenter_provider_aws_tpu.scheduling.native import (  # noqa: F401
-            repack_check_native,
-        )
-
-        native_ok = True
-    except Exception:
-        pass
-    screen_mode = (
-        "native-fallback"
-        if n_nodes >= native_floor and not ct.has_topology() and native_ok
-        else "mesh-chunked"
-    )
+    assert (ok_mesh == single).all(), "chunked mesh diverged from single-device"
     return {
         # exact node count in the key: truncating to a k-suffix collides
         # different scales under one BENCH_SUMMARY row
@@ -108,13 +132,15 @@ def bench_sharded_screen(n_nodes=5000, iters=3) -> dict:
         "p99_ms": round(float(np.percentile(times, 99)), 3),
         "p50_ms": round(float(np.percentile(times, 50)), 3),
         "single_device_ms": round(single_ms, 3),
+        "mesh_chunked_ms": mesh_chunked_ms,
         "consolidatable_nodes": int(ok.sum()),
         # the scaling-cliff guards (see parallel/mesh.py screen_sharded):
-        # chunked lanes bound per-device memory; a big-N CPU (virtual) mesh
-        # answers via the native kernel instead of 8-way-sharding one host
+        # chunked lanes bound per-device memory, and the serving mode is
+        # chosen from MEASURED per-mode cost (the 500-node inversion fix)
         "screen_mode": screen_mode,
         "lanes_per_device": screen_lanes_per_device(n_nodes, ct.free.shape[1]),
         "device": "cpu-virtual-mesh",
+        "backend": screen_mode,
     }
 
 
@@ -237,6 +263,7 @@ def partition_evidence(n_nodes=2000, num_pods=10_000, devices=None) -> dict:
         "solve_scalar_psums": scalar_psums,
         "solve_collective_bytes_per_solve": 4 * scalar_psums,
         "device": "cpu-virtual-mesh",
+        "backend": "mesh",
         "note": "static SPMD-partition analysis; see docstring",
     }
 
@@ -253,7 +280,23 @@ def run_all(scale: float = 1.0, on_row=None) -> list[dict]:
         (partition_evidence, {"n_nodes": max(int(2000 * scale), 200),
                               "num_pods": max(int(10_000 * scale), 2000)}),
     ):
-        row = fn(**kwargs)
+        try:
+            row = fn(**kwargs)
+        except AssertionError:
+            # correctness gates (mesh-vs-single-device divergence) must
+            # stay LOUD — only environmental breakage is skippable
+            raise
+        except Exception as e:
+            # per-row isolation (the bench's streaming contract): a runtime
+            # without jax.shard_map can still produce the screen rows via
+            # the native path — one broken row must not kill the phase
+            import sys
+
+            print(
+                f"{fn.__name__}{kwargs} skipped: {type(e).__name__}: {e}",
+                file=sys.stderr, flush=True,
+            )
+            continue
         rows.append(row)
         print(json.dumps(row), flush=True)
         if on_row is not None:

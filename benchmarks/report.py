@@ -12,8 +12,8 @@ from pathlib import Path
 ROOT = Path(__file__).resolve().parent.parent
 
 
-def latest_rows(path: Path) -> dict[str, dict]:
-    rows: dict[str, dict] = {}
+def _parse(path: Path) -> list[dict]:
+    rows: list[dict] = []
     if not path.exists():
         return rows
     for line in path.read_text().splitlines():
@@ -24,19 +24,46 @@ def latest_rows(path: Path) -> dict[str, dict]:
             row = json.loads(line)
         except json.JSONDecodeError:
             continue
-        key = row.get("benchmark") or row.get("metric")
-        if not key:
+        rows.append(row)
+    return rows
+
+
+def _key(row: dict) -> str | None:
+    key = row.get("benchmark") or row.get("metric")
+    if not key:
+        return None
+    # the headline's name embeds the catalog size, which changed when
+    # the real-snapshot catalog landed (700 -> 776 types); collapse the
+    # family so the stale-named row doesn't read as a second headline.
+    # Only the north-star 50k-pod rows collapse: reduced-scale fallback
+    # headlines (e.g. the 8000-pod CPU row) and the bare error-path
+    # name keep their own keys so they can never shadow the real one.
+    if key.startswith("p99_ffd_solve_latency") and "50000pods" in key:
+        key = "p99_ffd_solve_latency_50000pods (headline)"
+    return key
+
+
+def select(rows: list[dict]) -> tuple[dict[str, dict], dict[str, dict]]:
+    """(selected, stale) per benchmark key.
+
+    Selection keeps the PR 1 rule: prefer full-scale rows; within a scale
+    the newest wins. ``stale`` marks keys whose SELECTED row is UNSTAMPED
+    (no provenance) while a stamped successor — any scale — exists with a
+    newer-or-equal timestamp: the headline number predates the provenance
+    contract and a measured, attributable replacement is on file, so the
+    summary must say the old figure is stale instead of letting the
+    full-scale preference keep republishing it as current."""
+    selected: dict[str, dict] = {}
+    best_stamped: dict[str, dict] = {}
+    for row in rows:
+        key = _key(row)
+        if key is None:
             continue
-        # the headline's name embeds the catalog size, which changed when
-        # the real-snapshot catalog landed (700 -> 776 types); collapse the
-        # family so the stale-named row doesn't read as a second headline.
-        # Only the north-star 50k-pod rows collapse: reduced-scale fallback
-        # headlines (e.g. the 8000-pod CPU row) and the bare error-path
-        # name keep their own keys so they can never shadow the real one.
-        if key.startswith("p99_ffd_solve_latency") and "50000pods" in key:
-            key = "p99_ffd_solve_latency_50000pods (headline)"
-        # prefer full-scale rows; within a scale, the newest wins
-        prev = rows.get(key)
+        if isinstance(row.get("provenance"), dict):
+            prev = best_stamped.get(key)
+            if prev is None or row.get("run_at_unix", 0) >= prev.get("run_at_unix", 0):
+                best_stamped[key] = row
+        prev = selected.get(key)
         if prev is not None and prev.get("scale", 1.0) > row.get("scale", 1.0):
             continue
         if (
@@ -44,8 +71,21 @@ def latest_rows(path: Path) -> dict[str, dict]:
             or row.get("scale", 1.0) > prev.get("scale", 1.0)
             or row.get("run_at_unix", 0) >= prev.get("run_at_unix", 0)
         ):
-            rows[key] = row
-    return rows
+            selected[key] = row
+    stale: dict[str, dict] = {}
+    for key, row in selected.items():
+        if isinstance(row.get("provenance"), dict):
+            continue
+        succ = best_stamped.get(key)
+        if succ is not None and (
+            succ.get("run_at_unix", 0) >= row.get("run_at_unix", 0)
+        ):
+            stale[key] = succ
+    return selected, stale
+
+
+def latest_rows(path: Path) -> dict[str, dict]:
+    return select(_parse(path))[0]
 
 
 def fmt(row: dict) -> str:
@@ -58,10 +98,14 @@ def fmt(row: dict) -> str:
               "pallas_p99_ms", "vmap_p99_ms", "native_p99_ms", "encode_ms",
               "controller_pass_ms", "cost_vs_greedy",
               "projected_local_p99_ms", "link_rtt_p99_ms",
-              "single_device_ms", "cost_merged", "max_ms",
+              "single_device_ms", "mesh_chunked_ms", "cost_merged", "max_ms",
               # incremental-encode rows (docs/performance.md)
               "full_encode_ms", "hit_ms", "patch_p50_ms", "patch_p99_ms",
               "first_pass_ms", "second_pass_ms", "screen_mode",
+              # device-residency rows (designs/device-resident-state.md)
+              "upload_ms", "patch_vs_upload",
+              "chained_p50_ms", "chained_p99_ms", "dispatch_p50_ms",
+              "unchained_p50_ms", "unchained_p99_ms",
               # lifecycle-SLI columns (docs/observability.md): virtual-
               # seconds time-to-bind/ready through the controller stack
               "bind_count", "unbound", "ready_count", "p50_s", "p99_s",
@@ -77,6 +121,8 @@ def fmt(row: dict) -> str:
         label = f"{prov.get('device', '?')}/{prov.get('backend', '?')}"
         if prov.get("fallback"):
             label += "(fallback)"
+        if prov.get("residency"):
+            label += f",{prov['residency']}"
         sha = prov.get("git_sha", "")
         bits.append(f"[{label}@{sha}]" if sha else f"[{label}]")
     else:
@@ -88,8 +134,19 @@ def fmt(row: dict) -> str:
     return " · ".join(bits)
 
 
+def stale_note(succ: dict) -> str:
+    date = time.strftime("%Y-%m-%d", time.gmtime(succ.get("run_at_unix", 0)))
+    scale = succ.get("scale", 1.0)
+    prov = succ.get("provenance") or {}
+    label = f"{prov.get('device', '?')}/{prov.get('backend', '?')}"
+    return (
+        f"**[STALE — superseded by stamped {date} row "
+        f"(scale={scale}, {label})]**"
+    )
+
+
 def main() -> None:
-    rows = latest_rows(ROOT / "BENCH_DETAIL.jsonl")
+    selected, stale = select(_parse(ROOT / "BENCH_DETAIL.jsonl"))
     lines = [
         "# BENCH_SUMMARY — latest full-scale row per benchmark",
         "",
@@ -97,14 +154,17 @@ def main() -> None:
         "`BENCH_DETAIL.jsonl` (append-only history; this file is derived).",
         "",
     ]
-    for key in sorted(rows):
-        row = rows[key]
+    for key in sorted(selected):
+        row = selected[key]
         stamp = time.strftime(
             "%Y-%m-%d", time.gmtime(row.get("run_at_unix", 0))
         )
-        lines.append(f"- **{key}** ({stamp}): {fmt(row)}")
+        line = f"- **{key}** ({stamp}): {fmt(row)}"
+        if key in stale:
+            line += " · " + stale_note(stale[key])
+        lines.append(line)
     (ROOT / "BENCH_SUMMARY.md").write_text("\n".join(lines) + "\n")
-    print(f"wrote BENCH_SUMMARY.md ({len(rows)} benchmarks)")
+    print(f"wrote BENCH_SUMMARY.md ({len(selected)} benchmarks)")
 
 
 if __name__ == "__main__":

@@ -29,7 +29,7 @@ from ..models import labels as lbl
 from ..ops.consolidate import (
     ClusterTensors,
     cheaper_replacement,
-    consolidatable,
+    dispatch_screen,
     encode_cluster,
     repack_set_feasible,
 )
@@ -103,6 +103,17 @@ class DisruptionController:
 
     def _disrupt(self, claim, reason: str, budget: "_BudgetTracker",
                  detail: dict = None) -> bool:
+        # Commit-time live recheck: the candidate walks read pod
+        # do-not-disrupt from per-pass snapshots, but an annotation stamped
+        # in place SINCE (a mutation the change journal cannot see) must
+        # still protect the node at the single point where a disruption
+        # actually commits — for every reason, not just consolidation.
+        node_name = getattr(getattr(claim, "status", None), "node_name", "")
+        if node_name and any(
+            p.do_not_disrupt()
+            for p in self.cluster.pods_on_nodes([node_name]).get(node_name, ())
+        ):
+            return False
         rclass = self._REASON_CLASS.get(reason.split(":")[0], "")
         audit = self._audit()
         if not budget.consume(claim.nodepool_name, rclass):
@@ -150,13 +161,23 @@ class DisruptionController:
         # that mutates between this snapshot and the encode.
         rev0 = getattr(self.cluster, "rev", None)
         by_node = self.cluster.pods_by_node()
-        self._reconcile_expiration(budget, by_node)
+        # per-node do-not-disrupt flag + the (claim, node) working set, each
+        # computed ONCE per pass: the three claim-driven phases used to
+        # regenerate _claims_with_nodes independently, re-walking every
+        # bound pod's annotations per phase — 3x O(pods) of pure repeat work
+        # on the warm 5k-node pass (the <50ms controller-pass budget)
+        dnd_node = {
+            name: any(p.do_not_disrupt() for p in pods)
+            for name, pods in by_node.items()
+        }
+        cn = list(self._claims_with_nodes(by_node, dnd_node))
+        self._reconcile_expiration(budget, by_node, cn)
         if self.drift_enabled:
-            self._reconcile_drift(budget, by_node)
-        self._reconcile_emptiness(budget, by_node)
-        self._reconcile_consolidation(budget, by_node, rev0)
+            self._reconcile_drift(budget, by_node, cn)
+        self._reconcile_emptiness(budget, by_node, cn)
+        self._reconcile_consolidation(budget, by_node, rev0, dnd_node)
 
-    def _claims_with_nodes(self, pods_by_node=None):
+    def _claims_with_nodes(self, pods_by_node=None, dnd_node=None):
         if pods_by_node is None:
             pods_by_node = self.cluster.pods_by_node()
         for claim in self.cluster.snapshot_claims():
@@ -171,31 +192,53 @@ class DisruptionController:
             if (
                 claim.annotations.get(lbl.ANNOTATION_DO_NOT_DISRUPT) == "true"
                 or node.annotations.get(lbl.ANNOTATION_DO_NOT_DISRUPT) == "true"
-                or any(p.do_not_disrupt() for p in pods_by_node.get(node.name, ()))
+                or (
+                    dnd_node.get(node.name, False)
+                    if dnd_node is not None
+                    else any(
+                        p.do_not_disrupt()
+                        for p in pods_by_node.get(node.name, ())
+                    )
+                )
             ):
                 continue
             yield claim, node
 
-    def _reconcile_expiration(self, budget, pods_by_node=None) -> None:
+    def _reconcile_expiration(self, budget, pods_by_node=None,
+                              claims_nodes=None) -> None:
         now = self.clock.now()
-        for claim, node in self._claims_with_nodes(pods_by_node):
+        if claims_nodes is None:
+            claims_nodes = self._claims_with_nodes(pods_by_node)
+        for claim, node in claims_nodes:
+            if claim.deleted:  # a shared working set spans phases now: an
+                continue       # earlier phase may have disrupted this claim
             pool = self.cluster.nodepools.get(claim.nodepool_name)
             if pool is None or pool.disruption.expire_after_s is None:
                 continue
             if now - claim.created_at >= pool.disruption.expire_after_s:
                 self._disrupt(claim, "expired", budget)
 
-    def _reconcile_drift(self, budget, pods_by_node=None) -> None:
-        for claim, node in self._claims_with_nodes(pods_by_node):
+    def _reconcile_drift(self, budget, pods_by_node=None,
+                         claims_nodes=None) -> None:
+        if claims_nodes is None:
+            claims_nodes = self._claims_with_nodes(pods_by_node)
+        for claim, node in claims_nodes:
+            if claim.deleted:
+                continue
             reason = self.cloudprovider.is_drifted(claim)
             if reason != DriftReason.NONE:
                 self._disrupt(claim, f"drifted:{reason.value}", budget)
 
-    def _reconcile_emptiness(self, budget, pods_by_node=None) -> None:
+    def _reconcile_emptiness(self, budget, pods_by_node=None,
+                             claims_nodes=None) -> None:
         now = self.clock.now()
         if pods_by_node is None:
             pods_by_node = self.cluster.pods_by_node()
-        for claim, node in self._claims_with_nodes(pods_by_node):
+        if claims_nodes is None:
+            claims_nodes = self._claims_with_nodes(pods_by_node)
+        for claim, node in claims_nodes:
+            if claim.deleted:
+                continue
             pool = self.cluster.nodepools.get(claim.nodepool_name)
             if pool is None:
                 continue
@@ -211,7 +254,7 @@ class DisruptionController:
             self._disrupt(claim, "empty", budget)
 
     def _reconcile_consolidation(self, budget, pods_by_node=None,
-                                 rev0=None) -> None:
+                                 rev0=None, dnd_node=None) -> None:
         pools = self.cluster.nodepools
         # Skip the whole encode + device screen when no pool can consolidate.
         if not any(
@@ -245,9 +288,15 @@ class DisruptionController:
             # live pod-level do-not-disrupt recheck: ct.blocked carries it
             # from encode time, but an annotation stamped since (an
             # in-place mutation the change journal cannot see) must still
-            # protect the node before anything commits this pass
-            if node is not None and any(
-                p.do_not_disrupt() for p in pods_by_node.get(node.name, ())
+            # protect the node before anything commits this pass. The
+            # per-node flag is precomputed once from this pass's pod view
+            # (reconcile()); the generator fallback serves direct callers.
+            if node is not None and (
+                dnd_node.get(node.name, False)
+                if dnd_node is not None
+                else any(
+                    p.do_not_disrupt() for p in pods_by_node.get(node.name, ())
+                )
             ):
                 node = None
             if node is not None:
@@ -277,7 +326,11 @@ class DisruptionController:
         # pods ALL repack onto the survivors (candidates never serve as
         # targets for each other — the set is removed at once, matching
         # designs/consolidation.md's simulated scheduling).
-        can = consolidatable(ct)
+        # Chained dispatch: the screen's device programs go in flight FIRST
+        # (served from the device-resident cluster tensors), then the
+        # host-side eligibility/validation walk below runs UNDER the device
+        # compute; wait() pays the link once for the tiny mask.
+        pending_screen = dispatch_screen(ct)
         order = np.argsort(ct.disruption_cost, kind="stable")
         eligible_all = [
             int(ni)
@@ -302,6 +355,7 @@ class DisruptionController:
         # delete candidates additionally pass the device repack screen;
         # multi-node REPLACE considers every eligible node (a node whose
         # pods don't fit on survivors is exactly the replace case)
+        can = pending_screen.wait()
         candidates = [ni for ni in eligible_all if can[ni]]
         deleted_nodes: set[int] = set()
         if candidates:
@@ -312,9 +366,24 @@ class DisruptionController:
                     lo = mid
                 else:
                     hi = mid - 1
+            rclass = self._REASON_CLASS.get("consolidatable", "")
             for ni in candidates[:lo]:
                 claim = eligible(ni)
-                if claim is not None and self._disrupt(
+                if claim is None:
+                    continue
+                # fast path for the exhausted-budget sweep: when the pool's
+                # allowance is gone AND this claim's reject is already
+                # audit-logged inside the TTL window, _disrupt would do
+                # nothing — skipping the call keeps the warm large-cluster
+                # pass from paying thousands of no-op consume/dedup rounds
+                # (identical audit/metrics outcome either way)
+                if budget.left(claim.nodepool_name, rclass) <= 0:
+                    last = self._reject_logged.get((claim.name, "consolidatable"))
+                    if last is not None and (
+                        self.clock.now() - last < self.REJECT_AUDIT_TTL_S
+                    ):
+                        continue
+                if self._disrupt(
                     claim, "consolidatable:delete", budget,
                     detail={"savings_per_hour": round(float(ct.price[ni]), 4)},
                 ):

@@ -313,6 +313,25 @@ ENCODE_PATCH_ROWS = REGISTRY.counter(
     "karpenter_encode_patch_rows_total",
     "Node rows rewritten by incremental cluster-encode patches",
 )
+# -- ops/device_state.py: device-resident cluster state ---------------------
+DEVICE_STATE = REGISTRY.counter(
+    "karpenter_device_state_total",
+    "Device-resident cluster-state outcomes by path (screen = the "
+    "consolidation repack tensors) and outcome (hit = device buffers "
+    "served unchanged, patch = scatter-patched on device from the change "
+    "journal delta, upload = full host->device upload, fallback = the "
+    "residency layer was off/unusable and the host-buffer path ran)",
+)
+DEVICE_STATE_PATCH_ROWS = REGISTRY.counter(
+    "karpenter_device_state_patch_rows_total",
+    "Node rows rewritten on device by scatter patches (the link carries "
+    "only these rows' bytes instead of the full ladder-padded buffers)",
+)
+DEVICE_STATE_BYTES = REGISTRY.counter(
+    "karpenter_device_state_bytes_total",
+    "Bytes shipped host->device by the residency layer, by kind (upload = "
+    "full buffer uploads, patch = scatter-patch row payloads)",
+)
 BATCH_SIZE = REGISTRY.histogram(
     "karpenter_batcher_batch_size", "Requests per coalesced batch",
     buckets=(1, 2, 5, 10, 50, 100, 500, 1000),

@@ -523,50 +523,130 @@ def live_slot_width(group_counts: np.ndarray) -> int:
     return min(w, group_counts.shape[1] if group_counts.ndim == 2 else w)
 
 
+class _PendingScreen:
+    """An in-flight repack screen: ``wait()`` drains the device programs and
+    returns the can_delete mask. The XLA vmap path with device-resident
+    tensors enqueues every candidate chunk WITHOUT a transfer wait, so the
+    caller (the disruption controller) overlaps its host-side candidate
+    eligibility work against device compute and pays the link exactly once."""
+
+    def __init__(self, wait):
+        self.wait = wait
+
+
 def consolidatable(ct: ClusterTensors, chunk: int = 512) -> np.ndarray:
     """can_delete[N]: pallas VMEM-resident kernel (one grid program per
-    candidate, zero HBM traffic in the slot loop), chunked vmap lanes,
-    mesh-sharded lanes, or the C++ kernel.
+    candidate, zero HBM traffic in the slot loop), chunked vmap lanes over
+    device-resident cluster tensors (ops/device_state.py), mesh-sharded
+    lanes, or the C++ kernel.
 
     Every sweep is flight-recorded (``consolidate.screen`` span) and
     leaves a provenance record naming the backend that ACTUALLY ran —
-    including a pallas->vmap fallback — readable via
+    including a pallas->vmap fallback — and where its inputs lived
+    (``residency``), readable via
     ``trace.last_record("consolidate.screen")``; the bench's config4 rows
     carry it so a screen number can never be silent about its kernel."""
+    return dispatch_screen(ct, chunk).wait()
+
+
+def dispatch_screen(ct: ClusterTensors, chunk: int = 512) -> _PendingScreen:
+    """Chained-dispatch entry behind :func:`consolidatable`: runs backend
+    selection + (for the vmap path) enqueues the chunk programs, deferring
+    the device->host fetch of the tiny mask to ``wait()``. Eager backends
+    (pallas / mesh / native) complete inside dispatch; ``wait()`` is then a
+    cached read. Provenance is recorded once, at wait time, with the full
+    dispatch->fetch wall."""
     import time as _time
 
     from ..trace import span as _span
     from ..trace.provenance import screen_record
 
     t0 = _time.perf_counter()
+    # ct-identity mask memo: the screen answer is a pure function of the
+    # tensors, and the incremental encoder re-emits the SAME object across
+    # unchanged passes — a warm reconcile re-screening an untouched cluster
+    # pays a dict lookup instead of the whole sweep. Keyed by the backend
+    # that WOULD run (masks legitimately differ across backends: the C++
+    # kernel screens compat only).
+    backend_would = _repack_backend(ct)
+    memo = ct.__dict__.get("_screen_mask_memo")
+    if memo is not None and memo[1] == backend_would:
+        mask, used_backend, fallback, residency = memo
+        if used_backend in ("vmap", "vmap-fallback"):
+            from .device_state import note_hit
+
+            # the device mirror is still current for this ct: the pass was
+            # served with state resident and zero bytes crossed the link
+            if note_hit(ct):
+                residency = "resident"
+        out = mask.copy()
+        rec = screen_record(
+            backend=used_backend, nodes=len(ct.node_names),
+            wall_ms=(_time.perf_counter() - t0) * 1e3, fallback=fallback,
+            residency=residency,
+        )
+        try:
+            from ..obs.quality import cluster_packing
+
+            eff = cluster_packing(ct)  # identity-memoized on the ct
+            if eff:
+                rec.quality["packing_efficiency"] = eff
+        except Exception:
+            pass
+        return _PendingScreen(wait=lambda: out)
     with _span("consolidate.screen", nodes=len(ct.node_names)) as sp:
-        out, used_backend, fallback = _screen(ct, chunk)
+        waiter, used_backend, fallback, residency = _screen(ct, chunk)
         sp.set(backend=used_backend)
+        if residency:
+            sp.set(residency=residency)
         if fallback:
             sp.set(fallback=fallback)
-    rec = screen_record(
-        backend=used_backend, nodes=len(ct.node_names),
-        wall_ms=(_time.perf_counter() - t0) * 1e3, fallback=fallback,
-    )
-    # cluster-wide packing SLI rides the sweep's provenance (and the
-    # karpenter_cluster_packing_efficiency gauge): every screen answer
-    # names how packed the cluster it judged actually was
-    try:
-        from ..obs.quality import cluster_packing
 
-        eff = cluster_packing(ct)
-        if eff:
-            rec.quality["packing_efficiency"] = eff
-    except Exception:
-        pass
-    return out
+    done: dict = {}
+
+    def _wait() -> np.ndarray:
+        if "out" in done:
+            return done["out"]
+        from ..trace import span as _span2
+
+        with _span2("consolidate.screen.fetch", nodes=len(ct.node_names)):
+            out = waiter()
+        done["out"] = out
+        # Keyed by the backend that RAN: a fallback sweep (e.g.
+        # "vmap-fallback" after a pallas failure) stores under a name the
+        # would-run backend never matches, so degraded passes deliberately
+        # re-dispatch every time — the memo must not mask the breaker's
+        # half-open retry of the healthy kernel.
+        ct.__dict__["_screen_mask_memo"] = (
+            out.copy(), used_backend, fallback, residency,
+        )
+        rec = screen_record(
+            backend=used_backend, nodes=len(ct.node_names),
+            wall_ms=(_time.perf_counter() - t0) * 1e3, fallback=fallback,
+            residency=residency,
+        )
+        # cluster-wide packing SLI rides the sweep's provenance (and the
+        # karpenter_cluster_packing_efficiency gauge): every screen answer
+        # names how packed the cluster it judged actually was
+        try:
+            from ..obs.quality import cluster_packing
+
+            eff = cluster_packing(ct)
+            if eff:
+                rec.quality["packing_efficiency"] = eff
+        except Exception:
+            pass
+        return out
+
+    return _PendingScreen(wait=_wait)
 
 
-def _screen(ct: ClusterTensors, chunk: int) -> tuple[np.ndarray, str, str]:
-    """The screen body behind ``consolidatable``: returns (mask, the
-    backend that ran, fallback reason or ""). Split out so the wrapper can
-    stamp provenance for every exit path without touching the dispatch
-    logic."""
+def _screen(ct: ClusterTensors, chunk: int):
+    """The screen body behind ``dispatch_screen``: returns (waiter, the
+    backend that ran, fallback reason or "", residency or ""). Split out so
+    the wrapper can stamp provenance for every exit path without touching
+    the dispatch logic. Only the vmap waiter defers work (the mask fetch);
+    every other backend resolves eagerly."""
     from ..resilience import breakers as _rbreakers
 
     N = len(ct.node_names)
@@ -596,7 +676,7 @@ def _screen(ct: ClusterTensors, chunk: int) -> tuple[np.ndarray, str, str]:
                 )
                 out &= ~ct.blocked
                 br.record_success()
-                return out, "pallas", fallback
+                return (lambda: out), "pallas", fallback, ""
             except Exception as e:
                 import os
 
@@ -628,7 +708,7 @@ def _screen(ct: ClusterTensors, chunk: int) -> tuple[np.ndarray, str, str]:
             try:
                 res = screen_sharded(ct, make_mesh())
                 br.record_success()
-                return res, "mesh", fallback
+                return (lambda: res), "mesh", fallback, ""
             except Exception as e:
                 import os
 
@@ -654,22 +734,45 @@ def _screen(ct: ClusterTensors, chunk: int) -> tuple[np.ndarray, str, str]:
             ct.compat, cand,
         )
         out &= ~ct.blocked
-        return out, "native", fallback
-    free = jnp.asarray(ct.free)
-    requests = jnp.asarray(ct.requests)
-    gids = jnp.asarray(gids_s)
-    gcounts = jnp.asarray(gcounts_s)
-    cap = jnp.asarray(screen_cap)
+        return (lambda: out), "native", fallback, ""
+    # -- XLA vmap path: device-resident inputs when available --------------
+    # The residency layer serves the big buffers from a persistent device
+    # mirror (hit or scatter patch); only the tiny candidate vectors and the
+    # result mask cross the link. Padding rows are inert (zero free, zero
+    # cap columns), so the mask over the live prefix is exactly the
+    # unpadded screen's answer.
+    from .device_state import acquire_screen_tensors
+
+    resident, residency = acquire_screen_tensors(ct)
+    if resident is not None:
+        free, requests, gids, gcounts, cap, _n_live = resident
+    else:
+        residency = residency or "fallback"
+        free = jnp.asarray(ct.free)
+        requests = jnp.asarray(ct.requests)
+        gids = jnp.asarray(gids_s)
+        gcounts = jnp.asarray(gcounts_s)
+        cap = jnp.asarray(screen_cap)
+    chunks = []
     for start in range(0, N, chunk):
         idx = np.arange(start, min(start + chunk, N), dtype=np.int32)
         pad = np.zeros(chunk - len(idx), dtype=np.int32)
         cand = jnp.asarray(np.concatenate([idx, pad]))
-        ok = np.asarray(repack_check(free, requests, gids, gcounts, cap, cand))
-        out[idx] = ok[: len(idx)]
-    out &= ~ct.blocked
-    # an empty node is trivially "repackable"; emptiness is handled separately
+        # enqueue only — the device result stays a device ref until wait()
+        chunks.append((idx, repack_check(free, requests, gids, gcounts, cap, cand)))
+
+    def waiter() -> np.ndarray:
+        res = out
+        for idx, ok_dev in chunks:
+            ok = np.asarray(ok_dev)
+            res[idx] = ok[: len(idx)]
+        res &= ~ct.blocked
+        # an empty node is trivially "repackable"; emptiness is handled
+        # separately
+        return res
+
     # "vmap-fallback" when the auto-selected pallas kernel failed into here
-    return out, ("vmap-fallback" if fallback else "vmap"), fallback
+    return waiter, ("vmap-fallback" if fallback else "vmap"), fallback, residency
 
 
 def repack_feasible_numpy(ct: ClusterTensors, free: np.ndarray, i: int) -> Optional[np.ndarray]:
@@ -735,7 +838,20 @@ def repack_set_feasible(
     ``allow_overflow=True`` returns ``(free, overflow)`` where overflow maps
     group id -> pods that found no survivor — the N->1 replacement path
     absorbs them on one new node. Without it, any leftover fails the check.
+
+    Boolean verdicts are memoized per (ct emission, candidate tuple): the
+    answer is a pure function of the tensors, and the warm reconcile's
+    binary search re-validates the same cost-ordered prefixes against the
+    same unchanged ct every pass (the <50ms controller-pass budget).
     """
+    _bool_mode = free is None and not return_free and not allow_overflow
+    _memo = _mkey = None
+    if _bool_mode:
+        _memo = ct.__dict__.setdefault("_repack_memo", {})
+        _mkey = tuple(candidate_ids)
+        hit = _memo.get(_mkey)
+        if hit is not None:
+            return hit
     free = (ct.free if free is None else free).copy()
     N = free.shape[0]
     G = ct.requests.shape[0]
@@ -882,10 +998,18 @@ def repack_set_feasible(
                 pending[g] = leftover
     for g, leftover in pending.items():
         if not allow_overflow:
+            if _bool_mode:
+                if len(_memo) > 256:
+                    _memo.clear()
+                _memo[_mkey] = False
             return None if return_free else False
         overflow[g] = overflow.get(g, 0) + leftover
     if allow_overflow:
         return free, overflow
+    if _bool_mode:
+        if len(_memo) > 256:
+            _memo.clear()
+        _memo[_mkey] = True
     return free if return_free else True
 
 
@@ -1187,6 +1311,22 @@ def cheaper_replacement(
     dec: dict = memo["dec"]
     _MISS = object()
     cacheable = not bool(res_left.any())
+    # Whole-result memo: on an unchanged ct (same emission object across
+    # warm passes) with the same pool set / margins and NO hard reservation
+    # slots in play, the entire candidate list is deterministic — the
+    # per-node loop below is pure repeat work on every quiet reconcile.
+    ra_sig = (
+        None if reserved_allow is None
+        else tuple(sorted(
+            (p, True if v is True else tuple(sorted(v)) if v else ())
+            for p, v in reserved_allow.items()
+        ))
+    )
+    out_key = (margin, spot_to_spot, ra_sig)
+    if cacheable:
+        hit = memo.get("out")
+        if hit is not None and hit[0] == out_key:
+            return list(hit[1])
     for i in range(N):
         if ct.blocked[i] or not present[i].any():
             continue
@@ -1289,4 +1429,6 @@ def cheaper_replacement(
             out.append((i,) + result)
         elif dkey is not None:
             dec[dkey] = None
+    if cacheable:
+        memo["out"] = (out_key, list(out))
     return out

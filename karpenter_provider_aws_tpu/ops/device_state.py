@@ -1,0 +1,457 @@
+"""Device-resident cluster state: scatter-patched tensors on the chip.
+
+The PR 3 incremental encoder killed the host-side *encode* cost (a no-change
+pass re-emits the same ``ClusterTensors`` object in ~0.1ms), but every device
+consumer still paid the host->device *link* for the big buffers each sweep:
+at 5k nodes the consolidation screen re-uploaded ``free`` / ``group_ids`` /
+``group_counts`` / ``cap`` every reconcile even when one pod moved — and over
+a tunneled device the link RTT (~76ms p99), not the chip (~3.4ms amortized),
+is the entire solve bound (BENCH_SUMMARY.md; ROADMAP "Kill the tunnel").
+
+This module keeps ONE persistent device-resident mirror of the screen
+tensors per incremental-encoder chain:
+
+ - the first pass uploads the full ladder-padded buffers once (node axis on
+   the same ``{2^k, 1.5*2^k}`` ladder the solver uses, group/slot axes on
+   power-of-two buckets, so jit shapes stay stable as the cluster drifts);
+ - each journal delta is applied as a small jitted device-side scatter
+   (``arr.at[rows].set``) of exactly the rows the incremental encoder
+   patched (``_patch_positions`` metadata on the emitted ``ClusterTensors``,
+   chained across passes the screen skipped) — patched host buffers are
+   NEVER re-uploaded;
+ - inputs are donated (``jax.jit(..., donate_argnums=...)``) on real
+   accelerators so the scatter updates buffers in place instead of doubling
+   resident memory per patch (CPU backends copy — donation there only warns);
+ - fallbacks mirror ``encode_delta``: membership change / journal overflow /
+   too-deep patch chain / axis growth all degrade to one full re-upload, and
+   ``KARPENTER_TPU_DEVICE_STATE=0`` kills the layer entirely (the legacy
+   host-buffer path runs, counted as ``outcome="fallback"``).
+
+Exactness contract: the mirror must describe byte-identically the same
+tensors the host path would upload. ``verify_mirror`` fetches the device
+buffers and compares them exactly against the host ``ClusterTensors``;
+``KARPENTER_TPU_DEVICE_STATE_VERIFY=1`` runs that check after every acquire
+(the randomized-churn property test and the chaos same-seed invariant pin
+it; never enabled in serving).
+
+Observability: outcomes land on ``karpenter_device_state_total{path,outcome}``
+(hit / patch / upload / fallback), patched row counts on
+``karpenter_device_state_patch_rows_total``, shipped bytes on
+``karpenter_device_state_bytes_total{kind}``, the scatter wall time on the
+``solve.device_patch`` span, and every screen sweep's provenance carries a
+``residency`` field (resident | upload | fallback).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import threading
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as np
+
+from ..metrics import DEVICE_STATE, DEVICE_STATE_BYTES, DEVICE_STATE_PATCH_ROWS
+from ..trace import span as trace_span
+
+_UNCAPPED = 1 << 30
+#: wire cap for hostname headroom (shared with consolidate.screen_cap_wire)
+_CAP_WIRE_MAX = 60000
+#: patch chains longer than this re-upload instead (row sets would approach
+#: the full buffer anyway, and each link is one dict walk per pass)
+MAX_CHAIN_DEPTH = 16
+_HOLDER_CAP = 8
+
+
+def enabled() -> bool:
+    return os.environ.get("KARPENTER_TPU_DEVICE_STATE", "1") == "1"
+
+
+def _verify_every_pass() -> bool:
+    return os.environ.get("KARPENTER_TPU_DEVICE_STATE_VERIFY", "0") == "1"
+
+
+def donate_enabled() -> bool:
+    """Donate scatter inputs so patches update in place. Default: on for
+    real accelerators, off on the CPU backend (XLA CPU cannot alias these
+    donations and would warn on every call)."""
+    v = os.environ.get("KARPENTER_TPU_DEVICE_DONATE")
+    if v is not None:
+        return v == "1"
+    try:
+        import jax
+
+        return jax.default_backend() != "cpu"
+    except Exception:
+        return False
+
+
+def _ladder_bucket(n: int, minimum: int = 8) -> int:
+    p = minimum
+    while True:
+        if n <= p:
+            return p
+        if n <= p * 3 // 2:
+            return p * 3 // 2
+        p *= 2
+
+
+def _pow2(n: int, minimum: int = 1) -> int:
+    w = minimum
+    while w < n:
+        w *= 2
+    return w
+
+
+# -- jitted scatter patch ----------------------------------------------------
+
+def _patch_body(free, gids, gcounts, cap, rows, free_v, gids_v, gcounts_v,
+                cap_v):
+    # ``rows`` is padded with the node-axis LENGTH as a sentinel: scatter
+    # updates drop out-of-bounds indices, so sentinel lanes are no-ops
+    # (never use -1 — negative indices WRAP and would corrupt the tail row)
+    free = free.at[rows].set(free_v)
+    gids = gids.at[rows].set(gids_v)
+    gcounts = gcounts.at[rows].set(gcounts_v)
+    cap = cap.at[:, rows].set(cap_v)
+    return free, gids, gcounts, cap
+
+
+_patch_fns: dict[bool, object] = {}
+
+
+def _patch_fn(donate: bool):
+    fn = _patch_fns.get(donate)
+    if fn is None:
+        import jax
+
+        fn = jax.jit(
+            _patch_body, donate_argnums=(0, 1, 2, 3) if donate else (),
+        )
+        _patch_fns[donate] = fn
+    return fn
+
+
+# -- the per-chain mirror ----------------------------------------------------
+
+class DeviceClusterTensors:
+    """Mutable holder of the device-resident screen tensors for ONE
+    incremental-encoder chain.
+
+    The holder is the single owner of the device buffers: after a donated
+    scatter patch the OLD buffers are dead, and the holder's fields are the
+    only sanctioned way to reach the live ones — callers must re-read
+    ``arrays()`` per pass and never cache the jax arrays across passes (the
+    donation contract; ``arrays()`` detects deleted buffers and reports the
+    holder unusable so a stale handle degrades to a re-upload instead of
+    crashing).
+    """
+
+    def __init__(self, chain):
+        self.chain = chain          # strong ref: pins the id() key
+        self.lock = threading.RLock()
+        self.base_ct = None         # host ClusterTensors this mirrors
+        self.free = None            # [NB, R]  float32
+        self.gids = None            # [NB, S]  int32
+        self.gcounts = None         # [NB, S]  int32
+        self.cap = None             # [GB, NB] float32 (wire form)
+        self.requests = None        # [GB, R]  float32
+        self.NB = 0
+        self.GB = 0
+        self.S = 0
+        self.n_live = 0
+        self.G = 0
+
+    def arrays(self) -> Optional[tuple]:
+        """(free, requests, gids, gcounts, cap, n_live) — the live device
+        refs, or None when the mirror is unusable (nothing uploaded yet, or
+        a buffer was deleted out from under us)."""
+        with self.lock:
+            bufs = (self.free, self.requests, self.gids, self.gcounts,
+                    self.cap)
+            if any(b is None for b in bufs):
+                return None
+            try:
+                if any(getattr(b, "is_deleted", lambda: False)() for b in bufs):
+                    return None
+            except Exception:
+                return None
+            return bufs + (self.n_live,)
+
+
+_HOLDERS: "OrderedDict[int, DeviceClusterTensors]" = OrderedDict()
+_HOLDERS_LOCK = threading.Lock()
+
+
+def _holder_for(chain) -> DeviceClusterTensors:
+    with _HOLDERS_LOCK:
+        h = _HOLDERS.get(id(chain))
+        if h is not None and h.chain is chain:
+            _HOLDERS.move_to_end(id(chain))
+            return h
+        h = DeviceClusterTensors(chain)
+        _HOLDERS[id(chain)] = h
+        while len(_HOLDERS) > _HOLDER_CAP:
+            _HOLDERS.popitem(last=False)
+        return h
+
+
+def reset_device_state() -> None:
+    """Drop every device mirror (tests / backend reinit)."""
+    with _HOLDERS_LOCK:
+        _HOLDERS.clear()
+
+
+# -- host-side tensor prep ---------------------------------------------------
+
+def _cap_wire_f32(ct, cols: Optional[np.ndarray] = None) -> np.ndarray:
+    """The screen capability matrix in device form: float32, _UNCAPPED for
+    uncapped-compatible, 0 for incompatible, hostname headroom otherwise —
+    value-identical to what repack_check derives from screen_cap_wire's
+    uint16/bool wire (integers <= 60000 and 2^30 are exact in float32)."""
+    src = ct.cap if ct.cap is not None else ct.compat
+    if cols is not None:
+        src = src[:, cols]
+    if src.dtype == bool:
+        return np.where(src, np.float32(_UNCAPPED), np.float32(0.0))
+    return np.minimum(src, _CAP_WIRE_MAX).astype(np.float32)
+
+
+def _collect_patch_positions(ct, base) -> Optional[np.ndarray]:
+    """Walk the ``_patch_base`` chain from ``ct`` back to ``base``; returns
+    the merged dirty positions (sorted, deduped) or None when no bounded
+    chain connects them (membership changed / chain broken / too deep)."""
+    chunks: list[np.ndarray] = []
+    cur = ct
+    for _ in range(MAX_CHAIN_DEPTH):
+        if cur is base:
+            if not chunks:
+                return np.zeros(0, dtype=np.int32)
+            return np.unique(np.concatenate(chunks)).astype(np.int32)
+        nxt = cur.__dict__.get("_patch_base")
+        pos = cur.__dict__.get("_patch_positions")
+        if nxt is None or pos is None:
+            return None
+        chunks.append(pos)
+        cur = nxt
+    return None
+
+
+# -- acquire -----------------------------------------------------------------
+
+def acquire_screen_tensors(ct, span=None):
+    """Device-resident (free, requests, gids, gcounts, cap, n_live) for the
+    repack screen of ``ct``, plus the outcome label.
+
+    Returns ``(arrays, residency)`` where residency is ``"resident"`` (hit
+    or scatter patch) or ``"upload"`` — or ``(None, "fallback")`` when the
+    residency layer is off, the tensors predate the incremental encoder, or
+    the device path errored (the caller then runs the legacy host-buffer
+    upload path). Never raises out of the fast path unless the explicit
+    verify knob is on.
+    """
+    if not enabled():
+        DEVICE_STATE.inc(path="screen", outcome="fallback")
+        return None, "fallback"
+    chain = ct.__dict__.get("_device_chain")
+    if chain is None:
+        # full-encode tensors (no persistent encoder): nothing to key a
+        # persistent mirror on — the host upload path handles it
+        DEVICE_STATE.inc(path="screen", outcome="fallback")
+        return None, "fallback"
+    try:
+        holder = _holder_for(chain)
+        with holder.lock:
+            out = _acquire_locked(holder, ct, span)
+        if _verify_every_pass() and out[0] is not None:
+            diffs = verify_mirror(holder, ct)
+            if diffs:
+                raise RuntimeError(
+                    f"device-resident screen tensors diverged from the host "
+                    f"encoder: {diffs}"
+                )
+        return out
+    except Exception:
+        if _verify_every_pass():
+            raise
+        DEVICE_STATE.inc(path="screen", outcome="fallback")
+        return None, "fallback"
+
+
+def _acquire_locked(holder: DeviceClusterTensors, ct, span):
+    from .consolidate import live_slot_width
+
+    N = len(ct.node_names)
+    G = ct.requests.shape[0]
+    W = live_slot_width(ct.group_counts)
+    bufs = holder.arrays()
+
+    if bufs is not None and holder.base_ct is ct:
+        DEVICE_STATE.inc(path="screen", outcome="hit")
+        if span is not None and hasattr(span, "set"):
+            span.set(residency="resident", mode="hit")
+        return bufs, "resident"
+
+    if (
+        bufs is not None
+        and holder.base_ct is not None
+        and N == holder.n_live
+        and G == holder.G
+        and W <= holder.S
+        # the fast-patch emission shares the group-axis arrays outright;
+        # identity is the cheap witness that G-axis content is unchanged
+        and ct.requests is holder.base_ct.requests
+    ):
+        rows = _collect_patch_positions(ct, holder.base_ct)
+        if rows is not None:
+            _apply_patch(holder, ct, rows)
+            DEVICE_STATE.inc(path="screen", outcome="patch")
+            DEVICE_STATE_PATCH_ROWS.inc(len(rows))
+            if span is not None and hasattr(span, "set"):
+                span.set(residency="resident", mode="patch", rows=len(rows))
+            return holder.arrays(), "resident"
+
+    _upload(holder, ct, N, G, W)
+    DEVICE_STATE.inc(path="screen", outcome="upload")
+    if span is not None and hasattr(span, "set"):
+        span.set(residency="upload", mode="upload")
+    return holder.arrays(), "upload"
+
+
+def _upload(holder: DeviceClusterTensors, ct, N: int, G: int, W: int) -> None:
+    import jax
+
+    R = ct.free.shape[1]
+    NB = max(_ladder_bucket(N), holder.NB)
+    GB = max(_pow2(G, minimum=8), holder.GB)
+    S = max(_pow2(W), holder.S, 1)
+    S = min(S, ct.group_ids.shape[1])
+
+    free_h = np.zeros((NB, R), dtype=np.float32)
+    free_h[:N] = ct.free
+    gids_h = np.zeros((NB, S), dtype=np.int32)
+    gids_h[:N] = ct.group_ids[:, :S]
+    gcounts_h = np.zeros((NB, S), dtype=np.int32)
+    gcounts_h[:N] = ct.group_counts[:, :S]
+    req_h = np.zeros((GB, R), dtype=np.float32)
+    req_h[:G] = ct.requests
+    cap_h = np.zeros((GB, NB), dtype=np.float32)
+    cap_h[:G, :N] = _cap_wire_f32(ct)
+
+    holder.free = jax.device_put(free_h)
+    holder.gids = jax.device_put(gids_h)
+    holder.gcounts = jax.device_put(gcounts_h)
+    holder.requests = jax.device_put(req_h)
+    holder.cap = jax.device_put(cap_h)
+    holder.NB, holder.GB, holder.S = NB, GB, S
+    holder.n_live, holder.G = N, G
+    holder.base_ct = ct
+    DEVICE_STATE_BYTES.inc(
+        free_h.nbytes + gids_h.nbytes + gcounts_h.nbytes + req_h.nbytes
+        + cap_h.nbytes,
+        kind="upload",
+    )
+
+
+def _apply_patch(holder: DeviceClusterTensors, ct, rows: np.ndarray) -> None:
+    """Scatter exactly ``rows`` into the resident buffers (donated in-place
+    update on real accelerators). ``rows`` may be empty — the group-pod-only
+    patch — in which case the buffers are already exact."""
+    import jax
+
+    if not len(rows):
+        holder.base_ct = ct
+        return
+    K = _pow2(len(rows), minimum=8)
+    NB, S, GB = holder.NB, holder.S, holder.GB
+    rows_p = np.full(K, NB, dtype=np.int32)  # NB = out-of-bounds sentinel
+    rows_p[: len(rows)] = rows
+    R = ct.free.shape[1]
+    free_v = np.zeros((K, R), dtype=np.float32)
+    free_v[: len(rows)] = ct.free[rows]
+    gids_v = np.zeros((K, S), dtype=np.int32)
+    gids_v[: len(rows)] = ct.group_ids[rows, :S]
+    gcounts_v = np.zeros((K, S), dtype=np.int32)
+    gcounts_v[: len(rows)] = ct.group_counts[rows, :S]
+    cap_v = np.zeros((GB, K), dtype=np.float32)
+    cap_v[: holder.G, : len(rows)] = _cap_wire_f32(ct, cols=rows)
+
+    with trace_span("solve.device_patch", rows=int(len(rows)), bucket=K):
+        fn = _patch_fn(donate_enabled())
+        holder.free, holder.gids, holder.gcounts, holder.cap = fn(
+            holder.free, holder.gids, holder.gcounts, holder.cap,
+            jax.device_put(rows_p), jax.device_put(free_v),
+            jax.device_put(gids_v), jax.device_put(gcounts_v),
+            jax.device_put(cap_v),
+        )
+    holder.base_ct = ct
+    DEVICE_STATE_BYTES.inc(
+        rows_p.nbytes + free_v.nbytes + gids_v.nbytes + gcounts_v.nbytes
+        + cap_v.nbytes,
+        kind="patch",
+    )
+
+
+# -- exactness witness -------------------------------------------------------
+
+def verify_mirror(holder: DeviceClusterTensors, ct) -> list[str]:
+    """Fetch the device buffers and compare them EXACTLY against what a
+    fresh upload of ``ct`` would contain. Returns the differing field names
+    (empty = mirror exact). The property test and the chaos invariant pin
+    this; ``KARPENTER_TPU_DEVICE_STATE_VERIFY=1`` runs it per acquire."""
+    import jax
+
+    bufs = holder.arrays()
+    if bufs is None:
+        return ["<no-mirror>"]
+    free_d, req_d, gids_d, gcounts_d, cap_d, n_live = bufs
+    N = len(ct.node_names)
+    G = ct.requests.shape[0]
+    if n_live != N:
+        return ["n_live"]
+    free, req, gids, gcounts, cap = jax.device_get(
+        (free_d, req_d, gids_d, gcounts_d, cap_d)
+    )
+    S = holder.S
+    bad = []
+    if not np.array_equal(free[:N], ct.free):
+        bad.append("free")
+    if not np.array_equal(req[:G], ct.requests):
+        bad.append("requests")
+    if not np.array_equal(gids[:N], ct.group_ids[:, :S]):
+        bad.append("group_ids")
+    if not np.array_equal(gcounts[:N], ct.group_counts[:, :S]):
+        bad.append("group_counts")
+    if not np.array_equal(cap[:G, :N], _cap_wire_f32(ct)):
+        bad.append("cap")
+    # padding must stay inert: zero free/cap rows can never absorb pods
+    if N < holder.NB and (
+        free[N:].any() or cap[:, N:].any() or gcounts[N:].any()
+    ):
+        bad.append("padding")
+    return bad
+
+
+def note_hit(ct) -> bool:
+    """True (and one ``outcome="hit"`` tick) when a live device mirror is
+    current for ``ct`` — the caller served the pass from resident state
+    without dispatching (the host-side mask memo above the screen)."""
+    if not enabled():
+        return False
+    h = mirror_for(ct)
+    if h is None or h.base_ct is not ct or h.arrays() is None:
+        return False
+    DEVICE_STATE.inc(path="screen", outcome="hit")
+    return True
+
+
+def mirror_for(ct) -> Optional[DeviceClusterTensors]:
+    """The holder currently mirroring ``ct``'s encoder chain (None when no
+    mirror exists) — introspection for tests and the bench."""
+    chain = ct.__dict__.get("_device_chain")
+    if chain is None:
+        return None
+    with _HOLDERS_LOCK:
+        h = _HOLDERS.get(id(chain))
+        return h if h is not None and h.chain is chain else None

@@ -641,6 +641,11 @@ def _emit(state: _EncoderState):
     state.emit_gpos = {int(g): k for k, g in enumerate(gids)}
     state.membership_changed = False
     state.touched_gids = set()
+    # residency chain token: downstream device-resident mirrors key their
+    # persistent buffers on the encoder identity (ops/device_state.py); a
+    # re-emitted membership change carries no patch metadata, forcing the
+    # mirror to re-upload (exactly the fallback the fast path avoids)
+    out.__dict__["_device_chain"] = state
     return out
 
 
@@ -747,6 +752,16 @@ def _emit_fast(state: _EncoderState, prev, dirty_rows: list[int]):
     )
     state.emitted = out
     state.touched_gids = set()
+    # device-residency patch metadata: the emission differs from ``prev``
+    # in EXACTLY these node positions (group-axis arrays are shared), so a
+    # device-resident mirror of ``prev`` becomes a mirror of ``out`` via
+    # one scatter update of these rows — no full re-upload
+    # (ops/device_state.py walks this chain).
+    out.__dict__["_device_chain"] = state
+    out.__dict__["_patch_base"] = prev
+    out.__dict__["_patch_positions"] = np.asarray(
+        sorted(state.emit_pos[r] for r in dirty_rows), dtype=np.int32
+    )
     return out
 
 
@@ -861,6 +876,7 @@ def _full_build(state: _EncoderState, cluster, catalog, gmax,
     state.emit_gpos = {g: g for g in range(G)}
     state.membership_changed = False
     state.touched_gids = set()
+    ct.__dict__["_device_chain"] = state
     return ct
 
 

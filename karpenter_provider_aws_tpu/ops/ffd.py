@@ -259,8 +259,7 @@ def rank_launch_options(
     return idx.astype(jnp.int16), n_valid, best_price
 
 
-@functools.partial(jax.jit, static_argnames=("max_nodes",))
-def ffd_solve(
+def _ffd_solve_impl(
     requests: jnp.ndarray,     # [G, R] float32 (FFD-sorted by encode)
     counts: jnp.ndarray,       # [G] int32
     compat: jnp.ndarray,       # [G, T] bool
@@ -309,3 +308,18 @@ def ffd_solve(
         placed=placed,
         unplaced=unplaced,
     )
+
+
+ffd_solve = functools.partial(jax.jit, static_argnames=("max_nodes",))(
+    _ffd_solve_impl
+)
+
+#: Chained-dispatch variant: DONATES ``init_state`` (argument 9), so a
+#: group-chunked solve's carry buffers update in place on device instead of
+#: allocating a fresh [N, R]/[N, Z, C] set per chunk. Callers must only pass
+#: state they own outright (the previous chunk's result) — never buffers a
+#: cache also holds (the solver's content-addressed upload cache builds the
+#: FIRST chunk's state, which therefore goes through the non-donating entry).
+ffd_solve_chained = jax.jit(
+    _ffd_solve_impl, static_argnames=("max_nodes",), donate_argnums=(9,),
+)

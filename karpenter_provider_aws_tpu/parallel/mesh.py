@@ -210,6 +210,51 @@ def screen_lanes_per_device(n_nodes: int, n_resources: int) -> int:
     return max(16, budget // per_lane)
 
 
+#: Measured per-mode screen cost on the CPU virtual mesh, keyed by
+#: node-count bucket: {bucket: {"native": best_ms, "mesh": best_ms}}. The
+#: PR 3 threshold picked native-vs-mesh by node count alone, and the cliff
+#: moved with it: at 500 nodes (under the 1024 floor) the 8-way-sharded
+#: virtual mesh measured 819ms where the native kernel answers in ~3ms —
+#: an inversion against the 5k row's 28ms. Cost, not scale, decides now.
+_SCREEN_MODE_COST: dict[int, dict[str, float]] = {}
+_LAST_SCREEN_MODE = {"mode": ""}
+
+
+def last_screen_mode() -> str:
+    """The mode the most recent ``screen_sharded`` call actually ran
+    ("native-fallback" | "mesh-chunked") — bench rows stamp it."""
+    return _LAST_SCREEN_MODE["mode"]
+
+
+def _screen_bucket(n: int) -> int:
+    b = 64
+    while b < n:
+        b *= 2
+    return b
+
+
+def _pick_screen_mode(n: int, explore_bound: int) -> str:
+    """Choose native vs mesh-chunked from MEASURED per-mode cost.
+
+    The un-measured mode is explored once per node bucket, but only while
+    its worst case is bounded: native is always cheap to try; the chunked
+    mesh path is only explored under ``explore_bound`` nodes (above it the
+    known O(N^2)-ish virtual-mesh cliff — 20s at 5k nodes — must never be
+    paid in serving just to learn it is slow). KARPENTER_TPU_MESH_SCREEN_MODE
+    pins a mode outright (tests / operators)."""
+    import os
+
+    pinned = os.environ.get("KARPENTER_TPU_MESH_SCREEN_MODE")
+    if pinned in ("native", "mesh"):
+        return pinned
+    costs = _SCREEN_MODE_COST.setdefault(_screen_bucket(n), {})
+    if "native" not in costs:
+        return "native"
+    if "mesh" not in costs and n < explore_bound:
+        return "mesh"
+    return min(costs, key=costs.get)
+
+
 def screen_sharded(ct, mesh: Mesh, lanes_per_device: Optional[int] = None) -> np.ndarray:
     """Mesh-parallel ``consolidatable``: can_delete[N] with the candidate
     axis split across the mesh devices. Exact same semantics as the
@@ -219,42 +264,86 @@ def screen_sharded(ct, mesh: Mesh, lanes_per_device: Optional[int] = None) -> np
     The candidate axis is CHUNKED to ``lanes_per_device`` lanes per dispatch
     (auto-sized via KARPENTER_TPU_MESH_LANE_BYTES) so per-device memory stays flat
     as the cluster grows. On a CPU (virtual) mesh, where D-way sharding of
-    one host's cores is pure overhead, clusters past
-    ``KARPENTER_TPU_MESH_SCREEN_NATIVE_N`` nodes fall back to the C++ screen
-    when it is available and the cluster carries no hostname caps (the
-    native kernel screens compat only) — the 5k-node virtual-mesh row went
-    from ~20s to the native kernel's tens of ms."""
+    one host's cores is pure overhead, the C++ screen substitutes whenever
+    it is available, the cluster carries no hostname caps (the native
+    kernel screens compat only), and MEASURED per-mode cost says it wins —
+    both modes are timed per node bucket (the expensive mesh explore is
+    bounded to small clusters, KARPENTER_TPU_MESH_SCREEN_NATIVE_N) and the
+    cheaper one is pinned, so neither the 5k-node 20s virtual-mesh cliff nor
+    the 500-node 819ms inversion can recur from a scale threshold alone."""
+    import logging
     import os
-
-    from ..ops.consolidate import live_slot_width, screen_cap_wire
+    import time as _time
 
     N = len(ct.node_names)
-    D = mesh.devices.size
     is_cpu_mesh = all(d.platform == "cpu" for d in mesh.devices.flat)
-    native_floor = int(os.environ.get("KARPENTER_TPU_MESH_SCREEN_NATIVE_N", 1024))
-    if is_cpu_mesh and N >= native_floor and not ct.has_topology():
+    explore_bound = int(os.environ.get("KARPENTER_TPU_MESH_SCREEN_NATIVE_N", 1024))
+    mode_costs = _SCREEN_MODE_COST.setdefault(_screen_bucket(N), {})
+    native_eligible = is_cpu_mesh and not ct.has_topology()
+    mode = (
+        _pick_screen_mode(N, explore_bound) if native_eligible else "mesh"
+    )
+    t_mode = _time.perf_counter()
+    if mode == "native":
         try:
-            from ..scheduling.native import repack_check_native
-
-            S = live_slot_width(ct.group_counts)
-            cand = np.arange(N, dtype=np.int32)
-            out = np.asarray(repack_check_native(
-                ct.free, ct.requests, ct.group_ids[:, :S],
-                ct.group_counts[:, :S], ct.compat, cand,
-            ), dtype=bool).copy()
-            out &= ~ct.blocked
+            out = _native_screen(ct, N)
+            ms = (_time.perf_counter() - t_mode) * 1e3
+            mode_costs["native"] = min(mode_costs.get("native", ms), ms)
+            _LAST_SCREEN_MODE["mode"] = "native-fallback"
             return out
         except Exception as e:
             # no native build: the chunked mesh path still answers, but say
             # so — silently re-entering the O(N^2) CPU path at 5k nodes is
-            # the 20s cliff this fallback exists to avoid
-            import logging
-
+            # the 20s cliff this fallback exists to avoid. An unusable
+            # kernel must also lose every future cost comparison.
+            mode_costs["native"] = float("inf")
             logging.getLogger("karpenter.tpu.mesh").warning(
                 "native screen fallback unavailable on the cpu mesh; "
                 "using the chunked mesh screen: %s: %s",
                 type(e).__name__, e,
             )
+    t_mode = _time.perf_counter()
+    try:
+        out = _mesh_screen(ct, mesh, lanes_per_device, N)
+    except Exception as e:
+        # a broken mesh path (e.g. no jax.shard_map in this runtime) loses
+        # every future comparison; serve via the native kernel when the
+        # cluster allows it instead of failing the sweep
+        mode_costs["mesh"] = float("inf")
+        if not native_eligible or mode_costs.get("native") == float("inf"):
+            raise
+        logging.getLogger("karpenter.tpu.mesh").warning(
+            "chunked mesh screen unavailable; using the native kernel: "
+            "%s: %s", type(e).__name__, e,
+        )
+        out = _native_screen(ct, N)
+        _LAST_SCREEN_MODE["mode"] = "native-fallback"
+        return out
+    if is_cpu_mesh:
+        ms = (_time.perf_counter() - t_mode) * 1e3
+        mode_costs["mesh"] = min(mode_costs.get("mesh", ms), ms)
+    _LAST_SCREEN_MODE["mode"] = "mesh-chunked"
+    return out
+
+
+def _native_screen(ct, N: int) -> np.ndarray:
+    from ..ops.consolidate import live_slot_width
+    from ..scheduling.native import repack_check_native
+
+    S = live_slot_width(ct.group_counts)
+    cand = np.arange(N, dtype=np.int32)
+    out = np.asarray(repack_check_native(
+        ct.free, ct.requests, ct.group_ids[:, :S],
+        ct.group_counts[:, :S], ct.compat, cand,
+    ), dtype=bool).copy()
+    out &= ~ct.blocked
+    return out
+
+
+def _mesh_screen(ct, mesh: Mesh, lanes_per_device: Optional[int], N: int) -> np.ndarray:
+    from ..ops.consolidate import live_slot_width, screen_cap_wire
+
+    D = mesh.devices.size
     lanes = lanes_per_device or screen_lanes_per_device(N, ct.free.shape[1])
     chunk = lanes * D
     if chunk >= N:

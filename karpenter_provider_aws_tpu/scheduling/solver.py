@@ -880,6 +880,7 @@ class TPUSolver:
             # open — pods must keep binding via the host FFD
             self.timings["breaker_fallback"] = "breaker:" + "+".join(names)
             self.timings["degraded"] = "host-ffd"
+            self.timings["residency"] = "fallback"
             _solver_log().warning(
                 "all device FFD breakers open (%s); serving this solve "
                 "from the host FFD path", "+".join(names),
@@ -950,6 +951,7 @@ class TPUSolver:
                 "host FFD path: %s: %s", type(e).__name__, e,
             )
         self.timings["degraded"] = "host-ffd"
+        self.timings["residency"] = "fallback"
         return host_solve_encoded(problem, existing)
 
     def _dispatch_device(
@@ -1007,9 +1009,22 @@ class TPUSolver:
             placed_chunks = []
             unplaced_chunks = []
             chunk = min(self.group_chunk, GB)
+            # chunk >= 1 carries the node state from the previous chunk's
+            # result — buffers this solve owns outright — so the chained
+            # (donating) entry updates them in place on device instead of
+            # allocating a fresh carry set per chunk. Chunk 0's state comes
+            # from the content-addressed upload cache and MUST NOT be
+            # donated (the cache would be serving dead buffers).
+            from ..ops.device_state import donate_enabled
+            from ..ops.ffd import ffd_solve_chained
+
+            donate_ok = donate_enabled()
             for start in range(0, GB, chunk):
                 sl = slice(start, start + chunk)
-                res = ffd_solve(
+                solve_fn = (
+                    ffd_solve_chained if (start and donate_ok) else ffd_solve
+                )
+                res = solve_fn(
                     self._dput(padded.requests[sl]),
                     self._dput(padded.counts[sl]),
                     self._dput(padded.compat[sl]),
@@ -1333,6 +1348,14 @@ class TPUSolver:
         self.timings["device_ms"] = self.timings.get("device_ms", 0.0) + (
             (time.perf_counter() - t_dev) * 1e3
         )
+        # input residency for provenance: a solve whose every _dput was a
+        # content-cache hit shipped NOTHING over the link ("resident"); any
+        # cache miss paid an upload. A breaker/device fallback already
+        # stamped "fallback" and keeps it.
+        if self.timings.get("residency") != "fallback":
+            self.timings["residency"] = (
+                "upload" if self.timings.get("upload_bytes") else "resident"
+            )
         self.timings["n_rows"] = self.timings.get("n_rows", 0) + N + pre_extra
         self.timings["n_open"] = self.timings.get("n_open", 0) + n_open
         self._n_open_hist[hist_key] = n_open - n_pre

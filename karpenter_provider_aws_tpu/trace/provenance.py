@@ -85,6 +85,14 @@ class ProvenanceRecord:
     backend: str = "host"              # xla-scan | pallas | pallas-interpret |
     #                                    host | sidecar | vmap | native | mesh
     fallback: str = ""                 # non-empty = a fallback fired (reason)
+    # Where the input tensors lived when the kernel ran (ops/device_state.py):
+    #   resident — served from device-resident state (hit or scatter patch;
+    #              no host re-upload of the big buffers)
+    #   upload   — the pass paid a full host->device upload
+    #   fallback — the device-residency layer was off/unusable; the legacy
+    #              host-buffer path ran
+    # Empty on paths that predate (or don't use) the residency layer.
+    residency: str = ""
     scale: dict = field(default_factory=dict)    # pods/groups/nodes/rows...
     phases_ms: dict = field(default_factory=dict)  # encode/upload/device/decode
     wall_ms: float = 0.0
@@ -116,6 +124,8 @@ class ProvenanceRecord:
             "created_unix": int(self.created_unix),
             "schema": self.schema,
         }
+        if self.residency:
+            d["residency"] = self.residency
         if self.context:
             d["context"] = dict(self.context)
         if self.quality:
@@ -179,6 +189,7 @@ def solve_record(
     wall_ms: float = 0.0,
     fallback: str = "",
     extra_scale: Optional[dict] = None,
+    residency: str = "",
 ) -> ProvenanceRecord:
     """Build + register the provenance for one end-to-end solve."""
     device, count = device_info()
@@ -203,9 +214,16 @@ def solve_record(
             if isinstance(v, str) and v:
                 fallback = v
                 break
+    if not residency:
+        # solvers note their input residency in timings (TPUSolver: the
+        # content-addressed device cache; degraded/host paths: "fallback")
+        v = timings.get("residency")
+        if isinstance(v, str):
+            residency = v
     return record(ProvenanceRecord(
         kind="solve", device=device, device_count=count, backend=backend,
         fallback=fallback, scale=scale, phases_ms=phases, wall_ms=wall_ms,
+        residency=residency,
     ))
 
 
@@ -215,13 +233,14 @@ def screen_record(
     wall_ms: float,
     fallback: str = "",
     phases_ms: Optional[dict] = None,
+    residency: str = "",
 ) -> ProvenanceRecord:
     """Build + register the provenance for one consolidation screen sweep."""
     device, count = device_info()
     return record(ProvenanceRecord(
         kind="consolidate.screen", device=device, device_count=count,
         backend=backend, fallback=fallback, scale={"nodes": int(nodes)},
-        phases_ms=dict(phases_ms or {}), wall_ms=wall_ms,
+        phases_ms=dict(phases_ms or {}), wall_ms=wall_ms, residency=residency,
     ))
 
 

@@ -1,0 +1,459 @@
+"""Device-resident cluster state (ops/device_state.py) + PR 6 satellites:
+
+ - exactness: the scatter-patched device mirror vs the host encoder
+   (randomized-churn property test with the in-path verify knob armed)
+ - buffer donation & aliasing: screening twice from one mirror, interleaved
+   provisioning/consolidation chains, and post-donation access of stale
+   handles (the donate_argnums contract)
+ - tier-1 /metrics guard: two identical disruption passes increment the
+   device-state cache-hit counter (mirrors the PR 3 encode guard)
+ - chaos same-seed byte-identical invariant with KARPENTER_TPU_DEVICE_STATE=1
+ - measured-cost screen-mode selection (the multichip 500-node inversion)
+ - BENCH_SUMMARY stale markers for superseded [UNSTAMPED] rows
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from karpenter_provider_aws_tpu.metrics import DEVICE_STATE
+from karpenter_provider_aws_tpu.models.pod import make_pods
+from karpenter_provider_aws_tpu.ops import device_state
+from karpenter_provider_aws_tpu.ops.consolidate import (
+    consolidatable,
+    dispatch_screen,
+    encode_cluster,
+)
+from karpenter_provider_aws_tpu.ops.device_state import (
+    acquire_screen_tensors,
+    mirror_for,
+    reset_device_state,
+    verify_mirror,
+)
+
+
+def _outcomes():
+    return {
+        k: DEVICE_STATE.value(path="screen", outcome=k)
+        for k in ("hit", "patch", "upload", "fallback")
+    }
+
+
+def _synth(n_nodes=120):
+    from benchmarks.solve_configs import _synth_cluster
+
+    return _synth_cluster(n_nodes=n_nodes)
+
+
+def _host_mask(ct, monkeypatch):
+    """The legacy host-buffer screen answer (kill switch on)."""
+    import os
+
+    prev = os.environ.get("KARPENTER_TPU_DEVICE_STATE")
+    os.environ["KARPENTER_TPU_DEVICE_STATE"] = "0"
+    try:
+        ct.__dict__.pop("_screen_mask_memo", None)
+        out = consolidatable(ct)
+    finally:
+        if prev is None:
+            os.environ.pop("KARPENTER_TPU_DEVICE_STATE", None)
+        else:
+            os.environ["KARPENTER_TPU_DEVICE_STATE"] = prev
+    ct.__dict__.pop("_screen_mask_memo", None)
+    return out
+
+
+@pytest.fixture(autouse=True)
+def _fresh_mirrors():
+    reset_device_state()
+    yield
+    reset_device_state()
+
+
+class TestResidencyOutcomes:
+    def test_upload_hit_patch_sequence(self):
+        env = _synth()
+        cl = env.cluster
+        c0 = _outcomes()
+        ct = encode_cluster(cl, env.catalog)
+        m1 = consolidatable(ct)
+        assert _outcomes()["upload"] == c0["upload"] + 1
+        # unchanged pass: same emission object -> resident hit, same answer
+        ct2 = encode_cluster(cl, env.catalog)
+        assert ct2 is ct
+        m2 = consolidatable(ct2)
+        assert _outcomes()["hit"] == c0["hit"] + 1
+        assert (m1 == m2).all()
+        # one bind -> journal patch -> device scatter patch
+        names = [n.name for n in cl.snapshot_nodes()]
+        p = make_pods(1, "ds", {"cpu": "250m", "memory": "512Mi"})[0]
+        cl.apply(p)
+        cl.bind_pod(p.uid, names[3])
+        ct3 = encode_cluster(cl, env.catalog)
+        consolidatable(ct3)
+        assert _outcomes()["patch"] == c0["patch"] + 1
+        assert verify_mirror(mirror_for(ct3), ct3) == []
+
+    def test_kill_switch_counts_fallback_and_matches(self, monkeypatch):
+        env = _synth()
+        ct = encode_cluster(env.cluster, env.catalog)
+        on = consolidatable(ct)
+        monkeypatch.setenv("KARPENTER_TPU_DEVICE_STATE", "0")
+        ct.__dict__.pop("_screen_mask_memo", None)
+        c0 = _outcomes()
+        off = consolidatable(ct)
+        assert _outcomes()["fallback"] == c0["fallback"] + 1
+        assert (on == off).all()
+
+    def test_membership_change_forces_upload(self):
+        from karpenter_provider_aws_tpu.models.nodeclaim import NodeClaim
+        from karpenter_provider_aws_tpu.models import labels as lbl
+        from karpenter_provider_aws_tpu.state.cluster import Node
+
+        env = _synth()
+        cl = env.cluster
+        ct = encode_cluster(cl, env.catalog)
+        consolidatable(ct)
+        it = env.catalog.get("m5.large")
+        claim = NodeClaim.fresh(
+            nodepool_name="default", nodeclass_name="default",
+            instance_type_options=[it.name], zone_options=["zone-a"],
+            capacity_type_options=["spot"],
+        )
+        claim.status.provider_id = "cloud:///zone-a/i-new"
+        claim.status.capacity = it.capacity()
+        claim.status.allocatable = env.catalog.allocatable(it)
+        claim.labels.update(it.labels())
+        claim.labels[lbl.TOPOLOGY_ZONE] = "zone-a"
+        claim.labels[lbl.CAPACITY_TYPE] = "spot"
+        claim.status.set_condition("Launched", True)
+        claim.status.set_condition("Registered", True)
+        cl.apply(claim)
+        node = Node(
+            name="node-new", provider_id=claim.status.provider_id,
+            nodepool_name="default", nodeclaim_name=claim.name,
+            labels=dict(claim.labels), capacity=claim.status.capacity,
+            allocatable=claim.status.allocatable, ready=True,
+        )
+        claim.status.node_name = node.name
+        cl.apply(node)
+        ct2 = encode_cluster(cl, env.catalog)
+        c0 = _outcomes()
+        consolidatable(ct2)
+        assert _outcomes()["upload"] == c0["upload"] + 1
+        assert verify_mirror(mirror_for(ct2), ct2) == []
+
+    def test_chain_walk_patches_across_skipped_screens(self):
+        """Two journal deltas land between screens: the mirror walks the
+        _patch_base chain and applies the merged row set in one scatter."""
+        env = _synth()
+        cl = env.cluster
+        names = [n.name for n in cl.snapshot_nodes()]
+        ct = encode_cluster(cl, env.catalog)
+        consolidatable(ct)
+        for k in (1, 2):
+            # the synth fill shape: binds stay within the existing group,
+            # so both deltas are pure row patches (no membership change)
+            p = make_pods(1, f"cw{k}", {"cpu": "250m", "memory": "512Mi"})[0]
+            cl.apply(p)
+            cl.bind_pod(p.uid, names[k])
+            ct = encode_cluster(cl, env.catalog)  # no screen between
+        c0 = _outcomes()
+        consolidatable(ct)
+        assert _outcomes()["patch"] == c0["patch"] + 1
+        assert verify_mirror(mirror_for(ct), ct) == []
+
+
+class TestRandomizedChurnExactness:
+    @pytest.mark.parametrize("seed", [3, 11, 29])
+    def test_property_scatter_patched_mirror_is_exact(self, seed, monkeypatch):
+        """Randomized churn through the sanctioned mutation surface; every
+        pass the device mirror must equal the host tensors EXACTLY (the
+        verify knob raises in-path on any divergence), the screen answer
+        must match the kill-switch host path, and the incremental emission
+        must stay canonical-equal to a from-scratch encode."""
+        from karpenter_provider_aws_tpu.ops.consolidate import _encode_cluster
+        from karpenter_provider_aws_tpu.ops.encode_delta import (
+            canonical_equal,
+            canonical_form,
+        )
+
+        monkeypatch.setenv("KARPENTER_TPU_DEVICE_STATE_VERIFY", "1")
+        env = _synth(n_nodes=60)
+        cl = env.cluster
+        names = [n.name for n in cl.snapshot_nodes()]
+        rng = np.random.RandomState(seed)
+        ct = encode_cluster(cl, env.catalog)
+        consolidatable(ct)
+        for it in range(8):
+            for _ in range(rng.randint(1, 5)):
+                r = rng.rand()
+                if r < 0.45:
+                    p = make_pods(1, "prop", {"cpu": "100m", "memory": "64Mi"})[0]
+                    cl.apply(p)
+                    cl.bind_pod(p.uid, names[rng.randint(len(names))])
+                elif r < 0.8:
+                    bound = [pp for pp in list(cl.pods.values())[:128]
+                             if pp.node_name]
+                    if bound:
+                        cl.unbind_pod(bound[rng.randint(len(bound))].uid)
+                else:
+                    node = cl.nodes[names[rng.randint(len(names))]]
+                    node.cordoned = not node.cordoned
+            ct = encode_cluster(cl, env.catalog)
+            mask = consolidatable(ct)
+            assert (mask == _host_mask(ct, monkeypatch)).all(), f"iter {it}"
+            fresh = _encode_cluster(cl, env.catalog, 32)
+            assert not canonical_equal(canonical_form(ct), canonical_form(fresh))
+            if ct is not None:
+                h = mirror_for(ct)
+                if h is not None and h.arrays() is not None:
+                    assert verify_mirror(h, ct) == []
+
+
+class TestDonationAliasing:
+    """The donate_argnums contract (satellite): donated patches update in
+    place; the holder is the single owner; stale refs degrade, not crash."""
+
+    @pytest.fixture(autouse=True)
+    def _force_donation(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_DEVICE_DONATE", "1")
+        device_state._patch_fns.clear()
+        yield
+        device_state._patch_fns.clear()
+
+    def test_two_screens_from_same_mirror(self, monkeypatch):
+        """Screening twice from the same DeviceClusterTensors (hit path)
+        must be exact both times — donation must never fire on a hit."""
+        env = _synth()
+        ct = encode_cluster(env.cluster, env.catalog)
+        m1 = consolidatable(ct)
+        ct.__dict__.pop("_screen_mask_memo", None)
+        m2 = consolidatable(ct)
+        assert (m1 == m2).all()
+        assert (m1 == _host_mask(ct, monkeypatch)).all()
+
+    def test_donated_patch_updates_in_place_and_invalidates_old_refs(self, monkeypatch):
+        env = _synth()
+        cl = env.cluster
+        names = [n.name for n in cl.snapshot_nodes()]
+        ct = encode_cluster(cl, env.catalog)
+        consolidatable(ct)
+        holder = mirror_for(ct)
+        old = holder.arrays()
+        assert old is not None
+        old_free = old[0]
+        p = make_pods(1, "don", {"cpu": "250m", "memory": "512Mi"})[0]
+        cl.apply(p)
+        cl.bind_pod(p.uid, names[0])
+        ct2 = encode_cluster(cl, env.catalog)
+        mask = consolidatable(ct2)  # scatter patch with donation
+        # the donated input buffer is dead; the holder serves the live one
+        assert old_free.is_deleted()
+        assert holder.arrays() is not None
+        assert verify_mirror(holder, ct2) == []
+        assert (mask == _host_mask(ct2, monkeypatch)).all()
+
+    def test_interleaved_provisioning_consolidation_chains(self, monkeypatch):
+        """Provisioning solves (TPUSolver, device-cached uploads + chained
+        chunk dispatch) interleaved with donated screen patches must stay
+        exact vs the host paths throughout."""
+        from karpenter_provider_aws_tpu.models import (
+            NodePool, Operator, Requirement,
+        )
+        from karpenter_provider_aws_tpu.models import labels as lbl
+        from karpenter_provider_aws_tpu.ops.encode import encode_problem
+        from karpenter_provider_aws_tpu.scheduling.solver import (
+            TPUSolver, host_solve_encoded,
+        )
+
+        env = _synth()
+        cl = env.cluster
+        names = [n.name for n in cl.snapshot_nodes()]
+        pool = NodePool(name="default", requirements=[
+            Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m")),
+        ])
+        # small group chunk so the chained (donating) ffd entry engages
+        solver = TPUSolver(group_chunk=2, max_nodes=64)
+        for it in range(3):
+            pods = make_pods(24, f"mix{it}", {"cpu": "500m", "memory": "512Mi"})
+            for i, p in enumerate(pods):  # distinct shapes -> several groups
+                p.requests = p.requests * 1.0
+            problem = encode_problem(pods, env.catalog, nodepool=pool)
+            specs, binds, unplaced = solver.solve_encoded(problem)
+            h_specs, h_binds, h_unplaced = host_solve_encoded(problem)
+            placed = sum(len(s.pods) for s in specs)
+            h_placed = sum(len(s.pods) for s in h_specs)
+            assert placed == len(pods) and h_placed == len(pods)
+            assert unplaced == h_unplaced == {}
+            p = make_pods(1, f"chain{it}", {"cpu": "100m", "memory": "128Mi"})[0]
+            cl.apply(p)
+            cl.bind_pod(p.uid, names[it])
+            ct = encode_cluster(cl, env.catalog)
+            mask = consolidatable(ct)
+            assert (mask == _host_mask(ct, monkeypatch)).all(), f"iter {it}"
+
+    def test_stale_handle_access_degrades_to_upload_not_crash(self):
+        """A mirror whose buffers were deleted out from under it (lost
+        device session / double donation) must report unusable and the next
+        acquire must re-upload — never serve dead refs or crash."""
+        env = _synth()
+        cl = env.cluster
+        ct = encode_cluster(cl, env.catalog)
+        consolidatable(ct)
+        holder = mirror_for(ct)
+        for b in (holder.free, holder.gids, holder.gcounts,
+                  holder.cap, holder.requests):
+            b.delete()
+        assert holder.arrays() is None  # stale handle: unusable, not a crash
+        c0 = _outcomes()
+        arrays, residency = acquire_screen_tensors(ct)
+        assert arrays is not None and residency == "upload"
+        assert _outcomes()["upload"] == c0["upload"] + 1
+        ct.__dict__.pop("_screen_mask_memo", None)
+        mask = consolidatable(ct)
+        assert mask.shape == (len(ct.node_names),)
+
+
+class TestMetricsGuardTier1:
+    def test_two_identical_passes_increment_device_state_hit(self):
+        """Tier-1 guard (mirrors the PR 3 encode guard): a second identical
+        disruption reconcile must serve the screen from the device-resident
+        state, visible as a cache-hit increment at /metrics over HTTP."""
+        import urllib.request
+
+        from karpenter_provider_aws_tpu.metrics import REGISTRY
+
+        env = _synth(n_nodes=40)
+        pool = env.cluster.nodepools["default"]
+        pool.disruption.consolidate_after_s = 60
+        pool.disruption.budgets = ["0%"]
+        env.clock.advance(120)
+
+        def scrape(port):
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics").read().decode()
+            for line in body.splitlines():
+                if line.startswith("karpenter_device_state_total") and \
+                        'outcome="hit"' in line and 'path="screen"' in line:
+                    return float(line.rsplit(" ", 1)[1])
+            return 0.0
+
+        port = REGISTRY.serve(0)
+        try:
+            env.disruption.reconcile()
+            h1 = scrape(port)
+            env.disruption.reconcile()
+            h2 = scrape(port)
+        finally:
+            REGISTRY.stop()
+        assert h2 > h1, (
+            "second identical reconcile did not hit the device-resident "
+            f"state ({h1} -> {h2})"
+        )
+
+
+@pytest.mark.slow
+class TestChaosDeterminismWithDeviceState:
+    def test_same_seed_byte_identical_with_device_state(self, monkeypatch):
+        """The chaos same-seed invariant must hold with the residency layer
+        on AND self-verifying: two spot-storm runs, identical signatures."""
+        from karpenter_provider_aws_tpu.chaos import run_deterministic
+
+        monkeypatch.setenv("KARPENTER_TPU_DEVICE_STATE", "1")
+        monkeypatch.setenv("KARPENTER_TPU_DEVICE_STATE_VERIFY", "1")
+        a, b = run_deterministic("spot-storm", seed=7, runs=2)
+        assert a.signature == b.signature
+        assert len(a.signature) > 0
+
+
+class TestScreenModeCost:
+    """Satellite: the CPU-virtual-mesh screen mode comes from measured
+    per-mode cost, not node count alone (the 500-node inversion)."""
+
+    def setup_method(self):
+        from karpenter_provider_aws_tpu.parallel import mesh
+
+        mesh._SCREEN_MODE_COST.clear()
+
+    def test_explore_then_pick_cheaper(self):
+        from karpenter_provider_aws_tpu.parallel.mesh import (
+            _SCREEN_MODE_COST,
+            _pick_screen_mode,
+            _screen_bucket,
+        )
+
+        n = 500
+        b = _screen_bucket(n)
+        assert _pick_screen_mode(n, 1024) == "native"      # explore native
+        _SCREEN_MODE_COST[b]["native"] = 3.0
+        assert _pick_screen_mode(n, 1024) == "mesh"        # explore mesh once
+        _SCREEN_MODE_COST[b]["mesh"] = 800.0
+        assert _pick_screen_mode(n, 1024) == "native"      # measured winner
+        # an inverted measurement flips the choice — cost decides, not scale
+        _SCREEN_MODE_COST[b]["mesh"] = 1.0
+        assert _pick_screen_mode(n, 1024) == "mesh"
+
+    def test_expensive_explore_is_bounded(self):
+        from karpenter_provider_aws_tpu.parallel.mesh import (
+            _SCREEN_MODE_COST,
+            _pick_screen_mode,
+            _screen_bucket,
+        )
+
+        n = 5000
+        _SCREEN_MODE_COST[_screen_bucket(n)] = {"native": 28.0}
+        # above the bound the un-measured mesh cliff is never explored
+        assert _pick_screen_mode(n, 1024) == "native"
+
+    def test_env_pin_wins(self, monkeypatch):
+        from karpenter_provider_aws_tpu.parallel.mesh import _pick_screen_mode
+
+        monkeypatch.setenv("KARPENTER_TPU_MESH_SCREEN_MODE", "mesh")
+        assert _pick_screen_mode(5000, 1024) == "mesh"
+
+
+class TestReportStaleMarkers:
+    """Satellite: superseded [UNSTAMPED] headline rows are visibly marked
+    stale once a stamped successor row exists for the same config."""
+
+    def _rows(self):
+        return [
+            {"benchmark": "config1", "p99_ms": 72.9, "scale": 1.0,
+             "run_at_unix": 100},                       # unstamped, full-scale
+            {"benchmark": "config1", "p99_ms": 9.1, "scale": 0.15,
+             "run_at_unix": 200,
+             "provenance": {"device": "cpu", "backend": "xla-scan",
+                            "git_sha": "abc"}},        # stamped successor
+            {"benchmark": "config2", "p99_ms": 5.0, "scale": 1.0,
+             "run_at_unix": 100,
+             "provenance": {"device": "cpu", "backend": "host",
+                            "git_sha": "abc"}},        # stamped, selected
+        ]
+
+    def test_select_marks_superseded_unstamped_rows(self):
+        from benchmarks.report import select
+
+        selected, stale = select(self._rows())
+        # full-scale preference still wins selection...
+        assert selected["config1"]["run_at_unix"] == 100
+        # ...but the unstamped selection is flagged with its successor
+        assert "config1" in stale
+        assert stale["config1"]["provenance"]["backend"] == "xla-scan"
+        # stamped selections are never flagged
+        assert "config2" not in stale
+
+    def test_no_successor_no_flag(self):
+        from benchmarks.report import select
+
+        rows = [{"benchmark": "x", "scale": 1.0, "run_at_unix": 100}]
+        selected, stale = select(rows)
+        assert "x" in selected and not stale
+
+    def test_stale_note_renders(self):
+        from benchmarks.report import select, stale_note
+
+        _, stale = select(self._rows())
+        note = stale_note(stale["config1"])
+        assert "STALE" in note and "cpu/xla-scan" in note

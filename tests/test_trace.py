@@ -329,8 +329,13 @@ class TestBenchStampEnforcement:
         bench = self._bench()
         with pytest.raises(ValueError, match="provenance"):
             bench.emit({"metric": "p99", "value": 1.0})
-        row = bench.stamp({"metric": "p99", "value": 1.0})
+        # a stamp that resolves to backend=unknown is refused too — the
+        # [cpu/unknown@...] rows this retired must name a real backend
+        with pytest.raises(ValueError, match="unknown backend"):
+            bench.emit(bench.stamp({"metric": "p99", "value": 1.0}))
+        row = bench.stamp({"metric": "p99", "value": 1.0, "backend": "host"})
         bench.emit(row)
         out = capsys.readouterr().out.strip()
         parsed = json.loads(out)
         assert parsed["provenance"]["git_sha"] == git_sha()
+        assert parsed["provenance"]["backend"] == "host"
