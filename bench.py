@@ -522,6 +522,32 @@ def child_device_state() -> None:
         run_device_state(scale=scale, on_row=on_row)
 
 
+def child_scale() -> None:
+    """config9 scale-tier row: partitioned encode + lanes solve + merge at
+    100k nodes (benchmarks/scale_bench.py). Heavy — runs in its own
+    subprocess with the standard hard timeout; the row streams as soon as
+    it is measured."""
+    import contextlib
+
+    _force_cpu_if_asked()
+    _enable_jit_cache()
+
+    from benchmarks.scale_bench import run_all as run_scale
+
+    scale = float(os.environ.get("BENCH_SCALE_TIER_SCALE", "1.0"))
+    at = {"run_at_unix": int(time.time()), "scale": scale}
+
+    def on_row(row):
+        if "provenance" not in row:
+            stamp(row)
+        check_backend(row)
+        with open(DETAIL_PATH, "a") as f:
+            f.write(json.dumps({**row, **at}) + "\n")
+
+    with contextlib.redirect_stdout(sys.stderr):
+        run_scale(scale=scale, on_row=on_row)
+
+
 def child_multichip() -> None:
     """Virtual-mesh rows (sharded solve+merge, sharded 5k screen) — host
     only, stream to BENCH_DETAIL.jsonl."""
@@ -740,6 +766,17 @@ def main() -> None:
         if err:
             errors.append(err)
 
+    # config9 scale tier (100k nodes): opt-in via BENCH_PHASES=...,scale —
+    # the build alone is minutes of host work, too heavy for the default
+    # driver budget; its rows stream so a timeout loses nothing measured.
+    if "scale" in phases:
+        _, err = run_child(
+            "scale", min(900.0, _remaining() - SAFETY_MARGIN_S),
+            env_extra={"BENCH_FORCE_CPU": "1"},
+        )
+        if err:
+            errors.append(err)
+
     # Phase B: CPU headline at reduced scale — ALWAYS produces a fallback
     # headline before any accelerator is touched.
     cpu_line = None
@@ -830,7 +867,7 @@ if __name__ == "__main__":
             try:
                 {"host": child_host, "measure": child_measure,
                  "configs": child_configs, "multichip": child_multichip,
-                 "encode": child_encode,
+                 "encode": child_encode, "scale": child_scale,
                  "device_state": child_device_state}[child]()
             except Exception as e:
                 traceback.print_exc()

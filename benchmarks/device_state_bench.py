@@ -176,6 +176,11 @@ def bench_chained_dispatch(n_nodes=2000, iters=15) -> dict:
     gc.collect()
     gc.freeze()
     gc.disable()
+    # pin the chained path for the measurement: the serving-path chooser
+    # (KARPENTER_TPU_CHAINED_SCREEN unset) would explore the unchained mode
+    # mid-run and pollute the per-mode numbers this row exists to separate
+    prev_pin = os.environ.get("KARPENTER_TPU_CHAINED_SCREEN")
+    os.environ["KARPENTER_TPU_CHAINED_SCREEN"] = "1"
     try:
         with force_repack_backend("vmap"):
             reset_device_state()
@@ -192,6 +197,10 @@ def bench_chained_dispatch(n_nodes=2000, iters=15) -> dict:
                 else:  # restore a pre-existing pin
                     os.environ["KARPENTER_TPU_DEVICE_STATE"] = prev
     finally:
+        if prev_pin is None:
+            os.environ.pop("KARPENTER_TPU_CHAINED_SCREEN", None)
+        else:
+            os.environ["KARPENTER_TPU_CHAINED_SCREEN"] = prev_pin
         gc.enable()
         gc.unfreeze()
 

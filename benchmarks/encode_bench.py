@@ -54,7 +54,7 @@ def bench_incremental_encode(n_nodes=5000, churn_frac=0.01, iters=30) -> dict:
     gc.freeze()
     gc.disable()
     try:
-        c0 = {k: ENCODE_CACHE.value(path="cluster", outcome=k)
+        c0 = {k: ENCODE_CACHE.sum(path="cluster", outcome=k)
               for k in ("hit", "patch", "full")}
         t0 = time.perf_counter()
         encode_cluster(cl, env.catalog)
@@ -85,7 +85,7 @@ def bench_incremental_encode(n_nodes=5000, churn_frac=0.01, iters=30) -> dict:
         inc = encode_cluster(cl, env.catalog)
         fresh = _encode_cluster(cl, env.catalog, 32)
         diffs = canonical_equal(canonical_form(inc), canonical_form(fresh))
-        c1 = {k: ENCODE_CACHE.value(path="cluster", outcome=k)
+        c1 = {k: ENCODE_CACHE.sum(path="cluster", outcome=k)
               for k in ("hit", "patch", "full")}
     finally:
         gc.enable()

@@ -43,16 +43,32 @@ def _key(row: dict) -> str | None:
     return key
 
 
+#: UNSTAMPED rows superseded by a DIFFERENTLY-NAMED stamped row: the
+#: successor family measures the same question (the multichip screen rows
+#: were re-measured at 500/5000 nodes under the measured-cost mode chooser;
+#: the native_* solve rows are covered by the stamped config sweep, whose
+#: provenance names the kernel that ran). Marked stale exactly like
+#: same-key headline rows — an unattributable number must never read as
+#: current once an attributable replacement exists.
+SUPERSEDED_BY = {
+    "multichip_8dev_200node_screen": "multichip_8dev_500node_screen",
+    "multichip_8dev_250node_screen": "multichip_8dev_500node_screen",
+    "native_config1_2k": "config1_homogeneous_2k",
+    "native_config2_50k": "config2_heterogeneous_50k",
+}
+
+
 def select(rows: list[dict]) -> tuple[dict[str, dict], dict[str, dict]]:
     """(selected, stale) per benchmark key.
 
     Selection keeps the PR 1 rule: prefer full-scale rows; within a scale
     the newest wins. ``stale`` marks keys whose SELECTED row is UNSTAMPED
-    (no provenance) while a stamped successor — any scale — exists with a
-    newer-or-equal timestamp: the headline number predates the provenance
-    contract and a measured, attributable replacement is on file, so the
-    summary must say the old figure is stale instead of letting the
-    full-scale preference keep republishing it as current."""
+    (no provenance) while a stamped successor — same key, or the
+    ``SUPERSEDED_BY`` successor family — exists with a newer-or-equal
+    timestamp: the headline number predates the provenance contract and a
+    measured, attributable replacement is on file, so the summary must say
+    the old figure is stale instead of letting the full-scale preference
+    keep republishing it as current."""
     selected: dict[str, dict] = {}
     best_stamped: dict[str, dict] = {}
     for row in rows:
@@ -77,6 +93,8 @@ def select(rows: list[dict]) -> tuple[dict[str, dict], dict[str, dict]]:
         if isinstance(row.get("provenance"), dict):
             continue
         succ = best_stamped.get(key)
+        if succ is None:
+            succ = best_stamped.get(SUPERSEDED_BY.get(key, ""))
         if succ is not None and (
             succ.get("run_at_unix", 0) >= row.get("run_at_unix", 0)
         ):
@@ -106,6 +124,12 @@ def fmt(row: dict) -> str:
               "upload_ms", "patch_vs_upload",
               "chained_p50_ms", "chained_p99_ms", "dispatch_p50_ms",
               "unchained_p50_ms", "unchained_p99_ms",
+              # scale-tier rows (designs/sharded-scale.md): per-partition
+              # encode / lanes solve / cross-partition merge breakdown
+              "partitions", "lanes", "lanes_mode", "solve_lanes_ms",
+              "merge_ms", "screen_partition_ms", "screen_partition_nodes",
+              "global_unsharded_encode_ms", "steady_state_incremental",
+              "exactness_ok",
               # lifecycle-SLI columns (docs/observability.md): virtual-
               # seconds time-to-bind/ready through the controller stack
               "bind_count", "unbound", "ready_count", "p50_s", "p99_s",
@@ -134,13 +158,18 @@ def fmt(row: dict) -> str:
     return " · ".join(bits)
 
 
-def stale_note(succ: dict) -> str:
+def stale_note(succ: dict, key: str = "") -> str:
     date = time.strftime("%Y-%m-%d", time.gmtime(succ.get("run_at_unix", 0)))
     scale = succ.get("scale", 1.0)
     prov = succ.get("provenance") or {}
     label = f"{prov.get('device', '?')}/{prov.get('backend', '?')}"
+    succ_key = succ.get("benchmark") or succ.get("metric") or ""
+    # a cross-family supersession names its successor row outright
+    who = (
+        f"**{succ_key}** " if key and succ_key and succ_key != key else ""
+    )
     return (
-        f"**[STALE — superseded by stamped {date} row "
+        f"**[STALE — superseded by stamped {date} {who}row "
         f"(scale={scale}, {label})]**"
     )
 
@@ -161,7 +190,7 @@ def main() -> None:
         )
         line = f"- **{key}** ({stamp}): {fmt(row)}"
         if key in stale:
-            line += " · " + stale_note(stale[key])
+            line += " · " + stale_note(stale[key], key=key)
         lines.append(line)
     (ROOT / "BENCH_SUMMARY.md").write_text("\n".join(lines) + "\n")
     print(f"wrote BENCH_SUMMARY.md ({len(selected)} benchmarks)")

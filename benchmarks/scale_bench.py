@@ -1,0 +1,242 @@
+"""100k-node scale-tier benchmark (config9): partitioned encode, lanes
+solve, cross-partition merge.
+
+Everything before this tier was sized for ~5k nodes; config9 measures the
+partition-aware path end to end on a synthetic 100k-node / ~250k-pod
+cluster spread over the catalog's zones:
+
+ - ``full_encode_ms``        — cold partitioned build (every partition's
+   chain built once, merged)
+ - ``encode_patch_p50/99_ms`` — steady-state merged emission under ~1%
+   node churn routed through the per-partition journals. The acceptance
+   bound is that steady churn stays INCREMENTAL: the per-pass outcomes
+   carry in ``cache_outcomes`` and ``steady_state_incremental`` is True
+   only when no pass fell back to a full re-encode.
+ - ``exactness_ok``          — the merged partitioned emission compared
+   ``canonical_equal`` against a from-scratch GLOBAL encode at the end of
+   the churn run (the sharded-vs-unsharded contract at full scale)
+ - ``solve_lanes_ms``        — a pending-pod burst split per zone, every
+   zone's FFD problem solved as one vmapped/shard_mapped partition-lane
+   program (parallel/mesh.py)
+ - ``merge_ms`` / ``cost_lanes`` / ``cost_merged`` — the cross-partition
+   packed-cost merge over the flattened lane plans
+ - ``screen_partition_ms``   — one partition's repack screen on the
+   native kernel (the partition-local serving cost; the global N^2 sweep
+   is exactly what the partition split exists to avoid)
+ - ``per_partition``         — per-partition node counts and encode
+   outcome tallies (the breakdown columns)
+
+Rows stream via ``on_row`` like every other phase.
+"""
+
+from __future__ import annotations
+
+import gc
+import json
+import os
+import time
+
+import numpy as np
+
+
+def bench_scale(n_nodes=100_000, churn_frac=0.01, iters=10,
+                pods_per_node=4) -> dict:
+    os.environ.setdefault("KARPENTER_TPU_PARTITION_ENCODE", "1")
+    from benchmarks.solve_configs import _synth_cluster
+    from karpenter_provider_aws_tpu.metrics import ENCODE_CACHE
+    from karpenter_provider_aws_tpu.models import labels as lbl
+    from karpenter_provider_aws_tpu.models.pod import make_pods
+    from karpenter_provider_aws_tpu.ops.consolidate import (
+        _encode_cluster,
+        dispatch_screen,
+        encode_cluster,
+        force_repack_backend,
+    )
+    from karpenter_provider_aws_tpu.ops.encode import encode_problem, pad_problem
+    from karpenter_provider_aws_tpu.ops.encode_delta import (
+        canonical_equal,
+        canonical_form,
+    )
+    from karpenter_provider_aws_tpu.ops.ffd import _State
+    from karpenter_provider_aws_tpu.parallel.mesh import (
+        lanes_mode,
+        merge_partition_plans,
+        solve_partition_lanes,
+        stack_lane_problems,
+    )
+
+    t_build0 = time.perf_counter()
+    env = _synth_cluster(n_nodes=n_nodes, pods_per_node=pods_per_node)
+    cl = env.cluster
+    build_s = time.perf_counter() - t_build0
+    names = [n.name for n in cl.snapshot_nodes()]
+    rng = np.random.RandomState(23)
+    churn = max(1, int(n_nodes * churn_frac))
+
+    def outcomes():
+        out = {}
+        for path in ("cluster", "cluster_part"):
+            out[path] = {
+                k: ENCODE_CACHE.sum(path=path, outcome=k)
+                for k in ("hit", "patch", "full")
+            }
+        return out
+
+    gc.collect()
+    gc.freeze()
+    gc.disable()
+    try:
+        t0 = time.perf_counter()
+        ct = encode_cluster(cl, env.catalog)
+        full_ms = (time.perf_counter() - t0) * 1e3
+        parts = ct.__dict__.get("_partitions", [])
+        t0 = time.perf_counter()
+        encode_cluster(cl, env.catalog)
+        hit_ms = (time.perf_counter() - t0) * 1e3
+
+        c0 = outcomes()
+        times = []
+        for it in range(iters):
+            for _ in range(churn):
+                if rng.rand() < 0.5:
+                    p = make_pods(1, f"sc{it}",
+                                  {"cpu": "250m", "memory": "512Mi"})[0]
+                    cl.apply(p)
+                    cl.bind_pod(p.uid, names[rng.randint(len(names))])
+                else:
+                    bound = [pp for pp in list(cl.pods.values())[:512]
+                             if pp.node_name]
+                    if bound:
+                        cl.unbind_pod(bound[rng.randint(len(bound))].uid)
+            t0 = time.perf_counter()
+            ct = encode_cluster(cl, env.catalog)
+            times.append((time.perf_counter() - t0) * 1e3)
+        c1 = outcomes()
+        steady = {
+            path: {k: int(c1[path][k] - c0[path][k]) for k in c1[path]}
+            for path in c1
+        }
+
+        # sharded-vs-unsharded exactness at full scale
+        t0 = time.perf_counter()
+        fresh = _encode_cluster(cl, env.catalog, 32)
+        global_encode_ms = (time.perf_counter() - t0) * 1e3
+        diffs = canonical_equal(canonical_form(ct), canonical_form(fresh))
+
+        # per-partition breakdown
+        per_partition = {
+            "/".join(map(str, key)): int(n)
+            for key, _pct, _off, n in ct.__dict__.get("_partitions", [])
+        }
+
+        # partition-lanes solve: one pending burst per zone, ONE program
+        zones = sorted({z for (_p, z) in cl.partition_keys()})
+        pool = cl.nodepools["default"]
+        burst = max(64, n_nodes // 100)
+        problems = []
+        for z in zones:
+            pods = make_pods(burst // len(zones), f"burst{z}",
+                             {"cpu": "500m", "memory": "1Gi"},
+                             node_selector={lbl.TOPOLOGY_ZONE: z})
+            problems.append(encode_problem(pods, env.catalog, nodepool=pool))
+        GB = max(p.requests.shape[0] for p in problems)
+        padded = [pad_problem(p, GB) for p in problems]
+        t0 = time.perf_counter()
+        args, (TB, ZB) = stack_lane_problems(padded)
+        K, NL = len(padded), 256
+        R = args["requests"].shape[2]
+        C = args["group_window"].shape[3]
+        init = _State(
+            node_type=np.zeros((K, NL), np.int32),
+            node_price=np.zeros((K, NL), np.float32),
+            used=np.zeros((K, NL, R), np.float32),
+            node_cap=np.zeros((K, NL, R), np.float32),
+            node_window=np.zeros((K, NL, ZB, C), bool),
+            n_open=np.zeros(K, np.int32),
+        )
+        import jax
+
+        res, _dev = solve_partition_lanes(args, init, [0] * K, NL)
+        fetched = jax.device_get(res)
+        solve_lanes_ms = (time.perf_counter() - t0) * 1e3
+        lane_plans = []
+        for k, p in enumerate(problems):
+            Z = p.group_window.shape[1]
+            lane_plans.append({
+                "node_type": np.asarray(fetched.node_type[k]),
+                "node_price": np.asarray(fetched.node_price[k]),
+                "used": np.asarray(fetched.used[k]),
+                "node_window": np.asarray(fetched.node_window[k])[:, :Z],
+                "placed": np.asarray(fetched.placed[k]),
+                "n_open": int(fetched.n_open[k]),
+            })
+        t0 = time.perf_counter()
+        merged = merge_partition_plans(problems, lane_plans)
+        merge_ms = (time.perf_counter() - t0) * 1e3
+
+        # one partition's screen on the native kernel (partition-local cost)
+        screen_partition_ms = None
+        screened_nodes = 0
+        if parts:
+            biggest = max(parts, key=lambda t: t[3])
+            try:
+                with force_repack_backend("native"):
+                    t0 = time.perf_counter()
+                    dispatch_screen(biggest[1]).wait()
+                    screen_partition_ms = round(
+                        (time.perf_counter() - t0) * 1e3, 1)
+                    screened_nodes = int(biggest[3])
+            except Exception as e:
+                screen_partition_ms = f"error: {type(e).__name__}"
+    finally:
+        gc.enable()
+        gc.unfreeze()
+
+    incremental = steady["cluster"]["full"] == 0 and (
+        steady["cluster_part"]["full"] == 0
+    )
+    return {
+        "benchmark": "config9_100k_nodes",
+        "nodes": n_nodes,
+        "pods": len(cl.pods),
+        "partitions": len(per_partition),
+        "churn_nodes_per_pass": churn,
+        "iters": iters,
+        "build_s": round(build_s, 1),
+        "full_encode_ms": round(full_ms, 1),
+        "global_unsharded_encode_ms": round(global_encode_ms, 1),
+        "hit_ms": round(hit_ms, 3),
+        "patch_p50_ms": round(float(np.percentile(times, 50)), 2),
+        "patch_p99_ms": round(float(np.percentile(times, 99)), 2),
+        "cache_outcomes": steady,
+        "steady_state_incremental": bool(incremental),
+        "exactness_ok": not diffs,
+        "exactness_diffs": diffs,
+        "per_partition": per_partition,
+        "lanes": len(problems),
+        "lanes_mode": lanes_mode(),
+        "solve_lanes_ms": round(solve_lanes_ms, 1),
+        "merge_ms": round(merge_ms, 1),
+        "cost_lanes": round(merged["cost_lanes"], 4),
+        "cost_merged": round(merged["cost_merged"], 4),
+        "screen_partition_ms": screen_partition_ms,
+        "screen_partition_nodes": screened_nodes,
+        "device": "host" if os.environ.get("BENCH_FORCE_CPU") == "1" else "auto",
+        "backend": "xla-scan",
+        "note": "partitioned encode + vmapped partition-lane FFD + "
+                "cross-partition merge; screen is per-partition native",
+    }
+
+
+def run_all(scale: float = 1.0, on_row=None) -> list[dict]:
+    n = max(int(float(os.environ.get("BENCH_SCALE_NODES", 100_000)) * scale),
+            1000)
+    row = bench_scale(n_nodes=n)
+    print(json.dumps(row), flush=True)
+    if on_row is not None:
+        on_row(row)
+    return [row]
+
+
+if __name__ == "__main__":
+    run_all(scale=float(os.environ.get("BENCH_SCALE", "1.0")))

@@ -24,6 +24,11 @@ The list (designs/fault-injection.md):
 - ``breakers-recovered``    no circuit breaker is wedged open once the
                             settle phase ends (closed, or at least ready
                             to admit a half-open probe)
+- ``encode-exact``          the served cluster tensors (partitioned or
+                            single-chain) are canonical-equal to a
+                            from-scratch global encode — the sharded-vs-
+                            unsharded exactness contract under fire
+                            (designs/sharded-scale.md)
 - ``controllers-healthy``   no controller reconcile raised during the
                             whole run (faults must surface as behavior,
                             never as crashes)
@@ -140,6 +145,31 @@ def check_breakers_recovered(harness) -> InvariantResult:
     )
 
 
+def check_encode_exact(harness) -> InvariantResult:
+    """Sharded-vs-unsharded exactness (designs/sharded-scale.md): after
+    the settle phase, the cluster's served tensors — partitioned-merged or
+    single-chain incremental, whatever path is active — must equal a
+    from-scratch GLOBAL encode byte-for-byte in ``canonical_form``. A
+    storm that desynchronizes any partition's chain (or the merge) from
+    the store fails here even when every behavioral invariant passes."""
+    from ..ops.consolidate import _encode_cluster, encode_cluster
+    from ..ops.encode_delta import canonical_equal, canonical_form
+
+    env = harness.env
+    try:
+        served = encode_cluster(env.cluster, env.catalog)
+        fresh = _encode_cluster(env.cluster, env.catalog, 32)
+        diffs = canonical_equal(canonical_form(served), canonical_form(fresh))
+    except Exception as e:  # an encode crash is itself a failure
+        return _result("encode-exact", False, f"{type(e).__name__}: {e}")
+    parts = len((served.__dict__.get("_partitions") or ())) if served else 0
+    return _result(
+        "encode-exact", not diffs,
+        (f"diverged on {diffs}" if diffs else
+         f"canonical-equal ({'partitioned x' + str(parts) if parts else 'single-chain'})"),
+    )
+
+
 def check_controllers_healthy(harness) -> InvariantResult:
     errors = harness.env.manager.errors[harness.errors_baseline:]
     return _result(
@@ -157,6 +187,7 @@ INVARIANTS = (
     check_ice_mask_expired,
     check_queue_drained,
     check_breakers_recovered,
+    check_encode_exact,
     check_controllers_healthy,
 )
 

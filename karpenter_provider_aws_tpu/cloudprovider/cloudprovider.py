@@ -496,7 +496,11 @@ class CloudProvider:
         return self.catalog.list()
 
     # -- IsDrifted ---------------------------------------------------------
-    def is_drifted(self, claim: NodeClaim) -> DriftReason:
+    def is_drifted(self, claim: NodeClaim, instances=None) -> DriftReason:
+        """``instances`` (id -> instance) lets a bulk caller (the
+        disruption controller's per-pass drift sweep) resolve the running
+        instance from ONE list call instead of a locked per-claim
+        ``get()`` round trip — 5k claims paid 5k cloud lookups per pass."""
         # NodePool template drift first: the pool the claim was stamped
         # from has since changed labels/taints/requirements (core static
         # drift). Independent of the nodeclass — a deleted nodeclass must
@@ -513,10 +517,19 @@ class CloudProvider:
         stamped = claim.annotations.get(lbl.ANNOTATION_NODECLASS_HASH)
         if stamped is not None and stamped != nodeclass.hash():
             return DriftReason.STATIC
-        try:
-            inst = self.get(claim.status.provider_id)
-        except Exception:
-            return DriftReason.NONE
+        inst = None
+        if instances is not None:
+            iid = parse_provider_id(claim.status.provider_id)
+            inst = instances.get(iid) if iid else None
+        if inst is None:
+            # bulk-map miss falls back to the exact per-claim lookup: the
+            # listing is tag-filtered, and an untagged-but-running instance
+            # must not silently stop drift-checking (misses are rare, so
+            # the bulk win survives)
+            try:
+                inst = self.get(claim.status.provider_id)
+            except Exception:
+                return DriftReason.NONE
         # image drift: running image no longer among resolved images
         images = {i.id for i in self.images.list(nodeclass)}
         if images and inst.image_id not in images:

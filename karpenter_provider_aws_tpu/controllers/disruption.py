@@ -79,6 +79,15 @@ class DisruptionController:
         # pass; without this the identical reject records would cycle the
         # bounded audit ring and evict the history it exists to retain.
         self._reject_logged: dict[tuple, float] = {}
+        # Warm-pass scan cache: the O(pods) per-pass views (pods_by_node,
+        # per-node do-not-disrupt flags, the (claim, node) working set) are
+        # pure functions of store content, keyed on (epoch, rev, node/pod
+        # write sequences) — a quiet reconcile reuses them outright. An
+        # annotation stamped IN PLACE between passes is invisible to the
+        # key, so ``_disrupt``'s commit-time recheck covers claim/node/pod
+        # do-not-disrupt before anything commits (the single enforcement
+        # point, same contract as the PR 3 live pod recheck).
+        self._scan_cache: Optional[tuple] = None
 
     # -- budget accounting -------------------------------------------------
     # reason-string prefix -> core DisruptionReason class (budget scoping)
@@ -103,12 +112,22 @@ class DisruptionController:
 
     def _disrupt(self, claim, reason: str, budget: "_BudgetTracker",
                  detail: dict = None) -> bool:
-        # Commit-time live recheck: the candidate walks read pod
-        # do-not-disrupt from per-pass snapshots, but an annotation stamped
-        # in place SINCE (a mutation the change journal cannot see) must
-        # still protect the node at the single point where a disruption
-        # actually commits — for every reason, not just consolidation.
+        # Commit-time live recheck: the candidate walks read claim/node/pod
+        # do-not-disrupt from per-pass (now revision-cached) snapshots, but
+        # an annotation stamped in place SINCE (a mutation the change
+        # journal cannot see) must still protect the node at the single
+        # point where a disruption actually commits — for every reason,
+        # not just consolidation, and on every object level.
+        if getattr(claim, "annotations", {}).get(
+            lbl.ANNOTATION_DO_NOT_DISRUPT
+        ) == "true":
+            return False
         node_name = getattr(getattr(claim, "status", None), "node_name", "")
+        node = self.cluster.nodes.get(node_name) if node_name else None
+        if node is not None and (
+            node.annotations.get(lbl.ANNOTATION_DO_NOT_DISRUPT) == "true"
+        ):
+            return False
         if node_name and any(
             p.do_not_disrupt()
             for p in self.cluster.pods_on_nodes([node_name]).get(node_name, ())
@@ -160,17 +179,31 @@ class DisruptionController:
         # captured FIRST so the incremental encoder re-patches anything
         # that mutates between this snapshot and the encode.
         rev0 = getattr(self.cluster, "rev", None)
-        by_node = self.cluster.pods_by_node()
         # per-node do-not-disrupt flag + the (claim, node) working set, each
-        # computed ONCE per pass: the three claim-driven phases used to
-        # regenerate _claims_with_nodes independently, re-walking every
-        # bound pod's annotations per phase — 3x O(pods) of pure repeat work
-        # on the warm 5k-node pass (the <50ms controller-pass budget)
-        dnd_node = {
-            name: any(p.do_not_disrupt() for p in pods)
-            for name, pods in by_node.items()
-        }
-        cn = list(self._claims_with_nodes(by_node, dnd_node))
+        # computed ONCE per pass — and, since the views are pure functions
+        # of store content, reused ACROSS passes while the store is quiet:
+        # the 3x O(pods) annotation walks were the host-side floor of the
+        # warm 5k-node pass (the <50ms controller-pass budget). Direct
+        # in-place annotation stamps are invisible to the key; _disrupt's
+        # commit recheck enforces them (see _scan_cache).
+        from ..models.pod import POD_WRITE_SEQ
+        from ..state.cluster import NODE_WRITE_SEQ
+
+        ckey = (
+            getattr(self.cluster, "epoch", None), rev0,
+            NODE_WRITE_SEQ.v, POD_WRITE_SEQ.v,
+        )
+        cached = self._scan_cache
+        if cached is not None and cached[0] == ckey:
+            _, by_node, dnd_node, cn = cached
+        else:
+            by_node = self.cluster.pods_by_node()
+            dnd_node = {
+                name: any(p.do_not_disrupt() for p in pods)
+                for name, pods in by_node.items()
+            }
+            cn = list(self._claims_with_nodes(by_node, dnd_node))
+            self._scan_cache = (ckey, by_node, dnd_node, cn)
         self._reconcile_expiration(budget, by_node, cn)
         if self.drift_enabled:
             self._reconcile_drift(budget, by_node, cn)
@@ -222,10 +255,20 @@ class DisruptionController:
                          claims_nodes=None) -> None:
         if claims_nodes is None:
             claims_nodes = self._claims_with_nodes(pods_by_node)
+        # one bulk instance listing instead of a locked per-claim cloud
+        # get(): the drift sweep is O(claims) either way, but 5k lock
+        # round trips were ~1/5 of the warm controller pass
+        instances = None
+        try:
+            instances = {
+                i.id: i for i in self.cloudprovider.list_instances()
+            }
+        except Exception:
+            pass  # per-claim get() fallback keeps the sweep alive
         for claim, node in claims_nodes:
             if claim.deleted:
                 continue
-            reason = self.cloudprovider.is_drifted(claim)
+            reason = self.cloudprovider.is_drifted(claim, instances=instances)
             if reason != DriftReason.NONE:
                 self._disrupt(claim, f"drifted:{reason.value}", budget)
 
@@ -332,16 +375,22 @@ class DisruptionController:
         # compute; wait() pays the link once for the tiny mask.
         pending_screen = dispatch_screen(ct)
         order = np.argsort(ct.disruption_cost, kind="stable")
-        eligible_all = [
-            int(ni)
-            for ni in order
-            if not ct.blocked[ni] and eligible(int(ni)) is not None
-        ]
+        order = order[~ct.blocked[order]]  # vectorized: skip blocked rows
+        # one eligibility evaluation per node; every later phase reads the
+        # captured claim map instead of re-calling through the cache
+        elig_claim: dict[int, object] = {}
+        eligible_all: list[int] = []
+        for ni in order:
+            ni = int(ni)
+            c = eligible(ni)
+            if c is not None:
+                eligible_all.append(ni)
+                elig_claim[ni] = c
         # Validation window: a candidate commits only after staying
         # consolidatable for validation_period_s (first-seen times pruned
         # when a claim stops being a candidate, so a flapping node restarts
         # its clock).
-        current = {eligible(ni).name: ni for ni in eligible_all}
+        current = {elig_claim[ni].name: ni for ni in eligible_all}
         self._consol_seen = {
             name: self._consol_seen.get(name, now) for name in current
         }
@@ -349,7 +398,7 @@ class DisruptionController:
             eligible_all = [
                 ni
                 for ni in eligible_all
-                if now - self._consol_seen[eligible(ni).name]
+                if now - self._consol_seen[elig_claim[ni].name]
                 >= self.validation_period_s
             ]
         # delete candidates additionally pass the device repack screen;
@@ -367,8 +416,10 @@ class DisruptionController:
                 else:
                     hi = mid - 1
             rclass = self._REASON_CLASS.get("consolidatable", "")
+            now_c = self.clock.now()
+            left_by_pool: dict[str, int] = {}
             for ni in candidates[:lo]:
-                claim = eligible(ni)
+                claim = elig_claim.get(ni)
                 if claim is None:
                     continue
                 # fast path for the exhausted-budget sweep: when the pool's
@@ -377,10 +428,15 @@ class DisruptionController:
                 # nothing — skipping the call keeps the warm large-cluster
                 # pass from paying thousands of no-op consume/dedup rounds
                 # (identical audit/metrics outcome either way)
-                if budget.left(claim.nodepool_name, rclass) <= 0:
+                pool_left = left_by_pool.get(claim.nodepool_name)
+                if pool_left is None:
+                    pool_left = left_by_pool[claim.nodepool_name] = (
+                        budget.left(claim.nodepool_name, rclass)
+                    )
+                if pool_left <= 0:
                     last = self._reject_logged.get((claim.name, "consolidatable"))
                     if last is not None and (
-                        self.clock.now() - last < self.REJECT_AUDIT_TTL_S
+                        now_c - last < self.REJECT_AUDIT_TTL_S
                     ):
                         continue
                 if self._disrupt(
@@ -388,6 +444,7 @@ class DisruptionController:
                     detail={"savings_per_hour": round(float(ct.price[ni]), 4)},
                 ):
                     deleted_nodes.add(ni)
+                    left_by_pool[claim.nodepool_name] = pool_left - 1
 
         # 2. multi-node replace (N -> 1 cheaper): candidates whose pods
         # repack onto survivors EXCEPT an overflow absorbed by one new,
@@ -412,7 +469,7 @@ class DisruptionController:
         ):
             if ni in deleted_nodes:
                 continue
-            claim = eligible(int(ni))
+            claim = elig_claim.get(int(ni))
             if claim is None:
                 continue
             if int(ni) not in validated:

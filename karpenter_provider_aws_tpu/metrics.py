@@ -45,6 +45,17 @@ class Counter:
         with self._lock:
             return float(sum(self._values.values()))
 
+    def sum(self, **labels) -> float:
+        """Sum across label sets MATCHING the given subset — e.g.
+        ``ENCODE_CACHE.sum(path="cluster", outcome="full")`` totals every
+        ``cause`` series of the full outcome. ``value()`` stays an exact
+        label-set lookup."""
+        want = set(labels.items())
+        with self._lock:
+            return float(sum(
+                v for key, v in self._values.items() if want <= set(key)
+            ))
+
     def _snapshot(self) -> list[tuple]:
         with self._lock:
             return sorted(self._values.items())
@@ -312,6 +323,18 @@ ENCODE_CACHE = REGISTRY.counter(
 ENCODE_PATCH_ROWS = REGISTRY.counter(
     "karpenter_encode_patch_rows_total",
     "Node rows rewritten by incremental cluster-encode patches",
+)
+ENCODE_PARTITIONS = REGISTRY.gauge(
+    "karpenter_encode_partitions",
+    "Live (nodepool, zone) partitions tracked by the partitioned cluster "
+    "encoder (ops/encode_partition.py); 0 while the single-chain encoder "
+    "serves the cluster",
+)
+PARTITION_SOLVE_LANES = REGISTRY.counter(
+    "karpenter_partition_solve_lanes_total",
+    "FFD partition lanes executed by the mesh-parallel multi-pool solve, "
+    "by mode (vmap = single-program vmapped lanes, shard_map = lanes "
+    "sharded across the device axis, fallback = per-pool dispatch)",
 )
 # -- ops/device_state.py: device-resident cluster state ---------------------
 DEVICE_STATE = REGISTRY.counter(

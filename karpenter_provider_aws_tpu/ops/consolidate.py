@@ -123,7 +123,16 @@ def encode_cluster(cluster, catalog, gmax: int = GMAX_DEFAULT,
     with _span("consolidate.encode") as sp:
         if incremental:
             from .encode_delta import incremental_encode_cluster
+            from .encode_partition import (
+                partition_encode_active,
+                partitioned_encode_cluster,
+            )
 
+            if partition_encode_active(cluster):
+                return partitioned_encode_cluster(
+                    cluster, catalog, gmax, pods_by_node=pods_by_node,
+                    rev_floor=rev_floor, span=sp,
+                )
             return incremental_encode_cluster(
                 cluster, catalog, gmax, pods_by_node=pods_by_node,
                 rev_floor=rev_floor, span=sp,
@@ -134,11 +143,13 @@ def encode_cluster(cluster, catalog, gmax: int = GMAX_DEFAULT,
 
 
 def _encode_cluster(cluster, catalog, gmax: int,
-                    pods_by_node=None) -> Optional[ClusterTensors]:
+                    pods_by_node=None, node_filter=None) -> Optional[ClusterTensors]:
     from ..models import labels as lbl
 
     # A node whose claim is already draining (deleted) is neither a
     # candidate nor a repack target — its capacity is going away.
+    # ``node_filter`` (a name set) scopes the encode to one partition's
+    # nodes (ops/encode_partition.py); eligibility rules are identical.
     claims = {c.name: c for c in cluster.snapshot_claims()}
     nodes = [
         n
@@ -147,6 +158,7 @@ def _encode_cluster(cluster, catalog, gmax: int,
         and not n.cordoned
         and n.nodeclaim_name in claims
         and not claims[n.nodeclaim_name].deleted
+        and (node_filter is None or n.name in node_filter)
     ]
     if not nodes:
         return None
@@ -556,12 +568,26 @@ def dispatch_screen(ct: ClusterTensors, chunk: int = 512) -> _PendingScreen:
     (pallas / mesh / native) complete inside dispatch; ``wait()`` is then a
     cached read. Provenance is recorded once, at wait time, with the full
     dispatch->fetch wall."""
+    import os
     import time as _time
 
     from ..trace import span as _span
     from ..trace.provenance import screen_record
 
     t0 = _time.perf_counter()
+    # Partitioned tensors (ops/encode_partition.py): screen each partition
+    # against its OWN device-resident mirror, concatenating the masks.
+    # Partition-local repack is a sound TIGHTENING of the global screen
+    # (survivors within the partition are a subset of global survivors, so
+    # a partition-local proof is a valid global proof); the host validator
+    # (repack_set_feasible on the merged tensors) stays the enforcement
+    # point either way, and one partition losing its device session
+    # degrades only that partition to a re-upload.
+    parts = ct.__dict__.get("_partitions")
+    if parts and len(parts) > 1 and os.environ.get(
+        "KARPENTER_TPU_PARTITION_SCREEN", "1"
+    ) == "1":
+        return _dispatch_screen_partitioned(ct, parts, chunk, t0)
     # ct-identity mask memo: the screen answer is a pure function of the
     # tensors, and the incremental encoder re-emits the SAME object across
     # unchanged passes — a warm reconcile re-screening an untouched cluster
@@ -612,6 +638,16 @@ def dispatch_screen(ct: ClusterTensors, chunk: int = 512) -> _PendingScreen:
         with _span2("consolidate.screen.fetch", nodes=len(ct.node_names)):
             out = waiter()
         done["out"] = out
+        if used_backend in ("vmap", "vmap-fallback"):
+            # feed the chained-vs-unchained chooser: full sweep wall per
+            # (node bucket, mode); best case wins per mode
+            from .device_state import note_screen_cost
+
+            note_screen_cost(
+                len(ct.node_names),
+                residency in ("resident", "upload"),
+                (_time.perf_counter() - t0) * 1e3,
+            )
         # Keyed by the backend that RAN: a fallback sweep (e.g.
         # "vmap-fallback" after a pallas failure) stores under a name the
         # would-run backend never matches, so degraded passes deliberately
@@ -636,6 +672,49 @@ def dispatch_screen(ct: ClusterTensors, chunk: int = 512) -> _PendingScreen:
                 rec.quality["packing_efficiency"] = eff
         except Exception:
             pass
+        return out
+
+    return _PendingScreen(wait=_wait)
+
+
+def _dispatch_screen_partitioned(ct: ClusterTensors, parts, chunk: int,
+                                 t0: float) -> _PendingScreen:
+    """Per-partition screen dispatch: every partition's repack tensors are
+    served from that partition's own device-resident mirror (the part
+    tensors carry their own encoder chains), all partitions' device
+    programs go in flight before any mask is fetched, and the global mask
+    is the concatenation. See ``dispatch_screen`` for the soundness note;
+    provenance records one ``partitioned(<backend>)`` sweep."""
+    import time as _time
+
+    from ..trace import span as _span
+    from ..trace.provenance import screen_record
+
+    N = len(ct.node_names)
+    with _span("consolidate.screen", nodes=N, partitions=len(parts)):
+        pendings = [
+            (off, n, dispatch_screen(part_ct, chunk))
+            for _key, part_ct, off, n in parts
+        ]
+
+    done: dict = {}
+
+    def _wait() -> np.ndarray:
+        if "out" in done:
+            return done["out"]
+        out = np.zeros(N, dtype=bool)
+        for off, n, pending in pendings:
+            out[off:off + n] = pending.wait()
+        done["out"] = out
+        from ..trace.provenance import last_record
+
+        part_rec = last_record("consolidate.screen")
+        inner = part_rec.backend if part_rec is not None else "?"
+        screen_record(
+            backend=f"partitioned({inner})", nodes=N,
+            wall_ms=(_time.perf_counter() - t0) * 1e3,
+            residency="partitioned",
+        )
         return out
 
     return _PendingScreen(wait=_wait)
@@ -740,10 +819,23 @@ def _screen(ct: ClusterTensors, chunk: int):
     # mirror (hit or scatter patch); only the tiny candidate vectors and the
     # result mask cross the link. Padding rows are inert (zero free, zero
     # cap columns), so the mask over the live prefix is exactly the
-    # unpadded screen's answer.
+    # unpadded screen's answer. At small N the mirror's bookkeeping can
+    # cost more than re-uploading the tiny buffers outright — the chooser
+    # picks chained (resident) vs unchained (per-sweep upload) from
+    # measured per-bucket cost (KARPENTER_TPU_CHAINED_SCREEN pins).
     from .device_state import acquire_screen_tensors
+    from .device_state import enabled as _residency_enabled
+    from .device_state import pick_chained
 
-    resident, residency = acquire_screen_tensors(ct)
+    if not _residency_enabled() or pick_chained(N):
+        # disabled layer: acquire counts the fallback itself (kill-switch
+        # semantics unchanged); otherwise the chooser decided chained
+        resident, residency = acquire_screen_tensors(ct)
+    else:
+        from ..metrics import DEVICE_STATE
+
+        DEVICE_STATE.inc(path="screen", outcome="bypass")
+        resident, residency = None, "bypass"
     if resident is not None:
         free, requests, gids, gcounts, cap, _n_live = resident
     else:

@@ -61,7 +61,13 @@ _CAP_WIRE_MAX = 60000
 #: patch chains longer than this re-upload instead (row sets would approach
 #: the full buffer anyway, and each link is one dict walk per pass)
 MAX_CHAIN_DEPTH = 16
-_HOLDER_CAP = 8
+
+
+def _holder_cap() -> int:
+    """Mirror-holder LRU size. The partitioned scale tier keeps ONE mirror
+    per (nodepool, zone) partition plus the merged chain, so the cap must
+    cover the partition count or mirrors evict each other every sweep."""
+    return int(os.environ.get("KARPENTER_TPU_DEVICE_HOLDERS", "32"))
 
 
 def enabled() -> bool:
@@ -192,7 +198,7 @@ def _holder_for(chain) -> DeviceClusterTensors:
             return h
         h = DeviceClusterTensors(chain)
         _HOLDERS[id(chain)] = h
-        while len(_HOLDERS) > _HOLDER_CAP:
+        while len(_HOLDERS) > _holder_cap():
             _HOLDERS.popitem(last=False)
         return h
 
@@ -455,3 +461,60 @@ def mirror_for(ct) -> Optional[DeviceClusterTensors]:
     with _HOLDERS_LOCK:
         h = _HOLDERS.get(id(chain))
         return h if h is not None and h.chain is chain else None
+
+
+def drop_mirror(ct) -> bool:
+    """Tear down the device mirror behind ``ct`` (chaos: lose ONE
+    partition's device session; the next acquire re-uploads that partition
+    while every other partition's mirror stays resident)."""
+    chain = ct.__dict__.get("_device_chain")
+    if chain is None:
+        return False
+    with _HOLDERS_LOCK:
+        return _HOLDERS.pop(id(chain), None) is not None
+
+
+# -- chained-vs-unchained chooser --------------------------------------------
+#: Measured full-sweep cost per node bucket and mode. At small N the
+#: residency layer's bookkeeping + scatter-patch dispatch costs MORE than
+#: simply re-uploading the tiny host buffers every sweep (the
+#: ``device_state_chained_400node_screen`` inversion: 20.6 vs 16.4ms p50) —
+#: cost, not scale, decides, exactly like the PR 6 mesh-mode chooser.
+_CHAINED_COST: dict[int, dict[str, float]] = {}
+
+
+def _cost_bucket(n: int) -> int:
+    b = 64
+    while b < n:
+        b *= 2
+    return b
+
+
+def pick_chained(n: int) -> bool:
+    """Serve this sweep from the device-resident mirror (True) or the
+    plain per-sweep host-buffer upload (False), from MEASURED per-bucket
+    cost. The un-measured mode is explored once per bucket (chained
+    first); KARPENTER_TPU_CHAINED_SCREEN=1|0 pins."""
+    pin = os.environ.get("KARPENTER_TPU_CHAINED_SCREEN")
+    if pin == "1":
+        return True
+    if pin == "0":
+        return False
+    costs = _CHAINED_COST.setdefault(_cost_bucket(n), {})
+    if "chained" not in costs:
+        return True
+    if "unchained" not in costs:
+        return False
+    return costs["chained"] <= costs["unchained"]
+
+
+def note_screen_cost(n: int, chained: bool, ms: float) -> None:
+    """Record one full sweep's wall per (bucket, mode); best-case wins so
+    cold compiles/uploads don't pin a mode on its worst pass."""
+    costs = _CHAINED_COST.setdefault(_cost_bucket(n), {})
+    key = "chained" if chained else "unchained"
+    costs[key] = min(costs.get(key, ms), ms)
+
+
+def reset_chained_costs() -> None:
+    _CHAINED_COST.clear()

@@ -151,12 +151,20 @@ def balanced_fill(counts: dict, live, P: int) -> tuple[dict, int]:
     return {z: int(a) for z, a in zip(zis, assign) if a}, int(assign.sum())
 
 
-def _count_encode_cache(path: str, outcome: str) -> None:
+def _count_encode_cache(path: str, outcome: str, cause: str = "") -> None:
     """Encode-cache observability (metrics.ENCODE_CACHE); lazy import so
-    ops/ keeps no import-time edge onto the metrics registry."""
+    ops/ keeps no import-time edge onto the metrics registry.
+
+    ``cause`` rides along on ``outcome="full"`` only (journal_overflow /
+    dirty_ratio / epoch / catalog / refresh_interval): a full re-encode is
+    a latency cliff, and ladder mis-sizing must be visible by cause before
+    it becomes one. hit/patch keep their two-label series unchanged."""
     from ..metrics import ENCODE_CACHE
 
-    ENCODE_CACHE.inc(path=path, outcome=outcome)
+    if cause:
+        ENCODE_CACHE.inc(path=path, outcome=outcome, cause=cause)
+    else:
+        ENCODE_CACHE.inc(path=path, outcome=outcome)
 
 
 class ZoneOccupancy:

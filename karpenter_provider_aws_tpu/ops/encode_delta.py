@@ -113,6 +113,9 @@ class _EncoderState:
         self.class_labels: list = []
         self.class_taints: list = []
         self.class_compat = np.zeros((0, 0), dtype=bool)  # [GB, C]
+        # bumped whenever the class projection is rebuilt from scratch
+        # (cross-partition compat memos key on it — encode_partition.py)
+        self.class_gen = 0
         # -- misc ----------------------------------------------------------
         self.zones: list[str] = []
         self.zone_idx: dict[str, int] = {}
@@ -299,6 +302,7 @@ def _rebuild_classes(state: _EncoderState, cluster) -> None:
     state.class_labels = []
     state.class_taints = []
     state.class_compat = np.zeros((state.GB, 8), dtype=bool)
+    state.class_gen += 1
     nodes = cluster.nodes
     for row in np.flatnonzero(state.live[: state.n_hi]):
         node = nodes.get(state.row_name[row])
@@ -768,13 +772,14 @@ def _emit_fast(state: _EncoderState, prev, dirty_rows: list[int]):
 # -- full (re)build ---------------------------------------------------------
 
 def _full_build(state: _EncoderState, cluster, catalog, gmax,
-                pods_by_node=None, rev_floor=None):
+                pods_by_node=None, rev_floor=None, node_filter=None):
     from ..state.cluster import NODE_WRITE_SEQ
     from .consolidate import _encode_cluster
 
     rev0 = cluster.rev if rev_floor is None else rev_floor
     seq0 = NODE_WRITE_SEQ.v
-    ct = _encode_cluster(cluster, catalog, gmax, pods_by_node=pods_by_node)
+    ct = _encode_cluster(cluster, catalog, gmax, pods_by_node=pods_by_node,
+                         node_filter=node_filter)
     lock = state.lock  # held by the caller — must survive the re-init
     state.__init__(gmax)
     state.lock = lock
@@ -785,8 +790,12 @@ def _full_build(state: _EncoderState, cluster, catalog, gmax,
     state.passes_since_full = 0
     # every node NOT in the encoding is parked with its current version so
     # direct-mutation flips back to eligibility are caught by the scan
+    # (``node_filter`` scopes a PARTITION encoder to its own nodes — it
+    # must never park another partition's population)
     tracked = set(ct.node_names) if ct is not None else set()
     for name, node in cluster.nodes.items():
+        if node_filter is not None and name not in node_filter:
+            continue
         if name not in tracked:
             state.parked[name] = node._version
     if ct is None:
@@ -880,6 +889,56 @@ def _full_build(state: _EncoderState, cluster, catalog, gmax,
     return ct
 
 
+# -- dirty-set computation (shared with the partitioned encoder) -------------
+
+def _collect_dirty(state: _EncoderState, cluster, changes,
+                   claim_owner=None) -> dict:
+    """Dirty node names for one pass: journal entries first (store order),
+    then the defensive version scan that catches direct attribute writes
+    on live objects. The scan runs only when SOME Node field was written
+    since the state's last look (NODE_WRITE_SEQ) — binds/unbinds don't
+    count as node writes, so the steady-churn path skips the O(rows) walk
+    entirely. ``claim_owner(node_name) -> bool`` lets the partitioned
+    encoder skip claim-carried names owned by another partition."""
+    from ..state.cluster import NODE_WRITE_SEQ
+
+    dirty: dict[str, None] = {}
+    for name in changes.get("node", ()):
+        dirty[name] = None
+    for name in changes.get("pod", ()):
+        if name:
+            dirty[name] = None
+    for cname in changes.get("claim", ()):
+        claim = cluster.nodeclaims.get(cname)
+        if claim is not None and claim.status.node_name:
+            if claim_owner is None or claim_owner(claim.status.node_name):
+                dirty[claim.status.node_name] = None
+        row = state.claim_row.get(cname)
+        if row is not None and state.row_name[row] is not None:
+            dirty[state.row_name[row]] = None
+    node_seq = NODE_WRITE_SEQ.v
+    if node_seq != state.node_seq:
+        nodes = cluster.nodes
+        claims = cluster.nodeclaims
+        for row in np.flatnonzero(state.live[: state.n_hi]):
+            name = state.row_name[row]
+            node = nodes.get(name)
+            if node is None or node._version != state.row_nver[row]:
+                dirty[name] = None
+                continue
+            claim = claims.get(state.row_claim[row])
+            if claim is None or claim.deleted:
+                dirty[name] = None
+        for name, ver in list(state.parked.items()):
+            node = nodes.get(name)
+            if node is None:
+                state.parked.pop(name, None)
+            elif node._version != ver:
+                dirty[name] = None
+        state.node_seq = node_seq
+    return dirty
+
+
 # -- entry ------------------------------------------------------------------
 
 _STATES_ATTR = "_cluster_encoders"
@@ -903,65 +962,26 @@ def incremental_encode_cluster(cluster, catalog, gmax, pods_by_node=None,
         # instead of being silently absorbed into a stale snapshot.
         rev_now = cluster.rev if rev_floor is None else rev_floor
         catalog_key = catalog.cache_key()
-        mode = "patch"
+        mode, cause = "patch", ""
         if state.epoch is not cluster.epoch:
-            mode = "full"
+            mode, cause = "full", "epoch"
         elif state.catalog_key != catalog_key:
-            mode = "full"
+            mode, cause = "full", "catalog"
         elif state.passes_since_full >= _refresh_every() > 0:
-            mode = "full"
+            mode, cause = "full", "refresh_interval"
         changes = None
         if mode != "full":
             changes = cluster.changes_since(state.rev)
             if changes is None:
-                mode = "full"  # journal rolled past our snapshot
+                mode, cause = "full", "journal_overflow"
         if mode == "full":
-            _count_encode_cache("cluster", "full")
+            _count_encode_cache("cluster", "full", cause)
             if span is not None and hasattr(span, "set"):
-                span.set(mode="full")
+                span.set(mode="full", cause=cause)
             return _full_build(state, cluster, catalog, gmax,
                                pods_by_node=pods_by_node, rev_floor=rev_floor)
 
-        # dirty rows: journal entries first (store order), then the defensive
-        # version scan that catches direct attribute writes on live objects.
-        # The scan runs only when SOME Node field was written since our last
-        # look (NODE_WRITE_SEQ) — binds/unbinds don't count as node writes,
-        # so the steady-churn path skips the O(N) walk entirely.
-        from ..state.cluster import NODE_WRITE_SEQ
-
-        dirty: dict[str, None] = {}
-        for name in changes.get("node", ()):
-            dirty[name] = None
-        for name in changes.get("pod", ()):
-            if name:
-                dirty[name] = None
-        for cname in changes.get("claim", ()):
-            claim = cluster.nodeclaims.get(cname)
-            if claim is not None and claim.status.node_name:
-                dirty[claim.status.node_name] = None
-            row = state.claim_row.get(cname)
-            if row is not None and state.row_name[row] is not None:
-                dirty[state.row_name[row]] = None
-        node_seq = NODE_WRITE_SEQ.v
-        if node_seq != state.node_seq:
-            nodes = cluster.nodes
-            claims = cluster.nodeclaims
-            for row in np.flatnonzero(state.live[: state.n_hi]):
-                name = state.row_name[row]
-                node = nodes.get(name)
-                if node is None or node._version != state.row_nver[row]:
-                    dirty[name] = None
-                    continue
-                claim = claims.get(state.row_claim[row])
-                if claim is None or claim.deleted:
-                    dirty[name] = None
-            for name, ver in list(state.parked.items()):
-                node = nodes.get(name)
-                if node is None:
-                    state.parked.pop(name, None)
-                elif node._version != ver:
-                    dirty[name] = None
-            state.node_seq = node_seq
+        dirty = _collect_dirty(state, cluster, changes)
 
         if not dirty:
             state.rev = max(state.rev, rev_now)
@@ -973,9 +993,9 @@ def incremental_encode_cluster(cluster, catalog, gmax, pods_by_node=None,
 
         live_n = int(state.live[: state.n_hi].sum())
         if len(dirty) > PATCH_FRAC * max(live_n, 1):
-            _count_encode_cache("cluster", "full")
+            _count_encode_cache("cluster", "full", "dirty_ratio")
             if span is not None and hasattr(span, "set"):
-                span.set(mode="full", dirty=len(dirty))
+                span.set(mode="full", dirty=len(dirty), cause="dirty_ratio")
             return _full_build(state, cluster, catalog, gmax,
                                pods_by_node=pods_by_node, rev_floor=rev_floor)
 
@@ -1012,8 +1032,10 @@ def incremental_encode_cluster(cluster, catalog, gmax, pods_by_node=None,
 
 
 def invalidate_cluster_encoders(cluster) -> None:
-    """Drop every persistent encoder for ``cluster`` (tests / big hammer)."""
+    """Drop every persistent encoder for ``cluster`` (tests / big hammer)
+    — the single-chain states AND the partitioned sibling's."""
     cluster.__dict__.pop(_STATES_ATTR, None)
+    cluster.__dict__.pop("_cluster_part_encoders", None)
 
 
 # -- canonical comparison (the property-test contract) ----------------------

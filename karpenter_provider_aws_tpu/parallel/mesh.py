@@ -375,6 +375,266 @@ def _mesh_screen(ct, mesh: Mesh, lanes_per_device: Optional[int], N: int) -> np.
     return out
 
 
+# -- partition lanes: K independent FFD problems in ONE device program ------
+
+def lanes_mode() -> str:
+    """How partition lanes run here: ``shard_map`` (lane axis sharded over
+    the device mesh) on real multi-device runtimes that expose it, else
+    ``vmap`` (single-program vmapped lanes — the native fallback)."""
+    try:
+        if getattr(jax, "shard_map", None) is not None and len(jax.devices()) > 1:
+            return "shard_map"
+    except Exception:
+        pass
+    return "vmap"
+
+
+def _lane_body(max_nodes: int):
+    from ..ops.ffd import _ffd_solve_impl
+
+    def one(requests, counts, compat, capacity, price, gw, tw, mpn, state,
+            n_pre):
+        return _ffd_solve_impl(
+            requests, counts, compat, capacity, price, gw, tw,
+            max_per_node=mpn, max_nodes=max_nodes, init_state=state,
+            n_pre=n_pre,
+        )
+
+    return one
+
+
+@functools.lru_cache(maxsize=8)
+def _lanes_vmap_fn(max_nodes: int):
+    return jax.jit(jax.vmap(_lane_body(max_nodes)))
+
+
+@functools.lru_cache(maxsize=8)
+def _lanes_shard_fn(mesh: Mesh, max_nodes: int):
+    """Lane axis sharded over the device mesh: each device runs its K/D
+    lanes through the identical vmapped scan (pure SPMD, no cross-device
+    communication — independent partitions share nothing inside a solve)."""
+    fn = functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=P(POD_AXIS),
+        out_specs=P(POD_AXIS),
+        check_vma=False,
+    )(jax.vmap(_lane_body(max_nodes)))
+    return jax.jit(fn)
+
+
+def stack_lane_problems(padded_list):
+    """Stack K group-padded ``EncodedProblem``s onto a leading lane axis
+    with common type/zone buckets. Padded types are structurally unusable
+    (compat False, price inf, dead offering windows) and padded zones carry
+    no offerings, so every lane solves exactly its own problem; committed
+    type indices stay valid in each lane's ORIGINAL axis (padding appends).
+    Returns (args dict of stacked numpy arrays, (TB, ZB))."""
+    TB = max(p.capacity.shape[0] for p in padded_list)
+    ZB = max(p.group_window.shape[1] for p in padded_list)
+
+    def padTZ(a, t_axis=None, z_axis=None, fill=0):
+        widths = [(0, 0)] * a.ndim
+        if t_axis is not None:
+            widths[t_axis] = (0, TB - a.shape[t_axis])
+        if z_axis is not None:
+            widths[z_axis] = (0, ZB - a.shape[z_axis])
+        if not any(w != (0, 0) for w in widths):
+            return a
+        return np.pad(a, widths, constant_values=fill)
+
+    args = {
+        "requests": np.stack([p.requests for p in padded_list]),
+        "counts": np.stack([p.counts for p in padded_list]),
+        "compat": np.stack([padTZ(p.compat, t_axis=1) for p in padded_list]),
+        "capacity": np.stack([padTZ(p.capacity, t_axis=0) for p in padded_list]),
+        "price": np.stack(
+            [padTZ(p.price, t_axis=1, fill=np.inf) for p in padded_list]
+        ),
+        "group_window": np.stack(
+            [padTZ(p.group_window, z_axis=1) for p in padded_list]
+        ),
+        "type_window": np.stack(
+            [padTZ(p.type_window, t_axis=0, z_axis=1) for p in padded_list]
+        ),
+        "max_per_node": np.stack([p.max_per_node for p in padded_list]),
+    }
+    return args, (TB, ZB)
+
+
+def solve_partition_lanes(args, init_state, n_pres, max_nodes: int,
+                          dput=None, mode: Optional[str] = None):
+    """Run K stacked lanes through one jitted program; returns the batched
+    (leading lane axis) ``FFDResult`` of device arrays — the caller slices
+    per lane and fetches once. ``init_state`` is a batched ``ops.ffd._State``
+    (pre-opened existing rows per lane), ``n_pres`` the per-lane pre-row
+    counts. ``mode`` pins shard_map/vmap (default: :func:`lanes_mode`);
+    shard_map pads the lane axis to a device multiple with inert lanes."""
+    import jax.numpy as jnp
+
+    from ..ops.ffd import _State
+
+    dput = dput or (lambda x: jnp.asarray(x))
+    mode = mode or lanes_mode()
+    K = args["requests"].shape[0]
+    Kp = K
+    if mode == "shard_map":
+        D = len(jax.devices())
+        if K % D:
+            Kp = K + (D - K % D)
+
+    def lane_pad(a):
+        if Kp == K:
+            return a
+        widths = [(0, Kp - K)] + [(0, 0)] * (a.ndim - 1)
+        return np.pad(a, widths)
+
+    arrs = tuple(
+        dput(lane_pad(np.ascontiguousarray(args[k])))
+        for k in ("requests", "counts", "compat", "capacity", "price",
+                  "group_window", "type_window", "max_per_node")
+    )
+    state = _State(*(dput(lane_pad(np.asarray(f))) for f in init_state))
+    n_pre = dput(lane_pad(np.asarray(n_pres, dtype=np.int32)))
+    if mode == "shard_map":
+        fn = _lanes_shard_fn(make_mesh(), max_nodes)
+    else:
+        fn = _lanes_vmap_fn(max_nodes)
+    res = fn(*arrs, state, n_pre)
+    if Kp != K:
+        res = jax.tree_util.tree_map(lambda a: a[:K], res)
+    # the device-resident stacked inputs ride along so callers can slice
+    # per-lane views (post-scan ranking) without re-uploading anything
+    dev_args = dict(zip(
+        ("requests", "counts", "compat", "capacity", "price",
+         "group_window", "type_window", "max_per_node"), arrs,
+    ))
+    return res, dev_args
+
+
+def merge_partition_plans(problems, lane_plans, max_tries: int = 512,
+                          util_threshold: float = 0.97):
+    """Cross-partition plan merge: flatten per-partition lane plans into
+    one global node namespace and run the packed-cost descent over the
+    CONCATENATED group axis — exactly the multi-pool merge
+    (:func:`merge_sharded_plan`): an under-filled tail node from one
+    partition drains into another partition's slack whenever group
+    compatibility, windows, and hostname caps allow.
+
+    ``problems`` must share type/zone axes (partitions of one pool do);
+    ``lane_plans`` are per-lane dicts with node_type/node_price/used/
+    node_window/placed/n_open in host numpy. Returns the merged plan dict
+    with cost_lanes / cost_merged.
+    """
+    import dataclasses
+
+    from ..scheduling.solver import _refine_plan
+
+    first = problems[0]
+    for p in problems[1:]:
+        if p.zones != first.zones:
+            raise ValueError("merge_partition_plans needs a shared zone axis")
+    # Union type axis by NAME: per-problem type-axis compaction keeps only
+    # types with live offerings inside that problem's window, so two zone
+    # partitions of one pool legitimately carry different type axes.
+    union: list = list(first.type_names)
+    uidx = {n: i for i, n in enumerate(union)}
+    for p in problems[1:]:
+        for n in p.type_names:
+            if n not in uidx:
+                uidx[n] = len(union)
+                union.append(n)
+    T = len(union)
+    R = first.capacity.shape[1]
+    Z, C = first.group_window.shape[1], first.group_window.shape[2]
+    capacity = np.zeros((T, R), dtype=first.capacity.dtype)
+    type_window = np.zeros((T, Z, C), dtype=bool)
+    type_exotic = np.zeros(T, dtype=bool)
+    tmaps = []
+    for p in problems:
+        tmap = np.array([uidx[n] for n in p.type_names], dtype=np.int64)
+        tmaps.append(tmap)
+        capacity[tmap] = p.capacity
+        type_window[tmap] |= p.type_window
+        if p.type_exotic is not None:
+            type_exotic[tmap] |= p.type_exotic
+    Gs = [len(p.group_pods) for p in problems]
+    G = sum(Gs)
+
+    def remapT(p, tmap, a, fill):
+        out = np.full((a.shape[0], T), fill, dtype=a.dtype)
+        out[:, tmap] = a
+        return out
+
+    combined = dataclasses.replace(
+        first,
+        requests=np.concatenate([p.requests[: len(p.group_pods)] for p in problems]),
+        counts=np.concatenate([p.counts[: len(p.group_pods)] for p in problems]),
+        compat=np.concatenate([
+            remapT(p, tm, p.compat[: len(p.group_pods)], False)
+            for p, tm in zip(problems, tmaps)
+        ]),
+        price=np.concatenate([
+            remapT(p, tm, p.price[: len(p.group_pods)], np.inf)
+            for p, tm in zip(problems, tmaps)
+        ]),
+        capacity=capacity,
+        type_window=type_window,
+        type_exotic=type_exotic,
+        type_names=tuple(union),
+        group_window=np.concatenate(
+            [p.group_window[: len(p.group_pods)] for p in problems]
+        ),
+        max_per_node=np.concatenate(
+            [p.max_per_node[: len(p.group_pods)] for p in problems]
+        ),
+        group_pods=[pl for p in problems for pl in p.group_pods],
+        atomic=(
+            np.concatenate([
+                (p.atomic[: len(p.group_pods)] if p.atomic is not None
+                 else np.zeros(len(p.group_pods), dtype=bool))
+                for p in problems
+            ])
+            if any(p.atomic is not None for p in problems) else None
+        ),
+    )
+    n_opens = [int(pl["n_open"]) for pl in lane_plans]
+    offsets = np.concatenate([[0], np.cumsum(n_opens)]).astype(int)
+    M = int(offsets[-1])
+    m_type = np.zeros(M, dtype=np.int64)
+    m_price = np.zeros(M, dtype=np.float32)
+    m_used = np.zeros((M, R), dtype=np.float32)
+    m_window = np.zeros((M, Z, C), dtype=bool)
+    m_placed = np.zeros((G, M), dtype=np.int64)
+    g_off = 0
+    for k, (p, pl) in enumerate(zip(problems, lane_plans)):
+        lo, hi = offsets[k], offsets[k + 1]
+        n = hi - lo
+        m_type[lo:hi] = tmaps[k][np.asarray(pl["node_type"][:n], dtype=np.int64)]
+        m_price[lo:hi] = pl["node_price"][:n]
+        m_used[lo:hi] = pl["used"][:n]
+        m_window[lo:hi] = pl["node_window"][:n, :Z]
+        m_placed[g_off:g_off + Gs[k], lo:hi] = pl["placed"][: Gs[k], :n]
+        g_off += Gs[k]
+    cost_lanes = float(m_price.sum())
+    dropped, _ = _refine_plan(
+        combined, m_type, m_price, m_used, m_window, m_placed, M,
+        max_tries=max_tries, util_threshold=util_threshold,
+    )
+    cost_merged = float(np.where(~dropped, m_price, 0.0).sum())
+    return {
+        "node_type": m_type,
+        "node_price": m_price,
+        "used": m_used,
+        "node_window": m_window,
+        "placed": m_placed,
+        "n_open": M,
+        "dropped": dropped,
+        "cost_lanes": cost_lanes,
+        "cost_merged": cost_merged,
+    }
+
+
 def merge_sharded_plan(problem, mesh: Mesh, max_nodes: int = 1024):
     """Sharded solve + the promised cross-shard tail-node merge.
 

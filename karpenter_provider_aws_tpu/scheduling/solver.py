@@ -851,6 +851,213 @@ class TPUSolver:
     ) -> tuple[list[NodeSpec], list[tuple[Pod, str]], dict[int, int]]:
         return self.dispatch_encoded(problem, existing).wait()
 
+    def dispatch_encoded_batch(
+        self, items: Sequence[tuple]
+    ) -> list["_PendingSolve"]:
+        """Batched dispatch: K independent encoded problems (one per
+        nodepool / partition) in ONE device program — vmapped partition
+        lanes, sharded over the device axis where ``jax.shard_map`` exists
+        (parallel/mesh.py). The multi-pool solve pays one dispatch and one
+        result fetch instead of K sequential rounds; each lane's
+        post-processing (device ranking, sparse plan, refine, decode) is
+        the same code the solo path runs, so plans are identical.
+
+        Falls back to per-problem ``dispatch_encoded`` whenever lanes do
+        not apply (pallas backend, open breaker, fewer than two non-empty
+        problems, or KARPENTER_TPU_PARTITION_SOLVE=0)."""
+        from ..resilience import breakers as _rbreakers
+
+        lanes = [i for i, (p, _e) in enumerate(items) if len(p.group_pods) > 0]
+        if (
+            os.environ.get("KARPENTER_TPU_PARTITION_SOLVE", "auto") == "0"
+            or len(lanes) < 2
+            or self._resolved_mode() != "xla"
+            or not _rbreakers.get("solver.xla-scan").available()
+        ):
+            return [self.dispatch_encoded(p, e) for p, e in items]
+        try:
+            lane_pendings = self._dispatch_lanes([items[i] for i in lanes])
+        except Exception as e:
+            from ..metrics import PARTITION_SOLVE_LANES
+
+            PARTITION_SOLVE_LANES.inc(len(lanes), mode="fallback")
+            _solver_log().warning(
+                "partition-lane dispatch failed; per-pool dispatch: %s: %s",
+                type(e).__name__, e,
+            )
+            return [self.dispatch_encoded(p, e) for p, e in items]
+        out: list[_PendingSolve] = []
+        it = iter(lane_pendings)
+        lane_set = set(lanes)
+        for i, (p, e) in enumerate(items):
+            out.append(next(it) if i in lane_set else self.dispatch_encoded(p, e))
+        return out
+
+    def _dispatch_lanes(self, items: Sequence[tuple]) -> list["_PendingSolve"]:
+        import jax
+        import jax.numpy as jnp
+
+        from ..metrics import PARTITION_SOLVE_LANES
+        from ..ops.ffd import _State
+        from ..parallel.mesh import (
+            lanes_mode,
+            solve_partition_lanes,
+            stack_lane_problems,
+        )
+        from ..resilience import faultgate
+
+        K = len(items)
+        GB = max(bucket(max(len(p.group_pods), 1)) for p, _ in items)
+        metas: list[dict] = []
+        NR = 64
+        for problem, existing in items:
+            G = len(problem.group_pods)
+            num_pods = int(problem.counts[:G].sum())
+            pre_rows = _encode_existing(problem, existing) if existing else None
+            n_pre = len(pre_rows[0]) if pre_rows else 0
+            pad_memo = problem.__dict__.setdefault("_pad_memo", {})
+            padded = pad_memo.get(GB)
+            if padded is None:
+                padded = pad_memo[GB] = pad_problem(problem, GB)
+            N_cap = self.max_nodes or _node_bucket(num_pods)
+            # keyed on the problem's OWN group bucket (not the batch-wide
+            # GB), so row/nonzero history transfers between the solo and
+            # batched paths and survives batch-composition changes
+            hist_key = (
+                problem.nodepool.name if problem.nodepool else "",
+                bucket(max(G, 1)),
+                bucket(max(num_pods, 1)),
+            )
+            hist = self._n_open_hist.get(hist_key)
+            est = (
+                int(hist * 1.25) + 8 if hist is not None
+                else _estimate_nodes(problem, G)
+            )
+            N = min(_node_rows_bucket(max(est, 64)), N_cap)
+            pre_extra = bucket(n_pre, minimum=256) if n_pre else 0
+            metas.append(dict(
+                problem=problem, existing=existing, padded=padded, G=G,
+                pre_rows=pre_rows, n_pre=n_pre, pre_extra=pre_extra,
+                hist_key=hist_key,
+            ))
+            NR = max(NR, N + pre_extra)
+        NR = _node_rows_bucket(NR)
+
+        t_dev = time.perf_counter()
+        faultgate.check("xla-scan")
+        args, (TB, ZB) = stack_lane_problems([m["padded"] for m in metas])
+        R = args["requests"].shape[2]
+        C = args["group_window"].shape[3]
+        node_type0 = np.zeros((K, NR), dtype=np.int32)
+        node_price0 = np.zeros((K, NR), dtype=np.float32)
+        used0 = np.zeros((K, NR, R), dtype=np.float32)
+        cap0 = np.zeros((K, NR, R), dtype=np.float32)
+        win0 = np.zeros((K, NR, ZB, C), dtype=bool)
+        n_pres = []
+        for k, m in enumerate(metas):
+            if m["pre_rows"]:
+                _nm, ptype, pused, pcap, pwin = m["pre_rows"]
+                npre = m["n_pre"]
+                node_type0[k, :npre] = ptype
+                used0[k, :npre] = pused
+                cap0[k, :npre] = pcap
+                win0[k, :npre, : pwin.shape[1]] = pwin
+            n_pres.append(m["n_pre"])
+        init = _State(
+            node_type=node_type0, node_price=node_price0, used=used0,
+            node_cap=cap0, node_window=win0,
+            n_open=np.asarray(n_pres, dtype=np.int32),
+        )
+        mode = lanes_mode()
+        with trace_span("solve.dispatch", rows=NR, lanes=K) as sp:
+            self.timings["ffd_backend"] = "xla"
+            self.timings["lanes"] = self.timings.get("lanes", 0) + K
+            res, dev_args = solve_partition_lanes(
+                args, init, n_pres, NR, dput=self._dput, mode=mode,
+            )
+            sp.set(backend="xla-scan", mode=mode)
+        PARTITION_SOLVE_LANES.inc(K, mode=mode)
+
+        from ..ops.ffd import compact_plan, rank_launch_options
+
+        shared: dict = {}
+        all_refs: list = []
+        lane_ctx: list = []
+        for k, m in enumerate(metas):
+            problem, padded = m["problem"], m["padded"]
+            G = m["G"]
+            Z = padded.group_window.shape[1]
+            state = _State(
+                node_type=res.node_type[k], node_price=res.node_price[k],
+                used=res.used[k], node_cap=res.node_cap[k],
+                node_window=res.node_window[k], n_open=res.n_open[k],
+            )
+            placed_dev = res.placed[k]
+            T_k = padded.capacity.shape[0]
+            exotic = np.zeros(TB, dtype=bool)
+            if problem.type_exotic is not None:
+                exotic[:T_k] = problem.type_exotic
+            kk = min(MAX_INSTANCE_TYPE_OPTIONS, T_k)
+            ranked_idx_dev, ranked_n_dev, best_price_dev = rank_launch_options(
+                placed_dev, dev_args["price"][k], state.used,
+                dev_args["capacity"][k], dev_args["type_window"][k],
+                state.node_window, state.node_type, self._dput(exotic), k=kk,
+            )
+            # lanes pad the type axis: clip ranked indices into the lane's
+            # REAL axis (entries past n_valid are never consumed, but the
+            # decode's bulk name materialization indexes the whole row)
+            ranked_idx_dev = jnp.minimum(ranked_idx_dev, T_k - 1)
+            nz_seen = self._nz_hist.get(m["hist_key"])
+            E = bucket(max(1024, 2 * NR, 4 * GB,
+                           0 if nz_seen is None else int(nz_seen * 1.5) + 64))
+            nz_dev, cnt_dev, total_dev = compact_plan(placed_dev, E)
+            refs = (
+                nz_dev, cnt_dev, total_dev, [res.unplaced[k]],
+                state.node_type, state.node_price, state.n_open,
+                state.node_window[:, :Z, :], ranked_idx_dev, ranked_n_dev,
+                best_price_dev,
+            )
+            all_refs.append(refs)
+            lane_ctx.append((m, {"placed_dev": placed_dev, "state": state,
+                                 "t_run0": t_dev}))
+
+        def fetch_all():
+            if "fetched" not in shared:
+                # ONE transfer drains every lane's result set
+                shared["fetched"] = jax.device_get(all_refs)
+            return shared["fetched"]
+
+        pendings: list[_PendingSolve] = []
+        for k, (m, handles) in enumerate(lane_ctx):
+            problem = m["problem"]
+            existing = m["existing"]
+            pre_extra = m["pre_extra"]
+            N_lane = NR - pre_extra
+
+            def fetch_refs(dd, _k=k):
+                return fetch_all()[_k], (dd["placed_dev"], dd["state"])
+
+            def _wait_lane(_m=m, _handles=handles, _fetch=fetch_refs,
+                           _N=N_lane, _pre_extra=pre_extra,
+                           _problem=problem, _existing=existing):
+                try:
+                    # N_cap == N: a row-exhausted lane skips the in-wait
+                    # retry and its leftover pods ride the multi-pool
+                    # straggler pass (which re-dispatches solo)
+                    out = self._wait(
+                        _problem, _handles, _fetch, None, _N, _N,
+                        _pre_extra, _m["hist_key"], _m["pre_rows"],
+                        _m["pre_rows"][0] if _m["pre_rows"] else [],
+                        _m["n_pre"], GB, t_dev,
+                    )
+                except Exception as e:
+                    return self._device_failed(_problem, _existing, e)
+                self._device_breaker().record_success()
+                return out
+
+            pendings.append(_PendingSolve(wait=_wait_lane))
+        return pendings
+
     def dispatch_encoded(
         self, problem: EncodedProblem, existing: Optional[Sequence[ExistingNode]] = None,
     ) -> "_PendingSolve":
@@ -1746,6 +1953,14 @@ def _solve_multi_nodepool(
         # non-certain reasons (limits, minValues, row exhaustion) — catch
         # up in a sequential pass; rare, and the limits/launched state
         # carries so re-offering a pool is idempotent.
+        # Partition lanes: when the impl can batch (TPUSolver), every
+        # pool's problem is collected first and dispatched as ONE device
+        # program (vmapped lanes / shard_map over the device axis) — the
+        # pod chaining below is host-computable from the encode alone, so
+        # nothing about the pipeline's semantics changes, only the number
+        # of device programs and transfer round trips.
+        batch = hasattr(impl, "dispatch_encoded_batch")
+        to_batch = []
         staged = []
         rem = pods_list
         for pool in pools_order:
@@ -1771,9 +1986,18 @@ def _solve_multi_nodepool(
                         counts[g] = 0
                 problem = dataclasses.replace(problem, counts=counts)
             certain += hopeless
-            pending = dispatch_pool(problem, pool_existing)
-            staged.append((pool, problem, pending, {p.uid for p in certain}))
+            if batch:
+                to_batch.append((problem, pool_existing))
+                pending = None
+            else:
+                pending = dispatch_pool(problem, pool_existing)
+            staged.append([pool, problem, pending, {p.uid for p in certain}])
             rem = certain
+        if batch and staged:
+            for entry, pending in zip(
+                staged, impl.dispatch_encoded_batch(to_batch)
+            ):
+                entry[2] = pending
         stragglers: list[Pod] = []
         for pool, problem, pending, certain_uids in staged:
             leftover = pool_round(

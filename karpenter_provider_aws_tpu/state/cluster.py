@@ -64,8 +64,45 @@ class Node:
 
 # Bounded change-journal length: at the production reconcile cadence this
 # covers thousands of mutations between encode passes; overflow simply
-# forces one full re-encode (never a correctness loss).
+# forces one full re-encode (never a correctness loss). This is the FLOOR
+# of the journal ladder — the store regrows its journals on a power-of-two
+# ladder as the object population grows (see ``journal_cap_for``), so a
+# 100k-node / 1M-pod store keeps enough window for steady 1% churn between
+# passes to stay incremental.
 JOURNAL_CAP = 4096
+
+#: absolute journal ceiling (~entries; a tuple is ~100B, so the worst case
+#: is ~400MB of journal across a multi-million-object store — past this the
+#: full re-encode is cheaper than the window anyway)
+JOURNAL_CAP_MAX = 1 << 22
+
+
+def journal_cap_for(n_objects: int, floor: int = JOURNAL_CAP) -> int:
+    """Journal cap on the power-of-two ladder: ~4 entries of headroom per
+    tracked object, so a full churn sweep of the population fits in the
+    window several times over before an overflow forces a rebuild."""
+    cap = floor
+    while cap < 4 * n_objects and cap < JOURNAL_CAP_MAX:
+        cap *= 2
+    return cap
+
+
+class _Partition:
+    """Per-partition change journal + revision bookkeeping (see Cluster).
+
+    Entries carry the cluster's GLOBAL revision numbers, so one consumer
+    can mix global and per-partition reads; ``rev`` is the newest global
+    revision routed to this partition (cheap "did partition p change since
+    rev r" checks without touching the journal)."""
+
+    __slots__ = ("key", "rev", "journal", "evicted_rev", "nodes")
+
+    def __init__(self, key: tuple, cap: int = 1024):
+        self.key = key
+        self.rev = 0
+        self.journal: deque = deque(maxlen=cap)
+        self.evicted_rev = 0  # newest global rev lost to the cap
+        self.nodes = 0        # live node count (journal-ladder input)
 
 
 #: Bumped by every tracked Node field write, across all clusters. The
@@ -114,6 +151,23 @@ class Cluster:
         self.rev: int = 0
         self._journal: deque = deque(maxlen=JOURNAL_CAP)
         self._journal_evicted_rev: int = 0  # newest rev lost to the cap
+        # Stable (nodepool, zone) partition index: every node maps to one
+        # partition, and journal entries route to the partition(s) they
+        # dirty IN ADDITION to the global journal. Per-partition revision
+        # counters + journals let one churning zone stay incremental for
+        # every other partition (ops/encode_partition.py), and the sharded
+        # screen/solve paths shard the partition axis across devices.
+        self._partitions: dict[tuple, _Partition] = {}
+        self._node_part: dict[str, tuple] = {}  # node name -> partition key
+        # Claim entries the router cannot place (no bound node yet) go to
+        # ONE shared claims journal instead of broadcasting into every
+        # partition's journal: a pending-claim storm (a big scale-up) must
+        # not roll every quiet partition's window at once — that would be
+        # the synchronized full-re-encode cliff the partition split exists
+        # to remove. Capped on its own ladder over the claim population.
+        self._claims_journal: deque = deque(maxlen=JOURNAL_CAP)
+        self._claims_evicted_rev: int = 0
+        self._claims_rev: int = 0
         # Epoch token: identifies THIS store incarnation. Environment.reset()
         # re-runs __init__ on the same object, so revision-keyed caches held
         # by other components key on the epoch object identity and can never
@@ -160,13 +214,163 @@ class Cluster:
                 bucket.pop(uid, None)
 
     # -- change journal ----------------------------------------------------
+    @staticmethod
+    def partition_key(node: "Node") -> tuple:
+        """The stable partition identity of a node: (nodepool, zone)."""
+        return (node.nodepool_name, node.zone())
+
+    def _partition(self, key: tuple) -> _Partition:
+        part = self._partitions.get(key)
+        if part is None:
+            part = self._partitions[key] = _Partition(key)
+        return part
+
+    def _route(self, part: _Partition, entry: tuple) -> None:
+        j = part.journal
+        if len(j) == j.maxlen:
+            cap = journal_cap_for(8 * max(part.nodes, 1), floor=1024)
+            if cap > j.maxlen:
+                # ladder regrow BEFORE overflow: the window scales with the
+                # partition population instead of silently rolling
+                part.journal = j = deque(j, maxlen=cap)
+            else:
+                part.evicted_rev = j[0][0]
+        j.append(entry)
+        part.rev = entry[0]
+
     def _record(self, kind: str, name: str) -> None:
-        """Bump ``rev`` and journal one mutation (callers hold the lock)."""
+        """Bump ``rev`` and journal one mutation (callers hold the lock).
+
+        The entry also routes to the partition(s) it dirties: node/pod
+        entries to the named node's partition, claim entries to the backing
+        node's partition when known (broadcast otherwise — a claim flip the
+        router cannot place must dirty every partition, never none).
+        Pool/nodeclass/pdb entries stay global-only: the cluster encoder
+        ignores them, and partition consumers read them from the store."""
         self.rev += 1
         j = self._journal
-        if len(j) == JOURNAL_CAP:
-            self._journal_evicted_rev = j[0][0]
-        j.append((self.rev, kind, name))
+        if len(j) == j.maxlen:
+            cap = journal_cap_for(len(self.nodes) + len(self.pods))
+            if cap > j.maxlen:
+                self._journal = j = deque(j, maxlen=cap)
+            else:
+                self._journal_evicted_rev = j[0][0]
+        entry = (self.rev, kind, name)
+        j.append(entry)
+        if kind in ("node", "pod"):
+            if name:
+                pkey = self._node_part.get(name)
+                if pkey is None:
+                    node = self.nodes.get(name)
+                    if node is not None:
+                        pkey = self.partition_key(node)
+                        self._node_part[name] = pkey
+                        self._partition(pkey).nodes += 1
+                if pkey is not None:
+                    part = self._partition(pkey)
+                    self._route(part, entry)
+                    if kind == "node":
+                        node = self.nodes.get(name)
+                        if node is None:
+                            # node left the store: route the delete, drop
+                            # the mapping so the slot is reclaimable
+                            self._node_part.pop(name, None)
+                            part.nodes = max(part.nodes - 1, 0)
+                        else:
+                            cur = self.partition_key(node)
+                            if cur != pkey:
+                                # a node hopping partitions (pool/zone label
+                                # rewrite) dirties BOTH sides
+                                self._node_part[name] = cur
+                                part.nodes = max(part.nodes - 1, 0)
+                                new = self._partition(cur)
+                                new.nodes += 1
+                                self._route(new, entry)
+        elif kind == "claim":
+            claim = self.nodeclaims.get(name)
+            pkey = None
+            if claim is not None and claim.status.node_name:
+                pkey = self._node_part.get(claim.status.node_name)
+            if pkey is not None:
+                self._route(self._partition(pkey), entry)
+            else:
+                j = self._claims_journal
+                if len(j) == j.maxlen:
+                    cap = journal_cap_for(len(self.nodeclaims))
+                    if cap > j.maxlen:
+                        self._claims_journal = j = deque(j, maxlen=cap)
+                    else:
+                        self._claims_evicted_rev = j[0][0]
+                j.append(entry)
+                self._claims_rev = self.rev
+
+    # -- partition views ---------------------------------------------------
+    def partition_keys(self) -> list[tuple]:
+        """Stable (insertion-ordered) list of known partition keys."""
+        with self._lock:
+            return list(self._partitions)
+
+    def partition_rev(self, key: tuple) -> int:
+        """Newest global revision routed to ``key`` (0 = never touched)."""
+        with self._lock:
+            part = self._partitions.get(key)
+            return part.rev if part is not None else 0
+
+    def partition_of(self, name: str) -> Optional[tuple]:
+        """The partition a node's journal entries route to (None =
+        unknown). This is the ROUTER mapping, not the node's live labels:
+        the partitioned encoder keeps its row ownership consistent with
+        entry routing, so a direct label write that 'moves' a node is
+        simply re-encoded in place by its owning partition (exactness is
+        per-node, not per-partition-assignment)."""
+        with self._lock:
+            return self._node_part.get(name)
+
+    def partition_nodes(self) -> dict[tuple, set]:
+        """Partition key -> set of node names (router view; full-build
+        scoping input for the partitioned encoder)."""
+        with self._lock:
+            out: dict[tuple, set] = {}
+            for name, key in self._node_part.items():
+                out.setdefault(key, set()).add(name)
+            return out
+
+    def note_node_update(self, node: "Node") -> None:
+        """Journal an in-place/direct mutation of a stored node. The
+        ``Node.__setattr__`` version counter already catches direct writes
+        for the encoders' defensive scan; journaling ALSO re-routes the
+        partition mapping when the write moved the node across partitions
+        (pool/zone label rewrite), dirtying both sides."""
+        with self._lock:
+            self._record("node", node.name)
+
+    def partition_changes_since(self, key: tuple, rev: int) -> Optional[dict]:
+        """Per-partition :meth:`changes_since`: mutations routed to ``key``
+        after global revision ``rev`` — plus unplaced claim entries from
+        the shared claims journal (every partition must see them) — as
+        ``{kind: [names]}``. ``{}`` when nothing relevant moved since
+        ``rev``, ``None`` when a bounded journal no longer covers
+        ``(rev, now]`` (rebuild that partition)."""
+        with self._lock:
+            part = self._partitions.get(key)
+            part_new = part is not None and part.rev > rev
+            claims_new = self._claims_rev > rev
+            if not part_new and not claims_new:
+                return {}
+            if part_new and rev < part.evicted_rev:
+                return None
+            if claims_new and rev < self._claims_evicted_rev:
+                return None
+            out: dict[str, list[str]] = {}
+            if part_new:
+                for r, kind, name in part.journal:
+                    if r > rev:
+                        out.setdefault(kind, []).append(name)
+            if claims_new:
+                for r, _kind, name in self._claims_journal:
+                    if r > rev:
+                        out.setdefault("claim", []).append(name)
+            return out
 
     def changes_since(self, rev: int) -> Optional[dict[str, list[str]]]:
         """Mutations after ``rev`` as ``{kind: [names, in order]}``.

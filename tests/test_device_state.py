@@ -66,9 +66,23 @@ def _host_mask(ct, monkeypatch):
 
 @pytest.fixture(autouse=True)
 def _fresh_mirrors():
+    import os
+
     reset_device_state()
+    device_state.reset_chained_costs()
+    # pin the chained path: these tests assert residency outcomes, and the
+    # measured-cost chooser's one-time "unchained" exploration would turn
+    # a deterministic hit/patch pass into a bypass (chooser behavior has
+    # its own TestChainedScreenChooser below)
+    prev = os.environ.get("KARPENTER_TPU_CHAINED_SCREEN")
+    os.environ["KARPENTER_TPU_CHAINED_SCREEN"] = "1"
     yield
+    if prev is None:
+        os.environ.pop("KARPENTER_TPU_CHAINED_SCREEN", None)
+    else:
+        os.environ["KARPENTER_TPU_CHAINED_SCREEN"] = prev
     reset_device_state()
+    device_state.reset_chained_costs()
 
 
 class TestResidencyOutcomes:

@@ -264,13 +264,13 @@ class TestIncrementalClusterEncode:
 
         cluster, nodes = _small_cluster(session_catalog, n=10)
         encode_cluster(cluster, session_catalog)
-        full0 = ENCODE_CACHE.value(path="cluster", outcome="full")
+        full0 = ENCODE_CACHE.sum(path="cluster", outcome="full")
         for node in nodes[:8]:  # 80% of rows dirty > PATCH_FRAC
             p = make_pods(1, f"hc{node.name}", {"cpu": "100m"})[0]
             cluster.apply(p)
             cluster.bind_pod(p.uid, node.name)
         _assert_equal(cluster, session_catalog, "heavy churn")
-        assert ENCODE_CACHE.value(path="cluster", outcome="full") > full0
+        assert ENCODE_CACHE.sum(path="cluster", outcome="full") > full0
 
 
 class TestOccupancyRevisionCache:

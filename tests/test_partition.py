@@ -1,0 +1,563 @@
+"""Partitioned cluster state + encoder + screen + solve (the 100k scale
+tier, ops/encode_partition.py):
+
+ - state/cluster.py partition index: routing, per-partition journals,
+   ladder caps, claim broadcast, cross-partition node hops
+ - sharded-vs-unsharded EXACTNESS: randomized-churn property test (3
+   seeds) asserting ``canonical_equal`` between the merged partitioned
+   emission and a from-scratch global encode, plus controller-driven
+   provisioning + consolidation passes under the partitioned path
+ - journal-overflow telemetry: cause-labelled full re-encodes and the
+   double-overflow Warning event
+ - partitioned screen: per-partition device mirrors, mirror-loss
+   degradation (one partition re-uploads, the others stay resident)
+ - partition lanes: the batched multi-pool solve matches the per-pool
+   dispatch plan exactly; merge_partition_plans conserves pods
+ - chained-vs-unchained screen chooser (the small-N inversion satellite)
+ - tier-1 /metrics guard: two identical sharded passes hit the
+   per-partition encoder and device-state caches over HTTP
+"""
+
+from __future__ import annotations
+
+import urllib.request
+
+import numpy as np
+import pytest
+
+from karpenter_provider_aws_tpu.models import labels as lbl
+from karpenter_provider_aws_tpu.models.pod import make_pods
+from karpenter_provider_aws_tpu.ops.consolidate import (
+    _encode_cluster,
+    consolidatable,
+    dispatch_screen,
+    encode_cluster,
+    force_repack_backend,
+)
+from karpenter_provider_aws_tpu.ops.device_state import (
+    drop_mirror,
+    mirror_for,
+    reset_chained_costs,
+    reset_device_state,
+    verify_mirror,
+)
+from karpenter_provider_aws_tpu.ops.encode_delta import (
+    canonical_equal,
+    canonical_form,
+)
+from karpenter_provider_aws_tpu.state.cluster import (
+    JOURNAL_CAP,
+    Cluster,
+    journal_cap_for,
+)
+
+
+def _synth(n_nodes=120):
+    from benchmarks.solve_configs import _synth_cluster
+
+    return _synth_cluster(n_nodes=n_nodes)
+
+
+@pytest.fixture(autouse=True)
+def _partitioned(monkeypatch):
+    monkeypatch.setenv("KARPENTER_TPU_PARTITION_ENCODE", "1")
+    monkeypatch.setenv("KARPENTER_TPU_CHAINED_SCREEN", "1")
+    reset_device_state()
+    reset_chained_costs()
+    yield
+    reset_device_state()
+    reset_chained_costs()
+
+
+def _assert_exact(cluster, catalog, where: str) -> None:
+    inc = encode_cluster(cluster, catalog)
+    fresh = _encode_cluster(cluster, catalog, 32)
+    diffs = canonical_equal(canonical_form(inc), canonical_form(fresh))
+    assert not diffs, f"{where}: partitioned encode diverged on {diffs}"
+
+
+def _churn(cl, names, rng, count, tag):
+    for i in range(count):
+        r = rng.rand()
+        if r < 0.5:
+            p = make_pods(1, f"{tag}{i}", {"cpu": "250m", "memory": "512Mi"})[0]
+            cl.apply(p)
+            cl.bind_pod(p.uid, names[rng.randint(len(names))])
+        elif r < 0.8:
+            bound = [pp for pp in list(cl.pods.values())[:256] if pp.node_name]
+            if bound:
+                cl.unbind_pod(bound[rng.randint(len(bound))].uid)
+        else:
+            bound = [pp for pp in list(cl.pods.values())[:256] if pp.node_name]
+            if bound:
+                cl.delete(bound[rng.randint(len(bound))])
+
+
+class TestPartitionIndex:
+    def test_routing_and_per_partition_changes(self):
+        env = _synth(n_nodes=24)
+        cl = env.cluster
+        keys = cl.partition_keys()
+        assert len(keys) > 1
+        # a bind dirties exactly the bound node's partition
+        node = next(iter(cl.nodes.values()))
+        pkey = cl.partition_of(node.name)
+        revs = {k: cl.partition_rev(k) for k in keys}
+        p = make_pods(1, "route", {"cpu": "100m"})[0]
+        cl.apply(p)          # pending pod: name "" -> global only
+        cl.bind_pod(p.uid, node.name)
+        for k in keys:
+            ch = cl.partition_changes_since(k, revs[k])
+            if k == pkey:
+                assert ch and node.name in ch.get("pod", [])
+            else:
+                # other partitions never see the bind (unplaced-claim
+                # entries from the shared claims journal may ride along)
+                assert node.name not in ch.get("pod", [])
+                assert "node" not in ch
+
+    def test_claim_without_node_broadcasts(self):
+        env = _synth(n_nodes=12)
+        cl = env.cluster
+        from karpenter_provider_aws_tpu.models.nodeclaim import NodeClaim
+
+        keys = cl.partition_keys()
+        revs = {k: cl.partition_rev(k) for k in keys}
+        claim = NodeClaim.fresh(nodepool_name="default",
+                                nodeclass_name="default")
+        cl.apply(claim)
+        for k in keys:
+            ch = cl.partition_changes_since(k, revs[k])
+            assert ch and claim.name in ch.get("claim", [])
+
+    def test_node_partition_hop_dirties_both_sides(self):
+        env = _synth(n_nodes=12)
+        cl = env.cluster
+        node = next(iter(cl.nodes.values()))
+        old = cl.partition_of(node.name)
+        other_zone = next(
+            z for (_pool, z) in cl.partition_keys() if z != old[1]
+        )
+        revs = {k: cl.partition_rev(k) for k in cl.partition_keys()}
+        node.labels = {**node.labels, lbl.TOPOLOGY_ZONE: other_zone}
+        cl.note_node_update(node)  # sanctioned journal of the direct write
+        new = cl.partition_of(node.name)
+        assert new == (node.nodepool_name, other_zone) and new != old
+        for k in (old, new):
+            ch = cl.partition_changes_since(k, revs[k])
+            assert ch and node.name in ch.get("node", [])
+
+    def test_journal_ladder(self):
+        assert journal_cap_for(10) == JOURNAL_CAP
+        assert journal_cap_for(2000) == 8192
+        assert journal_cap_for(100_000) == 1 << 19
+        assert journal_cap_for(10**9) == 1 << 22  # absolute ceiling
+        # the global journal regrows before rolling when the store is big
+        cl = Cluster()
+        from karpenter_provider_aws_tpu.state.cluster import Node
+
+        for i in range(1500):
+            cl.apply(Node(name=f"n{i}", nodepool_name="p",
+                          labels={lbl.TOPOLOGY_ZONE: "z"}))
+        rev0 = cl.rev
+        for i in range(5000):
+            cl._record("pod", f"n{i % 1500}")
+        assert cl.changes_since(rev0) is not None  # ladder held the window
+
+    def test_partition_journal_overflow_returns_none(self):
+        env = _synth(n_nodes=8)
+        cl = env.cluster
+        node = next(iter(cl.nodes.values()))
+        key = cl.partition_of(node.name)
+        rev0 = cl.partition_rev(key)
+        # a tiny partition's cap stays at the 1024 floor: roll it
+        for i in range(1500):
+            cl._record("pod", node.name)
+        assert cl.partition_changes_since(key, rev0) is None
+
+
+class TestPartitionedEncoderExactness:
+    @pytest.mark.parametrize("seed", [3, 11, 42])
+    def test_property_randomized_churn(self, seed):
+        env = _synth(n_nodes=60)
+        cl = env.cluster
+        names = [n.name for n in cl.snapshot_nodes()]
+        rng = np.random.RandomState(seed)
+        _assert_exact(cl, env.catalog, f"seed{seed} initial")
+        for step in range(8):
+            _churn(cl, names, rng, 12, f"s{seed}t{step}")
+            if step == 4:  # node deletion mid-run
+                name = names[rng.randint(len(names))]
+                n = cl.nodes.get(name)
+                if n is not None:
+                    cl.delete(n)
+            _assert_exact(cl, env.catalog, f"seed{seed} step{step}")
+
+    def test_unchanged_pass_returns_same_object(self):
+        env = _synth(n_nodes=30)
+        ct = encode_cluster(env.cluster, env.catalog)
+        assert encode_cluster(env.cluster, env.catalog) is ct
+        assert len(ct.__dict__["_partitions"]) > 1
+
+    def test_merged_patch_chain_feeds_device_mirror(self):
+        env = _synth(n_nodes=60)
+        cl = env.cluster
+        names = [n.name for n in cl.snapshot_nodes()]
+        with force_repack_backend("vmap"):
+            # disable the partitioned screen so the MERGED chain mirrors
+            import os
+
+            os.environ["KARPENTER_TPU_PARTITION_SCREEN"] = "0"
+            try:
+                ct = encode_cluster(cl, env.catalog)
+                consolidatable(ct)
+                p = make_pods(1, "mp", {"cpu": "250m", "memory": "512Mi"})[0]
+                cl.apply(p)
+                cl.bind_pod(p.uid, names[5])
+                ct2 = encode_cluster(cl, env.catalog)
+                assert ct2.__dict__.get("_patch_base") is ct
+                consolidatable(ct2)
+                assert verify_mirror(mirror_for(ct2), ct2) == []
+            finally:
+                os.environ.pop("KARPENTER_TPU_PARTITION_SCREEN", None)
+
+    def test_epoch_reset_drops_all_partition_chains(self):
+        """Environment.reset re-runs Cluster.__init__: every partition
+        chain must drop — a key absent from the new incarnation must not
+        merge its ghost emission into the new cluster's tensors."""
+        env = _synth(n_nodes=30)
+        cl = env.cluster
+        ct = encode_cluster(cl, env.catalog)
+        assert ct is not None and len(ct.node_names) == 30
+        cl.__init__()  # fresh epoch, empty store, no partitions
+        assert encode_cluster(cl, env.catalog) is None
+
+    def test_full_rebuild_refreshes_cross_partition_compat(self):
+        """A partition full rebuild (or membership change) with the node
+        count unchanged must invalidate its cross-partition compat memo —
+        the merged compat must track the LIVE rows, not the memoized ones."""
+        env = _synth(n_nodes=40)
+        cl = env.cluster
+        # seed cross-partition state + memos
+        _assert_exact(cl, env.catalog, "seed")
+        # swap one node's labels in place (defensive-scan path), then force
+        # that partition past the dirty-ratio threshold so it FULL-rebuilds
+        node = next(iter(cl.nodes.values()))
+        key = cl.partition_of(node.name)
+        members = [n for n in cl.nodes.values()
+                   if cl.partition_of(n.name) == key]
+        for n in members:  # dirty > PATCH_FRAC of the partition
+            p = mp = make_pods(1, f"fr{n.name}", {"cpu": "100m"})[0]
+            cl.apply(mp)
+            cl.bind_pod(p.uid, n.name)
+        _assert_exact(cl, env.catalog, "post full-rebuild")
+        """Provisioning + consolidation through the real controllers with
+        the partitioned encoder active: tensors stay canonical-equal."""
+        env = _synth(n_nodes=40)
+        pool = env.cluster.nodepools["default"]
+        pool.disruption.consolidate_after_s = 60
+        pool.disruption.budgets = ["10%"]
+        pods = make_pods(6, "prov", {"cpu": "250m", "memory": "512Mi"})
+        for p in pods:
+            env.cluster.apply(p)
+        env.provisioning.reconcile()
+        env.clock.advance(120)
+        env.disruption.reconcile()
+        _assert_exact(env.cluster, env.catalog, "controller cycle")
+
+
+class TestOverflowTelemetry:
+    def test_overflow_cause_and_warning_event(self):
+        from karpenter_provider_aws_tpu.events import default_recorder
+        from karpenter_provider_aws_tpu.metrics import ENCODE_CACHE
+
+        env = _synth(n_nodes=8)
+        cl = env.cluster
+        encode_cluster(cl, env.catalog)
+        node = next(iter(cl.nodes.values()))
+        key = cl.partition_of(node.name)
+        c0 = ENCODE_CACHE.sum(path="cluster_part", outcome="full",
+                              cause="journal_overflow")
+        for round_ in range(2):
+            with cl._lock:
+                for i in range(1500):  # roll ONE partition's journal
+                    cl._record("pod", node.name)
+            encode_cluster(cl, env.catalog)
+        assert ENCODE_CACHE.sum(
+            path="cluster_part", outcome="full", cause="journal_overflow"
+        ) >= c0 + 2
+        events = [
+            e for e in default_recorder().query()
+            if e.reason == "EncodeJournalOverflow"
+            and e.name == f"{key[0]}/{key[1]}"
+        ]
+        assert events, "double overflow must publish a Warning event"
+        assert events[-1].type == "Warning"
+
+
+class TestPartitionedScreen:
+    def test_masks_tighten_and_mirrors_are_per_partition(self):
+        env = _synth(n_nodes=80)
+        cl = env.cluster
+        with force_repack_backend("vmap"):
+            ct = encode_cluster(cl, env.catalog)
+            parts = ct.__dict__["_partitions"]
+            mask = consolidatable(ct)
+            for _key, pct, _off, _n in parts:
+                assert mirror_for(pct) is not None
+            import os
+
+            os.environ["KARPENTER_TPU_PARTITION_SCREEN"] = "0"
+            try:
+                ct.__dict__.pop("_screen_mask_memo", None)
+                global_mask = consolidatable(ct)
+            finally:
+                os.environ.pop("KARPENTER_TPU_PARTITION_SCREEN", None)
+            # partition-local repack is a sound tightening of the global
+            assert not (mask & ~global_mask).any()
+
+    def test_one_partition_mirror_loss_degrades_locally(self):
+        """Chaos: kill ONE partition's device session mid-storm — that
+        partition re-uploads, every other partition stays resident."""
+        from karpenter_provider_aws_tpu.metrics import DEVICE_STATE
+
+        env = _synth(n_nodes=80)
+        cl = env.cluster
+        names = [n.name for n in cl.snapshot_nodes()]
+        rng = np.random.RandomState(5)
+        with force_repack_backend("vmap"):
+            ct = encode_cluster(cl, env.catalog)
+            consolidatable(ct)
+            # storm: churn + mid-storm session loss on partition 0
+            _churn(cl, names, rng, 10, "storm")
+            parts = ct.__dict__["_partitions"]
+            drop_mirror(parts[0][1])
+            ct2 = encode_cluster(cl, env.catalog)
+
+            def outcome(k):
+                return DEVICE_STATE.value(path="screen", outcome=k)
+
+            up0, patch0 = outcome("upload"), outcome("patch")
+            mask = consolidatable(ct2)
+            assert outcome("upload") == up0 + 1  # ONLY the lost partition
+            assert outcome("patch") >= patch0 + 1  # others scatter-patched
+            # and the answer still matches the host path exactly
+            import os
+
+            os.environ["KARPENTER_TPU_DEVICE_STATE"] = "0"
+            try:
+                for _k, pct, _o, _n in ct2.__dict__["_partitions"]:
+                    pct.__dict__.pop("_screen_mask_memo", None)
+                ct2.__dict__.pop("_screen_mask_memo", None)
+                host = consolidatable(ct2)
+            finally:
+                os.environ.pop("KARPENTER_TPU_DEVICE_STATE", None)
+            assert (mask == host).all()
+
+
+class TestChaosPartitioned:
+    def test_spot_storm_invariants_green_under_partitioned_encode(self):
+        from karpenter_provider_aws_tpu.chaos import run_scenario
+
+        report = run_scenario("spot-storm", seed=7)
+        failed = [c.line() for c in report.invariants if not c.passed]
+        assert not failed, failed
+
+    @pytest.mark.slow
+    def test_same_seed_byte_identical_partitioned(self):
+        from karpenter_provider_aws_tpu.chaos import run_deterministic
+
+        a, b = run_deterministic("spot-storm", seed=7, runs=2)
+        assert a.signature == b.signature and len(a.signature) > 0
+
+
+class TestPartitionLanes:
+    def _pools_and_pods(self):
+        from karpenter_provider_aws_tpu.catalog import CatalogProvider
+        from karpenter_provider_aws_tpu.models import (
+            NodePool,
+            Operator,
+            Requirement,
+        )
+
+        catalog = CatalogProvider()
+        pools = [
+            NodePool(name="a", weight=10, requirements=[
+                Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c",))]),
+            NodePool(name="b", weight=5, requirements=[
+                Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("m",))]),
+        ]
+        pods = make_pods(24, "x", {"cpu": "500m", "memory": "1Gi"}) + \
+            make_pods(18, "y", {"cpu": "2000m", "memory": "2Gi"},
+                      node_selector={lbl.INSTANCE_CATEGORY: "m"})
+        return catalog, pools, pods
+
+    @staticmethod
+    def _sig(res):
+        return sorted(
+            (s.nodepool_name, tuple(s.instance_type_options), len(s.pods),
+             round(s.estimated_price, 6))
+            for s in res.node_specs
+        )
+
+    def test_lanes_plan_equals_per_pool_dispatch(self, monkeypatch):
+        from karpenter_provider_aws_tpu.metrics import PARTITION_SOLVE_LANES
+        from karpenter_provider_aws_tpu.scheduling.solver import TPUSolver
+
+        catalog, pools, pods = self._pools_and_pods()
+        c0 = PARTITION_SOLVE_LANES.sum()
+        lanes = TPUSolver().solve(pods, pools, catalog)
+        assert PARTITION_SOLVE_LANES.sum(mode="vmap") > 0 or \
+            PARTITION_SOLVE_LANES.sum(mode="shard_map") > 0
+        assert PARTITION_SOLVE_LANES.sum() >= c0 + 2
+        monkeypatch.setenv("KARPENTER_TPU_PARTITION_SOLVE", "0")
+        solo = TPUSolver().solve(pods, pools, catalog)
+        assert self._sig(lanes) == self._sig(solo)
+        assert len(lanes.unschedulable) == len(solo.unschedulable) == 0
+
+    def test_merge_partition_plans_conserves_pods(self):
+        import jax
+
+        from karpenter_provider_aws_tpu.catalog import CatalogProvider
+        from karpenter_provider_aws_tpu.models import NodePool
+        from karpenter_provider_aws_tpu.ops.encode import (
+            encode_problem,
+            pad_problem,
+        )
+        from karpenter_provider_aws_tpu.ops.ffd import _State
+        from karpenter_provider_aws_tpu.parallel.mesh import (
+            merge_partition_plans,
+            solve_partition_lanes,
+            stack_lane_problems,
+        )
+
+        catalog = CatalogProvider()
+        pool = NodePool(name="default")
+        zones = catalog.zones[:2]
+        problems = []
+        for z in zones:
+            pods = make_pods(30, f"z{z}", {"cpu": "500m", "memory": "1Gi"},
+                             node_selector={lbl.TOPOLOGY_ZONE: z})
+            problems.append(encode_problem(pods, catalog, nodepool=pool))
+        GB = max(p.requests.shape[0] for p in problems)
+        padded = [pad_problem(p, GB) for p in problems]
+        args, (TB, ZB) = stack_lane_problems(padded)
+        K, N = len(padded), 256
+        R = args["requests"].shape[2]
+        C = args["group_window"].shape[3]
+        init = _State(
+            node_type=np.zeros((K, N), np.int32),
+            node_price=np.zeros((K, N), np.float32),
+            used=np.zeros((K, N, R), np.float32),
+            node_cap=np.zeros((K, N, R), np.float32),
+            node_window=np.zeros((K, N, ZB, C), bool),
+            n_open=np.zeros(K, np.int32),
+        )
+        res, _dev = solve_partition_lanes(args, init, [0] * K, N, mode="vmap")
+        fetched = jax.device_get(res)
+        lane_plans = []
+        total = 0
+        for k, p in enumerate(problems):
+            G = len(p.group_pods)
+            Z = p.group_window.shape[1]
+            assert int(np.asarray(fetched.unplaced[k][:G]).sum()) == 0
+            lane_plans.append({
+                "node_type": np.asarray(fetched.node_type[k]),
+                "node_price": np.asarray(fetched.node_price[k]),
+                "used": np.asarray(fetched.used[k]),
+                "node_window": np.asarray(fetched.node_window[k])[:, :Z],
+                "placed": np.asarray(fetched.placed[k]),
+                "n_open": int(fetched.n_open[k]),
+            })
+            total += int(p.counts[:G].sum())
+        merged = merge_partition_plans(problems, lane_plans)
+        kept = ~merged["dropped"]
+        assert int(merged["placed"][:, kept].sum()) == total
+        assert merged["cost_merged"] <= merged["cost_lanes"] + 1e-6
+
+
+class TestChainedScreenChooser:
+    def test_explore_then_pick_cheaper(self, monkeypatch):
+        from karpenter_provider_aws_tpu.ops.device_state import (
+            _CHAINED_COST,
+            _cost_bucket,
+            note_screen_cost,
+            pick_chained,
+        )
+
+        monkeypatch.delenv("KARPENTER_TPU_CHAINED_SCREEN", raising=False)
+        reset_chained_costs()
+        n = 400
+        assert pick_chained(n) is True            # explore chained first
+        note_screen_cost(n, True, 20.6)
+        assert pick_chained(n) is False           # explore unchained once
+        note_screen_cost(n, False, 16.4)
+        assert pick_chained(n) is False           # measured winner
+        # a flipped measurement flips the choice — cost decides, not scale
+        note_screen_cost(n, True, 2.0)
+        assert _CHAINED_COST[_cost_bucket(n)]["chained"] == 2.0
+        assert pick_chained(n) is True
+
+    def test_env_pin_wins(self, monkeypatch):
+        from karpenter_provider_aws_tpu.ops.device_state import (
+            note_screen_cost,
+            pick_chained,
+        )
+
+        reset_chained_costs()
+        note_screen_cost(300, True, 100.0)
+        note_screen_cost(300, False, 1.0)
+        monkeypatch.setenv("KARPENTER_TPU_CHAINED_SCREEN", "1")
+        assert pick_chained(300) is True
+        monkeypatch.setenv("KARPENTER_TPU_CHAINED_SCREEN", "0")
+        assert pick_chained(300) is False
+
+
+class TestMetricsGuardTier1Partitioned:
+    def test_two_identical_sharded_passes_hit_both_caches(self):
+        """Tier-1 guard: under the partitioned encoder, a second identical
+        disruption reconcile must (a) serve the merged tensors from the
+        per-partition encoder caches and (b) serve every partition's
+        screen from its resident device mirror — both visible at /metrics
+        over HTTP."""
+        from karpenter_provider_aws_tpu.metrics import REGISTRY
+
+        env = _synth(n_nodes=40)
+        pool = env.cluster.nodepools["default"]
+        pool.disruption.consolidate_after_s = 60
+        pool.disruption.budgets = ["0%"]
+        env.clock.advance(120)
+
+        def scrape(port, name, **labels):
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics").read().decode()
+            total = 0.0
+            for line in body.splitlines():
+                if line.startswith(name) and all(
+                    f'{k}="{v}"' in line for k, v in labels.items()
+                ):
+                    total += float(line.rsplit(" ", 1)[1])
+            return total
+
+        port = REGISTRY.serve(0)
+        try:
+            with force_repack_backend("vmap"):
+                env.disruption.reconcile()
+                e1 = scrape(port, "karpenter_encode_cache_total",
+                            path="cluster", outcome="hit")
+                p1 = scrape(port, "karpenter_encode_cache_total",
+                            path="cluster_part", outcome="hit")
+                d1 = scrape(port, "karpenter_device_state_total",
+                            path="screen", outcome="hit")
+                env.disruption.reconcile()
+                e2 = scrape(port, "karpenter_encode_cache_total",
+                            path="cluster", outcome="hit")
+                p2 = scrape(port, "karpenter_encode_cache_total",
+                            path="cluster_part", outcome="hit")
+                d2 = scrape(port, "karpenter_device_state_total",
+                            path="screen", outcome="hit")
+        finally:
+            REGISTRY.stop()
+        assert e2 > e1, "merged-emission hit counter did not move"
+        assert p2 > p1, "per-partition hit counter did not move"
+        assert d2 > d1, "device-state hit counter did not move"
