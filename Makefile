@@ -4,7 +4,7 @@
 
 PYTEST ?= python -m pytest
 
-.PHONY: presubmit test deflake stress e2etests benchmark interruption-bench verify multichip native soak sidecar-client
+.PHONY: presubmit test deflake stress e2etests benchmark interruption-bench verify multichip native soak sidecar-client sim-smoke sim-sweep
 
 presubmit: test multichip  ## everything CI gates on
 
@@ -47,3 +47,13 @@ native/build/sidecar_client: tools/sidecar_client.cpp
 
 soak:  ## randomized churn with convergence invariants (SOAK_ROUNDS scales)
 	SOAK_ROUNDS=$${SOAK_ROUNDS:-150} $(PYTEST) tests/test_soak.py -q
+
+sim-smoke:  ## 500-node 2-simulated-hour fleet run under the SLO regression gate
+	JAX_PLATFORMS=cpu python -m karpenter_provider_aws_tpu.sim run \
+		--trace smoke --seed 0 --report /tmp/fleet_report_smoke.json
+	python tools/fleet_gate.py /tmp/fleet_report_smoke.json \
+		--baseline karpenter_provider_aws_tpu/sim/baselines/smoke-500.json
+
+sim-sweep:  ## scale-tier ladder + cliff detector (slow; SIM_TIERS overrides)
+	JAX_PLATFORMS=cpu python -m karpenter_provider_aws_tpu.sim sweep \
+		--trace smoke --seed 0 --tiers $${SIM_TIERS:-500,1000,2000}

@@ -149,6 +149,21 @@ def _relay(phase: str, lines) -> None:
 # child phases (run in subprocesses; `--child=<phase>` dispatch at bottom)
 # --------------------------------------------------------------------------
 
+def _detail_writer(extra: dict):
+    """The one per-row detail sink every child phase shares: stamp if the
+    producer didn't, refuse unknown backends, append to BENCH_DETAIL.jsonl
+    immediately (streaming — a later wedge must not lose measured rows)."""
+
+    def on_row(row):
+        if "provenance" not in row:
+            stamp(row)
+        check_backend(row)
+        with open(DETAIL_PATH, "a") as f:
+            f.write(json.dumps({**row, **extra}) + "\n")
+
+    return on_row
+
+
 def _enable_jit_cache() -> None:
     if os.environ.get("BENCH_COMPILE_CACHE", "1") == "1":
         # persistent jit cache: children and repeat bench runs share
@@ -483,15 +498,7 @@ def child_encode() -> None:
     from benchmarks.encode_bench import run_all as run_encode
 
     scale = float(os.environ.get("BENCH_ENCODE_SCALE", "1.0"))
-    at = {"run_at_unix": int(time.time()), "scale": scale}
-
-    def on_row(row):
-        if "provenance" not in row:
-            stamp(row)
-        check_backend(row)
-        with open(DETAIL_PATH, "a") as f:
-            f.write(json.dumps({**row, **at}) + "\n")
-
+    on_row = _detail_writer({"run_at_unix": int(time.time()), "scale": scale})
     with contextlib.redirect_stdout(sys.stderr):
         run_encode(scale=scale, on_row=on_row)
 
@@ -509,15 +516,7 @@ def child_device_state() -> None:
     from benchmarks.device_state_bench import run_all as run_device_state
 
     scale = float(os.environ.get("BENCH_DEVICE_STATE_SCALE", "1.0"))
-    at = {"run_at_unix": int(time.time()), "scale": scale}
-
-    def on_row(row):
-        if "provenance" not in row:
-            stamp(row)
-        check_backend(row)
-        with open(DETAIL_PATH, "a") as f:
-            f.write(json.dumps({**row, **at}) + "\n")
-
+    on_row = _detail_writer({"run_at_unix": int(time.time()), "scale": scale})
     with contextlib.redirect_stdout(sys.stderr):
         run_device_state(scale=scale, on_row=on_row)
 
@@ -535,17 +534,26 @@ def child_scale() -> None:
     from benchmarks.scale_bench import run_all as run_scale
 
     scale = float(os.environ.get("BENCH_SCALE_TIER_SCALE", "1.0"))
-    at = {"run_at_unix": int(time.time()), "scale": scale}
-
-    def on_row(row):
-        if "provenance" not in row:
-            stamp(row)
-        check_backend(row)
-        with open(DETAIL_PATH, "a") as f:
-            f.write(json.dumps({**row, **at}) + "\n")
-
+    on_row = _detail_writer({"run_at_unix": int(time.time()), "scale": scale})
     with contextlib.redirect_stdout(sys.stderr):
         run_scale(scale=scale, on_row=on_row)
+
+
+def child_sim() -> None:
+    """Fleet-simulator rows: wall per simulated day + the SLO/efficiency
+    gate metrics at two fleet sizes (benchmarks/sim_bench.py). Host-only
+    (the sim drives the full controller manager with the host solver and
+    the native screen)."""
+    import contextlib
+
+    _force_cpu_if_asked()
+
+    from benchmarks.sim_bench import run_all as run_sim
+
+    scale = float(os.environ.get("BENCH_SIM_SCALE", "1.0"))
+    on_row = _detail_writer({"run_at_unix": int(time.time()), "scale": scale})
+    with contextlib.redirect_stdout(sys.stderr):
+        run_sim(scale=scale, on_row=on_row)
 
 
 def child_multichip() -> None:
@@ -556,15 +564,7 @@ def child_multichip() -> None:
     from benchmarks.multichip_bench import run_all as run_multichip
 
     scale = float(os.environ.get("BENCH_MULTICHIP_SCALE", "1.0"))
-    at = {"run_at_unix": int(time.time()), "scale": scale}
-
-    def on_row(row):
-        if "provenance" not in row:
-            stamp(row)
-        check_backend(row)
-        with open(DETAIL_PATH, "a") as f:
-            f.write(json.dumps({**row, **at}) + "\n")
-
+    on_row = _detail_writer({"run_at_unix": int(time.time()), "scale": scale})
     with contextlib.redirect_stdout(sys.stderr):
         run_multichip(scale=scale, on_row=on_row)
 
@@ -580,15 +580,7 @@ def child_configs() -> None:
 
     scale = float(os.environ.get("BENCH_CONFIG_SCALE", "1.0"))
     iters = int(os.environ.get("BENCH_CONFIG_ITERS", "30"))
-    at = {"run_at_unix": int(time.time()), "scale": scale}
-
-    def on_row(row):
-        if "provenance" not in row:
-            stamp(row)
-        check_backend(row)
-        with open(DETAIL_PATH, "a") as f:
-            f.write(json.dumps({**row, **at}) + "\n")
-
+    on_row = _detail_writer({"run_at_unix": int(time.time()), "scale": scale})
     with contextlib.redirect_stdout(sys.stderr):
         run_all(scale=scale, iters=iters, on_row=on_row)
 
@@ -759,6 +751,14 @@ def main() -> None:
         )
         if err:
             errors.append(err)
+        # fleet-simulator rows: a simulated day's wall + SLO gate metrics
+        # at two fleet sizes (sim/; host solver + native screen)
+        _, err = run_child(
+            "sim", min(300.0, _remaining() - SAFETY_MARGIN_S),
+            env_extra={"BENCH_FORCE_CPU": "1"},
+        )
+        if err:
+            errors.append(err)
         # virtual-mesh multichip rows: sharded solve+merge and the
         # mesh-sharded 5k consolidation screen (own process: the virtual
         # platform must be set before jax initializes)
@@ -868,7 +868,7 @@ if __name__ == "__main__":
                 {"host": child_host, "measure": child_measure,
                  "configs": child_configs, "multichip": child_multichip,
                  "encode": child_encode, "scale": child_scale,
-                 "device_state": child_device_state}[child]()
+                 "device_state": child_device_state, "sim": child_sim}[child]()
             except Exception as e:
                 traceback.print_exc()
                 if child == "measure":

@@ -55,6 +55,13 @@ SUPERSEDED_BY = {
     "multichip_8dev_250node_screen": "multichip_8dev_500node_screen",
     "native_config1_2k": "config1_homogeneous_2k",
     "native_config2_50k": "config2_heterogeneous_50k",
+    # the virtual-mesh solve-merge and static SPMD-partition-analysis rows
+    # predate the provenance contract; the measured partition-lane solve +
+    # cross-partition merge of the stamped config9 row answers the merge
+    # question with attribution, and the measured-at-scale screen row
+    # replaces the static partition-evidence analysis
+    "multichip_8dev_2k_merge": "config9_100k_nodes",
+    "multichip_8dev_partition_evidence": "multichip_8dev_5000node_screen",
 }
 
 
@@ -134,6 +141,11 @@ def fmt(row: dict) -> str:
               # seconds time-to-bind/ready through the controller stack
               "bind_count", "unbound", "ready_count", "p50_s", "p99_s",
               "max_s",
+              # fleet-simulator rows (docs/simulation.md): wall per
+              # simulated day + the SLO/efficiency gate metrics
+              "wall_ms", "sim_hours", "passes", "slo_worst_burn",
+              "packing_eff_min", "cost_vs_oracle_p95", "bind_p99_s",
+              "attribution_coverage",
               "probe_error"):
         if k in row and row[k] is not None:
             v = row[k]

@@ -1,0 +1,82 @@
+"""Fleet-simulator bench rows: wall per simulated day + the SLO gate
+metrics, stamped into BENCH_DETAIL.jsonl.
+
+Each row drives one seeded trace through the REAL controller manager
+(``sim/``) and reports how much wall clock a simulated day costs at that
+fleet size alongside the judgment-layer outcome of the day — worst SLO
+burn, minimum packing efficiency, p95 cost-vs-oracle, bind p99 — so a
+future perf PR that makes the control plane faster but WORSE shows up in
+the same row that celebrates the speedup. ``wall_ms`` is normalized to a
+24h simulated day (the acceptance unit) whatever the trace's duration.
+
+Run directly: ``python -m benchmarks.sim_bench``; the bench harness runs
+it as ``bench.py --child=sim``.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def bench_sim_day(nodes: int, trace_name: str = "smoke", seed: int = 0) -> dict:
+    from karpenter_provider_aws_tpu.sim import canned_trace, run_trace
+
+    spec = canned_trace(trace_name)
+    report = run_trace(spec, seed=seed, nodes=nodes)
+    gate = report.gate
+    wall = report.data["wall"]
+    sim_hours = spec.duration_s / 3600.0
+    per_day_ms = (wall["wall_s"] or 0.0) * 1e3 * (24.0 / sim_hours)
+    return {
+        "benchmark": f"sim_day_{nodes}node",
+        "nodes": nodes,
+        "trace": trace_name,
+        "seed": seed,
+        "sim_hours": round(sim_hours, 2),
+        "passes": report.data["virtual"]["driver"]["passes"],
+        "wall_ms": round(per_day_ms, 1),           # normalized to a 24h day
+        "wall_measured_s": wall["wall_s"],
+        "slo_worst_burn": gate["slo_worst_burn"],
+        "packing_eff_min": gate["packing_eff_min"],
+        "cost_vs_oracle_p95": gate["cost_vs_oracle_p95"],
+        "bind_p99_s": gate["pod_time_to_bind_p99_s"],
+        "attribution_coverage": gate["attribution_coverage"],
+        "invariants_failed": gate["invariants_failed"],
+        "signature": report.signature()[:16],
+        "device": "host",
+        "backend": "host",
+        "note": "full controller manager on FakeClock; wall_ms normalized "
+                "to a 24h simulated day",
+    }
+
+
+def run_all(scale: float = 1.0, on_row=None) -> list[dict]:
+    rows = []
+    for nodes in (max(int(500 * scale), 100), max(int(2000 * scale), 200)):
+        row = bench_sim_day(nodes)
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+        if on_row is not None:
+            on_row(row)
+    return rows
+
+
+def main() -> None:
+    import os
+
+    from karpenter_provider_aws_tpu.trace.provenance import stamp_row
+
+    detail = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_DETAIL.jsonl",
+    )
+    at = {"run_at_unix": int(time.time()), "scale": 1.0}
+    with open(detail, "a") as f:
+        for row in run_all():
+            stamp_row(row)
+            f.write(json.dumps({**row, **at}) + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -30,6 +30,15 @@ def run_all(on_row=None, waves: int = 6, pods_per_wave: int = 50,
 
     rows = []
     env = new_environment(use_tpu_solver=False)
+    # sub-tick SLI stamps (utils/clock.py): without interpolation every
+    # bind in a pass snaps to the FakeClock tick and the rows degenerate
+    # to p50 == p99 == the step size — a histogram that cannot regress.
+    # With it, each bind lands microseconds apart in deterministic
+    # read-count order, so the percentiles discriminate a staggered
+    # pipeline. Cap stays under the step so interpolation never crosses
+    # a tick.
+    env.clock.enable_subtick(resolution_s=0.001,
+                             cap_s=min(2.0, step_advance_s * 0.4))
     try:
         env.apply_defaults()
         t0 = time.perf_counter()

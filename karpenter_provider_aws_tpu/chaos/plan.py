@@ -182,3 +182,26 @@ def canned(name: str) -> Scenario:
             f"unknown canned scenario {name!r}; shipped: {list_canned()}"
         )
     return Scenario.from_file(path)
+
+
+def compose_overlay(scenario, at_s: float = 0.0,
+                    stretch: float = 1.0) -> list[TimedFault]:
+    """A scenario's fault timeline as an OVERLAY: private fault clones
+    (the same data round-trip :class:`ChaosHarness` uses — fault
+    instances carry per-run fire state, so sharing would break
+    determinism) shifted to start at ``at_s`` and optionally stretched.
+
+    The fleet simulator (``sim/``) composes these onto its own workload
+    trace: a spot-storm or api-brownout window dropped into a simulated
+    day of diurnal load. Only the ``timeline`` participates — the
+    scenario's workloads/pool/settle knobs belong to the chaos harness
+    and are ignored here."""
+    sc = canned(scenario) if isinstance(scenario, str) else scenario
+    out: list[TimedFault] = []
+    for tf in sc.timeline:
+        clone = TimedFault.from_dict(tf.to_dict())
+        clone.at_s = at_s + clone.at_s * stretch
+        if clone.duration_s is not None:
+            clone.duration_s = clone.duration_s * stretch
+        out.append(clone)
+    return sorted(out, key=lambda t: t.at_s)

@@ -265,10 +265,13 @@ class DisruptionController:
             }
         except Exception:
             pass  # per-claim get() fallback keeps the sweep alive
+        discovery_cache: dict = {}  # per-sweep nodeclass discovery memo
         for claim, node in claims_nodes:
             if claim.deleted:
                 continue
-            reason = self.cloudprovider.is_drifted(claim, instances=instances)
+            reason = self.cloudprovider.is_drifted(
+                claim, instances=instances, discovery_cache=discovery_cache
+            )
             if reason != DriftReason.NONE:
                 self._disrupt(claim, f"drifted:{reason.value}", budget)
 
@@ -484,8 +487,12 @@ class DisruptionController:
             # (parity: core nomination protecting in-flight capacity)
             if self.provisioning is not None:
                 node_name = claim.status.node_name
+                # bound-pod index, not the full-store scan: this runs per
+                # committed replacement, and commit-heavy consolidation
+                # passes paid O(pods) per commit
+                bound = self.cluster.pods_on_nodes([node_name]).get(node_name, [])
                 with self.provisioning._nominations_lock:
-                    for pod in self.cluster.pods_on_node(node_name):
+                    for pod in bound:
                         self.provisioning.nominations[pod.uid] = replacement.name
             self._disrupt(
                 claim, f"consolidatable:replace->{type_name}", budget,
@@ -570,10 +577,13 @@ class DisruptionController:
                 # interchangeable (same scheduling key + labels), so any
                 # overflow[g] of the group's pods on the subset will do.
                 if self.provisioning is not None:
+                    subset_pods = self.cluster.pods_on_nodes(
+                        [ct.node_names[i] for i in subset]
+                    )
                     on_subset = {
                         p.uid
-                        for i in subset
-                        for p in self.cluster.pods_on_node(ct.node_names[i])
+                        for pods in subset_pods.values()
+                        for p in pods
                     }
                     with self.provisioning._nominations_lock:
                         for g, cnt in overflow.items():

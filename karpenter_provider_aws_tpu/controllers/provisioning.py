@@ -140,8 +140,17 @@ class ProvisioningController:
         self._apply_binds(result.binds)
         specs = result.node_specs
         if specs:
-            if len(specs) == 1:
-                self._launch(specs[0])
+            import os
+
+            if len(specs) == 1 or os.environ.get(
+                "KARPENTER_TPU_SERIAL_LAUNCH"
+            ) == "1":
+                # KARPENTER_TPU_SERIAL_LAUNCH=1: deterministic harnesses
+                # (the fleet simulator's byte-identical-report contract)
+                # serialize launches — thread scheduling otherwise decides
+                # claim names, event order, and capacity-pool draw order
+                for spec in specs:
+                    self._launch(spec)
             else:
                 with ThreadPoolExecutor(max_workers=min(MAX_LAUNCH_WORKERS, len(specs))) as pool:
                     list(pool.map(self._launch, specs))

@@ -183,6 +183,14 @@ class SchedulingController:
             with self.provisioning._nominations_lock:
                 nominated = set(self.provisioning.nominations)
         nodes = {n.name: n for n in self.cluster.snapshot_nodes()}
+        # Vectorized fit pre-filter: one [N, R] matrix in free-map order,
+        # one numpy comparison per pod, then the label/taint/topology
+        # checks run only on nodes that FIT — same first-fit order and
+        # outcome as the per-node loop, without walking 10k non-fitting
+        # rows in Python per pod (the fleet simulator's attribution
+        # profile had this loop as the #2 controller at fleet scale).
+        names = list(free)
+        fmat = np.stack([free[n] for n in names])
         # Per-pass memo of zone->matching-pod counts; binds change the counts,
         # so it is dropped after every successful bind.
         zone_cache: dict = {}
@@ -190,10 +198,12 @@ class SchedulingController:
             if pod.uid in nominated:
                 continue
             reqs = pod.requirements()
-            for name, f in free.items():
+            fit_rows = np.nonzero(
+                ~((pod.requests.v > fmat + 1e-6).any(axis=1))
+            )[0]
+            for i in fit_rows:
+                name = names[i]
                 node = nodes[name]
-                if (pod.requests.v > f + 1e-6).any():
-                    continue
                 if not reqs.satisfied_by_labels(node.labels):
                     continue
                 if not pod.tolerates_all(node.taints):
@@ -201,6 +211,6 @@ class SchedulingController:
                 if not self._topology_allows(pod, node, nodes, zone_cache):
                     continue
                 self.cluster.bind_pod(pod.uid, name, now=self.clock.now())
-                free[name] = f - pod.requests.v
+                fmat[i] = fmat[i] - pod.requests.v
                 zone_cache.clear()
                 break

@@ -465,6 +465,23 @@ CRASHLOOP_BACKOFFS = REGISTRY.counter(
     "controller (reset on the first successful reconcile)",
 )
 
+# -- sim/ subsystem: deterministic fleet simulator --------------------------
+SIM_EVENTS = REGISTRY.counter(
+    "karpenter_sim_events_total",
+    "Workload-trace events applied by the fleet simulator, by kind "
+    "(wave / flood / churn / expire / overlay-activate / overlay-deactivate)",
+)
+SIM_PASSES = REGISTRY.counter(
+    "karpenter_sim_controller_passes_total",
+    "Full controller-manager reconcile passes driven by the fleet "
+    "simulator (micro-bursts after events + steady heartbeat)",
+)
+SIM_VIRTUAL_SECONDS = REGISTRY.gauge(
+    "karpenter_sim_virtual_seconds",
+    "Virtual seconds elapsed in the current (or most recent) fleet-"
+    "simulator run; /debug/sim serves the full last-run summary",
+)
+
 # Catalog gauges (parity: instancetype metrics.go:32-75 — vCPU/memory per
 # type, offering price/availability per (type, zone, capacity type)).
 INSTANCE_TYPE_VCPU = REGISTRY.gauge(

@@ -38,6 +38,12 @@ def main(argv=None) -> int:
              "the in-process audit ring",
     )
     p_explain.add_argument(
+        "--sim-report", default="",
+        help="fleet-report JSON artifact (sim/ run --report): join the "
+             "decision against the simulated day's audit ring, events, "
+             "provenance stamps, and run-level SLO summary",
+    )
+    p_explain.add_argument(
         "--json", action="store_true", help="emit the joined view as JSON"
     )
 
@@ -66,11 +72,25 @@ def main(argv=None) -> int:
         print("subject must be <kind>/<name>", file=sys.stderr)
         return 2
     kind, name = args.subject.split("/", 1)
-    if args.audit_file:
+    events = None
+    slo = None
+    if args.sim_report:
+        from .audit import AuditRecord
+
+        with open(args.sim_report) as f:
+            report = json.load(f)
+        virtual = report.get("virtual", {})
+        audit = [
+            AuditRecord.from_dict(r)
+            for r in virtual.get("audit", {}).get("records", [])
+        ]
+        events = virtual.get("events", [])
+        slo = virtual.get("slo_summary", {})
+    elif args.audit_file:
         audit = AuditLog.load_jsonl(args.audit_file)
     else:
         audit = default_audit()
-    view = explain(kind, name, audit=audit)
+    view = explain(kind, name, audit=audit, recorder=events, slo=slo)
     if args.json:
         print(json.dumps(view, indent=2))
     else:

@@ -19,13 +19,20 @@ def explain(
     audit=None,
     recorder=None,
     limit: int = 50,
+    slo: Optional[dict] = None,
 ) -> dict:
     """JSON-ready joined view for one object.
 
     ``kind`` is the subject kind (Pod / NodeClaim / Node / SLO ...);
     ``audit`` an AuditLog (or a pre-loaded list of AuditRecords);
-    ``recorder`` an EventRecorder. Absent planes join as empty lists.
+    ``recorder`` an EventRecorder — or a list of event DICTS (the fleet
+    report's ``events`` section), filtered here on kind/name. ``slo``
+    attaches run-level SLO context (the fleet report's ``slo_summary``)
+    to the view so a simulated day's decision reads with the day's
+    promises beside it. Absent planes join as empty lists.
     """
+    from types import SimpleNamespace
+
     records: list = []
     if audit is not None:
         if hasattr(audit, "query"):
@@ -37,7 +44,18 @@ def explain(
             ][-limit:]
     events: list = []
     if recorder is not None:
-        events = recorder.query(kind=kind, name=name)
+        if hasattr(recorder, "query"):
+            events = recorder.query(kind=kind, name=name)
+        else:  # fleet-report event dicts
+            events = [
+                SimpleNamespace(
+                    type=e.get("type", ""), reason=e.get("reason", ""),
+                    message=e.get("message", ""), at=float(e.get("at", 0.0)),
+                    count=int(e.get("count", 1)),
+                )
+                for e in recorder
+                if e.get("kind") == kind and e.get("name") == name
+            ]
 
     # provenance join: prefer the stamp each audit record carried at
     # decision time; fall back to the most recent live solve record
@@ -57,7 +75,7 @@ def explain(
         except Exception:
             provenance = None
 
-    return {
+    view = {
         "subject": f"{kind}/{name}",
         "audit": [r.as_dict() for r in records],
         "events": [
@@ -69,6 +87,9 @@ def explain(
         ],
         "provenance": provenance,
     }
+    if slo:
+        view["slo"] = slo
+    return view
 
 
 def render_text(view: dict) -> str:
@@ -105,5 +126,13 @@ def render_text(view: dict) -> str:
                 f"{prov.get('device', '?')}/{prov.get('backend', '?')}"
                 f"@{prov.get('git_sha', '?')}"
                 + (f" quality={prov['quality']}" if prov.get("quality") else "")
+            )
+    slo = view.get("slo")
+    if slo:
+        lines.append("run SLO context:")
+        for name, d in sorted(slo.items()):
+            lines.append(
+                f"  {name}: budget_remaining>={d.get('min_budget_remaining')} "
+                f"worst_burn={d.get('worst_burn')}"
             )
     return "\n".join(lines)

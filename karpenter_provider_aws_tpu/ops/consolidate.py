@@ -17,6 +17,7 @@ candidate axis can be chunked by the host for memory.
 from __future__ import annotations
 
 import functools
+import threading
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -807,11 +808,55 @@ def _screen(ct: ClusterTensors, chunk: int):
         # The C++ screen takes bool compat only; hostname headroom is not
         # expressible there, so its screen is looser — the host validator
         # (repack_set_feasible) remains the enforcement point either way.
-        cand = np.arange(N, dtype=np.int32)
-        out[:] = repack_check_native(
-            ct.free, ct.requests, gids_s, gcounts_s,
-            ct.compat, cand,
-        )
+        #
+        # Necessary-condition pre-filter before the O(C x N) kernel: a
+        # candidate can only repack if, for EVERY group it hosts, the
+        # whole-fleet slot supply elsewhere covers the group's count under
+        # interaction-free packing (a strict relaxation of the kernel's
+        # semantics, so pruned candidates are provably not repackable).
+        # On a well-packed fleet this prunes nearly everything and turns
+        # a ~340ms/pass full-fleet proof-of-nothing into a few ms of
+        # numpy — the fleet simulator's screen-attribution finding.
+        # float32/int32 throughout: the [G, N] working set is the
+        # pre-filter's whole footprint (~25 MB at 100k nodes x 64 groups)
+        # and must not double it for precision the floor doesn't need
+        fit = np.full(ct.requests.shape[:1] + (N,), np.inf, dtype=np.float32)
+        for r in range(ct.requests.shape[1]):
+            req_r = ct.requests[:, r]
+            pos = req_r > 0
+            if pos.any():
+                fit[pos] = np.minimum(
+                    fit[pos], ct.free[None, :, r] / req_r[pos, None]
+                )
+        # clip before floor: a group with all-zero requests keeps +inf fit,
+        # and inf-total minus inf-own would poison the comparison with NaN.
+        # The relative slack keeps the filter SOUND in float32: a quotient
+        # that is exactly integral in reals may round just below it (3.0 ->
+        # 2.9999998 -> floor 2), understating supply and wrongly pruning a
+        # barely-feasible candidate — overestimating by <= 1 slot merely
+        # hands the exact kernel one extra candidate
+        fit = np.clip(fit, 0.0, np.float32(1 << 30))
+        fit = np.where(
+            ct.compat,
+            np.floor(fit * np.float32(1.000001) + np.float32(1e-6)),
+            np.float32(0.0),
+        ).astype(np.float32)
+        S_all = gids_s.shape[1]
+        cnt = np.zeros((N, ct.requests.shape[0]), dtype=np.int32)
+        rows = np.arange(N)
+        for s in range(S_all):
+            np.add.at(cnt, (rows, gids_s[:, s]), gcounts_s[:, s])
+        total = fit.sum(axis=1, dtype=np.float64)  # [G] slots fleet-wide
+        pre = ((cnt == 0) | (cnt <= (total[None, :] - fit.T))).all(axis=1)
+        pre &= ~ct.blocked
+        cand = np.nonzero(pre)[0].astype(np.int32)
+        if len(cand):
+            # the kernel wants candidate-GATHERED group rows ([C, GMAX]
+            # aligned with the candidates array), not the full node axis
+            out[cand] = repack_check_native(
+                ct.free, ct.requests, gids_s[cand], gcounts_s[cand],
+                ct.compat, cand,
+            )
         out &= ~ct.blocked
         return (lambda: out), "native", fallback, ""
     # -- XLA vmap path: device-resident inputs when available --------------
@@ -1239,6 +1284,20 @@ def replacement_for_groups(
 MIN_TYPES_FOR_SPOT_TO_SPOT = 15
 
 
+#: process-level class cache for cheaper_replacement, keyed inside on one
+#: (catalog snapshot, pool set, nodeclass set) signature — see the cache
+#: comment in the function body. Values are pure functions of their keys,
+#: so sharing across environments/runs is sound (and determinism-neutral).
+#: Publication is build-then-swap under the lock: a caller whose mkey
+#: differs builds a FRESH state object and swaps it in, so a concurrent
+#: pass in another environment keeps its own consistent reference instead
+#: of reading a cleared-and-half-repopulated dict. Same-key dict fills
+#: race benignly (idempotent values, GIL-atomic ops).
+_REPLACE_CLASS_CACHE: dict = {}
+_REPLACE_CLASS_LOCK = threading.Lock()
+_REPLACE_DEC_CAP = 262144
+
+
 def cheaper_replacement(
     ct: ClusterTensors, catalog, nodepools: Optional[dict] = None, margin: float = 0.15,
     reserved_allow: Optional[dict] = None, spot_to_spot: bool = False,
@@ -1312,9 +1371,21 @@ def cheaper_replacement(
         for name, nc in (nodeclass_by_pool or {}).items()
     ))
     mkey = (catalog.uid, tensors.key, pools_sig, nc_sig)
-    if memo.get("key") != mkey:
-        memo.clear()
-        memo["key"] = mkey
+    # Token-keyed class cache, shared across emissions AND encoders: a
+    # churn pass emits a NEW ClusterTensors (and the partitioned merge
+    # rebuilds the group axis outright), but a group's [T] compat row, its
+    # (zone, captype) window, and the per-node-CLASS replacement decision
+    # are pure functions of the group's interned ``group_token`` under one
+    # (catalog snapshot, pool set) — the same identity the encoders use
+    # for group equality. Keying on tokens instead of per-ct group indices
+    # means 1%-churn passes re-score only genuinely NEW classes; before,
+    # every emission rebuilt the matrix and re-scored ~2k candidates
+    # (~0.5s of a 10k-node disruption pass in the fleet simulator's
+    # attribution profile).
+    with _REPLACE_CLASS_LOCK:
+        cache = _REPLACE_CLASS_CACHE.get("state")
+    if cache is None or cache.get("key") != mkey:
+        cache = {"key": mkey, "rows": {}, "gw": {}, "dec": {}}
         # spec requirements only — template *labels* are stamped onto
         # nodes, not constraints the instance type must itself satisfy
         pool_masks: dict[str, np.ndarray] = {}
@@ -1327,31 +1398,48 @@ def cheaper_replacement(
             zrow = np.array([zvs.contains(z) for z in tensors.zones])
             crow = np.array([cvs.contains(ct_) for ct_ in lbl.CAPACITY_TYPES])
             pool_windows[name] = zrow[:, None] & crow[None, :]
-        # group x type compat via the same vectorized path as encode
+        cache["pool_masks"] = pool_masks
+        cache["pool_windows"] = pool_windows
+        with _REPLACE_CLASS_LOCK:
+            # fully built before publication; a concurrent different-key
+            # pass that swapped first just wins (we keep OUR reference)
+            _REPLACE_CLASS_CACHE["state"] = cache
+    pool_masks = cache["pool_masks"]
+    pool_windows = cache["pool_windows"]
+    if memo.get("key") != mkey:
+        memo.clear()
+        memo["key"] = mkey
+        # group identity: interned consolidation tokens (models/pod.py)
+        tokens = [
+            pods[0].group_token() if pods else None
+            for pods in ct.group_pods
+        ]
+        # group x type compat via the same vectorized path as encode,
+        # computed only for tokens the class cache hasn't seen
         G = ct.requests.shape[0]
         compat_t = np.ones((G, T), dtype=bool)
+        rows = cache["rows"]
         for gi, pods in enumerate(ct.group_pods):
-            reqs = pods[0].requirements()
-            row = np.ones(T, dtype=bool)
-            for key, vs in reqs:
-                if key in (lbl.TOPOLOGY_ZONE, lbl.CAPACITY_TYPE,
-                           lbl.HOSTNAME, lbl.NODEPOOL):
-                    continue
-                arrays = label_arrays.get(key)
-                if arrays is None:
-                    if not vs.allow_undefined:
-                        row[:] = False
-                        break
-                    continue
-                row &= _contains_vec(vs, *arrays)
+            row = rows.get(tokens[gi])
+            if row is None:
+                reqs = pods[0].requirements()
+                row = np.ones(T, dtype=bool)
+                for key, vs in reqs:
+                    if key in (lbl.TOPOLOGY_ZONE, lbl.CAPACITY_TYPE,
+                               lbl.HOSTNAME, lbl.NODEPOOL):
+                        continue
+                    arrays = label_arrays.get(key)
+                    if arrays is None:
+                        if not vs.allow_undefined:
+                            row[:] = False
+                            break
+                        continue
+                    row &= _contains_vec(vs, *arrays)
+                rows[tokens[gi]] = row
             compat_t[gi] = row
-        memo["pool_masks"] = pool_masks
-        memo["pool_windows"] = pool_windows
+        memo["tokens"] = tokens
         memo["compat_t"] = compat_t
-        memo["gw"] = {}
-        memo["dec"] = {}
-    pool_masks = memo["pool_masks"]
-    pool_windows = memo["pool_windows"]
+    tokens = memo["tokens"]
     compat_t = memo["compat_t"]
 
     def group_window(gi: int) -> np.ndarray:
@@ -1365,7 +1453,7 @@ def cheaper_replacement(
     out = []
     N = len(ct.node_names)
     present = ct.group_counts > 0  # [N, GMAX]
-    gw_cache: dict[int, np.ndarray] = memo["gw"]
+    gw_cache: dict = cache["gw"]  # token -> [Z, C] window
     # Hard reserved counts, tracked across candidates within this pass: a
     # single free reservation slot may justify at most ONE replacement —
     # later candidates must price against market capacity or stay put.
@@ -1397,10 +1485,12 @@ def cheaper_replacement(
     # Per-node-CLASS decision cache: thousands of nodes collapse to the
     # distinct (pool, group set, zone, captype, price, fill) combinations
     # actually present, within a pass and — because the memo lives on the
-    # (persistent) ct — across unchanged passes. Disabled whenever hard
-    # reservation slots are in play: those decisions mutate res_left and
-    # may not be replayed.
-    dec: dict = memo["dec"]
+    # (token-keyed) class cache — across passes, emissions, and encoder
+    # rebuilds. Disabled whenever hard reservation slots are in play:
+    # those decisions mutate res_left and may not be replayed.
+    dec: dict = cache["dec"]
+    if len(dec) > _REPLACE_DEC_CAP:  # unbounded fills are a leak, not a cache
+        dec.clear()
     _MISS = object()
     cacheable = not bool(res_left.any())
     # Whole-result memo: on an unchanged ct (same emission object across
@@ -1427,7 +1517,7 @@ def cheaper_replacement(
         if cacheable:
             dkey = (
                 ct.nodepool_names[i],
-                tuple(sorted({int(g) for g in gids})),
+                tuple(sorted({tokens[int(g)] for g in gids})),
                 ct.node_zone[i] if ct.node_zone else None,
                 ct.node_captype[i] if ct.node_captype else None,
                 float(ct.price[i]),
@@ -1449,9 +1539,10 @@ def cheaper_replacement(
         zone_pinned = False
         for g in gids:
             g = int(g)
-            if g not in gw_cache:
-                gw_cache[g] = group_window(g)
-            window &= gw_cache[g]
+            tok = tokens[g]
+            if tok not in gw_cache:
+                gw_cache[tok] = group_window(g)
+            window &= gw_cache[tok]
             if ct.zone_constraints and ct.zone_constraints[g]:
                 zone_pinned = True
         if zone_pinned:

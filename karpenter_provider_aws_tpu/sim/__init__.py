@@ -1,0 +1,40 @@
+"""sim/: the deterministic fleet simulator — a day of prod in a minute.
+
+The chaos harness (``chaos/``) proves the control plane survives canned
+fault timelines; this subsystem proves it keeps its PROMISES under
+sustained realistic load, observed through the ``obs/`` judgment layer:
+
+- :mod:`.traces` — the seeded workload-trace grammar (diurnal deployment
+  waves, batch-job floods, pod churn, chaos overlays composed from
+  ``chaos/plan.py`` scenarios) and its generators.
+- :mod:`.driver` — :class:`FleetSimulator`: builds an N-node fleet,
+  replays the trace against the FULL controller manager on a sub-tick
+  FakeClock with adaptive stepping, and runs the chaos invariants after
+  a settle phase. Byte-identical per seed.
+- :mod:`.report` — the fleet-report artifact: SLO/burn timelines, SLI
+  percentiles, packing + cost-vs-oracle series, audit decision counts,
+  and a span-level wall-time attribution profile covering >= 95% of the
+  driver's wall clock; ``signature()`` is the determinism witness.
+- :mod:`.cliffs` — the scale-tier sweep + cliff detector that flags the
+  first tier where SLO burn or a span family's attribution share
+  regresses super-linearly — the instrument that finds the next scaling
+  cliff (and names it) before a tier bump does.
+
+CLI: ``python -m karpenter_provider_aws_tpu.sim run --trace smoke``;
+CI gate: ``tools/fleet_gate.py`` against a checked-in baseline
+(``make sim-smoke``). Docs: ``docs/simulation.md`` +
+``designs/fleet-simulator.md``.
+"""
+
+from __future__ import annotations
+
+from .cliffs import detect_cliffs, sweep, tier_row
+from .driver import FleetSimulator, run_deterministic, run_trace
+from .report import FleetReport, normalize_ids
+from .traces import Overlay, SimEvent, TraceSpec, canned_trace, canned_traces, generate
+
+__all__ = [
+    "FleetReport", "FleetSimulator", "Overlay", "SimEvent", "TraceSpec",
+    "canned_trace", "canned_traces", "detect_cliffs", "generate",
+    "normalize_ids", "run_deterministic", "run_trace", "sweep", "tier_row",
+]

@@ -1,0 +1,126 @@
+"""CLI: ``python -m karpenter_provider_aws_tpu.sim <run|sweep|traces>``.
+
+``run`` drives one simulated day and writes the fleet-report artifact
+(optionally running twice to verify same-seed determinism); ``sweep``
+runs the scale-tier ladder and prints the cliff detector's verdict;
+``traces`` lists the shipped trace specs. Exit status: 0 on success,
+1 when invariants failed / determinism broke / a cliff was found (so CI
+can gate directly on the command).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .cliffs import sweep
+from .driver import run_deterministic, run_trace
+from .traces import canned_trace, canned_traces
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m karpenter_provider_aws_tpu.sim",
+        description="deterministic fleet simulator: a day of prod in a minute",
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_run = sub.add_parser("run", help="drive one simulated day")
+    p_run.add_argument("--trace", default="smoke",
+                       help="canned trace name or a TraceSpec JSON file path")
+    p_run.add_argument("--seed", type=int, default=0)
+    p_run.add_argument("--nodes", type=int, default=None,
+                       help="override the trace's fleet size")
+    p_run.add_argument("--hours", type=float, default=None,
+                       help="override the trace's simulated duration")
+    p_run.add_argument("--overlay", action="append", default=[],
+                       help="chaos overlay as scenario[@at_s[xstretch]], "
+                            "e.g. spot-storm@3600 (repeatable)")
+    p_run.add_argument("--report", default="",
+                       help="write the fleet-report JSON artifact here")
+    p_run.add_argument("--check-determinism", action="store_true",
+                       help="run twice and require byte-identical reports")
+    p_run.add_argument("--json", action="store_true",
+                       help="print the summary as JSON instead of text")
+
+    p_sweep = sub.add_parser("sweep", help="scale-tier sweep + cliff detector")
+    p_sweep.add_argument("--trace", default="smoke")
+    p_sweep.add_argument("--seed", type=int, default=0)
+    p_sweep.add_argument("--tiers", default="500,1000,2000",
+                         help="comma-separated fleet sizes")
+    p_sweep.add_argument("--hours", type=float, default=None)
+    p_sweep.add_argument("--report", default="",
+                         help="write the sweep JSON here")
+
+    sub.add_parser("traces", help="list the shipped traces")
+
+    args = parser.parse_args(argv)
+
+    if args.cmd == "traces":
+        for name, spec in sorted(canned_traces().items()):
+            print(f"{name}: {spec.nodes} nodes, {spec.duration_s / 3600:g}h, "
+                  f"{spec.waves_per_hour:g} waves/h x {spec.wave_pods} pods, "
+                  f"{spec.floods} floods, churn {spec.churn_pods} pods "
+                  f"every {spec.churn_every_s:g}s")
+        return 0
+
+    def load_trace(name: str):
+        if name.endswith(".json"):
+            from .traces import TraceSpec
+
+            with open(name) as f:
+                return TraceSpec.from_json(f.read())
+        return canned_trace(name)
+
+    duration = args.hours * 3600.0 if args.hours is not None else None
+
+    if args.cmd == "run":
+        kw = dict(nodes=args.nodes, duration_s=duration,
+                  overlays=list(args.overlay))
+        if args.check_determinism:
+            try:
+                reports = run_deterministic(
+                    load_trace(args.trace), seed=args.seed, runs=2, **kw
+                )
+            except AssertionError as e:
+                print(str(e), file=sys.stderr)
+                return 1
+            report = reports[0]
+            print("determinism: 2 same-seed runs byte-identical",
+                  file=sys.stderr)
+        else:
+            report = run_trace(load_trace(args.trace), seed=args.seed, **kw)
+        if args.report:
+            report.save(args.report)
+            print(f"wrote {args.report}", file=sys.stderr)
+        print(json.dumps(report.summary(), indent=1, sort_keys=True)
+              if args.json else report.summary_text())
+        failed = [r for r in report.data["virtual"]["invariants"]
+                  if not r["passed"]]
+        return 1 if failed else 0
+
+    # sweep
+    tiers = [int(t) for t in args.tiers.split(",") if t]
+    out = sweep(load_trace(args.trace), tiers, seed=args.seed,
+                duration_s=duration)
+    if args.report:
+        with open(args.report, "w") as f:
+            json.dump(out, f, indent=1, sort_keys=True)
+        print(f"wrote {args.report}", file=sys.stderr)
+    for row in out["tiers"]:
+        print(f"tier {row['tier']}: wall={row['wall_s']}s "
+              f"({row['wall_per_sim_hour_s']}s/sim-hour) "
+              f"worst_burn={row['slo_worst_burn']} "
+              f"bind_p99={row['bind_p99_s']}s")
+    if out["cliff_tier"] is not None:
+        print(f"CLIFF at tier {out['cliff_tier']}:")
+        for f_ in out["findings"]:
+            print(f"  [{f_['kind']}] tier {f_['tier']}: {f_['detail']}")
+        return 1
+    print("no cliff detected across tiers")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,137 @@
+"""The cliff detector: sweep scale tiers, flag super-linear regressions.
+
+``sweep`` runs the SAME trace at a ladder of fleet sizes and reduces each
+run's fleet report to one tier row; ``detect_cliffs`` (a pure function —
+unit-testable without running anything) compares consecutive tiers and
+flags the FIRST tier where the system stops scaling linearly:
+
+- **wall-superlinear** — driver wall per simulated hour grew faster than
+  ``scale_ratio ** wall_exponent``: doubling the fleet may double the
+  wall time, but a 2x fleet costing 3x wall is the next perf PR.
+- **slo-burn-regression** — the worst SLO burn rate jumped past both an
+  absolute floor and a multiple of the previous tier: the control plane
+  is no longer keeping its promises at this size.
+- **attribution-shift** — one span family's share of the wall profile
+  jumped (relative AND absolute): whatever subsystem suddenly dominates
+  at this tier is where the cliff lives. This is the span-level half of
+  "find the cliff AND name it".
+
+Method + thresholds are documented in ``designs/fleet-simulator.md``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+#: defaults, chosen loose enough that measurement noise at small tiers
+#: does not page and tight enough that a real N^2 blowup cannot hide
+WALL_EXPONENT = 1.35          # allowed wall growth ~ scale ** exponent
+WALL_FLOOR_S = 1.0            # ignore wall deltas below this (noise)
+BURN_FLOOR = 1.0              # a burn below sustainable never flags
+BURN_RATIO = 2.0              # ...and must at least double tier-to-tier
+SHARE_JUMP_ABS = 0.10         # +10 percentage points of the profile
+SHARE_JUMP_REL = 1.5          # and 1.5x its previous share
+
+
+def tier_row(nodes: int, report) -> dict:
+    """Reduce one fleet report to the tier metrics the detector compares."""
+    wall = report.data.get("wall", {})
+    att = wall.get("attribution", {})
+    wall_s = wall.get("wall_s") or 0.0
+    wall_ms = wall_s * 1e3
+    shares: dict[str, float] = {}
+    if wall_ms > 0:
+        for name, cell in att.get("spans", {}).items():
+            family = name.split(".", 1)[0] if "." in name else name
+            # sim.controllers CONTAINS the controller.* spans; keep the
+            # leaf families (controller/solve/consolidate/aws) and the
+            # sim-only segments so shares don't double-count
+            if family == "sim" and name != "sim.build":
+                continue
+            key = name if family in ("controller", "sim") else family
+            shares[key] = round(
+                shares.get(key, 0.0) + cell["total_ms"] / wall_ms, 4
+            )
+    return {
+        "tier": int(nodes),
+        "wall_s": round(wall_s, 3),
+        "wall_per_sim_hour_s": wall.get("wall_per_sim_hour_s"),
+        "slo_worst_burn": report.gate.get("slo_worst_burn", 0.0),
+        "bind_p99_s": report.gate.get("pod_time_to_bind_p99_s"),
+        "pending_end": report.gate.get("pending_end", 0),
+        "shares": shares,
+        "signature": report.signature(),
+    }
+
+
+def detect_cliffs(rows: list[dict],
+                  wall_exponent: float = WALL_EXPONENT,
+                  wall_floor_s: float = WALL_FLOOR_S,
+                  burn_floor: float = BURN_FLOOR,
+                  burn_ratio: float = BURN_RATIO,
+                  share_jump_abs: float = SHARE_JUMP_ABS,
+                  share_jump_rel: float = SHARE_JUMP_REL) -> dict:
+    """Pure comparison over tier rows (sorted by ``tier`` ascending).
+
+    Returns ``{"cliff_tier": first flagged tier or None,
+    "findings": [...]}`` — each finding names the tier, the metric, and
+    the evidence (previous vs current value and the allowed bound)."""
+    rows = sorted(rows, key=lambda r: r["tier"])
+    findings: list[dict] = []
+    for prev, cur in zip(rows, rows[1:]):
+        k = cur["tier"] / prev["tier"] if prev["tier"] else 1.0
+        # wall growth vs scale growth
+        w0 = prev.get("wall_per_sim_hour_s") or 0.0
+        w1 = cur.get("wall_per_sim_hour_s") or 0.0
+        bound = w0 * (k ** wall_exponent)
+        if w0 > 0 and w1 - bound > wall_floor_s:
+            findings.append({
+                "tier": cur["tier"], "kind": "wall-superlinear",
+                "detail": (
+                    f"wall/sim-hour {w0:g}s -> {w1:g}s at {k:g}x scale "
+                    f"(allowed <= {bound:.2f}s = prev * {k:g}^{wall_exponent})"
+                ),
+            })
+        # SLO burn regression
+        b0 = prev.get("slo_worst_burn") or 0.0
+        b1 = cur.get("slo_worst_burn") or 0.0
+        if b1 > burn_floor and b1 > max(b0 * burn_ratio, b0 + burn_floor):
+            findings.append({
+                "tier": cur["tier"], "kind": "slo-burn-regression",
+                "detail": (
+                    f"worst burn {b0:g} -> {b1:g} "
+                    f"(floor {burn_floor:g}, ratio {burn_ratio:g}x)"
+                ),
+            })
+        # attribution share shift
+        for family in sorted(set(prev.get("shares", {}))
+                             | set(cur.get("shares", {}))):
+            s0 = prev.get("shares", {}).get(family, 0.0)
+            s1 = cur.get("shares", {}).get(family, 0.0)
+            if s1 - s0 > share_jump_abs and s1 > s0 * share_jump_rel:
+                findings.append({
+                    "tier": cur["tier"], "kind": "attribution-shift",
+                    "detail": (
+                        f"{family} share {s0:.1%} -> {s1:.1%} "
+                        f"(+{share_jump_abs:.0%} abs and "
+                        f"{share_jump_rel:g}x rel exceeded)"
+                    ),
+                })
+    cliff: Optional[int] = min(
+        (f["tier"] for f in findings), default=None
+    )
+    return {"cliff_tier": cliff, "findings": findings}
+
+
+def sweep(trace, tiers, seed: int = 0, **kw) -> dict:
+    """Run the trace at every tier and detect cliffs. Returns
+    ``{"tiers": [tier rows], "cliff_tier": ..., "findings": [...]}``."""
+    from .driver import run_trace
+
+    rows = []
+    for n in sorted(int(t) for t in tiers):
+        report = run_trace(trace, seed=seed, nodes=n, **kw)
+        rows.append(tier_row(n, report))
+    out = detect_cliffs(rows)
+    out["tiers"] = rows
+    return out

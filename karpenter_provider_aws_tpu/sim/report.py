@@ -1,0 +1,322 @@
+"""The fleet report: one JSON artifact per simulated day.
+
+Two planes, deliberately separated:
+
+- ``virtual`` — everything measured in virtual time or counts: SLO
+  timelines and burn trajectories, SLI percentiles, packing-efficiency
+  series, cost-vs-oracle distribution, audit decision counts, chaos
+  injections, invariants. This is the DETERMINISTIC core:
+  :meth:`FleetReport.signature` hashes exactly this plane (plus the
+  trace + seed) after normalizing process-global identifiers (instance
+  ids, claim-name suffixes, pod uids) to per-run ordinals — the same
+  witness pattern ``chaos.ChaosLog.signature`` uses — so two same-seed
+  runs are byte-identical here even though id counters kept counting.
+- ``wall`` — wall-clock attribution: per-span totals (controller /
+  solve-phase / backend breakdowns from the streaming SpanAggregator +
+  provenance records) and the profile's coverage of driver wall time.
+  Real and reportable, but excluded from the signature by construction.
+
+``gate`` is the flat metric dict ``tools/fleet_gate.py`` thresholds
+against a checked-in baseline; ``docs/simulation.md`` documents every
+field.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import re
+from dataclasses import dataclass
+
+SCHEMA_VERSION = 1
+
+#: process-global identifier shapes normalized out of the signature, in
+#: one alternation so first-appearance ordinals interleave stably:
+#: fake-cloud instance ids, NodeClaim.fresh name suffixes (and the node
+#: names derived from them), pod uids.
+_ID_RE = re.compile(r"i-[0-9a-f]{6,}|default-[0-9a-f]+|pod-[0-9]+")
+
+#: how many audit/event records the artifact retains (the rings are
+#: bounded anyway; this just caps artifact size for huge days)
+RECORDS_CAP = 4096
+
+
+def normalize_ids(text: str) -> str:
+    """Replace every process-global id with a per-run ordinal keyed on
+    first appearance (``i-…`` -> ``i#0``, ``default-…`` -> ``claim#1``,
+    ``pod-…`` -> ``pod#2``)."""
+    ranks: dict[str, str] = {}
+
+    def sub(m: re.Match) -> str:
+        tok = m.group(0)
+        if tok not in ranks:
+            prefix = ("i" if tok.startswith("i-")
+                      else "claim" if tok.startswith("default-") else "pod")
+            ranks[tok] = f"{prefix}#{len(ranks)}"
+        return ranks[tok]
+
+    return _ID_RE.sub(sub, text)
+
+
+def _percentiles(samples: list[float]) -> dict:
+    from ..obs import percentile
+
+    return {
+        "count": len(samples),
+        "p50": percentile(samples, 0.50),
+        "p95": percentile(samples, 0.95),
+        "p99": percentile(samples, 0.99),
+        "max": round(max(samples), 4) if samples else None,
+    }
+
+
+@dataclass
+class FleetReport:
+    data: dict
+
+    # -- persistence ---------------------------------------------------------
+    def to_json(self, indent: int = 1) -> str:
+        return json.dumps(self.data, indent=indent, sort_keys=True)
+
+    def save(self, path: str) -> str:
+        with open(path, "w") as f:
+            f.write(self.to_json() + "\n")
+        return path
+
+    @classmethod
+    def load(cls, path: str) -> "FleetReport":
+        with open(path) as f:
+            return cls(data=json.load(f))
+
+    # -- determinism witness -------------------------------------------------
+    def witness(self) -> str:
+        """The canonical, id-normalized text of the deterministic core."""
+        core = {
+            "schema": self.data.get("schema"),
+            "trace": self.data.get("trace"),
+            "seed": self.data.get("seed"),
+            "virtual": self.data.get("virtual"),
+        }
+        return normalize_ids(json.dumps(core, sort_keys=True))
+
+    def signature(self) -> str:
+        return hashlib.sha256(self.witness().encode()).hexdigest()
+
+    # -- views ---------------------------------------------------------------
+    @property
+    def gate(self) -> dict:
+        return self.data.get("gate", {})
+
+    def summary(self) -> dict:
+        """Compact one-screen view (/debug/sim, CLI output)."""
+        v = self.data.get("virtual", {})
+        w = self.data.get("wall", {})
+        return {
+            "trace": self.data.get("trace", {}).get("name"),
+            "seed": self.data.get("seed"),
+            "nodes": self.data.get("trace", {}).get("nodes"),
+            "duration_s": self.data.get("trace", {}).get("duration_s"),
+            "passes": v.get("driver", {}).get("passes"),
+            "wall_s": w.get("wall_s"),
+            "coverage": w.get("attribution", {}).get("coverage"),
+            "gate": self.gate,
+            "invariants_failed": [
+                r["name"] for r in v.get("invariants", []) if not r["passed"]
+            ],
+            "signature": self.signature(),
+        }
+
+    def summary_text(self) -> str:
+        v = self.data.get("virtual", {})
+        w = self.data.get("wall", {})
+        t = self.data.get("trace", {})
+        lines = [
+            f"fleet report: trace={t.get('name')} seed={self.data.get('seed')} "
+            f"nodes={t.get('nodes')} sim_duration={t.get('duration_s'):g}s",
+            f"  wall={w.get('wall_s', 0):.2f}s over "
+            f"{v.get('driver', {}).get('passes')} controller passes "
+            f"(coverage {100 * w.get('attribution', {}).get('coverage', 0):.1f}% "
+            "of driver wall attributed to spans)",
+            "  gate: " + ", ".join(
+                f"{k}={vv}" for k, vv in sorted(self.gate.items())
+            ),
+        ]
+        top = sorted(
+            w.get("attribution", {}).get("spans", {}).items(),
+            key=lambda kv: -kv[1]["total_ms"],
+        )[:8]
+        if top:
+            lines.append("  top spans: " + ", ".join(
+                f"{name}={cell['total_ms']:.0f}ms" for name, cell in top
+            ))
+        for r in v.get("invariants", []):
+            lines.append(f"  [{'PASS' if r['passed'] else 'FAIL'}] "
+                         f"{r['name']}: {r['detail']}")
+        lines.append(f"  signature: {self.signature()}")
+        return "\n".join(lines)
+
+
+def build_report(sim, span_profile: dict, deltas: dict) -> FleetReport:
+    """Assemble the artifact from a finished :class:`FleetSimulator`."""
+    env = sim.env
+    obs = env.obs
+
+    binds = [round(d, 4) for d in obs.sli.bind_durations()]
+    readies = [round(d, 4) for d in obs.sli.ready_durations()]
+
+    slo_summary: dict[str, dict] = {}
+    for sample in sim.samples:
+        for s in sample["slos"]:
+            cur = slo_summary.setdefault(s["name"], {
+                "min_budget_remaining": 1.0, "worst_burn": 0.0,
+                "bad_max_in_window": 0,
+            })
+            cur["min_budget_remaining"] = min(
+                cur["min_budget_remaining"], s["budget_remaining"]
+            )
+            cur["worst_burn"] = round(
+                max(cur["worst_burn"], s["worst_burn"]), 3
+            )
+            cur["bad_max_in_window"] = max(
+                cur["bad_max_in_window"], s["bad_in_window"]
+            )
+
+    packing_cpu = [
+        s["packing"]["cpu"] for s in sim.samples if "cpu" in s["packing"]
+    ]
+    worst_burn = max(
+        (d["worst_burn"] for d in slo_summary.values()), default=0.0
+    )
+    min_budget = min(
+        (d["min_budget_remaining"] for d in slo_summary.values()), default=1.0
+    )
+    quality = _percentiles(sorted(sim.quality_samples))
+
+    audit_records = [
+        r.as_dict() for r in obs.audit.tail(RECORDS_CAP)
+    ]
+    # seq is a PROCESS-global counter — rebase to per-run ordinals so the
+    # deterministic core stays byte-identical across same-seed runs in one
+    # process (the same reason instance/claim/pod ids are normalized)
+    for i, rec in enumerate(audit_records, start=1):
+        rec["seq"] = i
+    events = [
+        {
+            "kind": e.kind, "name": e.name, "type": e.type,
+            "reason": e.reason, "message": e.message,
+            "at": round(e.at, 3), "count": e.count,
+        }
+        for e in env.events.query()[-RECORDS_CAP:]
+    ]
+
+    invariants = [
+        {"name": r.name, "passed": r.passed, "detail": r.detail}
+        for r in sim.invariants
+    ]
+
+    virtual = {
+        "slo_timeline": sim.samples,
+        "slo_summary": slo_summary,
+        "sli": {
+            "pod_time_to_bind_s": _percentiles(binds),
+            "nodeclaim_time_to_ready_s": _percentiles(readies),
+        },
+        "packing": {
+            "cpu_min": round(min(packing_cpu), 4) if packing_cpu else None,
+            "cpu_mean": (
+                round(sum(packing_cpu) / len(packing_cpu), 4)
+                if packing_cpu else None
+            ),
+        },
+        "quality": {
+            "cost_vs_oracle": quality,
+            "unschedulable_total": deltas["unschedulable"],
+            "solve_backends": dict(sorted(sim.backend_counts.items())),
+            "residency": dict(sorted(sim.residency_counts.items())),
+            "fallbacks": dict(sorted(sim.fallback_counts.items())),
+        },
+        "audit": {
+            "counts_by_kind": deltas["audit"],
+            "records": audit_records,
+        },
+        "events": events,
+        "cluster": {
+            "nodes_start": sim.nodes_start,
+            "nodes_end": len(env.cluster.nodes),
+            "pods_end": len(env.cluster.pods),
+            "pending_end": len(env.cluster.pending_pods()),
+            "launched": deltas["launched"],
+            "terminated": deltas["terminated"],
+            "binds_audited": len(sim.bind_events),
+        },
+        "chaos": {
+            "injections": len(sim.log),
+            "faults_by_kind": sim.log.by_kind(),
+            "probe_failures": sim.probe_failures,
+            "probe_calls": sim.probe_calls,
+        },
+        "driver": {
+            "passes": sim.passes,
+            "events_applied": dict(sorted(sim.events_applied.items())),
+            "settle_steps_used": sim.settle_steps_used,
+        },
+        "invariants": invariants,
+    }
+
+    wall_ms = sim.driver_wall_s * 1e3
+    root_ms = sum(
+        cell["total_ms"] for cell in span_profile.get("roots", {}).values()
+    )
+    coverage = round(root_ms / wall_ms, 4) if wall_ms > 0 else 0.0
+    spans = span_profile.get("spans", {})
+
+    def _family(prefix: str) -> dict:
+        return {
+            name[len(prefix):]: cell
+            for name, cell in spans.items() if name.startswith(prefix)
+        }
+
+    wall = {
+        "wall_s": round(sim.driver_wall_s, 3),
+        "wall_per_sim_hour_s": (
+            round(sim.driver_wall_s / (sim.trace.duration_s / 3600.0), 3)
+            if sim.trace.duration_s else None
+        ),
+        "attribution": {
+            "coverage": coverage,
+            "roots": span_profile.get("roots", {}),
+            "spans": spans,
+            "controllers": _family("controller."),
+            "solve_phases": _family("solve."),
+            "consolidate_phases": _family("consolidate."),
+            "aws": _family("aws."),
+            "backend_wall_ms": dict(sorted(sim.backend_wall_ms.items())),
+        },
+    }
+
+    gate = {
+        "slo_worst_burn": round(worst_burn, 3),
+        "slo_budget_remaining_min": round(min_budget, 4),
+        "pod_time_to_bind_p50_s": virtual["sli"]["pod_time_to_bind_s"]["p50"],
+        "pod_time_to_bind_p99_s": virtual["sli"]["pod_time_to_bind_s"]["p99"],
+        "nodeclaim_time_to_ready_p99_s": (
+            virtual["sli"]["nodeclaim_time_to_ready_s"]["p99"]
+        ),
+        "bind_count": virtual["sli"]["pod_time_to_bind_s"]["count"],
+        "packing_eff_min": virtual["packing"]["cpu_min"],
+        "cost_vs_oracle_p95": quality["p95"],
+        "unschedulable_total": deltas["unschedulable"],
+        "pending_end": virtual["cluster"]["pending_end"],
+        "invariants_failed": sum(1 for r in invariants if not r["passed"]),
+        "attribution_coverage": coverage,
+    }
+
+    return FleetReport(data={
+        "schema": SCHEMA_VERSION,
+        "kind": "fleet-report",
+        "trace": sim.trace.to_dict(),
+        "seed": sim.seed,
+        "virtual": virtual,
+        "wall": wall,
+        "gate": gate,
+    })

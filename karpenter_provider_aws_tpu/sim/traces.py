@@ -1,0 +1,305 @@
+"""Workload-trace grammar + seeded generators: a day of prod as data.
+
+A :class:`TraceSpec` declares the SHAPE of a simulated day — fleet size
+and fill, diurnal deployment-wave rate, batch-job floods, pod-churn
+cadence, chaos overlays — and :func:`generate` expands it into a sorted
+list of :class:`SimEvent` s using nothing but streams derived from the
+seed. Two calls with the same (spec, seed) produce the identical event
+list; the driver (``sim/driver.py``) replays it against the full
+controller manager on a FakeClock, so a whole simulated day is
+byte-identical per seed.
+
+Event kinds (the trace grammar, documented in ``designs/fleet-simulator.md``):
+
+- ``wave``   — a diurnal deployment wave: N pods of a seeded shape, with a
+  TTL after which the wave is deleted again (the scale-down half of the
+  diurnal curve).
+- ``flood``  — a batch-job burst: many large pods at once, sized past the
+  per-node free capacity so the pass is a pure launch (which is also what
+  arms the FFD-oracle cost sampler).
+- ``churn``  — steady pod recycling: M bound pods die and M replacements
+  arrive (victims drawn deterministically by sorted pod name).
+- ``expire`` — the scheduled deletion of an earlier wave/flood's pods.
+
+Overlays compose fault timelines from ``chaos/plan.py`` scenarios into
+the day (``chaos.plan.compose_overlay``): a spot-storm at hour 6, an
+API brownout at hour 14 — the same seeded fault primitives the chaos
+harness runs, riding the simulator's clock.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+#: shapes a wave draws from: (cpu, memory) request pairs, weighted to the
+#: small end like a prod mix (the flood shape is configured separately)
+WAVE_SHAPES = (
+    ("250m", "512Mi"), ("250m", "1Gi"), ("500m", "1Gi"),
+    ("500m", "2Gi"), ("1000m", "2Gi"), ("1000m", "4Gi"), ("2000m", "4Gi"),
+)
+
+
+@dataclass
+class SimEvent:
+    """One timed workload mutation."""
+
+    at_s: float
+    kind: str                     # wave | flood | churn | expire
+    pods: int = 0
+    cpu: str = "500m"
+    memory: str = "1Gi"
+    name: str = ""                # pod-name prefix (expire targets it)
+    ttl_s: Optional[float] = None
+    unschedulable: bool = False   # poison shape: no node can ever fit it
+
+    def to_dict(self) -> dict:
+        d = {"at_s": self.at_s, "kind": self.kind, "pods": self.pods,
+             "cpu": self.cpu, "memory": self.memory, "name": self.name}
+        if self.ttl_s is not None:
+            d["ttl_s"] = self.ttl_s
+        if self.unschedulable:
+            d["unschedulable"] = True
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SimEvent":
+        return cls(
+            at_s=float(d["at_s"]), kind=str(d["kind"]),
+            pods=int(d.get("pods", 0)), cpu=str(d.get("cpu", "500m")),
+            memory=str(d.get("memory", "1Gi")), name=str(d.get("name", "")),
+            ttl_s=(None if d.get("ttl_s") is None else float(d["ttl_s"])),
+            unschedulable=bool(d.get("unschedulable", False)),
+        )
+
+
+@dataclass
+class Overlay:
+    """A chaos scenario's fault timeline dropped into the day at ``at_s``."""
+
+    scenario: str
+    at_s: float = 0.0
+    stretch: float = 1.0
+
+    def to_dict(self) -> dict:
+        d: dict = {"scenario": self.scenario, "at_s": self.at_s}
+        if self.stretch != 1.0:
+            d["stretch"] = self.stretch
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Overlay":
+        return cls(scenario=str(d["scenario"]), at_s=float(d.get("at_s", 0.0)),
+                   stretch=float(d.get("stretch", 1.0)))
+
+    @classmethod
+    def parse(cls, text: str) -> "Overlay":
+        """CLI form ``scenario[@at_s[xstretch]]``, e.g. ``spot-storm@3600``."""
+        at_s, stretch = 0.0, 1.0
+        name = text
+        if "@" in text:
+            name, rest = text.split("@", 1)
+            if "x" in rest:
+                at, st = rest.split("x", 1)
+                at_s, stretch = float(at), float(st)
+            else:
+                at_s = float(rest)
+        return cls(scenario=name, at_s=at_s, stretch=stretch)
+
+
+@dataclass
+class TraceSpec:
+    """The declarative shape of one simulated day (JSON round-trips)."""
+
+    name: str
+    # fleet
+    nodes: int = 500
+    pods_per_node: int = 4          # ballast + churn-target fill per node
+    fill_fraction: float = 0.6      # target cpu utilization of the ballast
+    spot_fraction: float = 0.6
+    # time base
+    duration_s: float = 7200.0
+    heartbeat_s: float = 600.0      # steady reconcile cadence between events
+    burst_passes: int = 3           # reconcile micro-burst after each event
+    burst_step_s: float = 15.0      # virtual advance between burst passes
+    sample_every_s: float = 900.0   # SLO/packing timeline cadence
+    settle_reconciles: int = 40     # post-trace convergence budget
+    # diurnal deployment waves
+    waves_per_hour: float = 1.0
+    wave_pods: int = 40
+    wave_ttl_s: float = 7200.0
+    diurnal_amplitude: float = 0.6  # peak-to-mean swing of the wave size
+    peak_hour: float = 14.0
+    # batch floods — the default shape exceeds any fleet node's free
+    # capacity (fill_fraction leaves < 7 of <= 16 vcpus free), so a flood
+    # pass is a pure launch: new capacity, and the pass the FFD-oracle
+    # cost sampler (obs/quality.py) is allowed to judge
+    floods: int = 1
+    flood_pods: int = 64
+    flood_cpu: str = "7000m"
+    flood_memory: str = "12Gi"
+    flood_ttl_s: float = 1800.0
+    # pod churn
+    churn_every_s: float = 1800.0
+    churn_pods: int = 16
+    # deliberate SLO regression (the red-gate injection): every wave also
+    # lands this many pods NO node shape can serve — each solve pass they
+    # pend is a solve-success SLO miss and an unschedulable-rate hit
+    unschedulable_per_wave: int = 0
+    # nodepool disruption posture
+    consolidation_budgets: tuple = ("2%",)
+    consolidate_after_s: Optional[float] = 600.0
+    # chaos overlays
+    overlays: list = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        d = {
+            k: getattr(self, k)
+            for k in (
+                "name", "nodes", "pods_per_node", "fill_fraction",
+                "spot_fraction", "duration_s", "heartbeat_s", "burst_passes",
+                "burst_step_s", "sample_every_s", "settle_reconciles",
+                "waves_per_hour", "wave_pods", "wave_ttl_s",
+                "diurnal_amplitude", "peak_hour", "floods", "flood_pods",
+                "flood_cpu", "flood_memory", "flood_ttl_s", "churn_every_s",
+                "churn_pods", "unschedulable_per_wave", "consolidate_after_s",
+            )
+        }
+        d["consolidation_budgets"] = list(self.consolidation_budgets)
+        d["overlays"] = [o.to_dict() for o in self.overlays]
+        return d
+
+    def to_json(self, indent: int = 1) -> str:
+        return json.dumps(self.to_dict(), indent=indent)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TraceSpec":
+        d = dict(d)
+        overlays = [Overlay.from_dict(o) for o in d.pop("overlays", [])]
+        budgets = tuple(d.pop("consolidation_budgets", ("2%",)))
+        known = {f for f in cls.__dataclass_fields__}  # noqa: SIM118
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(f"trace: unknown fields {sorted(unknown)}")
+        return cls(**d, overlays=overlays, consolidation_budgets=budgets)
+
+    @classmethod
+    def from_json(cls, text: str) -> "TraceSpec":
+        return cls.from_dict(json.loads(text))
+
+
+def canned_traces() -> dict[str, TraceSpec]:
+    """The shipped traces. ``smoke`` is the tier-1 gate workload; the
+    ``*-day`` traces are the sweep/acceptance tiers."""
+    return {
+        # 2 simulated hours at 500 nodes: the CI smoke under the fleet gate
+        "smoke": TraceSpec(
+            name="smoke", nodes=500, duration_s=2 * 3600.0,
+            heartbeat_s=600.0, sample_every_s=900.0,
+            waves_per_hour=2.0, wave_pods=24, wave_ttl_s=3600.0,
+            floods=1, flood_pods=48, churn_every_s=1800.0, churn_pods=12,
+            settle_reconciles=40,
+        ),
+        # the full diurnal day: hourly waves riding a sine, two floods,
+        # steady churn — "a day of prod in a minute"
+        "diurnal-day": TraceSpec(
+            name="diurnal-day", nodes=1000, duration_s=86400.0,
+            heartbeat_s=900.0, sample_every_s=1800.0,
+            waves_per_hour=1.0, wave_pods=48, wave_ttl_s=4 * 3600.0,
+            floods=2, flood_pods=96, churn_every_s=3600.0, churn_pods=24,
+            settle_reconciles=60,
+        ),
+        # batch-heavy: big floods dominate, waves are background noise
+        "flood-day": TraceSpec(
+            name="flood-day", nodes=1000, duration_s=86400.0,
+            heartbeat_s=900.0, sample_every_s=1800.0,
+            waves_per_hour=0.5, wave_pods=24, wave_ttl_s=4 * 3600.0,
+            floods=6, flood_pods=128, churn_every_s=7200.0, churn_pods=16,
+            settle_reconciles=60,
+        ),
+    }
+
+
+def canned_trace(name: str) -> TraceSpec:
+    traces = canned_traces()
+    if name not in traces:
+        raise ValueError(f"unknown trace {name!r}; shipped: {sorted(traces)}")
+    return traces[name]
+
+
+def generate(spec: TraceSpec, seed: int) -> list[SimEvent]:
+    """Expand a TraceSpec into the sorted, seeded event list.
+
+    All randomness comes from ``Random(f"{seed}:trace")``; the diurnal
+    curve scales each wave's size by
+    ``1 + amplitude * sin(2*pi*(hour - peak + 6) / 24)`` so waves peak at
+    ``peak_hour`` and trough 12 hours opposite. Expire events are
+    scheduled at ``at_s + ttl_s`` (clamped inside the trace) for every
+    wave/flood that declares a TTL."""
+    import random
+
+    rng = random.Random(f"{seed}:trace")
+    events: list[SimEvent] = []
+
+    def _expire(ev: SimEvent) -> None:
+        if ev.ttl_s is None:
+            return
+        at = ev.at_s + ev.ttl_s
+        if at < spec.duration_s:
+            events.append(SimEvent(at_s=at, kind="expire", name=ev.name))
+
+    # diurnal waves
+    if spec.waves_per_hour > 0:
+        period = 3600.0 / spec.waves_per_hour
+        t = period * 0.5
+        i = 0
+        while t < spec.duration_s:
+            hour = (t / 3600.0) % 24.0
+            diurnal = 1.0 + spec.diurnal_amplitude * math.sin(
+                2.0 * math.pi * (hour - spec.peak_hour + 6.0) / 24.0
+            )
+            pods = max(1, int(round(spec.wave_pods * diurnal)))
+            cpu, mem = WAVE_SHAPES[rng.randrange(len(WAVE_SHAPES))]
+            ev = SimEvent(
+                at_s=round(t, 3), kind="wave", pods=pods, cpu=cpu, memory=mem,
+                name=f"wave{i}", ttl_s=spec.wave_ttl_s,
+            )
+            events.append(ev)
+            _expire(ev)
+            if spec.unschedulable_per_wave > 0:
+                events.append(SimEvent(
+                    at_s=round(t, 3), kind="wave",
+                    pods=spec.unschedulable_per_wave,
+                    cpu="512000m", memory="4096Gi",  # no catalog shape fits
+                    name=f"poison{i}", unschedulable=True,
+                ))
+            t += period
+            i += 1
+
+    # batch floods, spread evenly through the middle of the trace
+    for j in range(spec.floods):
+        at = spec.duration_s * (j + 1) / (spec.floods + 1)
+        ev = SimEvent(
+            at_s=round(at, 3), kind="flood", pods=spec.flood_pods,
+            cpu=spec.flood_cpu, memory=spec.flood_memory,
+            name=f"flood{j}", ttl_s=spec.flood_ttl_s,
+        )
+        events.append(ev)
+        _expire(ev)
+
+    # steady churn
+    if spec.churn_every_s > 0 and spec.churn_pods > 0:
+        t = spec.churn_every_s
+        k = 0
+        while t < spec.duration_s:
+            events.append(SimEvent(
+                at_s=round(t, 3), kind="churn", pods=spec.churn_pods,
+                name=f"churn{k}",
+            ))
+            t += spec.churn_every_s
+            k += 1
+
+    events.sort(key=lambda e: (e.at_s, e.kind, e.name))
+    return events

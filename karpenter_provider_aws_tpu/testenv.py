@@ -107,6 +107,26 @@ class Environment:
         return pool, nodeclass
 
 
+def seed_instance(cloud: FakeCloud, *, instance_id: str, instance_type: str,
+                  zone: str, capacity_type: str, image_id: str,
+                  tags: Optional[dict] = None, launch_time: float = 0.0):
+    """Place a pre-existing running instance directly into the fake cloud
+    (the fleet simulator's pre-built-fleet seam). Lives here because
+    testenv is the ONE sanctioned production-side importer of ``fake/``
+    (tests/test_backend_contract.py) — harnesses that need synthetic
+    cloud state route through it instead of importing fake themselves."""
+    from .fake.cloud import Instance
+
+    inst = Instance(
+        id=instance_id, instance_type=instance_type, zone=zone,
+        capacity_type=capacity_type, image_id=image_id,
+        launch_time=launch_time, tags=dict(tags or {}),
+    )
+    with cloud._lock:
+        cloud.instances[inst.id] = inst
+    return inst
+
+
 def new_environment(solver: Optional[Solver] = None, use_tpu_solver: bool = True,
                     zones=None, cluster_info=None) -> Environment:
     clock = FakeClock()

@@ -26,6 +26,8 @@ machine-checkable artifact.
 
 from .export import (
     MetricsBridge,
+    SpanAggregator,
+    aggregate_spans,
     to_chrome_trace,
     validate_chrome_trace,
     write_chrome_trace,
@@ -45,6 +47,8 @@ __all__ = [
     "git_sha",
     "last_record",
     "MetricsBridge",
+    "SpanAggregator",
+    "aggregate_spans",
     "to_chrome_trace",
     "write_chrome_trace",
     "validate_chrome_trace",

@@ -150,6 +150,64 @@ class MetricsBridge:
             return cls._installed
 
 
+class SpanAggregator:
+    """Streaming wall-time attribution: an ``on_finish`` hook folding
+    every completed span into per-name totals as it lands.
+
+    The flight recorder's ring is bounded (8192 spans), so a consumer
+    that wants a WHOLE run's attribution — the fleet simulator's
+    "where did the simulated day's wall time go" profile — cannot
+    snapshot the tape at the end: a day of reconciles overflows it many
+    times over. Aggregating at finish time is O(1) per span and misses
+    nothing. Root spans (``parent_id == 0``) are totaled separately so a
+    driver that wraps all of its work in top-level spans can state what
+    fraction of its wall clock the profile accounts for (nested spans
+    would double-count if summed naively).
+
+    Install with ``tracer.on_finish(agg)``; remove with
+    ``tracer.remove_on_finish(agg)``; read :meth:`profile`.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._by_name: dict[str, list] = {}    # name -> [count, total_ns]
+        self._roots: dict[str, list] = {}
+
+    def __call__(self, span: Span) -> None:
+        with self._lock:
+            cell = self._by_name.setdefault(span.name, [0, 0])
+            cell[0] += 1
+            cell[1] += span.dur_ns
+            if span.parent_id == 0:
+                cell = self._roots.setdefault(span.name, [0, 0])
+                cell[0] += 1
+                cell[1] += span.dur_ns
+
+    def profile(self) -> dict:
+        """``{"spans": {name: {count, total_ms}}, "roots": {...}}``,
+        totals rounded to microsecond-ms for stable JSON."""
+        with self._lock:
+            return {
+                "spans": {
+                    name: {"count": c, "total_ms": round(ns / 1e6, 3)}
+                    for name, (c, ns) in sorted(self._by_name.items())
+                },
+                "roots": {
+                    name: {"count": c, "total_ms": round(ns / 1e6, 3)}
+                    for name, (c, ns) in sorted(self._roots.items())
+                },
+            }
+
+
+def aggregate_spans(spans: Iterable[Span]) -> dict:
+    """One-shot :class:`SpanAggregator` over an in-memory span list
+    (tests, small tapes). Same output shape as ``SpanAggregator.profile``."""
+    agg = SpanAggregator()
+    for s in spans:
+        agg(s)
+    return agg.profile()
+
+
 # Auto-install on first import of the trace package: every instrumented
 # layer that records a span also populates /metrics, with no wiring step
 # for operators to forget.

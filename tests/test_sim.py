@@ -1,0 +1,483 @@
+"""Fleet simulator (sim/): trace grammar, driver, report, gate, cliffs.
+
+Tier-1 coverage for ISSUE 8's tentpole + satellites:
+
+ - sub-tick FakeClock interpolation (the SLI-quantization fix) and the
+   p50 < p99 discrimination contract under a staggered-bind workload,
+ - seeded trace generation (same seed -> identical event list, JSON
+   round-trip, overlay parsing/composition),
+ - a real small simulated run through the FULL controller manager: gate
+   metrics, >= 95% span-attribution coverage, green invariants, the
+   shipped smoke baseline, and ``obs explain --sim-report`` joins,
+ - same-seed determinism (byte-identical fleet report witness — the
+   chaos ``signature()`` pattern),
+ - red-then-green: a deliberately-injected SLO regression must FAIL
+   ``tools/fleet_gate.py`` while the honest run passes,
+ - the cliff detector's pure comparison rules,
+ - benchmarks/report.py stale-marking for the superseded multichip rows.
+
+The 10k-node "day in under a minute" acceptance run is ``slow``-marked.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT / "tools"))
+
+from karpenter_provider_aws_tpu.sim import (  # noqa: E402
+    FleetReport,
+    TraceSpec,
+    canned_trace,
+    detect_cliffs,
+    generate,
+    normalize_ids,
+    run_trace,
+)
+from karpenter_provider_aws_tpu.sim.traces import Overlay  # noqa: E402
+from karpenter_provider_aws_tpu.utils.clock import FakeClock  # noqa: E402
+
+
+def tiny_trace(**kw) -> TraceSpec:
+    base = dict(
+        name="tiny", nodes=60, duration_s=1200.0, heartbeat_s=300.0,
+        sample_every_s=600.0, waves_per_hour=6.0, wave_pods=8,
+        wave_ttl_s=600.0, floods=1, flood_pods=10, churn_every_s=600.0,
+        churn_pods=4, settle_reconciles=25,
+    )
+    base.update(kw)
+    return TraceSpec(**base)
+
+
+# ---------------------------------------------------------------------------
+# satellite: sub-tick FakeClock (the SLI-quantization fix)
+# ---------------------------------------------------------------------------
+
+class TestSubtickClock:
+    def test_default_exact_ticks(self):
+        c = FakeClock()
+        c.advance(5.0)
+        assert c.now() == 5.0 and c.now() == 5.0  # no creep by default
+
+    def test_subtick_reads_creep_then_reset(self):
+        c = FakeClock()
+        c.enable_subtick(resolution_s=0.01, cap_s=0.5)
+        a, b = c.now(), c.now()
+        assert 0 < a < b < 0.5
+        c.advance(5.0)
+        assert c.now() == pytest.approx(5.01)
+
+    def test_subtick_caps_below_next_tick(self):
+        c = FakeClock()
+        c.enable_subtick(resolution_s=0.1, cap_s=0.3)
+        vals = [c.now() for _ in range(10)]
+        assert max(vals) == pytest.approx(0.3)  # flattens on the cap
+        assert vals == sorted(vals)
+
+    def test_monotonic_across_small_advance(self):
+        c = FakeClock()
+        c.enable_subtick(resolution_s=0.1, cap_s=1.0)
+        for _ in range(8):
+            c.now()
+        before = c.now()
+        c.advance(0.2)  # smaller than the accumulated sub-tick offset
+        assert c.now() >= before
+
+    def test_disable_restores_exact(self):
+        c = FakeClock()
+        c.enable_subtick()
+        c.now()
+        c.disable_subtick()
+        c.advance(1.0)
+        assert c.now() == 1.0
+
+
+class TestSLIDiscrimination:
+    def test_staggered_binds_give_p50_below_p99(self):
+        """The satellite's regression test: a staggered-bind workload
+        through the real controller stack must produce a discriminating
+        time-to-bind histogram (p50 < p99), not the degenerate
+        p50 == p99 == tick the quantized clock produced."""
+        from benchmarks.sli_bench import run_all
+
+        rows = run_all(waves=3, pods_per_wave=30)
+        bind = next(r for r in rows if r["benchmark"] == "pod_time_to_bind_sli")
+        assert bind["bind_count"] > 0
+        assert bind["p50_s"] < bind["p99_s"], bind
+
+
+# ---------------------------------------------------------------------------
+# trace grammar
+# ---------------------------------------------------------------------------
+
+class TestTraceGrammar:
+    def test_same_seed_same_events(self):
+        spec = canned_trace("diurnal-day")
+        a = [e.to_dict() for e in generate(spec, 7)]
+        b = [e.to_dict() for e in generate(spec, 7)]
+        assert a == b
+        c = [e.to_dict() for e in generate(spec, 8)]
+        assert a != c  # the seed actually reaches the draws
+
+    def test_diurnal_waves_peak(self):
+        spec = canned_trace("diurnal-day")
+        waves = [e for e in generate(spec, 0) if e.kind == "wave"]
+        by_hour = {int(e.at_s // 3600): e.pods for e in waves}
+        peak = max(by_hour, key=by_hour.get)
+        trough = min(by_hour, key=by_hour.get)
+        assert by_hour[peak] > by_hour[trough]
+        assert abs(peak - spec.peak_hour) <= 2
+
+    def test_expires_follow_ttls(self):
+        spec = tiny_trace()
+        events = generate(spec, 3)
+        names_with_ttl = {e.name for e in events if e.ttl_s is not None}
+        expire_names = {e.name for e in events if e.kind == "expire"}
+        assert expire_names <= names_with_ttl
+        assert expire_names  # some waves expire inside the trace
+
+    def test_json_round_trip(self):
+        spec = canned_trace("smoke")
+        spec.overlays = [Overlay(scenario="spot-storm", at_s=600.0)]
+        again = TraceSpec.from_json(spec.to_json())
+        assert again.to_dict() == spec.to_dict()
+
+    def test_unknown_field_rejected(self):
+        with pytest.raises(ValueError, match="unknown fields"):
+            TraceSpec.from_dict({"name": "x", "bogus": 1})
+
+    def test_overlay_parse(self):
+        o = Overlay.parse("spot-storm@3600x2.0")
+        assert (o.scenario, o.at_s, o.stretch) == ("spot-storm", 3600.0, 2.0)
+        assert Overlay.parse("api-brownout").at_s == 0.0
+
+    def test_compose_overlay_shifts_and_clones(self):
+        from karpenter_provider_aws_tpu.chaos.plan import canned, compose_overlay
+
+        sc = canned("spot-storm")
+        shifted = compose_overlay("spot-storm", at_s=1000.0)
+        assert shifted and all(
+            tf.at_s == pytest.approx(orig.at_s + 1000.0)
+            for tf, orig in zip(shifted, sorted(sc.timeline, key=lambda t: t.at_s))
+        )
+        # private clones: composing twice never shares fault instances
+        again = compose_overlay("spot-storm", at_s=1000.0)
+        assert all(a.fault is not b.fault for a, b in zip(shifted, again))
+
+
+# ---------------------------------------------------------------------------
+# the real run: one small simulated stretch, reused across assertions
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def small_run():
+    report = run_trace(tiny_trace(), seed=5)
+    return report
+
+
+class TestFleetRun:
+    def test_invariants_green(self, small_run):
+        failed = [r for r in small_run.data["virtual"]["invariants"]
+                  if not r["passed"]]
+        assert not failed, failed
+
+    def test_attribution_covers_driver_wall(self, small_run):
+        # the acceptance bar: span-level attribution sums to >= 95% of
+        # driver wall time (roots are the disjoint sim.* segments)
+        assert small_run.gate["attribution_coverage"] >= 0.95
+
+    def test_attribution_names_controllers_and_phases(self, small_run):
+        att = small_run.data["wall"]["attribution"]
+        assert "provisioning" in att["controllers"]
+        assert "disruption" in att["controllers"]
+        assert att["spans"].get("sim.controllers", {}).get("count", 0) > 0
+
+    def test_sli_discriminates(self, small_run):
+        sli = small_run.data["virtual"]["sli"]["pod_time_to_bind_s"]
+        assert sli["count"] > 0
+        assert sli["p50"] < sli["p99"]
+
+    def test_slo_timeline_and_summary(self, small_run):
+        v = small_run.data["virtual"]
+        assert v["slo_timeline"], "no samples collected"
+        names = {s["name"] for s in v["slo_timeline"][0]["slos"]}
+        assert {"pod-time-to-bind", "solve-success"} <= names
+        assert "pod-time-to-bind" in v["slo_summary"]
+
+    def test_audit_and_quality_planes(self, small_run):
+        v = small_run.data["virtual"]
+        assert v["audit"]["counts_by_kind"]["placement"] > 0
+        assert v["audit"]["records"]
+        assert v["quality"]["solve_backends"]  # backend breakdown present
+        assert v["cluster"]["binds_audited"] > 0
+
+    def test_debug_sim_page(self, small_run):
+        from karpenter_provider_aws_tpu.metrics import REGISTRY
+
+        page = REGISTRY.debug_page("/debug/sim")
+        assert page and page.get("signature") == small_run.signature()
+
+    def test_report_round_trip_and_signature(self, small_run, tmp_path):
+        path = str(tmp_path / "report.json")
+        small_run.save(path)
+        loaded = FleetReport.load(path)
+        assert loaded.signature() == small_run.signature()
+        assert loaded.gate == small_run.gate
+
+    def test_normalize_ids_ordinals(self):
+        text = "i-00abc123 then pod-99 then i-00abc123 and default-1f"
+        out = normalize_ids(text)
+        assert out == "i#0 then pod#1 then i#0 and claim#2"
+
+    def test_explain_sim_report_joins(self, small_run, tmp_path, capsys):
+        """Satellite: ``obs explain --sim-report`` joins a simulated
+        decision against the artifact's audit/SLO/provenance context."""
+        from karpenter_provider_aws_tpu.obs.__main__ import main as obs_main
+
+        path = str(tmp_path / "report.json")
+        small_run.save(path)
+        placement = next(
+            r for r in small_run.data["virtual"]["audit"]["records"]
+            if r["kind"] == "placement"
+        )
+        rc = obs_main([
+            "explain", f"{placement['subject_kind']}/{placement['subject']}",
+            "--sim-report", path,
+        ])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert placement["subject"] in out
+        assert "run SLO context" in out
+        assert "pod-time-to-bind" in out
+
+
+class TestDeterminism:
+    def test_same_seed_byte_identical(self):
+        spec = tiny_trace(nodes=40, duration_s=900.0, settle_reconciles=20)
+        r1 = run_trace(TraceSpec.from_dict(spec.to_dict()), seed=11)
+        r2 = run_trace(TraceSpec.from_dict(spec.to_dict()), seed=11)
+        assert r1.witness() == r2.witness()
+        assert r1.signature() == r2.signature()
+
+    def test_different_seed_diverges(self):
+        spec = tiny_trace(nodes=40, duration_s=900.0, settle_reconciles=20)
+        r1 = run_trace(TraceSpec.from_dict(spec.to_dict()), seed=1)
+        r2 = run_trace(TraceSpec.from_dict(spec.to_dict()), seed=2)
+        assert r1.signature() != r2.signature()
+
+
+class TestOverlayRun:
+    def test_spot_storm_overlay_fires(self):
+        spec = tiny_trace(
+            nodes=40, duration_s=900.0, settle_reconciles=25,
+            overlays=[Overlay(scenario="spot-storm", at_s=200.0, stretch=0.5)],
+        )
+        report = run_trace(spec, seed=4)
+        chaos = report.data["virtual"]["chaos"]
+        assert chaos["faults_by_kind"].get("SpotInterrupt", 0) > 0
+        assert chaos["injections"] > 0
+        failed = [r for r in report.data["virtual"]["invariants"]
+                  if not r["passed"]]
+        assert not failed, failed
+
+
+# ---------------------------------------------------------------------------
+# the regression gate: shipped baseline + red-then-green
+# ---------------------------------------------------------------------------
+
+BASELINE_PATH = (
+    ROOT / "karpenter_provider_aws_tpu" / "sim" / "baselines" / "smoke-500.json"
+)
+
+
+class TestFleetGate:
+    def test_check_pure_rules(self):
+        from fleet_gate import check
+
+        report = {"gate": {"a": 2.0, "b": 0.5}, "trace": {}, "seed": 0}
+        baseline = {"thresholds": {
+            "a": {"max": 1.0}, "b": {"min": 0.6}, "c": {"max": 1.0},
+            "d": {"max": 1.0, "allow_missing": True},
+        }}
+        failures = {f["metric"] for f in check(report, baseline)}
+        assert failures == {"a", "b", "c"}  # d allowed missing
+
+    def test_identity_mismatch_fails(self):
+        from fleet_gate import check
+
+        report = {"gate": {}, "trace": {"name": "tiny", "nodes": 40}, "seed": 1}
+        baseline = {"trace": "smoke", "nodes": 500, "seed": 0, "thresholds": {}}
+        assert len(check(report, baseline)) == 3
+
+    def test_red_then_green(self, small_run, tmp_path):
+        """Satellite: a deliberately-injected SLO regression (a poison
+        workload no node shape can serve) must FAIL the gate; the honest
+        run passes the same thresholds."""
+        from fleet_gate import check
+
+        red_spec = tiny_trace(unschedulable_per_wave=3, settle_reconciles=10)
+        red = run_trace(red_spec, seed=5)
+        thresholds = {"thresholds": {
+            "slo_worst_burn": {"max": 1.0},
+            "unschedulable_total": {"max": 0},
+            "pending_end": {"max": 0},
+            "invariants_failed": {"max": 0},
+        }}
+        red_failures = check(red.data, thresholds)
+        assert red_failures, "injected regression did not trip the gate"
+        assert {"unschedulable_total", "pending_end"} <= {
+            f["metric"] for f in red_failures
+        }
+        assert red.gate["slo_worst_burn"] > 1.0  # the burn engine saw it
+        green_failures = check(small_run.data, thresholds)
+        assert not green_failures, green_failures
+
+    def test_shipped_smoke_baseline_passes(self, tmp_path):
+        """The tier-1 smoke: the `smoke` trace (500 nodes, 2 simulated
+        hours, seed 0 — exactly what `make sim-smoke` runs) must pass
+        the checked-in baseline end to end through the CLI."""
+        from fleet_gate import main as gate_main
+
+        report = run_trace(canned_trace("smoke"), seed=0)
+        path = str(tmp_path / "smoke_report.json")
+        report.save(path)
+        rc = gate_main([path, "--baseline", str(BASELINE_PATH)])
+        assert rc == 0, report.gate
+
+
+# ---------------------------------------------------------------------------
+# the cliff detector (pure rules)
+# ---------------------------------------------------------------------------
+
+class TestCliffDetector:
+    def rows(self, **tier2):
+        base = {"tier": 1000, "wall_per_sim_hour_s": 10.0,
+                "slo_worst_burn": 0.0, "shares": {"controller.disruption": 0.30}}
+        cur = {"tier": 2000, "wall_per_sim_hour_s": 20.0,
+               "slo_worst_burn": 0.0, "shares": {"controller.disruption": 0.30}}
+        cur.update(tier2)
+        return [base, cur]
+
+    def test_linear_growth_is_quiet(self):
+        out = detect_cliffs(self.rows())
+        assert out["cliff_tier"] is None and not out["findings"]
+
+    def test_superlinear_wall_flags(self):
+        out = detect_cliffs(self.rows(wall_per_sim_hour_s=60.0))
+        assert out["cliff_tier"] == 2000
+        assert out["findings"][0]["kind"] == "wall-superlinear"
+
+    def test_burn_regression_flags(self):
+        out = detect_cliffs(self.rows(slo_worst_burn=5.0))
+        assert any(f["kind"] == "slo-burn-regression" for f in out["findings"])
+
+    def test_burn_below_floor_is_quiet(self):
+        out = detect_cliffs(self.rows(slo_worst_burn=0.9))
+        assert not out["findings"]
+
+    def test_attribution_shift_flags(self):
+        out = detect_cliffs(
+            self.rows(shares={"controller.disruption": 0.70})
+        )
+        assert any(f["kind"] == "attribution-shift" for f in out["findings"])
+        assert "controller.disruption" in out["findings"][0]["detail"]
+
+    def test_first_tier_wins(self):
+        rows = [
+            {"tier": 500, "wall_per_sim_hour_s": 5.0, "slo_worst_burn": 0.0,
+             "shares": {}},
+            {"tier": 1000, "wall_per_sim_hour_s": 40.0, "slo_worst_burn": 0.0,
+             "shares": {}},
+            {"tier": 2000, "wall_per_sim_hour_s": 400.0, "slo_worst_burn": 9.0,
+             "shares": {}},
+        ]
+        assert detect_cliffs(rows)["cliff_tier"] == 1000
+
+
+# ---------------------------------------------------------------------------
+# satellite: benchmarks/report.py stale-marking for the two multichip rows
+# ---------------------------------------------------------------------------
+
+class TestSupersededMultichipRows:
+    def test_both_rows_marked_stale(self):
+        from benchmarks.report import select, stale_note
+
+        rows = [
+            {"benchmark": "multichip_8dev_2k_merge", "p99_ms": 11.3,
+             "scale": 1.0, "run_at_unix": 100},
+            {"benchmark": "multichip_8dev_partition_evidence",
+             "devices": 8, "scale": 1.0, "run_at_unix": 100},
+            {"benchmark": "config9_100k_nodes", "scale": 1.0,
+             "run_at_unix": 200,
+             "provenance": {"device": "cpu", "backend": "xla-scan",
+                            "git_sha": "abc"}},
+            {"benchmark": "multichip_8dev_5000node_screen", "scale": 1.0,
+             "run_at_unix": 200,
+             "provenance": {"device": "cpu", "backend": "native-fallback",
+                            "git_sha": "abc"}},
+        ]
+        selected, stale = select(rows)
+        assert "multichip_8dev_2k_merge" in stale
+        assert "multichip_8dev_partition_evidence" in stale
+        note = stale_note(stale["multichip_8dev_2k_merge"],
+                          key="multichip_8dev_2k_merge")
+        assert "config9_100k_nodes" in note and "STALE" in note
+        note2 = stale_note(stale["multichip_8dev_partition_evidence"],
+                           key="multichip_8dev_partition_evidence")
+        assert "multichip_8dev_5000node_screen" in note2
+
+    def test_stamped_successor_required(self):
+        from benchmarks.report import select
+
+        rows = [{"benchmark": "multichip_8dev_2k_merge", "scale": 1.0,
+                 "run_at_unix": 100}]
+        _, stale = select(rows)
+        assert not stale  # no stamped successor on file -> no flag
+
+
+# ---------------------------------------------------------------------------
+# slow tier: the acceptance run + the tier sweep
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestAcceptance10k:
+    def test_10k_day_under_a_minute_and_deterministic(self):
+        """ISSUE 8 acceptance: a 10k-node simulated day completes in
+        < 60s wall on CPU, byte-identical per seed, with span attribution
+        covering >= 95% of driver wall."""
+        import time
+
+        # steady-state posture: an all-spot, well-packed, price-optimal
+        # fleet (what a Karpenter that ran yesterday leaves behind) — a
+        # mixed od/spot fleet turns day one into a fleet-wide od->spot
+        # replacement migration, which the smoke trace covers at 500
+        # nodes instead
+        spec = TraceSpec(
+            name="diurnal-day-10k", nodes=10000, duration_s=86400.0,
+            heartbeat_s=1800.0, sample_every_s=3600.0, waves_per_hour=1.0,
+            wave_pods=48, wave_ttl_s=14400.0, floods=2, flood_pods=96,
+            churn_every_s=7200.0, churn_pods=24, settle_reconciles=40,
+            burst_passes=3, fill_fraction=0.85, consolidate_after_s=3600.0,
+            pods_per_node=4, spot_fraction=1.0,
+        )
+        t0 = time.time()
+        r1 = run_trace(TraceSpec.from_dict(spec.to_dict()), seed=0)
+        wall = time.time() - t0
+        assert wall < 60.0, f"10k simulated day took {wall:.1f}s"
+        assert r1.gate["attribution_coverage"] >= 0.95
+        assert r1.gate["invariants_failed"] == 0
+        r2 = run_trace(TraceSpec.from_dict(spec.to_dict()), seed=0)
+        assert r1.witness() == r2.witness()
+
+    def test_tier_sweep_detects_injected_cliff(self):
+        from karpenter_provider_aws_tpu.sim import sweep, tier_row
+
+        out = sweep(tiny_trace(duration_s=900.0, settle_reconciles=20),
+                    tiers=[50, 100], seed=0)
+        assert len(out["tiers"]) == 2
+        assert all("wall_per_sim_hour_s" in r for r in out["tiers"])

@@ -1,0 +1,128 @@
+#!/usr/bin/env python3
+"""fleet_gate: threshold a fleet report against a checked-in baseline.
+
+The CI half of the fleet simulator (``sim/``): a run's report artifact
+(``python -m karpenter_provider_aws_tpu.sim run --report r.json``) is
+compared metric-by-metric against a baseline JSON carrying per-metric
+thresholds, and the process exits non-zero on any regression — so an SLO
+burn, a packing-efficiency drop, or a cost-vs-oracle blowup is a red CI
+gate, not a dashboard footnote.
+
+Baseline format (``karpenter_provider_aws_tpu/sim/baselines/*.json``)::
+
+    {
+      "description": "...",
+      "trace": "smoke", "nodes": 500, "seed": 0,
+      "thresholds": {
+        "slo_worst_burn":        {"max": 1.0},
+        "pod_time_to_bind_p99_s": {"max": 120.0},
+        "packing_eff_min":       {"min": 0.3},
+        "cost_vs_oracle_p95":    {"max": 1.5, "allow_missing": true},
+        ...
+      }
+    }
+
+Each threshold checks the same-named key of the report's flat ``gate``
+dict: ``max`` fails when the metric exceeds it, ``min`` when it falls
+below, ``equals`` on mismatch. A metric that is missing/None fails its
+threshold unless ``allow_missing`` is set (absence of evidence must not
+pass a gate). Trace/nodes/seed declared in the baseline must match the
+report's — a gate run against the wrong workload proves nothing.
+
+Usage::
+
+    python tools/fleet_gate.py REPORT.json --baseline BASELINE.json
+    python tools/fleet_gate.py REPORT.json --baseline B.json --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def check(report: dict, baseline: dict) -> list[dict]:
+    """Evaluate every baseline threshold; returns the failure list
+    (empty == gate passes). Pure, unit-testable."""
+    failures: list[dict] = []
+    gate = report.get("gate", {})
+    trace = report.get("trace", {})
+    for key, want in (("trace", trace.get("name")),
+                      ("nodes", trace.get("nodes")),
+                      ("seed", report.get("seed"))):
+        declared = baseline.get(key)
+        if declared is not None and declared != want:
+            failures.append({
+                "metric": f"baseline.{key}",
+                "detail": f"baseline declares {key}={declared!r} but the "
+                          f"report ran {key}={want!r}",
+            })
+    for metric, rule in sorted(baseline.get("thresholds", {}).items()):
+        value = gate.get(metric)
+        if value is None:
+            if not rule.get("allow_missing"):
+                failures.append({
+                    "metric": metric,
+                    "detail": "missing from the report's gate metrics "
+                              "(absence of evidence does not pass a gate)",
+                })
+            continue
+        if "max" in rule and value > rule["max"]:
+            failures.append({
+                "metric": metric, "value": value,
+                "detail": f"{value} > max {rule['max']}",
+            })
+        if "min" in rule and value < rule["min"]:
+            failures.append({
+                "metric": metric, "value": value,
+                "detail": f"{value} < min {rule['min']}",
+            })
+        if "equals" in rule and value != rule["equals"]:
+            failures.append({
+                "metric": metric, "value": value,
+                "detail": f"{value} != {rule['equals']}",
+            })
+    return failures
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tools/fleet_gate.py",
+        description="gate a fleet-simulator report against a baseline",
+    )
+    parser.add_argument("report", help="fleet-report JSON artifact")
+    parser.add_argument("--baseline", required=True,
+                        help="baseline JSON with per-metric thresholds")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the verdict as JSON")
+    args = parser.parse_args(argv)
+
+    with open(args.report) as f:
+        report = json.load(f)
+    with open(args.baseline) as f:
+        baseline = json.load(f)
+
+    failures = check(report, baseline)
+    gate = report.get("gate", {})
+    if args.json:
+        print(json.dumps({
+            "passed": not failures,
+            "failures": failures,
+            "gate": gate,
+        }, indent=1, sort_keys=True))
+    else:
+        for metric in sorted(baseline.get("thresholds", {})):
+            print(f"  {metric} = {gate.get(metric)}")
+        if failures:
+            print(f"fleet gate FAILED ({len(failures)} regressions) "
+                  f"vs {args.baseline}:")
+            for f_ in failures:
+                print(f"  [FAIL] {f_['metric']}: {f_['detail']}")
+        else:
+            print(f"fleet gate passed vs {args.baseline}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
