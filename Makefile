@@ -4,7 +4,7 @@
 
 PYTEST ?= python -m pytest
 
-.PHONY: presubmit test deflake stress e2etests benchmark interruption-bench verify multichip native soak sidecar-client sim-smoke sim-sweep
+.PHONY: presubmit test deflake stress e2etests benchmark interruption-bench verify multichip native soak sidecar-client sim-smoke sim-sweep chaos-smoke sim-replica-smoke
 
 presubmit: test multichip  ## everything CI gates on
 
@@ -57,3 +57,14 @@ sim-smoke:  ## 500-node 2-simulated-hour fleet run under the SLO regression gate
 sim-sweep:  ## scale-tier ladder + cliff detector (slow; SIM_TIERS overrides)
 	JAX_PLATFORMS=cpu python -m karpenter_provider_aws_tpu.sim sweep \
 		--trace smoke --seed 0 --tiers $${SIM_TIERS:-500,1000,2000}
+
+chaos-smoke:  ## every canned chaos scenario (incl. replica-loss), run twice, determinism diffed
+	JAX_PLATFORMS=cpu python -m karpenter_provider_aws_tpu.chaos --all --seed 0
+
+sim-replica-smoke:  ## 2-replica sharded-control-plane day with a replica-loss overlay, fleet-gated
+	JAX_PLATFORMS=cpu python -m karpenter_provider_aws_tpu.sim run \
+		--trace smoke --nodes 200 --seed 0 --replicas 2 \
+		--overlay replica-loss@1800 \
+		--report /tmp/fleet_report_replica.json
+	python tools/fleet_gate.py /tmp/fleet_report_replica.json \
+		--baseline karpenter_provider_aws_tpu/sim/baselines/replica-loss-2r.json

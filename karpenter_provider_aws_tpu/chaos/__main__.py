@@ -25,6 +25,10 @@ def main(argv=None) -> int:
         "--scenario", default="",
         help="canned scenario name or a path to a scenario JSON file",
     )
+    parser.add_argument(
+        "--all", action="store_true",
+        help="run every canned scenario (the `make chaos-smoke` gate)",
+    )
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument(
         "--runs", type=int, default=2,
@@ -47,39 +51,50 @@ def main(argv=None) -> int:
     from .harness import run_scenario
     from .plan import Scenario, canned, list_canned
 
-    if args.list or not args.scenario:
+    if args.list or (not args.scenario and not args.all):
         for name in list_canned():
             print(f"  {name}: {canned(name).description[:100]}")
         return 0 if args.list else 2
 
-    if os.path.exists(args.scenario):
-        scenario = Scenario.from_file(args.scenario)
+    if args.all:
+        scenarios = [canned(name) for name in list_canned()]
+    elif os.path.exists(args.scenario):
+        scenarios = [Scenario.from_file(args.scenario)]
     else:
-        scenario = canned(args.scenario)
+        scenarios = [canned(args.scenario)]
 
-    reports = []
-    for i in range(max(args.runs, 1)):
-        report = run_scenario(scenario, seed=args.seed,
-                              use_tpu_solver=args.tpu_solver)
-        reports.append(report)
-        print(report.summary())
+    ok = True
+    scenario_reports = []  # one representative report per scenario
+    for scenario in scenarios:
+        reports = []
+        for i in range(max(args.runs, 1)):
+            report = run_scenario(scenario, seed=args.seed,
+                                  use_tpu_solver=args.tpu_solver)
+            reports.append(report)
+            print(report.summary())
+        ok = ok and all(r.passed for r in reports)
+        scenario_reports.append(reports[0])
+        for i, r in enumerate(reports[1:], start=2):
+            if r.signature != reports[0].signature:
+                print(f"DETERMINISM FAIL: {scenario.name}: run 1 and run {i} "
+                      f"fault sequences diverge with seed {args.seed}",
+                      file=sys.stderr)
+                ok = False
+            else:
+                print(f"determinism: {scenario.name} run {i} fault sequence "
+                      f"byte-identical to run 1 "
+                      f"({len(reports[0].signature.encode())} bytes)")
 
-    ok = all(r.passed for r in reports)
-    first = reports[0]
-    for i, r in enumerate(reports[1:], start=2):
-        if r.signature != first.signature:
-            print(f"DETERMINISM FAIL: run 1 and run {i} fault sequences "
-                  f"diverge with seed {args.seed}", file=sys.stderr)
-            ok = False
-        else:
-            print(f"determinism: run {i} fault sequence byte-identical to "
-                  f"run 1 ({len(first.signature.encode())} bytes)")
-
-    if args.json_out:
-        doc = first.as_dict()
-        doc["fault_sequence"] = first.signature.splitlines()
+    if args.json_out and scenario_reports:
+        docs = []
+        for r in scenario_reports:
+            doc = r.as_dict()
+            doc["fault_sequence"] = r.signature.splitlines()
+            docs.append(doc)
         with open(args.json_out, "w") as f:
-            json.dump(doc, f, indent=1)
+            # one scenario -> the report object (the stable shape);
+            # --all -> a list with every scenario's report
+            json.dump(docs[0] if len(docs) == 1 else docs, f, indent=1)
         print(f"report written to {args.json_out}")
     return 0 if ok else 1
 

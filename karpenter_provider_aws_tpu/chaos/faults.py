@@ -440,12 +440,91 @@ class EventualConsistencyLag(Fault):
         uninstall_consistency_lag(harness.env.cloud)
 
 
+def _replica_env(harness, kind: str):
+    """The multi-replica environment behind a replica fault, or a loud
+    error: these faults only mean something when the scenario declared
+    ``"replicas": N`` (the harness then builds a ReplicaSetEnv)."""
+    env = harness.env
+    if not hasattr(env, "crash"):
+        raise ValueError(
+            f"{kind} requires a multi-replica scenario "
+            '(set "replicas" >= 2 in the scenario JSON)'
+        )
+    return env
+
+
+@dataclass
+class ReplicaCrash(Fault):
+    """Kill control-plane replica ``replica`` outright: it stops
+    reconciling and renewing mid-window, its partition leases expire
+    after the TTL, and the survivors' rendezvous rebalance adopts its
+    partitions (operator/sharding.py). At window end the replica rejoins
+    as a fresh process (same identity, new holder nonce) unless
+    ``restart`` is false."""
+
+    kind = "ReplicaCrash"
+
+    replica: int = 1
+    restart: bool = True
+
+    def on_activate(self, harness) -> None:
+        _replica_env(harness, self.kind).crash(self.replica)
+        harness.record_cloud_fault(self, f"killed replica {self.replica}")
+
+    def on_deactivate(self, harness) -> None:
+        if self.restart:
+            _replica_env(harness, self.kind).restart(self.replica)
+
+
+@dataclass
+class ReplicaPause(Fault):
+    """Stop-the-world pause of replica ``replica`` (GC, VM migration)
+    for the window — size the window past the lease TTL and the resumed
+    replica wakes up DEPOSED: with ``stale_pass`` (default) it runs one
+    controller pass on its pause-time ownership snapshot first, so its
+    in-flight launches/terminates hit the cloud carrying superseded
+    fencing tokens and MUST be rejected (the no-double-launch proof)."""
+
+    kind = "ReplicaPause"
+
+    replica: int = 1
+    stale_pass: bool = True
+
+    def on_activate(self, harness) -> None:
+        _replica_env(harness, self.kind).pause(self.replica)
+        harness.record_cloud_fault(self, f"paused replica {self.replica}")
+
+    def on_deactivate(self, harness) -> None:
+        _replica_env(harness, self.kind).resume(
+            self.replica, stale_pass=self.stale_pass
+        )
+
+
+@dataclass
+class ReplicaNetsplit(Fault):
+    """Partition replica ``replica`` from the lease host only: it keeps
+    reconciling on its local ownership snapshot, must stand down at the
+    renew deadline (strictly inside the TTL), and heals at window end."""
+
+    kind = "ReplicaNetsplit"
+
+    replica: int = 1
+
+    def on_activate(self, harness) -> None:
+        _replica_env(harness, self.kind).netsplit(self.replica)
+        harness.record_cloud_fault(self, f"netsplit replica {self.replica}")
+
+    def on_deactivate(self, harness) -> None:
+        _replica_env(harness, self.kind).heal(self.replica)
+
+
 FAULT_KINDS: dict[str, type] = {
     cls.kind: cls
     for cls in (
         Throttle, ServerError, ConnectionDrop, InjectedLatency,
         CredentialExpiry, Ice, SpotInterrupt, InstanceVanish,
         DeviceLost, EventualConsistencyLag,
+        ReplicaCrash, ReplicaPause, ReplicaNetsplit,
     )
 }
 

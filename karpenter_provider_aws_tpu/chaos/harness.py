@@ -128,10 +128,20 @@ class ChaosHarness:
         self.scenario = Scenario.from_dict(sc.to_dict())
         self.seed = int(seed)
         # the scenario may demand the device solver (DeviceLost/breaker
-        # scenarios are meaningless against the host solver)
-        self.env = new_environment(
-            use_tpu_solver=use_tpu_solver or self.scenario.solver == "tpu"
-        )
+        # scenarios are meaningless against the host solver), or a
+        # multi-replica control plane (Replica* faults + the sharded
+        # lease-layer invariants)
+        if self.scenario.replicas > 1:
+            from ..testenv import new_replicaset
+
+            self.env = new_replicaset(
+                self.scenario.replicas,
+                use_tpu_solver=use_tpu_solver or self.scenario.solver == "tpu",
+            )
+        else:
+            self.env = new_environment(
+                use_tpu_solver=use_tpu_solver or self.scenario.solver == "tpu"
+            )
         self.log = ChaosLog()
         # three independent deterministic streams: interleaving wire draws
         # with cloud sampling (or jitter) must not shift either sequence

@@ -29,6 +29,18 @@ The list (designs/fault-injection.md):
                             from-scratch global encode — the sharded-vs-
                             unsharded exactness contract under fire
                             (designs/sharded-scale.md)
+- ``no-double-launch``      every instance was launched under exactly one
+                            valid fencing token and no claim got two
+                            instances — a deposed replica's in-flight
+                            writes bounced instead of racing the
+                            successor (multi-replica scenarios;
+                            designs/sharded-control-plane.md)
+- ``no-orphaned-claims``    post-settle, every claim's partition has an
+                            effective lease owner (multi-replica)
+- ``leases-partition-the-fleet``  at every audited tick, effective
+                            ownership was a partition of the key space
+                            (no overlap), and post-settle it covers every
+                            known key (multi-replica)
 - ``controllers-healthy``   no controller reconcile raised during the
                             whole run (faults must surface as behavior,
                             never as crashes)
@@ -170,6 +182,114 @@ def check_encode_exact(harness) -> InvariantResult:
     )
 
 
+def _replicaset(harness):
+    """The ReplicaSetEnv behind a multi-replica run, else None — the
+    sharded-lease invariants self-skip (PASS with an n/a detail) on
+    single-replica scenarios so every report lists the same checks."""
+    env = harness.env
+    return env if hasattr(env, "ownership_map") else None
+
+
+def check_no_double_launch(harness) -> InvariantResult:
+    """Sharded control plane: every instance launched during the run was
+    created under exactly one VALID fencing token — stale-token launches
+    were rejected at the cloud (they appear in ``fenced_rejections``, not
+    in the instance store) and no NodeClaim ever got two instances. This
+    is the cross-replica extension of pods-bound-once: a deposed leader's
+    in-flight launch must bounce, not double the successor's."""
+    rs = _replicaset(harness)
+    if rs is None:
+        return _result("no-double-launch", True, "single-replica: n/a")
+    from ..cloudprovider.cloudprovider import NODECLAIM_TAG
+
+    env = harness.env
+    with env.cloud._lock:
+        instances = list(env.cloud.instances.values())
+        rejections = list(env.cloud.fenced_rejections)
+    unfenced = [
+        i.id for i in instances
+        if not i.launch_fence
+    ]
+    by_claim: dict[str, list[str]] = {}
+    for i in instances:
+        if i.state == "terminated":
+            continue
+        claim = i.tags.get(NODECLAIM_TAG, "")
+        if claim:
+            by_claim.setdefault(claim, []).append(i.id)
+    doubled = {c: ids for c, ids in by_claim.items() if len(ids) > 1}
+    ok = not unfenced and not doubled
+    if doubled:
+        detail = "claims with two instances: " + ", ".join(
+            f"{c}={[harness.stable_id(i) for i in ids]}"
+            for c, ids in sorted(doubled.items())[:3]
+        )
+    elif unfenced:
+        detail = (
+            f"{len(unfenced)} instances launched without a fencing token: "
+            f"{[harness.stable_id(i) for i in unfenced[:4]]}"
+        )
+    else:
+        detail = (
+            f"{len(instances)} launches all fenced; "
+            f"{len(rejections)} stale-token writes rejected"
+        )
+    return _result("no-double-launch", ok, detail)
+
+
+def check_no_orphaned_claims(harness) -> InvariantResult:
+    """Post-settle, every live claim's partition has an effective owner
+    (and the GLOBAL scope is held): a replica loss may orphan partitions
+    for up to a TTL mid-run, but once the dust settles the lease layer
+    must cover the whole fleet or claims rot unmanaged."""
+    rs = _replicaset(harness)
+    if rs is None:
+        return _result("no-orphaned-claims", True, "single-replica: n/a")
+    from ..operator import sharding
+
+    gap = set(rs.partition_gap())
+    orphaned = []
+    for claim in rs.cluster.snapshot_claims():
+        key = sharding._partition_of_claim(rs.cluster, claim)
+        if key is None:
+            key = sharding.GLOBAL_KEY
+        if key in gap or (
+            key not in set(rs.ownership_map()) and sharding.GLOBAL_KEY in gap
+        ):
+            orphaned.append((claim.name, key))
+    ok = not orphaned and sharding.GLOBAL_KEY not in gap
+    return _result(
+        "no-orphaned-claims", ok,
+        (f"unowned: {orphaned[:4]} gap={sorted(gap)[:4]}" if not ok
+         else f"{len(rs.cluster.nodeclaims)} claims all owned post-settle"),
+    )
+
+
+def check_leases_partition_fleet(harness) -> InvariantResult:
+    """At EVERY tick of the run, effective lease ownership was a
+    partition of the key space: no two replicas simultaneously owned one
+    partition (ReplicaSetEnv audits this after each step), and post-settle
+    the union covers every known partition key."""
+    rs = _replicaset(harness)
+    if rs is None:
+        return _result("leases-partition-the-fleet", True, "single-replica: n/a")
+    overlaps = list(rs.lease_overlaps)
+    gap = rs.partition_gap()
+    ok = not overlaps and not gap
+    if overlaps:
+        detail = f"ownership overlap at t={overlaps[0][0]}: {overlaps[:3]}"
+    elif gap:
+        detail = f"uncovered partitions post-settle: {sorted(gap)[:4]}"
+    else:
+        keys = 1 + len(rs.cluster.partition_keys())
+        detail = (
+            f"{keys} keys partitioned across "
+            f"{sum(1 for r in rs.replicas if r.alive)} replicas, "
+            f"0 overlaps over {len(rs.coverage_history)} audited ticks"
+        )
+    return _result("leases-partition-the-fleet", ok, detail)
+
+
 def check_controllers_healthy(harness) -> InvariantResult:
     errors = harness.env.manager.errors[harness.errors_baseline:]
     return _result(
@@ -188,6 +308,9 @@ INVARIANTS = (
     check_queue_drained,
     check_breakers_recovered,
     check_encode_exact,
+    check_no_double_launch,
+    check_no_orphaned_claims,
+    check_leases_partition_fleet,
     check_controllers_healthy,
 )
 

@@ -105,6 +105,11 @@ class Scenario:
     # which Solver the environment runs: "host" (default, fast) or "tpu"
     # (the device path — what DeviceLost/breaker scenarios exercise)
     solver: str = "host"
+    # control-plane replicas: 1 = the single hermetic environment; >= 2
+    # builds a ReplicaSetEnv (testenv.new_replicaset) with the sharded
+    # lease layer live — what Replica* faults and the no-double-launch /
+    # leases-partition-the-fleet invariants exercise
+    replicas: int = 1
     capacity_types: tuple = ()            # () = pool default (any)
     categories: tuple = ("c", "m", "r")
     workloads: list[Workload] = field(default_factory=list)
@@ -124,6 +129,8 @@ class Scenario:
             d["assume_role"] = True
         if self.solver != "host":
             d["solver"] = self.solver
+        if self.replicas != 1:
+            d["replicas"] = self.replicas
         pool: dict = {}
         if self.capacity_types:
             pool["capacity_types"] = list(self.capacity_types)
@@ -147,6 +154,7 @@ class Scenario:
             settle_reconciles=int(d.get("settle_reconciles", 60)),
             assume_role=bool(d.get("assume_role", False)),
             solver=str(d.get("solver", "host")),
+            replicas=int(d.get("replicas", 1)),
             capacity_types=tuple(pool.get("capacity_types", ())),
             categories=tuple(pool.get("categories", ("c", "m", "r"))),
             workloads=[Workload.from_dict(w) for w in d.get("workloads", [])],

@@ -31,6 +31,11 @@ class LaunchRequest:
     launch_template_name: str = ""            # "" = launch without a template
     # reserved EC2 launch context, verbatim pass-through (instance.go:220)
     context: str = ""
+    # sharded-control-plane fencing (operator/sharding.py): the
+    # (lease name, token) tuple naming the lease tenancy that sanctioned
+    # this launch. () = unfenced (single-replica). The backend rejects a
+    # token older than the lease's current tenancy (StaleFencingToken).
+    fence: tuple = ()
 
 
 @runtime_checkable
@@ -72,6 +77,18 @@ class CloudBackend(Protocol):
     def try_acquire_lease(self, name: str, holder: str, ttl_s: float) -> str: ...
 
     def release_lease(self, name: str, holder: str) -> None: ...
+
+    # Fenced coordination (sharded control plane, operator/sharding.py):
+    # the CAS additionally returns a monotonic fencing token (bumped per
+    # holder change, never per renew) + the holder's instance nonce, and
+    # list_leases serves membership discovery. Backends that cannot host
+    # fenced leases simply don't run the sharded elector — the single
+    # LeaderElector path needs only the two methods above.
+    def try_acquire_lease_fenced(
+        self, name: str, holder: str, ttl_s: float, nonce: str = "",
+    ) -> tuple[str, int, str]: ...
+
+    def list_leases(self, prefix: str = "") -> dict: ...
 
     # -- networking / discovery -------------------------------------------
     def describe_availability_zones(self) -> dict[str, str]: ...

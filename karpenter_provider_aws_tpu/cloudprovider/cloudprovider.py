@@ -16,6 +16,7 @@ Create() parity with ``pkg/cloudprovider/cloudprovider.go:81-141`` +
 from __future__ import annotations
 
 import enum
+import threading
 from typing import Optional
 
 from ..catalog.provider import CatalogProvider
@@ -78,11 +79,39 @@ class CloudProvider:
         self._launchable_cache = TTLCache(default_ttl=CacheTTL.DEFAULT, clock=clock)
         opts = batcher_options or BatcherOptions()
         self._fleet_batcher: Batcher = Batcher(self.cloud.create_fleet, options=opts)
+        # fences stamped by delete() ride beside the coalesced id batch:
+        # the batcher's unit is a bare instance id, so the (id -> fence)
+        # map travels out of band and is consumed per flushed batch
+        self._pending_fences: dict[str, tuple] = {}
+        self._fences_lock = threading.Lock()
         self._terminate_batcher: Batcher = Batcher(
-            self.cloud.terminate_instances,
+            self._terminate_batch,
             options=BatcherOptions(idle_timeout_s=opts.idle_timeout_s * 3,
                                    max_timeout_s=opts.max_timeout_s, max_items=500),
         )
+
+    def _terminate_batch(self, ids: list) -> list:
+        """One coalesced TerminateInstances call, carrying each id's
+        fencing token when the sharded control plane stamped one and the
+        backend can enforce it (the fake / any fenced store); unfenced
+        backends get the plain call."""
+        with self._fences_lock:
+            fences = {
+                i: self._pending_fences.pop(i)
+                for i in list(ids) if i in self._pending_fences
+            }
+        if fences:
+            import inspect
+
+            try:
+                accepts = "fences" in inspect.signature(
+                    self.cloud.terminate_instances
+                ).parameters
+            except (TypeError, ValueError):
+                accepts = False
+            if accepts:
+                return self.cloud.terminate_instances(list(ids), fences=fences)
+        return self.cloud.terminate_instances(list(ids))
 
     # -- Create ------------------------------------------------------------
     def create(self, claim: NodeClaim) -> NodeClaim:
@@ -213,7 +242,15 @@ class CloudProvider:
             )[image.id]
 
         lt_name = ensure_template()
+        # Fencing (sharded control plane): name the lease tenancy that
+        # sanctioned this launch — the ambient sanction key (a disruption
+        # replacement is sanctioned by the OLD node's partition lease),
+        # else the GLOBAL lease (provisioning). () when unsharded.
+        from ..operator import sharding
+
+        fence = sharding.write_fence(self.cluster, claim) or ()
         request = LaunchRequest(
+            fence=tuple(fence),
             instance_type_options=[t.name for t in type_options],
             offering_options=offerings,
             image_id=image.id,
@@ -405,6 +442,12 @@ class CloudProvider:
         instance_id = parse_provider_id(claim.status.provider_id)
         if instance_id is None:
             raise errors.NotFoundError(f"claim {claim.name} has no provider id")
+        from ..operator import sharding
+
+        fence = sharding.write_fence(self.cluster, claim)
+        if fence is not None:
+            with self._fences_lock:
+                self._pending_fences[instance_id] = tuple(fence)
         self._terminate_batcher.add(instance_id)
         # Return pre-paid capacity to the in-flight view — but only once the
         # cloud confirms the instance is actually terminated. Releasing on

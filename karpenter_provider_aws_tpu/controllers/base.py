@@ -148,7 +148,8 @@ class Manager:
                 with _budget.scope(_budget.Budget(
                     self._budget_s(c), clock=self.clock,
                 )):
-                    c.reconcile()
+                    with self._ownership_scope(c):
+                        c.reconcile()
         except Exception as e:
             log.exception("controller %s reconcile failed", name)
             self._record_error(c, e)
@@ -162,6 +163,26 @@ class Manager:
                 self._stuck.discard(name)
             if was_stuck:
                 self._set_stuck_gauge(name, 0.0)
+
+    def _ownership_scope(self, c: Controller):
+        """Ambient partition ownership for this reconcile (sharded control
+        plane): when the elector publishes an ``ownership()`` snapshot
+        (operator/sharding.ShardElector), every OTHER controller runs
+        inside ``sharding.scope(snapshot)`` and filters its work through
+        the owns_* predicates. The single LeaderElector (no snapshot) and
+        elector-less managers change nothing — the predicates answer True
+        with no ambient scope."""
+        import contextlib
+
+        if (
+            self.elector is None
+            or c is self.elector
+            or not hasattr(self.elector, "ownership")
+        ):
+            return contextlib.nullcontext()
+        from ..operator import sharding
+
+        return sharding.scope(self.elector.ownership())
 
     @staticmethod
     def _budget_s(c: Controller) -> float:

@@ -187,11 +187,17 @@ class DisruptionController:
         # in-place annotation stamps are invisible to the key; _disrupt's
         # commit recheck enforces them (see _scan_cache).
         from ..models.pod import POD_WRITE_SEQ
+        from ..operator import sharding
         from ..state.cluster import NODE_WRITE_SEQ
 
+        own = sharding.current()
         ckey = (
             getattr(self.cluster, "epoch", None), rev0,
             NODE_WRITE_SEQ.v, POD_WRITE_SEQ.v,
+            # sharded: the working set is ownership-filtered, and leases
+            # can move between passes with no store mutation — the cache
+            # key carries the owned-key set so a rebalance invalidates it
+            frozenset(own.keys) if own is not None else None,
         )
         cached = self._scan_cache
         if cached is not None and cached[0] == ckey:
@@ -211,6 +217,8 @@ class DisruptionController:
         self._reconcile_consolidation(budget, by_node, rev0, dnd_node)
 
     def _claims_with_nodes(self, pods_by_node=None, dnd_node=None):
+        from ..operator import sharding
+
         if pods_by_node is None:
             pods_by_node = self.cluster.pods_by_node()
         for claim in self.cluster.snapshot_claims():
@@ -219,6 +227,8 @@ class DisruptionController:
             node = self.cluster.nodes.get(claim.status.node_name)
             if node is None or node.cordoned:
                 continue
+            if not sharding.owns_node(self.cluster, node):
+                continue  # sharded: another replica disrupts this partition
             # karpenter.sh/do-not-disrupt blocks EVERY voluntary disruption
             # (expiration/drift/emptiness/consolidation): on the claim, the
             # node, or any pod still running there
@@ -331,6 +341,11 @@ class DisruptionController:
                 return _eligible_cache[ni]
             result = None
             node = nodes.get(ct.node_names[ni])
+            if node is not None:
+                from ..operator import sharding
+
+                if not sharding.owns_node(self.cluster, node):
+                    node = None  # another replica's partition
             # live pod-level do-not-disrupt recheck: ct.blocked carries it
             # from encode time, but an annotation stamped since (an
             # in-place mutation the change journal cannot see) must still
@@ -615,7 +630,12 @@ class DisruptionController:
         """Launch the cheaper replacement BEFORE disrupting the old node
         (consolidation.md: replacements come up first), through the shared
         launch path so pool labels/taints/constraints are identical to a
-        provisioner launch. Returns the new claim, or None on failure."""
+        provisioner launch. Returns the new claim, or None on failure.
+
+        Sharded: the replacement write is sanctioned by the OLD node's
+        partition lease — that lease authorized disrupting the node, so
+        its fencing token rides the launch wherever the new node lands."""
+        from ..operator import sharding
         from ..scheduling.solver import NodeSpec
         from .provisioning import launch_claim
 
@@ -629,8 +649,10 @@ class DisruptionController:
             capacity_type_options=sorted({ct for _, ct in offering_options}),
             offering_options=list(offering_options),
         )
-        return launch_claim(self.cluster, self.cloudprovider, pool, spec,
-                            recorder=self.recorder)
+        key = sharding._partition_of_claim(self.cluster, old_claim)
+        with sharding.sanction(key):
+            return launch_claim(self.cluster, self.cloudprovider, pool, spec,
+                                recorder=self.recorder)
 
 
 class _BudgetTracker:

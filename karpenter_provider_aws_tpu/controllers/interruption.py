@@ -130,6 +130,13 @@ class InterruptionController:
         )
 
     def reconcile(self) -> None:
+        from ..operator import sharding
+
+        # one queue, one consumer: the interruption queue's receive/delete
+        # protocol cannot be partitioned safely (a message's claim is only
+        # known after receipt), so it rides the GLOBAL lease
+        if not sharding.owns_global():
+            return
         messages = self.queue.receive()
         if not messages:
             return

@@ -38,6 +38,47 @@ class LivenessController:
         # (budget gauges, fast-burn events, idle event-recorder sweep)
         self.obs = obs
         self.reaped: list[str] = []
+        # dirty-set walk state (change-journal pattern, like the
+        # encoders): claim names that might still need liveness reaping —
+        # anything not yet registered. The launch path re-applies a claim
+        # when its provider id lands, so every state flip this controller
+        # cares about is journaled.
+        self._watch: dict[str, None] = {}
+        self._cursor = None
+
+    def _watched_claims(self) -> list:
+        """Claims a pass must condition-check, fed by the change journal
+        instead of an O(claims) walk per pass: a claim leaves the watch
+        set once registered (or gone) and re-enters whenever the store
+        journals it. The simulator's attribution profile named this
+        per-claim tail; the registration controller uses the same
+        pattern (the PR's pattern-setter pair)."""
+        cluster = self.cluster
+        epoch = getattr(cluster, "epoch", None)
+        rev = getattr(cluster, "rev", None)
+        if epoch is None or rev is None:
+            return list(cluster.snapshot_claims())
+        changes = None
+        if self._cursor is not None and self._cursor[0] is epoch:
+            changes = cluster.changes_since(self._cursor[1])
+        if changes is None:
+            self._watch = {
+                c.name: None
+                for c in cluster.snapshot_claims()
+                if not c.is_registered()
+            }
+        else:
+            for name in changes.get("claim", ()):
+                self._watch[name] = None
+        self._cursor = (epoch, rev)
+        out = []
+        for name in list(self._watch):
+            claim = cluster.nodeclaims.get(name)
+            if claim is None or claim.is_registered():
+                del self._watch[name]
+                continue
+            out.append(claim)
+        return out
 
     def _obs(self):
         if self.obs is None:
@@ -47,15 +88,19 @@ class LivenessController:
         return self.obs
 
     def reconcile(self) -> None:
+        from ..operator import sharding
+
         now = self.clock.now()
         obs = self._obs()
-        for claim in self.cluster.snapshot_claims():
+        for claim in self._watched_claims():
             if claim.deleted or claim.is_registered():
                 continue
             if not claim.is_launched():
                 continue  # launch path owns pre-launch failures
             if now - claim.created_at < self.ttl_s:
                 continue
+            if not sharding.owns_claim(self.cluster, claim):
+                continue  # the partition's owner reaps
             log.warning(
                 "claim %s launched but never registered within %.0fs; reaping",
                 claim.name, self.ttl_s,

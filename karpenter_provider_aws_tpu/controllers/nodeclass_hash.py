@@ -19,6 +19,10 @@ class NodeClassHashController:
         self.cluster = cluster
 
     def reconcile(self) -> None:
+        from ..operator import sharding
+
+        if not sharding.owns_global():
+            return  # global scope: one hash writer for the shared store
         for nc in list(self.cluster.nodeclasses.values()):
             if nc.deleted:
                 continue

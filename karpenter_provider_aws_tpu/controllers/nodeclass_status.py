@@ -22,6 +22,12 @@ class NodeClassStatusController:
         self.cloudprovider = cloudprovider
 
     def reconcile(self) -> None:
+        from ..operator import sharding
+
+        # nodeclass objects are pool/zone-agnostic (global scope): one
+        # writer keeps the shared store's status fresh for every replica
+        if not sharding.owns_global():
+            return
         live = [nc for nc in self.cluster.nodeclasses.values() if not nc.deleted]
         # One cloud describe serves every nodeclass this pass (lazy: skipped
         # entirely when no nodeclass selects reservations).

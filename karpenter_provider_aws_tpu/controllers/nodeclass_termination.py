@@ -21,6 +21,10 @@ class NodeClassTerminationController:
         self.cloudprovider = cloudprovider
 
     def reconcile(self) -> None:
+        from ..operator import sharding
+
+        if not sharding.owns_global():
+            return  # global scope, like nodeclass-status
         for nc in list(self.cluster.nodeclasses.values()):
             if not nc.deleted:
                 continue

@@ -29,6 +29,12 @@ log = logging.getLogger("karpenter.tpu.provisioning")
 MAX_LAUNCH_WORKERS = 10  # parity: reconcile worker-pool width (SURVEY 2.3)
 
 
+def _null_ctx():
+    import contextlib
+
+    return contextlib.nullcontext()
+
+
 class ProvisioningController:
     name = "provisioning"
     interval_s = 10.0
@@ -56,8 +62,15 @@ class ProvisioningController:
 
     def reconcile(self) -> None:
         from ..models.pod import POD_WRITE_SEQ
+        from ..operator import sharding
 
+        # Sharded control plane: pending pods are unpartitioned work — the
+        # replica holding the GLOBAL lease provisions; everyone else's
+        # pass is a no-op except pruning nominations whose claims died
+        # (a replica keeps its own nomination map fresh regardless).
         self._prune_stale_nominations()
+        if not sharding.owns_global():
+            return
         # revision components are captured BEFORE the pending snapshot: a
         # mutation racing the list read then leaves the token OLDER than the
         # pods (at worst one extra cache miss next pass) — capturing after
@@ -142,6 +155,12 @@ class ProvisioningController:
         if specs:
             import os
 
+            # worker threads don't inherit the reconcile thread's ambient
+            # ownership (thread-local) — capture it here and re-enter the
+            # scope inside each launch so CloudProvider.create stamps the
+            # right fencing token whichever thread runs it
+            own = sharding.current()
+            launch = lambda spec: self._launch(spec, own)  # noqa: E731
             if len(specs) == 1 or os.environ.get(
                 "KARPENTER_TPU_SERIAL_LAUNCH"
             ) == "1":
@@ -150,10 +169,10 @@ class ProvisioningController:
                 # serialize launches — thread scheduling otherwise decides
                 # claim names, event order, and capacity-pool draw order
                 for spec in specs:
-                    self._launch(spec)
+                    launch(spec)
             else:
                 with ThreadPoolExecutor(max_workers=min(MAX_LAUNCH_WORKERS, len(specs))) as pool:
-                    list(pool.map(self._launch, specs))
+                    list(pool.map(launch, specs))
         # Sampled oracle price gap LAST, after binds and launches are
         # applied: quality telemetry must never add latency to pod
         # time-to-bind — the SLI this subsystem measures. Keyed on
@@ -303,12 +322,15 @@ class ProvisioningController:
                 if cn in claims and not claims[cn].deleted
             }
 
-    def _launch(self, spec: NodeSpec) -> None:
+    def _launch(self, spec: NodeSpec, own=None) -> None:
+        from ..operator import sharding
+
         pool = self.cluster.nodepools.get(spec.nodepool_name)
         if pool is None:
             return
-        claim = launch_claim(self.cluster, self.cloudprovider, pool, spec,
-                             recorder=self.recorder)
+        with sharding.scope(own) if own is not None else _null_ctx():
+            claim = launch_claim(self.cluster, self.cloudprovider, pool, spec,
+                                 recorder=self.recorder)
         if claim is None:
             return
         with self._nominations_lock:

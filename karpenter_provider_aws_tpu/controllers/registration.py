@@ -26,8 +26,65 @@ class RegistrationController:
         self.clock = clock or RealClock()
         self._pass_usage = None  # per-reconcile usage snapshot (see below)
         self._pass_noms = None   # per-reconcile reverse nomination map
+        # dirty-set walk state (the change-journal pattern the encoders
+        # set): insertion-ordered claim names still needing lifecycle work
+        self._watch: dict[str, None] = {}
+        self._cursor = None      # (epoch, rev) of the last journal read
+
+    def _watched_claims(self) -> list:
+        """The claims a pass must visit, driven off the store's change
+        journal instead of an O(claims) condition-check walk per pass
+        (the simulator-found per-claim tail): claims enter the watch set
+        when the journal names them (apply/launch/delete) and leave once
+        fully initialized; claims referenced by this replica's live
+        nominations ride along so a nomination landing AFTER a claim
+        initialized still binds. Journal overflow / store reset falls
+        back to one full rebuild — never a correctness loss."""
+        cluster = self.cluster
+        epoch = getattr(cluster, "epoch", None)
+        rev = getattr(cluster, "rev", None)
+        if epoch is None or rev is None:  # foreign store: full walk
+            return list(cluster.nodeclaims.values())
+        changes = None
+        if self._cursor is not None and self._cursor[0] is epoch:
+            changes = cluster.changes_since(self._cursor[1])
+        if changes is None:
+            self._watch = {
+                c.name: None
+                for c in cluster.snapshot_claims()
+                if not c.is_initialized() or c.deleted
+            }
+        else:
+            for name in changes.get("claim", ()):
+                self._watch[name] = None
+        self._cursor = (epoch, rev)
+        noms: set = set()
+        if self.provisioning is not None:
+            with self.provisioning._nominations_lock:
+                noms = set(self.provisioning.nominations.values())
+        out = []
+        for name in list(self._watch):
+            claim = cluster.nodeclaims.get(name)
+            if claim is None or claim.deleted or (
+                claim.is_initialized() and name not in noms
+            ):
+                # settled (or gone): out of the watch set — a later
+                # nomination re-reaches it through ``noms`` below, and a
+                # later store mutation re-journals it
+                del self._watch[name]
+                if claim is None or claim.deleted:
+                    continue
+            out.append(claim)
+        seen = {c.name for c in out}
+        for name in sorted(noms - seen):
+            claim = cluster.nodeclaims.get(name)
+            if claim is not None and not claim.deleted:
+                out.append(claim)
+        return out
 
     def reconcile(self) -> None:
+        from ..operator import sharding
+
         observer = getattr(self.cluster, "observer", None)
         # one usage snapshot per pass, shared by every claim's nomination
         # binding and decremented as binds land: recomputing the O(pods)
@@ -38,8 +95,23 @@ class RegistrationController:
         # reverse nomination map, built once per pass: scanning the whole
         # nominations dict per claim was O(claims x nominations)
         self._pass_noms = None
-        for claim in list(self.cluster.nodeclaims.values()):
+        # names of claims THIS replica nominated pods onto: the launcher
+        # keeps binding its nominations even when the claim's partition
+        # landed with another replica (binds are store writes the fencing
+        # layer doesn't gate; a pod uid lives in exactly one replica's
+        # nomination map, so pods-bound-once holds across replicas)
+        self_nominated: set = set()
+        if self.provisioning is not None and sharding.current() is not None:
+            with self.provisioning._nominations_lock:
+                self_nominated = set(self.provisioning.nominations.values())
+        for claim in self._watched_claims():
             if claim.deleted or not claim.is_launched():
+                continue
+            if not sharding.owns_claim(self.cluster, claim):
+                # not ours to register — but bind our own nominations once
+                # its real owner has brought the node up
+                if claim.name in self_nominated and claim.is_registered():
+                    self._bind_nominated(claim)
                 continue
             if not claim.is_registered():
                 # registration: node joins carrying pool taints + startup

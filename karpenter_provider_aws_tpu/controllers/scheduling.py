@@ -165,6 +165,12 @@ class SchedulingController:
         return counts.get(zone, 0) + 1 - floor <= skew
 
     def reconcile(self) -> None:
+        from ..operator import sharding
+
+        # pending pods are unpartitioned: the GLOBAL-lease holder binds
+        # (same scope as the provisioner it backstops)
+        if not sharding.owns_global():
+            return
         pending = self.cluster.pending_pods()
         if not pending:
             return

@@ -22,9 +22,13 @@ class TaggingController:
         self.cloudprovider = cloudprovider
 
     def reconcile(self) -> None:
+        from ..operator import sharding
+
         for claim in list(self.cluster.nodeclaims.values()):
             if claim.deleted or not claim.is_registered():
                 continue
+            if not sharding.owns_claim(self.cluster, claim):
+                continue  # the partition's owner tags
             if claim.annotations.get(lbl.ANNOTATION_INSTANCE_TAGGED) == "true":
                 continue
             instance_id = parse_provider_id(claim.status.provider_id)

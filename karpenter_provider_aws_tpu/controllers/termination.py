@@ -87,9 +87,13 @@ class TerminationController:
         return drained
 
     def reconcile(self) -> None:
+        from ..operator import sharding
+
         for claim in self.cluster.snapshot_claims():
             if not claim.deleted:
                 continue
+            if not sharding.owns_claim(self.cluster, claim):
+                continue  # the partition's owner drains + terminates
             node = self.cluster.nodes.get(claim.status.node_name)
             if node is not None:
                 node.cordoned = True
@@ -99,6 +103,10 @@ class TerminationController:
                 try:
                     self.cloudprovider.delete(claim)
                 except Exception as e:
+                    if errors.is_stale_fence(e):
+                        # deposed mid-pass: the partition's new owner
+                        # carries this drain forward — stand down quietly
+                        continue
                     if not errors.is_not_found(e):
                         raise
             if node is not None:

@@ -88,6 +88,11 @@ class Instance:
     launch_time: float = 0.0
     tags: dict[str, str] = field(default_factory=dict)
     capacity_reservation_id: str = ""  # set for reserved-captype launches
+    # the (lease name, fencing token) this launch was sanctioned under —
+    # () for unfenced launches (single-replica deployments) and the
+    # ("__seeded__", 0) sentinel for harness-seeded fleets. The
+    # no-double-launch chaos invariant reads this.
+    launch_fence: tuple = ()
 
     @property
     def provider_id(self) -> str:
@@ -125,8 +130,19 @@ class FakeCloud:
         self.security_groups: list[SecurityGroup] = [
             SecurityGroup(id="sg-1", name="default", tags={"discovery": "cluster-1"}),
         ]
-        # leader-election leases: name -> (holder, expires_at)
-        self._leases: dict[str, tuple[str, float]] = {}
+        # coordination leases: name -> (holder, expires_at, holder nonce).
+        # The nonce distinguishes two ELECTOR INSTANCES that share one
+        # identity string (a deployment bug): without it both would renew
+        # "their" lease and both believe they lead.
+        self._leases: dict[str, tuple[str, float, str]] = {}
+        # per-lease monotonic fencing tokens: bumped on every HOLDER
+        # change (acquire of a new/expired/released lease), never on a
+        # renew — the control-plane-store half of the fenced-write
+        # protocol (operator/sharding.py)
+        self._lease_tokens: dict[str, int] = {}
+        # fenced writes rejected, by lease name (introspection; the
+        # metric counts globally)
+        self.fenced_rejections: list[tuple[str, int, int, str]] = []
         self.images: list[Image] = [
             Image(id="img-std-2", name="standard-v2", family="standard", arch="amd64", created_seq=2),
             Image(id="img-std-arm-2", name="standard-arm-v2", family="standard", arch="arm64", created_seq=2),
@@ -173,6 +189,7 @@ class FakeCloud:
             self.capacity_reservations.clear()
             self.next_errors.clear()
             self.calls.clear()
+            self.fenced_rejections.clear()
 
     # -- fleet launch ------------------------------------------------------
     def create_fleet(self, requests: list[LaunchRequest]) -> list:
@@ -187,6 +204,12 @@ class FakeCloud:
             return results
 
     def _launch_one(self, req: LaunchRequest):
+        # Fencing first (sharded control plane): a launch sanctioned by a
+        # superseded lease tenancy must not create capacity — the
+        # successor replica already owns this partition's writes.
+        fence_err = self._check_fence(getattr(req, "fence", ()), "create_fleet")
+        if fence_err is not None:
+            return fence_err
         # Launch-template reference must resolve (parity: CreateFleet's
         # InvalidLaunchTemplateName.NotFoundException, instance.go:106-110).
         if req.launch_template_name and req.launch_template_name not in self.launch_templates:
@@ -239,6 +262,7 @@ class FakeCloud:
                     launch_time=self.clock.now(),
                     tags=dict(req.tags),
                     capacity_reservation_id=reservation_id,
+                    launch_fence=tuple(getattr(req, "fence", ()) or ()),
                 )
                 self.instances[inst.id] = inst
                 return inst
@@ -259,21 +283,93 @@ class FakeCloud:
         takes over only after expiry. Returns the holder AFTER the attempt
         (parity: the coordination.k8s.io Lease the reference's manager
         rides, cmd/controller/main.go:34)."""
+        return self.try_acquire_lease_fenced(name, holder, ttl_s)[0]
+
+    def try_acquire_lease_fenced(
+        self, name: str, holder: str, ttl_s: float, nonce: str = "",
+    ) -> tuple[str, int, str]:
+        """Fenced CAS acquire-or-renew: returns ``(holder, token, nonce)``
+        after the attempt. The fencing token bumps on every holder change
+        and NEVER on a renew, so a token uniquely names one continuous
+        tenancy of the lease; the fenced write checks below reject any
+        token older than the current one. ``nonce`` distinguishes elector
+        instances sharing one identity: a same-identity contender with a
+        different nonce is a CONTENDER, not the holder renewing — it
+        waits out the TTL like anyone else."""
         with self._lock:
             self._maybe_fail()
             now = self.clock.now()
             lease = self._leases.get(name)
-            if lease is None or lease[0] == holder or now >= lease[1]:
-                self._leases[name] = (holder, now + ttl_s)
-                return holder
-            return lease[0]
+            if lease is None or now >= lease[1] or (
+                lease[0] == holder and lease[2] == nonce
+            ):
+                if lease is None or lease[0] != holder or lease[2] != nonce \
+                        or now >= lease[1]:
+                    # new tenancy (fresh, expired, or a same-identity
+                    # takeover): the fencing token advances
+                    self._lease_tokens[name] = self._lease_tokens.get(name, 0) + 1
+                self._leases[name] = (holder, now + ttl_s, nonce)
+                return holder, self._lease_tokens[name], nonce
+            return lease[0], self._lease_tokens.get(name, 0), lease[2]
 
     def release_lease(self, name: str, holder: str) -> None:
-        """Voluntary hand-off; only the holder may release."""
+        """Voluntary hand-off; only the holder may release. The fencing
+        token survives the release — the NEXT acquire bumps it, so a
+        released-and-reacquired lease still fences the old tenancy out."""
         with self._lock:
             lease = self._leases.get(name)
             if lease is not None and lease[0] == holder:
                 del self._leases[name]
+
+    def list_leases(self, prefix: str = "") -> dict[str, tuple[str, float, str]]:
+        """Live (unexpired) leases by name, optionally prefix-filtered —
+        the sharded elector's membership discovery reads
+        ``karpenter-shard-member/`` through this."""
+        with self._lock:
+            now = self.clock.now()
+            return {
+                name: lease
+                for name, lease in self._leases.items()
+                if name.startswith(prefix) and now < lease[1]
+            }
+
+    def lease_token(self, name: str) -> int:
+        """The current fencing token for ``name`` (0 = never acquired)."""
+        with self._lock:
+            return self._lease_tokens.get(name, 0)
+
+    def _check_fence(self, fence, api: str):
+        """Validate a write's fencing token against the lease host's
+        current token the way a real control-plane store would: a token
+        OLDER than the lease's current tenancy means the writer was
+        deposed after it planned this write — reject, don't race the
+        successor. Returns the error (callers decide raise-vs-positional).
+        Callers hold the lock. Valid tokens start at 1 — token 0 is the
+        explicit never-held sentinel (``sharding.write_fence``'s fallback
+        for a writer holding no relevant lease) and is rejected even when
+        the lease has never been acquired (``cur == 0``): a fenced write
+        is only sanctioned by a tenancy somebody actually holds."""
+        if not fence:
+            return None
+        name, token = fence[0], int(fence[1])
+        if name == "__seeded__":
+            return None
+        cur = self._lease_tokens.get(name, 0)
+        if token < cur or token < 1:
+            self.fenced_rejections.append((name, token, cur, api))
+            try:
+                from ..metrics import FENCED_WRITES_REJECTED
+
+                FENCED_WRITES_REJECTED.inc(api=api)
+            except Exception:
+                pass
+            from ..utils.errors import StaleFencingTokenError
+
+            return StaleFencingTokenError(
+                f"{api}: fencing token {token} for {name} superseded by "
+                f"{cur}: the sanctioning lease has a new holder"
+            )
+        return None
 
     def describe_cluster(self) -> dict:
         """Cluster network facts (EKS DescribeCluster analogue)."""
@@ -308,12 +404,23 @@ class FakeCloud:
                 out.append(inst)
             return out
 
-    def terminate_instances(self, ids: list[str]) -> list:
+    def terminate_instances(self, ids: list[str], fences: Optional[dict] = None) -> list:
+        """``fences`` (instance id -> (lease name, token), optional) fences
+        each terminate the way ``LaunchRequest.fence`` fences a launch: a
+        write from a superseded lease tenancy returns the rejection
+        positionally (the batcher scatters it back) and the instance
+        stays running for its real owner to manage."""
         with self._lock:
             self._record("terminate_instances", list(ids))
             self._maybe_fail()
             results = []
             for i in ids:
+                fence_err = self._check_fence(
+                    (fences or {}).get(i, ()), "terminate_instances"
+                )
+                if fence_err is not None:
+                    results.append(fence_err)
+                    continue
                 inst = self.instances.get(i)
                 if inst is None:
                     results.append(NotFoundError(f"instance {i} not found"))

@@ -465,6 +465,28 @@ CRASHLOOP_BACKOFFS = REGISTRY.counter(
     "controller (reset on the first successful reconcile)",
 )
 
+# -- operator/sharding.py: horizontally sharded control plane ---------------
+SHARD_LEASES_HELD = REGISTRY.gauge(
+    "karpenter_shard_leases_held",
+    "Partition leases this replica currently holds (by replica identity); "
+    "the GLOBAL lease counts as one — a healthy N-replica deployment sums "
+    "to the partition count + 1 across replicas",
+)
+SHARD_REBALANCES = REGISTRY.counter(
+    "karpenter_shard_rebalances_total",
+    "Partition-lease ownership changes by reason (acquired = new tenancy, "
+    "rebalance = voluntary hand-off to the rendezvous target, lost = a "
+    "definitive foreign holder dropped the lease, renew-failed = an "
+    "indeterminate CAS renew error; the lease rides its old renew date "
+    "to the renew deadline)",
+)
+FENCED_WRITES_REJECTED = REGISTRY.counter(
+    "karpenter_fenced_writes_rejected_total",
+    "Cloud-side writes rejected because their fencing token belonged to a "
+    "superseded lease tenancy (a deposed replica's in-flight launch/"
+    "terminate bounced instead of racing the successor), by api",
+)
+
 # -- sim/ subsystem: deterministic fleet simulator --------------------------
 SIM_EVENTS = REGISTRY.counter(
     "karpenter_sim_events_total",

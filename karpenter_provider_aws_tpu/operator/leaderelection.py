@@ -58,21 +58,42 @@ class LeaderElector:
         self.clock = clock
         self._leader = False
         self._renewed_at: Optional[float] = None
+        # Holder-instance nonce: two replicas misconfigured with the SAME
+        # identity string must not both believe they lead — a fenced lease
+        # host distinguishes the instances by nonce, so the second is a
+        # contender, not the holder renewing. (Fall back to the legacy
+        # identity-only CAS on hosts without the fenced API.)
+        self._nonce = uuid.uuid4().hex
 
     def _now(self) -> float:
         return self.clock.now() if self.clock is not None else time.monotonic()
 
     def reconcile(self) -> None:
-        holder = self.cloud.try_acquire_lease(
-            self.lease_name, self.identity, self.ttl_s
-        )
+        # Capture the clock BEFORE the CAS: the lease host stamps expiry
+        # at some instant DURING the call, so dating the renewal after the
+        # call returns would overstate freshness by the call's latency —
+        # exactly the boundary where a slow renew lets the local deadline
+        # and the host's expiry disagree (client-go dates renewals from
+        # the request, not the response).
+        pre = self._now()
+        fenced = getattr(self.cloud, "try_acquire_lease_fenced", None)
+        if fenced is not None:
+            holder, _token, nonce = fenced(
+                self.lease_name, self.identity, self.ttl_s, nonce=self._nonce
+            )
+            is_me = holder == self.identity and nonce == self._nonce
+        else:
+            holder = self.cloud.try_acquire_lease(
+                self.lease_name, self.identity, self.ttl_s
+            )
+            is_me = holder == self.identity
         was = self._leader
-        self._leader = holder == self.identity
+        self._leader = is_me
         from ..metrics import LEADER
 
         LEADER.set(1.0 if self._leader else 0.0, identity=self.identity)
         if self._leader:
-            self._renewed_at = self._now()
+            self._renewed_at = pre
         if self._leader and not was:
             log.info("%s acquired leadership (%s)", self.identity, self.lease_name)
         elif was and not self._leader:
@@ -94,7 +115,9 @@ class LeaderElector:
         renewDeadline < leaseDuration shape)."""
         if not self._leader or self._renewed_at is None:
             return False
-        if self._now() - self._renewed_at > self.ttl_s * RENEW_DEADLINE_FRACTION:
+        # >=, not >: AT the deadline is already too late to keep writing
+        # (the exact-boundary tie goes to safety, never to the old leader)
+        if self._now() - self._renewed_at >= self.ttl_s * RENEW_DEADLINE_FRACTION:
             self._leader = False
             log.warning(
                 "%s dropping leadership: no successful renew within %.0fs",

@@ -346,7 +346,16 @@ def new_operator(
         )
 
     elector = None
-    if options.leader_elect:
+    if options.shard_elect:
+        # horizontally sharded control plane: per-partition leases with
+        # fenced writes (operator/sharding.py); N replicas built over one
+        # shared cluster store each wire their own ShardElector
+        from .sharding import ShardElector
+
+        elector = ShardElector(
+            cloud, cluster, identity=options.leader_identity, clock=clock
+        )
+    elif options.leader_elect:
         from .leaderelection import LeaderElector
 
         elector = LeaderElector(

@@ -56,6 +56,11 @@ class Options:
     # shipped deployment.yaml runs 2 replicas behind this flag)
     leader_elect: bool = False
     leader_identity: str = ""                    # "" = hostname + random suffix
+    # horizontally sharded control plane (operator/sharding.py): N
+    # active-active replicas each own a partition of (nodepool, zone)
+    # leases with fenced writes, instead of the all-or-nothing single
+    # leader lease above. Mutually exclusive with --leader-elect.
+    shard_elect: bool = False
     # freeze the startup object graph out of the GC working set (gen-2
     # passes over large pod graphs inject ~100ms spikes into solve p99)
     gc_freeze: bool = True
@@ -107,6 +112,11 @@ class Options:
             raise ValueError(f"ip-family must be ipv4 or ipv6, got {self.ip_family!r}")
         if self.cloud_backend not in ("fake", "aws"):
             raise ValueError(f"unknown cloud backend {self.cloud_backend!r}")
+        if self.leader_elect and self.shard_elect:
+            raise ValueError(
+                "leader-elect and shard-elect are mutually exclusive: the "
+                "sharded lease layer subsumes the single leader lease"
+            )
 
     def gate(self, name: str, default: bool = True) -> bool:
         for pair in self.feature_gates.split(","):

@@ -1,0 +1,547 @@
+"""Sharded control plane: per-partition leases, fencing tokens, ownership.
+
+The single :class:`~.leaderelection.LeaderElector` makes replication
+all-or-nothing: one replica owns every nodepool and a leader loss idles
+the whole fleet for up to a lease TTL. This module generalizes it into a
+**sharded lease layer** (designs/sharded-control-plane.md):
+
+- **Partition leases.** Replicas contend for one lease per cluster
+  partition, keyed on the store's stable ``(nodepool, zone)`` index
+  (``Cluster.partition_key`` — the same key the partitioned encoder
+  chains and the sharded screen/solve already shard by), plus one
+  ``GLOBAL`` lease owning the unpartitioned work: pending-pod
+  provisioning, the host binder, the interruption queue, and any object
+  whose partition cannot be determined.
+- **Fencing tokens.** Every lease carries a monotonic fencing token that
+  bumps on every holder change (``CloudBackend.try_acquire_lease_fenced``;
+  the fake hosts it the way a real control-plane store would). The token
+  is stamped into every cloud-side write a replica makes under that lease
+  (launch via ``LaunchRequest.fence``, terminate via per-id fences), and
+  the store REJECTS any write carrying a token older than the lease's
+  current one — a deposed leader's in-flight writes bounce off the cloud
+  instead of racing the successor (``StaleFencingTokenError``,
+  ``karpenter_fenced_writes_rejected_total``).
+- **Ownership scope.** The :class:`~..controllers.base.Manager` wraps
+  every reconcile in an ambient :func:`scope` carrying the replica's
+  current :class:`Ownership` snapshot. Controllers filter their work
+  through :func:`owns_key` / :func:`owns_claim` / :func:`owns_node` /
+  :func:`owns_global`; with no ambient scope (single-replica deployments,
+  every existing test) the predicates answer True and nothing changes.
+- **Rebalancing + handoff barrier.** Desired ownership is rendezvous
+  (highest-random-weight) hashing of partition keys over the live member
+  set — deterministic, minimal movement on membership change. A replica
+  acquires a partition only once the previous lease has expired (the CAS
+  enforces that) and then ADOPTS the partition's unsettled claims —
+  launched-but-unregistered NodeClaims whose previous owner died mid
+  lifecycle — exactly once, at the acquire edge, extending the
+  pods-bound-once invariant across replicas.
+
+Chaos proves the invariants instead of asserting them:
+``chaos/scenarios/replica-loss.json`` kills / pauses / netsplits a
+replica mid-spot-storm and the ``no-double-launch`` /
+``no-orphaned-claims`` / ``leases-partition-the-fleet`` invariants close
+the run (chaos/invariants.py).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import logging
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..utils.clock import Clock
+
+log = logging.getLogger("karpenter.tpu.sharding")
+
+#: sentinel partition key for the unpartitioned scope (pending pods, the
+#: interruption queue, objects with no resolvable partition)
+GLOBAL_KEY: tuple = ("__global__", "")
+
+LEASE_PREFIX = "karpenter-shard"
+MEMBER_PREFIX = "karpenter-shard-member"
+
+SHARD_TTL_S = 15.0
+# same shape as leaderelection.RENEW_DEADLINE_FRACTION: a replica stops
+# acting on a lease strictly before the lease host would let a contender
+# steal it
+RENEW_DEADLINE_FRACTION = 2.0 / 3.0
+
+
+def lease_name(key: tuple) -> str:
+    return LEASE_PREFIX + "/" + "/".join(str(k) for k in key)
+
+
+def rendezvous_owner(key: tuple, members: list[str]) -> Optional[str]:
+    """Highest-random-weight owner of ``key`` among ``members``:
+    deterministic, and a membership change moves only the partitions the
+    joining/leaving replica wins/loses (minimal reshuffle)."""
+    if not members:
+        return None
+    token = "/".join(str(k) for k in key)
+    return max(
+        members,
+        key=lambda m: (
+            hashlib.sha256(f"{token}@{m}".encode()).hexdigest(), m
+        ),
+    )
+
+
+# -- ambient ownership -------------------------------------------------------
+
+@dataclass(frozen=True)
+class Ownership:
+    """One replica's point-in-time lease holdings: partition key ->
+    fencing token. Immutable — a controller pass runs against the
+    snapshot taken when the pass started, and a snapshot that goes stale
+    mid-pass is exactly what the cloud-side fencing check exists for."""
+
+    replica: str
+    keys: dict = field(default_factory=dict)   # partition key -> token
+
+    def holds(self, key: tuple) -> bool:
+        return key in self.keys
+
+    def fence(self, key: tuple) -> Optional[tuple]:
+        """(lease name, token) for stamping a write sanctioned by
+        ``key``'s lease; None when this replica does not hold it."""
+        token = self.keys.get(key)
+        if token is None:
+            return None
+        return (lease_name(key), token)
+
+
+_AMBIENT = threading.local()
+
+
+@contextlib.contextmanager
+def scope(ownership: Optional[Ownership]):
+    """Ambient ownership for the current thread (the Manager enters this
+    around every reconcile when a ShardElector is wired)."""
+    prev = getattr(_AMBIENT, "own", None)
+    _AMBIENT.own = ownership
+    try:
+        yield ownership
+    finally:
+        _AMBIENT.own = prev
+
+
+def current() -> Optional[Ownership]:
+    return getattr(_AMBIENT, "own", None)
+
+
+@contextlib.contextmanager
+def sanction(key: Optional[tuple]):
+    """Name the partition lease sanctioning the cloud writes inside this
+    block (e.g. a consolidation replacement launch is sanctioned by the
+    OLD node's partition lease, wherever the new node lands)."""
+    prev = getattr(_AMBIENT, "sanction", None)
+    _AMBIENT.sanction = key
+    try:
+        yield
+    finally:
+        _AMBIENT.sanction = prev
+
+
+def owns_global() -> bool:
+    own = current()
+    if own is None:
+        return True
+    return own.holds(GLOBAL_KEY)
+
+
+def owns_key(key: Optional[tuple]) -> bool:
+    """Does this replica own partition ``key``? ``None`` and keys no
+    elector has contended yet (a brand-new pool/zone's first node) fall
+    to the GLOBAL owner, so no object is orphaned between a partition
+    appearing and its lease being contended."""
+    own = current()
+    if own is None:
+        return True
+    if key is None:
+        return own.holds(GLOBAL_KEY)
+    return own.holds(key) or (
+        key not in _known_keys(own) and own.holds(GLOBAL_KEY)
+    )
+
+
+def _partition_of_claim(cluster, claim) -> Optional[tuple]:
+    """The partition a claim's work routes to: its backing node's router
+    mapping when registered, else the (nodepool, zone-label) pair when the
+    launch pinned a zone, else None (global)."""
+    node_name = getattr(getattr(claim, "status", None), "node_name", "")
+    if node_name:
+        key = cluster.partition_of(node_name)
+        if key is not None:
+            return key
+    from ..models import labels as lbl
+
+    zone = claim.labels.get(lbl.TOPOLOGY_ZONE, "")
+    if zone:
+        return (claim.nodepool_name, zone)
+    return None
+
+
+def owns_claim(cluster, claim) -> bool:
+    own = current()
+    if own is None:
+        return True
+    key = _partition_of_claim(cluster, claim)
+    if key is None:
+        return own.holds(GLOBAL_KEY)
+    return own.holds(key) or (
+        # unleased partition (no replica has contended it yet) falls to
+        # the global owner — checked against the elector's known-key set
+        key not in _known_keys(own) and own.holds(GLOBAL_KEY)
+    )
+
+
+def owns_node(cluster, node) -> bool:
+    own = current()
+    if own is None:
+        return True
+    key = cluster.partition_of(node.name)
+    if key is None:
+        from ..state.cluster import Cluster
+
+        key = Cluster.partition_key(node)
+    return own.holds(key) or (
+        key not in _known_keys(own) and own.holds(GLOBAL_KEY)
+    )
+
+
+def _known_keys(own: Ownership) -> frozenset:
+    return getattr(own, "_known", frozenset())
+
+
+def write_fence(cluster=None, claim=None, key: Optional[tuple] = None):
+    """The (lease name, token) to stamp into a cloud write, resolved from
+    the ambient ownership: an explicit ``key``, the ambient
+    :func:`sanction` key, the claim's partition, or the GLOBAL lease —
+    whichever this replica holds, in that order. ``None`` when no
+    sharding is active (single-replica: writes are unfenced).
+
+    A replica whose snapshot no longer matches the cloud (deposed while a
+    pass was in flight) still stamps its OLD token here — that is the
+    point: the cloud rejects it."""
+    own = current()
+    if own is None:
+        return None
+    candidates = []
+    if key is not None:
+        candidates.append(key)
+    sk = getattr(_AMBIENT, "sanction", None)
+    if sk is not None:
+        candidates.append(sk)
+    if claim is not None and cluster is not None:
+        ck = _partition_of_claim(cluster, claim)
+        if ck is not None:
+            candidates.append(ck)
+    candidates.append(GLOBAL_KEY)
+    for k in candidates:
+        f = own.fence(k)
+        if f is not None:
+            return f
+    # held nothing relevant: stamp the first candidate with a token the
+    # cloud has certainly superseded (explicitly stale — never silent)
+    return (lease_name(candidates[0]), 0)
+
+
+# -- the sharded elector -----------------------------------------------------
+
+class ShardElector:
+    """A controller that contends for per-partition leases and publishes
+    this replica's :class:`Ownership` snapshot.
+
+    Runs as ``Manager.elector``: reconcile = membership heartbeat +
+    rendezvous target computation + acquire/renew/release, exactly one
+    CAS per lease per tick. ``is_leader()`` answers "does this replica
+    own at least one partition within its renew deadline" — the Manager
+    idles every other controller when False (a zero-partition replica is
+    a hot standby), and wraps each reconcile in ``sharding.scope(
+    elector.ownership())`` when True."""
+
+    name = "sharding"
+    interval_s = 2.0
+
+    def __init__(self, cloud, cluster, identity: str, clock: Optional[Clock] = None,
+                 ttl_s: float = SHARD_TTL_S):
+        import socket
+        import uuid
+
+        self.cloud = cloud
+        self.cluster = cluster
+        self.identity = identity or f"{socket.gethostname()}-{uuid.uuid4().hex[:8]}"
+        self.clock = clock
+        self.ttl_s = float(ttl_s)
+        if not 0 < RENEW_DEADLINE_FRACTION < 1:  # pragma: no cover - constant
+            raise ValueError("renew deadline must sit strictly inside the TTL")
+        self._nonce = uuid.uuid4().hex
+        self._lock = threading.Lock()
+        self._held: dict[tuple, int] = {}      # key -> fencing token
+        self._known: frozenset = frozenset()   # every key this pass saw
+        # per-lease date of the last SUCCESSFUL renew (taken BEFORE the
+        # CAS round-trip). An indeterminate renew failure — transport
+        # error, lease-host brownout, netsplit — says nothing about the
+        # lease's state, so the lease stays in the snapshot with its old
+        # date and the renew-deadline check stands it down on time; only
+        # a definitive answer (another holder) drops it immediately.
+        self._renewed: dict[tuple, float] = {}
+        # key -> token at the last adoption: a re-acquire of our own
+        # unchanged tenancy (token never bumped, e.g. healed within the
+        # TTL after a deadline stand-down) must not re-adopt
+        self._adopted: dict[tuple, int] = {}
+        # chaos seam: a netsplit replica's lease RPCs all fail (it keeps
+        # reconciling on its snapshot until the renew deadline lapses)
+        self.partitioned = False
+        self._host_unreachable = False  # edge-triggered outage logging
+        # adoption log: (partition key, claim names) per acquire edge —
+        # the exactly-once evidence the ReplicaSet tests assert on
+        self.adoptions: list[tuple[tuple, tuple]] = []
+        self.rebalances: list[tuple[str, tuple]] = []  # (reason, key)
+
+    # -- clock -------------------------------------------------------------
+    def _now(self) -> float:
+        import time
+
+        return self.clock.now() if self.clock is not None else time.monotonic()
+
+    # -- lease RPCs (all veto-able by the netsplit chaos seam) -------------
+    def _acquire(self, name: str, ttl: Optional[float] = None):
+        if self.partitioned:
+            raise ConnectionError("sharding: netsplit (chaos)")
+        return self.cloud.try_acquire_lease_fenced(
+            name, self.identity, ttl if ttl is not None else self.ttl_s,
+            nonce=self._nonce,
+        )
+
+    def _release(self, name: str) -> None:
+        if self.partitioned:
+            raise ConnectionError("sharding: netsplit (chaos)")
+        self.cloud.release_lease(name, self.identity)
+
+    # -- the reconcile ------------------------------------------------------
+    def reconcile(self) -> None:
+        from ..metrics import SHARD_LEASES_HELD, SHARD_REBALANCES
+
+        pre = self._now()  # pessimistic freshness: time BEFORE the CAS round
+        try:
+            # 1. membership heartbeat + live-member discovery
+            self._acquire(f"{MEMBER_PREFIX}/{self.identity}")
+            members = sorted(
+                name[len(MEMBER_PREFIX) + 1:]
+                for name, (holder, _exp, _nonce) in
+                self.cloud.list_leases(MEMBER_PREFIX + "/").items()
+            )
+        except Exception as e:
+            # membership unknown (API brownout / netsplit): keep renewing
+            # what we hold if we can, but never re-target — rebalancing on
+            # a partial member list would thrash ownership. This is
+            # expected weather, not a crash: the renew deadline stands the
+            # replica down if the outage outlasts it. Logged on the edge
+            # only — a 30s outage must not spam one line per tick.
+            if not self._host_unreachable:
+                self._host_unreachable = True
+                log.warning(
+                    "%s lease-host unreachable (%s: %s); renewing held only",
+                    self.identity, type(e).__name__, e,
+                )
+            self._renew_held_only()
+            return
+        if self._host_unreachable:
+            self._host_unreachable = False
+            log.info("%s lease host reachable again", self.identity)
+        if self.identity not in members:  # pragma: no cover - defensive
+            members.append(self.identity)
+            members.sort()
+
+        # 2. the partition universe: every key the store knows + GLOBAL
+        keys = [GLOBAL_KEY] + list(self.cluster.partition_keys())
+        desired = {
+            k for k in keys if rendezvous_owner(k, members) == self.identity
+        }
+
+        acquired: dict[tuple, int] = {}
+        with self._lock:
+            held = dict(self._held)
+        # 3. voluntary hand-off of partitions rebalanced away from us:
+        # release BEFORE acquiring so a rebalance never transits through
+        # overlap (two holders) — the successor CAS-acquires next tick
+        for k in [k for k in held if k not in desired]:
+            try:
+                self._release(lease_name(k))
+            except Exception:
+                pass  # expiry hands it off anyway
+            held.pop(k, None)
+            with self._lock:
+                self._renewed.pop(k, None)
+            self.rebalances.append(("rebalance", k))
+            SHARD_REBALANCES.inc(reason="rebalance")
+        # 4. renew held + contend for desired
+        for k in sorted(desired, key=lease_name):
+            try:
+                holder, token, nonce = self._acquire(lease_name(k))
+            except Exception:
+                # indeterminate (transport error): a held lease KEEPS its
+                # old renew date and rides toward the renew deadline — the
+                # lease host may still consider us the holder, and the
+                # deadline stands us down strictly before a contender can
+                # get in (renew-failed counts the miss)
+                if k in held:
+                    self.rebalances.append(("renew-failed", k))
+                    SHARD_REBALANCES.inc(reason="renew-failed")
+                continue
+            if holder == self.identity and nonce == self._nonce:
+                if k not in held:
+                    acquired[k] = token
+                    self.rebalances.append(("acquired", k))
+                    SHARD_REBALANCES.inc(reason="acquired")
+                held[k] = token
+                with self._lock:
+                    self._renewed[k] = pre
+            elif k in held:
+                # lost to a contender (e.g. we paused past the TTL) — a
+                # definitive answer, unlike a failed RPC: drop immediately
+                held.pop(k, None)
+                with self._lock:
+                    self._renewed.pop(k, None)
+                self.rebalances.append(("lost", k))
+                SHARD_REBALANCES.inc(reason="lost")
+        with self._lock:
+            self._held = held
+            self._known = frozenset(keys)
+            self._renewed = {k: at for k, at in self._renewed.items() if k in held}
+        SHARD_LEASES_HELD.set(float(len(held)), replica=self.identity)
+        # 5. handoff barrier, adopt side: partitions we JUST acquired may
+        # carry unsettled claims from a dead predecessor — adopt them at
+        # the acquire edge, exactly once per TENANCY (token bump). A
+        # re-acquire of our own unchanged tenancy (healed within the TTL)
+        # keeps the same token and must not re-adopt.
+        for k, token in sorted(acquired.items(), key=lambda kv: lease_name(kv[0])):
+            if self._adopted.get(k) == token:
+                continue
+            self._adopted[k] = token
+            self._adopt(k)
+
+    def _renew_held_only(self) -> None:
+        """Best-effort renew of current holdings when membership discovery
+        failed; never grows the snapshot. An indeterminate per-lease
+        failure keeps the lease on its old renew date (it stands down at
+        the renew deadline, per the failure matrix — one browned-out tick
+        must not idle every partition); a definitive foreign holder drops
+        it immediately."""
+        from ..metrics import SHARD_LEASES_HELD, SHARD_REBALANCES
+
+        pre = self._now()
+        with self._lock:
+            held = dict(self._held)
+        for k in list(held):
+            try:
+                holder, token, nonce = self._acquire(lease_name(k))
+            except Exception:
+                self.rebalances.append(("renew-failed", k))
+                SHARD_REBALANCES.inc(reason="renew-failed")
+                continue
+            if holder == self.identity and nonce == self._nonce:
+                held[k] = token
+                with self._lock:
+                    self._renewed[k] = pre
+            else:
+                held.pop(k, None)
+                with self._lock:
+                    self._renewed.pop(k, None)
+                self.rebalances.append(("lost", k))
+                SHARD_REBALANCES.inc(reason="lost")
+        with self._lock:
+            self._held = held
+        SHARD_LEASES_HELD.set(float(len(held)), replica=self.identity)
+
+    def _adopt(self, key: tuple) -> None:
+        """Adopt a freshly-acquired partition's unsettled claims: every
+        launched-but-unregistered (and every draining) NodeClaim whose
+        lifecycle the previous owner left in flight. The adoption itself
+        is bookkeeping — the successor's registration/liveness/termination
+        controllers pick the claims up because the ownership filter now
+        includes this partition — but it happens exactly once, at the
+        acquire edge, and leaves an audit trail."""
+        unsettled = []
+        for claim in self.cluster.snapshot_claims():
+            if key != GLOBAL_KEY:
+                if _partition_of_claim(self.cluster, claim) != key:
+                    continue
+            else:
+                ck = _partition_of_claim(self.cluster, claim)
+                if ck is not None and ck in self._known:
+                    continue
+            if claim.deleted or (
+                claim.is_launched() and not claim.is_registered()
+            ):
+                unsettled.append(claim.name)
+        self.adoptions.append((key, tuple(sorted(unsettled))))
+        if unsettled:
+            log.info(
+                "%s adopted partition %s with %d unsettled claims: %s",
+                self.identity, key, len(unsettled), unsettled[:4],
+            )
+
+    # -- Manager protocol ---------------------------------------------------
+    def _prune_stale_locked(self) -> None:
+        """Drop every lease whose last successful renew is at or past the
+        renew deadline — a lease we could not renew must leave the
+        snapshot strictly before the lease host would let successors in
+        (the same client-go renewDeadline < leaseDuration shape the
+        single elector uses; the boundary tie goes to safety). Per lease,
+        so one unreachable partition's lease never stands down the rest.
+        Caller holds the lock."""
+        deadline = self.ttl_s * RENEW_DEADLINE_FRACTION
+        now = self._now()
+        for k in [k for k in self._held
+                  if now - self._renewed.get(k, -float("inf")) >= deadline]:
+            self._held.pop(k, None)
+            self._renewed.pop(k, None)
+            log.warning(
+                "%s dropping shard lease %s: no successful renew within %.0fs",
+                self.identity, k, deadline,
+            )
+
+    def is_leader(self) -> bool:
+        """True while this replica owns >= 1 lease renewed inside the
+        renew deadline."""
+        with self._lock:
+            self._prune_stale_locked()
+            return bool(self._held)
+
+    def ownership(self) -> Ownership:
+        """The snapshot the Manager hands to sharding.scope() — leases
+        past their renew deadline are pruned out first."""
+        with self._lock:
+            self._prune_stale_locked()
+            own = Ownership(replica=self.identity, keys=dict(self._held))
+        object.__setattr__(own, "_known", self._known)
+        return own
+
+    def owned_keys(self) -> list[tuple]:
+        with self._lock:
+            self._prune_stale_locked()
+            return sorted(self._held, key=lease_name)
+
+    def release(self) -> None:
+        """Voluntary hand-off of everything (clean shutdown)."""
+        from ..metrics import SHARD_LEASES_HELD
+
+        with self._lock:
+            held = list(self._held)
+            self._held = {}
+            self._renewed = {}
+        for k in held:
+            try:
+                self._release(lease_name(k))
+            except Exception:
+                pass
+        try:
+            self._release(f"{MEMBER_PREFIX}/{self.identity}")
+        except Exception:
+            pass
+        SHARD_LEASES_HELD.set(0.0, replica=self.identity)

@@ -452,11 +452,24 @@ def repack_check(
     return jax.vmap(one)(candidates)
 
 
+#: CPU crossover: past this many nodes the C++ kernel (with its
+#: necessary-condition candidate pre-filter) beats the jitted vmap screen
+#: outright — measured on the smoke trace: a 10k-node 2-sim-hour day is
+#: 57s native vs 350s pure-vmap, while <=500-node days are equivalent.
+#: Deliberately a STATIC threshold, not a measured chooser: the screen
+#: backend lands in provenance (and the fleet report's deterministic
+#: core), so the choice must be a pure function of the problem, never of
+#: wall-clock exploration.
+CPU_SCREEN_NATIVE_N = 1024
+
+
 def _repack_backend(ct: ClusterTensors) -> str:
     """mesh (candidate axis sharded over the devices) on real multi-chip;
-    pallas on single accelerators when the shared blocks fit VMEM; the XLA
-    vmap path otherwise; 'native' (C++) available for JAX-free deployments.
-    KARPENTER_TPU_REPACK=mesh|pallas|vmap|native overrides."""
+    pallas on single accelerators when the shared blocks fit VMEM; on CPU
+    the C++ kernel past ``CPU_SCREEN_NATIVE_N`` nodes (when built) and
+    the ladder-padded XLA vmap path below it / without the build.
+    KARPENTER_TPU_REPACK=mesh|pallas|vmap|native overrides;
+    KARPENTER_TPU_CPU_SCREEN_NATIVE_N moves the CPU crossover."""
     import os
 
     mode = os.environ.get("KARPENTER_TPU_REPACK", "auto")
@@ -465,7 +478,20 @@ def _repack_backend(ct: ClusterTensors) -> str:
     from .repack_pallas import VMEM_BUDGET_BYTES, repack_vmem_bytes
 
     if jax.default_backend() == "cpu":
-        return "vmap"  # interpret mode is for tests, not serving
+        # interpret-mode pallas is for tests, not serving; the real CPU
+        # choice is native-vs-vmap by fleet size (see CPU_SCREEN_NATIVE_N)
+        try:
+            threshold = int(os.environ.get(
+                "KARPENTER_TPU_CPU_SCREEN_NATIVE_N", CPU_SCREEN_NATIVE_N
+            ))
+        except ValueError:
+            threshold = CPU_SCREEN_NATIVE_N
+        if len(ct.node_names) >= threshold:
+            from ..scheduling.native import native_available
+
+            if native_available():
+                return "native"
+        return "vmap"
     if len(jax.devices()) > 1:
         # real multi-chip: D devices screen the candidate axis D-ways
         return "mesh"
@@ -885,11 +911,35 @@ def _screen(ct: ClusterTensors, chunk: int):
         free, requests, gids, gcounts, cap, _n_live = resident
     else:
         residency = residency or "fallback"
-        free = jnp.asarray(ct.free)
-        requests = jnp.asarray(ct.requests)
-        gids = jnp.asarray(gids_s)
-        gcounts = jnp.asarray(gcounts_s)
-        cap = jnp.asarray(screen_cap)
+        # Ladder-pad the host path to the SAME {2^k, 1.5*2^k} node /
+        # pow2 group buckets the device-resident buffers use: the jitted
+        # screen's shapes are then stable under churn. Unpadded, every
+        # wave that changed the group axis re-jitted repack_check
+        # (~270ms/sweep — the re-jit cliff the fleet simulator surfaced,
+        # which used to force the sim onto the native kernel on CPU).
+        # Padding is inert by construction: pad nodes have zero free and
+        # zero cap columns, pad groups zero requests and zero cap rows,
+        # and the mask is only read over the live candidate prefix.
+        from .device_state import _ladder_bucket, _pow2
+
+        G = ct.requests.shape[0]
+        NB = _ladder_bucket(N)
+        GB = _pow2(G, minimum=8)
+        free_h = np.zeros((NB, ct.free.shape[1]), dtype=ct.free.dtype)
+        free_h[:N] = ct.free
+        req_h = np.zeros((GB, ct.requests.shape[1]), dtype=ct.requests.dtype)
+        req_h[:G] = ct.requests
+        gids_h = np.zeros((NB, S), dtype=gids_s.dtype)
+        gids_h[:N] = gids_s
+        gcounts_h = np.zeros((NB, S), dtype=gcounts_s.dtype)
+        gcounts_h[:N] = gcounts_s
+        cap_h = np.zeros((GB, NB), dtype=screen_cap.dtype)
+        cap_h[:G, :N] = screen_cap
+        free = jnp.asarray(free_h)
+        requests = jnp.asarray(req_h)
+        gids = jnp.asarray(gids_h)
+        gcounts = jnp.asarray(gcounts_h)
+        cap = jnp.asarray(cap_h)
     chunks = []
     for start in range(0, N, chunk):
         idx = np.arange(start, min(start + chunk, N), dtype=np.int32)

@@ -62,26 +62,47 @@ def _tags(wire) -> dict[str, str]:
 
 class _LocalLease:
     """Single-process lease host (standalone operator); multi-replica
-    deployments pass a kube-backed delegate instead."""
+    deployments pass a kube-backed delegate instead. Hosts the fenced
+    per-name variant too (operator/sharding.py) with the same semantics
+    as the fake/control-plane store: tokens bump per holder change."""
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._holder = ""
-        self._expiry = 0.0
+        # name -> (holder, expiry, nonce); "" name = the legacy singleton
+        self._leases: dict[str, tuple[str, float, str]] = {}
+        self._tokens: dict[str, int] = {}
 
     def try_acquire(self, name: str, holder: str, ttl_s: float) -> str:
+        return self.try_acquire_fenced(name, holder, ttl_s)[0]
+
+    def try_acquire_fenced(self, name: str, holder: str, ttl_s: float,
+                           nonce: str = "") -> tuple[str, int, str]:
         with self._lock:
             now = time.monotonic()
-            if not self._holder or self._holder == holder or now >= self._expiry:
-                self._holder = holder
-                self._expiry = now + ttl_s
-            return self._holder
+            lease = self._leases.get(name)
+            if lease is None or now >= lease[1] or (
+                lease[0] == holder and lease[2] == nonce
+            ):
+                if lease is None or lease[0] != holder or lease[2] != nonce \
+                        or now >= lease[1]:
+                    self._tokens[name] = self._tokens.get(name, 0) + 1
+                self._leases[name] = (holder, now + ttl_s, nonce)
+                return holder, self._tokens[name], nonce
+            return lease[0], self._tokens.get(name, 0), lease[2]
 
     def release(self, name: str, holder: str) -> None:
         with self._lock:
-            if self._holder == holder:
-                self._holder = ""
-                self._expiry = 0.0
+            lease = self._leases.get(name)
+            if lease is not None and lease[0] == holder:
+                del self._leases[name]
+
+    def list(self, prefix: str = "") -> dict[str, tuple[str, float, str]]:
+        with self._lock:
+            now = time.monotonic()
+            return {
+                name: lease for name, lease in self._leases.items()
+                if name.startswith(prefix) and now < lease[1]
+            }
 
 
 class AwsCloudBackend:
@@ -242,6 +263,13 @@ class AwsCloudBackend:
 
     def try_acquire_lease(self, name: str, holder: str, ttl_s: float) -> str:
         return self._lease.try_acquire(name, holder, ttl_s)
+
+    def try_acquire_lease_fenced(self, name: str, holder: str, ttl_s: float,
+                                 nonce: str = "") -> tuple[str, int, str]:
+        return self._lease.try_acquire_fenced(name, holder, ttl_s, nonce=nonce)
+
+    def list_leases(self, prefix: str = "") -> dict:
+        return self._lease.list(prefix)
 
     def release_lease(self, name: str, holder: str) -> None:
         self._lease.release(name, holder)

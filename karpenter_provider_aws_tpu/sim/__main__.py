@@ -37,6 +37,9 @@ def main(argv=None) -> int:
     p_run.add_argument("--overlay", action="append", default=[],
                        help="chaos overlay as scenario[@at_s[xstretch]], "
                             "e.g. spot-storm@3600 (repeatable)")
+    p_run.add_argument("--replicas", type=int, default=1,
+                       help="control-plane replicas (>= 2 turns on the "
+                            "sharded lease layer; Replica* overlays need it)")
     p_run.add_argument("--report", default="",
                        help="write the fleet-report JSON artifact here")
     p_run.add_argument("--check-determinism", action="store_true",
@@ -77,7 +80,7 @@ def main(argv=None) -> int:
 
     if args.cmd == "run":
         kw = dict(nodes=args.nodes, duration_s=duration,
-                  overlays=list(args.overlay))
+                  overlays=list(args.overlay), replicas=args.replicas)
         if args.check_determinism:
             try:
                 reports = run_deterministic(

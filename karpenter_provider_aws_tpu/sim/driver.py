@@ -79,7 +79,8 @@ class FleetSimulator:
                  duration_s: Optional[float] = None,
                  overlays: Optional[list] = None,
                  use_tpu_solver: bool = False,
-                 check_invariants: bool = True):
+                 check_invariants: bool = True,
+                 replicas: int = 1):
         spec = canned_trace(trace) if isinstance(trace, str) else trace
         # private clone (data round-trip): overlay fault instances carry
         # per-run fire state, exactly like chaos scenarios
@@ -95,7 +96,22 @@ class FleetSimulator:
             ]
         self.seed = int(seed)
         self.check_invariants = check_invariants
-        self.env = new_environment(use_tpu_solver=use_tpu_solver)
+        # multi-replica mode: N in-process control-plane replicas over one
+        # FakeClock/cluster/cloud, partition leases live (Replica* chaos
+        # overlays drive the kill/pause/netsplit seams)
+        self.replicas = int(replicas)
+        if self.replicas > 1:
+            from ..testenv import new_replicaset
+
+            self.env = new_replicaset(self.replicas,
+                                      use_tpu_solver=use_tpu_solver)
+        else:
+            self.env = new_environment(use_tpu_solver=use_tpu_solver)
+        # replica-loss recovery: armed by a Replica* overlay activation,
+        # resolved at the first pass where every partition key has an
+        # effective owner again — the gate thresholds the worst case
+        self._loss_at: Optional[float] = None
+        self.replica_recoveries: list[float] = []
         # sub-tick SLI stamps: cap stays under the smallest driver advance
         # (burst_step_s), so interpolation never crosses a tick
         self.env.clock.enable_subtick(
@@ -321,6 +337,12 @@ class FleetSimulator:
             self._scan_provenance()
         self.passes += 1
         SIM_PASSES.inc()
+        if self._loss_at is not None and hasattr(self.env, "partition_gap"):
+            if not self.env.partition_gap():
+                self.replica_recoveries.append(
+                    round(self.env.clock.now() - self._loss_at, 3)
+                )
+                self._loss_at = None
 
     def _probe(self) -> None:
         self.probe_calls += 1
@@ -337,6 +359,12 @@ class FleetSimulator:
         at the heartbeat width and burning the time-to-ready SLO on a
         pure simulation artifact."""
         env = self.env
+        if self._loss_at is not None:
+            # replica-loss recovery in flight: keep micro-stepping so the
+            # survivors' electors cross the lease TTL at burst resolution
+            # — otherwise the recovery stopwatch quantizes at the
+            # heartbeat width and the failover looks slower than it is
+            return False
         if env.cluster.pending_pods():
             return False
         for c in env.cluster.nodeclaims.values():
@@ -430,6 +458,9 @@ class FleetSimulator:
 
         self.active.append(tf)
         SIM_EVENTS.inc(kind="overlay-activate")
+        if tf.fault.kind.startswith("Replica") and self._loss_at is None:
+            # arm the recovery stopwatch at the loss edge
+            self._loss_at = self.env.clock.now()
         self.log.record(
             t=self.env.clock.now(), kind=tf.fault.kind, service="timeline",
             action="activate", detail=tf.fault.describe(),
@@ -506,19 +537,14 @@ class FleetSimulator:
         spec = self.trace
         agg = SpanAggregator()
         TRACER.on_finish(agg)
-        # CPU runs serve the consolidation screen from the C++ native
-        # kernel: the auto heuristic's vmap path re-jits every time churn
-        # changes the group axis (~270ms per sweep — the recompile cliff
-        # this simulator itself surfaced), which is a JAX artifact, not
-        # control-plane cost. An explicit KARPENTER_TPU_REPACK always wins.
+        # The simulator used to pin KARPENTER_TPU_REPACK=native on CPU
+        # because the auto-selected vmap screen re-jitted (~270ms/sweep)
+        # whenever churn changed the group axis. The host vmap path now
+        # ladder-pads its group/slot/node axes to the same pow2 ladder the
+        # device-resident buffers use (ops/consolidate.py `_screen`), so
+        # jitted shapes are churn-stable and the pin is gone — the sim
+        # measures whatever backend the repack heuristic really picks.
         screen_pin = contextlib.nullcontext()
-        if os.environ.get("KARPENTER_TPU_REPACK") is None:
-            from ..ops.consolidate import force_repack_backend
-            from ..scheduling.native import native_available
-
-            if provenance.device_info()[0] in ("host", "cpu") \
-                    and native_available():
-                screen_pin = force_repack_backend("native")
         # byte-identical-per-seed contract: multi-spec launches must not
         # race worker threads over claim names / event order / capacity
         # pool draws (restored after the run)
@@ -598,8 +624,19 @@ class FleetSimulator:
                 # in-flight lifecycle transitions until the next heartbeat.
                 self._pass()
                 extra = 0
-                while extra < spec.burst_passes and not self._quiesced():
-                    self._advance(spec.burst_step_s)
+                max_extra = spec.burst_passes
+                while extra < max_extra and not self._quiesced():
+                    step = spec.burst_step_s
+                    if self._loss_at is not None:
+                        # replica-loss recovery: cross the lease TTL at
+                        # fine resolution (the stopwatch would otherwise
+                        # quantize at burst_step_s) — bounded so a
+                        # non-recovering lease layer cannot spin the day
+                        step = min(step, 2.0)
+                        max_extra = max(
+                            max_extra, spec.burst_passes + 12
+                        )
+                    self._advance(step)
                     self._pass()
                     extra += 1
                 if m["sample"]:

@@ -262,6 +262,25 @@ def build_report(sim, span_profile: dict, deltas: dict) -> FleetReport:
         },
         "invariants": invariants,
     }
+    if getattr(sim, "replicas", 1) > 1:
+        # sharded-control-plane plane (all virtual-time: deterministic,
+        # inside the signature): per-replica lease holdings, the audited
+        # overlap list (must be empty), and replica-loss recovery times
+        env_rs = sim.env
+        with env_rs.cloud._lock:
+            fenced_rejections = len(env_rs.cloud.fenced_rejections)
+        virtual["sharding"] = {
+            "replicas": sim.replicas,
+            "alive": sum(1 for r in env_rs.replicas if r.alive),
+            "leases_held": {
+                r.identity: len(r.elector.owned_keys())
+                for r in env_rs.replicas
+            },
+            "lease_overlaps": len(env_rs.lease_overlaps),
+            "partition_gap_end": len(env_rs.partition_gap()),
+            "fenced_writes_rejected": fenced_rejections,
+            "replica_loss_recoveries_s": list(sim.replica_recoveries),
+        }
 
     wall_ms = sim.driver_wall_s * 1e3
     root_ms = sum(
@@ -310,6 +329,14 @@ def build_report(sim, span_profile: dict, deltas: dict) -> FleetReport:
         "invariants_failed": sum(1 for r in invariants if not r["passed"]),
         "attribution_coverage": coverage,
     }
+    if getattr(sim, "replicas", 1) > 1:
+        sharding = virtual["sharding"]
+        gate["replica_loss_recovery_s"] = (
+            max(sharding["replica_loss_recoveries_s"])
+            if sharding["replica_loss_recoveries_s"] else None
+        )
+        gate["lease_overlaps"] = sharding["lease_overlaps"]
+        gate["partition_gap_end"] = sharding["partition_gap_end"]
 
     return FleetReport(data={
         "schema": SCHEMA_VERSION,

@@ -121,10 +121,275 @@ def seed_instance(cloud: FakeCloud, *, instance_id: str, instance_type: str,
         id=instance_id, instance_type=instance_type, zone=zone,
         capacity_type=capacity_type, image_id=image_id,
         launch_time=launch_time, tags=dict(tags or {}),
+        # sentinel fence: a harness-seeded fleet predates the lease layer
+        # by construction; the no-double-launch invariant exempts it
+        launch_fence=("__seeded__", 0),
     )
     with cloud._lock:
         cloud.instances[inst.id] = inst
     return inst
+
+
+@dataclass
+class Replica:
+    """One control-plane replica of a :class:`ReplicaSetEnv`: its own
+    controllers + Manager + ShardElector over the SHARED world."""
+
+    identity: str
+    manager: Manager
+    elector: object
+    cloudprovider: CloudProvider
+    provisioning: ProvisioningController
+    alive: bool = True
+    paused: bool = False
+    # ownership snapshot captured at pause time — the "in-flight work" a
+    # resumed (GC-paused / live-migrated) process acts on before its
+    # elector refreshes; the fencing layer exists to reject exactly this
+    stale_ownership: object = None
+
+
+class _ManagerView:
+    """Duck-types the single Environment's ``manager`` for harnesses that
+    read ``env.manager.errors`` (chaos invariants) across every replica."""
+
+    def __init__(self, rs: "ReplicaSetEnv"):
+        self._rs = rs
+
+    @property
+    def errors(self):
+        out = []
+        for r in self._rs.replicas:
+            out.extend(r.manager.errors)
+        return out
+
+
+@dataclass
+class ReplicaSetEnv:
+    """N active-active control-plane replicas over ONE shared world (the
+    N-replicas-one-apiserver shape): one FakeClock, FakeCloud, queue,
+    catalog, cluster store, event recorder, and obs bundle; per replica
+    its own controllers, Manager, and ShardElector contending for the
+    partition leases (operator/sharding.py). Duck-types ``Environment``
+    closely enough that the chaos harness and fleet simulator drive it
+    unchanged.
+
+    ``step()`` runs each live replica's deterministic pass in index order
+    and then audits the lease layer: any EFFECTIVE-ownership overlap
+    between two replicas is appended to ``lease_overlaps`` (the
+    leases-partition-the-fleet invariant must find it empty), and the
+    current unowned-partition count lands in ``coverage_history`` so a
+    driver can measure recovery time after a replica loss."""
+
+    clock: FakeClock
+    cloud: FakeCloud
+    queue: FakeQueue
+    catalog: CatalogProvider
+    cluster: Cluster
+    replicas: "list[Replica]"
+    events: "EventRecorder"
+    obs: object
+    nodeclass_status: NodeClassStatusController
+    nodeclass_hash: NodeClassHashController
+
+    def __post_init__(self):
+        self.manager = _ManagerView(self)
+        self.lease_overlaps: list = []
+        self.coverage_history: list = []
+
+    # -- Environment duck type ---------------------------------------------
+    @property
+    def cloudprovider(self) -> CloudProvider:
+        return self.replicas[0].cloudprovider
+
+    @property
+    def provisioning(self) -> ProvisioningController:
+        return self.replicas[0].provisioning
+
+    def close(self) -> None:
+        for r in self.replicas:
+            r.cloudprovider.close()
+
+    def apply_defaults(self, nodepool: Optional[NodePool] = None):
+        nodeclass = NodeClass(name="default", role="node-role")
+        pool = nodepool or NodePool(name="default")
+        self.cluster.apply(nodeclass)
+        self.cluster.apply(pool)
+        self.nodeclass_status.reconcile()
+        self.nodeclass_hash.reconcile()
+        return pool, nodeclass
+
+    def step(self, n: int = 1) -> None:
+        for _ in range(n):
+            for r in self.replicas:
+                if r.alive and not r.paused:
+                    r.manager.reconcile_all_once()
+            self._audit_leases()
+
+    # -- lease-layer audit ----------------------------------------------------
+    def ownership_map(self) -> dict:
+        """partition key -> [identities with EFFECTIVE ownership] (live
+        replicas only; effective = inside the renew deadline)."""
+        out: dict = {}
+        for r in self.replicas:
+            if not (r.alive and not r.paused):
+                continue
+            for key in r.elector.ownership().keys:
+                out.setdefault(key, []).append(r.identity)
+        return out
+
+    def partition_gap(self) -> list:
+        """Partition keys (incl. GLOBAL) with no effective owner."""
+        from .operator.sharding import GLOBAL_KEY
+
+        owned = set(self.ownership_map())
+        keys = [GLOBAL_KEY] + list(self.cluster.partition_keys())
+        return [k for k in keys if k not in owned]
+
+    def _audit_leases(self) -> None:
+        owners = self.ownership_map()
+        for key, who in owners.items():
+            if len(who) > 1:
+                self.lease_overlaps.append(
+                    (round(self.clock.now(), 3), key, tuple(sorted(who)))
+                )
+        self.coverage_history.append(
+            (round(self.clock.now(), 3), len(self.partition_gap()))
+        )
+
+    # -- replica failure controls (the chaos seams) ---------------------------
+    def _replica(self, i: int) -> Replica:
+        return self.replicas[i]
+
+    def crash(self, i: int) -> None:
+        """Kill replica ``i`` outright: it stops reconciling and renewing;
+        its leases (and membership) expire after the TTL."""
+        self._replica(i).alive = False
+
+    def restart(self, i: int) -> None:
+        """Rejoin replica ``i`` as a FRESH process with the same identity:
+        empty lease snapshot, new elector nonce (a restarted pod is a new
+        holder instance — the nonce keeps a stale twin fenced out)."""
+        import uuid
+
+        r = self._replica(i)
+        r.alive = True
+        r.paused = False
+        el = r.elector
+        with el._lock:
+            el._held = {}
+            el._renewed = {}
+        el._nonce = uuid.uuid4().hex
+        el.partitioned = False
+
+    def pause(self, i: int) -> None:
+        """Stop-the-world pause (GC, VM migration): the replica freezes
+        mid-flight with its ownership snapshot intact."""
+        r = self._replica(i)
+        r.paused = True
+        r.stale_ownership = r.elector.ownership()
+
+    def resume(self, i: int, stale_pass: bool = True) -> None:
+        """Resume a paused replica. With ``stale_pass`` (the default) its
+        controllers run ONE pass under the ownership snapshot captured at
+        pause time, BEFORE the elector refreshes — exactly the in-flight
+        writes a real deposed leader would have racing the successor.
+        Past the TTL those writes carry superseded fencing tokens and the
+        cloud rejects them (karpenter_fenced_writes_rejected_total)."""
+        from .operator import sharding
+
+        r = self._replica(i)
+        r.paused = False
+        own = r.stale_ownership
+        r.stale_ownership = None
+        if stale_pass and own is not None and own.keys:
+            with sharding.scope(own):
+                for c in r.manager.controllers:
+                    if c is r.manager.elector:
+                        continue
+                    try:
+                        c.reconcile()
+                    except Exception as e:  # isolation, like the Manager
+                        r.manager.errors.append((c.name, e))
+
+    def netsplit(self, i: int) -> None:
+        """Partition replica ``i`` from the lease host only: it keeps
+        reconciling on its snapshot until the renew deadline lapses."""
+        self._replica(i).elector.partitioned = True
+
+    def heal(self, i: int) -> None:
+        self._replica(i).elector.partitioned = False
+
+
+def new_replicaset(n: int = 2, use_tpu_solver: bool = False,
+                   zones=None, ttl_s: float = 15.0) -> ReplicaSetEnv:
+    """N-replica hermetic control plane over one shared world (see
+    :class:`ReplicaSetEnv`). Replica identities are ``replica-0..n-1`` —
+    stable, so rendezvous hashing (and with it every chaos/sim run) is
+    deterministic per seed."""
+    from .operator.sharding import ShardElector
+    from .resilience import breakers, faultgate
+
+    clock = FakeClock()
+    breakers.configure(clock=clock)
+    faultgate.clear()
+    cloud = FakeCloud(clock=clock, **({"zones": zones} if zones else {}))
+    queue = FakeQueue()
+    catalog = CatalogProvider(clock=clock, **({"zones": zones} if zones else {}))
+    cluster = Cluster(clock=clock)
+    recorder = EventRecorder(clock=clock)
+    from . import obs as obs_mod
+
+    obs_bundle = obs_mod.install(cluster=cluster, recorder=recorder, clock=clock)
+    replicas: list[Replica] = []
+    first_status = first_hash = None
+    for i in range(n):
+        identity = f"replica-{i}"
+        cloudprovider = CloudProvider(
+            cloud, catalog, cluster, clock=clock,
+            batcher_options=BatcherOptions(idle_timeout_s=0.001, max_timeout_s=0.05),
+        )
+        solver = TPUSolver() if use_tpu_solver else HostSolver()
+        provisioning = ProvisioningController(
+            cluster, solver, cloudprovider, recorder=recorder, obs=obs_bundle,
+        )
+        scheduling = SchedulingController(cluster, provisioning, clock=clock)
+        registration = RegistrationController(cluster, provisioning, clock=clock)
+        termination = TerminationController(cluster, cloudprovider, clock=clock)
+        disruption = DisruptionController(
+            cluster, cloudprovider, clock=clock, provisioning=provisioning,
+            recorder=recorder, validation_period_s=0.0, obs=obs_bundle,
+        )
+        interruption = InterruptionController(
+            cluster, cloudprovider, queue, recorder=recorder, obs=obs_bundle,
+        )
+        gc = GarbageCollectionController(cluster, cloudprovider, clock=clock)
+        liveness = LivenessController(cluster, clock=clock, recorder=recorder,
+                                      obs=obs_bundle)
+        tagging = TaggingController(cluster, cloudprovider)
+        nc_hash = NodeClassHashController(cluster)
+        nc_status = NodeClassStatusController(cluster, cloudprovider)
+        nc_term = NodeClassTerminationController(cluster, cloudprovider)
+        if i == 0:
+            first_status, first_hash = nc_status, nc_hash
+        elector = ShardElector(cloud, cluster, identity=identity, clock=clock,
+                               ttl_s=ttl_s)
+        manager = Manager(
+            [
+                nc_status, nc_hash, interruption, termination, registration,
+                scheduling, provisioning, tagging, disruption, gc, liveness,
+                nc_term,
+            ],
+            elector=elector, clock=clock, recorder=recorder,
+        )
+        replicas.append(Replica(
+            identity=identity, manager=manager, elector=elector,
+            cloudprovider=cloudprovider, provisioning=provisioning,
+        ))
+    return ReplicaSetEnv(
+        clock=clock, cloud=cloud, queue=queue, catalog=catalog,
+        cluster=cluster, replicas=replicas, events=recorder, obs=obs_bundle,
+        nodeclass_status=first_status, nodeclass_hash=first_hash,
+    )
 
 
 def new_environment(solver: Optional[Solver] = None, use_tpu_solver: bool = True,

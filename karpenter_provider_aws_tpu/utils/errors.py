@@ -49,6 +49,24 @@ class RateLimitedError(CloudError):
     code = "RequestLimitExceeded"
 
 
+class StaleFencingTokenError(CloudError):
+    """A fenced write carried a token older than its lease's current
+    tenancy: the writer was deposed (crash, pause past the TTL, netsplit)
+    after planning the write, and the control-plane store rejects it
+    instead of letting it race the successor replica
+    (operator/sharding.py; designs/sharded-control-plane.md)."""
+
+    code = "StaleFencingToken"
+
+
+def is_stale_fence(err: Exception) -> bool:
+    """A deposed replica's sanctioned write bounced off the store. The
+    correct response is always "stand down quietly": the partition's new
+    owner carries the work forward, so callers log and skip rather than
+    crash-loop the reconcile."""
+    return isinstance(err, CloudError) and err.code == StaleFencingTokenError.code
+
+
 _NOT_FOUND_CODES = {
     "InvalidInstanceID.NotFound",
     "InvalidLaunchTemplateName.NotFoundException",

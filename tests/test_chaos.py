@@ -341,8 +341,8 @@ class TestCloudHooks:
 class TestScenarioPlans:
     def test_canned_scenarios_ship(self):
         assert list_canned() == [
-            "api-brownout", "eventual-consistency", "solver-brownout",
-            "spot-storm", "sts-outage",
+            "api-brownout", "eventual-consistency", "replica-loss",
+            "solver-brownout", "spot-storm", "sts-outage",
         ]
 
     def test_scenario_json_round_trip(self):
@@ -605,3 +605,47 @@ class TestUnavailableEntriesAndGauge:
         for t in threads:
             t.join(timeout=5.0)
         assert not errors
+
+
+# ---------------------------------------------------------------------------
+# sharded control plane: the replica-loss scenario (PR 9 tentpole proof)
+# ---------------------------------------------------------------------------
+
+class TestReplicaLossScenario:
+    def test_invariants_and_fencing(self, reports):
+        r = reports["replica-loss"]
+        assert r.passed, r.summary()
+        by_name = {i.name: i for i in r.invariants}
+        # the three sharded-lease invariants ran FOR REAL (not the
+        # single-replica n/a skip) and passed
+        for name in ("no-double-launch", "no-orphaned-claims",
+                     "leases-partition-the-fleet"):
+            assert by_name[name].passed, by_name[name]
+            assert "n/a" not in by_name[name].detail
+        assert r.faults_by_kind.get("ReplicaCrash", 0) >= 1
+        assert r.faults_by_kind.get("ReplicaPause", 0) >= 1
+        assert r.faults_by_kind.get("ReplicaNetsplit", 0) >= 1
+
+    def test_single_replica_scenarios_skip_lease_invariants(self, reports):
+        by_name = {i.name: i for i in reports["spot-storm"].invariants}
+        assert by_name["no-double-launch"].passed
+        assert "n/a" in by_name["no-double-launch"].detail
+
+    def test_replica_faults_require_multi_replica_scenario(self):
+        """A Replica* fault dropped into a single-replica scenario must
+        fail LOUDLY at activation, not silently no-op."""
+        from karpenter_provider_aws_tpu.chaos.faults import ReplicaCrash
+
+        class FakeHarness:
+            env = object()  # a plain Environment: no crash/restart seams
+
+        with pytest.raises(ValueError, match="replicas"):
+            ReplicaCrash(replica=0).on_activate(FakeHarness())
+
+    def test_replica_loss_same_seed_byte_identical(self):
+        """Seeded chaos e2e for lease adoption (PR 9 satellite): the
+        crash -> adoption -> re-registration sequence is byte-identical
+        per seed (run_deterministic raises on divergence)."""
+        a, b = run_deterministic("replica-loss", seed=3, runs=2)
+        assert a.signature == b.signature
+        assert a.passed, a.summary()

@@ -216,3 +216,121 @@ class TestRenewDeadline:
         # the lease must still be held: a contender cannot take it
         assert cloud.try_acquire_lease(elector.lease_name, "b", 15.0) == "a"
         release.set()
+
+
+class TestBoundaries:
+    """Clock-skew / exact-TTL-boundary contract (PR 9 satellite): the
+    renew deadline sits STRICTLY inside the TTL, renewals are dated from
+    BEFORE the CAS round-trip, boundary ties go to safety, and identity
+    collisions cannot mint two leaders."""
+
+    def test_exact_renew_deadline_boundary_is_stale(self):
+        clock = FakeClock()
+        cloud = FakeCloud(clock=clock)
+        a = LeaderElector(cloud, identity="a", ttl_s=15.0, clock=clock)
+        a.reconcile()
+        assert a.is_leader()
+        clock.advance(10.0)  # exactly ttl * 2/3
+        assert not a.is_leader()  # AT the deadline is already too late
+
+    def test_just_inside_deadline_still_leader(self):
+        clock = FakeClock()
+        cloud = FakeCloud(clock=clock)
+        a = LeaderElector(cloud, identity="a", ttl_s=15.0, clock=clock)
+        a.reconcile()
+        clock.advance(9.999)
+        assert a.is_leader()
+
+    def test_renewal_dated_before_the_cas_call(self):
+        """A slow lease host must not inflate local freshness: the renew
+        timestamp is captured BEFORE the CAS, so 3s of call latency eats
+        INTO the deadline window instead of extending it."""
+        clock = FakeClock()
+        cloud = FakeCloud(clock=clock)
+
+        class SlowCloud:
+            def try_acquire_lease_fenced(self, name, holder, ttl_s, nonce=""):
+                out = cloud.try_acquire_lease_fenced(name, holder, ttl_s,
+                                                     nonce=nonce)
+                clock.advance(3.0)  # the call itself took 3 virtual secs
+                return out
+
+            def release_lease(self, name, holder):
+                cloud.release_lease(name, holder)
+
+        a = LeaderElector(SlowCloud(), identity="a", ttl_s=15.0, clock=clock)
+        a.reconcile()
+        # 3s already elapsed inside the call; 7s more reaches the 10s
+        # deadline measured from the PRE-call instant
+        clock.advance(7.0)
+        assert not a.is_leader()
+
+    def test_paused_leader_resume_within_ttl_keeps_lease(self):
+        clock = FakeClock()
+        cloud = FakeCloud(clock=clock)
+        a = LeaderElector(cloud, identity="a", ttl_s=15.0, clock=clock)
+        b = LeaderElector(cloud, identity="b", ttl_s=15.0, clock=clock)
+        a.reconcile()
+        clock.advance(9.0)  # paused, but inside the TTL
+        b.reconcile()       # contender cannot steal a live lease
+        assert not b.is_leader()
+        a.reconcile()       # resume: renews its own lease
+        assert a.is_leader() and not b.is_leader()
+
+    def test_paused_leader_resume_past_ttl_no_double_leader(self):
+        clock = FakeClock()
+        cloud = FakeCloud(clock=clock)
+        a = LeaderElector(cloud, identity="a", ttl_s=15.0, clock=clock)
+        b = LeaderElector(cloud, identity="b", ttl_s=15.0, clock=clock)
+        a.reconcile()
+        clock.advance(16.0)     # paused past the TTL
+        assert not a.is_leader()  # local deadline stood it down long ago
+        b.reconcile()
+        assert b.is_leader()
+        a.reconcile()           # resumed leader sees the new holder
+        assert not a.is_leader()
+        assert b.is_leader()
+
+    def test_identity_collision_single_leader(self):
+        """Two elector INSTANCES misconfigured with one identity string:
+        the fenced lease host distinguishes them by nonce, so exactly one
+        leads (the legacy identity-only CAS would have made both
+        leaders — the split-brain this satellite closes)."""
+        clock = FakeClock()
+        cloud = FakeCloud(clock=clock)
+        a1 = LeaderElector(cloud, identity="x", ttl_s=15.0, clock=clock)
+        a2 = LeaderElector(cloud, identity="x", ttl_s=15.0, clock=clock)
+        a1.reconcile()
+        a2.reconcile()
+        leaders = [e for e in (a1, a2) if e.is_leader()]
+        assert len(leaders) == 1
+        # and the twin takes over only after the real holder's TTL lapses
+        clock.advance(16.0)
+        a2.reconcile()
+        assert a2.is_leader() and not a1.is_leader()
+
+    def test_bounded_clock_skew_never_two_leaders(self):
+        """A leader whose local clock runs SLOW under-counts its elapsed
+        time — the renewDeadline margin (2/3 of the TTL) tolerates rate
+        skew up to ttl/deadline = 1.5x. At 25% slow (well inside the
+        bound) the old leader must stand down strictly before the host
+        would let a contender steal."""
+        host_clock = FakeClock()
+        slow_clock = FakeClock()
+        cloud = FakeCloud(clock=host_clock)
+        a = LeaderElector(cloud, identity="a", ttl_s=15.0, clock=slow_clock)
+        b = LeaderElector(cloud, identity="b", ttl_s=15.0, clock=host_clock)
+        a.reconcile()
+        assert a.is_leader()
+        # host time marches to just before expiry; a's clock saw only 75%
+        for _ in range(15):
+            host_clock.advance(0.999)
+            slow_clock.advance(0.749)
+            b.reconcile()
+            # never two leaders at any observation
+            assert not (a.is_leader() and b.is_leader())
+        # past expiry on the host: b steals; a's local deadline (10s at
+        # 0.75 rate = 13.3 host secs < 15) already stood it down
+        host_clock.advance(0.1)
+        b.reconcile()
+        assert b.is_leader() and not a.is_leader()

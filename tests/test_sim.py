@@ -481,3 +481,58 @@ class TestAcceptance10k:
                     tiers=[50, 100], seed=0)
         assert len(out["tiers"]) == 2
         assert all("wall_per_sim_hour_s" in r for r in out["tiers"])
+
+
+# ---------------------------------------------------------------------------
+# sharded control plane: multi-replica simulated days (PR 9)
+# ---------------------------------------------------------------------------
+
+class TestMultiReplicaSim:
+    def test_two_replica_day_with_replica_loss_overlay(self):
+        spec = tiny_trace(nodes=50, duration_s=1200.0, settle_reconciles=25)
+        report = run_trace(
+            TraceSpec.from_dict(spec.to_dict()), seed=9, replicas=2,
+            overlays=["replica-loss@300"],
+        )
+        inv = {r["name"]: r for r in report.data["virtual"]["invariants"]}
+        for name in ("no-double-launch", "no-orphaned-claims",
+                     "leases-partition-the-fleet"):
+            assert inv[name]["passed"], inv[name]
+            assert "n/a" not in inv[name]["detail"]
+        sharding = report.data["virtual"]["sharding"]
+        assert sharding["replicas"] == 2
+        assert sharding["lease_overlaps"] == 0
+        assert sharding["partition_gap_end"] == 0
+        # ownership recovered within one lease TTL (15s) + the 2s burst
+        # measurement quantum of the first replica kill
+        rec = report.gate["replica_loss_recovery_s"]
+        assert rec is not None and rec <= 17.0, rec
+
+    def test_two_replica_same_seed_byte_identical(self):
+        spec = tiny_trace(nodes=40, duration_s=900.0, settle_reconciles=20)
+        r1 = run_trace(TraceSpec.from_dict(spec.to_dict()), seed=13, replicas=2)
+        r2 = run_trace(TraceSpec.from_dict(spec.to_dict()), seed=13, replicas=2)
+        assert r1.signature() == r2.signature()
+
+    def test_two_replica_day_matches_single_replica_envelope(self):
+        """Acceptance: a 2-replica simulated day matches the
+        single-replica run's packing/cost envelope — sharding the control
+        plane must not change WHAT the controllers decide, only who runs
+        them."""
+        spec = tiny_trace(nodes=50, duration_s=1200.0, settle_reconciles=25)
+        solo = run_trace(TraceSpec.from_dict(spec.to_dict()), seed=21)
+        duo = run_trace(TraceSpec.from_dict(spec.to_dict()), seed=21,
+                        replicas=2)
+        g1, g2 = solo.gate, duo.gate
+        assert g2["pending_end"] == g1["pending_end"] == 0
+        assert g2["unschedulable_total"] == g1["unschedulable_total"] == 0
+        assert g2["invariants_failed"] == 0
+        # packing envelope within 10% of the single-replica day
+        assert g1["packing_eff_min"] is not None
+        assert abs(g2["packing_eff_min"] - g1["packing_eff_min"]) <= 0.10
+        # cost-vs-oracle envelope (when both sampled)
+        if g1["cost_vs_oracle_p95"] is not None and \
+                g2["cost_vs_oracle_p95"] is not None:
+            assert abs(g2["cost_vs_oracle_p95"] - g1["cost_vs_oracle_p95"]) <= 0.1
+        # the same workload bound (every pod the trace handed in bound)
+        assert g2["bind_count"] >= 0.9 * g1["bind_count"]
