@@ -4,7 +4,7 @@
 
 PYTEST ?= python -m pytest
 
-.PHONY: presubmit test deflake stress e2etests benchmark interruption-bench verify multichip native soak sidecar-client sim-smoke sim-sweep chaos-smoke sim-replica-smoke
+.PHONY: presubmit test deflake stress e2etests benchmark interruption-bench verify multichip native soak sidecar-client sim-smoke sim-sweep sim-cliff-smoke bench-gate chaos-smoke sim-replica-smoke
 
 presubmit: test multichip  ## everything CI gates on
 
@@ -57,6 +57,14 @@ sim-smoke:  ## 500-node 2-simulated-hour fleet run under the SLO regression gate
 sim-sweep:  ## scale-tier ladder + cliff detector (slow; SIM_TIERS overrides)
 	JAX_PLATFORMS=cpu python -m karpenter_provider_aws_tpu.sim sweep \
 		--trace smoke --seed 0 --tiers $${SIM_TIERS:-500,1000,2000}
+
+sim-cliff-smoke:  ## small tier pair through the cliff detector — zero findings required
+	JAX_PLATFORMS=cpu python -m karpenter_provider_aws_tpu.sim sweep \
+		--trace smoke --seed 0 --tiers 300,600
+
+bench-gate:  ## steady-state perf budgets (config9 tick + disruption quiet pass) vs measured rows
+	python tools/bench_gate.py BENCH_DETAIL.jsonl \
+		--budgets benchmarks/baselines/steady-state.json
 
 chaos-smoke:  ## every canned chaos scenario (incl. replica-loss), run twice, determinism diffed
 	JAX_PLATFORMS=cpu python -m karpenter_provider_aws_tpu.chaos --all --seed 0

@@ -521,6 +521,23 @@ def child_device_state() -> None:
         run_device_state(scale=scale, on_row=on_row)
 
 
+def child_disruption() -> None:
+    """Disruption quiet-pass rows: the dirty-set sweep vs the legacy full
+    O(claims) walk (controllers/disruption.py _DirtyScan). Pure host
+    control-loop wall — the evidence row for the steady-state O(dirty)
+    claim, like the PR 9 liveness/registration rows."""
+    import contextlib
+
+    _force_cpu_if_asked()
+
+    from benchmarks.disruption_bench import run_all as run_disruption
+
+    scale = float(os.environ.get("BENCH_DISRUPTION_SCALE", "1.0"))
+    on_row = _detail_writer({"run_at_unix": int(time.time()), "scale": scale})
+    with contextlib.redirect_stdout(sys.stderr):
+        run_disruption(scale=scale, on_row=on_row)
+
+
 def child_scale() -> None:
     """config9 scale-tier row: partitioned encode + lanes solve + merge at
     100k nodes (benchmarks/scale_bench.py). Heavy — runs in its own
@@ -751,6 +768,14 @@ def main() -> None:
         )
         if err:
             errors.append(err)
+        # disruption quiet-pass rows: dirty-set sweep vs full O(claims)
+        # walk (host control loop; the steady-state evidence row)
+        _, err = run_child(
+            "disruption", min(300.0, _remaining() - SAFETY_MARGIN_S),
+            env_extra={"BENCH_FORCE_CPU": "1"},
+        )
+        if err:
+            errors.append(err)
         # fleet-simulator rows: a simulated day's wall + SLO gate metrics
         # at two fleet sizes (sim/; host solver + native screen)
         _, err = run_child(
@@ -868,7 +893,8 @@ if __name__ == "__main__":
                 {"host": child_host, "measure": child_measure,
                  "configs": child_configs, "multichip": child_multichip,
                  "encode": child_encode, "scale": child_scale,
-                 "device_state": child_device_state, "sim": child_sim}[child]()
+                 "device_state": child_device_state, "sim": child_sim,
+                 "disruption": child_disruption}[child]()
             except Exception as e:
                 traceback.print_exc()
                 if child == "measure":

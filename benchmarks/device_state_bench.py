@@ -205,10 +205,26 @@ def bench_chained_dispatch(n_nodes=2000, iters=15) -> dict:
         gc.unfreeze()
 
     assert (mask_resident == mask_host).all(), "residency changed the answer"
+    # feed the measured best-case costs through the REAL serving chooser:
+    # the row carries what an unpinned reconcile at this bucket would run
+    # (the 2k-node inversion regression — chained measured slower there,
+    # so the chooser must answer "unchained")
+    from karpenter_provider_aws_tpu.ops.device_state import (
+        note_screen_cost,
+        pick_chained,
+        reset_chained_costs,
+    )
+
+    reset_chained_costs()
+    note_screen_cost(n_nodes, True, float(min(chained)))
+    note_screen_cost(n_nodes, False, float(min(unchained)))
+    chooser_picks = "chained" if pick_chained(n_nodes) else "unchained"
+    reset_chained_costs()
     return {
         "benchmark": f"device_state_chained_{n_nodes}node_screen",
         "nodes": n_nodes,
         "iters": iters,
+        "chooser_picks": chooser_picks,
         "chained_p50_ms": round(float(np.percentile(chained, 50)), 3),
         "chained_p99_ms": round(float(np.percentile(chained, 99)), 3),
         # host-blocked time per chained sweep: everything past this runs

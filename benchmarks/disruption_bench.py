@@ -1,0 +1,140 @@
+"""Disruption quiet-pass benchmark: the dirty-set sweep vs the full walk.
+
+PR 9's liveness/registration ``_watched_claims`` pair killed the per-claim
+Python floor for two controllers; the disruption controller inherited the
+same change-journal pattern (controllers/disruption.py ``_DirtyScan``):
+expiration rides a deadline heap, drift a pending set, emptiness the
+empty-node set, and consolidation a quiet-pass memo on the incremental
+encoder's identical-emission guarantee. These rows pin the claim with
+numbers the way every other perf win here is pinned:
+
+ - ``dirty_p50_ms``   — a QUIET pass (no store mutation since the last
+   reconcile) through the full reconcile() with the dirty path on. This is
+   what a steady-state controller tick pays per 10s interval.
+ - ``churn_p50_ms``   — a pass after ~0.1% pod churn (O(dirty) work).
+ - ``full_p50_ms``    — the same quiet pass with
+   KARPENTER_TPU_DISRUPTION_DIRTY=0 (the legacy O(claims) walk; its
+   ``_scan_cache`` still serves the pod views, so this measures exactly
+   the per-claim condition loop the dirty path removes).
+ - ``decisions_equal`` — both paths disrupted the same (empty) set during
+   the measured quiet window.
+
+The fleet is a realistic steady state: consolidation enabled with the
+quiet window not yet elapsed (nodes saw pods recently), expiration armed
+but far out, drift enabled with nothing drifted.
+"""
+
+from __future__ import annotations
+
+import gc
+import json
+import os
+import time
+
+import numpy as np
+
+
+def bench_quiet_pass(n_nodes=10_000, iters=20, churn_iters=10) -> dict:
+    from benchmarks.solve_configs import _synth_cluster
+    from karpenter_provider_aws_tpu.models.pod import make_pods
+
+    env = _synth_cluster(n_nodes=n_nodes, pods_per_node=4)
+    cl = env.cluster
+    pool = cl.nodepools["default"]
+    pool.disruption.consolidation_policy = "WhenUnderutilized"
+    pool.disruption.consolidate_after_s = 3600.0
+    pool.disruption.expire_after_s = 86_400.0
+    d = env.disruption
+    d.validation_period_s = 15.0
+    names = [n.name for n in cl.snapshot_nodes()]
+    rng = np.random.RandomState(7)
+    churn = max(1, n_nodes // 1000)
+
+    def quiet_passes(count, advance_s=5.0):
+        out = []
+        for _ in range(count):
+            env.clock.advance(advance_s)
+            t0 = time.perf_counter()
+            d.reconcile()
+            out.append((time.perf_counter() - t0) * 1e3)
+        return out
+
+    prev = os.environ.get("KARPENTER_TPU_DISRUPTION_DIRTY")
+    gc.collect()
+    gc.freeze()
+    gc.disable()
+    try:
+        os.environ["KARPENTER_TPU_DISRUPTION_DIRTY"] = "1"
+        d.reconcile()  # scan rebuild + first consolidation evaluation
+        d.reconcile()
+        disrupted0 = len(d.disrupted)
+        dirty_times = quiet_passes(iters)
+        dirty_disrupted = len(d.disrupted) - disrupted0
+
+        churn_times = []
+        for it in range(churn_iters):
+            for _ in range(churn):
+                if rng.rand() < 0.5:
+                    p = make_pods(1, f"dq{it}",
+                                  {"cpu": "250m", "memory": "512Mi"})[0]
+                    cl.apply(p)
+                    cl.bind_pod(p.uid, names[rng.randint(len(names))])
+                else:
+                    bound = [pp for pp in list(cl.pods.values())[:256]
+                             if pp.node_name]
+                    if bound:
+                        cl.unbind_pod(bound[rng.randint(len(bound))].uid)
+            env.clock.advance(5)
+            t0 = time.perf_counter()
+            d.reconcile()
+            churn_times.append((time.perf_counter() - t0) * 1e3)
+
+        os.environ["KARPENTER_TPU_DISRUPTION_DIRTY"] = "0"
+        d.reconcile()  # legacy path warm (scan cache + consolidation memos)
+        d.reconcile()
+        full0 = len(d.disrupted)
+        full_times = quiet_passes(max(iters // 2, 5))
+        full_disrupted = len(d.disrupted) - full0
+    finally:
+        if prev is None:
+            os.environ.pop("KARPENTER_TPU_DISRUPTION_DIRTY", None)
+        else:
+            os.environ["KARPENTER_TPU_DISRUPTION_DIRTY"] = prev
+        gc.enable()
+        gc.unfreeze()
+
+    dirty_p50 = float(np.percentile(dirty_times, 50))
+    full_p50 = float(np.percentile(full_times, 50))
+    return {
+        "benchmark": f"disruption_quiet_pass_{n_nodes}node",
+        "nodes": n_nodes,
+        "claims": len(cl.nodeclaims),
+        "pods": len(cl.pods),
+        "iters": iters,
+        "dirty_p50_ms": round(dirty_p50, 3),
+        "dirty_p99_ms": round(float(np.percentile(dirty_times, 99)), 3),
+        "churn_nodes_per_pass": churn,
+        "churn_p50_ms": round(float(np.percentile(churn_times, 50)), 3),
+        "full_p50_ms": round(full_p50, 3),
+        "full_p99_ms": round(float(np.percentile(full_times, 99)), 3),
+        "speedup_quiet": round(full_p50 / max(dirty_p50, 1e-4), 1),
+        "decisions_equal": dirty_disrupted == full_disrupted == 0,
+        "device": "host",
+        "backend": "host",
+        "note": "quiet reconcile() wall: journal-fed dirty sets + deadline "
+                "heap + consolidation identical-ct skip vs the "
+                "KARPENTER_TPU_DISRUPTION_DIRTY=0 full O(claims) walk",
+    }
+
+
+def run_all(scale: float = 1.0, on_row=None) -> list[dict]:
+    rows = [bench_quiet_pass(n_nodes=max(int(10_000 * scale), 500))]
+    for row in rows:
+        print(json.dumps(row), flush=True)
+        if on_row is not None:
+            on_row(row)
+    return rows
+
+
+if __name__ == "__main__":
+    run_all(scale=float(os.environ.get("BENCH_SCALE", "1.0")))

@@ -136,7 +136,12 @@ def fmt(row: dict) -> str:
               "partitions", "lanes", "lanes_mode", "solve_lanes_ms",
               "merge_ms", "screen_partition_ms", "screen_partition_nodes",
               "global_unsharded_encode_ms", "steady_state_incremental",
-              "exactness_ok",
+              "exactness_ok", "solve_lanes_cold_ms", "combined_steady_ms",
+              # dirty-set disruption sweep rows (docs/performance.md):
+              # quiet/churn pass vs the legacy full O(claims) walk
+              "dirty_p50_ms", "dirty_p99_ms", "churn_p50_ms",
+              "full_p50_ms", "full_p99_ms", "speedup_quiet",
+              "decisions_equal", "chooser_picks",
               # lifecycle-SLI columns (docs/observability.md): virtual-
               # seconds time-to-bind/ready through the controller stack
               "bind_count", "unbound", "ready_count", "p50_s", "p99_s",

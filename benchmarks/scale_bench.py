@@ -129,7 +129,14 @@ def bench_scale(n_nodes=100_000, churn_frac=0.01, iters=10,
             for key, _pct, _off, n in ct.__dict__.get("_partitions", [])
         }
 
-        # partition-lanes solve: one pending burst per zone, ONE program
+        # partition-lanes solve: one pending burst per zone, ONE program.
+        # Cold = stack + jit compile + solve (paid once per ladder shape);
+        # steady = what every later burst of the same shape pays — the
+        # number the sub-second steady-state budget is about. The jitted
+        # program is cached per (mesh, shapes), exactly like production's
+        # dispatch_encoded_batch path.
+        import jax
+
         zones = sorted({z for (_p, z) in cl.partition_keys()})
         pool = cl.nodepools["default"]
         burst = max(64, n_nodes // 100)
@@ -141,24 +148,28 @@ def bench_scale(n_nodes=100_000, churn_frac=0.01, iters=10,
             problems.append(encode_problem(pods, env.catalog, nodepool=pool))
         GB = max(p.requests.shape[0] for p in problems)
         padded = [pad_problem(p, GB) for p in problems]
-        t0 = time.perf_counter()
-        args, (TB, ZB) = stack_lane_problems(padded)
-        K, NL = len(padded), 256
-        R = args["requests"].shape[2]
-        C = args["group_window"].shape[3]
-        init = _State(
-            node_type=np.zeros((K, NL), np.int32),
-            node_price=np.zeros((K, NL), np.float32),
-            used=np.zeros((K, NL, R), np.float32),
-            node_cap=np.zeros((K, NL, R), np.float32),
-            node_window=np.zeros((K, NL, ZB, C), bool),
-            n_open=np.zeros(K, np.int32),
-        )
-        import jax
 
-        res, _dev = solve_partition_lanes(args, init, [0] * K, NL)
-        fetched = jax.device_get(res)
-        solve_lanes_ms = (time.perf_counter() - t0) * 1e3
+        def lanes_once():
+            t0 = time.perf_counter()
+            args, (TB, ZB) = stack_lane_problems(padded)
+            K, NL = len(padded), 256
+            R = args["requests"].shape[2]
+            C = args["group_window"].shape[3]
+            init = _State(
+                node_type=np.zeros((K, NL), np.int32),
+                node_price=np.zeros((K, NL), np.float32),
+                used=np.zeros((K, NL, R), np.float32),
+                node_cap=np.zeros((K, NL, R), np.float32),
+                node_window=np.zeros((K, NL, ZB, C), bool),
+                n_open=np.zeros(K, np.int32),
+            )
+            res, _dev = solve_partition_lanes(args, init, [0] * K, NL)
+            fetched = jax.device_get(res)
+            return (time.perf_counter() - t0) * 1e3, fetched
+
+        solve_lanes_cold_ms, fetched = lanes_once()
+        lane_times = [lanes_once()[0] for _ in range(5)]
+        solve_lanes_ms = float(np.percentile(lane_times, 50))
         lane_plans = []
         for k, p in enumerate(problems):
             Z = p.group_window.shape[1]
@@ -174,18 +185,31 @@ def bench_scale(n_nodes=100_000, churn_frac=0.01, iters=10,
         merged = merge_partition_plans(problems, lane_plans)
         merge_ms = (time.perf_counter() - t0) * 1e3
 
-        # one partition's screen on the native kernel (partition-local cost)
+        # partition screens on the native kernel: the biggest partition's
+        # sweep (the per-partition serving cost) and the whole fleet's
+        # partitioned sweep — both steady-state p50 over repeat sweeps
+        # (the screen-mask memo is dropped per sweep; the candidate
+        # pre-filter + single-group exact accept do the work)
         screen_partition_ms = None
+        screen_all_ms = None
         screened_nodes = 0
         if parts:
             biggest = max(parts, key=lambda t: t[3])
+
+            def sweep(tensors):
+                tensors.__dict__.pop("_screen_mask_memo", None)
+                t0 = time.perf_counter()
+                dispatch_screen(tensors).wait()
+                return (time.perf_counter() - t0) * 1e3
+
             try:
                 with force_repack_backend("native"):
-                    t0 = time.perf_counter()
-                    dispatch_screen(biggest[1]).wait()
-                    screen_partition_ms = round(
-                        (time.perf_counter() - t0) * 1e3, 1)
+                    sweep(biggest[1])  # warm
+                    screen_partition_ms = round(float(np.percentile(
+                        [sweep(biggest[1]) for _ in range(5)], 50)), 1)
                     screened_nodes = int(biggest[3])
+                    screen_all_ms = round(float(np.percentile(
+                        [sweep(ct) for _ in range(3)], 50)), 1)
             except Exception as e:
                 screen_partition_ms = f"error: {type(e).__name__}"
     finally:
@@ -216,15 +240,26 @@ def bench_scale(n_nodes=100_000, churn_frac=0.01, iters=10,
         "lanes": len(problems),
         "lanes_mode": lanes_mode(),
         "solve_lanes_ms": round(solve_lanes_ms, 1),
+        "solve_lanes_cold_ms": round(solve_lanes_cold_ms, 1),
         "merge_ms": round(merge_ms, 1),
         "cost_lanes": round(merged["cost_lanes"], 4),
         "cost_merged": round(merged["cost_merged"], 4),
         "screen_partition_ms": screen_partition_ms,
+        "screen_all_partitions_ms": screen_all_ms,
         "screen_partition_nodes": screened_nodes,
+        # THE steady-state tick budget: incremental patch + warm lane solve
+        # + biggest-partition screen (tools/scale_gate.py holds the ceiling)
+        "combined_steady_ms": round(
+            float(np.percentile(times, 50)) + solve_lanes_ms
+            + (screen_partition_ms
+               if isinstance(screen_partition_ms, (int, float)) else 0.0),
+            1,
+        ),
         "device": "host" if os.environ.get("BENCH_FORCE_CPU") == "1" else "auto",
         "backend": "xla-scan",
-        "note": "partitioned encode + vmapped partition-lane FFD + "
-                "cross-partition merge; screen is per-partition native",
+        "note": "partitioned encode + partition-lane FFD (steady p50; cold "
+                "compile separate) + per-partition native screen with the "
+                "single-group exact pre-filter",
     }
 
 

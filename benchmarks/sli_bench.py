@@ -48,10 +48,17 @@ def run_all(on_row=None, waves: int = 6, pods_per_wave: int = 50,
             ):
                 env.cluster.apply(p)
             # two passes per wave with virtual time between them: launch +
-            # registration/bind land on distinct virtual timestamps
+            # registration/bind land on distinct virtual timestamps. The
+            # registration delay is STAGGERED per wave (0.7x..1.3x the
+            # step) so claim time-to-ready carries a real distribution:
+            # a claim registers+readies in one pass, so a fixed advance
+            # would collapse every wave's ready duration to the same
+            # p50 == p99 == step value no matter how fine the sub-tick
+            # interpolation stamps within the pass.
+            stag = 1.0 + 0.6 * (w / max(waves - 1, 1)) - 0.3
             for _ in range(2):
                 env.step(1)
-                env.clock.advance(step_advance_s)
+                env.clock.advance(step_advance_s * stag)
         # settle: everything must bind for the percentiles to mean "bind"
         for _ in range(5):
             if not env.cluster.pending_pods():

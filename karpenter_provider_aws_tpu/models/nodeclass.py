@@ -15,6 +15,14 @@ from dataclasses import dataclass, field, asdict
 from typing import Optional
 
 from . import labels as lbl
+from .pod import _Seq
+
+#: Bumped by every NodePool / NodeClass field reassignment, process-wide.
+#: Direct in-place spec edits on live objects never reach the store's
+#: change journal; consumers that cache per-spec derivations (the
+#: disruption controller's dirty-set drift/expiry state) re-scan when this
+#: sequence moves — the same over-invalidation contract as NODE_WRITE_SEQ.
+SPEC_WRITE_SEQ = _Seq()
 
 
 @dataclass(frozen=True)
@@ -153,6 +161,10 @@ class Condition:
 
 @dataclass
 class NodeClassStatus:
+    def __setattr__(self, name, value):
+        object.__setattr__(self, name, value)
+        SPEC_WRITE_SEQ.v += 1  # discovery updates move drift answers
+
     subnets: list = field(default_factory=list)           # resolved Subnet objects
     security_groups: list = field(default_factory=list)   # resolved SecurityGroup objects
     images: list = field(default_factory=list)            # resolved Image objects
@@ -170,6 +182,12 @@ class NodeClassStatus:
 
 @dataclass
 class NodeClass:
+    def __setattr__(self, name, value):
+        # see SPEC_WRITE_SEQ: direct spec edits must wake journal-driven
+        # consumers (the disruption drift sweep) without a store apply()
+        object.__setattr__(self, name, value)
+        SPEC_WRITE_SEQ.v += 1
+
     name: str
     image_family: str = "standard"  # parity with AMIFamily: standard|minimal|gpu|custom
     image_selector: list[SelectorTerm] = field(default_factory=list)

@@ -14,7 +14,7 @@ from typing import Optional
 from .requirements import Requirement, Requirements
 from .resources import ResourceVector
 from . import labels as lbl
-from .nodeclass import KubeletConfiguration
+from .nodeclass import KubeletConfiguration, SPEC_WRITE_SEQ
 
 
 @dataclass(frozen=True)
@@ -91,6 +91,10 @@ class Budget:
 class Disruption:
     """NodePool.spec.disruption (core): consolidation + expiration policy."""
 
+    def __setattr__(self, name, value):
+        object.__setattr__(self, name, value)
+        SPEC_WRITE_SEQ.v += 1  # see SPEC_WRITE_SEQ (policy edits in place)
+
     consolidation_policy: str = "WhenUnderutilized"  # or WhenEmpty
     consolidate_after_s: Optional[float] = 0.0  # None = Never
     expire_after_s: Optional[float] = None  # None = Never
@@ -134,6 +138,15 @@ class NodePool:
     # Kubelet knobs templated onto every node of this pool (parity: the
     # v1beta1 NodePool.spec.template.spec.kubelet block).
     kubelet: "Optional[KubeletConfiguration]" = None
+
+    def __setattr__(self, name, value):
+        # process-wide spec write signal: a direct field reassignment on a
+        # live pool (tests and ad-hoc operators edit in place instead of
+        # re-applying) is invisible to the store's change journal, and the
+        # disruption controller's dirty-set walk re-scans on this sequence
+        # exactly like the encoders do on NODE_WRITE_SEQ
+        object.__setattr__(self, name, value)
+        SPEC_WRITE_SEQ.v += 1
 
     def scheduling_requirements(self) -> Requirements:
         """Template requirements + identity labels as a requirement set."""

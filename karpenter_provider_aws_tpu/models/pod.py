@@ -52,6 +52,14 @@ class _Seq:
 #: per-pod (id, _version) key caught exactly that).
 POD_WRITE_SEQ = _Seq()
 
+#: Bumped by every Pod ``phase`` / ``node_name`` write, process-wide. The
+#: cluster store's pending-pod index resyncs against it: the sanctioned
+#: mutation surface (bind/unbind/apply/delete) maintains the index
+#: incrementally and snapshots this sequence, so a DIRECT ``pod.phase =``
+#: write anywhere else makes the next ``pending_pods()`` read fall back to
+#: one full rescan (over-invalidation, never a stale answer).
+POD_BIND_SEQ = _Seq()
+
 
 @dataclass(frozen=True)
 class Toleration:
@@ -158,6 +166,12 @@ class Pod:
             # _scheduling_key was transiently None (review round-3)
             if getattr(self, "_scheduling_token", None) is not None:
                 object.__setattr__(self, "_scheduling_token", None)
+        # RE-assignment only (the field already exists): dataclass __init__
+        # assigns every field once, and construction must not look like a
+        # pendingness flip to the store's index
+        rebind = (
+            (name == "phase" or name == "node_name") and name in self.__dict__
+        )
         object.__setattr__(self, name, value)
         # version bumps AFTER the field write: a reader that keys on the new
         # version has then necessarily seen (or will re-read) the new value,
@@ -166,6 +180,8 @@ class Pod:
         if name in Pod._VERSION_FIELDS:
             object.__setattr__(self, "_version", getattr(self, "_version", 0) + 1)
             POD_WRITE_SEQ.v += 1
+        elif rebind:
+            POD_BIND_SEQ.v += 1
 
     def bump_version(self) -> None:
         """Explicit invalidation after IN-PLACE mutation of a scheduling

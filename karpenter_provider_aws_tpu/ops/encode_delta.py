@@ -545,6 +545,26 @@ def _process_node(state: _EncoderState, cluster, catalog, name, plist) -> bool:
 
 # -- emission ---------------------------------------------------------------
 
+def _emit_slot_width(max_live: int, gmax: int) -> int:
+    """Slot-table width for an EMISSION: power-of-two covering the widest
+    live row, floored at 4 (headroom so a node gaining a 2nd/3rd group
+    patches in place instead of re-emitting), capped at gmax.
+
+    Emissions carry ``[N, width]`` group tables instead of ``[N, gmax]``:
+    production nodes host 1-2 distinct consolidation groups while gmax is
+    32, and at 100k nodes the two full-width tables were 25MB of pure
+    padding COPIED on every copy-on-write patch/merge — the single
+    largest slice of the steady-state patch wall on a bandwidth-bound
+    host. Every consumer already slices by ``live_slot_width`` (computed
+    from the array), so width is a representation detail; the canonical
+    form compares slot tables as {token: count} dicts either way."""
+    w = 4
+    cap = max(min(max_live, gmax), 1)
+    while w < cap:
+        w *= 2
+    return min(w, max(gmax, 1))
+
+
 def _emit(state: _EncoderState):
     from .consolidate import ClusterTensors, ZoneConstraint
 
@@ -569,8 +589,6 @@ def _emit(state: _EncoderState):
     free = state.alloc[rows] - state.used[rows]
     blocked = state.blocked[rows].copy()
 
-    group_ids = np.zeros((N, state.gmax), dtype=np.int32)
-    group_counts = np.zeros((N, state.gmax), dtype=np.int32)
     if len(gids):
         requests = state.g_requests[gids].copy()
         gnc_e = state.gnc[np.ix_(gids, rows)].astype(np.int32)
@@ -578,12 +596,19 @@ def _emit(state: _EncoderState):
         mpn_e = state.g_mpn[gids].copy()
         hn_e = state.hn_match[np.ix_(gids, gids)].copy()
         # per-row slot tables from the [G, N] counts (same packing rule as
-        # the full encoder: ascending group id, first gmax slots kept)
+        # the full encoder: ascending group id, first gmax slots kept),
+        # emitted at the live slot width (see _emit_slot_width)
         t = gnc_e.T                      # [N, G]
+        live_counts = (t > 0).sum(axis=1)
+        S_em = _emit_slot_width(
+            int(live_counts.max()) if len(live_counts) else 0, state.gmax
+        )
+        group_ids = np.zeros((N, S_em), dtype=np.int32)
+        group_counts = np.zeros((N, S_em), dtype=np.int32)
         rnz, cnz = np.nonzero(t)
         if len(rnz):
             slot = np.arange(len(rnz)) - np.searchsorted(rnz, rnz)
-            keep = slot < state.gmax
+            keep = slot < S_em
             group_ids[rnz[keep], slot[keep]] = cnz[keep]
             group_counts[rnz[keep], slot[keep]] = t[rnz[keep], cnz[keep]]
         cap = np.where(compat_e, np.float32(_UNCAPPED), np.float32(0.0))
@@ -604,7 +629,9 @@ def _emit(state: _EncoderState):
                                            match=m[gids].copy(),
                                            selector=sel))
             zone_constraints.append(cons)
-        group_pods = [_group_pod_list(state, int(gid)) for gid in gids]
+        group_pods = LazyGroupPods(
+            [_lazy_builder(state, int(gid)) for gid in gids]
+        )
     else:
         # podless cluster: mirror the full encoder's G=1 dummy group
         requests = np.zeros((1, NUM_RESOURCES), dtype=np.float32)
@@ -615,6 +642,9 @@ def _emit(state: _EncoderState):
         cap = np.where(compat_e, np.float32(_UNCAPPED), np.float32(0.0))
         zone_constraints = []
         group_pods = []
+        S_em = _emit_slot_width(0, state.gmax)
+        group_ids = np.zeros((N, S_em), dtype=np.int32)
+        group_counts = np.zeros((N, S_em), dtype=np.int32)
 
     out = ClusterTensors(
         node_names=[state.row_name[i] for i in rows],
@@ -663,6 +693,124 @@ def _group_pod_list(state: _EncoderState, gid: int) -> list:
     return out
 
 
+class LazyGroupPods:
+    """List-like ``group_pods`` whose per-group pod lists materialize on
+    first access.
+
+    Rebuilding a churned group's flat pod list eagerly is O(pods in the
+    group) per pass — at 100k nodes / 255k pods a single bench-shaped
+    group made every steady-state emission pay a ~255k-element list build
+    (the dominant patch cost after the journal bisect). Hot consumers only
+    read ``pods[0]`` (group representatives) or ``len(ct.group_pods)``;
+    full materialization (canonical_form, nomination commits) is rare and
+    pays the build exactly once per emission.
+
+    Elements are either concrete lists (carried over from the previous
+    emission) or zero-arg builders over SNAPSHOTTED state (the per-row
+    bucket dicts are replaced, never mutated in place, so a shallow dict
+    copy pins the emission-time content whatever the encoder does next).
+    Built results cache in a side table so the SLOT objects stay stable:
+    emissions chained across passes carry a slot over by identity, and the
+    partitioned merge detects "this group's pods changed" by exactly that
+    identity — materialization must never perturb it."""
+
+    __slots__ = ("_items", "_built")
+
+    def __init__(self, items: list):
+        self._items = items  # each: list | callable -> list
+        self._built: dict[int, list] = {}
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def __bool__(self) -> bool:
+        return bool(self._items)
+
+    def _get(self, g: int):
+        it = self._items[g]
+        if callable(it):
+            got = self._built.get(g)
+            if got is None:
+                got = self._built[g] = it()
+            return got
+        return it
+
+    def __getitem__(self, g):
+        if isinstance(g, slice):
+            return [self._get(i) for i in range(*g.indices(len(self._items)))]
+        return self._get(g)
+
+    def __iter__(self):
+        for g in range(len(self._items)):
+            yield self._get(g)
+
+    def rep(self, g: int):
+        """Group ``g``'s representative pod (``pods[0]``) WITHOUT
+        materializing the flat list — the merge paths only need reps."""
+        it = self._items[g]
+        if callable(it):
+            got = self._built.get(g)
+            if got is not None:
+                return got[0] if got else None
+            first = getattr(it, "first", None)
+            if first is not None:
+                return first()
+            it = self._get(g)
+        return it[0] if it else None
+
+
+def group_rep(pods, g: int):
+    """``pods[g][0]`` (or None for an empty group) that stays O(1) on a
+    :class:`LazyGroupPods` emission."""
+    if isinstance(pods, LazyGroupPods):
+        return pods.rep(g)
+    plist = pods[g]
+    return plist[0] if plist else None
+
+
+class _PodsBuilder:
+    """Zero-arg flat-list builder over a snapshotted row bucket, with an
+    O(rows) ``first()`` so representative reads skip the build."""
+
+    __slots__ = ("snap",)
+
+    def __init__(self, snap: dict):
+        self.snap = snap
+
+    def __call__(self) -> list:
+        out: list = []
+        for r in sorted(self.snap):
+            out.extend(self.snap[r])
+        return out
+
+    def first(self):
+        if not self.snap:
+            return None
+        return self.snap[min(self.snap)][0]
+
+
+def _lazy_builder(state: _EncoderState, gid: int):
+    bucket = state.g_pods.get(gid)
+    if not bucket:
+        return []
+    # row lists are replaced, never mutated in place: a shallow copy pins
+    # the emission-time content
+    return _PodsBuilder(dict(bucket))
+
+
+def _carry_group_pods(prev_pods, g: int):
+    """The previous emission's slot for group ``g`` WITHOUT materializing
+    it (keeps untouched groups lazy across pass chains, depth-free, and
+    preserves slot identity — the partitioned merge's touched test).
+    Prefers an already-built list so a carried slot never rebuilds."""
+    if isinstance(prev_pods, LazyGroupPods):
+        it = prev_pods._items[g]
+        if callable(it):
+            return prev_pods._built.get(g, it)
+        return it
+    return prev_pods[g]
+
+
 def _emit_fast(state: _EncoderState, prev, dirty_rows: list[int]):
     """Patch the previous emission in copy-on-write fashion.
 
@@ -675,6 +823,12 @@ def _emit_fast(state: _EncoderState, prev, dirty_rows: list[int]):
 
     gpos = state.emit_gpos
     gids = state.emit_gids
+    # emissions carry live-width slot tables (_emit_slot_width): a dirty
+    # row that outgrew the previous emission's width cannot patch in
+    # place — re-emit at the next ladder bucket instead (rare)
+    W_prev = prev.group_ids.shape[1]
+    if any(len(state.row_tokens[r]) > W_prev for r in dirty_rows):
+        return _emit(state)
     free = prev.free.copy()
     price = prev.price.copy()
     used = prev.used_total.copy()
@@ -688,49 +842,62 @@ def _emit_fast(state: _EncoderState, prev, dirty_rows: list[int]):
     pools = list(prev.nodepool_names)
     captype = list(prev.node_captype)
     G = len(gids)
-    hn_int = prev.hn_match.astype(np.int32) if G else None
-    capped = np.flatnonzero(state.g_mpn[gids] < _UNCAPPED) if G else []
-    for r in dirty_rows:
-        pos = state.emit_pos[r]
-        free[pos] = state.alloc[r] - state.used[r]
-        price[pos] = state.price[r]
-        used[pos] = state.used[r]
-        dcost[pos] = state.dcost[r]
-        blocked[pos] = state.blocked[r]
+    # Batched row rewrite: one fancy-indexed numpy op per buffer instead of
+    # a per-dirty-row python loop of [G]-vector ops — at 100k nodes a 1%
+    # churn pass rewrites ~1000 rows, and the per-row loop overhead (not
+    # the arithmetic) was a measured chunk of the steady-state patch wall.
+    rows_a = np.asarray(dirty_rows, dtype=np.int64)
+    pos_a = np.asarray([state.emit_pos[r] for r in dirty_rows],
+                       dtype=np.int64)
+    free[pos_a] = state.alloc[rows_a] - state.used[rows_a]
+    price[pos_a] = state.price[rows_a]
+    used[pos_a] = state.used[rows_a]
+    dcost[pos_a] = state.dcost[rows_a]
+    blocked[pos_a] = state.blocked[rows_a]
+    for r, pos in zip(dirty_rows, pos_a):
         pools[pos] = state.row_pool[r]
         captype[pos] = state.row_captype[r]
-        if G:
-            col = state.gnc[gids, r].astype(np.int32)
-            gnc_e[:, pos] = col
-            ccol = state.compat[gids, r]
-            compat_e[:, pos] = ccol
-            group_ids[pos] = 0
-            group_counts[pos] = 0
+    if G:
+        cols = state.gnc[np.ix_(gids, rows_a)].astype(np.int32)   # [G, k]
+        gnc_e[:, pos_a] = cols
+        ccols = state.compat[np.ix_(gids, rows_a)]
+        compat_e[:, pos_a] = ccols
+        # per-row slot tables: few live tokens per row — stays a loop
+        group_ids[pos_a] = 0
+        group_counts[pos_a] = 0
+        for r, pos in zip(dirty_rows, pos_a):
             slot = 0
             for gk in sorted(gpos[state.gid_of[t]]
                              for t in state.row_tokens[r]):
-                if slot >= state.gmax:
+                if slot >= W_prev:
                     break
                 group_ids[pos, slot] = gk
                 group_counts[pos, slot] = gnc_e[gk, pos]
                 slot += 1
-            if cap is not None:
-                cap[:, pos] = np.where(ccol, np.float32(_UNCAPPED),
-                                       np.float32(0.0))
-                if len(capped):
-                    occ = hn_int[capped] @ col
-                    mpn_c = state.g_mpn[gids[capped]]
-                    cap[capped, pos] = np.where(
-                        ccol[capped],
-                        np.maximum(mpn_c - occ, 0).astype(np.float32), 0.0,
-                    )
+        if cap is not None:
+            cap[:, pos_a] = np.where(ccols, np.float32(_UNCAPPED),
+                                     np.float32(0.0))
+            capped = np.flatnonzero(state.g_mpn[gids] < _UNCAPPED)
+            if len(capped):
+                hn_int = prev.hn_match.astype(np.int32)
+                occ = hn_int[capped] @ cols                       # [c, k]
+                mpn_c = state.g_mpn[gids[capped]]
+                cap[np.ix_(capped, pos_a)] = np.where(
+                    ccols[capped],
+                    np.maximum(mpn_c[:, None] - occ, 0).astype(np.float32),
+                    0.0,
+                )
     group_pods = prev.group_pods
     if state.touched_gids:
-        group_pods = list(prev.group_pods)
+        items = [
+            _carry_group_pods(prev.group_pods, k)
+            for k in range(len(prev.group_pods))
+        ]
         for gid in state.touched_gids:
             k = gpos.get(gid)
             if k is not None:
-                group_pods[k] = _group_pod_list(state, gid)
+                items[k] = _lazy_builder(state, gid)
+        group_pods = LazyGroupPods(items)
     out = ClusterTensors(
         node_names=prev.node_names,
         nodepool_names=pools,
@@ -878,6 +1045,21 @@ def _full_build(state: _EncoderState, cluster, catalog, gmax,
             node = nodes.get(name)
             if node is not None:
                 state.row_class[i] = _class_of(state, node)
+    # trim the emitted slot tables to the live ladder width (the delta
+    # emissions' representation — see _emit_slot_width): canonical content
+    # is identical (consumers slice by live_slot_width), and every later
+    # copy-on-write patch then copies ~gmax/width fewer slot-table bytes
+    import dataclasses as _dc
+
+    from .consolidate import live_slot_width as _lsw
+
+    S_em = _emit_slot_width(_lsw(ct.group_counts), gmax)
+    if S_em < ct.group_ids.shape[1]:
+        ct = _dc.replace(
+            ct,
+            group_ids=np.ascontiguousarray(ct.group_ids[:, :S_em]),
+            group_counts=np.ascontiguousarray(ct.group_counts[:, :S_em]),
+        )
     state.emitted = ct
     state.emit_pos = {i: i for i in range(N)}
     G = len(ct.group_pods)

@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import os
 import threading
+from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
 import numpy as np
@@ -48,7 +49,9 @@ from .encode import _count_encode_cache
 from .encode_delta import (
     _EncoderState,
     _UNCAPPED,
+    LazyGroupPods,
     PATCH_FRAC,
+    _carry_group_pods,
     _collect_dirty,
     _emit,
     _emit_fast,
@@ -57,9 +60,65 @@ from .encode_delta import (
     _process_node,
     _refresh_every,
     _remove_row,
+    group_rep,
 )
 
 _PSTATES_ATTR = "_cluster_part_encoders"
+
+
+class _MergedPods:
+    """Lazy flat pod list for one merged group: concatenates the sources'
+    per-part lists (part order) on first access. ``first()`` serves the
+    representative without materializing anything — merged emissions stay
+    O(changed) even when a 255k-pod group rides along untouched."""
+
+    __slots__ = ("sources",)
+
+    def __init__(self, sources: list):
+        self.sources = sources  # [(part group_pods, k), ...] in part order
+
+    def __call__(self) -> list:
+        out: list = []
+        for pods, k in self.sources:
+            out.extend(pods[k])
+        return out
+
+    def first(self):
+        for pods, k in self.sources:
+            rep = group_rep(pods, k)
+            if rep is not None:
+                return rep
+        return None
+
+
+#: shared advance pool: partitions patch concurrently (the per-partition
+#: chains are independent, each under its own state lock; store reads take
+#: the cluster lock per call). Sized small — the win is overlapping the
+#: GIL-releasing numpy row/merge work, not oversubscribing the host.
+_ADVANCE_POOL: Optional[ThreadPoolExecutor] = None
+_ADVANCE_POOL_LOCK = threading.Lock()
+
+
+def _advance_workers(n_parts: int) -> int:
+    """0/1 = serial. KARPENTER_TPU_PARTITION_PATCH_WORKERS pins (0 = off);
+    auto: one worker per partition, capped at min(8, cores)."""
+    try:
+        pinned = int(os.environ.get("KARPENTER_TPU_PARTITION_PATCH_WORKERS", "-1"))
+    except ValueError:
+        pinned = -1
+    if pinned >= 0:
+        return min(pinned, n_parts)
+    return min(n_parts, 8, os.cpu_count() or 1)
+
+
+def _advance_pool() -> ThreadPoolExecutor:
+    global _ADVANCE_POOL
+    with _ADVANCE_POOL_LOCK:
+        if _ADVANCE_POOL is None:
+            _ADVANCE_POOL = ThreadPoolExecutor(
+                max_workers=8, thread_name_prefix="part-encode"
+            )
+        return _ADVANCE_POOL
 
 
 def partition_encode_active(cluster) -> bool:
@@ -354,19 +413,26 @@ def _merge_full(pstate: _PartitionedEncoder, cluster, parts):
         pstate.parts_used = {}
         return None
 
-    # group union (first-seen across parts, in stable part order)
+    # group union (first-seen across parts, in stable part order);
+    # representatives read via group_rep so a lazy emission never
+    # materializes a whole group's flat list just to name its token
     tokens: list = []
     tok_idx: dict[int, int] = {}
     reps: list = []
+    tok_sources: dict[int, list] = {}  # token -> [(part pods, k)] part order
     pstate.part_tokens = {}
     for key, ct in parts:
-        toks = [pods[0].group_token() for pods in ct.group_pods]
+        toks = []
+        for k_ in range(len(ct.group_pods)):
+            t = group_rep(ct.group_pods, k_).group_token()
+            toks.append(t)
+            tok_sources.setdefault(t, []).append((ct.group_pods, k_))
         pstate.part_tokens[key] = toks
         for k_, t in enumerate(toks):
             if t not in tok_idx:
                 tok_idx[t] = len(tokens)
                 tokens.append(t)
-                reps.append(ct.group_pods[k_][0])
+                reps.append(group_rep(ct.group_pods, k_))
     G = len(tokens)
     pstate.tokens, pstate.reps = tokens, reps
 
@@ -395,14 +461,19 @@ def _merge_full(pstate: _PartitionedEncoder, cluster, parts):
     dcost = np.concatenate([ct.disruption_cost for _, ct in parts])
     blocked = np.concatenate([ct.blocked for _, ct in parts])
 
-    group_ids = np.zeros((N, gmax), dtype=np.int32)
-    group_counts = np.zeros((N, gmax), dtype=np.int32)
+    # merged slot width = the widest part's live width (parts emit
+    # ladder-trimmed tables — encode_delta._emit_slot_width)
+    W_m = max((ct.group_ids.shape[1] for _k, ct in parts), default=4)
+    group_ids = np.zeros((N, W_m), dtype=np.int32)
+    group_counts = np.zeros((N, W_m), dtype=np.int32)
     if G:
         requests = np.zeros((G, NUM_RESOURCES), dtype=np.float32)
         mpn = np.full(G, _UNCAPPED, dtype=np.int32)
         gnc = np.zeros((G, N), dtype=np.int32)
         compat = np.zeros((G, N), dtype=bool)
-        group_pods: list[list] = [[] for _ in range(G)]
+        group_pods = LazyGroupPods(
+            [_MergedPods(tok_sources[t]) for t in tokens]
+        )
         for key, ct in parts:
             off = pstate.offsets[key]
             n = len(ct.node_names)
@@ -415,12 +486,11 @@ def _merge_full(pstate: _PartitionedEncoder, cluster, parts):
                 compat[np.ix_(gm, cols)] = ct.compat[:Gp]
                 requests[gm] = ct.requests[:Gp]
                 mpn[gm] = ct.mpn[:Gp]
-                for k_, t in enumerate(toks):
-                    group_pods[tok_idx[t]].extend(ct.group_pods[k_])
-                group_ids[off:off + n] = np.where(
+                W_p = ct.group_ids.shape[1]
+                group_ids[off:off + n, :W_p] = np.where(
                     ct.group_counts > 0, gm[ct.group_ids], 0
                 )
-                group_counts[off:off + n] = ct.group_counts
+                group_counts[off:off + n, :W_p] = ct.group_counts
             own = {tok_idx[t] for t in toks}
             for g in range(G):
                 if g in own:
@@ -560,10 +630,19 @@ def _merge_fast(pstate: _PartitionedEncoder, cluster, parts, changed):
             Gp = len(toks)
             gnc[np.ix_(gm, col_idx)] = ct.group_node_count[:Gp]
             compat[np.ix_(gm, col_idx)] = ct.compat[:Gp]
-            group_ids[cols] = np.where(ct.group_counts > 0, gm[ct.group_ids], 0)
-            group_counts[cols] = ct.group_counts
+            # same-width guaranteed by the fast-path eligibility check;
+            # beyond-W_p columns of this part's rows are zero on both sides
+            W_p = ct.group_ids.shape[1]
+            group_ids[cols, :W_p] = np.where(
+                ct.group_counts > 0, gm[ct.group_ids], 0
+            )
+            group_counts[cols, :W_p] = ct.group_counts
             for k_, t in enumerate(toks):
-                if ct.group_pods[k_] is not prev_ct.group_pods[k_]:
+                # slot identity, not content: lazy emissions carry an
+                # untouched group's slot object across passes unchanged
+                if _carry_group_pods(ct.group_pods, k_) is not (
+                    _carry_group_pods(prev_ct.group_pods, k_)
+                ):
                     touched_tokens.add(t)
         own = {tok_idx[t] for t in toks}
         for g in range(G):
@@ -585,16 +664,19 @@ def _merge_fast(pstate: _PartitionedEncoder, cluster, parts, changed):
                     0.0,
                 )
     if touched_tokens:
-        group_pods = list(prev.group_pods)
+        items = [
+            _carry_group_pods(prev.group_pods, g)
+            for g in range(len(prev.group_pods))
+        ]
         for t in touched_tokens:
-            g = tok_idx[t]
-            merged: list = []
-            for key2, ct2 in parts:
-                toks2 = pstate.part_tokens[key2]
-                for k_, t2 in enumerate(toks2):
-                    if t2 == t:
-                        merged.extend(ct2.group_pods[k_])
-            group_pods[g] = merged
+            sources = [
+                (ct2.group_pods, k_)
+                for key2, ct2 in parts
+                for k_, t2 in enumerate(pstate.part_tokens[key2])
+                if t2 == t
+            ]
+            items[tok_idx[t]] = _MergedPods(sources)
+        group_pods = LazyGroupPods(items)
 
     out = ClusterTensors(
         node_names=prev.node_names,
@@ -666,29 +748,61 @@ def partitioned_encode_cluster(cluster, catalog, gmax, pods_by_node=None,
         keys = cluster.partition_keys()
         ENCODE_PARTITIONS.set(float(len(keys)))
         # full-build node scoping, computed lazily ONCE per pass (only a
-        # rebuilding partition pays the O(nodes) router walk)
+        # rebuilding partition pays the O(nodes) router walk); thread-safe:
+        # concurrent advances may race the first build
         part_map: dict = {}
+        part_map_lock = threading.Lock()
 
         def part_filter_for(key):
             def _filter():
-                if not part_map:
-                    part_map.update(cluster.partition_nodes())
-                return part_map.get(key, set())
+                with part_map_lock:
+                    if not part_map:
+                        part_map.update(cluster.partition_nodes())
+                    return part_map.get(key, set())
             return _filter
 
         outcomes: dict[tuple, tuple] = {}
         with _span("consolidate.encode.partitioned", partitions=len(keys)):
             for key in keys:
-                state = pstate.states.get(key)
-                if state is None:
-                    state = pstate.states[key] = _EncoderState(gmax)
+                if key not in pstate.states:
+                    pstate.states[key] = _EncoderState(gmax)
                     pstate.order.append(key)
+
+            def advance(key):
+                state = pstate.states[key]
                 with state.lock:
-                    outcome, cause = _advance_partition(
+                    return _advance_partition(
                         pstate, state, cluster, catalog, key,
                         pods_by_node, rev_now, part_filter_for(key),
                     )
-                outcomes[key] = (outcome, cause)
+
+            # partitions advance CONCURRENTLY: each chain is independent
+            # (own state lock, own journal cursor), the heavy row/emission
+            # work is numpy (GIL-releasing), and a churn burst rarely lands
+            # in exactly one zone — serial chain walks made every pass pay
+            # the sum instead of the max.
+            workers = _advance_workers(len(keys))
+            if workers > 1:
+                # the shared pool is fixed at 8 threads; the computed cap
+                # (incl. the KARPENTER_TPU_PARTITION_PATCH_WORKERS pin and
+                # the core-count auto cap) is enforced by a semaphore so a
+                # pinned-down host is never oversubscribed past the knob
+                gate = threading.BoundedSemaphore(workers)
+
+                def advance_bounded(key):
+                    with gate:
+                        return advance(key)
+
+                futs = {
+                    key: _advance_pool().submit(advance_bounded, key)
+                    for key in keys
+                }
+                for key, fut in futs.items():
+                    outcomes[key] = fut.result()
+            else:
+                for key in keys:
+                    outcomes[key] = advance(key)
+            for key, (outcome, cause) in outcomes.items():
                 _count_encode_cache("cluster_part", outcome, cause)
 
             parts = [
@@ -723,6 +837,9 @@ def partitioned_encode_cluster(cluster, catalog, gmax, pods_by_node=None,
                     if (
                         len(ct.node_names) != len(prev_ct.node_names)
                         or ct.requests is not prev_ct.requests
+                        # slot-table width moved (a row grew groups): the
+                        # sliced fast-merge write needs equal widths
+                        or ct.group_ids.shape[1] != prev_ct.group_ids.shape[1]
                     ):
                         fast = False
                         break

@@ -32,6 +32,29 @@ from ..ops.ffd import ffd_solve
 POD_AXIS = "pods"
 
 
+def shard_map_impl():
+    """The runtime's shard_map entry, laddered: ``jax.shard_map`` (new
+    API) when the runtime ships it, else ``jax.experimental.shard_map``
+    (same semantics; the replication check is spelled ``check_rep``
+    there), else ``None`` — callers fall back to ``jax.vmap`` lanes.
+    Returned as a uniform ``(f, mesh, in_specs, out_specs) -> wrapped``
+    so every mesh path shares ONE compatibility seam."""
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None:
+        return lambda f, mesh, in_specs, out_specs: fn(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    try:
+        from jax.experimental.shard_map import shard_map as _esm
+    except Exception:
+        return None
+    return lambda f, mesh, in_specs, out_specs: _esm(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False,
+    )
+
+
 @functools.lru_cache(maxsize=8)
 def _cached_mesh(devices: tuple, n: int) -> Mesh:
     return Mesh(np.array(devices[:n]), (POD_AXIS,))
@@ -51,15 +74,17 @@ def make_mesh(n_devices: Optional[int] = None) -> Mesh:
 def sharded_solve_fn(mesh: Mesh, max_nodes: int):
     """Build the jitted SPMD solve: inputs sharded on the group axis, node
     state replicated per shard, cost psum'd over ICI."""
+    smap = shard_map_impl()
+    if smap is None:
+        raise RuntimeError("no shard_map in this jax runtime")
 
     @functools.partial(
-        jax.shard_map,
+        smap,
         mesh=mesh,
         in_specs=(P(POD_AXIS), P(POD_AXIS), P(POD_AXIS), P(), P(POD_AXIS),
                   P(POD_AXIS), P(), P(POD_AXIS)),
         out_specs=(P(POD_AXIS), P(POD_AXIS, None), P(POD_AXIS), P(POD_AXIS), P(),
                    P(POD_AXIS), P(POD_AXIS, None, None), P(POD_AXIS, None)),
-        check_vma=False,
     )
     def _solve_shard(requests, counts, compat, capacity, price,
                      group_window, type_window, max_per_node):
@@ -154,12 +179,15 @@ def sharded_screen_fn(mesh: Mesh):
     per reconcile would recompile the screen every disruption pass."""
     from ..ops.consolidate import repack_check
 
+    smap = shard_map_impl()
+    if smap is None:
+        raise RuntimeError("no shard_map in this jax runtime")
+
     @functools.partial(
-        jax.shard_map,
+        smap,
         mesh=mesh,
         in_specs=(P(), P(), P(), P(), P(), P(POD_AXIS)),
         out_specs=P(POD_AXIS),
-        check_vma=False,
     )
     def _screen(free, requests, gids, gcounts, cap, candidates):
         return repack_check(free, requests, gids, gcounts, cap, candidates)
@@ -327,15 +355,21 @@ def screen_sharded(ct, mesh: Mesh, lanes_per_device: Optional[int] = None) -> np
 
 
 def _native_screen(ct, N: int) -> np.ndarray:
-    from ..ops.consolidate import live_slot_width
+    from ..ops.consolidate import live_slot_width, native_screen_prefilter
     from ..scheduling.native import repack_check_native
 
     S = live_slot_width(ct.group_counts)
-    cand = np.arange(N, dtype=np.int32)
-    out = np.asarray(repack_check_native(
-        ct.free, ct.requests, ct.group_ids[:, :S],
-        ct.group_counts[:, :S], ct.compat, cand,
-    ), dtype=bool).copy()
+    gids_s = ct.group_ids[:, :S]
+    gcounts_s = ct.group_counts[:, :S]
+    # same triage as the single-device native path (ops/consolidate.py):
+    # vectorized necessary-condition prune + exact single-group accept;
+    # the O(C x N) kernel only sees multi-group candidates
+    out, cand = native_screen_prefilter(ct, gids_s, gcounts_s)
+    if len(cand):
+        out[cand] = np.asarray(repack_check_native(
+            ct.free, ct.requests, gids_s[cand], gcounts_s[cand],
+            ct.compat, cand,
+        ), dtype=bool)
     out &= ~ct.blocked
     return out
 
@@ -379,10 +413,12 @@ def _mesh_screen(ct, mesh: Mesh, lanes_per_device: Optional[int], N: int) -> np.
 
 def lanes_mode() -> str:
     """How partition lanes run here: ``shard_map`` (lane axis sharded over
-    the device mesh) on real multi-device runtimes that expose it, else
-    ``vmap`` (single-program vmapped lanes — the native fallback)."""
+    the device mesh) on multi-device runtimes that expose one — the new
+    ``jax.shard_map`` API or the experimental module (see
+    :func:`shard_map_impl`) — else ``vmap`` (single-program vmapped lanes,
+    the native fallback)."""
     try:
-        if getattr(jax, "shard_map", None) is not None and len(jax.devices()) > 1:
+        if shard_map_impl() is not None and len(jax.devices()) > 1:
             return "shard_map"
     except Exception:
         pass
@@ -413,13 +449,15 @@ def _lanes_shard_fn(mesh: Mesh, max_nodes: int):
     """Lane axis sharded over the device mesh: each device runs its K/D
     lanes through the identical vmapped scan (pure SPMD, no cross-device
     communication — independent partitions share nothing inside a solve)."""
-    fn = functools.partial(
-        jax.shard_map,
-        mesh=mesh,
-        in_specs=P(POD_AXIS),
-        out_specs=P(POD_AXIS),
-        check_vma=False,
-    )(jax.vmap(_lane_body(max_nodes)))
+    smap = shard_map_impl()
+    if smap is None:
+        raise RuntimeError("no shard_map in this jax runtime")
+    fn = smap(
+        jax.vmap(_lane_body(max_nodes)),
+        mesh,
+        P(POD_AXIS),
+        P(POD_AXIS),
+    )
     return jax.jit(fn)
 
 

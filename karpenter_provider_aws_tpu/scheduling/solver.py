@@ -968,7 +968,12 @@ class TPUSolver:
             node_cap=cap0, node_window=win0,
             n_open=np.asarray(n_pres, dtype=np.int32),
         )
-        mode = lanes_mode()
+        # KARPENTER_TPU_PARTITION_SOLVE: 0 = per-problem dispatch (handled
+        # by the caller), auto = runtime-laddered (shard_map on multi-
+        # device runtimes that expose one, else vmap), or an explicit
+        # vmap/shard_map pin for apples-to-apples lane benchmarking
+        pin = os.environ.get("KARPENTER_TPU_PARTITION_SOLVE", "auto")
+        mode = pin if pin in ("vmap", "shard_map") else lanes_mode()
         with trace_span("solve.dispatch", rows=NR, lanes=K) as sp:
             self.timings["ffd_backend"] = "xla"
             self.timings["lanes"] = self.timings.get("lanes", 0) + K

@@ -3,7 +3,7 @@
 from __future__ import annotations
 
 import threading
-from collections import deque
+from bisect import bisect_right
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
@@ -87,6 +87,54 @@ def journal_cap_for(n_objects: int, floor: int = JOURNAL_CAP) -> int:
     return cap
 
 
+class _Journal:
+    """Bounded change journal with O(log n + k) reads.
+
+    Entries are ``(rev, kind, name)`` with strictly increasing ``rev``, so
+    ``since(rev)`` bisects to the first newer entry instead of scanning the
+    whole window — at 100k nodes the partition windows ladder up to ~1M
+    entries and a full-deque filter per consumer per pass was the dominant
+    steady-state patch cost. Keeps deque(maxlen=cap) eviction semantics
+    exactly: appending past ``maxlen`` drops the single oldest entry
+    (amortized O(1) via a head offset compacted in bulk)."""
+
+    __slots__ = ("maxlen", "_buf", "_revs", "_start")
+
+    def __init__(self, iterable=(), maxlen: int = JOURNAL_CAP):
+        self.maxlen = maxlen
+        self._buf: list[tuple] = list(iterable)[-maxlen:]
+        self._revs: list[int] = [e[0] for e in self._buf]
+        self._start = 0
+
+    def __len__(self) -> int:
+        return len(self._buf) - self._start
+
+    def __iter__(self):
+        return iter(self._buf[self._start:])
+
+    def __getitem__(self, i):
+        if i < 0:
+            return self._buf[i]
+        return self._buf[self._start + i]
+
+    def append(self, entry: tuple) -> None:
+        if len(self._buf) - self._start >= self.maxlen:
+            self._start += 1
+            if self._start >= self.maxlen:  # amortized front compaction
+                del self._buf[: self._start]
+                del self._revs[: self._start]
+                self._start = 0
+        self._buf.append(entry)
+        self._revs.append(entry[0])
+
+    def since(self, rev: int) -> list[tuple]:
+        """Entries with revision strictly greater than ``rev``, oldest
+        first. Callers check their cursor against ``evicted_rev`` BEFORE
+        calling (exactly as they did when this was a deque scan)."""
+        lo = bisect_right(self._revs, rev, self._start)
+        return self._buf[lo:]
+
+
 class _Partition:
     """Per-partition change journal + revision bookkeeping (see Cluster).
 
@@ -100,7 +148,7 @@ class _Partition:
     def __init__(self, key: tuple, cap: int = 1024):
         self.key = key
         self.rev = 0
-        self.journal: deque = deque(maxlen=cap)
+        self.journal: _Journal = _Journal(maxlen=cap)
         self.evicted_rev = 0  # newest global rev lost to the cap
         self.nodes = 0        # live node count (journal-ladder input)
 
@@ -149,7 +197,7 @@ class Cluster:
         self.claims_seq: int = 0
         # Monotonic store revision + bounded change journal (see class doc).
         self.rev: int = 0
-        self._journal: deque = deque(maxlen=JOURNAL_CAP)
+        self._journal: _Journal = _Journal(maxlen=JOURNAL_CAP)
         self._journal_evicted_rev: int = 0  # newest rev lost to the cap
         # Stable (nodepool, zone) partition index: every node maps to one
         # partition, and journal entries route to the partition(s) they
@@ -165,7 +213,7 @@ class Cluster:
         # not roll every quiet partition's window at once — that would be
         # the synchronized full-re-encode cliff the partition split exists
         # to remove. Capped on its own ladder over the claim population.
-        self._claims_journal: deque = deque(maxlen=JOURNAL_CAP)
+        self._claims_journal: _Journal = _Journal(maxlen=JOURNAL_CAP)
         self._claims_evicted_rev: int = 0
         self._claims_rev: int = 0
         # Epoch token: identifies THIS store incarnation. Environment.reset()
@@ -186,14 +234,42 @@ class Cluster:
         # node_name was mutated outside Cluster methods.
         self._pods_index: dict[str, dict[str, Pod]] = {}  # node -> uid -> Pod
         self._pod_node: dict[str, str] = {}               # uid -> indexed node
+        # Incrementally-maintained pending-pod index: pending_pods() is on
+        # every provisioning/scheduling tick and was an O(pods) store scan
+        # per pass (a quiet 255k-pod controller tick paid two of them). The
+        # sanctioned mutation surface keeps it exact; a direct
+        # ``pod.phase = ...`` write elsewhere desyncs POD_BIND_SEQ from the
+        # snapshot below and forces one full rescan (never a stale answer).
+        self._pending_index: dict[str, Pod] = {}
+        self._pending_seq: int = -1
+        # store-position ordinal per pod uid: ``pending_pods()`` must
+        # return STORE (apply) order — the order the legacy full scan
+        # produced and provisioning's packing decisions observe — while
+        # the pending index itself accretes in pendingness-flip order
+        self._pod_ord: dict[str, int] = {}
+        self._pod_ord_next: int = 0
 
     def _now(self) -> float:
         return self.clock.now() if self.clock is not None else 0.0
 
     # -- bound-pod index ---------------------------------------------------
+    def _pending_check(self) -> None:
+        """Disarm the pending index if a pod ``phase``/``node_name`` write
+        happened OUTSIDE the sanctioned surface since the last sync (the
+        next ``pending_pods()`` rescans). Every sanctioned pod mutator
+        calls this BEFORE its own field writes, so its own bumps are never
+        mistaken for foreign ones (callers hold the lock)."""
+        from ..models.pod import POD_BIND_SEQ
+
+        if self._pending_seq >= 0 and POD_BIND_SEQ.v != self._pending_seq:
+            self._pending_seq = -1
+            self._pending_index = {}
+
     def _index_pod(self, pod: Pod) -> None:
-        """Point the bound-pod index at ``pod``'s current binding (callers
-        hold the lock)."""
+        """Point the bound-pod index (and the pending index) at ``pod``'s
+        current binding (callers hold the lock)."""
+        from ..models.pod import POD_BIND_SEQ
+
         target = pod.node_name or ""
         cur = self._pod_node.get(pod.uid)
         if cur is not None and cur != target:
@@ -205,13 +281,24 @@ class Cluster:
             self._pod_node[pod.uid] = target
         else:
             self._pod_node.pop(pod.uid, None)
+        if self._pending_seq >= 0:  # index armed: keep it exact + resynced
+            if pod.is_pending():
+                self._pending_index[pod.uid] = pod
+            else:
+                self._pending_index.pop(pod.uid, None)
+            self._pending_seq = POD_BIND_SEQ.v
 
     def _unindex_pod(self, uid: str) -> None:
+        from ..models.pod import POD_BIND_SEQ
+
         cur = self._pod_node.pop(uid, None)
         if cur is not None:
             bucket = self._pods_index.get(cur)
             if bucket is not None:
                 bucket.pop(uid, None)
+        if self._pending_seq >= 0:
+            self._pending_index.pop(uid, None)
+            self._pending_seq = POD_BIND_SEQ.v
 
     # -- change journal ----------------------------------------------------
     @staticmethod
@@ -232,7 +319,7 @@ class Cluster:
             if cap > j.maxlen:
                 # ladder regrow BEFORE overflow: the window scales with the
                 # partition population instead of silently rolling
-                part.journal = j = deque(j, maxlen=cap)
+                j.maxlen = cap
             else:
                 part.evicted_rev = j[0][0]
         j.append(entry)
@@ -252,7 +339,7 @@ class Cluster:
         if len(j) == j.maxlen:
             cap = journal_cap_for(len(self.nodes) + len(self.pods))
             if cap > j.maxlen:
-                self._journal = j = deque(j, maxlen=cap)
+                j.maxlen = cap
             else:
                 self._journal_evicted_rev = j[0][0]
         entry = (self.rev, kind, name)
@@ -298,7 +385,7 @@ class Cluster:
                 if len(j) == j.maxlen:
                     cap = journal_cap_for(len(self.nodeclaims))
                     if cap > j.maxlen:
-                        self._claims_journal = j = deque(j, maxlen=cap)
+                        j.maxlen = cap
                     else:
                         self._claims_evicted_rev = j[0][0]
                 j.append(entry)
@@ -363,13 +450,11 @@ class Cluster:
                 return None
             out: dict[str, list[str]] = {}
             if part_new:
-                for r, kind, name in part.journal:
-                    if r > rev:
-                        out.setdefault(kind, []).append(name)
+                for _r, kind, name in part.journal.since(rev):
+                    out.setdefault(kind, []).append(name)
             if claims_new:
-                for r, _kind, name in self._claims_journal:
-                    if r > rev:
-                        out.setdefault("claim", []).append(name)
+                for _r, _kind, name in self._claims_journal.since(rev):
+                    out.setdefault("claim", []).append(name)
             return out
 
     def changes_since(self, rev: int) -> Optional[dict[str, list[str]]]:
@@ -386,9 +471,8 @@ class Cluster:
             if rev < self._journal_evicted_rev:
                 return None
             out: dict[str, list[str]] = {}
-            for r, kind, name in self._journal:
-                if r > rev:
-                    out.setdefault(kind, []).append(name)
+            for _r, kind, name in self._journal.since(rev):
+                out.setdefault(kind, []).append(name)
             return out
 
     # -- apply/delete ------------------------------------------------------
@@ -411,7 +495,11 @@ class Cluster:
                 self.nodes[obj.name] = obj
                 self._record("node", obj.name)
             elif isinstance(obj, Pod):
+                self._pending_check()
                 prev = self.pods.get(obj.uid)
+                if prev is None:  # dict overwrite keeps store position
+                    self._pod_ord[obj.uid] = self._pod_ord_next
+                    self._pod_ord_next += 1
                 self.pods[obj.uid] = obj
                 if prev is not None and prev is not obj and prev.node_name:
                     # replacement may move the binding: both nodes dirty
@@ -457,7 +545,9 @@ class Cluster:
                 self.nodes.pop(obj.name, None)
                 self._record("node", obj.name)
             elif isinstance(obj, Pod):
+                self._pending_check()
                 stored = self.pods.pop(obj.uid, None)
+                self._pod_ord.pop(obj.uid, None)
                 self._unindex_pod(obj.uid)
                 node = self.nodes.get(obj.node_name)
                 if node is not None:
@@ -521,8 +611,27 @@ class Cluster:
 
     # -- views -------------------------------------------------------------
     def pending_pods(self) -> list[Pod]:
+        """Pending (schedulable) pods from the incrementally-maintained
+        index: O(pending), not O(pods) — this read is on every
+        provisioning/scheduling tick. Rebuilt from a full scan on first use
+        and whenever POD_BIND_SEQ says a ``phase``/``node_name`` write
+        bypassed the sanctioned surface (see ``_pending_check``)."""
+        from ..models.pod import POD_BIND_SEQ
+
         with self._lock:
-            return [p for p in self.pods.values() if p.is_pending()]
+            if self._pending_seq < 0 or POD_BIND_SEQ.v != self._pending_seq:
+                self._pending_index = {
+                    p.uid: p for p in self.pods.values() if p.is_pending()
+                }
+                self._pending_seq = POD_BIND_SEQ.v
+            out = list(self._pending_index.values())
+            # STORE order, not index-accretion order: a pod that went
+            # pending late (an eviction) must surface at its apply
+            # position, exactly where the legacy full scan returned it —
+            # provisioning's packing is order-sensitive and the replica
+            # chaos envelope is pinned against that order. O(pending).
+            out.sort(key=lambda p: self._pod_ord.get(p.uid, 1 << 62))
+            return out
 
     def node_usage(self) -> dict[str, "object"]:
         """node name -> summed bound-pod requests, in ONE locked pass over
@@ -538,6 +647,7 @@ class Cluster:
 
     def bind_pod(self, pod_uid: str, node_name: str, now: float = 0.0) -> None:
         with self._lock:
+            self._pending_check()
             pod = self.pods[pod_uid]
             old = pod.node_name
             pod.node_name = node_name
@@ -562,6 +672,7 @@ class Cluster:
         stored pod's binding — a direct ``pod.node_name = ...`` write is
         invisible to the change journal and can serve stale tensors."""
         with self._lock:
+            self._pending_check()
             pod = self.pods.get(pod_uid)
             if pod is None:
                 return

@@ -471,3 +471,56 @@ class TestReportStaleMarkers:
         _, stale = select(self._rows())
         note = stale_note(stale["config1"])
         assert "STALE" in note and "cpu/xla-scan" in note
+
+
+class TestChainedChooser:
+    """The measured per-bucket chained-vs-unchained chooser must actually
+    SELECT the cheaper mode — the 2k-node bench row measured chained p50
+    slower than unchained (the inversion), so an unpinned sweep at that
+    bucket has to serve unchained."""
+
+    def test_chooser_selects_unchained_at_the_2k_inversion(self, monkeypatch):
+        from karpenter_provider_aws_tpu.ops.device_state import (
+            note_screen_cost,
+            pick_chained,
+            reset_chained_costs,
+        )
+
+        monkeypatch.delenv("KARPENTER_TPU_CHAINED_SCREEN", raising=False)
+        reset_chained_costs()
+        try:
+            # explore order: chained first, then the un-measured mode
+            assert pick_chained(2000) is True
+            note_screen_cost(2000, True, 323.4)   # the measured inversion
+            assert pick_chained(2000) is False
+            note_screen_cost(2000, False, 308.9)
+            # both measured: the cheaper mode (unchained) serves the bucket
+            assert pick_chained(2000) is False
+            # best-case wins: one slow unchained sweep must not flip it back
+            note_screen_cost(2000, False, 500.0)
+            assert pick_chained(2000) is False
+            # an independent bucket where chained measured cheaper stays
+            # chained (the choice is per node bucket, not global)
+            note_screen_cost(400, True, 10.0)
+            note_screen_cost(400, False, 16.4)
+            assert pick_chained(400) is True
+        finally:
+            reset_chained_costs()
+
+    def test_pin_overrides_measured_costs(self, monkeypatch):
+        from karpenter_provider_aws_tpu.ops.device_state import (
+            note_screen_cost,
+            pick_chained,
+            reset_chained_costs,
+        )
+
+        reset_chained_costs()
+        try:
+            note_screen_cost(2000, True, 400.0)
+            note_screen_cost(2000, False, 100.0)
+            monkeypatch.setenv("KARPENTER_TPU_CHAINED_SCREEN", "1")
+            assert pick_chained(2000) is True
+            monkeypatch.setenv("KARPENTER_TPU_CHAINED_SCREEN", "0")
+            assert pick_chained(2000) is False
+        finally:
+            reset_chained_costs()

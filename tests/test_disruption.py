@@ -417,3 +417,230 @@ class TestRAID0Consolidation:
         )
         assert not rows_blind
         assert rows_aware
+
+
+class TestDirtySweepContract:
+    """The change-journal-driven dirty-set sweep (_DirtyScan) must return
+    the IDENTICAL disruption decision set as the legacy full O(claims)
+    walk — the same contract style as the PR 7 sharded-vs-unsharded
+    ``canonical_equal`` property test, here over the controller's commit
+    log instead of tensors. Claim names come from a process-global
+    sequence, so decisions are compared by creation ORDINAL (stable across
+    the two runs), never by raw name."""
+
+    STEPS = 6
+    N_NODES = 48
+
+    def _churn(self, cl, names, rng, step):
+        from karpenter_provider_aws_tpu.models import labels as lbl
+
+        for _ in range(6):
+            r = rng.rand()
+            if r < 0.40:  # bind a new pod somewhere
+                p = make_pods(
+                    1, f"dsc{step}", {"cpu": "250m", "memory": "512Mi"}
+                )[0]
+                cl.apply(p)
+                cl.bind_pod(p.uid, names[rng.randint(len(names))])
+            elif r < 0.70:  # evict one bound pod
+                bound = [pp for pp in cl.pods.values() if pp.node_name]
+                if bound:
+                    bound.sort(key=lambda pp: pp.name)
+                    cl.unbind_pod(bound[rng.randint(len(bound))].uid)
+            elif r < 0.85:  # drain a whole node (arms emptiness)
+                nd = cl.nodes.get(names[rng.randint(len(names))])
+                if nd is not None:
+                    for pp in list(cl.pods_on_node(nd.name)):
+                        cl.unbind_pod(pp.uid)
+            else:  # flip a do-not-disrupt annotation IN PLACE (a direct
+                # node write the journal never sees — the defensive
+                # node-version scan must catch it in both modes)
+                nd = cl.nodes.get(names[rng.randint(len(names))])
+                if nd is not None:
+                    cur = nd.annotations.get(lbl.ANNOTATION_DO_NOT_DISRUPT)
+                    anns = dict(nd.annotations)
+                    anns[lbl.ANNOTATION_DO_NOT_DISRUPT] = (
+                        "false" if cur == "true" else "true"
+                    )
+                    nd.annotations = anns  # __setattr__ bumps the version
+
+    def _run_mode(self, mode: str, seed: int):
+        import os
+
+        from benchmarks.solve_configs import _synth_cluster
+
+        prev = os.environ.get("KARPENTER_TPU_DISRUPTION_DIRTY")
+        os.environ["KARPENTER_TPU_DISRUPTION_DIRTY"] = mode
+        env = None
+        try:
+            env = _synth_cluster(n_nodes=self.N_NODES, pods_per_node=3)
+            cl = env.cluster
+            pool = cl.nodepools["default"]
+            pool.disruption.consolidation_policy = "WhenUnderutilized"
+            pool.disruption.consolidate_after_s = 60.0
+            pool.disruption.expire_after_s = 500.0  # fires in late steps
+            pool.disruption.budgets = ["10%"]
+            d = env.disruption
+            d.validation_period_s = 0.0
+            # creation-ordinal normalization: synth claims first, any
+            # replacement launched during the run next, in first-seen order
+            ordinal = {
+                name: f"c{i}" for i, name in enumerate(cl.nodeclaims)
+            }
+
+            def norm(name):
+                if name not in ordinal:
+                    ordinal[name] = f"r{len(ordinal)}"
+                return ordinal[name]
+
+            rng = np.random.RandomState(seed)
+            # CREATION order, NOT sorted(): node names embed the process-
+            # global claim sequence, so lexicographic order is different in
+            # the two runs while insertion order is identical
+            names = [n.name for n in cl.snapshot_nodes()]
+            log = []
+            for step in range(self.STEPS):
+                self._churn(cl, names, rng, step)
+                env.clock.advance(100.0)
+                before = len(d.disrupted)
+                d.reconcile()
+                # visit claims in creation order so replacement ordinals
+                # assign deterministically
+                for cname in cl.nodeclaims:
+                    norm(cname)
+                log.append(tuple(
+                    (norm(cn), reason) for cn, reason in d.disrupted[before:]
+                ))
+            deleted = tuple(sorted(
+                norm(c.name) for c in cl.nodeclaims.values() if c.deleted
+            ))
+            return tuple(log), deleted
+        finally:
+            if env is not None:
+                env.close()
+            if prev is None:
+                os.environ.pop("KARPENTER_TPU_DISRUPTION_DIRTY", None)
+            else:
+                os.environ["KARPENTER_TPU_DISRUPTION_DIRTY"] = prev
+
+    @pytest.mark.parametrize("seed", [3, 11, 42])
+    def test_property_randomized_churn_same_decisions(self, seed):
+        dirty = self._run_mode("1", seed)
+        full = self._run_mode("0", seed)
+        assert dirty == full, (
+            f"seed {seed}: dirty-set decisions diverged from the full walk"
+            f"\n dirty: {dirty}\n full:  {full}"
+        )
+
+    def test_property_decisions_are_nonempty_somewhere(self):
+        """Guard against the property test passing vacuously: at least one
+        seed's run must actually disrupt something (expiration at 500s is
+        armed by construction — 6 steps x 100s crosses it)."""
+        log, deleted = self._run_mode("1", 3)
+        assert any(log) or deleted
+
+    def test_overflow_rebuild_path(self):
+        """Rolling the change journal between passes must force the
+        epoch-guarded rebuild (a NEW _DirtyScan), and a real change buried
+        in the overflowed window — a node drained empty — must still be
+        seen by the rebuilt scan."""
+        import os
+
+        from benchmarks.solve_configs import _synth_cluster
+
+        prev = os.environ.get("KARPENTER_TPU_DISRUPTION_DIRTY")
+        os.environ["KARPENTER_TPU_DISRUPTION_DIRTY"] = "1"
+        env = None
+        try:
+            env = _synth_cluster(n_nodes=24, pods_per_node=2)
+            cl = env.cluster
+            pool = cl.nodepools["default"]
+            pool.disruption.consolidation_policy = "WhenEmpty"
+            pool.disruption.consolidate_after_s = 0.0
+            d = env.disruption
+            d.reconcile()
+            ds0 = d._ds
+            assert ds0 is not None
+            rev0 = cl.rev
+            # drain one node empty, then roll the journal right past it
+            victim = next(
+                n.name for n in cl.snapshot_nodes()
+                if cl.pods_on_node(n.name)
+            )
+            empty_claim = next(
+                c.name for c in cl.nodeclaims.values()
+                if c.status.node_name == victim
+            )
+            for pp in list(cl.pods_on_node(victim)):
+                cl.unbind_pod(pp.uid)
+            spin = make_pods(1, "ovf", {"cpu": "100m", "memory": "128Mi"})[0]
+            cl.apply(spin)
+            other = next(
+                n.name for n in cl.snapshot_nodes() if n.name != victim
+            )
+            for _ in range(3000):
+                cl.bind_pod(spin.uid, other)
+                cl.unbind_pod(spin.uid)
+            assert cl.changes_since(rev0) is None  # the window really rolled
+            env.clock.advance(30.0)
+            d.reconcile()
+            assert d._ds is not ds0  # overflow forced a full rebuild
+            assert any(
+                cn == empty_claim and reason == "empty"
+                for cn, reason in d.disrupted
+            ), d.disrupted
+        finally:
+            if env is not None:
+                env.close()
+            if prev is None:
+                os.environ.pop("KARPENTER_TPU_DISRUPTION_DIRTY", None)
+            else:
+                os.environ["KARPENTER_TPU_DISRUPTION_DIRTY"] = prev
+
+
+class TestExpiryHeapSupersededEntries:
+    def test_live_deadline_survives_duplicate_due_entries(self):
+        """A claim with TWO due heap entries (its deadline moved earlier
+        while a stale entry was still queued — e.g. budget-blocked, then
+        the pool's expire_after shortened) must expire via the LIVE
+        entry: the per-name collapse used to keep whichever popped last
+        (the stale one) and silently consume the live entry without a
+        repush."""
+        import os
+
+        from benchmarks.solve_configs import _synth_cluster
+
+        prev = os.environ.get("KARPENTER_TPU_DISRUPTION_DIRTY")
+        os.environ["KARPENTER_TPU_DISRUPTION_DIRTY"] = "1"
+        env = None
+        try:
+            env = _synth_cluster(n_nodes=4, pods_per_node=1)
+            cl = env.cluster
+            pool = cl.nodepools["default"]
+            pool.disruption.consolidation_policy = None
+            pool.disruption.expire_after_s = 1000.0
+            d = env.disruption
+            d.reconcile()
+            ds = d._ds
+            assert ds is not None and ds.expiry_at
+            name = next(iter(ds.expiry_at))
+            import heapq
+
+            stale_dl = ds.expiry_at[name]
+            live_dl = stale_dl - 900.0  # deadline moved EARLIER
+            ds.expiry_at[name] = live_dl
+            heapq.heappush(ds.expiry, (live_dl, name))
+            # both entries due; the stale one pops last (larger deadline)
+            env.clock.advance(1001.0)
+            d.reconcile()
+            assert any(
+                cn == name and reason == "expired"
+                for cn, reason in d.disrupted
+            ), d.disrupted
+        finally:
+            if env is not None:
+                env.close()
+            if prev is None:
+                os.environ.pop("KARPENTER_TPU_DISRUPTION_DIRTY", None)
+            else:
+                os.environ["KARPENTER_TPU_DISRUPTION_DIRTY"] = prev

@@ -365,3 +365,49 @@ class TestEncodeCacheMetrics:
         assert after >= before + 1, (
             f"encode-cache hit counter did not increment ({before} -> {after})"
         )
+
+
+class TestPendingPodIndex:
+    """The incrementally-maintained pending-pod index must be a drop-in
+    for the legacy full scan: same MEMBERSHIP and same STORE ORDER — a
+    pod that goes pending late (an eviction) surfaces at its apply
+    position, not appended at the index's tail. Provisioning's packing is
+    order-sensitive; the 2-replica chaos envelope regressed ~570s of
+    bind p99 when the index leaked accretion order."""
+
+    def _store(self):
+        cl = Cluster()
+        cl.apply(Node(name="n0", capacity={}, allocatable={}))
+        pods = make_pods(5, "ord", {"cpu": "100m"})
+        for p in pods:
+            cl.apply(p)
+        return cl, pods
+
+    def test_membership_and_store_order(self):
+        cl, pods = self._store()
+        assert [p.uid for p in cl.pending_pods()] == [p.uid for p in pods]
+        # bind the SECOND pod, then evict it: it re-enters pendingness
+        # after every other pod, but must still surface at position 1
+        cl.bind_pod(pods[1].uid, "n0")
+        assert [p.uid for p in cl.pending_pods()] == [
+            p.uid for p in pods if p is not pods[1]
+        ]
+        cl.unbind_pod(pods[1].uid)
+        assert [p.uid for p in cl.pending_pods()] == [p.uid for p in pods]
+
+    def test_foreign_write_rescan_keeps_order(self):
+        cl, pods = self._store()
+        assert len(cl.pending_pods()) == 5
+        pods[3].phase = "Succeeded"  # direct write outside the surface
+        got = cl.pending_pods()      # POD_BIND_SEQ forces a full rescan
+        assert [p.uid for p in got] == [
+            p.uid for p in pods if p is not pods[3]
+        ]
+
+    def test_delete_and_reapply_moves_to_store_tail(self):
+        cl, pods = self._store()
+        cl.delete(pods[0])
+        cl.apply(pods[0])  # re-applied: store position moves to the end
+        assert [p.uid for p in cl.pending_pods()] == [
+            p.uid for p in pods[1:] + [pods[0]]
+        ]

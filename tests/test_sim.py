@@ -110,6 +110,22 @@ class TestSLIDiscrimination:
         assert bind["bind_count"] > 0
         assert bind["p50_s"] < bind["p99_s"], bind
 
+    def test_staggered_registration_gives_p50_below_p99(self):
+        """Claim time-to-ready must discriminate too: registration marks a
+        claim Registered AND Initialized in one pass, so a fixed per-wave
+        advance collapses every ready duration to the step size (the
+        p50 == p99 == 5.000 row this satellite retires). Under the
+        staggered-registration workload each wave readies after a
+        different virtual delay and the sub-tick stamps order claims
+        within a pass."""
+        from benchmarks.sli_bench import run_all
+
+        rows = run_all(waves=4, pods_per_wave=20)
+        ready = next(r for r in rows
+                     if r["benchmark"] == "nodeclaim_time_to_ready_sli")
+        assert ready["ready_count"] > 0
+        assert ready["p50_s"] < ready["p99_s"], ready
+
 
 # ---------------------------------------------------------------------------
 # trace grammar
@@ -348,6 +364,77 @@ class TestFleetGate:
         report.save(path)
         rc = gate_main([path, "--baseline", str(BASELINE_PATH)])
         assert rc == 0, report.gate
+
+
+
+class TestBenchGate:
+    """tools/bench_gate.py — the steady-state twin of fleet_gate: gates
+    the measured config9 tick + disruption quiet-pass rows so the PR 10
+    tentpole wins cannot silently regress."""
+
+    def test_check_pure_rules(self):
+        import json
+
+        from bench_gate import check
+
+        lines = [
+            json.dumps({"benchmark": "config9_100k_nodes",
+                        "patch_p50_ms": 900.0, "exactness_ok": True}),
+            # newest row wins (append-only history)
+            json.dumps({"benchmark": "config9_100k_nodes",
+                        "patch_p50_ms": 100.0, "exactness_ok": True,
+                        "provenance": {"backend": "xla-scan"}}),
+        ]
+        budgets = {"rows": {"config9_100k_nodes": {
+            "require_stamp": True,
+            "thresholds": {
+                "patch_p50_ms": {"max": 400.0},
+                "exactness_ok": {"equals": True},
+                "absent_metric": {"max": 1.0, "allow_missing": True},
+            },
+        }}}
+        assert check(lines, budgets) == []
+
+    def test_red_missing_stamped_and_over_budget(self):
+        import json
+
+        from bench_gate import check
+
+        lines = [json.dumps({"benchmark": "config9_100k_nodes",
+                             "patch_p50_ms": 900.0,
+                             "exactness_ok": False})]
+        budgets = {"rows": {
+            "config9_100k_nodes": {
+                "require_stamp": True,
+                "thresholds": {
+                    "patch_p50_ms": {"max": 400.0},
+                    "exactness_ok": {"equals": True},
+                },
+            },
+            "disruption_quiet_pass_10000node": {
+                "thresholds": {"dirty_p50_ms": {"max": 5.0}},
+            },
+        }}
+        metrics = {f["metric"] for f in check(lines, budgets)}
+        assert metrics == {
+            "config9_100k_nodes.provenance",          # unstamped
+            "config9_100k_nodes.patch_p50_ms",        # over ceiling
+            "config9_100k_nodes.exactness_ok",        # inexact
+            "disruption_quiet_pass_10000node",        # row absent entirely
+        }
+
+    def test_shipped_budgets_pass_against_real_detail(self):
+        """The checked-in budget file must pass against the repo's own
+        BENCH_DETAIL.jsonl through the CLI — exactly what `make
+        bench-gate` runs."""
+        from bench_gate import main as gate_main
+
+        rc = gate_main([
+            str(ROOT / "BENCH_DETAIL.jsonl"),
+            "--budgets",
+            str(ROOT / "benchmarks" / "baselines" / "steady-state.json"),
+        ])
+        assert rc == 0
 
 
 # ---------------------------------------------------------------------------

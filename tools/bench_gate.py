@@ -1,0 +1,155 @@
+#!/usr/bin/env python3
+"""bench_gate: threshold BENCH_DETAIL.jsonl rows against a checked-in
+budget file — the steady-state twin of ``tools/fleet_gate.py``.
+
+``fleet_gate`` guards the simulator's SLO envelope; this guards the
+measured steady-state perf budgets (the PR 10 tentpole wins): the
+config9 100k-node tick breakdown (``patch_p50_ms`` / ``solve_lanes_ms``
+/ ``screen_partition_ms`` and their combined budget) and the disruption
+quiet-pass O(dirty) floor. A perf regression that re-inflates any of
+these shows up as a non-zero exit, not a quietly worse bench row.
+
+Budget format (``benchmarks/baselines/*.json``) reuses the fleet_gate
+threshold vocabulary (``max`` / ``min`` / ``equals`` /
+``allow_missing``), grouped per benchmark row name::
+
+    {
+      "description": "...",
+      "rows": {
+        "config9_100k_nodes": {
+          "require_stamp": true,
+          "thresholds": {
+            "patch_p50_ms":        {"max": 400.0},
+            "combined_steady_ms":  {"max": 1000.0},
+            "exactness_ok":        {"equals": true}
+          }
+        },
+        ...
+      }
+    }
+
+For each named row the LATEST matching line of the detail file is
+gated (newest measurement wins — the file is append-only history). A
+row that is entirely missing fails, as does an unstamped row when
+``require_stamp`` is set (absence of evidence must not pass a gate).
+
+Usage::
+
+    python tools/bench_gate.py BENCH_DETAIL.jsonl --budgets benchmarks/baselines/steady-state.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def latest_rows(lines, names) -> dict:
+    """Newest row per benchmark name (the detail file is append-only)."""
+    out: dict = {}
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        name = row.get("benchmark") or row.get("metric")
+        if name in names:
+            out[name] = row
+    return out
+
+
+def check_row(name: str, row, spec: dict) -> list[dict]:
+    """fleet_gate.check's vocabulary applied to one bench row."""
+    failures: list[dict] = []
+    if row is None:
+        return [{
+            "metric": name,
+            "detail": "no row in the detail file (absence of evidence "
+                      "does not pass a gate)",
+        }]
+    if spec.get("require_stamp") and "provenance" not in row:
+        failures.append({
+            "metric": f"{name}.provenance",
+            "detail": "row is unstamped but the budget requires provenance",
+        })
+    for metric, rule in sorted(spec.get("thresholds", {}).items()):
+        value = row.get(metric)
+        if value is None:
+            if not rule.get("allow_missing"):
+                failures.append({
+                    "metric": f"{name}.{metric}",
+                    "detail": "missing from the bench row",
+                })
+            continue
+        if "max" in rule and value > rule["max"]:
+            failures.append({
+                "metric": f"{name}.{metric}", "value": value,
+                "detail": f"{value} > max {rule['max']}",
+            })
+        if "min" in rule and value < rule["min"]:
+            failures.append({
+                "metric": f"{name}.{metric}", "value": value,
+                "detail": f"{value} < min {rule['min']}",
+            })
+        if "equals" in rule and value != rule["equals"]:
+            failures.append({
+                "metric": f"{name}.{metric}", "value": value,
+                "detail": f"{value!r} != {rule['equals']!r}",
+            })
+    return failures
+
+
+def check(lines, budgets: dict) -> list[dict]:
+    """Evaluate every budget row; returns the failure list (empty ==
+    gate passes). Pure, unit-testable — mirrors fleet_gate.check."""
+    rows_spec = budgets.get("rows", {})
+    rows = latest_rows(lines, set(rows_spec))
+    failures: list[dict] = []
+    for name, spec in sorted(rows_spec.items()):
+        failures.extend(check_row(name, rows.get(name), spec))
+    return failures
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tools/bench_gate.py",
+        description="gate BENCH_DETAIL rows against steady-state budgets",
+    )
+    parser.add_argument("detail", help="BENCH_DETAIL.jsonl path")
+    parser.add_argument("--budgets", required=True,
+                        help="budget JSON with per-row thresholds")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the verdict as JSON")
+    args = parser.parse_args(argv)
+
+    with open(args.detail) as f:
+        lines = f.readlines()
+    with open(args.budgets) as f:
+        budgets = json.load(f)
+
+    failures = check(lines, budgets)
+    if args.json:
+        print(json.dumps({"passed": not failures, "failures": failures},
+                         indent=1, sort_keys=True))
+    else:
+        rows = latest_rows(lines, set(budgets.get("rows", {})))
+        for name, spec in sorted(budgets.get("rows", {}).items()):
+            row = rows.get(name, {})
+            shown = {m: row.get(m) for m in spec.get("thresholds", {})}
+            print(f"  {name}: {shown}")
+        if failures:
+            print(f"bench gate FAILED ({len(failures)} regressions) "
+                  f"vs {args.budgets}:")
+            for f_ in failures:
+                print(f"  [FAIL] {f_['metric']}: {f_['detail']}")
+        else:
+            print(f"bench gate passed vs {args.budgets}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
