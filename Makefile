@@ -4,7 +4,7 @@
 
 PYTEST ?= python -m pytest
 
-.PHONY: presubmit test deflake stress e2etests benchmark interruption-bench verify multichip native soak sidecar-client sim-smoke sim-sweep sim-cliff-smoke bench-gate chaos-smoke sim-replica-smoke
+.PHONY: presubmit test deflake stress e2etests benchmark interruption-bench verify multichip native soak sidecar-client sim-smoke sim-sweep sim-cliff-smoke bench-gate bench-optimizer chaos-smoke sim-replica-smoke
 
 presubmit: test multichip  ## everything CI gates on
 
@@ -62,9 +62,13 @@ sim-cliff-smoke:  ## small tier pair through the cliff detector — zero finding
 	JAX_PLATFORMS=cpu python -m karpenter_provider_aws_tpu.sim sweep \
 		--trace smoke --seed 0 --tiers 300,600
 
-bench-gate:  ## steady-state perf budgets (config9 tick + disruption quiet pass) vs measured rows
+bench-gate:  ## steady-state perf budgets (config9 tick + disruption quiet pass + optimizer lane) vs measured rows
 	python tools/bench_gate.py BENCH_DETAIL.jsonl \
 		--budgets benchmarks/baselines/steady-state.json
+
+bench-optimizer:  ## optimizer-lane evidence rows (config6 family) -> BENCH_DETAIL.jsonl, then the gate
+	JAX_PLATFORMS=cpu BENCH_FORCE_CPU=1 python bench.py --child=optimizer
+	$(MAKE) bench-gate
 
 chaos-smoke:  ## every canned chaos scenario (incl. replica-loss), run twice, determinism diffed
 	JAX_PLATFORMS=cpu python -m karpenter_provider_aws_tpu.chaos --all --seed 0

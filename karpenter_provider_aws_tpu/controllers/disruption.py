@@ -1209,17 +1209,55 @@ class DisruptionController:
     MAX_REPLACE_SET = 16  # bound the N of N->1 (stale-snapshot risk grows with N)
     REPLACE_MARGIN = 0.15
 
+    def _eval_replace_set(self, ct, subset, pool_name, pools, ncmap):
+        """Score one candidate set for N->1 replace: ``(net_saving, subset,
+        rep, overflow, set_price)`` when the set overflows onto a cheaper
+        single node, else None. Pure evaluation — the authoritative
+        feasibility pair (``repack_set_feasible`` + the margin check inside
+        ``replacement_for_groups``) — so the optimizer subset chooser and
+        the prefix walk share one enforcement point."""
+        from ..ops.consolidate import replacement_for_groups
+
+        free_over = repack_set_feasible(ct, subset, allow_overflow=True)
+        _, overflow = free_over
+        if not overflow:
+            return None  # pure delete set; phase 1 owns those
+        set_price = float(sum(ct.price[i] for i in subset))
+        rep = replacement_for_groups(
+            ct, overflow, self.cloudprovider.catalog, pool_name,
+            nodepools=dict(pools), margin=self.REPLACE_MARGIN,
+            price_cap=set_price,
+            nodeclass_by_pool=ncmap,
+            set_has_spot=any(
+                ct.node_captype[i] == lbl.CAPACITY_TYPE_SPOT
+                for i in subset
+            ) if ct.node_captype else False,
+            spot_to_spot=self.spot_to_spot,
+        )
+        if rep is None:
+            return None
+        return (set_price - float(rep[1]), subset, rep, overflow, set_price)
+
     def _multi_node_replace(self, ct, candidates, budget, pools,
                             flags: Optional[dict] = None) -> bool:
         """Try replacing a cost-ordered candidate SET with one cheaper node.
 
-        Per pool (the replacement must belong to one pool), largest set
-        first: pods repack onto survivors with the overflow priced onto a
-        single new node; accepted when that node costs < (1 - margin) x the
-        set's combined price. Launch-before-delete, budget-aware, reserved
-        offerings untouched (replacement_for_groups). Returns True when a
-        replacement committed (snapshot is then stale — end the pass)."""
-        from ..ops.consolidate import replacement_for_groups
+        Per pool (the replacement must belong to one pool), pods repack
+        onto survivors with the overflow priced onto a single new node;
+        accepted when that node costs < (1 - margin) x the set's combined
+        price. Launch-before-delete, budget-aware, reserved offerings
+        untouched (replacement_for_groups). Returns True when a
+        replacement committed (snapshot is then stale — end the pass).
+
+        Chooser: with the optimizer lane enabled (KARPENTER_TPU_OPTIMIZER,
+        default on) every cost-ordered prefix PLUS the seeded price-biased
+        subset proposals (``ops.consolidate.optimizer_replace_sets``) are
+        scored and the largest net $/hr saving commits — the prefix walk
+        alone cannot see a replaceable set that skips a blocking middle
+        candidate. With the kill switch thrown, the legacy largest-prefix-
+        first walk runs byte-identically."""
+        from ..ops.consolidate import optimizer_replace_sets
+        from ..scheduling.optimizer import count_outcome, optimizer_enabled
 
         by_pool: dict[str, list[int]] = {}
         for ni in candidates:
@@ -1232,26 +1270,35 @@ class DisruptionController:
             )
             if flags is not None and top < min(len(cand), self.MAX_REPLACE_SET):
                 flags["active"] = True  # budget-capped: window may reopen
-            for m in range(top, 1, -1):
-                subset = cand[:m]
-                free_over = repack_set_feasible(ct, subset, allow_overflow=True)
-                _, overflow = free_over
-                if not overflow:
-                    continue  # pure delete set; phase 1 owns those
-                set_price = float(sum(ct.price[i] for i in subset))
-                rep = replacement_for_groups(
-                    ct, overflow, self.cloudprovider.catalog, pool_name,
-                    nodepools=dict(pools), margin=self.REPLACE_MARGIN,
-                    price_cap=set_price,
-                    nodeclass_by_pool=ncmap,
-                    set_has_spot=any(
-                        ct.node_captype[i] == lbl.CAPACITY_TYPE_SPOT
-                        for i in subset
-                    ) if ct.node_captype else False,
-                    spot_to_spot=self.spot_to_spot,
+            prefixes = [cand[:m] for m in range(top, 1, -1)]
+            if optimizer_enabled():
+                # set equality, not tuple order: proposals come back
+                # numerically sorted while prefixes keep cost order — a
+                # set-equal proposal must dedup (else the expensive eval
+                # runs twice and consolidation_adopted over-counts)
+                prefix_keys = {frozenset(s) for s in prefixes}
+                proposed = [
+                    s for s in optimizer_replace_sets(ct, cand[:top])
+                    if frozenset(s) not in prefix_keys
+                ]
+                opt_keys = {frozenset(s) for s in proposed}
+                scored = []
+                for subset in proposed + prefixes:
+                    ev = self._eval_replace_set(ct, subset, pool_name, pools, ncmap)
+                    if ev is not None:
+                        scored.append(ev)
+                # biggest saving first; ties prefer the larger set, then the
+                # stable proposal order (deterministic per snapshot)
+                scored.sort(key=lambda e: (-e[0], -len(e[1])))
+                trials = scored
+            else:
+                opt_keys = set()
+                trials = (
+                    ev for subset in prefixes
+                    if (ev := self._eval_replace_set(
+                        ct, subset, pool_name, pools, ncmap)) is not None
                 )
-                if rep is None:
-                    continue
+            for _net, subset, rep, overflow, set_price in trials:
                 type_name, new_price, offering_options = rep
                 claims = [
                     self.cluster.nodeclaims.get(
@@ -1313,6 +1360,11 @@ class DisruptionController:
                         claim, f"consolidatable:multi-replace->{type_name}",
                         budget, detail=multi_detail,
                     )
+                if frozenset(subset) in opt_keys:
+                    # the committed set came from the optimizer's subset
+                    # search, not the prefix walk — provenance for the
+                    # "fragmentation money lives in multi-replace" claim
+                    count_outcome("consolidation_adopted")
                 return True
         return False
 

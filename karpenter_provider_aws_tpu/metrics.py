@@ -423,6 +423,14 @@ SOLVE_COST_VS_ORACLE = REGISTRY.gauge(
     "(scheduling/oracle.py; sampled off the hot path, pure-launch "
     "passes only — ~1.0 means the device plan matches the oracle)",
 )
+OPTIMIZER_LANE = REGISTRY.counter(
+    "karpenter_optimizer_lane_total",
+    "Optimizer-lane outcomes per solve (scheduling/optimizer.py): "
+    "adopted, rejected, skipped_tight (FFD within 1% of the LP bound), "
+    "skipped_existing (plan binds live slack), skipped_large (group axis "
+    "past the dispatch ceiling), breaker_open, error, and "
+    "consolidation_adopted (the multi-replace subset chooser)",
+)
 UNSCHEDULABLE_PODS = REGISTRY.counter(
     "karpenter_solver_unschedulable_pods_total",
     "Pods a solve pass left unschedulable (solver-quality SLI; the "

@@ -9,13 +9,16 @@ Two layers:
    ``ProvenanceRecord.quality`` and exported as gauges.
 
  - **Sampled (off the hot path):** ``OracleSampler`` replays the pending
-   set through the pure-numpy FFD oracle (``scheduling/oracle.py``) and
-   publishes ``karpenter_solver_cost_vs_oracle`` — committed cost over the
-   oracle's cost. Sampling is keyed on the cluster ``(epoch, rev)`` token:
-   an unchanged pass NEVER re-runs the oracle (the <1ms warm-pass
-   contract), and pure-launch passes only (binds to existing capacity
-   make the all-new-nodes oracle incomparable). ``KARPENTER_TPU_ORACLE_SAMPLE=0``
-   disables outright.
+   set through the pure-numpy FFD oracle (``scheduling/oracle.py``) — one
+   weight-ordered pool sweep with fall-through, mirroring the solver's
+   multi-nodepool walk — and publishes
+   ``karpenter_solver_cost_vs_oracle``: committed cost over the oracle's
+   cost. Sampling is keyed on the cluster ``(epoch, rev)`` token: an
+   unchanged pass NEVER re-runs the oracle (the <1ms warm-pass contract),
+   and pure-launch passes only (binds to existing capacity make the
+   all-new-nodes oracle incomparable). With the optimizer lane adopted,
+   the sampled gap drops BELOW 1.0 — the witness that the global plan
+   beat the greedy. ``KARPENTER_TPU_ORACLE_SAMPLE=0`` disables outright.
 """
 
 from __future__ import annotations
@@ -94,7 +97,13 @@ def solve_quality(result, catalog) -> dict:
 
 
 class OracleSampler:
-    """Price-optimality gap vs the FFD oracle, sampled off the hot path."""
+    """Price-optimality gap vs the FFD oracle, sampled off the hot path.
+
+    Multi-pool aware: the oracle replays the SAME weight-ordered pool
+    sweep the solver runs (pods a pool's oracle cannot place fall through
+    to the next pool), so ``cost_vs_oracle`` measures exactly the
+    pure-launch passes the optimizer lane targets — single-pool floods
+    AND the multi-pool mixed fleets where fragmentation money lives."""
 
     def __init__(self):
         self._last_key: Optional[tuple] = None
@@ -108,9 +117,11 @@ class OracleSampler:
 
         Skips when: disabled, the cluster ``(epoch, rev)`` is unchanged
         since the last sample (identical passes pay nothing), the plan
-        binds to existing capacity (oracle incomparable), nothing
-        launched, or more than one nodepool competed (the oracle is
-        single-pool)."""
+        binds to existing capacity (oracle incomparable), or nothing
+        launched. Pool order and fall-through mirror
+        ``scheduling.solver._solve_multi_nodepool``; the per-pool encode
+        hits the revision-keyed problem cache for the first pool, so a
+        single-pool sample stays as cheap as it was."""
         if os.environ.get("KARPENTER_TPU_ORACLE_SAMPLE", "1") != "1":
             return None
         key = (
@@ -120,27 +131,48 @@ class OracleSampler:
         if key == self._last_key:
             return None
         self._last_key = key
-        if result.binds or not result.node_specs or len(nodepools) != 1:
+        if result.binds or not result.node_specs or not nodepools:
             return None
         try:
             from ..ops.encode import encode_problem
             from ..scheduling.oracle import ffd_oracle, oracle_cost
 
-            pool = nodepools[0]
-            # same arguments as the solve's own encode, so the revision-
-            # keyed problem cache almost always serves this for free
-            problem = encode_problem(
-                pods, catalog, nodepool=pool, occupancy=occupancy,
-                allowed_types=(type_allow or {}).get(pool.name),
-                allow_reserved=(
-                    reserved_allow.get(pool.name, False)
-                    if reserved_allow is not None else True
-                ),
-                nodeclass=(nodeclass_by_pool or {}).get(pool.name),
-                revision=revision,
-            )
-            nodes, _unplaced = ffd_oracle(problem)
-            base = oracle_cost(nodes)
+            base = 0.0
+            remaining = list(pods)
+            first = True
+            for pool in sorted(nodepools, key=lambda p: -p.weight):
+                if not remaining:
+                    break
+                # First pool: same arguments as the solve's own encode, so
+                # the revision-keyed problem cache serves it free. LATER
+                # pools get revision=None — the fall-through pod list is
+                # NOT a pure function of the revision (the cache contract,
+                # ops/encode.py: the revision path collapses the pods key
+                # to (rev, len, id(first))), and the solver's own chained
+                # pool problems could collide with it.
+                problem = encode_problem(
+                    remaining, catalog, nodepool=pool, occupancy=occupancy,
+                    allowed_types=(type_allow or {}).get(pool.name),
+                    allow_reserved=(
+                        reserved_allow.get(pool.name, False)
+                        if reserved_allow is not None else True
+                    ),
+                    nodeclass=(nodeclass_by_pool or {}).get(pool.name),
+                    revision=revision if first else None,
+                )
+                first = False
+                nodes, unplaced = ffd_oracle(problem)
+                base += oracle_cost(nodes)
+                # fall-through: unencodable pods + each group's unplaced
+                # tail ride to the next pool, like the solver's pool sweep
+                leftover = [p for p, _why in problem.unencodable]
+                for g, cnt in unplaced.items():
+                    plist = problem.group_pods[g]
+                    if problem.atomic is not None and problem.atomic[g]:
+                        leftover.extend(plist)
+                    else:
+                        leftover.extend(plist[len(plist) - cnt:])
+                remaining = leftover
             if base <= 0:
                 return None
             gap = float(result.total_cost) / base

@@ -782,6 +782,14 @@ class TPUSolver:
         self._dev_cache_budget = int(
             os.environ.get("KARPENTER_TPU_DEVCACHE_MB", "256")
         ) * (1 << 20)
+        # Optimizer-lane admission memory: last measured FFD-cost/LP-bound
+        # gap per problem signature. A signature whose previous solve was
+        # within the tight threshold skips the lane dispatch outright
+        # (outcome=skipped_tight) — the bound PROVES there is no money on
+        # the table, and reconcile loops re-solve near-identical problems.
+        self._opt_gap_hist: dict[tuple, float] = {}
+        # cumulative lane outcomes for provenance (adopted/rejected)
+        self._opt_counts = {"adopted": 0, "rejected": 0}
         # FFD backend: "auto" resolves to the Pallas kernel on TPU (VMEM-
         # resident state, one kernel for the whole group scan) and the XLA
         # scan elsewhere; KARPENTER_TPU_FFD forces xla / pallas /
@@ -800,9 +808,13 @@ class TPUSolver:
         host FFD) did the work."""
         if self.timings.get("degraded"):
             return "host-ffd(degraded)"
+        # the optimizer lane's plan shipped this solve: the bench row must
+        # say the global optimizer priced it, not the greedy alone
+        opt = "+opt-lp" if self.timings.get("opt_lane") == "adopted" else ""
         if "pallas_fallback" in self.timings:
-            return "xla-scan(pallas-fallback)"
-        return {"xla": "xla-scan"}.get(self._resolved_mode(), self._resolved_mode())
+            return "xla-scan(pallas-fallback)" + opt
+        base = {"xla": "xla-scan"}.get(self._resolved_mode(), self._resolved_mode())
+        return base + opt
 
     def _resolved_mode(self) -> str:
         mode = self._ffd_mode
@@ -1038,13 +1050,18 @@ class TPUSolver:
             existing = m["existing"]
             pre_extra = m["pre_extra"]
             N_lane = NR - pre_extra
+            # optimizer lane per partition/pool problem, enqueued after the
+            # whole FFD lane batch (concurrent through the same boundary)
+            opt = self._maybe_dispatch_optimizer(
+                problem, m["padded"], N_lane, m["n_pre"], m["hist_key"],
+            )
 
             def fetch_refs(dd, _k=k):
                 return fetch_all()[_k], (dd["placed_dev"], dd["state"])
 
             def _wait_lane(_m=m, _handles=handles, _fetch=fetch_refs,
                            _N=N_lane, _pre_extra=pre_extra,
-                           _problem=problem, _existing=existing):
+                           _problem=problem, _existing=existing, _opt=opt):
                 try:
                     # N_cap == N: a row-exhausted lane skips the in-wait
                     # retry and its leftover pods ride the multi-pool
@@ -1058,7 +1075,11 @@ class TPUSolver:
                 except Exception as e:
                     return self._device_failed(_problem, _existing, e)
                 self._device_breaker().record_success()
-                return out
+                # adoption contract applies per lane; a lane failure
+                # degrades the LANE, never the solve
+                return self._optimizer_arbitrate(
+                    _problem, out, _opt, _m["hist_key"],
+                )
 
             pendings.append(_PendingSolve(wait=_wait_lane))
         return pendings
@@ -1165,6 +1186,185 @@ class TPUSolver:
         self.timings["degraded"] = "host-ffd"
         self.timings["residency"] = "fallback"
         return host_solve_encoded(problem, existing)
+
+    def _maybe_dispatch_optimizer(self, problem, padded, n_rows: int,
+                                  n_pre: int, hist_key) -> Optional[dict]:
+        """Enqueue the optimizer lane's device program NEXT TO the FFD scan
+        (both are in flight before any transfer round trip is paid — the
+        PR 7 pending-solve boundary). Returns the device refs, or None with
+        the skip outcome counted (``karpenter_optimizer_lane_total``).
+
+        The lane never gates the solve: a dispatch failure (including a
+        chaos ``DeviceLost`` on the ``optimizer`` faultgate backend) feeds
+        the ``solver.optimizer`` breaker and the FFD plan serves alone."""
+        from . import optimizer as _opt
+        from ..resilience import breakers as _rbreakers
+
+        if not _opt.optimizer_enabled():
+            # kill switch: byte-identical FFD-only plans, nothing dispatched
+            return None
+        if len(problem.group_pods) == 0:
+            return None
+        if len(problem.group_pods) > _opt.max_groups():
+            # bulk placements amortize greedy tails (cost_vs_lp_bound ~1.0
+            # at scale) — K x lanes there is device time for no win
+            self.timings["opt_lane"] = "skipped_large"
+            _opt.count_outcome("skipped_large")
+            return None
+        if n_pre > 0:
+            # pure-launch passes only: a plan binding onto existing slack
+            # is incomparable to the lane's all-fresh repack
+            self.timings["opt_lane"] = "skipped_existing"
+            _opt.count_outcome("skipped_existing")
+            return None
+        # content-digested key: a tight HOMOGENEOUS wave sharing this
+        # problem's shape buckets must not suppress the lane on a
+        # FRAGMENTED burst of the same size (optimizer.gap_key)
+        gap = self._opt_gap_hist.get(_opt.gap_key(problem, hist_key))
+        if gap is not None and gap <= _opt.tight_threshold():
+            self.timings["opt_lane"] = "skipped_tight"
+            _opt.count_outcome("skipped_tight")
+            return None
+        br = _rbreakers.get("solver.optimizer")
+        if not br.allow():
+            self.timings["opt_lane"] = "breaker_open"
+            _opt.count_outcome("breaker_open")
+            return None
+        try:
+            out = _opt.dispatch_optimizer(padded, n_rows, dput=self._dput)
+            out["GB"] = padded.requests.shape[0]
+            return out
+        except Exception as e:
+            br.record_failure(e)
+            self.timings["opt_lane"] = "error"
+            _opt.count_outcome("error")
+            _solver_log().warning(
+                "optimizer lane dispatch failed; serving FFD only: %s: %s",
+                type(e).__name__, e,
+            )
+            return None
+
+    def _optimizer_arbitrate(self, problem, ffd_out, opt: Optional[dict],
+                             hist_key) -> tuple:
+        """The adoption contract (designs/optimizer-lane.md): fetch the
+        lane's best plan, validate it host-side (``optimizer.validate_plan``),
+        run the SAME packed-cost descent the FFD plan got, and serve it only
+        when it prices strictly cheaper while placing at least as many pods.
+        Every other outcome — including any lane failure — returns the FFD
+        plan unchanged, so the lane can only ever subtract cost.
+
+        Also promotes the LP lower bound into provenance (``lp_gap``) and
+        the per-signature admission memory, whether or not a lane ran."""
+        from . import optimizer as _opt
+        from ..resilience import breakers as _rbreakers
+
+        specs, binds, unplaced = ffd_out
+        G = len(problem.group_pods)
+        ffd_cost = float(sum(s.estimated_price for s in specs))
+        gap = None
+        # the bound is O(G x T x R) host numpy (memoized per problem
+        # object, so revision-cached steady passes pay a dict hit): paid
+        # willingly when a lane is in flight (it IS the admission signal),
+        # otherwise only under the lp_gap stamp knob and a size cap — a
+        # 100k-tier churn tick must not buy telemetry with hot-path ms
+        want_gap = opt is not None or (
+            os.environ.get("KARPENTER_TPU_LP_GAP", "1") == "1"
+            and problem.price.size <= 4_000_000
+        )
+        if not binds and specs and want_gap:
+            try:
+                bound = _opt.lp_bound_for(problem)
+                if bound > 0 and ffd_cost > 0:
+                    gap = ffd_cost / bound
+                    self.timings["lp_gap"] = round(gap, 4)
+                    if len(self._opt_gap_hist) > 4096:
+                        # content-digested keys are unbounded under churn
+                        # (unlike the shape-bucket hists) — bound the memory
+                        self._opt_gap_hist.clear()
+                    self._opt_gap_hist[_opt.gap_key(problem, hist_key)] = gap
+            except Exception:  # the stamp must never take down the solve
+                pass
+        if opt is None:
+            return ffd_out
+        br = _rbreakers.get("solver.optimizer")
+        try:
+            import jax
+
+            t0 = time.perf_counter()
+            (costs, best_cost, node_type, node_price, n_open, node_window,
+             unplaced_arr, nz, nz_cnt, total_nz) = jax.device_get(opt["refs"])
+            n_open = int(n_open)
+            rows = opt["rows"]
+            GB = opt["GB"]
+            if int(total_nz) > nz.shape[0]:
+                placed = np.asarray(
+                    jax.device_get(opt["placed_dev"]), dtype=np.int32
+                )
+            else:
+                placed = np.zeros((GB, rows), dtype=np.int32)
+                valid = nz >= 0
+                placed.reshape(-1)[nz[valid]] = nz_cnt[valid]
+            unplaced_arr = np.asarray(unplaced_arr)[:G]
+            node_type = np.asarray(node_type, dtype=np.int64).copy()
+            node_price = np.asarray(node_price, dtype=np.float32).copy()
+            node_window = np.array(node_window)
+            used = placed[:G].T.astype(np.float32) @ problem.requests[:G]
+            # used=None: the validator's used-consistency branch would
+            # compare a product of the same inputs we just computed —
+            # vacuous here; it exists for callers with a fetched tensor
+            ok, why = _opt.validate_plan(
+                problem, node_type, node_price, None, placed, node_window,
+                n_open, unplaced_arr,
+            )
+            if not ok:
+                br.record_success()  # algorithmic miss, not a device failure
+                self.timings["opt_lane"] = f"rejected:{why}"[:80]
+                self._opt_counts["rejected"] += 1
+                _opt.count_outcome("rejected")
+                return ffd_out
+            node_cap = problem.capacity[node_type]
+            _refine_plan(
+                problem, node_type, node_price, used, node_window, placed,
+                n_open, node_cap=node_cap,
+            )
+            opt_specs, _ = _decode_nodes(
+                problem, node_type, node_price, used, n_open, placed,
+                problem.nodepool.name if problem.nodepool else "",
+                node_window,
+            )
+            br.record_success()
+            self.timings["opt_ms"] = self.timings.get("opt_ms", 0.0) + (
+                (time.perf_counter() - t0) * 1e3
+            )
+            opt_cost = float(sum(s.estimated_price for s in opt_specs))
+            opt_placed = sum(len(s.pods) for s in opt_specs)
+            ffd_placed = sum(len(s.pods) for s in specs)
+            margin = max(1e-6, 1e-6 * ffd_cost)
+            if opt_cost < ffd_cost - margin and opt_placed >= ffd_placed:
+                self.timings["opt_lane"] = "adopted"
+                self.timings["opt_saving"] = round(ffd_cost - opt_cost, 6)
+                # the admission memory keeps the FFD gap (not the adopted
+                # plan's): skipped_tight asks "is the GREEDY already within
+                # 1% of the bound" — a winning lane is the opposite signal
+                self._opt_counts["adopted"] += 1
+                _opt.count_outcome("adopted")
+                opt_unplaced = {
+                    g: int(c) for g, c in enumerate(unplaced_arr) if c > 0
+                }
+                return opt_specs, binds, opt_unplaced
+            self.timings["opt_lane"] = "rejected"
+            self._opt_counts["rejected"] += 1
+            _opt.count_outcome("rejected")
+            return ffd_out
+        except Exception as e:
+            br.record_failure(e)
+            self.timings["opt_lane"] = "error"
+            _opt.count_outcome("error")
+            _solver_log().warning(
+                "optimizer lane failed at fetch/validate; serving the FFD "
+                "plan: %s: %s", type(e).__name__, e,
+            )
+            return ffd_out
 
     def _dispatch_device(
         self, problem: EncodedProblem, existing: Optional[Sequence[ExistingNode]] = None,
@@ -1501,13 +1701,22 @@ class TPUSolver:
         pre_extra = bucket(n_pre, minimum=256) if n_pre else 0
         t_dev = time.perf_counter()
         pending = dispatch(N + pre_extra)
+        # Optimizer lane: enqueued AFTER the FFD program (same device
+        # stream, same content-cached input tensors) so both are in flight
+        # before the first transfer round trip; arbitration at wait time
+        # adopts the lane's plan only under the strict-cheaper contract.
+        opt = self._maybe_dispatch_optimizer(problem, padded, N, n_pre, hist_key)
         # the PendingSolve boundary: everything above is pure dispatch (no
         # transfer round trip yet); _wait below fetches + decodes. A
         # multi-pool solve dispatches every pool before waiting on any.
         return _PendingSolve(
-            wait=lambda: self._wait(
-                problem, pending, fetch_refs, run, N, N_cap, pre_extra,
-                hist_key, pre_rows, names, n_pre, GB, t_dev,
+            wait=lambda: self._optimizer_arbitrate(
+                problem,
+                self._wait(
+                    problem, pending, fetch_refs, run, N, N_cap, pre_extra,
+                    hist_key, pre_rows, names, n_pre, GB, t_dev,
+                ),
+                opt, hist_key,
             )
         )
 
@@ -1577,6 +1786,7 @@ class TPUSolver:
             self._nz_hist.clear()
             self._refine_zero_streak.clear()
             self._refine_skip_ctr.clear()
+            self._opt_gap_hist.clear()
         # Commit-downsize (SURVEY section 7.3's cost refinement, step 1):
         # re-commit each fresh node to the cheapest type its FINAL packed
         # load fits (ranked[0] — feasibility, window, and the exotic filter
@@ -2035,6 +2245,19 @@ def _solve_multi_nodepool(
         )
     result.total_cost = float(sum(s.estimated_price for s in result.node_specs))
     result.solve_seconds = time.perf_counter() - t0
+    extra_scale = {
+        "nodepools": len(nodepools),
+        "node_specs": len(result.node_specs),
+        "binds": len(result.binds),
+        "unschedulable": len(result.unschedulable),
+    }
+    # optimizer-lane adopted/rejected counts ride every record the solver
+    # stamps, so a bench row can never claim the lane ran (or didn't)
+    # without the numbers to prove it
+    opt_counts = getattr(impl, "_opt_counts", None)
+    if opt_counts is not None and (opt_counts["adopted"] or opt_counts["rejected"]):
+        extra_scale["opt_adopted"] = opt_counts["adopted"]
+        extra_scale["opt_rejected"] = opt_counts["rejected"]
     result.provenance = solve_record(
         backend=(
             impl.backend_label() if hasattr(impl, "backend_label") else "host"
@@ -2042,12 +2265,7 @@ def _solve_multi_nodepool(
         timings=getattr(impl, "timings", None),
         num_pods=len(pods),
         wall_ms=result.solve_seconds * 1e3,
-        extra_scale={
-            "nodepools": len(nodepools),
-            "node_specs": len(result.node_specs),
-            "binds": len(result.binds),
-            "unschedulable": len(result.unschedulable),
-        },
+        extra_scale=extra_scale,
     )
     # answer-quality stamp (packing efficiency, unschedulable rate,
     # fallback) on the SAME provenance record every consumer reads —
@@ -2055,4 +2273,48 @@ def _solve_multi_nodepool(
     from ..obs.quality import solve_quality
 
     solve_quality(result, catalog)
+    # lp_gap promotion: committed cost over the LP fractional lower bound,
+    # the in-band optimality witness the optimizer lane admits on. The
+    # device solver stamps it from the arbitration pass; the host path
+    # computes it here for single-pool pure-launch solves (the encode is
+    # revision-cached and the bound memoized on the problem object, so a
+    # warm pass pays a dict lookup).
+    prov = result.provenance
+    if (
+        prov is not None
+        and "lp_gap" not in prov.quality
+        and os.environ.get("KARPENTER_TPU_LP_GAP", "1") == "1"
+    ):
+        timings = getattr(impl, "timings", None) or {}
+        gap = timings.get("lp_gap")
+        if gap is None and (
+            len(nodepools) == 1 and not result.binds and result.node_specs
+            and not result.unschedulable and result.total_cost > 0
+            and len(pods) <= 100_000
+            # the degraded fallback path stays telemetry-free: a solve
+            # that just survived a device failure must not buy a stamp
+            # with extra host ms
+            and not timings.get("degraded")
+        ):
+            try:
+                from .optimizer import lp_bound_for
+
+                pool = list(nodepools)[0]
+                problem = encode_problem(
+                    pods, catalog, nodepool=pool, occupancy=occupancy,
+                    allowed_types=(type_allow or {}).get(pool.name),
+                    allow_reserved=(
+                        reserved_allow.get(pool.name, False)
+                        if reserved_allow is not None else True
+                    ),
+                    nodeclass=(nodeclass_by_pool or {}).get(pool.name),
+                    revision=revision,
+                )
+                bound = lp_bound_for(problem)
+                if bound > 0:
+                    gap = round(result.total_cost / bound, 4)
+            except Exception:  # pragma: no cover - stamp is best-effort
+                gap = None
+        if isinstance(gap, (int, float)):
+            prov.quality["lp_gap"] = round(float(gap), 4)
     return result

@@ -41,6 +41,18 @@ WAVE_SHAPES = (
     ("500m", "2Gi"), ("1000m", "2Gi"), ("1000m", "4Gi"), ("2000m", "4Gi"),
 )
 
+#: fragmentation burst shapes: (tall, wide) pairs sized so a greedy FFD
+#: interleaves singleton tail nodes — tall pods bind on cpu (~2 fit a
+#: 16-vcpu node), wide pods bind on memory. Odd counts of each, arriving
+#: together, are the config6/config8 failure mode the optimizer lane
+#: exists to repack (designs/optimizer-lane.md); the `frag` trace makes
+#: that workload a seeded, reproducible simulator input.
+FRAG_SHAPES = (
+    (("7000m", "6Gi"), ("1500m", "12Gi")),
+    (("6000m", "4Gi"), ("2000m", "14Gi")),
+    (("5000m", "8Gi"), ("1000m", "10Gi")),
+)
+
 
 @dataclass
 class SimEvent:
@@ -144,6 +156,12 @@ class TraceSpec:
     # pod churn
     churn_every_s: float = 1800.0
     churn_pods: int = 16
+    # fragmentation bursts: paired tall/wide waves with seeded ODD counts
+    # (FRAG_SHAPES) that a greedy FFD packs into interleaved singleton
+    # tails — the optimizer lane's target workload. 0 = off.
+    frag_every_s: float = 0.0
+    frag_pods: int = 24
+    frag_ttl_s: float = 3600.0
     # deliberate SLO regression (the red-gate injection): every wave also
     # lands this many pods NO node shape can serve — each solve pass they
     # pend is a solve-success SLO miss and an unschedulable-rate hit
@@ -164,7 +182,8 @@ class TraceSpec:
                 "waves_per_hour", "wave_pods", "wave_ttl_s",
                 "diurnal_amplitude", "peak_hour", "floods", "flood_pods",
                 "flood_cpu", "flood_memory", "flood_ttl_s", "churn_every_s",
-                "churn_pods", "unschedulable_per_wave", "consolidate_after_s",
+                "churn_pods", "frag_every_s", "frag_pods", "frag_ttl_s",
+                "unschedulable_per_wave", "consolidate_after_s",
             )
         }
         d["consolidation_budgets"] = list(self.consolidation_budgets)
@@ -210,6 +229,19 @@ def canned_traces() -> dict[str, TraceSpec]:
             waves_per_hour=1.0, wave_pods=48, wave_ttl_s=4 * 3600.0,
             floods=2, flood_pods=96, churn_every_s=3600.0, churn_pods=24,
             settle_reconciles=60,
+        ),
+        # fragmentation: paired tall/wide odd-count bursts the greedy FFD
+        # packs into interleaved singleton tails — the seeded reproducible
+        # workload behind the optimizer lane's headline bench rows
+        # (benchmarks/optimizer_bench.py builds its solve problems from
+        # exactly these events)
+        "frag": TraceSpec(
+            name="frag", nodes=300, duration_s=2 * 3600.0,
+            heartbeat_s=600.0, sample_every_s=900.0,
+            waves_per_hour=1.0, wave_pods=16, wave_ttl_s=3600.0,
+            floods=0, churn_every_s=0.0, churn_pods=0,
+            frag_every_s=1200.0, frag_pods=28, frag_ttl_s=3000.0,
+            settle_reconciles=40,
         ),
         # batch-heavy: big floods dominate, waves are background noise
         "flood-day": TraceSpec(
@@ -288,6 +320,31 @@ def generate(spec: TraceSpec, seed: int) -> list[SimEvent]:
         )
         events.append(ev)
         _expire(ev)
+
+    # fragmentation bursts: a tall wave and a wide wave land TOGETHER with
+    # seeded odd counts, so the greedy's per-group tails interleave (new
+    # capacity every burst: the shapes exceed fleet free slack, making the
+    # pass a pure launch — the one the oracle sampler and optimizer lane
+    # both judge)
+    if spec.frag_every_s > 0 and spec.frag_pods > 0:
+        t = spec.frag_every_s
+        j = 0
+        while t < spec.duration_s:
+            tall, wide = FRAG_SHAPES[rng.randrange(len(FRAG_SHAPES))]
+            n_tall = max(3, spec.frag_pods // 2) | 1   # odd by construction
+            n_wide = max(3, spec.frag_pods - n_tall + rng.randrange(3)) | 1
+            for suffix, (cpu, mem), n in (
+                ("T", tall, n_tall), ("W", wide, n_wide),
+            ):
+                ev = SimEvent(
+                    at_s=round(t, 3), kind="wave", pods=n, cpu=cpu,
+                    memory=mem, name=f"frag{suffix}{j}",
+                    ttl_s=spec.frag_ttl_s,
+                )
+                events.append(ev)
+                _expire(ev)
+            t += spec.frag_every_s
+            j += 1
 
     # steady churn
     if spec.churn_every_s > 0 and spec.churn_pods > 0:

@@ -341,8 +341,8 @@ class TestCloudHooks:
 class TestScenarioPlans:
     def test_canned_scenarios_ship(self):
         assert list_canned() == [
-            "api-brownout", "eventual-consistency", "replica-loss",
-            "solver-brownout", "spot-storm", "sts-outage",
+            "api-brownout", "eventual-consistency", "optimizer-lane-lost",
+            "replica-loss", "solver-brownout", "spot-storm", "sts-outage",
         ]
 
     def test_scenario_json_round_trip(self):

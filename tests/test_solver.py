@@ -125,6 +125,13 @@ class TestParity:
     """TPU solver must match the host oracle exactly (same policy, same
     tensors -> same nodes)."""
 
+    @pytest.fixture(autouse=True)
+    def _ffd_only(self, monkeypatch):
+        # parity is a property of the FFD scan KERNEL; the optimizer lane
+        # legitimately beats the oracle (tests/test_optimizer_lane.py owns
+        # its contract) so it is pinned off here
+        monkeypatch.setenv("KARPENTER_TPU_OPTIMIZER", "0")
+
     def check(self, pods, pool, catalog):
         problem = encode_problem(pods, catalog, pool)
         # refine=False: the oracle is the PLAIN greedy; the refine pass can
@@ -248,7 +255,17 @@ class TestPackingQuality:
 
 class TestTypeAxisCompaction:
     """Pruning types no group can use must not change ANY outcome — it only
-    shrinks the device programs. Equivalence is asserted plan-for-plan."""
+    shrinks the device programs. Equivalence is asserted plan-for-plan.
+
+    FFD-only: the optimizer lane is deterministic per (problem, seed) but
+    its Gumbel draws are shaped by the type axis, so pruning legitimately
+    shifts WHICH strictly-cheaper plan it lands on (the adoption contract
+    — validity + never pricier — is the invariant there, not identity;
+    designs/optimizer-lane.md)."""
+
+    @pytest.fixture(autouse=True)
+    def _ffd_only(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_OPTIMIZER", "0")
 
     def test_pruned_matches_unpruned_exactly(self, catalog):
         import os

@@ -100,6 +100,31 @@ def check_row(name: str, row, spec: dict) -> list[dict]:
                 "metric": f"{name}.{metric}", "value": value,
                 "detail": f"{value!r} != {rule['equals']!r}",
             })
+        if "max_times" in rule:
+            # relative ceiling vs a sibling metric of the SAME row:
+            #   {"max_times": {"metric": "ffd_p99_ms", "factor": 8.0}}
+            # the optimizer configs use it as the solve-p99 no-regression
+            # key (lane-on wall bounded by a multiple of the lane-off
+            # FFD floor measured in the same run)
+            mt = rule["max_times"]
+            other = row.get(mt.get("metric"))
+            factor = float(mt.get("factor", 1.0))
+            if other is None:
+                failures.append({
+                    "metric": f"{name}.{metric}",
+                    "detail": (
+                        f"max_times reference {mt.get('metric')!r} missing "
+                        "from the bench row"
+                    ),
+                })
+            elif value > factor * other:
+                failures.append({
+                    "metric": f"{name}.{metric}", "value": value,
+                    "detail": (
+                        f"{value} > {factor} x {mt.get('metric')} "
+                        f"({other})"
+                    ),
+                })
     return failures
 
 
