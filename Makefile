@@ -4,7 +4,7 @@
 
 PYTEST ?= python -m pytest
 
-.PHONY: presubmit test deflake stress e2etests benchmark interruption-bench verify multichip native soak sidecar-client sim-smoke sim-sweep sim-cliff-smoke bench-gate bench-optimizer chaos-smoke sim-replica-smoke
+.PHONY: presubmit test deflake stress e2etests benchmark interruption-bench verify multichip native soak sidecar-client sim-smoke sim-sweep sim-cliff-smoke bench-gate bench-optimizer chaos-smoke sim-replica-smoke sim-provision-smoke
 
 presubmit: test multichip  ## everything CI gates on
 
@@ -80,3 +80,11 @@ sim-replica-smoke:  ## 2-replica sharded-control-plane day with a replica-loss o
 		--report /tmp/fleet_report_replica.json
 	python tools/fleet_gate.py /tmp/fleet_report_replica.json \
 		--baseline karpenter_provider_aws_tpu/sim/baselines/replica-loss-2r.json
+
+sim-provision-smoke:  ## 4-replica sharded-provisioning flood day (GLOBAL holder killed mid-flood; work-stealing + packing-envelope-parity), fleet-gated
+	JAX_PLATFORMS=cpu python -m karpenter_provider_aws_tpu.sim run \
+		--trace flood-day --nodes 250 --hours 2 --seed 0 --replicas 4 \
+		--overlay provisioning-replica-loss@1800 \
+		--report /tmp/fleet_report_provision.json
+	python tools/fleet_gate.py /tmp/fleet_report_provision.json \
+		--baseline karpenter_provider_aws_tpu/sim/baselines/provisioning-4r.json

@@ -577,6 +577,24 @@ def child_scale() -> None:
         run_scale(scale=scale, on_row=on_row)
 
 
+def child_provisioning() -> None:
+    """config9 sharded-provisioning throughput rows at replicas={1,4,8}
+    (benchmarks/scale_bench.bench_provisioning): the same pinned+global
+    flood against fresh replica-set worlds; per-replica busy walls, the
+    concurrent-replica fleet wall, speedup_vs_r1, and the handled-set
+    exactness contract. Host control loop — CPU-forced."""
+    import contextlib
+
+    _force_cpu_if_asked()
+
+    from benchmarks.scale_bench import run_provisioning
+
+    scale = float(os.environ.get("BENCH_PROVISION_SCALE", "1.0"))
+    on_row = _detail_writer({"run_at_unix": int(time.time()), "scale": scale})
+    with contextlib.redirect_stdout(sys.stderr):
+        run_provisioning(scale=scale, on_row=on_row)
+
+
 def child_sim() -> None:
     """Fleet-simulator rows: wall per simulated day + the SLO/efficiency
     gate metrics at two fleet sizes (benchmarks/sim_bench.py). Host-only
@@ -841,6 +859,15 @@ def main() -> None:
         )
         if err:
             errors.append(err)
+        # sharded-provisioning throughput at replicas={1,4,8} — rides the
+        # same opt-in (its three replica-set worlds are minutes of host
+        # build at the 100k default)
+        _, err = run_child(
+            "provisioning", min(900.0, _remaining() - SAFETY_MARGIN_S),
+            env_extra={"BENCH_FORCE_CPU": "1"},
+        )
+        if err:
+            errors.append(err)
 
     # Phase B: CPU headline at reduced scale — ALWAYS produces a fallback
     # headline before any accelerator is touched.
@@ -935,6 +962,7 @@ if __name__ == "__main__":
                  "encode": child_encode, "scale": child_scale,
                  "device_state": child_device_state, "sim": child_sim,
                  "disruption": child_disruption,
+                 "provisioning": child_provisioning,
                  "optimizer": child_optimizer}[child]()
             except Exception as e:
                 traceback.print_exc()

@@ -35,6 +35,7 @@ import gc
 import json
 import os
 import time
+from typing import Optional
 
 import numpy as np
 
@@ -261,6 +262,263 @@ def bench_scale(n_nodes=100_000, churn_frac=0.01, iters=10,
                 "compile separate) + per-partition native screen with the "
                 "single-group exact pre-filter",
     }
+
+
+def _provision_world(n_replicas: int, n_nodes: int, zones: tuple,
+                     fill_fraction: float = 0.72):
+    """One N-replica shared world with a pre-built fleet spread over
+    ``zones`` (direct store writes, like ``_synth_cluster`` — launching
+    the fleet through the control loop would be a control-plane bench,
+    not a provisioning bench). Returns the ReplicaSetEnv."""
+    from karpenter_provider_aws_tpu.models import (
+        Disruption,
+        NodePool,
+        Operator,
+        Requirement,
+    )
+    from karpenter_provider_aws_tpu.models import labels as lbl
+    from karpenter_provider_aws_tpu.models.nodeclaim import NodeClaim
+    from karpenter_provider_aws_tpu.models.pod import make_pods
+    from karpenter_provider_aws_tpu.state.cluster import Node
+    from karpenter_provider_aws_tpu.testenv import new_replicaset
+
+    rs = new_replicaset(n_replicas, zones=list(zones))
+    rs.apply_defaults(NodePool(
+        name="default",
+        requirements=[
+            Requirement(lbl.INSTANCE_CATEGORY, Operator.IN, ("c", "m")),
+        ],
+        disruption=Disruption(consolidate_after_s=None),
+    ))
+    catalog = rs.catalog
+    candidates = [
+        t for t in catalog.list()
+        if t.category in ("c", "m") and 4 <= t.vcpus <= 16
+    ]
+    rng = np.random.RandomState(97)
+    for i in range(n_nodes):
+        it = candidates[rng.randint(len(candidates))]
+        zone = zones[i % len(zones)]  # even spread: balanced partitions
+        claim = NodeClaim.fresh(
+            nodepool_name="default",
+            nodeclass_name="default",
+            instance_type_options=[it.name],
+            zone_options=[zone],
+            capacity_type_options=["on-demand"],
+        )
+        claim.status.provider_id = f"cloud:///{zone}/i-prov{i}"
+        claim.status.capacity = it.capacity()
+        claim.status.allocatable = catalog.allocatable(it)
+        claim.labels.update(it.labels())
+        claim.labels[lbl.TOPOLOGY_ZONE] = zone
+        claim.labels[lbl.CAPACITY_TYPE] = "on-demand"
+        claim.labels[lbl.NODEPOOL] = "default"
+        claim.status.set_condition("Launched", True)
+        claim.status.set_condition("Registered", True)
+        claim.status.set_condition("Initialized", True)
+        rs.cluster.apply(claim)
+        node = Node(
+            name=f"node-{claim.name}",
+            provider_id=claim.status.provider_id,
+            nodepool_name="default",
+            nodeclaim_name=claim.name,
+            labels=dict(claim.labels),
+            capacity=claim.status.capacity,
+            allocatable=claim.status.allocatable,
+            ready=True,
+        )
+        node.labels[lbl.HOSTNAME] = node.name
+        claim.status.node_name = node.name
+        rs.cluster.apply(node)
+        ballast_m = int(it.vcpus * 1000 * fill_fraction)
+        p = make_pods(1, f"fill{i}", {
+            "cpu": f"{ballast_m}m",
+            "memory": f"{max(1, int(it.memory_mib * 0.5))}Mi",
+        })[0]
+        rs.cluster.apply(p)
+        rs.cluster.bind_pod(p.uid, node.name)
+    return rs
+
+
+def bench_provisioning(replica_counts=(1, 4, 8), n_nodes=None,
+                       flood_pods=None) -> list[dict]:
+    """Sharded-provisioning throughput at the config9 tier: the SAME
+    pinned+global pod flood against fresh {1, 4, 8}-replica worlds over
+    one pre-built fleet shape.
+
+    Per replica count, every live replica's provisioning reconcile runs
+    under its own ownership snapshot and its busy wall time is summed;
+    the fleet wall is the MAX per-replica busy time (replicas run
+    concurrently in production — each is its own process with its own
+    device mirror; this in-process bench serializes them and models the
+    concurrency, which is honest because the replicas share NO mutable
+    solver state, only the store). Throughput = pods handled / fleet
+    wall; ``speedup_vs_r1`` divides r1's fleet wall by this run's.
+
+    ``exactness_ok`` is the sharded-vs-unsharded contract at the
+    provisioning layer: the union of per-replica handled sets (bound +
+    nominated pods, by name) equals the single-replica run's, with zero
+    pods claimed by two replicas."""
+    from karpenter_provider_aws_tpu.models import labels as lbl
+    from karpenter_provider_aws_tpu.models.pod import make_pods
+    from karpenter_provider_aws_tpu.operator import sharding
+
+    n_nodes = n_nodes if n_nodes is not None else int(
+        os.environ.get("BENCH_PROVISION_NODES", 100_000)
+    )
+    flood_pods = flood_pods if flood_pods is not None else int(
+        os.environ.get("BENCH_PROVISION_FLOOD", 4096)
+    )
+    # 16 zones -> 16 partition leases: fine enough that rendezvous spreads
+    # the keys near-evenly over 8 replicas (8 keys over 8 replicas leaves
+    # somebody with 3 and somebody with 0 — the fleet wall is the max)
+    zones = tuple(f"zone-{i:02d}" for i in range(16))
+    global_pods = max(64, flood_pods // 8)
+    prev_serial = os.environ.get("KARPENTER_TPU_SERIAL_LAUNCH")
+    os.environ["KARPENTER_TPU_SERIAL_LAUNCH"] = "1"
+    rows: list[dict] = []
+    r1_wall_ms = None
+    r1_handled: Optional[set] = None
+    try:
+        for n_rep in replica_counts:
+            gc.collect()
+            t_build0 = time.perf_counter()
+            rs = _provision_world(n_rep, n_nodes, zones)
+            build_s = time.perf_counter() - t_build0
+            try:
+                # settle the lease layer before the flood
+                for _ in range(3):
+                    for r in rs.replicas:
+                        r.elector.reconcile()
+                    rs.clock.advance(2)
+                # warmup (unmeasured): one tiny pinned pod per zone + one
+                # global pod through every replica's pass, so the first
+                # MEASURED bucket doesn't pay the process-wide cold costs
+                # (catalog/type-allow caches, occupancy build) that a
+                # long-running replica paid at startup, not per flood
+                for z in zones:
+                    for p in make_pods(1, f"warm-{z}",
+                                       {"cpu": "100m", "memory": "128Mi"},
+                                       node_selector={lbl.TOPOLOGY_ZONE: z}):
+                        rs.cluster.apply(p)
+                for p in make_pods(1, "warm-global",
+                                   {"cpu": "100m", "memory": "128Mi"}):
+                    rs.cluster.apply(p)
+                for _ in range(2):
+                    for r in rs.replicas:
+                        with sharding.scope(r.elector.ownership()):
+                            r.provisioning.reconcile()
+                    rs.clock.advance(1)
+                # the flood: zone-pinned pods per partition + a global slice
+                per_zone = flood_pods // len(zones)
+                for z in zones:
+                    for p in make_pods(per_zone, f"flood-{z}",
+                                       {"cpu": "2", "memory": "3Gi"},
+                                       node_selector={lbl.TOPOLOGY_ZONE: z}):
+                        rs.cluster.apply(p)
+                for p in make_pods(global_pods, "flood-global",
+                                   {"cpu": "2", "memory": "3Gi"}):
+                    rs.cluster.apply(p)
+
+                def unhandled() -> list:
+                    nominated = set()
+                    for r in rs.replicas:
+                        nominated |= set(r.provisioning.nominations)
+                    return [
+                        p for p in rs.cluster.pending_pods()
+                        if p.uid not in nominated
+                    ]
+
+                busy = {r.identity: 0.0 for r in rs.replicas}
+                rounds = 0
+                while unhandled() and rounds < 6:
+                    rounds += 1
+                    for r in rs.replicas:
+                        own = r.elector.ownership()
+                        t0 = time.perf_counter()
+                        with sharding.scope(own):
+                            r.provisioning.reconcile()
+                        busy[r.identity] += time.perf_counter() - t0
+                    rs.clock.advance(1)
+                # handled = bound onto existing capacity + nominated onto
+                # a claim, by pod name (uids are process-global counters)
+                uid_owner: dict = {}
+                dupes = 0
+                for r in rs.replicas:
+                    for uid in r.provisioning.nominations:
+                        if uid in uid_owner:
+                            dupes += 1
+                        uid_owner[uid] = r.identity
+                handled = {
+                    p.name for p in rs.cluster.pods.values()
+                    if p.name.startswith("flood") and (
+                        p.node_name or p.uid in uid_owner
+                    )
+                }
+                fleet_wall_ms = max(busy.values()) * 1e3 if busy else 0.0
+                total_busy_ms = sum(busy.values()) * 1e3
+                launches = len(rs.cloud.instances)
+                if r1_handled is None:
+                    r1_handled, r1_wall_ms = set(handled), fleet_wall_ms
+                exact = (
+                    handled == r1_handled and dupes == 0
+                    and not rs.lease_overlaps
+                )
+                rows.append({
+                    "benchmark": f"config9_provisioning_r{n_rep}",
+                    "replicas": n_rep,
+                    "nodes": n_nodes,
+                    "partitions": len(zones),
+                    "flood_pods_pinned": per_zone * len(zones),
+                    "flood_pods_global": global_pods,
+                    "build_s": round(build_s, 1),
+                    "rounds": rounds,
+                    "per_replica_busy_ms": {
+                        k: round(v * 1e3, 1) for k, v in sorted(busy.items())
+                    },
+                    "fleet_wall_ms": round(fleet_wall_ms, 1),
+                    "total_busy_ms": round(total_busy_ms, 1),
+                    "pods_handled": len(handled),
+                    "pods_per_s": round(
+                        len(handled) / (fleet_wall_ms / 1e3), 1
+                    ) if fleet_wall_ms else None,
+                    "speedup_vs_r1": round(
+                        r1_wall_ms / fleet_wall_ms, 2
+                    ) if fleet_wall_ms and r1_wall_ms else None,
+                    "launches": launches,
+                    "duplicate_claims": dupes,
+                    "lease_overlaps": len(rs.lease_overlaps),
+                    "exactness_ok": bool(exact),
+                    "device": "host",
+                    "backend": "host",
+                    "note": "per-replica provisioning busy wall under one "
+                            "pinned+global flood; fleet wall = max replica "
+                            "(concurrent-replica model); exactness = "
+                            "handled-set parity vs r1 + zero double claims",
+                })
+            finally:
+                rs.close()
+            del rs
+            gc.collect()
+    finally:
+        if prev_serial is None:
+            os.environ.pop("KARPENTER_TPU_SERIAL_LAUNCH", None)
+        else:
+            os.environ["KARPENTER_TPU_SERIAL_LAUNCH"] = prev_serial
+    return rows
+
+
+def run_provisioning(scale: float = 1.0, on_row=None) -> list[dict]:
+    n = max(
+        int(float(os.environ.get("BENCH_PROVISION_NODES", 100_000)) * scale),
+        1000,
+    )
+    rows = bench_provisioning(n_nodes=n)
+    for row in rows:
+        print(json.dumps(row), flush=True)
+        if on_row is not None:
+            on_row(row)
+    return rows
 
 
 def run_all(scale: float = 1.0, on_row=None) -> list[dict]:

@@ -41,6 +41,11 @@ The list (designs/fault-injection.md):
                             ownership was a partition of the key space
                             (no overlap), and post-settle it covers every
                             known key (multi-replica)
+- ``packing-envelope-parity``  the multi-replica day's packing/fleet-cost
+                            stayed inside the single-replica reference
+                            run's envelope (sharded provisioning must not
+                            buy a worse fleet; sim-attached reference,
+                            designs/sharded-provisioning.md)
 - ``controllers-healthy``   no controller reconcile raised during the
                             whole run (faults must surface as behavior,
                             never as crashes)
@@ -290,6 +295,64 @@ def check_leases_partition_fleet(harness) -> InvariantResult:
     return _result("leases-partition-the-fleet", ok, detail)
 
 
+#: envelope half-widths for packing-envelope-parity: a multi-replica day
+#: may pack up to 10% worse and cost up to 10% more than its
+#: single-replica reference before the invariant fails
+PACKING_ENVELOPE = 0.10
+COST_ENVELOPE = 0.10
+
+
+def check_packing_envelope_parity(harness) -> InvariantResult:
+    """Sharded provisioning must not buy a worse fleet than one replica
+    would have (designs/sharded-provisioning.md): against a same-trace
+    same-seed single-replica reference run, the multi-replica day's mean
+    packing efficiency stays within ``PACKING_ENVELOPE`` below the
+    reference and its fleet $/hr within ``COST_ENVELOPE`` above it.
+    Harnesses without a reference (single-replica scenarios, the chaos
+    CLI) self-skip so every report lists the same checks; the fleet
+    simulator attaches ``harness.envelope`` when ``envelope_check`` is
+    on (the default for multi-replica runs)."""
+    rs = _replicaset(harness)
+    if rs is None:
+        return _result("packing-envelope-parity", True, "single-replica: n/a")
+    env = getattr(harness, "envelope", None)
+    if not env:
+        return _result(
+            "packing-envelope-parity", True,
+            "n/a (no single-replica reference run attached)",
+        )
+    packing_ratio = env.get("packing_ratio")
+    cost_ratio = env.get("cost_ratio")
+    if packing_ratio is None and cost_ratio is None:
+        # an attached envelope with no computable ratios (empty-fleet or
+        # no-sample reference) compared nothing — say so, don't claim parity
+        return _result(
+            "packing-envelope-parity", True,
+            "n/a (reference attached but ratios unavailable: "
+            f"ref_packing={env.get('ref_packing_cpu_mean')} "
+            f"ref_cost={env.get('ref_fleet_cost_per_hr')})",
+        )
+    fails = []
+    if packing_ratio is not None and packing_ratio < 1.0 - PACKING_ENVELOPE:
+        fails.append(
+            f"packing {packing_ratio:.3f}x of single-replica "
+            f"(< {1.0 - PACKING_ENVELOPE:.2f})"
+        )
+    if cost_ratio is not None and cost_ratio > 1.0 + COST_ENVELOPE:
+        fails.append(
+            f"fleet cost {cost_ratio:.3f}x of single-replica "
+            f"(> {1.0 + COST_ENVELOPE:.2f})"
+        )
+    if fails:
+        return _result("packing-envelope-parity", False, "; ".join(fails))
+    return _result(
+        "packing-envelope-parity", True,
+        f"packing {packing_ratio}x / cost {cost_ratio}x of the "
+        f"single-replica envelope (bounds -{PACKING_ENVELOPE:g}/"
+        f"+{COST_ENVELOPE:g})",
+    )
+
+
 def check_controllers_healthy(harness) -> InvariantResult:
     errors = harness.env.manager.errors[harness.errors_baseline:]
     return _result(
@@ -311,6 +374,7 @@ INVARIANTS = (
     check_no_double_launch,
     check_no_orphaned_claims,
     check_leases_partition_fleet,
+    check_packing_envelope_parity,
     check_controllers_healthy,
 )
 

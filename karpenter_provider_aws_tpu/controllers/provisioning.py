@@ -9,6 +9,19 @@ offerings mask (the failure-plane feedback loop of SURVEY.md section 5).
 Launches run on a small worker pool so concurrent CloudProvider.Create
 calls land in one coalesced fleet batch (parity: createfleet.go windows —
 a serial loop would defeat the batcher entirely).
+
+Sharded provisioning (designs/sharded-provisioning.md): under an ambient
+ownership scope (N-replica deployments, ``operator/sharding.py``) the
+pending set is PARTITIONED instead of GLOBAL-owned. Pods whose required
+constraints pin them to an owned (nodepool, zone) partition solve locally
+on this replica's device mirror, sanctioned by that partition's lease;
+truly global pods flow through the fenced work-stealing GLOBAL queue on
+the lease host — the GLOBAL-lease holder claims them in batches, any
+other lease holder steals only while the GLOBAL lease has no live holder
+(replica loss), and every claim/steal/launch carries the owning lease's
+fencing token so a deposed replica's in-flight work bounces off the
+cloud instead of double-launching capacity. With no ambient scope
+(single-replica, every existing test) nothing changes: one global solve.
 """
 
 from __future__ import annotations
@@ -27,6 +40,11 @@ from ..state.cluster import Cluster
 log = logging.getLogger("karpenter.tpu.provisioning")
 
 MAX_LAUNCH_WORKERS = 10  # parity: reconcile worker-pool width (SURVEY 2.3)
+
+# sharded provisioning: how long one GLOBAL-queue claim stays exclusive
+# before an unrenewed claimant (a dead stealer) loses it to re-steal —
+# the same shape as the partition-lease TTL
+WORK_CLAIM_TTL_S = 15.0
 
 
 def _null_ctx():
@@ -59,18 +77,161 @@ class ProvisioningController:
         self.nominations: dict[str, str] = {}
         self._nominations_lock = threading.Lock()
         self.last_unschedulable: list = []
+        # sharded provisioning: the elector behind this replica's ownership
+        # snapshot (testenv/operator wire it) — consulted only for the
+        # netsplit seam (a replica cut off from the lease host must not
+        # keep claiming GLOBAL-queue work on its stale snapshot)
+        self.elector = None
 
     def reconcile(self) -> None:
+        from ..operator import sharding
+
+        self._prune_stale_nominations()
+        self.last_unschedulable = []
+        own = sharding.current()
+        if own is None:
+            # no ambient ownership (single replica): one global solve
+            self._provision()
+            return
+        # Sharded provisioning: route the pending set through the
+        # ownership snapshot — partition-pinned pods solve locally under
+        # their partition lease, truly global pods through the fenced
+        # work-stealing GLOBAL queue (designs/sharded-provisioning.md).
+        with self._nominations_lock:
+            nominated = set(self.nominations)
+        pending = [
+            p for p in self.cluster.pending_pods() if p.uid not in nominated
+        ]
+        if not pending:
+            return
+        nodepools = list(self.cluster.nodepools.values())
+        local, global_pods, foreign = sharding.split_pending(
+            pending, nodepools, own
+        )
+        from ..metrics import PROVISIONING_SHARDED_PODS
+
+        for scope_name, n in (
+            ("local", sum(len(v) for v in local.values())),
+            ("global", len(global_pods)),
+            ("foreign", len(foreign)),
+        ):
+            if n:
+                PROVISIONING_SHARDED_PODS.inc(n, scope=scope_name)
+        # owned partitions first (lease-name order — deterministic): each
+        # bucket solves on this replica's device mirror against ITS OWN
+        # partition's capacity only (a pinned pod can't land elsewhere),
+        # sanctioned by the partition's lease so every launch carries its
+        # fencing token. One O(pods) usage walk and one occupancy snapshot
+        # are shared by every bucket this pass solves — binds landed by an
+        # earlier bucket of the SAME pass are invisible to later buckets'
+        # planning, which is safe because _apply_binds re-verifies slack
+        # against live usage at apply time. (The pending re-list and the
+        # node/claim scans inside snapshot_existing_capacity remain
+        # per-bucket — the freshness contract each solve snapshot keeps;
+        # those scans parallelize across replicas, which is where the
+        # config9_provisioning speedup comes from.)
+        usage = occupancy = None
+        if local or global_pods:
+            from ..ops.encode import ZoneOccupancy
+
+            usage = self.cluster.node_usage()
+            occupancy = ZoneOccupancy.from_cluster(self.cluster)
+        for key in sorted(local, key=sharding.lease_name):
+            with sharding.sanction(key):
+                self._provision(
+                    scope=key, pod_uids={p.uid for p in local[key]},
+                    partition=key, usage=usage, occupancy=occupancy,
+                )
+        # truly global pods: fenced, exactly-once claim from the queue
+        claimed, fence_key = self._claim_global(global_pods, own)
+        if claimed:
+            with sharding.sanction(fence_key):
+                self._provision(
+                    scope=("global", frozenset(claimed)),
+                    pod_uids=set(claimed), usage=usage, occupancy=occupancy,
+                )
+
+    def _claim_global(self, pods, own) -> tuple[list, Optional[tuple]]:
+        """Claim global pending pods from the work-stealing queue on the
+        lease host. Returns ``(claimed pod uids, sanctioning key)``.
+
+        The GLOBAL-lease holder claims its whole batch; any OTHER lease
+        holder steals only while the GLOBAL lease has no live holder
+        (replica loss — the work must not stall a full rendezvous cycle).
+        Either way the claim CAS is fenced by the claimant's own lease
+        token: a deposed replica's claim attempt raises and it stands
+        down instead of double-solving (exactly-once handoff; re-steal of
+        a dead claimant's pods happens through claim-TTL expiry)."""
+        from ..metrics import PROVISIONING_STEALS
+        from ..operator import sharding
+        from ..utils.errors import StaleFencingTokenError
+
+        if not pods:
+            return [], None
+        sf = sharding.steal_fence(own)
+        if sf is None:
+            return [], None  # lease-less replica: stand down
+        key, fence = sf
+        holds_global = key == sharding.GLOBAL_KEY
+        host = getattr(self.cloudprovider, "cloud", None)
+        if host is None or not hasattr(host, "try_claim_work"):
+            # lease host without a work queue (plain backend): the
+            # GLOBAL holder provisions everything, nobody steals
+            if holds_global:
+                return [p.uid for p in pods], key
+            return [], None
+        if getattr(self.elector, "partitioned", False):
+            # netsplit from the lease host: existing claims ride to their
+            # TTL, but no new work is claimed on the stale snapshot
+            return [], None
+        if not holds_global and self._global_lease_live(host):
+            # the GLOBAL holder is alive — its batches own the queue; a
+            # steal now would only contend the CAS
+            return [], None
+        want = sorted(p.uid for p in pods)
+        try:
+            granted = host.try_claim_work(
+                sharding.WORK_QUEUE, want, own.replica,
+                WORK_CLAIM_TTL_S, fence,
+            )
+        except StaleFencingTokenError:
+            PROVISIONING_STEALS.inc(outcome="fenced")
+            return [], None
+        except Exception:
+            return [], None  # lease host unreachable: claim nothing
+        if granted:
+            PROVISIONING_STEALS.inc(
+                len(granted),
+                outcome="claimed" if holds_global else "stolen",
+            )
+        if len(granted) < len(want):
+            PROVISIONING_STEALS.inc(
+                len(want) - len(granted), outcome="contended"
+            )
+        return granted, key
+
+    def _global_lease_live(self, host) -> bool:
+        from ..operator import sharding
+
+        try:
+            leases = host.list_leases(sharding.LEASE_PREFIX + "/")
+        except Exception:
+            return True  # indeterminate: assume the holder lives (no steal)
+        return sharding.lease_name(sharding.GLOBAL_KEY) in leases
+
+    def _provision(self, scope=None, pod_uids: Optional[set] = None,
+                   partition: Optional[tuple] = None, usage=None,
+                   occupancy=None) -> None:
+        """One solve pass over the pending set (or the ``pod_uids``
+        subset), applying binds and driving launches. ``scope`` is the
+        routing identity mixed into the encoded-problem cache revision so
+        two different subsets of one store revision can never alias;
+        ``partition`` scopes the existing-capacity snapshot to the owned
+        (nodepool, zone); ``usage`` shares one node-usage walk across a
+        sharded pass's solves."""
         from ..models.pod import POD_WRITE_SEQ
         from ..operator import sharding
 
-        # Sharded control plane: pending pods are unpartitioned work — the
-        # replica holding the GLOBAL lease provisions; everyone else's
-        # pass is a no-op except pruning nominations whose claims died
-        # (a replica keeps its own nomination map fresh regardless).
-        self._prune_stale_nominations()
-        if not sharding.owns_global():
-            return
         # revision components are captured BEFORE the pending snapshot: a
         # mutation racing the list read then leaves the token OLDER than the
         # pods (at worst one extra cache miss next pass) — capturing after
@@ -82,7 +243,11 @@ class ProvisioningController:
         with self._nominations_lock:
             nominated_map = dict(self.nominations)
         nominated = set(nominated_map)
-        pending = [p for p in self.cluster.pending_pods() if p.uid not in nominated]
+        pending = [
+            p for p in self.cluster.pending_pods()
+            if p.uid not in nominated
+            and (pod_uids is None or p.uid in pod_uids)
+        ]
         if not pending:
             return
         nodepools = list(self.cluster.nodepools.values())
@@ -92,17 +257,19 @@ class ProvisioningController:
         from ..scheduling.solver import snapshot_existing_capacity
 
         # O(1) revision token for the encoded-problem cache: the pending set
-        # is fully determined by (store epoch, store revision, nominations),
-        # so the cache key skips the per-pod id/version tuples. epoch is an
-        # identity object — a reset store can never alias an old revision —
-        # and POD_WRITE_SEQ rides along so a direct pod field reassignment
-        # (bumps Pod._version, not cluster.rev) still misses the cache.
+        # is fully determined by (store epoch, store revision, nominations,
+        # routing scope), so the cache key skips the per-pod id/version
+        # tuples. epoch is an identity object — a reset store can never
+        # alias an old revision — and POD_WRITE_SEQ rides along so a direct
+        # pod field reassignment (bumps Pod._version, not cluster.rev)
+        # still misses the cache.
         revision = (
-            (epoch0, rev0, pod_seq0, frozenset(nominated))
+            (epoch0, rev0, pod_seq0, frozenset(nominated), scope)
             if epoch0 is not None and rev0 is not None
             else None
         )
-        occupancy = ZoneOccupancy.from_cluster(self.cluster)
+        if occupancy is None:
+            occupancy = ZoneOccupancy.from_cluster(self.cluster)
         type_allow = {
             pool.name: self.cloudprovider.launchable_type_names(pool)
             for pool in nodepools
@@ -125,7 +292,10 @@ class ProvisioningController:
                 # Live nodes AND in-flight claims ride into the solve as
                 # pre-opened capacity, so pending pods land on slack already
                 # owned (or already being launched) instead of opening more.
-                existing=snapshot_existing_capacity(self.cluster, nominated_map),
+                existing=snapshot_existing_capacity(
+                    self.cluster, nominated_map,
+                    partition=partition, usage=usage,
+                ),
                 # per-pool nodeclass: ephemeral-storage capacity follows its
                 # root volume + instanceStorePolicy (types.go:218-244)
                 nodeclass_by_pool=nodeclass_by_pool,
@@ -134,7 +304,11 @@ class ProvisioningController:
 
         SOLVE_DURATION.observe(result.solve_seconds)
         SOLVE_PODS.inc(len(pending))
-        self.last_unschedulable = result.unschedulable
+        # accumulate across this pass's solves (one per routing scope when
+        # sharded; exactly one in the single-replica path)
+        self.last_unschedulable = (
+            list(self.last_unschedulable) + list(result.unschedulable)
+        )
         obs = self._obs()
         self._audit_solve(result, obs.audit, rev0)
         self._audit_degraded(result, obs.audit, rev0, len(pending))
@@ -156,11 +330,12 @@ class ProvisioningController:
             import os
 
             # worker threads don't inherit the reconcile thread's ambient
-            # ownership (thread-local) — capture it here and re-enter the
-            # scope inside each launch so CloudProvider.create stamps the
-            # right fencing token whichever thread runs it
+            # ownership or sanction (thread-locals) — capture both here
+            # and re-enter them inside each launch so CloudProvider.create
+            # stamps the right fencing token whichever thread runs it
             own = sharding.current()
-            launch = lambda spec: self._launch(spec, own)  # noqa: E731
+            sanction_key = sharding.current_sanction()
+            launch = lambda spec: self._launch(spec, own, sanction_key)  # noqa: E731
             if len(specs) == 1 or os.environ.get(
                 "KARPENTER_TPU_SERIAL_LAUNCH"
             ) == "1":
@@ -322,15 +497,17 @@ class ProvisioningController:
                 if cn in claims and not claims[cn].deleted
             }
 
-    def _launch(self, spec: NodeSpec, own=None) -> None:
+    def _launch(self, spec: NodeSpec, own=None, sanction_key=None) -> None:
         from ..operator import sharding
 
         pool = self.cluster.nodepools.get(spec.nodepool_name)
         if pool is None:
             return
         with sharding.scope(own) if own is not None else _null_ctx():
-            claim = launch_claim(self.cluster, self.cloudprovider, pool, spec,
-                                 recorder=self.recorder)
+            with (sharding.sanction(sanction_key) if sanction_key is not None
+                  else _null_ctx()):
+                claim = launch_claim(self.cluster, self.cloudprovider, pool,
+                                     spec, recorder=self.recorder)
         if claim is None:
             return
         with self._nominations_lock:

@@ -167,13 +167,23 @@ class SchedulingController:
     def reconcile(self) -> None:
         from ..operator import sharding
 
-        # pending pods are unpartitioned: the GLOBAL-lease holder binds
-        # (same scope as the provisioner it backstops)
-        if not sharding.owns_global():
-            return
         pending = self.cluster.pending_pods()
         if not pending:
             return
+        own = sharding.current()
+        if own is not None:
+            # Sharded provisioning routing (the provisioner's predicate,
+            # order-preserving): partition-pinned pods bind on their
+            # partition's lease holder, global pods on the GLOBAL holder —
+            # disjoint by construction, so no two replicas ever race one
+            # pod onto two nodes.
+            nodepools = list(self.cluster.nodepools.values())
+            pending = [
+                p for p in pending
+                if sharding.routes_here(p, nodepools, own)
+            ]
+            if not pending:
+                return
         if len(pending) > GENERAL_LOOP_MAX_PODS:
             # Bulk scale: bound THIS pass's work, topology cases first (no
             # other binder handles them); the device solve drains the bulk.

@@ -143,6 +143,10 @@ class FakeCloud:
         # fenced writes rejected, by lease name (introspection; the
         # metric counts globally)
         self.fenced_rejections: list[tuple[str, int, int, str]] = []
+        # work-stealing claim table (sharded provisioning): (queue, item)
+        # -> (owner, expires_at, fence). Claims are fenced CAS writes like
+        # launches — a deposed replica can neither claim nor renew.
+        self._work_claims: dict[tuple[str, str], tuple[str, float, tuple]] = {}
         self.images: list[Image] = [
             Image(id="img-std-2", name="standard-v2", family="standard", arch="amd64", created_seq=2),
             Image(id="img-std-arm-2", name="standard-arm-v2", family="standard", arch="arm64", created_seq=2),
@@ -190,6 +194,7 @@ class FakeCloud:
             self.next_errors.clear()
             self.calls.clear()
             self.fenced_rejections.clear()
+            self._work_claims.clear()
 
     # -- fleet launch ------------------------------------------------------
     def create_fleet(self, requests: list[LaunchRequest]) -> list:
@@ -337,6 +342,56 @@ class FakeCloud:
         """The current fencing token for ``name`` (0 = never acquired)."""
         with self._lock:
             return self._lease_tokens.get(name, 0)
+
+    # -- work-stealing claim table (sharded provisioning) ------------------
+    def try_claim_work(self, queue: str, items: list[str], owner: str,
+                       ttl_s: float, fence: tuple) -> list[str]:
+        """Fenced batch CAS over the GLOBAL work queue: returns the subset
+        of ``items`` now claimed by ``owner`` — newly claimed (unclaimed
+        or expired entries) plus renewals of ``owner``'s own live claims.
+        Items claimed by another live owner are skipped, never stolen
+        silently: a steal happens only through expiry (the claimant died)
+        or release. The claim itself is a control-plane write sanctioned
+        by ``fence`` — a superseded tenancy's claim attempt raises
+        ``StaleFencingTokenError``, so a deposed replica cannot keep
+        feeding itself work (the exactly-once handoff edge)."""
+        with self._lock:
+            self._record("try_claim_work", (queue, list(items), owner))
+            self._maybe_fail()
+            fence_err = self._check_fence(fence, "try_claim_work")
+            if fence_err is not None:
+                raise fence_err
+            now = self.clock.now()
+            granted: list[str] = []
+            for item in items:
+                cur = self._work_claims.get((queue, item))
+                if cur is not None and cur[0] != owner and now < cur[1]:
+                    continue  # live foreign claim: lost the race
+                self._work_claims[(queue, item)] = (
+                    owner, now + float(ttl_s), tuple(fence or ()),
+                )
+                granted.append(item)
+            return granted
+
+    def release_work(self, queue: str, items: list[str], owner: str) -> None:
+        """Voluntary release (item solved/bound or abandoned); only the
+        owner's own claims are dropped."""
+        with self._lock:
+            self._record("release_work", (queue, list(items), owner))
+            for item in items:
+                cur = self._work_claims.get((queue, item))
+                if cur is not None and cur[0] == owner:
+                    del self._work_claims[(queue, item)]
+
+    def list_work_claims(self, queue: str) -> dict[str, tuple[str, float]]:
+        """Live (unexpired) claims: item -> (owner, expires_at)."""
+        with self._lock:
+            now = self.clock.now()
+            return {
+                item: (owner, exp)
+                for (q, item), (owner, exp, _f) in self._work_claims.items()
+                if q == queue and now < exp
+            }
 
     def _check_fence(self, fence, api: str):
         """Validate a write's fencing token against the lease host's

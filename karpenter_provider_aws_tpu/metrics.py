@@ -494,6 +494,22 @@ FENCED_WRITES_REJECTED = REGISTRY.counter(
     "superseded lease tenancy (a deposed replica's in-flight launch/"
     "terminate bounced instead of racing the successor), by api",
 )
+PROVISIONING_STEALS = REGISTRY.counter(
+    "karpenter_provisioning_steals_total",
+    "Work-stealing GLOBAL-queue claim outcomes (sharded provisioning), by "
+    "outcome: claimed = the GLOBAL-lease holder's normal batch, stolen = a "
+    "partition holder picked up unclaimed/expired global pods, contended = "
+    "items lost to another live claimant's CAS, fenced = the whole claim "
+    "attempt bounced on a superseded fencing token (deposed replica)",
+)
+PROVISIONING_SHARDED_PODS = REGISTRY.counter(
+    "karpenter_provisioning_sharded_pods_total",
+    "Pending pods routed by the sharded provisioner, by scope: local = "
+    "pinned to an owned (nodepool, zone) partition and solved on this "
+    "replica's device mirror, global = through the work-stealing GLOBAL "
+    "queue, foreign = pinned to a partition another replica owns (skipped "
+    "here, solved there)",
+)
 
 # -- sim/ subsystem: deterministic fleet simulator --------------------------
 SIM_EVENTS = REGISTRY.counter(

@@ -214,3 +214,25 @@ def cluster_packing(ct) -> dict:
     except TypeError:  # pragma: no cover - non-weakrefable snapshot
         _last_pack = (None, None)
     return eff
+
+
+def fleet_hourly_cost(cluster, catalog) -> float:
+    """Total $/hr of the live fleet: every node priced by its instance
+    type and capacity type from the catalog's pricing model. The number
+    behind the multi-replica packing-envelope-parity check (a sharded
+    provisioning split must not buy a measurably more expensive fleet
+    than the single-replica solve would have) — deterministic given the
+    store and the static catalog."""
+    total = 0.0
+    for node in cluster.snapshot_nodes():
+        it = catalog.get(node.instance_type())
+        if it is None:
+            continue
+        try:
+            if node.capacity_type() == "spot":
+                total += float(catalog.pricing.spot_price(it, node.zone()))
+            else:
+                total += float(catalog.pricing.on_demand_price(it))
+        except Exception:  # pragma: no cover - defensive
+            continue
+    return round(total, 4)

@@ -1,0 +1,308 @@
+"""Kube-Lease-backed lease host: fenced shard leases on a real apiserver.
+
+The sharded control plane (``operator/sharding.py``) talks to its lease
+host through four calls — ``try_acquire_lease_fenced`` / ``release_lease``
+/ ``list_leases`` / ``lease_token`` — which the FakeCloud hosts in
+memory for every hermetic environment. Outside the fake, ``--shard-elect``
+needs the same semantics on what a production control plane actually has:
+``coordination.k8s.io/v1`` Lease objects. This module provides that
+adapter.
+
+Mapping (designs/sharded-provisioning.md documents the full matrix):
+
+- one Lease object per shard lease. Shard lease NAMES are free-form
+  (``karpenter-shard/default/zone-a``, the ``__global__`` sentinel) while
+  Kubernetes object names are DNS-1123 subdomains, so the adapter derives
+  a deterministic safe object name (sanitized + an 8-hex content hash)
+  and stores the ORIGINAL name in the ``karpenter.tpu/lease-key``
+  annotation — ``list_leases`` maps back losslessly.
+- ``spec.holderIdentity`` / ``spec.leaseDurationSeconds`` /
+  ``spec.renewTime`` / ``spec.acquireTime`` carry the client-go-shaped
+  tenancy; expiry is ``renewTime + leaseDurationSeconds`` on the
+  adapter's injected clock.
+- the **fencing token** and **holder nonce** live in annotations
+  (``karpenter.tpu/fencing-token``, ``karpenter.tpu/holder-nonce``).
+  The token bumps on every HOLDER change — acquire of a fresh, expired,
+  or released lease, or a same-identity takeover with a different nonce
+  (the identity-collision edge) — and NEVER on a renew, exactly the
+  FakeCloud contract. Valid tokens start at 1: token 0 remains the
+  explicit never-held sentinel the cloud-side fence check rejects.
+- ``release_lease`` clears the holder and backdates ``renewTime`` but
+  KEEPS the object (a delete would lose the token annotation and reset
+  fencing history — the one divergence from the fake, which hosts tokens
+  separately from leases).
+- every write is a compare-and-swap on ``metadata.resourceVersion``; on
+  ``ConflictError`` the attempt re-reads once and reports the real
+  holder, the same "CAS lost = somebody else holds it" answer the fake
+  gives without retrying forever inside a reconcile tick.
+
+The transport is injected (``LeaseTransport`` protocol below): unit
+tests run a :class:`StubLeaseApi` that models apiserver optimistic
+concurrency; a production deployment supplies a thin client over its
+kube credentials. The adapter itself is transport-agnostic and carries
+no HTTP machinery.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+import threading
+from typing import Optional, Protocol
+
+from ..utils.clock import Clock, RealClock
+
+TOKEN_ANNOTATION = "karpenter.tpu/fencing-token"
+NONCE_ANNOTATION = "karpenter.tpu/holder-nonce"
+KEY_ANNOTATION = "karpenter.tpu/lease-key"
+
+_UNSAFE = re.compile(r"[^a-z0-9.-]+")
+
+
+class ConflictError(Exception):
+    """Optimistic-concurrency failure: the object's resourceVersion moved
+    under the write (HTTP 409 from a real apiserver)."""
+
+
+class LeaseNotFound(Exception):
+    """GET/PUT target does not exist (HTTP 404)."""
+
+
+class LeaseTransport(Protocol):
+    """The minimal apiserver surface the adapter needs. All objects are
+    plain dicts in the coordination.k8s.io/v1 Lease shape with
+    ``metadata.resourceVersion`` strings."""
+
+    def get(self, name: str) -> dict: ...
+    def create(self, name: str, obj: dict) -> dict: ...
+    def update(self, name: str, obj: dict, resource_version: str) -> dict: ...
+    def list(self) -> list[dict]: ...
+
+
+def k8s_lease_name(key: str) -> str:
+    """Deterministic DNS-1123-safe object name for a free-form shard
+    lease name: lowercased, unsafe runs collapsed to ``-``, suffixed with
+    an 8-hex content hash so two keys can never collide after
+    sanitization (``__global__`` and ``--global--`` must stay distinct)."""
+    digest = hashlib.sha256(key.encode()).hexdigest()[:8]
+    safe = _UNSAFE.sub("-", key.lower()).strip("-.") or "lease"
+    return f"{safe[:54]}-{digest}"
+
+
+class KubeLeaseHost:
+    """``try_acquire_lease_fenced`` semantics over Lease objects.
+
+    Duck-types the FakeCloud's lease surface, so ``ShardElector`` (and
+    the provisioner's work-queue steal probe via :meth:`list_leases`)
+    runs unchanged against a real control plane."""
+
+    def __init__(self, transport: LeaseTransport,
+                 clock: Optional[Clock] = None):
+        self.transport = transport
+        self.clock = clock or RealClock()
+        self._lock = threading.Lock()
+
+    # -- object plumbing ----------------------------------------------------
+    def _now(self) -> float:
+        return self.clock.now()
+
+    @staticmethod
+    def _annotations(obj: dict) -> dict:
+        return obj.setdefault("metadata", {}).setdefault("annotations", {})
+
+    @staticmethod
+    def _token_of(obj: dict) -> int:
+        try:
+            return int(KubeLeaseHost._annotations(obj).get(
+                TOKEN_ANNOTATION, "0"
+            ))
+        except ValueError:
+            return 0
+
+    def _expired(self, obj: dict) -> bool:
+        spec = obj.get("spec", {})
+        holder = spec.get("holderIdentity") or ""
+        if not holder:
+            return True
+        renew = spec.get("renewTime")
+        duration = spec.get("leaseDurationSeconds") or 0
+        if renew is None:
+            return True
+        return self._now() >= float(renew) + float(duration)
+
+    def _fresh_obj(self, name: str, key: str) -> dict:
+        return {
+            "apiVersion": "coordination.k8s.io/v1",
+            "kind": "Lease",
+            "metadata": {
+                "name": name,
+                "annotations": {
+                    KEY_ANNOTATION: key,
+                    TOKEN_ANNOTATION: "0",
+                    NONCE_ANNOTATION: "",
+                },
+            },
+            "spec": {},
+        }
+
+    # -- the lease-host surface --------------------------------------------
+    def try_acquire_lease_fenced(
+        self, name: str, holder: str, ttl_s: float, nonce: str = "",
+    ) -> tuple[str, int, str]:
+        """Fenced CAS acquire-or-renew; returns ``(holder, token, nonce)``
+        after the attempt — the FakeCloud contract verbatim. A lost CAS
+        (another writer moved the resourceVersion) re-reads once and
+        reports the winner instead of spinning."""
+        with self._lock:
+            return self._acquire_locked(name, holder, ttl_s, nonce)
+
+    def _acquire_locked(self, name, holder, ttl_s, nonce, retried=False):
+        obj_name = k8s_lease_name(name)
+        try:
+            obj = self.transport.get(obj_name)
+            resource_version = obj["metadata"].get("resourceVersion", "")
+            created = False
+        except LeaseNotFound:
+            obj = self._fresh_obj(obj_name, name)
+            resource_version = None
+            created = True
+        ann = self._annotations(obj)
+        spec = obj.setdefault("spec", {})
+        cur_holder = spec.get("holderIdentity") or ""
+        cur_nonce = ann.get(NONCE_ANNOTATION, "")
+        token = self._token_of(obj)
+        ours = cur_holder == holder and cur_nonce == nonce
+        if not created and not self._expired(obj) and not ours:
+            # live foreign tenancy (including the identity-collision edge:
+            # same holder string, different elector nonce = a CONTENDER)
+            return cur_holder, token, cur_nonce
+        if created or not ours or self._expired(obj):
+            # new tenancy (fresh, expired, released, or takeover): the
+            # fencing token advances; never on a renew
+            token += 1
+            ann[TOKEN_ANNOTATION] = str(token)
+            spec["acquireTime"] = self._now()
+        ann[NONCE_ANNOTATION] = nonce
+        spec["holderIdentity"] = holder
+        spec["leaseDurationSeconds"] = float(ttl_s)
+        spec["renewTime"] = self._now()
+        try:
+            if created:
+                self.transport.create(obj_name, obj)
+            else:
+                self.transport.update(obj_name, obj, resource_version)
+        except ConflictError:
+            if retried:
+                raise
+            # somebody else won the CAS: one re-read names the winner
+            return self._acquire_locked(name, holder, ttl_s, nonce,
+                                        retried=True)
+        return holder, token, nonce
+
+    def release_lease(self, name: str, holder: str) -> None:
+        """Voluntary hand-off; only the holder may release. The Lease
+        OBJECT (and its token annotation) survives — the next acquire
+        bumps the token, fencing the released tenancy out."""
+        with self._lock:
+            obj_name = k8s_lease_name(name)
+            try:
+                obj = self.transport.get(obj_name)
+            except LeaseNotFound:
+                return
+            if (obj.get("spec", {}).get("holderIdentity") or "") != holder:
+                return
+            resource_version = obj["metadata"].get("resourceVersion", "")
+            obj["spec"]["holderIdentity"] = ""
+            obj["spec"]["renewTime"] = None
+            try:
+                self.transport.update(obj_name, obj, resource_version)
+            except ConflictError:
+                pass  # a contender already took it; nothing to release
+
+    def list_leases(self, prefix: str = "") -> dict[str, tuple[str, float, str]]:
+        """Live (unexpired) leases by ORIGINAL shard-lease name,
+        prefix-filtered — the elector's membership discovery and the
+        provisioner's GLOBAL-holder liveness probe read this."""
+        out: dict[str, tuple[str, float, str]] = {}
+        for obj in self.transport.list():
+            ann = self._annotations(obj)
+            key = ann.get(KEY_ANNOTATION, "")
+            if not key.startswith(prefix) or self._expired(obj):
+                continue
+            spec = obj.get("spec", {})
+            expires = float(spec.get("renewTime") or 0.0) + float(
+                spec.get("leaseDurationSeconds") or 0.0
+            )
+            out[key] = (
+                spec.get("holderIdentity") or "", expires,
+                ann.get(NONCE_ANNOTATION, ""),
+            )
+        return out
+
+    def lease_token(self, name: str) -> int:
+        """Current fencing token (0 = never acquired); survives release."""
+        try:
+            return self._token_of(self.transport.get(k8s_lease_name(name)))
+        except LeaseNotFound:
+            return 0
+
+
+class StubLeaseApi:
+    """In-memory apiserver stub with optimistic concurrency — what the
+    unit tests (and any hermetic integration of ``KubeLeaseHost``) run
+    against. Models exactly the transport surface: resourceVersion bumps
+    on every write, ``update`` with a stale version raises
+    :class:`ConflictError`, ``get`` of a missing object raises
+    :class:`LeaseNotFound`."""
+
+    def __init__(self):
+        self._objects: dict[str, dict] = {}
+        self._rv = 0
+        self._lock = threading.Lock()
+        # introspection for tests: every (verb, name) in arrival order
+        self.writes: list[tuple[str, str]] = []
+
+    def _bump(self, obj: dict) -> dict:
+        self._rv += 1
+        obj.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
+        return obj
+
+    @staticmethod
+    def _copy(obj: dict) -> dict:
+        import copy
+
+        return copy.deepcopy(obj)
+
+    def get(self, name: str) -> dict:
+        with self._lock:
+            obj = self._objects.get(name)
+            if obj is None:
+                raise LeaseNotFound(name)
+            return self._copy(obj)
+
+    def create(self, name: str, obj: dict) -> dict:
+        with self._lock:
+            if name in self._objects:
+                raise ConflictError(f"{name} already exists")
+            stored = self._bump(self._copy(obj))
+            self._objects[name] = stored
+            self.writes.append(("create", name))
+            return self._copy(stored)
+
+    def update(self, name: str, obj: dict, resource_version: str) -> dict:
+        with self._lock:
+            cur = self._objects.get(name)
+            if cur is None:
+                raise LeaseNotFound(name)
+            if cur["metadata"].get("resourceVersion") != resource_version:
+                raise ConflictError(
+                    f"{name}: resourceVersion {resource_version} is stale"
+                )
+            stored = self._bump(self._copy(obj))
+            self._objects[name] = stored
+            self.writes.append(("update", name))
+            return self._copy(stored)
+
+    def list(self) -> list[dict]:
+        with self._lock:
+            return [self._copy(o) for o in self._objects.values()]

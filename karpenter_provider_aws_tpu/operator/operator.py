@@ -130,11 +130,15 @@ def new_operator(
     queue=None,
     clock: Optional[Clock] = None,
     cluster: Optional[Cluster] = None,
+    lease_host=None,
 ) -> Operator:
     """Build the full control plane. ``cloud`` is the cloud backend handle
     (the fake for tests; a real adapter in production). ``cluster`` lets
     multi-replica tests share one state store the way two replicas share
-    one apiserver."""
+    one apiserver. ``lease_host`` is where ``--shard-elect`` /
+    ``--leader-elect`` leases live: defaults to the cloud backend when it
+    hosts leases (the fake does); production shard deployments pass an
+    ``operator.leasehost.KubeLeaseHost`` over their apiserver transport."""
     options = options or Options.from_env_and_args()
     clock = clock or RealClock()
     if not options.prune_types:
@@ -346,20 +350,44 @@ def new_operator(
         )
 
     elector = None
+    if lease_host is None and hasattr(cloud, "try_acquire_lease"):
+        # the fake hosts leases (fenced AND plain); a plain-lease backend
+        # still serves the single LeaderElector path below
+        lease_host = cloud
     if options.shard_elect:
         # horizontally sharded control plane: per-partition leases with
         # fenced writes (operator/sharding.py); N replicas built over one
-        # shared cluster store each wire their own ShardElector
+        # shared cluster store each wire their own ShardElector. Outside
+        # the fake (the AWS backend hosts no leases) the caller supplies a
+        # kube-Lease-backed host (operator/leasehost.KubeLeaseHost) so
+        # --shard-elect works against a real apiserver.
         from .sharding import ShardElector
 
+        if lease_host is None or not hasattr(
+            lease_host, "try_acquire_lease_fenced"
+        ):
+            raise RuntimeError(
+                "--shard-elect needs a FENCED lease host: the cloud "
+                "backend does not host fenced leases — pass new_operator("
+                "lease_host=KubeLeaseHost(transport)) (operator/leasehost.py)"
+            )
         elector = ShardElector(
-            cloud, cluster, identity=options.leader_identity, clock=clock
+            lease_host, cluster, identity=options.leader_identity,
+            clock=clock,
         )
+        # the provisioner's work-stealing GLOBAL queue lives on the same
+        # lease host (netsplit seam included)
+        provisioning.elector = elector
     elif options.leader_elect:
         from .leaderelection import LeaderElector
 
+        if lease_host is None:
+            raise RuntimeError(
+                "--leader-elect needs a lease host: the cloud backend "
+                "does not host leases — pass new_operator(lease_host=...)"
+            )
         elector = LeaderElector(
-            cloud, identity=options.leader_identity, clock=clock
+            lease_host, identity=options.leader_identity, clock=clock
         )
 
     return Operator(

@@ -9,9 +9,13 @@ the whole fleet for up to a lease TTL. This module generalizes it into a
   partition, keyed on the store's stable ``(nodepool, zone)`` index
   (``Cluster.partition_key`` — the same key the partitioned encoder
   chains and the sharded screen/solve already shard by), plus one
-  ``GLOBAL`` lease owning the unpartitioned work: pending-pod
-  provisioning, the host binder, the interruption queue, and any object
-  whose partition cannot be determined.
+  ``GLOBAL`` lease owning the unpartitioned work: the interruption
+  queue, objects whose partition cannot be determined, and the
+  work-stealing GLOBAL pod queue. Pending pods themselves are ROUTED,
+  not GLOBAL-owned (:func:`pod_partition` / :func:`split_pending`,
+  designs/sharded-provisioning.md): partition-pinned pods solve on
+  their partition's lease holder, unpinned pods through the fenced
+  queue on the lease host.
 - **Fencing tokens.** Every lease carries a monotonic fencing token that
   bumps on every holder change (``CloudBackend.try_acquire_lease_fenced``;
   the fake hosts it the way a real control-plane store would). The token
@@ -145,11 +149,125 @@ def sanction(key: Optional[tuple]):
         _AMBIENT.sanction = prev
 
 
+def current_sanction() -> Optional[tuple]:
+    """The ambient :func:`sanction` key for the current thread (None when
+    no explicit sanction is in force) — captured by callers that hand
+    work to other threads (the provisioner's launch pool) so the fencing
+    resolution is identical whichever thread runs the write."""
+    return getattr(_AMBIENT, "sanction", None)
+
+
 def owns_global() -> bool:
     own = current()
     if own is None:
         return True
     return own.holds(GLOBAL_KEY)
+
+
+# -- pending-pod routing (sharded provisioning) ------------------------------
+
+#: name of the work-stealing queue for truly global pending pods on the
+#: lease host (designs/sharded-provisioning.md)
+WORK_QUEUE = "karpenter-global-pods"
+
+
+def _pinned_value(value_set) -> Optional[str]:
+    """The single label value a requirement ValueSet pins its key to, or
+    None (unconstrained / complement / multi-valued sets don't pin)."""
+    if value_set is None or value_set.complement:
+        return None
+    if len(value_set.values) != 1:
+        return None
+    return next(iter(value_set.values))
+
+
+def pod_partition(pod, nodepools=None) -> Optional[tuple]:
+    """The FEASIBLE (nodepool, zone) partition a pending pod's required
+    constraints pin it to, or None (a truly global pod).
+
+    A pod is partition-pinned iff its nodeSelector + required node
+    affinity constrain ``topology.kubernetes.io/zone`` to exactly one
+    zone AND the nodepool is determined — either pinned by a
+    ``karpenter.sh/nodepool`` selector or unambiguous because the cluster
+    runs exactly one nodepool. The rule is a pure function of the pod
+    spec (plus the stable nodepool list), so every replica routes every
+    pod identically — the property the ownership split relies on."""
+    from ..models import labels as lbl
+
+    reqs = pod.requirements()
+    zone = _pinned_value(reqs.get(lbl.TOPOLOGY_ZONE))
+    if not zone:
+        return None
+    pool = _pinned_value(reqs.get(lbl.NODEPOOL))
+    if not pool:
+        pools = list(nodepools or ())
+        if len(pools) != 1:
+            return None
+        pool = getattr(pools[0], "name", pools[0])
+    return (str(pool), str(zone))
+
+
+def routes_here(pod, nodepools=None, own: Optional[Ownership] = None) -> bool:
+    """Does this replica own ``pod``'s provisioning/binding work? The ONE
+    routing predicate both the provisioner's split and the host binder
+    filter through — the no-double-bind guarantee rests on every replica
+    routing every pod identically, so the rule must not be re-derived at
+    call sites. Pinned pods route to their partition's holder; unpinned
+    (or unleased-partition) pods to the GLOBAL holder; no ownership
+    scope means single-replica — everything routes here."""
+    own = own if own is not None else current()
+    if own is None:
+        return True
+    key = pod_partition(pod, nodepools)
+    if key is None or key not in _known_keys(own):
+        return own.holds(GLOBAL_KEY)
+    return own.holds(key)
+
+
+def split_pending(pods, nodepools=None, own: Optional[Ownership] = None):
+    """Route a pending-pod list through the ownership snapshot:
+    ``(local, global_pods, foreign)`` where ``local`` maps each OWNED
+    partition key to its pinned pods, ``global_pods`` are the unpinned
+    (or unleased-partition) pods that flow through the work-stealing
+    GLOBAL queue, and ``foreign`` are pods pinned to partitions another
+    replica owns (skipped here; their owner solves them).
+
+    With no ownership (single-replica), everything lands in
+    ``global_pods`` — the unchanged legacy path."""
+    own = own if own is not None else current()
+    local: dict[tuple, list] = {}
+    global_pods: list = []
+    foreign: list = []
+    if own is None:
+        return {}, list(pods), []
+    known = _known_keys(own)
+    for pod in pods:
+        key = pod_partition(pod, nodepools)
+        if key is None or key not in known:
+            # unpinned, or pinned to a partition no elector has contended
+            # yet: GLOBAL scope (same fall-through as owns_key)
+            global_pods.append(pod)
+        elif own.holds(key):
+            local.setdefault(key, []).append(pod)
+        else:
+            foreign.append(pod)
+    return local, global_pods, foreign
+
+
+def steal_fence(own: Optional[Ownership] = None) -> Optional[tuple]:
+    """The (key, (lease name, token)) pair sanctioning this replica's
+    claims against the GLOBAL work queue: the GLOBAL lease when held,
+    else the replica's first held partition lease (lease-name order, so
+    the choice is stable across passes). None when the replica holds
+    nothing — a lease-less replica must not touch the queue."""
+    own = own if own is not None else current()
+    if own is None:
+        return None
+    if own.holds(GLOBAL_KEY):
+        return (GLOBAL_KEY, own.fence(GLOBAL_KEY))
+    for key in sorted(own.keys, key=lease_name):
+        return (key, own.fence(key))
+    return None
 
 
 def owns_key(key: Optional[tuple]) -> bool:

@@ -86,7 +86,8 @@ class ExistingNode:
 IN_FLIGHT_PREFIX = "nodeclaim:"
 
 
-def snapshot_existing_capacity(cluster, nominations=None) -> list[ExistingNode]:
+def snapshot_existing_capacity(cluster, nominations=None, partition=None,
+                               usage=None) -> list[ExistingNode]:
     """Ready, uncordoned nodes with their current usage, solver-shaped —
     plus IN-FLIGHT NodeClaims (launched, unregistered) as pre-opened
     capacity, the core scheduler's in-flight virtual nodes: a pod burst
@@ -94,8 +95,16 @@ def snapshot_existing_capacity(cluster, nominations=None) -> list[ExistingNode]:
 
     Node usage comes from one locked pass over the pod store; in-flight
     usage is the requests of pods already nominated onto each claim
-    (``nominations``: pod uid -> claim name)."""
-    usage = cluster.node_usage()
+    (``nominations``: pod uid -> claim name).
+
+    ``partition`` scopes the snapshot to one (nodepool, zone) — the
+    sharded provisioner's partition-local solves only offer the owned
+    partition's capacity, since a partition-pinned pod cannot land
+    anywhere else (and building 100k foreign rows per local solve would
+    cap the multi-replica speedup). ``usage`` lets one reconcile pass
+    share a single O(pods) node-usage walk across its per-partition
+    solves instead of paying it per bucket."""
+    usage = usage if usage is not None else cluster.node_usage()
     claims = cluster.snapshot_claims()  # ONE snapshot for both passes below
     # a node whose claim is draining is capacity that is going away — never
     # offer it (same filter as consolidation's encode_cluster)
@@ -122,6 +131,10 @@ def snapshot_existing_capacity(cluster, nominations=None) -> list[ExistingNode]:
     for node in cluster.snapshot_nodes():
         if not node.ready or node.cordoned or node.name in draining:
             continue
+        if partition is not None and (
+            (node.nodepool_name, node.zone()) != partition
+        ):
+            continue
         out.append(row(
             node.name, node.nodepool_name, node.instance_type(), node.zone(),
             node.capacity_type(), usage.get(node.name), node.allocatable.v,
@@ -144,6 +157,8 @@ def snapshot_existing_capacity(cluster, nominations=None) -> list[ExistingNode]:
         captype = claim.labels.get(lbl.CAPACITY_TYPE, "")
         if not itype or not zone or claim.status.allocatable.is_zero():
             continue  # launch not far enough along to offer
+        if partition is not None and (claim.nodepool_name, zone) != partition:
+            continue
         out.append(row(
             IN_FLIGHT_PREFIX + claim.name, claim.nodepool_name, itype, zone,
             captype, nominated_used.get(claim.name),

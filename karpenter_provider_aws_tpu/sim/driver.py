@@ -80,7 +80,8 @@ class FleetSimulator:
                  overlays: Optional[list] = None,
                  use_tpu_solver: bool = False,
                  check_invariants: bool = True,
-                 replicas: int = 1):
+                 replicas: int = 1,
+                 envelope_check: Optional[bool] = None):
         spec = canned_trace(trace) if isinstance(trace, str) else trace
         # private clone (data round-trip): overlay fault instances carry
         # per-run fire state, exactly like chaos scenarios
@@ -96,10 +97,23 @@ class FleetSimulator:
             ]
         self.seed = int(seed)
         self.check_invariants = check_invariants
+        self.use_tpu_solver = use_tpu_solver
         # multi-replica mode: N in-process control-plane replicas over one
         # FakeClock/cluster/cloud, partition leases live (Replica* chaos
         # overlays drive the kill/pause/netsplit seams)
         self.replicas = int(replicas)
+        # packing-envelope parity (designs/sharded-provisioning.md): a
+        # multi-replica run first drives the SAME trace+seed on one
+        # replica (Replica* faults ignored — they need a ReplicaSet) and
+        # the invariant bounds this run's packing/cost against it
+        self.envelope_check = (
+            self.replicas > 1 if envelope_check is None else bool(envelope_check)
+        )
+        self.envelope: Optional[dict] = None
+        self._envelope_ref: Optional[dict] = None
+        # set on a reference sim so its composed overlays skip the
+        # replica kill/pause/netsplit faults instead of raising
+        self.ignore_replica_faults = False
         if self.replicas > 1:
             from ..testenv import new_replicaset
 
@@ -322,8 +336,38 @@ class FleetSimulator:
     # -- stepping ------------------------------------------------------------
 
     def _advance(self, seconds: float) -> None:
-        if seconds > 0:
-            self.env.clock.advance(seconds)
+        if seconds <= 0:
+            return
+        env = self.env
+        if self.replicas > 1 and hasattr(env, "replicas"):
+            # Lease renewal between driver moments: real replicas renew on
+            # their own ~2s elector cadence regardless of workload, so a
+            # quiet heartbeat must not leap past the TTL in one jump —
+            # that would expire EVERY lease and member heartbeat at once
+            # and let whichever replica reconciles first in the next pass
+            # monopolize the whole key space (and the recovery stopwatch).
+            # Chunk the advance at half the renew deadline and run the
+            # live electors between chunks; everything stays on the
+            # FakeClock, so determinism is unchanged.
+            from ..operator.sharding import RENEW_DEADLINE_FRACTION
+
+            ttl = min(r.elector.ttl_s for r in env.replicas)
+            step = max(2.0, ttl * RENEW_DEADLINE_FRACTION * 0.5)
+            remaining = seconds
+            while remaining > step:
+                env.clock.advance(step)
+                self._t += step
+                remaining -= step
+                for r in env.replicas:
+                    if r.alive and not r.paused:
+                        try:
+                            r.elector.reconcile()
+                        except Exception:  # netsplit chaos: expected weather
+                            pass
+            env.clock.advance(remaining)
+            self._t += remaining
+        else:
+            env.clock.advance(seconds)
             self._t += seconds
 
     def _pass(self) -> None:
@@ -456,6 +500,12 @@ class FleetSimulator:
     def _activate(self, tf: TimedFault) -> None:
         from ..metrics import SIM_EVENTS
 
+        if tf.fault.kind.startswith("Replica") and self.replicas == 1 \
+                and self.ignore_replica_faults:
+            # envelope reference run: the single-replica twin of a
+            # multi-replica day keeps every workload/cloud/wire fault but
+            # has no ReplicaSet for the replica seams to act on
+            return
         self.active.append(tf)
         SIM_EVENTS.inc(kind="overlay-activate")
         if tf.fault.kind.startswith("Replica") and self._loss_at is None:
@@ -525,6 +575,77 @@ class FleetSimulator:
                 "pods": len(env.cluster.pods),
             })
 
+    # -- envelope reference (packing-envelope-parity) ------------------------
+
+    def _run_envelope_reference(self) -> None:
+        """Drive the single-replica twin of this trace+seed FIRST and
+        remember its packing/cost envelope — the packing-envelope-parity
+        invariant then bounds the multi-replica day against it (sharded
+        provisioning must not buy a worse fleet than one replica would).
+        Replica* overlay faults are ignored on the twin (no ReplicaSet to
+        act on); every workload/cloud/wire fault replays identically. The
+        nested environment re-keys the process-global resilience layer
+        onto its own clock, so it is re-keyed back before this run."""
+        from ..obs.quality import fleet_hourly_cost
+        from ..resilience import breakers, faultgate
+
+        ref = FleetSimulator(
+            self.trace, seed=self.seed, replicas=1,
+            use_tpu_solver=self.use_tpu_solver,
+            check_invariants=False, envelope_check=False,
+        )
+        ref.ignore_replica_faults = True
+        try:
+            report = ref.run()
+            cost = fleet_hourly_cost(ref.env.cluster, ref.env.catalog)
+            self._envelope_ref = {
+                "packing_cpu_mean": (
+                    report.data["virtual"].get("packing", {}).get("cpu_mean")
+                ),
+                "fleet_cost_per_hr": cost,
+                "bind_count": report.gate.get("bind_count"),
+            }
+        except Exception:
+            # a broken reference run must not abort the multi-replica day:
+            # with no reference attached, packing-envelope-parity reports
+            # its explicit n/a skip instead of a never-compared PASS
+            import logging
+
+            logging.getLogger("karpenter.tpu.sim").exception(
+                "envelope reference run failed; parity check will self-skip"
+            )
+            self._envelope_ref = None
+        finally:
+            breakers.configure(clock=self.env.clock)
+            faultgate.clear()
+
+    def _compute_envelope(self) -> dict:
+        from ..obs.quality import fleet_hourly_cost
+
+        ref = self._envelope_ref or {}
+        packs = [
+            s["packing"].get("cpu") for s in self.samples
+            if s["packing"].get("cpu") is not None
+        ]
+        self_pack = round(sum(packs) / len(packs), 4) if packs else None
+        self_cost = fleet_hourly_cost(self.env.cluster, self.env.catalog)
+        ref_pack = ref.get("packing_cpu_mean")
+        ref_cost = ref.get("fleet_cost_per_hr")
+        return {
+            "self_packing_cpu_mean": self_pack,
+            "self_fleet_cost_per_hr": self_cost,
+            "ref_packing_cpu_mean": ref_pack,
+            "ref_fleet_cost_per_hr": ref_cost,
+            "ref_bind_count": ref.get("bind_count"),
+            "packing_ratio": (
+                round(self_pack / ref_pack, 4)
+                if self_pack is not None and ref_pack else None
+            ),
+            "cost_ratio": (
+                round(self_cost / ref_cost, 4) if ref_cost else None
+            ),
+        }
+
     # -- the run -------------------------------------------------------------
 
     def run(self):
@@ -535,6 +656,8 @@ class FleetSimulator:
         import os
 
         spec = self.trace
+        if self.envelope_check and self.replicas > 1:
+            self._run_envelope_reference()
         agg = SpanAggregator()
         TRACER.on_finish(agg)
         # The simulator used to pin KARPENTER_TPU_REPACK=native on CPU
@@ -557,15 +680,19 @@ class FleetSimulator:
         }
         provenance.register_ambient_provider(provider)
         from ..metrics import AUDIT_RECORDS, NODES_CREATED, NODES_TERMINATED, \
-            UNSCHEDULABLE_PODS
+            PROVISIONING_STEALS, UNSCHEDULABLE_PODS
 
         audit_kinds = ("placement", "disruption", "interruption", "eviction",
                        "lifecycle", "resilience")
+        steal_outcomes = ("claimed", "stolen", "contended", "fenced")
         counters0 = {
             "audit": {k: AUDIT_RECORDS.value(kind=k) for k in audit_kinds},
             "launched": NODES_CREATED.total(),
             "terminated": NODES_TERMINATED.total(),
             "unschedulable": UNSCHEDULABLE_PODS.total(),
+            "steals": {
+                o: PROVISIONING_STEALS.value(outcome=o) for o in steal_outcomes
+            },
         }
         wall0 = time.perf_counter()
         try:
@@ -625,6 +752,15 @@ class FleetSimulator:
                 self._pass()
                 extra = 0
                 max_extra = spec.burst_passes
+                if self.replicas > 1:
+                    # sharded provisioning pipelines work ACROSS replicas
+                    # (launch on the GLOBAL holder, register on the
+                    # partition owner, bind the nomination back on the
+                    # launcher) and each handoff lands one pass later in
+                    # the serialized step order — give multi-replica runs
+                    # the extra passes a real fleet's continuous reconcile
+                    # cadence would provide for free
+                    max_extra += 4
                 while extra < max_extra and not self._quiesced():
                     step = spec.burst_step_s
                     if self._loss_at is not None:
@@ -687,6 +823,8 @@ class FleetSimulator:
                 self._advance(CacheTTL.UNAVAILABLE_OFFERINGS + 1.0)
                 self._pass()
                 self._sample()
+                if self._envelope_ref is not None:
+                    self.envelope = self._compute_envelope()
                 if self.check_invariants:
                     self.invariants = check_all(self)
             self.driver_wall_s = time.perf_counter() - wall0
@@ -706,6 +844,9 @@ class FleetSimulator:
             "launched": NODES_CREATED.total(),
             "terminated": NODES_TERMINATED.total(),
             "unschedulable": UNSCHEDULABLE_PODS.total(),
+            "steals": {
+                o: PROVISIONING_STEALS.value(outcome=o) for o in steal_outcomes
+            },
         }
         deltas = {
             "audit": {
@@ -719,6 +860,10 @@ class FleetSimulator:
             "unschedulable": int(
                 counters1["unschedulable"] - counters0["unschedulable"]
             ),
+            "steals": {
+                o: int(counters1["steals"][o] - counters0["steals"][o])
+                for o in steal_outcomes
+            },
         }
         report = build_report(self, agg.profile(), deltas)
         global _LAST_RUN

@@ -265,7 +265,9 @@ def build_report(sim, span_profile: dict, deltas: dict) -> FleetReport:
     if getattr(sim, "replicas", 1) > 1:
         # sharded-control-plane plane (all virtual-time: deterministic,
         # inside the signature): per-replica lease holdings, the audited
-        # overlap list (must be empty), and replica-loss recovery times
+        # overlap list (must be empty), replica-loss recovery times, the
+        # work-stealing queue's claim outcomes, and the packing-envelope
+        # comparison against the single-replica reference run
         env_rs = sim.env
         with env_rs.cloud._lock:
             fenced_rejections = len(env_rs.cloud.fenced_rejections)
@@ -280,6 +282,8 @@ def build_report(sim, span_profile: dict, deltas: dict) -> FleetReport:
             "partition_gap_end": len(env_rs.partition_gap()),
             "fenced_writes_rejected": fenced_rejections,
             "replica_loss_recoveries_s": list(sim.replica_recoveries),
+            "steals": dict(deltas.get("steals", {})),
+            "envelope": dict(getattr(sim, "envelope", None) or {}),
         }
 
     wall_ms = sim.driver_wall_s * 1e3
@@ -337,6 +341,10 @@ def build_report(sim, span_profile: dict, deltas: dict) -> FleetReport:
         )
         gate["lease_overlaps"] = sharding["lease_overlaps"]
         gate["partition_gap_end"] = sharding["partition_gap_end"]
+        envelope = sharding["envelope"]
+        if envelope:
+            gate["packing_envelope_ratio"] = envelope.get("packing_ratio")
+            gate["cost_envelope_ratio"] = envelope.get("cost_ratio")
 
     return FleetReport(data={
         "schema": SCHEMA_VERSION,

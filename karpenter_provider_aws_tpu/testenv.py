@@ -245,6 +245,13 @@ class ReplicaSetEnv:
         keys = [GLOBAL_KEY] + list(self.cluster.partition_keys())
         return [k for k in keys if k not in owned]
 
+    def work_claims(self) -> dict:
+        """Live GLOBAL-queue work claims (pod uid -> (owner, expires_at))
+        — the work-stealing provisioning surface the tests assert on."""
+        from .operator.sharding import WORK_QUEUE
+
+        return self.cloud.list_work_claims(WORK_QUEUE)
+
     def _audit_leases(self) -> None:
         owners = self.ownership_map()
         for key, who in owners.items():
@@ -373,6 +380,9 @@ def new_replicaset(n: int = 2, use_tpu_solver: bool = False,
             first_status, first_hash = nc_status, nc_hash
         elector = ShardElector(cloud, cluster, identity=identity, clock=clock,
                                ttl_s=ttl_s)
+        # the provisioner's netsplit seam: a replica cut off from the
+        # lease host must stop claiming GLOBAL-queue work too
+        provisioning.elector = elector
         manager = Manager(
             [
                 nc_status, nc_hash, interruption, termination, registration,

@@ -342,7 +342,8 @@ class TestScenarioPlans:
     def test_canned_scenarios_ship(self):
         assert list_canned() == [
             "api-brownout", "eventual-consistency", "optimizer-lane-lost",
-            "replica-loss", "solver-brownout", "spot-storm", "sts-outage",
+            "provisioning-replica-loss", "replica-loss", "solver-brownout",
+            "spot-storm", "sts-outage",
         ]
 
     def test_scenario_json_round_trip(self):

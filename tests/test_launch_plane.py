@@ -59,7 +59,9 @@ class TestBootstrap:
         assert script.index("pre-bootstrap") < script.index("/etc/node/bootstrap.sh")
 
     def test_toml_family(self):
-        import tomllib
+        tomllib = pytest.importorskip(
+            "tomllib", reason="needs Python >= 3.11 (stdlib TOML parser)"
+        )
 
         from karpenter_provider_aws_tpu.models.nodepool import Taint
 
@@ -77,7 +79,9 @@ class TestBootstrap:
         assert k8s["node-labels"]["a"] == "b"
 
     def test_toml_custom_merged_generated_wins(self):
-        import tomllib
+        tomllib = pytest.importorskip(
+            "tomllib", reason="needs Python >= 3.11 (stdlib TOML parser)"
+        )
 
         custom = '[settings.kubernetes]\nmax-pods = 20\nextra = "kept"\n[settings.host]\nhostname = "h"\n'
         script = bootstrapper_for(
@@ -92,6 +96,10 @@ class TestBootstrap:
         assert parsed["settings"]["host"]["hostname"] == "h"
 
     def test_toml_invalid_custom_raises(self):
+        # the producer parses custom userdata with the stdlib TOML parser
+        pytest.importorskip(
+            "tomllib", reason="needs Python >= 3.11 (stdlib TOML parser)"
+        )
         with pytest.raises(ValueError, match="not valid TOML"):
             bootstrapper_for("bottlerocket", self.info, custom="not = [toml").script()
 

@@ -1,0 +1,217 @@
+"""Kube-Lease-backed lease host (operator/leasehost.py): fenced shard
+leases over coordination.k8s.io/v1 Lease objects, CAS'd on
+resourceVersion against a stub apiserver transport — the adapter that
+makes ``--shard-elect`` work outside FakeCloud.
+
+Mirrors the FakeCloud lease-host contract test-for-test where it
+matters: token-per-tenancy (never per renew), the token-0 never-held
+sentinel, and the identity-collision (same holder string, different
+elector nonce) edge from PR 9.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from karpenter_provider_aws_tpu.operator.leasehost import (
+    KEY_ANNOTATION,
+    ConflictError,
+    KubeLeaseHost,
+    LeaseNotFound,
+    StubLeaseApi,
+    k8s_lease_name,
+)
+from karpenter_provider_aws_tpu.operator.sharding import (
+    GLOBAL_KEY,
+    ShardElector,
+    lease_name,
+)
+from karpenter_provider_aws_tpu.state.cluster import Cluster, Node
+from karpenter_provider_aws_tpu.utils.clock import FakeClock
+
+
+def _host():
+    clock = FakeClock()
+    api = StubLeaseApi()
+    return clock, api, KubeLeaseHost(api, clock=clock)
+
+
+class TestObjectNames:
+    def test_names_are_dns1123_safe_and_distinct(self):
+        a = k8s_lease_name("karpenter-shard/__global__/")
+        b = k8s_lease_name("karpenter-shard/--global--/")
+        assert a != b  # sanitization collisions disambiguated by hash
+        for name in (a, b, k8s_lease_name("karpenter-shard/default/zone-a")):
+            assert len(name) <= 63
+            assert name == name.lower()
+            assert all(c.isalnum() or c in ".-" for c in name)
+            assert not name.startswith(("-", ".")), name
+
+    def test_deterministic(self):
+        key = "karpenter-shard/default/zone-a"
+        assert k8s_lease_name(key) == k8s_lease_name(key)
+
+
+class TestFencedSemantics:
+    def test_token_bumps_per_tenancy_not_per_renew(self):
+        clock, _api, host = _host()
+        h, t1, _ = host.try_acquire_lease_fenced("l", "a", 15.0, nonce="n1")
+        assert (h, t1) == ("a", 1)
+        clock.advance(5)
+        _, t2, _ = host.try_acquire_lease_fenced("l", "a", 15.0, nonce="n1")
+        assert t2 == 1  # renew: same tenancy, same token
+        clock.advance(16)
+        h, t3, _ = host.try_acquire_lease_fenced("l", "b", 15.0, nonce="n2")
+        assert (h, t3) == ("b", 2)  # takeover after expiry: new tenancy
+
+    def test_token_zero_is_never_held(self):
+        _clock, _api, host = _host()
+        assert host.lease_token("never-contended") == 0
+
+    def test_identity_collision_same_holder_different_nonce(self):
+        """Two elector INSTANCES misconfigured with one identity string:
+        the second is a CONTENDER, not the holder renewing — no renew, no
+        token bump, and the returned nonce names the real holder."""
+        clock, _api, host = _host()
+        h1, t1, n1 = host.try_acquire_lease_fenced("l", "x", 15.0, nonce="A")
+        h2, t2, n2 = host.try_acquire_lease_fenced("l", "x", 15.0, nonce="B")
+        assert (h1, n1) == ("x", "A")
+        assert (h2, t2, n2) == ("x", 1, "A")
+        # ... and the collision did not extend the real holder's lease:
+        # after the TTL the contender takes over with a bumped token
+        clock.advance(16)
+        h3, t3, n3 = host.try_acquire_lease_fenced("l", "x", 15.0, nonce="B")
+        assert (h3, t3, n3) == ("x", 2, "B")
+
+    def test_release_keeps_token_and_next_acquire_bumps(self):
+        _clock, api, host = _host()
+        _, t1, _ = host.try_acquire_lease_fenced("l", "a", 15.0, nonce="n")
+        host.release_lease("l", "a")
+        # the Lease OBJECT survives release with its token annotation
+        obj = api.get(k8s_lease_name("l"))
+        assert obj["metadata"]["annotations"][KEY_ANNOTATION] == "l"
+        assert host.lease_token("l") == t1
+        assert "l" not in host.list_leases()
+        _, t2, _ = host.try_acquire_lease_fenced("l", "b", 15.0, nonce="m")
+        assert t2 == t1 + 1
+
+    def test_release_by_non_holder_is_a_noop(self):
+        _clock, _api, host = _host()
+        host.try_acquire_lease_fenced("l", "a", 15.0, nonce="n")
+        host.release_lease("l", "not-a")
+        assert host.list_leases()["l"][0] == "a"
+
+    def test_live_foreign_tenancy_reports_holder(self):
+        clock, _api, host = _host()
+        host.try_acquire_lease_fenced("l", "a", 15.0, nonce="n1")
+        clock.advance(5)
+        h, t, n = host.try_acquire_lease_fenced("l", "b", 15.0, nonce="n2")
+        assert (h, t, n) == ("a", 1, "n1")
+
+    def test_list_leases_maps_back_original_names_and_prefix(self):
+        clock, _api, host = _host()
+        host.try_acquire_lease_fenced(
+            "karpenter-shard/default/zone-a", "a", 15.0, nonce="n")
+        host.try_acquire_lease_fenced(
+            "karpenter-shard-member/replica-0", "replica-0", 15.0, nonce="n")
+        live = host.list_leases("karpenter-shard-member/")
+        assert list(live) == ["karpenter-shard-member/replica-0"]
+        holder, expires, nonce = live["karpenter-shard-member/replica-0"]
+        assert holder == "replica-0" and expires == 15.0
+        clock.advance(16)
+        assert host.list_leases() == {}  # expired leases drop out
+
+    def test_conflict_retries_once_and_reports_winner(self):
+        """A CAS lost to a concurrent writer re-reads once and answers
+        with the real holder instead of raising into the elector."""
+        clock, api, host = _host()
+        host.try_acquire_lease_fenced("l", "a", 15.0, nonce="n1")
+        clock.advance(16)  # expired: both contenders see a takeover window
+
+        real_update = api.update
+        fired = {"n": 0}
+
+        def racing_update(name, obj, resource_version):
+            if fired["n"] == 0:
+                fired["n"] += 1
+                # a concurrent writer wins the CAS between our get and put
+                cur = api.get(name)
+                cur["spec"]["holderIdentity"] = "rival"
+                cur["spec"]["renewTime"] = clock.now()
+                cur["spec"]["leaseDurationSeconds"] = 15.0
+                cur["metadata"]["annotations"][
+                    "karpenter.tpu/fencing-token"] = "2"
+                cur["metadata"]["annotations"][
+                    "karpenter.tpu/holder-nonce"] = "rn"
+                real_update(name, cur,
+                            cur["metadata"]["resourceVersion"])
+                raise ConflictError("lost the race")
+            return real_update(name, obj, resource_version)
+
+        api.update = racing_update
+        h, t, n = host.try_acquire_lease_fenced("l", "b", 15.0, nonce="n2")
+        assert (h, t, n) == ("rival", 2, "rn")
+        assert fired["n"] == 1
+
+    def test_stub_transport_contract(self):
+        api = StubLeaseApi()
+        with pytest.raises(LeaseNotFound):
+            api.get("missing")
+        obj = api.create("x", {"metadata": {"name": "x"}, "spec": {}})
+        rv = obj["metadata"]["resourceVersion"]
+        with pytest.raises(ConflictError):
+            api.update("x", obj, "stale-rv")
+        api.update("x", obj, rv)
+        with pytest.raises(ConflictError):
+            api.create("x", obj)
+
+
+class TestElectorIntegration:
+    def test_shard_elector_splits_partitions_over_kube_leases(self):
+        clock = FakeClock()
+        host = KubeLeaseHost(StubLeaseApi(), clock=clock)
+        cluster = Cluster(clock=clock)
+        for z in "ab":
+            cluster.apply(Node(
+                name=f"n-{z}", nodepool_name="default",
+                labels={"topology.kubernetes.io/zone": f"zone-{z}"},
+            ))
+        a = ShardElector(host, cluster, identity="replica-0", clock=clock)
+        b = ShardElector(host, cluster, identity="replica-1", clock=clock)
+        for _ in range(3):
+            a.reconcile()
+            b.reconcile()
+            clock.advance(2)
+        owned_a, owned_b = set(a.ownership().keys), set(b.ownership().keys)
+        assert not (owned_a & owned_b)
+        assert owned_a | owned_b == {
+            GLOBAL_KEY, ("default", "zone-a"), ("default", "zone-b"),
+        }
+
+    def test_failover_within_one_ttl_on_kube_leases(self):
+        clock = FakeClock()
+        host = KubeLeaseHost(StubLeaseApi(), clock=clock)
+        cluster = Cluster(clock=clock)
+        cluster.apply(Node(
+            name="n-a", nodepool_name="default",
+            labels={"topology.kubernetes.io/zone": "zone-a"},
+        ))
+        a = ShardElector(host, cluster, identity="replica-0", clock=clock)
+        b = ShardElector(host, cluster, identity="replica-1", clock=clock)
+        for _ in range(2):
+            a.reconcile()
+            b.reconcile()
+            clock.advance(2)
+        owner = a if ("default", "zone-a") in a.ownership().keys else b
+        other = b if owner is a else a
+        t0 = clock.now()
+        recovered = None
+        for _ in range(20):
+            clock.advance(2)
+            other.reconcile()
+            if ("default", "zone-a") in other.ownership().keys:
+                recovered = clock.now() - t0
+                break
+        assert recovered is not None and recovered <= 15.0 + 2.0
+        # the takeover bumped the token: the dead replica's writes fence out
+        assert host.lease_token(lease_name(("default", "zone-a"))) >= 2

@@ -194,7 +194,9 @@ class TestLaunchTemplateReview:
         assert after and not (after & before), f"stale template survived: {after & before}"
 
     def test_toml_array_values_round_trip(self):
-        import tomllib
+        tomllib = pytest.importorskip(
+            "tomllib", reason="needs Python >= 3.11 (stdlib TOML parser)"
+        )
 
         from karpenter_provider_aws_tpu.providers.bootstrap import ClusterInfo, bootstrapper_for
 

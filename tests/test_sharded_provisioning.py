@@ -1,0 +1,468 @@
+"""Sharded provisioning: partition-owned pending pods, the work-stealing
+GLOBAL queue, and no-double-launch under replica loss.
+
+The PR 12 tentpole contract (designs/sharded-provisioning.md): pods whose
+required constraints pin them to an owned (nodepool, zone) partition are
+solved locally by that partition's lease holder; truly global pods flow
+through a fenced, exactly-once work-stealing queue on the lease host; and
+the union of per-replica outcomes equals the single-replica outcome —
+no pod solved twice, no capacity launched twice, packing/cost inside the
+single-replica envelope.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from karpenter_provider_aws_tpu.fake import FakeCloud
+from karpenter_provider_aws_tpu.models import (
+    Disruption,
+    NodePool,
+    Operator,
+    Requirement,
+)
+from karpenter_provider_aws_tpu.models import labels as lbl
+from karpenter_provider_aws_tpu.models.pod import make_pods
+from karpenter_provider_aws_tpu.operator import sharding
+from karpenter_provider_aws_tpu.operator.sharding import (
+    GLOBAL_KEY,
+    WORK_QUEUE,
+    Ownership,
+    lease_name,
+    pod_partition,
+    split_pending,
+    steal_fence,
+)
+from karpenter_provider_aws_tpu.state.cluster import Node
+from karpenter_provider_aws_tpu.testenv import new_environment, new_replicaset
+from karpenter_provider_aws_tpu.utils.clock import FakeClock
+from karpenter_provider_aws_tpu.utils.errors import StaleFencingTokenError
+
+
+def _pool(name="default"):
+    return NodePool(name=name, disruption=Disruption(consolidate_after_s=None))
+
+
+def _seed_node(cluster, zone, pool="default"):
+    cluster.apply(Node(
+        name=f"seed-{pool}-{zone}", nodepool_name=pool,
+        labels={lbl.TOPOLOGY_ZONE: zone}, ready=True,
+    ))
+
+
+# ---------------------------------------------------------------------------
+# pod -> partition routing
+# ---------------------------------------------------------------------------
+
+class TestPodPartition:
+    def test_unpinned_pod_is_global(self):
+        (p,) = make_pods(1, "w", {"cpu": "1", "memory": "1Gi"})
+        assert pod_partition(p, [_pool()]) is None
+
+    def test_zone_selector_with_single_pool_pins(self):
+        (p,) = make_pods(1, "w", {"cpu": "1", "memory": "1Gi"},
+                         node_selector={lbl.TOPOLOGY_ZONE: "zone-b"})
+        assert pod_partition(p, [_pool()]) == ("default", "zone-b")
+
+    def test_zone_selector_with_many_pools_needs_pool_pin(self):
+        (p,) = make_pods(1, "w", {"cpu": "1", "memory": "1Gi"},
+                         node_selector={lbl.TOPOLOGY_ZONE: "zone-b"})
+        pools = [_pool("a"), _pool("b")]
+        assert pod_partition(p, pools) is None
+        (q,) = make_pods(1, "w2", {"cpu": "1", "memory": "1Gi"},
+                         node_selector={lbl.TOPOLOGY_ZONE: "zone-b",
+                                        lbl.NODEPOOL: "b"})
+        assert pod_partition(q, pools) == ("b", "zone-b")
+
+    def test_required_affinity_single_zone_pins(self):
+        (p,) = make_pods(
+            1, "w", {"cpu": "1", "memory": "1Gi"},
+            node_affinity=[Requirement(lbl.TOPOLOGY_ZONE, Operator.IN,
+                                       ("zone-c",))],
+        )
+        assert pod_partition(p, [_pool()]) == ("default", "zone-c")
+
+    def test_multi_zone_affinity_is_global(self):
+        (p,) = make_pods(
+            1, "w", {"cpu": "1", "memory": "1Gi"},
+            node_affinity=[Requirement(lbl.TOPOLOGY_ZONE, Operator.IN,
+                                       ("zone-a", "zone-b"))],
+        )
+        assert pod_partition(p, [_pool()]) is None
+
+    def test_routing_matches_owns_key(self):
+        """The split agrees pod-by-pod with the owns_key predicate the
+        rest of the control plane filters through."""
+        own = Ownership(replica="r0", keys={("default", "zone-a"): 3})
+        object.__setattr__(own, "_known", frozenset(
+            [GLOBAL_KEY, ("default", "zone-a"), ("default", "zone-b")]
+        ))
+        pools = [_pool()]
+        pinned_a = make_pods(2, "a", {"cpu": "1", "memory": "1Gi"},
+                             node_selector={lbl.TOPOLOGY_ZONE: "zone-a"})
+        pinned_b = make_pods(2, "b", {"cpu": "1", "memory": "1Gi"},
+                             node_selector={lbl.TOPOLOGY_ZONE: "zone-b"})
+        pinned_new = make_pods(1, "n", {"cpu": "1", "memory": "1Gi"},
+                               node_selector={lbl.TOPOLOGY_ZONE: "zone-new"})
+        free = make_pods(2, "g", {"cpu": "1", "memory": "1Gi"})
+        local, global_pods, foreign = split_pending(
+            pinned_a + pinned_b + pinned_new + free, pools, own
+        )
+        assert {p.name for p in local[("default", "zone-a")]} == {"a-0", "a-1"}
+        assert {p.name for p in foreign} == {"b-0", "b-1"}
+        # unpinned AND pinned-to-unleased-partition pods are GLOBAL work —
+        # exactly the owns_key fall-through
+        assert {p.name for p in global_pods} == {"g-0", "g-1", "n-0"}
+        with sharding.scope(own):
+            for p in pinned_a:
+                assert sharding.owns_key(pod_partition(p, pools))
+            for p in pinned_b:
+                assert not sharding.owns_key(pod_partition(p, pools))
+
+    def test_steal_fence_prefers_global_then_stable_partition(self):
+        own = Ownership(replica="r0", keys={
+            GLOBAL_KEY: 7, ("default", "zone-a"): 3,
+        })
+        key, fence = steal_fence(own)
+        assert key == GLOBAL_KEY and fence == (lease_name(GLOBAL_KEY), 7)
+        own2 = Ownership(replica="r0", keys={
+            ("default", "zone-b"): 5, ("default", "zone-a"): 3,
+        })
+        key2, fence2 = steal_fence(own2)
+        assert key2 == ("default", "zone-a")  # lease-name order: stable
+        assert fence2 == (lease_name(("default", "zone-a")), 3)
+        assert steal_fence(Ownership(replica="r0", keys={})) is None
+
+
+# ---------------------------------------------------------------------------
+# the fenced work-claim table (the queue on the lease host)
+# ---------------------------------------------------------------------------
+
+class TestWorkQueue:
+    def _cloud(self):
+        clock = FakeClock()
+        cloud = FakeCloud(clock=clock)
+        name = lease_name(GLOBAL_KEY)
+        _, token, _ = cloud.try_acquire_lease_fenced(name, "a", 15.0, nonce="n")
+        return clock, cloud, (name, token)
+
+    def test_steal_once_under_concurrent_holders(self):
+        clock, cloud, fence = self._cloud()
+        name2 = lease_name(("default", "zone-a"))
+        _, t2, _ = cloud.try_acquire_lease_fenced(name2, "b", 15.0, nonce="m")
+        fence_b = (name2, t2)
+        got_a = cloud.try_claim_work(WORK_QUEUE, ["p1", "p2"], "a", 15.0, fence)
+        got_b = cloud.try_claim_work(WORK_QUEUE, ["p1", "p2", "p3"], "b", 15.0,
+                                     fence_b)
+        assert got_a == ["p1", "p2"]
+        assert got_b == ["p3"]  # live claims are never silently stolen
+        # the owner renews its own claims
+        clock.advance(10)
+        cloud.try_acquire_lease_fenced(lease_name(GLOBAL_KEY), "a", 15.0,
+                                       nonce="n")
+        assert cloud.try_claim_work(
+            WORK_QUEUE, ["p1"], "a", 15.0, fence) == ["p1"]
+
+    def test_expired_claim_is_re_stealable(self):
+        clock, cloud, fence = self._cloud()
+        cloud.try_claim_work(WORK_QUEUE, ["p1"], "a", 15.0, fence)
+        name2 = lease_name(("default", "zone-a"))
+        _, t2, _ = cloud.try_acquire_lease_fenced(name2, "b", 60.0, nonce="m")
+        clock.advance(16)  # a's claim (and lease) expire: a died
+        got = cloud.try_claim_work(WORK_QUEUE, ["p1"], "b", 15.0, (name2, t2))
+        assert got == ["p1"]
+        assert cloud.list_work_claims(WORK_QUEUE)["p1"][0] == "b"
+
+    def test_stale_fence_cannot_claim(self):
+        clock, cloud, fence = self._cloud()
+        name, token = fence
+        clock.advance(16)
+        cloud.try_acquire_lease_fenced(name, "b", 15.0, nonce="m")  # deposes a
+        with pytest.raises(StaleFencingTokenError):
+            cloud.try_claim_work(WORK_QUEUE, ["p1"], "a", 15.0, (name, token))
+        assert cloud.list_work_claims(WORK_QUEUE) == {}
+        assert cloud.fenced_rejections
+
+    def test_release_only_drops_own_claims(self):
+        clock, cloud, fence = self._cloud()
+        cloud.try_claim_work(WORK_QUEUE, ["p1"], "a", 15.0, fence)
+        cloud.release_work(WORK_QUEUE, ["p1"], "not-a")
+        assert cloud.list_work_claims(WORK_QUEUE)["p1"][0] == "a"
+        cloud.release_work(WORK_QUEUE, ["p1"], "a")
+        assert cloud.list_work_claims(WORK_QUEUE) == {}
+
+
+# ---------------------------------------------------------------------------
+# the sharded provisioner over a ReplicaSet
+# ---------------------------------------------------------------------------
+
+class TestShardedProvisioning:
+    def test_pinned_pods_launch_under_their_partition_lease(self):
+        rs = new_replicaset(3)
+        try:
+            rs.apply_defaults(_pool())
+            for z in ("zone-a", "zone-b"):
+                _seed_node(rs.cluster, z)
+            rs.step(2)
+            for z in ("zone-a", "zone-b"):
+                for p in make_pods(2, f"pin-{z}", {"cpu": "1", "memory": "2Gi"},
+                                   node_selector={lbl.TOPOLOGY_ZONE: z}):
+                    rs.cluster.apply(p)
+            for _ in range(8):
+                rs.step(1)
+                rs.clock.advance(1)
+            assert not rs.cluster.pending_pods()
+            with rs.cloud._lock:
+                instances = list(rs.cloud.instances.values())
+            assert instances
+            by_lease = {i.launch_fence[0] for i in instances}
+            # every launch sanctioned by the PARTITION lease of the zone
+            # it serves, not the GLOBAL lease
+            assert by_lease <= {
+                lease_name(("default", "zone-a")),
+                lease_name(("default", "zone-b")),
+            }
+            assert rs.lease_overlaps == []
+        finally:
+            rs.close()
+
+    def test_global_pods_claimed_then_launched_under_global_lease(self):
+        rs = new_replicaset(2)
+        try:
+            rs.apply_defaults(_pool())
+            _seed_node(rs.cluster, "zone-a")
+            rs.step(2)
+            for p in make_pods(3, "glob", {"cpu": "1", "memory": "2Gi"}):
+                rs.cluster.apply(p)
+            rs.step(1)
+            claims = rs.work_claims()
+            assert len(claims) == 3
+            holders = {owner for owner, _exp in claims.values()}
+            assert len(holders) == 1  # one claimant: the GLOBAL holder
+            for _ in range(6):
+                rs.step(1)
+                rs.clock.advance(1)
+            with rs.cloud._lock:
+                fences = {
+                    i.launch_fence[0] for i in rs.cloud.instances.values()
+                }
+            assert lease_name(GLOBAL_KEY) in fences
+        finally:
+            rs.close()
+
+    def test_partition_holder_steals_when_global_holder_dead(self):
+        """The work-stealing edge, pinned deterministically: the GLOBAL
+        lease is expired (holder crashed) and a surviving partition
+        holder's provisioner must claim the queue with ITS OWN lease
+        token and launch — before any elector rendezvous hands GLOBAL
+        over."""
+        from karpenter_provider_aws_tpu.metrics import PROVISIONING_STEALS
+
+        rs = new_replicaset(2)
+        try:
+            rs.apply_defaults(_pool())
+            _seed_node(rs.cluster, "zone-a")
+            rs.step(2)
+            holder = next(r for r in rs.replicas
+                          if GLOBAL_KEY in r.elector.ownership().keys)
+            survivor = next(r for r in rs.replicas if r is not holder)
+            rs.crash(rs.replicas.index(holder))
+            rs.clock.advance(16)  # every lease (incl. GLOBAL) expires
+            for p in make_pods(2, "steal", {"cpu": "1", "memory": "2Gi"}):
+                rs.cluster.apply(p)
+            # the survivor re-acquires ONLY its partition lease (its
+            # elector's rendezvous pass hasn't run yet — exactly the
+            # pre-rendezvous window work stealing exists for)
+            key = ("default", "zone-a")
+            _, tok, _ = rs.cloud.try_acquire_lease_fenced(
+                lease_name(key), survivor.identity, 15.0,
+                nonce=survivor.elector._nonce,
+            )
+            own = Ownership(replica=survivor.identity, keys={key: tok})
+            object.__setattr__(own, "_known", frozenset([GLOBAL_KEY, key]))
+            assert GLOBAL_KEY not in own.keys and own.keys
+            before = PROVISIONING_STEALS.value(outcome="stolen")
+            with sharding.scope(own):
+                survivor.provisioning.reconcile()
+            assert PROVISIONING_STEALS.value(outcome="stolen") - before >= 2
+            claims = rs.work_claims()
+            assert {o for o, _ in claims.values()} == {survivor.identity}
+            with rs.cloud._lock:
+                fences = {
+                    i.launch_fence[0] for i in rs.cloud.instances.values()
+                }
+            # the steal's launches carry the SURVIVOR'S partition lease
+            key = sorted(own.keys, key=lease_name)[0]
+            assert fences == {lease_name(key)}
+        finally:
+            rs.close()
+
+    def test_netsplit_replica_claims_nothing(self):
+        rs = new_replicaset(2)
+        try:
+            rs.apply_defaults(_pool())
+            rs.step(2)
+            holder = next(r for r in rs.replicas
+                          if GLOBAL_KEY in r.elector.ownership().keys)
+            rs.netsplit(rs.replicas.index(holder))
+            for p in make_pods(2, "cut", {"cpu": "1", "memory": "2Gi"}):
+                rs.cluster.apply(p)
+            own = holder.elector.ownership()  # snapshot still live pre-deadline
+            with sharding.scope(own):
+                holder.provisioning.reconcile()
+            # cut off from the lease host: no work claimed, no launches
+            assert rs.work_claims() == {}
+            with rs.cloud._lock:
+                assert not rs.cloud.instances
+        finally:
+            rs.close()
+
+    def test_deposed_replica_claim_is_fenced_out(self):
+        from karpenter_provider_aws_tpu.metrics import PROVISIONING_STEALS
+
+        rs = new_replicaset(2)
+        try:
+            rs.apply_defaults(_pool())
+            rs.step(2)
+            holder = next(r for r in rs.replicas
+                          if GLOBAL_KEY in r.elector.ownership().keys)
+            stale_own = holder.elector.ownership()
+            # depose: a contender takes the GLOBAL tenancy (token bumps)
+            rs.clock.advance(16)
+            rs.cloud.try_acquire_lease_fenced(
+                lease_name(GLOBAL_KEY), "intruder", 60.0, nonce="x")
+            for p in make_pods(2, "late", {"cpu": "1", "memory": "2Gi"}):
+                rs.cluster.apply(p)
+            before = PROVISIONING_STEALS.value(outcome="fenced")
+            with sharding.scope(stale_own):
+                holder.provisioning.reconcile()
+            assert PROVISIONING_STEALS.value(outcome="fenced") > before
+            assert rs.work_claims() == {}  # the stale claim bounced
+            with rs.cloud._lock:
+                assert not rs.cloud.instances
+        finally:
+            rs.close()
+
+    @pytest.mark.parametrize("seed", [1, 2, 3])
+    def test_bind_set_union_equals_single_replica(self, seed):
+        """Property (3 seeds): the union of per-replica handled sets —
+        pods bound or nominated, by name — equals the single-replica
+        run's, order-insensitive, with no pod handled by two replicas."""
+        import random
+
+        rng = random.Random(seed)
+        zones = ("zone-a", "zone-b", "zone-c")
+
+        def workload():
+            pods = []
+            for z in zones:
+                pods += make_pods(
+                    rng.randint(1, 3), f"s{seed}-pin-{z}",
+                    {"cpu": "1", "memory": "2Gi"},
+                    node_selector={lbl.TOPOLOGY_ZONE: z},
+                )
+            pods += make_pods(rng.randint(2, 4), f"s{seed}-glob",
+                              {"cpu": "1", "memory": "2Gi"})
+            return pods
+
+        def drive(env, is_rs):
+            env.apply_defaults(_pool())
+            for z in zones:
+                _seed_node(env.cluster, z)
+            env.step(2)
+            for p in workload():
+                env.cluster.apply(p)
+            for _ in range(10):
+                env.step(1)
+                env.clock.advance(1)
+            bound = sorted(
+                p.name for p in env.cluster.pods.values()
+                if p.name.startswith(f"s{seed}-") and p.node_name
+            )
+            if is_rs:
+                # no pod nominated by two replicas (exactly-once claim)
+                seen: dict = {}
+                for r in env.replicas:
+                    for uid in r.provisioning.nominations:
+                        assert uid not in seen, uid
+                        seen[uid] = r.identity
+            return bound
+
+        # seeded RNG is consumed identically for both runs
+        rng = random.Random(seed)
+        rs = new_replicaset(3)
+        try:
+            multi = drive(rs, True)
+            assert rs.lease_overlaps == []
+        finally:
+            rs.close()
+        rng = random.Random(seed)
+        env = new_environment(use_tpu_solver=False)
+        try:
+            single = drive(env, False)
+        finally:
+            env.close()
+        assert multi == single
+        assert len(multi) == len(set(multi))  # no duplicates
+
+
+# ---------------------------------------------------------------------------
+# the packing-envelope-parity invariant
+# ---------------------------------------------------------------------------
+
+class TestPackingEnvelopeInvariant:
+    def _harness(self, envelope):
+        class _H:
+            pass
+
+        h = _H()
+        h.env = new_replicaset(2)
+        h.envelope = envelope
+        return h
+
+    def test_within_envelope_passes(self):
+        from karpenter_provider_aws_tpu.chaos.invariants import (
+            check_packing_envelope_parity,
+        )
+
+        h = self._harness({"packing_ratio": 0.95, "cost_ratio": 1.05})
+        try:
+            assert check_packing_envelope_parity(h).passed
+        finally:
+            h.env.close()
+
+    def test_packing_below_envelope_fails(self):
+        from karpenter_provider_aws_tpu.chaos.invariants import (
+            check_packing_envelope_parity,
+        )
+
+        h = self._harness({"packing_ratio": 0.85, "cost_ratio": 1.0})
+        try:
+            r = check_packing_envelope_parity(h)
+            assert not r.passed and "packing" in r.detail
+        finally:
+            h.env.close()
+
+    def test_cost_above_envelope_fails(self):
+        from karpenter_provider_aws_tpu.chaos.invariants import (
+            check_packing_envelope_parity,
+        )
+
+        h = self._harness({"packing_ratio": 1.0, "cost_ratio": 1.2})
+        try:
+            r = check_packing_envelope_parity(h)
+            assert not r.passed and "cost" in r.detail
+        finally:
+            h.env.close()
+
+    def test_missing_reference_self_skips(self):
+        from karpenter_provider_aws_tpu.chaos.invariants import (
+            check_packing_envelope_parity,
+        )
+
+        h = self._harness(None)
+        try:
+            r = check_packing_envelope_parity(h)
+            assert r.passed and "n/a" in r.detail
+        finally:
+            h.env.close()
