@@ -96,10 +96,97 @@ def run_all(on_row=None, waves: int = 6, pods_per_wave: int = 50,
         })
     finally:
         env.close()
+    rows.append(_steal_wait_row(step_advance_s))
     if on_row is not None:
         for row in rows:
             on_row(row)
     return rows
+
+
+def _steal_wait_row(step_advance_s: float) -> dict:
+    """Steal-latency SLI (obs/sli.py): queue-wait (enqueue->claim) for
+    GLOBAL pods on a 2-replica sharded control plane, plus the
+    steal-wait tail forced by killing the GLOBAL-lease holder mid-run —
+    the pods it left on the queue must be STOLEN by the survivor after
+    the lease TTL, and that wait is the row's p99."""
+    import time as _time
+
+    from karpenter_provider_aws_tpu.models import Disruption, NodePool
+    from karpenter_provider_aws_tpu.models import labels as lbl
+    from karpenter_provider_aws_tpu.models.pod import make_pods
+    from karpenter_provider_aws_tpu.operator import sharding
+    from karpenter_provider_aws_tpu.operator.sharding import (
+        GLOBAL_KEY,
+        Ownership,
+        lease_name,
+    )
+    from karpenter_provider_aws_tpu.state.cluster import Node
+    from karpenter_provider_aws_tpu.testenv import new_replicaset
+
+    rs = new_replicaset(2)
+    t0 = _time.perf_counter()
+    try:
+        rs.apply_defaults(NodePool(
+            name="default", disruption=Disruption(consolidate_after_s=None),
+        ))
+        rs.cluster.apply(Node(
+            name="seed-zone-a", nodepool_name="default",
+            labels={lbl.TOPOLOGY_ZONE: "zone-a"}, ready=True,
+        ))
+        rs.step(2)
+        # healthy phase: the GLOBAL holder claims its batches in-pass
+        for w in range(3):
+            for p in make_pods(10, f"q{w}", {"cpu": "500m", "memory": "1Gi"}):
+                rs.cluster.apply(p)
+            rs.step(2)
+            rs.clock.advance(step_advance_s)
+        # loss phase: kill the holder with pods freshly enqueued. The
+        # steal window is the pre-rendezvous gap — after the dead
+        # holder's lease expires but BEFORE any elector re-targets
+        # GLOBAL — so the survivor's pass is driven explicitly under its
+        # re-acquired partition lease (the same deterministic window
+        # tests/test_sharded_provisioning.py pins).
+        holder = next(
+            r for r in rs.replicas
+            if GLOBAL_KEY in r.elector.ownership().keys
+        )
+        survivor = next(r for r in rs.replicas if r is not holder)
+        rs.crash(rs.replicas.index(holder))
+        for p in make_pods(10, "stolen", {"cpu": "500m", "memory": "1Gi"}):
+            rs.cluster.apply(p)
+        rs.step(1)  # survivor routes + enqueues; GLOBAL lease still live
+        rs.clock.advance(16.0)  # every one of the dead holder's leases lapses
+        key = ("default", "zone-a")
+        _, tok, _ = rs.cloud.try_acquire_lease_fenced(
+            lease_name(key), survivor.identity, 15.0,
+            nonce=survivor.elector._nonce,
+        )
+        own = Ownership(replica=survivor.identity, keys={key: tok})
+        object.__setattr__(own, "_known", frozenset([GLOBAL_KEY, key]))
+        with sharding.scope(own):
+            survivor.provisioning.reconcile()  # the steal
+        for _ in range(8):
+            rs.clock.advance(3.0)
+            rs.step(1)
+        queue = rs.obs.sli.queue_wait_durations()
+        steal = rs.obs.sli.steal_wait_durations()
+        return {
+            "benchmark": "pod_steal_wait_sli",
+            "global_pods": len(queue),
+            "stolen": len(steal),
+            "queue_wait_p50_s": _pct(queue, 0.50),
+            "queue_wait_p99_s": _pct(queue, 0.99),
+            "steal_wait_p50_s": _pct(steal, 0.50),
+            "steal_wait_p99_s": _pct(steal, 0.99),
+            "unbound": len(rs.cluster.pending_pods()),
+            "wall_s": round(_time.perf_counter() - t0, 3),
+            "device": "host",
+            "backend": "host",
+            "note": "2-replica work-stealing queue; GLOBAL holder killed "
+                    "with 10 pods enqueued (FakeClock; deterministic)",
+        }
+    finally:
+        rs.close()
 
 
 def main() -> None:

@@ -143,8 +143,16 @@ class Manager:
             # flight-recorded: every reconcile is a span, so the
             # /metrics per-controller latency histogram and the
             # Chrome trace of a live manager come for free (the
-            # span's error attr marks failing passes)
-            with trace_span(f"controller.{name}"):
+            # span's error attr marks failing passes). The replica
+            # identity rides the span when an elector names one, so N
+            # replicas sharing one process registry (new_replicaset)
+            # land DISTINGUISHABLE per-replica series instead of
+            # silently summing into unlabeled ones.
+            attrs = {}
+            identity = getattr(self.elector, "identity", None)
+            if identity:
+                attrs["replica"] = identity
+            with trace_span(f"controller.{name}", **attrs):
                 with _budget.scope(_budget.Budget(
                     self._budget_s(c), clock=self.clock,
                 )):

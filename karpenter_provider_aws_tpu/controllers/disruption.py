@@ -266,6 +266,18 @@ class DisruptionController:
             dict(detail or {}, nodepool=claim.nodepool_name),
             at=self.clock.now(), rev=getattr(self.cluster, "rev", None),
         )
+        # flight recorder: the claim's timeline shows WHY its pods'
+        # chains grow evict hops a moment later (trace/correlate.py)
+        ledger = getattr(self.obs, "ledger", None)
+        if ledger is not None:
+            try:
+                ledger.record(
+                    ledger.mint("NodeClaim", claim.name), "disrupt",
+                    subject_kind="NodeClaim", subject=claim.name,
+                    at=self.clock.now(), detail={"reason": reason},
+                )
+            except Exception:
+                pass
         self.cluster.delete(claim)  # termination controller drains + reaps
         return True
 

@@ -117,6 +117,38 @@ class ProvisioningController:
         ):
             if n:
                 PROVISIONING_SHARDED_PODS.inc(n, scope=scope_name)
+        # flight recorder: one route hop per pod (record_once — a pod
+        # pending across many passes routes once), and the GLOBAL pods'
+        # queue-wait clocks start here. Foreign pods are skipped: their
+        # partition's owner records the same deterministic routing as
+        # `local` — exactly one replica narrates each pod's route.
+        obs = self._obs()
+        now = self.clock.now()
+        ledger = getattr(obs, "ledger", None)
+        if ledger is not None:
+            from ..trace.correlate import correlation_id
+
+            for key, pods in local.items():
+                for p in pods:
+                    if ledger.has_recorded(correlation_id("Pod", p.uid),
+                                           "route"):
+                        continue
+                    ledger.record_once(
+                        ledger.mint("Pod", p.uid, name=p.name), "route",
+                        subject_kind="Pod", subject=p.name, at=now,
+                        detail={"scope": "local", "partition": list(key)},
+                    )
+            for p in global_pods:
+                if ledger.has_recorded(correlation_id("Pod", p.uid),
+                                       "route"):
+                    continue
+                ledger.record_once(
+                    ledger.mint("Pod", p.uid, name=p.name), "route",
+                    subject_kind="Pod", subject=p.name, at=now,
+                    detail={"scope": "global"},
+                )
+        for p in global_pods:
+            obs.sli.pod_routed_global(p.uid, now=now)
         # owned partitions first (lease-name order — deterministic): each
         # bucket solves on this replica's device mirror against ITS OWN
         # partition's capacity only (a pinned pod can't land elsewhere),
@@ -145,6 +177,20 @@ class ProvisioningController:
         # truly global pods: fenced, exactly-once claim from the queue
         claimed, fence_key = self._claim_global(global_pods, own)
         if claimed:
+            stolen = fence_key != sharding.GLOBAL_KEY
+            now = self.clock.now()
+            names = {p.uid: p.name for p in global_pods}
+            fence = own.fence(fence_key)
+            for uid in claimed:
+                obs.sli.pod_work_claimed(uid, now=now, stolen=stolen)
+                if ledger is not None:
+                    ledger.record_once(
+                        ledger.mint("Pod", uid, name=names.get(uid)),
+                        "steal" if stolen else "claim",
+                        subject_kind="Pod", subject=names.get(uid, uid),
+                        at=now, fence=fence,
+                        detail={"queue": sharding.WORK_QUEUE},
+                    )
             with sharding.sanction(fence_key):
                 self._provision(
                     scope=("global", frozenset(claimed)),
@@ -312,6 +358,29 @@ class ProvisioningController:
         obs = self._obs()
         self._audit_solve(result, obs.audit, rev0)
         self._audit_degraded(result, obs.audit, rev0, len(pending))
+        ledger = getattr(obs, "ledger", None)
+        if ledger is not None:
+            # one solve hop per pod this pass planned (record_once: an
+            # unschedulable pod re-solving every pass narrates once)
+            prov = result.provenance.label() if result.provenance else ""
+            now = self.clock.now()
+            if partition is not None:
+                solve_scope = {"scope": "local", "partition": list(partition)}
+            elif scope is not None:
+                solve_scope = {"scope": "global"}
+            else:
+                solve_scope = {"scope": "single"}
+            from ..trace.correlate import correlation_id
+
+            for pod in pending:
+                if ledger.has_recorded(correlation_id("Pod", pod.uid),
+                                       "solve"):
+                    continue
+                ledger.record_once(
+                    ledger.mint("Pod", pod.uid, name=pod.name), "solve",
+                    subject_kind="Pod", subject=pod.name, at=now,
+                    detail=dict(solve_scope, provenance=prov),
+                )
         # one SLI event per solve pass: good iff every pod was placed
         obs.slo.record(
             "solve-success", good=not result.unschedulable,
@@ -440,10 +509,10 @@ class ProvisioningController:
             f"{num_pods} pods served via the host FFD path", type=WARNING,
         )
 
-    def _note_nominated(self, uid: str) -> None:
+    def _note_nominated(self, uid: str, claim: Optional[str] = None) -> None:
         observer = getattr(self.cluster, "observer", None)
         if observer is not None:
-            observer.pod_nominated(uid, now=self.clock.now())
+            observer.pod_nominated(uid, now=self.clock.now(), claim=claim)
 
     def _apply_binds(self, binds) -> None:
         """Bind planned pods onto existing nodes, re-verifying slack at apply
@@ -472,7 +541,7 @@ class ProvisioningController:
                     continue  # launch died under us; re-solve next pass
                 with self._nominations_lock:
                     self.nominations[pod.uid] = cname
-                self._note_nominated(pod.uid)
+                self._note_nominated(pod.uid, cname)
                 continue
             node = nodes.get(node_name)
             if node is None or not node.ready or node.cordoned:
@@ -508,13 +577,36 @@ class ProvisioningController:
                   else _null_ctx()):
                 claim = launch_claim(self.cluster, self.cloudprovider, pool,
                                      spec, recorder=self.recorder)
-        if claim is None:
-            return
-        with self._nominations_lock:
-            for pod in spec.pods:
-                self.nominations[pod.uid] = claim.name
-        for pod in spec.pods:
-            self._note_nominated(pod.uid)
+                if claim is None:
+                    return
+                # hop + nomination bookkeeping stays INSIDE the re-entered
+                # scope: the hop's replica stamp and fence must name the
+                # launcher whichever worker thread runs this
+                fence = sharding.write_fence(cluster=self.cluster, claim=claim)
+                ledger = getattr(self._obs(), "ledger", None)
+                if ledger is not None:
+                    now = self.clock.now()
+                    claim_cid = ledger.mint("NodeClaim", claim.name)
+                    for pod in spec.pods:
+                        ledger.record_once(
+                            ledger.mint("Pod", pod.uid, name=pod.name),
+                            "launch", key=claim.name, subject_kind="Pod",
+                            subject=pod.name, at=now, fence=fence,
+                            detail={"claim": claim.name},
+                        )
+                    # the claim side carries the reverse link, so a claim's
+                    # timeline names the pods it was launched for
+                    ledger.record_once(
+                        claim_cid, "launch-for", key=claim.name,
+                        subject_kind="NodeClaim", subject=claim.name, at=now,
+                        fence=fence,
+                        detail={"pods": sorted(p.name for p in spec.pods)},
+                    )
+                with self._nominations_lock:
+                    for pod in spec.pods:
+                        self.nominations[pod.uid] = claim.name
+                for pod in spec.pods:
+                    self._note_nominated(pod.uid, claim.name)
 
     def forget_nominations_for(self, claim_name: str) -> None:
         with self._nominations_lock:

@@ -184,6 +184,35 @@ class SchedulingController:
             ]
             if not pending:
                 return
+            # flight recorder: the host binder runs on a 1s cadence and
+            # can bind a pod before provisioning ever routes it — record
+            # the route hop here too (record_once: whichever controller
+            # narrates first wins, the rule is the same predicate)
+            ledger = getattr(
+                getattr(self.cluster, "observer", None), "ledger", None
+            )
+            if ledger is not None:
+                from ..trace.correlate import correlation_id
+
+                now = self.clock.now()
+                for p in pending:
+                    # has_recorded first: a pod pending across many 1s
+                    # passes must not re-pay pod_partition + mint for a
+                    # hop the dedupe would discard anyway
+                    cid = correlation_id("Pod", p.uid)
+                    if ledger.has_recorded(cid, "route"):
+                        continue
+                    key = sharding.pod_partition(p, nodepools)
+                    detail = (
+                        {"scope": "local", "partition": list(key)}
+                        if key is not None and own.holds(key)
+                        else {"scope": "global"}
+                    )
+                    ledger.record_once(
+                        ledger.mint("Pod", p.uid, name=p.name), "route",
+                        subject_kind="Pod", subject=p.name, at=now,
+                        detail=detail,
+                    )
         if len(pending) > GENERAL_LOOP_MAX_PODS:
             # Bulk scale: bound THIS pass's work, topology cases first (no
             # other binder handles them); the device solve drains the bulk.

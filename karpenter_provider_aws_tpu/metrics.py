@@ -502,6 +502,19 @@ PROVISIONING_STEALS = REGISTRY.counter(
     "items lost to another live claimant's CAS, fenced = the whole claim "
     "attempt bounced on a superseded fencing token (deposed replica)",
 )
+LEASE_OWNERSHIP = REGISTRY.gauge(
+    "karpenter_lease_ownership",
+    "Partition leases (incl. GLOBAL) held per replica identity as seen on "
+    "the lease host — the fleet-wide twin of karpenter_shard_leases_held "
+    "(which each replica sets for itself); the rendezvous-imbalance gauge "
+    "below is derived from this distribution",
+)
+RENDEZVOUS_IMBALANCE = REGISTRY.gauge(
+    "karpenter_rendezvous_imbalance",
+    "max/mean partition leases held across live replicas (1.0 = perfectly "
+    "balanced rendezvous hash; the ROADMAP's 16-keys/8-replicas skew made "
+    "this measured, not anecdotal)",
+)
 PROVISIONING_SHARDED_PODS = REGISTRY.counter(
     "karpenter_provisioning_sharded_pods_total",
     "Pending pods routed by the sharded provisioner, by scope: local = "
@@ -509,6 +522,45 @@ PROVISIONING_SHARDED_PODS = REGISTRY.counter(
     "replica's device mirror, global = through the work-stealing GLOBAL "
     "queue, foreign = pinned to a partition another replica owns (skipped "
     "here, solved there)",
+)
+
+# -- fleet flight recorder (trace/correlate.py + obs/fleet.py) --------------
+POD_QUEUE_WAIT_SECONDS = REGISTRY.histogram(
+    "karpenter_pod_queue_wait_seconds",
+    "GLOBAL work-queue wait per pod (enqueue -> claim), by outcome "
+    "(claimed = the GLOBAL-lease holder's normal batch, stolen = picked "
+    "up by a partition holder after the GLOBAL holder died) — the "
+    "steal-latency SLI (obs/sli.py)",
+    buckets=(0.5, 1, 5, 15, 30, 60, 120, 300, 600, 1800),
+)
+CORRELATION_HOPS = REGISTRY.counter(
+    "karpenter_correlation_hops_total",
+    "Lifecycle hops recorded in the correlation ledger, by hop kind: "
+    "pod-side pending / route / claim / steal / solve / launch / "
+    "nominate / bind / evict, claim-side launched / launch-for / "
+    "register / ready / adopt / disrupt (trace/correlate.py; the hop "
+    "table in designs/fleet-flight-recorder.md is the vocabulary)",
+)
+
+# -- obs/sentinel.py: live steady-state regression sentinel -----------------
+SENTINEL_TICK_WALL = REGISTRY.gauge(
+    "karpenter_sentinel_tick_wall_ms",
+    "Wall milliseconds of span time attributed to the most recent "
+    "sentinel tick (the liveness-cadence delta over the cumulative "
+    "span profile)",
+)
+SENTINEL_SHARE = REGISTRY.gauge(
+    "karpenter_sentinel_share",
+    "Per-subsystem share of the most recent sentinel tick's wall profile "
+    "(controller.* spans keep their name; other spans fold to their "
+    "family) — the live twin of the cliff detector's attribution shares",
+)
+SENTINEL_REGRESSIONS = REGISTRY.counter(
+    "karpenter_sentinel_regressions_total",
+    "Edge-triggered SteadyStateRegression findings by named subsystem "
+    "and kind (attribution-shift = one family's share jumped past the "
+    "cliff thresholds, tick-superlinear = the whole tick blew past its "
+    "rolling baseline)",
 )
 
 # -- sim/ subsystem: deterministic fleet simulator --------------------------

@@ -21,23 +21,27 @@ from __future__ import annotations
 
 from typing import Optional
 
+from ..trace.correlate import CorrelationLedger
 from .audit import AuditLog, AuditRecord, default_audit
 from .explain import explain, render_text
 from .quality import OracleSampler, cluster_packing, solve_quality
+from .sentinel import SteadyStateSentinel, detect_cliffs
 from .sli import LifecycleSLI, percentile
 from .slo import BurnRule, SLOEngine, SLOSpec, default_slos
 
 __all__ = [
-    "AuditLog", "AuditRecord", "BurnRule", "LifecycleSLI", "Obs",
-    "OracleSampler", "SLOEngine", "SLOSpec", "cluster_packing",
-    "default_audit", "default_obs", "default_slos", "explain", "install",
+    "AuditLog", "AuditRecord", "BurnRule", "CorrelationLedger",
+    "LifecycleSLI", "Obs", "OracleSampler", "SLOEngine", "SLOSpec",
+    "SteadyStateSentinel", "cluster_packing", "default_audit",
+    "default_obs", "default_slos", "detect_cliffs", "explain", "install",
     "percentile", "render_text", "solve_quality",
 ]
 
 
 class Obs:
     """One observability bundle: audit ring + SLO engine + lifecycle SLI
-    + oracle sampler, sharing a clock and recorder."""
+    + oracle sampler + correlation ledger + steady-state sentinel,
+    sharing a clock and recorder."""
 
     def __init__(self, clock=None, recorder=None, audit: Optional[AuditLog] = None,
                  specs=None):
@@ -45,8 +49,16 @@ class Obs:
         self.recorder = recorder
         self.audit = audit or AuditLog(clock=clock)
         self.slo = SLOEngine(clock=clock, recorder=recorder, specs=specs)
-        self.sli = LifecycleSLI(clock=clock, engine=self.slo, audit=self.audit)
+        # cross-replica correlation ledger (trace/correlate.py): the SLI
+        # observer mints ids at first sight and controllers thread hops
+        # through it (designs/fleet-flight-recorder.md)
+        self.ledger = CorrelationLedger(clock=clock)
+        self.sli = LifecycleSLI(clock=clock, engine=self.slo, audit=self.audit,
+                                ledger=self.ledger)
         self.oracle = OracleSampler()
+        # live steady-state regression sentinel (obs/sentinel.py),
+        # evaluated on every tick below
+        self.sentinel = SteadyStateSentinel(clock=clock, recorder=recorder)
         self.cluster = None  # set by install()
 
     def tick(self, now: Optional[float] = None) -> dict:
@@ -55,6 +67,10 @@ class Obs:
         housekeeping — the event recorder's dedupe sweep happens here
         even when no new events arrive."""
         snapshot = self.slo.evaluate(now=now)
+        try:
+            self.sentinel.tick(now=now)
+        except Exception:
+            pass  # judgment must never take down the liveness loop
         if self.recorder is not None:
             try:
                 self.recorder.sweep(now=now)
@@ -105,6 +121,8 @@ class Obs:
         self.audit.reset()
         self.slo.reset()
         self.sli.reset()
+        self.ledger.reset()
+        self.sentinel.reset()
         self.oracle = OracleSampler()
 
 
@@ -128,6 +146,24 @@ def install(cluster=None, recorder=None, clock=None, specs=None,
             lambda: [r.as_dict() for r in bundle.audit.tail(200)],
         )
         REGISTRY.register_debug_page("/debug/cluster", bundle.cluster_summary)
+        # fleet flight recorder surfaces: the serialized per-process
+        # flight snapshot (full schema — ledger + audit + events +
+        # coverage — so a collected page round-trips straight into
+        # FleetRecorder.from_snapshot), and the live sentinel's
+        # baseline + findings
+        def _flight_snapshot() -> dict:
+            from .fleet import FleetRecorder
+
+            return FleetRecorder(
+                ledger=bundle.ledger, audit=bundle.audit,
+                events=bundle.recorder,
+                bound_uids=bundle.sli.bound_uids(),
+            ).snapshot()
+
+        REGISTRY.register_debug_page("/debug/flight", _flight_snapshot)
+        REGISTRY.register_debug_page(
+            "/debug/sentinel", bundle.sentinel.summary
+        )
     return bundle
 
 

@@ -5,6 +5,15 @@ object. ``--audit-file`` reads a JSONL ring dumped by ``AuditLog.dump``
 (the offline mode operators use against a collected artifact); without it
 the process-default audit log is consulted (useful in-process, mostly
 empty from a cold CLI). ``slo`` prints the engine's spec table.
+
+``fleet`` is the cross-replica flight recorder's surface
+(designs/fleet-flight-recorder.md): ``fleet explain pod/<name>`` prints
+the MERGED decision timeline (route -> steal -> solve -> fenced launch ->
+bind, whichever replicas performed each hop), ``fleet timeline`` the
+partition-ownership Gantt, ``fleet coverage`` the correlation-coverage
+stats the smoke gate thresholds. All three read a flight snapshot —
+``sim run --flight-out f.json`` or a collected ``/debug/flight`` page —
+via ``--flight-file``.
 """
 
 from __future__ import annotations
@@ -21,9 +30,37 @@ from .slo import default_slos
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m karpenter_provider_aws_tpu.obs",
-        description="observability toolbox: decision explain + SLO specs",
+        description="observability toolbox: decision explain + SLO specs "
+                    "+ fleet flight recorder",
     )
     sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_fleet = sub.add_parser(
+        "fleet", help="cross-replica flight recorder: merged timelines, "
+                      "ownership Gantt, correlation coverage",
+    )
+    fleet_sub = p_fleet.add_subparsers(dest="fleet_cmd", required=True)
+    pf_explain = fleet_sub.add_parser(
+        "explain", help="merged cross-replica lifecycle for one object"
+    )
+    pf_explain.add_argument(
+        "subject", help="object as <kind>/<name> (kind is case-insensitive: "
+                        "pod/web-0 or Pod/web-0)",
+    )
+    pf_timeline = fleet_sub.add_parser(
+        "timeline", help="partition-ownership Gantt: holders, handoffs, "
+                         "adoptions, fence rejections",
+    )
+    pf_coverage = fleet_sub.add_parser(
+        "coverage", help="correlation coverage over bound pods"
+    )
+    for p in (pf_explain, pf_timeline, pf_coverage):
+        p.add_argument(
+            "--flight-file", required=True,
+            help="flight snapshot JSON (sim run --flight-out, or a "
+                 "collected /debug/flight page)",
+        )
+        p.add_argument("--json", action="store_true")
 
     p_explain = sub.add_parser(
         "explain", help="join audit + events + provenance for one object"
@@ -51,6 +88,34 @@ def main(argv=None) -> int:
     p_slo.add_argument("--json", action="store_true")
 
     args = parser.parse_args(argv)
+
+    if args.cmd == "fleet":
+        from .fleet import FleetRecorder
+
+        recorder = FleetRecorder.load(args.flight_file)
+        if args.fleet_cmd == "coverage":
+            cov = recorder.coverage()
+            print(json.dumps(cov, indent=2) if args.json else "\n".join(
+                f"{k}: {v}" for k, v in cov.items()
+            ))
+            return 0
+        if args.fleet_cmd == "timeline":
+            gantt = recorder.ownership_gantt()
+            print(json.dumps(gantt, indent=2, sort_keys=True)
+                  if args.json else recorder.render_gantt(gantt))
+            return 0
+        # fleet explain
+        if "/" not in args.subject:
+            print("subject must be <kind>/<name>", file=sys.stderr)
+            return 2
+        kind, name = args.subject.split("/", 1)
+        kind = {"pod": "Pod", "nodeclaim": "NodeClaim"}.get(
+            kind.lower(), kind
+        )
+        view = recorder.explain(kind, name)
+        print(json.dumps(view, indent=2, sort_keys=True)
+              if args.json else recorder.render_explain(view))
+        return 0 if view.get("hops") else 3
 
     if args.cmd == "slo":
         specs = [s.as_dict() for s in default_slos()]

@@ -1,0 +1,364 @@
+"""FleetRecorder: the cross-replica flight recorder's merge + query side.
+
+``trace/correlate.py`` threads one CorrelationId through every hop of a
+pod/claim lifecycle, whichever replica performs it. This module turns
+the recorded hops into answers:
+
+- :meth:`FleetRecorder.explain` — the merged decision timeline for one
+  object: its own hops PLUS the hops of every claim its chain links to
+  (launch fences, cross-replica registration, adoption), ordered by the
+  merge rule (store clock, then ledger seq — causal within one
+  shared-world ledger — then fencing-token epoch for concatenated
+  per-process snapshots; see :func:`~..trace.correlate.merge_key`),
+  joined with the audit ring and events. ``python -m ...obs fleet
+  explain pod/<name>`` renders it.
+- :meth:`FleetRecorder.ownership_gantt` — who held which partition when:
+  segments built from the ReplicaSet's edge-triggered ownership
+  timeline, annotated with handoffs, adoptions, steals, and fenced-write
+  rejections. ``obs fleet timeline`` renders it.
+- :meth:`FleetRecorder.coverage` — the correlation-coverage gate metric:
+  the fraction of bound pods whose chain is complete (carries every
+  :data:`~..trace.correlate.REQUIRED_POD_HOPS` hop). ``make
+  fleet-obs-smoke`` fails below 99%.
+
+Sources, in order of preference:
+
+- **live** — an ``Environment`` / ``ReplicaSetEnv`` (the testenv seam):
+  the shared world's ledger, audit ring, event recorder, and — for
+  replica sets — each elector's adoption/rebalance logs and the lease
+  audit's ownership timeline.
+- **serialized** — a flight snapshot (:meth:`snapshot` /
+  :meth:`from_snapshot`): what real deployments serve per process at
+  ``/debug/flight`` and what ``sim run --flight-out`` writes. Merging N
+  processes' snapshots is concatenating their hop lists — correlation
+  ids are pure functions of object identity, so the chains interleave
+  with no translation.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..trace.correlate import (
+    CorrelationLedger,
+    Hop,
+    chain_complete,
+    merge_key,
+)
+
+SNAPSHOT_SCHEMA = 1
+RECORDS_CAP = 4096
+
+
+class FleetRecorder:
+    def __init__(self, env=None, ledger: Optional[CorrelationLedger] = None,
+                 audit=None, events=None, ownership_timeline=None,
+                 adoptions=None, rebalances=None, fenced_rejections=None,
+                 bound_uids=None):
+        self.env = env
+        obs = getattr(env, "obs", None)
+        self.ledger = ledger or (getattr(obs, "ledger", None)
+                                 if obs is not None else None) \
+            or CorrelationLedger()
+        self.audit = audit if audit is not None else (
+            getattr(obs, "audit", None) if obs is not None else None
+        )
+        self.events = events if events is not None else getattr(
+            env, "events", None
+        )
+        self.ownership_timeline = list(
+            ownership_timeline
+            if ownership_timeline is not None
+            else getattr(env, "ownership_timeline", ())
+        )
+        self._adoptions = adoptions
+        self._rebalances = rebalances
+        self._fenced = fenced_rejections
+        self._bound_uids = bound_uids
+
+    # -- collection --------------------------------------------------------
+    def adoptions(self) -> list:
+        if self._adoptions is not None:
+            return list(self._adoptions)
+        out = []
+        for r in getattr(self.env, "replicas", ()):
+            for key, claims in r.elector.adoptions:
+                out.append({
+                    "replica": r.identity, "partition": list(key),
+                    "claims": list(claims),
+                })
+        return out
+
+    def rebalances(self) -> list:
+        if self._rebalances is not None:
+            return list(self._rebalances)
+        out = []
+        for r in getattr(self.env, "replicas", ()):
+            for reason, key in r.elector.rebalances:
+                out.append({
+                    "replica": r.identity, "reason": reason,
+                    "partition": list(key),
+                })
+        return out
+
+    def fenced_rejections(self) -> list:
+        if self._fenced is not None:
+            return list(self._fenced)
+        cloud = getattr(self.env, "cloud", None)
+        if cloud is None or not hasattr(cloud, "fenced_rejections"):
+            return []
+        with cloud._lock:
+            return [
+                {"lease": name, "token": tok, "current": cur, "api": api}
+                for name, tok, cur, api in cloud.fenced_rejections
+            ]
+
+    def bound_uids(self) -> list[str]:
+        if self._bound_uids is not None:
+            return list(self._bound_uids)
+        obs = getattr(self.env, "obs", None)
+        sli = getattr(obs, "sli", None) if obs is not None else None
+        return sli.bound_uids() if sli is not None else []
+
+    # -- coverage (the fleet-obs-smoke gate) -------------------------------
+    def coverage(self) -> dict:
+        """Correlation coverage over bound pods: a chain is COMPLETE when
+        it carries a lifecycle start (pending, or evict for drained pods
+        re-entering) and the terminal bind. The denominator is the SLI's
+        bind ring (bounded at 4096 — the smoke gate's scale sits well
+        inside it)."""
+        from ..trace.correlate import correlation_id
+
+        uids = self.bound_uids()
+        complete = 0
+        for uid in uids:
+            kinds = {h.kind for h in self.ledger.hops(
+                correlation_id("Pod", uid)
+            )}
+            if chain_complete(kinds):
+                complete += 1
+        by_kind: dict[str, int] = {}
+        for hop in self.ledger.all_hops():
+            by_kind[hop.kind] = by_kind.get(hop.kind, 0) + 1
+        return {
+            "bound": len(uids),
+            "complete": complete,
+            "coverage": round(complete / len(uids), 4) if uids else None,
+            "hops_total": len(self.ledger),
+            "hops_by_kind": dict(sorted(by_kind.items())),
+        }
+
+    # -- the merged decision timeline --------------------------------------
+    def timeline(self, cid: str) -> list[Hop]:
+        return self.ledger.hops(cid)
+
+    def explain(self, kind: str, name: str, limit: int = 200) -> dict:
+        """The full cross-replica lifecycle of one object: its hops plus
+        every linked claim's hops, merge-ordered, with the audit/event
+        join beside them."""
+        cid = self.ledger.resolve(kind, name)
+        hops = list(self.ledger.hops(cid)) if cid else []
+        # follow pod -> claim links (launch/nominate hops name the claim)
+        linked: list[Hop] = []
+        seen_claims: set = set()
+        for hop in hops:
+            claim = hop.detail.get("claim")
+            if claim and claim not in seen_claims:
+                seen_claims.add(claim)
+                ccid = self.ledger.resolve("NodeClaim", claim)
+                if ccid:
+                    linked.extend(self.ledger.hops(ccid))
+        merged = sorted(hops + linked, key=merge_key)[-limit:]
+        view = {
+            "subject": f"{kind}/{name}",
+            "cid": cid,
+            "hops": [h.as_dict() for h in merged],
+            "replicas": sorted({h.replica for h in merged}),
+            "linked_claims": sorted(seen_claims),
+        }
+        # audit/event join (the PR 4 explain planes, when sources exist)
+        if self.audit is not None or self.events is not None:
+            from .explain import explain as _explain
+
+            base = _explain(kind, name, audit=self.audit,
+                            recorder=self.events, limit=50)
+            view["audit"] = base["audit"]
+            view["events"] = base["events"]
+        return view
+
+    def render_explain(self, view: dict) -> str:
+        lines = [f"== {view['subject']} "
+                 f"(cid {view.get('cid') or 'unknown'}) =="]
+        hops = view.get("hops", [])
+        if not hops:
+            lines.append("no correlated hops retained for this object")
+        else:
+            lines.append(
+                f"lifecycle across {len(view.get('replicas', []))} "
+                f"replica(s): {', '.join(view.get('replicas', []))}"
+            )
+            for h in hops:
+                fence = ""
+                if h.get("fence"):
+                    fence = f" fence={h['fence'][0]}@{h['fence'][1]}"
+                detail = h.get("detail") or {}
+                extra = " ".join(
+                    f"{k}={v}" for k, v in sorted(detail.items())
+                )
+                lines.append(
+                    f"  [{h['at']:>10.3f}] {h['replica']:<12} "
+                    f"{h['subject_kind']}/{h['subject']} {h['kind']}"
+                    + (f"  {extra}" if extra else "") + fence
+                )
+        for rec in view.get("audit", [])[-10:]:
+            lines.append(
+                f"  audit [{rec['at']:>10.3f}] {rec['kind']}: "
+                f"{rec['decision']}"
+            )
+        for ev in view.get("events", [])[-10:]:
+            lines.append(
+                f"  event [{ev['at']:>10.3f}] {ev['type']}/{ev['reason']}: "
+                f"{ev['message']}"
+            )
+        return "\n".join(lines)
+
+    # -- ownership Gantt ---------------------------------------------------
+    def ownership_gantt(self, until: Optional[float] = None) -> dict:
+        """Per-partition ownership segments from the edge-triggered
+        timeline: who held which partition when, plus the handoff /
+        adoption / steal / fence-rejection annotations."""
+        segments: dict[str, list] = {}
+        open_seg: dict[str, dict] = {}
+        last_t = 0.0
+        for t, key, _prev, cur, token in self.ownership_timeline:
+            kname = "/".join(str(k) for k in key)
+            last_t = max(last_t, t)
+            seg = open_seg.pop(kname, None)
+            if seg is not None:
+                seg["to_s"] = t
+            if cur:
+                seg = {
+                    "holder": cur, "from_s": t, "to_s": None, "token": token,
+                }
+                open_seg[kname] = seg
+                segments.setdefault(kname, []).append(seg)
+            else:
+                segments.setdefault(kname, []).append({
+                    "holder": "", "from_s": t, "to_s": None, "token": token,
+                })
+                open_seg[kname] = segments[kname][-1]
+        horizon = until if until is not None else last_t
+        for seg in open_seg.values():
+            seg["to_s"] = None if horizon <= seg["from_s"] else horizon
+        return {
+            "segments": {k: v for k, v in sorted(segments.items())},
+            "rebalances": self.rebalances(),
+            "adoptions": self.adoptions(),
+            "fenced_rejections": self.fenced_rejections(),
+        }
+
+    def render_gantt(self, gantt: Optional[dict] = None) -> str:
+        g = gantt or self.ownership_gantt()
+        lines = ["== partition ownership timeline =="]
+        if not g["segments"]:
+            lines.append("no ownership transitions recorded "
+                         "(single replica or no lease audit)")
+        for kname, segs in g["segments"].items():
+            lines.append(f"{kname}:")
+            for seg in segs:
+                to = f"{seg['to_s']:.0f}s" if seg["to_s"] is not None else "…"
+                holder = seg["holder"] or "(unowned)"
+                lines.append(
+                    f"  {seg['from_s']:>8.0f}s -> {to:<8} {holder}"
+                    + (f"  token={seg['token']}" if seg["holder"] else "")
+                )
+        ad = g.get("adoptions", [])
+        if ad:
+            lines.append("adoptions:")
+            for a in ad:
+                if a["claims"]:
+                    lines.append(
+                        f"  {a['replica']} adopted "
+                        f"{'/'.join(str(k) for k in a['partition'])}: "
+                        f"{', '.join(a['claims'][:6])}"
+                    )
+        fr = g.get("fenced_rejections", [])
+        if fr:
+            lines.append(f"fenced-write rejections: {len(fr)}")
+            for f in fr[:8]:
+                lines.append(
+                    f"  {f['api']} under {f['lease']}@{f['token']} "
+                    f"(current {f['current']})"
+                )
+        return "\n".join(lines)
+
+    # -- serialization (/debug/flight + sim --flight-out) ------------------
+    def snapshot(self) -> dict:
+        data = {
+            "schema": SNAPSHOT_SCHEMA,
+            "kind": "flight-snapshot",
+            "ledger": self.ledger.snapshot(),
+            "ownership_timeline": [
+                [t, list(key), prev, cur, token]
+                for t, key, prev, cur, token in self.ownership_timeline
+            ],
+            "adoptions": self.adoptions(),
+            "rebalances": self.rebalances(),
+            "fenced_rejections": self.fenced_rejections(),
+            "bound_uids": self.bound_uids(),
+            "coverage": self.coverage(),
+        }
+        if self.audit is not None and hasattr(self.audit, "tail"):
+            data["audit"] = [
+                r.as_dict() for r in self.audit.tail(RECORDS_CAP)
+            ]
+        if self.events is not None and hasattr(self.events, "query"):
+            data["events"] = [
+                {
+                    "kind": e.kind, "name": e.name, "type": e.type,
+                    "reason": e.reason, "message": e.message,
+                    "at": round(e.at, 3), "count": e.count,
+                }
+                for e in self.events.query()[-RECORDS_CAP:]
+            ]
+        return data
+
+    def save(self, path: str) -> str:
+        import json
+
+        with open(path, "w") as f:
+            json.dump(self.snapshot(), f, indent=1, sort_keys=True)
+            f.write("\n")
+        return path
+
+    @classmethod
+    def from_snapshot(cls, data: dict) -> "FleetRecorder":
+        from .audit import AuditRecord
+
+        audit = [
+            AuditRecord.from_dict(d) for d in data.get("audit", [])
+        ] or None
+        # event DICTS, the shape obs.explain's offline branch consumes
+        events = data.get("events") or None
+        return cls(
+            ledger=CorrelationLedger.from_snapshot(data.get("ledger", {})),
+            audit=audit,
+            events=events,
+            ownership_timeline=[
+                (t, tuple(key), prev, cur, token)
+                for t, key, prev, cur, token in data.get(
+                    "ownership_timeline", ()
+                )
+            ],
+            adoptions=data.get("adoptions", ()),
+            rebalances=data.get("rebalances", ()),
+            fenced_rejections=data.get("fenced_rejections", ()),
+            bound_uids=data.get("bound_uids", ()),
+        )
+
+    @classmethod
+    def load(cls, path: str) -> "FleetRecorder":
+        import json
+
+        with open(path) as f:
+            return cls.from_snapshot(json.load(f))

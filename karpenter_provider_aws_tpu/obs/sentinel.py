@@ -1,0 +1,388 @@
+"""Live steady-state regression sentinel + the cliff-detector core.
+
+PR 8's cliff detector found super-linear tails by sweeping the simulator
+across scale tiers — attribution AFTER the fact, inside the harness. The
+ROADMAP's 1M-node climb needs the same judgment on the live fleet, on
+the way up: when a subsystem's share of the steady-state tick jumps or a
+tick goes super-linear against its own rolling baseline, the operator
+should get a Warning event that NAMES the subsystem — not a dashboard
+they have to already be watching.
+
+Two layers, one file:
+
+- :func:`detect_cliffs` — the pure tier-comparison function, LIFTED here
+  from ``sim/cliffs.py`` (which now imports it back) so the simulator's
+  offline sweep and the live sentinel share one set of thresholds and
+  one definition of "super-linear".
+- :class:`SteadyStateSentinel` — the live half. A process-wide streaming
+  :class:`~..trace.export.SpanAggregator` (installed once, like the
+  metrics bridge) accumulates every finished span; each sentinel
+  ``tick()`` (driven on the liveness cadence through ``Obs.tick``) diffs
+  the cumulative profile against its own cursor, folds the delta into
+  per-subsystem shares, and maintains an EWMA + bounded-p99 baseline of
+  both the shares and the total tick wall. After a warmup, a share jump
+  past the cliff thresholds or a tick blowing past the wall ratio raises
+  an **edge-triggered** ``SteadyStateRegression`` Warning event naming
+  the subsystem, bumps ``karpenter_sentinel_regressions_total``, and
+  lands in ``findings`` (what ``/debug/sentinel`` and the fleet report's
+  wall plane serve).
+
+Sentinel readings are WALL-time measurements: deterministic harnesses
+(the fleet simulator's byte-identical-report contract) keep findings in
+the report's unsigned ``wall`` plane and set ``publish_events = False``
+so a slow CI machine can never perturb the signed event stream.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Optional
+
+# -- shared thresholds (the cliff detector's, unchanged from sim/cliffs) ----
+#: defaults, chosen loose enough that measurement noise at small tiers
+#: does not page and tight enough that a real N^2 blowup cannot hide
+WALL_EXPONENT = 1.35          # allowed wall growth ~ scale ** exponent
+WALL_FLOOR_S = 1.0            # ignore wall deltas below this (noise)
+BURN_FLOOR = 1.0              # a burn below sustainable never flags
+BURN_RATIO = 2.0              # ...and must at least double tier-to-tier
+SHARE_JUMP_ABS = 0.10         # +10 percentage points of the profile
+SHARE_JUMP_REL = 1.5          # and 1.5x its previous share
+
+# -- live-sentinel tuning ----------------------------------------------------
+# Floors mirror the offline detector's noise immunity (WALL_FLOOR_S):
+# a share jump inside a sub-second tick is burst texture, not a cliff —
+# the PR 10 50k finding was disruption claiming SECONDS of tick wall.
+WARMUP_TICKS = 5              # baseline samples before the sentinel judges
+EWMA_ALPHA = 0.2              # rolling-baseline smoothing
+TICK_WALL_RATIO = 3.0         # a tick this many times its EWMA is a finding
+TICK_WALL_FLOOR_MS = 1000.0   # ...if it also grew by at least this much
+SHARE_FLOOR_MS = 20.0         # ignore share math on near-empty ticks
+FAMILY_FLOOR_MS = 500.0       # a share jump must also BE this much wall
+P99_WINDOW = 128              # bounded tick-wall history for the p99 gauge
+FINDINGS_CAP = 256
+
+
+def span_family(name: str) -> str:
+    """The attribution family a span name folds into: ``controller.*``
+    spans keep their full name (the finding must NAME the controller),
+    everything else folds to its first segment (solve / consolidate /
+    aws / ...). One rule shared by the live sentinel and the simulator's
+    tier rows."""
+    family = name.split(".", 1)[0] if "." in name else name
+    return name if family == "controller" else family
+
+
+def detect_cliffs(rows: list[dict],
+                  wall_exponent: float = WALL_EXPONENT,
+                  wall_floor_s: float = WALL_FLOOR_S,
+                  burn_floor: float = BURN_FLOOR,
+                  burn_ratio: float = BURN_RATIO,
+                  share_jump_abs: float = SHARE_JUMP_ABS,
+                  share_jump_rel: float = SHARE_JUMP_REL) -> dict:
+    """Pure comparison over tier rows (sorted by ``tier`` ascending).
+
+    Returns ``{"cliff_tier": first flagged tier or None,
+    "findings": [...]}`` — each finding names the tier, the metric, and
+    the evidence (previous vs current value and the allowed bound).
+    Formerly ``sim.cliffs.detect_cliffs``; the simulator re-exports it."""
+    rows = sorted(rows, key=lambda r: r["tier"])
+    findings: list[dict] = []
+    for prev, cur in zip(rows, rows[1:]):
+        k = cur["tier"] / prev["tier"] if prev["tier"] else 1.0
+        # wall growth vs scale growth
+        w0 = prev.get("wall_per_sim_hour_s") or 0.0
+        w1 = cur.get("wall_per_sim_hour_s") or 0.0
+        bound = w0 * (k ** wall_exponent)
+        if w0 > 0 and w1 - bound > wall_floor_s:
+            findings.append({
+                "tier": cur["tier"], "kind": "wall-superlinear",
+                "detail": (
+                    f"wall/sim-hour {w0:g}s -> {w1:g}s at {k:g}x scale "
+                    f"(allowed <= {bound:.2f}s = prev * {k:g}^{wall_exponent})"
+                ),
+            })
+        # SLO burn regression
+        b0 = prev.get("slo_worst_burn") or 0.0
+        b1 = cur.get("slo_worst_burn") or 0.0
+        if b1 > burn_floor and b1 > max(b0 * burn_ratio, b0 + burn_floor):
+            findings.append({
+                "tier": cur["tier"], "kind": "slo-burn-regression",
+                "detail": (
+                    f"worst burn {b0:g} -> {b1:g} "
+                    f"(floor {burn_floor:g}, ratio {burn_ratio:g}x)"
+                ),
+            })
+        # attribution share shift
+        for family in sorted(set(prev.get("shares", {}))
+                             | set(cur.get("shares", {}))):
+            s0 = prev.get("shares", {}).get(family, 0.0)
+            s1 = cur.get("shares", {}).get(family, 0.0)
+            if s1 - s0 > share_jump_abs and s1 > s0 * share_jump_rel:
+                findings.append({
+                    "tier": cur["tier"], "kind": "attribution-shift",
+                    "detail": (
+                        f"{family} share {s0:.1%} -> {s1:.1%} "
+                        f"(+{share_jump_abs:.0%} abs and "
+                        f"{share_jump_rel:g}x rel exceeded)"
+                    ),
+                })
+    cliff: Optional[int] = min(
+        (f["tier"] for f in findings), default=None
+    )
+    return {"cliff_tier": cliff, "findings": findings}
+
+
+# -- the process-wide cumulative profile ------------------------------------
+
+_CUM_LOCK = threading.Lock()
+_CUMULATIVE = None
+
+
+def cumulative_profile() -> dict:
+    """The process's streaming span profile (installed once on the
+    default tracer, like the metrics bridge). Sentinels diff this
+    against their own cursors — N bundles share one on_finish hook."""
+    global _CUMULATIVE
+    with _CUM_LOCK:
+        if _CUMULATIVE is None:
+            from ..trace.export import SpanAggregator
+            from ..trace.spans import TRACER
+
+            _CUMULATIVE = SpanAggregator()
+            TRACER.on_finish(_CUMULATIVE)
+        return _CUMULATIVE.profile()
+
+
+class SteadyStateSentinel:
+    """Rolling per-tick attribution baseline + edge-triggered regression
+    events. One per Obs bundle; ticked on the liveness cadence."""
+
+    def __init__(self, clock=None, recorder=None, profile_source=None,
+                 warmup_ticks: int = WARMUP_TICKS,
+                 share_jump_abs: float = SHARE_JUMP_ABS,
+                 share_jump_rel: float = SHARE_JUMP_REL,
+                 tick_wall_ratio: float = TICK_WALL_RATIO,
+                 tick_wall_floor_ms: float = TICK_WALL_FLOOR_MS,
+                 family_floor_ms: float = FAMILY_FLOOR_MS):
+        self.clock = clock
+        self.recorder = recorder
+        # deterministic harnesses flip this off: findings stay readable
+        # (wall plane, /debug/sentinel) but never enter the event stream
+        self.publish_events = True
+        self._source = profile_source or cumulative_profile
+        self.warmup_ticks = int(warmup_ticks)
+        self.share_jump_abs = float(share_jump_abs)
+        self.share_jump_rel = float(share_jump_rel)
+        self.tick_wall_ratio = float(tick_wall_ratio)
+        self.tick_wall_floor_ms = float(tick_wall_floor_ms)
+        self.family_floor_ms = float(family_floor_ms)
+        self._lock = threading.Lock()
+        self._cursor: dict[str, float] = {}     # span name -> total_ms seen
+        self._baseline: dict[str, float] = {}   # family -> EWMA share
+        self._wall_ewma: Optional[float] = None
+        self._wall_hist: deque = deque(maxlen=P99_WINDOW)
+        self._ticks = 0
+        self._active: set = set()               # (kind, family) episodes
+        self._share_exported: set = set()       # families on the gauge
+        self.findings: deque = deque(maxlen=FINDINGS_CAP)
+        self.last_tick: dict = {}
+
+    def _now(self) -> float:
+        if self.clock is not None:
+            return self.clock.now()
+        import time
+
+        return time.monotonic()
+
+    # -- the tick ----------------------------------------------------------
+    def tick(self, now: Optional[float] = None) -> list[dict]:
+        """One judgment pass: diff the cumulative profile, update the
+        baseline, raise edge-triggered findings. Returns the findings
+        NEW this tick."""
+        now = self._now() if now is None else now
+        profile = self._source()
+        spans = profile.get("spans", profile)  # tolerate bare span maps
+        delta: dict[str, float] = {}
+        with self._lock:
+            for name, cell in spans.items():
+                if name.startswith("sim."):
+                    # driver container spans CONTAIN the controller spans
+                    # (and exist only under the simulator) — folding them
+                    # in would double-count every reconcile
+                    continue
+                total = float(cell["total_ms"])
+                d = total - self._cursor.get(name, 0.0)
+                self._cursor[name] = total
+                if d > 0:
+                    family = span_family(name)
+                    delta[family] = delta.get(family, 0.0) + d
+            tick_ms = sum(delta.values())
+            new = self._judge_locked(delta, tick_ms, now)
+            self._ticks += 1
+            self._wall_hist.append(tick_ms)
+            # baseline update AFTER judging: a regression tick must not
+            # teach the baseline that regressed is normal before it is
+            # flagged (it still folds in afterwards, so a persistent new
+            # plateau stops alerting — edge-triggered, not a stuck page)
+            if self._wall_ewma is None:
+                self._wall_ewma = tick_ms
+            else:
+                self._wall_ewma += EWMA_ALPHA * (tick_ms - self._wall_ewma)
+            if tick_ms >= SHARE_FLOOR_MS:
+                for family, d in delta.items():
+                    share = d / tick_ms
+                    base = self._baseline.get(family)
+                    self._baseline[family] = (
+                        share if base is None
+                        else base + EWMA_ALPHA * (share - base)
+                    )
+            self.last_tick = {
+                "at": round(now, 3),
+                "tick_wall_ms": round(tick_ms, 3),
+                "shares": {
+                    f: round(d / tick_ms, 4) for f, d in sorted(delta.items())
+                } if tick_ms > 0 else {},
+            }
+        self._export_gauges(delta, tick_ms)
+        for f in new:
+            self._raise(f)
+        return new
+
+    def _judge_locked(self, delta: dict, tick_ms: float,
+                      now: float) -> list[dict]:
+        new: list[dict] = []
+        if self._ticks < self.warmup_ticks:
+            return new
+        seen: set = set()
+        # share jump: one subsystem suddenly dominates the tick
+        if tick_ms >= SHARE_FLOOR_MS:
+            for family, d in delta.items():
+                if d < self.family_floor_ms:
+                    continue  # sub-floor wall: burst texture, not a cliff
+                share = d / tick_ms
+                base = self._baseline.get(family, 0.0)
+                if (share - base > self.share_jump_abs
+                        and share > base * self.share_jump_rel):
+                    key = ("attribution-shift", family)
+                    seen.add(key)
+                    if key not in self._active:
+                        self._active.add(key)
+                        new.append({
+                            "at": round(now, 3),
+                            "kind": "attribution-shift",
+                            "family": family,
+                            "detail": (
+                                f"{family} share {base:.1%} -> {share:.1%} "
+                                f"of a {tick_ms:.0f}ms tick "
+                                f"(+{self.share_jump_abs:.0%} abs and "
+                                f"{self.share_jump_rel:g}x rel exceeded)"
+                            ),
+                        })
+        # tick blowup: the whole steady-state pass went super-linear
+        # against its own rolling baseline; name the top-growing family
+        base_wall = self._wall_ewma or 0.0
+        if (base_wall > 0
+                and tick_ms > base_wall * self.tick_wall_ratio
+                and tick_ms - base_wall > self.tick_wall_floor_ms):
+            top = max(delta, key=delta.get, default="?")
+            key = ("tick-superlinear", top)
+            seen.add(key)
+            if key not in self._active:
+                self._active.add(key)
+                new.append({
+                    "at": round(now, 3),
+                    "kind": "tick-superlinear",
+                    "family": top,
+                    "detail": (
+                        f"tick wall {tick_ms:.0f}ms vs baseline "
+                        f"{base_wall:.0f}ms (> {self.tick_wall_ratio:g}x); "
+                        f"led by {top} ({delta.get(top, 0.0):.0f}ms)"
+                    ),
+                })
+        # episodes that calmed down re-arm (edge-triggered)
+        self._active &= seen
+        self.findings.extend(new)
+        return new
+
+    def _raise(self, finding: dict) -> None:
+        try:
+            from ..metrics import SENTINEL_REGRESSIONS
+
+            SENTINEL_REGRESSIONS.inc(
+                family=finding["family"], kind=finding["kind"]
+            )
+        except Exception:
+            pass
+        if self.recorder is not None and self.publish_events:
+            try:
+                from ..events import WARNING
+
+                self.recorder.publish(
+                    "Sentinel", finding["family"], "SteadyStateRegression",
+                    finding["detail"], type=WARNING,
+                )
+            except Exception:
+                pass
+
+    def _export_gauges(self, delta: dict, tick_ms: float) -> None:
+        try:
+            from ..metrics import SENTINEL_SHARE, SENTINEL_TICK_WALL
+        except Exception:
+            return
+        SENTINEL_TICK_WALL.set(round(tick_ms, 3))
+        exported: set = set()
+        if tick_ms > 0:
+            # bounded cardinality: only the tick's top families
+            top = sorted(delta.items(), key=lambda kv: -kv[1])[:12]
+            for family, d in top:
+                SENTINEL_SHARE.set(round(d / tick_ms, 4), family=family)
+                exported.add(family)
+        # families absent from THIS tick drop to 0: the gauge documents
+        # one tick's profile, and stale shares from earlier ticks would
+        # sum past 1.0 and mislead attribution triage
+        for family in self._share_exported - exported:
+            SENTINEL_SHARE.set(0.0, family=family)
+        self._share_exported = exported
+
+    # -- introspection (/debug/sentinel) -----------------------------------
+    def summary(self) -> dict:
+        from .sli import percentile
+
+        with self._lock:
+            hist = list(self._wall_hist)
+            return {
+                "ticks": self._ticks,
+                "warmed_up": self._ticks >= self.warmup_ticks,
+                "baseline_shares": {
+                    f: round(s, 4) for f, s in sorted(self._baseline.items())
+                },
+                "tick_wall_ewma_ms": (
+                    round(self._wall_ewma, 3)
+                    if self._wall_ewma is not None else None
+                ),
+                "tick_wall_p99_ms": percentile(hist, 0.99),
+                "last_tick": dict(self.last_tick),
+                "active_episodes": sorted(
+                    f"{kind}:{family}" for kind, family in self._active
+                ),
+                "findings": [dict(f) for f in self.findings],
+            }
+
+    def reset(self) -> None:
+        """Fresh baseline AND a fresh cursor over the cumulative profile:
+        spans recorded before the reset (a previous run's, a fleet
+        build's) must not land in the first tick's delta."""
+        profile = self._source()
+        spans = profile.get("spans", profile)
+        with self._lock:
+            self._cursor = {
+                name: float(cell["total_ms"]) for name, cell in spans.items()
+            }
+            self._baseline.clear()
+            self._wall_ewma = None
+            self._wall_hist.clear()
+            self._ticks = 0
+            self._active.clear()
+            self.findings.clear()
+            self.last_tick = {}

@@ -419,6 +419,9 @@ class ShardElector:
         # the exactly-once evidence the ReplicaSet tests assert on
         self.adoptions: list[tuple[tuple, tuple]] = []
         self.rebalances: list[tuple[str, tuple]] = []  # (reason, key)
+        # holders this elector last published lease_ownership for
+        # (vanished ones are zeroed on the next export)
+        self._ownership_exported: set = set()
 
     # -- clock -------------------------------------------------------------
     def _now(self) -> float:
@@ -532,6 +535,7 @@ class ShardElector:
             self._known = frozenset(keys)
             self._renewed = {k: at for k, at in self._renewed.items() if k in held}
         SHARD_LEASES_HELD.set(float(len(held)), replica=self.identity)
+        self._export_imbalance()
         # 5. handoff barrier, adopt side: partitions we JUST acquired may
         # carry unsettled claims from a dead predecessor — adopt them at
         # the acquire edge, exactly once per TENANCY (token bump). A
@@ -576,6 +580,36 @@ class ShardElector:
             self._held = held
         SHARD_LEASES_HELD.set(float(len(held)), replica=self.identity)
 
+    def _export_imbalance(self) -> None:
+        """Publish the fleet-wide lease distribution the lease host sees:
+        ``karpenter_lease_ownership{replica}`` per holder and
+        ``karpenter_rendezvous_imbalance`` = max/mean held — the ROADMAP's
+        16-keys/8-replicas rendezvous skew, measured instead of anecdotal.
+        One extra prefix listing per elector tick (~2s); every replica
+        computes the same answer from the same lease table."""
+        from ..metrics import LEASE_OWNERSHIP, RENDEZVOUS_IMBALANCE
+
+        try:
+            leases = self.cloud.list_leases(LEASE_PREFIX + "/")
+        except Exception:
+            return  # brownout: keep the last published distribution
+        by_holder: dict[str, int] = {}
+        for _name, (holder, _exp, _nonce) in leases.items():
+            by_holder[holder] = by_holder.get(holder, 0) + 1
+        # holders that vanished since the last export (crashed replica,
+        # leases expired) must drop to 0, not freeze at their last value
+        # — the replica-loss dashboard reads exactly this edge
+        for holder in self._ownership_exported - set(by_holder):
+            LEASE_OWNERSHIP.set(0.0, replica=holder)
+        self._ownership_exported = set(by_holder)
+        for holder, n in sorted(by_holder.items()):
+            LEASE_OWNERSHIP.set(float(n), replica=holder)
+        if by_holder:
+            mean = sum(by_holder.values()) / len(by_holder)
+            RENDEZVOUS_IMBALANCE.set(
+                round(max(by_holder.values()) / mean, 4) if mean else 0.0
+            )
+
     def _adopt(self, key: tuple) -> None:
         """Adopt a freshly-acquired partition's unsettled claims: every
         launched-but-unregistered (and every draining) NodeClaim whose
@@ -598,6 +632,26 @@ class ShardElector:
             ):
                 unsettled.append(claim.name)
         self.adoptions.append((key, tuple(sorted(unsettled))))
+        # flight recorder: one adopt hop per claim, under the NEW
+        # tenancy's fencing token (the elector reconciles outside the
+        # ownership scope, so the replica is stamped explicitly)
+        ledger = getattr(
+            getattr(self.cluster, "observer", None), "ledger", None
+        )
+        if ledger is not None:
+            token = self._held.get(key, 0)
+            for name in sorted(unsettled):
+                try:
+                    ledger.record_once(
+                        ledger.mint("NodeClaim", name), "adopt",
+                        key=f"{lease_name(key)}@{token}",
+                        subject_kind="NodeClaim", subject=name,
+                        replica=self.identity,
+                        fence=(lease_name(key), token),
+                        detail={"partition": list(key)},
+                    )
+                except Exception:
+                    pass
         if unsettled:
             log.info(
                 "%s adopted partition %s with %d unsettled claims: %s",

@@ -42,6 +42,9 @@ def main(argv=None) -> int:
                             "sharded lease layer; Replica* overlays need it)")
     p_run.add_argument("--report", default="",
                        help="write the fleet-report JSON artifact here")
+    p_run.add_argument("--flight-out", default="",
+                       help="write the flight-recorder snapshot here "
+                            "(the `obs fleet explain/timeline` input)")
     p_run.add_argument("--check-determinism", action="store_true",
                        help="run twice and require byte-identical reports")
     p_run.add_argument("--json", action="store_true",
@@ -82,6 +85,13 @@ def main(argv=None) -> int:
         kw = dict(nodes=args.nodes, duration_s=duration,
                   overlays=list(args.overlay), replicas=args.replicas)
         if args.check_determinism:
+            if args.flight_out:
+                # the determinism harness discards its simulators, so
+                # there is no ledger left to snapshot — be loud, not
+                # silent, about the flag being unsupported here
+                print("warning: --flight-out is ignored with "
+                      "--check-determinism (rerun without it to write "
+                      "the flight snapshot)", file=sys.stderr)
             try:
                 reports = run_deterministic(
                     load_trace(args.trace), seed=args.seed, runs=2, **kw
@@ -93,7 +103,13 @@ def main(argv=None) -> int:
             print("determinism: 2 same-seed runs byte-identical",
                   file=sys.stderr)
         else:
-            report = run_trace(load_trace(args.trace), seed=args.seed, **kw)
+            from .driver import FleetSimulator
+
+            sim = FleetSimulator(load_trace(args.trace), seed=args.seed, **kw)
+            report = sim.run()
+            if args.flight_out:
+                sim.flight_recorder().save(args.flight_out)
+                print(f"wrote {args.flight_out}", file=sys.stderr)
         if args.report:
             report.save(args.report)
             print(f"wrote {args.report}", file=sys.stderr)

@@ -17,20 +17,26 @@ flags the FIRST tier where the system stops scaling linearly:
   "find the cliff AND name it".
 
 Method + thresholds are documented in ``designs/fleet-simulator.md``.
+
+The pure detector and its thresholds now LIVE in ``obs/sentinel.py``
+(the live steady-state sentinel shares them — one definition of
+"super-linear" for the offline sweep and the on-fleet judge); this
+module keeps the simulator-side halves (tier reduction + sweep) and
+re-exports the names existing callers import from here.
 """
 
 from __future__ import annotations
 
-from typing import Optional
-
-#: defaults, chosen loose enough that measurement noise at small tiers
-#: does not page and tight enough that a real N^2 blowup cannot hide
-WALL_EXPONENT = 1.35          # allowed wall growth ~ scale ** exponent
-WALL_FLOOR_S = 1.0            # ignore wall deltas below this (noise)
-BURN_FLOOR = 1.0              # a burn below sustainable never flags
-BURN_RATIO = 2.0              # ...and must at least double tier-to-tier
-SHARE_JUMP_ABS = 0.10         # +10 percentage points of the profile
-SHARE_JUMP_REL = 1.5          # and 1.5x its previous share
+# re-exported for existing importers; canonical home is obs/sentinel.py
+from ..obs.sentinel import (  # noqa: F401
+    BURN_FLOOR,
+    BURN_RATIO,
+    SHARE_JUMP_ABS,
+    SHARE_JUMP_REL,
+    WALL_EXPONENT,
+    WALL_FLOOR_S,
+    detect_cliffs,
+)
 
 
 def tier_row(nodes: int, report) -> dict:
@@ -41,6 +47,8 @@ def tier_row(nodes: int, report) -> dict:
     wall_ms = wall_s * 1e3
     shares: dict[str, float] = {}
     if wall_ms > 0:
+        from ..obs.sentinel import span_family
+
         for name, cell in att.get("spans", {}).items():
             family = name.split(".", 1)[0] if "." in name else name
             # sim.controllers CONTAINS the controller.* spans; keep the
@@ -48,7 +56,7 @@ def tier_row(nodes: int, report) -> dict:
             # sim-only segments so shares don't double-count
             if family == "sim" and name != "sim.build":
                 continue
-            key = name if family in ("controller", "sim") else family
+            key = name if family == "sim" else span_family(name)
             shares[key] = round(
                 shares.get(key, 0.0) + cell["total_ms"] / wall_ms, 4
             )
@@ -62,65 +70,6 @@ def tier_row(nodes: int, report) -> dict:
         "shares": shares,
         "signature": report.signature(),
     }
-
-
-def detect_cliffs(rows: list[dict],
-                  wall_exponent: float = WALL_EXPONENT,
-                  wall_floor_s: float = WALL_FLOOR_S,
-                  burn_floor: float = BURN_FLOOR,
-                  burn_ratio: float = BURN_RATIO,
-                  share_jump_abs: float = SHARE_JUMP_ABS,
-                  share_jump_rel: float = SHARE_JUMP_REL) -> dict:
-    """Pure comparison over tier rows (sorted by ``tier`` ascending).
-
-    Returns ``{"cliff_tier": first flagged tier or None,
-    "findings": [...]}`` — each finding names the tier, the metric, and
-    the evidence (previous vs current value and the allowed bound)."""
-    rows = sorted(rows, key=lambda r: r["tier"])
-    findings: list[dict] = []
-    for prev, cur in zip(rows, rows[1:]):
-        k = cur["tier"] / prev["tier"] if prev["tier"] else 1.0
-        # wall growth vs scale growth
-        w0 = prev.get("wall_per_sim_hour_s") or 0.0
-        w1 = cur.get("wall_per_sim_hour_s") or 0.0
-        bound = w0 * (k ** wall_exponent)
-        if w0 > 0 and w1 - bound > wall_floor_s:
-            findings.append({
-                "tier": cur["tier"], "kind": "wall-superlinear",
-                "detail": (
-                    f"wall/sim-hour {w0:g}s -> {w1:g}s at {k:g}x scale "
-                    f"(allowed <= {bound:.2f}s = prev * {k:g}^{wall_exponent})"
-                ),
-            })
-        # SLO burn regression
-        b0 = prev.get("slo_worst_burn") or 0.0
-        b1 = cur.get("slo_worst_burn") or 0.0
-        if b1 > burn_floor and b1 > max(b0 * burn_ratio, b0 + burn_floor):
-            findings.append({
-                "tier": cur["tier"], "kind": "slo-burn-regression",
-                "detail": (
-                    f"worst burn {b0:g} -> {b1:g} "
-                    f"(floor {burn_floor:g}, ratio {burn_ratio:g}x)"
-                ),
-            })
-        # attribution share shift
-        for family in sorted(set(prev.get("shares", {}))
-                             | set(cur.get("shares", {}))):
-            s0 = prev.get("shares", {}).get(family, 0.0)
-            s1 = cur.get("shares", {}).get(family, 0.0)
-            if s1 - s0 > share_jump_abs and s1 > s0 * share_jump_rel:
-                findings.append({
-                    "tier": cur["tier"], "kind": "attribution-shift",
-                    "detail": (
-                        f"{family} share {s0:.1%} -> {s1:.1%} "
-                        f"(+{share_jump_abs:.0%} abs and "
-                        f"{share_jump_rel:g}x rel exceeded)"
-                    ),
-                })
-    cliff: Optional[int] = min(
-        (f["tier"] for f in findings), default=None
-    )
-    return {"cliff_tier": cliff, "findings": findings}
 
 
 def sweep(trace, tiers, seed: int = 0, **kw) -> dict:

@@ -132,6 +132,11 @@ class FleetSimulator:
             resolution_s=0.001,
             cap_s=max(0.25, min(2.0, self.trace.burst_step_s * 0.5)),
         )
+        # steady-state sentinel: findings are wall-time judgments, so a
+        # slow CI machine must never perturb the SIGNED event stream —
+        # the sentinel keeps judging (its findings land in the report's
+        # unsigned wall plane) but publishes no events here
+        self.env.obs.sentinel.publish_events = False
         # chaos seams (the harness protocol faults/invariants expect)
         self.log = ChaosLog()
         self.cloud_rng = random.Random(f"{self.seed}:cloud")
@@ -330,7 +335,9 @@ class FleetSimulator:
                 env.cluster.bind_pod(p.uid, node.name)
         self.nodes_start = len(env.cluster.nodes)
         # the build's own binds are setup, not signal: wipe the judgment
-        # plane so SLO/SLI/audit history starts at the trace's t=0
+        # plane (incl. the correlation ledger and the sentinel's span
+        # cursor — build spans must not be the first tick's "regression")
+        # so SLO/SLI/audit history starts at the trace's t=0
         env.obs.reset()
 
     # -- stepping ------------------------------------------------------------
@@ -647,6 +654,14 @@ class FleetSimulator:
         }
 
     # -- the run -------------------------------------------------------------
+
+    def flight_recorder(self):
+        """The run's cross-replica flight recorder (obs/fleet.py) over
+        the shared world — ``--flight-out`` serializes its snapshot for
+        the ``obs fleet`` CLI."""
+        from ..obs.fleet import FleetRecorder
+
+        return FleetRecorder(self.env)
 
     def run(self):
         """Drive the whole trace; returns the :class:`sim.report.FleetReport`."""

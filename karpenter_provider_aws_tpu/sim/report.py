@@ -214,6 +214,13 @@ def build_report(sim, span_profile: dict, deltas: dict) -> FleetReport:
         for r in sim.invariants
     ]
 
+    # cross-replica correlation (deterministic: hops ride the FakeClock
+    # and the serialized pass order) — the fleet-obs-smoke gate's source
+    try:
+        correlation = sim.flight_recorder().coverage()
+    except Exception:
+        correlation = {}
+
     virtual = {
         "slo_timeline": sim.samples,
         "slo_summary": slo_summary,
@@ -260,6 +267,7 @@ def build_report(sim, span_profile: dict, deltas: dict) -> FleetReport:
             "events_applied": dict(sorted(sim.events_applied.items())),
             "settle_steps_used": sim.settle_steps_used,
         },
+        "correlation": correlation,
         "invariants": invariants,
     }
     if getattr(sim, "replicas", 1) > 1:
@@ -271,18 +279,41 @@ def build_report(sim, span_profile: dict, deltas: dict) -> FleetReport:
         env_rs = sim.env
         with env_rs.cloud._lock:
             fenced_rejections = len(env_rs.cloud.fenced_rejections)
+        leases_held = {
+            r.identity: len(r.elector.owned_keys())
+            for r in env_rs.replicas
+        }
+        held_alive = [
+            n for r, n in (
+                (r, leases_held[r.identity]) for r in env_rs.replicas
+            ) if r.alive
+        ]
+        mean_held = (
+            sum(held_alive) / len(held_alive) if held_alive else 0.0
+        )
+        queue_waits = sorted(obs.sli.queue_wait_durations())
+        steal_waits = sorted(obs.sli.steal_wait_durations())
         virtual["sharding"] = {
             "replicas": sim.replicas,
             "alive": sum(1 for r in env_rs.replicas if r.alive),
-            "leases_held": {
-                r.identity: len(r.elector.owned_keys())
-                for r in env_rs.replicas
-            },
+            "leases_held": leases_held,
+            # the ROADMAP's rendezvous skew, measured: max/mean leases
+            # over live replicas at day end (1.0 = perfectly balanced)
+            "rendezvous_imbalance": (
+                round(max(held_alive) / mean_held, 4) if mean_held else None
+            ),
             "lease_overlaps": len(env_rs.lease_overlaps),
             "partition_gap_end": len(env_rs.partition_gap()),
             "fenced_writes_rejected": fenced_rejections,
             "replica_loss_recoveries_s": list(sim.replica_recoveries),
             "steals": dict(deltas.get("steals", {})),
+            # steal-latency SLI (obs/sli.py): enqueue->claim for every
+            # GLOBAL pod; steal-wait = the stolen subset's tail
+            "queue_wait_s": _percentiles(queue_waits),
+            "steal_wait_s": _percentiles(steal_waits),
+            "ownership_transitions": len(
+                getattr(env_rs, "ownership_timeline", ())
+            ),
             "envelope": dict(getattr(sim, "envelope", None) or {}),
         }
 
@@ -299,12 +330,25 @@ def build_report(sim, span_profile: dict, deltas: dict) -> FleetReport:
             for name, cell in spans.items() if name.startswith(prefix)
         }
 
+    # sentinel readings are wall-time judgments: reportable, NEVER signed
+    sentinel = getattr(obs, "sentinel", None)
+    sentinel_wall = {}
+    if sentinel is not None:
+        s = sentinel.summary()
+        sentinel_wall = {
+            "ticks": s["ticks"],
+            "tick_wall_ewma_ms": s["tick_wall_ewma_ms"],
+            "tick_wall_p99_ms": s["tick_wall_p99_ms"],
+            "findings": s["findings"],
+        }
+
     wall = {
         "wall_s": round(sim.driver_wall_s, 3),
         "wall_per_sim_hour_s": (
             round(sim.driver_wall_s / (sim.trace.duration_s / 3600.0), 3)
             if sim.trace.duration_s else None
         ),
+        "sentinel": sentinel_wall,
         "attribution": {
             "coverage": coverage,
             "roots": span_profile.get("roots", {}),
@@ -332,6 +376,8 @@ def build_report(sim, span_profile: dict, deltas: dict) -> FleetReport:
         "pending_end": virtual["cluster"]["pending_end"],
         "invariants_failed": sum(1 for r in invariants if not r["passed"]),
         "attribution_coverage": coverage,
+        "correlation_coverage": correlation.get("coverage"),
+        "sentinel_findings": len(sentinel_wall.get("findings", ())),
     }
     if getattr(sim, "replicas", 1) > 1:
         sharding = virtual["sharding"]
@@ -341,6 +387,9 @@ def build_report(sim, span_profile: dict, deltas: dict) -> FleetReport:
         )
         gate["lease_overlaps"] = sharding["lease_overlaps"]
         gate["partition_gap_end"] = sharding["partition_gap_end"]
+        gate["rendezvous_imbalance"] = sharding["rendezvous_imbalance"]
+        gate["queue_wait_p99_s"] = sharding["queue_wait_s"]["p99"]
+        gate["steal_wait_p99_s"] = sharding["steal_wait_s"]["p99"]
         envelope = sharding["envelope"]
         if envelope:
             gate["packing_envelope_ratio"] = envelope.get("packing_ratio")

@@ -195,6 +195,11 @@ class ReplicaSetEnv:
         self.manager = _ManagerView(self)
         self.lease_overlaps: list = []
         self.coverage_history: list = []
+        # ownership Gantt source (obs/fleet.py FleetRecorder): one edge
+        # per effective-holder change — (t, key, previous holder, new
+        # holder, fencing token); "" marks an ownership gap
+        self.ownership_timeline: list = []
+        self._last_owners: dict = {}
 
     # -- Environment duck type ---------------------------------------------
     @property
@@ -253,15 +258,34 @@ class ReplicaSetEnv:
         return self.cloud.list_work_claims(WORK_QUEUE)
 
     def _audit_leases(self) -> None:
+        now = round(self.clock.now(), 3)
         owners = self.ownership_map()
         for key, who in owners.items():
             if len(who) > 1:
-                self.lease_overlaps.append(
-                    (round(self.clock.now(), 3), key, tuple(sorted(who)))
+                self.lease_overlaps.append((now, key, tuple(sorted(who))))
+        self.coverage_history.append((now, len(self.partition_gap())))
+        # edge-triggered ownership transitions (who held which partition
+        # when): the merged timeline + Gantt read these, and a loss edge
+        # (holder -> "") is the replica-loss recovery's visible start
+        tokens: dict = {}
+        current: dict = {}
+        for r in self.replicas:
+            if not (r.alive and not r.paused):
+                continue
+            own = r.elector.ownership()
+            for key, token in own.keys.items():
+                current[key] = r.identity
+                tokens[key] = token
+        from .operator.sharding import GLOBAL_KEY
+
+        for key in [GLOBAL_KEY] + list(self.cluster.partition_keys()):
+            prev = self._last_owners.get(key, "")
+            cur = current.get(key, "")
+            if cur != prev:
+                self.ownership_timeline.append(
+                    (now, key, prev, cur, tokens.get(key, 0))
                 )
-        self.coverage_history.append(
-            (round(self.clock.now(), 3), len(self.partition_gap()))
-        )
+                self._last_owners[key] = cur
 
     # -- replica failure controls (the chaos seams) ---------------------------
     def _replica(self, i: int) -> Replica:

@@ -127,10 +127,14 @@ class MetricsBridge:
         elif span.name.startswith(_CONSOLIDATE_PREFIX):
             m.SOLVE_PHASE_SECONDS.observe(span.duration_s, phase=span.name)
         elif span.name.startswith(_CONTROLLER_PREFIX):
-            m.RECONCILE_SECONDS.observe(
-                span.duration_s,
-                controller=span.name[len(_CONTROLLER_PREFIX):],
-            )
+            labels = {"controller": span.name[len(_CONTROLLER_PREFIX):]}
+            # N-replica processes (testenv.new_replicaset) stamp the
+            # replica identity on reconcile spans: without the label,
+            # every replica's series silently summed into one
+            replica = span.attrs.get("replica")
+            if replica:
+                labels["replica"] = replica
+            m.RECONCILE_SECONDS.observe(span.duration_s, **labels)
         elif span.name.startswith(_AWS_PREFIX):
             m.AWS_REQUEST_SECONDS.observe(
                 span.duration_s, service=span.name[len(_AWS_PREFIX):]

@@ -1,0 +1,608 @@
+"""Fleet flight recorder: cross-replica correlation, merged timelines,
+the ownership Gantt, the steal-latency SLI, the replica metrics label,
+and the live steady-state sentinel.
+
+The PR 13 tentpole contract (designs/fleet-flight-recorder.md): one
+CorrelationId per pod/claim lifecycle, minted identically on every
+replica with zero coordination; every hop stamped with the replica that
+performed it (and the fencing token that sanctioned it); FleetRecorder
+merges the shared world's hops into one deterministic decision timeline
+per object; and the sentinel re-detects attribution cliffs live while
+staying silent on quiet runs.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from karpenter_provider_aws_tpu.metrics import REGISTRY
+from karpenter_provider_aws_tpu.models import Disruption, NodePool
+from karpenter_provider_aws_tpu.models import labels as lbl
+from karpenter_provider_aws_tpu.models.pod import make_pods
+from karpenter_provider_aws_tpu.obs.fleet import FleetRecorder
+from karpenter_provider_aws_tpu.obs.sentinel import (
+    SteadyStateSentinel,
+    detect_cliffs,
+    span_family,
+)
+from karpenter_provider_aws_tpu.operator.sharding import GLOBAL_KEY
+from karpenter_provider_aws_tpu.state.cluster import Node
+from karpenter_provider_aws_tpu.testenv import new_environment, new_replicaset
+from karpenter_provider_aws_tpu.trace.correlate import (
+    CorrelationLedger,
+    chain_complete,
+    correlation_id,
+)
+from karpenter_provider_aws_tpu.utils.clock import FakeClock
+
+
+def _pool():
+    return NodePool(name="default",
+                    disruption=Disruption(consolidate_after_s=None))
+
+
+# ---------------------------------------------------------------------------
+# the correlation ledger
+# ---------------------------------------------------------------------------
+
+class TestCorrelationLedger:
+    def test_correlation_id_is_pure(self):
+        assert correlation_id("Pod", "pod-1") == correlation_id("Pod", "pod-1")
+        assert correlation_id("Pod", "pod-1") != correlation_id("Pod", "pod-2")
+        assert correlation_id("Pod", "x") != correlation_id("NodeClaim", "x")
+
+    def test_record_once_dedupes(self):
+        led = CorrelationLedger(clock=FakeClock())
+        cid = led.mint("Pod", "pod-1", name="web-0")
+        assert led.record_once(cid, "route") is not None
+        assert led.record_once(cid, "route") is None
+        assert led.record_once(cid, "route", key="other") is not None
+        assert len(led.hops(cid)) == 2
+
+    def test_alias_resolution_by_name_and_uid(self):
+        led = CorrelationLedger(clock=FakeClock())
+        cid = led.mint("Pod", "pod-7", name="web-3")
+        assert led.resolve("Pod", "web-3") == cid
+        assert led.resolve("Pod", "pod-7") == cid
+        assert led.resolve("Pod", "missing") is None
+
+    def test_ring_bound_prunes_index(self):
+        led = CorrelationLedger(capacity=8, clock=FakeClock())
+        for i in range(20):
+            led.record(led.mint("Pod", f"pod-{i}"), "pending")
+        assert len(led) == 8
+        # the first 12 pods' hops were evicted WITH their index entries
+        assert led.hops(correlation_id("Pod", "pod-0")) == []
+        assert len(led.hops(correlation_id("Pod", "pod-19"))) == 1
+
+    def test_snapshot_roundtrip(self):
+        clock = FakeClock()
+        led = CorrelationLedger(clock=clock)
+        cid = led.mint("Pod", "pod-1", name="web-0")
+        led.record(cid, "pending", subject_kind="Pod", subject="web-0")
+        clock.advance(5)
+        led.record(cid, "bind", subject_kind="Pod", subject="web-0",
+                   fence=("karpenter-shard/__global__/", 2),
+                   detail={"node": "n1"})
+        data = json.loads(json.dumps(led.snapshot()))
+        led2 = CorrelationLedger.from_snapshot(data)
+        assert led2.resolve("Pod", "web-0") == cid
+        hops = led2.hops(cid)
+        assert [h.kind for h in hops] == ["pending", "bind"]
+        assert hops[1].fence == ("karpenter-shard/__global__/", 2)
+
+    def test_merge_order_time_then_seq(self):
+        clock = FakeClock()
+        led = CorrelationLedger(clock=clock)
+        cid = led.mint("Pod", "pod-1")
+        led.record(cid, "route", fence=None)
+        led.record(cid, "claim", fence=("l", 5))  # same instant, later seq
+        clock.advance(1)
+        led.record(cid, "bind")
+        assert [h.kind for h in led.hops(cid)] == ["route", "claim", "bind"]
+
+    def test_chain_complete_rule(self):
+        assert chain_complete({"pending", "bind"})
+        assert chain_complete({"evict", "bind"})  # drained ballast re-bind
+        assert not chain_complete({"pending"})
+        assert not chain_complete({"bind"})
+
+
+# ---------------------------------------------------------------------------
+# single-replica chain through the real controller stack
+# ---------------------------------------------------------------------------
+
+class TestSingleReplicaChain:
+    def test_full_lifecycle_chain_and_coverage(self):
+        env = new_environment(use_tpu_solver=False)
+        try:
+            env.apply_defaults()
+            for p in make_pods(3, "web", {"cpu": "500m", "memory": "1Gi"}):
+                env.cluster.apply(p)
+            for _ in range(6):
+                env.step(1)
+                env.clock.advance(5)
+            assert not env.cluster.pending_pods()
+            fr = FleetRecorder(env)
+            cov = fr.coverage()
+            assert cov["bound"] == 3 and cov["coverage"] == 1.0
+            view = fr.explain("Pod", "web-0")
+            kinds = [h["kind"] for h in view["hops"]
+                     if h["subject"] == "web-0"]
+            assert kinds[0] == "pending"
+            assert "solve" in kinds and "launch" in kinds
+            assert kinds[-1] == "bind"
+            # the launch hop links the claim; its hops merged in
+            claim_kinds = {h["kind"] for h in view["hops"]
+                           if h["subject_kind"] == "NodeClaim"}
+            assert {"launched", "register", "ready"} <= claim_kinds
+            text = fr.render_explain(view)
+            assert "Pod/web-0" in text and "bind" in text
+        finally:
+            env.close()
+
+    def test_debug_flight_page_serves_ledger(self):
+        env = new_environment(use_tpu_solver=False)
+        try:
+            env.apply_defaults()
+            (p,) = make_pods(1, "flight", {"cpu": "250m", "memory": "512Mi"})
+            env.cluster.apply(p)
+            env.step(2)
+            page = REGISTRY.debug_page("/debug/flight")
+            assert page is not None
+            assert any(
+                h["kind"] == "pending" for h in page["ledger"]["hops"]
+            )
+            assert page["coverage"]["bound"] >= 0
+            # the snapshot round-trips into an offline recorder
+            fr = FleetRecorder.from_snapshot(json.loads(json.dumps(page)))
+            assert fr.ledger.resolve("Pod", "flight-0")
+        finally:
+            env.close()
+
+
+# ---------------------------------------------------------------------------
+# cross-replica explain (satellite: seeded replica-loss reconstruction)
+# ---------------------------------------------------------------------------
+
+def _replica_loss_run():
+    """Route a global pod, kill its claimant mid-lifecycle, let a
+    survivor steal/adopt and finish the bind. Returns (env, recorder)."""
+    rs = new_replicaset(4)
+    rs.apply_defaults(_pool())
+    rs.step(2)
+    holder = next(r for r in rs.replicas
+                  if GLOBAL_KEY in r.elector.ownership().keys)
+    for p in make_pods(2, "loss", {"cpu": "1", "memory": "2Gi"}):
+        rs.cluster.apply(p)
+    rs.step(1)          # holder claims + launches (fenced)
+    rs.crash(rs.replicas.index(holder))
+    rs.clock.advance(16)  # the dead holder's leases lapse
+    for _ in range(12):
+        rs.step(1)
+        rs.clock.advance(3)
+    return rs, FleetRecorder(rs)
+
+
+def _normalized_chain(view: dict) -> list[tuple]:
+    """The hop chain with process-global ids normalized away (claim
+    names / node names / uids carry process counters)."""
+    from karpenter_provider_aws_tpu.sim.report import normalize_ids
+
+    out = []
+    for h in view["hops"]:
+        out.append((
+            round(h["at"], 3), h["replica"], h["kind"],
+            normalize_ids(h["subject"]),
+            normalize_ids(json.dumps(h.get("detail", {}), sort_keys=True)),
+            normalize_ids(json.dumps(h.get("fence", []))),
+        ))
+    return out
+
+
+class TestCrossReplicaExplain:
+    def test_replica_loss_chain_reconstructs(self):
+        rs, fr = _replica_loss_run()
+        try:
+            assert not rs.cluster.pending_pods()
+            view = fr.explain("Pod", "loss-0")
+            kinds = [h["kind"] for h in view["hops"]]
+            for want in ("pending", "route", "claim", "solve", "launch",
+                         "adopt", "register", "bind"):
+                assert want in kinds, f"missing hop {want}: {kinds}"
+            # causal order of the pod's own lifecycle hops
+            pod_kinds = [h["kind"] for h in view["hops"]
+                         if h["subject_kind"] == "Pod"]
+            assert pod_kinds.index("route") < pod_kinds.index("claim")
+            assert pod_kinds.index("claim") < pod_kinds.index("launch")
+            assert pod_kinds.index("launch") < pod_kinds.index("bind")
+            # the lifecycle genuinely crossed replicas
+            doers = {h["replica"] for h in view["hops"]
+                     if h["replica"].startswith("replica-")}
+            assert len(doers) >= 2, doers
+            # the launch carried the claimant's fencing token
+            launch = next(h for h in view["hops"] if h["kind"] == "launch")
+            assert launch["fence"] and launch["fence"][1] >= 1
+            # the adopt hop carries the SUCCESSOR's (newer) tenancy
+            adopt = next(h for h in view["hops"] if h["kind"] == "adopt")
+            assert adopt["fence"][1] > launch["fence"][1]
+            assert fr.coverage()["coverage"] == 1.0
+        finally:
+            rs.close()
+
+    def test_replica_loss_chain_byte_identical_per_seed(self):
+        rs1, fr1 = _replica_loss_run()
+        chain1 = _normalized_chain(fr1.explain("Pod", "loss-0"))
+        rs1.close()
+        rs2, fr2 = _replica_loss_run()
+        chain2 = _normalized_chain(fr2.explain("Pod", "loss-0"))
+        rs2.close()
+        assert chain1 == chain2
+        assert len(chain1) >= 8
+
+    def test_ownership_gantt_records_handoff(self):
+        rs, fr = _replica_loss_run()
+        try:
+            gantt = fr.ownership_gantt()
+            key = "/".join(str(k) for k in GLOBAL_KEY)
+            segs = gantt["segments"].get(key, [])
+            holders = [s["holder"] for s in segs if s["holder"]]
+            assert len(holders) >= 2, segs  # the GLOBAL lease changed hands
+            tokens = [s["token"] for s in segs if s["holder"]]
+            assert tokens == sorted(tokens)  # tenancies only move forward
+            assert any(a["claims"] for a in gantt["adoptions"])
+            text = fr.render_gantt(gantt)
+            assert "__global__" in text
+        finally:
+            rs.close()
+
+    def test_fleet_cli_explains_from_snapshot(self, tmp_path, capsys):
+        from karpenter_provider_aws_tpu.obs.__main__ import main as obs_main
+
+        rs, fr = _replica_loss_run()
+        path = str(tmp_path / "flight.json")
+        fr.save(path)
+        rs.close()
+        assert obs_main(["fleet", "explain", "pod/loss-0",
+                         "--flight-file", path]) == 0
+        out = capsys.readouterr().out
+        assert "Pod/loss-0" in out and "bind" in out and "claim" in out
+        assert obs_main(["fleet", "timeline", "--flight-file", path]) == 0
+        assert "__global__" in capsys.readouterr().out
+        assert obs_main(["fleet", "coverage", "--flight-file", path]) == 0
+        assert "coverage: 1.0" in capsys.readouterr().out
+        # unknown object exits non-zero (absence must be loud)
+        assert obs_main(["fleet", "explain", "pod/ghost",
+                         "--flight-file", path]) == 3
+
+
+# ---------------------------------------------------------------------------
+# satellite: per-replica reconcile metrics must not silently sum
+# ---------------------------------------------------------------------------
+
+class TestReplicaMetricsLabel:
+    def test_two_replicas_distinguishable_on_metrics(self):
+        rs = new_replicaset(2)
+        try:
+            rs.apply_defaults(_pool())
+            rs.step(2)
+            body = REGISTRY.expose()
+            for identity in ("replica-0", "replica-1"):
+                needle = (
+                    'karpenter_controller_reconcile_duration_seconds_count'
+                    f'{{controller="provisioning",replica="{identity}"}}'
+                )
+                assert needle in body, f"missing per-replica series: {needle}"
+        finally:
+            rs.close()
+
+    def test_single_replica_series_unlabeled(self):
+        env = new_environment(use_tpu_solver=False)
+        try:
+            env.apply_defaults()
+            env.step(1)
+            body = REGISTRY.expose()
+            assert ('karpenter_controller_reconcile_duration_seconds_count'
+                    '{controller="provisioning"}') in body
+        finally:
+            env.close()
+
+
+# ---------------------------------------------------------------------------
+# satellite: rendezvous imbalance is measured, not anecdotal
+# ---------------------------------------------------------------------------
+
+class TestRendezvousImbalance:
+    def test_gauges_exported_from_lease_table(self):
+        from karpenter_provider_aws_tpu.metrics import (
+            LEASE_OWNERSHIP,
+            RENDEZVOUS_IMBALANCE,
+        )
+
+        rs = new_replicaset(2)
+        try:
+            rs.apply_defaults(_pool())
+            for z in ("zone-a", "zone-b", "zone-c"):
+                rs.cluster.apply(Node(
+                    name=f"seed-{z}", nodepool_name="default",
+                    labels={lbl.TOPOLOGY_ZONE: z}, ready=True,
+                ))
+            rs.step(3)
+            held = {
+                r.identity: LEASE_OWNERSHIP.value(replica=r.identity)
+                for r in rs.replicas
+            }
+            assert sum(held.values()) >= 4  # 3 partitions + GLOBAL
+            imb = RENDEZVOUS_IMBALANCE.value()
+            mean = sum(held.values()) / len(held)
+            assert imb == pytest.approx(max(held.values()) / mean, abs=1e-3)
+            assert 'karpenter_lease_ownership{replica="replica-0"}' in (
+                REGISTRY.expose()
+            )
+        finally:
+            rs.close()
+
+    def test_dead_holder_ownership_drops_to_zero(self):
+        from karpenter_provider_aws_tpu.metrics import LEASE_OWNERSHIP
+
+        rs = new_replicaset(2)
+        try:
+            rs.apply_defaults(_pool())
+            rs.step(3)
+            dead = next(
+                r for r in rs.replicas
+                if LEASE_OWNERSHIP.value(replica=r.identity) > 0
+            )
+            rs.crash(rs.replicas.index(dead))
+            rs.clock.advance(16)  # its leases lapse
+            rs.step(2)
+            # the survivor's export must zero the vanished holder, not
+            # leave its series frozen at the pre-crash value
+            assert LEASE_OWNERSHIP.value(replica=dead.identity) == 0.0
+        finally:
+            rs.close()
+
+
+# ---------------------------------------------------------------------------
+# satellite: steal-latency SLI
+# ---------------------------------------------------------------------------
+
+class TestStealWaitSLI:
+    def test_healthy_claims_have_zero_queue_wait(self):
+        rs = new_replicaset(2)
+        try:
+            rs.apply_defaults(_pool())
+            rs.step(2)
+            for p in make_pods(4, "q", {"cpu": "500m", "memory": "1Gi"}):
+                rs.cluster.apply(p)
+            rs.step(2)
+            waits = rs.obs.sli.queue_wait_durations()
+            assert len(waits) == 4
+            assert max(waits) == 0.0  # routed and claimed in the same pass
+            assert rs.obs.sli.steal_wait_durations() == []
+        finally:
+            rs.close()
+
+    def test_steal_wait_measures_the_loss_window(self):
+        """The bench scenario's teeth: a killed GLOBAL holder's pods are
+        stolen only after the lease TTL, and the SLI measures exactly
+        that wait (benchmarks/sli_bench.py emits the row)."""
+        from benchmarks.sli_bench import _steal_wait_row
+
+        row = _steal_wait_row(5.0)
+        assert row["benchmark"] == "pod_steal_wait_sli"
+        assert row["stolen"] == 10
+        assert row["unbound"] == 0
+        # enqueue -> steal spans the 15s lease TTL the survivor waits out
+        assert 15.0 <= row["steal_wait_p99_s"] <= 20.0
+        assert row["queue_wait_p50_s"] == 0.0  # healthy phase unaffected
+        row2 = _steal_wait_row(5.0)
+        assert {k: v for k, v in row.items() if k != "wall_s"} == \
+               {k: v for k, v in row2.items() if k != "wall_s"}
+
+
+# ---------------------------------------------------------------------------
+# the live steady-state sentinel
+# ---------------------------------------------------------------------------
+
+def _profiles_to_source(profiles):
+    """A profile_source yielding each cumulative profile in turn, then
+    holding the last one."""
+    it = iter(profiles)
+    state = {"cur": None}
+
+    def source():
+        try:
+            state["cur"] = next(it)
+        except StopIteration:
+            pass
+        return state["cur"]
+
+    return source
+
+
+def _cumulate(tick_deltas):
+    """Turn per-tick {span: ms} deltas into cumulative profiles."""
+    out = []
+    totals: dict[str, float] = {}
+    for delta in tick_deltas:
+        for name, ms in delta.items():
+            totals[name] = totals.get(name, 0.0) + ms
+        out.append({
+            "spans": {n: {"count": 1, "total_ms": t}
+                      for n, t in totals.items()},
+        })
+    return out
+
+
+QUIET_TICK = {
+    "controller.disruption": 900.0,
+    "controller.provisioning": 400.0,
+    "solve.device": 300.0,
+    "consolidate.screen": 400.0,
+}
+
+
+class TestSteadyStateSentinel:
+    def test_quiet_steady_state_is_silent(self):
+        clock = FakeClock()
+        s = SteadyStateSentinel(
+            clock=clock,
+            profile_source=_profiles_to_source(_cumulate([QUIET_TICK] * 20)),
+        )
+        findings = []
+        for _ in range(20):
+            clock.advance(10)
+            findings += s.tick()
+        assert findings == []
+        assert s.summary()["warmed_up"]
+
+    def test_redetects_the_50k_disruption_cliff(self):
+        """The PR 10 finding, replayed live: controller.disruption's
+        share jumps 44.8% -> 67.4% of a multi-second tick when the
+        dirty-sweep fix is off — the sentinel must raise an
+        edge-triggered finding NAMING the controller."""
+        quiet = {
+            "controller.disruption": 900.0,   # ~45% of a 2s tick
+            "controller.provisioning": 500.0,
+            "solve.device": 300.0,
+            "consolidate.screen": 300.0,
+        }
+        cliff = {
+            "controller.disruption": 4200.0,  # ~67% of a 6.2s tick
+            "controller.provisioning": 800.0,
+            "solve.device": 500.0,
+            "consolidate.screen": 700.0,
+        }
+        clock = FakeClock()
+        s = SteadyStateSentinel(
+            clock=clock,
+            profile_source=_profiles_to_source(
+                _cumulate([quiet] * 8 + [cliff] * 3)
+            ),
+        )
+        all_findings = []
+        for _ in range(11):
+            clock.advance(10)
+            all_findings += s.tick()
+        shifts = [f for f in all_findings
+                  if f["kind"] == "attribution-shift"]
+        assert shifts, all_findings
+        assert shifts[0]["family"] == "controller.disruption"
+        # edge-triggered: the persisting cliff raised exactly ONE
+        # attribution-shift episode for the named controller
+        assert len([f for f in shifts
+                    if f["family"] == "controller.disruption"]) == 1
+
+    def test_tick_superlinear_names_top_family(self):
+        quiet = {"controller.liveness": 200.0}
+        blowup = {"controller.liveness": 200.0, "solve.device": 9000.0}
+        clock = FakeClock()
+        s = SteadyStateSentinel(
+            clock=clock,
+            profile_source=_profiles_to_source(
+                _cumulate([quiet] * 8 + [blowup])
+            ),
+        )
+        findings = []
+        for _ in range(9):
+            clock.advance(10)
+            findings += s.tick()
+        supers = [f for f in findings if f["kind"] == "tick-superlinear"]
+        assert supers and supers[0]["family"] == "solve"
+
+    def test_events_published_only_when_enabled(self):
+        from karpenter_provider_aws_tpu.events import EventRecorder
+
+        clock = FakeClock()
+        recorder = EventRecorder(clock=clock)
+        profiles = _cumulate(
+            [QUIET_TICK] * 8
+            + [{**QUIET_TICK, "controller.disruption": 9000.0}]
+        )
+        s = SteadyStateSentinel(
+            clock=clock, recorder=recorder,
+            profile_source=_profiles_to_source(profiles),
+        )
+        s.publish_events = False
+        for _ in range(9):
+            clock.advance(10)
+            s.tick()
+        assert recorder.query(reason="SteadyStateRegression") == []
+        assert s.findings  # ...but the finding itself was recorded
+
+    def test_events_fire_when_publishing(self):
+        from karpenter_provider_aws_tpu.events import EventRecorder
+
+        clock = FakeClock()
+        recorder = EventRecorder(clock=clock)
+        profiles = _cumulate(
+            [QUIET_TICK] * 8
+            + [{**QUIET_TICK, "controller.disruption": 9000.0}]
+        )
+        s = SteadyStateSentinel(
+            clock=clock, recorder=recorder,
+            profile_source=_profiles_to_source(profiles),
+        )
+        for _ in range(9):
+            clock.advance(10)
+            s.tick()
+        events = recorder.query(reason="SteadyStateRegression")
+        assert events and events[0].name == "controller.disruption"
+
+    def test_sim_container_spans_excluded(self):
+        assert span_family("controller.disruption") == "controller.disruption"
+        assert span_family("solve.device") == "solve"
+        assert span_family("consolidate.screen") == "consolidate"
+        clock = FakeClock()
+        # sim.* container spans contain every controller span; folding
+        # them in would double-count the tick
+        ticks = [dict(QUIET_TICK, **{"sim.controllers": 10000.0})] * 8
+        s = SteadyStateSentinel(
+            clock=clock, profile_source=_profiles_to_source(_cumulate(ticks)),
+        )
+        for _ in range(8):
+            clock.advance(10)
+            s.tick()
+        assert "sim" not in s.summary()["baseline_shares"]
+
+    def test_detect_cliffs_reexported_for_sim(self):
+        # the simulator's import path must keep working after the lift
+        from karpenter_provider_aws_tpu.sim.cliffs import (
+            detect_cliffs as sim_detect,
+        )
+
+        assert sim_detect is detect_cliffs
+
+    def test_share_gauge_zeroed_for_absent_families(self):
+        from karpenter_provider_aws_tpu.metrics import SENTINEL_SHARE
+
+        clock = FakeClock()
+        ticks = _cumulate([
+            {"controller.liveness": 100.0, "solve.device": 100.0},
+            {"controller.liveness": 100.0},  # solve does nothing this tick
+        ])
+        s = SteadyStateSentinel(
+            clock=clock, profile_source=_profiles_to_source(ticks),
+        )
+        clock.advance(10)
+        s.tick()
+        assert SENTINEL_SHARE.value(family="solve") == 0.5
+        clock.advance(10)
+        s.tick()
+        # absent from this tick -> 0, not frozen at the stale 0.5
+        assert SENTINEL_SHARE.value(family="solve") == 0.0
+        assert SENTINEL_SHARE.value(family="controller.liveness") == 1.0
+
+    def test_debug_sentinel_page(self):
+        env = new_environment(use_tpu_solver=False)
+        try:
+            env.apply_defaults()
+            env.step(1)
+            env.obs.tick()
+            page = REGISTRY.debug_page("/debug/sentinel")
+            assert page is not None and "ticks" in page
+        finally:
+            env.close()

@@ -1,0 +1,133 @@
+#!/usr/bin/env python3
+"""fleet_obs_smoke: the fleet flight recorder's CI gate.
+
+Drives a 2-replica smoke day through the fleet simulator WITH the flight
+recorder attached, then gates on the observability plane itself
+(designs/fleet-flight-recorder.md):
+
+- **correlation coverage** — >= 99% of the day's bound pods must carry a
+  COMPLETE hop chain (pending + bind at minimum) in the correlation
+  ledger. A controller path that binds pods without narrating them is a
+  regression in the instrument, not the fleet.
+- **sentinel silence** — a quiet steady-state day must produce ZERO
+  SteadyStateRegression findings (the sentinel's false-positive gate;
+  its true-positive half is unit-tested against the PR 10 disruption
+  cliff profile in tests/test_fleet_obs.py).
+- **CLI round-trip** — the flight snapshot is written to disk and read
+  back through the real ``obs fleet explain`` / ``timeline`` code paths
+  for one bound pod, so the operator surface cannot silently rot.
+
+Usage::
+
+    python tools/fleet_obs_smoke.py [--nodes 200] [--seed 0]
+        [--replicas 2] [--flight-out /tmp/flight.json]
+
+Exit status: 0 on success, 1 on any gate failure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+MIN_COVERAGE = 0.99
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="tools/fleet_obs_smoke.py")
+    parser.add_argument("--trace", default="smoke")
+    parser.add_argument("--nodes", type=int, default=200)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--replicas", type=int, default=2)
+    parser.add_argument("--flight-out", default="/tmp/flight_smoke.json")
+    args = parser.parse_args(argv)
+
+    from karpenter_provider_aws_tpu.sim.driver import FleetSimulator
+
+    sim = FleetSimulator(
+        args.trace, seed=args.seed, nodes=args.nodes,
+        replicas=args.replicas,
+    )
+    report = sim.run()
+    recorder = sim.flight_recorder()
+    recorder.save(args.flight_out)
+    print(f"wrote {args.flight_out}", file=sys.stderr)
+
+    failures: list[str] = []
+
+    cov = report.data["virtual"].get("correlation", {})
+    coverage = cov.get("coverage")
+    print(f"correlation: {cov.get('complete')}/{cov.get('bound')} bound "
+          f"pods with complete hop chains (coverage={coverage}, "
+          f"{cov.get('hops_total')} hops)")
+    if coverage is None or coverage < MIN_COVERAGE:
+        failures.append(
+            f"correlation coverage {coverage} < {MIN_COVERAGE}"
+        )
+
+    sentinel = report.data["wall"].get("sentinel", {})
+    findings = sentinel.get("findings", [])
+    print(f"sentinel: {sentinel.get('ticks')} ticks, "
+          f"{len(findings)} findings")
+    for f in findings:
+        print(f"  [{f['kind']}] {f['family']}: {f['detail']}")
+    if findings:
+        failures.append(
+            f"{len(findings)} sentinel findings on a quiet run "
+            "(false-positive gate)"
+        )
+
+    failed_inv = [
+        r["name"] for r in report.data["virtual"]["invariants"]
+        if not r["passed"]
+    ]
+    if failed_inv:
+        failures.append(f"invariants failed: {failed_inv}")
+
+    # CLI round-trip: explain one bound pod + render the ownership Gantt
+    # through the REAL obs fleet entry point against the saved snapshot
+    bound = cov.get("bound", 0)
+    if bound:
+        from karpenter_provider_aws_tpu.obs.fleet import FleetRecorder
+        from karpenter_provider_aws_tpu.obs.__main__ import main as obs_main
+
+        offline = FleetRecorder.load(args.flight_out)
+        uid = offline.bound_uids()[0]
+        # resolve the uid's pod name through the ledger alias table
+        name = next(
+            (n for (k, n), cid in offline.ledger._alias.items()
+             if k == "Pod" and cid == offline.ledger.resolve("Pod", uid)
+             and n != uid),
+            None,
+        )
+        if name is None:
+            failures.append(f"no pod-name alias for bound uid {uid}")
+        else:
+            rc = obs_main([
+                "fleet", "explain", f"pod/{name}",
+                "--flight-file", args.flight_out,
+            ])
+            if rc != 0:
+                failures.append(
+                    f"obs fleet explain pod/{name} exited {rc}"
+                )
+            rc = obs_main([
+                "fleet", "timeline", "--flight-file", args.flight_out,
+            ])
+            if rc != 0:
+                failures.append(f"obs fleet timeline exited {rc}")
+
+    if failures:
+        print(f"fleet-obs gate FAILED ({len(failures)}):", file=sys.stderr)
+        for f in failures:
+            print(f"  {f}", file=sys.stderr)
+        return 1
+    print("fleet-obs gate OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
