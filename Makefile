@@ -4,7 +4,7 @@
 
 PYTEST ?= python -m pytest
 
-.PHONY: presubmit test deflake stress e2etests benchmark interruption-bench verify multichip native soak sidecar-client sim-smoke sim-sweep sim-cliff-smoke bench-gate bench-optimizer chaos-smoke sim-replica-smoke sim-provision-smoke fleet-obs-smoke
+.PHONY: presubmit test deflake stress e2etests benchmark interruption-bench verify multichip native soak sidecar-client sim-smoke sim-sweep sim-cliff-smoke bench-gate bench-optimizer chaos-smoke sim-replica-smoke sim-provision-smoke fleet-obs-smoke device-obs-smoke
 
 presubmit: test multichip  ## everything CI gates on
 
@@ -83,6 +83,9 @@ sim-replica-smoke:  ## 2-replica sharded-control-plane day with a replica-loss o
 
 fleet-obs-smoke:  ## 2-replica smoke day through the flight recorder: correlation coverage >= 99%, zero sentinel false positives, obs-fleet CLI round-trip
 	JAX_PLATFORMS=cpu python tools/fleet_obs_smoke.py
+
+device-obs-smoke:  ## smoke-500 day with jitwatch armed: per-family compile counts, 0 retraces after warmup, obs-device CLI round-trip of the ledger snapshot
+	JAX_PLATFORMS=cpu python tools/device_obs_smoke.py
 
 sim-provision-smoke:  ## 4-replica sharded-provisioning flood day (GLOBAL holder killed mid-flood; work-stealing + packing-envelope-parity), fleet-gated
 	JAX_PLATFORMS=cpu python -m karpenter_provider_aws_tpu.sim run \

@@ -644,6 +644,24 @@ def child_optimizer() -> None:
         run_optimizer(scale=scale, seeds=seeds, on_row=on_row)
 
 
+def child_jit() -> None:
+    """Compile-ledger rows (benchmarks/jit_bench.py): cold-vs-warm
+    compile count and wall per program family off the jitwatch ledger —
+    the config6 solver dispatch + the config9 partition-lane program at
+    reduced shape. The steady-state contract these rows witness
+    (warm_compiles == 0) is what `make bench-gate` enforces at full
+    scale via config9_100k_nodes.steady_state_retraces."""
+    _force_cpu_if_asked()
+    import contextlib
+
+    from benchmarks.jit_bench import run_all as run_jit
+
+    scale = float(os.environ.get("BENCH_JIT_SCALE", "1.0"))
+    on_row = _detail_writer({"run_at_unix": int(time.time()), "scale": scale})
+    with contextlib.redirect_stdout(sys.stderr):
+        run_jit(scale=scale, on_row=on_row)
+
+
 def child_configs() -> None:
     """The BASELINE config sweep; rows stream to BENCH_DETAIL.jsonl."""
     _force_cpu_if_asked()
@@ -834,6 +852,14 @@ def main() -> None:
         )
         if err:
             errors.append(err)
+        # compile-ledger rows: cold-vs-warm compile count/ms per program
+        # family (jitwatch); warm passes must compile NOTHING
+        _, err = run_child(
+            "jit", min(240.0, _remaining() - SAFETY_MARGIN_S),
+            env_extra={"BENCH_FORCE_CPU": "1"},
+        )
+        if err:
+            errors.append(err)
         # fleet-simulator rows: a simulated day's wall + SLO gate metrics
         # at two fleet sizes (sim/; host solver + native screen)
         _, err = run_child(
@@ -963,7 +989,8 @@ if __name__ == "__main__":
                  "device_state": child_device_state, "sim": child_sim,
                  "disruption": child_disruption,
                  "provisioning": child_provisioning,
-                 "optimizer": child_optimizer}[child]()
+                 "optimizer": child_optimizer,
+                 "jit": child_jit}[child]()
             except Exception as e:
                 traceback.print_exc()
                 if child == "measure":

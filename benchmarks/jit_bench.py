@@ -1,0 +1,219 @@
+"""Compile-ledger benchmark: cold-vs-warm compile count and wall per
+program family (``bench.py --child=jit``).
+
+Two rows, both read straight off the jitwatch ledger
+(``trace/jitwatch.py``) instead of inferring compile cost by subtracting
+wall clocks:
+
+- ``jit_cold_warm_config6`` — a config6-shaped fragmented provisioning
+  burst through the full ``TPUSolver`` dispatch (FFD scan + device
+  ranking + sparse plan + optimizer lane where enabled), solved COLD
+  (fresh process ledger) and then WARM (identical problem). The row
+  carries per-family compile counts/walls for the cold pass and proves
+  the warm pass compiled NOTHING (``warm_compiles`` — the
+  ``ProvenanceRecord.compiles`` stamp's bench-side twin).
+- ``jit_lanes_cold_config9`` — the config9 partition-lane program
+  (``parallel/mesh.solve_partition_lanes``) at a reduced lane shape:
+  cold compile wall attributed per family, then the warm p50. The
+  full-scale cold number lives on the ``config9_100k_nodes`` row
+  (``solve_lanes_cold_compile_ms``); this row is the cheap always-run
+  witness of the same attribution.
+
+Rows stream via ``on_row`` like every other phase.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+def _family_breakdown(events: list[dict]) -> dict:
+    out: dict[str, dict] = {}
+    for e in events:
+        cell = out.setdefault(e["family"], {"count": 0, "compile_ms": 0.0})
+        cell["count"] += 1
+        cell["compile_ms"] = round(cell["compile_ms"] + e["wall_ms"], 1)
+    return out
+
+
+def _frag_pods(n_pods: int):
+    """A config6-shaped fragmented burst: paired tall/wide odd-count
+    shapes that leave greedy tails (the optimizer lane's home turf)."""
+    from karpenter_provider_aws_tpu.models.pod import make_pods
+
+    shapes = [
+        ("tall", {"cpu": "3", "memory": "2Gi"}),
+        ("wide", {"cpu": "1", "memory": "7Gi"}),
+        ("mid", {"cpu": "1500m", "memory": "3Gi"}),
+        ("small", {"cpu": "500m", "memory": "1Gi"}),
+    ]
+    per = max(1, n_pods // len(shapes))
+    pods = []
+    for name, req in shapes:
+        pods.extend(make_pods(per + (1 if name == "tall" else 0),
+                              f"frag-{name}", req))
+    return pods
+
+
+def bench_config6_cold_warm(n_pods: int = 220) -> dict:
+    from karpenter_provider_aws_tpu.scheduling.solver import TPUSolver
+    from karpenter_provider_aws_tpu.testenv import new_environment
+    from karpenter_provider_aws_tpu.trace import jitwatch
+
+    env = new_environment(use_tpu_solver=False)
+    try:
+        pool, _ = env.apply_defaults()
+        solver = TPUSolver()
+        pods = _frag_pods(n_pods)
+        led = jitwatch.ledger()
+
+        seq0 = led.seq()
+        t0 = time.perf_counter()
+        cold = solver.solve(pods, [pool], env.catalog)
+        cold_ms = (time.perf_counter() - t0) * 1e3
+        cold_events = led.events_since(seq0)
+
+        # the solver right-sizes its node-row bucket from the observed
+        # n_open after the first solve, so pass 2 legitimately retraces
+        # at the smaller bucket; keep solving (bounded) until a pass
+        # compiles NOTHING — that pass is the steady-state warm number
+        resize_events: list[dict] = []
+        warm = cold
+        warm_ms = cold_ms
+        warm_events: list[dict] = [{}]  # non-empty: enter the loop
+        for _ in range(3):
+            seq1 = led.seq()
+            t0 = time.perf_counter()
+            warm = solver.solve(pods, [pool], env.catalog)
+            warm_ms = (time.perf_counter() - t0) * 1e3
+            warm_events = led.events_since(seq1)
+            if not warm_events:
+                break
+            resize_events.extend(warm_events)
+
+        prov_cold = cold.provenance.as_dict() if cold.provenance else {}
+        prov_warm = warm.provenance.as_dict() if warm.provenance else {}
+        return {
+            "benchmark": "jit_cold_warm_config6",
+            "pods": len(pods),
+            "cold_ms": round(cold_ms, 1),
+            "warm_ms": round(warm_ms, 1),
+            "cold_compiles": len(cold_events),
+            "warm_compiles": len(warm_events),
+            # bucket right-sizing between cold and warm (the adaptive
+            # node-row estimate recompiling once at the observed size)
+            "resize_compiles": len(resize_events),
+            "cold_compile_ms": round(
+                sum(e["wall_ms"] for e in cold_events), 1
+            ),
+            "cold_families": _family_breakdown(cold_events),
+            # the provenance stamp's own compiles field, round-tripped:
+            # the bench-row proof that a warm solve stamps compiles=0
+            "provenance_compiles_cold": prov_cold.get("compiles"),
+            "provenance_compiles_warm": prov_warm.get("compiles"),
+            "placed_cold": cold.pods_placed(),
+            "placed_warm": warm.pods_placed(),
+            "device": "host" if os.environ.get("BENCH_FORCE_CPU") == "1"
+                      else "auto",
+            "backend": solver.backend_label(),
+            "note": "full TPUSolver dispatch cold vs warm; compile walls "
+                    "attributed per program family by the jitwatch ledger",
+        }
+    finally:
+        env.close()
+
+
+def bench_lanes_cold(n_lanes: int = 4, burst: int = 96) -> dict:
+    import numpy as np
+
+    from karpenter_provider_aws_tpu.models import labels as lbl
+    from karpenter_provider_aws_tpu.models.pod import make_pods
+    from karpenter_provider_aws_tpu.ops.encode import encode_problem, pad_problem
+    from karpenter_provider_aws_tpu.ops.ffd import _State
+    from karpenter_provider_aws_tpu.parallel.mesh import (
+        lanes_mode,
+        solve_partition_lanes,
+        stack_lane_problems,
+    )
+    from karpenter_provider_aws_tpu.testenv import new_environment
+    from karpenter_provider_aws_tpu.trace import jitwatch
+
+    import jax
+
+    env = new_environment(use_tpu_solver=False)
+    try:
+        pool, _ = env.apply_defaults()
+        zones = sorted(env.catalog.zones)[:n_lanes]
+        problems = []
+        for z in zones:
+            pods = make_pods(burst // len(zones), f"lane{z}",
+                             {"cpu": "500m", "memory": "1Gi"},
+                             node_selector={lbl.TOPOLOGY_ZONE: z})
+            problems.append(encode_problem(pods, env.catalog, nodepool=pool))
+        GB = max(p.requests.shape[0] for p in problems)
+        padded = [pad_problem(p, GB) for p in problems]
+
+        def once():
+            t0 = time.perf_counter()
+            args, (TB, ZB) = stack_lane_problems(padded)
+            K, NL = len(padded), 128
+            R = args["requests"].shape[2]
+            C = args["group_window"].shape[3]
+            init = _State(
+                node_type=np.zeros((K, NL), np.int32),
+                node_price=np.zeros((K, NL), np.float32),
+                used=np.zeros((K, NL, R), np.float32),
+                node_cap=np.zeros((K, NL, R), np.float32),
+                node_window=np.zeros((K, NL, ZB, C), bool),
+                n_open=np.zeros(K, np.int32),
+            )
+            res, _dev = solve_partition_lanes(args, init, [0] * K, NL)
+            jax.device_get(res)
+            return (time.perf_counter() - t0) * 1e3
+
+        led = jitwatch.ledger()
+        seq0 = led.seq()
+        cold_ms = once()
+        cold_events = led.events_since(seq0)
+        seq1 = led.seq()
+        warm = [once() for _ in range(5)]
+        warm_events = led.events_since(seq1)
+        return {
+            "benchmark": "jit_lanes_cold_config9",
+            "lanes": len(problems),
+            "lanes_mode": lanes_mode(),
+            "cold_ms": round(cold_ms, 1),
+            "warm_ms": round(float(np.percentile(warm, 50)), 1),
+            "cold_compiles": len(cold_events),
+            "warm_compiles": len(warm_events),
+            "cold_compile_ms": round(
+                sum(e["wall_ms"] for e in cold_events), 1
+            ),
+            "cold_families": _family_breakdown(cold_events),
+            "device": "host" if os.environ.get("BENCH_FORCE_CPU") == "1"
+                      else "auto",
+            "backend": "xla-scan",
+            "note": "partition-lane program cold vs warm at reduced lane "
+                    "shape; the 100k-scale twin rides config9_100k_nodes "
+                    "as solve_lanes_cold_compile_ms",
+        }
+    finally:
+        env.close()
+
+
+def run_all(scale: float = 1.0, on_row=None) -> list[dict]:
+    rows = [
+        bench_config6_cold_warm(n_pods=max(40, int(220 * scale))),
+        bench_lanes_cold(burst=max(16, int(96 * scale))),
+    ]
+    for row in rows:
+        print(json.dumps(row), flush=True)
+        if on_row is not None:
+            on_row(row)
+    return rows
+
+
+if __name__ == "__main__":
+    run_all(scale=float(os.environ.get("BENCH_JIT_SCALE", "1.0")))

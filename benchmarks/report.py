@@ -137,6 +137,12 @@ def fmt(row: dict) -> str:
               "merge_ms", "screen_partition_ms", "screen_partition_nodes",
               "global_unsharded_encode_ms", "steady_state_incremental",
               "exactness_ok", "solve_lanes_cold_ms", "combined_steady_ms",
+              # device-plane observatory rows (designs/device-observatory
+              # .md): compile-ledger attribution — cold/warm compile
+              # counts + walls per family, and the zero-retrace witness
+              "cold_ms", "warm_ms", "cold_compiles", "warm_compiles",
+              "cold_compile_ms", "solve_lanes_cold_compile_ms",
+              "steady_state_retraces",
               # dirty-set disruption sweep rows (docs/performance.md):
               # quiet/churn pass vs the legacy full O(claims) walk
               "dirty_p50_ms", "dirty_p99_ms", "churn_p50_ms",

@@ -542,6 +542,29 @@ CORRELATION_HOPS = REGISTRY.counter(
     "table in designs/fleet-flight-recorder.md is the vocabulary)",
 )
 
+# -- trace/jitwatch.py + obs/device.py: device-plane observatory ------------
+JIT_COMPILES = REGISTRY.counter(
+    "karpenter_jit_compiles_total",
+    "Program (re)traces recorded by the jitwatch ledger, by program family "
+    "and kind (compile = a family's first trace, retrace = an additional "
+    "signature after it — the ladder discipline demands steady state "
+    "retraces ZERO times; the retrace sentinel pages on this edge)",
+)
+JIT_COMPILE_SECONDS = REGISTRY.histogram(
+    "karpenter_jit_compile_seconds",
+    "Wall seconds of each jitwatch-recorded trace (first call with a new "
+    "signature: trace + compile + one execution), by program family — fed "
+    "by the metrics bridge from jit.compile spans",
+    buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0, 60.0),
+)
+DEVICE_LIVE_BYTES = REGISTRY.gauge(
+    "karpenter_device_live_bytes",
+    "Estimated device-resident bytes per program family (last dispatch's "
+    "abstract input sizes; the device_state.mirror family is the "
+    "holder-LRU's actual buffer bytes) — the DeviceAccountant's "
+    "HBM-watermark source (obs/device.py)",
+)
+
 # -- obs/sentinel.py: live steady-state regression sentinel -----------------
 SENTINEL_TICK_WALL = REGISTRY.gauge(
     "karpenter_sentinel_tick_wall_ms",

@@ -25,13 +25,19 @@ from ..trace.correlate import CorrelationLedger
 from .audit import AuditLog, AuditRecord, default_audit
 from .explain import explain, render_text
 from .quality import OracleSampler, cluster_packing, solve_quality
-from .sentinel import SteadyStateSentinel, detect_cliffs
+from .sentinel import (
+    EdgeTrigger,
+    RetraceSentinel,
+    SteadyStateSentinel,
+    detect_cliffs,
+)
 from .sli import LifecycleSLI, percentile
 from .slo import BurnRule, SLOEngine, SLOSpec, default_slos
 
 __all__ = [
     "AuditLog", "AuditRecord", "BurnRule", "CorrelationLedger",
-    "LifecycleSLI", "Obs", "OracleSampler", "SLOEngine", "SLOSpec",
+    "EdgeTrigger", "LifecycleSLI", "Obs", "OracleSampler",
+    "RetraceSentinel", "SLOEngine", "SLOSpec",
     "SteadyStateSentinel", "cluster_packing", "default_audit",
     "default_obs", "default_slos", "detect_cliffs", "explain", "install",
     "percentile", "render_text", "solve_quality",
@@ -59,6 +65,9 @@ class Obs:
         # live steady-state regression sentinel (obs/sentinel.py),
         # evaluated on every tick below
         self.sentinel = SteadyStateSentinel(clock=clock, recorder=recorder)
+        # device-plane retrace sentinel: the jitwatch ledger's judge
+        # (DeviceRetraceStorm when a warmed-up steady state compiles)
+        self.retrace = RetraceSentinel(clock=clock, recorder=recorder)
         self.cluster = None  # set by install()
 
     def tick(self, now: Optional[float] = None) -> dict:
@@ -71,6 +80,10 @@ class Obs:
             self.sentinel.tick(now=now)
         except Exception:
             pass  # judgment must never take down the liveness loop
+        try:
+            self.retrace.tick(now=now)
+        except Exception:
+            pass
         if self.recorder is not None:
             try:
                 self.recorder.sweep(now=now)
@@ -123,6 +136,7 @@ class Obs:
         self.sli.reset()
         self.ledger.reset()
         self.sentinel.reset()
+        self.retrace.reset()
         self.oracle = OracleSampler()
 
 
@@ -164,6 +178,14 @@ def install(cluster=None, recorder=None, clock=None, specs=None,
         REGISTRY.register_debug_page(
             "/debug/sentinel", bundle.sentinel.summary
         )
+        # the device-plane observatory (obs/device.py): jitwatch ledger,
+        # residency map, link/live-byte accounting, retrace findings
+        def _device_page() -> dict:
+            from .device import device_summary
+
+            return device_summary(retrace_sentinel=bundle.retrace)
+
+        REGISTRY.register_debug_page("/debug/device", _device_page)
     return bundle
 
 

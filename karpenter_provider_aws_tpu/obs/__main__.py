@@ -62,6 +62,18 @@ def main(argv=None) -> int:
         )
         p.add_argument("--json", action="store_true")
 
+    p_device = sub.add_parser(
+        "device", help="device-plane observatory: jitwatch ledger table, "
+                       "top retracers, residency map",
+    )
+    p_device.add_argument(
+        "--snapshot-file", default="",
+        help="saved device snapshot (a collected /debug/device page, or a "
+             "fleet report whose wall.device plane is read); default: the "
+             "in-process ledger (mostly empty from a cold CLI)",
+    )
+    p_device.add_argument("--json", action="store_true")
+
     p_explain = sub.add_parser(
         "explain", help="join audit + events + provenance for one object"
     )
@@ -88,6 +100,20 @@ def main(argv=None) -> int:
     p_slo.add_argument("--json", action="store_true")
 
     args = parser.parse_args(argv)
+
+    if args.cmd == "device":
+        from .device import device_summary, load_snapshot, render_device
+
+        snapshot = (
+            load_snapshot(args.snapshot_file) if args.snapshot_file
+            else device_summary()
+        )
+        print(json.dumps(snapshot, indent=2, sort_keys=True, default=str)
+              if args.json else render_device(snapshot))
+        # a snapshot with no families is an empty observatory — exit 3 so
+        # the smoke gate can tell "round-tripped nothing" from success
+        families = (snapshot.get("jitwatch") or snapshot).get("families", {})
+        return 0 if families else 3
 
     if args.cmd == "fleet":
         from .fleet import FleetRecorder

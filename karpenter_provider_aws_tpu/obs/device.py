@@ -1,0 +1,252 @@
+"""The device-plane accountant: what the chip compiles, holds, and ships.
+
+``trace/jitwatch.py`` records *compiles* (the ledger);
+``ops/device_state.py`` records *residency outcomes and bytes* (the
+holder LRU + ``karpenter_device_state_bytes_total``); the solver and
+sidecar record *upload payloads*. This module folds all three into one
+judgment surface:
+
+- :class:`DeviceAccountant` — per-family live-buffer estimate (last
+  dispatch's abstract input bytes; the device-state mirrors' ACTUAL
+  buffer bytes), cumulative link bytes, and an HBM-watermark estimate
+  (the max total live estimate this process has seen). Exported on
+  ``karpenter_device_live_bytes{family}``.
+- ``/debug/device`` — the full observatory page: ledger snapshot
+  (compile/retrace/hit counts, attribution, first-compile callsites),
+  residency map, link accounting, watermark, and the retrace sentinel's
+  findings. Registered by ``obs.install()``.
+- ``obs device`` CLI rendering — ledger table + top retracers +
+  residency map, from the live process or a ``--snapshot-file`` (a saved
+  ``/debug/device`` page or ``sim run``'s device plane), so a collected
+  artifact round-trips offline (the ``make device-obs-smoke`` contract).
+
+Estimates are labeled estimates: the live-bytes gauge is derived from
+abstract input shapes (what a dispatch *presents* to the device), not a
+runtime allocator dump — good enough to rank families and catch a
+residency leak, not a byte-exact HBM profiler.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..trace import jitwatch
+
+# process-wide HBM watermark estimate (monotonic; reset with the ledger)
+_WM_LOCK = threading.Lock()
+_WATERMARK = {"bytes": 0}
+
+
+class DeviceAccountant:
+    """Folds the jitwatch ledger + device_state holders + byte counters
+    into the device plane's summary. Stateless apart from the module
+    watermark — build one wherever needed."""
+
+    def residency_map(self) -> list[dict]:
+        """The device-state mirror LRU: one row per live holder with its
+        actual buffer bytes (the scatter-patched screen tensors)."""
+        rows: list[dict] = []
+        try:
+            from ..ops import device_state as ds
+
+            with ds._HOLDERS_LOCK:
+                holders = list(ds._HOLDERS.values())
+            for h in holders:
+                bufs = h.arrays()
+                nbytes = 0
+                if bufs is not None:
+                    for b in bufs[:5]:
+                        nbytes += int(getattr(b, "nbytes", 0) or 0)
+                rows.append({
+                    "nodes_live": h.n_live,
+                    "node_bucket": h.NB,
+                    "group_bucket": h.GB,
+                    "slot_width": h.S,
+                    "resident_bytes": nbytes,
+                    "usable": bufs is not None,
+                })
+        except Exception:
+            pass
+        return rows
+
+    def link_bytes(self) -> dict:
+        """Cumulative host->device link accounting, by source."""
+        out: dict = {}
+        try:
+            from ..metrics import DEVICE_STATE_BYTES
+
+            out["device_state.upload"] = DEVICE_STATE_BYTES.value(kind="upload")
+            out["device_state.patch"] = DEVICE_STATE_BYTES.value(kind="patch")
+        except Exception:
+            pass
+        out.update(jitwatch.ledger().dispatch_bytes())
+        return out
+
+    def live_bytes(self, residency: Optional[list] = None) -> dict:
+        """Per-family live-buffer estimate: each program family's last
+        dispatch footprint, plus the mirrors' actual resident bytes.
+        Pass a precomputed ``residency_map()`` to avoid re-walking the
+        holder LRU."""
+        out = dict(jitwatch.ledger().live_arg_bytes())
+        rows = self.residency_map() if residency is None else residency
+        mirror = sum(r["resident_bytes"] for r in rows)
+        if mirror:
+            out["device_state.mirror"] = mirror
+        return out
+
+    def export(self, live: Optional[dict] = None) -> int:
+        """Publish the live-bytes gauge per family and advance the HBM
+        watermark; returns the current total estimate. Cheap by design —
+        the retrace sentinel calls this every liveness tick (no event
+        ring is copied; see ``JitLedger.live_arg_bytes``)."""
+        live = self.live_bytes() if live is None else live
+        total = int(sum(live.values()))
+        try:
+            from ..metrics import DEVICE_LIVE_BYTES
+
+            for family, n in live.items():
+                DEVICE_LIVE_BYTES.set(float(n), family=family)
+        except Exception:
+            pass
+        with _WM_LOCK:
+            if total > _WATERMARK["bytes"]:
+                _WATERMARK["bytes"] = total
+        return total
+
+    def summary(self) -> dict:
+        """The ``/debug/device`` payload (JSON-ready, self-contained —
+        the ``obs device`` CLI renders exactly this snapshot). The
+        ledger snapshot and residency walk are taken ONCE and reused."""
+        residency = self.residency_map()
+        live = self.live_bytes(residency=residency)
+        total = self.export(live=live)
+        with _WM_LOCK:
+            watermark = _WATERMARK["bytes"]
+        return {
+            "jitwatch": jitwatch.ledger().snapshot(),
+            "top_retracers": jitwatch.ledger().top_retracers(),
+            "residency": residency,
+            "link_bytes": self.link_bytes(),
+            "live_bytes": live,
+            "live_bytes_total": total,
+            "hbm_watermark_bytes": watermark,
+        }
+
+
+def reset_watermark() -> None:
+    with _WM_LOCK:
+        _WATERMARK["bytes"] = 0
+
+
+def device_summary(retrace_sentinel=None) -> dict:
+    """Build the full observatory page; with a sentinel attached, its
+    findings ride along (what ``/debug/device`` serves)."""
+    out = DeviceAccountant().summary()
+    if retrace_sentinel is not None:
+        try:
+            out["retrace_sentinel"] = retrace_sentinel.summary()
+        except Exception:
+            pass
+    return out
+
+
+# ---------------------------------------------------------------------------
+# CLI rendering (obs/__main__.py `device` subcommand)
+# ---------------------------------------------------------------------------
+
+def _fmt_bytes(n) -> str:
+    n = float(n or 0)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}GiB"  # pragma: no cover - loop always returns
+
+
+def render_device(snapshot: dict) -> str:
+    """Human rendering of a device-observatory snapshot (the summary()
+    dict, a saved /debug/device page, or a sim report's device plane)."""
+    jw = snapshot.get("jitwatch", snapshot)
+    lines: list[str] = []
+    families = jw.get("families", {})
+    traces = jw.get("seq") or sum(
+        f.get("compiles", 0) + f.get("retraces", 0)
+        for f in families.values()
+    )
+    lines.append(
+        f"jitwatch ledger: {'armed' if jw.get('enabled', True) else 'OFF'}, "
+        f"{len(families)} program families, "
+        f"{traces} total (re)traces"
+    )
+    if families:
+        header = (
+            f"  {'family':<26} {'compiles':>8} {'retraces':>8} {'hits':>8} "
+            f"{'compile_ms':>10} {'last_change'}"
+        )
+        lines.append(header)
+        for name, fam in sorted(families.items()):
+            lines.append(
+                f"  {name:<26} {fam['compiles']:>8} {fam['retraces']:>8} "
+                f"{fam['hits']:>8} {fam['compile_ms_total']:>10.1f} "
+                f"{fam.get('last_change', '')}"
+            )
+    top = snapshot.get("top_retracers") or []
+    retracers = [f for f in top if f.get("retraces")]
+    if retracers:
+        lines.append("top retracers:")
+        for fam in retracers:
+            lines.append(
+                f"  {fam['family']}: {fam['retraces']} retraces "
+                f"(last: {fam.get('last_change', '?')}; "
+                f"callsite {fam.get('callsite', '?')})"
+            )
+    res = snapshot.get("residency") or []
+    if res:
+        lines.append("residency map (device-state mirrors):")
+        for r in res:
+            lines.append(
+                f"  nodes={r['nodes_live']}/{r['node_bucket']} "
+                f"groups<={r['group_bucket']} slots={r['slot_width']} "
+                f"{_fmt_bytes(r['resident_bytes'])}"
+                f"{'' if r['usable'] else ' (UNUSABLE)'}"
+            )
+    link = snapshot.get("link_bytes") or {}
+    if link:
+        lines.append("cumulative link bytes: " + ", ".join(
+            f"{k}={_fmt_bytes(v)}" for k, v in sorted(link.items())
+        ))
+    if "live_bytes_total" in snapshot:
+        lines.append(
+            f"live-bytes estimate: {_fmt_bytes(snapshot['live_bytes_total'])} "
+            f"(HBM watermark {_fmt_bytes(snapshot.get('hbm_watermark_bytes'))})"
+        )
+    mon = jw.get("monitoring") or {}
+    if mon:
+        lines.append("jax.monitoring compile events:")
+        for k, cell in sorted(mon.items()):
+            lines.append(
+                f"  {k}: {cell['count']}x, {cell['total_s']:.2f}s"
+            )
+    sent = snapshot.get("retrace_sentinel")
+    if sent:
+        lines.append(
+            f"retrace sentinel: {sent.get('ticks', 0)} ticks, "
+            f"{len(sent.get('findings', []))} findings"
+        )
+        for f in sent.get("findings", []):
+            lines.append(f"  [STORM] {f.get('detail')}")
+    return "\n".join(lines)
+
+
+def load_snapshot(path: str) -> dict:
+    """Read a saved device snapshot: a /debug/device page, a summary()
+    dump, or a fleet report (its ``wall.device`` plane is extracted)."""
+    import json
+
+    with open(path) as f:
+        doc = json.load(f)
+    if "wall" in doc and isinstance(doc.get("wall"), dict) \
+            and "device" in doc["wall"]:
+        return doc["wall"]["device"]
+    return doc

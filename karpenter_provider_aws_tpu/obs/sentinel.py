@@ -61,6 +61,13 @@ SHARE_FLOOR_MS = 20.0         # ignore share math on near-empty ticks
 FAMILY_FLOOR_MS = 500.0       # a share jump must also BE this much wall
 P99_WINDOW = 128              # bounded tick-wall history for the p99 gauge
 FINDINGS_CAP = 256
+#: a tick whose jit.compile spans carry at least this much wall is not
+#: steady state: the compile inflates its owner's share and the total
+#: tick, and compile judgment belongs to the RETRACE sentinel (which
+#: pages on repetition, not on one ladder-growth compile) — the
+#: steady-state judgments skip such ticks instead of paging on a benign
+#: one-off spike that only stands out BECAUSE steady ticks got cheap
+COMPILE_GRACE_MS = 250.0
 
 
 def span_family(name: str) -> str:
@@ -133,6 +140,36 @@ def detect_cliffs(rows: list[dict],
     return {"cliff_tier": cliff, "findings": findings}
 
 
+# -- shared edge-trigger/dedupe helper ---------------------------------------
+
+class EdgeTrigger:
+    """Edge-triggered episode set shared by every sentinel: a key FIRES
+    once when it first appears, stays silent while the episode persists,
+    and re-arms once the episode ends (``settle`` with the keys seen this
+    tick). PR 13 duplicated this pattern inline; one helper now owns it."""
+
+    def __init__(self):
+        self._active: set = set()
+
+    def fire(self, key) -> bool:
+        """True exactly when ``key`` newly activates (the edge)."""
+        if key in self._active:
+            return False
+        self._active.add(key)
+        return True
+
+    def settle(self, seen) -> None:
+        """End every episode whose key was NOT seen this tick — it
+        re-arms and can fire again."""
+        self._active &= set(seen)
+
+    def active(self) -> set:
+        return set(self._active)
+
+    def clear(self) -> None:
+        self._active.clear()
+
+
 # -- the process-wide cumulative profile ------------------------------------
 
 _CUM_LOCK = threading.Lock()
@@ -183,7 +220,7 @@ class SteadyStateSentinel:
         self._wall_ewma: Optional[float] = None
         self._wall_hist: deque = deque(maxlen=P99_WINDOW)
         self._ticks = 0
-        self._active: set = set()               # (kind, family) episodes
+        self._edges = EdgeTrigger()             # (kind, family) episodes
         self._share_exported: set = set()       # families on the gauge
         self.findings: deque = deque(maxlen=FINDINGS_CAP)
         self.last_tick: dict = {}
@@ -204,20 +241,46 @@ class SteadyStateSentinel:
         profile = self._source()
         spans = profile.get("spans", profile)  # tolerate bare span maps
         delta: dict[str, float] = {}
+        jit_ms = 0.0
         with self._lock:
             for name, cell in spans.items():
+                total = float(cell["total_ms"])
+                d = total - self._cursor.get(name, 0.0)
+                self._cursor[name] = total
                 if name.startswith("sim."):
                     # driver container spans CONTAIN the controller spans
                     # (and exist only under the simulator) — folding them
                     # in would double-count every reconcile
                     continue
-                total = float(cell["total_ms"])
-                d = total - self._cursor.get(name, 0.0)
-                self._cursor[name] = total
+                if name.startswith("jit."):
+                    # compile spans are nested INSIDE the dispatching
+                    # span (solve.dispatch / consolidate.screen), so
+                    # their wall is already attributed to the owner —
+                    # folding them in double-counts every compile and
+                    # invents a "jit" family; the retrace sentinel is
+                    # the compile plane's judge, not this one. Their
+                    # delta still gates the tick below (COMPILE_GRACE_MS)
+                    if d > 0:
+                        jit_ms += d
+                    continue
                 if d > 0:
                     family = span_family(name)
                     delta[family] = delta.get(family, 0.0) + d
             tick_ms = sum(delta.values())
+            if jit_ms >= COMPILE_GRACE_MS:
+                # compile-dominated tick: not steady state — no judgment
+                # (the retrace sentinel owns the compile plane), no
+                # episode re-arm, and the inflated wall stays out of the
+                # baseline so the NEXT genuinely-steady tick is judged
+                # against an honest floor
+                self.last_tick = {
+                    "at": round(now, 3),
+                    "tick_wall_ms": round(tick_ms, 3),
+                    "compile_grace_ms": round(jit_ms, 3),
+                    "shares": {},
+                }
+                self._export_gauges(delta, tick_ms)
+                return []
             new = self._judge_locked(delta, tick_ms, now)
             self._ticks += 1
             self._wall_hist.append(tick_ms)
@@ -266,8 +329,7 @@ class SteadyStateSentinel:
                         and share > base * self.share_jump_rel):
                     key = ("attribution-shift", family)
                     seen.add(key)
-                    if key not in self._active:
-                        self._active.add(key)
+                    if self._edges.fire(key):
                         new.append({
                             "at": round(now, 3),
                             "kind": "attribution-shift",
@@ -288,8 +350,7 @@ class SteadyStateSentinel:
             top = max(delta, key=delta.get, default="?")
             key = ("tick-superlinear", top)
             seen.add(key)
-            if key not in self._active:
-                self._active.add(key)
+            if self._edges.fire(key):
                 new.append({
                     "at": round(now, 3),
                     "kind": "tick-superlinear",
@@ -301,7 +362,7 @@ class SteadyStateSentinel:
                     ),
                 })
         # episodes that calmed down re-arm (edge-triggered)
-        self._active &= seen
+        self._edges.settle(seen)
         self.findings.extend(new)
         return new
 
@@ -364,7 +425,7 @@ class SteadyStateSentinel:
                 "tick_wall_p99_ms": percentile(hist, 0.99),
                 "last_tick": dict(self.last_tick),
                 "active_episodes": sorted(
-                    f"{kind}:{family}" for kind, family in self._active
+                    f"{kind}:{family}" for kind, family in self._edges.active()
                 ),
                 "findings": [dict(f) for f in self.findings],
             }
@@ -383,6 +444,175 @@ class SteadyStateSentinel:
             self._wall_ewma = None
             self._wall_hist.clear()
             self._ticks = 0
-            self._active.clear()
+            self._edges.clear()
+            self.findings.clear()
+            self.last_tick = {}
+
+
+# -- the device-plane retrace sentinel ---------------------------------------
+
+#: ticks before the retrace sentinel judges: legitimate compiles happen
+#: while the process discovers its ladder buckets (the first wave of each
+#: size, the first screen of each node bucket).
+RETRACE_WARMUP_TICKS = 5
+#: a family compiling on this many CONSECUTIVE ticks is a storm — one
+#: compile is the ladder growing across a boundary (expected, absorbed),
+#: repetition means shapes are flapping past the ladder every pass (the
+#: ~270ms vmap-screen re-jit cliff's signature)
+RETRACE_STORM_TICKS = 2
+#: ...as is this many distinct new signatures inside ONE tick
+RETRACE_STORM_BURST = 3
+
+
+class RetraceSentinel:
+    """Edge-triggered ``DeviceRetraceStorm`` findings off the jitwatch
+    ledger (trace/jitwatch.py): the compile discipline says a warmed-up
+    steady state retraces ~zero times — a single compile is the ladder
+    absorbing growth across one boundary, but a family that keeps
+    compiling (consecutive ticks, or a burst of signatures in one tick)
+    has shapes flapping PAST the ladder, and the finding NAMES the
+    program family and the signature axis that changed — the exact
+    attribution the two prior compile cliffs (the vmap-screen re-jit,
+    the cold lane solve) lacked.
+
+    One per Obs bundle, ticked on the liveness cadence beside the
+    steady-state sentinel. Deterministic harnesses set
+    ``publish_events = False`` exactly like the steady-state sentinel:
+    findings stay readable (``/debug/device``, the fleet report's wall
+    plane) but never enter the signed event stream. The hard ZERO-compile
+    contract lives in the gates, where the window is controlled:
+    ``retraces_after_warmup`` (fleet gate) and ``steady_state_retraces``
+    (bench gate)."""
+
+    def __init__(self, clock=None, recorder=None,
+                 warmup_ticks: int = RETRACE_WARMUP_TICKS,
+                 storm_ticks: int = RETRACE_STORM_TICKS,
+                 storm_burst: int = RETRACE_STORM_BURST):
+        self.clock = clock
+        self.recorder = recorder
+        self.publish_events = True
+        self.warmup_ticks = int(warmup_ticks)
+        self.storm_ticks = int(storm_ticks)
+        self.storm_burst = int(storm_burst)
+        self._lock = threading.Lock()
+        self._ticks = 0
+        self._cursor = 0          # ledger seq already judged
+        self._streak: dict[str, int] = {}  # family -> consecutive ticks
+        self._edges = EdgeTrigger()
+        self.findings: deque = deque(maxlen=FINDINGS_CAP)
+        self.last_tick: dict = {}
+
+    def _now(self) -> float:
+        if self.clock is not None:
+            return self.clock.now()
+        import time
+
+        return time.monotonic()
+
+    def tick(self, now: Optional[float] = None) -> list[dict]:
+        """One judgment pass: diff the ledger's compile seq against the
+        cursor; after warmup, every new compile event is a storm edge.
+        Also refreshes the device accountant's live-bytes gauge."""
+        from ..trace import jitwatch
+
+        if not jitwatch.enabled():
+            return []
+        now = self._now() if now is None else now
+        led = jitwatch.ledger()
+        new: list[dict] = []
+        with self._lock:
+            events = led.events_since(self._cursor)
+            self._cursor = led.seq()
+            self._ticks += 1
+            warmed = self._ticks > self.warmup_ticks
+            by_family: dict[str, list] = {}
+            for ev in events:
+                by_family.setdefault(ev["family"], []).append(ev)
+            # consecutive-tick streaks: a family absent this tick re-arms
+            for family in list(self._streak):
+                if family not in by_family:
+                    self._streak.pop(family)
+            seen: set = set()
+            for family, evs in by_family.items():
+                streak = self._streak.get(family, 0) + 1
+                self._streak[family] = streak
+                stormy = (
+                    streak >= self.storm_ticks
+                    or len(evs) >= self.storm_burst
+                )
+                if not (warmed and stormy):
+                    continue
+                key = ("retrace-storm", family)
+                seen.add(key)
+                if self._edges.fire(key):
+                    last = evs[-1]
+                    wall = sum(e["wall_ms"] for e in evs)
+                    new.append({
+                        "at": round(now, 3),
+                        "kind": "retrace-storm",
+                        "family": family,
+                        "changed": last["changed"],
+                        "detail": (
+                            f"{family} keeps compiling in steady state "
+                            f"({len(evs)} new signatures this tick, "
+                            f"{streak} consecutive ticks, {wall:.0f}ms): "
+                            f"last change {last['changed']} — shapes are "
+                            f"flapping past the ladder"
+                        ),
+                    })
+            self._edges.settle(seen)
+            self.findings.extend(new)
+            self.last_tick = {
+                "at": round(now, 3),
+                "compiles": len(events),
+                "warmed_up": warmed,
+            }
+        # live-bytes gauge + HBM watermark ride the sentinel cadence
+        try:
+            from .device import DeviceAccountant
+
+            DeviceAccountant().export()
+        except Exception:
+            pass
+        for f in new:
+            self._raise(f)
+        return new
+
+    def _raise(self, finding: dict) -> None:
+        if self.recorder is not None and self.publish_events:
+            try:
+                from ..events import WARNING
+
+                self.recorder.publish(
+                    "Sentinel", finding["family"], "DeviceRetraceStorm",
+                    finding["detail"], type=WARNING,
+                )
+            except Exception:
+                pass
+
+    def summary(self) -> dict:
+        with self._lock:
+            return {
+                "ticks": self._ticks,
+                "warmed_up": self._ticks > self.warmup_ticks,
+                "cursor": self._cursor,
+                "active_episodes": sorted(
+                    f"{kind}:{family}" for kind, family in self._edges.active()
+                ),
+                "last_tick": dict(self.last_tick),
+                "findings": [dict(f) for f in self.findings],
+            }
+
+    def reset(self) -> None:
+        """Fresh warmup AND a fresh cursor: compiles recorded before the
+        reset (a previous run's, a fleet build's) are not this run's
+        storms."""
+        from ..trace import jitwatch
+
+        with self._lock:
+            self._cursor = jitwatch.ledger().seq()
+            self._ticks = 0
+            self._streak.clear()
+            self._edges.clear()
             self.findings.clear()
             self.last_tick = {}

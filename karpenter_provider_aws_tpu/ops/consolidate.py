@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.resources import NUM_RESOURCES
+from ..trace.jitwatch import tracked_jit
 
 _EPS = 1e-4
 GMAX_DEFAULT = 32
@@ -413,7 +414,7 @@ def _fit_counts(cap_rem: jnp.ndarray, req: jnp.ndarray) -> jnp.ndarray:
     return jnp.maximum(jnp.min(ratio, axis=-1), 0.0).astype(jnp.int32)
 
 
-@jax.jit
+@tracked_jit(family="screen.repack")
 def repack_check(
     free: jnp.ndarray,          # [N, R]
     requests: jnp.ndarray,      # [G, R]
@@ -630,6 +631,29 @@ def native_screen_prefilter(ct: ClusterTensors, gids_s: np.ndarray,
     out[pre & single] = True  # exact: see (2) above
     cand = np.nonzero(pre & ~single)[0].astype(np.int32)
     return out, cand
+
+
+#: Process-wide high-watermark of the host vmap screen's jit shape
+#: buckets, keyed NB (node rows) / GB (groups) / S (slots). Bounded at 4x
+#: the current need: shrinking across a ladder boundary must not re-jit
+#: (the compiled larger program is cached, padding is inert — the jitwatch
+#: ledger caught the 267ms shrink re-jit on its first armed smoke day),
+#: but one giant cluster in a long-lived process must not tax every later
+#: tiny one with unbounded padding work either.
+_SCREEN_BUCKET_HW: dict[str, int] = {}
+
+
+def _screen_bucket_hw(kind: str, value: int) -> int:
+    cur = _SCREEN_BUCKET_HW.get(kind, 0)
+    if value > cur:
+        _SCREEN_BUCKET_HW[kind] = value
+        return value
+    return min(cur, value * 4)
+
+
+def reset_screen_buckets() -> None:
+    """Tests: forget the ratcheted host-screen shape buckets."""
+    _SCREEN_BUCKET_HW.clear()
 
 
 class _PendingScreen:
@@ -949,16 +973,27 @@ def _screen(ct: ClusterTensors, chunk: int):
         from .device_state import _ladder_bucket, _pow2
 
         G = ct.requests.shape[0]
-        NB = _ladder_bucket(N)
-        GB = _pow2(G, minimum=8)
+        # Ratcheted buckets: buckets are high-watermarked (bounded at 4x
+        # the current need, so one giant cluster cannot tax every later
+        # tiny one with padding work forever) — a fleet that
+        # consolidation SHRANK across a ladder boundary used to re-jit
+        # the screen (~267ms on the smoke-500 day, caught by the jitwatch
+        # ledger the moment it armed) to buy nothing: the larger program
+        # is already compiled and its padding is inert. Same rule the
+        # device mirror's holder buckets always had.
+        NB = _screen_bucket_hw("NB", _ladder_bucket(N))
+        GB = _screen_bucket_hw("GB", _pow2(G, minimum=8))
+        # the slot axis rides the same ratchet (zero-count slots are
+        # no-ops wherever they sit, so widening is semantics-free)
+        SP = min(_screen_bucket_hw("S", S), ct.group_ids.shape[1])
         free_h = np.zeros((NB, ct.free.shape[1]), dtype=ct.free.dtype)
         free_h[:N] = ct.free
         req_h = np.zeros((GB, ct.requests.shape[1]), dtype=ct.requests.dtype)
         req_h[:G] = ct.requests
-        gids_h = np.zeros((NB, S), dtype=gids_s.dtype)
-        gids_h[:N] = gids_s
-        gcounts_h = np.zeros((NB, S), dtype=gcounts_s.dtype)
-        gcounts_h[:N] = gcounts_s
+        gids_h = np.zeros((NB, SP), dtype=gids_s.dtype)
+        gids_h[:N, :S] = gids_s
+        gcounts_h = np.zeros((NB, SP), dtype=gcounts_s.dtype)
+        gcounts_h[:N, :S] = gcounts_s
         cap_h = np.zeros((GB, NB), dtype=screen_cap.dtype)
         cap_h[:G, :N] = screen_cap
         free = jnp.asarray(free_h)

@@ -130,10 +130,11 @@ _patch_fns: dict[bool, object] = {}
 def _patch_fn(donate: bool):
     fn = _patch_fns.get(donate)
     if fn is None:
-        import jax
+        from ..trace.jitwatch import tracked_jit
 
-        fn = jax.jit(
-            _patch_body, donate_argnums=(0, 1, 2, 3) if donate else (),
+        fn = tracked_jit(
+            _patch_body, family="device_state.patch",
+            donate_argnums=(0, 1, 2, 3) if donate else (),
         )
         _patch_fns[donate] = fn
     return fn

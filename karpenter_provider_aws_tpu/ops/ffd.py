@@ -33,6 +33,8 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from ..trace.jitwatch import tracked_jit
+
 _EPS = 1e-4
 
 
@@ -167,7 +169,8 @@ def _step(capacity: jnp.ndarray, type_window: jnp.ndarray, n_pre, state: _State,
     return new_state, (placed_row, unplaced.astype(jnp.int32))
 
 
-@functools.partial(jax.jit, static_argnames=("max_entries",))
+@functools.partial(tracked_jit, family="ffd.compact_plan",
+                   static_argnames=("max_entries",))
 def compact_plan(placed: jnp.ndarray, max_entries: int):
     """Sparse (flat-index, count) encoding of the placement matrix.
 
@@ -187,7 +190,8 @@ def compact_plan(placed: jnp.ndarray, max_entries: int):
     return nz.astype(jnp.int32), cnt.astype(jnp.int32), total.astype(jnp.int32)
 
 
-@functools.partial(jax.jit, static_argnames=("k",))
+@functools.partial(tracked_jit, family="ffd.rank_launch_options",
+                   static_argnames=("k",))
 def rank_launch_options(
     placed: jnp.ndarray,       # [G, N] int32 pods of group g on node n
     price: jnp.ndarray,        # [G, T] float32, inf where group can't use type
@@ -310,8 +314,8 @@ def _ffd_solve_impl(
     )
 
 
-ffd_solve = functools.partial(jax.jit, static_argnames=("max_nodes",))(
-    _ffd_solve_impl
+ffd_solve = tracked_jit(
+    _ffd_solve_impl, family="ffd.solve", static_argnames=("max_nodes",)
 )
 
 #: Chained-dispatch variant: DONATES ``init_state`` (argument 9), so a
@@ -320,6 +324,7 @@ ffd_solve = functools.partial(jax.jit, static_argnames=("max_nodes",))(
 #: state they own outright (the previous chunk's result) — never buffers a
 #: cache also holds (the solver's content-addressed upload cache builds the
 #: FIRST chunk's state, which therefore goes through the non-donating entry).
-ffd_solve_chained = jax.jit(
-    _ffd_solve_impl, static_argnames=("max_nodes",), donate_argnums=(9,),
+ffd_solve_chained = tracked_jit(
+    _ffd_solve_impl, family="ffd.solve_chained",
+    static_argnames=("max_nodes",), donate_argnums=(9,),
 )

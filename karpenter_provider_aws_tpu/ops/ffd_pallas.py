@@ -52,6 +52,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..trace.jitwatch import tracked_jit
 from .ffd import FFDResult, _State
 
 _EPS = 1e-4
@@ -307,7 +308,8 @@ def pack_compat_bits(compat: np.ndarray, n_words: int) -> np.ndarray:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("max_nodes", "interpret", "n_resources")
+    tracked_jit, family="ffd.pallas",
+    static_argnames=("max_nodes", "interpret", "n_resources"),
 )
 def _ffd_pallas_call(
     requests_l,   # [G, R_LANES] f32

@@ -33,6 +33,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..trace.jitwatch import tracked_jit
+
 _EPS = 1e-4
 _BIG = np.float32(1 << 30)
 
@@ -126,7 +128,8 @@ def _kernel(cand_ref, slots_ref, counts_ref, nslots_ref, free_ref, req_ref,
     ok_ref[i, 0] = (leftover <= 0.5).astype(jnp.int32)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(tracked_jit, family="screen.pallas",
+                   static_argnames=("interpret",))
 def _repack_call(cand_bands, slots_bands, counts_bands, nslots_bands,
                  free_t, req_t, cap_f32, interpret=False):
     """All candidate bands in ONE dispatch: ``lax.map`` over 256-wide bands,

@@ -28,6 +28,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.ffd import ffd_solve
+from ..trace.jitwatch import tracked_jit
 
 POD_AXIS = "pods"
 
@@ -106,7 +107,7 @@ def sharded_solve_fn(mesh: Mesh, max_nodes: int):
             res.placed[None, :, :],
         )
 
-    return jax.jit(_solve_shard)
+    return tracked_jit(_solve_shard, family="mesh.solve_shard")
 
 
 def pad_problem_for_mesh(problem, mesh: Mesh):
@@ -192,7 +193,7 @@ def sharded_screen_fn(mesh: Mesh):
     def _screen(free, requests, gids, gcounts, cap, candidates):
         return repack_check(free, requests, gids, gcounts, cap, candidates)
 
-    return jax.jit(_screen)
+    return tracked_jit(_screen, family="mesh.screen")
 
 
 def place_screen_args(ct, mesh: Mesh):
@@ -441,7 +442,7 @@ def _lane_body(max_nodes: int):
 
 @functools.lru_cache(maxsize=8)
 def _lanes_vmap_fn(max_nodes: int):
-    return jax.jit(jax.vmap(_lane_body(max_nodes)))
+    return tracked_jit(jax.vmap(_lane_body(max_nodes)), family="mesh.lanes")
 
 
 @functools.lru_cache(maxsize=8)
@@ -458,7 +459,7 @@ def _lanes_shard_fn(mesh: Mesh, max_nodes: int):
         P(POD_AXIS),
         P(POD_AXIS),
     )
-    return jax.jit(fn)
+    return tracked_jit(fn, family="mesh.lanes_shard")
 
 
 def stack_lane_problems(padded_list):

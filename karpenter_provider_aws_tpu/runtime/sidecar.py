@@ -123,6 +123,12 @@ class SolverServer:
                 self._dev_cache.move_to_end(key)
                 return hit
         arr = jax.device_put(x)
+        # link-byte attribution: a cache miss is real host->device payload;
+        # the device-plane accountant folds it into the sidecar's family
+        # (trace/jitwatch.py — no-op when jitwatch is off)
+        from ..trace.jitwatch import note_dispatch
+
+        note_dispatch("sidecar.devcache", x.nbytes)
         with self._dev_lock:
             # re-check under the lock: two workers can miss on the same key
             # concurrently (the shared catalog arrays), and overwriting the

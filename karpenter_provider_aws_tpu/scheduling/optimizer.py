@@ -274,7 +274,9 @@ def _program(max_nodes: int, lanes: int):
         return (costs, best_cost, node_type, node_price, used, node_cap,
                 node_window, n_open, placed, unplaced)
 
-    return jax.jit(program)
+    from ..trace.jitwatch import tracked_jit
+
+    return tracked_jit(program, family="optimizer.lanes")
 
 
 @functools.lru_cache(maxsize=16)

@@ -866,6 +866,11 @@ class TPUSolver:
             (time.perf_counter() - t0) * 1e3
         )
         self.timings["upload_bytes"] = self.timings.get("upload_bytes", 0) + x.nbytes
+        # the device-plane accountant folds solver upload payload into its
+        # per-family link accounting (no-op when jitwatch is off)
+        from ..trace.jitwatch import note_dispatch
+
+        note_dispatch("solver.upload", x.nbytes)
         self._dev_cache[key] = arr
         self._dev_cache_bytes += x.nbytes
         while self._dev_cache_bytes > self._dev_cache_budget and len(self._dev_cache) > 1:
@@ -2054,6 +2059,13 @@ def _solve_multi_nodepool(
     t0 = time.perf_counter()
     if hasattr(impl, "timings"):
         impl.timings = {}
+    # jitwatch cursor: the provenance stamp proves whether THIS solve paid
+    # any program (re)trace (compiles == 0 == ran warm). Thread-local, not
+    # the process-global seq: a concurrent screen compiling on another
+    # thread must not make a warm solve read as cold — trace/jitwatch.py
+    from ..trace import jitwatch as _jitwatch
+
+    _jit_seq0 = _jitwatch.thread_compiles() if _jitwatch.enabled() else None
     result = SolveResult(num_pods=len(pods))
     remaining: list[Pod] = list(pods)
     reasons: dict[str, str] = {}
@@ -2273,6 +2285,8 @@ def _solve_multi_nodepool(
     if opt_counts is not None and (opt_counts["adopted"] or opt_counts["rejected"]):
         extra_scale["opt_adopted"] = opt_counts["adopted"]
         extra_scale["opt_rejected"] = opt_counts["rejected"]
+    if _jit_seq0 is not None and hasattr(impl, "timings"):
+        impl.timings["compiles"] = _jitwatch.thread_compiles() - _jit_seq0
     result.provenance = solve_record(
         backend=(
             impl.backend_label() if hasattr(impl, "backend_label") else "host"

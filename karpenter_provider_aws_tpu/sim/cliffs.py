@@ -56,6 +56,11 @@ def tier_row(nodes: int, report) -> dict:
             # sim-only segments so shares don't double-count
             if family == "sim" and name != "sim.build":
                 continue
+            # jit.compile spans are nested inside their dispatching span
+            # (same double-count rule the live sentinel applies); compile
+            # judgment is the retrace sentinel's, not a tier share
+            if family == "jit":
+                continue
             key = name if family == "sim" else span_family(name)
             shares[key] = round(
                 shares.get(key, 0.0) + cell["total_ms"] / wall_ms, 4

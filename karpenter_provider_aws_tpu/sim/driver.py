@@ -132,11 +132,18 @@ class FleetSimulator:
             resolution_s=0.001,
             cap_s=max(0.25, min(2.0, self.trace.burst_step_s * 0.5)),
         )
-        # steady-state sentinel: findings are wall-time judgments, so a
-        # slow CI machine must never perturb the SIGNED event stream —
-        # the sentinel keeps judging (its findings land in the report's
-        # unsigned wall plane) but publishes no events here
+        # sentinels: findings are wall-time judgments (the retrace
+        # sentinel's detail strings carry compile walls), so a slow CI
+        # machine must never perturb the SIGNED event stream — both
+        # sentinels keep judging (their findings land in the report's
+        # unsigned wall plane) but publish no events here
         self.env.obs.sentinel.publish_events = False
+        self.env.obs.retrace.publish_events = False
+        # jitwatch warmup cursor: compiles BEFORE the trace's halfway
+        # point are ladder discovery (first wave of each size bucket);
+        # compiles after it are steady-state retraces — the
+        # `retraces_after_warmup` gate key (wall.device plane)
+        self._jit_warm_seq: Optional[int] = None
         # chaos seams (the harness protocol faults/invariants expect)
         self.log = ChaosLog()
         self.cloud_rng = random.Random(f"{self.seed}:cloud")
@@ -388,6 +395,13 @@ class FleetSimulator:
             self._scan_provenance()
         self.passes += 1
         SIM_PASSES.inc()
+        if (
+            self._jit_warm_seq is None
+            and self._t >= self.trace.duration_s * 0.5
+        ):
+            from ..trace import jitwatch
+
+            self._jit_warm_seq = jitwatch.ledger().seq()
         if self._loss_at is not None and hasattr(self.env, "partition_gap"):
             if not self.env.partition_gap():
                 self.replica_recoveries.append(
@@ -662,6 +676,33 @@ class FleetSimulator:
         from ..obs.fleet import FleetRecorder
 
         return FleetRecorder(self.env)
+
+    def jit_summary(self) -> dict:
+        """The run's device plane (wall-side: compile walls are real
+        milliseconds): jitwatch ledger families, the warmup boundary, and
+        the compiles that fired AFTER it — `retraces_after_warmup` is the
+        zero-retrace steady-state gate's source. None entries mean
+        jitwatch was off (KARPENTER_TPU_JITWATCH=0)."""
+        from ..trace import jitwatch
+
+        if not jitwatch.enabled():
+            return {"enabled": False}
+        led = jitwatch.ledger()
+        snap = led.snapshot()
+        after = (
+            led.events_since(self._jit_warm_seq)
+            if self._jit_warm_seq is not None else []
+        )
+        return {
+            "enabled": True,
+            "families": snap["families"],
+            "monitoring": snap["monitoring"],
+            "warmup_boundary_s": round(self.trace.duration_s * 0.5, 1),
+            "warmup_cursor": self._jit_warm_seq,
+            "retraces_after_warmup": len(after),
+            "retrace_events_after_warmup": after,
+            "sentinel": self.env.obs.retrace.summary(),
+        }
 
     def run(self):
         """Drive the whole trace; returns the :class:`sim.report.FleetReport`."""

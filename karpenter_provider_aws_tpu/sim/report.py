@@ -239,7 +239,13 @@ def build_report(sim, span_profile: dict, deltas: dict) -> FleetReport:
             "cost_vs_oracle": quality,
             "unschedulable_total": deltas["unschedulable"],
             "solve_backends": dict(sorted(sim.backend_counts.items())),
-            "residency": dict(sorted(sim.residency_counts.items())),
+            # NOTE: residency counts deliberately live in the WALL plane
+            # (wall.residency): the chained-vs-unchained screen chooser
+            # picks from MEASURED per-bucket wall cost (ops/device_state
+            # .pick_chained), so the labels are wall-clock-dependent and
+            # must never enter the signed deterministic core — the PR 13
+            # determinism divergence at smoke@120-nodes/2-replicas was
+            # exactly this leak.
             "fallbacks": dict(sorted(sim.fallback_counts.items())),
         },
         "audit": {
@@ -342,6 +348,15 @@ def build_report(sim, span_profile: dict, deltas: dict) -> FleetReport:
             "findings": s["findings"],
         }
 
+    # the device plane (trace/jitwatch.py): compile/retrace ledger +
+    # the zero-retrace steady-state witness. Wall-side by construction —
+    # compile walls are real milliseconds, and residency labels depend on
+    # the measured-cost screen chooser.
+    try:
+        device_plane = sim.jit_summary()
+    except Exception:
+        device_plane = {}
+
     wall = {
         "wall_s": round(sim.driver_wall_s, 3),
         "wall_per_sim_hour_s": (
@@ -349,6 +364,8 @@ def build_report(sim, span_profile: dict, deltas: dict) -> FleetReport:
             if sim.trace.duration_s else None
         ),
         "sentinel": sentinel_wall,
+        "device": device_plane,
+        "residency": dict(sorted(sim.residency_counts.items())),
         "attribution": {
             "coverage": coverage,
             "roots": span_profile.get("roots", {}),
@@ -378,6 +395,10 @@ def build_report(sim, span_profile: dict, deltas: dict) -> FleetReport:
         "attribution_coverage": coverage,
         "correlation_coverage": correlation.get("coverage"),
         "sentinel_findings": len(sentinel_wall.get("findings", ())),
+        # the zero-retrace steady-state gate: compiles recorded after the
+        # trace's warmup boundary (None when jitwatch was off — absence
+        # fails the gate unless the baseline allows it)
+        "retraces_after_warmup": device_plane.get("retraces_after_warmup"),
     }
     if getattr(sim, "replicas", 1) > 1:
         sharding = virtual["sharding"]

@@ -27,6 +27,7 @@ _PHASE_PREFIX = "solve."
 _CONTROLLER_PREFIX = "controller."
 _AWS_PREFIX = "aws."
 _CONSOLIDATE_PREFIX = "consolidate."
+_JIT_PREFIX = "jit."
 
 
 def to_chrome_trace(spans: Iterable[Span], pid: Optional[int] = None) -> dict:
@@ -110,6 +111,9 @@ class MetricsBridge:
     - ``controller.<name>``    -> RECONCILE_SECONDS{controller=...}
     - ``aws.<service>``        -> AWS_REQUEST_SECONDS{service=...} (+ the
       retry counter when the span carries a ``retries`` attr > 0)
+    - ``jit.compile``          -> JIT_COMPILE_SECONDS{family=...} (the
+      jitwatch ledger records one such span per new trace signature, so
+      compile walls land in Chrome export AND /metrics from one spot)
 
     Installed once per process (idempotent via ``install``).
     """
@@ -144,6 +148,11 @@ class MetricsBridge:
                 m.AWS_REQUEST_RETRIES.inc(
                     retries, service=span.name[len(_AWS_PREFIX):]
                 )
+        elif span.name.startswith(_JIT_PREFIX):
+            m.JIT_COMPILE_SECONDS.observe(
+                span.duration_s,
+                family=str(span.attrs.get("family", "?")),
+            )
 
     @classmethod
     def install(cls, tracer: Tracer = TRACER) -> "MetricsBridge":

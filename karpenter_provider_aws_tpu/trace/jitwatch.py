@@ -1,0 +1,515 @@
+"""jitwatch: the compile/retrace ledger behind the device-plane observatory.
+
+The entire device hot path is built on a "one compiled program per ladder
+bucket" discipline — asserted in comments (``ops/ffd.py``,
+``ops/encode.py``, ``scheduling/optimizer.py``, ``parallel/mesh.py``) but
+never *observed*: two prior compile cliffs (the ~270ms vmap-screen re-jit
+the PR 8 simulator surfaced, the 245.8ms cold lane solve in ``config9``)
+were diagnosed indirectly from wall-clock anomalies. This module makes
+compiles first-class telemetry:
+
+- :func:`tracked_jit` — a drop-in ``jax.jit`` replacement used at every
+  jit/shard_map callsite in the tree. Each wrapped function belongs to a
+  **program family** (``ffd.solve``, ``screen.repack``, ``mesh.lanes`` …);
+  every call derives the abstract *trace signature* of its arguments
+  (pytree structure + per-leaf shape/dtype + static-arg values — the same
+  key axes ``jax.jit``'s cache uses) and folds the outcome into the
+  process-wide :class:`JitLedger`: cache hits, compiles, **retrace
+  attribution** (which signature axis changed vs. the previous trace —
+  the ladder's whole point is that steady state retraces zero times),
+  first-compile wall and callsite, and per-family dispatch bytes.
+- :class:`JitLedger` — bounded, thread-safe, process-wide. ``seq()`` is a
+  monotonic compile counter: any consumer (the solver's provenance stamp,
+  the sim driver's warmup cursor, the retrace sentinel, the bench gates)
+  can prove a window ran warm by reading it twice.
+- :func:`install_monitoring` — hooks ``jax.monitoring`` duration events
+  where the runtime exposes them, so compiles from *un-wrapped* callsites
+  (library internals, future code that forgets the wrapper) are counted
+  rather than silently missed.
+
+Each compile/retrace also lands as a ``jit.compile`` span on the flight
+recorder (Chrome-trace export + the metrics bridge feeds
+``karpenter_jit_compile_seconds``), and bumps
+``karpenter_jit_compiles_total{family,kind}``.
+
+Compile wall is measured as the first call with a new signature — trace +
+compile + one execution. That overstates pure-XLA-compile time by one
+kernel run, which is noise at the ~100ms-to-seconds compile scale this
+ledger exists to attribute; the ``jax.monitoring`` hook reports the
+runtime's own backend-compile durations beside it where available.
+
+``KARPENTER_TPU_JITWATCH=0`` kills the layer: wrapped functions forward
+straight to their plain jitted form (one env read of overhead), nothing
+is recorded, and the metric families stay absent from ``/metrics``.
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+import threading
+import time
+import traceback
+from collections import deque
+from typing import Optional
+
+#: bounded compile-event history (a healthy process compiles tens of
+#: programs, not thousands; a runaway retrace storm must not grow memory)
+EVENTS_CAP = 1024
+
+
+def enabled() -> bool:
+    return os.environ.get("KARPENTER_TPU_JITWATCH", "1") != "0"
+
+
+# ---------------------------------------------------------------------------
+# the ledger
+# ---------------------------------------------------------------------------
+
+class FamilyRecord:
+    """Per-program-family accounting. Plain mutable holder; the ledger's
+    lock guards every access."""
+
+    __slots__ = ("name", "callsite", "compiles", "retraces", "hits",
+                 "compile_ms_total", "last_compile_ms", "signatures",
+                 "last_sig", "last_change", "dispatch_bytes_total",
+                 "last_arg_bytes")
+
+    def __init__(self, name: str, callsite: str):
+        self.name = name
+        self.callsite = callsite
+        self.compiles = 0          # first trace of a brand-new family
+        self.retraces = 0          # additional signatures after the first
+        self.hits = 0              # calls served by an already-traced sig
+        self.compile_ms_total = 0.0
+        self.last_compile_ms = 0.0
+        self.signatures: dict = {}  # sig -> call count
+        self.last_sig = None
+        self.last_change = ""      # retrace attribution of the last trace
+        self.dispatch_bytes_total = 0
+        self.last_arg_bytes = 0
+
+    def as_dict(self) -> dict:
+        return {
+            "family": self.name,
+            "callsite": self.callsite,
+            "compiles": self.compiles,
+            "retraces": self.retraces,
+            "hits": self.hits,
+            "signatures": len(self.signatures),
+            "compile_ms_total": round(self.compile_ms_total, 1),
+            "last_compile_ms": round(self.last_compile_ms, 1),
+            "last_change": self.last_change,
+            "dispatch_bytes_total": int(self.dispatch_bytes_total),
+            "last_arg_bytes": int(self.last_arg_bytes),
+        }
+
+
+class JitLedger:
+    """Process-wide compile/retrace ledger (one per process, like the
+    metrics registry). Thread-safe; every read returns plain data."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._families: dict[str, FamilyRecord] = {}
+        self._events: deque = deque(maxlen=EVENTS_CAP)
+        self._seq = 0               # monotonic compile counter
+        #: jax.monitoring observations: event key -> [count, total_secs]
+        self._monitor: dict[str, list] = {}
+
+    # -- recording ----------------------------------------------------------
+    def family(self, name: str, callsite: str = "") -> FamilyRecord:
+        with self._lock:
+            rec = self._families.get(name)
+            if rec is None:
+                rec = self._families[name] = FamilyRecord(name, callsite)
+            elif callsite and not rec.callsite:
+                rec.callsite = callsite
+            return rec
+
+    def record_hit(self, name: str, sig, nbytes: int = 0) -> None:
+        with self._lock:
+            rec = self._families.get(name)
+            if rec is None:
+                rec = self._families[name] = FamilyRecord(name, "")
+            rec.hits += 1
+            if sig is not None:
+                rec.signatures[sig] = rec.signatures.get(sig, 0) + 1
+            rec.dispatch_bytes_total += nbytes
+            if nbytes:
+                rec.last_arg_bytes = nbytes
+
+    def record_compile(self, name: str, sig, wall_ms: float, changed: str,
+                       nbytes: int = 0, callsite: str = "") -> dict:
+        """One new trace of ``name``: returns the event dict (also kept in
+        the bounded event ring and counted on the metric family)."""
+        with self._lock:
+            rec = self._families.get(name)
+            if rec is None:
+                rec = self._families[name] = FamilyRecord(name, callsite)
+            kind = "compile" if not rec.signatures else "retrace"
+            if kind == "compile":
+                rec.compiles += 1
+            else:
+                rec.retraces += 1
+            rec.signatures[sig] = 1
+            rec.last_sig = sig
+            rec.last_change = changed
+            rec.compile_ms_total += wall_ms
+            rec.last_compile_ms = wall_ms
+            rec.dispatch_bytes_total += nbytes
+            if nbytes:
+                rec.last_arg_bytes = nbytes
+            self._seq += 1
+            event = {
+                "seq": self._seq,
+                "family": name,
+                "kind": kind,
+                "wall_ms": round(wall_ms, 1),
+                "changed": changed,
+                "at_unix": round(time.time(), 3),
+            }
+            self._events.append(event)
+        _TLS.compiles = getattr(_TLS, "compiles", 0) + 1
+        try:
+            from ..metrics import JIT_COMPILES
+
+            JIT_COMPILES.inc(family=name, kind=kind)
+        except Exception:
+            pass
+        return event
+
+    def note_monitor(self, key: str, secs: float) -> None:
+        with self._lock:
+            cell = self._monitor.setdefault(key, [0, 0.0])
+            cell[0] += 1
+            cell[1] += secs
+
+    # -- reading ------------------------------------------------------------
+    def seq(self) -> int:
+        """The monotonic compile counter: reading it twice bounds a
+        window's compile count (0 delta == the window ran warm)."""
+        with self._lock:
+            return self._seq
+
+    def events_since(self, seq: int) -> list[dict]:
+        with self._lock:
+            return [dict(e) for e in self._events if e["seq"] > seq]
+
+    def compiles_total(self) -> int:
+        with self._lock:
+            return sum(
+                r.compiles + r.retraces for r in self._families.values()
+            )
+
+    def snapshot(self) -> dict:
+        """JSON-ready ledger state: the ``/debug/device`` page's core, the
+        ``obs device`` CLI's input, and the sim report's device plane."""
+        with self._lock:
+            return {
+                "enabled": enabled(),
+                "seq": self._seq,
+                "families": {
+                    name: rec.as_dict()
+                    for name, rec in sorted(self._families.items())
+                },
+                "events": [dict(e) for e in self._events],
+                "monitoring": {
+                    k: {"count": c, "total_s": round(s, 3)}
+                    for k, (c, s) in sorted(self._monitor.items())
+                },
+            }
+
+    def live_arg_bytes(self) -> dict:
+        """{family: last_arg_bytes} for families with a footprint — the
+        cheap accessor the per-tick gauge export uses (no event-ring
+        copy; ``snapshot()`` is for pages and artifacts)."""
+        with self._lock:
+            return {
+                name: rec.last_arg_bytes
+                for name, rec in self._families.items()
+                if rec.last_arg_bytes
+            }
+
+    def dispatch_bytes(self) -> dict:
+        """{family: cumulative dispatch bytes}, nonzero families only."""
+        with self._lock:
+            return {
+                name: rec.dispatch_bytes_total
+                for name, rec in self._families.items()
+                if rec.dispatch_bytes_total
+            }
+
+    def top_retracers(self, n: int = 8) -> list[dict]:
+        with self._lock:
+            recs = sorted(
+                self._families.values(),
+                key=lambda r: (-r.retraces, -r.compiles, r.name),
+            )
+            return [r.as_dict() for r in recs[:n] if r.retraces or r.compiles]
+
+    def reset(self) -> None:
+        """Tests only: a fresh process-equivalent ledger."""
+        with self._lock:
+            self._families.clear()
+            self._events.clear()
+            self._seq = 0
+            self._monitor.clear()
+
+
+_LEDGER = JitLedger()
+
+#: per-thread compile counter: a solve's provenance stamp must count ITS
+#: OWN compiles, not a concurrent screen's on another thread (the ledger
+#: seq is process-global; a warm solve overlapping someone else's compile
+#: would otherwise stamp compiles>0 and read as cold)
+_TLS = threading.local()
+
+
+def ledger() -> JitLedger:
+    return _LEDGER
+
+
+def thread_compiles() -> int:
+    """Compiles recorded on the CALLING thread so far — read twice to
+    bound one code window's own compile count."""
+    return getattr(_TLS, "compiles", 0)
+
+
+# ---------------------------------------------------------------------------
+# jax.monitoring hook: compiles from un-wrapped callsites
+# ---------------------------------------------------------------------------
+
+_monitor_installed = False
+_monitor_lock = threading.Lock()
+
+
+def install_monitoring() -> bool:
+    """Register a ``jax.monitoring`` duration listener that folds every
+    compile-flavored runtime event into the ledger. Idempotent; returns
+    whether a listener is installed (older runtimes without the API
+    return False — the tracked_jit signature ledger still works)."""
+    global _monitor_installed
+    with _monitor_lock:
+        if _monitor_installed:
+            return True
+        try:
+            from jax import monitoring as _m
+
+            register = getattr(
+                _m, "register_event_duration_secs_listener", None
+            )
+            if register is None:
+                return False
+
+            def _listener(key: str, secs: float, **kw) -> None:
+                if not enabled():
+                    return
+                if "compil" in key or "trace" in key.split("/")[-1]:
+                    _LEDGER.note_monitor(key, float(secs))
+
+            register(_listener)
+            _monitor_installed = True
+            return True
+        except Exception:
+            return False
+
+
+# ---------------------------------------------------------------------------
+# tracked_jit
+# ---------------------------------------------------------------------------
+
+def _trace_state_clean() -> bool:
+    """True when the calling thread is NOT inside a jax trace. Runtimes
+    without the API report clean (recording proceeds; nested phantom
+    events are then only guarded by the enclosing wrapper's own event)."""
+    try:
+        import jax
+
+        return bool(jax.core.trace_state_clean())
+    except Exception:
+        return True
+
+
+def _leaf_sig(leaf) -> tuple:
+    shape = getattr(leaf, "shape", None)
+    if shape is not None:
+        return (tuple(shape), str(getattr(leaf, "dtype", "?")))
+    # dynamic python scalars trace by dtype, not value (weak types): the
+    # signature must not call a changing n_pre int a retrace
+    return (type(leaf).__name__,)
+
+
+def _leaf_bytes(leaf) -> int:
+    n = getattr(leaf, "nbytes", None)
+    return int(n) if isinstance(n, (int,)) else 0
+
+
+def _describe_change(prev, cur) -> str:
+    """Human-readable retrace attribution: WHICH signature axis moved.
+    ``prev``/``cur`` are (treedef, leaf_sigs, statics) triples."""
+    if prev is None:
+        return "first trace"
+    if prev[0] != cur[0]:
+        return "pytree structure changed"
+    bits: list[str] = []
+    pl, cl = prev[1], cur[1]
+    if len(pl) != len(cl):
+        return f"leaf count {len(pl)} -> {len(cl)}"
+    for i, (a, b) in enumerate(zip(pl, cl)):
+        if a == b:
+            continue
+        if len(a) == 2 and len(b) == 2 and a[1] != b[1]:
+            bits.append(f"leaf{i}.dtype {a[1]} -> {b[1]}")
+        elif len(a) == 2 and len(b) == 2:
+            sa, sb = a[0], b[0]
+            if len(sa) == len(sb):
+                for ax, (da, db) in enumerate(zip(sa, sb)):
+                    if da != db:
+                        bits.append(f"leaf{i}.shape[{ax}] {da} -> {db}")
+            else:
+                bits.append(f"leaf{i}.shape {sa} -> {sb}")
+        else:
+            bits.append(f"leaf{i} {a} -> {b}")
+    ps, cs = dict(prev[2]), dict(cur[2])
+    for k in sorted(set(ps) | set(cs)):
+        if ps.get(k) != cs.get(k):
+            bits.append(f"static {k}: {ps.get(k)!r} -> {cs.get(k)!r}")
+    return "; ".join(bits[:6]) or "signature changed"
+
+
+def _callsite_of(fn) -> str:
+    try:
+        code = fn.__code__
+        return f"{os.path.basename(code.co_filename)}:{code.co_firstlineno}"
+    except Exception:
+        return ""
+
+
+def _compile_backtrace(depth: int = 4) -> str:
+    """Short summary of who triggered the first compile (the ledger's
+    first-compile backtrace): the innermost non-jitwatch frames."""
+    frames = traceback.extract_stack()[:-2]
+    keep = [
+        f"{os.path.basename(f.filename)}:{f.lineno}:{f.name}"
+        for f in frames
+        if "jitwatch" not in f.filename
+    ]
+    return " <- ".join(reversed(keep[-depth:]))
+
+
+class _TrackedJit:
+    """The wrapper ``tracked_jit`` returns: behaves exactly like the
+    jitted function, with the ledger fold on every call."""
+
+    def __init__(self, fn, family: str, jit_kwargs: dict):
+        import jax
+
+        self.family = family
+        self.__wrapped__ = fn
+        self._jit = jax.jit(fn, **jit_kwargs)
+        self._static = tuple(jit_kwargs.get("static_argnames") or ())
+        # bound lazily: inspect.signature pays once, only when statics can
+        # arrive positionally (compact_plan(placed, E) style calls)
+        self._pysig = inspect.signature(fn) if self._static else None
+        self._lock = threading.Lock()
+        self._seen: set = set()
+        self._last_sig = None
+        self._callsite = _callsite_of(fn)
+
+    # jax's jitted functions expose lower/trace etc.; forward unknowns so
+    # the wrapper stays a drop-in
+    def __getattr__(self, name):
+        return getattr(self._jit, name)
+
+    def _signature(self, args, kwargs) -> tuple[tuple, int]:
+        import jax
+
+        if self._static:
+            bound = self._pysig.bind(*args, **kwargs)
+            bound.apply_defaults()
+            statics = tuple(
+                (k, bound.arguments.get(k)) for k in self._static
+            )
+            dynamic = {
+                k: v for k, v in bound.arguments.items()
+                if k not in self._static
+            }
+            leaves, treedef = jax.tree_util.tree_flatten(dynamic)
+        else:
+            statics = ()
+            leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+        sigs = tuple(_leaf_sig(leaf) for leaf in leaves)
+        nbytes = sum(_leaf_bytes(leaf) for leaf in leaves)
+        return (treedef, sigs, statics), nbytes
+
+    def __call__(self, *args, **kwargs):
+        if not enabled():
+            return self._jit(*args, **kwargs)
+        if not _trace_state_clean():
+            # called UNDER an enclosing jax trace (mesh.solve_shard
+            # tracing calls ffd_solve with tracers): recording here would
+            # log a phantom compile whose wall is already inside the
+            # enclosing family's event AND poison the signature set — a
+            # later REAL standalone compile of the same shapes would then
+            # read as a hit and the zero-retrace gates would pass falsely.
+            # The enclosing tracked wrapper owns this compile's event.
+            return self._jit(*args, **kwargs)
+        if not _monitor_installed:      # lock-free fast path; see install
+            install_monitoring()
+        try:
+            sig, nbytes = self._signature(args, kwargs)
+            hashable = True
+            # check-and-claim in ONE lock block: two threads racing the
+            # same new signature must produce exactly one compile event —
+            # the loser records a hit (a doubled event would fail the
+            # hard zero-retrace gates as a phantom retrace)
+            with self._lock:
+                known = sig in self._seen
+                if not known:
+                    prev = self._last_sig
+                    self._seen.add(sig)
+                    self._last_sig = sig
+        except Exception:
+            # an unhashable static / exotic pytree must never take down the
+            # dispatch it observes
+            sig, nbytes, known, hashable = None, 0, True, False
+        if known:
+            _LEDGER.record_hit(self.family, sig if hashable else None, nbytes)
+            return self._jit(*args, **kwargs)
+        # new signature: this call traces (and compiles on a cache miss
+        # of jax's own); time it and attribute the changed axis
+        changed = _describe_change(prev, sig)
+        from .spans import span as _span
+
+        t0 = time.perf_counter()
+        with _span("jit.compile", family=self.family,
+                   kind=("compile" if prev is None else "retrace"),
+                   changed=changed):
+            out = self._jit(*args, **kwargs)
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        _LEDGER.record_compile(
+            self.family, sig, wall_ms, changed, nbytes=nbytes,
+            callsite=self._callsite or _compile_backtrace(),
+        )
+        return out
+
+
+def tracked_jit(fn=None, *, family: Optional[str] = None, **jit_kwargs):
+    """``jax.jit`` with the ledger fold. Use as a decorator
+    (``@tracked_jit(family="screen.repack")``), a decorator factory with
+    jit options (``static_argnames`` / ``donate_argnums`` pass through),
+    or a direct call (``tracked_jit(impl, family="ffd.solve", ...)``)."""
+    if fn is None:
+        return lambda f: tracked_jit(f, family=family, **jit_kwargs)
+    fam = family or getattr(fn, "__name__", "anonymous")
+    return _TrackedJit(fn, fam, jit_kwargs)
+
+
+def note_dispatch(family: str, nbytes: int) -> None:
+    """Fold link bytes a non-jit path shipped for ``family`` (the sidecar's
+    server-side device cache, the solver's upload path) into the ledger's
+    per-family dispatch accounting. No-op when jitwatch is off."""
+    if not enabled():
+        return
+    _LEDGER.record_hit(family, None, int(nbytes))

@@ -107,6 +107,11 @@ class ProvenanceRecord:
     # so a latency number can never again be silent about whether the
     # fast answer was also a good one
     quality: dict = field(default_factory=dict)
+    # jitwatch ledger compiles that fired DURING this record's window
+    # (trace/jitwatch.py): 0 proves the measurement ran warm — a bench row
+    # can no longer launder a cold compile into a steady-state number.
+    # None = jitwatch disabled / the producer predates the ledger.
+    compiles: Optional[int] = None
 
     def as_dict(self) -> dict:
         d = {
@@ -130,6 +135,8 @@ class ProvenanceRecord:
             d["context"] = dict(self.context)
         if self.quality:
             d["quality"] = dict(self.quality)
+        if self.compiles is not None:
+            d["compiles"] = int(self.compiles)
         return d
 
     def label(self) -> str:
@@ -220,10 +227,12 @@ def solve_record(
         v = timings.get("residency")
         if isinstance(v, str):
             residency = v
+    compiles = timings.get("compiles")
     return record(ProvenanceRecord(
         kind="solve", device=device, device_count=count, backend=backend,
         fallback=fallback, scale=scale, phases_ms=phases, wall_ms=wall_ms,
         residency=residency,
+        compiles=int(compiles) if isinstance(compiles, int) else None,
     ))
 
 

@@ -1,0 +1,639 @@
+"""Device-plane observatory tests: the jitwatch compile/retrace ledger,
+the tracked_jit wrapper's zero-retrace contract, the retrace sentinel,
+the device accountant/CLI, and the bench-gate red-then-green proof.
+
+The ledger is process-global (like the metrics registry), so every test
+reads it through seq() cursors and unique family names instead of
+assuming a fresh ledger.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from karpenter_provider_aws_tpu.trace import jitwatch
+from karpenter_provider_aws_tpu.trace.jitwatch import ledger, tracked_jit
+
+_uniq = itertools.count()
+
+
+def _family(prefix: str = "test") -> str:
+    return f"{prefix}.fam{next(_uniq)}"
+
+
+# ---------------------------------------------------------------------------
+# the ledger + wrapper
+# ---------------------------------------------------------------------------
+
+class TestTrackedJit:
+    def test_compile_hit_retrace_accounting(self):
+        fam = _family()
+
+        @tracked_jit(family=fam, static_argnames=("k",))
+        def fn(a, k=1):
+            return a * k
+
+        x = np.ones((8, 2), np.float32)
+        seq0 = ledger().seq()
+        fn(x, k=2)                      # compile
+        fn(x, k=2)                      # hit
+        fn(x, k=3)                      # retrace: static changed
+        fn(np.ones((16, 2), np.float32), k=3)   # retrace: shape changed
+        fn(x, k=3)                      # hit (sig already traced)
+        assert ledger().seq() - seq0 == 3
+        rec = ledger().snapshot()["families"][fam]
+        assert rec["compiles"] == 1
+        assert rec["retraces"] == 2
+        assert rec["hits"] == 2
+        assert rec["signatures"] == 3
+        assert rec["compile_ms_total"] > 0
+
+    def test_retrace_attribution_names_the_changed_axis(self):
+        fam = _family()
+
+        @tracked_jit(family=fam, static_argnames=("k",))
+        def fn(a, b, k=1):
+            return a + b * k
+
+        x = np.ones((4, 3), np.float32)
+        fn(x, x, k=2)
+        fn(x, x, k=5)
+        rec = ledger().snapshot()["families"][fam]
+        assert "static k: 2 -> 5" in rec["last_change"]
+        fn(np.ones((9, 3), np.float32), np.ones((9, 3), np.float32), k=5)
+        rec = ledger().snapshot()["families"][fam]
+        assert "shape[0] 4 -> 9" in rec["last_change"]
+
+    def test_dynamic_python_scalar_is_not_a_retrace(self):
+        """A traced python int (n_pre-style) retraces by TYPE, never by
+        value — jit's weak-type rule; a changing value must not read as
+        a broken ladder."""
+        fam = _family()
+
+        @tracked_jit(family=fam)
+        def fn(a, n):
+            return a + n
+
+        x = np.ones(4, np.float32)
+        fn(x, 1)
+        seq0 = ledger().seq()
+        fn(x, 2)
+        fn(x, 17)
+        assert ledger().seq() == seq0
+        assert ledger().snapshot()["families"][fam]["hits"] == 2
+
+    def test_positional_static_argument(self):
+        """compact_plan-style call: the static arg arrives positionally;
+        the signature must still split it out by name."""
+        fam = _family()
+
+        @tracked_jit(family=fam, static_argnames=("width",))
+        def fn(a, width):
+            return a[:width]
+
+        x = np.arange(8, dtype=np.int32)
+        fn(x, 4)
+        seq0 = ledger().seq()
+        fn(x, 4)                 # same static positionally -> hit
+        assert ledger().seq() == seq0
+        fn(x, 6)                 # changed static -> retrace
+        assert ledger().seq() == seq0 + 1
+        rec = ledger().snapshot()["families"][fam]
+        assert "static width: 4 -> 6" in rec["last_change"]
+
+    def test_events_ride_chrome_trace_and_metrics(self):
+        from karpenter_provider_aws_tpu.metrics import JIT_COMPILES
+        from karpenter_provider_aws_tpu.trace.spans import TRACER
+
+        fam = _family()
+
+        @tracked_jit(family=fam)
+        def fn(a):
+            return a + 1
+
+        before = JIT_COMPILES.value(family=fam, kind="compile")
+        fn(np.ones(3, np.float32))
+        assert JIT_COMPILES.value(family=fam, kind="compile") == before + 1
+        names = [
+            (s.name, s.attrs.get("family")) for s in TRACER.snapshot()
+        ]
+        assert ("jit.compile", fam) in names
+
+    def test_ladder_growth_is_exactly_one_compile_for_one_family(self):
+        """The zero-retrace contract's growth clause: crossing ONE
+        {2^k, 1.5*2^k} ladder boundary compiles exactly one new program
+        for exactly the affected family — sibling families stay warm."""
+        from karpenter_provider_aws_tpu.ops.device_state import _ladder_bucket
+
+        fam_screen = _family("ladder")
+        fam_other = _family("ladder")
+
+        @tracked_jit(family=fam_screen)
+        def screen(free):
+            return free.sum(axis=1)
+
+        @tracked_jit(family=fam_other)
+        def other(v):
+            return v * 2
+
+        def sweep(n):
+            nb = _ladder_bucket(n)
+            buf = np.zeros((nb, 4), np.float32)
+            screen(buf)
+            other(np.ones(8, np.float32))
+
+        sweep(300)               # bucket 384: compiles both families
+        seq0 = ledger().seq()
+        sweep(310)               # same bucket: fully warm
+        sweep(384)               # still bucket 384
+        assert ledger().seq() == seq0
+        sweep(385)               # crosses 384 -> 512
+        events = ledger().events_since(seq0)
+        assert len(events) == 1
+        assert events[0]["family"] == fam_screen
+        assert "384 -> 512" in events[0]["changed"]
+        seq1 = ledger().seq()
+        sweep(510)               # inside the new bucket: warm again
+        assert ledger().seq() == seq1
+
+    def test_kill_switch_records_nothing_and_metrics_stay_absent(self, monkeypatch):
+        from karpenter_provider_aws_tpu.metrics import REGISTRY
+
+        monkeypatch.setenv("KARPENTER_TPU_JITWATCH", "0")
+        fam = _family("killed")
+
+        @tracked_jit(family=fam)
+        def fn(a):
+            return a - 1
+
+        seq0 = ledger().seq()
+        fn(np.ones((5, 5), np.float32))
+        fn(np.ones((7, 5), np.float32))
+        assert ledger().seq() == seq0
+        assert fam not in ledger().snapshot()["families"]
+        assert fam not in REGISTRY.expose()
+        # flipping the switch back on mid-process resumes recording
+        monkeypatch.delenv("KARPENTER_TPU_JITWATCH")
+        fn(np.ones((9, 5), np.float32))
+        assert ledger().seq() == seq0 + 1
+
+    def test_nested_trace_records_no_phantom_and_never_poisons(self):
+        """A tracked fn invoked UNDER another tracked fn's trace (the
+        mesh wrappers call ffd_solve/repack_check with tracers) must not
+        log a phantom compile — and, critically, must not poison its
+        signature set: a later REAL standalone compile of the same
+        shapes has to register as a compile, not a hit, or the
+        zero-retrace gates pass falsely."""
+        inner_fam = _family("nested")
+        outer_fam = _family("nested")
+
+        @tracked_jit(family=inner_fam)
+        def inner(a):
+            return a * 2
+
+        @tracked_jit(family=outer_fam)
+        def outer(a):
+            return inner(a) + 1
+
+        x = np.ones((11, 3), np.float32)
+        seq0 = ledger().seq()
+        outer(x)
+        events = ledger().events_since(seq0)
+        assert [e["family"] for e in events] == [outer_fam]
+        # the standalone call now genuinely compiles AND is recorded
+        seq1 = ledger().seq()
+        inner(x)
+        events = ledger().events_since(seq1)
+        assert [e["family"] for e in events] == [inner_fam]
+
+    def test_note_dispatch_folds_link_bytes(self):
+        fam = _family("bytes")
+        jitwatch.note_dispatch(fam, 1024)
+        jitwatch.note_dispatch(fam, 4096)
+        rec = ledger().snapshot()["families"][fam]
+        assert rec["dispatch_bytes_total"] == 5120
+        assert rec["last_arg_bytes"] == 4096
+
+
+# ---------------------------------------------------------------------------
+# tier-1 /metrics guard: two identical reconciles compile nothing new
+# ---------------------------------------------------------------------------
+
+def _jit_compiles_from_metrics(text: str) -> float:
+    total = 0.0
+    for line in text.splitlines():
+        if line.startswith("karpenter_jit_compiles_total{"):
+            total += float(line.rsplit(" ", 1)[1])
+    return total
+
+
+class TestZeroRetraceReconcile:
+    def test_two_identical_disruption_reconciles_compile_nothing(self):
+        """The PR 6/7 cache-guard pattern on the compile plane: pass 1
+        may compile (first ladder buckets of this fleet shape); pass 2
+        sees an identical cluster and must add ZERO ledger compiles,
+        visible at /metrics over HTTP."""
+        from tests.test_encode_incremental import _add_node
+
+        from karpenter_provider_aws_tpu.metrics import REGISTRY
+        from karpenter_provider_aws_tpu.models.pod import make_pods
+        from karpenter_provider_aws_tpu.testenv import new_environment
+
+        env = new_environment(use_tpu_solver=False)
+        pool, _ = env.apply_defaults()
+        pool.disruption.consolidate_after_s = 60
+        pool.disruption.consolidation_policy = "WhenUnderutilized"
+        pool.disruption.budgets = ["0%"]  # decide-only: identical pass 2
+        for i in range(4):
+            node, _ = _add_node(env.cluster, env.catalog, i)
+            for p in make_pods(2, f"jw{i}", {"cpu": "250m",
+                                             "memory": "512Mi"}):
+                env.cluster.apply(p)
+                env.cluster.bind_pod(p.uid, node.name)
+        env.clock.advance(120)
+
+        port = REGISTRY.serve(0)
+        try:
+            env.disruption.reconcile()   # pass 1: may compile buckets
+            body1 = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics").read().decode()
+            seq1 = ledger().seq()
+            env.disruption.reconcile()   # pass 2: identical -> warm
+            body2 = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics").read().decode()
+        finally:
+            REGISTRY.stop()
+            env.close()
+        assert ledger().seq() == seq1, (
+            f"identical reconcile recompiled: "
+            f"{ledger().events_since(seq1)}"
+        )
+        assert _jit_compiles_from_metrics(body2) == \
+            _jit_compiles_from_metrics(body1)
+
+
+# ---------------------------------------------------------------------------
+# the retrace sentinel
+# ---------------------------------------------------------------------------
+
+class _Recorder:
+    def __init__(self):
+        self.events = []
+
+    def publish(self, kind, name, reason, message, type=None):
+        self.events.append((kind, name, reason, message))
+
+
+class TestRetraceSentinel:
+    def _sentinel(self, recorder=None, warmup=0):
+        from karpenter_provider_aws_tpu.obs.sentinel import RetraceSentinel
+
+        s = RetraceSentinel(recorder=recorder, warmup_ticks=warmup)
+        s.reset()   # cursor to the live ledger's current seq
+        return s
+
+    def _compile_once(self, fam):
+        @tracked_jit(family=fam)
+        def fn(a):
+            return a + 1
+
+        fn(np.ones((3, 3), np.float32))
+
+    def test_single_ladder_growth_is_not_a_storm(self):
+        s = self._sentinel()
+        s.tick()
+        self._compile_once(_family("storm"))
+        assert s.tick() == []           # one compile, one tick: growth
+        assert s.summary()["findings"] == []
+
+    def test_consecutive_tick_compiles_fire_once_and_name_the_family(self):
+        s = self._sentinel()
+        fam = _family("storm")
+        self._compile_once(fam)
+        s.tick()
+        # second consecutive tick with a compile of the SAME family
+        @tracked_jit(family=fam)
+        def fn2(a):
+            return a * 3
+
+        fn2(np.ones((4, 4), np.float32))
+        new = s.tick()
+        assert len(new) == 1
+        assert new[0]["family"] == fam
+        assert new[0]["kind"] == "retrace-storm"
+        assert fam in new[0]["detail"]
+        # edge-triggered: the persisting episode does not re-fire
+        fn2(np.ones((5, 4), np.float32))
+        assert s.tick() == []
+        # calm tick re-arms; a fresh storm fires again
+        assert s.tick() == []
+        self._compile_once(fam)
+        s.tick()
+        fn2(np.ones((6, 4), np.float32))
+        assert len(s.tick()) == 1
+
+    def test_burst_of_signatures_in_one_tick_is_a_storm(self):
+        s = self._sentinel()
+        s.tick()
+        fam = _family("burst")
+
+        @tracked_jit(family=fam)
+        def fn(a):
+            return a + 2
+
+        for n in (3, 5, 7):          # 3 distinct sigs, one tick
+            fn(np.ones((n, 2), np.float32))
+        new = s.tick()
+        assert len(new) == 1 and new[0]["family"] == fam
+
+    def test_warmup_suppresses(self):
+        s = self._sentinel(warmup=99)
+        for _ in range(3):
+            fam = _family("warm")
+            self._compile_once(fam)
+            self._compile_once(fam + "b")
+            assert s.tick() == []
+
+    def test_publish_gating_covers_retrace_storms(self):
+        rec = _Recorder()
+        s = self._sentinel(recorder=rec)
+        s.publish_events = False
+        fam = _family("gated")
+        self._compile_once(fam)
+        s.tick()
+
+        @tracked_jit(family=fam)
+        def fn2(a):
+            return a * 7
+
+        fn2(np.ones((2, 2), np.float32))
+        new = s.tick()
+        assert len(new) == 1             # the finding still lands...
+        assert rec.events == []          # ...but no event is published
+        # with publishing on, the same pattern emits DeviceRetraceStorm
+        s2 = self._sentinel(recorder=rec)
+        self._compile_once(fam + "x")
+        s2.tick()
+        fn3 = tracked_jit(lambda a: a - 1, family=fam + "x")
+        fn3(np.ones((2, 3), np.float32))
+        s2.tick()
+        assert any(r[2] == "DeviceRetraceStorm" for r in rec.events)
+
+    def test_obs_bundle_ticks_and_resets_the_retrace_sentinel(self):
+        from karpenter_provider_aws_tpu import obs as obs_mod
+
+        bundle = obs_mod.Obs()
+        assert bundle.retrace is not None
+        bundle.tick(now=1.0)
+        assert bundle.retrace.summary()["ticks"] == 1
+        bundle.reset()
+        assert bundle.retrace.summary()["ticks"] == 0
+
+
+class TestSteadyStateSentinelCompileGrace:
+    """Jurisdiction between the two sentinels: jit.compile spans never
+    enter the wall sentinel's attribution (they are nested inside their
+    dispatching span), and a compile-dominated tick is skipped outright
+    — the retrace sentinel owns the compile plane."""
+
+    def _sentinel(self, profiles):
+        from karpenter_provider_aws_tpu.obs.sentinel import (
+            SteadyStateSentinel,
+        )
+
+        it = iter(profiles)
+        return SteadyStateSentinel(
+            profile_source=lambda: next(it), warmup_ticks=1,
+        )
+
+    @staticmethod
+    def _profile(**totals):
+        return {"spans": {
+            name: {"count": 1, "total_ms": ms}
+            for name, ms in totals.items()
+        }}
+
+    def test_jit_spans_never_enter_shares(self):
+        s = self._sentinel([
+            self._profile(**{"solve.device": 100.0, "jit.compile": 900.0}),
+        ])
+        s.tick(now=1.0)
+        assert "jit" not in s.last_tick.get("shares", {})
+
+    def test_compile_dominated_tick_is_skipped(self):
+        def prof(liveness, screen, jit):
+            return self._profile(**{
+                "controller.liveness": liveness,
+                "consolidate.screen": screen,
+                "jit.compile": jit,
+            })
+
+        # warm baseline ticks (~100ms), then a tick where a 600ms compile
+        # inflates the screen to a would-be attribution-shift + blowup
+        s = self._sentinel([
+            prof(80.0, 20.0, 0.0),
+            prof(160.0, 40.0, 0.0),
+            prof(240.0, 60.0, 0.0),
+            prof(320.0, 80.0, 0.0),
+            prof(400.0, 1800.0, 600.0),   # compile tick: grace, no page
+        ])
+        for i in range(4):
+            assert s.tick(now=float(i)) == []
+        assert s.tick(now=9.0) == []
+        assert s.last_tick.get("compile_grace_ms") == 600.0
+
+
+# ---------------------------------------------------------------------------
+# device accountant + CLI round-trip
+# ---------------------------------------------------------------------------
+
+class TestDeviceAccountant:
+    def test_summary_shape_and_rendering(self):
+        from karpenter_provider_aws_tpu.obs.device import (
+            DeviceAccountant,
+            device_summary,
+            render_device,
+        )
+
+        fam = _family("acct")
+
+        @tracked_jit(family=fam)
+        def fn(a):
+            return a.sum()
+
+        fn(np.ones((16, 8), np.float32))
+        acct = DeviceAccountant()
+        assert acct.live_bytes().get(fam) == 16 * 8 * 4
+        summary = device_summary()
+        assert fam in summary["jitwatch"]["families"]
+        assert summary["hbm_watermark_bytes"] >= 16 * 8 * 4
+        text = render_device(summary)
+        assert fam in text
+        json.dumps(summary, default=str)   # JSON-ready
+
+    def test_live_bytes_gauge_exported(self):
+        from karpenter_provider_aws_tpu.metrics import DEVICE_LIVE_BYTES
+        from karpenter_provider_aws_tpu.obs.device import DeviceAccountant
+
+        fam = _family("gauge")
+
+        @tracked_jit(family=fam)
+        def fn(a):
+            return a * 2
+
+        fn(np.ones((32, 4), np.float32))
+        DeviceAccountant().export()
+        assert DEVICE_LIVE_BYTES.value(family=fam) == 32 * 4 * 4
+
+    def test_cli_round_trips_a_snapshot_file(self, tmp_path, capsys):
+        from karpenter_provider_aws_tpu.obs.__main__ import main
+        from karpenter_provider_aws_tpu.obs.device import device_summary
+
+        fam = _family("cli")
+
+        @tracked_jit(family=fam)
+        def fn(a):
+            return a + 4
+
+        fn(np.ones((6, 6), np.float32))
+        path = tmp_path / "device.json"
+        path.write_text(json.dumps(device_summary(), default=str))
+        assert main(["device", "--snapshot-file", str(path)]) == 0
+        out = capsys.readouterr().out
+        assert fam in out
+        assert "jitwatch ledger" in out
+        # and from a fleet-report-shaped document (wall.device plane)
+        report = {"wall": {"device": {
+            "enabled": True,
+            "families": {fam: {"family": fam, "compiles": 1, "retraces": 0,
+                               "hits": 0, "signatures": 1,
+                               "compile_ms_total": 1.0,
+                               "last_compile_ms": 1.0, "last_change": "",
+                               "dispatch_bytes_total": 0,
+                               "last_arg_bytes": 0}},
+        }}}
+        path2 = tmp_path / "report.json"
+        path2.write_text(json.dumps(report))
+        assert main(["device", "--snapshot-file", str(path2)]) == 0
+        assert fam in capsys.readouterr().out
+
+    def test_cli_exit_3_on_empty_observatory(self, tmp_path, capsys):
+        from karpenter_provider_aws_tpu.obs.__main__ import main
+
+        path = tmp_path / "empty.json"
+        path.write_text(json.dumps({"jitwatch": {"families": {}}}))
+        assert main(["device", "--snapshot-file", str(path)]) == 3
+        capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# the bench gate: red-then-green on a bucket-busting steady state
+# ---------------------------------------------------------------------------
+
+def _measured_steady_window(fam: str, sizes) -> int:
+    """The scale_bench pattern in miniature: run the 'steady' repeats,
+    counting ledger compiles inside the measured window only (the first
+    call is the sanctioned cold compile)."""
+
+    @tracked_jit(family=fam)
+    def program(free):
+        return free.sum()
+
+    program(np.zeros((sizes[0], 4), np.float32))   # cold: outside window
+    seq0 = ledger().seq()
+    for n in sizes[1:]:
+        program(np.zeros((n, 4), np.float32))
+    return ledger().seq() - seq0
+
+
+class TestBenchGateSteadyStateRetraces:
+    BUDGET = {"rows": {"config9_100k_nodes": {"thresholds": {
+        "steady_state_retraces": {"equals": 0},
+    }}}}
+
+    def _gate(self, retraces: int):
+        import sys
+        sys.path.insert(0, "tools")
+        try:
+            from bench_gate import check
+        finally:
+            sys.path.remove("tools")
+        row = json.dumps({
+            "benchmark": "config9_100k_nodes",
+            "steady_state_retraces": retraces,
+        })
+        return check([row], self.BUDGET)
+
+    def test_red_bucket_busting_shapes_fail_the_gate(self):
+        """Deliberately unladdered sizes: every 'steady' pass presents a
+        fresh shape, the ledger counts each retrace, and the gate goes
+        red — the comment-enforced discipline is now CI-enforced."""
+        from karpenter_provider_aws_tpu.ops.device_state import _ladder_bucket
+
+        retraces = _measured_steady_window(
+            _family("bust"), [500, 501, 502, 503]   # raw N: no ladder
+        )
+        assert retraces == 3
+        failures = self._gate(retraces)
+        assert failures and "steady_state_retraces" in failures[0]["metric"]
+        # the same sizes THROUGH the ladder stay in one bucket: green
+        laddered = _measured_steady_window(
+            _family("laddered"),
+            [_ladder_bucket(n) for n in (500, 501, 502, 503)],
+        )
+        assert laddered == 0
+        assert self._gate(laddered) == []
+
+    def test_gate_red_on_missing_key(self):
+        import sys
+        sys.path.insert(0, "tools")
+        try:
+            from bench_gate import check
+        finally:
+            sys.path.remove("tools")
+        row = json.dumps({"benchmark": "config9_100k_nodes"})
+        failures = check([row], self.BUDGET)
+        assert failures  # absence of evidence must not pass a gate
+
+
+# ---------------------------------------------------------------------------
+# provenance: the compiles stamp
+# ---------------------------------------------------------------------------
+
+class TestProvenanceCompiles:
+    def test_as_dict_carries_compiles_only_when_known(self):
+        from karpenter_provider_aws_tpu.trace.provenance import (
+            ProvenanceRecord,
+        )
+
+        assert "compiles" not in ProvenanceRecord(kind="solve").as_dict()
+        rec = ProvenanceRecord(kind="solve", compiles=0)
+        assert rec.as_dict()["compiles"] == 0
+
+    def test_warm_solve_stamps_compiles_zero(self):
+        """Cold solves stamp their compile count; a repeated identical
+        solve (after the node-bucket right-sizing pass) stamps 0 — the
+        bench-row proof it ran warm."""
+        from karpenter_provider_aws_tpu.models.pod import make_pods
+        from karpenter_provider_aws_tpu.scheduling.solver import TPUSolver
+        from karpenter_provider_aws_tpu.testenv import new_environment
+
+        env = new_environment(use_tpu_solver=False)
+        try:
+            pool, _ = env.apply_defaults()
+            solver = TPUSolver()
+            pods = make_pods(24, "pv", {"cpu": "500m", "memory": "1Gi"})
+            results = [
+                solver.solve(pods, [pool], env.catalog) for _ in range(4)
+            ]
+            stamps = [r.provenance.as_dict().get("compiles")
+                      for r in results]
+            assert all(isinstance(s, int) for s in stamps)
+            assert stamps[-1] == 0, stamps
+        finally:
+            env.close()

@@ -1,0 +1,128 @@
+#!/usr/bin/env python3
+"""device_obs_smoke: the device-plane observatory's CI gate.
+
+Drives the smoke-500 simulated day with jitwatch armed (the default), then
+asserts the whole observatory loop closes:
+
+ 1. the fleet report's ``wall.device`` plane carries per-family compile
+    counts (an empty ledger means the wrappers came unwired);
+ 2. ``retraces_after_warmup == 0`` — the zero-retrace steady-state
+    contract, thresholded through the real ``tools/fleet_gate.py`` against
+    ``sim/baselines/smoke-500.json``;
+ 3. the retrace sentinel reports ZERO ``DeviceRetraceStorm`` findings over
+    the day's liveness ticks;
+ 4. the ``obs device`` CLI round-trips the saved snapshot (families render
+    from the file exactly as they counted in-process).
+
+Run via ``make device-obs-smoke`` (JAX_PLATFORMS=cpu).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if os.environ.get("KARPENTER_TPU_JITWATCH") == "0":
+        print("device-obs-smoke requires jitwatch armed "
+              "(unset KARPENTER_TPU_JITWATCH)", file=sys.stderr)
+        return 2
+
+    from karpenter_provider_aws_tpu.sim.driver import FleetSimulator
+
+    sim = FleetSimulator("smoke", seed=0)
+    report = sim.run()
+
+    failures: list[str] = []
+    device = report.data.get("wall", {}).get("device", {})
+    families = device.get("families", {})
+    if not families:
+        failures.append("wall.device.families is empty — jitwatch unwired?")
+    else:
+        print("per-family compile counts:")
+        for name, fam in sorted(families.items()):
+            print(f"  {name}: compiles={fam['compiles']} "
+                  f"retraces={fam['retraces']} hits={fam['hits']} "
+                  f"compile_ms={fam['compile_ms_total']}")
+
+    sentinel = device.get("sentinel", {})
+    storms = sentinel.get("findings", [])
+    if storms:
+        failures.append(f"retrace sentinel found {len(storms)} storms: "
+                        f"{[f.get('detail') for f in storms]}")
+    else:
+        print(f"retrace sentinel: 0 findings over {sentinel.get('ticks')} "
+              "ticks")
+
+    with tempfile.TemporaryDirectory() as td:
+        report_path = os.path.join(td, "report.json")
+        report.save(report_path)
+
+        # 2. the real fleet gate (retraces_after_warmup rides the baseline)
+        gate = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "fleet_gate.py"),
+             report_path, "--baseline",
+             os.path.join(REPO, "karpenter_provider_aws_tpu", "sim",
+                          "baselines", "smoke-500.json")],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        sys.stdout.write(gate.stdout)
+        sys.stderr.write(gate.stderr)
+        if gate.returncode != 0:
+            failures.append("fleet gate failed (see output above)")
+
+        # 4. obs device CLI round-trip against the saved artifact: the
+        # CLI must render the SAME families from the file (exit 3 = an
+        # empty observatory)
+        cli = subprocess.run(
+            [sys.executable, "-m", "karpenter_provider_aws_tpu.obs",
+             "device", "--snapshot-file", report_path],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        sys.stdout.write(cli.stdout)
+        if cli.returncode != 0:
+            failures.append(
+                f"obs device CLI exited {cli.returncode}: {cli.stderr}"
+            )
+        for name in families:
+            if name not in cli.stdout:
+                failures.append(
+                    f"obs device CLI round-trip lost family {name!r}"
+                )
+        cli_json = subprocess.run(
+            [sys.executable, "-m", "karpenter_provider_aws_tpu.obs",
+             "device", "--snapshot-file", report_path, "--json"],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        try:
+            parsed = json.loads(cli_json.stdout)
+            got = set((parsed.get("jitwatch") or parsed).get("families", {}))
+            if got != set(families):
+                failures.append(
+                    f"CLI JSON families {sorted(got)} != report "
+                    f"{sorted(families)}"
+                )
+        except json.JSONDecodeError as e:
+            failures.append(f"obs device --json did not emit JSON: {e}")
+
+    if failures:
+        print("device-obs-smoke FAILED:", file=sys.stderr)
+        for f in failures:
+            print(f"  [FAIL] {f}", file=sys.stderr)
+        return 1
+    print("device-obs-smoke passed: jitwatch armed, "
+          f"{len(families)} families, retraces_after_warmup="
+          f"{device.get('retraces_after_warmup')}, CLI round-trip OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
