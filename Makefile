@@ -4,7 +4,7 @@
 
 PYTEST ?= python -m pytest
 
-.PHONY: presubmit test deflake stress e2etests benchmark interruption-bench verify multichip native soak sidecar-client sim-smoke sim-sweep sim-cliff-smoke bench-gate bench-optimizer chaos-smoke sim-replica-smoke sim-provision-smoke fleet-obs-smoke device-obs-smoke
+.PHONY: presubmit test deflake stress e2etests benchmark interruption-bench verify multichip native soak sidecar-client sim-smoke sim-sweep sim-cliff-smoke bench-gate bench-optimizer bench-market market-smoke chaos-smoke sim-replica-smoke sim-provision-smoke fleet-obs-smoke device-obs-smoke
 
 presubmit: test multichip  ## everything CI gates on
 
@@ -69,6 +69,16 @@ bench-gate:  ## steady-state perf budgets (config9 tick + disruption quiet pass 
 bench-optimizer:  ## optimizer-lane evidence rows (config6 family) -> BENCH_DETAIL.jsonl, then the gate
 	JAX_PLATFORMS=cpu BENCH_FORCE_CPU=1 python bench.py --child=optimizer
 	$(MAKE) bench-gate
+
+bench-market:  ## cost-vs-oracle-under-moving-prices rows (cost_vs_oracle_market_* family) -> BENCH_DETAIL.jsonl, then the gate
+	JAX_PLATFORMS=cpu BENCH_FORCE_CPU=1 python bench.py --child=market
+	$(MAKE) bench-gate
+
+market-smoke:  ## 500-node market day (moving prices + a reserved-capacity window) fleet-gated: oracle-relative cost, zero sentinel findings, zero retraces after warmup
+	JAX_PLATFORMS=cpu python -m karpenter_provider_aws_tpu.sim run \
+		--trace market-day --seed 0 --report /tmp/fleet_report_market.json
+	python tools/fleet_gate.py /tmp/fleet_report_market.json \
+		--baseline karpenter_provider_aws_tpu/sim/baselines/market-500.json
 
 chaos-smoke:  ## every canned chaos scenario (incl. replica-loss), run twice, determinism diffed
 	JAX_PLATFORMS=cpu python -m karpenter_provider_aws_tpu.chaos --all --seed 0

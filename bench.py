@@ -644,6 +644,27 @@ def child_optimizer() -> None:
         run_optimizer(scale=scale, seeds=seeds, on_row=on_row)
 
 
+def child_market() -> None:
+    """Market-engine evidence rows (cost_vs_oracle_market_* family):
+    lane-armed solver vs the FFD oracle on the SAME MarketModel-walked
+    catalog, one solve pair per (seed, tick) across the three canned
+    MARKET scenarios. The market-day row is gated by
+    benchmarks/baselines/steady-state.json via `make bench-gate`."""
+    _force_cpu_if_asked()
+    import contextlib
+
+    _enable_jit_cache()
+
+    from benchmarks.market_bench import run_all as run_market
+
+    scale = float(os.environ.get("BENCH_MARKET_SCALE", "1.0"))
+    seeds = int(os.environ.get("BENCH_MARKET_SEEDS", "8"))
+    ticks = int(os.environ.get("BENCH_MARKET_TICKS", "4"))
+    on_row = _detail_writer({"run_at_unix": int(time.time()), "scale": scale})
+    with contextlib.redirect_stdout(sys.stderr):
+        run_market(scale=scale, seeds=seeds, ticks=ticks, on_row=on_row)
+
+
 def child_jit() -> None:
     """Compile-ledger rows (benchmarks/jit_bench.py): cold-vs-warm
     compile count and wall per program family off the jitwatch ledger —
@@ -990,6 +1011,7 @@ if __name__ == "__main__":
                  "disruption": child_disruption,
                  "provisioning": child_provisioning,
                  "optimizer": child_optimizer,
+                 "market": child_market,
                  "jit": child_jit}[child]()
             except Exception as e:
                 traceback.print_exc()

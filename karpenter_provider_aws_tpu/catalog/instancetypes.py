@@ -39,12 +39,31 @@ LOCAL_ZONE_PRICE_FACTOR = 1.2
 @dataclass(frozen=True)
 class Offering:
     """One purchasable (zone, capacity-type) slice of an instance type
-    (parity: cloudprovider.Offerings built at instancetype.go:252-293)."""
+    (parity: cloudprovider.Offerings built at instancetype.go:252-293).
+
+    Reserved offerings built from a reservation window additionally carry
+    ``remaining`` slot count and ``expires_at`` (window end); a price sort
+    must use :meth:`usable`, not ``available`` — a committed-price (often
+    $0) window with no remaining slots or past its end is not purchasable
+    no matter what its price says."""
 
     zone: str
     capacity_type: str
     price: float
     available: bool
+    remaining: Optional[int] = None   # None = not slot-counted
+    expires_at: Optional[float] = None  # None = open-ended
+
+    def usable(self, now: Optional[float] = None) -> bool:
+        """Purchasable right now: available, slots remain (when counted),
+        and the window has not expired (when bounded)."""
+        if not self.available:
+            return False
+        if self.remaining is not None and self.remaining <= 0:
+            return False
+        if self.expires_at is not None and now is not None and now >= self.expires_at:
+            return False
+        return True
 
 
 @dataclass
@@ -165,11 +184,16 @@ class InstanceType:
             reqs.add(Requirement(lbl.CAPACITY_TYPE, Operator.IN, tuple(captypes)))
         return reqs
 
-    def cheapest_price(self, capacity_types=lbl.CAPACITY_TYPES, zones=None) -> float:
+    def cheapest_price(self, capacity_types=lbl.CAPACITY_TYPES, zones=None,
+                       now: Optional[float] = None) -> float:
+        # usable(), not available: an expired or slot-exhausted reservation
+        # window carries a committed price (often $0) that would otherwise
+        # win every price sort while selling capacity that does not exist
+        # (ISSUE 16 regression: tests/test_market.py)
         prices = [
             o.price
             for o in self.offerings
-            if o.available and o.capacity_type in capacity_types and (zones is None or o.zone in zones)
+            if o.usable(now) and o.capacity_type in capacity_types and (zones is None or o.zone in zones)
         ]
         return min(prices) if prices else math.inf
 

@@ -161,7 +161,34 @@ class CatalogProvider:
             self.reservations.seq_num(),
             self.overhead.vm_memory_overhead_percent,
             self.overhead.max_pods,
+        ) + self._market_fragment()
+
+    def _market_fragment(self) -> tuple:
+        """The clock-driven part of the cache key. Everything slot- or
+        price-shaped already rides the seqnums above; only two things move
+        with the clock alone — the MarketModel tick (reclaim discounts are
+        a function of it) and bounded-window open/close transitions. Empty
+        () when the market is off or there is no market state, so the key
+        is the exact pre-market tuple and cached tensors keep hitting."""
+        from ..market import (
+            market_enabled,
+            windows_cache_key,
+            windows_from_reservations,
         )
+
+        if not market_enabled():
+            return ()
+        frag: list = []
+        now = self._clock.now()
+        model = self.pricing.market
+        if model is not None:
+            frag.append(("tick", model.tick_index(now)))
+        wkey = windows_cache_key(
+            windows_from_reservations(self.reservations.list()), now
+        )
+        if wkey:
+            frag.append(("win", wkey))
+        return tuple(frag)
 
     # -- tensor exports (the TPU-facing view) ------------------------------
     def tensors(self) -> "CatalogTensors":
@@ -178,16 +205,22 @@ class CatalogProvider:
         return built
 
     def _build_tensors(self) -> "CatalogTensors":
+        from ..market import (
+            apply_window_columns,
+            market_enabled,
+            windows_from_reservations,
+        )
+
         with self._lock:
             T, Z = len(self._types), len(self.zones)
             zone_idx = {z: i for i, z in enumerate(self.zones)}
             C = np.zeros((T, NUM_RESOURCES), dtype=np.float32)
             price = np.full((T, Z, lbl.NUM_CAPACITY_TYPES), np.inf, dtype=np.float32)
             avail = np.zeros((T, Z, lbl.NUM_CAPACITY_TYPES), dtype=bool)
-            reserved_remaining: dict[tuple[str, str], int] = {}
-            for r in self.reservations.list():
-                k = (r.instance_type, r.zone)
-                reserved_remaining[k] = reserved_remaining.get(k, 0) + r.remaining
+            names = tuple(t.name for t in self._types)
+            use_market = market_enabled()
+            model = self.pricing.market if use_market else None
+            now = self._clock.now()
             for ti, it in enumerate(self._types):
                 C[ti] = self.allocatable(it).v
                 for o in it.offerings:
@@ -206,20 +239,44 @@ class CatalogProvider:
                         if ci == 0
                         else self.pricing.spot_price(it, o.zone)
                     )
+                    if model is not None and ci == lbl.SPOT_INDEX:
+                        # reclaim-risk premium, folded into the price VALUE
+                        # so every consumer (FFD sort, consolidation screen,
+                        # optimizer LP objective) arbitrages the same
+                        # effective spot — and no jit signature changes
+                        p = p * (1.0 + model.reclaim_lambda
+                                 * model.reclaim_probability(it.name, o.zone, now))
                     price[ti, zi, ci] = p
                     avail[ti, zi, ci] = live
-                # Reserved offerings come from the resolved reservation
-                # store, not the type's own offering list: price 0 (already
-                # paid) while count remains, ICE mask still applies.
-                for zi, zone in enumerate(self.zones):
-                    if reserved_remaining.get((it.name, zone), 0) > 0:
-                        ci = lbl.RESERVED_INDEX
-                        price[ti, zi, ci] = 0.0
-                        avail[ti, zi, ci] = not self.unavailable.is_unavailable(
-                            it.name, zone, lbl.CAPACITY_TYPE_RESERVED
-                        )
+            # Reserved offerings come from the resolved reservation store,
+            # not the type's own offering list: committed price (0 for a
+            # plain ODCR — already paid) while slots remain, ICE mask on top.
+            if use_market:
+                # window encoding: honors [start_s, end_s) bounds and slot
+                # exhaustion; a plain open-ended reservation encodes exactly
+                # like the legacy branch below
+                apply_window_columns(
+                    price, avail, names, self.zones,
+                    windows_from_reservations(self.reservations.list()),
+                    now, unavailable=self.unavailable,
+                )
+            else:
+                # KARPENTER_TPU_MARKET=0: the pre-market encoding, kept
+                # verbatim for byte-identity (tests/test_market.py)
+                reserved_remaining: dict[tuple[str, str], int] = {}
+                for r in self.reservations.list():
+                    k = (r.instance_type, r.zone)
+                    reserved_remaining[k] = reserved_remaining.get(k, 0) + r.remaining
+                for ti, it in enumerate(self._types):
+                    for zi, zone in enumerate(self.zones):
+                        if reserved_remaining.get((it.name, zone), 0) > 0:
+                            ci = lbl.RESERVED_INDEX
+                            price[ti, zi, ci] = 0.0
+                            avail[ti, zi, ci] = not self.unavailable.is_unavailable(
+                                it.name, zone, lbl.CAPACITY_TYPE_RESERVED
+                            )
             return CatalogTensors(
-                names=tuple(t.name for t in self._types),
+                names=names,
                 zones=self.zones,
                 capacity=C,
                 price=price,

@@ -27,10 +27,28 @@ class Reservation:
     zone: str
     count: int
     used: int = 0       # instances currently drawing from the reservation
+    # Market-window fields (market/offerings.py lifts these into
+    # OfferingWindow s): a plain ODCR reservation leaves all three at the
+    # defaults (open-ended, marginal price 0); a capacity block carries a
+    # [start_s, end_s) purchase window and a committed $/hr.
+    start_s: Optional[float] = None
+    end_s: Optional[float] = None
+    committed_price: float = 0.0
 
     @property
     def remaining(self) -> int:
         return max(self.count - self.used, 0)
+
+    def open_at(self, now: Optional[float]) -> bool:
+        """Inside the purchase window (``now=None`` = ignore the clock,
+        the pre-market call shape)."""
+        if now is None:
+            return True
+        if self.start_s is not None and now < self.start_s:
+            return False
+        if self.end_s is not None and now >= self.end_s:
+            return False
+        return True
 
 
 class ReservationStore:
@@ -55,20 +73,29 @@ class ReservationStore:
         with self._lock:
             return self._by_id.get(rid)
 
-    def remaining(self, instance_type: str, zone: str) -> int:
+    def remaining(self, instance_type: str, zone: str,
+                  now: Optional[float] = None) -> int:
+        """Slots purchasable for (type, zone). ``now`` excludes windows
+        that are not currently open — the market-aware callers (launch
+        eligibility, consolidation slot accounting) pass the clock so an
+        expired capacity block stops advertising capacity."""
         with self._lock:
             return sum(
                 r.remaining
                 for r in self._by_id.values()
                 if r.instance_type == instance_type and r.zone == zone
+                and r.open_at(now)
             )
 
-    def consume(self, instance_type: str, zone: str) -> Optional[str]:
+    def consume(self, instance_type: str, zone: str,
+                now: Optional[float] = None) -> Optional[str]:
         """In-flight decrement at launch commit; returns the reservation id
-        or None when exhausted (the launch must fall back / ICE)."""
+        or None when exhausted (the launch must fall back / ICE). A closed
+        window never serves a slot."""
         with self._lock:
             for r in self._by_id.values():
-                if r.instance_type == instance_type and r.zone == zone and r.remaining > 0:
+                if r.instance_type == instance_type and r.zone == zone \
+                        and r.remaining > 0 and r.open_at(now):
                     r.used += 1
                     self._seq += 1
                     return r.id

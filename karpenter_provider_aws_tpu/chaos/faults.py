@@ -333,6 +333,72 @@ class SpotInterrupt(Fault):
 
 
 @dataclass
+class PriceSpike(Fault):
+    """A market-wide spot price spike: every spot offering's live price
+    multiplies by ``factor`` for the window, then the exact pre-spike
+    prices are pushed back. The fault snapshots fleet churn (cumulative
+    launches + terminations at the fake cloud) at both window edges and
+    leaves the numbers on ``harness.market_spike`` for the
+    ``no-fleet-thrash`` invariant — a transient 3x spike landing
+    mid-consolidation must not make the fleet flip to on-demand and
+    back (``designs/market-engine.md``)."""
+
+    kind = "PriceSpike"
+
+    factor: float = 3.0
+    _saved: tuple = field(default=(), init=False, compare=False)
+    _mark: tuple = field(default=(), init=False, compare=False)
+
+    @staticmethod
+    def _churn(cloud) -> tuple[int, int]:
+        """(cumulative launches, cumulative terminations): the fake
+        cloud keeps terminated instances in the dict, so ``len`` is the
+        ever-launched count."""
+        with cloud._lock:
+            insts = list(cloud.instances.values())
+        return len(insts), sum(1 for i in insts if i.state == "terminated")
+
+    def on_activate(self, harness) -> None:
+        from ..models import labels as lbl
+
+        catalog = harness.env.catalog
+        saved: dict[tuple[str, str], float] = {}
+        spiked: dict[tuple[str, str], float] = {}
+        for it in catalog.list():
+            for o in it.offerings:
+                if o.capacity_type != lbl.CAPACITY_TYPE_SPOT:
+                    continue
+                key = (it.name, o.zone)
+                if key in saved:
+                    continue
+                cur = catalog.pricing.spot_price(it, o.zone)
+                saved[key] = cur
+                spiked[key] = round(cur * self.factor, 5)
+        catalog.pricing.update_spot(spiked)
+        self._saved = tuple(sorted(saved.items()))
+        launches, terms = self._churn(harness.env.cloud)
+        self._mark = (harness.env.clock.now(), launches, terms)
+        harness.record_cloud_fault(
+            self, f"spot x{self.factor:g} across {len(spiked)} offerings"
+        )
+
+    def on_deactivate(self, harness) -> None:
+        catalog = harness.env.catalog
+        if self._saved:
+            catalog.pricing.update_spot(dict(self._saved))
+        t0, l0, d0 = self._mark or (harness.env.clock.now(), 0, 0)
+        l1, d1 = self._churn(harness.env.cloud)
+        t1 = harness.env.clock.now()
+        harness.market_spike = {
+            "t_start": t0, "t_end": t1, "window_s": t1 - t0,
+            "launches": l1 - l0, "terminations": d1 - d0,
+            "pre_launches": l0, "pre_terminations": d0,
+        }
+        self._saved = ()
+        self._mark = ()
+
+
+@dataclass
 class InstanceVanish(Fault):
     """Out-of-band instance loss: the newest N running instances flip to
     terminated at the cloud with NO warning message — the GC/liveness
@@ -522,7 +588,7 @@ FAULT_KINDS: dict[str, type] = {
     cls.kind: cls
     for cls in (
         Throttle, ServerError, ConnectionDrop, InjectedLatency,
-        CredentialExpiry, Ice, SpotInterrupt, InstanceVanish,
+        CredentialExpiry, Ice, SpotInterrupt, PriceSpike, InstanceVanish,
         DeviceLost, EventualConsistencyLag,
         ReplicaCrash, ReplicaPause, ReplicaNetsplit,
     )

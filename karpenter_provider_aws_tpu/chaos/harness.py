@@ -229,7 +229,14 @@ class ChaosHarness:
         self.env.apply_defaults(NodePool(
             name="default",
             requirements=requirements,
-            disruption=Disruption(budgets=["100%"], consolidate_after_s=None),
+            # consolidation stays OFF unless the scenario arms it
+            # (pool.consolidate_after_s): most scenarios isolate fault
+            # effects; spot-price-spike needs the spike to land MID-
+            # consolidation for the no-fleet-thrash invariant to bite
+            disruption=Disruption(
+                budgets=["100%"],
+                consolidate_after_s=sc.consolidate_after_s,
+            ),
         ))
 
     def _apply_workload(self, w) -> None:

@@ -353,6 +353,40 @@ def check_packing_envelope_parity(harness) -> InvariantResult:
     )
 
 
+#: no-fleet-thrash bounds: during a PriceSpike window the fleet's churn
+#: rate (launches + terminations per simulated hour) may not exceed the
+#: pre-spike baseline by more than THRASH_RATE_MULT, with an absolute
+#: floor so a single reactive replacement in a short window never fails
+#: the check (designs/market-engine.md derives the numbers).
+THRASH_RATE_MULT = 2.0
+THRASH_FLOOR_PER_HOUR = 40.0
+
+
+def check_no_fleet_thrash(harness) -> InvariantResult:
+    """A transient price spike must not make the fleet flip: the
+    churn rate inside the PriceSpike window stays within
+    ``THRASH_RATE_MULT`` x the pre-spike (quiet + buildout) rate, floor
+    ``THRASH_FLOOR_PER_HOUR``/hr. The PriceSpike fault leaves its
+    window-edge churn snapshots on ``harness.market_spike``; scenarios
+    without one self-skip so every report lists the same checks."""
+    spike = getattr(harness, "market_spike", None)
+    if not spike:
+        return _result("no-fleet-thrash", True, "no PriceSpike fault: n/a")
+    window_s = max(float(spike["window_s"]), 1e-9)
+    events = int(spike["launches"]) + int(spike["terminations"])
+    rate = events * 3600.0 / window_s
+    quiet_s = max(float(spike["t_start"]), 1e-9)
+    quiet_events = int(spike["pre_launches"]) + int(spike["pre_terminations"])
+    quiet_rate = quiet_events * 3600.0 / quiet_s
+    allowed = max(THRASH_FLOOR_PER_HOUR, THRASH_RATE_MULT * quiet_rate)
+    detail = (
+        f"spike {events} events in {window_s:g}s ({rate:.0f}/hr) vs quiet "
+        f"{quiet_events} in {quiet_s:g}s ({quiet_rate:.0f}/hr); "
+        f"allowed {allowed:.0f}/hr"
+    )
+    return _result("no-fleet-thrash", rate <= allowed, detail)
+
+
 def check_controllers_healthy(harness) -> InvariantResult:
     errors = harness.env.manager.errors[harness.errors_baseline:]
     return _result(
@@ -375,6 +409,7 @@ INVARIANTS = (
     check_no_orphaned_claims,
     check_leases_partition_fleet,
     check_packing_envelope_parity,
+    check_no_fleet_thrash,
     check_controllers_healthy,
 )
 

@@ -112,6 +112,11 @@ class Scenario:
     replicas: int = 1
     capacity_types: tuple = ()            # () = pool default (any)
     categories: tuple = ("c", "m", "r")
+    # pool.consolidate_after_s: None (default) keeps consolidation OFF —
+    # most scenarios want disruption quiet so fault effects are isolated.
+    # A number arms it (the spot-price-spike scenario needs a spike to
+    # land MID-consolidation to prove the no-fleet-thrash invariant).
+    consolidate_after_s: Optional[float] = None
     workloads: list[Workload] = field(default_factory=list)
     timeline: list[TimedFault] = field(default_factory=list)
 
@@ -136,6 +141,8 @@ class Scenario:
             pool["capacity_types"] = list(self.capacity_types)
         if self.categories != ("c", "m", "r"):
             pool["categories"] = list(self.categories)
+        if self.consolidate_after_s is not None:
+            pool["consolidate_after_s"] = self.consolidate_after_s
         if pool:
             d["pool"] = pool
         return d
@@ -157,6 +164,10 @@ class Scenario:
             replicas=int(d.get("replicas", 1)),
             capacity_types=tuple(pool.get("capacity_types", ())),
             categories=tuple(pool.get("categories", ("c", "m", "r"))),
+            consolidate_after_s=(
+                None if pool.get("consolidate_after_s") is None
+                else float(pool["consolidate_after_s"])
+            ),
             workloads=[Workload.from_dict(w) for w in d.get("workloads", [])],
             timeline=sorted(
                 (TimedFault.from_dict(t) for t in d.get("timeline", [])),

@@ -374,6 +374,9 @@ class CloudProvider:
             (z, ct) for z in zones for ct in pairs
         ]
         it = self.catalog.get(type_names[0]) if type_names else None
+        # clock-gated reservation windows: an expired/not-yet-open capacity
+        # block must not rank (or pass the filter) as free capacity
+        now = self.clock.now()
 
         def price(offer):
             zone, captype = offer
@@ -382,7 +385,7 @@ class CloudProvider:
             if captype == lbl.CAPACITY_TYPE_RESERVED:
                 # pre-paid: marginal cost 0 while count remains, else
                 # unusable (skipped below too)
-                has = self.catalog.reservations.remaining(it.name, zone) > 0
+                has = self.catalog.reservations.remaining(it.name, zone, now=now) > 0
                 return 0.0 if has else float("inf")
             if captype == lbl.CAPACITY_TYPE_SPOT:
                 return self.catalog.pricing.spot_price(it, zone)
@@ -390,7 +393,8 @@ class CloudProvider:
 
         for zone, captype in sorted(joint, key=price):
             if captype == lbl.CAPACITY_TYPE_RESERVED and not any(
-                self.catalog.reservations.remaining(t, zone) > 0 for t in type_names
+                self.catalog.reservations.remaining(t, zone, now=now) > 0
+                for t in type_names
             ):
                 continue
             if any(

@@ -57,6 +57,11 @@ class NodeClassStatusController:
             else:
                 nc.status.set_condition("Ready", True)
         self._publish_reservations()
+        # pricing-feed staleness rides this reconcile's cadence: the gauge
+        # (karpenter_pricing_age_seconds{source}) plus a PricingStale
+        # Warning past the TTL — a wedged poller pages as an event, not as
+        # silently frozen market arbitrage (designs/market-engine.md)
+        self.cloudprovider.catalog.pricing.observe_staleness()
 
     def _publish_reservations(self) -> None:
         """Publish the cross-nodeclass union into the catalog store (the
@@ -73,11 +78,22 @@ class NodeClassStatusController:
                 union[r.id] = Reservation(
                     id=r.id, instance_type=r.instance_type, zone=r.zone,
                     count=r.count, used=r.used,
+                    # market-window fields: a capacity block's purchase
+                    # window and committed $/hr ride the status through to
+                    # the store so the tensor build can encode them
+                    start_s=getattr(r, "start_s", None),
+                    end_s=getattr(r, "end_s", None),
+                    committed_price=float(getattr(r, "committed_price", 0.0) or 0.0),
                 )
         store = self.cloudprovider.catalog.reservations
 
         def fingerprint(rs):
-            return {r.id: (r.instance_type, r.zone, r.count, r.used) for r in rs}
+            return {
+                r.id: (r.instance_type, r.zone, r.count, r.used,
+                       getattr(r, "start_s", None), getattr(r, "end_s", None),
+                       getattr(r, "committed_price", 0.0))
+                for r in rs
+            }
 
         if fingerprint(store.list()) != fingerprint(union.values()):
             store.update(union.values())

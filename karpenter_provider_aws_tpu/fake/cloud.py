@@ -56,10 +56,23 @@ class CapacityReservation:
     used: int = 0
     name: str = ""
     tags: dict[str, str] = field(default_factory=dict)
+    # Market-window fields (EC2 Capacity Blocks shape): launches may draw
+    # slots only inside [start_s, end_s); None = open-ended on that side
+    # (a plain ODCR). committed_price is the $/hr the block was bought at.
+    start_s: Optional[float] = None
+    end_s: Optional[float] = None
+    committed_price: float = 0.0
 
     @property
     def remaining(self) -> int:
         return max(self.count - self.used, 0)
+
+    def open_at(self, now: float) -> bool:
+        if self.start_s is not None and now < self.start_s:
+            return False
+        if self.end_s is not None and now >= self.end_s:
+            return False
+        return True
 
 
 @dataclass
@@ -243,7 +256,8 @@ class FakeCloud:
                     # reservation, else the pool is effectively ICE
                     res = next(
                         (r for r in self.capacity_reservations.values()
-                         if r.instance_type == itype and r.zone == zone and r.remaining > 0),
+                         if r.instance_type == itype and r.zone == zone
+                         and r.remaining > 0 and r.open_at(self.clock.now())),
                         None,
                     )
                     if res is None:

@@ -1,0 +1,57 @@
+"""Market engine: time-varying prices and reserved-capacity windows.
+
+PAPER.md's pricing layer is not a static table: spot prices move, ODCR
+reservations expire, capacity blocks open at a future start time. This
+package makes that market a first-class, *deterministic* input to every
+cost decision:
+
+- :mod:`market.offerings` — reserved pools and time-boxed capacity
+  blocks modeled as :class:`OfferingWindow` s (start/end, committed
+  price, remaining slots) and encoded into the reserved column of the
+  catalog's ``[T, Z, C]`` price/availability tensors — the same columns
+  the encode stack (``ops/encode.py`` family) already derives the
+  solver's ``price``/``type_window`` tensors from, so windows ride the
+  existing ladder buckets and never change a jitted shape.
+- :class:`catalog.pricing.MarketModel` — seeded price-volatility walks
+  and per-offering spot-reclaim probability, pure functions of
+  ``(seed, instance_type, zone, tick)`` on the injected clock, so two
+  runs with the same seed see byte-identical markets.
+- :mod:`market.scenarios` — the canned MARKET simulator traces
+  (diurnal spot walks, reservation-expiry day, capacity-block arrival)
+  behind ``python -m karpenter_provider_aws_tpu.sim run --trace ...``
+  and the ``cost_vs_oracle_market_*`` bench family.
+
+Kill switch: ``KARPENTER_TPU_MARKET=0`` disables every market effect —
+no walks applied, no windows encoded, no reclaim discount — and the
+static-catalog solve path is byte-identical to a build that never
+constructed market state (``tests/test_market.py`` pins this per seed).
+
+Design doc: ``designs/market-engine.md``.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def market_enabled() -> bool:
+    """The market kill switch. Env-read per call (not cached) so an
+    operator or a chaos harness can flip it live; ``KARPENTER_TPU_MARKET=0``
+    restores the static-catalog path bit-for-bit."""
+    return os.environ.get("KARPENTER_TPU_MARKET", "1") != "0"
+
+
+from .offerings import (  # noqa: E402
+    OfferingWindow,
+    apply_window_columns,
+    windows_cache_key,
+    windows_from_reservations,
+)
+
+__all__ = [
+    "market_enabled",
+    "OfferingWindow",
+    "apply_window_columns",
+    "windows_cache_key",
+    "windows_from_reservations",
+]

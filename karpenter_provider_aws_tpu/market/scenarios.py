@@ -1,0 +1,149 @@
+"""Canned MARKET scenarios: the moving-price days the gates replay.
+
+Three shapes, each a :class:`sim.traces.TraceSpec` that arms the seeded
+:class:`catalog.pricing.MarketModel` (``market_tick_s > 0``) so every
+cost decision in the day happens against walked prices:
+
+- ``market-day`` — the headline 500-node day: diurnal spot walks every
+  5 simulated minutes, fragmentation bursts (the optimizer lane's
+  target workload), and a standing ODCR the consolidation screen should
+  keep full. This is the ``make market-smoke`` /
+  ``sim/baselines/market-500.json`` trace and the default bench
+  scenario.
+- ``reservation-expiry-day`` — the standing reservation EXPIRES halfway
+  through: every solve after the expiry must price reserved capacity as
+  gone (the window column goes dark), and nothing may keep launching
+  into it (the satellite-3 regression at fleet scale).
+- ``capacity-block-day`` — a discounted capacity block ARRIVES
+  mid-trace: the window column lights up at its committed price and the
+  solver should migrate new capacity onto it while it is open.
+
+The bench family ``cost_vs_oracle_market_*`` (benchmarks/market_bench.py
+via ``bench.py --child=market``) replays each scenario's market against
+solver-vs-FFD-oracle solve pairs; :func:`market_catalog` is the shared
+builder that stands up a catalog with the scenario's market state
+installed (model attached + reservations in the store), deterministic
+per seed.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def market_traces() -> dict:
+    """The shipped MARKET TraceSpecs (merged into sim.traces.canned_traces)."""
+    from ..sim.traces import TraceSpec
+
+    return {
+        # 500 nodes, 4 simulated hours, spot walked every 5 min; frag
+        # bursts make solves the oracle sampler judges; a standing ODCR
+        # gives consolidation a paid-for target
+        "market-day": TraceSpec(
+            name="market-day", nodes=500, duration_s=4 * 3600.0,
+            heartbeat_s=600.0, sample_every_s=900.0,
+            waves_per_hour=2.0, wave_pods=24, wave_ttl_s=3600.0,
+            floods=1, flood_pods=48, churn_every_s=1800.0, churn_pods=12,
+            frag_every_s=1800.0, frag_pods=24, frag_ttl_s=3000.0,
+            settle_reconciles=40,
+            market_tick_s=300.0, market_volatility=0.35,
+            market_reservations=6,
+        ),
+        # the standing reservation expires at the halfway mark: reserved
+        # capacity must vanish from every price sort at that instant
+        "reservation-expiry-day": TraceSpec(
+            name="reservation-expiry-day", nodes=300, duration_s=4 * 3600.0,
+            heartbeat_s=600.0, sample_every_s=900.0,
+            waves_per_hour=2.0, wave_pods=20, wave_ttl_s=3600.0,
+            floods=1, flood_pods=32, churn_every_s=1800.0, churn_pods=8,
+            settle_reconciles=40,
+            market_tick_s=300.0, market_volatility=0.35,
+            market_reservations=8, market_reservation_end_s=2 * 3600.0,
+        ),
+        # a discounted capacity block opens at hour 1 for 2 hours: the
+        # reserved window column lights mid-trace and new capacity should
+        # prefer it while open
+        "capacity-block-day": TraceSpec(
+            name="capacity-block-day", nodes=300, duration_s=4 * 3600.0,
+            heartbeat_s=600.0, sample_every_s=900.0,
+            waves_per_hour=2.0, wave_pods=20, wave_ttl_s=3600.0,
+            floods=2, flood_pods=32, churn_every_s=1800.0, churn_pods=8,
+            settle_reconciles=40,
+            market_tick_s=300.0, market_volatility=0.35,
+            market_block_at_s=3600.0, market_block_slots=8,
+            market_block_duration_s=2 * 3600.0,
+        ),
+    }
+
+
+def reserved_candidate(catalog):
+    """The (instance_type, zone) a seeded sim/bench reservation pins:
+    the cheapest-$/vCPU c/m type in the fleet-builder's candidate band
+    (sim/driver.py draws fleet nodes from exactly this band, so the
+    reservation is always for capacity the workload can actually use).
+    Deterministic for a given catalog."""
+    candidates = [
+        t for t in catalog.list()
+        if t.category in ("c", "m") and 4 <= t.vcpus <= 16
+    ]
+
+    def per_cpu(t):
+        try:
+            p = catalog.pricing.on_demand_price(t)
+        except Exception:
+            p = float("inf")
+        return (float(p) / t.vcpus) if p else float("inf")
+
+    candidates.sort(key=lambda t: (per_cpu(t), t.name))
+    if not candidates:
+        raise ValueError("catalog has no c/m candidates for a reservation")
+    return candidates[0].name, catalog.zones[0]
+
+
+def market_catalog(seed: int, scenario: str = "market-day",
+                   clock=None, reservations: Optional[int] = None):
+    """Stand up a CatalogProvider with the scenario's market installed:
+    seeded MarketModel attached (and applied once, so prices start
+    walked), reservations in the store. The bench family solves against
+    exactly this catalog; everything is a function of (seed, scenario).
+    Returns (catalog, model)."""
+    from ..catalog.pricing import MarketModel, PricingProvider
+    from ..catalog.provider import CatalogProvider
+    from ..catalog.reservations import Reservation
+    from ..utils.clock import FakeClock
+
+    spec = market_traces()[scenario]
+    clk = clock or FakeClock()
+    catalog = CatalogProvider(clock=clk, pricing=PricingProvider(clock=clk))
+    model = MarketModel(
+        seed=seed, clock=clk, volatility=spec.market_volatility,
+        tick_s=spec.market_tick_s or 300.0,
+    )
+    catalog.pricing.market = model
+    slots = spec.market_reservations if reservations is None else reservations
+    resv = []
+    if slots > 0:
+        itype, zone = reserved_candidate(catalog)
+        end_s = spec.market_reservation_end_s or None
+        resv.append(Reservation(
+            id=f"bench-odcr-{seed}", instance_type=itype, zone=zone,
+            count=int(slots), end_s=end_s,
+        ))
+    if spec.market_block_at_s >= 0 and spec.market_block_slots > 0:
+        # the capacity block as a bounded window at a committed discount
+        # (the sim driver installs the same shape through the fake cloud;
+        # the bench catalog installs it directly in the store)
+        itype, zone = reserved_candidate(catalog)
+        it = next(t for t in catalog.list() if t.name == itype)
+        od = catalog.pricing.on_demand_price(it)
+        resv.append(Reservation(
+            id=f"bench-block-{seed}", instance_type=itype, zone=zone,
+            count=int(spec.market_block_slots),
+            start_s=float(spec.market_block_at_s),
+            end_s=float(spec.market_block_at_s + spec.market_block_duration_s),
+            committed_price=round(0.35 * od, 5),
+        ))
+    if resv:
+        catalog.reservations.update(resv)
+    model.apply(catalog)
+    return catalog, model

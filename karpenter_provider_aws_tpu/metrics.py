@@ -617,6 +617,13 @@ OFFERING_PRICE = REGISTRY.gauge(
 OFFERING_AVAILABLE = REGISTRY.gauge(
     "karpenter_instance_type_offering_available", "Offering availability (0/1)"
 )
+PRICING_AGE = REGISTRY.gauge(
+    "karpenter_pricing_age_seconds",
+    "Seconds since the live pricing backend last refreshed, per source "
+    "(spot / on-demand); only published once a source has refreshed at "
+    "least once — past the TTL a PricingStale Warning event fires "
+    "(catalog/pricing.py observe_staleness)",
+)
 
 
 def publish_catalog_metrics(types) -> None:

@@ -984,8 +984,14 @@ def _screen(ct: ClusterTensors, chunk: int):
         NB = _screen_bucket_hw("NB", _ladder_bucket(N))
         GB = _screen_bucket_hw("GB", _pow2(G, minimum=8))
         # the slot axis rides the same ratchet (zero-count slots are
-        # no-ops wherever they sit, so widening is semantics-free)
-        SP = min(_screen_bucket_hw("S", S), ct.group_ids.shape[1])
+        # no-ops wherever they sit, so widening is semantics-free). The
+        # pow2(minimum=8) round-up BEFORE ratcheting matches the device
+        # mirror's slot policy exactly — the chained/unchained chooser
+        # flips between the two paths per node-count bucket, and any
+        # width disagreement between them re-jits the screen on the
+        # flip. The bucket may exceed the source slot axis (the surplus
+        # columns stay zero-count = inert).
+        SP = _screen_bucket_hw("S", _pow2(S, minimum=8))
         free_h = np.zeros((NB, ct.free.shape[1]), dtype=ct.free.dtype)
         free_h[:N] = ct.free
         req_h = np.zeros((GB, ct.requests.shape[1]), dtype=ct.requests.dtype)
@@ -1000,7 +1006,17 @@ def _screen(ct: ClusterTensors, chunk: int):
         requests = jnp.asarray(req_h)
         gids = jnp.asarray(gids_h)
         gcounts = jnp.asarray(gcounts_h)
-        cap = jnp.asarray(cap_h)
+        # Upload the compact uint16/bool wire (H2D bandwidth is why the
+        # wire exists), then widen to float32 ON DEVICE — the exact form
+        # _cap_wire_f32 serves from the resident mirror, and exact in
+        # float32 (values <= 60000 and 2^30). Without this the jitted
+        # screen has a uint16 signature here and a float32 one on the
+        # resident path, and the chained/unchained flip re-jits it.
+        cap_w = jnp.asarray(cap_h)
+        if cap_h.dtype == np.bool_:
+            cap = jnp.where(cap_w, jnp.float32(_UNCAPPED), jnp.float32(0.0))
+        else:
+            cap = cap_w.astype(jnp.float32)
     chunks = []
     for start in range(0, N, chunk):
         idx = np.arange(start, min(start + chunk, N), dtype=np.int32)
@@ -1650,7 +1666,15 @@ def cheaper_replacement(
     res_left = np.zeros((T, Z), dtype=np.int64)
     type_idx = {n: i for i, n in enumerate(tensors.names)}
     zone_idx = {z: i for i, z in enumerate(tensors.zones)}
+    # Window-aware slot accounting: a capacity block outside its
+    # [start_s, end_s) purchase window contributes no slots, so a
+    # replacement can never be justified by a reservation that will have
+    # expired by the time the new node launches (market/offerings.py).
+    _clk = getattr(catalog, "_clock", None)
+    _now = _clk.now() if _clk is not None else None
     for r in catalog.reservations.list():
+        if _now is not None and hasattr(r, "open_at") and not r.open_at(_now):
+            continue
         ti, zi = type_idx.get(r.instance_type), zone_idx.get(r.zone)
         if ti is not None and zi is not None:
             res_left[ti, zi] += r.remaining

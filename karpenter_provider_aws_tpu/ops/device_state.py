@@ -329,18 +329,31 @@ def _acquire_locked(holder: DeviceClusterTensors, ct, span):
 def _upload(holder: DeviceClusterTensors, ct, N: int, G: int, W: int) -> None:
     import jax
 
+    from .consolidate import _screen_bucket_hw
+
     R = ct.free.shape[1]
-    NB = max(_ladder_bucket(N), holder.NB)
-    GB = max(_pow2(G, minimum=8), holder.GB)
-    S = max(_pow2(W), holder.S, 1)
-    S = min(S, ct.group_ids.shape[1])
+    # One shape policy for BOTH screen paths: the process-wide ratchet
+    # (`_screen_bucket_hw`) that the host-upload fallback already uses.
+    # The chained/unchained chooser flips paths per node-count bucket;
+    # when the mirror sized its buffers from a private per-holder ratchet
+    # the two paths could disagree on the padded shapes (seen on the
+    # market-day sim: slot axis 4 vs 8 across the flip) and every flip
+    # re-jitted the screen. The global ratchet keeps the 4x shrink bound,
+    # so holder buffers stay bounded the same way the host buffers do.
+    NB = _screen_bucket_hw("NB", _ladder_bucket(N))
+    GB = _screen_bucket_hw("GB", _pow2(G, minimum=8))
+    # minimum=8 matches the group axis: the slot bucket may exceed the
+    # source's own slot axis (extra slots are zero-count = inert), so a
+    # fleet that densifies past the source width later does not re-jit
+    S = _screen_bucket_hw("S", _pow2(W, minimum=8))
+    w = min(S, ct.group_ids.shape[1])
 
     free_h = np.zeros((NB, R), dtype=np.float32)
     free_h[:N] = ct.free
     gids_h = np.zeros((NB, S), dtype=np.int32)
-    gids_h[:N] = ct.group_ids[:, :S]
+    gids_h[:N, :w] = ct.group_ids[:, :w]
     gcounts_h = np.zeros((NB, S), dtype=np.int32)
-    gcounts_h[:N] = ct.group_counts[:, :S]
+    gcounts_h[:N, :w] = ct.group_counts[:, :w]
     req_h = np.zeros((GB, R), dtype=np.float32)
     req_h[:G] = ct.requests
     cap_h = np.zeros((GB, NB), dtype=np.float32)
@@ -377,10 +390,11 @@ def _apply_patch(holder: DeviceClusterTensors, ct, rows: np.ndarray) -> None:
     R = ct.free.shape[1]
     free_v = np.zeros((K, R), dtype=np.float32)
     free_v[: len(rows)] = ct.free[rows]
+    w = min(S, ct.group_ids.shape[1])
     gids_v = np.zeros((K, S), dtype=np.int32)
-    gids_v[: len(rows)] = ct.group_ids[rows, :S]
+    gids_v[: len(rows), :w] = ct.group_ids[rows, :w]
     gcounts_v = np.zeros((K, S), dtype=np.int32)
-    gcounts_v[: len(rows)] = ct.group_counts[rows, :S]
+    gcounts_v[: len(rows), :w] = ct.group_counts[rows, :w]
     cap_v = np.zeros((GB, K), dtype=np.float32)
     cap_v[: holder.G, : len(rows)] = _cap_wire_f32(ct, cols=rows)
 
@@ -421,14 +435,20 @@ def verify_mirror(holder: DeviceClusterTensors, ct) -> list[str]:
         (free_d, req_d, gids_d, gcounts_d, cap_d)
     )
     S = holder.S
+    w = min(S, ct.group_ids.shape[1])
     bad = []
     if not np.array_equal(free[:N], ct.free):
         bad.append("free")
     if not np.array_equal(req[:G], ct.requests):
         bad.append("requests")
-    if not np.array_equal(gids[:N], ct.group_ids[:, :S]):
+    # the slot bucket may be wider than the source slot axis; the surplus
+    # columns must then be all-zero (inert slots)
+    if not np.array_equal(gids[:N, :w], ct.group_ids[:, :w]) or gids[:N, w:].any():
         bad.append("group_ids")
-    if not np.array_equal(gcounts[:N], ct.group_counts[:, :S]):
+    if (
+        not np.array_equal(gcounts[:N, :w], ct.group_counts[:, :w])
+        or gcounts[:N, w:].any()
+    ):
         bad.append("group_counts")
     if not np.array_equal(cap[:G, :N], _cap_wire_f32(ct)):
         bad.append("cap")

@@ -881,7 +881,12 @@ def encode_problem(
 
             fits = (pod.requests.v[None, :] <= cap_eff + 1e-6).all(axis=1)
             # (reserved-offering access is enforced via the masked
-            # `available` array — price, compat, type_window derive from it)
+            # `available` array — price, compat, type_window derive from it.
+            # Market state rides the same columns: an open reservation
+            # window lands as (committed_price, True) in the RESERVED cell,
+            # a reclaim-risk premium is already folded into the SPOT price
+            # value — so the min below IS the market arbitrage and no shape
+            # ever changes with the market on.)
             offer_tc = available & crow[None, None, :]           # [T, Z, C]
             price_tz = np.where(offer_tc, tensors.price, np.inf).min(axis=2)
             avail_tz = offer_tc.any(axis=2)                      # [T, Z]

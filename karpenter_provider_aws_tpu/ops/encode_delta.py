@@ -241,7 +241,10 @@ def _zone_of(state: _EncoderState, zone: str) -> int:
 
 def _node_price(state: _EncoderState, catalog, node) -> float:
     """Per-offering running price (mirror of the full encode's memo; NaN =
-    unknown type, which blocks the node)."""
+    unknown type, which blocks the node). Reserved stays marginal-price 0
+    regardless of the reservation window's committed price: the commitment
+    is sunk whether or not the node runs, so consolidating ONTO it is the
+    win and consolidating it AWAY is never one (designs/market-engine.md)."""
     ct_ = node.capacity_type()
     pkey = (node.instance_type(), node.zone(), ct_)
     hit = state.price_memo.get(pkey)

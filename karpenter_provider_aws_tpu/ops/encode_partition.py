@@ -23,6 +23,12 @@ change journals (state/cluster.py):
  - ``_partitions`` metadata on the merged emission lets the consolidation
    screen and the mesh-parallel solve shard the partition axis.
 
+Market note: per-partition encoders invalidate on the catalog cache key
+exactly like the single chain, and that key carries the market fragment
+(pricing seqnum for walked prices, tick index for reclaim discounts,
+bounded-window open/close states — catalog/provider.py), so a price tick
+rebuilds every partition's price row instead of patching around it.
+
 Cross-partition blocks (a group's compatibility with another partition's
 nodes, hostname-selector occupancy across partitions, zone-constraint
 match vectors) are computed from the same predicates the global encoder

@@ -11,6 +11,11 @@ pod *groups*. Each scan step places a whole multiplicity at once:
     ``price / pods-per-node`` — cost-per-slot greedy, which reproduces the
     reference's behavior of packing big cheap bins (the FFD chooses the type
     maximizing packed pods; CreateFleet then picks the cheapest offering).
+    Because ``price[G, T]`` is the min over each group's live (zone,
+    captype) columns, an OPEN reservation window (market/offerings.py)
+    surfaces here as its committed price — usually 0 — so the open phase
+    prefers capacity the cluster already paid for without any
+    reservation-specific logic in the kernel.
 
 Nodes carry a joint *(zone x capacity-type)* offering window (like the core
 scheduler's virtual nodes carrying narrowing requirements): a group may only

@@ -50,6 +50,14 @@ bound proves there is no money on the table (designs/optimizer-lane.md).
 All inputs are the already-uploaded encoded-problem tensors (the solver's
 content-addressed ``_dput`` cache), so a steady-state lane dispatch ships
 zero new link payload.
+
+Market awareness is free: the ``price[G, T]`` tensor the LP objective
+minimizes is derived from the catalog's market-encoded offering columns
+(designs/market-engine.md) — open reservation windows at committed price,
+spot carrying its reclaim-probability risk premium, on-demand as quoted —
+so the lane arbitrages spot/OD/reserved per group at the current tick's
+prices with no market-specific code here, and ``KARPENTER_TPU_MARKET=0``
+returns it to the static catalog bit-for-bit.
 """
 
 from __future__ import annotations

@@ -173,6 +173,9 @@ class FleetSimulator:
         self.scenario = SimpleNamespace(
             name=self.trace.name, settle_reconciles=self.trace.settle_reconciles
         )
+        # market state (installed by _seed_market when the trace arms it)
+        self._market_model = None
+        self._market_pair = None
         # bookkeeping the report reads
         self._t = 0.0                      # virtual seconds into the trace
         self.passes = 0
@@ -341,11 +344,69 @@ class FleetSimulator:
                 env.cluster.apply(p)
                 env.cluster.bind_pod(p.uid, node.name)
         self.nodes_start = len(env.cluster.nodes)
+        self._seed_market()
         # the build's own binds are setup, not signal: wipe the judgment
         # plane (incl. the correlation ledger and the sentinel's span
         # cursor — build spans must not be the first tick's "regression")
         # so SLO/SLI/audit history starts at the trace's t=0
         env.obs.reset()
+
+    def _seed_market(self) -> None:
+        """Install the trace's market state (designs/market-engine.md):
+        a seeded MarketModel on the sim clock (spot walks + reclaim
+        discounts), and/or a standing ODCR on the fleet's cheapest
+        candidate type — published through the REAL discovery path (fake
+        cloud -> reservation provider -> nodeclass status -> catalog
+        store), so ``pool_reserved_allowed`` arms the solver exactly as
+        a live cluster would."""
+        spec = self.trace
+        env = self.env
+        self._market_model = None
+        self._market_pair = None
+        wants_model = spec.market_tick_s > 0
+        wants_res = spec.market_reservations > 0
+        wants_block = spec.market_block_at_s >= 0 and spec.market_block_slots > 0
+        if not (wants_model or wants_res or wants_block):
+            return
+        from ..market.scenarios import reserved_candidate
+
+        self._market_pair = reserved_candidate(env.catalog)
+        if wants_model:
+            from ..catalog.pricing import MarketModel
+
+            self._market_model = MarketModel(
+                seed=self.seed, clock=env.clock,
+                volatility=spec.market_volatility, tick_s=spec.market_tick_s,
+            )
+            env.catalog.pricing.market = self._market_model
+            self._market_model.apply(env.catalog)
+        if wants_res or wants_block:
+            from ..models.nodeclass import SelectorTerm
+
+            nc = env.cluster.nodeclasses.get("default")
+            if nc is not None and not nc.capacity_reservation_selector:
+                nc.capacity_reservation_selector = [
+                    SelectorTerm(tags=(("sim-market", "true"),))
+                ]
+        if wants_res:
+            from ..testenv import CapacityReservation
+
+            itype, zone = self._market_pair
+            env.cloud.capacity_reservations["sim-odcr-0"] = CapacityReservation(
+                id="sim-odcr-0", instance_type=itype, zone=zone,
+                count=int(spec.market_reservations),
+                end_s=spec.market_reservation_end_s or None,
+                name="sim-odcr-0", tags={"sim-market": "true"},
+            )
+        self._republish_reservations()
+
+    def _republish_reservations(self) -> None:
+        """Drop the discovery cache and reconcile the nodeclass status so
+        a cloud-side reservation mutation lands in the catalog store (and
+        the solver's reserved gating) THIS moment, not a cache-TTL later."""
+        env = self.env
+        env.cloudprovider.capacity_reservations.reset()
+        env.nodeclass_status.reconcile()
 
     # -- stepping ------------------------------------------------------------
 
@@ -511,6 +572,35 @@ class FleetSimulator:
                 env.cluster.apply(p)
                 uids.append(p.uid)
             self._pods_by_prefix[ev.name] = uids
+        elif ev.kind == "market":
+            # one market tick: re-walk every spot price at the current
+            # virtual time through the live update_spot channel (seqnums
+            # bump, tensor caches invalidate — a real pricing backend)
+            if self._market_model is not None:
+                self._market_model.apply(env.catalog)
+        elif ev.kind == "capacity_block":
+            # a purchased capacity block opens NOW for ttl_s: install it
+            # cloud-side at a committed discount and republish so the
+            # reserved window column lights this moment
+            from ..testenv import CapacityReservation
+
+            itype, zone = self._market_pair or (None, None)
+            if itype is not None:
+                it = env.catalog.get(itype)
+                committed = round(
+                    0.35 * env.catalog.pricing.on_demand_price(it), 5
+                )
+                now = env.clock.now()
+                env.cloud.capacity_reservations[f"sim-{ev.name}"] = (
+                    CapacityReservation(
+                        id=f"sim-{ev.name}", instance_type=itype, zone=zone,
+                        count=int(ev.pods), start_s=now,
+                        end_s=now + float(ev.ttl_s or 0.0) if ev.ttl_s else None,
+                        committed_price=committed,
+                        name=f"sim-{ev.name}", tags={"sim-market": "true"},
+                    )
+                )
+                self._republish_reservations()
         else:  # pragma: no cover - generator never emits unknown kinds
             raise ValueError(f"unknown sim event kind {ev.kind!r}")
         self.log.record(

@@ -169,6 +169,22 @@ class TraceSpec:
     # nodepool disruption posture
     consolidation_budgets: tuple = ("2%",)
     consolidate_after_s: Optional[float] = 600.0
+    # market engine (designs/market-engine.md): tick_s > 0 arms a seeded
+    # MarketModel on the sim clock — every tick re-walks all spot prices
+    # through the live update_spot channel (kind="market" events below)
+    market_tick_s: float = 0.0
+    market_volatility: float = 0.35
+    # reserved capacity seeded at t=0: an ODCR on the fleet's cheapest
+    # candidate type (slots), optionally expiring mid-trace (end_s > 0 —
+    # the reservation-expiry-day shape)
+    market_reservations: int = 0
+    market_reservation_end_s: float = 0.0   # 0 = open-ended
+    # a capacity block ARRIVING mid-trace: opens at block_at_s for
+    # block_duration_s with block_slots slots at a committed discount
+    # (kind="capacity_block" event)
+    market_block_at_s: float = -1.0         # < 0 = no block
+    market_block_slots: int = 0
+    market_block_duration_s: float = 14400.0
     # chaos overlays
     overlays: list = field(default_factory=list)
 
@@ -184,6 +200,9 @@ class TraceSpec:
                 "flood_cpu", "flood_memory", "flood_ttl_s", "churn_every_s",
                 "churn_pods", "frag_every_s", "frag_pods", "frag_ttl_s",
                 "unschedulable_per_wave", "consolidate_after_s",
+                "market_tick_s", "market_volatility", "market_reservations",
+                "market_reservation_end_s", "market_block_at_s",
+                "market_block_slots", "market_block_duration_s",
             )
         }
         d["consolidation_budgets"] = list(self.consolidation_budgets)
@@ -251,7 +270,17 @@ def canned_traces() -> dict[str, TraceSpec]:
             floods=6, flood_pods=128, churn_every_s=7200.0, churn_pods=16,
             settle_reconciles=60,
         ),
+        # MARKET traces (moving prices / reserved windows) live in
+        # market/scenarios.py next to the model they exercise
+        **_market_traces(),
     }
+
+
+def _market_traces() -> dict[str, TraceSpec]:
+    # lazy import: market.scenarios builds TraceSpecs from THIS module
+    from ..market.scenarios import market_traces
+
+    return market_traces()
 
 
 def canned_trace(name: str) -> TraceSpec:
@@ -357,6 +386,30 @@ def generate(spec: TraceSpec, seed: int) -> list[SimEvent]:
             ))
             t += spec.churn_every_s
             k += 1
+
+    # market ticks: each one re-walks every spot price through the live
+    # update_spot channel (the driver holds the seeded MarketModel); the
+    # tick times are trace data, the PRICES are the model's — both pure
+    # functions of the seed, so the whole market day is byte-identical
+    if spec.market_tick_s > 0:
+        t = spec.market_tick_s
+        m = 0
+        while t < spec.duration_s:
+            events.append(SimEvent(
+                at_s=round(t, 3), kind="market", name=f"tick{m}",
+            ))
+            t += spec.market_tick_s
+            m += 1
+
+    # capacity-block arrival: a bounded reservation window opens mid-trace
+    # (pods = slots, ttl_s = window length; the driver installs it in the
+    # cloud and republishes the nodeclass status)
+    if spec.market_block_at_s >= 0 and spec.market_block_slots > 0:
+        events.append(SimEvent(
+            at_s=round(spec.market_block_at_s, 3), kind="capacity_block",
+            pods=spec.market_block_slots, name="block0",
+            ttl_s=spec.market_block_duration_s,
+        ))
 
     events.sort(key=lambda e: (e.at_s, e.kind, e.name))
     return events

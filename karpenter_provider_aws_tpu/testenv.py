@@ -29,7 +29,7 @@ from .controllers import (
     TaggingController,
     TerminationController,
 )
-from .fake import FakeCloud, FakeQueue
+from .fake import CapacityReservation, FakeCloud, FakeQueue
 from .models.nodeclass import NodeClass
 from .models.nodepool import NodePool
 from .scheduling.solver import HostSolver, Solver, TPUSolver

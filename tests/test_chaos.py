@@ -343,7 +343,7 @@ class TestScenarioPlans:
         assert list_canned() == [
             "api-brownout", "eventual-consistency", "optimizer-lane-lost",
             "provisioning-replica-loss", "replica-loss", "solver-brownout",
-            "spot-storm", "sts-outage",
+            "spot-price-spike", "spot-storm", "sts-outage",
         ]
 
     def test_scenario_json_round_trip(self):

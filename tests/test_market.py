@@ -1,0 +1,349 @@
+"""Market-engine suite: moving prices, reserved-capacity windows, cost
+under volatility (designs/market-engine.md).
+
+Pins the four load-bearing properties of the market engine:
+
+1. **Determinism** — a seeded :class:`MarketModel` is a pure function of
+   ``(seed, coordinates, tick)``: same seed => byte-identical price
+   traces, different seed => different market (3-seed property test).
+2. **Kill switch** — ``KARPENTER_TPU_MARKET=0`` restores the static
+   catalog bit-for-bit: tensors, cache key, and the FFD plan are
+   identical to a provider that never constructed market state.
+3. **Plan quality** — every optimizer-lane-ADOPTED plan under a MARKET
+   scenario places all pods and is STRICTLY cheaper than the FFD oracle
+   at the current tick's prices (adoption implies host validation).
+4. **Offering windows** — expired or slot-exhausted reservation windows
+   never win a price sort (the ``cheapest_price`` regression) and never
+   light the reserved tensor column.
+
+Plus the staleness probe: ``karpenter_pricing_age_seconds{source}`` and
+the ``PricingStale`` Warning once a refreshed source crosses the TTL.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from karpenter_provider_aws_tpu.catalog.instancetypes import Offering
+from karpenter_provider_aws_tpu.catalog.pricing import (
+    PRICING_STALE_TTL_S,
+    MarketModel,
+    PricingProvider,
+)
+from karpenter_provider_aws_tpu.catalog.provider import CatalogProvider
+from karpenter_provider_aws_tpu.catalog.reservations import Reservation
+from karpenter_provider_aws_tpu.market import (
+    OfferingWindow,
+    apply_window_columns,
+    windows_cache_key,
+    windows_from_reservations,
+)
+from karpenter_provider_aws_tpu.market.offerings import EXPIRED, OPEN, PENDING
+from karpenter_provider_aws_tpu.market.scenarios import market_catalog
+from karpenter_provider_aws_tpu.models import labels as lbl
+from karpenter_provider_aws_tpu.utils.clock import FakeClock
+
+SEEDS = (0, 1, 2)
+
+
+def _price_trace(seed: int, ticks: int = 6) -> list:
+    """The full walked market for ``seed``: every (type, zone) spot price
+    and reclaim probability at each of ``ticks`` hourly steps."""
+    catalog, model = market_catalog(seed, "market-day")
+    out = []
+    for t in range(ticks):
+        if t:
+            catalog._clock.advance(3600.0)
+            model.apply(catalog)
+        now = catalog._clock.now()
+        for it in catalog.list():
+            for o in it.offerings:
+                if o.capacity_type != lbl.CAPACITY_TYPE_SPOT:
+                    continue
+                out.append((
+                    t, it.name, o.zone,
+                    catalog.pricing.spot_price(it, o.zone),
+                    round(model.reclaim_probability(it.name, o.zone, now), 9),
+                ))
+    return out
+
+
+class TestMarketDeterminism:
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_same_seed_byte_identical(self, seed):
+        a, b = _price_trace(seed), _price_trace(seed)
+        assert repr(a) == repr(b)  # byte-identical, not just approx-equal
+
+    def test_different_seeds_differ(self):
+        assert repr(_price_trace(0)) != repr(_price_trace(1))
+
+    def test_walk_moves_and_stays_bounded(self):
+        catalog, model = market_catalog(0, "market-day")
+        it = catalog.list()[0]
+        zone = it.offerings[0].zone
+        base = catalog.pricing.base_spot_price(it, zone)
+        mults = set()
+        for h in range(24):
+            m = model.spot_multiplier(it.name, zone, h * 3600.0)
+            assert m >= 0.2
+            mults.add(round(m, 6))
+        assert len(mults) > 1, "a market that never moves is a still photo"
+        assert base > 0
+
+    def test_apply_never_compounds(self):
+        # two applies at the same instant are idempotent: the walk rides
+        # the OVERRIDE-IGNORING base table, so ticks compose as
+        # base x multiplier, never walked x multiplier
+        catalog, model = market_catalog(1, "market-day")
+        it = catalog.list()[0]
+        zone = it.offerings[0].zone
+        p1 = catalog.pricing.spot_price(it, zone)
+        model.apply(catalog)
+        assert catalog.pricing.spot_price(it, zone) == p1
+
+
+class TestKillSwitch:
+    @staticmethod
+    def _virgin(clk: FakeClock, reservations):
+        """A provider that NEVER constructed market state (the pre-PR
+        shape): same clock, same reservation rows, no model."""
+        cat = CatalogProvider(clock=clk, pricing=PricingProvider(clock=clk))
+        if reservations:
+            cat.reservations.update(reservations)
+        return cat
+
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_tensors_and_key_byte_identical(self, seed, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_MARKET", "0")
+        catalog, model = market_catalog(seed, "market-day")
+        catalog._clock.advance(7200.0)
+        assert model.apply(catalog) == 0  # the switch gates the walk too
+        virgin = self._virgin(catalog._clock, catalog.reservations.list())
+        mt, vt = catalog.tensors(), virgin.tensors()
+        assert np.array_equal(mt.price, vt.price)
+        assert np.array_equal(mt.available, vt.available)
+        assert np.array_equal(mt.capacity, vt.capacity)
+        # the market fragment must degrade to (): the cache key is the
+        # exact pre-market tuple shape
+        assert catalog._market_fragment() == ()
+
+    def test_plan_byte_identical_when_off(self, monkeypatch):
+        from benchmarks.optimizer_bench import _pool, frag_workload
+
+        from karpenter_provider_aws_tpu.ops.encode import encode_problem
+        from karpenter_provider_aws_tpu.scheduling.oracle import ffd_oracle
+
+        monkeypatch.setenv("KARPENTER_TPU_MARKET", "0")
+        catalog, _model = market_catalog(0, "market-day")
+        virgin = self._virgin(catalog._clock, catalog.reservations.list())
+        pods = frag_workload(0)
+        pool = _pool()
+
+        def plan(cat):
+            nodes, un = ffd_oracle(encode_problem(pods, cat, nodepool=pool))
+            return [
+                (n.type_index, n.price, n.window.tobytes(),
+                 sorted(n.group_counts.items()))
+                for n in nodes
+            ], un
+
+        assert plan(catalog) == plan(virgin)
+
+    def test_market_on_actually_moves_prices(self):
+        # the converse guard: with the switch ON (default), the walked
+        # catalog differs from the virgin one — otherwise the kill-switch
+        # test above is vacuously green
+        catalog, _model = market_catalog(0, "market-day")
+        virgin = self._virgin(catalog._clock, catalog.reservations.list())
+        assert not np.array_equal(
+            catalog.tensors().price, virgin.tensors().price
+        )
+
+
+class TestAdoptedPlansUnderMarket:
+    def test_adopted_plans_place_all_and_beat_oracle(self):
+        """Every lane-ADOPTED plan under a MARKET scenario host-validates
+        (all pods placed, nothing unschedulable) and is STRICTLY cheaper
+        than the FFD oracle at the CURRENT tick's prices."""
+        from benchmarks.optimizer_bench import _pool, frag_workload
+
+        from karpenter_provider_aws_tpu.ops.encode import encode_problem
+        from karpenter_provider_aws_tpu.scheduling import TPUSolver
+        from karpenter_provider_aws_tpu.scheduling.oracle import (
+            ffd_oracle,
+            oracle_cost,
+        )
+
+        pool = _pool()
+        tpu = TPUSolver()
+        adopted = 0
+        for seed in (0, 1):
+            catalog, model = market_catalog(seed, "market-day")
+            pods = frag_workload(seed)
+            for tick in range(2):
+                if tick:
+                    catalog._clock.advance(3600.0)
+                    model.apply(catalog)
+                res = tpu.solve(pods, [pool], catalog)
+                nodes, un = ffd_oracle(
+                    encode_problem(pods, catalog, nodepool=pool))
+                assert not un, "oracle itself must place the workload"
+                assert res.pods_placed() == len(pods)
+                assert not res.unschedulable
+                base = oracle_cost(nodes)
+                if tpu.timings.get("opt_lane") == "adopted":
+                    adopted += 1
+                    assert res.total_cost < base, (
+                        f"adopted plan not cheaper at tick {tick}: "
+                        f"{res.total_cost} >= {base}"
+                    )
+                else:
+                    assert res.total_cost <= base * (1 + 1e-9)
+        assert adopted >= 1, "no MARKET sample adopted the optimizer plan"
+
+
+class TestOfferingWindows:
+    def test_lifecycle(self):
+        w = OfferingWindow(id="w", instance_type="c7g.xlarge", zone="z",
+                           slots=4, committed_price=0.1,
+                           start_s=100.0, end_s=200.0)
+        assert w.state_at(50.0) == PENDING and not w.open_at(50.0)
+        assert w.state_at(100.0) == OPEN and w.open_at(100.0)
+        assert w.state_at(200.0) == EXPIRED and not w.open_at(200.0)
+        # slot exhaustion closes an otherwise-open window
+        full = OfferingWindow(id="f", instance_type="t", zone="z",
+                              slots=2, used=2)
+        assert full.state_at(0.0) == OPEN and not full.open_at(0.0)
+
+    def test_apply_window_columns(self):
+        names, zones = ("a", "b"), ("z1",)
+        T, Z, C = len(names), len(zones), lbl.NUM_CAPACITY_TYPES
+        ci = lbl.RESERVED_INDEX
+        price = np.full((T, Z, C), np.inf, dtype=np.float32)
+        avail = np.zeros((T, Z, C), dtype=bool)
+        windows = [
+            OfferingWindow(id="open", instance_type="a", zone="z1",
+                           slots=2, committed_price=0.5),
+            # cheaper window on the same cell must win the min
+            OfferingWindow(id="cheaper", instance_type="a", zone="z1",
+                           slots=1, committed_price=0.2),
+            OfferingWindow(id="expired", instance_type="b", zone="z1",
+                           slots=2, committed_price=0.0, end_s=10.0),
+            OfferingWindow(id="exhausted", instance_type="b", zone="z1",
+                           slots=2, used=2, committed_price=0.0),
+        ]
+        lit = apply_window_columns(price, avail, names, zones, windows,
+                                   now=100.0)
+        assert lit == 2  # both live windows land on the same cell
+        assert avail[0, 0, ci] and price[0, 0, ci] == np.float32(0.2)
+        assert not avail[1, 0, ci] and price[1, 0, ci] == np.inf
+
+    def test_cache_key_tracks_bounded_windows_only(self):
+        odcr = OfferingWindow(id="odcr", instance_type="a", zone="z",
+                              slots=2)
+        block = OfferingWindow(id="blk", instance_type="a", zone="z",
+                               slots=2, start_s=100.0, end_s=200.0)
+        assert windows_cache_key([odcr], 0.0) == ()
+        assert windows_cache_key([odcr, block], 50.0) == (("blk", PENDING),)
+        assert windows_cache_key([odcr, block], 150.0) == (("blk", OPEN),)
+        assert windows_cache_key([odcr, block], 250.0) == (("blk", EXPIRED),)
+
+    def test_expiry_darkens_the_tensor_column(self):
+        clk = FakeClock()
+        catalog = CatalogProvider(clock=clk,
+                                  pricing=PricingProvider(clock=clk))
+        itype = catalog.list()[0].name
+        zone = catalog.zones[0]
+        catalog.reservations.update([Reservation(
+            id="r", instance_type=itype, zone=zone, count=4,
+            end_s=1000.0,
+        )])
+        ti = catalog.tensors().names.index(itype)
+        ci = lbl.RESERVED_INDEX
+        assert catalog.tensors().available[ti, 0, ci]
+        clk.advance(1000.0)  # the window dies; only the CLOCK moved
+        t2 = catalog.tensors()
+        assert not t2.available[ti, 0, ci]
+        assert t2.price[ti, 0, ci] == np.inf
+
+
+class TestCheapestPriceRegression:
+    def _it(self, offerings):
+        catalog = CatalogProvider()
+        it = catalog.list()[0]
+        import dataclasses
+
+        return dataclasses.replace(it, offerings=offerings)
+
+    def test_exhausted_window_cannot_win_the_sort(self):
+        it = self._it([
+            Offering(zone="z1", capacity_type=lbl.CAPACITY_TYPE_ON_DEMAND,
+                     price=1.0, available=True),
+            # price 0, available=True, but zero slots remain: pre-fix this
+            # won every cheapest-price sort while selling nothing
+            Offering(zone="z1", capacity_type=lbl.CAPACITY_TYPE_RESERVED,
+                     price=0.0, available=True, remaining=0),
+        ])
+        assert it.cheapest_price() == 1.0
+
+    def test_expired_window_cannot_win_the_sort(self):
+        it = self._it([
+            Offering(zone="z1", capacity_type=lbl.CAPACITY_TYPE_ON_DEMAND,
+                     price=1.0, available=True),
+            Offering(zone="z1", capacity_type=lbl.CAPACITY_TYPE_RESERVED,
+                     price=0.0, available=True, remaining=3,
+                     expires_at=500.0),
+        ])
+        assert it.cheapest_price(now=600.0) == 1.0
+        # ... but the same window IS the cheapest while it lives
+        assert it.cheapest_price(now=400.0) == 0.0
+
+    def test_open_ended_reserved_still_wins(self):
+        it = self._it([
+            Offering(zone="z1", capacity_type=lbl.CAPACITY_TYPE_ON_DEMAND,
+                     price=1.0, available=True),
+            Offering(zone="z1", capacity_type=lbl.CAPACITY_TYPE_RESERVED,
+                     price=0.0, available=True, remaining=3),
+        ])
+        assert it.cheapest_price() == 0.0
+
+
+class TestStaleness:
+    def test_gauge_and_stale_event(self):
+        from karpenter_provider_aws_tpu.events import EventRecorder
+        from karpenter_provider_aws_tpu.metrics import PRICING_AGE
+
+        clk = FakeClock()
+        pricing = PricingProvider(clock=clk)
+        catalog = CatalogProvider(clock=clk, pricing=pricing)
+        rec = EventRecorder(clock=clk)
+        # never refreshed: static-catalog processes must not report/page
+        assert pricing.observe_staleness(recorder=rec) == {}
+        it = catalog.list()[0]
+        zone = it.offerings[0].zone
+        pricing.update_spot({(it.name, zone): 0.123})
+        clk.advance(10.0)
+        ages = pricing.observe_staleness(recorder=rec)
+        assert ages == {"spot": 10.0}
+        assert PRICING_AGE.value(source="spot") == 10.0
+        assert not [e for e in rec.events() if e.reason == "PricingStale"]
+        clk.advance(PRICING_STALE_TTL_S)
+        ages = pricing.observe_staleness(recorder=rec)
+        assert ages["spot"] > PRICING_STALE_TTL_S
+        stale = [e for e in rec.events() if e.reason == "PricingStale"]
+        assert stale and stale[0].type == "Warning"
+        assert PRICING_AGE.value(source="spot") == ages["spot"]
+
+    def test_reservation_windows_ride_discovery(self):
+        """The fake cloud's CapacityReservation window fields survive the
+        nodeclass-status publish into the reservation store — the path a
+        real capacity block takes into the tensors."""
+        res = Reservation(id="cb", instance_type="c7g.xlarge", zone="z",
+                          count=4, start_s=50.0, end_s=150.0,
+                          committed_price=0.25)
+        (w,) = windows_from_reservations([res])
+        assert (w.start_s, w.end_s, w.committed_price) == (50.0, 150.0, 0.25)
+        assert w.state_at(0.0) == PENDING
+        assert w.state_at(100.0) == OPEN
+        assert w.state_at(150.0) == EXPIRED
