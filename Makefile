@@ -4,7 +4,7 @@
 
 PYTEST ?= python -m pytest
 
-.PHONY: presubmit test deflake stress e2etests benchmark interruption-bench verify multichip native soak sidecar-client sim-smoke sim-sweep sim-cliff-smoke bench-gate bench-optimizer bench-market market-smoke chaos-smoke sim-replica-smoke sim-provision-smoke fleet-obs-smoke device-obs-smoke
+.PHONY: presubmit test deflake stress e2etests benchmark interruption-bench verify multichip native soak sidecar-client sim-smoke sim-sweep sim-cliff-smoke bench-gate bench-optimizer bench-market bench-gang market-smoke gang-smoke chaos-smoke sim-replica-smoke sim-provision-smoke fleet-obs-smoke device-obs-smoke
 
 presubmit: test multichip  ## everything CI gates on
 
@@ -74,11 +74,21 @@ bench-market:  ## cost-vs-oracle-under-moving-prices rows (cost_vs_oracle_market
 	JAX_PLATFORMS=cpu BENCH_FORCE_CPU=1 python bench.py --child=market
 	$(MAKE) bench-gate
 
+bench-gang:  ## gang-day fleet row (config10_gang_day: wall/day + zero partial gangs + fairness + zero retraces) -> BENCH_DETAIL.jsonl, then the gate
+	JAX_PLATFORMS=cpu BENCH_FORCE_CPU=1 python bench.py --child=gang
+	$(MAKE) bench-gate
+
 market-smoke:  ## 500-node market day (moving prices + a reserved-capacity window) fleet-gated: oracle-relative cost, zero sentinel findings, zero retraces after warmup
 	JAX_PLATFORMS=cpu python -m karpenter_provider_aws_tpu.sim run \
 		--trace market-day --seed 0 --report /tmp/fleet_report_market.json
 	python tools/fleet_gate.py /tmp/fleet_report_market.json \
 		--baseline karpenter_provider_aws_tpu/sim/baselines/market-500.json
+
+gang-smoke:  ## 500-node gang day (all-or-nothing training gangs + HA pairs + DaemonSet overhead + noisy tenant) fleet-gated: zero partial gangs, fairness ratio <= 2x, zero retraces after warmup
+	JAX_PLATFORMS=cpu python -m karpenter_provider_aws_tpu.sim run \
+		--trace gang-day --seed 0 --report /tmp/fleet_report_gang.json
+	python tools/fleet_gate.py /tmp/fleet_report_gang.json \
+		--baseline karpenter_provider_aws_tpu/sim/baselines/gang-500.json
 
 chaos-smoke:  ## every canned chaos scenario (incl. replica-loss), run twice, determinism diffed
 	JAX_PLATFORMS=cpu python -m karpenter_provider_aws_tpu.chaos --all --seed 0

@@ -665,6 +665,24 @@ def child_market() -> None:
         run_market(scale=scale, seeds=seeds, ticks=ticks, on_row=on_row)
 
 
+def child_gang() -> None:
+    """Gang-scheduling row (benchmarks/gang_bench.py): the 500-node
+    gang day through the real controller manager — wall per simulated
+    day PLUS the plane's promises (zero partial gangs, quiet-tenant
+    fairness ratio, zero retraces after warmup) in one stamped row.
+    config10_gang_day is gated by benchmarks/baselines/steady-state.json
+    via `make bench-gate`."""
+    _force_cpu_if_asked()
+    import contextlib
+
+    from benchmarks.gang_bench import run_all as run_gang
+
+    scale = float(os.environ.get("BENCH_GANG_SCALE", "1.0"))
+    on_row = _detail_writer({"run_at_unix": int(time.time()), "scale": scale})
+    with contextlib.redirect_stdout(sys.stderr):
+        run_gang(scale=scale, on_row=on_row)
+
+
 def child_jit() -> None:
     """Compile-ledger rows (benchmarks/jit_bench.py): cold-vs-warm
     compile count and wall per program family off the jitwatch ledger —
@@ -1012,6 +1030,7 @@ if __name__ == "__main__":
                  "provisioning": child_provisioning,
                  "optimizer": child_optimizer,
                  "market": child_market,
+                 "gang": child_gang,
                  "jit": child_jit}[child]()
             except Exception as e:
                 traceback.print_exc()

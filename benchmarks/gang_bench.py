@@ -1,0 +1,92 @@
+"""Gang-scheduling bench row: the 500-node gang day as a budgeted config.
+
+``config10_gang_day`` drives the canned ``gang-day`` trace (topology-
+spread training gangs, anti-affine HA pairs, per-node DaemonSet agents,
+a 3-tenant mix with a noisy-neighbor burst — designs/gang-scheduling.md)
+through the REAL controller manager and stamps one row carrying BOTH the
+perf headline (wall per simulated 24h day, like the sim_day family) and
+the plane's correctness gate outcomes: zero partially-placed gangs, the
+quiet-tenant fairness ratio, and zero retraces after warmup. A future
+perf PR that speeds the solver up but starts splitting gangs — or taxes
+quiet tenants under a noisy one — fails in the same row that celebrates
+the speedup (``make bench-gate`` via benchmarks/baselines/steady-state.json,
+require_stamp: true).
+
+Run directly: ``python -m benchmarks.gang_bench``; the bench harness
+runs it as ``bench.py --child=gang`` (``make bench-gang``).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def bench_gang_day(nodes: int = 500, seed: int = 0) -> dict:
+    from karpenter_provider_aws_tpu.sim import canned_trace, run_trace
+
+    spec = canned_trace("gang-day")
+    report = run_trace(spec, seed=seed, nodes=nodes)
+    gate = report.gate
+    wall = report.data["wall"]
+    gangs = report.data["virtual"].get("gangs", {})
+    sim_hours = spec.duration_s / 3600.0
+    per_day_ms = (wall["wall_s"] or 0.0) * 1e3 * (24.0 / sim_hours)
+    return {
+        "benchmark": "config10_gang_day",
+        "nodes": nodes,
+        "trace": "gang-day",
+        "seed": seed,
+        "sim_hours": round(sim_hours, 2),
+        "passes": report.data["virtual"]["driver"]["passes"],
+        "wall_ms": round(per_day_ms, 1),           # normalized to a 24h day
+        "wall_measured_s": wall["wall_s"],
+        # the gang plane's own promises, gated alongside the perf headline
+        "gangs_declared": gangs.get("declared_live", 0),
+        "gangs_placed": gate.get("gangs_placed", 0),
+        "gangs_partial": gate.get("gangs_partial", 0),
+        "tenant_bind_p99_ratio": gate.get("tenant_bind_p99_ratio", 0.0),
+        "retraces_after_warmup": gate.get("retraces_after_warmup", 0),
+        # the fleet-health context every sim row carries
+        "slo_worst_burn": gate["slo_worst_burn"],
+        "packing_eff_min": gate["packing_eff_min"],
+        "cost_vs_oracle_p95": gate["cost_vs_oracle_p95"],
+        "bind_p99_s": gate["pod_time_to_bind_p99_s"],
+        "invariants_failed": gate["invariants_failed"],
+        "signature": report.signature()[:16],
+        "device": "host",
+        "backend": "host",
+        "note": "full controller manager on FakeClock; wall_ms normalized "
+                "to a 24h simulated day; gang/fairness/retrace outcomes "
+                "gated with the perf headline",
+    }
+
+
+def run_all(scale: float = 1.0, on_row=None) -> list[dict]:
+    rows = []
+    row = bench_gang_day(nodes=max(int(500 * scale), 100))
+    rows.append(row)
+    print(json.dumps(row), flush=True)
+    if on_row is not None:
+        on_row(row)
+    return rows
+
+
+def main() -> None:
+    import os
+
+    from karpenter_provider_aws_tpu.trace.provenance import stamp_row
+
+    detail = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_DETAIL.jsonl",
+    )
+    at = {"run_at_unix": int(time.time()), "scale": 1.0}
+    with open(detail, "a") as f:
+        for row in run_all():
+            stamp_row(row)
+            f.write(json.dumps({**row, **at}) + "\n")
+
+
+if __name__ == "__main__":
+    main()

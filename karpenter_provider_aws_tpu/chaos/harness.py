@@ -240,8 +240,17 @@ class ChaosHarness:
         ))
 
     def _apply_workload(self, w) -> None:
-        for p in make_pods(w.pods, f"{w.name}-{int(w.at_s)}",
-                           {"cpu": w.cpu, "memory": w.memory}):
+        pods = make_pods(w.pods, f"{w.name}-{int(w.at_s)}",
+                         {"cpu": w.cpu, "memory": w.memory})
+        if getattr(w, "gang_min", 0) > 0:
+            from ..scheduling.groups import PodGroup
+
+            PodGroup(
+                name=f"{w.name}-{int(w.at_s)}", min_count=int(w.gang_min),
+                spread_skew=int(getattr(w, "spread_skew", 0)),
+                anti_affine=bool(getattr(w, "anti_affine", False)),
+            ).apply_to(pods)
+        for p in pods:
             self.env.cluster.apply(p)
         self.log.record(
             t=self.env.clock.now(), kind="Workload", service="cluster",

@@ -387,6 +387,27 @@ def check_no_fleet_thrash(harness) -> InvariantResult:
     return _result("no-fleet-thrash", rate <= allowed, detail)
 
 
+def check_gangs_atomic(harness) -> InvariantResult:
+    """All-or-nothing gangs stay all-or-nothing through faults: at settle
+    every declared PodGroup is either fully bound (>= its min_count) or
+    fully unbound — a partially-placed gang burns reserved accelerator
+    capacity with zero training progress, which is exactly what
+    ``scheduling/groups.enforce_gangs`` exists to prevent. Scenarios and
+    traces with no gang workloads self-skip."""
+    from ..scheduling.groups import gang_partial_counts
+
+    counts = gang_partial_counts(harness.env.cluster.pods.values())
+    if not counts:
+        return _result("gangs-atomic", True, "no gang pods: n/a")
+    partial = {g: bm for g, bm in counts.items() if 0 < bm[0] < bm[1]}
+    placed = sum(1 for b, m in counts.values() if b >= m)
+    detail = (
+        f"partially placed: {sorted(partial.items())[:4]}" if partial
+        else f"{placed}/{len(counts)} gangs fully placed, rest unbound"
+    )
+    return _result("gangs-atomic", not partial, detail)
+
+
 def check_controllers_healthy(harness) -> InvariantResult:
     errors = harness.env.manager.errors[harness.errors_baseline:]
     return _result(
@@ -410,6 +431,7 @@ INVARIANTS = (
     check_leases_partition_fleet,
     check_packing_envelope_parity,
     check_no_fleet_thrash,
+    check_gangs_atomic,
     check_controllers_healthy,
 )
 

@@ -69,17 +69,30 @@ class TimedFault:
 
 @dataclass
 class Workload:
-    """A wave of pending pods applied at ``at_s``."""
+    """A wave of pending pods applied at ``at_s``. ``gang_min > 0`` makes
+    the wave an all-or-nothing PodGroup (scheduling/groups.py): the gang
+    must place atomically even when a fault lands mid-placement — the
+    ``gangs-atomic`` invariant audits it at settle."""
 
     at_s: float = 0.0
     pods: int = 4
     cpu: str = "1"
     memory: str = "2Gi"
     name: str = "chaos"
+    gang_min: int = 0
+    spread_skew: int = 0
+    anti_affine: bool = False
 
     def to_dict(self) -> dict:
-        return {"at_s": self.at_s, "pods": self.pods, "cpu": self.cpu,
-                "memory": self.memory, "name": self.name}
+        d = {"at_s": self.at_s, "pods": self.pods, "cpu": self.cpu,
+             "memory": self.memory, "name": self.name}
+        if self.gang_min:
+            d["gang_min"] = self.gang_min
+        if self.spread_skew:
+            d["spread_skew"] = self.spread_skew
+        if self.anti_affine:
+            d["anti_affine"] = True
+        return d
 
     @classmethod
     def from_dict(cls, d: dict) -> "Workload":
@@ -87,6 +100,9 @@ class Workload:
             at_s=float(d.get("at_s", 0.0)), pods=int(d.get("pods", 4)),
             cpu=str(d.get("cpu", "1")), memory=str(d.get("memory", "2Gi")),
             name=str(d.get("name", "chaos")),
+            gang_min=int(d.get("gang_min", 0)),
+            spread_skew=int(d.get("spread_skew", 0)),
+            anti_affine=bool(d.get("anti_affine", False)),
         )
 
 

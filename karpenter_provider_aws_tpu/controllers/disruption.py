@@ -227,7 +227,7 @@ class DisruptionController:
         ):
             return False
         if node_name and any(
-            p.do_not_disrupt()
+            (p.do_not_disrupt() or p.gang_locked())
             for p in self.cluster.pods_on_nodes([node_name]).get(node_name, ())
         ):
             return False
@@ -341,7 +341,7 @@ class DisruptionController:
         seq0 = NODE_WRITE_SEQ.v  # BEFORE the version reads: over-invalidate
         ds.by_node = cluster.pods_by_node()
         ds.dnd_node = {
-            name: any(p.do_not_disrupt() for p in pods)
+            name: any((p.do_not_disrupt() or p.gang_locked()) for p in pods)
             for name, pods in ds.by_node.items()
         }
         ds.node_vers = {
@@ -499,7 +499,7 @@ class DisruptionController:
                     ds.by_node[name] = pods
                 else:
                     ds.by_node.pop(name, None)
-                ds.dnd_node[name] = any(p.do_not_disrupt() for p in pods)
+                ds.dnd_node[name] = any((p.do_not_disrupt() or p.gang_locked()) for p in pods)
                 if node.nodeclaim_name:
                     dirty_claims[node.nodeclaim_name] = None
                 if cname and cname != node.nodeclaim_name:
@@ -748,7 +748,7 @@ class DisruptionController:
         else:
             by_node = self.cluster.pods_by_node()
             dnd_node = {
-                name: any(p.do_not_disrupt() for p in pods)
+                name: any((p.do_not_disrupt() or p.gang_locked()) for p in pods)
                 for name, pods in by_node.items()
             }
             cn = list(self._claims_with_nodes(by_node, dnd_node))
@@ -782,7 +782,7 @@ class DisruptionController:
                     dnd_node.get(node.name, False)
                     if dnd_node is not None
                     else any(
-                        p.do_not_disrupt()
+                        (p.do_not_disrupt() or p.gang_locked())
                         for p in pods_by_node.get(node.name, ())
                     )
                 )
@@ -874,7 +874,7 @@ class DisruptionController:
                 dnd_node.get(node.name, False)
                 if dnd_node is not None
                 else any(
-                    p.do_not_disrupt() for p in pods_by_node.get(node.name, ())
+                    (p.do_not_disrupt() or p.gang_locked()) for p in pods_by_node.get(node.name, ())
                 )
             ):
                 node = None
@@ -1018,7 +1018,7 @@ class DisruptionController:
                 dnd_node.get(node.name, False)
                 if dnd_node is not None
                 else any(
-                    p.do_not_disrupt() for p in pods_by_node.get(node.name, ())
+                    (p.do_not_disrupt() or p.gang_locked()) for p in pods_by_node.get(node.name, ())
                 )
             ):
                 node = None

@@ -325,6 +325,15 @@ class ProvisioningController:
             for pool in nodepools
         }
         nodeclass_by_pool = self.cluster.nodeclass_by_pool(nodepools)
+        # already-bound gang members credit their gang's all-or-nothing
+        # floor, so a partially-bound gang's stragglers can complete
+        # (scheduling/groups.enforce_gangs); one O(pods) pass, only when a
+        # pending pod actually carries a gang annotation
+        from ..models.pod import gangs_enabled as _gangs_enabled
+
+        gang_bound = None
+        if _gangs_enabled() and any(p.gang_name() for p in pending):
+            gang_bound = self.cluster.gang_bound_counts()
         with self.profiler.capture("solve"):
             result = self.solver.solve(
                 pending,
@@ -345,6 +354,7 @@ class ProvisioningController:
                 # per-pool nodeclass: ephemeral-storage capacity follows its
                 # root volume + instanceStorePolicy (types.go:218-244)
                 nodeclass_by_pool=nodeclass_by_pool,
+                gang_bound=gang_bound,
             )
         from ..metrics import SOLVE_DURATION, SOLVE_PODS
 

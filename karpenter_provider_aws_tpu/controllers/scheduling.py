@@ -242,6 +242,12 @@ class SchedulingController:
         for pod in pending:
             if pod.uid in nominated:
                 continue
+            if pod.gang_locked():
+                # armed gang members place ONLY through the solver's
+                # all-or-nothing commit gate (scheduling/groups.py): a
+                # one-pod-at-a-time first-fit binder cannot place a group
+                # atomically, and binding part of one strands the gang
+                continue
             reqs = pod.requirements()
             fit_rows = np.nonzero(
                 ~((pod.requests.v > fmat + 1e-6).any(axis=1))

@@ -436,6 +436,16 @@ UNSCHEDULABLE_PODS = REGISTRY.counter(
     "Pods a solve pass left unschedulable (solver-quality SLI; the "
     "per-pod reasons ride the audit log and FailedScheduling events)",
 )
+GANG_PLACEMENTS = REGISTRY.counter(
+    "karpenter_gang_placements_total",
+    "Pod groups committed atomically — every member placed in one solve "
+    "(the all-or-nothing gate in scheduling/groups.enforce_gangs)",
+)
+GANG_WITHHELD = REGISTRY.counter(
+    "karpenter_gang_withheld_total",
+    "Pod groups stripped WHOLE by the all-or-nothing commit gate because "
+    "fewer than min_count members were placeable this solve",
+)
 LEADER = REGISTRY.gauge(
     "karpenter_leader",
     "1 when this replica holds the leader lease, else 0 (by identity). "

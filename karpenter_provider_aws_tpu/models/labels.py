@@ -57,6 +57,17 @@ ANNOTATION_NODECLASS_HASH_VERSION = f"{GROUP}/nodeclass-hash-version"
 ANNOTATION_INSTANCE_TAGGED = f"{GROUP}/tagged"
 ANNOTATION_DO_NOT_DISRUPT = "karpenter.sh/do-not-disrupt"
 
+# Gang scheduling (designs/gang-scheduling.md). The gang identity rides
+# ANNOTATIONS, never the scheduling key: an annotation write bumps neither
+# Pod._version nor the interned scheduling token, so a disarmed run
+# (``KARPENTER_TPU_GANGS=0``) is byte-identical to a world where the
+# annotations were never stamped.
+ANNOTATION_POD_GROUP = f"{GROUP}/pod-group"
+ANNOTATION_POD_GROUP_MIN = f"{GROUP}/pod-group-min"
+# Tenant identity for per-tenant fairness SLOs (a LABEL: selectors and the
+# sim's fairness accounting both match on it; stamped at pod creation).
+TENANT_LABEL = f"{GROUP}/tenant"
+
 # Bump whenever a field joins the NodeClass static hash: the hash
 # controller then RE-STAMPS existing claims' annotations instead of
 # letting the new field's presence falsely drift-flag the whole fleet

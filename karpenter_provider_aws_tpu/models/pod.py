@@ -32,6 +32,32 @@ _token_counter = itertools.count()
 # Pod.group_token). Same never-renumber rule as _TOKEN_INTERN.
 _GROUP_INTERN: dict[tuple, int] = {}
 _group_counter = itertools.count()
+# gang name -> 1-based ordinal (0 = "no gang", the zero-fill-safe sentinel
+# for the encoders' node_gang column). Never renumbered, same rule as the
+# token interns: a gang re-interned under a fresh ordinal would make two
+# encodes of the same cluster disagree about node_gang.
+_GANG_INTERN: dict[str, int] = {}
+
+
+def gangs_enabled() -> bool:
+    """Kill switch for the gang-scheduling plane (scheduling/groups.py):
+    ``KARPENTER_TPU_GANGS=0`` makes every gang annotation inert — grouping,
+    encoding, solve enforcement, and disruption locking all read this, so a
+    disarmed run is byte-identical to pre-gang behavior."""
+    import os
+
+    return os.environ.get("KARPENTER_TPU_GANGS", "1") == "1"
+
+
+def gang_ordinal(name: str) -> int:
+    """Process-interned 1-based ordinal for a gang name (0 for none)."""
+    if not name:
+        return 0
+    with _TOKEN_LOCK:
+        o = _GANG_INTERN.get(name)
+        if o is None:
+            o = _GANG_INTERN[name] = len(_GANG_INTERN) + 1
+    return o
 
 
 class _Seq:
@@ -219,6 +245,32 @@ class Pod:
 
     def is_pending(self) -> bool:
         return self.phase == "Pending" and not self.node_name
+
+    # -- gang views (designs/gang-scheduling.md) ---------------------------
+    def gang_name(self) -> str:
+        """Gang identity, or "" — annotation-carried, scheduling-key-inert."""
+        return self.annotations.get(lbl.ANNOTATION_POD_GROUP, "")
+
+    def gang_min(self) -> int:
+        """All-or-nothing floor: a gang with fewer than this many members
+        placed must place NONE (scheduling/groups.enforce_gangs)."""
+        try:
+            return int(self.annotations.get(lbl.ANNOTATION_POD_GROUP_MIN, "0"))
+        except ValueError:
+            return 0
+
+    def gang_ordinal(self) -> int:
+        """Interned gang ordinal (0 = no gang) for the node_gang tensor
+        column; intentionally NOT gated on ``gangs_enabled()`` so the
+        column is a pure function of cluster content (the kill switch
+        gates consumers, not the encoding of identity)."""
+        return gang_ordinal(self.gang_name())
+
+    def gang_locked(self) -> bool:
+        """True when disruption must treat this pod's node atomically: a
+        live gang member may never be consolidated out from under its
+        gang. Shares the blocked-predicate seam with do_not_disrupt()."""
+        return bool(self.annotations.get(lbl.ANNOTATION_POD_GROUP)) and gangs_enabled()
 
     # -- topology views ----------------------------------------------------
     def hostname_cap(self) -> int:

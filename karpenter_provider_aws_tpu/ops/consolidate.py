@@ -85,6 +85,8 @@ class ClusterTensors:
     zones: list = field(default_factory=list)             # zone vocabulary
     node_zone_idx: np.ndarray = None     # [N] int32 index into zones
     node_captype: list = field(default_factory=list)      # [N] capacity types
+    node_gang: np.ndarray = None         # [N] int32 MAX gang ordinal among the
+    #                                      node's pods (0 = no gang member)
 
     def has_topology(self) -> bool:
         return bool((self.mpn < _UNCAPPED).any()) or any(
@@ -181,6 +183,7 @@ def _encode_cluster(cluster, catalog, gmax: int,
 
     blocked = np.zeros(N, dtype=bool)
     disruption_cost = np.zeros(N, dtype=np.float32)
+    node_gang = np.zeros(N, dtype=np.int32)
     used_total = np.zeros((N, NUM_RESOURCES), dtype=np.float32)
     group_ids = np.zeros((N, gmax), dtype=np.int32)
     group_counts = np.zeros((N, gmax), dtype=np.int32)
@@ -210,10 +213,18 @@ def _encode_cluster(cluster, catalog, gmax: int,
         # candidates (single-replace still moves the whole node's pods to
         # one replacement, which is sound, but blocked gates both)
         flags = np.fromiter(
-            (p.do_not_disrupt() or p.hostname_colocated() for p in pods_flat),
+            (p.do_not_disrupt() or p.hostname_colocated() or p.gang_locked()
+             for p in pods_flat),
             dtype=bool, count=P,
         )
         np.logical_or.at(blocked, node_idx, flags)
+        # MAX gang ordinal per node (0 = none): consolidation treats a live
+        # gang's nodes atomically, and the incremental encoder must patch
+        # to the exact same column (_fill_row uses the same max rule)
+        ords = np.fromiter(
+            (p.gang_ordinal() for p in pods_flat), dtype=np.int32, count=P,
+        )
+        np.maximum.at(node_gang, node_idx, ords)
         # (node, group) multiset -> per-node slots + [G, N] counts via one
         # unique over packed pairs (already sorted by node, then group)
         pair = node_idx * G + gidx
@@ -350,7 +361,12 @@ def _encode_cluster(cluster, catalog, gmax: int,
         node_zone.append(z)
         node_zone_idx[ni] = zidx[z]
 
-    free = np.stack([n.allocatable.v for n in nodes]).astype(np.float32) - used_total
+    from . import overhead as _overhead
+
+    alloc = _overhead.apply(
+        np.stack([n.allocatable.v for n in nodes]).astype(np.float32)
+    )
+    free = alloc - used_total
     price = np.zeros(N, dtype=np.float32)
     # price memo per (type, zone, captype): thousands of nodes collapse to
     # the distinct offerings actually running
@@ -401,6 +417,7 @@ def _encode_cluster(cluster, catalog, gmax: int,
         zones=zone_names,
         node_zone_idx=node_zone_idx,
         node_captype=[n.capacity_type() for n in nodes],
+        node_gang=node_gang,
     )
 
 

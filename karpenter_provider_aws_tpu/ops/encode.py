@@ -501,8 +501,17 @@ def _problem_cache_key(pods, catalog, nodepool, occupancy, allowed_types,
         # scheduling-field reassignment (Pod.__setattr__) so a mutated pod
         # can never be served its stale encoding
         pods_key = (tuple(map(id, pods)), tuple(p._version for p in pods))
+    # the gang plane changes GROUPING (per-gang groups when armed) and the
+    # DaemonSet overhead changes CAPACITY; both are process state outside
+    # the pod/catalog keys, so they participate explicitly — flipping the
+    # kill switch or re-registering agents can never serve a stale encoding
+    from ..models.pod import gangs_enabled as _gangs_enabled
+    from . import overhead as _overhead
+
     return (
         pods_key,
+        _gangs_enabled(),
+        _overhead.seq(),
         # catalog.uid, not id(catalog): the cached problem does not keep the
         # catalog alive, so a freed catalog's address could be reused
         catalog.uid,
@@ -574,8 +583,12 @@ def encode_problem(
     # Effective per-type capacity: ephemeral-storage follows the pool's
     # NODECLASS (GetInstanceTypes is per-NodePool + nodeclass in the
     # reference for exactly this reason). Computed HERE so the per-pod fit
-    # prefilter and the solve tensor agree.
-    cap_eff = effective_capacity(tensors.capacity, types, nodeclass)
+    # prefilter and the solve tensor agree. Per-node agent reservations
+    # (ops/overhead.py) come off every candidate type the same way — a
+    # fresh node pays its DaemonSets before the first workload pod lands.
+    from . import overhead as _overhead
+
+    cap_eff = _overhead.apply(effective_capacity(tensors.capacity, types, nodeclass))
 
     # Per-problem offering availability: the reserved axis is masked down to
     # the pairs this pool may use; price/compat/type_window all derive from
@@ -604,9 +617,23 @@ def encode_problem(
     # tolerations, topology), so taint/compat checks on 50k pods collapse to
     # checks on ~dozens of groups — this is the per-pod loop the TPU design
     # moves off the hot path (SURVEY.md section 7).
-    raw_groups: dict[int, list[Pod]] = {}  # keyed by interned scheduling token
+    # Keyed by interned scheduling token — plus the gang ordinal when the
+    # gang plane is armed: equal-shaped pods from DIFFERENT gangs must not
+    # share a group, or the decoder's cursor could attribute one gang's
+    # placements to another and the all-or-nothing commit would strip the
+    # wrong members. Disarmed, the key degenerates to the legacy token
+    # (gang annotations are invisible), preserving byte-identical plans.
+    from ..models.pod import gangs_enabled as _gangs_enabled
+
+    gangs_on = _gangs_enabled()
+    raw_groups: dict = {}
     for pod in pods:
-        raw_groups.setdefault(pod.scheduling_token(), []).append(pod)
+        key = (
+            (pod.scheduling_token(), pod.gang_ordinal())
+            if gangs_on
+            else pod.scheduling_token()
+        )
+        raw_groups.setdefault(key, []).append(pod)
     groups: dict[int, list[Pod]] = {}
     unencodable: list[tuple[Pod, str]] = []
     for key, plist in raw_groups.items():

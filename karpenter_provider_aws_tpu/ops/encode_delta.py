@@ -44,6 +44,7 @@ import numpy as np
 
 from ..models import labels as lbl
 from ..models.resources import NUM_RESOURCES
+from . import overhead as _overhead
 from .encode import _count_encode_cache, _ladder_bucket
 
 _UNCAPPED = 1 << 30
@@ -91,6 +92,16 @@ class _EncoderState:
         self.price = np.zeros(0, dtype=np.float32)
         self.zidx = np.zeros(0, dtype=np.int32)
         self.row_class = np.zeros(0, dtype=np.int64)
+        # max interned gang ordinal among the row's pods (0 = none): the
+        # node_gang column disruption uses to treat a gang's nodes as one
+        # unit (designs/gang-scheduling.md). int32 like zidx; ladder-padded
+        # with the node axis so arming gangs never moves tensor shapes.
+        self.gang = np.zeros(0, dtype=np.int32)
+        # process-state fingerprints folded into state validity: flipping
+        # the gang kill switch or re-registering DaemonSet overhead must
+        # force a full rebuild, not patch around stale blocked/alloc rows
+        self.gangs_armed = None
+        self.overhead_seq = None
         # -- group axis (slots [0, g_hi); refcount 0 == zombie) ------------
         self.GB = 0
         self.g_hi = 0
@@ -155,6 +166,7 @@ class _EncoderState:
         self.price = padn(self.price, 0)
         self.zidx = padn(self.zidx, 0)
         self.row_class = padn(self.row_class, 0)
+        self.gang = padn(self.gang, 0)
         self.gnc = padn(self.gnc, 1)
         self.compat = padn(self.compat, 1)
         for lst, fill in (
@@ -200,7 +212,7 @@ class _EncoderState:
         keep = np.flatnonzero(self.live[: self.n_hi])
         k = len(keep)
         for a_name in ("alloc", "used", "dcost", "blocked", "price",
-                       "zidx", "row_class", "live"):
+                       "zidx", "row_class", "gang", "live"):
             a = getattr(self, a_name)
             out = np.zeros_like(a)
             out[:k] = a[keep]
@@ -425,6 +437,7 @@ def _clear_row_pods(state: _EncoderState, row: int) -> None:
     state.used[row] = 0.0
     state.dcost[row] = 0.0
     state.blocked[row] = False
+    state.gang[row] = 0
 
 
 def _remove_row(state: _EncoderState, row: int) -> None:
@@ -475,13 +488,16 @@ def _fill_row(state: _EncoderState, cluster, catalog, row, node, claim,
         state.membership_changed = True
         state.zidx[row] = zi
     state.row_captype[row] = node.capacity_type()
-    state.alloc[row] = np.asarray(node.allocatable.v).astype(np.float32)
+    state.alloc[row] = _overhead.apply(
+        np.asarray(node.allocatable.v).astype(np.float32)
+    )
     # pods -> groups; accumulate in pod order with float32 adds, exactly
     # like the full encoder's np.add.at, so values are byte-identical
     d: dict[int, list] = {}
     used = np.zeros(NUM_RESOURCES, dtype=np.float32)
     dcost = np.float32(0.0)
     blocked = False
+    gang = 0
     for p in plist:
         d.setdefault(p.group_token(), []).append(p)
     state.row_tokens[row] = d
@@ -496,10 +512,12 @@ def _fill_row(state: _EncoderState, cluster, catalog, row, node, claim,
         dcost = np.float32(
             dcost + np.float32(1.0 + p.deletion_cost() + p.priority / 1000.0)
         )
-        if p.do_not_disrupt() or p.hostname_colocated():
+        if p.do_not_disrupt() or p.hostname_colocated() or p.gang_locked():
             blocked = True
+        gang = max(gang, p.gang_ordinal())
     state.used[row] = used
     state.dcost[row] = dcost
+    state.gang[row] = gang
     blocked = blocked or len(d) > state.gmax
     hit = _node_price(state, catalog, node)
     if hit != hit:  # NaN: type missing from the catalog snapshot
@@ -671,6 +689,7 @@ def _emit(state: _EncoderState):
         zones=zones_e,
         node_zone_idx=node_zone_idx,
         node_captype=[state.row_captype[i] for i in rows],
+        node_gang=state.gang[rows].copy(),
     )
     state.emitted = out
     state.emit_pos = {int(r): k for k, r in enumerate(rows)}
@@ -857,6 +876,12 @@ def _emit_fast(state: _EncoderState, prev, dirty_rows: list[int]):
     used[pos_a] = state.used[rows_a]
     dcost[pos_a] = state.dcost[rows_a]
     blocked[pos_a] = state.blocked[rows_a]
+    gang = (
+        prev.node_gang.copy()
+        if prev.node_gang is not None
+        else np.zeros(len(prev.node_names), dtype=np.int32)
+    )
+    gang[pos_a] = state.gang[rows_a]
     for r, pos in zip(dirty_rows, pos_a):
         pools[pos] = state.row_pool[r]
         captype[pos] = state.row_captype[r]
@@ -923,6 +948,7 @@ def _emit_fast(state: _EncoderState, prev, dirty_rows: list[int]):
         zones=prev.zones,
         node_zone_idx=prev.node_zone_idx,
         node_captype=captype,
+        node_gang=gang,
     )
     state.emitted = out
     state.touched_gids = set()
@@ -958,6 +984,10 @@ def _full_build(state: _EncoderState, cluster, catalog, gmax,
     state.node_seq = seq0
     state.catalog_key = catalog.cache_key()
     state.passes_since_full = 0
+    from ..models.pod import gangs_enabled as _gangs_enabled
+
+    state.gangs_armed = _gangs_enabled()
+    state.overhead_seq = _overhead.seq()
     # every node NOT in the encoding is parked with its current version so
     # direct-mutation flips back to eligibility are caught by the scan
     # (``node_filter`` scopes a PARTITION encoder to its own nodes — it
@@ -983,6 +1013,8 @@ def _full_build(state: _EncoderState, cluster, catalog, gmax,
     state.used[:N] = ct.used_total
     state.dcost[:N] = ct.disruption_cost
     state.blocked[:N] = ct.blocked
+    if ct.node_gang is not None:
+        state.gang[:N] = ct.node_gang
     alloc_rows = []
     for i, name in enumerate(ct.node_names):
         node = nodes.get(name)
@@ -995,7 +1027,12 @@ def _full_build(state: _EncoderState, cluster, catalog, gmax,
             state.row_nver[i] = node._version
             state.row_claim[i] = node.nodeclaim_name
             state.claim_row[node.nodeclaim_name] = i
-            alloc_rows.append(node.allocatable.v)
+            # net of the per-node agent reservation, same as _fill_row —
+            # the torn branch below is ALREADY net (ct.free is), so the
+            # overhead applies per live row, never to the stack
+            alloc_rows.append(_overhead.apply(
+                np.asarray(node.allocatable.v, dtype=np.float32)
+            ))
         else:  # torn snapshot: reconstruct so free still emits exactly
             alloc_rows.append(ct.free[i] + ct.used_total[i])
     state.alloc[:N] = np.stack(alloc_rows).astype(np.float32)
@@ -1154,6 +1191,15 @@ def incremental_encode_cluster(cluster, catalog, gmax, pods_by_node=None,
             mode, cause = "full", "catalog"
         elif state.passes_since_full >= _refresh_every() > 0:
             mode, cause = "full", "refresh_interval"
+        else:
+            from ..models.pod import gangs_enabled as _gangs_enabled
+
+            if (state.gangs_armed != _gangs_enabled()
+                    or state.overhead_seq != _overhead.seq()):
+                # the gang kill switch flipped or the per-node agent
+                # reservation changed: every row's blocked/gang/alloc
+                # content is suspect, not just the journaled ones
+                mode, cause = "full", "gang_plane"
         changes = None
         if mode != "full":
             changes = cluster.changes_since(state.rev)
@@ -1246,6 +1292,7 @@ def canonical_form(ct) -> Optional[dict]:
         "used": ct.used_total[node_order],
         "dcost": ct.disruption_cost[node_order],
         "blocked": ct.blocked[node_order],
+        "gang": ct.node_gang[node_order] if ct.node_gang is not None else None,
         "captype": [ct.node_captype[i] for i in node_order] if ct.node_captype else [],
         "zone": [ct.node_zone[i] for i in node_order],
         "tokens": sorted(tokens),

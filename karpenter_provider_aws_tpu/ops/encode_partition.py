@@ -466,6 +466,11 @@ def _merge_full(pstate: _PartitionedEncoder, cluster, parts):
     used = np.concatenate([ct.used_total for _, ct in parts])
     dcost = np.concatenate([ct.disruption_cost for _, ct in parts])
     blocked = np.concatenate([ct.blocked for _, ct in parts])
+    gang = np.concatenate([
+        ct.node_gang if ct.node_gang is not None
+        else np.zeros(len(ct.node_names), dtype=np.int32)
+        for _, ct in parts
+    ]).astype(np.int32)
 
     # merged slot width = the widest part's live width (parts emit
     # ladder-trimmed tables — encode_delta._emit_slot_width)
@@ -568,6 +573,7 @@ def _merge_full(pstate: _PartitionedEncoder, cluster, parts):
         zones=zones,
         node_zone_idx=node_zone_idx,
         node_captype=captype,
+        node_gang=gang,
     )
     _stamp(pstate, out, parts)
     pstate.merged = out
@@ -593,6 +599,11 @@ def _merge_fast(pstate: _PartitionedEncoder, cluster, parts, changed):
     used = prev.used_total.copy()
     dcost = prev.disruption_cost.copy()
     blocked = prev.blocked.copy()
+    gang = (
+        prev.node_gang.copy()
+        if prev.node_gang is not None
+        else np.zeros(len(prev.node_names), dtype=np.int32)
+    )
     pools = list(prev.nodepool_names)
     captype = list(prev.node_captype)
     gnc = prev.group_node_count.copy()
@@ -628,6 +639,10 @@ def _merge_fast(pstate: _PartitionedEncoder, cluster, parts, changed):
         used[cols] = ct.used_total
         dcost[cols] = ct.disruption_cost
         blocked[cols] = ct.blocked
+        gang[cols] = (
+            ct.node_gang if ct.node_gang is not None
+            else np.zeros(n, dtype=np.int32)
+        )
         pools[off:off + n] = ct.nodepool_names
         captype[off:off + n] = ct.node_captype
         toks = pstate.part_tokens[key]
@@ -706,6 +721,7 @@ def _merge_fast(pstate: _PartitionedEncoder, cluster, parts, changed):
         zones=prev.zones,
         node_zone_idx=prev.node_zone_idx,
         node_captype=captype,
+        node_gang=gang,
     )
     out.__dict__["_patch_base"] = prev
     out.__dict__["_patch_positions"] = (

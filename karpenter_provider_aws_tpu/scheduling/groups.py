@@ -1,0 +1,311 @@
+"""Gang scheduling: all-or-nothing pod groups as a first-class solver plane.
+
+The TPU-native workload is a multi-node training job: N replicas that are
+useless unless ALL of them run (a partially-placed gang burns reserved
+accelerator capacity while the job makes no progress). This module is the
+declarative surface and the commit-time enforcement for that contract
+(designs/gang-scheduling.md):
+
+- ``PodGroup`` declares a gang (id, min_count, optional zone-spread skew
+  cap, optional anti-affinity, tenant) and ``apply_to`` lowers it onto
+  pods at creation: the gang identity rides ANNOTATIONS (scheduling-key
+  inert, so the ``KARPENTER_TPU_GANGS=0`` kill switch restores
+  byte-identical legacy plans), while spread/anti-affinity materialize as
+  the ordinary ``TopologySpreadConstraint``/``PodAffinityTerm`` objects
+  the encoder already lowers to zone windows and hostname caps — FFD, the
+  optimizer LP lane, and the consolidation repack screen all reuse the
+  same masks with zero new device code.
+
+- ``gang_feasible`` is the device-side verdict: a vmapped-segment-sum
+  reduction over ladder-padded (values-move-shapes-don't) per-pod gang
+  ordinals producing per-gang placed counts, compared against min_count.
+  It is tracked under the ``gangs.feasible`` jit family so the PR 14
+  zero-retrace gates cover it.
+
+- ``enforce_gangs`` is the host-validated commit: called once per solve in
+  ``_solve_multi_nodepool`` after every pool round and preference
+  relaxation, it strips EVERY member of any gang whose placed count fell
+  below min_count from the plan (specs and binds), so a partial gang can
+  never reach the launch path. The host count is authoritative; the
+  device verdict is the accelerated screen.
+
+Disruption atomicity rides the shared blocked-predicate seam:
+``Pod.gang_locked()`` joins ``do_not_disrupt()`` at every consolidation /
+disruption decision point, so a live gang's nodes are never repacked out
+from under it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import labels as lbl
+from ..models.pod import (  # noqa: F401 (re-exported: the plane's one import point)
+    Pod,
+    PodAffinityTerm,
+    TopologySpreadConstraint,
+    gang_ordinal,
+    gangs_enabled,
+)
+from ..trace.jitwatch import tracked_jit
+
+
+def _ladder(n: int, minimum: int = 8) -> int:
+    """Next value >= n on the {2^k, 1.5*2^k} bucket ladder — the same
+    values-move-shapes-don't padding rule the solver uses, so gang axes
+    never mint compile buckets the ledger hasn't seen scale before."""
+    p = minimum
+    while True:
+        if n <= p:
+            return p
+        if n <= p * 3 // 2:
+            return p * 3 // 2
+        p *= 2
+
+
+@dataclass
+class PodGroup:
+    """One declared gang. ``min_count`` defaults to the full member count
+    at ``apply_to`` time (strict all-or-nothing); a smaller floor models
+    elastic jobs that tolerate stragglers."""
+
+    name: str
+    min_count: int = 0
+    # DoNotSchedule zone topology spread with this skew cap (0 = none):
+    # the training gang's "spread across fault domains" shape.
+    spread_skew: int = 0
+    # Required self-matching zone anti-affinity (HA pairs: at most one
+    # member per zone). Mutually exclusive with spread_skew in practice;
+    # both lower onto the standard constraint objects if set.
+    anti_affine: bool = False
+
+    def apply_to(self, pods: Sequence[Pod]) -> Sequence[Pod]:
+        """Stamp the gang identity (always) and materialize its topology
+        constraints (only while armed) onto freshly created pods.
+
+        Must run before the pods are first encoded: constraints are
+        scheduling-KEY fields, and the sanctioned-mutation contract stamps
+        them at creation, never on live pods. Annotations are stamped
+        unconditionally — they are inert until a consumer runs armed —
+        while the selector LABEL and the constraint objects exist only
+        when armed, which is exactly what makes the kill switch
+        byte-exact (labels participate in group_token; annotations do
+        not participate in anything).
+        """
+        mincnt = self.min_count or len(pods)
+        sel = {lbl.ANNOTATION_POD_GROUP: self.name}
+        for p in pods:
+            p.annotations[lbl.ANNOTATION_POD_GROUP] = self.name
+            p.annotations[lbl.ANNOTATION_POD_GROUP_MIN] = str(mincnt)
+        if gangs_enabled():
+            if self.spread_skew or self.anti_affine:
+                for p in pods:
+                    labels = dict(p.labels)
+                    labels[lbl.ANNOTATION_POD_GROUP] = self.name
+                    p.labels = labels  # reassignment: versions bump correctly
+            if self.spread_skew:
+                c = TopologySpreadConstraint(
+                    topology_key=lbl.TOPOLOGY_ZONE,
+                    max_skew=max(int(self.spread_skew), 1),
+                    when_unsatisfiable="DoNotSchedule",
+                    label_selector=sel,
+                )
+                for p in pods:
+                    p.topology_spread = list(p.topology_spread) + [c]
+            if self.anti_affine:
+                t = PodAffinityTerm(
+                    topology_key=lbl.TOPOLOGY_ZONE, label_selector=sel
+                )
+                for p in pods:
+                    p.anti_affinity = list(p.anti_affinity) + [t]
+        return pods
+
+
+# ---------------------------------------------------------------------------
+# device-side feasibility
+# ---------------------------------------------------------------------------
+
+@tracked_jit(family="gangs.feasible", static_argnames=("num_gangs",))
+def _gang_counts(gidx: jnp.ndarray, placed: jnp.ndarray, num_gangs: int) -> jnp.ndarray:
+    """[NG] placed-member count per gang ordinal slot via one segment-sum
+    over the ladder-padded pod axis (padding rides ordinal slot 0, which
+    is reserved for "no gang" and never read)."""
+    return jax.ops.segment_sum(
+        placed.astype(jnp.int32), gidx, num_segments=num_gangs
+    )
+
+
+def warm_gang_kernels(max_pods: int = 64, max_gangs: int = 8) -> None:
+    """Pre-trace ``gangs.feasible`` at every pod-axis ladder bucket up to
+    ``max_pods`` (and the base gang-axis bucket), so arming gangs mid-run
+    never mints a first compile — or a bucket step — after the jitwatch
+    warmup boundary. Idempotent per process; callers with a warmup phase
+    (the fleet simulator's build step) invoke it before events flow."""
+    sizes, v = [], 8
+    while v <= max_pods:
+        sizes.append(v)
+        if v * 3 // 2 <= max_pods:
+            sizes.append(v * 3 // 2)
+        v *= 2
+    gb = _ladder(max(max_gangs, 1))
+    mins = np.ones(gb, dtype=np.int32)
+    for pb in sizes:
+        gang_feasible(np.zeros(pb, dtype=np.int32),
+                      np.zeros(pb, dtype=np.int32), mins)
+
+
+def gang_feasible(
+    gang_idx: np.ndarray,    # [P] per-pod gang ordinal slot (0 = none)
+    placed: np.ndarray,      # [P] bool/int: pod landed in the plan
+    min_counts: np.ndarray,  # [NG] per-slot all-or-nothing floor
+) -> np.ndarray:
+    """[NG] bool: gang slot is atomically satisfiable as placed (count is
+    0 — nothing to strip — or >= its floor). Pod and gang axes are both
+    ladder-padded so repeated solves at nearby fleet sizes reuse one
+    compiled program."""
+    ng = len(min_counts)
+    if ng == 0:
+        return np.zeros(0, dtype=bool)
+    pb = _ladder(max(len(gang_idx), 1))
+    gb = _ladder(max(ng, 1))
+    gi = np.zeros(pb, dtype=np.int32)
+    gi[: len(gang_idx)] = gang_idx
+    pl = np.zeros(pb, dtype=np.int32)
+    pl[: len(placed)] = np.asarray(placed, dtype=np.int32)
+    counts = np.asarray(_gang_counts(gi, pl, gb))[:ng]
+    mins = np.asarray(min_counts, dtype=np.int32)
+    return (counts == 0) | (counts >= mins)
+
+
+# ---------------------------------------------------------------------------
+# host-validated commit
+# ---------------------------------------------------------------------------
+
+def _plan_pods(result) -> list[tuple[Pod, Optional[object], Optional[int]]]:
+    """Every placed pod with its container: (pod, spec_or_None, bind_idx)."""
+    out = []
+    for spec in result.node_specs:
+        for p in spec.pods:
+            out.append((p, spec, None))
+    for i, (p, _node) in enumerate(result.binds):
+        out.append((p, None, i))
+    return out
+
+
+def enforce_gangs(result, bound=None) -> list[tuple[Pod, str]]:
+    """All-or-nothing commit gate over a finished SolveResult.
+
+    Counts placed members per gang (device screen + authoritative host
+    recount), then strips every member of each under-floor gang from the
+    plan: launches lose the pods (an emptied NodeSpec is dropped whole,
+    and a partially-emptied one keeps its node for the survivors), binds
+    are removed, and the stripped pods are returned with a reason so the
+    caller marks them unschedulable as one unit. Mutates ``result``.
+
+    ``bound`` (gang name -> live bound member count, from
+    ``Cluster.gang_bound_counts``) credits members ALREADY RUNNING toward
+    each gang's floor. Without the credit a gang that partially binds —
+    the plan placed everyone but a flood consumed the launched capacity
+    before the stragglers landed — could never complete: every later
+    solve would see fewer pending members than min_count and withhold
+    them forever.
+    """
+    bound = bound or {}
+    plan = _plan_pods(result)
+    if not plan:
+        return []
+    # gang ordinal -> contiguous slot; slot 0 stays "no gang"
+    slot_of: dict[int, int] = {}
+    names: list[str] = [""]
+    mins: list[int] = [0]
+    gidx = np.zeros(len(plan), dtype=np.int32)
+    for i, (p, _s, _b) in enumerate(plan):
+        o = p.gang_ordinal()
+        if o == 0:
+            continue
+        s = slot_of.get(o)
+        if s is None:
+            s = slot_of[o] = len(names)
+            names.append(p.gang_name())
+            # effective floor = declared floor minus members already bound
+            # (never below 1: an over-satisfied gang's stragglers place
+            # freely, but a count of 0 placed must still read "nothing to
+            # strip", not "floor breached")
+            mins.append(max(p.gang_min() - bound.get(p.gang_name(), 0), 1))
+        gidx[i] = s
+    if not slot_of:
+        return []
+    # device screen over GANG MEMBERS only: ordinal-0 rows are pure
+    # padding to the segment-sum, and dropping them pins the pod-axis
+    # ladder bucket to gang content instead of arbitrary plan sizes (a
+    # 300-pod wave sharing the plan must not mint a new compile bucket)
+    members = np.nonzero(gidx)[0]
+    ok = gang_feasible(
+        gidx[members], np.ones(len(members), dtype=np.int32),
+        np.asarray(mins, dtype=np.int32),
+    )
+    # authoritative host recount (the device reduction is the accelerated
+    # screen; a transfer/precision fault must not strip a healthy gang)
+    counts = np.bincount(gidx, minlength=len(names))
+    ok_host = (counts == 0) | (counts >= np.asarray(mins))
+    bad_slots = {s for s in range(1, len(names)) if not (ok[s] and ok_host[s])}
+    if not bad_slots:
+        _count_gangs(len(names) - 1, 0)
+        return []
+    stripped: list[tuple[Pod, str]] = []
+    reasons = {
+        s: (
+            f"gang {names[s]}: only {int(counts[s])} of {mins[s]} outstanding "
+            "members placeable; all-or-nothing group withheld"
+        )
+        for s in bad_slots
+    }
+    drop_bind_idx = set()
+    for i, (p, spec, bind_idx) in enumerate(plan):
+        s = int(gidx[i])
+        if s not in bad_slots:
+            continue
+        if spec is not None:
+            spec.pods = [q for q in spec.pods if q.uid != p.uid]
+        else:
+            drop_bind_idx.add(bind_idx)
+        stripped.append((p, reasons[s]))
+    if drop_bind_idx:
+        result.binds = [
+            b for i, b in enumerate(result.binds) if i not in drop_bind_idx
+        ]
+    result.node_specs = [s for s in result.node_specs if s.pods]
+    _count_gangs(len(names) - 1 - len(bad_slots), len(bad_slots))
+    return stripped
+
+
+# -- gang-level placement records (obs) -------------------------------------
+
+def _count_gangs(placed: int, withheld: int) -> None:
+    from ..metrics import GANG_PLACEMENTS, GANG_WITHHELD
+
+    if placed:
+        GANG_PLACEMENTS.inc(placed)
+    if withheld:
+        GANG_WITHHELD.inc(withheld)
+
+
+def gang_partial_counts(pods) -> dict[str, tuple[int, int]]:
+    """Post-settle audit over live pods: gang name -> (bound, min_count).
+    A gang with 0 < bound < min_count is PARTIAL — the invariant both the
+    chaos harness and the fleet simulator gate on (``gangs-atomic``)."""
+    bound: dict[str, int] = {}
+    mins: dict[str, int] = {}
+    for p in pods:
+        g = p.gang_name()
+        if not g:
+            continue
+        mins[g] = max(mins.get(g, 0), p.gang_min())
+        if p.node_name:
+            bound[g] = bound.get(g, 0) + 1
+    return {g: (bound.get(g, 0), m) for g, m in mins.items()}

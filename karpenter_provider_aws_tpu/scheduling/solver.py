@@ -19,7 +19,7 @@ import os
 import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
-from typing import Optional, Protocol, Sequence
+from typing import Mapping, Optional, Protocol, Sequence
 
 import numpy as np
 
@@ -110,6 +110,11 @@ def snapshot_existing_capacity(cluster, nominations=None, partition=None,
     # offer it (same filter as consolidation's encode_cluster)
     draining = {c.status.node_name for c in claims if c.deleted}
 
+    # per-node agent reservations (ops/overhead.py) come off every offered
+    # node's allocatable — the same subtraction the cluster encoders make,
+    # so bind decisions and repack screens agree about real headroom
+    from ..ops import overhead as _overhead
+
     def row(name, pool, itype, zone, captype, used, allocatable, taints, labels):
         return ExistingNode(
             name=name,
@@ -122,7 +127,7 @@ def snapshot_existing_capacity(cluster, nominations=None, partition=None,
                 if used is not None
                 else np.zeros_like(allocatable, dtype=np.float32)
             ),
-            allocatable=allocatable.astype(np.float32),
+            allocatable=_overhead.apply(allocatable.astype(np.float32)),
             taints=tuple(taints),
             labels=dict(labels),
         )
@@ -209,6 +214,7 @@ class Solver(Protocol):
         reserved_allow=None,
         existing: Optional[Sequence[ExistingNode]] = None,
         nodeclass_by_pool=None,
+        gang_bound: Optional[Mapping[str, int]] = None,
     ) -> SolveResult: ...
 
 
@@ -1882,11 +1888,11 @@ class TPUSolver:
 
     def solve(self, pods, nodepools, catalog, in_use=None, occupancy=None, type_allow=None,
               reserved_allow=None, existing=None, nodeclass_by_pool=None,
-              revision=None) -> SolveResult:
+              revision=None, gang_bound=None) -> SolveResult:
         return _solve_multi_nodepool(self, pods, nodepools, catalog, in_use, occupancy,
                                      type_allow, reserved_allow, existing,
                                      nodeclass_by_pool=nodeclass_by_pool,
-                                     revision=revision)
+                                     revision=revision, gang_bound=gang_bound)
 
 
 def host_solve_encoded(
@@ -1940,11 +1946,11 @@ class HostSolver:
 
     def solve(self, pods, nodepools, catalog, in_use=None, occupancy=None, type_allow=None,
               reserved_allow=None, existing=None, nodeclass_by_pool=None,
-              revision=None) -> SolveResult:
+              revision=None, gang_bound=None) -> SolveResult:
         return _solve_multi_nodepool(self, pods, nodepools, catalog, in_use, occupancy,
                                      type_allow, reserved_allow, existing,
                                      nodeclass_by_pool=nodeclass_by_pool,
-                                     revision=revision)
+                                     revision=revision, gang_bound=gang_bound)
 
 
 def _enforce_pool_constraints(
@@ -2055,6 +2061,7 @@ def certainly_unplaceable(problem, pool_existing=None) -> list[Pod]:
 def _solve_multi_nodepool(
     impl, pods, nodepools, catalog, in_use=None, occupancy=None, type_allow=None,
     reserved_allow=None, existing=None, nodeclass_by_pool=None, revision=None,
+    gang_bound=None,
 ) -> SolveResult:
     t0 = time.perf_counter()
     if hasattr(impl, "timings"):
@@ -2266,6 +2273,20 @@ def _solve_multi_nodepool(
             others = [p for p in remaining if not p.preferred_node_affinity]
             remaining = others + full_round(prefs, False)
         sp.set(unschedulable=len(remaining))
+    # All-or-nothing gang commit (scheduling/groups.py): AFTER every pool
+    # round and the preference relaxation — a gang must only be withheld
+    # once every placement avenue has been tried — and BEFORE cost/quality
+    # stamping, so no downstream consumer ever sees a partial gang. The
+    # kill switch check lives inside Pod.gang_locked/gangs_enabled;
+    # without gang annotations in the pod set this is a no-op scan.
+    from ..models.pod import gangs_enabled as _gangs_enabled
+
+    if _gangs_enabled() and (result.node_specs or result.binds):
+        from .groups import enforce_gangs
+
+        for pod, why in enforce_gangs(result, bound=gang_bound):
+            reasons[pod.uid] = why
+            remaining.append(pod)
     for pod in remaining:
         result.unschedulable.append(
             (pod, reasons.get(pod.uid, "no nodepool can schedule this pod"))

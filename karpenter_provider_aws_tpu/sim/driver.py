@@ -164,6 +164,9 @@ class FleetSimulator:
         # audit/report state (same names the chaos invariants read)
         self.bind_events: list[tuple[str, str]] = []
         self.double_binds: list[str] = []
+        # per-tenant bind samples (tenant, bound_at_s, pending_dur_s) —
+        # the fairness plane's raw data (tenant_bind_p99_ratio gate key)
+        self.tenant_binds: list[tuple[str, float, float]] = []
         self._id_ranks: dict[str, int] = {}
         self.active: list[TimedFault] = []
         self.probe_failures = 0
@@ -236,6 +239,17 @@ class FleetSimulator:
                     f"{pod.name}: {pod.node_name} -> {node_name}"
                 )
             self.bind_events.append((pod_uid, node_name))
+            if pod is not None:
+                tenant = pod.labels.get(lbl.TENANT_LABEL, "")
+                if tenant:
+                    # read the pending stamp BEFORE orig_bind pops it
+                    t0 = self.env.obs.sli._pod_pending.get(pod_uid)
+                    t_now = self.env.clock.now()
+                    self.tenant_binds.append((
+                        tenant, round(t_now, 3),
+                        round(max(0.0, t_now - t0), 4)
+                        if t0 is not None else 0.0,
+                    ))
             return orig_bind(pod_uid, node_name, now)
 
         cluster.bind_pod = audited_bind
@@ -253,6 +267,27 @@ class FleetSimulator:
 
         spec = self.trace
         env = self.env
+        # per-node agent overhead: registered BEFORE any encode so every
+        # capacity tensor of the run is net of the agents (cleared in
+        # run()'s finally — the registry is process-global)
+        from ..ops import overhead as _overhead
+
+        agents = {}
+        if spec.daemonset_cpu:
+            agents["cpu"] = spec.daemonset_cpu
+        if spec.daemonset_memory:
+            agents["memory"] = spec.daemonset_memory
+        _overhead.set_node_overhead(agents or None)
+        # gang plane armed and the trace will exercise it: pre-trace the
+        # gangs.feasible ladder buckets NOW, inside the warmup half, so a
+        # late gang wave can never mint a first compile after the
+        # retraces_after_warmup boundary
+        if spec.gang_every_s > 0 or spec.hapair_every_s > 0:
+            from ..models.pod import gangs_enabled
+            from ..scheduling.groups import warm_gang_kernels
+
+            if gangs_enabled():
+                warm_gang_kernels()
         pool = NodePool(
             name="default",
             requirements=[
@@ -539,10 +574,21 @@ class FleetSimulator:
         env = self.env
         self.events_applied[ev.kind] = self.events_applied.get(ev.kind, 0) + 1
         SIM_EVENTS.inc(kind=ev.kind)
-        if ev.kind in ("wave", "flood"):
+        if ev.kind in ("wave", "flood", "gang"):
+            kwargs = {}
+            if ev.tenant:
+                kwargs["labels"] = {lbl.TENANT_LABEL: ev.tenant}
+            pods = make_pods(ev.pods, ev.name,
+                             {"cpu": ev.cpu, "memory": ev.memory}, **kwargs)
+            if ev.kind == "gang":
+                from ..scheduling.groups import PodGroup
+
+                PodGroup(
+                    name=ev.name, min_count=ev.gang_min or ev.pods,
+                    spread_skew=ev.spread_skew, anti_affine=ev.anti_affine,
+                ).apply_to(pods)
             uids = []
-            for p in make_pods(ev.pods, ev.name,
-                               {"cpu": ev.cpu, "memory": ev.memory}):
+            for p in pods:
                 env.cluster.apply(p)
                 uids.append(p.uid)
             self._pods_by_prefix[ev.name] = uids
@@ -556,8 +602,13 @@ class FleetSimulator:
             # currently-bound pods (names are trace-derived and stable;
             # uids are process-global counters and are not)
             rng = random.Random(f"{self.seed}:{ev.name}")
+            # gang members are excluded from churn victims: a workload
+            # deleting ONE member of a live training job is not a thing
+            # (jobs die whole via their expire event), and random single-
+            # member deletion would fake a partial-gang invariant breach
             bound = sorted(
-                (p.name, p.uid) for p in env.cluster.pods.values() if p.node_name
+                (p.name, p.uid) for p in env.cluster.pods.values()
+                if p.node_name and not p.gang_name()
             )
             victims = []
             for _ in range(min(ev.pods, len(bound))):
@@ -975,6 +1026,9 @@ class FleetSimulator:
                     self.invariants = check_all(self)
             self.driver_wall_s = time.perf_counter() - wall0
         finally:
+            from ..ops import overhead as _overhead
+
+            _overhead.set_node_overhead(None)
             if prev_serial is None:
                 os.environ.pop("KARPENTER_TPU_SERIAL_LAUNCH", None)
             else:

@@ -276,6 +276,43 @@ def build_report(sim, span_profile: dict, deltas: dict) -> FleetReport:
         "correlation": correlation,
         "invariants": invariants,
     }
+
+    # gang plane (designs/gang-scheduling.md): post-settle audit over live
+    # pods — every declared gang must be fully bound or fully unbound.
+    # Virtual-time data, inside the signature.
+    gang_counts: dict = {}
+    if sim.events_applied.get("gang"):
+        from ..scheduling.groups import gang_partial_counts
+
+        gang_counts = gang_partial_counts(env.cluster.pods.values())
+        virtual["gangs"] = {
+            "declared_live": len(gang_counts),
+            "placed": sum(1 for b, m in gang_counts.values() if b >= m),
+            "partial": sorted(
+                g for g, (b, m) in gang_counts.items() if 0 < b < m
+            ),
+            "unplaced": sorted(
+                g for g, (b, m) in gang_counts.items() if b == 0
+            ),
+        }
+
+    # tenancy / fairness plane: quiet tenants' bind p99 inside the noisy-
+    # neighbor window vs outside it (virtual-time durations: signed)
+    noisy_at = getattr(sim.trace, "noisy_at_s", -1.0)
+    tenancy: dict = {}
+    if getattr(sim, "tenant_binds", None):
+        per: dict[str, dict[str, list]] = {}
+        w0 = noisy_at
+        w1 = noisy_at + getattr(sim.trace, "noisy_duration_s", 0.0)
+        for tenant, at_s, dur in sim.tenant_binds:
+            cell = per.setdefault(tenant, {"in": [], "out": []})
+            cell["in" if (w0 >= 0 and w0 <= at_s <= w1) else "out"].append(dur)
+        for tenant, cell in sorted(per.items()):
+            tenancy[tenant] = {
+                "in_window": _percentiles(sorted(cell["in"])),
+                "out_window": _percentiles(sorted(cell["out"])),
+            }
+        virtual["tenancy"] = tenancy
     if getattr(sim, "replicas", 1) > 1:
         # sharded-control-plane plane (all virtual-time: deterministic,
         # inside the signature): per-replica lease holdings, the audited
@@ -400,6 +437,27 @@ def build_report(sim, span_profile: dict, deltas: dict) -> FleetReport:
         # fails the gate unless the baseline allows it)
         "retraces_after_warmup": device_plane.get("retraces_after_warmup"),
     }
+    if sim.events_applied.get("gang"):
+        # GANG traces gate atomicity by name: zero partially-placed gangs
+        # at settle, and at least one fully placed (a zero-placement day
+        # would pass atomicity vacuously)
+        gate["gangs_partial"] = len(virtual["gangs"]["partial"])
+        gate["gangs_placed"] = virtual["gangs"]["placed"]
+    if noisy_at >= 0 and tenancy:
+        # the per-tenant fairness SLO: worst quiet-tenant ratio of bind
+        # p99 inside the noisy window vs outside (the noisy tenant itself
+        # is excluded — IT chose to flood)
+        ratios = []
+        for tenant, cell in tenancy.items():
+            if tenant == "noisy":
+                continue
+            p_in = cell["in_window"]["p99"]
+            p_out = cell["out_window"]["p99"]
+            if p_in is not None and p_out:
+                ratios.append(p_in / p_out)
+        gate["tenant_bind_p99_ratio"] = (
+            round(max(ratios), 4) if ratios else None
+        )
     if getattr(sim.trace, "market_tick_s", 0.0) > 0:
         # MARKET traces gate cost-vs-oracle under moving prices by its own
         # name, so baselines can hold the market bar independently of the

@@ -59,13 +59,18 @@ class SimEvent:
     """One timed workload mutation."""
 
     at_s: float
-    kind: str                     # wave | flood | churn | expire
+    kind: str                     # wave | flood | churn | expire | gang | ...
     pods: int = 0
     cpu: str = "500m"
     memory: str = "1Gi"
     name: str = ""                # pod-name prefix (expire targets it)
     ttl_s: Optional[float] = None
     unschedulable: bool = False   # poison shape: no node can ever fit it
+    # gang plane (kind="gang"): the wave is an all-or-nothing PodGroup
+    gang_min: int = 0             # members required to place (0 = all)
+    spread_skew: int = 0          # DoNotSchedule zone-spread skew cap
+    anti_affine: bool = False     # HA pair: at most one member per zone
+    tenant: str = ""              # tenant label stamped onto the pods
 
     def to_dict(self) -> dict:
         d = {"at_s": self.at_s, "kind": self.kind, "pods": self.pods,
@@ -74,6 +79,14 @@ class SimEvent:
             d["ttl_s"] = self.ttl_s
         if self.unschedulable:
             d["unschedulable"] = True
+        if self.gang_min:
+            d["gang_min"] = self.gang_min
+        if self.spread_skew:
+            d["spread_skew"] = self.spread_skew
+        if self.anti_affine:
+            d["anti_affine"] = True
+        if self.tenant:
+            d["tenant"] = self.tenant
         return d
 
     @classmethod
@@ -84,6 +97,10 @@ class SimEvent:
             memory=str(d.get("memory", "1Gi")), name=str(d.get("name", "")),
             ttl_s=(None if d.get("ttl_s") is None else float(d["ttl_s"])),
             unschedulable=bool(d.get("unschedulable", False)),
+            gang_min=int(d.get("gang_min", 0)),
+            spread_skew=int(d.get("spread_skew", 0)),
+            anti_affine=bool(d.get("anti_affine", False)),
+            tenant=str(d.get("tenant", "")),
         )
 
 
@@ -185,6 +202,30 @@ class TraceSpec:
     market_block_at_s: float = -1.0         # < 0 = no block
     market_block_slots: int = 0
     market_block_duration_s: float = 14400.0
+    # gang scheduling (designs/gang-scheduling.md): training gangs of
+    # gang_size all-or-nothing members with a zone-spread skew cap arrive
+    # every gang_every_s (0 = off); anti-affine HA pairs (one member per
+    # zone) arrive every hapair_every_s
+    gang_every_s: float = 0.0
+    gang_size: int = 8
+    gang_cpu: str = "4000m"
+    gang_memory: str = "8Gi"
+    gang_spread_skew: int = 2
+    gang_ttl_s: float = 7200.0
+    hapair_every_s: float = 0.0
+    hapair_ttl_s: float = 7200.0
+    # per-node agent (DaemonSet) overhead the encoders subtract from every
+    # node's allocatable at encode time (ops/overhead.py); "" = none
+    daemonset_cpu: str = ""
+    daemonset_memory: str = ""
+    # per-tenant arrival mix: > 0 stamps every wave/gang pod with a seeded
+    # tenant label; the noisy-neighbor window lands a burst attributed to
+    # tenant "noisy" so the fairness gate can compare quiet tenants' bind
+    # p99 inside vs outside it (tenant_bind_p99_ratio)
+    tenants: int = 0
+    noisy_at_s: float = -1.0                # < 0 = no noisy window
+    noisy_duration_s: float = 1800.0
+    noisy_pods: int = 0
     # chaos overlays
     overlays: list = field(default_factory=list)
 
@@ -203,6 +244,10 @@ class TraceSpec:
                 "market_tick_s", "market_volatility", "market_reservations",
                 "market_reservation_end_s", "market_block_at_s",
                 "market_block_slots", "market_block_duration_s",
+                "gang_every_s", "gang_size", "gang_cpu", "gang_memory",
+                "gang_spread_skew", "gang_ttl_s", "hapair_every_s",
+                "hapair_ttl_s", "daemonset_cpu", "daemonset_memory",
+                "tenants", "noisy_at_s", "noisy_duration_s", "noisy_pods",
             )
         }
         d["consolidation_budgets"] = list(self.consolidation_budgets)
@@ -270,6 +315,26 @@ def canned_traces() -> dict[str, TraceSpec]:
             floods=6, flood_pods=128, churn_every_s=7200.0, churn_pods=16,
             settle_reconciles=60,
         ),
+        # a gang day at 500 nodes: topology-spread training gangs +
+        # anti-affine HA pairs arrive on a tenant-mixed diurnal floor,
+        # per-node agents tax every node's allocatable, and a noisy
+        # tenant floods mid-morning (hour 1.5 — INSIDE the jitwatch
+        # warmup half, so the fleet's peak tensor buckets are all minted
+        # before the retrace gate arms) — the `make gang-smoke` workload
+        # (fleet-gated vs sim/baselines/gang-500.json: zero partial
+        # gangs, fairness ratio, zero steady-state retraces)
+        "gang-day": TraceSpec(
+            name="gang-day", nodes=500, duration_s=4 * 3600.0,
+            heartbeat_s=600.0, sample_every_s=900.0,
+            waves_per_hour=2.0, wave_pods=24, wave_ttl_s=3600.0,
+            floods=1, flood_pods=48, churn_every_s=1800.0, churn_pods=12,
+            settle_reconciles=40,
+            gang_every_s=1500.0, gang_size=8, gang_spread_skew=2,
+            gang_ttl_s=5400.0, hapair_every_s=2700.0, hapair_ttl_s=5400.0,
+            daemonset_cpu="200m", daemonset_memory="256Mi",
+            tenants=3, noisy_at_s=1.5 * 3600.0, noisy_duration_s=1800.0,
+            noisy_pods=96,
+        ),
         # MARKET traces (moving prices / reserved windows) live in
         # market/scenarios.py next to the model they exercise
         **_market_traces(),
@@ -323,9 +388,14 @@ def generate(spec: TraceSpec, seed: int) -> list[SimEvent]:
             )
             pods = max(1, int(round(spec.wave_pods * diurnal)))
             cpu, mem = WAVE_SHAPES[rng.randrange(len(WAVE_SHAPES))]
+            # tenant mix: guarded draw, so tenant-less traces consume the
+            # exact same rng stream they always did
+            tenant = (
+                f"t{rng.randrange(spec.tenants)}" if spec.tenants > 0 else ""
+            )
             ev = SimEvent(
                 at_s=round(t, 3), kind="wave", pods=pods, cpu=cpu, memory=mem,
-                name=f"wave{i}", ttl_s=spec.wave_ttl_s,
+                name=f"wave{i}", ttl_s=spec.wave_ttl_s, tenant=tenant,
             )
             events.append(ev)
             _expire(ev)
@@ -386,6 +456,52 @@ def generate(spec: TraceSpec, seed: int) -> list[SimEvent]:
             ))
             t += spec.churn_every_s
             k += 1
+
+    # training gangs: all-or-nothing groups with a zone-spread skew cap,
+    # tenant-attributed round-robin so the fairness plane sees gang load
+    if spec.gang_every_s > 0 and spec.gang_size > 0:
+        t = spec.gang_every_s
+        g = 0
+        while t < spec.duration_s:
+            ev = SimEvent(
+                at_s=round(t, 3), kind="gang", pods=spec.gang_size,
+                cpu=spec.gang_cpu, memory=spec.gang_memory,
+                name=f"gang{g}", ttl_s=spec.gang_ttl_s,
+                gang_min=spec.gang_size, spread_skew=spec.gang_spread_skew,
+                tenant=(f"t{g % spec.tenants}" if spec.tenants > 0 else ""),
+            )
+            events.append(ev)
+            _expire(ev)
+            t += spec.gang_every_s
+            g += 1
+
+    # anti-affine HA pairs: two replicas, at most one per zone
+    if spec.hapair_every_s > 0:
+        t = spec.hapair_every_s * 0.75
+        h = 0
+        while t < spec.duration_s:
+            ev = SimEvent(
+                at_s=round(t, 3), kind="gang", pods=2,
+                cpu="500m", memory="1Gi", name=f"hapair{h}",
+                ttl_s=spec.hapair_ttl_s, gang_min=2, anti_affine=True,
+                tenant=(f"t{h % spec.tenants}" if spec.tenants > 0 else ""),
+            )
+            events.append(ev)
+            _expire(ev)
+            t += spec.hapair_every_s
+            h += 1
+
+    # the noisy neighbor: one tenant floods the control plane mid-trace;
+    # the fairness gate compares quiet tenants' bind p99 inside vs
+    # outside this window (no tenant's p99 may degrade > 2x)
+    if spec.noisy_at_s >= 0 and spec.noisy_pods > 0:
+        ev = SimEvent(
+            at_s=round(spec.noisy_at_s, 3), kind="wave",
+            pods=spec.noisy_pods, cpu="500m", memory="1Gi",
+            name="noisy0", ttl_s=spec.noisy_duration_s, tenant="noisy",
+        )
+        events.append(ev)
+        _expire(ev)
 
     # market ticks: each one re-walks every spot price through the live
     # update_spot channel (the driver holds the seeded MarketModel); the

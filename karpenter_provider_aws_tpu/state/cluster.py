@@ -633,6 +633,21 @@ class Cluster:
             out.sort(key=lambda p: self._pod_ord.get(p.uid, 1 << 62))
             return out
 
+    def gang_bound_counts(self) -> dict[str, int]:
+        """gang name -> live BOUND member count, one locked pass. Solve-time
+        input for the all-or-nothing gate (scheduling/groups.enforce_gangs):
+        members already running credit the gang's floor, so the pending
+        remainder of a partially-bound gang can complete instead of being
+        withheld forever against the full min_count."""
+        out: dict[str, int] = {}
+        with self._lock:
+            for p in self.pods.values():
+                if p.node_name:
+                    g = p.gang_name()
+                    if g:
+                        out[g] = out.get(g, 0) + 1
+        return out
+
     def node_usage(self) -> dict[str, "object"]:
         """node name -> summed bound-pod requests, in ONE locked pass over
         the pod store (callers used to run pods_on_node per node — O(nodes x

@@ -1,0 +1,279 @@
+"""Gang scheduling plane: kill-switch byte-identity, all-or-nothing commit,
+node_gang encoder exactness, and DaemonSet-overhead capacity margins.
+
+The contract under test (designs/gang-scheduling.md):
+
+- ``KARPENTER_TPU_GANGS=0`` restores byte-identical legacy plans — gang
+  annotations are scheduling-key inert, so a disarmed solve over annotated
+  pods must equal the same solve over plain pods, per seed.
+- An armed solve never commits a partial gang: every member of an
+  under-floor group is withheld as one unit, and feasible gangs place whole.
+- The ``node_gang`` tensor column (max member ordinal per node) survives the
+  incremental encoder exactly, and gang nodes are blocked from repack.
+- Per-node agent overhead (ops/overhead.py) comes off offered existing
+  capacity, so a one-slot-margin fleet stops over-binding.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from karpenter_provider_aws_tpu.catalog import CatalogProvider
+from karpenter_provider_aws_tpu.models import Disruption, NodePool
+from karpenter_provider_aws_tpu.models import labels as lbl
+from karpenter_provider_aws_tpu.models.pod import gang_ordinal, make_pods
+from karpenter_provider_aws_tpu.ops import overhead as _overhead
+from karpenter_provider_aws_tpu.ops.consolidate import _encode_cluster, encode_cluster
+from karpenter_provider_aws_tpu.ops.encode_delta import (
+    canonical_equal,
+    canonical_form,
+    invalidate_cluster_encoders,
+)
+from karpenter_provider_aws_tpu.scheduling import TPUSolver
+from karpenter_provider_aws_tpu.scheduling.groups import (
+    PodGroup,
+    gang_feasible,
+    gang_partial_counts,
+)
+from karpenter_provider_aws_tpu.scheduling.solver import snapshot_existing_capacity
+from karpenter_provider_aws_tpu.state.cluster import Cluster
+
+from test_encode_incremental import _add_node, _small_cluster  # noqa: F401
+
+
+@pytest.fixture(scope="module")
+def catalog():
+    return CatalogProvider()
+
+
+@pytest.fixture(scope="module")
+def pool():
+    return NodePool(name="default", disruption=Disruption(consolidate_after_s=None))
+
+
+@pytest.fixture(autouse=True)
+def _clean_overhead():
+    yield
+    _overhead.set_node_overhead(None)
+
+
+def _sig(res):
+    """Order-insensitive byte signature of a SolveResult plan."""
+    specs = tuple(sorted(
+        (s.nodepool_name,
+         tuple(s.instance_type_options),
+         tuple(s.zone_options),
+         tuple(s.capacity_type_options),
+         round(float(s.estimated_price), 6),
+         tuple(sorted(p.name for p in s.pods)))
+        for s in res.node_specs))
+    binds = tuple(sorted(
+        (p.name, getattr(n, "name", str(n))) for p, n in res.binds))
+    unsched = tuple(sorted(p.name for p, _ in res.unschedulable))
+    return (specs, binds, unsched)
+
+
+def _seeded_pods(seed: int, gangs: bool):
+    """Deterministic mixed workload; when ``gangs`` the training groups get
+    PodGroup identity stamped (annotations, and — only if armed — labels
+    and topology constraints)."""
+    rng = random.Random(seed)
+    pods = []
+    for w in range(rng.randint(2, 4)):
+        n = rng.randint(3, 9)
+        cpu = rng.choice(["500m", "1", "2"])
+        mem = rng.choice(["1Gi", "2Gi", "4Gi"])
+        pods += make_pods(n, f"web{seed}-{w}", {"cpu": cpu, "memory": mem})
+    for g in range(2):
+        n = rng.randint(4, 8)
+        members = make_pods(n, f"train{seed}-{g}", {"cpu": "2", "memory": "4Gi"})
+        if gangs:
+            PodGroup(name=f"train{seed}-{g}", spread_skew=2).apply_to(members)
+        pods += members
+    return pods
+
+
+class TestKillSwitch:
+    @pytest.mark.parametrize("seed", [11, 23, 47])
+    def test_disarmed_plans_byte_identical(self, catalog, pool, monkeypatch, seed):
+        """With KARPENTER_TPU_GANGS=0, a solve over gang-annotated pods is
+        byte-identical to the same solve over plain pods."""
+        monkeypatch.setenv("KARPENTER_TPU_GANGS", "0")
+        plain = TPUSolver().solve(_seeded_pods(seed, gangs=False), [pool], catalog)
+        gangy = TPUSolver().solve(_seeded_pods(seed, gangs=True), [pool], catalog)
+        assert _sig(plain) == _sig(gangy)
+
+    def test_armed_annotations_change_nothing_when_constraint_free(
+        self, catalog, pool, monkeypatch
+    ):
+        """Armed, a gang with no spread/anti-affinity and a satisfiable
+        floor yields the same packing as plain pods — the plane only ever
+        SUBTRACTS infeasible gangs, never perturbs feasible plans."""
+        monkeypatch.delenv("KARPENTER_TPU_GANGS", raising=False)
+        plain = make_pods(6, "job", {"cpu": "2", "memory": "4Gi"})
+        members = make_pods(6, "job", {"cpu": "2", "memory": "4Gi"})
+        PodGroup(name="job").apply_to(members)
+        a = TPUSolver().solve(plain, [pool], catalog)
+        b = TPUSolver().solve(members, [pool], catalog)
+        assert _sig(a) == _sig(b)
+        assert not b.unschedulable
+
+
+class TestAllOrNothing:
+    def test_infeasible_gang_withheld_whole(self, catalog, pool, monkeypatch):
+        """An anti-affine gang of 8 with only 4 zones can place at most 4
+        members — the commit gate must withhold ALL 8, never a subset."""
+        monkeypatch.delenv("KARPENTER_TPU_GANGS", raising=False)
+        members = make_pods(8, "ha", {"cpu": "1", "memory": "2Gi"})
+        PodGroup(name="ha-octet", anti_affine=True).apply_to(members)
+        filler = make_pods(10, "web", {"cpu": "500m", "memory": "1Gi"})
+        res = TPUSolver().solve(members + filler, [pool], catalog)
+        names = {p.name for p in members}
+        unsched = {p.name for p, why in res.unschedulable}
+        assert names <= unsched, "every gang member must be withheld"
+        # the placeable members carry the commit gate's reason; the rest
+        # keep the anti-affinity reason that made the gang infeasible
+        gate_reasons = [why for p, why in res.unschedulable
+                        if p.name in names and "all-or-nothing" in why]
+        assert gate_reasons, "commit gate must report the withheld gang"
+        placed = {p.name for s in res.node_specs for p in s.pods}
+        placed |= {p.name for p, _n in res.binds}
+        assert not (placed & names), "no partial gang bind may survive"
+        # the innocent bystanders still place
+        assert {p.name for p in filler} <= placed
+
+    def test_feasible_gang_places_whole(self, catalog, pool, monkeypatch):
+        monkeypatch.delenv("KARPENTER_TPU_GANGS", raising=False)
+        members = make_pods(4, "pair", {"cpu": "1", "memory": "2Gi"})
+        PodGroup(name="ha-quad", anti_affine=True).apply_to(members)
+        res = TPUSolver().solve(members, [pool], catalog)
+        placed = {p.name for s in res.node_specs for p in s.pods}
+        assert placed == {p.name for p in members}
+        assert not res.unschedulable
+        # anti-affinity held: one member per zone
+        zones = [tuple(s.zone_options) for s in res.node_specs for _ in s.pods]
+        assert len(zones) == 4
+
+    def test_elastic_floor_keeps_survivors(self, catalog, pool, monkeypatch):
+        """min_count below the member count: an elastic gang placing at
+        least its floor is NOT stripped."""
+        monkeypatch.delenv("KARPENTER_TPU_GANGS", raising=False)
+        members = make_pods(8, "elastic", {"cpu": "1", "memory": "2Gi"})
+        PodGroup(name="elastic-8of3", min_count=3, anti_affine=True).apply_to(members)
+        res = TPUSolver().solve(members, [pool], catalog)
+        placed = {p.name for s in res.node_specs for p in s.pods}
+        assert len(placed) >= 3
+
+    def test_gang_feasible_kernel(self):
+        gidx = np.array([0, 1, 1, 2, 2, 2], dtype=np.int32)
+        placed = np.ones(6, dtype=np.int32)
+        mins = np.array([0, 3, 3], dtype=np.int32)
+        ok = gang_feasible(gidx, placed, mins)
+        assert ok.tolist() == [True, False, True]
+        # empty gang slot (count 0) is vacuously satisfiable
+        ok2 = gang_feasible(np.array([2, 2, 2]), np.ones(3), np.array([0, 4, 3]))
+        assert ok2.tolist() == [True, True, True]
+
+
+class TestNodeGangEncoding:
+    def test_incremental_matches_full_and_blocks(self, monkeypatch):
+        monkeypatch.delenv("KARPENTER_TPU_GANGS", raising=False)
+        catalog = CatalogProvider()
+        cluster, nodes = _small_cluster(catalog, n=6)
+        invalidate_cluster_encoders(cluster)
+        members = make_pods(4, "g", {"cpu": "500m", "memory": "512Mi"})
+        PodGroup(name="enc-gang").apply_to(members)
+        for p, node in zip(members, nodes[:2] * 2):
+            cluster.apply(p)
+            cluster.bind_pod(p.uid, node.name)
+        plain = make_pods(2, "w", {"cpu": "250m", "memory": "256Mi"})
+        for p in plain:
+            cluster.apply(p)
+            cluster.bind_pod(p.uid, nodes[3].name)
+
+        served = encode_cluster(cluster, catalog)
+        fresh = _encode_cluster(cluster, catalog, 32)
+        assert canonical_equal(canonical_form(served), canonical_form(fresh)) == []
+
+        o = gang_ordinal("enc-gang")
+        by_name = {n: i for i, n in enumerate(served.node_names)}
+        for node in nodes[:2]:
+            i = by_name[node.name]
+            assert served.node_gang[i] == o
+            assert bool(served.blocked[i]), "gang nodes must be repack-blocked"
+        assert served.node_gang[by_name[nodes[3].name]] == 0
+        assert not bool(served.blocked[by_name[nodes[3].name]])
+
+        # incremental patch path: unbind one member, re-encode, still exact
+        cluster.unbind_pod(members[0].uid)
+        served2 = encode_cluster(cluster, catalog)
+        fresh2 = _encode_cluster(cluster, catalog, 32)
+        assert canonical_equal(canonical_form(served2), canonical_form(fresh2)) == []
+
+    def test_disarmed_gang_does_not_block(self, monkeypatch):
+        """Disarmed, gang identity still encodes (node_gang is a pure
+        function of cluster content) but the kill switch gates the
+        CONSUMER: the gang node is not repack-blocked."""
+        monkeypatch.setenv("KARPENTER_TPU_GANGS", "0")
+        catalog = CatalogProvider()
+        cluster, nodes = _small_cluster(catalog, n=3)
+        invalidate_cluster_encoders(cluster)
+        members = make_pods(2, "g0", {"cpu": "500m", "memory": "512Mi"})
+        PodGroup(name="dead-gang").apply_to(members)
+        for p in members:
+            cluster.apply(p)
+            cluster.bind_pod(p.uid, nodes[0].name)
+        ct = encode_cluster(cluster, catalog)
+        i = ct.node_names.index(nodes[0].name)
+        assert ct.node_gang[i] == gang_ordinal("dead-gang")
+        assert not bool(ct.blocked[i]), "kill switch must unblock gang nodes"
+        fresh = _encode_cluster(cluster, catalog, 32)
+        assert canonical_equal(canonical_form(ct), canonical_form(fresh)) == []
+
+    def test_partial_counts_audit(self, monkeypatch):
+        monkeypatch.delenv("KARPENTER_TPU_GANGS", raising=False)
+        members = make_pods(4, "a", {"cpu": "1"})
+        PodGroup(name="audit-gang").apply_to(members)
+        for p in members[:2]:
+            p.node_name = "n0"
+        counts = gang_partial_counts(members)
+        assert counts == {"audit-gang": (2, 4)}
+
+
+class TestDaemonSetOverheadMargin:
+    def test_one_slot_margin_stops_over_binding(self, catalog, pool, monkeypatch):
+        """A node with exactly one 500m slot free accepts the pod without
+        agent overhead registered, and must REFUSE it once a 200m/node
+        DaemonSet reservation is in effect (the over-binding regression)."""
+        monkeypatch.delenv("KARPENTER_TPU_GANGS", raising=False)
+        cluster = Cluster()
+        cluster.apply(NodePool(
+            name="default", disruption=Disruption(consolidate_after_s=None)))
+        node, _claim = _add_node(cluster, catalog, 0)
+        # allocatable cpu rides in millicores; leave exactly one 500m slot
+        fill_m = int(node.allocatable.get("cpu")) - 500
+        assert fill_m > 0
+        filler = make_pods(1, "fill", {"cpu": f"{fill_m}m", "memory": "256Mi"})
+        cluster.apply(filler[0])
+        cluster.bind_pod(filler[0].uid, node.name)
+        pod = make_pods(1, "margin", {"cpu": "500m", "memory": "128Mi"})
+
+        existing = snapshot_existing_capacity(cluster)
+        res = TPUSolver().solve(pod, [pool], catalog, existing=existing)
+        bind_names = [getattr(n, "name", n) for _p, n in res.binds]
+        assert bind_names == [node.name]
+        assert not res.node_specs
+
+        _overhead.set_node_overhead({"cpu": "200m"})
+        try:
+            existing = snapshot_existing_capacity(cluster)
+            res = TPUSolver().solve(pod, [pool], catalog, existing=existing)
+            assert not res.binds, "overhead must shrink the offered slot"
+            assert len(res.node_specs) == 1  # opens fresh capacity instead
+        finally:
+            _overhead.set_node_overhead(None)
+
+    def test_overhead_identity_when_unregistered(self):
+        cap = np.array([4.0, 8.0, 10.0], dtype=np.float32)
+        assert np.array_equal(_overhead.apply(cap), cap)
