@@ -4,7 +4,7 @@
 
 PYTEST ?= python -m pytest
 
-.PHONY: presubmit test deflake stress e2etests benchmark interruption-bench verify multichip native soak sidecar-client sim-smoke sim-sweep sim-cliff-smoke bench-gate bench-optimizer bench-market bench-gang market-smoke gang-smoke chaos-smoke sim-replica-smoke sim-provision-smoke fleet-obs-smoke device-obs-smoke
+.PHONY: presubmit test deflake stress e2etests benchmark interruption-bench verify multichip native soak sidecar-client sim-smoke sim-sweep sim-cliff-smoke bench-gate bench-optimizer bench-market bench-gang market-smoke gang-smoke chaos-smoke sim-replica-smoke sim-provision-smoke fleet-obs-smoke device-obs-smoke warmup-smoke
 
 presubmit: test multichip  ## everything CI gates on
 
@@ -106,6 +106,9 @@ fleet-obs-smoke:  ## 2-replica smoke day through the flight recorder: correlatio
 
 device-obs-smoke:  ## smoke-500 day with jitwatch armed: per-family compile counts, 0 retraces after warmup, obs-device CLI round-trip of the ledger snapshot
 	JAX_PLATFORMS=cpu python tools/device_obs_smoke.py
+
+warmup-smoke:  ## smoke-500 day warmed from the checked-in AOT manifest: first solve compiles=0 (first_solve_after_restart) + 0 retraces, fleet-gated
+	JAX_PLATFORMS=cpu python tools/warmup_smoke.py
 
 sim-provision-smoke:  ## 4-replica sharded-provisioning flood day (GLOBAL holder killed mid-flood; work-stealing + packing-envelope-parity), fleet-gated
 	JAX_PLATFORMS=cpu python -m karpenter_provider_aws_tpu.sim run \

@@ -693,6 +693,8 @@ def child_jit() -> None:
     _force_cpu_if_asked()
     import contextlib
 
+    _enable_jit_cache()
+
     from benchmarks.jit_bench import run_all as run_jit
 
     scale = float(os.environ.get("BENCH_JIT_SCALE", "1.0"))

@@ -1,7 +1,7 @@
 """Compile-ledger benchmark: cold-vs-warm compile count and wall per
 program family (``bench.py --child=jit``).
 
-Two rows, both read straight off the jitwatch ledger
+Three rows, all read straight off the jitwatch ledger
 (``trace/jitwatch.py``) instead of inferring compile cost by subtracting
 wall clocks:
 
@@ -18,6 +18,11 @@ wall clocks:
   full-scale cold number lives on the ``config9_100k_nodes`` row
   (``solve_lanes_cold_compile_ms``); this row is the cheap always-run
   witness of the same attribution.
+- ``first_solve_after_restart`` — the zero-cold-start ladder across real
+  process boundaries (``benchmarks/restart_probe.py``): a fresh
+  interpreter's first solve cold, against the fleet-shared persistent
+  compile cache, and after an AOT manifest warmup
+  (``trace/warmup.py``) — the warmed rung must compile NOTHING.
 
 Rows stream via ``on_row`` like every other phase.
 """
@@ -203,10 +208,93 @@ def bench_lanes_cold(n_lanes: int = 4, burst: int = 96) -> dict:
         env.close()
 
 
+def bench_first_solve_after_restart(n_pods: int = 220) -> dict:
+    """The zero-cold-start ladder, measured across REAL process
+    boundaries (``benchmarks/restart_probe.py``): cold-no-cache vs
+    cold-with-cache vs manifest-warmed, each the FIRST solve a fresh
+    interpreter serves. The warmed rung must attribute zero ledger
+    compiles to that solve (and its provenance must stamp 0) — the
+    bench-side twin of the chaos ``successor-warm`` invariant."""
+    import subprocess
+    import sys
+    import tempfile
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def probe(mode: str, manifest: str, cache_dir: str) -> dict:
+        env = dict(os.environ)
+        env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        # a probe IS a restart: no inherited warmup/cache knobs may leak
+        for k in ("KARPENTER_TPU_WARMUP_MANIFEST",
+                  "KARPENTER_TPU_WARMUP_SAVE",
+                  "KARPENTER_TPU_WARMUP_DEADLINE_S",
+                  "KARPENTER_TPU_COMPILE_CACHE_DIR"):
+            env.pop(k, None)
+        cmd = [sys.executable, "-m", "benchmarks.restart_probe",
+               "--mode", mode, "--pods", str(n_pods)]
+        if manifest:
+            cmd += ["--manifest", manifest]
+        if cache_dir:
+            cmd += ["--cache-dir", cache_dir]
+        res = subprocess.run(cmd, cwd=root, env=env, capture_output=True,
+                             text=True, timeout=600)
+        if res.returncode != 0:
+            raise RuntimeError(
+                f"restart probe --mode={mode} failed "
+                f"(exit {res.returncode}): {res.stderr[-2000:]}"
+            )
+        return json.loads(res.stdout.strip().splitlines()[-1])
+
+    with tempfile.TemporaryDirectory(prefix="restart-bench-") as tmp:
+        manifest = os.path.join(tmp, "warmup-manifest.json")
+        cache = os.path.join(tmp, "compile-cache")
+        cold = probe("cold", "", "")
+        writer = probe("write", manifest, cache)
+        cached = probe("cache", "", cache)
+        warm = probe("warm", manifest, cache)
+
+    wa = warm.get("warmup", {})
+    speedup = cold["first_solve_ms"] / max(warm["first_solve_ms"], 1e-6)
+    return {
+        "benchmark": "first_solve_after_restart",
+        "pods": cold["pods"],
+        # the ladder: each is a fresh process's FIRST solve
+        "no_cache_cold_ms": cold["first_solve_ms"],
+        "cache_only_ms": cached["first_solve_ms"],
+        "with_cache_ms": warm["first_solve_ms"],
+        "warm_ms": warm["second_solve_ms"],
+        "first_solve_speedup": round(speedup, 1),
+        # ledger attribution for the cold rung (what the restart costs)
+        "no_cache_cold_compiles": cold["first_compiles"],
+        "no_cache_cold_compile_ms": cold["first_compile_ms"],
+        "cold_families": cold["first_families"],
+        "cache_only_compiles": cached["first_compiles"],
+        "cache_only_compile_ms": cached["first_compile_ms"],
+        # the warmed rung's proof: zero compiles on the first solve
+        "compiles_after_warm": warm["first_compiles"],
+        "compile_ms_after_warm": warm["first_compile_ms"],
+        "provenance_compiles_after_warm": warm["provenance_compiles_first"],
+        # sweep accounting (manifest replay before the timed solve)
+        "warmup_wall_ms": wa.get("wall_ms"),
+        "warmup_specs": wa.get("specs_warmed"),
+        "warmup_skipped": wa.get("skipped"),
+        "manifest_entries": writer.get("manifest_entries"),
+        "placed_first": warm["placed_first"],
+        "backend": warm["backend"],
+        "device": "host" if os.environ.get("BENCH_FORCE_CPU") == "1"
+                  else "auto",
+        "note": "fresh-interpreter first solves: cold vs persistent-cache "
+                "vs manifest-warmed (benchmarks/restart_probe.py); the "
+                "warmed rung's compiles come from the jitwatch ledger",
+    }
+
+
 def run_all(scale: float = 1.0, on_row=None) -> list[dict]:
     rows = [
         bench_config6_cold_warm(n_pods=max(40, int(220 * scale))),
         bench_lanes_cold(burst=max(16, int(96 * scale))),
+        bench_first_solve_after_restart(n_pods=max(40, int(220 * scale))),
     ]
     for row in rows:
         print(json.dumps(row), flush=True)

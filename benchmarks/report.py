@@ -62,6 +62,10 @@ SUPERSEDED_BY = {
     # replaces the static partition-evidence analysis
     "multichip_8dev_2k_merge": "config9_100k_nodes",
     "multichip_8dev_partition_evidence": "multichip_8dev_5000node_screen",
+    # the unstamped end-to-end native controller pass predates the
+    # provenance contract; the stamped warm-encode controller pass at
+    # 5000 nodes measures the same loop with kernel attribution
+    "config4_controller_pass_native": "controller_pass_warm_encode_5000node",
 }
 
 

@@ -1,0 +1,124 @@
+"""Subprocess probe behind the ``first_solve_after_restart`` bench row.
+
+A restart is a process boundary, so the bench must cross one: the parent
+(``benchmarks/jit_bench.py``) launches this module three times and times
+the FIRST solve each fresh process serves —
+
+- ``--mode=cold``   no compile cache, no manifest: the full cold-start
+  tax (the number PR 14's ledger priced at ~4.3s for config6).
+- ``--mode=write``  enables the shared persistent compile cache, solves
+  until the ledger goes quiet (so the adaptive node-row bucket's
+  right-sized signatures are captured too), then writes the warmup
+  manifest — the "previous fleet process" of the story.
+- ``--mode=cache``  fresh process against the now-populated cache but NO
+  manifest: tracing still happens in-line on the first solve, only the
+  XLA backend work is a disk read — the middle rung of the ladder.
+- ``--mode=warm``   runs :func:`trace.warmup.startup_warm` against that
+  manifest + cache BEFORE the solver exists, then times the first solve.
+  The ledger must attribute ZERO compiles to it (``first_compiles`` and
+  the solve's own ``ProvenanceRecord.compiles`` stamp).
+
+One JSON object on stdout per run; everything else goes to stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="benchmarks.restart_probe")
+    parser.add_argument("--mode", choices=("cold", "write", "cache", "warm"),
+                        required=True)
+    parser.add_argument("--manifest", default="",
+                        help="manifest path (write: output, warm: input)")
+    parser.add_argument("--cache-dir", default="",
+                        help="persistent compile cache dir (write/warm)")
+    parser.add_argument("--pods", type=int, default=220)
+    args = parser.parse_args(argv)
+
+    from karpenter_provider_aws_tpu.trace import jitwatch, warmup
+
+    warm_acct = None
+    if args.mode == "warm":
+        # deadline 0 (unbounded) + foreground: the probe measures the
+        # steady mechanism, not a deadline policy — every family warms
+        # before the timed solve
+        warm_acct = warmup.startup_warm(
+            manifest_path=args.manifest,
+            deadline_s=0,
+            cache_dir=args.cache_dir or None,
+            background=False,
+        )
+    elif args.mode in ("write", "cache") and args.cache_dir:
+        warmup.ensure_compile_cache(args.cache_dir)
+
+    from benchmarks.jit_bench import _family_breakdown, _frag_pods
+    from karpenter_provider_aws_tpu.scheduling.solver import TPUSolver
+    from karpenter_provider_aws_tpu.testenv import new_environment
+
+    env = new_environment(use_tpu_solver=False)
+    try:
+        pool, _ = env.apply_defaults()
+        solver = TPUSolver()
+        pods = _frag_pods(args.pods)
+        led = jitwatch.ledger()
+
+        seq0 = led.seq()
+        t0 = time.perf_counter()
+        first = solver.solve(pods, [pool], env.catalog)
+        first_ms = (time.perf_counter() - t0) * 1e3
+        first_events = led.events_since(seq0)
+
+        # keep solving until a pass compiles nothing: the last pass is
+        # the in-process warm number, and a write-mode manifest captures
+        # the right-sized bucket signatures the resize passes mint
+        second_ms = first_ms
+        for _ in range(4):
+            seq1 = led.seq()
+            t0 = time.perf_counter()
+            solver.solve(pods, [pool], env.catalog)
+            second_ms = (time.perf_counter() - t0) * 1e3
+            if not led.events_since(seq1):
+                break
+
+        prov = first.provenance.as_dict() if first.provenance else {}
+        out = {
+            "mode": args.mode,
+            "pods": len(pods),
+            "first_solve_ms": round(first_ms, 1),
+            "second_solve_ms": round(second_ms, 1),
+            "first_compiles": len(first_events),
+            "first_compile_ms": round(
+                sum(e["wall_ms"] for e in first_events), 1
+            ),
+            "first_families": _family_breakdown(first_events),
+            "provenance_compiles_first": prov.get("compiles"),
+            "placed_first": first.pods_placed(),
+            "backend": solver.backend_label(),
+        }
+        if warm_acct is not None:
+            out["warmup"] = {
+                "families": len(warm_acct["families"]),
+                "specs_warmed": sum(
+                    c["warmed"] for c in warm_acct["families"].values()
+                ),
+                "wall_ms": warm_acct["wall_ms"],
+                "skipped": len(warm_acct["skipped"]),
+            }
+        if args.mode == "write" and args.manifest:
+            warmup.save_manifest(warmup.build_manifest(), args.manifest)
+            out["manifest_entries"] = len(
+                warmup.load_manifest(args.manifest)["entries"]
+            )
+        print(json.dumps(out), flush=True)
+        return 0
+    finally:
+        env.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -176,7 +176,12 @@ class ChaosHarness:
         self.probe_calls = 0
         self.settle_steps_used = 0
         self.errors_baseline = len(self.env.manager.errors)
+        #: (virtual t, replica identity, ProvenanceRecord.compiles) per
+        #: solve — the successor-warm invariant joins this against the
+        #: replica set's ownership timeline
+        self.solve_log: list[tuple[float, str, Optional[int]]] = []
         self._install_bind_audit()
+        self._install_solve_audit()
 
     # -- determinism helpers -------------------------------------------------
 
@@ -214,6 +219,37 @@ class ChaosHarness:
             return orig_bind(pod_uid, node_name, now)
 
         cluster.bind_pod = audited_bind
+
+    def _install_solve_audit(self) -> None:
+        """Wrap every replica's solver so each solve logs (t, identity,
+        provenance compiles) — same seam as the bind audit. The compiles
+        stamp is the jitwatch thread-local delta the solver already
+        records; 0 proves the solve ran warm."""
+        replicas = getattr(self.env, "replicas", None)
+        if replicas is not None:
+            targets = [(r.identity, r.provisioning) for r in replicas]
+        else:
+            targets = [("", getattr(self.env, "provisioning", None))]
+        for identity, prov in targets:
+            solver = getattr(prov, "solver", None)
+            if solver is None:
+                continue
+            self._wrap_solver_audit(identity, solver)
+
+    def _wrap_solver_audit(self, identity: str, solver) -> None:
+        orig_solve = solver.solve
+
+        def audited_solve(*args, **kwargs):
+            res = orig_solve(*args, **kwargs)
+            compiles = getattr(
+                getattr(res, "provenance", None), "compiles", None
+            )
+            self.solve_log.append(
+                (self.env.clock.now(), identity, compiles)
+            )
+            return res
+
+        solver.solve = audited_solve
 
     # -- scenario driving ----------------------------------------------------
 

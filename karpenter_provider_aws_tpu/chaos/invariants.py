@@ -408,6 +408,48 @@ def check_gangs_atomic(harness) -> InvariantResult:
     return _result("gangs-atomic", not partial, detail)
 
 
+def check_successor_warm(harness) -> InvariantResult:
+    """Zero-cold-start takeover (designs/aot-warmup.md): when a replica
+    adopts a dead launcher's shard, its first post-adoption solve must be
+    WARM — the adoption hook replays the fleet's warmup manifest before
+    the first owned pass, so the solve's provenance stamps ``compiles ==
+    0``. A successor that recompiles on its first pass would add seconds
+    of XLA latency exactly when the fleet is down a replica."""
+    rs = _replicaset(harness)
+    if rs is None:
+        return _result("successor-warm", True, "single-replica: n/a")
+    takeovers = [
+        (t, cur) for (t, key, prev, cur, token) in rs.ownership_timeline
+        if prev and cur and cur != prev
+    ]
+    if not takeovers:
+        return _result("successor-warm", True, "no takeovers: n/a")
+    solve_log = getattr(harness, "solve_log", [])
+    cold: list[str] = []
+    checked = 0
+    for t_take, successor in takeovers:
+        first = next(
+            (e for e in solve_log
+             if e[0] >= t_take and e[1] == successor), None)
+        if first is None:
+            continue  # successor never solved after takeover — nothing to attribute
+        _, _, compiles = first
+        if compiles is None:
+            continue  # unattributable solve (no provenance): skip, don't fail
+        checked += 1
+        if compiles != 0:
+            cold.append(f"{successor}@t={t_take:.0f}s compiles={compiles}")
+    if not checked:
+        return _result(
+            "successor-warm", True,
+            f"{len(takeovers)} takeovers, no attributable successor solves: n/a")
+    return _result(
+        "successor-warm", not cold,
+        (f"cold first solve after takeover: {cold[:3]}" if cold
+         else f"{checked} post-takeover first solves all compiles=0"),
+    )
+
+
 def check_controllers_healthy(harness) -> InvariantResult:
     errors = harness.env.manager.errors[harness.errors_baseline:]
     return _result(
@@ -432,6 +474,7 @@ INVARIANTS = (
     check_packing_envelope_parity,
     check_no_fleet_thrash,
     check_gangs_atomic,
+    check_successor_warm,
     check_controllers_healthy,
 )
 

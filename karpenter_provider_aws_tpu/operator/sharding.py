@@ -618,6 +618,12 @@ class ShardElector:
         controllers pick the claims up because the ownership filter now
         includes this partition — but it happens exactly once, at the
         acquire edge, and leaves an audit trail."""
+        # successor warmup: before the first owned pass compiles anything,
+        # replay the fleet's warmup manifest (no-op and jax-import-free
+        # unless KARPENTER_TPU_WARMUP_MANIFEST is set; never raises)
+        from ..trace.warmup import warm_on_adoption
+
+        warm_on_adoption()
         unsettled = []
         for claim in self.cluster.snapshot_claims():
             if key != GLOBAL_KEY:

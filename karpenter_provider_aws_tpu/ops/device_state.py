@@ -136,6 +136,9 @@ def _patch_fn(donate: bool):
             _patch_body, family="device_state.patch",
             donate_argnums=(0, 1, 2, 3) if donate else (),
         )
+        # builder params for the warmup manifest (trace/warmup.py): a
+        # fresh process re-materializes this wrapper via _patch_fn(**p)
+        fn.warmup_params = {"donate": bool(donate)}
         _patch_fns[donate] = fn
     return fn
 

@@ -107,7 +107,11 @@ def sharded_solve_fn(mesh: Mesh, max_nodes: int):
             res.placed[None, :, :],
         )
 
-    return tracked_jit(_solve_shard, family="mesh.solve_shard")
+    fn = tracked_jit(_solve_shard, family="mesh.solve_shard")
+    # warmup manifest builder params (trace/warmup.py): the mesh itself is
+    # re-derived from the fresh process's devices via make_mesh()
+    fn.warmup_params = {"max_nodes": int(max_nodes)}
+    return fn
 
 
 def pad_problem_for_mesh(problem, mesh: Mesh):
@@ -193,7 +197,9 @@ def sharded_screen_fn(mesh: Mesh):
     def _screen(free, requests, gids, gcounts, cap, candidates):
         return repack_check(free, requests, gids, gcounts, cap, candidates)
 
-    return tracked_jit(_screen, family="mesh.screen")
+    fn = tracked_jit(_screen, family="mesh.screen")
+    fn.warmup_params = {}
+    return fn
 
 
 def place_screen_args(ct, mesh: Mesh):
@@ -442,7 +448,9 @@ def _lane_body(max_nodes: int):
 
 @functools.lru_cache(maxsize=8)
 def _lanes_vmap_fn(max_nodes: int):
-    return tracked_jit(jax.vmap(_lane_body(max_nodes)), family="mesh.lanes")
+    fn = tracked_jit(jax.vmap(_lane_body(max_nodes)), family="mesh.lanes")
+    fn.warmup_params = {"max_nodes": int(max_nodes)}
+    return fn
 
 
 @functools.lru_cache(maxsize=8)
@@ -459,7 +467,9 @@ def _lanes_shard_fn(mesh: Mesh, max_nodes: int):
         P(POD_AXIS),
         P(POD_AXIS),
     )
-    return tracked_jit(fn, family="mesh.lanes_shard")
+    wrapped = tracked_jit(fn, family="mesh.lanes_shard")
+    wrapped.warmup_params = {"max_nodes": int(max_nodes)}
+    return wrapped
 
 
 def stack_lane_problems(padded_list):

@@ -239,6 +239,12 @@ class SolverServer:
     def start(self) -> int:
         self._server.start()
         log.info("solver sidecar listening on port %d", self.port)
+        # zero-cold-start: replay the fleet warmup manifest (and point jax
+        # at the shared persistent compile cache) before the first Solve
+        # RPC pays a compile. Env-gated no-op; never raises.
+        from ..trace.warmup import startup_warm
+
+        startup_warm()
         return self.port
 
     def stop(self, grace: float = 1.0) -> None:

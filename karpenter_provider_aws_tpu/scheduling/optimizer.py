@@ -64,6 +64,7 @@ from __future__ import annotations
 
 import functools
 import os
+import threading
 from typing import Optional
 
 import numpy as np
@@ -76,6 +77,10 @@ __all__ = [
     "dispatch_optimizer",
     "validate_plan",
     "count_outcome",
+    "cold_skip_active",
+    "lanes_warm",
+    "warm_lanes_async",
+    "join_lane_warm",
 ]
 
 #: cost penalty per unplaced pod inside lane selection — dominates any
@@ -129,6 +134,82 @@ def count_outcome(outcome: str, n: int = 1) -> None:
         OPTIMIZER_LANE.inc(n, outcome=outcome)
     except Exception:  # pragma: no cover - defensive
         pass
+
+
+def cold_skip_active() -> bool:
+    """Lazy lane admission on cold start (``outcome=skipped_cold``): when
+    active and the lane program is still cold, the solver serves FFD-only
+    instead of blocking its first solve ~3.4s behind the lane compile.
+    ``KARPENTER_TPU_OPT_COLD_SKIP=1`` forces it on, ``0`` kills it; the
+    default ``auto`` arms it only on a warmup-managed cold start (a
+    process that loaded a warmup manifest — trace/warmup.py), so plain
+    test/bench processes keep first-solve lane dispatch unchanged."""
+    v = os.environ.get("KARPENTER_TPU_OPT_COLD_SKIP", "auto")
+    if v == "1":
+        return True
+    if v == "0":
+        return False
+    from ..trace.warmup import cold_start_context
+
+    return cold_start_context()
+
+
+def lanes_warm() -> bool:
+    """Whether ``optimizer.lanes`` has at least one trace signature in
+    this process (compiled or AOT-warmed) — the lazy-admission gate."""
+    from ..trace.jitwatch import ledger
+
+    return ledger().family_signatures("optimizer.lanes") > 0
+
+
+_warm_lock = threading.Lock()
+_warm_thread: Optional[threading.Thread] = None
+
+
+def warm_lanes_async(padded, max_nodes: int, dput=None,
+                     seed: Optional[int] = None,
+                     lanes: Optional[int] = None) -> threading.Thread:
+    """Compile the lane program OFF the serving path: a daemon thread runs
+    one throwaway :func:`dispatch_optimizer` against the current padded
+    tensors, so ``lanes_warm()`` flips true and the next solve admits the
+    lane. One in-flight warm at a time; failures are swallowed (the
+    breaker path owns real dispatch errors)."""
+    global _warm_thread
+    with _warm_lock:
+        if _warm_thread is not None and _warm_thread.is_alive():
+            return _warm_thread
+
+        def _run():
+            import logging
+
+            try:
+                out = dispatch_optimizer(
+                    padded, max_nodes, dput=dput, seed=seed, lanes=lanes
+                )
+                import jax
+
+                jax.block_until_ready(out["refs"])
+            except Exception as e:  # off-path: log, never raise
+                logging.getLogger("karpenter.tpu.optimizer").debug(
+                    "background lane warm failed: %s: %s",
+                    type(e).__name__, e,
+                )
+
+        t = threading.Thread(target=_run, name="opt-lane-warm", daemon=True)
+        _warm_thread = t
+        t.start()
+        return t
+
+
+def join_lane_warm(timeout: Optional[float] = None) -> bool:
+    """Wait for an in-flight background lane warm (tests). True when no
+    warm is running."""
+    with _warm_lock:
+        t = _warm_thread
+    if t is None:
+        return True
+    t.join(timeout)
+    return not t.is_alive()
 
 
 def gap_key(problem, hist_key) -> tuple:
@@ -284,7 +365,11 @@ def _program(max_nodes: int, lanes: int):
 
     from ..trace.jitwatch import tracked_jit
 
-    return tracked_jit(program, family="optimizer.lanes")
+    fn = tracked_jit(program, family="optimizer.lanes")
+    # builder params ride on the wrapper: a fresh process replays this
+    # family's manifest entries through _program_cached(**warmup_params)
+    fn.warmup_params = {"max_nodes": int(max_nodes), "lanes": int(lanes)}
+    return fn
 
 
 @functools.lru_cache(maxsize=16)

@@ -1251,6 +1251,16 @@ class TPUSolver:
             self.timings["opt_lane"] = "skipped_tight"
             _opt.count_outcome("skipped_tight")
             return None
+        if _opt.cold_skip_active() and not _opt.lanes_warm():
+            # lazy admission on a warmup-managed cold start: FFD serves
+            # NOW instead of blocking ~3.4s behind the lane compile; a
+            # background warm re-arms the lane for the next pass (plain
+            # dput — the solver's content cache is not shared off-thread)
+            self.timings["opt_lane"] = "skipped_cold"
+            self.timings["opt_skipped_cold"] = True
+            _opt.count_outcome("skipped_cold")
+            _opt.warm_lanes_async(padded, n_rows)
+            return None
         br = _rbreakers.get("solver.optimizer")
         if not br.allow():
             self.timings["opt_lane"] = "breaker_open"
@@ -1935,6 +1945,12 @@ def host_solve_encoded(
 
 class HostSolver:
     """Numpy fallback solver (and the oracle in tests)."""
+
+    def __init__(self):
+        # a real timings dict makes _solve_multi_nodepool stamp
+        # ``compiles`` on host provenance too — the chaos successor-warm
+        # invariant needs host solves attributable, not None
+        self.timings: dict = {}
 
     def backend_label(self) -> str:
         return "host"

@@ -189,6 +189,11 @@ class FleetSimulator:
         self.backend_wall_ms: dict[str, float] = {}
         self.residency_counts: dict[str, int] = {}
         self.fallback_counts: dict[str, int] = {}
+        # zero-cold-start proof (designs/aot-warmup.md): the FIRST solve's
+        # provenance `compiles` stamp — when the process warmed from a
+        # manifest this must be 0 (the `first_solve_after_restart` gate)
+        self.first_solve_compiles: Optional[int] = None
+        self._first_solve_seen = False
         self._pods_by_prefix: dict[str, list[str]] = {}  # name -> pod uids
         # seen-record cursor over the process-global provenance registry:
         # id -> weakref of the record seen under that id. A bare id() set
@@ -267,6 +272,13 @@ class FleetSimulator:
 
         spec = self.trace
         env = self.env
+        # AOT warmup before the fleet exists: when the process carries a
+        # warmup manifest (KARPENTER_TPU_WARMUP_MANIFEST) every solver
+        # family is compiled here, so the run's first solve — and the
+        # first_solve_after_restart gate it feeds — is warm
+        from ..trace import warmup as _warmup
+
+        _warmup.startup_warm()
         # per-node agent overhead: registered BEFORE any encode so every
         # capacity tensor of the run is net of the agents (cleared in
         # run()'s finally — the registry is process-global)
@@ -548,6 +560,9 @@ class FleetSimulator:
                 if ref is not None and ref() is rec:
                     continue
                 self._seen_records[id(rec)] = weakref.ref(rec)
+                if kind == "solve" and not self._first_solve_seen:
+                    self._first_solve_seen = True
+                    self.first_solve_compiles = rec.compiles
                 self.backend_counts[rec.backend] = (
                     self.backend_counts.get(rec.backend, 0) + 1
                 )
@@ -834,6 +849,8 @@ class FleetSimulator:
             led.events_since(self._jit_warm_seq)
             if self._jit_warm_seq is not None else []
         )
+        from ..trace import warmup as _warmup
+
         return {
             "enabled": True,
             "families": snap["families"],
@@ -843,6 +860,14 @@ class FleetSimulator:
             "retraces_after_warmup": len(after),
             "retrace_events_after_warmup": after,
             "sentinel": self.env.obs.retrace.summary(),
+            # AOT manifest warmup (pre-fleet, designs/aot-warmup.md):
+            # per-family replay accounting when the process warmed from a
+            # manifest, plus the first solve's provenance compile stamp
+            "aot_warmup": {
+                "did_warm": _warmup.did_warm(),
+                "accounting": _warmup.accounting(),
+                "first_solve_compiles": self.first_solve_compiles,
+            },
         }
 
     def run(self):
@@ -1025,6 +1050,12 @@ class FleetSimulator:
                 if self.check_invariants:
                     self.invariants = check_all(self)
             self.driver_wall_s = time.perf_counter() - wall0
+            # every family the day traced is in the ledger now: serialize
+            # the warmup manifest (KARPENTER_TPU_WARMUP_SAVE; no-op when
+            # unset) so the next process starts warm
+            from ..trace import warmup as _warmup
+
+            _warmup.maybe_save()
         finally:
             from ..ops import overhead as _overhead
 

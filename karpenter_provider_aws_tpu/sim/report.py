@@ -478,6 +478,13 @@ def build_report(sim, span_profile: dict, deltas: dict) -> FleetReport:
         if envelope:
             gate["packing_envelope_ratio"] = envelope.get("packing_ratio")
             gate["cost_envelope_ratio"] = envelope.get("cost_ratio")
+    aot = (device_plane or {}).get("aot_warmup") or {}
+    if aot.get("did_warm"):
+        # the zero-cold-start gate (designs/aot-warmup.md): the process
+        # warmed from a manifest, so the run's FIRST solve must have
+        # compiled nothing — only stamped when warmup actually ran, so
+        # plain (cold) runs don't gate a key they can't satisfy
+        gate["first_solve_after_restart"] = aot.get("first_solve_compiles")
 
     return FleetReport(data={
         "schema": SCHEMA_VERSION,

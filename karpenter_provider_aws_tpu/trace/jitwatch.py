@@ -50,6 +50,7 @@ import os
 import threading
 import time
 import traceback
+import weakref
 from collections import deque
 from typing import Optional
 
@@ -73,7 +74,7 @@ class FamilyRecord:
     __slots__ = ("name", "callsite", "compiles", "retraces", "hits",
                  "compile_ms_total", "last_compile_ms", "signatures",
                  "last_sig", "last_change", "dispatch_bytes_total",
-                 "last_arg_bytes")
+                 "last_arg_bytes", "warmed", "warm_ms_total")
 
     def __init__(self, name: str, callsite: str):
         self.name = name
@@ -88,6 +89,8 @@ class FamilyRecord:
         self.last_change = ""      # retrace attribution of the last trace
         self.dispatch_bytes_total = 0
         self.last_arg_bytes = 0
+        self.warmed = 0            # AOT warmup replays (trace/warmup.py)
+        self.warm_ms_total = 0.0
 
     def as_dict(self) -> dict:
         return {
@@ -102,6 +105,8 @@ class FamilyRecord:
             "last_change": self.last_change,
             "dispatch_bytes_total": int(self.dispatch_bytes_total),
             "last_arg_bytes": int(self.last_arg_bytes),
+            "warmed": self.warmed,
+            "warm_ms_total": round(self.warm_ms_total, 1),
         }
 
 
@@ -116,6 +121,10 @@ class JitLedger:
         self._seq = 0               # monotonic compile counter
         #: jax.monitoring observations: event key -> [count, total_secs]
         self._monitor: dict[str, list] = {}
+        #: AOT warmup replays (trace/warmup.py) — kept OUT of the compile
+        #: event ring: a warmup is not a compile the serving path paid,
+        #: and the zero-retrace gates must not count it
+        self._warm_events: deque = deque(maxlen=EVENTS_CAP)
 
     # -- recording ----------------------------------------------------------
     def family(self, name: str, callsite: str = "") -> FamilyRecord:
@@ -179,6 +188,47 @@ class JitLedger:
             pass
         return event
 
+    def record_warm(self, name: str, sig, wall_ms: float) -> None:
+        """One AOT warmup replay of ``name`` (``lower().compile()`` —
+        trace/warmup.py). Claims the signature so the first REAL call with
+        these shapes records a *hit*, and keeps the warm wall in its own
+        accounting: ``_seq`` does not move, ``thread_compiles()`` does not
+        move, and no event lands in the compile ring — a warmed family is
+        exactly as invisible to the retrace gates as a warm one."""
+        with self._lock:
+            rec = self._families.get(name)
+            if rec is None:
+                rec = self._families[name] = FamilyRecord(name, "")
+            if sig is not None and sig not in rec.signatures:
+                rec.signatures[sig] = 0
+                rec.last_sig = sig
+            rec.warmed += 1
+            rec.warm_ms_total += wall_ms
+            self._warm_events.append({
+                "family": name,
+                "wall_ms": round(wall_ms, 1),
+                "at_unix": round(time.time(), 3),
+            })
+
+    def family_signatures(self, name: str) -> int:
+        """How many trace signatures ``name`` has (compiled OR warmed) —
+        0 means the family is still cold in this process."""
+        with self._lock:
+            rec = self._families.get(name)
+            return len(rec.signatures) if rec else 0
+
+    def warm_summary(self) -> dict:
+        """{family: {count, wall_ms}} of AOT warmup replays so far."""
+        with self._lock:
+            out: dict[str, dict] = {}
+            for e in self._warm_events:
+                cell = out.setdefault(
+                    e["family"], {"count": 0, "wall_ms": 0.0}
+                )
+                cell["count"] += 1
+                cell["wall_ms"] = round(cell["wall_ms"] + e["wall_ms"], 1)
+            return out
+
     def note_monitor(self, key: str, secs: float) -> None:
         with self._lock:
             cell = self._monitor.setdefault(key, [0, 0.0])
@@ -218,6 +268,7 @@ class JitLedger:
                     k: {"count": c, "total_s": round(s, 3)}
                     for k, (c, s) in sorted(self._monitor.items())
                 },
+                "warmups": [dict(e) for e in self._warm_events],
             }
 
     def live_arg_bytes(self) -> dict:
@@ -255,6 +306,7 @@ class JitLedger:
             self._events.clear()
             self._seq = 0
             self._monitor.clear()
+            self._warm_events.clear()
 
 
 _LEDGER = JitLedger()
@@ -316,6 +368,43 @@ def install_monitoring() -> bool:
 
 
 # ---------------------------------------------------------------------------
+# wrapper registry: every live tracked_jit wrapper, by family
+# ---------------------------------------------------------------------------
+
+_registry_lock = threading.Lock()
+#: family -> [weakref to _TrackedJit]. Weak: factory-built wrappers
+#: (optimizer lane programs, mesh lane fns) live in lru_caches and may be
+#: evicted; the registry must not pin them.
+_registry: dict[str, list] = {}
+
+
+def _register(wrapper: "_TrackedJit") -> None:
+    with _registry_lock:
+        refs = _registry.setdefault(wrapper.family, [])
+        refs[:] = [r for r in refs if r() is not None]
+        refs.append(weakref.ref(wrapper))
+
+
+def wrappers_for(family: str) -> list:
+    """The LIVE tracked wrappers registered under ``family`` (a factory
+    family like ``optimizer.lanes`` can have several — one per builder
+    parameterization)."""
+    with _registry_lock:
+        refs = _registry.get(family, ())
+        return [w for w in (r() for r in refs) if w is not None]
+
+
+def all_wrappers() -> list:
+    """Every live tracked wrapper in the process — the warmup manifest
+    builder walks this."""
+    with _registry_lock:
+        out = []
+        for refs in _registry.values():
+            out.extend(w for w in (r() for r in refs) if w is not None)
+        return out
+
+
+# ---------------------------------------------------------------------------
 # tracked_jit
 # ---------------------------------------------------------------------------
 
@@ -329,6 +418,24 @@ def _trace_state_clean() -> bool:
         return bool(jax.core.trace_state_clean())
     except Exception:
         return True
+
+
+def _abstract_spec(args, kwargs):
+    """The abstract twin of one call's arguments: array-likes become
+    ``jax.ShapeDtypeStruct`` (only shape/dtype survive — exactly the axes
+    ``_leaf_sig`` keys on, so a replay produces the identical signature),
+    python scalars and static values stay concrete. Captured BEFORE the
+    dispatch runs — donated buffers are invalid after it."""
+    import jax
+
+    def leaf(x):
+        shape = getattr(x, "shape", None)
+        dtype = getattr(x, "dtype", None)
+        if shape is not None and dtype is not None:
+            return jax.ShapeDtypeStruct(tuple(shape), dtype)
+        return x
+
+    return jax.tree_util.tree_map(leaf, (args, kwargs))
 
 
 def _leaf_sig(leaf) -> tuple:
@@ -416,6 +523,16 @@ class _TrackedJit:
         self._seen: set = set()
         self._last_sig = None
         self._callsite = _callsite_of(fn)
+        #: sig -> abstract (args, kwargs) replay spec (ShapeDtypeStruct
+        #: leaves, concrete python scalars) captured at first trace — the
+        #: warmup manifest's raw material (trace/warmup.py)
+        self._replay: dict = {}
+        #: builder parameters for factory-made wrappers (set by the
+        #: factory: optimizer._program, device_state._patch_fn, the mesh
+        #: lane builders) so a fresh process can re-materialize THIS
+        #: wrapper before replaying its specs; None for module-level fns
+        self.warmup_params: Optional[dict] = None
+        _register(self)
 
     # jax's jitted functions expose lower/trace etc.; forward unknowns so
     # the wrapper stays a drop-in
@@ -480,6 +597,12 @@ class _TrackedJit:
         # new signature: this call traces (and compiles on a cache miss
         # of jax's own); time it and attribute the changed axis
         changed = _describe_change(prev, sig)
+        try:
+            spec = _abstract_spec(args, kwargs)
+            with self._lock:
+                self._replay[sig] = spec
+        except Exception:
+            pass  # an exotic pytree loses its manifest entry, not the call
         from .spans import span as _span
 
         t0 = time.perf_counter()
@@ -493,6 +616,37 @@ class _TrackedJit:
             callsite=self._callsite or _compile_backtrace(),
         )
         return out
+
+    # -- AOT warmup (trace/warmup.py drives these) --------------------------
+    def replay_specs(self) -> list:
+        """The abstract (args, kwargs) specs this wrapper has traced —
+        one per signature, manifest-ready."""
+        with self._lock:
+            return list(self._replay.values())
+
+    def warm(self, spec) -> float:
+        """AOT-compile one replay spec (``lower().compile()``) and claim
+        its signature: the next real call with these shapes records a
+        ledger *hit*, and jax serves the executable from its own (persistent
+        cache backed) compile cache. Returns the warmup wall in ms."""
+        args, kwargs = spec
+        try:
+            sig, _ = self._signature(args, kwargs)
+            with self._lock:
+                if sig in self._seen:   # already traced/warmed: idempotent
+                    return 0.0
+        except Exception:
+            sig = None
+        t0 = time.perf_counter()
+        self._jit.lower(*args, **kwargs).compile()
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        if sig is not None:
+            with self._lock:
+                self._seen.add(sig)
+                self._last_sig = sig
+                self._replay.setdefault(sig, spec)
+        _LEDGER.record_warm(self.family, sig, wall_ms)
+        return wall_ms
 
 
 def tracked_jit(fn=None, *, family: Optional[str] = None, **jit_kwargs):

@@ -1,0 +1,511 @@
+"""AOT warmup: the jitwatch ledger serialized into a manifest, replayed
+through ``lower().compile()`` before a process serves its first solve.
+
+PR 14's ledger priced the cold-start tax precisely: a config6 cold solve
+is 4,355.9ms of which 4,242.3ms is XLA compile (``optimizer.lanes`` alone
+~3.4s) against 51.6ms warm — so every restarted sidecar/replica wins its
+leases in seconds and then stalls its first real solve behind compiles it
+has paid a thousand times before. This module closes that cliff with the
+classic serving-stack pair:
+
+- **The manifest** — the ledger IS the record of which trace signatures a
+  fleet of this exact workload actually compiles (ladder buckets, static
+  axes, dtypes). :func:`build_manifest` serializes every live
+  ``tracked_jit`` wrapper's replay specs (captured at first trace as
+  ``ShapeDtypeStruct`` pytrees) into a versioned JSON document;
+  :func:`warm_from_manifest` replays it through ``lower().compile()`` in
+  a fixed priority order — FFD + screen first, so the solve-serving path
+  is warm before the ~3.4s optimizer lane program even starts; the lane
+  programs may finish warming on a background thread while FFD already
+  serves — under a deadline budget with per-family wall/skip accounting.
+- **The persistent compile cache** — :func:`ensure_compile_cache` points
+  jax's persistent compilation cache at a fleet-shared directory (with a
+  uid-/pid-keyed fallback when the shared path is not writable), so a
+  warmup on a restarted process is a cache *read*, not a re-compile: the
+  first process pays XLA once and writes executables the whole fleet
+  reuses.
+
+Entry points, threaded through every place a process learns its shapes:
+:func:`startup_warm` (sim driver fleet build, ``bench.py`` children,
+sidecar startup), :func:`warm_on_adoption` (``ShardElector`` — a
+successor warms the dead launcher's manifest before its first owned
+pass), :func:`maybe_save` (end of a run, env-gated).
+
+Knobs::
+
+    KARPENTER_TPU_WARMUP_MANIFEST     path to load + warm at startup
+    KARPENTER_TPU_WARMUP_SAVE         path to write the manifest at exit
+    KARPENTER_TPU_WARMUP_DEADLINE_S   foreground warmup budget (0 = none)
+    KARPENTER_TPU_COMPILE_CACHE_DIR   shared cache dir ("0" disables)
+
+A corrupt, version-skewed, or simply missing manifest degrades to a plain
+cold start: every loader/decoder error is caught, accounted, and never
+crosses into the serving path.
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import logging
+import os
+import threading
+import time
+from typing import Optional
+
+from . import jitwatch
+
+log = logging.getLogger("karpenter.tpu.warmup")
+
+MANIFEST_VERSION = 1
+
+ENV_MANIFEST = "KARPENTER_TPU_WARMUP_MANIFEST"
+ENV_SAVE = "KARPENTER_TPU_WARMUP_SAVE"
+ENV_DEADLINE = "KARPENTER_TPU_WARMUP_DEADLINE_S"
+ENV_CACHE = "KARPENTER_TPU_COMPILE_CACHE_DIR"
+DEFAULT_CACHE_DIR = "/tmp/karpenter_tpu_jit_cache"
+
+#: only our own containers may be re-materialized by the spec decoder —
+#: a manifest is fleet-internal data, not a pickle
+_PKG = "karpenter_provider_aws_tpu"
+
+
+class ManifestError(ValueError):
+    """The manifest file is unusable (corrupt JSON, wrong version, wrong
+    shape) — callers degrade to a plain cold start."""
+
+
+class SpecCodecError(ValueError):
+    """One replay spec cannot be (de)serialized — that entry is skipped
+    with a recorded reason, never fatal."""
+
+
+# ---------------------------------------------------------------------------
+# spec codec: restricted JSON pytrees (no pickle)
+# ---------------------------------------------------------------------------
+
+def _encode(x) -> dict:
+    import jax
+
+    if isinstance(x, jax.ShapeDtypeStruct):
+        return {"t": "arr", "shape": list(x.shape), "dtype": str(x.dtype)}
+    if x is None or isinstance(x, (bool, int, float, str)):
+        return {"t": "py", "v": x}
+    if isinstance(x, tuple) and hasattr(x, "_fields"):      # NamedTuple
+        cls = type(x)
+        return {
+            "t": "nt",
+            "cls": f"{cls.__module__}:{cls.__qualname__}",
+            "items": [_encode(v) for v in x],
+        }
+    if isinstance(x, tuple):
+        return {"t": "tuple", "items": [_encode(v) for v in x]}
+    if isinstance(x, list):
+        return {"t": "list", "items": [_encode(v) for v in x]}
+    if isinstance(x, dict):
+        if not all(isinstance(k, str) for k in x):
+            raise SpecCodecError("non-string dict keys")
+        return {
+            "t": "dict",
+            "items": [[k, _encode(v)] for k, v in sorted(x.items())],
+        }
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:   # stray concrete array
+        return {"t": "arr", "shape": list(shape), "dtype": str(dtype)}
+    raise SpecCodecError(f"unserializable leaf {type(x).__name__}")
+
+
+def _decode(d: dict):
+    import numpy as np
+
+    import jax
+
+    t = d.get("t")
+    if t == "arr":
+        return jax.ShapeDtypeStruct(tuple(d["shape"]), np.dtype(d["dtype"]))
+    if t == "py":
+        return d["v"]
+    if t == "tuple":
+        return tuple(_decode(v) for v in d["items"])
+    if t == "list":
+        return [_decode(v) for v in d["items"]]
+    if t == "dict":
+        return {k: _decode(v) for k, v in d["items"]}
+    if t == "nt":
+        modname, _, qual = d["cls"].partition(":")
+        if not modname.startswith(_PKG):
+            raise SpecCodecError(f"refusing foreign class {d['cls']!r}")
+        obj = importlib.import_module(modname)
+        for part in qual.split("."):
+            obj = getattr(obj, part)
+        return obj(*(_decode(v) for v in d["items"]))
+    raise SpecCodecError(f"unknown spec tag {t!r}")
+
+
+# ---------------------------------------------------------------------------
+# family materialization: find-or-build the wrapper a spec replays through
+# ---------------------------------------------------------------------------
+
+#: module-level families: importing the home module registers the wrapper
+_FAMILY_MODULES = {
+    "ffd.solve": f"{_PKG}.ops.ffd",
+    "ffd.solve_chained": f"{_PKG}.ops.ffd",
+    "ffd.compact_plan": f"{_PKG}.ops.ffd",
+    "ffd.rank_launch_options": f"{_PKG}.ops.ffd",
+    "ffd.pallas": f"{_PKG}.ops.ffd_pallas",
+    "screen.repack": f"{_PKG}.ops.consolidate",
+    "screen.pallas": f"{_PKG}.ops.repack_pallas",
+    "gangs.feasible": f"{_PKG}.scheduling.groups",
+}
+
+
+def _materialize(family: str, params: Optional[dict]):
+    """The live wrapper for ``family`` — factory families rebuild through
+    their (cached) builder with the manifest's recorded params, module
+    families import their home module and read the registry."""
+    params = params or {}
+    # NOTE: lru_cache keys keyword calls separately from positional ones —
+    # every builder below must be called POSITIONALLY, exactly like its
+    # runtime dispatch site, or the warm replay lands on a second cache
+    # entry and the fleet's first solve still compiles.
+    if family == "optimizer.lanes":
+        from ..scheduling.optimizer import _program_cached
+
+        return _program_cached(int(params["max_nodes"]), int(params["lanes"]))
+    if family == "device_state.patch":
+        from ..ops.device_state import _patch_fn
+
+        return _patch_fn(bool(params["donate"]))
+    if family == "mesh.lanes":
+        from ..parallel.mesh import _lanes_vmap_fn
+
+        return _lanes_vmap_fn(int(params["max_nodes"]))
+    if family == "mesh.lanes_shard":
+        from ..parallel.mesh import _lanes_shard_fn, make_mesh
+
+        return _lanes_shard_fn(make_mesh(), int(params["max_nodes"]))
+    if family == "mesh.solve_shard":
+        from ..parallel.mesh import make_mesh, sharded_solve_fn
+
+        return sharded_solve_fn(make_mesh(), int(params["max_nodes"]))
+    if family == "mesh.screen":
+        from ..parallel.mesh import make_mesh, sharded_screen_fn
+
+        return sharded_screen_fn(make_mesh())
+    mod = _FAMILY_MODULES.get(family)
+    if mod is not None:
+        importlib.import_module(mod)
+    wrappers = jitwatch.wrappers_for(family)
+    if not wrappers:
+        raise SpecCodecError(f"no wrapper for family {family!r}")
+    return wrappers[0]
+
+
+# ---------------------------------------------------------------------------
+# manifest build / save / load
+# ---------------------------------------------------------------------------
+
+def build_manifest() -> dict:
+    """Serialize every live wrapper's replay specs into a manifest dict.
+    Unserializable specs are recorded under ``unserializable`` (family +
+    reason) rather than failing the build."""
+    import jax
+
+    entries: list[dict] = []
+    unserializable: list[dict] = []
+    for w in jitwatch.all_wrappers():
+        for spec in w.replay_specs():
+            try:
+                args, kwargs = spec
+                entries.append({
+                    "family": w.family,
+                    "params": w.warmup_params,
+                    "args": [_encode(a) for a in args],
+                    "kwargs": {k: _encode(v) for k, v in kwargs.items()},
+                })
+            except SpecCodecError as e:
+                unserializable.append({"family": w.family, "reason": str(e)})
+    return {
+        "version": MANIFEST_VERSION,
+        "jax": jax.__version__,
+        "entries": entries,
+        "unserializable": unserializable,
+    }
+
+
+def save_manifest(manifest: dict, path: str) -> str:
+    """Atomic write (tmp + rename): a reader never sees a torn file."""
+    d = os.path.dirname(os.path.abspath(path))
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    return path
+
+
+def load_manifest(path: str) -> dict:
+    """Parse + validate one manifest file. Raises :class:`ManifestError`
+    on corrupt JSON, a version skew, or a structurally wrong document —
+    callers catch it and run cold."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        raise ManifestError(f"unreadable manifest {path!r}: {e}") from e
+    if not isinstance(doc, dict):
+        raise ManifestError(f"manifest {path!r} is not an object")
+    if doc.get("version") != MANIFEST_VERSION:
+        raise ManifestError(
+            f"manifest {path!r} version {doc.get('version')!r} != "
+            f"{MANIFEST_VERSION}"
+        )
+    if not isinstance(doc.get("entries"), list):
+        raise ManifestError(f"manifest {path!r} has no entries list")
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# the warmup sweep
+# ---------------------------------------------------------------------------
+
+#: foreground priority: the solve-serving path (FFD + screen + patch +
+#: gangs) warms first; the lane programs — including the ~3.4s
+#: optimizer.lanes compile — rank last and may finish in the background
+_PRIORITY = {fam: i for i, fam in enumerate((
+    "ffd.solve", "ffd.solve_chained", "ffd.rank_launch_options",
+    "ffd.compact_plan", "screen.repack", "screen.pallas", "ffd.pallas",
+    "device_state.patch", "gangs.feasible",
+    "mesh.solve_shard", "mesh.screen",
+))}
+_LATE = {"mesh.lanes": 100, "mesh.lanes_shard": 101, "optimizer.lanes": 200}
+
+
+def _rank(family: str) -> int:
+    return _PRIORITY.get(family, _LATE.get(family, 50))
+
+
+_bg_lock = threading.Lock()
+_bg_thread: Optional[threading.Thread] = None
+
+
+def _warm_entry(entry: dict, acct: dict, lock: threading.Lock) -> None:
+    family = entry.get("family", "?")
+    try:
+        wrapper = _materialize(family, entry.get("params"))
+        args = tuple(_decode(a) for a in entry.get("args", []))
+        kwargs = {k: _decode(v) for k, v in entry.get("kwargs", {}).items()}
+        wall = wrapper.warm((args, kwargs))
+        with lock:
+            cell = acct["families"].setdefault(
+                family, {"warmed": 0, "wall_ms": 0.0}
+            )
+            cell["warmed"] += 1
+            cell["wall_ms"] = round(cell["wall_ms"] + wall, 1)
+    except Exception as e:
+        with lock:
+            acct["skipped"].append({
+                "family": family,
+                "reason": f"{type(e).__name__}: {e}",
+            })
+
+
+def warm_from_manifest(manifest: dict, deadline_s: Optional[float] = None,
+                       background: bool = True) -> dict:
+    """Replay every manifest entry through ``lower().compile()`` in
+    priority order under a deadline budget; returns the accounting dict
+    ({families: {name: {warmed, wall_ms}}, skipped: [{family, reason}],
+    deadline_hit, background_families, wall_ms}).
+
+    When the deadline fires, remaining late-ranked entries (the lane
+    programs) continue on a daemon thread if ``background`` — FFD serves
+    warm while the 3.4s lane compile finishes off-path; other remaining
+    entries are skipped with reason ``deadline``."""
+    global _bg_thread
+    if deadline_s is None:
+        deadline_s = float(os.environ.get(ENV_DEADLINE, "0") or 0)
+    t0 = time.perf_counter()
+    lock = threading.Lock()
+    acct: dict = {
+        "families": {},
+        "skipped": [],
+        "deadline_hit": False,
+        "background_families": [],
+        "wall_ms": 0.0,
+    }
+    entries = sorted(
+        manifest.get("entries", []),
+        key=lambda e: _rank(e.get("family", "?")),
+    )
+    deferred: list[dict] = []
+    for entry in entries:
+        if deadline_s and (time.perf_counter() - t0) > deadline_s:
+            acct["deadline_hit"] = True
+            fam = entry.get("family", "?")
+            if background and _rank(fam) >= 100:
+                deferred.append(entry)
+            else:
+                acct["skipped"].append({"family": fam, "reason": "deadline"})
+            continue
+        _warm_entry(entry, acct, lock)
+    if deferred:
+        acct["background_families"] = sorted(
+            {e.get("family", "?") for e in deferred}
+        )
+
+        def _bg():
+            for e in deferred:
+                _warm_entry(e, acct, lock)
+
+        with _bg_lock:
+            t = threading.Thread(
+                target=_bg, name="warmup-lanes", daemon=True
+            )
+            _bg_thread = t
+            t.start()
+    acct["wall_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+    return acct
+
+
+def join_background(timeout: Optional[float] = None) -> bool:
+    """Wait for a deferred background lane warmup (tests / smoke tools).
+    True when no background work remains."""
+    with _bg_lock:
+        t = _bg_thread
+    if t is None:
+        return True
+    t.join(timeout)
+    return not t.is_alive()
+
+
+# ---------------------------------------------------------------------------
+# persistent compile cache wiring
+# ---------------------------------------------------------------------------
+
+def ensure_compile_cache(path: Optional[str] = None) -> Optional[str]:
+    """Point jax's persistent compilation cache at the fleet-shared dir
+    (``KARPENTER_TPU_COMPILE_CACHE_DIR``, default a shared /tmp path),
+    falling back to a uid-keyed then pid-keyed sibling when the shared
+    path is not writable. ``"0"`` disables. Returns the dir in use."""
+    raw = path or os.environ.get(ENV_CACHE) or DEFAULT_CACHE_DIR
+    if raw in ("0", "off", "none"):
+        return None
+    uid = getattr(os, "getuid", lambda: 0)()
+    for candidate in (raw, f"{raw}-u{uid}", f"{raw}-p{os.getpid()}"):
+        try:
+            os.makedirs(candidate, exist_ok=True)
+        except OSError:
+            continue
+        if not os.access(candidate, os.W_OK):
+            continue
+        from ..utils.observability import enable_compilation_cache
+
+        enable_compilation_cache(candidate)
+        if candidate != raw:
+            log.warning(
+                "shared compile cache %s not writable; using "
+                "process-keyed fallback %s", raw, candidate,
+            )
+        return candidate
+    log.warning("no writable compile cache dir under %s; cache disabled", raw)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# process entry points
+# ---------------------------------------------------------------------------
+
+_state = {
+    "context": False,        # a warmup-managed cold start is in progress
+    "did_warm": False,       # a sweep actually ran
+    "accounting": None,
+    "adoption_attempted": False,
+}
+_state_lock = threading.Lock()
+
+
+def cold_start_context() -> bool:
+    """True once this process opted into warmup-managed cold start (a
+    manifest path was given) — the solver's lazy optimizer-lane admission
+    keys on this in its default ``auto`` mode."""
+    return _state["context"]
+
+
+def did_warm() -> bool:
+    """True once a warmup sweep actually ran in this process — the sim
+    report only emits ``first_solve_after_restart`` when it did."""
+    return _state["did_warm"]
+
+
+def accounting() -> Optional[dict]:
+    return _state["accounting"]
+
+
+def startup_warm(manifest_path: Optional[str] = None,
+                 deadline_s: Optional[float] = None,
+                 cache_dir: Optional[str] = None,
+                 background: bool = True) -> Optional[dict]:
+    """The one-call process warmup: enable the persistent compile cache,
+    load the manifest (explicit path or ``KARPENTER_TPU_WARMUP_MANIFEST``),
+    replay it. Returns the sweep accounting, or None when no manifest is
+    configured or anything degrades — NEVER raises: a broken manifest is
+    a plain cold start, not an outage."""
+    path = manifest_path or os.environ.get(ENV_MANIFEST)
+    if not path:
+        return None
+    with _state_lock:
+        _state["context"] = True
+    try:
+        ensure_compile_cache(cache_dir)
+        manifest = load_manifest(path)
+        acct = warm_from_manifest(
+            manifest, deadline_s=deadline_s, background=background
+        )
+        with _state_lock:
+            _state["did_warm"] = True
+            _state["accounting"] = acct
+        warmed = sum(c["warmed"] for c in acct["families"].values())
+        log.info(
+            "warmup: %d specs warmed in %.0fms (%d skipped%s)",
+            warmed, acct["wall_ms"], len(acct["skipped"]),
+            ", lanes finishing in background"
+            if acct["background_families"] else "",
+        )
+        return acct
+    except Exception as e:
+        log.warning("warmup degraded to cold start: %s: %s",
+                    type(e).__name__, e)
+        return None
+
+
+def warm_on_adoption() -> None:
+    """``ShardElector`` adoption hook: the successor of a dead launcher
+    warms the fleet manifest before its first owned pass. No-op — and
+    jax-import-free — unless ``KARPENTER_TPU_WARMUP_MANIFEST`` is set
+    (electors run in hundreds of plain unit tests); at most one attempt
+    per process; never raises."""
+    if not os.environ.get(ENV_MANIFEST):
+        return
+    with _state_lock:
+        if _state["did_warm"] or _state["adoption_attempted"]:
+            return
+        _state["adoption_attempted"] = True
+    try:
+        startup_warm()
+    except Exception:       # startup_warm already never raises; belt+braces
+        pass
+
+
+def maybe_save(path: Optional[str] = None) -> Optional[str]:
+    """Write this process's manifest when asked (explicit path or
+    ``KARPENTER_TPU_WARMUP_SAVE``). Never raises."""
+    p = path or os.environ.get(ENV_SAVE)
+    if not p:
+        return None
+    try:
+        return save_manifest(build_manifest(), p)
+    except Exception as e:
+        log.warning("manifest save failed: %s: %s", type(e).__name__, e)
+        return None

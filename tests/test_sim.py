@@ -543,6 +543,26 @@ class TestSupersededMultichipRows:
         _, stale = select(rows)
         assert not stale  # no stamped successor on file -> no flag
 
+    def test_native_controller_pass_marked_stale(self):
+        # PR 18 satellite: the unstamped end-to-end native controller
+        # pass is superseded by the stamped 5000-node warm-encode row
+        from benchmarks.report import select, stale_note
+
+        rows = [
+            {"benchmark": "config4_controller_pass_native",
+             "wall_ms": 125.0, "scale": 1.0, "run_at_unix": 100},
+            {"benchmark": "controller_pass_warm_encode_5000node",
+             "wall_ms": 80.0, "scale": 1.0, "run_at_unix": 200,
+             "provenance": {"device": "cpu", "backend": "xla-scan",
+                            "git_sha": "abc"}},
+        ]
+        selected, stale = select(rows)
+        assert "config4_controller_pass_native" in stale
+        note = stale_note(stale["config4_controller_pass_native"],
+                          key="config4_controller_pass_native")
+        assert "controller_pass_warm_encode_5000node" in note
+        assert "STALE" in note
+
 
 # ---------------------------------------------------------------------------
 # slow tier: the acceptance run + the tier sweep

@@ -1,0 +1,389 @@
+"""Zero-cold-start serving (PR 18): AOT warmup manifest + lazy optimizer
+admission.
+
+Four planes, each load-bearing for the restart story:
+
+1. **The spec codec is a restricted JSON pytree, not a pickle.** Round
+   trips ``ShapeDtypeStruct`` leaves, scalars, containers, and the
+   package's own NamedTuples (``ops.ffd._State``); refuses foreign
+   classes and unserializable leaves with recorded reasons.
+2. **AOT replay claims the ledger signature.** Warming a wrapper from a
+   captured spec compiles via ``lower().compile()`` without bumping the
+   compile ledger — the next concrete call is a HIT, and the warmup is
+   invisible to every ``events_since``-based retrace gate.
+3. **A restart round-trips through the manifest.** A real subprocess
+   compiles a family and saves the manifest; a second fresh interpreter
+   warms from it and its first concrete call attributes ZERO compiles. A
+   corrupt or version-skewed manifest degrades to a plain cold start —
+   never a crash.
+4. **Lazy optimizer-lane admission.** On a warmup-managed cold start the
+   solver serves FFD immediately (``opt_lane == skipped_cold``), warms
+   the lane in the background, and re-arms it once compiled; the
+   ``KARPENTER_TPU_OPT_COLD_SKIP=0`` kill switch restores the old
+   block-on-first-solve behavior.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from karpenter_provider_aws_tpu.trace import jitwatch, warmup
+
+ROOT = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture
+def warmup_state():
+    """Snapshot + restore the module's process-global warmup state so a
+    test that enters cold-start context cannot leak it into the suite."""
+    saved = dict(warmup._state)
+    yield warmup._state
+    with warmup._state_lock:
+        warmup._state.clear()
+        warmup._state.update(saved)
+
+
+# ---------------------------------------------------------------------------
+# 1. the spec codec
+# ---------------------------------------------------------------------------
+
+class TestSpecCodec:
+    def test_round_trip_shape_dtype_and_containers(self):
+        import jax
+
+        spec = (
+            (jax.ShapeDtypeStruct((4, 8), np.float32), 7, "mode"),
+            {"k": [jax.ShapeDtypeStruct((2,), np.int32), None, True, 1.5]},
+        )
+        back = warmup._decode(warmup._encode(spec))
+        assert back == spec
+
+    def test_round_trip_package_namedtuple(self):
+        import jax
+
+        from karpenter_provider_aws_tpu.ops.ffd import _State
+
+        st = _State(
+            node_type=jax.ShapeDtypeStruct((16,), np.int32),
+            node_price=jax.ShapeDtypeStruct((16,), np.float32),
+            used=jax.ShapeDtypeStruct((16, 4), np.float32),
+            node_cap=jax.ShapeDtypeStruct((16, 4), np.float32),
+            node_window=jax.ShapeDtypeStruct((16, 2, 8), np.bool_),
+            n_open=jax.ShapeDtypeStruct((), np.int32),
+        )
+        back = warmup._decode(warmup._encode(st))
+        assert isinstance(back, _State)
+        assert back == st
+
+    def test_foreign_class_refused(self):
+        doc = {"t": "nt", "cls": "os:path", "items": []}
+        with pytest.raises(warmup.SpecCodecError, match="foreign"):
+            warmup._decode(doc)
+
+    def test_unserializable_leaf_raises_not_crashes_build(self):
+        with pytest.raises(warmup.SpecCodecError):
+            warmup._encode(object())
+        # and through build_manifest the failure is accounted, not raised
+        fn = jitwatch.tracked_jit(lambda x: x, family="warmuptest.bad")
+        fn._replay = {("sig",): ((object(),), {})}
+        manifest = warmup.build_manifest()
+        assert any(
+            u["family"] == "warmuptest.bad"
+            for u in manifest["unserializable"]
+        )
+
+    def test_load_manifest_rejects_corrupt_and_skew(self, tmp_path):
+        p = tmp_path / "m.json"
+        p.write_text("{not json")
+        with pytest.raises(warmup.ManifestError):
+            warmup.load_manifest(str(p))
+        p.write_text(json.dumps({"version": 999, "entries": []}))
+        with pytest.raises(warmup.ManifestError, match="version"):
+            warmup.load_manifest(str(p))
+        p.write_text(json.dumps({"version": 1}))
+        with pytest.raises(warmup.ManifestError, match="entries"):
+            warmup.load_manifest(str(p))
+
+    def test_startup_warm_degrades_to_cold_start(self, tmp_path, warmup_state):
+        p = tmp_path / "skewed.json"
+        p.write_text(json.dumps({"version": 999, "entries": []}))
+        assert warmup.startup_warm(manifest_path=str(p),
+                                   cache_dir="0") is None
+        assert warmup.cold_start_context()      # the process OPTED in...
+        assert not warmup.did_warm()            # ...but no sweep ran
+
+
+# ---------------------------------------------------------------------------
+# 2. AOT replay vs the ledger
+# ---------------------------------------------------------------------------
+
+class TestAotReplay:
+    def test_warm_compiles_without_ledger_compile(self):
+        import jax.numpy as jnp
+
+        def f(x, y):
+            return x * 2.0 + y
+
+        a = jitwatch.tracked_jit(f, family="warmuptest.a")
+        b = jitwatch.tracked_jit(f, family="warmuptest.b")
+        x = jnp.ones((8, 3), jnp.float32)
+        a(x, x)                              # concrete trace captures spec
+        (spec,) = a.replay_specs()
+
+        led = jitwatch.ledger()
+        seq0 = led.seq()
+        wall = b.warm(spec)
+        assert wall > 0.0
+        assert led.events_since(seq0) == []  # warmup never reads as retrace
+        fam = led.snapshot()["families"]["warmuptest.b"]
+        assert fam["compiles"] == 0 and fam["warmed"] == 1
+        assert fam["warm_ms_total"] > 0.0
+
+        seq1 = led.seq()
+        b(x, x)                              # the warmed sig is a HIT
+        assert led.events_since(seq1) == []
+        fam = led.snapshot()["families"]["warmuptest.b"]
+        assert fam["hits"] == 1 and fam["compiles"] == 0
+
+    def test_warm_is_idempotent(self):
+        import jax.numpy as jnp
+
+        fn = jitwatch.tracked_jit(lambda x: x + 1, family="warmuptest.idem")
+        fn(jnp.ones((4,), jnp.float32))
+        (spec,) = fn.replay_specs()
+        assert fn.warm(spec) == 0.0          # already traced: free
+        fam = jitwatch.ledger().snapshot()["families"]["warmuptest.idem"]
+        assert fam["compiles"] == 1 and fam["warmed"] == 0
+
+    def test_warm_from_manifest_priority_and_accounting(self, warmup_state):
+        import jax.numpy as jnp
+
+        # a live in-process wrapper resolves through the registry even
+        # for a family outside _FAMILY_MODULES
+        fn = jitwatch.tracked_jit(lambda x: x - 1, family="warmuptest.manif")
+        fn(jnp.ones((3,), jnp.float32))
+        manifest = warmup.build_manifest()
+        entries = [e for e in manifest["entries"]
+                   if e["family"] == "warmuptest.manif"]
+        assert entries
+        # an unknown family degrades to a skip with a reason, not a raise
+        entries.append({"family": "warmuptest.nowhere", "args": [],
+                        "kwargs": {}, "params": None})
+        acct = warmup.warm_from_manifest(
+            {"version": 1, "entries": entries}, background=False
+        )
+        assert "warmuptest.manif" in acct["families"]
+        assert any(s["family"] == "warmuptest.nowhere"
+                   for s in acct["skipped"])
+        assert acct["deadline_hit"] is False
+
+
+# ---------------------------------------------------------------------------
+# 3. the restart round trip (real process boundaries)
+# ---------------------------------------------------------------------------
+
+_CHILD_COMPILE = textwrap.dedent("""
+    import json, os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax.numpy as jnp
+    from karpenter_provider_aws_tpu.ops.device_state import _patch_fn
+    from karpenter_provider_aws_tpu.trace import jitwatch, warmup
+    fn = _patch_fn(False)
+    fn(jnp.zeros((16, 4), jnp.float32), jnp.zeros((16, 8), jnp.int32),
+       jnp.zeros((16, 8), jnp.int32), jnp.zeros((32, 16), jnp.float32),
+       jnp.zeros((4,), jnp.int32), jnp.zeros((4, 4), jnp.float32),
+       jnp.zeros((4, 8), jnp.int32), jnp.zeros((4, 8), jnp.int32),
+       jnp.zeros((32, 4), jnp.float32))
+    fam = jitwatch.ledger().snapshot()["families"]["device_state.patch"]
+    warmup.save_manifest(warmup.build_manifest(), sys.argv[1])
+    print(json.dumps({"compiles": fam["compiles"]}))
+""")
+
+_CHILD_WARM = textwrap.dedent("""
+    import json, os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["KARPENTER_TPU_WARMUP_MANIFEST"] = sys.argv[1]
+    from karpenter_provider_aws_tpu.trace import jitwatch, warmup
+    acct = warmup.startup_warm(cache_dir="0", background=False)
+    import jax.numpy as jnp
+    from karpenter_provider_aws_tpu.ops.device_state import _patch_fn
+    fn = _patch_fn(False)
+    led = jitwatch.ledger()
+    seq0 = led.seq()
+    fn(jnp.zeros((16, 4), jnp.float32), jnp.zeros((16, 8), jnp.int32),
+       jnp.zeros((16, 8), jnp.int32), jnp.zeros((32, 16), jnp.float32),
+       jnp.zeros((4,), jnp.int32), jnp.zeros((4, 4), jnp.float32),
+       jnp.zeros((4, 8), jnp.int32), jnp.zeros((4, 8), jnp.int32),
+       jnp.zeros((32, 4), jnp.float32))
+    fam = led.snapshot()["families"]["device_state.patch"]
+    print(json.dumps({
+        "warmed": fam["warmed"], "compiles": fam["compiles"],
+        "hits": fam["hits"], "events_since": len(led.events_since(seq0)),
+        "did_warm": warmup.did_warm(),
+        "acct_families": sorted((acct or {}).get("families", {})),
+    }))
+""")
+
+
+def _run_child(code: str, *argv: str) -> dict:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(ROOT) + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("KARPENTER_TPU_WARMUP_MANIFEST", None)
+    res = subprocess.run(
+        [sys.executable, "-c", code, *argv],
+        capture_output=True, text=True, cwd=str(ROOT), env=env, timeout=300,
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    return json.loads(res.stdout.strip().splitlines()[-1])
+
+
+class TestRestartRoundTrip:
+    def test_manifest_survives_a_real_restart(self, tmp_path):
+        manifest = str(tmp_path / "manifest.json")
+        first = _run_child(_CHILD_COMPILE, manifest)
+        assert first["compiles"] == 1        # the cold process paid XLA
+
+        second = _run_child(_CHILD_WARM, manifest)
+        assert second["did_warm"] is True
+        assert second["acct_families"] == ["device_state.patch"]
+        assert second["warmed"] == 1
+        assert second["compiles"] == 0       # ZERO compiles after restart
+        assert second["events_since"] == 0
+        assert second["hits"] == 1
+
+    @pytest.mark.parametrize("payload", [
+        "{corrupt not json",
+        json.dumps({"version": 999, "entries": []}),
+    ], ids=["corrupt", "version-skew"])
+    def test_bad_manifest_is_a_cold_start_not_a_crash(self, tmp_path, payload):
+        manifest = tmp_path / "bad.json"
+        manifest.write_text(payload)
+        out = _run_child(_CHILD_WARM, str(manifest))
+        assert out["did_warm"] is False
+        assert out["warmed"] == 0
+        assert out["compiles"] == 1          # plain cold start, served fine
+        assert out["events_since"] == 1
+
+
+# ---------------------------------------------------------------------------
+# 4. lazy optimizer-lane admission on cold start
+# ---------------------------------------------------------------------------
+
+class TestLazyOptAdmission:
+    @pytest.fixture
+    def lane_env(self, monkeypatch):
+        from karpenter_provider_aws_tpu.resilience import breakers
+        from karpenter_provider_aws_tpu.utils import FakeClock
+
+        monkeypatch.setenv("KARPENTER_TPU_OPTIMIZER", "1")
+        breakers.configure(clock=FakeClock())
+        yield
+        breakers.configure(clock=None)
+
+    def _frag_pods(self, seed: int = 11, n_deployments: int = 40):
+        from karpenter_provider_aws_tpu.models import labels as lbl
+        from karpenter_provider_aws_tpu.models.pod import make_pods
+
+        rng = np.random.RandomState(seed)
+        pods = []
+        zones = ("zone-a", "zone-b", "zone-c", "zone-d")
+        for i in range(n_deployments):
+            replicas = int(np.clip(rng.zipf(1.7), 1, 25))
+            cpu_m = int(rng.choice(
+                [250, 500, 1000, 1500, 2000, 3000, 5000, 7000]))
+            mem = int(cpu_m * rng.choice([1, 2, 4, 8]))
+            kwargs = {}
+            r = rng.rand()
+            if r < 0.25:
+                kwargs["node_selector"] = {
+                    lbl.TOPOLOGY_ZONE: str(rng.choice(zones))}
+            elif r < 0.45:
+                kwargs["node_selector"] = {lbl.CAPACITY_TYPE: "on-demand"}
+            elif r < 0.6:
+                kwargs["node_selector"] = {lbl.ARCH: "arm64"}
+            pods += make_pods(replicas, f"w{seed}_{i}",
+                              {"cpu": f"{cpu_m}m", "memory": f"{mem}Mi"},
+                              **kwargs)
+        return pods
+
+    def test_cold_skip_active_modes(self, monkeypatch, warmup_state):
+        from karpenter_provider_aws_tpu.scheduling import optimizer as opt
+
+        monkeypatch.setenv("KARPENTER_TPU_OPT_COLD_SKIP", "1")
+        assert opt.cold_skip_active() is True
+        monkeypatch.setenv("KARPENTER_TPU_OPT_COLD_SKIP", "0")
+        assert opt.cold_skip_active() is False   # kill switch wins
+        monkeypatch.delenv("KARPENTER_TPU_OPT_COLD_SKIP", raising=False)
+        assert opt.cold_skip_active() is False   # auto: no manifest context
+        with warmup._state_lock:
+            warmup._state["context"] = True
+        assert opt.cold_skip_active() is True    # auto: warmup-managed start
+
+    def test_skipped_cold_then_rearms_once_warm(self, lane_env, monkeypatch):
+        from karpenter_provider_aws_tpu.catalog import CatalogProvider
+        from karpenter_provider_aws_tpu.models import (
+            Disruption, NodePool, Operator, Requirement,
+        )
+        from karpenter_provider_aws_tpu.models import labels as lbl
+        from karpenter_provider_aws_tpu.scheduling import TPUSolver
+        from karpenter_provider_aws_tpu.scheduling import optimizer as opt
+
+        monkeypatch.setenv("KARPENTER_TPU_OPT_COLD_SKIP", "1")
+        # this process has long since compiled optimizer.lanes in other
+        # tests: reset the ledger so the lane reads cold, as a fresh
+        # process would
+        jitwatch.ledger().reset()
+        assert not opt.lanes_warm()
+
+        pool = NodePool(
+            name="default",
+            requirements=[Requirement(lbl.INSTANCE_CATEGORY, Operator.IN,
+                                      ("c", "m", "r"))],
+            disruption=Disruption(consolidate_after_s=None),
+        )
+        catalog = CatalogProvider()
+        pods = self._frag_pods(11)
+        solver = TPUSolver()
+        cold = solver.solve(pods, [pool], catalog)
+        # FFD served NOW; the lane was skipped, not blocked on, and the
+        # skip is stamped in both timings and provenance scale
+        assert solver.timings.get("opt_lane") == "skipped_cold"
+        assert solver.timings.get("opt_skipped_cold") is True
+        assert cold.node_specs
+
+        # the background warm re-arms the lane
+        assert opt.join_lane_warm(timeout=300.0)
+        assert opt.lanes_warm()
+        solver.solve(pods, [pool], catalog)
+        assert solver.timings.get("opt_lane") != "skipped_cold"
+        assert solver.timings.get("opt_lane") in (
+            "adopted", "rejected", "error")
+
+    def test_kill_switch_restores_blocking_dispatch(
+        self, lane_env, monkeypatch,
+    ):
+        from karpenter_provider_aws_tpu.catalog import CatalogProvider
+        from karpenter_provider_aws_tpu.models import (
+            Disruption, NodePool, Operator, Requirement,
+        )
+        from karpenter_provider_aws_tpu.models import labels as lbl
+        from karpenter_provider_aws_tpu.scheduling import TPUSolver
+
+        monkeypatch.setenv("KARPENTER_TPU_OPT_COLD_SKIP", "0")
+        pool = NodePool(
+            name="default",
+            requirements=[Requirement(lbl.INSTANCE_CATEGORY, Operator.IN,
+                                      ("c", "m", "r"))],
+            disruption=Disruption(consolidate_after_s=None),
+        )
+        solver = TPUSolver()
+        solver.solve(self._frag_pods(11), [pool], CatalogProvider())
+        assert solver.timings.get("opt_lane") != "skipped_cold"

@@ -1,0 +1,121 @@
+#!/usr/bin/env python3
+"""warmup_smoke: the zero-cold-start plane's CI gate.
+
+Arms ``KARPENTER_TPU_WARMUP_MANIFEST`` with the checked-in smoke manifest
+(``sim/baselines/warmup-smoke-manifest.json`` — written by a prior smoke
+day via ``KARPENTER_TPU_WARMUP_SAVE``) and drives the smoke-500 simulated
+day, then asserts the whole warmup loop closes:
+
+ 1. the AOT sweep actually ran (``did_warm``) and replayed specs for the
+    solve-serving families with zero skips;
+ 2. the run's FIRST solve compiled NOTHING — the report's
+    ``first_solve_after_restart`` gate key is 0, thresholded through the
+    real ``tools/fleet_gate.py`` against ``sim/baselines/smoke-500.json``
+    (which also holds ``retraces_after_warmup == 0``);
+ 3. the day stays green on every other smoke-500 threshold — warmup must
+    not perturb the SLO envelope it exists to protect.
+
+Run via ``make warmup-smoke`` (JAX_PLATFORMS=cpu).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+MANIFEST = os.path.join(
+    REPO, "karpenter_provider_aws_tpu", "sim", "baselines",
+    "warmup-smoke-manifest.json",
+)
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if os.environ.get("KARPENTER_TPU_JITWATCH") == "0":
+        print("warmup-smoke requires jitwatch armed "
+              "(unset KARPENTER_TPU_JITWATCH)", file=sys.stderr)
+        return 2
+    if not os.path.exists(MANIFEST):
+        print(f"checked-in manifest missing: {MANIFEST}", file=sys.stderr)
+        return 2
+    # the run IS the restarted process: warm from the checked-in manifest
+    # (foreground, unbounded — the gate measures the mechanism, not a
+    # deadline policy) before the fleet builds
+    os.environ["KARPENTER_TPU_WARMUP_MANIFEST"] = MANIFEST
+    os.environ.pop("KARPENTER_TPU_WARMUP_DEADLINE_S", None)
+
+    from karpenter_provider_aws_tpu.sim.driver import FleetSimulator
+
+    sim = FleetSimulator("smoke", seed=0)
+    report = sim.run()
+
+    failures: list[str] = []
+    device = report.data.get("wall", {}).get("device", {})
+    aot = device.get("aot_warmup", {})
+    acct = aot.get("accounting") or {}
+    if not aot.get("did_warm"):
+        failures.append("warmup sweep did not run (did_warm is false)")
+    else:
+        fams = acct.get("families", {})
+        warmed = sum(c["warmed"] for c in fams.values())
+        print(f"warmup sweep: {warmed} specs across {len(fams)} families "
+              f"in {acct.get('wall_ms')}ms")
+        for name, cell in sorted(fams.items()):
+            print(f"  {name}: warmed={cell['warmed']} "
+                  f"wall_ms={cell['wall_ms']}")
+        if not fams:
+            failures.append("warmup sweep replayed zero families")
+        skipped = acct.get("skipped", [])
+        if skipped:
+            failures.append(f"warmup sweep skipped {len(skipped)} specs: "
+                            f"{skipped[:4]}")
+
+    first = aot.get("first_solve_compiles")
+    print(f"first solve after warmup: compiles={first}")
+    if first != 0:
+        failures.append(
+            f"first solve after manifest warmup compiled {first!r} "
+            "programs (must be 0)"
+        )
+    retr = device.get("retraces_after_warmup")
+    print(f"retraces_after_warmup: {retr}")
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        report_path = os.path.join(td, "report.json")
+        report.save(report_path)
+        # the real fleet gate: first_solve_after_restart == 0 and
+        # retraces_after_warmup == 0 ride smoke-500.json with the SLO set
+        gate = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "fleet_gate.py"),
+             report_path, "--baseline",
+             os.path.join(REPO, "karpenter_provider_aws_tpu", "sim",
+                          "baselines", "smoke-500.json")],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        sys.stdout.write(gate.stdout)
+        sys.stderr.write(gate.stderr)
+        if gate.returncode != 0:
+            failures.append("fleet gate failed (see output above)")
+        if "first_solve_after_restart" not in gate.stdout:
+            failures.append(
+                "fleet gate output never mentioned first_solve_after_restart"
+            )
+
+    if failures:
+        print("warmup-smoke FAILED:", file=sys.stderr)
+        for f in failures:
+            print(f"  [FAIL] {f}", file=sys.stderr)
+        return 1
+    print("warmup-smoke passed: manifest warmup ran, first solve "
+          "compiles=0, fleet gate green")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
