@@ -4,7 +4,7 @@
 
 PYTEST ?= python -m pytest
 
-.PHONY: presubmit test deflake stress e2etests benchmark interruption-bench verify multichip native soak sidecar-client sim-smoke sim-sweep sim-cliff-smoke bench-gate bench-optimizer bench-market bench-gang market-smoke gang-smoke chaos-smoke sim-replica-smoke sim-provision-smoke fleet-obs-smoke device-obs-smoke warmup-smoke
+.PHONY: presubmit test deflake stress e2etests benchmark interruption-bench verify multichip native soak sidecar-client sim-smoke sim-sweep sim-cliff-smoke bench-gate bench-optimizer bench-market bench-gang market-smoke gang-smoke chaos-smoke sim-replica-smoke sim-provision-smoke fleet-obs-smoke device-obs-smoke warmup-smoke why-smoke
 
 presubmit: test multichip  ## everything CI gates on
 
@@ -109,6 +109,9 @@ device-obs-smoke:  ## smoke-500 day with jitwatch armed: per-family compile coun
 
 warmup-smoke:  ## smoke-500 day warmed from the checked-in AOT manifest: first solve compiles=0 (first_solve_after_restart) + 0 retraces, fleet-gated
 	JAX_PLATFORMS=cpu python tools/warmup_smoke.py
+
+why-smoke:  ## deliberately-starving why-day with the why-not engine armed: why_coverage == 1.0 + 0 retraces (fleet-gated vs why-500.json), kill-switch byte-identity, stamped why_overhead row < 5% p99
+	JAX_PLATFORMS=cpu python tools/why_smoke.py
 
 sim-provision-smoke:  ## 4-replica sharded-provisioning flood day (GLOBAL holder killed mid-flood; work-stealing + packing-envelope-parity), fleet-gated
 	JAX_PLATFORMS=cpu python -m karpenter_provider_aws_tpu.sim run \

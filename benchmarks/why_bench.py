@@ -1,0 +1,142 @@
+"""Why-not-engine overhead row: attribution must be observation-only.
+
+``why_overhead`` A/B-measures the steady solve tick with the engine armed
+(default) vs killed (``KARPENTER_TPU_WHY=0``) over the exact workload the
+engine exists for — a mixed wave carrying pods NO catalog shape can serve,
+so every armed tick pays the full attribution path: the device-side
+``why.eliminate`` elimination kernel, nearest-miss decode, and the
+per-pod verdict stamped into ``SolveResult.why``. The gated budget
+(benchmarks/baselines/steady-state.json, require_stamp: true) holds the
+armed p99 within 5% of the disarmed p99: a diagnosis plane that taxes the
+steady tick it diagnoses has failed its own design review
+(designs/why-engine.md).
+
+Run directly: ``python -m benchmarks.why_bench``; ``make why-smoke``
+stamps the row and gates it alongside the fleet-level coverage gate.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+def _percentile(sorted_vals, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def _workload():
+    from karpenter_provider_aws_tpu.models.pod import make_pods
+
+    pods = []
+    pods += make_pods(24, "web", {"cpu": "500m", "memory": "1Gi"})
+    pods += make_pods(12, "api", {"cpu": "2", "memory": "4Gi"})
+    pods += make_pods(8, "train", {"cpu": "4", "memory": "8Gi"})
+    # the poison tail: no catalog shape fits — every tick attributes these
+    pods += make_pods(4, "poison", {"cpu": "512000m", "memory": "4096Gi"})
+    return pods
+
+
+def _measure(iters: int) -> tuple[list[float], list[float]]:
+    """Interleaved A/B walls: each iteration times BOTH arms back to back
+    (alternating which goes first) so allocator/cache drift over the run
+    cancels instead of landing entirely on whichever arm ran second."""
+    from karpenter_provider_aws_tpu.catalog import CatalogProvider
+    from karpenter_provider_aws_tpu.models import Disruption, NodePool
+    from karpenter_provider_aws_tpu.scheduling import TPUSolver
+
+    prior = os.environ.get("KARPENTER_TPU_WHY")
+
+    def _tick(solver, pool, catalog, armed: bool) -> float:
+        os.environ["KARPENTER_TPU_WHY"] = "1" if armed else "0"
+        pods = _workload()
+        t0 = time.perf_counter()
+        res = solver.solve(pods, [pool], catalog)
+        wall = (time.perf_counter() - t0) * 1e3
+        assert len(res.unschedulable) == 4
+        if armed:
+            assert len(res.why) == 4, "armed tick must attribute"
+        else:
+            assert not res.why, "killed tick must not attribute"
+        return wall
+
+    try:
+        catalog = CatalogProvider()
+        pool = NodePool(
+            name="default",
+            disruption=Disruption(consolidate_after_s=None),
+        )
+        solver = TPUSolver()
+        # warm the solve families AND the why kernel so the measured
+        # ticks are steady-state, not compile walls
+        for armed in (False, True, False, True):
+            _tick(solver, pool, catalog, armed)
+        armed_walls, disarmed_walls = [], []
+        for i in range(iters):
+            order = (True, False) if i % 2 else (False, True)
+            for armed in order:
+                wall = _tick(solver, pool, catalog, armed)
+                (armed_walls if armed else disarmed_walls).append(wall)
+        return armed_walls, disarmed_walls
+    finally:
+        if prior is None:
+            os.environ.pop("KARPENTER_TPU_WHY", None)
+        else:
+            os.environ["KARPENTER_TPU_WHY"] = prior
+
+
+def bench_why_overhead(iters: int = 120) -> dict:
+    armed, disarmed = _measure(iters=iters)
+    armed, disarmed = sorted(armed), sorted(disarmed)
+    armed_p99 = _percentile(armed, 0.99)
+    disarmed_p99 = _percentile(disarmed, 0.99)
+    overhead_pct = (
+        (armed_p99 / disarmed_p99 - 1.0) * 100.0 if disarmed_p99 else 0.0
+    )
+    return {
+        "benchmark": "why_overhead",
+        "iters": iters,
+        "armed_p50_ms": round(_percentile(armed, 0.50), 3),
+        "armed_p99_ms": round(armed_p99, 3),
+        "disarmed_p50_ms": round(_percentile(disarmed, 0.50), 3),
+        "disarmed_p99_ms": round(disarmed_p99, 3),
+        "overhead_pct": round(overhead_pct, 2),
+        "device": "host",
+        "backend": "host",
+        "note": "steady solve tick with 4 unattributable poison pods per "
+                "wave; armed = full eliminate/decode/stamp path, disarmed "
+                "= KARPENTER_TPU_WHY=0; p99 over per-solve walls after "
+                "3 warmup ticks",
+    }
+
+
+def run_all(scale: float = 1.0, on_row=None) -> list[dict]:
+    rows = []
+    row = bench_why_overhead(iters=max(int(120 * scale), 40))
+    rows.append(row)
+    print(json.dumps(row), flush=True)
+    if on_row is not None:
+        on_row(row)
+    return rows
+
+
+def main() -> None:
+    from karpenter_provider_aws_tpu.trace.provenance import stamp_row
+
+    detail = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_DETAIL.jsonl",
+    )
+    at = {"run_at_unix": int(time.time()), "scale": 1.0}
+    with open(detail, "a") as f:
+        for row in run_all():
+            stamp_row(row)
+            f.write(json.dumps({**row, **at}) + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -208,6 +208,23 @@ class DisruptionController:
 
     REJECT_AUDIT_TTL_S = 300.0  # one reject record per (claim, reason) per window
 
+    @staticmethod
+    def _count_reject(detail: dict, token: str) -> None:
+        """Stamp the why-engine verdict for a rejected disruption into the
+        audit detail and the ``karpenter_consolidation_rejected_total``
+        family (obs/why.py). No-op under KARPENTER_TPU_WHY=0 so the
+        legacy audit shape stays byte-identical."""
+        try:
+            from ..metrics import CONSOLIDATION_REJECTED
+            from ..obs.why import enabled as _why_enabled
+
+            if not _why_enabled():
+                return
+            detail["why"] = {"top": token, "tokens": [token]}
+            CONSOLIDATION_REJECTED.inc(reason=token)
+        except Exception:  # pragma: no cover - telemetry is best-effort
+            pass
+
     def _disrupt(self, claim, reason: str, budget: "_BudgetTracker",
                  detail: dict = None) -> bool:
         # Commit-time live recheck: the candidate walks read claim/node/pod
@@ -248,10 +265,12 @@ class DisruptionController:
                         k: t for k, t in self._reject_logged.items()
                         if t >= cutoff
                     }
+                reject_detail = dict(detail or {}, reason=reason,
+                                     nodepool=claim.nodepool_name)
+                self._count_reject(reject_detail, f"budget:{rclass or 'none'}")
                 audit.record(
                     "disruption", "NodeClaim", claim.name, "reject:budget",
-                    dict(detail or {}, reason=reason,
-                         nodepool=claim.nodepool_name),
+                    reject_detail,
                     at=now, rev=getattr(self.cluster, "rev", None),
                 )
             return False

@@ -487,11 +487,32 @@ class ProvisioningController:
                     "placement", "Pod", pod.name, f"launch:{winner}",
                     detail, at=now, rev=rev,
                 )
+        why_map = getattr(result, "why", None) or {}
         for pod, reason in result.unschedulable:
+            detail = {"reason": reason, "provenance": prov}
+            rec = why_map.get(pod.uid)
+            if rec:
+                # the why-engine verdict rides the audit record AND the
+                # live board + reason metric family (obs/why.py); absent
+                # whenever KARPENTER_TPU_WHY=0 so the legacy audit shape
+                # is byte-identical under the kill switch
+                detail["why"] = dict(rec)
+                self._count_why(pod.name, rec, now)
             audit.record(
                 "placement", "Pod", pod.name, "unschedulable",
-                {"reason": reason, "provenance": prov}, at=now, rev=rev,
+                detail, at=now, rev=rev,
             )
+
+    @staticmethod
+    def _count_why(pod_name: str, rec: dict, now: float) -> None:
+        try:
+            from ..metrics import UNSCHEDULABLE_REASONS
+            from ..obs.why import board
+
+            UNSCHEDULABLE_REASONS.inc(reason=str(rec.get("top", "")))
+            board().stamp(pod_name, rec, at=now)
+        except Exception:  # pragma: no cover - telemetry is best-effort
+            pass
 
     def _audit_degraded(self, result, audit, rev, num_pods: int) -> None:
         """One audit record + Warning event per solve served in degraded

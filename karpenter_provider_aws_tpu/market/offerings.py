@@ -121,6 +121,30 @@ def apply_window_columns(price, avail, names: Sequence[str],
     return lit
 
 
+def dark_cell_reason(windows: Sequence[OfferingWindow], instance_type: str,
+                     zone: str, now: float) -> Optional[str]:
+    """Name why the RESERVED cell for ``(instance_type, zone)`` is dark
+    right now — the why-engine's market-plane refinement (obs/why.py).
+
+    A pending window, or an open one with every slot consumed, reads
+    ``market:window-closed`` (the market will or did sell here, just not
+    now); a window that ran out its clock reads ``reservation:expired``.
+    ``None`` means no window ever covered the cell — the darkness is not
+    market-caused and the caller falls back to zone/capacity verdicts.
+    """
+    expired = None
+    for w in windows:
+        if w.instance_type != instance_type or w.zone != zone:
+            continue
+        if w.state_at(now) == EXPIRED:
+            expired = "reservation:expired"
+        else:
+            # pending, or open + slot-exhausted (an open window with
+            # remaining slots would have lit the cell via open_at)
+            return "market:window-closed"
+    return expired
+
+
 def windows_cache_key(windows: Sequence[OfferingWindow], now: float) -> tuple:
     """The time-varying fragment of the catalog cache key: which bounded
     windows are open right now. Slot counts already ride the reservation

@@ -446,6 +446,19 @@ GANG_WITHHELD = REGISTRY.counter(
     "Pod groups stripped WHOLE by the all-or-nothing commit gate because "
     "fewer than min_count members were placeable this solve",
 )
+UNSCHEDULABLE_REASONS = REGISTRY.counter(
+    "karpenter_unschedulable_reason_total",
+    "Unschedulable pods by decoded why-engine verdict (obs/why.py: "
+    "capacity / shape / requirements / zone / hostname / ice / limits / "
+    "market:* / reservation:expired / gang:atomicity-shortfall) — the "
+    "aggregated frontier view of WHY pending work is pending",
+)
+CONSOLIDATION_REJECTED = REGISTRY.counter(
+    "karpenter_consolidation_rejected_total",
+    "Consolidation / optimizer proposals rejected, by decoded reason "
+    "(budget:<class> at the disruption budget gate, lane:validator and "
+    "lane:not-cheaper at the optimizer adoption contract) — obs/why.py",
+)
 LEADER = REGISTRY.gauge(
     "karpenter_leader",
     "1 when this replica holds the leader lease, else 0 (by identity). "

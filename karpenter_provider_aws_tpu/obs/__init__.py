@@ -33,14 +33,20 @@ from .sentinel import (
 )
 from .sli import LifecycleSLI, percentile
 from .slo import BurnRule, SLOEngine, SLOSpec, default_slos
+from .why import WhyBoard, gang_shortfall, warm_why_kernels
+from .why import attribute as why_attribute
+from .why import board as why_board
+from .why import enabled as why_enabled
 
 __all__ = [
     "AuditLog", "AuditRecord", "BurnRule", "CorrelationLedger",
     "EdgeTrigger", "LifecycleSLI", "Obs", "OracleSampler",
     "RetraceSentinel", "SLOEngine", "SLOSpec",
-    "SteadyStateSentinel", "cluster_packing", "default_audit",
-    "default_obs", "default_slos", "detect_cliffs", "explain", "install",
-    "percentile", "render_text", "solve_quality",
+    "SteadyStateSentinel", "WhyBoard", "cluster_packing", "default_audit",
+    "default_obs", "default_slos", "detect_cliffs", "explain",
+    "gang_shortfall", "install", "percentile", "render_text",
+    "solve_quality", "warm_why_kernels", "why_attribute", "why_board",
+    "why_enabled",
 ]
 
 
@@ -138,6 +144,9 @@ class Obs:
         self.sentinel.reset()
         self.retrace.reset()
         self.oracle = OracleSampler()
+        # the why board is process-global (the stamp sites have no bundle
+        # handle); a bundle reset is the "fresh run" boundary, so clear it
+        why_board().reset()
 
 
 def install(cluster=None, recorder=None, clock=None, specs=None,
@@ -186,6 +195,22 @@ def install(cluster=None, recorder=None, clock=None, specs=None,
             return device_summary(retrace_sentinel=bundle.retrace)
 
         REGISTRY.register_debug_page("/debug/device", _device_page)
+        # the why-not engine (obs/why.py): ranked unschedulable-reason
+        # histogram + the newest decoded per-pod attributions, plus the
+        # consolidation blocked-cause decode over THIS env's cluster
+        from .why import debug_why_page
+
+        def _why_page() -> dict:
+            page = debug_why_page()
+            try:
+                from ..ops.consolidate import blocked_summary
+
+                page["consolidation_blocked"] = blocked_summary(cluster)
+            except Exception:
+                page["consolidation_blocked"] = {}
+            return page
+
+        REGISTRY.register_debug_page("/debug/why", _why_page)
     return bundle
 
 

@@ -96,6 +96,32 @@ def main(argv=None) -> int:
         "--json", action="store_true", help="emit the joined view as JSON"
     )
 
+    p_why = sub.add_parser(
+        "why", help="the why-not engine: decoded constraint attribution "
+                    "for one object (why is this pod pending / this gang "
+                    "withheld / this consolidation rejected)",
+    )
+    p_why.add_argument(
+        "subject", help="object as <kind>/<name>, e.g. pod/web-0 or "
+                        "NodeClaim/default-abc12",
+    )
+    p_why.add_argument(
+        "--audit-file", default="",
+        help="JSONL audit dump to query (AuditLog.dump output); default: "
+             "the in-process audit ring + live why board",
+    )
+    p_why.add_argument(
+        "--sim-report", default="",
+        help="fleet-report JSON artifact (sim run --report): decode the "
+             "simulated day's why-stamped audit records",
+    )
+    p_why.add_argument(
+        "--flight-file", default="",
+        help="flight snapshot to join the object's cross-replica hops "
+             "under the verdict",
+    )
+    p_why.add_argument("--json", action="store_true")
+
     p_slo = sub.add_parser("slo", help="print the shipped SLO specs")
     p_slo.add_argument("--json", action="store_true")
 
@@ -142,6 +168,42 @@ def main(argv=None) -> int:
         print(json.dumps(view, indent=2, sort_keys=True)
               if args.json else recorder.render_explain(view))
         return 0 if view.get("hops") else 3
+
+    if args.cmd == "why":
+        from .why import render_why, why_view
+
+        if "/" not in args.subject:
+            print("subject must be <kind>/<name>", file=sys.stderr)
+            return 2
+        kind, name = args.subject.split("/", 1)
+        kind = {"pod": "Pod", "nodeclaim": "NodeClaim"}.get(
+            kind.lower(), kind
+        )
+        if args.sim_report:
+            from .audit import AuditRecord
+
+            with open(args.sim_report) as f:
+                report = json.load(f)
+            audit = [
+                AuditRecord.from_dict(r)
+                for r in report.get("virtual", {})
+                                .get("audit", {}).get("records", [])
+            ]
+        elif args.audit_file:
+            audit = AuditLog.load_jsonl(args.audit_file)
+        else:
+            audit = default_audit()
+        flight = None
+        if args.flight_file:
+            from .fleet import FleetRecorder
+
+            flight = FleetRecorder.load(args.flight_file)
+        view = why_view(kind, name, audit=audit, flight=flight)
+        print(json.dumps(view, indent=2, sort_keys=True)
+              if args.json else render_why(view))
+        # exit 3 when nothing was retained for the subject, so smoke
+        # gates can tell "decoded nothing" from success
+        return 0 if (view.get("verdict") or view.get("decisions")) else 3
 
     if args.cmd == "slo":
         specs = [s.as_dict() for s in default_slos()]

@@ -1,0 +1,640 @@
+"""The why-not engine: device-side constraint attribution for every
+unschedulable pod, withheld gang, and rejected consolidation.
+
+The reference Karpenter's core UX is the scheduling-failure event that
+names *why* a pod could not be placed; our tensor solver reproduces the
+placement math but — before this plane — dropped pods as bare
+"unschedulable". This module closes that gap (designs/why-engine.md):
+
+- ``eliminate_bits`` is a vectorized per-(group, type) **elimination
+  bitmask** computed device-side under ``tracked_jit`` (family
+  ``why.eliminate``) on the same content-cached tensors the FFD/LP
+  programs already hold — zero new link payload. One bit per constraint
+  plane the encode can express: resource shape, compat/requirements,
+  dark offering window (refined host-side into ICE / market window /
+  expired reservation), empty zone window, priced-out row.
+
+- ``attribute`` decodes the bitmasks into ranked human explanations:
+  the **nearest-miss** instance type is the one eliminated by the
+  FEWEST constraint planes, and its surviving bits name the reasons.
+  Dark-offering bits are refined host-side against the ICE cache
+  (``catalog.unavailable``) and the market plane's reservation windows
+  (``market/offerings.py``), and the chaos harness's ambient fault
+  context upgrades bare ``capacity`` verdicts inside a price-spike
+  window to ``market:price-spike``.
+
+- The decoded tokens ride four channels, all gated on the
+  ``KARPENTER_TPU_WHY=0`` kill switch so the lane-off path stays
+  byte-identical: ``SolveResult.why`` (per-pod records),
+  ``ProvenanceRecord.why`` (per-solve histogram), audit-record detail
+  (``detail["why"]`` at the provisioning / disruption stamp sites), and
+  the ``karpenter_unschedulable_reason_total`` /
+  ``karpenter_consolidation_rejected_total`` metric families.
+
+- ``gang_shortfall`` is the ONE source of truth for the all-or-nothing
+  withhold string: ``enforce_gangs`` renders its reason through it, so
+  the free-text surface and the bitmask decode can never drift apart
+  (pinned by tests/test_gangs.py).
+
+Axes are ladder-padded (values-move-shapes-don't): the group axis rides
+the unschedulable remainder's ladder bucket and the type axis is padded
+to the CATALOG's ladder bucket — never the per-problem compacted count,
+which varies solve-to-solve and would mint retraces the PR 14
+zero-retrace gates forbid. ``warm_why_kernels`` pre-traces the buckets
+at fleet build, and the family is manifest-warmed (trace/warmup.py).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Iterable, Mapping, Optional, Sequence
+
+import numpy as np
+
+from ..models import labels as lbl
+
+# -- the constraint planes (one bit each, device-computable) ---------------
+BIT_SHAPE = 1          # requests exceed the type's allocatable (never fits)
+BIT_REQUIREMENTS = 2   # node labels/taints fail the pod's requirements
+BIT_OFFERING = 4       # no live (zone, captype) offering inside the window
+BIT_ZONE = 8           # the group's zone/captype window is EMPTY
+BIT_PRICE = 16         # row survives but priced unusable (inf)
+
+BIT_NAMES = {
+    BIT_SHAPE: "shape",
+    BIT_REQUIREMENTS: "requirements",
+    BIT_OFFERING: "offering-dark",
+    BIT_ZONE: "zone",
+    BIT_PRICE: "priced-out",
+}
+
+# -- the decoded reason vocabulary (metric label values) -------------------
+TOKEN_CAPACITY = "capacity"
+TOKEN_SHAPE = "shape"
+TOKEN_REQUIREMENTS = "requirements"
+TOKEN_ZONE = "zone"
+TOKEN_HOSTNAME = "hostname"
+TOKEN_ICE = "ice"
+TOKEN_LIMITS = "limits"
+TOKEN_MARKET_CLOSED = "market:window-closed"
+TOKEN_MARKET_SPIKE = "market:price-spike"
+TOKEN_RESERVATION_EXPIRED = "reservation:expired"
+TOKEN_GANG = "gang:atomicity-shortfall"
+
+
+def enabled() -> bool:
+    """The why plane's kill switch. ``KARPENTER_TPU_WHY=0`` disables every
+    stamp channel at once — result/provenance/audit/metrics — so the
+    legacy path is byte-identical (tested in tests/test_why.py)."""
+    return os.environ.get("KARPENTER_TPU_WHY", "1") != "0"
+
+
+def _ladder(n: int, minimum: int = 8) -> int:
+    """The solver's {2^k, 1.5*2^k} padding ladder (scheduling/groups.py)."""
+    p = minimum
+    while True:
+        if n <= p:
+            return p
+        if n <= p * 3 // 2:
+            return p * 3 // 2
+        p *= 2
+
+
+# ---------------------------------------------------------------------------
+# device kernel
+# ---------------------------------------------------------------------------
+
+def _eliminate_impl(requests, capacity, compat, price, group_window, type_window):
+    """[GB, TB] int32 elimination bitmask + [GB] usable-type-exists flag.
+
+    Pure shape-stable jnp over the encode's own tensors. The stored
+    ``compat`` is the encode's full conjunction (static labels AND live
+    offering AND fits), so the pure-label plane is recovered as
+    "fits and live yet still incompatible" — live implies the encode's
+    offer_any conjunct, leaving static_ok as the only failed term.
+    """
+    import jax.numpy as jnp
+
+    fits = (requests[:, None, :] <= capacity[None, :, :] + 1e-6).all(-1)
+    live = (
+        jnp.einsum(
+            "gzc,tzc->gt",
+            group_window.astype(jnp.float32),
+            type_window.astype(jnp.float32),
+        )
+        > 0
+    )
+    zone_any = group_window.reshape(group_window.shape[0], -1).any(-1)
+    finite = jnp.isfinite(price)
+    bits = jnp.where(~fits, BIT_SHAPE, 0)
+    bits = bits | jnp.where(fits & live & ~compat, BIT_REQUIREMENTS, 0)
+    bits = bits | jnp.where(~live & zone_any[:, None], BIT_OFFERING, 0)
+    bits = bits | jnp.where(~zone_any[:, None], BIT_ZONE, 0)
+    bits = bits | jnp.where(fits & live & compat & ~finite, BIT_PRICE, 0)
+    usable = (fits & live & compat & finite).any(-1)
+    return bits.astype(jnp.int32), usable
+
+
+_eliminate = None
+_eliminate_lock = threading.Lock()
+
+
+def _kernel():
+    """Lazy tracked_jit wrapper: obs/ imports must not force jax."""
+    global _eliminate
+    if _eliminate is None:
+        with _eliminate_lock:
+            if _eliminate is None:
+                from ..trace.jitwatch import tracked_jit
+
+                _eliminate = tracked_jit(family="why.eliminate")(_eliminate_impl)
+    return _eliminate
+
+
+def eliminate_bits(
+    problem, group_idx: Sequence[int], catalog_types: int = 0
+) -> tuple[np.ndarray, np.ndarray]:
+    """Run the elimination kernel over ``group_idx``'s rows of an
+    EncodedProblem; returns (bits [n, T], usable [n]) sliced back to the
+    problem's real axes.
+
+    The group axis is ladder-padded over the SELECTED rows (the
+    unschedulable remainder — small), and the type axis over
+    ``max(T, catalog_types)`` so the per-problem type compaction (which
+    varies solve to solve) never mints a fresh compile bucket.
+    """
+    G = len(problem.group_pods)
+    T = problem.capacity.shape[0]
+    R = problem.capacity.shape[1]
+    idx = np.asarray(list(group_idx), dtype=np.int64)
+    n = len(idx)
+    GB = _ladder(max(n, 1))
+    TB = _ladder(max(T, catalog_types, 1))
+    Z, C = problem.type_window.shape[1], problem.type_window.shape[2]
+
+    requests = np.zeros((GB, R), dtype=np.float32)
+    compat = np.zeros((GB, TB), dtype=bool)
+    price = np.full((GB, TB), np.inf, dtype=np.float32)
+    group_window = np.zeros((GB, Z, C), dtype=bool)
+    capacity = np.zeros((TB, R), dtype=np.float32)
+    type_window = np.zeros((TB, Z, C), dtype=bool)
+    if n:
+        requests[:n] = problem.requests[idx]
+        compat[:n, :T] = problem.compat[idx][:, :T]
+        price[:n, :T] = problem.price[idx][:, :T]
+        group_window[:n] = problem.group_window[idx]
+    capacity[:T] = problem.capacity
+    type_window[:T] = problem.type_window
+
+    bits, usable = _kernel()(
+        requests, capacity, compat, price, group_window, type_window
+    )
+    return np.asarray(bits)[:n, :T], np.asarray(usable)[:n]
+
+
+def warm_why_kernels(max_groups: int = 64, catalog_types: int = 32,
+                     zones: int = 4, resources: int = 0) -> None:
+    """Pre-trace ``why.eliminate`` at every group-axis ladder bucket up to
+    ``max_groups`` for the catalog's type bucket, so arming the plane
+    mid-run never mints a compile after the jitwatch warmup boundary.
+    Idempotent per process (jit caches by shape)."""
+    if resources <= 0:
+        from ..models.resources import NUM_RESOURCES
+
+        resources = NUM_RESOURCES
+    TB = _ladder(max(catalog_types, 1))
+    C = lbl.NUM_CAPACITY_TYPES
+    sizes, v = [], 8
+    while v <= max_groups:
+        sizes.append(v)
+        if v * 3 // 2 <= max_groups:
+            sizes.append(v * 3 // 2)
+        v *= 2
+    capacity = np.ones((TB, resources), dtype=np.float32)
+    type_window = np.ones((TB, zones, C), dtype=bool)
+    for GB in sizes:
+        _kernel()(
+            np.zeros((GB, resources), dtype=np.float32),
+            capacity,
+            np.ones((GB, TB), dtype=bool),
+            np.ones((GB, TB), dtype=np.float32),
+            np.ones((GB, zones, C), dtype=bool),
+            type_window,
+        )
+
+
+# ---------------------------------------------------------------------------
+# host decode
+# ---------------------------------------------------------------------------
+
+def _popcount(x: int) -> int:
+    return bin(int(x)).count("1")
+
+
+def _bit_tokens(bits: int) -> list[str]:
+    return [name for bit, name in sorted(BIT_NAMES.items()) if bits & bit]
+
+
+def classify_reason(reason: str) -> Optional[str]:
+    """Map a legacy free-text solver reason string onto the token
+    vocabulary (the host-side rejects the device kernel never sees)."""
+    r = reason or ""
+    if "all-or-nothing" in r:
+        return TOKEN_GANG
+    if "hostname" in r or "co-located group already running" in r:
+        return TOKEN_HOSTNAME
+    if "anti-affinity" in r or "zone" in r or "skew" in r:
+        return TOKEN_ZONE
+    if "taints" in r or "requirements" in r or "minValues" in r:
+        return TOKEN_REQUIREMENTS
+    if "exceed nodepool limits" in r:
+        return TOKEN_LIMITS
+    if "no instance type fits" in r:
+        return None  # the kernel decode is strictly more specific
+    return None
+
+
+def _active_faults() -> str:
+    """The ambient fault context (trace/provenance.py providers): the
+    fleet simulator registers ``sim_active_faults`` and the chaos harness
+    ``chaos_active_faults`` — the decode reads both."""
+    try:
+        from ..trace import provenance as _prov
+
+        ctx: dict = {}
+        for p in list(getattr(_prov, "_ambient_providers", ())):
+            try:
+                ctx.update(p() or {})
+            except Exception:
+                continue
+        return ",".join((
+            str(ctx.get("sim_active_faults", "")),
+            str(ctx.get("chaos_active_faults", "")),
+        ))
+    except Exception:  # pragma: no cover - attribution is best-effort
+        return ""
+
+
+def _refine_dark(problem, g: int, t: int, catalog) -> str:
+    """Name WHY the nearest-miss type's offering window is dark: walk the
+    group's allowed (zone, captype) cells where the type's window is off
+    and classify against the ICE cache and the market plane's reservation
+    windows. Falls back to ``zone`` when the group restricted zones, else
+    ``capacity`` (every offering genuinely absent)."""
+    tname = problem.type_names[t]
+    zones = problem.zones
+    gw = problem.group_window[g]
+    tw = problem.type_window[t]
+    windows = None
+    now = 0.0
+    if catalog is not None:
+        try:
+            from ..market.offerings import windows_from_reservations
+
+            windows = windows_from_reservations(catalog.reservations.list())
+            now = catalog._clock.now()
+        except Exception:
+            windows = None
+    saw_ice = saw_closed = saw_expired = False
+    for z in range(gw.shape[0]):
+        for c in range(gw.shape[1]):
+            if not gw[z, c] or tw[z, c]:
+                continue
+            zone = zones[z] if z < len(zones) else ""
+            captype = lbl.CAPACITY_TYPES[c]
+            if catalog is not None and catalog.unavailable.is_unavailable(
+                tname, zone, captype
+            ):
+                saw_ice = True
+                continue
+            if c == lbl.RESERVED_INDEX and windows:
+                from ..market.offerings import dark_cell_reason
+
+                verdict = dark_cell_reason(windows, tname, zone, now)
+                if verdict == TOKEN_MARKET_CLOSED:
+                    saw_closed = True
+                elif verdict == TOKEN_RESERVATION_EXPIRED:
+                    saw_expired = True
+    if saw_ice:
+        return TOKEN_ICE
+    if saw_closed:
+        return TOKEN_MARKET_CLOSED
+    if saw_expired:
+        return TOKEN_RESERVATION_EXPIRED
+    zone_allowed = problem.group_zone_allowed[g]
+    if not zone_allowed.all():
+        return TOKEN_ZONE
+    return TOKEN_CAPACITY
+
+
+def attribute(
+    pods: Sequence,
+    problems: Mapping[str, object],
+    catalog=None,
+    reasons: Optional[Mapping[str, str]] = None,
+    gang_withheld: Optional[Iterable[str]] = None,
+) -> dict[str, dict]:
+    """Decode elimination bitmasks into per-pod why records.
+
+    ``problems`` maps nodepool name -> the pool's LAST EncodedProblem of
+    the solve (stashed by ``_solve_multi_nodepool``); ``reasons`` is the
+    solver's legacy uid -> free-text map (host-side rejects win over the
+    kernel when they are strictly more specific); ``gang_withheld`` names
+    the uids the all-or-nothing gate stripped.
+
+    Returns uid -> {"top", "tokens", "nearest", "pool"} where ``top`` is
+    the single ranked verdict, ``tokens`` the full decoded set, and
+    ``nearest`` the nearest-miss instance type (fewest elimination bits)
+    with its surviving bit names.
+    """
+    gang_uids = set(gang_withheld or ())
+    reasons = reasons or {}
+    catalog_types = 0
+    if catalog is not None:
+        try:
+            catalog_types = len(catalog.list())
+        except Exception:
+            catalog_types = 0
+
+    # uid -> (pool, problem, group) over every stashed pool problem; a pod
+    # can appear in several pools — the decode keeps the NEAREST miss.
+    locate: dict[str, list[tuple[str, object, int]]] = {}
+    kernel_rows: dict[int, tuple[np.ndarray, np.ndarray]] = {}
+    prob_list = list(problems.items())
+    for pi, (_pool, prob) in enumerate(prob_list):
+        for g, plist in enumerate(prob.group_pods):
+            for p in plist:
+                locate.setdefault(p.uid, []).append((pi, g))
+
+    wanted: dict[int, set[int]] = {}
+    for pod in pods:
+        for pi, g in locate.get(pod.uid, ()):
+            wanted.setdefault(pi, set()).add(g)
+    for pi, gset in wanted.items():
+        prob = prob_list[pi][1]
+        order = sorted(gset)
+        bits, usable = eliminate_bits(prob, order, catalog_types)
+        kernel_rows[pi] = ({g: i for i, g in enumerate(order)}, (bits, usable))
+
+    spike = "PriceSpike" in _active_faults()
+    out: dict[str, dict] = {}
+    for pod in pods:
+        uid = pod.uid
+        tokens: list[str] = []
+        nearest: Optional[dict] = None
+        pool_name = ""
+        legacy = classify_reason(reasons.get(uid, ""))
+        if uid in gang_uids or legacy == TOKEN_GANG:
+            tokens.append(TOKEN_GANG)
+        # nearest miss across every pool that encoded this pod
+        best = None  # (popcount, pool, problem, g, t, bits_row, usable)
+        for pi, g in locate.get(uid, ()):
+            got = kernel_rows.get(pi)
+            if got is None:
+                continue
+            row_of, (bits, usable) = got
+            i = row_of.get(g)
+            if i is None:
+                continue
+            row = bits[i]
+            if row.size == 0:
+                continue
+            # 5-plane popcount, vectorized (np.vectorize is a Python loop)
+            pops = sum((row >> k) & 1 for k in range(5))
+            t = int(np.argmin(pops))
+            cand = (int(pops[t]), pi, g, t, row, bool(usable[i]))
+            if best is None or cand[0] < best[0]:
+                best = cand
+        if best is not None:
+            _pop, pi, g, t, row, has_usable = best
+            pool_name, prob = prob_list[pi]
+            bit_val = int(row[t])
+            nearest = {
+                "type": prob.type_names[t] if t < len(prob.type_names) else "",
+                "bits": _bit_tokens(bit_val),
+            }
+            if has_usable or bit_val == 0:
+                # a usable type existed — the scan ran out of room, limits,
+                # or rows: the shortfall is capacity, not constraints
+                if TOKEN_CAPACITY not in tokens:
+                    tokens.append(TOKEN_CAPACITY)
+            else:
+                for bit, _name in sorted(BIT_NAMES.items()):
+                    if not bit_val & bit:
+                        continue
+                    if bit == BIT_OFFERING:
+                        tok = _refine_dark(prob, g, t, catalog)
+                    elif bit == BIT_SHAPE:
+                        tok = TOKEN_SHAPE
+                    elif bit == BIT_REQUIREMENTS:
+                        tok = TOKEN_REQUIREMENTS
+                    elif bit == BIT_ZONE:
+                        tok = TOKEN_ZONE
+                    else:
+                        tok = TOKEN_MARKET_CLOSED  # priced-out row
+                    if tok not in tokens:
+                        tokens.append(tok)
+        if legacy and legacy not in tokens:
+            # host-side reject (taints/limits/hostname) names the plane the
+            # kernel could not see; it outranks a generic kernel verdict
+            tokens.insert(0 if not (uid in gang_uids) else 1, legacy)
+        if not tokens:
+            tokens.append(TOKEN_CAPACITY)
+        if spike:
+            # chaos ambient context: a price-spike window upgrades bare
+            # capacity verdicts and annotates everything else — withheld
+            # work inside the spike is market-caused, not a fleet shortfall
+            if tokens[0] == TOKEN_CAPACITY:
+                tokens.insert(0, TOKEN_MARKET_SPIKE)
+            elif TOKEN_MARKET_SPIKE not in tokens:
+                tokens.append(TOKEN_MARKET_SPIKE)
+        rec = {"top": tokens[0], "tokens": tokens}
+        if nearest is not None:
+            rec["nearest"] = nearest
+        if pool_name:
+            rec["pool"] = pool_name
+        out[uid] = rec
+    return out
+
+
+def summarize(why_map: Mapping[str, Mapping]) -> dict:
+    """Per-solve histogram for ProvenanceRecord.why: reason -> count over
+    the ``top`` verdicts, plus the attributed total."""
+    hist: dict[str, int] = {}
+    for rec in why_map.values():
+        top = str(rec.get("top", ""))
+        if top:
+            hist[top] = hist.get(top, 0) + 1
+    return {"reasons": dict(sorted(hist.items())), "attributed": len(why_map)}
+
+
+# ---------------------------------------------------------------------------
+# one source of truth for the gang withhold string (satellite 2)
+# ---------------------------------------------------------------------------
+
+def gang_shortfall(name: str, placed: int, need: int) -> str:
+    """THE all-or-nothing withhold explanation. ``enforce_gangs`` renders
+    its free-text reason through this formatter and ``classify_reason``
+    maps it back to ``gang:atomicity-shortfall`` — the decode and the
+    string can never drift (pinned in tests/test_gangs.py)."""
+    return (
+        f"gang {name}: only {int(placed)} of {int(need)} outstanding "
+        "members placeable; all-or-nothing group withheld"
+    )
+
+
+# ---------------------------------------------------------------------------
+# the live board (backs `obs why` and /debug/why)
+# ---------------------------------------------------------------------------
+
+class WhyBoard:
+    """Bounded newest-wins record of decoded attributions, keyed by pod
+    name — the live lookup surface behind ``obs why pod/<name>`` and the
+    ``/debug/why`` page. Thread-safe; O(1) per stamp."""
+
+    def __init__(self, cap: int = 1024):
+        self._cap = cap
+        self._lock = threading.Lock()
+        self._records: dict[str, dict] = {}
+        self._hist: dict[str, int] = {}
+
+    def stamp(self, name: str, rec: Mapping, at: float = 0.0) -> None:
+        entry = dict(rec)
+        entry["at"] = float(at)
+        with self._lock:
+            self._records.pop(name, None)
+            self._records[name] = entry
+            top = str(entry.get("top", ""))
+            if top:
+                self._hist[top] = self._hist.get(top, 0) + 1
+            while len(self._records) > self._cap:
+                self._records.pop(next(iter(self._records)))
+
+    def get(self, name: str) -> Optional[dict]:
+        with self._lock:
+            rec = self._records.get(name)
+            return dict(rec) if rec else None
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "records": {k: dict(v) for k, v in self._records.items()},
+                "reasons": dict(sorted(self._hist.items())),
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._records.clear()
+            self._hist.clear()
+
+
+_board = WhyBoard()
+
+
+def board() -> WhyBoard:
+    return _board
+
+
+def why_view(kind: str, name: str, audit=None, flight=None) -> dict:
+    """The ``obs why <kind>/<name>`` join: every why-stamped decision the
+    audit plane retains for the subject (unschedulable placements,
+    disruption rejects), the live board's newest verdict, and — when a
+    flight snapshot is supplied — the object's cross-replica hops, so one
+    command answers "why is this pod pending" with the decoded constraint
+    planes attached.
+
+    ``audit`` is an AuditLog or a list of AuditRecord (the CLI's
+    ``--audit-file`` / ``--sim-report`` modes); ``flight`` a FleetRecorder.
+    """
+    records = []
+    if audit is not None:
+        if hasattr(audit, "query"):
+            records = audit.query(subject_kind=kind, subject=name)
+        else:
+            records = [
+                r for r in audit
+                if r.subject_kind == kind and r.subject == name
+            ]
+    decisions = []
+    verdict = None
+    for r in records:
+        d = r.as_dict() if hasattr(r, "as_dict") else dict(r)
+        entry = {
+            "at": d.get("at"),
+            "kind": d.get("kind"),
+            "decision": d.get("decision"),
+            "reason": (d.get("detail") or {}).get("reason", ""),
+        }
+        why = (d.get("detail") or {}).get("why")
+        if why:
+            entry["why"] = why
+            verdict = why  # newest why-stamped record wins
+        decisions.append(entry)
+    live = _board.get(name)
+    if live is not None:
+        verdict = live
+    hops = []
+    if flight is not None:
+        try:
+            hops = flight.explain(kind, name).get("hops", [])
+        except Exception:
+            hops = []
+    return {
+        "subject": f"{kind}/{name}",
+        "verdict": verdict,
+        "decisions": decisions,
+        "hops": hops,
+    }
+
+
+def render_why(view: Mapping) -> str:
+    """Human rendering of a why_view."""
+    lines = [f"why {view['subject']}"]
+    verdict = view.get("verdict")
+    if verdict:
+        lines.append(f"  verdict: {verdict.get('top', '?')}")
+        tokens = verdict.get("tokens") or []
+        if len(tokens) > 1:
+            lines.append(f"  contributing: {', '.join(tokens)}")
+        nearest = verdict.get("nearest") or {}
+        if nearest:
+            bits = ", ".join(nearest.get("bits") or []) or "none"
+            lines.append(
+                f"  nearest miss: {nearest.get('type', '?')} "
+                f"(eliminated by: {bits})"
+            )
+        if verdict.get("pool"):
+            lines.append(f"  nodepool: {verdict['pool']}")
+    else:
+        lines.append("  verdict: (no why-stamped decision retained)")
+    decs = view.get("decisions") or []
+    if decs:
+        lines.append(f"  decisions ({len(decs)}):")
+        for d in decs[-20:]:
+            why = d.get("why") or {}
+            suffix = f"  [why: {why.get('top')}]" if why else ""
+            reason = d.get("reason", "")
+            reason = f" — {reason}" if reason else ""
+            lines.append(
+                f"    t={d.get('at')} {d.get('kind')}/{d.get('decision')}"
+                f"{reason}{suffix}"
+            )
+    hops = view.get("hops") or []
+    if hops:
+        lines.append(f"  flight hops ({len(hops)}):")
+        for h in hops[-12:]:
+            lines.append(f"    {h}")
+    return "\n".join(lines)
+
+
+def debug_why_page() -> dict:
+    """``/debug/why``: the ranked reason histogram plus the newest decoded
+    records (newest last — insertion order is stamp order)."""
+    snap = _board.snapshot()
+    recs = list(snap["records"].items())
+    return {
+        "reasons": snap["reasons"],
+        "records": dict(recs[-64:]),
+        "total": len(recs),
+    }

@@ -421,6 +421,31 @@ def _encode_cluster(cluster, catalog, gmax: int,
     )
 
 
+def blocked_summary(cluster, gmax: int = GMAX_DEFAULT) -> dict[str, int]:
+    """Why-engine view of the ``blocked`` column (obs/why.py `/debug/why`):
+    node counts per blocked cause, mirroring ``_encode_cluster``'s
+    semantics with a read-only host walk — a debug-cadence query, never
+    on the encode hot path, so it adds no tensor column the incremental
+    patcher would have to maintain. A node trips every cause it matches
+    (the tensor collapses them into one bit; this is the decode)."""
+    hist = {"do-not-disrupt": 0, "hostname-colocated": 0,
+            "gang": 0, "fragmentation": 0}
+    pods_by_node = cluster.pods_by_node()
+    for node in cluster.snapshot_nodes():
+        pods = pods_by_node.get(node.name, ())
+        if not pods:
+            continue
+        if any(p.do_not_disrupt() for p in pods):
+            hist["do-not-disrupt"] += 1
+        if any(p.hostname_colocated() for p in pods):
+            hist["hostname-colocated"] += 1
+        if any(p.gang_locked() for p in pods):
+            hist["gang"] += 1
+        if len({p.group_token() for p in pods}) > gmax:
+            hist["fragmentation"] += 1
+    return {k: v for k, v in hist.items() if v}
+
+
 def _fit_counts(cap_rem: jnp.ndarray, req: jnp.ndarray) -> jnp.ndarray:
     with_req = req > 0
     ratio = jnp.where(

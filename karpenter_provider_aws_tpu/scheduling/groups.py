@@ -258,11 +258,16 @@ def enforce_gangs(result, bound=None) -> list[tuple[Pod, str]]:
         _count_gangs(len(names) - 1, 0)
         return []
     stripped: list[tuple[Pod, str]] = []
+    # ONE source of truth for the withhold explanation: the why-engine's
+    # formatter (obs/why.py gang_shortfall) — its classify_reason maps the
+    # string back to gang:atomicity-shortfall, so the free-text surface
+    # and the bitmask decode can never drift (tests/test_gangs.py pins
+    # agreement on the anti-affine-8-in-4-zones case). Lazy import: same
+    # cycle-safe pattern as _count_gangs.
+    from ..obs.why import gang_shortfall
+
     reasons = {
-        s: (
-            f"gang {names[s]}: only {int(counts[s])} of {mins[s]} outstanding "
-            "members placeable; all-or-nothing group withheld"
-        )
+        s: gang_shortfall(names[s], int(counts[s]), mins[s])
         for s in bad_slots
     }
     drop_bind_idx = set()

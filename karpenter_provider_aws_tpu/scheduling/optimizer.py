@@ -425,6 +425,25 @@ def dispatch_optimizer(padded, max_nodes: int, dput=None,
 # host-side adoption contract
 # ---------------------------------------------------------------------------
 
+def classify_reject(reason: str) -> str:
+    """Map a ``validate_plan`` rejection string onto the why-engine's
+    constraint-plane vocabulary (obs/why.py) so the
+    ``karpenter_consolidation_rejected_total{reason}`` family names the
+    violated plane, not just "the validator said no"."""
+    r = reason or ""
+    if "conservation" in r or "negative placement" in r:
+        return "lane:validator:conservation"
+    if "hostname cap" in r:
+        return "lane:validator:hostname"
+    if "capacity exceeded" in r or "used tensor" in r:
+        return "lane:validator:shape"
+    if "incompatible group" in r:
+        return "lane:validator:requirements"
+    if "offering window" in r or "node window" in r:
+        return "lane:validator:offering-dark"
+    return "lane:validator"
+
+
 def validate_plan(problem, node_type, node_price, used, placed, node_window,
                   n_open: int, unplaced=None) -> tuple[bool, str]:
     """The host validator every ADOPTED optimizer plan must pass — the

@@ -189,6 +189,12 @@ class SolveResult:
     # on EVERY solve so no downstream consumer (bench rows above all) can
     # be ambiguous about where a number came from (trace/provenance.py)
     provenance: Optional[ProvenanceRecord] = None
+    # why-engine attribution (obs/why.py): pod uid -> decoded record
+    # {"top", "tokens", "nearest", "pool"} for every unschedulable pod.
+    # Empty on clean solves and under KARPENTER_TPU_WHY=0 — the free-text
+    # reasons in ``unschedulable`` are unchanged either way (kill-switch
+    # byte-identity; the why map rides a separate channel).
+    why: dict = field(default_factory=dict)
 
     def pods_placed(self) -> int:
         return sum(len(s.pods) for s in self.node_specs) + len(self.binds)
@@ -1357,6 +1363,7 @@ class TPUSolver:
                 self.timings["opt_lane"] = f"rejected:{why}"[:80]
                 self._opt_counts["rejected"] += 1
                 _opt.count_outcome("rejected")
+                _count_consolidation_reject(_opt.classify_reject(why))
                 return ffd_out
             node_cap = problem.capacity[node_type]
             _refine_plan(
@@ -1391,6 +1398,7 @@ class TPUSolver:
             self.timings["opt_lane"] = "rejected"
             self._opt_counts["rejected"] += 1
             _opt.count_outcome("rejected")
+            _count_consolidation_reject("lane:not-cheaper")
             return ffd_out
         except Exception as e:
             br.record_failure(e)
@@ -2027,6 +2035,20 @@ def _enforce_pool_constraints(
     return kept, rejected
 
 
+def _count_consolidation_reject(reason: str) -> None:
+    """``karpenter_consolidation_rejected_total{reason}`` — the why-engine
+    verdict for a rejected optimizer/consolidation proposal. Rides the
+    KARPENTER_TPU_WHY kill switch so lane-off telemetry is unchanged."""
+    try:
+        from ..metrics import CONSOLIDATION_REJECTED
+        from ..obs.why import enabled as _why_enabled
+
+        if _why_enabled():
+            CONSOLIDATION_REJECTED.inc(reason=reason)
+    except Exception:  # pragma: no cover - telemetry is best-effort
+        pass
+
+
 def certainly_unplaceable(problem, pool_existing=None) -> list[Pod]:
     """Pods a pool's device solve is GUARANTEED to leave unplaced,
     computed host-side from the encode: a group with no instance type
@@ -2092,6 +2114,13 @@ def _solve_multi_nodepool(
     result = SolveResult(num_pods=len(pods))
     remaining: list[Pod] = list(pods)
     reasons: dict[str, str] = {}
+    # why-engine stash: the LAST EncodedProblem per pool (relaxation
+    # rounds overwrite — the final round is the one the verdict reflects).
+    # Holding the problems costs nothing: they are the encode's own
+    # content-cached arrays, and attribution only reads them when the
+    # solve actually left pods behind.
+    why_problems: dict[str, object] = {}
+    gang_withheld_uids: set[str] = set()
     in_use = in_use or {}
     # State shared across pools AND relaxation rounds, so the relaxed round
     # never re-offers what an earlier round consumed:
@@ -2127,6 +2156,7 @@ def _solve_multi_nodepool(
             impl.timings["encode_ms"] = impl.timings.get("encode_ms", 0.0) + (
                 (time.perf_counter() - t_enc) * 1e3
             )
+        why_problems[pool.name] = problem
         for pod, why in problem.unencodable:
             reasons[pod.uid] = f"nodepool {pool.name}: {why}"
         # This pool's own live nodes ride along as pre-opened capacity (same
@@ -2302,11 +2332,29 @@ def _solve_multi_nodepool(
 
         for pod, why in enforce_gangs(result, bound=gang_bound):
             reasons[pod.uid] = why
+            gang_withheld_uids.add(pod.uid)
             remaining.append(pod)
     for pod in remaining:
         result.unschedulable.append(
             (pod, reasons.get(pod.uid, "no nodepool can schedule this pod"))
         )
+    # why-engine attribution (obs/why.py): decode the elimination bitmask
+    # for the remainder — only when the solve actually left pods behind
+    # (clean solves pay a single truthiness check) and only with the
+    # plane armed (KARPENTER_TPU_WHY=0 keeps the legacy path byte-exact).
+    _why = None
+    if remaining:
+        from ..obs import why as _why_mod
+
+        if _why_mod.enabled():
+            _why = _why_mod
+            try:
+                result.why = _why.attribute(
+                    remaining, why_problems, catalog=catalog,
+                    reasons=reasons, gang_withheld=gang_withheld_uids,
+                )
+            except Exception:  # pragma: no cover - attribution best-effort
+                result.why = {}
     result.total_cost = float(sum(s.estimated_price for s in result.node_specs))
     result.solve_seconds = time.perf_counter() - t0
     extra_scale = {
@@ -2333,6 +2381,10 @@ def _solve_multi_nodepool(
         wall_ms=result.solve_seconds * 1e3,
         extra_scale=extra_scale,
     )
+    # the per-solve why histogram rides the provenance record every
+    # downstream consumer (audit, bench rows, sim report) already reads
+    if _why is not None and result.why and result.provenance is not None:
+        result.provenance.why = _why.summarize(result.why)
     # answer-quality stamp (packing efficiency, unschedulable rate,
     # fallback) on the SAME provenance record every consumer reads —
     # cheap O(specs + pods), exception-safe inside solve_quality

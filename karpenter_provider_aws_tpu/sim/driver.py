@@ -174,7 +174,11 @@ class FleetSimulator:
         self.settle_steps_used = 0
         self.errors_baseline = len(self.env.manager.errors)
         self.scenario = SimpleNamespace(
-            name=self.trace.name, settle_reconciles=self.trace.settle_reconciles
+            name=self.trace.name,
+            settle_reconciles=self.trace.settle_reconciles,
+            # check_converged exempts the red-gate poison pods (they pend
+            # forever by design on deliberately-starving traces)
+            unschedulable_per_wave=self.trace.unschedulable_per_wave,
         )
         # market state (installed by _seed_market when the trace arms it)
         self._market_model = None
@@ -300,6 +304,20 @@ class FleetSimulator:
 
             if gangs_enabled():
                 warm_gang_kernels()
+        # why plane armed: pre-trace the elimination kernel's ladder
+        # buckets inside the warmup half — the first unschedulable pod may
+        # arrive long after the retraces_after_warmup boundary, and its
+        # attribution must not mint a first compile there
+        from ..obs.why import enabled as _why_enabled
+        from ..obs.why import warm_why_kernels
+
+        if _why_enabled():
+            try:
+                catalog_types = len(self.env.catalog.list())
+                zones = len(self.env.catalog.zones)
+            except Exception:
+                catalog_types, zones = 32, 4
+            warm_why_kernels(catalog_types=catalog_types, zones=zones)
         pool = NodePool(
             name="default",
             requirements=[

@@ -296,6 +296,48 @@ def build_report(sim, span_profile: dict, deltas: dict) -> FleetReport:
             ),
         }
 
+    # why plane (designs/why-engine.md): decoded constraint attribution
+    # over the day's audit ring. Virtual-time data (the why stamps ride
+    # the solve's own tensors and the FakeClock), inside the signature.
+    # Keyed on the kill switch so KARPENTER_TPU_WHY=0 reports are
+    # byte-identical to a build without the engine.
+    from ..obs.why import enabled as _why_enabled
+
+    if _why_enabled():
+        unsched = [
+            r for r in audit_records
+            if r.get("kind") == "placement"
+            and r.get("decision") == "unschedulable"
+        ]
+        stamped = [
+            r for r in unsched if (r.get("detail") or {}).get("why")
+        ]
+        why_reasons: dict[str, int] = {}
+        for r in stamped:
+            top = (r["detail"]["why"].get("top") or "unknown")
+            why_reasons[top] = why_reasons.get(top, 0) + 1
+        reject_reasons: dict[str, int] = {}
+        for r in audit_records:
+            if (r.get("kind") == "disruption"
+                    and str(r.get("decision", "")).startswith("reject:")):
+                w = (r.get("detail") or {}).get("why") or {}
+                if w.get("top"):
+                    reject_reasons[w["top"]] = (
+                        reject_reasons.get(w["top"], 0) + 1
+                    )
+        virtual["why"] = {
+            # coverage over the ring's unschedulable records: every one
+            # must carry a decoded attribution (1.0 when none — a clean
+            # day attributes vacuously)
+            "unschedulable_records": len(unsched),
+            "attributed": len(stamped),
+            "coverage": (
+                round(len(stamped) / len(unsched), 4) if unsched else 1.0
+            ),
+            "reasons": dict(sorted(why_reasons.items())),
+            "consolidation_rejects": dict(sorted(reject_reasons.items())),
+        }
+
     # tenancy / fairness plane: quiet tenants' bind p99 inside the noisy-
     # neighbor window vs outside it (virtual-time durations: signed)
     noisy_at = getattr(sim.trace, "noisy_at_s", -1.0)
@@ -443,6 +485,16 @@ def build_report(sim, span_profile: dict, deltas: dict) -> FleetReport:
         # would pass atomicity vacuously)
         gate["gangs_partial"] = len(virtual["gangs"]["partial"])
         gate["gangs_placed"] = virtual["gangs"]["placed"]
+    if "why" in virtual:
+        # the why-not engine's own gate: full attribution coverage over
+        # the ring's unschedulable records, plus the ranked top reason so
+        # baselines can pin what a canned day is SUPPOSED to starve on
+        gate["why_coverage"] = virtual["why"]["coverage"]
+        ranked = sorted(
+            virtual["why"]["reasons"].items(),
+            key=lambda kv: (-kv[1], kv[0]),
+        )
+        gate["why_top_reason"] = ranked[0][0] if ranked else None
     if noisy_at >= 0 and tenancy:
         # the per-tenant fairness SLO: worst quiet-tenant ratio of bind
         # p99 inside the noisy window vs outside (the noisy tenant itself

@@ -335,6 +335,24 @@ def canned_traces() -> dict[str, TraceSpec]:
             tenants=3, noisy_at_s=1.5 * 3600.0, noisy_duration_s=1800.0,
             noisy_pods=96,
         ),
+        # the why-not engine's acceptance day (designs/why-engine.md): a
+        # smoke-shaped 2h at 500 nodes that DELIBERATELY starves — every
+        # wave lands two pods no shape can serve, training gangs ride the
+        # floor, and a seeded market walks spot prices — so every
+        # unschedulable record, withheld gang, and market-dark offering
+        # must come back attributed (`make why-smoke` gates
+        # why_coverage == 1.0 vs sim/baselines/why-500.json)
+        "why-day": TraceSpec(
+            name="why-day", nodes=500, duration_s=2 * 3600.0,
+            heartbeat_s=600.0, sample_every_s=900.0,
+            waves_per_hour=2.0, wave_pods=24, wave_ttl_s=3600.0,
+            floods=1, flood_pods=48, churn_every_s=1800.0, churn_pods=12,
+            settle_reconciles=40,
+            unschedulable_per_wave=2,
+            gang_every_s=1800.0, gang_size=8, gang_spread_skew=2,
+            gang_ttl_s=5400.0,
+            market_tick_s=900.0, market_volatility=0.35,
+        ),
         # MARKET traces (moving prices / reserved windows) live in
         # market/scenarios.py next to the model they exercise
         **_market_traces(),

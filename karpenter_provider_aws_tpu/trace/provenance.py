@@ -112,6 +112,12 @@ class ProvenanceRecord:
     # can no longer launder a cold compile into a steady-state number.
     # None = jitwatch disabled / the producer predates the ledger.
     compiles: Optional[int] = None
+    # why-engine attribution summary (obs/why.py): the decoded reason
+    # histogram over this solve's unschedulable remainder, e.g.
+    # {"reasons": {"capacity": 3, "zone": 1}, "attributed": 4}. Empty on
+    # clean solves and whenever KARPENTER_TPU_WHY=0 (the kill switch must
+    # keep the record byte-identical to the legacy shape).
+    why: dict = field(default_factory=dict)
 
     def as_dict(self) -> dict:
         d = {
@@ -137,6 +143,8 @@ class ProvenanceRecord:
             d["quality"] = dict(self.quality)
         if self.compiles is not None:
             d["compiles"] = int(self.compiles)
+        if self.why:
+            d["why"] = dict(self.why)
         return d
 
     def label(self) -> str:

@@ -75,6 +75,15 @@ class ManifestError(ValueError):
     shape) — callers degrade to a plain cold start."""
 
 
+class WarmupTopologySkew(Warning):
+    """The manifest was recorded on a different device topology (platform
+    or device count) than this process runs on. Replaying it would warm
+    wrong-shaped programs — sharded lanes trace against the live device
+    axis — so every entry is skipped and the process runs cold instead.
+    Heterogeneous fleets should point each topology class at its own
+    manifest (ROADMAP: per-topology manifests)."""
+
+
 class SpecCodecError(ValueError):
     """One replay spec cannot be (de)serialized — that entry is skipped
     with a recorded reason, never fatal."""
@@ -193,6 +202,10 @@ def _materialize(family: str, params: Optional[dict]):
         from ..parallel.mesh import make_mesh, sharded_screen_fn
 
         return sharded_screen_fn(make_mesh())
+    if family == "why.eliminate":
+        from ..obs.why import _kernel
+
+        return _kernel()
     mod = _FAMILY_MODULES.get(family)
     if mod is not None:
         importlib.import_module(mod)
@@ -229,8 +242,25 @@ def build_manifest() -> dict:
     return {
         "version": MANIFEST_VERSION,
         "jax": jax.__version__,
+        # the topology key (per-topology manifests): replay specs trace
+        # against THIS process's device axis — the sharded mesh lanes
+        # bake the device count into their programs — so a manifest is
+        # only valid on the topology that recorded it. Manifests without
+        # the key (pre-skew-gate fleets) warm unconditionally.
+        "topology": _live_topology(),
         "entries": entries,
         "unserializable": unserializable,
+    }
+
+
+def _live_topology() -> dict:
+    """The (platform, device_count) pair the manifest's programs were —
+    or would be — traced against."""
+    import jax
+
+    return {
+        "platform": str(jax.default_backend()),
+        "device_count": int(jax.device_count()),
     }
 
 
@@ -277,7 +307,7 @@ def load_manifest(path: str) -> dict:
 _PRIORITY = {fam: i for i, fam in enumerate((
     "ffd.solve", "ffd.solve_chained", "ffd.rank_launch_options",
     "ffd.compact_plan", "screen.repack", "screen.pallas", "ffd.pallas",
-    "device_state.patch", "gangs.feasible",
+    "device_state.patch", "gangs.feasible", "why.eliminate",
     "mesh.solve_shard", "mesh.screen",
 ))}
 _LATE = {"mesh.lanes": 100, "mesh.lanes_shard": 101, "optimizer.lanes": 200}
@@ -339,6 +369,35 @@ def warm_from_manifest(manifest: dict, deadline_s: Optional[float] = None,
         manifest.get("entries", []),
         key=lambda e: _rank(e.get("family", "?")),
     )
+    # per-topology gate: a manifest recorded on a different platform or
+    # device count must not be replayed — its specs would warm (and on
+    # sharded families, FAIL against) wrong-shaped programs. Every entry
+    # is skipped with an explicit reason and a WarmupTopologySkew Warning
+    # so operators see WHY the process ran cold. Manifests without the
+    # key (recorded before the gate existed) warm unconditionally.
+    recorded = manifest.get("topology")
+    if isinstance(recorded, dict) and entries:
+        live = _live_topology()
+        if (
+            str(recorded.get("platform", "")) != live["platform"]
+            or int(recorded.get("device_count", 0)) != live["device_count"]
+        ):
+            import warnings
+
+            msg = (
+                "warmup manifest topology "
+                f"{recorded.get('platform')}/{recorded.get('device_count')} "
+                f"!= live {live['platform']}/{live['device_count']}; "
+                f"skipping all {len(entries)} entries (running cold)"
+            )
+            warnings.warn(WarmupTopologySkew(msg))
+            log.warning("%s", msg)
+            acct["skipped"] = [
+                {"family": e.get("family", "?"), "reason": "topology-skew"}
+                for e in entries
+            ]
+            acct["wall_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+            return acct
     deferred: list[dict] = []
     for entry in entries:
         if deadline_s and (time.perf_counter() - t0) > deadline_s:

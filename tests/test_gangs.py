@@ -142,6 +142,23 @@ class TestAllOrNothing:
         assert not (placed & names), "no partial gang bind may survive"
         # the innocent bystanders still place
         assert {p.name for p in filler} <= placed
+        # ONE source of truth (PR 19): the commit gate's free-text reason
+        # IS the why-engine's gang_shortfall rendering — the string and
+        # the decoded token can never drift apart
+        from karpenter_provider_aws_tpu.obs import why as why_mod
+
+        placeable = 8 - len([
+            1 for p, why in res.unschedulable
+            if p.name in names and "anti-affinity" in why
+        ])
+        expected = why_mod.gang_shortfall("ha-octet", placeable, 8)
+        assert set(gate_reasons) == {expected}
+        assert why_mod.classify_reason(expected) == why_mod.TOKEN_GANG
+        gang_uids = {p.uid for p, why in res.unschedulable
+                     if p.name in names and "all-or-nothing" in why}
+        assert gang_uids
+        for uid in gang_uids:
+            assert res.why[uid]["top"] == why_mod.TOKEN_GANG
 
     def test_feasible_gang_places_whole(self, catalog, pool, monkeypatch):
         monkeypatch.delenv("KARPENTER_TPU_GANGS", raising=False)

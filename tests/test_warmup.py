@@ -273,6 +273,82 @@ class TestRestartRoundTrip:
         assert out["events_since"] == 1
 
 
+# child for the per-topology gate (PR 19 satellite): warm a manifest whose
+# stamped topology disagrees with the live process and prove every entry is
+# skipped with an explicit reason + a WarmupTopologySkew Warning — wrong-
+# topology specs must never warm (sharded families would FAIL against them).
+_CHILD_SKEW_WARM = textwrap.dedent("""
+    import json, os, sys, warnings
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["KARPENTER_TPU_WARMUP_MANIFEST"] = sys.argv[1]
+    from karpenter_provider_aws_tpu.trace import jitwatch, warmup
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        acct = warmup.startup_warm(cache_dir="0", background=False)
+    import jax.numpy as jnp
+    from karpenter_provider_aws_tpu.ops.device_state import _patch_fn
+    fn = _patch_fn(False)
+    fn(jnp.zeros((16, 4), jnp.float32), jnp.zeros((16, 8), jnp.int32),
+       jnp.zeros((16, 8), jnp.int32), jnp.zeros((32, 16), jnp.float32),
+       jnp.zeros((4,), jnp.int32), jnp.zeros((4, 4), jnp.float32),
+       jnp.zeros((4, 8), jnp.int32), jnp.zeros((4, 8), jnp.int32),
+       jnp.zeros((32, 4), jnp.float32))
+    fam = jitwatch.ledger().snapshot()["families"]["device_state.patch"]
+    acct = acct or {}
+    print(json.dumps({
+        "skew_warnings": [str(w.message) for w in caught
+                          if issubclass(w.category, warmup.WarmupTopologySkew)],
+        "warmed_families": sorted(acct.get("families", {})),
+        "skipped": acct.get("skipped", []),
+        "did_warm": warmup.did_warm(),
+        "compiles": fam["compiles"], "warmed": fam["warmed"],
+    }))
+""")
+
+
+class TestTopologySkewGate:
+    def test_mismatched_manifest_skips_everything_with_a_warning(
+        self, tmp_path
+    ):
+        manifest = str(tmp_path / "manifest.json")
+        first = _run_child(_CHILD_COMPILE, manifest)
+        assert first["compiles"] == 1
+
+        # the compiling child stamped its live topology; skew it
+        with open(manifest) as f:
+            data = json.load(f)
+        assert data["topology"]["platform"] == "cpu"
+        data["topology"]["device_count"] = int(
+            data["topology"]["device_count"]
+        ) + 1
+        with open(manifest, "w") as f:
+            json.dump(data, f)
+
+        out = _run_child(_CHILD_SKEW_WARM, manifest)
+        assert out["skew_warnings"], "WarmupTopologySkew never surfaced"
+        assert "skipping all" in out["skew_warnings"][0]
+        assert out["warmed_families"] == []          # nothing warmed
+        assert out["skipped"] and all(
+            s["reason"] == "topology-skew" for s in out["skipped"]
+        )
+        assert any(s["family"] == "device_state.patch"
+                   for s in out["skipped"])
+        assert out["warmed"] == 0
+        assert out["compiles"] == 1                  # honest cold start
+
+    def test_matching_topology_still_warms(self, tmp_path):
+        manifest = str(tmp_path / "manifest.json")
+        _run_child(_CHILD_COMPILE, manifest)
+        with open(manifest) as f:
+            data = json.load(f)
+        assert data["topology"]["platform"] == "cpu"
+
+        out = _run_child(_CHILD_SKEW_WARM, manifest)
+        assert out["skew_warnings"] == []
+        assert out["did_warm"] is True
+        assert out["warmed"] == 1 and out["compiles"] == 0
+
+
 # ---------------------------------------------------------------------------
 # 4. lazy optimizer-lane admission on cold start
 # ---------------------------------------------------------------------------

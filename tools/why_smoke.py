@@ -1,0 +1,148 @@
+#!/usr/bin/env python3
+"""why_smoke: the why-not engine's CI gate (designs/why-engine.md).
+
+Drives the deliberately-starving ``why-day`` simulated day (500 nodes,
+2 simulated hours — poison pods no shape can serve in every wave,
+training gangs, a seeded spot market) with the engine armed and asserts
+the whole attribution loop closes:
+
+ 1. every unschedulable record in the day's audit ring carries a decoded
+    verdict — ``why_coverage == 1.0`` and ``why_top_reason == "shape"``
+    thresholded through the real ``tools/fleet_gate.py`` against
+    ``sim/baselines/why-500.json`` (which also holds
+    ``retraces_after_warmup == 0``: attribution must not mint compiles);
+ 2. the kill switch is total: a ``KARPENTER_TPU_WHY=0`` run of the same
+    day produces a report whose deterministic witness is BYTE-IDENTICAL
+    to the armed run once the why channels (``virtual.why`` + the audit
+    records' ``detail.why`` stamps) are stripped — the engine observes,
+    it never steers;
+ 3. the armed steady tick stays within budget: the ``why_overhead``
+    bench row (benchmarks/why_bench.py) is stamped into
+    BENCH_DETAIL.jsonl and gated (< 5% p99) through
+    ``tools/bench_gate.py`` vs benchmarks/baselines/steady-state.json.
+
+Run via ``make why-smoke`` (JAX_PLATFORMS=cpu).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+BASELINE = os.path.join(
+    REPO, "karpenter_provider_aws_tpu", "sim", "baselines", "why-500.json"
+)
+BUDGETS = os.path.join(REPO, "benchmarks", "baselines", "steady-state.json")
+DETAIL = os.path.join(REPO, "BENCH_DETAIL.jsonl")
+
+
+def _stripped_witness(report) -> str:
+    """The armed report's deterministic witness with every why channel
+    removed: virtual.why and each audit record's detail.why stamp."""
+    from karpenter_provider_aws_tpu.sim.report import FleetReport
+
+    data = copy.deepcopy(report.data)
+    data.get("virtual", {}).pop("why", None)
+    for rec in data.get("virtual", {}).get("audit", {}).get("records", []):
+        (rec.get("detail") or {}).pop("why", None)
+    return FleetReport(data=data).witness()
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if os.environ.get("KARPENTER_TPU_WHY") == "0":
+        print("why-smoke requires the engine armed "
+              "(unset KARPENTER_TPU_WHY)", file=sys.stderr)
+        return 2
+
+    from karpenter_provider_aws_tpu.sim.driver import FleetSimulator
+
+    failures: list[str] = []
+
+    # -- 1. the armed day, gated against the checked-in baseline ----------
+    armed = FleetSimulator("why-day", seed=0).run()
+    why_plane = armed.data["virtual"].get("why") or {}
+    print(f"why plane: coverage={why_plane.get('coverage')} "
+          f"attributed={why_plane.get('attributed')}"
+          f"/{why_plane.get('unschedulable_records')} "
+          f"reasons={why_plane.get('reasons')}")
+    with tempfile.TemporaryDirectory() as td:
+        report_path = os.path.join(td, "report.json")
+        armed.save(report_path)
+        gate = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "fleet_gate.py"),
+             report_path, "--baseline", BASELINE],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        sys.stdout.write(gate.stdout)
+        sys.stderr.write(gate.stderr)
+        if gate.returncode != 0:
+            failures.append("fleet gate failed (see output above)")
+        for key in ("why_coverage", "retraces_after_warmup"):
+            if key not in gate.stdout:
+                failures.append(f"fleet gate output never mentioned {key}")
+
+    # -- 2. the kill switch is total --------------------------------------
+    os.environ["KARPENTER_TPU_WHY"] = "0"
+    try:
+        disarmed = FleetSimulator("why-day", seed=0).run()
+    finally:
+        os.environ.pop("KARPENTER_TPU_WHY", None)
+    if disarmed.data["virtual"].get("why") is not None:
+        failures.append("killed run still emitted a virtual.why plane")
+    stamped = [
+        r for r in disarmed.data["virtual"]["audit"]["records"]
+        if (r.get("detail") or {}).get("why")
+    ]
+    if stamped:
+        failures.append(
+            f"killed run still why-stamped {len(stamped)} audit records"
+        )
+    if _stripped_witness(armed) != disarmed.witness():
+        failures.append(
+            "KARPENTER_TPU_WHY=0 day is not byte-identical to the armed "
+            "day minus its why channels — the engine steered a decision"
+        )
+    else:
+        print("kill switch: disarmed witness byte-identical to the armed "
+              "day minus why channels")
+
+    # -- 3. the overhead budget, stamped and gated -------------------------
+    from benchmarks.why_bench import run_all
+    from karpenter_provider_aws_tpu.trace.provenance import stamp_row
+
+    at = {"run_at_unix": int(time.time()), "scale": 1.0}
+    with open(DETAIL, "a") as f:
+        for row in run_all():
+            stamp_row(row)
+            f.write(json.dumps({**row, **at}) + "\n")
+    bench = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "bench_gate.py"),
+         DETAIL, "--budgets", BUDGETS],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    sys.stdout.write(bench.stdout)
+    sys.stderr.write(bench.stderr)
+    if bench.returncode != 0:
+        failures.append("bench gate failed on why_overhead (see above)")
+
+    if failures:
+        print("why-smoke FAILED:", file=sys.stderr)
+        for f_ in failures:
+            print(f"  [FAIL] {f_}", file=sys.stderr)
+        return 1
+    print("why-smoke passed: coverage 1.0, kill switch byte-identical, "
+          "overhead within budget")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
